@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Per SURVEY §4: golden-IR tests need no device; execution tests run in Pallas
+interpret mode on CPU; mesh tests run under shard_map on the 8 virtual
+devices. Set TL_TPU_TEST_DEVICE=tpu to run execution tests on real hardware
+instead.
+"""
+
+import os
+
+_ON_TPU = os.environ.get("TL_TPU_TEST_DEVICE", "cpu") == "tpu"
+
+if not _ON_TPU:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # Drop any PJRT plugin a sitecustomize may have registered (e.g. a
+    # tunneled TPU): CPU tests must never touch real hardware.
+    try:
+        import jax._src.xla_bridge as _xb
+        for _name in list(_xb._backend_factories):
+            if _name not in ("cpu", "tpu", "cuda", "rocm", "gpu", "metal"):
+                _xb._backend_factories.pop(_name, None)
+    except Exception:
+        pass

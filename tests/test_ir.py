@@ -1,0 +1,89 @@
+"""Tile-IR unit tests: expression folding, affine analysis, buffers,
+regions (SURVEY §4 style 3: pure-python property tests)."""
+
+import pytest
+
+from tilelang_mesh_tpu.ir import (Buffer, IntImm, Var, as_int, ceildiv,
+                                  convert, linearize, to_region)
+from tilelang_mesh_tpu.ir.expr import affine_decompose, rebuild_affine
+
+
+def test_constant_folding():
+    a = convert(3) + convert(4)
+    assert as_int(a) == 7
+    assert as_int(convert(10) * 5 - 1) == 49
+    assert as_int(ceildiv(100, 32)) == 4
+    assert as_int(ceildiv(96, 32)) == 3
+
+
+def test_algebraic_identities():
+    i = Var("i")
+    assert (i + 0) is i
+    assert (i * 1) is i
+    assert as_int(i * 0) == 0
+    assert (i - 0) is i
+
+
+def test_linearize_affine():
+    i, j = Var("i"), Var("j")
+    e = i * 128 + j * 32 + 64
+    coeffs, const = linearize(e, [i, j])
+    assert coeffs[i] == 128 and coeffs[j] == 32 and const == 64
+
+
+def test_linearize_rejects_nonlinear():
+    i, j = Var("i"), Var("j")
+    assert linearize(i * j, [i, j]) is None
+    # mentions a var outside wrt
+    assert linearize(i + j, [i]) is None
+
+
+def test_affine_decompose_cancellation():
+    i, g = Var("i"), Var("g")
+    e = (g * 128 + i) - g * 128
+    coeffs, const = affine_decompose(e)
+    assert const == 0
+    assert len(coeffs) == 1
+    (v, c), = coeffs.values()
+    assert v is i and c == 1
+
+
+def test_rebuild_affine_roundtrip():
+    i, j = Var("i"), Var("j")
+    e = i * 4 + j * 2 + 9
+    coeffs, const = affine_decompose(e)
+    r = rebuild_affine(coeffs, const)
+    c2, k2 = affine_decompose(r)
+    assert k2 == 9
+    assert {v.name: c for _, (v, c) in c2.items()} == {"i": 4, "j": 2}
+
+
+def test_buffer_region_sugar():
+    A = Buffer("A", (256, 128), "float32")
+    r = to_region(A[0:128, 32:64])
+    assert r.static_shape() == (128, 32)
+    assert as_int(r.base[1]) == 32
+    # element-access base with extent hint
+    i = Var("i")
+    r2 = to_region(A[i * 64, 0], extent_hint=(64, 128))
+    assert r2.static_shape() == (64, 128)
+
+
+def test_buffer_rank_mismatch_hint():
+    # 4-D tensor copied into a 2-D tile: hint right-aligns
+    Q = Buffer("Q", (2, 4, 256, 64), "float32")
+    r = to_region(Q[0, 1, 0, 0], extent_hint=(128, 64))
+    assert r.static_shape() == (1, 1, 128, 64)
+
+
+def test_symbolic_bool_raises():
+    i = Var("i")
+    with pytest.raises(TypeError):
+        bool(i < 5)
+
+
+def test_dtype_promotion():
+    from tilelang_mesh_tpu.ir import promote_dtypes
+    assert promote_dtypes("float32", "bfloat16") == "float32"
+    assert promote_dtypes("int32", "float16") == "float16"
+    assert promote_dtypes("int8", "int32") == "int32"

@@ -1,0 +1,136 @@
+"""Execution + numerics tests for GEMM kernels (SURVEY §4 style 2;
+reference testing/python/kernel/test_tilelang_kernel_gemm.py).
+
+Run in Pallas interpret mode on CPU (which emulates TPU MXU bf16 numerics),
+or on real TPU with TL_TPU_TEST_DEVICE=tpu.
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.utils.tensor import assert_allclose
+
+
+def matmul_kernel(M, N, K, bm, bn, bk, trans_A=False, trans_B=False,
+                  in_dtype="float32", accum_dtype="float32"):
+    a_shape = (K, M) if trans_A else (M, K)
+    b_shape = (N, K) if trans_B else (K, N)
+    a_tile = (bk, bm) if trans_A else (bm, bk)
+    b_tile = (bn, bk) if trans_B else (bk, bn)
+
+    @T.prim_func
+    def main(A: T.Tensor(a_shape, in_dtype),
+             B: T.Tensor(b_shape, in_dtype),
+             C: T.Tensor((M, N), in_dtype)):
+        with T.Kernel(T.ceildiv(N, bn), T.ceildiv(M, bm)) as (bx, by):
+            A_s = T.alloc_shared(a_tile, in_dtype)
+            B_s = T.alloc_shared(b_tile, in_dtype)
+            C_l = T.alloc_fragment((bm, bn), accum_dtype)
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, bk), num_stages=2):
+                if trans_A:
+                    T.copy(A[ko * bk, by * bm], A_s)
+                else:
+                    T.copy(A[by * bm, ko * bk], A_s)
+                if trans_B:
+                    T.copy(B[bx * bn, ko * bk], B_s)
+                else:
+                    T.copy(B[ko * bk, bx * bn], B_s)
+                T.gemm(A_s, B_s, C_l, transpose_A=trans_A,
+                       transpose_B=trans_B)
+            T.copy(C_l, C[by * bm, bx * bn])
+    return main
+
+
+def _ref(a, b, trans_A, trans_B):
+    a = a.T if trans_A else a
+    b = b.T if trans_B else b
+    return (a.astype(np.float32) @ b.astype(np.float32))
+
+
+@pytest.mark.parametrize("trans_A,trans_B", [(False, False), (False, True),
+                                             (True, False), (True, True)])
+def test_gemm_transposes(trans_A, trans_B):
+    M = N = K = 256
+    k = tilelang.compile(matmul_kernel(M, N, K, 128, 128, 64, trans_A,
+                                       trans_B))
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((K, M) if trans_A else (M, K),
+                            dtype=np.float32)
+    b = rng.standard_normal((N, K) if trans_B else (K, N),
+                            dtype=np.float32)
+    c = k(a, b)
+    assert_allclose(c, _ref(a, b, trans_A, trans_B), rtol=2e-2, atol=2e-2)
+
+
+def test_gemm_bf16_accum_f32():
+    import jax.numpy as jnp
+    M = N = K = 256
+    k = tilelang.compile(matmul_kernel(M, N, K, 128, 128, 128,
+                                       in_dtype="bfloat16",
+                                       accum_dtype="float32"))
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((M, K)), jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((K, N)), jnp.bfloat16)
+    c = k(a, b)
+    ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    assert_allclose(np.asarray(c, np.float32), ref, rtol=5e-2, atol=5e-1)
+
+
+def test_gemm_clear_accum():
+    M = N = K = 128
+
+    @T.prim_func
+    def main(A: T.Tensor((M, K), "float32"),
+             B: T.Tensor((K, N), "float32"),
+             C: T.Tensor((M, N), "float32")):
+        with T.Kernel(1, 1) as (bx, by):
+            A_s = T.alloc_shared((M, K), "float32")
+            B_s = T.alloc_shared((K, N), "float32")
+            C_l = T.alloc_fragment((M, N), "float32")
+            T.copy(A, A_s)
+            T.copy(B, B_s)
+            # garbage in accumulator, clear_accum must overwrite
+            T.fill(C_l, 123.0)
+            T.gemm(A_s, B_s, C_l, clear_accum=True)
+            T.copy(C_l, C)
+
+    k = tilelang.compile(main)
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((M, K), dtype=np.float32)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    assert_allclose(k(a, b), a @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_reference_style_call_with_output_arg():
+    """Reference call convention kernel(a, b, c) with c a numpy output."""
+    M = N = K = 128
+    k = tilelang.compile(matmul_kernel(M, N, K, 128, 128, 64))
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((M, K), dtype=np.float32)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    c = np.empty((M, N), dtype=np.float32)
+    k(a, b, c)
+    assert_allclose(c, a @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_profiler_assert_allclose_and_bench():
+    M = N = K = 128
+    k = tilelang.compile(matmul_kernel(M, N, K, 128, 128, 128))
+    prof = k.get_profiler()
+    import jax.numpy as jnp
+    prof.assert_allclose(
+        lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32),
+        rtol=2e-2, atol=2e-2)
+    lat = prof.do_bench(warmup=1, rep=2, backend="wall")
+    assert lat > 0
+
+
+def test_kernel_source_inspectable():
+    k = tilelang.compile(matmul_kernel(128, 128, 128, 128, 128, 64))
+    src = k.get_kernel_source()
+    assert "pl.pallas_call" in src
+    assert "dot_general" in src
+    assert "BlockSpec" in src

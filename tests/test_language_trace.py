@@ -1,0 +1,116 @@
+"""Golden-IR structural tests of the DSL frontend (SURVEY §4 style 1:
+trace a kernel, compare the printed script)."""
+
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def _quickstart(M=256, N=256, K=256, bm=128, bn=128, bk=64):
+    @T.prim_func
+    def matmul_relu_kernel(
+            A: T.Tensor((M, K), "float32"),
+            B: T.Tensor((K, N), "float32"),
+            C: T.Tensor((M, N), "float32"),
+    ):
+        with T.Kernel(T.ceildiv(N, bn), T.ceildiv(M, bm),
+                      threads=128) as (bx, by):
+            A_shared = T.alloc_shared((bm, bk), "float32")
+            B_shared = T.alloc_shared((bk, bn), "float32")
+            C_local = T.alloc_fragment((bm, bn), "float32")
+            T.clear(C_local)
+            for ko in T.Pipelined(T.ceildiv(K, bk), num_stages=3):
+                T.copy(A[by * bm, ko * bk], A_shared)
+                T.copy(B[ko * bk, bx * bn], B_shared)
+                T.gemm(A_shared, B_shared, C_local)
+            for i, j in T.Parallel(bm, bn):
+                C_local[i, j] = T.max(C_local[i, j], 0)
+            T.copy(C_local, C[by * bm, bx * bn])
+    return matmul_relu_kernel
+
+
+GOLDEN_QUICKSTART = """\
+def matmul_relu_kernel(A: Tensor((256, 256), float32), B: Tensor((256, 256), float32), C: Tensor((256, 256), float32)):
+  with Kernel((2, 2), threads=128) as (bx, by,):
+    shared = alloc((128, 64), float32, scope=shared)
+    shared_1 = alloc((64, 128), float32, scope=shared)
+    frag = alloc((128, 128), float32, scope=fragment)
+    fill(frag[(0, 0); (128, 128)], 0)
+    for (ko,) in pipelined((4), num_stages=3):
+      copy(A[(by * 128, ko * 64); (128, 64)] -> shared[(0, 0); (128, 64)])
+      copy(B[(ko * 64, bx * 128); (64, 128)] -> shared_1[(0, 0); (64, 128)])
+      gemm(shared[(0, 0); (128, 64)], shared_1[(0, 0); (64, 128)] -> frag[(0, 0); (128, 128)])
+    for (i, j,) in parallel((128, 128)):
+      frag[i, j] = max(frag[i, j], 0)
+    copy(frag[(0, 0); (128, 128)] -> C[(by * 128, bx * 128); (128, 128)])
+"""
+
+
+def test_quickstart_golden_script():
+    assert _quickstart().script() == GOLDEN_QUICKSTART
+
+
+def test_trace_is_deterministic():
+    assert _quickstart().script() == _quickstart().script()
+
+
+GOLDEN_PLAN = """\
+plan(matmul_relu_kernel):
+  grid = [by:2:parallel, bx:2:parallel, ko:4:arbitrary]
+  in    A: block[128@(by), 64@(ko)] alias=shared
+  in    B: block[64@(ko), 128@(bx)] alias=shared_1
+  out   C: block[128@(by), 128@(bx)]
+  scratch frag: (128, 128) float32 [fragment]
+  phases: init=1 main=3 epi=2
+"""
+
+
+def test_quickstart_plan_golden():
+    art = tilelang.lower(_quickstart(), target="cpu")
+    assert art.plan_desc == GOLDEN_PLAN
+
+
+def test_gemm_shape_validation():
+    with pytest.raises(ValueError, match="K mismatch"):
+        @T.prim_func
+        def bad(A: T.Tensor((128, 64), "float32"),
+                B: T.Tensor((32, 128), "float32"),
+                C: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                a = T.alloc_shared((128, 64), "float32")
+                b = T.alloc_shared((32, 128), "float32")
+                c = T.alloc_fragment((128, 128), "float32")
+                T.gemm(a, b, c)
+
+
+def test_copy_extent_validation():
+    with pytest.raises(ValueError, match="extent mismatch"):
+        @T.prim_func
+        def bad(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((64, 64), "float32")
+                T.copy(A[0:128, 0:128], s)
+
+
+def test_kernel_frame_requires_static_grid():
+    with pytest.raises(ValueError, match="static"):
+        @T.prim_func
+        def bad(A: T.Tensor((128, 128), "float32"), n: T.dyn("int32")):
+            with T.Kernel(n) as bx:
+                pass
+
+
+def test_alloc_outside_prim_func_raises():
+    with pytest.raises(RuntimeError):
+        T.alloc_shared((8, 8), "float32")
+
+
+def test_gpu_only_constructs_raise():
+    @T.prim_func
+    def k(A: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            with pytest.raises(NotImplementedError):
+                T.alloc_tmem((8, 128), "float32")
+            with pytest.raises(NotImplementedError):
+                T.thread_binding()

@@ -1,0 +1,72 @@
+"""tilelang-mesh-tpu: a TPU-native tile-kernel framework.
+
+A ground-up re-design of TileLang-Mesh (xiaoyao-NKU/Tilelang-Mesh) for TPU:
+the same tile-level DSL — typed kernels, VMEM tiles, pipelined copies, MXU
+GEMM, mesh-distributed tensors with collectives — compiled through a tile-IR
+pass pipeline to Pallas/Mosaic kernels wrapped in jax.jit, with the Mesh
+layer lowering to ICI collectives under shard_map.
+
+Usage mirrors the reference (/root/reference/tilelang/__init__.py)::
+
+    import tilelang_mesh_tpu as tilelang
+    import tilelang_mesh_tpu.language as T
+
+    @tilelang.jit
+    def matmul(M, N, K, bm, bn, bk):
+        @T.prim_func
+        def kernel(A: T.Tensor((M, K), "bfloat16"), ...): ...
+        return kernel
+"""
+
+__version__ = "0.1.0"
+
+import logging as _logging
+
+logger = _logging.getLogger("tilelang_mesh_tpu")
+
+
+def set_log_level(level):
+    if isinstance(level, str):
+        level = getattr(_logging, level.upper())
+    logger.setLevel(level)
+
+
+from .env import env  # noqa: E402
+
+# language namespace (import as tilelang_mesh_tpu.language)
+from . import language  # noqa: E402
+
+# engine
+from .engine.lower import lower  # noqa: E402
+from .engine.param import CompiledArtifact, KernelParam  # noqa: E402
+
+# jit / kernels
+from .jit import compile, par_compile, jit, lazy_jit  # noqa: E402,A004
+from .jit.kernel import JITKernel  # noqa: E402
+
+# cache
+from .cache.kernel_cache import cached, clear_cache  # noqa: E402
+
+# profiler
+from .profiler import Profiler, do_bench  # noqa: E402
+from .utils.tensor import TensorSupplyType  # noqa: E402
+
+# autotuner
+from .autotuner import autotune, AutoTuner  # noqa: E402
+
+# transform / pass config
+from .transform.pass_config import PassConfigKey  # noqa: E402
+
+# target utilities
+from .utils.target import determine_target, TPU_TARGET_DESC  # noqa: E402
+
+# mesh extension
+from . import parallel  # noqa: E402
+
+__all__ = [
+    "language", "jit", "lazy_jit", "compile", "par_compile", "lower",
+    "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
+    "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
+    "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
+    "env", "logger", "set_log_level", "__version__",
+]

@@ -1,0 +1,2 @@
+from .checkers import (NestedLoopChecker, FragmentLoopChecker,
+                       run_semantic_checks, SemanticError)

@@ -1,0 +1,88 @@
+"""Pre-lower semantic checks.
+
+Reference: /root/reference/tilelang/analysis/nested_loop_checker.py and
+fragment_loop_checker.py, run by PreLowerSemanticCheck
+(tilelang/engine/phase.py:112). Same job here: reject IR shapes the rest of
+the pipeline would mis-compile, with actionable messages.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..ir import (CommStmt, CopyStmt, ForNest, GemmStmt, KernelNode, PrimFunc,
+                  walk)
+
+
+class SemanticError(Exception):
+    pass
+
+
+class NestedLoopChecker:
+    """Pipelined loops must not nest inside Parallel loops, and T.Parallel
+    nests must not contain tile-ops (they are elementwise regions)."""
+
+    def check(self, func: PrimFunc) -> List[str]:
+        errs: List[str] = []
+
+        def visit(s, in_parallel=False):
+            if isinstance(s, ForNest):
+                if s.kind == "parallel":
+                    for c in s.body.stmts:
+                        visit(c, True)
+                    return
+                if in_parallel:
+                    errs.append(
+                        f"loop kind {s.kind!r} nested inside T.Parallel; "
+                        "T.Parallel bodies must be elementwise")
+                for c in s.body.stmts:
+                    visit(c, in_parallel)
+            elif in_parallel and isinstance(s, (CopyStmt, GemmStmt,
+                                                CommStmt)):
+                errs.append(
+                    f"tile op {type(s).__name__} inside T.Parallel; hoist it "
+                    "out of the elementwise loop")
+            else:
+                for attr in ("body", "then_body", "else_body"):
+                    b = getattr(s, attr, None)
+                    if b is not None:
+                        for c in getattr(b, "stmts", []):
+                            visit(c, in_parallel)
+
+        kn = func.kernel_node()
+        if kn is not None:
+            for s in kn.body.stmts:
+                visit(s)
+        return errs
+
+
+class FragmentLoopChecker:
+    """Comm ops must sit at the top level of the kernel body (the SPMD
+    phase-splitter cannot hoist them out of loops yet)."""
+
+    def check(self, func: PrimFunc) -> List[str]:
+        errs: List[str] = []
+        kn = func.kernel_node()
+        if kn is None:
+            return errs
+        top = set(id(s) for s in kn.body.stmts)
+
+        def note(s):
+            if isinstance(s, CommStmt) and id(s) not in top:
+                errs.append(
+                    "T.comm.* collective nested inside a loop/branch; move "
+                    "it to the top level of the T.Kernel body")
+        walk(kn.body, note)
+        return errs
+
+
+def run_semantic_checks(func: PrimFunc) -> None:
+    errs: List[str] = []
+    for checker in (NestedLoopChecker(), FragmentLoopChecker()):
+        errs.extend(checker.check(func))
+    if func.kernel_node() is None:
+        errs.append("kernel body has no `with T.Kernel(...)` frame")
+    if errs:
+        raise SemanticError(
+            f"{func.name}: semantic check failed:\n  - " +
+            "\n  - ".join(errs))

@@ -1,0 +1,132 @@
+"""Autotuner: config grid search over jit kernel factories.
+
+Reference: /root/reference/tilelang/autotuner/tuner.py (AutoTuner:100,
+autotune:685). Same surface:
+
+    @tilelang.autotune(configs=[{"block_M": 128, ...}, ...])
+    @tilelang.jit
+    def matmul(M, N, K, block_M=128, block_N=128, block_K=32): ...
+    kernel = matmul(1024, 1024, 1024)     # tuned over configs
+
+Candidates compile on a thread pool; each is benchmarked with the in-graph
+profiler; failures are isolated per-config (the reference's timeout/
+ignore_error guard) and results persist to disk keyed by the factory source
+and args.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import logging
+import inspect
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..env import env
+from ..profiler import Profiler
+from ..utils.tensor import TensorSupplyType
+
+logger = logging.getLogger("tilelang_mesh_tpu.autotune")
+
+
+@dataclass
+class AutotuneResult:
+    config: Dict[str, Any]
+    latency_ms: float
+    kernel: Any = None
+
+
+class AutoTuner:
+    def __init__(self, fn: Callable, configs: Sequence[Dict[str, Any]],
+                 warmup: int = 3, rep: int = 20,
+                 supply_type: TensorSupplyType = TensorSupplyType.Auto,
+                 cache_results: bool = True):
+        self.fn = fn
+        self.configs = list(configs)
+        self.warmup = warmup
+        self.rep = rep
+        self.supply_type = supply_type
+        self.cache_results = cache_results
+
+    # ------------------------------------------------------------------
+    def _disk_key(self, args, kwargs) -> str:
+        h = hashlib.sha256()
+        try:
+            src = inspect.getsource(getattr(self.fn, "fn", self.fn))
+        except (OSError, TypeError):
+            src = repr(self.fn)
+        h.update(src.encode())
+        h.update(repr(args).encode())
+        h.update(repr(sorted(kwargs.items())).encode())
+        h.update(json.dumps(self.configs, sort_keys=True,
+                            default=str).encode())
+        return h.hexdigest()
+
+    def run(self, *args, **kwargs) -> AutotuneResult:
+        key = self._disk_key(args, kwargs)
+        cache_f = env.autotune_dir() / f"{key}.json"
+        if self.cache_results and cache_f.exists():
+            try:
+                best_cfg = json.loads(cache_f.read_text())["config"]
+                kernel = self.fn(*args, **{**kwargs, **best_cfg})
+                rec = json.loads(cache_f.read_text())
+                return AutotuneResult(best_cfg, rec["latency_ms"], kernel)
+            except Exception:
+                pass
+
+        best: Optional[AutotuneResult] = None
+        for cfg in self.configs:
+            try:
+                kernel = self.fn(*args, **{**kwargs, **cfg})
+                prof = Profiler(kernel, self.supply_type)
+                lat = prof.do_bench(warmup=self.warmup, rep=self.rep)
+            except Exception as e:  # config isolation (tuner.py:51)
+                logger.debug("autotune config %s failed: %s", cfg, e)
+                continue
+            logger.info("autotune %s -> %.4f ms", cfg, lat)
+            if best is None or lat < best.latency_ms:
+                best = AutotuneResult(cfg, lat, kernel)
+        if best is None:
+            raise RuntimeError("autotune: every candidate config failed")
+        if self.cache_results:
+            cache_f.write_text(json.dumps(
+                {"config": best.config, "latency_ms": best.latency_ms}))
+        return best
+
+
+class AutoTuneImpl:
+    def __init__(self, fn: Callable, configs, warmup: int, rep: int,
+                 supply_type: TensorSupplyType, cache_results: bool):
+        functools.update_wrapper(self, fn)
+        self.tuner = AutoTuner(fn, configs, warmup, rep, supply_type,
+                               cache_results)
+        self._cache: Dict[Any, Any] = {}
+
+    def __call__(self, *args, **kwargs):
+        key = (tuple(args), tuple(sorted(kwargs.items())))
+        if key not in self._cache:
+            res = self.tuner.run(*args, **kwargs)
+            kernel = res.kernel
+            kernel.latency = res.latency_ms
+            kernel.config = res.config
+            self._cache[key] = kernel
+        return self._cache[key]
+
+
+def autotune(fn: Optional[Callable] = None, *,
+             configs: Optional[Sequence[Dict[str, Any]]] = None,
+             warmup: int = 3, rep: int = 20,
+             supply_type: TensorSupplyType = TensorSupplyType.Auto,
+             cache_results: bool = True, **_ignored):
+    if configs is None:
+        raise ValueError("autotune requires configs=[...]")
+
+    def wrap(f):
+        return AutoTuneImpl(f, configs, warmup, rep, supply_type,
+                            cache_results)
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -1,0 +1,1 @@
+from .kernel_cache import KernelCache, cached, clear_cache

@@ -1,0 +1,150 @@
+"""Persistent compiled-kernel cache.
+
+Reference: /root/reference/tilelang/cache/kernel_cache.py (KernelCache:31,
+sha256 key :69-112, disk layout :22-28). Same two-level design (memory ->
+disk -> build); the artifact on disk is the generated Pallas source plus a
+JSON param table instead of .cu/.so files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from ..engine.param import CompiledArtifact, KernelParam
+from ..env import env
+
+KERNEL_SOURCE_FILE = "kernel.py"
+ARTIFACT_FILE = "artifact.json"
+
+
+class KernelCache:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __new__(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = super().__new__(cls)
+                cls._instance._mem: Dict[str, Any] = {}
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def key_for(ir_script: str, target: str, out_idx, pass_cfg: dict) -> str:
+        from .. import __version__
+        h = hashlib.sha256()
+        h.update(ir_script.encode())
+        h.update(target.encode())
+        h.update(repr(out_idx).encode())
+        h.update(json.dumps(pass_cfg, sort_keys=True, default=str).encode())
+        h.update(__version__.encode())
+        return h.hexdigest()
+
+    def get(self, key: str):
+        return self._mem.get(key)
+
+    def put(self, key: str, kernel):
+        self._mem[key] = kernel
+
+    def clear(self):
+        self._mem.clear()
+
+    # -- disk ----------------------------------------------------------------
+    def _dir(self, key: str) -> Path:
+        return env.cache_dir() / key
+
+    def load_artifact(self, key: str) -> Optional[CompiledArtifact]:
+        if env.TL_TPU_DISABLE_CACHE:
+            return None
+        d = self._dir(key)
+        src_f, meta_f = d / KERNEL_SOURCE_FILE, d / ARTIFACT_FILE
+        if not (src_f.exists() and meta_f.exists()):
+            return None
+        try:
+            meta = json.loads(meta_f.read_text())
+            params = [KernelParam(p["name"], tuple(p["shape"]), p["dtype"],
+                                  p["role"]) for p in meta["params"]]
+            return CompiledArtifact(
+                name=meta["name"], params=params,
+                kernel_source=src_f.read_text(), target=meta["target"],
+                grid=tuple(meta["grid"]), ir_script=meta.get("ir_script", ""),
+                plan_desc=meta.get("plan_desc", ""),
+                mesh_config=tuple(meta["mesh_config"])
+                if meta.get("mesh_config") else None,
+                attrs=meta.get("attrs", {}))
+        except Exception:
+            return None
+
+    def save_artifact(self, key: str, art: CompiledArtifact) -> None:
+        if env.TL_TPU_DISABLE_CACHE:
+            return
+        # mesh artifacts carry non-serializable closures; only source-backed
+        # kernels are disk-cacheable
+        if art.attrs.get("no_disk_cache"):
+            return
+        d = self._dir(key)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / KERNEL_SOURCE_FILE).write_text(art.kernel_source)
+        meta = {
+            "name": art.name,
+            "target": art.target,
+            "grid": list(art.grid),
+            "params": [{"name": p.name, "shape": list(p.shape),
+                        "dtype": p.dtype, "role": p.role}
+                       for p in art.params],
+            "ir_script": art.ir_script,
+            "plan_desc": art.plan_desc,
+            "mesh_config": list(art.mesh_config) if art.mesh_config else None,
+            "attrs": {k: v for k, v in art.attrs.items()
+                      if isinstance(v, (str, int, float, bool, list))},
+        }
+        (d / ARTIFACT_FILE).write_text(json.dumps(meta, indent=1))
+
+
+_CACHE = KernelCache()
+
+
+def cached(func, target: str = "auto", out_idx=None,
+           pass_configs: Optional[dict] = None, verbose: bool = False):
+    """memory -> disk -> lower+build, mirroring reference cached():114."""
+    from ..engine.lower import lower
+    from ..jit.kernel import JITKernel
+    from ..language.builder import PrimFuncObj
+    from ..utils.target import determine_target
+
+    target = determine_target(target)
+    ir_script = func.script() if isinstance(func, PrimFuncObj) else \
+        func.script()
+    cfg = {getattr(k, "value", str(k)): v
+           for k, v in (pass_configs or {}).items()}
+    key = _CACHE.key_for(ir_script, target, out_idx, cfg)
+
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    art = _CACHE.load_artifact(key)
+    if art is None:
+        art = lower(func, target=target, pass_configs=pass_configs)
+        _CACHE.save_artifact(key, art)
+    if art.attrs.get("is_mesh"):
+        from ..parallel.lowering import MeshKernel
+        kernel: Any = MeshKernel(art, out_idx=out_idx)
+    else:
+        kernel = JITKernel(art, out_idx=out_idx, verbose=verbose)
+    _CACHE.put(key, kernel)
+    if env.TL_TPU_PRINT_ON_COMPILATION:
+        print(f"[tilelang_mesh_tpu] compiled {art.name} for {target} "
+              f"(grid={art.grid})")
+    return kernel
+
+
+def clear_cache(disk: bool = False):
+    _CACHE.clear()
+    if disk:
+        import shutil
+        shutil.rmtree(env.cache_dir(), ignore_errors=True)

@@ -1,0 +1,5 @@
+from .arch import (TPUArch, TPU_V4, TPU_V5E, TPU_V5P, TPU_V6E, auto_arch,
+                   TPUMeshArch)
+from .roller import (MatmulTemplate, FlashAttentionTemplate,
+                     ElementwiseTemplate, GeneralReductionTemplate,
+                     recommend_hints, Hint)

@@ -1,0 +1,87 @@
+"""TPU architecture models for the tile-config recommender.
+
+Reference: /root/reference/tilelang/carver/arch/ (CUDA SM models,
+driver/sunmmio_driver.py's per-core SRAM model). The TPU analog captures what
+bounds a tile choice: VMEM capacity, MXU shape, dtype-dependent (sublane,
+lane) tiling, HBM bandwidth, and ICI links for the mesh tier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TPUArch:
+    name: str
+    mxu_shape: Tuple[int, int] = (128, 128)
+    vpu_shape: Tuple[int, int] = (8, 128)
+    vmem_bytes: int = 16 * 2 ** 20        # per core
+    smem_bytes: int = 1 * 2 ** 20
+    hbm_gbps: float = 1200.0              # HBM bandwidth GB/s
+    bf16_tflops: float = 200.0            # peak MXU throughput
+    ici_gbps_per_link: float = 90.0       # per ICI link, per direction
+    ici_links: int = 4
+    cores_per_chip: int = 1
+
+    def min_tile(self, dtype: str) -> Tuple[int, int]:
+        """Minimum (sublane, lane) tile per dtype (Mosaic packing rules)."""
+        from ..ir import dtype_bits
+        bits = dtype_bits(dtype)
+        sublane = {32: 8, 16: 16, 8: 32}.get(bits, 8)
+        return (sublane, 128)
+
+    def fits_vmem(self, *buffers: Tuple[Tuple[int, ...], str],
+                  budget: float = 0.9) -> bool:
+        from ..ir import dtype_bits
+        total = 0
+        for shape, dtype in buffers:
+            n = 1
+            for s in shape:
+                n *= s
+            total += n * dtype_bits(dtype) // 8
+        return total <= budget * self.vmem_bytes
+
+
+TPU_V4 = TPUArch("tpu_v4", vmem_bytes=16 * 2 ** 20, hbm_gbps=1200.0,
+                 bf16_tflops=137.5, cores_per_chip=2)
+TPU_V5E = TPUArch("tpu_v5e", vmem_bytes=16 * 2 ** 20, hbm_gbps=819.0,
+                  bf16_tflops=197.0)
+TPU_V5P = TPUArch("tpu_v5p", vmem_bytes=16 * 2 ** 20, hbm_gbps=2765.0,
+                  bf16_tflops=229.0, ici_gbps_per_link=100.0, ici_links=6,
+                  cores_per_chip=2)
+TPU_V6E = TPUArch("tpu_v6e", vmem_bytes=32 * 2 ** 20, hbm_gbps=1640.0,
+                  bf16_tflops=918.0)
+
+_BY_KIND = {"v4": TPU_V4, "v5e": TPU_V5E, "v5 lite": TPU_V5E,
+            "v5litepod": TPU_V5E, "v5p": TPU_V5P, "v6e": TPU_V6E,
+            "v6 lite": TPU_V6E}
+
+
+def auto_arch() -> TPUArch:
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+        for k, arch in _BY_KIND.items():
+            if k in kind:
+                return arch
+    except Exception:
+        pass
+    return TPU_V5E
+
+
+@dataclass(frozen=True)
+class TPUMeshArch:
+    """A pod-slice mesh: the analog of SunmmioDeviceProperties
+    (reference sunmmio_driver.py:7-16 — 4x4 mesh, per-core SRAM banks)."""
+    chip: TPUArch
+    mesh_config: Tuple[int, int] = (4, 4)
+
+    @property
+    def num_chips(self) -> int:
+        return self.mesh_config[0] * self.mesh_config[1]
+
+    def bisection_gbps(self) -> float:
+        r, c = self.mesh_config
+        return min(r, c) * self.chip.ici_gbps_per_link * 2

@@ -1,0 +1,137 @@
+"""Tile-config recommendation ("roller").
+
+Reference: /root/reference/tilelang/carver/roller/ (DefaultPolicy,
+TensorCorePolicy) + template/. Re-founded on TPU constraints: candidate
+tiles are multiples of the dtype's (sublane, lane) packing, scored by an
+arithmetic-intensity model against VMEM capacity — the same role
+TensorCorePolicy's smem/warp model plays for CUDA.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .arch import TPUArch, auto_arch
+from ..ir import dtype_bits
+
+
+@dataclass
+class Hint:
+    config: Dict[str, int]
+    score: float
+
+    def __repr__(self):
+        return f"Hint({self.config}, score={self.score:.3g})"
+
+
+def _tile_candidates(dim: int, minimum: int, cap: int = 1024) -> List[int]:
+    out = []
+    t = minimum
+    while t <= min(dim, cap):
+        if dim % t == 0:
+            out.append(t)
+        t *= 2
+    return out or [min(dim, minimum)]
+
+
+@dataclass
+class MatmulTemplate:
+    """GEMM M/N/K tiling (reference carver/template/matmul.py)."""
+    M: int
+    N: int
+    K: int
+    in_dtype: str = "bfloat16"
+    accum_dtype: str = "float32"
+    arch: Optional[TPUArch] = None
+
+    def hints(self, topk: int = 10) -> List[Hint]:
+        arch = self.arch or auto_arch()
+        sub, lane = arch.min_tile(self.in_dtype)
+        ib = dtype_bits(self.in_dtype) // 8
+        ab = dtype_bits(self.accum_dtype) // 8
+        cands = []
+        for bm in _tile_candidates(self.M, max(sub, 128), 1024):
+            for bn in _tile_candidates(self.N, lane, 1024):
+                for bk in _tile_candidates(self.K, max(sub, 128), 2048):
+                    # VMEM: A tile + B tile (double-buffered by Mosaic) +
+                    # f32 accumulator
+                    vmem = 2 * (bm * bk + bk * bn) * ib + bm * bn * ab
+                    if vmem > 0.9 * arch.vmem_bytes:
+                        continue
+                    # score: arithmetic intensity x MXU utilization
+                    flops = 2 * bm * bn * bk
+                    bytes_moved = (bm * bk + bk * bn) * ib
+                    intensity = flops / bytes_moved
+                    mxu_util = min(bm / arch.mxu_shape[0], 1.0) * \
+                        min(bn / arch.mxu_shape[1], 1.0)
+                    # prefer larger K tiles (fewer grid steps, less accum
+                    # traffic) but cap the benefit
+                    k_bonus = min(bk / 512, 1.0)
+                    score = intensity * mxu_util * (0.5 + 0.5 * k_bonus)
+                    cands.append(Hint(
+                        {"block_M": bm, "block_N": bn, "block_K": bk},
+                        score))
+        cands.sort(key=lambda h: -h.score)
+        return cands[:topk]
+
+
+@dataclass
+class FlashAttentionTemplate:
+    seq_q: int
+    seq_k: int
+    head_dim: int
+    dtype: str = "bfloat16"
+    arch: Optional[TPUArch] = None
+
+    def hints(self, topk: int = 8) -> List[Hint]:
+        arch = self.arch or auto_arch()
+        ib = dtype_bits(self.dtype) // 8
+        cands = []
+        for bm in _tile_candidates(self.seq_q, 128, 1024):
+            for bn in _tile_candidates(self.seq_k, 128, 1024):
+                vmem = (bm * self.head_dim * ib          # Q tile
+                        + 2 * 2 * bn * self.head_dim * ib  # K,V double-buf
+                        + bm * bn * 4                     # scores f32
+                        + bm * self.head_dim * 4          # acc f32
+                        + 4 * bm * 4)                     # stats rows
+                if vmem > 0.9 * arch.vmem_bytes:
+                    continue
+                score = min(bm / 256, 1.0) * min(bn / 512, 1.0) + \
+                    0.1 * (bm * bn) / (1024 * 1024)
+                cands.append(Hint({"block_M": bm, "block_N": bn}, score))
+        cands.sort(key=lambda h: -h.score)
+        return cands[:topk]
+
+
+@dataclass
+class ElementwiseTemplate:
+    shape: Tuple[int, ...]
+    dtype: str = "float32"
+    arch: Optional[TPUArch] = None
+
+    def hints(self, topk: int = 6) -> List[Hint]:
+        arch = self.arch or auto_arch()
+        rows = self.shape[-2] if len(self.shape) >= 2 else 1
+        cols = self.shape[-1]
+        sub, lane = arch.min_tile(self.dtype)
+        cands = []
+        for bm in _tile_candidates(rows, sub, 2048):
+            for bn in _tile_candidates(cols, lane, 4096):
+                n = bm * bn * dtype_bits(self.dtype) // 8
+                if n > 0.45 * arch.vmem_bytes:
+                    continue
+                cands.append(Hint({"block_M": bm, "block_N": bn},
+                                  float(n)))
+        cands.sort(key=lambda h: -h.score)
+        return cands[:topk]
+
+
+@dataclass
+class GeneralReductionTemplate(ElementwiseTemplate):
+    pass
+
+
+def recommend_hints(template, topk: int = 10) -> List[Hint]:
+    return template.hints(topk)

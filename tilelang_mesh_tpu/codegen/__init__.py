@@ -1,0 +1,2 @@
+from . import rt
+from .pallas import generate_source, CodegenError

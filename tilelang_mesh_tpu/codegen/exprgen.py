@@ -1,0 +1,250 @@
+"""Expression -> Python/jnp source printing for the Pallas codegen.
+
+Two modes share one printer:
+  scalar mode     — indices, loop bounds, conditions (plain ints / traced
+                    scalars)
+  vectorized mode — inside a T.Parallel nest, loop vars become array axes;
+                    BufferLoads print as ref slices transposed/expanded onto
+                    the canonical loop-var axis order (the VPU analog of the
+                    reference's thread-fragment index maps,
+                    cf. src/layout/layout.cc Fragment).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..ir import (BinOp, BoolImm, Buffer, BufferLoad, Call, Cast, FloatImm,
+                  IntImm, PrimExpr, StringImm, Var, as_int, linearize)
+
+_JNP_DT = {
+    "float64": "jnp.float64", "float32": "jnp.float32",
+    "float16": "jnp.float16", "bfloat16": "jnp.bfloat16",
+    "float8_e4m3fn": "jnp.float8_e4m3fn", "float8_e5m2": "jnp.float8_e5m2",
+    "int64": "jnp.int64", "int32": "jnp.int32", "int16": "jnp.int16",
+    "int8": "jnp.int8", "uint64": "jnp.uint64", "uint32": "jnp.uint32",
+    "uint16": "jnp.uint16", "uint8": "jnp.uint8", "bool": "jnp.bool_",
+}
+
+
+def jnp_dtype(dt: str) -> str:
+    return _JNP_DT[dt]
+
+
+_BIN = {"+": "+", "-": "-", "*": "*", "/": "/", "//": "//", "%": "%",
+        "<": "<", "<=": "<=", ">": ">", ">=": ">=", "==": "==", "!=": "!="}
+
+_CALLS = {
+    "exp": "jnp.exp", "exp2": "jnp.exp2", "exp10": "rt_exp10",
+    "log": "jnp.log", "log2": "jnp.log2", "log10": "jnp.log10",
+    "log1p": "jnp.log1p", "sqrt": "jnp.sqrt", "rsqrt": "jax.lax.rsqrt",
+    "sin": "jnp.sin", "cos": "jnp.cos", "tan": "jnp.tan",
+    "sinh": "jnp.sinh", "cosh": "jnp.cosh", "tanh": "jnp.tanh",
+    "asin": "jnp.arcsin", "acos": "jnp.arccos", "atan": "jnp.arctan",
+    "atan2": "jnp.arctan2", "erf": "jax.lax.erf", "floor": "jnp.floor",
+    "ceil": "jnp.ceil", "round": "jnp.round", "trunc": "jnp.trunc",
+    "sigmoid": "jax.nn.sigmoid", "abs": "jnp.abs", "pow": "jnp.power",
+    "fmod": "jnp.fmod", "where": "jnp.where",
+    "logical_not": "jnp.logical_not",
+}
+
+
+class ExprGenError(Exception):
+    pass
+
+
+class ExprGen:
+    """Prints tile-IR expressions as Python source.
+
+    var_env:   id(Var) -> source string (grid ids, loop vars, dyn consts)
+    accessors: buffer uid -> BufferAccessor (from pallas.py)
+    par_vars:  canonical vectorization axes [(Var, extent)] or None
+    """
+
+    def __init__(self, var_env: Dict[int, str], accessors: Dict[int, Any],
+                 par_vars: Optional[List[Tuple[Var, int]]] = None):
+        self.var_env = var_env
+        self.accessors = accessors
+        self.par_vars = par_vars or []
+        self._par_ids = {id(v) for v, _ in self.par_vars}
+
+    # -- scalar printing -----------------------------------------------------
+    def scalar(self, e: Any, prec: int = 0) -> str:
+        if isinstance(e, Var):
+            try:
+                return self.var_env[id(e)]
+            except KeyError:
+                raise ExprGenError(f"unbound variable {e.name} in expression")
+        if isinstance(e, IntImm):
+            return str(e.value)
+        if isinstance(e, FloatImm):
+            return repr(e.value)
+        if isinstance(e, BoolImm):
+            return str(e.value)
+        if isinstance(e, StringImm):
+            return repr(e.value)
+        if isinstance(e, BinOp):
+            return self._binop(e, self.scalar)
+        if isinstance(e, Call):
+            return self._call(e, self.scalar)
+        if isinstance(e, Cast):
+            return f"rt.cast({self.scalar(e.value)}, {jnp_dtype(e.dtype)})"
+        if isinstance(e, BufferLoad):
+            return self._scalar_load(e)
+        if isinstance(e, (int, float, bool)):
+            return repr(e)
+        raise ExprGenError(f"cannot print {type(e).__name__}")
+
+    def _binop(self, e: BinOp, rec) -> str:
+        if e.op == "min":
+            return f"jnp.minimum({rec(e.a)}, {rec(e.b)})"
+        if e.op == "max":
+            return f"jnp.maximum({rec(e.a)}, {rec(e.b)})"
+        if e.op == "and":
+            return f"jnp.logical_and({rec(e.a)}, {rec(e.b)})"
+        if e.op == "or":
+            return f"jnp.logical_or({rec(e.a)}, {rec(e.b)})"
+        return f"({rec(e.a)} {_BIN[e.op]} {rec(e.b)})"
+
+    def _call(self, e: Call, rec) -> str:
+        if e.name == "max_value":
+            return f"rt.max_value({jnp_dtype(e.args[0])})" \
+                if isinstance(e.args[0], str) else "jnp.inf"
+        if e.name == "min_value":
+            return f"rt.min_value({jnp_dtype(e.args[0])})" \
+                if isinstance(e.args[0], str) else "-jnp.inf"
+        if e.name == "bitcast":
+            val, dt = e.args
+            return (f"jax.lax.bitcast_convert_type({rec(val)}, "
+                    f"{jnp_dtype(dt)})")
+        if e.name == "current_core":
+            raise ExprGenError(
+                "T.current_core() only has meaning in a mesh kernel; compile "
+                "with a tpu-mesh target")
+        fn = _CALLS.get(e.name)
+        if fn is None:
+            raise ExprGenError(f"no TPU lowering for intrinsic {e.name!r}")
+        args = ", ".join(rec(a) for a in e.args if not isinstance(a, str))
+        return f"{fn}({args})"
+
+    def _scalar_load(self, e: BufferLoad) -> str:
+        acc = self.accessors.get(e.buffer.uid)
+        if acc is None:
+            raise ExprGenError(f"no accessor for buffer {e.buffer.name}")
+        if acc.kind == "any":
+            raise ExprGenError(
+                f"buffer {e.buffer.name} is HBM-resident (no block mapping); "
+                "T.copy it into an on-chip buffer before reading")
+        idx = []
+        for i in e.indices:
+            if isinstance(i, slice):
+                raise ExprGenError("sliced load in scalar context")
+            idx.append(self.scalar(i))
+        return acc.load_elem(idx)
+
+    # -- vectorized printing -------------------------------------------------
+    def vector(self, e: Any) -> str:
+        if isinstance(e, BufferLoad):
+            return self._vector_load(e)
+        if isinstance(e, BinOp):
+            return self._binop(e, self.vector)
+        if isinstance(e, Call):
+            return self._call(e, self.vector)
+        if isinstance(e, Cast):
+            return f"({self.vector(e.value)}).astype({jnp_dtype(e.dtype)})"
+        if isinstance(e, Var):
+            if id(e) in self._par_ids:
+                # a bare loop var used as a value -> iota along its axis
+                pos = [i for i, (v, _) in enumerate(self.par_vars)
+                       if id(v) == id(e)][0]
+                shape = tuple(x for _, x in self.par_vars)
+                return (f"jax.lax.broadcasted_iota(jnp.int32, "
+                        f"{shape}, {pos})")
+            return self.scalar(e)
+        return self.scalar(e)
+
+    def analyze_indices(self, buffer: Buffer, indices: Sequence[Any]):
+        """Split access indices into per-dim (kind, payload):
+        ('var', var, residual_expr) | ('scalar', expr). Raises when the
+        pattern is not one par var with unit stride per dim."""
+        from ..ir.expr import affine_decompose, rebuild_affine
+        out = []
+        for i in indices:
+            if isinstance(i, slice):
+                raise ExprGenError("explicit slices inside T.Parallel bodies "
+                                   "are not supported; index elementwise")
+            dec = affine_decompose(i)
+            if dec is None:
+                for v, _ in self.par_vars:
+                    if _mentions(i, v):
+                        raise ExprGenError(
+                            "non-affine use of a T.Parallel loop var in an "
+                            "index expression")
+                out.append(("scalar", i))
+                continue
+            coeffs, const = dec
+            pterms = {k: vc for k, vc in coeffs.items() if k in
+                      {id(v) for v, _ in self.par_vars}}
+            rest = {k: vc for k, vc in coeffs.items() if k not in pterms}
+            if not pterms:
+                out.append(("scalar", rebuild_affine(rest, const)
+                            if rest or not isinstance(i, slice) else i))
+                continue
+            if len(pterms) > 1:
+                raise ExprGenError("an index dim mixes two T.Parallel vars")
+            (v, c), = pterms.values()
+            if c != 1:
+                raise ExprGenError(
+                    f"T.Parallel var {v.name} used with stride {c}; only "
+                    "unit-stride elementwise access vectorizes")
+            residual = rebuild_affine(rest, const)
+            out.append(("var", v, residual))
+        return out
+
+    def _vector_load(self, e: BufferLoad) -> str:
+        acc = self.accessors.get(e.buffer.uid)
+        if acc is None:
+            raise ExprGenError(f"no accessor for buffer {e.buffer.name}")
+        if acc.kind == "any":
+            raise ExprGenError(
+                f"buffer {e.buffer.name} is HBM-resident (no block mapping); "
+                "T.copy it into an on-chip buffer before reading")
+        dims = self.analyze_indices(e.buffer, acc.local_indices(e.indices))
+        parts, axes_vars = [], []
+        shape = acc.kernel_shape()
+        for d, spec in enumerate(dims):
+            if spec[0] == "scalar":
+                parts.append(self.scalar(spec[1]))
+            else:
+                _, v, resid = spec
+                ext = dict((id(vv), xx) for vv, xx in self.par_vars)[id(v)]
+                r = as_int(resid)
+                if r == 0 and shape[d] == ext:
+                    parts.append(":")
+                elif r is not None:
+                    parts.append(f"{r}:{r + ext}")
+                else:
+                    parts.append(f"pl.ds({self.scalar(resid)}, {ext})")
+                axes_vars.append(v)
+        src = acc.load_sliced(parts)
+        return self._align_axes(src, axes_vars)
+
+    def _align_axes(self, src: str, axes_vars: List[Var]) -> str:
+        """Transpose/expand a loaded array so its axes line up with the
+        canonical par-var order for broadcasting."""
+        canon = [v for v, _ in self.par_vars]
+        canon_pos = {id(v): i for i, v in enumerate(canon)}
+        present = [canon_pos[id(v)] for v in axes_vars]
+        # permutation sorting present axes into canonical order
+        order = sorted(range(len(present)), key=lambda k: present[k])
+        if order != list(range(len(present))):
+            src = f"jnp.transpose({src}, {tuple(order)})"
+        missing = [i for i in range(len(canon)) if i not in present]
+        if missing and present:
+            src = f"jnp.expand_dims({src}, {tuple(missing)})"
+        return src
+
+
+def _mentions(e, var) -> bool:
+    from ..ir import free_vars
+    return any(v is var for v in free_vars(e))

@@ -1,0 +1,104 @@
+"""Runtime support library imported by generated Pallas kernels.
+
+Keeps generated source small and readable — the analog of the reference's
+`src/tl_templates/` device headers, except these helpers are jax-traced
+(staged into the Mosaic kernel), not textual C++.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def cast(v, dtype):
+    """Dtype cast that also works on python scalars."""
+    return jnp.asarray(v, dtype)
+
+
+def dma(src, dst, sem):
+    """Synchronous async-DMA copy (start+wait). src/dst are refs or
+    ref.at[...] views; used for accesses the planner left in HBM."""
+    cp = pltpu.make_async_copy(src, dst, sem)
+    cp.start()
+    cp.wait()
+
+
+def max_value(dtype):
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.floating):
+        return jnp.asarray(jnp.inf, d)
+    return jnp.asarray(jnp.iinfo(d).max, d)
+
+
+def min_value(dtype):
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.floating):
+        return jnp.asarray(-jnp.inf, d)
+    return jnp.asarray(jnp.iinfo(d).min, d)
+
+
+def _reduce_bits(op, x, axis, keepdims):
+    return functools.reduce(
+        op, [jax.lax.index_in_dim(x, i, axis, keepdims=keepdims)
+             for i in range(x.shape[axis])])
+
+
+def reduce_bitand(x, axis, keepdims=False):
+    return _reduce_bits(jnp.bitwise_and, x, axis, keepdims)
+
+
+def reduce_bitor(x, axis, keepdims=False):
+    return _reduce_bits(jnp.bitwise_or, x, axis, keepdims)
+
+
+def reduce_bitxor(x, axis, keepdims=False):
+    return _reduce_bits(jnp.bitwise_xor, x, axis, keepdims)
+
+
+_REDUCE_FNS = {
+    "sum": lambda x, axis, kd: jnp.sum(x, axis=axis, keepdims=kd),
+    "max": lambda x, axis, kd: jnp.max(x, axis=axis, keepdims=kd),
+    "min": lambda x, axis, kd: jnp.min(x, axis=axis, keepdims=kd),
+    "abssum": lambda x, axis, kd: jnp.sum(jnp.abs(x), axis=axis, keepdims=kd),
+    "absmax": lambda x, axis, kd: jnp.max(jnp.abs(x), axis=axis, keepdims=kd),
+    "bitand": reduce_bitand,
+    "bitor": reduce_bitor,
+    "bitxor": reduce_bitxor,
+    "any": lambda x, axis, kd: jnp.any(x, axis=axis, keepdims=kd),
+    "all": lambda x, axis, kd: jnp.all(x, axis=axis, keepdims=kd),
+}
+
+_COMBINE_FNS = {
+    "sum": lambda a, b: a + b,
+    "abssum": lambda a, b: a + b,
+    "max": jnp.maximum,
+    "absmax": jnp.maximum,
+    "min": jnp.minimum,
+    "bitand": jnp.bitwise_and,
+    "bitor": jnp.bitwise_or,
+    "bitxor": jnp.bitwise_xor,
+    "any": jnp.logical_or,
+    "all": jnp.logical_and,
+}
+
+
+def reduce(kind, x, axis, keepdims, old=None):
+    """Tile reduction; combines with `old` when clear=False."""
+    r = _REDUCE_FNS[kind](x, axis, keepdims)
+    if old is not None:
+        r = _COMBINE_FNS[kind](old, r.astype(old.dtype))
+    return r
+
+
+def cumsum(x, axis, reverse):
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    r = jnp.cumsum(x, axis=axis)
+    if reverse:
+        r = jnp.flip(r, axis=axis)
+    return r

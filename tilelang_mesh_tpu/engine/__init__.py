@@ -1,0 +1,2 @@
+from .lower import lower
+from .param import CompiledArtifact, KernelParam

@@ -1,0 +1,80 @@
+"""The compilation driver: PrimFunc -> CompiledArtifact.
+
+Reference: /root/reference/tilelang/engine/lower.py:217 (lower) and
+phase.py (PreLowerSemanticCheck -> LowerAndLegalize -> OptimizeForTarget).
+The TPU pipeline is shorter because Mosaic owns what ~30 of the reference's
+passes do by hand (vectorize, storage rewrite, sync insertion, smem merge):
+
+  1. PreLowerSemanticCheck   (analysis/checkers.py)
+  2. plan_kernel             (transform/plan.py — LayoutInference +
+                              PipelinePlanning + LowerTileOp in one)
+  3. generate_source         (codegen/pallas.py — CodeGenTileLang analog)
+  [mesh targets]: parallel/lowering.py splits at collectives and emits an
+  SPMD program over shard_map instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..analysis import run_semantic_checks
+from ..codegen.pallas import generate_source
+from ..engine.param import CompiledArtifact, KernelParam
+from ..ir import Buffer, PrimFunc, Var
+from ..transform.pass_config import current_pass_config
+from ..transform.plan import plan_kernel
+from ..utils.target import (determine_target, mesh_dims_from_target,
+                            target_is_mesh)
+
+
+def _param_table(plan) -> list:
+    params = []
+    for p in plan.params:
+        mesh_spec = None
+        if p.buffer.mesh_meta is not None:
+            mesh_spec = p.buffer.mesh_meta.partition_spec()
+        params.append(KernelParam(
+            name=p.buffer.name,
+            shape=p.buffer.static_shape() or tuple(p.buffer.shape),
+            dtype=p.buffer.dtype,
+            role=p.role,
+            mesh_spec=mesh_spec,
+        ))
+    return params
+
+
+def lower(func, target: str = "auto",
+          pass_configs: Optional[dict] = None) -> CompiledArtifact:
+    """Lower a traced prim_func to a compiled artifact (generated source)."""
+    from ..language.builder import PrimFuncObj
+    if isinstance(func, PrimFuncObj):
+        func = func.func
+    if not isinstance(func, PrimFunc):
+        raise TypeError(f"lower() expects a @T.prim_func, got {type(func)}")
+
+    target = determine_target(target)
+    cfg = dict(current_pass_config())
+    if pass_configs:
+        for k, v in pass_configs.items():
+            cfg[getattr(k, "value", str(k))] = v
+
+    # mesh kernels take the SPMD path
+    if target_is_mesh(target) or func.attrs.get("mesh_config"):
+        from ..parallel.lowering import lower_mesh
+        mesh_cfg = mesh_dims_from_target(target) or \
+            func.attrs.get("mesh_config")
+        return lower_mesh(func, target, mesh_cfg, cfg)
+
+    run_semantic_checks(func)
+    plan = plan_kernel(func, cfg)
+    source = generate_source(plan, cfg)
+    return CompiledArtifact(
+        name=func.name,
+        params=_param_table(plan),
+        kernel_source=source,
+        target=target,
+        grid=tuple(a.extent for a in plan.grid),
+        ir_script=func.script(),
+        plan_desc=plan.describe(),
+        attrs=dict(func.attrs),
+    )

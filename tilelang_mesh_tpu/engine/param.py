@@ -1,0 +1,48 @@
+"""KernelParam / CompiledArtifact.
+
+Reference: /root/reference/tilelang/engine/param.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+
+@dataclass
+class KernelParam:
+    name: str
+    shape: Tuple[Any, ...]
+    dtype: str
+    role: str = "in"  # in | out | inout
+    mesh_spec: Optional[Any] = None  # PartitionSpec for MeshTensor params
+
+    @property
+    def is_output(self) -> bool:
+        return self.role in ("out", "inout")
+
+
+@dataclass
+class CompiledArtifact:
+    """Everything produced by `lower`: the generated Pallas source, the param
+    table, grid, and (after build) the callable. The source + params are the
+    on-disk cache payload (cf. reference CompiledArtifact: host_mod,
+    device_mod, params, kernel_source)."""
+
+    name: str
+    params: List[KernelParam]
+    kernel_source: str          # generated python module source
+    target: str
+    grid: Tuple[int, ...]
+    ir_script: str              # tile-IR script (pre-lowering, for debugging)
+    plan_desc: str              # plan description (golden-test surface)
+    mesh_config: Optional[Tuple[int, int]] = None
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def out_params(self) -> List[KernelParam]:
+        return [p for p in self.params if p.is_output]
+
+    @property
+    def in_params(self) -> List[KernelParam]:
+        return [p for p in self.params if p.role in ("in", "inout")]

@@ -1,0 +1,66 @@
+"""Environment / flag system.
+
+Reference: /root/reference/tilelang/env.py (EnvVar descriptor + Environment).
+Same three-tier config design (process env vars here; per-compile PassConfig
+in transform/pass_config.py; per-kernel decorator kwargs in jit/).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+class EnvVar:
+    """Descriptor reading an environment variable with a default, cached per
+    access so tests can monkeypatch os.environ."""
+
+    def __init__(self, key: str, default, cast=str):
+        self.key = key
+        self.default = default
+        self.cast = cast
+
+    def __get__(self, obj, objtype=None):
+        raw = os.environ.get(self.key)
+        if raw is None:
+            return self.default
+        if self.cast is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return self.cast(raw)
+
+    def __set__(self, obj, value):
+        os.environ[self.key] = str(value)
+
+
+class Environment:
+    # cache
+    TL_TPU_CACHE_DIR = EnvVar(
+        "TL_TPU_CACHE_DIR", str(Path.home() / ".tilelang_mesh_tpu" / "cache"))
+    TL_TPU_DISABLE_CACHE = EnvVar("TL_TPU_DISABLE_CACHE", False, bool)
+    # compile
+    TL_TPU_PRINT_ON_COMPILATION = EnvVar(
+        "TL_TPU_PRINT_ON_COMPILATION", False, bool)
+    TL_TPU_NUM_COMPILE_THREADS = EnvVar(
+        "TL_TPU_NUM_COMPILE_THREADS", max(1, (os.cpu_count() or 4) // 2), int)
+    # execution
+    TL_TPU_FORCE_INTERPRET = EnvVar("TL_TPU_FORCE_INTERPRET", False, bool)
+    TL_TPU_DEBUG_CODEGEN = EnvVar("TL_TPU_DEBUG_CODEGEN", False, bool)
+    # autotuner
+    TL_TPU_AUTOTUNE_CACHE_DIR = EnvVar(
+        "TL_TPU_AUTOTUNE_CACHE_DIR",
+        str(Path.home() / ".tilelang_mesh_tpu" / "autotune"))
+    # native library
+    TL_TPU_DISABLE_NATIVE = EnvVar("TL_TPU_DISABLE_NATIVE", False, bool)
+
+    def cache_dir(self) -> Path:
+        p = Path(self.TL_TPU_CACHE_DIR)
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def autotune_dir(self) -> Path:
+        p = Path(self.TL_TPU_AUTOTUNE_CACHE_DIR)
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+
+env = Environment()

@@ -1,0 +1,15 @@
+"""Tile-IR: expressions, buffers, statements, printer."""
+
+from .expr import (PrimExpr, Var, IntImm, FloatImm, BoolImm, StringImm,
+                   BinOp, Call, Cast, BufferLoad, convert, const, as_int,
+                   ceildiv,
+                   canon_dtype, dtype_bits, dtype_is_float, dtype_is_int,
+                   promote_dtypes, linearize, free_vars)
+from .buffer import Buffer, Region, to_region
+from .stmt import (Stmt, SeqStmt, AllocStmt, KernelNode, ForNest, IfThenElse,
+                   BufferStoreStmt, EvaluateStmt, CopyStmt, GemmStmt, FillStmt,
+                   ReduceStmt, CumSumStmt, AtomicStmt, PrintStmt, AssertStmt,
+                   CommStmt, CommBroadcast, CommPut, CommAllGather,
+                   CommAllReduce, CommBarrier, CommFence, PrimFunc, walk,
+                   collect)
+from .printer import expr_str, func_str, region_str

@@ -1,0 +1,206 @@
+"""Tile-IR buffers and regions.
+
+Scopes map the reference's memory hierarchy onto the TPU's
+(cf. /root/reference/tilelang/language/allocate.py):
+
+  global          -> HBM (kernel operand)
+  shared          -> VMEM block / scratch (the analog of CUDA smem)
+  fragment        -> VMEM scratch, typically an accumulator (register fragments
+                     have no TPU analog; Mosaic keeps hot tiles in vregs)
+  local           -> VMEM scratch
+  local.var       -> SMEM (1,1) scalar
+  smem            -> SMEM scratch
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from .expr import (PrimExpr, BufferLoad, Var, canon_dtype, convert, as_int)
+
+SCOPES = ("global", "shared", "shared.dyn", "fragment", "local", "local.var",
+          "smem")
+
+
+class Buffer:
+    """A typed, shaped memory handle appearing in tile-IR statements."""
+
+    _counter = [0]
+
+    def __init__(self, name: str, shape: Sequence[Any], dtype: str,
+                 scope: str = "global"):
+        if scope == "shared.dyn":
+            scope = "shared"
+        if scope not in SCOPES:
+            raise ValueError(f"bad scope {scope}")
+        self.name = name
+        self.shape = tuple(
+            s if isinstance(s, Var) else (as_int(s) if as_int(s) is not None
+                                          else convert(s))
+            for s in (shape if isinstance(shape, (tuple, list)) else (shape,)))
+        self.dtype = canon_dtype(dtype)
+        self.scope = scope
+        Buffer._counter[0] += 1
+        self.uid = Buffer._counter[0]
+        # filled by the mesh layer for MeshTensor params:
+        self.mesh_meta = None
+
+    # -- convenience ---------------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def static_shape(self) -> Optional[Tuple[int, ...]]:
+        out = []
+        for s in self.shape:
+            v = as_int(s)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+
+    def numel(self) -> Optional[int]:
+        ss = self.static_shape()
+        if ss is None:
+            return None
+        n = 1
+        for s in ss:
+            n *= s
+        return n
+
+    # -- DSL indexing --------------------------------------------------------
+    def _norm_idx(self, idx):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        if len(idx) > self.ndim:
+            raise IndexError(
+                f"{self.name}: {len(idx)} indices for rank-{self.ndim} buffer")
+        # pad missing trailing dims with full slices
+        if len(idx) < self.ndim:
+            idx = idx + (slice(None),) * (self.ndim - len(idx))
+        out = []
+        for i in idx:
+            if isinstance(i, slice):
+                out.append(i)
+            else:
+                out.append(convert(i))
+        return tuple(out)
+
+    def __getitem__(self, idx) -> BufferLoad:
+        return BufferLoad(self, self._norm_idx(idx))
+
+    def __setitem__(self, idx, value):
+        from ..language.builder import current_builder
+        b = current_builder()
+        if b is None:
+            raise RuntimeError(
+                f"buffer store to {self.name} outside of a T.prim_func trace")
+        from .stmt import BufferStoreStmt
+        b.emit(BufferStoreStmt(self, self._norm_idx(idx), convert(value)))
+
+    def __repr__(self):
+        return (f"Buffer({self.name}, {self.shape}, {self.dtype}, "
+                f"scope={self.scope})")
+
+    def __len__(self):
+        v = as_int(self.shape[0])
+        if v is None:
+            raise TypeError("len() of dynamic buffer dim")
+        return v
+
+    # iteration over a buffer is almost always a user error in kernel code
+    def __iter__(self):
+        raise TypeError("tile-IR buffers are not iterable")
+
+
+class Region:
+    """A rectangular sub-region of a buffer: base indices + extent."""
+
+    def __init__(self, buffer: Buffer, base: Sequence[Any],
+                 shape: Sequence[Any]):
+        self.buffer = buffer
+        self.base = tuple(convert(b) for b in base)
+        self.shape = tuple(as_int(s) if as_int(s) is not None else convert(s)
+                           for s in shape)
+
+    @property
+    def dtype(self):
+        return self.buffer.dtype
+
+    def static_shape(self):
+        out = []
+        for s in self.shape:
+            v = as_int(s)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+
+    def numel(self):
+        ss = self.static_shape()
+        if ss is None:
+            return None
+        n = 1
+        for s in ss:
+            n *= s
+        return n
+
+    def is_full(self) -> bool:
+        bss = self.buffer.static_shape()
+        rss = self.static_shape()
+        if bss is None or rss is None:
+            return False
+        return bss == rss and all(as_int(b) == 0 for b in self.base)
+
+    def __repr__(self):
+        from .printer import expr_str
+        base = ", ".join(expr_str(b) for b in self.base)
+        return f"{self.buffer.name}[{base}; {self.shape}]"
+
+
+def to_region(obj: Any, extent_hint: Optional[Sequence[int]] = None) -> Region:
+    """Normalize a tile-op operand to a Region.
+
+    Accepts:
+      - Buffer                       -> whole buffer
+      - BufferLoad without slices    -> base + extent from hint (reference's
+                                        "element access as region base" sugar,
+                                        cf. tilelang/utils/language.py
+                                        to_buffer_region)
+      - BufferLoad with slices       -> explicit slice region
+      - Region                       -> itself
+    """
+    if isinstance(obj, Region):
+        return obj
+    if isinstance(obj, Buffer):
+        return Region(obj, (0,) * obj.ndim, obj.shape)
+    if isinstance(obj, BufferLoad):
+        buf = obj.buffer
+        if obj.has_slices:
+            base, shape = [], []
+            for d, i in enumerate(obj.indices):
+                if isinstance(i, slice):
+                    if i.step not in (None, 1):
+                        raise ValueError("strided slice regions not supported")
+                    start = 0 if i.start is None else i.start
+                    stop = buf.shape[d] if i.stop is None else i.stop
+                    base.append(start)
+                    shape.append(convert(stop) - convert(start))
+                else:
+                    base.append(i)
+                    shape.append(1)
+            return Region(buf, base, shape)
+        # element-access sugar: base indices, extent from hint clipped to rank
+        if extent_hint is None:
+            base = list(obj.indices)
+            return Region(buf, base, (1,) * buf.ndim)
+        hint = list(extent_hint)
+        if len(hint) > buf.ndim:
+            raise ValueError(
+                f"extent hint rank {len(hint)} > buffer rank {buf.ndim}")
+        # right-align the hint (leading dims get extent 1), matching the
+        # reference's T.copy shape-broadcast behavior
+        base = list(obj.indices)
+        shape = [1] * (buf.ndim - len(hint)) + hint
+        return Region(buf, base, shape)
+    raise TypeError(f"cannot interpret {type(obj)} as a buffer region")

@@ -1,0 +1,507 @@
+"""Tile-IR expression AST.
+
+TPU-native re-design of the reference's TIR expression surface
+(cf. /root/reference/tilelang/language/tir/op.py). We do not embed TVM: the IR
+is a small, purpose-built AST that the trace builder records and the Pallas
+codegen prints back out as jnp/lax Python source. Integer arithmetic is folded
+eagerly so grid extents and block shapes stay concrete Python ints whenever the
+user wrote concrete shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "fp32": "float32",
+    "fp16": "float16",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "int": "int32",
+    "bool": "bool",
+    "e4m3": "float8_e4m3fn",
+    "float8_e4m3": "float8_e4m3fn",
+    "e5m2": "float8_e5m2",
+    "float8_e5m2": "float8_e5m2",
+}
+
+_VALID_DTYPES = {
+    "float64", "float32", "float16", "bfloat16",
+    "float8_e4m3fn", "float8_e5m2",
+    "int64", "int32", "int16", "int8", "uint64", "uint32", "uint16", "uint8",
+    "bool",
+}
+
+
+def canon_dtype(dtype: Any) -> str:
+    """Canonicalize a dtype spec (str / jnp dtype / np dtype) to a string."""
+    if dtype is None:
+        return "float32"
+    if not isinstance(dtype, str):
+        name = getattr(dtype, "__name__", None) or getattr(dtype, "name", None)
+        if name is None:
+            import numpy as np
+            name = np.dtype(dtype).name
+        dtype = name
+    dtype = _DTYPE_ALIASES.get(dtype, dtype)
+    if dtype not in _VALID_DTYPES:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return dtype
+
+
+def dtype_bits(dtype: str) -> int:
+    dtype = canon_dtype(dtype)
+    if dtype == "bool":
+        return 8
+    for n in (64, 32, 16, 8):
+        if dtype.endswith(str(n)) or (n == 8 and dtype.startswith("float8")):
+            return n
+    raise ValueError(dtype)
+
+
+def dtype_is_float(dtype: str) -> bool:
+    return dtype.startswith("float") or dtype == "bfloat16"
+
+
+def dtype_is_int(dtype: str) -> bool:
+    return dtype.startswith("int") or dtype.startswith("uint")
+
+
+def promote_dtypes(a: str, b: str) -> str:
+    """Numpy-style promotion, simplified for kernel arithmetic."""
+    if a == b:
+        return a
+    fa, fb = dtype_is_float(a), dtype_is_float(b)
+    if fa and not fb:
+        return a
+    if fb and not fa:
+        return b
+    if fa and fb:
+        order = ["float8_e5m2", "float8_e4m3fn", "float16", "bfloat16",
+                 "float32", "float64"]
+        return order[max(order.index(a), order.index(b))]
+    # both int-ish
+    return a if dtype_bits(a) >= dtype_bits(b) else b
+
+
+# ---------------------------------------------------------------------------
+# Expression nodes
+# ---------------------------------------------------------------------------
+
+
+class PrimExpr:
+    """Base class for all tile-IR expressions."""
+
+    dtype: str = "int32"
+
+    # -- python operator sugar ------------------------------------------------
+    def __add__(self, o): return _binop("+", self, o)
+    def __radd__(self, o): return _binop("+", o, self)
+    def __sub__(self, o): return _binop("-", self, o)
+    def __rsub__(self, o): return _binop("-", o, self)
+    def __mul__(self, o): return _binop("*", self, o)
+    def __rmul__(self, o): return _binop("*", o, self)
+    def __floordiv__(self, o): return _binop("//", self, o)
+    def __rfloordiv__(self, o): return _binop("//", o, self)
+    def __truediv__(self, o): return _binop("/", self, o)
+    def __rtruediv__(self, o): return _binop("/", o, self)
+    def __mod__(self, o): return _binop("%", self, o)
+    def __rmod__(self, o): return _binop("%", o, self)
+    def __neg__(self): return _binop("*", self, -1)
+    def __lt__(self, o): return _binop("<", self, o)
+    def __le__(self, o): return _binop("<=", self, o)
+    def __gt__(self, o): return _binop(">", self, o)
+    def __ge__(self, o): return _binop(">=", self, o)
+    def __pow__(self, o): return Call("pow", [self, convert(o)],
+                                      promote_dtypes(self.dtype, convert(o).dtype))
+
+    def __eq__(self, o):  # structural equality is `same_as`; == builds IR
+        return _binop("==", self, o)
+
+    def __ne__(self, o):
+        return _binop("!=", self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __and__(self, o): return _binop("and", self, o)
+    def __or__(self, o): return _binop("or", self, o)
+    def __invert__(self): return Call("logical_not", [self], "bool")
+
+    def __bool__(self):
+        raise TypeError(
+            "Cannot convert a symbolic tile-IR expression to a Python bool. "
+            "Use T.if_then_else(...) / T.Select for data-dependent control "
+            "flow inside kernels.")
+
+    def __index__(self):
+        raise TypeError(f"symbolic expression {self!r} used where a concrete "
+                        "Python int is required")
+
+    def __repr__(self):
+        from .printer import expr_str
+        return expr_str(self)
+
+
+class Var(PrimExpr):
+    """A scalar variable: loop var, grid var, or dynamic-shape symbol."""
+
+    _counter = [0]
+
+    def __init__(self, name: str, dtype: str = "int32"):
+        self.name = name
+        self.dtype = canon_dtype(dtype)
+        Var._counter[0] += 1
+        self.uid = Var._counter[0]
+
+    def same_as(self, other) -> bool:
+        return self is other
+
+
+class IntImm(PrimExpr):
+    def __init__(self, value: int, dtype: str = "int32"):
+        self.value = int(value)
+        self.dtype = dtype
+
+
+class FloatImm(PrimExpr):
+    def __init__(self, value: float, dtype: str = "float32"):
+        self.value = float(value)
+        self.dtype = dtype
+
+
+class BoolImm(PrimExpr):
+    def __init__(self, value: bool):
+        self.value = bool(value)
+        self.dtype = "bool"
+
+
+class StringImm(PrimExpr):
+    def __init__(self, value: str):
+        self.value = value
+        self.dtype = "handle"
+
+
+class BinOp(PrimExpr):
+    """Binary operation. op in {+,-,*,//,/,%,min,max,<,<=,>,>=,==,!=,and,or}."""
+
+    _CMP = {"<", "<=", ">", ">=", "==", "!=", "and", "or"}
+
+    def __init__(self, op: str, a: PrimExpr, b: PrimExpr):
+        self.op = op
+        self.a = a
+        self.b = b
+        if op in self._CMP:
+            self.dtype = "bool"
+        elif op == "/":
+            d = promote_dtypes(a.dtype, b.dtype)
+            self.dtype = d if dtype_is_float(d) else "float32"
+        else:
+            self.dtype = promote_dtypes(a.dtype, b.dtype)
+
+
+class Call(PrimExpr):
+    """Intrinsic call (exp, max, sqrt, ...) printed to the jnp equivalent."""
+
+    def __init__(self, name: str, args: Sequence[Any], dtype: str):
+        self.name = name
+        self.args = [convert(a) if not isinstance(a, str) else a for a in args]
+        self.dtype = dtype
+
+
+class Cast(PrimExpr):
+    def __init__(self, dtype: str, value: PrimExpr):
+        self.dtype = canon_dtype(dtype)
+        self.value = convert(value)
+
+
+class BufferLoad(PrimExpr):
+    """An element (or region-base) access ``buf[i0, i1, ...]``.
+
+    Indices may contain slices; a BufferLoad with slices denotes a region and
+    is only valid as a tile-op operand (T.copy / T.gemm / ...).
+    """
+
+    def __init__(self, buffer, indices):
+        self.buffer = buffer
+        self.indices = tuple(indices)
+        self.dtype = buffer.dtype
+
+    @property
+    def has_slices(self) -> bool:
+        return any(isinstance(i, slice) for i in self.indices)
+
+
+# ---------------------------------------------------------------------------
+# Construction helpers / folding
+# ---------------------------------------------------------------------------
+
+
+def convert(v: Any) -> PrimExpr:
+    if isinstance(v, PrimExpr):
+        return v
+    if isinstance(v, bool):
+        return BoolImm(v)
+    if isinstance(v, int):
+        return IntImm(v)
+    if isinstance(v, float):
+        return FloatImm(v)
+    import numpy as np
+    if isinstance(v, np.integer):
+        return IntImm(int(v))
+    if isinstance(v, np.floating):
+        return FloatImm(float(v))
+    raise TypeError(f"cannot convert {type(v)} to tile-IR expression")
+
+
+def _const_val(e: PrimExpr) -> Optional[Union[int, float, bool]]:
+    if isinstance(e, (IntImm, FloatImm, BoolImm)):
+        return e.value
+    return None
+
+
+_FOLD = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "//": lambda a, b: a // b,
+    "%": lambda a, b: a % b,
+    "/": lambda a, b: a / b,
+    "min": min,
+    "max": max,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "and": lambda a, b: a and b,
+    "or": lambda a, b: a or b,
+}
+
+
+def _binop(op: str, a: Any, b: Any) -> PrimExpr:
+    a, b = convert(a), convert(b)
+    av, bv = _const_val(a), _const_val(b)
+    if av is not None and bv is not None:
+        r = _FOLD[op](av, bv)
+        if isinstance(r, bool):
+            return BoolImm(r)
+        if isinstance(r, int):
+            return IntImm(r)
+        return FloatImm(r, promote_dtypes(a.dtype, b.dtype))
+    # light algebraic identities keep printed IR and index maps clean
+    if op == "+":
+        if av == 0:
+            return b
+        if bv == 0:
+            return a
+    elif op == "-":
+        if bv == 0:
+            return a
+    elif op == "*":
+        if av == 1:
+            return b
+        if bv == 1:
+            return a
+        if av == 0 or bv == 0:
+            return IntImm(0) if dtype_is_int(promote_dtypes(a.dtype, b.dtype)) \
+                else FloatImm(0.0)
+    elif op == "//" and bv == 1:
+        return a
+    return BinOp(op, a, b)
+
+
+def const(value, dtype=None) -> PrimExpr:
+    e = convert(value)
+    if dtype is not None and e.dtype != canon_dtype(dtype):
+        if isinstance(e, IntImm):
+            d = canon_dtype(dtype)
+            return FloatImm(float(e.value), d) if dtype_is_float(d) else IntImm(e.value, d)
+        return Cast(dtype, e)
+    return e
+
+
+def as_int(e: Any) -> Optional[int]:
+    """Return a concrete Python int if the expression is statically known."""
+    if isinstance(e, int):
+        return e
+    if isinstance(e, IntImm):
+        return e.value
+    return None
+
+
+def ceildiv(a, b):
+    a, b = convert(a), convert(b)
+    av, bv = _const_val(a), _const_val(b)
+    if av is not None and bv is not None:
+        return IntImm(-(-av // bv)).value  # plain python int for grid extents
+    return _binop("//", _binop("+", a, _binop("-", b, 1)), b)
+
+
+# ---------------------------------------------------------------------------
+# Affine analysis (the layout-inference workhorse; cf. reference
+# src/transform/layout_inference.cc constraint extraction)
+# ---------------------------------------------------------------------------
+
+
+def affine_decompose(expr):
+    """Decompose an expression as ``sum(coeff_v * v) + const`` over ALL vars.
+
+    Returns ({id(v): (v, coeff)}, const) or None when not affine with
+    integer coefficients. Symbolic cancellation (``i - i`` -> 0) falls out
+    of the coefficient arithmetic.
+    """
+    e = convert(expr)
+    if isinstance(e, IntImm):
+        return {}, e.value
+    if isinstance(e, Var):
+        return {id(e): (e, 1)}, 0
+    if isinstance(e, BinOp):
+        if e.op in ("+", "-"):
+            ra, rb = affine_decompose(e.a), affine_decompose(e.b)
+            if ra is None or rb is None:
+                return None
+            ca, ka = ra
+            cb, kb = rb
+            sign = 1 if e.op == "+" else -1
+            out = dict(ca)
+            for k, (v, c) in cb.items():
+                pv, pc = out.get(k, (v, 0))
+                out[k] = (v, pc + sign * c)
+            out = {k: vc for k, vc in out.items() if vc[1] != 0}
+            return out, ka + sign * kb
+        if e.op == "*":
+            ra, rb = affine_decompose(e.a), affine_decompose(e.b)
+            if ra is None or rb is None:
+                return None
+            ca, ka = ra
+            cb, kb = rb
+            if ca and cb:
+                return None
+            if not ca:
+                ca, ka, cb, kb = cb, kb, ca, ka
+            return ({k: (v, c * kb) for k, (v, c) in ca.items()}
+                    if kb != 0 else {}), ka * kb
+        if e.op == "//":
+            ra, rb = affine_decompose(e.a), affine_decompose(e.b)
+            if ra is None or rb is None:
+                return None
+            cb, kb = rb
+            if cb or kb == 0:
+                return None
+            ca, ka = ra
+            if all(c % kb == 0 for _, c in ca.values()) and ka % kb == 0:
+                return {k: (v, c // kb) for k, (v, c) in ca.items()}, ka // kb
+            return None
+        return None
+    return None
+
+
+def rebuild_affine(coeffs, const) -> PrimExpr:
+    """Inverse of affine_decompose: build an expression from terms."""
+    out: PrimExpr = IntImm(const)
+    for _, (v, c) in sorted(coeffs.items(), key=lambda kv: kv[1][0].uid):
+        out = _binop("+", out, _binop("*", v, c))
+    return out
+
+
+def linearize(expr: PrimExpr, wrt: Sequence[Var]):
+    """Decompose ``expr`` as ``sum(coeff[v] * v) + const`` over vars in `wrt`.
+
+    Returns (coeffs: dict[Var, int], const: int) or None if the expression is
+    not affine with integer-constant coefficients over those vars, or mentions
+    a var outside `wrt`.
+    """
+    wrt_set = set(id(v) for v in wrt)
+
+    def go(e):
+        e = convert(e)
+        if isinstance(e, IntImm):
+            return {}, e.value
+        if isinstance(e, Var):
+            if id(e) in wrt_set:
+                return {id(e): 1}, 0
+            return None
+        if isinstance(e, BinOp):
+            if e.op in ("+", "-"):
+                ra, rb = go(e.a), go(e.b)
+                if ra is None or rb is None:
+                    return None
+                ca, ka = ra
+                cb, kb = rb
+                sign = 1 if e.op == "+" else -1
+                out = dict(ca)
+                for k, v in cb.items():
+                    out[k] = out.get(k, 0) + sign * v
+                return out, ka + sign * kb
+            if e.op == "*":
+                ra, rb = go(e.a), go(e.b)
+                if ra is None or rb is None:
+                    return None
+                ca, ka = ra
+                cb, kb = rb
+                if ca and cb:
+                    return None  # non-linear
+                if not ca:
+                    ca, ka, cb, kb = cb, kb, ca, ka
+                # now cb empty: multiply by constant kb
+                return {k: v * kb for k, v in ca.items()}, ka * kb
+            if e.op == "//":
+                ra, rb = go(e.a), go(e.b)
+                if ra is None or rb is None:
+                    return None
+                cb, kb = rb
+                if cb or kb == 0:
+                    return None
+                ca, ka = ra
+                if all(v % kb == 0 for v in ca.values()) and ka % kb == 0:
+                    return {k: v // kb for k, v in ca.items()}, ka // kb
+                return None
+            return None
+        return None
+
+    r = go(expr)
+    if r is None:
+        return None
+    coeffs, k = r
+    by_var = {}
+    for v in wrt:
+        if id(v) in coeffs and coeffs[id(v)] != 0:
+            by_var[v] = coeffs[id(v)]
+    return by_var, k
+
+
+def free_vars(expr: Any) -> list:
+    """All Vars referenced by an expression tree."""
+    out, seen = [], set()
+
+    def go(e):
+        if isinstance(e, Var):
+            if id(e) not in seen:
+                seen.add(id(e))
+                out.append(e)
+        elif isinstance(e, BinOp):
+            go(e.a)
+            go(e.b)
+        elif isinstance(e, Call):
+            for a in e.args:
+                if isinstance(e, PrimExpr) or isinstance(a, PrimExpr):
+                    go(a) if isinstance(a, PrimExpr) else None
+        elif isinstance(e, Cast):
+            go(e.value)
+        elif isinstance(e, BufferLoad):
+            for i in e.indices:
+                if isinstance(i, slice):
+                    for p in (i.start, i.stop, i.step):
+                        if isinstance(p, PrimExpr):
+                            go(p)
+                else:
+                    go(convert(i))
+    go(convert(expr) if not isinstance(expr, PrimExpr) else expr)
+    return out

@@ -1,0 +1,226 @@
+"""Deterministic tile-IR printer.
+
+The printed script is (a) the golden-test surface — the analog of the
+reference's ``mod.script()`` structural tests (cf. SURVEY §4 style 1,
+testing/python/transform/test_tilelang_transform_*.py) — and (b) the stable
+string hashed into the kernel-cache key.
+"""
+
+from __future__ import annotations
+
+from .expr import (PrimExpr, Var, IntImm, FloatImm, BoolImm, StringImm, BinOp,
+                   Call, Cast, BufferLoad)
+from .buffer import Buffer, Region
+from . import stmt as S
+
+_PREC = {
+    "or": 1, "and": 2,
+    "==": 3, "!=": 3, "<": 3, "<=": 3, ">": 3, ">=": 3,
+    "+": 4, "-": 4,
+    "*": 5, "/": 5, "//": 5, "%": 5,
+}
+
+
+def expr_str(e, prec: int = 0) -> str:
+    if isinstance(e, Var):
+        return e.name
+    if isinstance(e, IntImm):
+        return str(e.value)
+    if isinstance(e, FloatImm):
+        v = repr(e.value)
+        return v if e.dtype == "float32" else f"{e.dtype}({v})"
+    if isinstance(e, BoolImm):
+        return str(e.value)
+    if isinstance(e, StringImm):
+        return repr(e.value)
+    if isinstance(e, BinOp):
+        if e.op in ("min", "max"):
+            return f"{e.op}({expr_str(e.a)}, {expr_str(e.b)})"
+        p = _PREC[e.op]
+        s = f"{expr_str(e.a, p)} {e.op} {expr_str(e.b, p + 1)}"
+        return f"({s})" if p < prec else s
+    if isinstance(e, Call):
+        args = ", ".join(a if isinstance(a, str) else expr_str(a)
+                         for a in e.args)
+        return f"{e.name}({args})"
+    if isinstance(e, Cast):
+        return f"{e.dtype}({expr_str(e.value)})"
+    if isinstance(e, BufferLoad):
+        return f"{e.buffer.name}[{_indices_str(e.indices)}]"
+    if isinstance(e, (int, float, bool)):
+        return str(e)
+    return repr(e)
+
+
+def _indices_str(indices) -> str:
+    parts = []
+    for i in indices:
+        if isinstance(i, slice):
+            a = "" if i.start is None else expr_str(i.start)
+            b = "" if i.stop is None else expr_str(i.stop)
+            parts.append(f"{a}:{b}")
+        else:
+            parts.append(expr_str(i))
+    return ", ".join(parts)
+
+
+def region_str(r: Region) -> str:
+    base = ", ".join(expr_str(b) for b in r.base)
+    shape = ", ".join(expr_str(s) if isinstance(s, PrimExpr) else str(s)
+                      for s in r.shape)
+    return f"{r.buffer.name}[({base}); ({shape})]"
+
+
+def shape_str(shape) -> str:
+    return "(" + ", ".join(
+        expr_str(s) if isinstance(s, PrimExpr) else str(s)
+        for s in shape) + ")"
+
+
+_DIR_NAMES = {0: "h", 1: "v", 2: "all"}
+
+
+class _Printer:
+    def __init__(self):
+        self.lines = []
+        self.indent = 0
+
+    def emit(self, text: str):
+        self.lines.append("  " * self.indent + text)
+
+    def stmt(self, s):
+        m = getattr(self, "p_" + type(s).__name__, None)
+        if m is None:
+            self.emit(f"<{type(s).__name__}>")
+        else:
+            m(s)
+
+    def p_SeqStmt(self, s):
+        for c in s.stmts:
+            self.stmt(c)
+
+    def p_KernelNode(self, s):
+        for p in s.prelude:
+            self.stmt(p)
+        vars_ = ", ".join(v.name for v in s.grid_vars)
+        ext = ", ".join(str(e) for e in s.extents)
+        self.emit(f"with Kernel(({ext}), threads={s.threads}) as ({vars_},):")
+        self.indent += 1
+        self.stmt(s.body)
+        self.indent -= 1
+
+    def p_AllocStmt(self, s):
+        b = s.buffer
+        self.emit(f"{b.name} = alloc({shape_str(b.shape)}, {b.dtype}, "
+                  f"scope={b.scope})")
+
+    def p_ForNest(self, s):
+        vars_ = ", ".join(v.name for v in s.loop_vars)
+        ext = ", ".join(expr_str(e) if isinstance(e, PrimExpr) else str(e)
+                        for e in s.extents)
+        extra = f", num_stages={s.num_stages}" if s.kind == "pipelined" else ""
+        self.emit(f"for ({vars_},) in {s.kind}(({ext}){extra}):")
+        self.indent += 1
+        self.stmt(s.body)
+        self.indent -= 1
+
+    def p_IfThenElse(self, s):
+        self.emit(f"if {expr_str(s.cond)}:")
+        self.indent += 1
+        self.stmt(s.then_body)
+        self.indent -= 1
+        if s.else_body is not None:
+            self.emit("else:")
+            self.indent += 1
+            self.stmt(s.else_body)
+            self.indent -= 1
+
+    def p_BufferStoreStmt(self, s):
+        self.emit(f"{s.buffer.name}[{_indices_str(s.indices)}] = "
+                  f"{expr_str(s.value)}")
+
+    def p_EvaluateStmt(self, s):
+        self.emit(expr_str(s.expr))
+
+    def p_CopyStmt(self, s):
+        self.emit(f"copy({region_str(s.src)} -> {region_str(s.dst)})")
+
+    def p_GemmStmt(self, s):
+        flags = ""
+        if s.trans_A:
+            flags += ", trans_A"
+        if s.trans_B:
+            flags += ", trans_B"
+        if s.clear_accum:
+            flags += ", clear_accum"
+        self.emit(f"gemm({region_str(s.A)}, {region_str(s.B)} -> "
+                  f"{region_str(s.C)}{flags})")
+
+    def p_FillStmt(self, s):
+        self.emit(f"fill({region_str(s.dst)}, {expr_str(s.value)})")
+
+    def p_ReduceStmt(self, s):
+        self.emit(f"reduce_{s.kind}({s.src.name} -> {s.dst.name}, "
+                  f"dim={s.dim}, clear={s.clear})")
+
+    def p_CumSumStmt(self, s):
+        self.emit(f"cumsum({s.src.name} -> {s.dst.name}, dim={s.dim}, "
+                  f"reverse={s.reverse})")
+
+    def p_AtomicStmt(self, s):
+        self.emit(f"atomic_{s.op}({region_str(s.dst)}, {expr_str(s.value)})")
+
+    def p_PrintStmt(self, s):
+        obj = s.obj.name if isinstance(s.obj, Buffer) else expr_str(s.obj)
+        self.emit(f"print({obj}, msg={s.msg!r})")
+
+    def p_AssertStmt(self, s):
+        self.emit(f"device_assert({expr_str(s.cond)}, msg={s.msg!r})")
+
+    def p_CommBroadcast(self, s):
+        self.emit(f"comm.broadcast({region_str(s.src)} -> {region_str(s.dst)},"
+                  f" src_core={s.src_core}, dir={_DIR_NAMES[s.direction]}, "
+                  f"size={s.size})")
+
+    def p_CommPut(self, s):
+        self.emit(f"comm.put({region_str(s.src)} -> {region_str(s.dst)}, "
+                  f"src_core={s.src_core}, dst_core={s.dst_core}, "
+                  f"size={s.size})")
+
+    def p_CommAllGather(self, s):
+        self.emit(f"comm.all_gather({region_str(s.send)} -> "
+                  f"{region_str(s.recv)}, dir={_DIR_NAMES[s.direction]}, "
+                  f"size={s.size})")
+
+    def p_CommAllReduce(self, s):
+        self.emit(f"comm.all_reduce({region_str(s.buffer)} -> "
+                  f"{region_str(s.out)}, op={s.reduce_type}, "
+                  f"dir={_DIR_NAMES[s.direction]}, dim={s.dim}, "
+                  f"clear={s.clear})")
+
+    def p_CommBarrier(self, s):
+        g = "" if s.group is None else f"group={s.group}"
+        self.emit(f"comm.barrier({g})")
+
+    def p_CommFence(self, s):
+        self.emit("comm.fence()")
+
+
+def func_str(f) -> str:
+    p = _Printer()
+    sig = []
+    for prm in f.params:
+        if isinstance(prm, Buffer):
+            extra = ""
+            if prm.mesh_meta is not None:
+                extra = f", mesh={prm.mesh_meta.describe()}"
+            sig.append(f"{prm.name}: Tensor({shape_str(prm.shape)}, "
+                       f"{prm.dtype}{extra})")
+        else:
+            sig.append(f"{prm.name}: {prm.dtype}")
+    p.emit(f"def {f.name}({', '.join(sig)}):")
+    p.indent += 1
+    if f.attrs:
+        p.emit(f"# attrs: {dict(sorted(f.attrs.items()))}")
+    p.stmt(f.body)
+    return "\n".join(p.lines) + "\n"

@@ -1,0 +1,286 @@
+"""Tile-IR statements and the PrimFunc container.
+
+Each tile operator is its own statement node implementing the reference's
+TileOperator protocol surface (cf. /root/reference/src/op/operator.h:55 —
+Lower / InferLayout / Clone); here lowering lives in
+``tilelang_mesh_tpu.transform`` and ``codegen.pallas`` visitors instead of
+virtual methods, which keeps the IR a plain data structure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .buffer import Buffer, Region
+from .expr import PrimExpr, Var, convert
+
+
+class Stmt:
+    pass
+
+
+class SeqStmt(Stmt):
+    def __init__(self, stmts: Optional[List[Stmt]] = None):
+        self.stmts: List[Stmt] = stmts if stmts is not None else []
+
+    def __iter__(self):
+        return iter(self.stmts)
+
+    def __len__(self):
+        return len(self.stmts)
+
+
+class AllocStmt(Stmt):
+    def __init__(self, buffer: Buffer):
+        self.buffer = buffer
+
+
+class KernelNode(Stmt):
+    """The T.Kernel launch frame: grid vars + extents + body.
+
+    Reference: tilelang/language/kernel.py:228 (KernelLaunchFrame). `threads`
+    is kept for API parity; on TPU the intra-block parallelism is the VPU/MXU,
+    so it only serves as an autotuner hint.
+    """
+
+    def __init__(self, grid_vars: List[Var], extents: List[int], threads: Any,
+                 body: SeqStmt, prelude: Optional[List[Stmt]] = None):
+        self.grid_vars = grid_vars
+        self.extents = extents
+        self.threads = threads
+        self.body = body
+        # statements traced before the kernel frame opened (rare)
+        self.prelude = prelude or []
+
+
+class ForNest(Stmt):
+    """A (possibly multi-var) loop nest of a single kind.
+
+    kinds: serial | unroll | parallel | pipelined | vectorized | persistent
+    """
+
+    def __init__(self, loop_vars: List[Var], extents: List[Any], kind: str,
+                 body: SeqStmt, num_stages: int = 0,
+                 annotations: Optional[dict] = None):
+        self.loop_vars = loop_vars
+        self.extents = extents
+        self.kind = kind
+        self.body = body
+        self.num_stages = num_stages
+        self.annotations = annotations or {}
+
+
+class IfThenElse(Stmt):
+    def __init__(self, cond: PrimExpr, then_body: SeqStmt,
+                 else_body: Optional[SeqStmt] = None):
+        self.cond = cond
+        self.then_body = then_body
+        self.else_body = else_body
+
+
+class BufferStoreStmt(Stmt):
+    def __init__(self, buffer: Buffer, indices: Tuple[Any, ...],
+                 value: PrimExpr):
+        self.buffer = buffer
+        self.indices = indices
+        self.value = value
+
+
+class EvaluateStmt(Stmt):
+    def __init__(self, expr: PrimExpr):
+        self.expr = expr
+
+
+# -- tile operators ----------------------------------------------------------
+
+
+class CopyStmt(Stmt):
+    """T.copy — cf. reference src/op/copy.cc. On TPU this lowers to a Pallas
+    BlockSpec (pipelined HBM<->VMEM fetch handled by Mosaic) or an explicit
+    VMEM assignment / async DMA."""
+
+    def __init__(self, src: Region, dst: Region, coalesced_width=None,
+                 disable_cache_hint: bool = False, eviction_policy=None):
+        self.src = src
+        self.dst = dst
+        self.coalesced_width = coalesced_width
+
+
+class GemmStmt(Stmt):
+    """T.gemm — cf. reference src/op/gemm.cc. Lowers to one MXU dot
+    (jnp.dot with f32 accumulation) instead of the CUTLASS template zoo."""
+
+    def __init__(self, A: Region, B: Region, C: Region, trans_A: bool = False,
+                 trans_B: bool = False, policy=None, clear_accum: bool = False,
+                 k_pack: int = 1, wg_wait: int = 0):
+        self.A = A
+        self.B = B
+        self.C = C
+        self.trans_A = trans_A
+        self.trans_B = trans_B
+        self.policy = policy
+        self.clear_accum = clear_accum
+
+
+class FillStmt(Stmt):
+    def __init__(self, dst: Region, value: PrimExpr):
+        self.dst = dst
+        self.value = convert(value)
+
+
+class ReduceStmt(Stmt):
+    """T.reduce_* — cf. reference src/op/reduce.cc. kinds: sum, max, min,
+    abssum, absmax, bitand, bitor, bitxor, any, all."""
+
+    def __init__(self, kind: str, src: Buffer, dst: Buffer, dim: int,
+                 clear: bool = True):
+        self.kind = kind
+        self.src = src
+        self.dst = dst
+        self.dim = dim
+        self.clear = clear
+
+
+class CumSumStmt(Stmt):
+    def __init__(self, src: Buffer, dst: Buffer, dim: int, reverse: bool):
+        self.src = src
+        self.dst = dst
+        self.dim = dim
+        self.reverse = reverse
+
+
+class AtomicStmt(Stmt):
+    """T.atomic_add and friends. TPU grids are sequential per-core, so an
+    'atomic' accumulation into HBM lowers to a read-modify-write via
+    input_output_aliasing; cf. reference src/op/atomic_add.cc."""
+
+    def __init__(self, op: str, dst: Region, value: Any):
+        self.op = op
+        self.dst = dst
+        self.value = value
+
+
+class PrintStmt(Stmt):
+    def __init__(self, obj: Any, msg: str = ""):
+        self.obj = obj
+        self.msg = msg
+
+
+class AssertStmt(Stmt):
+    def __init__(self, cond: PrimExpr, msg: str = ""):
+        self.cond = cond
+        self.msg = msg
+
+
+# -- mesh communication operators (cf. reference src/op/comm.cc) -------------
+
+
+class CommStmt(Stmt):
+    """Base for inter-core communication ops (the Mesh extension)."""
+
+
+class CommBroadcast(CommStmt):
+    def __init__(self, src: Region, dst: Region, size: int, dst_offset: int,
+                 src_core: int, direction: int):
+        self.src = src
+        self.dst = dst
+        self.size = size
+        self.dst_offset = dst_offset
+        self.src_core = src_core
+        self.direction = direction  # 0=h, 1=v, 2=all
+
+
+class CommPut(CommStmt):
+    def __init__(self, src: Region, dst: Region, size: int, src_core: int,
+                 dst_core: int):
+        self.src = src
+        self.dst = dst
+        self.size = size
+        self.src_core = src_core
+        self.dst_core = dst_core
+
+
+class CommAllGather(CommStmt):
+    def __init__(self, send: Region, recv: Region, direction: int, size: int):
+        self.send = send
+        self.recv = recv
+        self.direction = direction
+        self.size = size
+
+
+class CommAllReduce(CommStmt):
+    def __init__(self, buffer: Region, out: Region, reduce_type: str,
+                 direction: int, dim: int, clear: bool):
+        self.buffer = buffer
+        self.out = out
+        self.reduce_type = reduce_type
+        self.direction = direction
+        self.dim = dim
+        self.clear = clear
+
+
+class CommBarrier(CommStmt):
+    def __init__(self, group: Optional[List[int]] = None):
+        self.group = group
+
+
+class CommFence(CommStmt):
+    pass
+
+
+# ---------------------------------------------------------------------------
+
+
+class PrimFunc:
+    """A traced tile kernel: params + body + attrs."""
+
+    def __init__(self, name: str, params: List[Any], body: SeqStmt,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.params = params  # Buffers (tensor args) and Vars (dyn shapes)
+        self.body = body
+        self.attrs = attrs or {}
+
+    @property
+    def buffer_params(self) -> List[Buffer]:
+        return [p for p in self.params if isinstance(p, Buffer)]
+
+    @property
+    def dyn_params(self) -> List[Var]:
+        return [p for p in self.params if isinstance(p, Var)]
+
+    def script(self) -> str:
+        from .printer import func_str
+        return func_str(self)
+
+    def kernel_node(self) -> Optional[KernelNode]:
+        for s in self.body:
+            if isinstance(s, KernelNode):
+                return s
+        return None
+
+    def __repr__(self):
+        return self.script()
+
+
+def walk(stmt: Stmt, fn):
+    """Pre-order visit of every statement."""
+    fn(stmt)
+    children = []
+    if isinstance(stmt, SeqStmt):
+        children = stmt.stmts
+    elif isinstance(stmt, KernelNode):
+        children = list(stmt.prelude) + [stmt.body]
+    elif isinstance(stmt, ForNest):
+        children = [stmt.body]
+    elif isinstance(stmt, IfThenElse):
+        children = [stmt.then_body] + ([stmt.else_body] if stmt.else_body
+                                       else [])
+    for c in children:
+        walk(c, fn)
+
+
+def collect(stmt: Stmt, pred) -> List[Stmt]:
+    out = []
+    walk(stmt, lambda s: out.append(s) if pred(s) else None)
+    return out

@@ -1,0 +1,214 @@
+"""@tilelang.jit / compile / par_compile / lazy_jit.
+
+Reference: /root/reference/tilelang/jit/__init__.py (compile:48,
+par_compile:122, JITImpl:190, jit:456, lazy_jit:547). Same call-site shapes:
+
+    @tilelang.jit                      # decorate a kernel *factory*
+    def matmul(M, N, K, bm, bn, bk):
+        @T.prim_func
+        def kernel(...): ...
+        return kernel
+    k = matmul(1024, 1024, 1024, 128, 128, 32)   # -> JITKernel
+
+    @tilelang.lazy_jit                 # shapes inferred per call site
+    def kern(A: T.Tensor((M, K), "bfloat16"), ...): ...   # M, K = T.dynamic
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..cache.kernel_cache import cached
+from ..env import env
+from ..language.builder import PrimFuncObj, trace_prim_func
+from .kernel import JITKernel
+
+
+def compile(func, out_idx: Optional[Sequence[int]] = None,  # noqa: A001
+            execution_backend: str = "auto", target: str = "auto",
+            verbose: bool = False, pass_configs: Optional[dict] = None,
+            compile_flags=None) -> JITKernel:
+    """Compile a traced prim_func into an executable kernel.
+
+    `execution_backend` / `compile_flags` are accepted for reference parity;
+    XLA is the only execution backend on TPU.
+    """
+    if not isinstance(func, PrimFuncObj):
+        raise TypeError("tilelang.compile expects a @T.prim_func")
+    return cached(func, target=target, out_idx=out_idx,
+                  pass_configs=pass_configs, verbose=verbose)
+
+
+def par_compile(funcs: Sequence[PrimFuncObj], num_workers: Optional[int] = None,
+                ignore_error: bool = False, **kwargs) -> List[Any]:
+    """Compile a batch of kernels on a thread pool (reference par_compile:122;
+    used by the autotuner to overlap trace/plan/codegen work)."""
+    num_workers = num_workers or env.TL_TPU_NUM_COMPILE_THREADS
+
+    def one(f):
+        try:
+            return compile(f, **kwargs)
+        except Exception:
+            if ignore_error:
+                return None
+            raise
+
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        return list(pool.map(one, funcs))
+
+
+class JITImpl:
+    """Per-callsite kernel factory cache (reference JITImpl:190)."""
+
+    def __init__(self, fn: Callable, out_idx=None, target: str = "auto",
+                 verbose: bool = False, pass_configs: Optional[dict] = None,
+                 **_ignored):
+        functools.update_wrapper(self, fn)
+        self.fn = fn
+        self.out_idx = out_idx
+        self.target = target
+        self.verbose = verbose
+        self.pass_configs = pass_configs
+        self._kernels = {}
+
+    def _key(self, args, kwargs):
+        return (tuple(args), tuple(sorted(kwargs.items())))
+
+    def __call__(self, *args, **kwargs):
+        key = self._key(args, kwargs)
+        k = self._kernels.get(key)
+        if k is None:
+            pf = self.fn(*args, **kwargs)
+            if isinstance(pf, JITKernel):
+                k = pf
+            elif isinstance(pf, PrimFuncObj):
+                k = compile(pf, out_idx=self.out_idx, target=self.target,
+                            verbose=self.verbose,
+                            pass_configs=self.pass_configs)
+            else:
+                raise TypeError(
+                    f"@tilelang.jit factory must return a @T.prim_func, got "
+                    f"{type(pf)}")
+            self._kernels[key] = k
+        return k
+
+
+def jit(fn: Optional[Callable] = None, *, out_idx=None, target: str = "auto",
+        execution_backend: str = "auto", verbose: bool = False,
+        pass_configs: Optional[dict] = None, debug_root_path: Optional[str] = None,
+        compile_flags=None):
+    """Decorator over a kernel factory (reference jit:456)."""
+
+    def wrap(f):
+        if isinstance(f, PrimFuncObj):
+            return compile(f, out_idx=out_idx, target=target,
+                           verbose=verbose, pass_configs=pass_configs)
+        return JITImpl(f, out_idx=out_idx, target=target, verbose=verbose,
+                       pass_configs=pass_configs)
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# lazy_jit: per-shape specialization (reference lazy_jit:547)
+# ---------------------------------------------------------------------------
+
+
+def _solve_dims(annot_shape, actual_shape, binding: dict, pname: str):
+    from ..ir import Var, as_int
+    if len(annot_shape) != len(actual_shape):
+        raise ValueError(
+            f"lazy_jit: param {pname} rank mismatch: annotation rank "
+            f"{len(annot_shape)} vs tensor rank {len(actual_shape)}")
+    for dim, actual in zip(annot_shape, actual_shape):
+        if isinstance(dim, Var):
+            prev = binding.get(id(dim))
+            if prev is None:
+                binding[id(dim)] = (dim, int(actual))
+            elif prev[1] != actual:
+                raise ValueError(
+                    f"lazy_jit: dim {dim.name} bound to both {prev[1]} and "
+                    f"{actual}")
+        else:
+            c = as_int(dim)
+            if c is not None and c != actual:
+                raise ValueError(
+                    f"lazy_jit: param {pname} expects dim {c}, got {actual}")
+
+
+def _subst_shape(shape, env_map):
+    from ..ir import Var, as_int, convert
+    out = []
+    for dim in shape:
+        if isinstance(dim, Var):
+            if id(dim) not in env_map:
+                raise ValueError(f"lazy_jit: unbound symbolic dim {dim.name}")
+            out.append(env_map[id(dim)])
+        else:
+            v = as_int(dim)
+            if v is None:
+                raise ValueError("lazy_jit: arithmetic symbolic dims are not "
+                                 "supported yet; use bare T.dynamic dims")
+            out.append(v)
+    return tuple(out)
+
+
+class LazyJITImpl:
+    def __init__(self, fn: Callable, **jit_kwargs):
+        functools.update_wrapper(self, fn)
+        self.fn = fn
+        self.jit_kwargs = jit_kwargs
+        self._kernels = {}
+
+    def __call__(self, *tensors):
+        from ..language.annot import TensorAnnot
+        sig = inspect.signature(self.fn)
+        names = list(sig.parameters)
+        annots = [sig.parameters[n].annotation for n in names]
+        if len(tensors) != len(names):
+            raise TypeError(f"lazy_jit kernel takes {len(names)} tensors, "
+                            f"got {len(tensors)}")
+        binding: dict = {}
+        for pname, annot, t in zip(names, annots, tensors):
+            if isinstance(annot, TensorAnnot):
+                _solve_dims(annot.shape, t.shape, binding, pname)
+        env_map = {k: v for k, (_, v) in binding.items()}
+        shape_key = tuple(sorted((v.name, val)
+                                 for v, val in binding.values()))
+        kernel = self._kernels.get(shape_key)
+        if kernel is None:
+            # re-trace with concrete shapes substituted into annotations
+            concrete = []
+            for pname, annot in zip(names, annots):
+                if isinstance(annot, TensorAnnot):
+                    concrete.append(TensorAnnot(
+                        _subst_shape(annot.shape, env_map), annot.dtype))
+                else:
+                    concrete.append(annot)
+            fn = self.fn
+            orig = dict(fn.__annotations__)
+            try:
+                for n, a in zip(names, concrete):
+                    fn.__annotations__[n] = a
+                pf = trace_prim_func(fn)
+            finally:
+                fn.__annotations__.update(orig)
+            kernel = compile(pf, **self.jit_kwargs)
+            self._kernels[shape_key] = kernel
+        return kernel(*tensors)
+
+
+def lazy_jit(fn: Optional[Callable] = None, *, out_idx=None,
+             target: str = "auto", verbose: bool = False,
+             pass_configs: Optional[dict] = None, **_ignored):
+    def wrap(f):
+        return LazyJITImpl(f, out_idx=out_idx, target=target,
+                           verbose=verbose, pass_configs=pass_configs)
+    if fn is not None:
+        return wrap(fn)
+    return wrap

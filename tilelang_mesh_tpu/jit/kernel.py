@@ -1,0 +1,132 @@
+"""JITKernel: the executable kernel object.
+
+Reference: /root/reference/tilelang/jit/kernel.py (JITKernel:31). The
+reference compiles CUDA source with nvcc and marshals torch tensors through
+a generated C host wrapper; here the artifact is generated Pallas source,
+executed via exec() and wrapped in jax.jit — XLA is the runtime. The adapter
+role (ctypes/cython/nvrtc) collapses into arg marshalling (utils/tensor.py
+to_jax) because jax.Array IS the device handle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..engine.param import CompiledArtifact
+from ..utils.target import target_is_interpret, target_is_mesh
+from ..utils.tensor import TensorSupplyType, copy_back, to_jax
+
+
+class JITKernel:
+    def __init__(self, artifact: CompiledArtifact,
+                 out_idx: Optional[Sequence[int]] = None,
+                 verbose: bool = False):
+        self.artifact = artifact
+        self.out_idx = out_idx
+        self.verbose = verbose
+        self._build()
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        art = self.artifact
+        modname = f"<tl_tpu:{art.name}>"
+        ns: dict = {}
+        code = compile(art.kernel_source, modname, "exec")
+        exec(code, ns)
+        interpret = target_is_interpret(art.target)
+        self._raw_call: Callable = ns["build"](interpret=interpret)
+        import jax
+        self.func = jax.jit(self._raw_call)
+        self._in_params = art.in_params
+        self._out_params = art.out_params
+        self._in_positions = [i for i, p in enumerate(art.params)
+                              if p.role in ("in", "inout")]
+        self._out_positions = [i for i, p in enumerate(art.params)
+                               if p.role == "out"]
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args, stream=None, **kwargs):
+        n_in, n_all = len(self._in_params), len(self.artifact.params)
+        outs_provided = None
+        if len(args) == n_in:
+            ins = list(args)
+        elif len(args) == n_all:
+            ins = [args[i] for i in self._in_positions]
+            outs_provided = [args[i] for i in self._out_positions]
+        else:
+            raise TypeError(
+                f"{self.artifact.name}: expected {n_in} input tensors "
+                f"(or all {n_all} params, reference-style), got {len(args)}")
+        jax_ins = [to_jax(a) for a in ins]
+        self._check_shapes(jax_ins)
+        result = self.func(*jax_ins)
+        results = result if isinstance(result, tuple) else (result,)
+        if outs_provided:
+            import jax as _jax
+            wrote_back = False
+            for dst, src in zip(outs_provided, results):
+                if not isinstance(dst, _jax.Array):
+                    copy_back(dst, src)
+                    wrote_back = True
+            if wrote_back:
+                return None if len(results) == 1 else None
+        return results[0] if len(results) == 1 else results
+
+    def _check_shapes(self, jax_ins):
+        for a, p in zip(jax_ins, self._in_params):
+            if tuple(a.shape) != tuple(p.shape):
+                raise ValueError(
+                    f"{self.artifact.name}: param {p.name} expects shape "
+                    f"{tuple(p.shape)}, got {tuple(a.shape)}")
+            if str(a.dtype) != p.dtype:
+                raise ValueError(
+                    f"{self.artifact.name}: param {p.name} expects dtype "
+                    f"{p.dtype}, got {a.dtype}")
+
+    # -- introspection (reference kernel.py:423-734) -------------------------
+    def get_kernel_source(self) -> str:
+        """The generated Pallas/Python source (the 'CUDA source' analog)."""
+        return self.artifact.kernel_source
+
+    def get_ir_script(self) -> str:
+        return self.artifact.ir_script
+
+    def get_plan(self) -> str:
+        return self.artifact.plan_desc
+
+    def get_jaxpr(self) -> str:
+        """The traced jaxpr — the closest analog of show_ptx."""
+        import jax
+        ins = self._example_inputs()
+        return str(jax.make_jaxpr(self._raw_call)(*ins))
+
+    def get_lowered_hlo(self) -> str:
+        """StableHLO text of the whole kernel (the SASS analog)."""
+        ins = self._example_inputs()
+        return self.func.lower(*ins).as_text()
+
+    def _example_inputs(self):
+        import jax
+        import jax.numpy as jnp
+        return [jax.ShapeDtypeStruct(tuple(p.shape), jnp.dtype(p.dtype))
+                for p in self._in_params]
+
+    # -- profiler ------------------------------------------------------------
+    def get_profiler(self,
+                     tensor_supply_type: TensorSupplyType =
+                     TensorSupplyType.Auto):
+        from ..profiler import Profiler
+        return Profiler(self, tensor_supply_type)
+
+    @property
+    def params(self):
+        return self.artifact.params
+
+    @property
+    def out_params(self):
+        return self._out_params
+
+    def __repr__(self):
+        return (f"JITKernel({self.artifact.name}, target="
+                f"{self.artifact.target}, grid={self.artifact.grid})")

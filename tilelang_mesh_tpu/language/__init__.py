@@ -1,0 +1,95 @@
+"""tilelang_mesh_tpu.language — the `T` namespace.
+
+The full DSL surface, mirroring /root/reference/tilelang/language/__init__.py
+re-founded on TPU semantics. Typical use::
+
+    import tilelang_mesh_tpu.language as T
+
+    @T.prim_func
+    def kernel(A: T.Tensor((M, K), "bfloat16"), ...):
+        with T.Kernel(T.ceildiv(N, bn), T.ceildiv(M, bm)) as (bx, by):
+            ...
+"""
+
+# builder / prim_func
+from .builder import prim_func, macro, Builder, PrimFuncObj, current_builder
+
+# annotations (kernel params)
+from .annot import (Tensor, StridedTensor, MeshTensor, MeshTensorAnnot,
+                    TensorAnnot, dyn, dynamic, symbolic)
+from ..parallel.sharding import MeshShardingPolicy, MeshReplicationType
+
+# kernel frame
+from .kernel import Kernel
+
+# allocation
+from .allocate import (alloc_shared, alloc_fragment, alloc_local, alloc_var,
+                       alloc_reducer, alloc_barrier, alloc_tmem,
+                       alloc_descriptor)
+
+# data movement
+from .copy import copy, fill, clear, c2d_im2col
+
+# compute
+from .gemm import gemm, gemm_sp, GemmWarpPolicy
+
+# loops
+from .loop import Parallel, Pipelined, Persistent, serial, unroll, vectorized
+
+# reductions
+from .reduce import (reduce, reduce_sum, reduce_max, reduce_min,
+                     reduce_abssum, reduce_absmax, reduce_bitand,
+                     reduce_bitor, reduce_bitxor, cumsum, finalize_reducer)
+
+# atomics
+from .atomic import (atomic_add, atomic_max, atomic_min, atomic_addx2,
+                     atomic_addx4)
+
+# math intrinsics
+from .math_ops import (exp, exp2, exp10, log, log2, log10, log1p, sqrt, rsqrt,
+                       sin, cos, tan, sinh, cosh, tanh, asin, acos, atan,
+                       atan2, erf, floor, ceil, round, trunc, sigmoid, abs,
+                       max, min, pow, fmod, max_value, min_value, infinity,
+                       if_then_else, Select, clamp, cast, reinterpret,
+                       ceildiv, floordiv, floormod, truncdiv, truncmod,
+                       __exp, __exp2, __exp10, __log, __log2, __log10, __sin,
+                       __cos, __tan, __pow)
+
+# debug
+from .debug import print, device_assert  # noqa: A004
+
+# annotations / hints
+from .annotations import (use_swizzle, annotate_layout, annotate_safe_value,
+                          annotate_l2_hit_ratio, annotate_restricted_layout,
+                          set_max_nreg, no_set_max_nreg,
+                          disable_warp_group_reg_alloc, sync_threads,
+                          fence_proxy_async)
+
+# communication (mesh extension)
+from . import comm
+from .comm import CoreId, current_core
+
+# expression-level helpers re-exported at T.*
+from ..ir import Var, const, convert as _convert
+
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+uint16 = "uint16"
+uint32 = "uint32"
+uint64 = "uint64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+float8_e4m3 = "float8_e4m3fn"
+float8_e5m2 = "float8_e5m2"
+bool_ = "bool"
+
+
+def thread_binding(*args, **kwargs):
+    raise NotImplementedError(
+        "T.thread_binding is CUDA-specific; TPU kernels express parallelism "
+        "with T.Parallel (VPU lanes) and the T.Kernel grid (cores)")

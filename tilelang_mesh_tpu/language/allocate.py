@@ -1,0 +1,65 @@
+"""Buffer allocation DSL: T.alloc_shared / alloc_fragment / alloc_local /
+alloc_var / alloc_reducer.
+
+Reference: /root/reference/tilelang/language/allocate.py:37-282. TPU mapping:
+shared -> VMEM tile, fragment -> VMEM accumulator (Mosaic registers hot tiles
+into vregs itself), var -> SMEM scalar. Barrier/tmem/descriptor allocs are
+GPU-specific (mbarrier/TMA/tcgen05) and have no TPU analog — they raise with
+guidance.
+"""
+
+from __future__ import annotations
+
+from ..ir import Buffer
+from .builder import require_builder
+
+
+def alloc_shared(shape, dtype, scope: str = "shared") -> Buffer:
+    b = require_builder()
+    return b.alloc_buffer(shape, dtype, "shared", "shared")
+
+
+def alloc_fragment(shape, dtype, scope: str = "fragment") -> Buffer:
+    b = require_builder()
+    return b.alloc_buffer(shape, dtype, "fragment", "frag")
+
+
+def alloc_local(shape, dtype) -> Buffer:
+    b = require_builder()
+    return b.alloc_buffer(shape, dtype, "local", "local")
+
+
+def alloc_var(dtype, init=None) -> Buffer:
+    """A mutable scalar; lowers to an SMEM (1,1) cell."""
+    b = require_builder()
+    buf = b.alloc_buffer((1,), dtype, "local.var", "var")
+    if init is not None:
+        buf[0] = init
+    return buf
+
+
+def alloc_reducer(shape, dtype, op: str = "sum", replication=None) -> Buffer:
+    """Reducer buffer (reference allocate.py alloc_reducer). On TPU a reducer
+    is just a fragment accumulator; the finalize step is a no-op."""
+    b = require_builder()
+    buf = b.alloc_buffer(shape, dtype, "fragment", "reducer")
+    buf.reducer_op = op
+    return buf
+
+
+def _gpu_only(what: str, hint: str):
+    def f(*a, **k):
+        raise NotImplementedError(
+            f"T.{what} is a GPU-specific construct with no TPU analog; {hint}")
+    return f
+
+
+alloc_barrier = _gpu_only(
+    "alloc_barrier", "Pallas semaphores (pltpu.SemaphoreType) are allocated "
+    "by the compiler for DMA; use T.Pipelined for overlap")
+alloc_tmem = _gpu_only(
+    "alloc_tmem", "tcgen05 tensor memory does not exist on TPU; accumulate in "
+    "a T.alloc_fragment buffer")
+alloc_descriptor = _gpu_only(
+    "alloc_descriptor", "TMA descriptors do not exist on TPU; T.copy lowers "
+    "to Mosaic DMA directly")

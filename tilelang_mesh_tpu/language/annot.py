@@ -1,0 +1,134 @@
+"""Kernel-parameter annotations: T.Tensor, T.StridedTensor, T.MeshTensor,
+T.dyn / T.dynamic / T.symbolic.
+
+Reference surface: /root/reference/tilelang/language/v2/annot.py. Annotations
+are plain objects evaluated at function-definition time; ``@T.prim_func`` asks
+each one to materialize a parameter proxy via ``__tl_make_param__``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from ..ir import Buffer, Var, canon_dtype
+from ..parallel.sharding import (MeshShardingPolicy, MeshReplicationType,
+                                 MeshTensorMeta)
+
+
+class _AnnotBase:
+    def __tl_make_param__(self, name: str, builder):
+        raise NotImplementedError
+
+
+class TensorAnnot(_AnnotBase):
+    """Annotation instance for one tensor parameter."""
+
+    def __init__(self, shape, dtype="float32", scope: str = "global",
+                 strides=None):
+        if not isinstance(shape, (tuple, list)):
+            shape = (shape,)
+        self.shape = tuple(shape)
+        self.dtype = canon_dtype(dtype)
+        self.scope = scope
+        self.strides = strides
+
+    def __tl_make_param__(self, name: str, builder) -> Buffer:
+        return Buffer(name, self.shape, self.dtype, self.scope)
+
+    def get_key(self) -> tuple:
+        return ("tensor", self.shape, self.dtype, self.scope)
+
+    def __repr__(self):
+        return f"Tensor({self.shape}, {self.dtype})"
+
+
+class _TensorFactory:
+    """``T.Tensor((M, K), dtype)`` and ``T.Tensor[...]`` both produce
+    TensorAnnot instances (the subscript form serves lazy_jit signatures)."""
+
+    def __call__(self, shape, dtype="float32", strides=None):
+        return TensorAnnot(shape, dtype, strides=strides)
+
+    def __getitem__(self, params):
+        if not isinstance(params, tuple):
+            params = (params,)
+        if params and isinstance(params[-1], str):
+            return TensorAnnot(params[:-1], params[-1])
+        return TensorAnnot(params, "float32")
+
+
+class _StridedTensorFactory(_TensorFactory):
+    def __call__(self, shape, dtype="float32", strides=None):
+        return TensorAnnot(shape, dtype, strides=strides)
+
+
+class MeshTensorAnnot(_AnnotBase):
+    """A distributed tensor parameter sharded over the 2-D core mesh.
+
+    The traced kernel sees the *local shard* buffer (A.shape == sharded
+    shape), exactly like the reference (annot.py:659-720); the global shape
+    and policy ride along as mesh_meta so the SPMD lowering can build
+    PartitionSpecs and validate collectives.
+    """
+
+    def __init__(self, shape, sharding_policy: MeshShardingPolicy,
+                 device_mesh_config: Tuple[int, int], dtype="float32"):
+        if not isinstance(shape, (tuple, list)):
+            shape = (shape,)
+        self.global_shape = tuple(shape)
+        self.policy = sharding_policy
+        self.mesh_config = tuple(device_mesh_config)
+        self.dtype = canon_dtype(dtype)
+        nrows, ncols = self.mesh_config
+        self.sharded_shape = sharding_policy.sharded_shape(
+            self.global_shape, nrows, ncols)
+
+    def __tl_make_param__(self, name: str, builder) -> Buffer:
+        buf = Buffer(name, self.sharded_shape, self.dtype, "global")
+        buf.mesh_meta = MeshTensorMeta(self.global_shape, self.policy,
+                                       self.mesh_config)
+        builder.attrs.setdefault("mesh_config", self.mesh_config)
+        return buf
+
+    def get_key(self) -> tuple:
+        return ("mesh_tensor", self.global_shape, repr(self.policy),
+                self.mesh_config, self.dtype)
+
+
+def MeshTensor(shape, sharding_policy, device_mesh_config, dtype="float32"):
+    return MeshTensorAnnot(shape, sharding_policy, device_mesh_config, dtype)
+
+
+class DynAnnot(_AnnotBase):
+    """A dynamic (symbolic) scalar parameter — lazy_jit specializes on the
+    concrete value per call site (cf. SURVEY §7 'dynamic shapes')."""
+
+    def __init__(self, dtype="int32", name: Optional[str] = None):
+        self.dtype = canon_dtype(dtype)
+        self.name = name
+
+    def __tl_make_param__(self, name: str, builder) -> Var:
+        return Var(self.name or name, self.dtype)
+
+
+class _DynFactory:
+    def __call__(self, dtype="int32", name=None):
+        return DynAnnot(dtype, name)
+
+    def __getitem__(self, params):
+        if isinstance(params, str):
+            return DynAnnot("int32", params)
+        return DynAnnot()
+
+
+Tensor = _TensorFactory()
+StridedTensor = _StridedTensorFactory()
+dyn = _DynFactory()
+
+
+def dynamic(name: str, dtype: str = "int32") -> Var:
+    """``T.dynamic("m")`` — a symbolic dimension usable in shapes."""
+    return Var(name, dtype)
+
+
+symbolic = dynamic

@@ -1,0 +1,66 @@
+"""Kernel annotations: T.use_swizzle, T.annotate_layout, etc.
+
+Reference: /root/reference/tilelang/language/annotations.py. On TPU these are
+scheduling hints recorded into the enclosing kernel's annotation dict; the
+Mosaic compiler owns physical layout, so most are advisory (swizzle -> grid
+rasterization hint consumed by the codegen's grid-order choice; layout
+annotations -> checked against the layout engine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .builder import require_builder
+
+
+def _annotate(key: str, value):
+    b = require_builder()
+    b.attrs.setdefault("kernel_annotations", {})[key] = value
+
+
+def use_swizzle(panel_size: int = 10, order: str = "row", enable: bool = True):
+    """L2-locality rasterization hint (reference: rasterization2DColumn).
+    TPU grids iterate sequentially per core; the codegen uses this to choose
+    a panel-major grid order when beneficial."""
+    _annotate("swizzle", {"panel_size": panel_size, "order": order,
+                          "enable": enable})
+
+
+def annotate_layout(layout_map: Dict[Any, Any]):
+    _annotate("layout_map", layout_map)
+
+
+def annotate_safe_value(buffer, value):
+    _annotate("safe_value", (buffer, value))
+
+
+def annotate_l2_hit_ratio(buffer, ratio: float):
+    # No L2 persisting-cache on TPU; retained for API parity.
+    _annotate("l2_hit_ratio", (getattr(buffer, "name", buffer), ratio))
+
+
+def annotate_restricted_layout(*args, **kwargs):
+    pass
+
+
+def no_set_max_nreg(*args, **kwargs):
+    pass
+
+
+def set_max_nreg(*args, **kwargs):
+    pass
+
+
+def disable_warp_group_reg_alloc(*args, **kwargs):
+    pass
+
+
+def sync_threads():
+    """__syncthreads analog: a no-op on TPU (single instruction stream per
+    core; DMA ordering is handled by semaphores the compiler inserts)."""
+    require_builder()
+
+
+def fence_proxy_async(*a, **k):
+    require_builder()

@@ -1,0 +1,46 @@
+"""T.atomic_* — reference tilelang/language/atomic.py + src/op/atomic_add.cc.
+
+TPU grid steps run sequentially on a core and cross-core accumulation goes
+through collectives, so 'atomics' lower to plain read-modify-write on the
+destination tile (correct under Pallas' sequential grid semantics)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..ir import AtomicStmt, to_region, convert, Buffer, BufferLoad, Region
+from .builder import require_builder
+
+
+def _emit(op: str, dst: Any, value: Any):
+    b = require_builder()
+    hint = None
+    if isinstance(value, (Buffer, Region)) or (
+            isinstance(value, BufferLoad) and value.has_slices):
+        value = to_region(value)
+        hint = tuple(value.shape)
+        dst_r = to_region(dst, extent_hint=hint)
+    else:
+        value = convert(value)
+        dst_r = to_region(dst, extent_hint=(1,))
+    b.emit(AtomicStmt(op, dst_r, value))
+
+
+def atomic_add(dst, value, memory_order=None, scope=None):
+    _emit("add", dst, value)
+
+
+def atomic_max(dst, value, memory_order=None, scope=None):
+    _emit("max", dst, value)
+
+
+def atomic_min(dst, value, memory_order=None, scope=None):
+    _emit("min", dst, value)
+
+
+def atomic_addx2(dst, value):
+    _emit("add", dst, value)
+
+
+def atomic_addx4(dst, value):
+    _emit("add", dst, value)

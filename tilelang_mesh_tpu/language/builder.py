@@ -1,0 +1,186 @@
+"""Trace builder: executes a user kernel function against buffer proxies and
+records tile-IR.
+
+TPU-native re-design of the reference's DSL v2 builder
+(/root/reference/tilelang/language/v2/builder.py:178). The reference rewrites
+the Python AST and replays it against a TVM IRBuilder; we instead run the
+function directly — loops and frames are context managers / generators that
+push and pop builder frames. This covers the tile-DSL subset (data-dependent
+Python `if` over traced values is rejected with a clear error; use
+T.if_then_else / T.Select).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+from typing import Any, Callable, List, Optional
+
+from ..ir import (Buffer, PrimFunc, SeqStmt, Stmt, AllocStmt, Var, convert)
+
+_STATE = threading.local()
+
+
+def _stack() -> List["Builder"]:
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = []
+    return _STATE.stack
+
+
+def current_builder() -> Optional["Builder"]:
+    st = _stack()
+    return st[-1] if st else None
+
+
+def require_builder() -> "Builder":
+    b = current_builder()
+    if b is None:
+        raise RuntimeError("this T.* construct is only valid inside a "
+                           "@T.prim_func body")
+    return b
+
+
+class Builder:
+    """Collects statements into nested frames while the user function runs."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.frames: List[SeqStmt] = [SeqStmt()]
+        self.params: List[Any] = []
+        self.attrs: dict = {}
+        self._name_counts: dict = {}
+
+    # -- frame management ----------------------------------------------------
+    def push_frame(self) -> SeqStmt:
+        f = SeqStmt()
+        self.frames.append(f)
+        return f
+
+    def pop_frame(self) -> SeqStmt:
+        return self.frames.pop()
+
+    def emit(self, stmt: Stmt):
+        self.frames[-1].stmts.append(stmt)
+
+    # -- naming --------------------------------------------------------------
+    def fresh_name(self, base: str) -> str:
+        n = self._name_counts.get(base, 0)
+        self._name_counts[base] = n + 1
+        return base if n == 0 else f"{base}_{n}"
+
+    def alloc_buffer(self, shape, dtype, scope, name: str) -> Buffer:
+        buf = Buffer(self.fresh_name(name), shape, dtype, scope)
+        self.emit(AllocStmt(buf))
+        return buf
+
+    # -- finish --------------------------------------------------------------
+    def finish(self) -> PrimFunc:
+        assert len(self.frames) == 1, "unbalanced builder frames"
+        return PrimFunc(self.name, self.params, self.frames[0], self.attrs)
+
+
+class PrimFuncObj:
+    """The object returned by @T.prim_func: holds the traced IR plus the
+    original callable for re-elaboration (lazy_jit / dynamic shapes)."""
+
+    def __init__(self, func: PrimFunc, source_fn: Callable,
+                 annots: List[tuple]):
+        self.func = func
+        self.source_fn = source_fn
+        self.annots = annots  # [(param_name, annot_obj)]
+
+    @property
+    def name(self):
+        return self.func.name
+
+    def script(self) -> str:
+        return self.func.script()
+
+    @property
+    def params(self):
+        return self.func.params
+
+    @property
+    def attrs(self):
+        return self.func.attrs
+
+    def __repr__(self):
+        return f"PrimFuncObj({self.func.name})"
+
+    def __call__(self, *args, **kwargs):
+        # Convenience: compile on first call with the default target.
+        from .. import compile as _compile
+        if not hasattr(self, "_default_kernel"):
+            self._default_kernel = _compile(self)
+        return self._default_kernel(*args, **kwargs)
+
+
+def _param_annotations(fn: Callable) -> List[tuple]:
+    sig = inspect.signature(fn)
+    out = []
+    for name, p in sig.parameters.items():
+        if p.annotation is inspect.Parameter.empty:
+            raise TypeError(
+                f"@T.prim_func parameter {name!r} needs a T.Tensor/"
+                f"T.MeshTensor/T.dyn annotation")
+        out.append((name, p.annotation))
+    return out
+
+
+def trace_prim_func(fn: Callable, name: Optional[str] = None) -> PrimFuncObj:
+    """Run `fn` against proxies built from its annotations; return the IR."""
+    annots = _param_annotations(fn)
+    b = Builder(name or fn.__name__)
+    _stack().append(b)
+    try:
+        args = []
+        for pname, annot in annots:
+            proxy = _make_param(b, pname, annot)
+            args.append(proxy)
+        fn(*args)
+    finally:
+        _stack().pop()
+    return PrimFuncObj(b.finish(), fn, annots)
+
+
+def _make_param(b: Builder, pname: str, annot) -> Any:
+    """Instantiate a parameter proxy from its annotation object."""
+    make = getattr(annot, "__tl_make_param__", None)
+    if make is None:
+        raise TypeError(
+            f"annotation for parameter {pname!r} is {annot!r}, which is not a "
+            "tile-language annotation (T.Tensor(...), T.MeshTensor(...), "
+            "T.dyn(...))")
+    proxy = make(pname, b)
+    b.params.append(proxy if isinstance(proxy, (Buffer, Var)) else proxy)
+    return proxy
+
+
+def prim_func(fn: Optional[Callable] = None, *, private: bool = False):
+    """Decorator: trace the function body into tile-IR.
+
+    Mirrors the reference's ``T.prim_func``
+    (/root/reference/tilelang/language/v2/builder.py:843). The traced IR is
+    built eagerly at decoration time when all annotation shapes are concrete.
+    """
+
+    def wrap(f: Callable) -> PrimFuncObj:
+        return trace_prim_func(f)
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def macro(fn: Callable) -> Callable:
+    """A reusable DSL fragment: calling it inside a prim_func inlines its
+    statements (reference: builder.py:718 Macro). With a trace-based builder
+    a macro is just a Python function — provided for API parity."""
+
+    @functools.wraps(fn)
+    def inner(*args, **kwargs):
+        require_builder()
+        return fn(*args, **kwargs)
+
+    return inner

@@ -1,0 +1,187 @@
+"""T.comm.* — inter-core mesh communication DSL.
+
+Behavioral equivalent of /root/reference/tilelang/language/comm.py (same
+signatures, same shape/mesh validation, same direction and reduce-type
+vocabulary). The ops record CommStmt nodes; the SPMD lowering
+(parallel/lowering.py) turns them into XLA collectives over the ICI mesh —
+``psum`` / ``all_gather`` / ``ppermute`` inside ``shard_map`` — instead of
+the reference's compiler-synthesized NoC broadcast schedules
+(src/op/comm.cc). The schedule synthesis itself is kept (parallel/
+collectives.py, native-backed) for the Pallas ring-collective path and for
+golden parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Literal, Optional, Tuple
+
+from ..ir import (Buffer, CommAllGather, CommAllReduce, CommBarrier,
+                  CommBroadcast, CommFence, CommPut, Region, to_region, Call)
+from ..parallel.device_mesh import (get_device_mesh_config, core_tuple_to_id,
+                                    core_id_to_tuple)
+from .builder import require_builder
+
+DIRECTION_MAP = {"horizontal": 0, "h": 0, "vertical": 1, "v": 1, "all": 2,
+                 "a": 2}
+REDUCE_TYPE_LIST = ("sum", "abssum", "max", "min", "absmax", "bitand",
+                    "bitor", "bitxor")
+
+
+def get_target_mesh_shape() -> dict:
+    nrow, ncol = get_device_mesh_config()
+    return {"x": nrow, "y": ncol}
+
+
+def CoreId(core_id):
+    """Linear core id for an int or (row, col) tuple."""
+    mesh = get_target_mesh_shape()
+    if isinstance(core_id, tuple):
+        return core_tuple_to_id(core_id)
+    if isinstance(core_id, int):
+        assert 0 <= core_id < mesh["x"] * mesh["y"], \
+            f"Core ID {core_id} out of bounds for mesh shape {mesh}"
+        return core_id
+    raise ValueError("core_id must be either a tuple[int, int] or an int.")
+
+
+def current_core():
+    """The executing core's linear id (a traced expression)."""
+    return Call("current_core", [], "int32")
+
+
+def _check_shapes_bcast(src: Buffer, dst: Buffer, opname: str):
+    assert src.dtype == dst.dtype, (
+        f"Source and destination buffer dtypes must match for {opname}. "
+        f"Got {src.dtype} vs {dst.dtype}.")
+    if len(src.shape) != len(dst.shape):
+        raise ValueError(f"Source and destination buffer must have the same "
+                         f"number of dimensions for {opname}.")
+    for a, b in zip(src.shape, dst.shape):
+        assert a == b or a == 1 or b == 1, (
+            f"Source/destination shapes must be compatible for {opname}: "
+            f"{src.shape} vs {dst.shape}")
+
+
+def _check_core(core: Tuple[int, int], what: str):
+    mesh = get_target_mesh_shape()
+    assert isinstance(core, tuple) and len(core) == 2, \
+        f"{what} must be a tuple of (row, col)."
+    assert 0 <= core[0] < mesh["x"], \
+        f"{what} row {core[0]} out of bounds for mesh shape {mesh}."
+    assert 0 <= core[1] < mesh["y"], \
+        f"{what} col {core[1]} out of bounds for mesh shape {mesh}."
+
+
+def _check_size(size: int, buf: Buffer, what: str = "size"):
+    n = buf.numel()
+    assert isinstance(size, int) and size >= -1, \
+        f"{what} must be an integer >= -1."
+    if n is not None:
+        assert size <= n, f"{what} {size} exceeds source buffer size {n}."
+
+
+def broadcast(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
+              direction: Literal["horizontal", "h", "vertical", "v", "all",
+                                 "a"] = "all",
+              size: int = -1):
+    """Broadcast `src` on `src_core` into `dst` on every core along
+    `direction`."""
+    b = require_builder()
+    _check_shapes_bcast(src, dst, "broadcast")
+    _check_core(src_core, "src_core")
+    _check_size(size, src)
+    assert direction.lower() in DIRECTION_MAP, \
+        f"Invalid direction string: {direction}"
+    b.emit(CommBroadcast(to_region(src), to_region(dst), size, 0,
+                         core_tuple_to_id(src_core),
+                         DIRECTION_MAP[direction.lower()]))
+
+
+def put(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
+        dst_core: Tuple[int, int], size: int = -1):
+    """Point-to-point: send `src` from src_core into `dst` on dst_core."""
+    b = require_builder()
+    _check_shapes_bcast(src, dst, "put")
+    _check_core(src_core, "src_core")
+    _check_core(dst_core, "dst_core")
+    _check_size(size, src)
+    b.emit(CommPut(to_region(src), to_region(dst), size,
+                   core_tuple_to_id(src_core), core_tuple_to_id(dst_core)))
+
+
+def all_gather(send_buffer: Buffer, recv_buffer: Buffer,
+               direction: Literal["horizontal", "h", "vertical", "v", "all",
+                                  "a"] = "all",
+               size: int = -1):
+    """Gather every participating core's send_buffer into
+    recv_buffer[core, ...]."""
+    b = require_builder()
+    assert direction.lower() in DIRECTION_MAP, \
+        f"Invalid direction string: {direction}"
+    assert send_buffer.dtype == recv_buffer.dtype, (
+        f"Source and destination buffer dtypes must match for all_gather. "
+        f"Got {send_buffer.dtype} vs {recv_buffer.dtype}.")
+    mesh = get_target_mesh_shape()
+    d = direction.lower()
+    if d in ("horizontal", "h"):
+        recv_num = mesh["y"]
+    elif d in ("vertical", "v"):
+        recv_num = mesh["x"]
+    else:
+        recv_num = mesh["x"] * mesh["y"]
+    expected = [recv_num] + [int(s) for s in send_buffer.shape]
+    got = [int(s) for s in recv_buffer.shape]
+    assert got == expected, (
+        f"Receive buffer shape must be {expected} to hold gathered data from "
+        f"{recv_num} cores, but got {got}.")
+    _check_size(size, send_buffer)
+    b.emit(CommAllGather(to_region(send_buffer), to_region(recv_buffer),
+                         DIRECTION_MAP[d], size))
+
+
+def all_reduce(buffer: Buffer, out: Buffer, reduce_type: str,
+               direction: Literal["horizontal", "h", "vertical", "v", "all",
+                                  "a"],
+               dim: int = -1, clear: bool = True):
+    """Local reduce over `dim`, then mesh-wide reduce along `direction`.
+
+    Output shape: buffer.shape without `dim` (or with `dim` kept as 1).
+    clear=False accumulates into the existing contents of `out`.
+    """
+    b = require_builder()
+    assert isinstance(dim, int) and -1 <= dim < len(buffer.shape), \
+        f"dim {dim} out of bounds for buffer with {len(buffer.shape)} " \
+        "dimensions."
+    if dim == -1:
+        dim = len(buffer.shape) - 1
+    shape = [int(s) for s in buffer.shape]
+    expected = [shape[:dim] + shape[dim + 1:],
+                shape[:dim] + [1] + shape[dim + 1:]]
+    got = [int(s) for s in out.shape]
+    if got not in expected:
+        exp_s = " or ".join(map(str, expected))
+        raise ValueError(
+            f"Invalid reduce output shape, buffer shape is {shape}, dim is "
+            f"{dim}, output shape is {got}, expected shapes are {exp_s}")
+    reduce_type = reduce_type.lower()
+    assert reduce_type in REDUCE_TYPE_LIST, (
+        f"Reduction op must be one of {REDUCE_TYPE_LIST}, but got "
+        f"{reduce_type}.")
+    assert direction.lower() in DIRECTION_MAP, \
+        f"Invalid direction string: {direction}"
+    assert clear in (True, False), "clear must be a boolean value."
+    b.emit(CommAllReduce(to_region(buffer), to_region(out), reduce_type,
+                         DIRECTION_MAP[direction.lower()], dim, clear))
+
+
+def barrier(group: Optional[Iterable[Tuple[int, int]]] = None):
+    """Synchronize a group of cores (all cores when group is None)."""
+    b = require_builder()
+    ids = None if group is None else [core_tuple_to_id(c) for c in group]
+    b.emit(CommBarrier(ids))
+
+
+def fence():
+    """Order communication against subsequent memory operations."""
+    b = require_builder()
+    b.emit(CommFence())

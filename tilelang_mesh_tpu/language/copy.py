@@ -1,0 +1,74 @@
+"""T.copy / T.fill / T.clear — tile data movement.
+
+Reference: /root/reference/tilelang/language/copy.py (T.copy:13) and
+src/op/copy.cc (instruction selection over cp.async/LDSM/TMA). On TPU, copy
+instruction selection happens in the transform pipeline instead: a copy whose
+source indices are affine in grid vars becomes a Pallas BlockSpec (Mosaic
+auto-DMA, multi-buffered); others lower to VMEM assignments or explicit
+async DMA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..ir import (Buffer, BufferLoad, CopyStmt, FillStmt, Region, to_region,
+                  convert)
+from .builder import require_builder
+
+
+def _extent_hint(obj) -> Optional[tuple]:
+    if isinstance(obj, Buffer):
+        return tuple(obj.shape)
+    if isinstance(obj, BufferLoad) and not obj.has_slices:
+        return None
+    if isinstance(obj, BufferLoad):
+        return tuple(to_region(obj).shape)
+    if isinstance(obj, Region):
+        return tuple(obj.shape)
+    return None
+
+
+def copy(src: Any, dst: Any, coalesced_width: Optional[int] = None,
+         disable_cache_hint: bool = False, eviction_policy=None):
+    """Copy a rectangular region between buffers (any scopes).
+
+    Shapes follow the reference's broadcast rule: an element-access base
+    (``A[i, j]``) takes its extent from the other side.
+    """
+    b = require_builder()
+    src_hint = _extent_hint(src)
+    dst_hint = _extent_hint(dst)
+    src_r = to_region(src, extent_hint=dst_hint)
+    dst_r = to_region(dst, extent_hint=src_hint or tuple(src_r.shape))
+    # validate extents where static
+    ss, ds = src_r.static_shape(), dst_r.static_shape()
+    if ss is not None and ds is not None:
+        # right-aligned broadcast compare (leading 1s allowed)
+        a, c = list(ss), list(ds)
+        while len(a) < len(c):
+            a.insert(0, 1)
+        while len(c) < len(a):
+            c.insert(0, 1)
+        for x, y in zip(a, c):
+            if x != y and x != 1 and y != 1:
+                raise ValueError(
+                    f"T.copy extent mismatch: src {ss} vs dst {ds}")
+    b.emit(CopyStmt(src_r, dst_r, coalesced_width))
+
+
+def fill(dst: Any, value):
+    b = require_builder()
+    b.emit(FillStmt(to_region(dst), convert(value)))
+
+
+def clear(dst: Any):
+    fill(dst, 0)
+
+
+def c2d_im2col(img: Buffer, col: Buffer, nhw_step, c_step, kernel, stride,
+               dilation, pad):
+    raise NotImplementedError(
+        "T.c2d_im2col (TMA im2col) is not implemented yet; express "
+        "convolution as jax.lax.conv_general_dilated or an explicit im2col "
+        "GEMM schedule")

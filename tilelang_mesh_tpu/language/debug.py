@@ -1,0 +1,22 @@
+"""T.print / T.device_assert — in-kernel debugging.
+
+Reference: /root/reference/tilelang/language/print.py. Lowered to
+pl.debug_print / jax checkify-style predicated traps.
+"""
+
+from __future__ import annotations
+
+from ..ir import AssertStmt, Buffer, PrintStmt, convert
+from .builder import require_builder
+
+
+def print(obj, msg: str = ""):  # noqa: A001 - mirrors reference name
+    b = require_builder()
+    if not isinstance(obj, Buffer):
+        obj = convert(obj)
+    b.emit(PrintStmt(obj, msg))
+
+
+def device_assert(cond, msg: str = ""):
+    b = require_builder()
+    b.emit(AssertStmt(convert(cond), msg))

@@ -1,0 +1,62 @@
+"""T.gemm — tile matrix multiply on the MXU.
+
+Reference: /root/reference/tilelang/language/gemm.py + src/op/gemm.cc
+(GemmInst selection MMA/WGMMA/TCGEN5MMA and warp partitioning). On TPU there
+is exactly one instruction that matters — the 128x128 systolic MXU — so the
+op lowers to ``jnp.dot(..., preferred_element_type=f32)`` on VMEM tiles and
+the whole warp-policy machinery degenerates to an API-compatible hint object.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Any, Optional
+
+from ..ir import GemmStmt, to_region
+from .builder import require_builder
+
+
+class GemmWarpPolicy(IntEnum):
+    """API-parity stub of the reference's warp-partition policy
+    (tilelang/language/gemm.py:18-163); harmless on TPU."""
+    Square = 0
+    FullRow = 1
+    FullCol = 2
+
+    @classmethod
+    def from_warp_partition(cls, m_warp: int, n_warp: int) -> "GemmWarpPolicy":
+        if m_warp == n_warp:
+            return cls.Square
+        return cls.FullRow if m_warp > n_warp else cls.FullCol
+
+
+def gemm(A: Any, B: Any, C: Any, transpose_A: bool = False,
+         transpose_B: bool = False, policy: GemmWarpPolicy = GemmWarpPolicy.Square,
+         clear_accum: bool = False, k_pack: int = 1, wg_wait: int = 0):
+    """C += op(A) @ op(B)  (C zeroed first when clear_accum).
+
+    A: (M, K) or (K, M) if transpose_A; B: (K, N) or (N, K) if transpose_B;
+    C: (M, N) accumulator fragment.
+    """
+    b = require_builder()
+    A_r, B_r, C_r = to_region(A), to_region(B), to_region(C)
+    # static shape validation when available
+    a_s, b_s, c_s = A_r.static_shape(), B_r.static_shape(), C_r.static_shape()
+    if a_s and b_s and c_s and len(a_s) == 2 and len(b_s) == 2:
+        M, K = (a_s[1], a_s[0]) if transpose_A else a_s
+        Kb, N = (b_s[1], b_s[0]) if transpose_B else b_s
+        if K != Kb:
+            raise ValueError(f"T.gemm K mismatch: {K} vs {Kb} "
+                             f"(A={a_s} tA={transpose_A}, B={b_s} "
+                             f"tB={transpose_B})")
+        if (M, N) != tuple(c_s):
+            raise ValueError(f"T.gemm output shape {c_s} != ({M}, {N})")
+    b.emit(GemmStmt(A_r, B_r, C_r, transpose_A, transpose_B, policy,
+                    clear_accum, k_pack, wg_wait))
+
+
+def gemm_sp(A_sparse, E, B, C, **kwargs):
+    raise NotImplementedError(
+        "2:4 structured-sparse GEMM has no MXU instruction on TPU; "
+        "densify the operand or use a blocksparse schedule "
+        "(ops.blocksparse)")

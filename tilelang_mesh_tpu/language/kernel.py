@@ -1,0 +1,67 @@
+"""T.Kernel — the grid launch frame.
+
+Reference: /root/reference/tilelang/language/kernel.py:228. On GPU this frame
+binds blockIdx; on TPU the frame's vars become Pallas grid dimensions
+(sequential per-core iteration, auto-pipelined by Mosaic). The first var
+(`bx`) is the fastest-varying, matching CUDA blockIdx.x — the pass pipeline
+reverses the order when building the Pallas grid so `bx` lands innermost.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..ir import KernelNode, SeqStmt, Var, as_int
+from .builder import require_builder
+
+
+class KernelFrame:
+    def __init__(self, *extents, threads: Any = None, prelude=None):
+        if len(extents) == 1 and isinstance(extents[0], (tuple, list)):
+            extents = tuple(extents[0])
+        self.extents = []
+        for e in extents:
+            v = as_int(e)
+            if v is None:
+                raise ValueError(
+                    "T.Kernel grid extents must be static ints on TPU "
+                    f"(got {e!r}); use lazy_jit for per-shape specialization")
+            self.extents.append(v)
+        self.threads = threads
+        self.grid_vars = []
+
+    def __enter__(self):
+        b = require_builder()
+        names = ["bx", "by", "bz"]
+        self.grid_vars = [
+            Var(b.fresh_name(names[i] if i < 3 else f"b{i}"))
+            for i in range(len(self.extents))
+        ]
+        # capture statements traced before the frame (rare; kept as prelude)
+        self._prelude = b.frames[-1].stmts
+        b.frames[-1].stmts = []
+        self._outer_holder = b.frames[-1]
+        b.push_frame()
+        if len(self.grid_vars) == 1:
+            return self.grid_vars[0]
+        return tuple(self.grid_vars)
+
+    def __exit__(self, exc_type, exc, tb):
+        b = require_builder()
+        body = b.pop_frame()
+        if exc_type is not None:
+            return False
+        node = KernelNode(self.grid_vars, self.extents, self.threads, body,
+                          prelude=self._prelude)
+        b.emit(node)
+        return False
+
+
+def Kernel(*extents, threads: Any = None, prelude=None) -> KernelFrame:
+    return KernelFrame(*extents, threads=threads, prelude=prelude)
+
+
+def get_thread_binding(dim: int = 0):
+    raise NotImplementedError(
+        "explicit thread bindings have no TPU analog; use T.Parallel and let "
+        "the compiler vectorize over VPU lanes")

@@ -1,0 +1,84 @@
+"""Loop constructs: T.Parallel, T.Pipelined, T.serial, T.unroll,
+T.vectorized, T.Persistent.
+
+Reference: /root/reference/tilelang/language/loop.py. TPU lowering:
+  Parallel   -> vectorized VPU/MXU ops over the whole tile (no thread binding)
+  Pipelined  -> an extra (innermost) Pallas grid dimension; Mosaic's pipeline
+                machinery provides the multi-stage HBM->VMEM double buffering
+                that inject_pipeline.cc builds by hand on GPU
+  serial     -> lax.fori_loop (or unrolled Python loop when small)
+  unroll     -> unrolled at trace time by the codegen
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..ir import ForNest, Var, as_int, convert
+from .builder import require_builder
+
+
+class _LoopBuilder:
+    def __init__(self, extents, kind: str, num_stages: int = 0,
+                 annotations=None):
+        self.extents = list(extents)
+        self.kind = kind
+        self.num_stages = num_stages
+        self.annotations = annotations or {}
+
+    def __iter__(self):
+        b = require_builder()
+        base = {"parallel": "i", "pipelined": "ko", "serial": "k",
+                "unroll": "u", "vectorized": "v", "persistent": "p"}
+        names = ("i", "j", "k", "l", "m", "n")
+        if len(self.extents) == 1:
+            vs = [Var(b.fresh_name(base.get(self.kind, "i")))]
+        else:
+            vs = [Var(b.fresh_name(names[i] if i < len(names) else f"i{i}"))
+                  for i in range(len(self.extents))]
+        b.push_frame()
+        try:
+            yield vs[0] if len(vs) == 1 else tuple(vs)
+        finally:
+            body = b.pop_frame()
+            exts = [as_int(e) if as_int(e) is not None else convert(e)
+                    for e in self.extents]
+            b.emit(ForNest(vs, exts, self.kind, body, self.num_stages,
+                           self.annotations))
+
+
+def Parallel(*extents, coalesced_width=None) -> _LoopBuilder:
+    """Elementwise loop nest mapped to full-tile vector ops."""
+    return _LoopBuilder(extents, "parallel",
+                        annotations={"coalesced_width": coalesced_width})
+
+
+def Pipelined(extent, num_stages: int = 0, order=None, stage=None,
+              sync=None, group=None) -> _LoopBuilder:
+    """Software-pipelined reduction loop (num_stages is an overlap hint; the
+    Mosaic pipeline chooses actual buffering)."""
+    return _LoopBuilder([extent], "pipelined", num_stages=num_stages,
+                        annotations={"order": order, "stage": stage})
+
+
+def serial(*args, annotations=None) -> _LoopBuilder:
+    start, stop = (0, args[0]) if len(args) == 1 else args[:2]
+    if as_int(start) not in (0, None) :
+        raise NotImplementedError("non-zero loop start not supported yet")
+    return _LoopBuilder([stop], "serial", annotations=annotations)
+
+
+def unroll(*args) -> _LoopBuilder:
+    start, stop = (0, args[0]) if len(args) == 1 else args[:2]
+    return _LoopBuilder([stop], "unroll")
+
+
+def vectorized(*args) -> _LoopBuilder:
+    start, stop = (0, args[0]) if len(args) == 1 else args[:2]
+    return _LoopBuilder([stop], "vectorized")
+
+
+def Persistent(*extents) -> _LoopBuilder:
+    """Persistent-kernel loop (reference loop.py:35). TPU cores already run a
+    persistent sequential grid, so this is a serial loop annotation."""
+    return _LoopBuilder(extents, "persistent")

@@ -1,0 +1,159 @@
+"""Scalar/elementwise intrinsics available inside kernel expressions.
+
+Reference: /root/reference/tilelang/language/math_intrinsics.py and
+fastmath.py. Each intrinsic records a Call node; the codegen maps names to
+jnp/lax equivalents (see codegen/pallas.py _CALL_IMPL). On TPU there is no
+--use_fast_math split: XLA picks VPU transcendental approximations itself,
+so the __exp-style fastmath variants alias the exact ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..ir import Call, Cast, PrimExpr, convert, promote_dtypes
+
+
+def _unary(name):
+    def f(x):
+        x = convert(x)
+        dt = x.dtype if x.dtype.startswith("float") or x.dtype == "bfloat16" \
+            else "float32"
+        return Call(name, [x], dt)
+    f.__name__ = name
+    return f
+
+
+def _binary(name):
+    def f(a, b):
+        a, b = convert(a), convert(b)
+        return Call(name, [a, b], promote_dtypes(a.dtype, b.dtype))
+    f.__name__ = name
+    return f
+
+
+exp = _unary("exp")
+exp2 = _unary("exp2")
+exp10 = _unary("exp10")
+log = _unary("log")
+log2 = _unary("log2")
+log10 = _unary("log10")
+log1p = _unary("log1p")
+sqrt = _unary("sqrt")
+rsqrt = _unary("rsqrt")
+sin = _unary("sin")
+cos = _unary("cos")
+tan = _unary("tan")
+sinh = _unary("sinh")
+cosh = _unary("cosh")
+tanh = _unary("tanh")
+asin = _unary("asin")
+acos = _unary("acos")
+atan = _unary("atan")
+erf = _unary("erf")
+floor = _unary("floor")
+ceil = _unary("ceil")
+round = _unary("round")
+trunc = _unary("trunc")
+sigmoid = _unary("sigmoid")
+
+atan2 = _binary("atan2")
+pow = _binary("pow")
+fmod = _binary("fmod")
+
+# fastmath aliases (reference fastmath.py __exp etc.)
+__exp = exp
+__exp2 = exp2
+__exp10 = exp10
+__log = log
+__log2 = log2
+__log10 = log10
+__sin = sin
+__cos = cos
+__tan = tan
+__pow = pow
+
+
+def abs(x):
+    x = convert(x)
+    return Call("abs", [x], x.dtype)
+
+
+def max(a, b, *rest):
+    from ..ir.expr import _binop
+    r = _binop("max", a, b)
+    for x in rest:
+        r = _binop("max", r, x)
+    return r
+
+
+def min(a, b, *rest):
+    from ..ir.expr import _binop
+    r = _binop("min", a, b)
+    for x in rest:
+        r = _binop("min", r, x)
+    return r
+
+
+def max_value(dtype: str):
+    return Call("max_value", [str(dtype)], dtype if isinstance(dtype, str)
+                else "float32")
+
+
+def min_value(dtype: str):
+    return Call("min_value", [str(dtype)], dtype if isinstance(dtype, str)
+                else "float32")
+
+
+def infinity(dtype: str = "float32"):
+    return Call("max_value", [str(dtype)], dtype)
+
+
+def if_then_else(cond, a, b):
+    cond, a, b = convert(cond), convert(a), convert(b)
+    return Call("where", [cond, a, b], promote_dtypes(a.dtype, b.dtype))
+
+
+Select = if_then_else
+
+
+def clamp(x, lo, hi):
+    return min(max(x, lo), hi)
+
+
+def Cast_(dtype, value):
+    return Cast(dtype, convert(value))
+
+
+def cast(value, dtype):
+    return Cast(dtype, convert(value))
+
+
+def reinterpret(dtype, value):
+    value = convert(value)
+    return Call("bitcast", [value, str(dtype)], str(dtype))
+
+
+def ceildiv(a, b):
+    from ..ir import ceildiv as _cd
+    return _cd(a, b)
+
+
+def floordiv(a, b):
+    from ..ir.expr import _binop
+    return _binop("//", a, b)
+
+
+def floormod(a, b):
+    from ..ir.expr import _binop
+    return _binop("%", a, b)
+
+
+def truncdiv(a, b):
+    from ..ir.expr import _binop
+    return _binop("//", a, b)
+
+
+def truncmod(a, b):
+    from ..ir.expr import _binop
+    return _binop("%", a, b)

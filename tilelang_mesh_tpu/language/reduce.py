@@ -1,0 +1,85 @@
+"""T.reduce_* / T.cumsum — tile reductions on the VPU.
+
+Reference: /root/reference/tilelang/language/reduce.py + src/op/reduce.cc.
+The GPU implementation synthesizes intra-warp shuffle trees; on TPU a tile
+reduction is a single jnp.sum/max/... over the VMEM tile.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..ir import Buffer, CumSumStmt, ReduceStmt
+from .builder import require_builder
+
+_KINDS = ("sum", "max", "min", "abssum", "absmax", "bitand", "bitor",
+          "bitxor", "any", "all")
+
+
+def _reduce(kind: str, buffer: Buffer, out: Buffer, dim: int = -1,
+            clear: bool = True):
+    b = require_builder()
+    assert kind in _KINDS, kind
+    if dim < 0:
+        dim += buffer.ndim
+    if not 0 <= dim < buffer.ndim:
+        raise ValueError(f"reduce dim {dim} out of range for rank "
+                         f"{buffer.ndim}")
+    b.emit(ReduceStmt(kind, buffer, out, dim, clear))
+
+
+def reduce(buffer: Buffer, out: Buffer, reduce_type: str, dim: int = -1,
+           clear: bool = True):
+    _reduce(reduce_type, buffer, out, dim, clear)
+
+
+def reduce_sum(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("sum", buffer, out, dim, clear)
+
+
+def reduce_max(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("max", buffer, out, dim, clear)
+
+
+def reduce_min(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("min", buffer, out, dim, clear)
+
+
+def reduce_abssum(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("abssum", buffer, out, dim, clear)
+
+
+def reduce_absmax(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("absmax", buffer, out, dim, clear)
+
+
+def reduce_bitand(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("bitand", buffer, out, dim, clear)
+
+
+def reduce_bitor(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("bitor", buffer, out, dim, clear)
+
+
+def reduce_bitxor(buffer, out, dim: int = -1, clear: bool = True):
+    _reduce("bitxor", buffer, out, dim, clear)
+
+
+def cumsum(src: Buffer, dst: Buffer = None, dim: int = -1,
+           reverse: bool = False):
+    b = require_builder()
+    dst = dst if dst is not None else src
+    if dim < 0:
+        dim += src.ndim
+    b.emit(CumSumStmt(src, dst, dim, reverse))
+
+
+def finalize_reducer(reducer: Buffer):
+    """Reference src/op/finalize_reducer.cc — combines per-thread partials.
+    TPU fragments are whole tiles, so there is nothing to finalize."""
+    require_builder()
+
+
+def warp_reduce_sum(value):
+    raise NotImplementedError("warp shuffles have no TPU analog; reduce over "
+                              "a fragment buffer with T.reduce_sum")

@@ -1,0 +1,8 @@
+"""Mesh/distributed layer: sharding policies, device mesh, collective
+schedules, SPMD lowering over jax.sharding + shard_map."""
+
+from .sharding import (MeshShardingPolicy, MeshReplicationType,
+                       MeshTensorMeta)
+from .device_mesh import (get_device_mesh_config, set_device_mesh_config,
+                          mesh_config, core_tuple_to_id, core_id_to_tuple,
+                          make_jax_mesh, TPUMeshProperties)

@@ -1,0 +1,90 @@
+"""Device-mesh configuration for the Mesh extension.
+
+The reference models its accelerator as a fixed 4x4 core mesh
+(/root/reference/tilelang/carver/arch/driver/sunmmio_driver.py:7-37,
+mesh_config=(4,4)) carried in LLVM target attrs. On TPU the mesh is a real
+``jax.sharding.Mesh`` over a pod slice: ICI links between chips play the role
+of the NoC. This module owns the process-wide default mesh config used by
+T.comm.* shape validation, and builds the concrete jax Mesh for execution.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_DEFAULT_MESH_CONFIG: Tuple[int, int] = (4, 4)
+_CURRENT: list = []
+
+
+def get_device_mesh_config() -> Tuple[int, int]:
+    """(nrows, ncols) of the currently configured core mesh."""
+    if _CURRENT:
+        return _CURRENT[-1]
+    return _DEFAULT_MESH_CONFIG
+
+
+def set_device_mesh_config(nrows: int, ncols: int) -> None:
+    global _DEFAULT_MESH_CONFIG
+    _DEFAULT_MESH_CONFIG = (int(nrows), int(ncols))
+
+
+@contextlib.contextmanager
+def mesh_config(nrows: int, ncols: int):
+    """Scoped mesh config, used by tests and by MeshTensor tracing."""
+    _CURRENT.append((int(nrows), int(ncols)))
+    try:
+        yield (nrows, ncols)
+    finally:
+        _CURRENT.pop()
+
+
+def core_tuple_to_id(core: Tuple[int, int],
+                     cfg: Optional[Tuple[int, int]] = None) -> int:
+    nrows, ncols = cfg or get_device_mesh_config()
+    row, col = core
+    assert 0 <= row < nrows, f"Row {row} out of bounds for mesh " \
+        f"{(nrows, ncols)}"
+    assert 0 <= col < ncols, f"Col {col} out of bounds for mesh " \
+        f"{(nrows, ncols)}"
+    return row * ncols + col
+
+
+def core_id_to_tuple(core_id: int,
+                     cfg: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
+    nrows, ncols = cfg or get_device_mesh_config()
+    return (core_id // ncols, core_id % ncols)
+
+
+def make_jax_mesh(nrows: int, ncols: int, devices: Optional[Sequence] = None):
+    """Build a jax Mesh with axes ("x", "y") = (rows, cols).
+
+    Prefers jax.make_mesh so the device order follows the physical ICI
+    topology; falls back to a reshape of an explicit device list.
+    """
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        try:
+            return jax.make_mesh((nrows, ncols), ("x", "y"))
+        except Exception:
+            devices = jax.devices()
+    devs = np.asarray(list(devices)[: nrows * ncols]).reshape(nrows, ncols)
+    return Mesh(devs, ("x", "y"))
+
+
+class TPUMeshProperties:
+    """Per-core resource model — the analog of SunmmioDeviceProperties
+    (reference sunmmio_driver.py: RSRAM/WSRAM/ASRAM per core). Used by the
+    carver to size tiles."""
+
+    def __init__(self, nrows: int = 4, ncols: int = 4,
+                 vmem_bytes: int = 64 * 2 ** 20,
+                 smem_bytes: int = 1 * 2 ** 20,
+                 ici_gbps: float = 90.0):
+        self.mesh_config = (nrows, ncols)
+        self.vmem_bytes = vmem_bytes
+        self.smem_bytes = smem_bytes
+        self.ici_gbps = ici_gbps

@@ -1,0 +1,566 @@
+"""SPMD lowering for mesh kernels: tile compute + ICI collectives.
+
+The reference lowers T.comm.* by synthesizing NoC broadcast schedules inside
+one kernel (/root/reference/src/op/comm.cc). The TPU-idiomatic equivalent,
+implemented here: split the kernel body at top-level collectives into
+compute *segments*; each segment compiles through the normal single-core
+pipeline into a Pallas kernel; the collectives lower to XLA collective ops
+(`psum` / `all_gather` / masked-psum routing) between segments — everything
+runs inside one ``shard_map`` over the 2-D device mesh (axes "x"=rows,
+"y"=cols), so XLA schedules the ICI transfers and overlaps them with
+compute. Fragments that cross a collective boundary are materialized as XLA
+values between segment kernels.
+
+Golden-testable: `lower_mesh` produces a deterministic textual schedule
+(CompiledArtifact.plan_desc) mirroring the reference's golden-IR comm tests
+(testing/python/language/test_tilelang_language_comm.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..analysis import run_semantic_checks
+from ..codegen.pallas import generate_source
+from ..engine.param import CompiledArtifact, KernelParam
+from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
+                  CommBarrier, CommBroadcast, CommFence, CommPut, CommStmt,
+                  CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
+                  collect, walk)
+from ..transform.plan import plan_kernel
+from .device_mesh import core_id_to_tuple, make_jax_mesh
+
+_DIRNAMES = {0: "h", 1: "v", 2: "all"}
+
+
+class MeshLowerError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# segmentation
+# ---------------------------------------------------------------------------
+
+
+def _buffer_reads_writes(stmts: Sequence[Stmt]):
+    """On-chip buffers read / written by a statement list."""
+    from ..ir import (BufferLoad, BufferStoreStmt, CumSumStmt, FillStmt,
+                      ForNest, GemmStmt, IfThenElse, ReduceStmt)
+    reads, writes = set(), set()
+
+    def expr_reads(e):
+        from ..ir.expr import BinOp, Call, Cast
+        if isinstance(e, BufferLoad):
+            reads.add(e.buffer.uid)
+            for i in e.indices:
+                if not isinstance(i, slice):
+                    expr_reads(i)
+        elif isinstance(e, BinOp):
+            expr_reads(e.a)
+            expr_reads(e.b)
+        elif isinstance(e, Call):
+            for a in e.args:
+                if not isinstance(a, str):
+                    expr_reads(a)
+        elif isinstance(e, Cast):
+            expr_reads(e.value)
+
+    def visit(s):
+        if isinstance(s, CopyStmt):
+            reads.add(s.src.buffer.uid)
+            writes.add(s.dst.buffer.uid)
+        elif isinstance(s, GemmStmt):
+            reads.add(s.A.buffer.uid)
+            reads.add(s.B.buffer.uid)
+            reads.add(s.C.buffer.uid)
+            writes.add(s.C.buffer.uid)
+        elif isinstance(s, FillStmt):
+            writes.add(s.dst.buffer.uid)
+        elif isinstance(s, ReduceStmt):
+            reads.add(s.src.uid)
+            writes.add(s.dst.uid)
+            if not s.clear:
+                reads.add(s.dst.uid)
+        elif isinstance(s, CumSumStmt):
+            reads.add(s.src.uid)
+            writes.add(s.dst.uid)
+        elif isinstance(s, BufferStoreStmt):
+            writes.add(s.buffer.uid)
+            expr_reads(s.value)
+            for i in s.indices:
+                if not isinstance(i, slice):
+                    expr_reads(i)
+
+    for s in stmts:
+        walk(s, visit)
+    return reads, writes
+
+
+def _comm_buffers(c: CommStmt) -> Tuple[List[Region], List[Region]]:
+    """(read regions, written regions) of a collective."""
+    if isinstance(c, CommBroadcast):
+        return [c.src], [c.dst]
+    if isinstance(c, CommPut):
+        return [c.src], [c.dst]
+    if isinstance(c, CommAllGather):
+        return [c.send], [c.recv]
+    if isinstance(c, CommAllReduce):
+        regs = [c.buffer] + ([c.out] if not c.clear else [])
+        return regs, [c.out]
+    return [], []
+
+
+def lower_mesh(func: PrimFunc, target: str,
+               mesh_cfg: Optional[Tuple[int, int]],
+               pass_cfg: dict) -> CompiledArtifact:
+    run_semantic_checks(func)
+    kn = func.kernel_node()
+    if mesh_cfg is None:
+        mesh_cfg = func.attrs.get("mesh_config")
+    if mesh_cfg is None:
+        raise MeshLowerError("mesh kernel without a mesh config: annotate "
+                             "params with T.MeshTensor or use a "
+                             "tpu-mesh[RxC] target")
+    nrow, ncol = mesh_cfg
+
+    top = list(kn.body.stmts)
+    has_comm = any(isinstance(s, CommStmt) for s in top)
+    if has_comm and any(e != 1 for e in kn.extents):
+        raise MeshLowerError(
+            "kernels mixing T.comm.* with a multi-tile T.Kernel grid are not "
+            "supported yet; use a (1,) grid (whole-shard tiles) for "
+            "communicating kernels")
+
+    # split into segments at collectives
+    segments: List[Tuple[str, Any]] = []
+    cur: List[Stmt] = []
+    allocs = [s for s in top if isinstance(s, AllocStmt)]
+    for s in top:
+        if isinstance(s, AllocStmt):
+            continue
+        if isinstance(s, CommStmt):
+            if cur:
+                segments.append(("compute", cur))
+                cur = []
+            segments.append(("comm", s))
+        else:
+            cur.append(s)
+    if cur:
+        segments.append(("compute", cur))
+
+    # liveness of on-chip buffers across segment boundaries
+    alloc_bufs = {a.buffer.uid: a.buffer for a in allocs}
+    seg_rw = []
+    for kind, payload in segments:
+        if kind == "compute":
+            seg_rw.append(_buffer_reads_writes(payload))
+        else:
+            r, w = _comm_buffers(payload)
+            seg_rw.append(({x.buffer.uid for x in r},
+                           {x.buffer.uid for x in w}))
+
+    n_seg = len(segments)
+
+    def live_in(i: int, uid: int) -> bool:
+        reads_here = uid in seg_rw[i][0]
+        written_before = any(uid in seg_rw[j][1] for j in range(i))
+        return reads_here and written_before
+
+    def live_out(i: int, uid: int) -> bool:
+        written_here = uid in seg_rw[i][1]
+        read_after = any(uid in seg_rw[j][0] for j in range(i + 1, n_seg))
+        return written_here and read_after
+
+    # build each compute segment as a standalone pallas kernel
+    compiled_segments: List[dict] = []
+    schedule_lines: List[str] = [
+        f"mesh_program({func.name}) mesh=({nrow}x{ncol}) axes=(x,y):"]
+
+    global_params = list(func.buffer_params)
+    gp_uids = {b.uid for b in global_params}
+
+    for i, (kind, payload) in enumerate(segments):
+        if kind == "comm":
+            schedule_lines.append(f"  [{i}] collective "
+                                  f"{_comm_desc(payload, nrow, ncol)}")
+            compiled_segments.append({"kind": "comm", "op": payload})
+            continue
+        reads, writes = seg_rw[i]
+        frag_ins = [alloc_bufs[u] for u in sorted(alloc_bufs)
+                    if live_in(i, u)]
+        frag_outs = [alloc_bufs[u] for u in sorted(alloc_bufs)
+                     if live_out(i, u)]
+        seg_func, in_bufs, out_bufs = _make_segment_func(
+            func, kn, allocs, payload, frag_ins, frag_outs, i)
+        plan = plan_kernel(seg_func, pass_cfg)
+        src = generate_source(plan, pass_cfg)
+        seg_params = [(p.buffer, p.role) for p in plan.params]
+        compiled_segments.append({
+            "kind": "compute",
+            "source": src,
+            "plan": plan,
+            "func": seg_func,
+            "frag_ins": frag_ins,
+            "frag_outs": frag_outs,
+            "param_bufs": seg_params,
+            "in_map": in_bufs,    # seg param buffer -> original buffer
+            "out_map": out_bufs,
+        })
+        ins = ", ".join(b.name for b, r in seg_params if r in ("in", "inout"))
+        outs = ", ".join(b.name for b, r in seg_params
+                         if r in ("out", "inout"))
+        schedule_lines.append(
+            f"  [{i}] pallas_segment {seg_func.name} grid="
+            f"{tuple(a.extent for a in plan.grid)} ins=({ins}) outs=({outs})")
+
+    # roles of the original global params across the whole program
+    roles: Dict[int, str] = {}
+    for seg in compiled_segments:
+        if seg["kind"] != "compute":
+            continue
+        for b, r in seg["param_bufs"]:
+            orig = seg["in_map"].get(b.uid) or seg["out_map"].get(b.uid)
+            if orig is None or orig.uid not in gp_uids:
+                continue
+            prev = roles.get(orig.uid)
+            if prev is None:
+                roles[orig.uid] = r if r != "inout" else "inout"
+            elif prev != r:
+                roles[orig.uid] = "inout"
+    params = []
+    for b in global_params:
+        spec = b.mesh_meta.partition_spec() if b.mesh_meta else None
+        params.append(KernelParam(
+            name=b.name,
+            shape=(b.mesh_meta.global_shape if b.mesh_meta
+                   else (b.static_shape() or tuple(b.shape))),
+            dtype=b.dtype, role=roles.get(b.uid, "in"), mesh_spec=spec))
+
+    for p in params:
+        schedule_lines.append(
+            f"  param {p.name}: role={p.role} spec="
+            f"{p.mesh_spec if p.mesh_spec is not None else 'replicated'}")
+
+    plan_desc = "\n".join(schedule_lines) + "\n"
+    source_blob = plan_desc + "\n" + "\n".join(
+        f"# ---- segment {j} ----\n" + s["source"]
+        for j, s in enumerate(compiled_segments) if s["kind"] == "compute")
+
+    art = CompiledArtifact(
+        name=func.name, params=params, kernel_source=source_blob,
+        target=target, grid=tuple(kn.extents), ir_script=func.script(),
+        plan_desc=plan_desc, mesh_config=(nrow, ncol),
+        attrs={"is_mesh": True, "no_disk_cache": True,
+               "_segments": compiled_segments,
+               "_global_params": global_params})
+    return art
+
+
+def _make_segment_func(func: PrimFunc, kn: KernelNode, allocs, stmts,
+                       frag_ins, frag_outs, idx):
+    """Wrap a compute segment as a standalone PrimFunc: original globals +
+    boundary fragments promoted to global params with explicit edge copies."""
+    in_map: Dict[int, Buffer] = {}
+    out_map: Dict[int, Buffer] = {}
+    params: List[Buffer] = []
+    # original global params referenced in this segment
+    reads, writes = _buffer_reads_writes(stmts)
+    for b in func.buffer_params:
+        if b.uid in reads or b.uid in writes:
+            params.append(b)
+            in_map[b.uid] = b
+            out_map[b.uid] = b
+    body: List[Stmt] = [AllocStmt(a.buffer) for a in allocs]
+    for fb in frag_ins:
+        p = Buffer(f"{fb.name}_li", fb.shape, fb.dtype, "global")
+        params.append(p)
+        in_map[p.uid] = fb
+        body.append(CopyStmt(Region(p, (0,) * p.ndim, p.shape),
+                             Region(fb, (0,) * fb.ndim, fb.shape)))
+    body.extend(stmts)
+    for fb in frag_outs:
+        p = Buffer(f"{fb.name}_lo", fb.shape, fb.dtype, "global")
+        params.append(p)
+        out_map[p.uid] = fb
+        body.append(CopyStmt(Region(fb, (0,) * fb.ndim, fb.shape),
+                             Region(p, (0,) * p.ndim, p.shape)))
+    new_kn = KernelNode(kn.grid_vars, kn.extents, kn.threads,
+                        SeqStmt(body))
+    seg = PrimFunc(f"{func.name}_seg{idx}", params, SeqStmt([new_kn]),
+                   attrs={})
+    return seg, in_map, out_map
+
+
+def _comm_desc(c: CommStmt, nrow: int, ncol: int) -> str:
+    if isinstance(c, CommBroadcast):
+        return (f"broadcast({c.src.buffer.name} -> {c.dst.buffer.name}, "
+                f"src_core={core_id_to_tuple(c.src_core, (nrow, ncol))}, "
+                f"dir={_DIRNAMES[c.direction]})")
+    if isinstance(c, CommPut):
+        return (f"put({c.src.buffer.name} -> {c.dst.buffer.name}, "
+                f"src={core_id_to_tuple(c.src_core, (nrow, ncol))}, "
+                f"dst={core_id_to_tuple(c.dst_core, (nrow, ncol))})")
+    if isinstance(c, CommAllGather):
+        return (f"all_gather({c.send.buffer.name} -> {c.recv.buffer.name}, "
+                f"dir={_DIRNAMES[c.direction]})")
+    if isinstance(c, CommAllReduce):
+        return (f"all_reduce({c.buffer.buffer.name} -> {c.out.buffer.name}, "
+                f"op={c.reduce_type}, dir={_DIRNAMES[c.direction]}, "
+                f"dim={c.dim}, clear={c.clear})")
+    if isinstance(c, CommBarrier):
+        return "barrier()"
+    if isinstance(c, CommFence):
+        return "fence()"
+    return type(c).__name__
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+class MeshKernel:
+    """Executable mesh program: shard_map(spmd_fn) over the device mesh."""
+
+    def __init__(self, artifact: CompiledArtifact, out_idx=None):
+        self.artifact = artifact
+        self.out_idx = out_idx
+        self._build()
+
+    def _build(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..utils.target import target_is_interpret
+
+        art = self.artifact
+        nrow, ncol = art.mesh_config
+        segments = art.attrs["_segments"]
+        global_params = art.attrs["_global_params"]
+        interpret = target_is_interpret(art.target)
+
+        # build per-segment pallas callables
+        seg_calls = []
+        for seg in segments:
+            if seg["kind"] == "comm":
+                seg_calls.append(None)
+                continue
+            ns: dict = {}
+            exec(compile(seg["source"], f"<tl_tpu:{seg['func'].name}>",
+                         "exec"), ns)
+            seg_calls.append(ns["build"](interpret=interpret))
+
+        in_params = [p for p in art.params if p.role in ("in", "inout")]
+        out_params = [p for p in art.params if p.role in ("out", "inout")]
+        gp_by_name = {b.name: b for b in global_params}
+        in_bufs = [gp_by_name[p.name] for p in in_params]
+        out_bufs = [gp_by_name[p.name] for p in out_params]
+
+        def spmd(*local_ins):
+            import jax.numpy as jnp
+            state: Dict[int, Any] = {}
+            for b, v in zip(in_bufs, local_ins):
+                state[b.uid] = v
+            for seg, call in zip(segments, seg_calls):
+                if seg["kind"] == "comm":
+                    _apply_comm(seg["op"], state, nrow, ncol)
+                    continue
+                plan = seg["plan"]
+                ins = []
+                for pp in plan.inputs:
+                    orig = seg["in_map"].get(pp.buffer.uid, None) or pp.buffer
+                    v = state.get(orig.uid)
+                    if v is None:
+                        # fragment never written yet: zero-init
+                        import jax.numpy as jnp2
+                        v = jnp2.zeros(
+                            tuple(int(s) for s in orig.shape),
+                            jnp2.dtype(orig.dtype))
+                    ins.append(v)
+                outs = call(*ins)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                for pp, v in zip(plan.outputs, outs):
+                    orig = seg["out_map"].get(pp.buffer.uid, None) \
+                        or pp.buffer
+                    state[orig.uid] = v
+            return tuple(state[b.uid] for b in out_bufs)
+
+        mesh = make_jax_mesh(nrow, ncol)
+        self.mesh = mesh
+        in_specs = tuple(
+            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
+            for b in in_bufs)
+        out_specs = tuple(
+            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
+            for b in out_bufs)
+        f = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+        self.func = jax.jit(f)
+        self._in_params = in_params
+        self._out_params = out_params
+        self._in_bufs = in_bufs
+
+    def __call__(self, *args, **kwargs):
+        from ..utils.tensor import to_jax, copy_back
+        import jax
+        n_in = len(self._in_params)
+        n_all = len(self.artifact.params)
+        outs_provided = None
+        if len(args) == n_in:
+            ins = list(args)
+        elif len(args) == n_all:
+            pos = {p.name: i for i, p in enumerate(self.artifact.params)}
+            ins = [args[pos[p.name]] for p in self._in_params]
+            outs_provided = [args[pos[p.name]] for p in self._out_params
+                             if p.role == "out"]
+        else:
+            raise TypeError(f"expected {n_in} inputs, got {len(args)}")
+        jins = [to_jax(a) for a in ins]
+        res = self.func(*jins)
+        res = res if isinstance(res, tuple) else (res,)
+        if outs_provided:
+            wrote = False
+            for dst, src in zip(outs_provided, res):
+                if not isinstance(dst, jax.Array):
+                    copy_back(dst, src)
+                    wrote = True
+            if wrote:
+                return None
+        return res[0] if len(res) == 1 else res
+
+    def get_kernel_source(self) -> str:
+        return self.artifact.kernel_source
+
+    def get_plan(self) -> str:
+        return self.artifact.plan_desc
+
+    def get_profiler(self, tensor_supply_type=None):
+        from ..profiler import Profiler
+        from ..utils.tensor import TensorSupplyType
+        return Profiler(self, tensor_supply_type or TensorSupplyType.Auto)
+
+    @property
+    def params(self):
+        return self.artifact.params
+
+
+def _apply_comm(op: CommStmt, state: Dict[int, Any], nrow: int, ncol: int):
+    """Lower one collective to XLA ops on the per-core state (runs inside
+    shard_map tracing)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def get(region: Region):
+        v = state.get(region.buffer.uid)
+        if v is None:
+            v = jnp.zeros(tuple(int(s) for s in region.buffer.shape),
+                          jnp.dtype(region.buffer.dtype))
+        return v
+
+    if isinstance(op, (CommBarrier, CommFence)):
+        # shard_map per-program semantics sequence collectives already; an
+        # optimization barrier pins ordering of the live values
+        keys = list(state)
+        if keys:
+            vals = lax.optimization_barrier(tuple(state[k] for k in keys))
+            for k, v in zip(keys, vals):
+                state[k] = v
+        return
+
+    row = lax.axis_index("x")
+    col = lax.axis_index("y")
+
+    if isinstance(op, CommBroadcast):
+        src = get(op.src)
+        dst_old = get(op.dst)
+        r0, c0 = op.src_core // ncol, op.src_core % ncol
+        contrib = jnp.where((row == r0) & (col == c0), src,
+                            jnp.zeros_like(src))
+        if op.direction == 0:    # horizontal: within the source row
+            tot = lax.psum(contrib, "y")
+            new = jnp.where(row == r0, tot.astype(dst_old.dtype), dst_old)
+        elif op.direction == 1:  # vertical: within the source column
+            tot = lax.psum(contrib, "x")
+            new = jnp.where(col == c0, tot.astype(dst_old.dtype), dst_old)
+        else:                    # all cores
+            tot = lax.psum(contrib, ("x", "y"))
+            new = tot.astype(dst_old.dtype)
+        state[op.dst.buffer.uid] = jnp.broadcast_to(
+            new, dst_old.shape).astype(dst_old.dtype)
+        return
+
+    if isinstance(op, CommPut):
+        src = get(op.src)
+        dst_old = get(op.dst)
+        sr, sc = op.src_core // ncol, op.src_core % ncol
+        dr, dc = op.dst_core // ncol, op.dst_core % ncol
+        contrib = jnp.where((row == sr) & (col == sc), src,
+                            jnp.zeros_like(src))
+        tot = lax.psum(contrib, ("x", "y"))
+        new = jnp.where((row == dr) & (col == dc),
+                        jnp.broadcast_to(tot, dst_old.shape).astype(
+                            dst_old.dtype), dst_old)
+        state[op.dst.buffer.uid] = new
+        return
+
+    if isinstance(op, CommAllGather):
+        send = get(op.send)
+        if op.direction == 0:
+            g = lax.all_gather(send, "y")
+        elif op.direction == 1:
+            g = lax.all_gather(send, "x")
+        else:
+            g = lax.all_gather(send, ("x", "y"))
+        recv = op.recv.buffer
+        state[recv.uid] = g.astype(jnp.dtype(recv.dtype)).reshape(
+            tuple(int(s) for s in recv.shape))
+        return
+
+    if isinstance(op, CommAllReduce):
+        x = get(op.buffer)
+        out_buf = op.out.buffer
+        keepdims = len(out_buf.shape) == len(op.buffer.buffer.shape)
+        kind = op.reduce_type
+        if kind == "abssum":
+            local = jnp.sum(jnp.abs(x), axis=op.dim, keepdims=keepdims)
+            kind_mesh = "sum"
+        elif kind == "absmax":
+            local = jnp.max(jnp.abs(x), axis=op.dim, keepdims=keepdims)
+            kind_mesh = "max"
+        elif kind == "sum":
+            local = jnp.sum(x, axis=op.dim, keepdims=keepdims)
+            kind_mesh = "sum"
+        elif kind == "max":
+            local = jnp.max(x, axis=op.dim, keepdims=keepdims)
+            kind_mesh = "max"
+        elif kind == "min":
+            local = jnp.min(x, axis=op.dim, keepdims=keepdims)
+            kind_mesh = "min"
+        else:  # bit ops: gather + local combine (no pbit primitive)
+            from ..codegen import rt
+            local = getattr(rt, f"reduce_{kind}")(x, op.dim, keepdims)
+            kind_mesh = "gather_" + kind
+        axes = {0: ("y",), 1: ("x",), 2: ("x", "y")}[op.direction]
+        if kind_mesh == "sum":
+            red = lax.psum(local, axes)
+        elif kind_mesh == "max":
+            red = lax.pmax(local, axes)
+        elif kind_mesh == "min":
+            red = lax.pmin(local, axes)
+        else:
+            g = lax.all_gather(local, axes)
+            from ..codegen import rt
+            red = getattr(rt, f"reduce_{kind}")(g, 0, False)
+        red = red.astype(jnp.dtype(out_buf.dtype)).reshape(
+            tuple(int(s) for s in out_buf.shape))
+        if not op.clear:
+            old = get(op.out)
+            from ..codegen.rt import _COMBINE_FNS
+            red = _COMBINE_FNS["sum" if kind in ("sum", "abssum") else
+                               ("max" if kind in ("max", "absmax") else
+                                ("min" if kind == "min" else
+                                 kind))](old, red)
+        state[out_buf.uid] = red
+        return
+
+    raise MeshLowerError(f"unhandled collective {type(op).__name__}")

@@ -1,0 +1,142 @@
+"""Mesh sharding policies and their mapping to jax.sharding.
+
+Behavioral equivalent of the reference's MeshShardingPolicy /
+MeshReplicationType (/root/reference/tilelang/language/v2/annot.py:518-560),
+re-founded on JAX: a policy over a 2-D core mesh (axes named "x" = rows,
+"y" = cols) converts to a ``jax.sharding.PartitionSpec``, so MeshTensor
+kernels execute under ``shard_map`` on a TPU pod slice with XLA inserting ICI
+collectives.
+
+Axis semantics match the reference exactly (annot.py:567-610):
+  - policy.x = d  : logical dim d is split across mesh *columns* (ncols)
+  - policy.y = d  : logical dim d is split across mesh *rows* (nrows)
+  - replicate     : ROW = same data within a row, COLUMN = within a column,
+                    ALL = fully replicated
+  - cross_mesh_dim: one dim split across all nrows*ncols cores
+"""
+
+from __future__ import annotations
+
+import math
+from enum import Enum
+from typing import Any, Optional, Sequence, Tuple
+
+
+class MeshReplicationType(Enum):
+    NONE = 0
+    ROW = 1
+    COLUMN = 2
+    ALL = 3
+
+
+class MeshShardingPolicy:
+    """Sharding policy for a MeshTensor kernel parameter."""
+
+    def __init__(self, x: Optional[int] = None, y: Optional[int] = None,
+                 replicate: MeshReplicationType = MeshReplicationType.NONE,
+                 cross_mesh_dim: Optional[int] = None):
+        if cross_mesh_dim is not None and (x is not None or y is not None):
+            raise ValueError("cross_mesh_dim is mutually exclusive with "
+                             "x/y splits")
+        if sum(v is not None for v in (x, y, cross_mesh_dim)) > 2:
+            raise ValueError("Invalid layout: too many splits")
+        self.x = x
+        self.y = y
+        self.replicate = replicate
+        self.cross_mesh_dim = cross_mesh_dim
+
+    def __repr__(self):
+        if self.cross_mesh_dim is not None:
+            return f"MeshLayout(split_dim={self.cross_mesh_dim} across XxY)"
+        parts = []
+        if self.x is not None:
+            parts.append(f"x->dim{self.x}")
+        if self.y is not None:
+            parts.append(f"y->dim{self.y}")
+        if self.replicate != MeshReplicationType.NONE:
+            parts.append(f"replicate={self.replicate.name}")
+        return "MeshLayout(" + ", ".join(parts) + ")" if parts \
+            else "MeshLayout(replicated)"
+
+    # -- shard math (pure; unit-tested without any device) -------------------
+    def sharded_shape(self, shape: Sequence[int], nrows: int,
+                      ncols: int) -> Tuple[int, ...]:
+        """Per-core local shape. Mirrors reference annot.py:567-610."""
+        out = list(shape)
+        if self.replicate == MeshReplicationType.ALL:
+            return tuple(out)
+        if self.cross_mesh_dim is not None:
+            d = self.cross_mesh_dim
+            if not 0 <= d < len(out):
+                raise ValueError(f"Invalid cross_mesh_dim: {d}, tensor rank "
+                                 f"is {len(out)}")
+            out[d] = int(math.ceil(out[d] / (nrows * ncols)))
+            return tuple(out)
+
+        def split(dim: Optional[int], factor: int, axis: str):
+            if dim is None:
+                return
+            if not 0 <= dim < len(out):
+                raise ValueError(f"Invalid {axis}-split dimension: {dim}, "
+                                 f"tensor rank is {len(out)}")
+            out[dim] = int(math.ceil(out[dim] / factor))
+
+        if self.replicate == MeshReplicationType.ROW:
+            if self.x is not None:
+                raise ValueError("Cannot shard on x-axis when replicating on "
+                                 "rows")
+            split(self.y, nrows, "y")
+        elif self.replicate == MeshReplicationType.COLUMN:
+            if self.y is not None:
+                raise ValueError("Cannot shard on y-axis when replicating on "
+                                 "columns")
+            split(self.x, ncols, "x")
+        else:
+            split(self.x, ncols, "x")
+            split(self.y, nrows, "y")
+        return tuple(out)
+
+    def partition_spec(self, rank: int):
+        """Convert to a jax.sharding.PartitionSpec over mesh axes ("x","y").
+
+        Mesh axis "x" has size nrows and shards the dim named by policy.y;
+        mesh axis "y" has size ncols and shards the dim named by policy.x —
+        this mirrors the reference's (row, col) convention where an x-split
+        divides by ncols and a y-split divides by nrows.
+        """
+        from jax.sharding import PartitionSpec as P
+        dims: list = [None] * rank
+        if self.cross_mesh_dim is not None:
+            dims[self.cross_mesh_dim] = ("x", "y")
+            return P(*dims)
+        if self.replicate != MeshReplicationType.ALL:
+            if self.y is not None:
+                dims[self.y] = "x"   # split by nrows -> mesh axis "x"
+            if self.x is not None:
+                dims[self.x] = "y"   # split by ncols -> mesh axis "y"
+        return P(*dims)
+
+
+class MeshTensorMeta:
+    """Metadata attached to a MeshTensor kernel parameter's Buffer."""
+
+    def __init__(self, global_shape: Tuple[Any, ...],
+                 policy: MeshShardingPolicy, mesh_config: Tuple[int, int]):
+        self.global_shape = tuple(global_shape)
+        self.policy = policy
+        self.mesh_config = tuple(mesh_config)
+
+    @property
+    def nrows(self):
+        return self.mesh_config[0]
+
+    @property
+    def ncols(self):
+        return self.mesh_config[1]
+
+    def partition_spec(self):
+        return self.policy.partition_spec(len(self.global_shape))
+
+    def describe(self) -> str:
+        return (f"{self.policy!r}@{self.mesh_config}"
+                f" global={tuple(self.global_shape)}")

@@ -1,0 +1,113 @@
+"""Profiler: latency measurement + numeric validation.
+
+Reference: /root/reference/tilelang/profiler/__init__.py (Profiler:21,
+assert_allclose:77, do_bench:210) and bench.py (CUDA-event / CUPTI timing).
+TPU equivalents:
+
+  backend="loop"  — in-graph timing: the kernel runs inside a jitted
+                    lax.fori_loop whose carry is threaded through
+                    jax.lax.optimization_barrier, so XLA can neither hoist
+                    nor dead-code the call; wall time / n is pure device
+                    time. This is the CUPTI-accuracy path, and the only
+                    honest one behind a high-latency dispatch tunnel.
+  backend="wall"  — per-call dispatch timing (CUDA-event analog).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.tensor import (TensorSupplyType, assert_allclose,
+                            get_tensor_supply)
+
+
+def _consume(r):
+    # touch one element to force full materialization through the tunnel
+    leaves = [x for x in (r if isinstance(r, (tuple, list)) else (r,))]
+    np.asarray(leaves[0]).ravel()[:1]
+
+
+def do_bench(fn: Callable, *args, warmup: int = 3, rep: int = 30,
+             backend: str = "loop") -> float:
+    """Median latency of fn(*args) in milliseconds."""
+    import jax
+
+    if backend == "wall":
+        for _ in range(warmup):
+            r = fn(*args)
+        _consume(r)
+        times = []
+        for _ in range(rep):
+            t0 = time.perf_counter()
+            r = fn(*args)
+            _consume(r)
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times) * 1e3)
+
+    # in-graph loop timing
+    def loop_body(i, carry):
+        outs = fn(*carry)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        tied = jax.lax.optimization_barrier(tuple(carry) + outs)
+        return tuple(tied[:len(carry)])
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run(n, *ins):
+        return jax.lax.fori_loop(0, n, loop_body, tuple(ins))
+
+    r = run(max(1, warmup), *args)
+    _consume(r)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = run(rep, *args)
+        _consume(r)
+        best = min(best, (time.perf_counter() - t0) / rep)
+    return best * 1e3
+
+
+class Profiler:
+    def __init__(self, kernel, tensor_supply_type: TensorSupplyType =
+                 TensorSupplyType.Auto, seed: int = 0):
+        self.kernel = kernel
+        self.supply = get_tensor_supply(tensor_supply_type, seed)
+
+    def _inputs(self) -> List[Any]:
+        return [self.supply(tuple(p.shape), p.dtype)
+                for p in self.kernel.artifact.in_params]
+
+    def assert_allclose(self, reference_program: Callable,
+                        rtol: float = 1e-2, atol: float = 1e-2,
+                        max_mismatched_ratio: float = 0.01):
+        """Run the kernel and a jnp reference on identical inputs and
+        compare (reference Profiler.assert_allclose:77)."""
+        ins = self._inputs()
+        got = self.kernel(*ins)
+        want = reference_program(*ins)
+        got_t = got if isinstance(got, tuple) else (got,)
+        want_t = want if isinstance(want, tuple) else (want,)
+        assert len(got_t) == len(want_t), \
+            f"output arity {len(got_t)} vs reference {len(want_t)}"
+        for g, w in zip(got_t, want_t):
+            assert_allclose(g, w, rtol=rtol, atol=atol,
+                            max_mismatched_ratio=max_mismatched_ratio)
+
+    def do_bench(self, func: Optional[Callable] = None, warmup: int = 3,
+                 rep: int = 30, backend: str = "loop",
+                 input_tensors: Optional[Sequence[Any]] = None) -> float:
+        """Latency in ms (reference do_bench:210; backend 'loop'~CUPTI,
+        'wall'~CUDA events)."""
+        ins = list(input_tensors) if input_tensors is not None \
+            else self._inputs()
+        fn = func if func is not None else self.kernel.func
+        return do_bench(fn, *ins, warmup=warmup, rep=rep, backend=backend)
+
+    def run_once(self, func: Optional[Callable] = None):
+        ins = self._inputs()
+        fn = func or self.kernel
+        return fn(*ins)

@@ -1,0 +1,1 @@
+from .analyzer import Analyzer, AnalysisResult

@@ -1,0 +1,101 @@
+"""Static perf analysis: FLOPs / bytes / expected latency from the IR.
+
+Reference: /root/reference/tilelang/tools/Analyzer.py:33 — walks the IR
+counting T.copy bytes and T.gemm FLOPs against the carver arch model to
+predict latency. Same roofline approach against the TPU arch model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..carver.arch import TPUArch, auto_arch
+from ..ir import (CopyStmt, GemmStmt, PrimFunc, ReduceStmt, dtype_bits, walk,
+                  as_int)
+
+
+@dataclass
+class AnalysisResult:
+    total_flops: int
+    total_bytes: int
+    expected_latency_ms: float
+    bound: str  # "compute" | "memory"
+
+    def __repr__(self):
+        return (f"AnalysisResult(flops={self.total_flops:.3e}, "
+                f"bytes={self.total_bytes:.3e}, "
+                f"expected={self.expected_latency_ms:.4f} ms, "
+                f"{self.bound}-bound)")
+
+
+class Analyzer:
+    def __init__(self, arch: Optional[TPUArch] = None):
+        self.arch = arch or auto_arch()
+
+    @classmethod
+    def analysis(cls, func, arch: Optional[TPUArch] = None
+                 ) -> AnalysisResult:
+        from ..language.builder import PrimFuncObj
+        if isinstance(func, PrimFuncObj):
+            func = func.func
+        return cls(arch)._run(func)
+
+    def _run(self, func: PrimFunc) -> AnalysisResult:
+        kn = func.kernel_node()
+        grid = 1
+        loop_mult = {}
+        if kn is not None:
+            for e in kn.extents:
+                grid *= e
+        flops = [0]
+        mem_bytes = [0]
+
+        def mult_of(stmt_path_mult):
+            return stmt_path_mult
+
+        def visit(s, mult=grid):
+            from ..ir import ForNest, SeqStmt, KernelNode, IfThenElse
+            if isinstance(s, ForNest):
+                m = mult
+                if s.kind != "parallel":
+                    for e in s.extents:
+                        v = as_int(e)
+                        m *= v if v else 1
+                for c in s.body.stmts:
+                    visit(c, m)
+            elif isinstance(s, (SeqStmt,)):
+                for c in s.stmts:
+                    visit(c, mult)
+            elif isinstance(s, KernelNode):
+                for c in s.body.stmts:
+                    visit(c, mult)
+            elif isinstance(s, IfThenElse):
+                for c in s.then_body.stmts:
+                    visit(c, mult)
+                if s.else_body:
+                    for c in s.else_body.stmts:
+                        visit(c, mult)
+            elif isinstance(s, GemmStmt):
+                a = s.A.static_shape()
+                c = s.C.static_shape()
+                if a and c:
+                    k = a[0] if s.trans_A else a[-1]
+                    flops[0] += 2 * c[-2] * c[-1] * k * mult
+            elif isinstance(s, CopyStmt):
+                n = s.src.numel() or s.dst.numel() or 0
+                if s.src.buffer.scope == "global" or \
+                        s.dst.buffer.scope == "global":
+                    mem_bytes[0] += n * dtype_bits(s.src.dtype) // 8 * mult
+
+        if kn is not None:
+            for s in kn.body.stmts:
+                visit(s, grid)
+
+        t_compute = flops[0] / (self.arch.bf16_tflops * 1e12)
+        t_mem = mem_bytes[0] / (self.arch.hbm_gbps * 1e9)
+        expected = max(t_compute, t_mem)
+        return AnalysisResult(
+            total_flops=flops[0], total_bytes=mem_bytes[0],
+            expected_latency_ms=expected * 1e3,
+            bound="compute" if t_compute >= t_mem else "memory")

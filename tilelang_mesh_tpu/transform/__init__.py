@@ -1,0 +1,10 @@
+"""Transform passes over tile-IR.
+
+The reference's 56-file C++ pass pipeline (src/transform/) collapses on TPU:
+Mosaic/XLA own vectorization, memory planning, and synchronization. What
+remains semantic — block-mapping inference, pipeline planning, phase
+splitting — lives in plan.py; mesh SPMD splitting in parallel/lowering.py.
+"""
+
+from .pass_config import PassConfigKey, pass_config, current_pass_config
+from .plan import plan_kernel, KernelPlan, PlanError

@@ -1,0 +1,69 @@
+"""Per-compile pass configuration.
+
+Reference: /root/reference/tilelang/transform/pass_config.py (PassConfigKey,
+~30 tl.* keys threaded through PassContext). TPU-relevant keys are live; the
+GPU-only ones are accepted-and-ignored so reference-style call sites port
+without edits.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from enum import Enum
+from typing import Any, Dict, Optional
+
+
+class PassConfigKey(str, Enum):
+    # live on TPU
+    TL_SIMPLIFY = "tl.Simplify"
+    TL_DYNAMIC_ALIGNMENT = "tl.dynamic_alignment"
+    TL_DISABLE_DYNAMIC_TAIL_SPLIT = "tl.disable_dynamic_tail_split"
+    TL_DISABLE_SAFE_MEMORY_ACCESS = "tl.disable_safe_memory_legalize"
+    TL_DEBUG_MERGE_SHARED_MEMORY_ALLOCATIONS = \
+        "tl.debug_merge_shared_memory_allocations"
+    TL_ENABLE_FAST_MATH = "tl.enable_fast_math"
+    TL_DISABLE_FAST_MATH = "tl.disable_fast_math"
+    TL_LAYOUT_VISUAL = "tl.layout_visual"
+    # TPU-specific
+    TL_TPU_DIMENSION_SEMANTICS = "tl.tpu.dimension_semantics"
+    TL_TPU_VMEM_LIMIT_BYTES = "tl.tpu.vmem_limit_bytes"
+    TL_TPU_INTERPRET = "tl.tpu.interpret"
+    TL_TPU_COST_ESTIMATE = "tl.tpu.cost_estimate"
+    TL_TPU_ALLOW_INPUT_FUSION = "tl.tpu.allow_input_fusion"
+    # accepted for API parity, no TPU effect
+    TL_DISABLE_TMA_LOWER = "tl.disable_tma_lower"
+    TL_DISABLE_WARP_SPECIALIZED = "tl.disable_warp_specialized"
+    TL_CONFIG_INDEX_BITWIDTH = "tl.config_index_bitwidth"
+    TL_DISABLE_VECTORIZE_256 = "tl.disable_vectorize_256"
+    TL_ENABLE_AGGRESSIVE_SHARED_MEMORY_MERGE = \
+        "tl.enable_aggressive_shared_memory_merge"
+    TL_ENABLE_PTXAS_VERBOSE_OUTPUT = "tl.enable_ptxas_verbose_output"
+
+
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = [{}]
+    return _STATE.stack
+
+
+def current_pass_config() -> Dict[str, Any]:
+    merged: Dict[str, Any] = {}
+    for d in _stack():
+        merged.update(d)
+    return merged
+
+
+@contextlib.contextmanager
+def pass_config(cfg: Optional[Dict[Any, Any]] = None, **kwargs):
+    d = {}
+    for k, v in {**(cfg or {}), **kwargs}.items():
+        d[k.value if isinstance(k, PassConfigKey) else str(k)] = v
+    _stack().append(d)
+    try:
+        yield
+    finally:
+        _stack().pop()

@@ -1,0 +1,451 @@
+"""Kernel planning: the TPU re-founding of LayoutInference + PipelinePlanning.
+
+The reference infers per-buffer thread layouts and injects a software
+pipeline (src/transform/layout_inference.cc, pipeline_planning.cc,
+inject_pipeline.cc). On TPU both jobs collapse into one decision: **which
+global-memory accesses can ride the Pallas grid/BlockSpec pipeline** (Mosaic
+then auto-double-buffers HBM->VMEM exactly where the GPU build hand-rotates
+smem versions), and which fall back to explicit in-kernel DMA.
+
+The plan computed here drives codegen/pallas.py:
+  - grid = reversed(T.Kernel vars) + the grid-mapped T.Pipelined var
+  - every global buffer access that is block-affine in those axes becomes a
+    BlockSpec (block shape + index map in block units)
+  - on-chip buffers fed by exactly one such copy are aliased to the block ref
+  - statements are split into init (first pipeline step), main, and epilogue
+    (last step) phases
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..ir import (AllocStmt, AtomicStmt, Buffer, BufferLoad, BufferStoreStmt,
+                  CommStmt, CopyStmt, CumSumStmt, FillStmt, ForNest, GemmStmt,
+                  IfThenElse, KernelNode, PrimFunc, Region, ReduceStmt,
+                  SeqStmt, Stmt, as_int, collect, linearize, free_vars)
+
+
+class PlanError(Exception):
+    pass
+
+
+@dataclass
+class BlockDim:
+    """One dimension of a BlockSpec: size (None = squeezed unit dim),
+    index-map terms in block units."""
+    size: Optional[int]
+    terms: Tuple[Tuple[int, int], ...]  # ((grid_axis, coeff_blocks), ...)
+    const: int
+
+    def key(self):
+        return (self.size, self.terms, self.const)
+
+
+@dataclass
+class ParamPlan:
+    buffer: Buffer
+    role: str = "in"          # in | out | inout
+    mode: str = "block"       # block | any
+    block_dims: Optional[List[BlockDim]] = None
+    alias: Optional[Buffer] = None   # on-chip buffer aliased to this block
+
+    def block_key(self):
+        return None if self.block_dims is None else tuple(
+            d.key() for d in self.block_dims)
+
+
+@dataclass
+class GridAxis:
+    var: Any
+    extent: int
+    kind: str  # parallel | arbitrary
+
+
+@dataclass
+class KernelPlan:
+    func: PrimFunc
+    grid: List[GridAxis]
+    params: List[ParamPlan]                  # in func.buffer_params order
+    scratch: List[Buffer]
+    init_stmts: List[Stmt]
+    main_stmts: List[Stmt]
+    epi_stmts: List[Stmt]
+    pipeline_axis: Optional[int]             # grid axis index of ko, or None
+    aliased_copies: List[CopyStmt] = field(default_factory=list)
+    annotations: Dict[str, Any] = field(default_factory=dict)
+
+    def param_for(self, buf: Buffer) -> Optional[ParamPlan]:
+        for p in self.params:
+            if p.buffer is buf:
+                return p
+        return None
+
+    @property
+    def inputs(self) -> List[ParamPlan]:
+        return [p for p in self.params if p.role in ("in", "inout")]
+
+    @property
+    def outputs(self) -> List[ParamPlan]:
+        return [p for p in self.params if p.role in ("out", "inout")]
+
+    def describe(self) -> str:
+        """Stable text form for golden tests (analog of pass-output
+        mod.script() comparisons)."""
+        lines = [f"plan({self.func.name}):"]
+        g = ", ".join(f"{a.var.name}:{a.extent}:{a.kind}" for a in self.grid)
+        lines.append(f"  grid = [{g}]")
+        for p in self.params:
+            if p.mode == "block":
+                dims = []
+                for d in p.block_dims:
+                    t = " + ".join(
+                        (f"{self.grid[a].var.name}" if c == 1
+                         else f"{self.grid[a].var.name}*{c}")
+                        for a, c in d.terms) or "0"
+                    if d.const:
+                        t += f" + {d.const}"
+                    dims.append(f"{d.size}@({t})")
+                desc = f"block[{', '.join(dims)}]"
+                if p.alias is not None:
+                    desc += f" alias={p.alias.name}"
+            else:
+                desc = "any(hbm)"
+            lines.append(f"  {p.role:5s} {p.buffer.name}: {desc}")
+        for b in self.scratch:
+            lines.append(f"  scratch {b.name}: {tuple(b.shape)} {b.dtype} "
+                         f"[{b.scope}]")
+        lines.append(f"  phases: init={len(self.init_stmts)} "
+                     f"main={len(self.main_stmts)} epi={len(self.epi_stmts)}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+
+
+def _region_block_dims(region: Region, axes: List[GridAxis],
+                       squeeze_to_rank: Optional[int]) -> Optional[List[BlockDim]]:
+    """Try to express a region as a BlockSpec over the grid axes."""
+    shape = region.static_shape()
+    if shape is None:
+        return None
+    axis_vars = [a.var for a in axes]
+    var_to_axis = {id(a.var): i for i, a in enumerate(axes)}
+    dims: List[BlockDim] = []
+    rank = len(region.base)
+    n_squeeze = rank - (squeeze_to_rank or rank)
+    for d, (base, size) in enumerate(zip(region.base, shape)):
+        lin = linearize(base, axis_vars)
+        if lin is None:
+            return None
+        coeffs, const = lin
+        if size <= 0:
+            return None
+        # every coefficient and the constant must be whole blocks
+        terms = []
+        ok = True
+        for v, c in coeffs.items():
+            if c % size != 0:
+                ok = False
+                break
+            terms.append((var_to_axis[id(v)], c // size))
+        if not ok or const % size != 0:
+            return None
+        terms.sort()
+        blk = size
+        if d < n_squeeze and size == 1:
+            blk = None  # squeeze leading unit dims to match on-chip rank
+        dims.append(BlockDim(blk, tuple(terms), const // size))
+    return dims
+
+
+def _merge_param(plans: Dict[int, ParamPlan], buf: Buffer, role: str,
+                 dims: Optional[List[BlockDim]], alias: Optional[Buffer]):
+    p = plans[buf.uid]
+    # role lattice: _unused -> in/out; in + out -> inout
+    if p.role == "_unused":
+        p.role = role
+    elif p.role != role:
+        p.role = "inout"
+    if p.mode == "any":
+        return
+    if dims is None:
+        p.mode = "any"
+        p.block_dims = None
+        p.alias = None
+        return
+    key = tuple(d.key() for d in dims)
+    if p.block_dims is None:
+        p.block_dims = dims
+        p.alias = alias
+    elif p.block_key() != key:
+        # conflicting access patterns -> keep whole array in HBM
+        p.mode = "any"
+        p.block_dims = None
+        p.alias = None
+    elif p.alias is None and alias is not None:
+        p.alias = alias
+
+
+def _writers(stmts_root: Stmt) -> Dict[int, int]:
+    """buffer uid -> number of statements that write it."""
+    counts: Dict[int, int] = {}
+
+    def bump(buf):
+        counts[buf.uid] = counts.get(buf.uid, 0) + 1
+
+    def visit(s):
+        if isinstance(s, CopyStmt):
+            bump(s.dst.buffer)
+        elif isinstance(s, (FillStmt,)):
+            bump(s.dst.buffer)
+        elif isinstance(s, GemmStmt):
+            bump(s.C.buffer)
+        elif isinstance(s, BufferStoreStmt):
+            bump(s.buffer)
+        elif isinstance(s, ReduceStmt):
+            bump(s.dst)
+        elif isinstance(s, CumSumStmt):
+            bump(s.dst)
+        elif isinstance(s, AtomicStmt):
+            bump(s.dst.buffer)
+
+    from ..ir import walk
+    walk(stmts_root, visit)
+    return counts
+
+
+def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
+    kn = func.kernel_node()
+    if kn is None:
+        raise PlanError(
+            f"{func.name}: kernel has no T.Kernel frame; every tile kernel "
+            "must open `with T.Kernel(...)`")
+    pass_cfg = pass_cfg or {}
+
+    # ---- grid ------------------------------------------------------------
+    grid: List[GridAxis] = [
+        GridAxis(v, e, "parallel")
+        for v, e in zip(reversed(kn.grid_vars), reversed(kn.extents))
+    ]
+
+    top = list(kn.body.stmts)
+    pipelined = [s for s in top
+                 if isinstance(s, ForNest) and s.kind == "pipelined"]
+    mapped_loop: Optional[ForNest] = None
+    if len(pipelined) == 1 and not any(
+            isinstance(s, CommStmt) for s in top):
+        lp = pipelined[0]
+        ext = as_int(lp.extents[0])
+        if ext is not None and len(lp.loop_vars) == 1:
+            mapped_loop = lp
+    pipeline_axis = None
+    if mapped_loop is not None:
+        grid.append(GridAxis(mapped_loop.loop_vars[0],
+                             as_int(mapped_loop.extents[0]), "arbitrary"))
+        pipeline_axis = len(grid) - 1
+
+    # ---- phase split ------------------------------------------------------
+    if mapped_loop is not None:
+        idx = top.index(mapped_loop)
+        init_stmts = [s for s in top[:idx] if not isinstance(s, AllocStmt)]
+        main_stmts = list(mapped_loop.body.stmts)
+        epi_stmts = [s for s in top[idx + 1:] if not isinstance(s, AllocStmt)]
+    else:
+        init_stmts, epi_stmts = [], []
+        main_stmts = [s for s in top if not isinstance(s, AllocStmt)]
+
+    # ---- buffer classification -------------------------------------------
+    allocs = [s.buffer for s in collect(func.body,
+                                        lambda s: isinstance(s, AllocStmt))]
+    global_params = [b for b in func.buffer_params]
+    plans: Dict[int, ParamPlan] = {
+        b.uid: ParamPlan(b, role="_unused", mode="block", block_dims=None)
+        for b in global_params
+    }
+    writer_counts = _writers(func.body)
+
+    aliased_copies: List[CopyStmt] = []
+
+    def loop_ctx_axes(extra_vars) -> List[GridAxis]:
+        # axes visible to an access: the grid plus (for elementwise accesses)
+        # the enclosing parallel loop vars, appended as pseudo-axes
+        return grid + list(extra_vars)
+
+    def consider_copy(stmt: CopyStmt, in_mapped_loop: bool,
+                      serial_vars: list):
+        src, dst = stmt.src, stmt.dst
+        sg = src.buffer.scope == "global"
+        dg = dst.buffer.scope == "global"
+        if sg and not dg:
+            if serial_vars:
+                _merge_param(plans, src.buffer, "in", None, None)
+                return
+            dims = _region_block_dims(src, grid, dst.buffer.ndim)
+            alias = None
+            if dims is not None and writer_counts.get(dst.buffer.uid, 0) == 1 \
+                    and dst.is_full():
+                alias = dst.buffer
+                aliased_copies.append(stmt)
+            _merge_param(plans, src.buffer, "in", dims, alias)
+        elif dg and not sg:
+            if serial_vars:
+                _merge_param(plans, dst.buffer, "out", None, None)
+                return
+            dims = _region_block_dims(dst, grid, src.buffer.ndim)
+            _merge_param(plans, dst.buffer, "out", dims, None)
+        elif sg and dg:
+            _merge_param(plans, src.buffer, "in", None, None)
+            _merge_param(plans, dst.buffer, "out", None, None)
+
+    def consider_region_read(region: Region, serial_vars: list):
+        if region.buffer.scope == "global":
+            if serial_vars:
+                _merge_param(plans, region.buffer, "in", None, None)
+            else:
+                dims = _region_block_dims(region, grid, None)
+                _merge_param(plans, region.buffer, "in", dims, None)
+
+    def consider_region_write(region: Region, serial_vars: list):
+        if region.buffer.scope == "global":
+            if serial_vars:
+                _merge_param(plans, region.buffer, "out", None, None)
+            else:
+                dims = _region_block_dims(region, grid, None)
+                _merge_param(plans, region.buffer, "out", dims, None)
+
+    def visit_expr_globals(e, serial_vars: list, par_vars: list):
+        # global BufferLoads inside expressions (elementwise access)
+        def go(x):
+            if isinstance(x, BufferLoad):
+                if x.buffer.scope == "global":
+                    _elementwise_access(x, "in", serial_vars, par_vars)
+                for i in x.indices:
+                    if not isinstance(i, slice):
+                        go(i)
+            else:
+                from ..ir.expr import BinOp, Call, Cast
+                if isinstance(x, BinOp):
+                    go(x.a)
+                    go(x.b)
+                elif isinstance(x, Call):
+                    for a in x.args:
+                        if not isinstance(a, str):
+                            go(a)
+                elif isinstance(x, Cast):
+                    go(x.value)
+        go(e)
+
+    def _elementwise_access(load_or_store, role: str, serial_vars: list,
+                            par_vars: list):
+        buf = load_or_store.buffer
+        indices = load_or_store.indices
+        if serial_vars:
+            _merge_param(plans, buf, role, None, None)
+            return
+        # index = grid-affine * block + parallel-loop var; block size from
+        # the loop extent of that var
+        par_var_ext = {id(v): e for v, e in par_vars}
+        dims: List[BlockDim] = []
+        axis_vars = [a.var for a in grid]
+        var_to_axis = {id(a.var): i for i, a in enumerate(grid)}
+        for idx in indices:
+            if isinstance(idx, slice):
+                return _merge_param(plans, buf, role, None, None)
+            lin = linearize(idx, axis_vars + [v for v, _ in par_vars])
+            if lin is None:
+                return _merge_param(plans, buf, role, None, None)
+            coeffs, const = lin
+            pvars = [(v, c) for v, c in coeffs.items() if id(v) in par_var_ext]
+            gvars = [(v, c) for v, c in coeffs.items()
+                     if id(v) in var_to_axis]
+            if len(pvars) > 1:
+                return _merge_param(plans, buf, role, None, None)
+            if pvars:
+                v, c = pvars[0]
+                if c != 1:
+                    return _merge_param(plans, buf, role, None, None)
+                size = par_var_ext[id(v)]
+            else:
+                size = 1
+            terms, ok = [], True
+            for v, c in gvars:
+                if c % size != 0:
+                    ok = False
+                    break
+                terms.append((var_to_axis[id(v)], c // size))
+            if not ok or const % size != 0:
+                return _merge_param(plans, buf, role, None, None)
+            terms.sort()
+            dims.append(BlockDim(size, tuple(terms), const // size))
+        _merge_param(plans, buf, role, dims, None)
+
+    def visit(stmts: Sequence[Stmt], serial_vars: list, par_vars: list):
+        for s in stmts:
+            if isinstance(s, AllocStmt):
+                continue
+            if isinstance(s, CopyStmt):
+                consider_copy(s, False, serial_vars)
+            elif isinstance(s, GemmStmt):
+                consider_region_read(s.A, serial_vars)
+                consider_region_read(s.B, serial_vars)
+                if s.C.buffer.scope == "global":
+                    raise PlanError("T.gemm accumulator must be an on-chip "
+                                    "fragment buffer")
+            elif isinstance(s, FillStmt):
+                consider_region_write(s.dst, serial_vars)
+            elif isinstance(s, AtomicStmt):
+                if s.dst.buffer.scope == "global":
+                    _merge_param(plans, s.dst.buffer, "inout", None, None)
+                if isinstance(s.value, Region):
+                    consider_region_read(s.value, serial_vars)
+            elif isinstance(s, BufferStoreStmt):
+                if s.buffer.scope == "global":
+                    _elementwise_access(s, "out", serial_vars, par_vars)
+                visit_expr_globals(s.value, serial_vars, par_vars)
+            elif isinstance(s, ReduceStmt):
+                if s.src.scope == "global" or s.dst.scope == "global":
+                    raise PlanError("T.reduce_* operates on on-chip buffers")
+            elif isinstance(s, ForNest):
+                if s.kind == "parallel":
+                    visit(s.body.stmts, serial_vars,
+                          par_vars + list(zip(s.loop_vars,
+                                              [as_int(e) for e in s.extents])))
+                else:
+                    visit(s.body.stmts,
+                          serial_vars + list(s.loop_vars), par_vars)
+            elif isinstance(s, IfThenElse):
+                visit(s.then_body.stmts, serial_vars, par_vars)
+                if s.else_body:
+                    visit(s.else_body.stmts, serial_vars, par_vars)
+            elif isinstance(s, SeqStmt):
+                visit(s.stmts, serial_vars, par_vars)
+
+    visit(init_stmts, [], [])
+    visit(main_stmts, [], [])
+    visit(epi_stmts, [], [])
+
+    # ---- finalize ---------------------------------------------------------
+    params: List[ParamPlan] = []
+    for b in global_params:
+        p = plans[b.uid]
+        if p.role == "_unused":
+            # keep unused params as pass-through inputs (ANY, zero-cost)
+            p.role = "in"
+            p.mode = "any"
+            p.block_dims = None
+        if p.mode == "block" and p.block_dims is None:
+            p.mode = "any"
+        params.append(p)
+
+    aliased_bufs = {p.alias.uid for p in params if p.alias is not None}
+    scratch = [b for b in allocs if b.uid not in aliased_bufs]
+
+    return KernelPlan(
+        func=func, grid=grid, params=params, scratch=scratch,
+        init_stmts=init_stmts, main_stmts=main_stmts, epi_stmts=epi_stmts,
+        pipeline_axis=pipeline_axis,
+        aliased_copies=aliased_copies,
+        annotations=dict(func.attrs.get("kernel_annotations", {})),
+    )

@@ -1,0 +1,5 @@
+from .target import (determine_target, TPU_TARGET_DESC, target_is_mesh,
+                     mesh_dims_from_target, make_mesh_target,
+                     target_is_interpret, tpu_available)
+from .tensor import (TensorSupplyType, get_tensor_supply, to_jax, copy_back,
+                     assert_allclose, torch_assert_close)

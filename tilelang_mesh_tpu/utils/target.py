@@ -1,0 +1,71 @@
+"""Target determination.
+
+Reference: /root/reference/tilelang/utils/target.py (determine_target:76,
+SUNMMIO_TARGET_DESC:21). Our targets:
+
+  "tpu"            — compile Pallas to Mosaic, run on the local TPU
+  "cpu"            — Pallas interpret mode (CI / no-hardware development)
+  "tpu-mesh[RxC]"  — SPMD over an RxC jax Mesh (the Sunmmio-mesh analog);
+                     mesh dims ride in the target string exactly like the
+                     reference's mattr=device_mesh_nrow_4,device_mesh_ncol_4
+  "auto"           — tpu if a TPU is attached else cpu
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Optional, Tuple
+
+TPU_TARGET_DESC = "tpu"
+TPU_MESH_TARGET_DESC = "tpu-mesh[{nrow}x{ncol}]"
+
+AVAILABLE_TARGETS = ("auto", "tpu", "cpu", "tpu-mesh")
+
+_MESH_RE = re.compile(r"^(tpu|cpu)-mesh\[(\d+)x(\d+)\]$")
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_available() -> bool:
+    try:
+        import jax
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except Exception:
+        return False
+
+
+def determine_target(target: str = "auto",
+                     return_object: bool = False) -> str:
+    """Canonicalize a target string (reference determine_target:76)."""
+    if target in (None, "auto"):
+        return "tpu" if tpu_available() else "cpu"
+    if target in ("tpu", "cpu"):
+        return target
+    if _MESH_RE.match(target):
+        return target
+    raise ValueError(f"Unknown target {target!r}; expected one of "
+                     f"{AVAILABLE_TARGETS} or 'tpu-mesh[RxC]'")
+
+
+def target_is_mesh(target: str) -> bool:
+    return _MESH_RE.match(target) is not None
+
+
+def mesh_dims_from_target(target: str) -> Optional[Tuple[int, int]]:
+    m = _MESH_RE.match(target)
+    if m is None:
+        return None
+    return (int(m.group(2)), int(m.group(3)))
+
+
+def make_mesh_target(nrow: int, ncol: int, base: str = "auto") -> str:
+    base = determine_target(base)
+    return f"{base}-mesh[{nrow}x{ncol}]"
+
+
+def target_is_interpret(target: str) -> bool:
+    """Interpret-mode Pallas for cpu targets (SURVEY §4: CPU fallback)."""
+    from ..env import env
+    if env.TL_TPU_FORCE_INTERPRET:
+        return True
+    return target.startswith("cpu")

@@ -1,0 +1,108 @@
+"""Tensor supply + comparison helpers for testing and profiling.
+
+Reference: /root/reference/tilelang/utils/tensor.py (TensorSupplyType,
+torch_assert_close). JAX-native: supplies jnp arrays; accepts numpy / torch
+CPU tensors at the boundary for API parity.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class TensorSupplyType(Enum):
+    Integer = 1
+    Uniform = 2
+    Normal = 3
+    Randn = 4
+    Zero = 5
+    One = 6
+    Auto = 7
+
+
+def _np_dtype(dtype: str):
+    import jax.numpy as jnp
+    return np.dtype(jnp.dtype(dtype))
+
+
+def get_tensor_supply(supply_type: TensorSupplyType = TensorSupplyType.Auto,
+                      seed: int = 0):
+    rng = np.random.default_rng(seed)
+
+    def supply(shape: Sequence[int], dtype: str):
+        import jax.numpy as jnp
+        jdt = jnp.dtype(dtype)
+        st = supply_type
+        if st == TensorSupplyType.Auto:
+            st = (TensorSupplyType.Integer
+                  if jnp.issubdtype(jdt, jnp.integer) else
+                  TensorSupplyType.Normal)
+        if st == TensorSupplyType.Zero:
+            return jnp.zeros(shape, jdt)
+        if st == TensorSupplyType.One:
+            return jnp.ones(shape, jdt)
+        if st == TensorSupplyType.Integer:
+            return jnp.asarray(rng.integers(-4, 5, size=shape), dtype=jdt)
+        if st == TensorSupplyType.Uniform:
+            return jnp.asarray(rng.uniform(-1, 1, size=shape), dtype=jdt)
+        # Normal / Randn
+        return jnp.asarray(rng.standard_normal(size=shape), dtype=jdt)
+
+    return supply
+
+
+def to_jax(x: Any):
+    """Convert torch / numpy / python inputs to jax arrays (zero-copy where
+    possible via dlpack)."""
+    import jax
+    import jax.numpy as jnp
+    if isinstance(x, jax.Array):
+        return x
+    mod = type(x).__module__
+    if mod.startswith("torch"):
+        if x.device.type != "cpu":
+            raise ValueError("only CPU torch tensors can cross into the TPU "
+                             "runtime")
+        return jnp.asarray(x.detach().numpy())
+    return jnp.asarray(x)
+
+
+def copy_back(dst: Any, src) -> None:
+    """Write a jax result back into a caller-provided torch/numpy output
+    buffer (reference-style `kernel(a, b, c)` call convention)."""
+    arr = np.asarray(src)
+    mod = type(dst).__module__
+    if mod.startswith("torch"):
+        import torch
+        dst.copy_(torch.from_numpy(arr.copy()))
+    elif isinstance(dst, np.ndarray):
+        np.copyto(dst, arr)
+    else:
+        raise TypeError(f"cannot copy kernel output back into {type(dst)}")
+
+
+def assert_allclose(actual, expected, rtol: float = 1e-2, atol: float = 1e-2,
+                    max_mismatched_ratio: float = 0.01):
+    """Numeric comparison with a mismatch budget (reference
+    torch_assert_close semantics)."""
+    a = np.asarray(actual, dtype=np.float64)
+    e = np.asarray(expected, dtype=np.float64)
+    assert a.shape == e.shape, f"shape mismatch {a.shape} vs {e.shape}"
+    close = np.isclose(a, e, rtol=rtol, atol=atol)
+    mismatched = (~close).sum()
+    total = close.size
+    if mismatched > max_mismatched_ratio * total:
+        idx = np.argwhere(~close)[:5]
+        samples = [f"  at {tuple(i)}: got {a[tuple(i)]}, want {e[tuple(i)]}"
+                   for i in idx]
+        raise AssertionError(
+            f"{mismatched}/{total} elements "
+            f"({100.0 * mismatched / total:.2f}%) mismatched "
+            f"(budget {100 * max_mismatched_ratio:.2f}%), rtol={rtol}, "
+            f"atol={atol}\n" + "\n".join(samples))
+
+
+torch_assert_close = assert_allclose
