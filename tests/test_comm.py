@@ -1,0 +1,279 @@
+"""T.comm.* collective tests.
+
+Mirrors reference testing/python/language/test_tilelang_language_comm.py:
+(1) golden lowering structure (no device), (2) execution semantics on the
+8-device virtual CPU mesh under shard_map.
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.parallel import mesh_config
+from tilelang_mesh_tpu.utils.tensor import assert_allclose
+
+MESH = (2, 4)
+NROW, NCOL = MESH
+SHAPE = (8, 128)
+
+
+def _compile(pf):
+    return tilelang.compile(pf, target=f"cpu-mesh[{NROW}x{NCOL}]")
+
+
+def _shards(rng):
+    """One distinct local shard per core, assembled into the global array
+    for a cross_mesh_dim=0 sharded input."""
+    return rng.standard_normal((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                               ).astype(np.float32)
+
+
+def _core_shard(x, r, c):
+    n = SHAPE[0]
+    i = r * NCOL + c
+    return x[i * n:(i + 1) * n]
+
+
+# ---- golden lowering (style 1: no device) ----------------------------------
+
+
+def test_broadcast_golden_schedule():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                src = T.alloc_shared(SHAPE, "float32")
+                dst = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, src)
+                T.comm.broadcast(src, dst, (0, 1), "horizontal")
+                T.copy(dst, B)
+
+        art = tilelang.lower(k, target=f"cpu-mesh[{NROW}x{NCOL}]")
+    desc = art.plan_desc
+    assert "collective broadcast" in desc
+    assert "src_core=(0, 1)" in desc
+    assert "dir=h" in desc
+    # compute segments on either side of the collective
+    assert desc.count("pallas_segment") == 2
+
+
+def test_allreduce_golden_schedule():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * SHAPE[0], 1),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                buf = T.alloc_fragment(SHAPE, "float32")
+                out = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, buf)
+                T.comm.all_reduce(buf, out, "sum", "all", dim=1)
+                T.copy(out, B)
+
+        art = tilelang.lower(k, target=f"cpu-mesh[{NROW}x{NCOL}]")
+    assert "all_reduce" in art.plan_desc
+    assert "op=sum" in art.plan_desc
+    assert "dir=all" in art.plan_desc
+
+
+# ---- execution semantics (8-device mesh) -----------------------------------
+
+
+def _identity_comm_kernel(comm_body, out_shape=SHAPE):
+    """Template: load per-core shard -> collective -> store result."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * out_shape[0], out_shape[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                src = T.alloc_shared(SHAPE, "float32")
+                dst = T.alloc_shared(out_shape, "float32")
+                T.copy(A, src)
+                comm_body(src, dst)
+                T.copy(dst, B)
+        return _compile(k)
+
+
+def test_broadcast_horizontal_exec():
+    def body(src, dst):
+        T.comm.fence()
+        T.comm.broadcast(src, dst, (1, 2), "h")
+        T.comm.barrier()
+    k = _identity_comm_kernel(body)
+    rng = np.random.default_rng(0)
+    a = _shards(rng)
+    out = np.asarray(k(a))
+    src_val = _core_shard(a, 1, 2)
+    for r in range(NROW):
+        for c in range(NCOL):
+            got = _core_shard(out, r, c)
+            if r == 1:  # source row receives
+                assert_allclose(got, src_val, rtol=1e-6, atol=1e-6)
+            else:       # others keep dst contents (zero-init fragments)
+                assert np.allclose(got, 0)
+
+
+def test_broadcast_all_exec():
+    def body(src, dst):
+        T.comm.broadcast(src, dst, (0, 3), "all")
+    k = _identity_comm_kernel(body)
+    rng = np.random.default_rng(1)
+    a = _shards(rng)
+    out = np.asarray(k(a))
+    src_val = _core_shard(a, 0, 3)
+    for r in range(NROW):
+        for c in range(NCOL):
+            assert_allclose(_core_shard(out, r, c), src_val,
+                            rtol=1e-6, atol=1e-6)
+
+
+def test_put_exec():
+    def body(src, dst):
+        T.comm.put(src, dst, (0, 0), (1, 3))
+    k = _identity_comm_kernel(body)
+    rng = np.random.default_rng(2)
+    a = _shards(rng)
+    out = np.asarray(k(a))
+    for r in range(NROW):
+        for c in range(NCOL):
+            got = _core_shard(out, r, c)
+            if (r, c) == (1, 3):
+                assert_allclose(got, _core_shard(a, 0, 0), rtol=1e-6,
+                                atol=1e-6)
+            else:
+                assert np.allclose(got, 0)
+
+
+def test_all_gather_horizontal_exec():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL, NCOL, SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                send = T.alloc_shared(SHAPE, "float32")
+                recv = T.alloc_shared((NCOL, *SHAPE), "float32")
+                T.copy(A, send)
+                T.comm.all_gather(send, recv, "h")
+                T.copy(recv, B[0, 0, 0])
+        kern = _compile(k)
+    rng = np.random.default_rng(3)
+    a = _shards(rng)
+    out = np.asarray(kern(a))  # (NROW*NCOL, NCOL, 8, 128)
+    for r in range(NROW):
+        for c in range(NCOL):
+            got = out[r * NCOL + c]
+            for cc in range(NCOL):
+                assert_allclose(got[cc], _core_shard(a, r, cc),
+                                rtol=1e-6, atol=1e-6)
+
+
+def test_all_reduce_sum_all_exec():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * SHAPE[0], 1),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                buf = T.alloc_fragment(SHAPE, "float32")
+                out = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, buf)
+                T.comm.all_reduce(buf, out, "sum", "all", dim=1)
+                T.copy(out, B)
+        kern = _compile(k)
+    rng = np.random.default_rng(4)
+    a = _shards(rng)
+    out = np.asarray(kern(a))
+    # every core ends with the same value: sum over all cores of rowsum
+    expected = np.zeros((SHAPE[0], 1), np.float32)
+    for r in range(NROW):
+        for c in range(NCOL):
+            expected += _core_shard(a, r, c).sum(1, keepdims=True)
+    n = SHAPE[0]
+    for i in range(NROW * NCOL):
+        assert_allclose(out[i * n:(i + 1) * n], expected, rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_all_reduce_max_vertical_exec():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * SHAPE[0], 1),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                buf = T.alloc_fragment(SHAPE, "float32")
+                out = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, buf)
+                T.comm.all_reduce(buf, out, "max", "v", dim=1)
+                T.copy(out, B)
+        kern = _compile(k)
+    rng = np.random.default_rng(5)
+    a = _shards(rng)
+    out = np.asarray(kern(a))
+    n = SHAPE[0]
+    for r in range(NROW):
+        for c in range(NCOL):
+            expected = np.maximum.reduce([
+                _core_shard(a, rr, c).max(1, keepdims=True)
+                for rr in range(NROW)])
+            got = out[(r * NCOL + c) * n:(r * NCOL + c + 1) * n]
+            assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+# ---- frontend validation (mirrors reference comm.py asserts) ---------------
+
+
+def test_comm_shape_validation():
+    with mesh_config(*MESH):
+        with pytest.raises(AssertionError):
+            @T.prim_func
+            def bad(A: T.Tensor((8, 128), "float32")):
+                with T.Kernel(1) as bx:
+                    s = T.alloc_shared((8, 128), "float32")
+                    d = T.alloc_shared((8, 64), "float32")  # dtype ok, shape bad
+                    T.comm.all_gather(s, d, "h")
+
+
+def test_comm_core_bounds():
+    with mesh_config(*MESH):
+        with pytest.raises(AssertionError):
+            @T.prim_func
+            def bad(A: T.Tensor((8, 128), "float32")):
+                with T.Kernel(1) as bx:
+                    s = T.alloc_shared((8, 128), "float32")
+                    d = T.alloc_shared((8, 128), "float32")
+                    T.comm.broadcast(s, d, (5, 0), "all")
+
+
+def test_comm_reduce_type_validation():
+    with mesh_config(*MESH):
+        with pytest.raises(AssertionError):
+            @T.prim_func
+            def bad(A: T.Tensor((8, 128), "float32")):
+                with T.Kernel(1) as bx:
+                    s = T.alloc_shared((8, 128), "float32")
+                    o = T.alloc_shared((8, 1), "float32")
+                    T.comm.all_reduce(s, o, "mean", "all")

@@ -1,0 +1,124 @@
+"""MeshTensor sharding math + sharded-kernel execution under shard_map.
+
+Mirrors reference testing/python/language/test_tilelang_language_mesh_tensor.py
+(sharding shape unit tests) plus execution on the 8-device virtual CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.parallel import (MeshReplicationType,
+                                        MeshShardingPolicy, mesh_config)
+from tilelang_mesh_tpu.utils.tensor import assert_allclose
+
+
+# ---- pure sharding math (style 3: no device) -------------------------------
+
+
+def test_sharded_shape_xy_split():
+    p = MeshShardingPolicy(x=1, y=0)
+    # x splits dim1 by ncols, y splits dim0 by nrows
+    assert p.sharded_shape((64, 128), 2, 4) == (32, 32)
+
+
+def test_sharded_shape_replicate_all():
+    p = MeshShardingPolicy(replicate=MeshReplicationType.ALL)
+    assert p.sharded_shape((64, 128), 2, 4) == (64, 128)
+
+
+def test_sharded_shape_cross_mesh():
+    p = MeshShardingPolicy(cross_mesh_dim=0)
+    assert p.sharded_shape((64, 128), 2, 4) == (8, 128)
+
+
+def test_sharded_shape_row_replicate_y_split():
+    p = MeshShardingPolicy(y=0, replicate=MeshReplicationType.ROW)
+    assert p.sharded_shape((64, 128), 2, 4) == (32, 128)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        MeshShardingPolicy(x=0, cross_mesh_dim=1)
+    p = MeshShardingPolicy(x=0, replicate=MeshReplicationType.ROW)
+    with pytest.raises(ValueError):
+        p.sharded_shape((8, 8), 2, 2)
+
+
+def test_partition_spec():
+    from jax.sharding import PartitionSpec as P
+    assert MeshShardingPolicy(x=1, y=0).partition_spec(2) == P("x", "y")
+    assert MeshShardingPolicy(
+        replicate=MeshReplicationType.ALL).partition_spec(2) == P(None, None)
+    assert MeshShardingPolicy(cross_mesh_dim=0).partition_spec(2) == \
+        P(("x", "y"), None)
+
+
+# ---- sharded kernel execution ---------------------------------------------
+
+
+def _mesh_matmul(M, N, K, bm, bn, bk, mesh_cfg, dtype="float32"):
+    """The reference's example_gemm_with_mesh_tensor.py brought to TPU:
+    A row-sharded, B col-sharded... here all row-sharded on x=1,y=0 like the
+    reference's (1,1) demo, generalized to real shards."""
+
+    @T.prim_func
+    def gemm(
+        A: T.MeshTensor((M, K), T.MeshShardingPolicy(y=0), mesh_cfg, dtype),
+        B: T.MeshTensor((K, N), T.MeshShardingPolicy(
+            replicate=T.MeshReplicationType.ALL), mesh_cfg, dtype),
+        C: T.MeshTensor((M, N), T.MeshShardingPolicy(y=0), mesh_cfg, dtype),
+    ):
+        sM, sK = A.shape
+        _, sN = B.shape
+        with T.Kernel(T.ceildiv(sN, bn), T.ceildiv(sM, bm)) as (bx, by):
+            A_s = T.alloc_shared((bm, bk), dtype)
+            B_s = T.alloc_shared((bk, bn), dtype)
+            C_l = T.alloc_fragment((bm, bn), "float32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(sK, bk)):
+                T.copy(A[by * bm, ko * bk], A_s)
+                T.copy(B[ko * bk, bx * bn], B_s)
+                T.gemm(A_s, B_s, C_l)
+            T.copy(C_l, C[by * bm, bx * bn])
+
+    return gemm
+
+
+def test_mesh_tensor_sharded_gemm_2x4():
+    """Row-sharded GEMM over the full 2x4 virtual mesh: each core computes
+    its row shard against a replicated B."""
+    mesh_cfg = (2, 4)
+    M, N, K = 512, 128, 128
+    with mesh_config(*mesh_cfg):
+        pf = _mesh_matmul(M, N, K, 64, 128, 64, mesh_cfg)
+        k = tilelang.compile(pf, target="cpu-mesh[2x4]")
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((M, K), dtype=np.float32)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    c = k(a, b)
+    assert_allclose(c, a @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_mesh_tensor_1x1_matches_reference_demo():
+    """The reference demo runs MeshTensor on a (1,1) mesh — degenerate
+    single-core case must behave like a plain kernel."""
+    mesh_cfg = (1, 1)
+    M = N = K = 256
+    with mesh_config(*mesh_cfg):
+        pf = _mesh_matmul(M, N, K, 128, 128, 64, mesh_cfg)
+        k = tilelang.compile(pf, target="cpu-mesh[1x1]")
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((M, K), dtype=np.float32)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    assert_allclose(k(a, b), a @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_mesh_kernel_source_describes_schedule():
+    mesh_cfg = (2, 4)
+    with mesh_config(*mesh_cfg):
+        pf = _mesh_matmul(512, 128, 128, 64, 128, 64, mesh_cfg)
+        art = tilelang.lower(pf, target="cpu-mesh[2x4]")
+    assert "mesh_program" in art.plan_desc
+    assert "pallas_segment" in art.plan_desc

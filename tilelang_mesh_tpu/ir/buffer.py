@@ -75,9 +75,11 @@ class Buffer:
         if len(idx) > self.ndim:
             raise IndexError(
                 f"{self.name}: {len(idx)} indices for rank-{self.ndim} buffer")
-        # pad missing trailing dims with full slices
+        # pad missing trailing dims with 0: a partial index is a region BASE
+        # (reference element-access sugar), the extent comes from the
+        # consuming tile op
         if len(idx) < self.ndim:
-            idx = idx + (slice(None),) * (self.ndim - len(idx))
+            idx = idx + (0,) * (self.ndim - len(idx))
         out = []
         for i in idx:
             if isinstance(i, slice):
