@@ -1,0 +1,92 @@
+"""Flagship transformer: forward, single-device training, and the
+megatron-style dp x tp sharded training step on the virtual mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.models import (ModelConfig, forward, init_params,
+                                      loss_fn, make_sharded_train_step,
+                                      make_train_step)
+
+
+CFG = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                  max_seq=32, dtype=jnp.float32, use_flash=False)
+
+
+def _tokens(rng, b, s):
+    return jnp.asarray(rng.integers(0, CFG.vocab, (b, s)), jnp.int32)
+
+
+def test_forward_shapes():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tok = _tokens(np.random.default_rng(0), 2, CFG.max_seq)
+    logits = forward(params, tok, CFG)
+    assert logits.shape == (2, CFG.max_seq, CFG.vocab)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_forward_uses_flash_kernel_matches_reference():
+    cfg_flash = ModelConfig(**{**CFG.__dict__, "use_flash": True})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tok = _tokens(np.random.default_rng(1), 2, 32)
+    a = forward(params, tok, CFG)
+    b = forward(params, tok, cfg_flash)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_train_step_reduces_loss():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    init, step = make_train_step(CFG, lr=1e-2)
+    opt_state = init(params)
+    tok = _tokens(np.random.default_rng(2), 4, CFG.max_seq + 1)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tok)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_sharded_train_step_matches_single_device():
+    """dp x tp sharded step must produce the same loss trajectory as the
+    single-device step (same math, different layout)."""
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs 4 devices")
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tok = _tokens(np.random.default_rng(3), 4, CFG.max_seq + 1)
+
+    init_s, make = make_sharded_train_step(CFG, mesh, lr=1e-2)
+    opt_s = init_s(params)
+    step_s = make(params, opt_s)
+    p1, o1, loss_sharded = step_s(params, opt_s, tok)
+
+    init_1, step_1 = make_train_step(CFG, lr=1e-2)
+    opt_1 = init_1(params)
+    p2, o2, loss_single = step_1(params, opt_1, tok)
+
+    np.testing.assert_allclose(float(loss_sharded), float(loss_single),
+                               rtol=1e-4)
+    # updated sharded params must match the single-device update
+    flat1 = jax.tree.leaves(p1)
+    flat2 = jax.tree.leaves(p2)
+    for a, b in zip(flat1, flat2):
+        # adamw normalizes by sqrt(nu): tiny psum-ordering differences in
+        # grads amplify near zero-curvature entries, so compare loosely
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-2,
+                                   atol=5e-3)
+
+
+def test_graft_entry_dryrun():
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+    ge.dryrun_multichip(len(jax.devices()))

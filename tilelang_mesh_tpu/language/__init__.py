@@ -55,6 +55,9 @@ from .math_ops import (exp, exp2, exp10, log, log2, log10, log1p, sqrt, rsqrt,
                        __exp, __exp2, __exp10, __log, __log2, __log10, __sin,
                        __cos, __tan, __pow)
 
+# predicated blocks
+from .ifelse import If, Else
+
 # debug
 from .debug import print, device_assert  # noqa: A004
 

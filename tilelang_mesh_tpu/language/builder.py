@@ -118,13 +118,35 @@ class PrimFuncObj:
 
 def _param_annotations(fn: Callable) -> List[tuple]:
     sig = inspect.signature(fn)
+    # `from __future__ import annotations` stringifies annotations; evaluate
+    # them against the function's globals + closure cells
+    env = None
     out = []
     for name, p in sig.parameters.items():
-        if p.annotation is inspect.Parameter.empty:
+        annot = p.annotation
+        if annot is inspect.Parameter.empty:
             raise TypeError(
                 f"@T.prim_func parameter {name!r} needs a T.Tensor/"
                 f"T.MeshTensor/T.dyn annotation")
-        out.append((name, p.annotation))
+        if isinstance(annot, str):
+            if env is None:
+                env = dict(fn.__globals__)
+                free = fn.__code__.co_freevars
+                cells = fn.__closure__ or ()
+                for fv, cell in zip(free, cells):
+                    try:
+                        env[fv] = cell.cell_contents
+                    except ValueError:
+                        pass
+            try:
+                annot = eval(annot, env)  # noqa: S307 - trusted kernel code
+            except NameError as e:
+                raise TypeError(
+                    f"cannot evaluate stringified annotation {annot!r} for "
+                    f"parameter {name!r} ({e}); avoid `from __future__ "
+                    "import annotations` in kernel modules or annotate with "
+                    "names visible in the function's closure") from e
+        out.append((name, annot))
     return out
 
 

@@ -1,0 +1,55 @@
+"""`with T.If(cond):` — predicated statement blocks.
+
+The reference rewrites native Python `if` via its AST pass; with a
+trace-based builder the explicit frame is the equivalent. Lowers to
+`@pl.when` (predicated execution on TPU).
+"""
+
+from __future__ import annotations
+
+from ..ir import IfThenElse, convert
+from .builder import require_builder
+
+
+class _IfFrame:
+    def __init__(self, cond):
+        self.cond = convert(cond)
+
+    def __enter__(self):
+        b = require_builder()
+        b.push_frame()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        b = require_builder()
+        body = b.pop_frame()
+        if exc_type is None:
+            b.emit(IfThenElse(self.cond, body))
+        return False
+
+
+class _ElseFrame:
+    def __enter__(self):
+        b = require_builder()
+        stmts = b.frames[-1].stmts
+        if not stmts or not isinstance(stmts[-1], IfThenElse) or \
+                stmts[-1].else_body is not None:
+            raise RuntimeError("T.Else() must directly follow a T.If block")
+        self._if = stmts[-1]
+        b.push_frame()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        b = require_builder()
+        body = b.pop_frame()
+        if exc_type is None:
+            self._if.else_body = body
+        return False
+
+
+def If(cond) -> _IfFrame:  # noqa: N802 - mirrors reference naming style
+    return _IfFrame(cond)
+
+
+def Else() -> _ElseFrame:  # noqa: N802
+    return _ElseFrame()

@@ -1,0 +1,3 @@
+from .transformer import (ModelConfig, init_params, forward, loss_fn,
+                          make_train_step, make_sharded_train_step,
+                          param_specs)

@@ -1,0 +1,265 @@
+"""Flagship model: LLaMA-style decoder built on the tile-kernel library.
+
+The reference is a kernel framework whose examples compose into model
+components (flash_attention, fusedmoe, norm — SURVEY §2.4); this module is
+the corresponding model tier: a functional transformer whose attention runs
+the framework's FlashAttention tile kernel, with a megatron-style
+tensor+data-parallel training step expressed through ``shard_map`` over a
+("dp", "tp") mesh — attention heads and MLP hidden sharded on tp (activation
+psums ride ICI), batch on dp (gradient psums).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 8
+    d_ff: int = 384
+    max_seq: int = 128
+    dtype: Any = jnp.float32
+    rope_theta: float = 10000.0
+    use_flash: bool = True   # tile kernel vs jnp reference (tiny-shape runs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Dict:
+    k = jax.random.split(rng, 2 + cfg.n_layers)
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+
+    def dense(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale
+                ).astype(cfg.dtype)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(k[2 + i], 7)
+        layers.append({
+            "attn_norm": jnp.ones((d,), cfg.dtype),
+            "wq": dense(lk[0], (d, d), d ** -0.5),
+            "wk": dense(lk[1], (d, d), d ** -0.5),
+            "wv": dense(lk[2], (d, d), d ** -0.5),
+            "wo": dense(lk[3], (d, d), d ** -0.5),
+            "mlp_norm": jnp.ones((d,), cfg.dtype),
+            "w_gate": dense(lk[4], (d, f), d ** -0.5),
+            "w_up": dense(lk[5], (d, f), d ** -0.5),
+            "w_down": dense(lk[6], (f, d), f ** -0.5),
+        })
+    return {
+        "embed": dense(k[0], (cfg.vocab, d), 1.0),
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "layers": layers,
+    }
+
+
+def param_specs(cfg: ModelConfig):
+    """PartitionSpec tree for the ("dp","tp") mesh: heads + mlp hidden on
+    tp, everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"), "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x, w, eps=1e-6):
+    v = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * jax.lax.rsqrt(v + eps)).astype(x.dtype) * w
+
+
+def _rope(x, theta: float):
+    # x: (B, H, S, hd)
+    hd = x.shape[-1]
+    S = x.shape[2]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    t = jnp.arange(S, dtype=jnp.float32)
+    ang = jnp.einsum("s,f->sf", t, freqs)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    xr1 = x1 * cos - x2 * sin
+    xr2 = x2 * cos + x1 * sin
+    return jnp.concatenate([xr1, xr2], -1).astype(x.dtype)
+
+
+def _attention(x, lp, cfg: ModelConfig, n_heads_local: int,
+               tp_axis: Optional[str]):
+    B, S, _ = x.shape
+    hd = cfg.head_dim
+    h = _rms_norm(x, lp["attn_norm"])
+
+    def proj(w):
+        y = jnp.einsum("bsd,dk->bsk", h, w)
+        return y.reshape(B, S, n_heads_local, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = proj(lp["wq"]), proj(lp["wk"]), proj(lp["wv"])
+    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+
+    if cfg.use_flash:
+        from ..ops.flash_attention import flash_attention
+        o = flash_attention(q, k, v, causal=True,
+                            block_M=min(128, S), block_N=min(128, S))
+    else:
+        from ..ops.flash_attention import _reference_attention
+        o = _reference_attention(q, k, v, True, 1.0 / math.sqrt(hd))
+
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, n_heads_local * hd)
+    o = jnp.einsum("bsk,kd->bsd", o, lp["wo"])
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    return x + o.astype(x.dtype)
+
+
+def _mlp(x, lp, tp_axis: Optional[str]):
+    h = _rms_norm(x, lp["mlp_norm"])
+    g = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]))
+    u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    y = jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    return x + y.astype(x.dtype)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: ModelConfig,
+            tp_axis: Optional[str] = None) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab). Works on full params
+    (tp_axis=None) or tp-sharded params inside shard_map."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    n_heads_local = params["layers"][0]["wq"].shape[1] // cfg.head_dim
+    for lp in params["layers"]:
+        x = _attention(x, lp, cfg, n_heads_local, tp_axis)
+        x = _mlp(x, lp, tp_axis)
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                      params["embed"].astype(jnp.float32))
+
+
+def loss_fn(params, tokens, cfg: ModelConfig,
+            tp_axis: Optional[str] = None):
+    """Next-token cross entropy (mean over local batch)."""
+    logits = forward(params, tokens[:, :-1], cfg, tp_axis)
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, -1)
+    gold = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# training steps
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(cfg: ModelConfig, lr: float = 3e-4):
+    """Single-device training step (adamw via optax)."""
+    import optax
+    opt = optax.adamw(lr)
+
+    def init(params):
+        return opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, step
+
+
+def make_sharded_train_step(cfg: ModelConfig, mesh, lr: float = 3e-4):
+    """Megatron-style dp x tp training step under shard_map.
+
+    Forward: tp-sharded attention heads / mlp hidden with activation psums
+    over "tp". Backward: grads psum over "dp"; grads of replicated params
+    additionally psum over "tp" (the transpose collective of using a
+    replicated activation against a tp-sharded weight).
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    opt = optax.adamw(lr)
+    pspecs = param_specs(cfg)
+
+    def _is_replicated(spec) -> bool:
+        return all(s is None for s in spec)
+
+    def local_step(params, opt_state, tokens):
+        dp = jax.lax.axis_size("dp")
+
+        def local_loss(p):
+            return loss_fn(p, tokens, cfg, tp_axis="tp")
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        loss = jax.lax.pmean(loss, "dp")
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        grads = jax.tree.map(
+            lambda g, s: jax.lax.psum(g, "tp") if _is_replicated(s) else g,
+            grads, pspecs)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, "tp")
+
+    def init(params):
+        return opt.init(params)
+
+    def make(params, opt_state):
+        data_spec = P("dp")
+        pspec_tree = pspecs
+        # optimizer-state leaves mirror param paths (mu/nu subtrees); match
+        # each state leaf to its param's spec by key-path suffix
+        from jax.tree_util import keystr, tree_flatten_with_path
+        from jax.tree_util import tree_map_with_path
+        param_paths = [(keystr(kp), spec) for kp, spec in
+                       tree_flatten_with_path(pspec_tree)[0]]
+
+        def state_spec(kp, leaf):
+            ks = keystr(kp)
+            for ppath, spec in param_paths:
+                if ks.endswith(ppath):
+                    return spec
+            return P()
+
+        ospec_tree = tree_map_with_path(state_spec, opt_state)
+        f = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pspec_tree, ospec_tree, data_spec),
+            out_specs=(pspec_tree, ospec_tree, P()),
+            check_vma=False)
+        return jax.jit(f)
+
+    return init, make

@@ -1,0 +1,8 @@
+"""Kernel library: ready-made jax-callable ops built on the tile DSL.
+
+The analog of the reference's examples/ capability surface packaged as a
+library (SURVEY §2.4): GEMM variants, FlashAttention, normalization, etc.
+"""
+
+from .gemm import matmul, matmul_kernel
+from .flash_attention import flash_attention, mha_fwd_kernel

@@ -1,0 +1,74 @@
+"""Ready-made GEMM ops built on the tile DSL.
+
+The analog of the reference's benchmark/matmul kernels
+(/root/reference/benchmark/matmul/benchmark_matmul.py) exposed as plain jax
+callables with carver-driven tile selection.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from ..carver import MatmulTemplate
+
+
+@functools.lru_cache(maxsize=None)
+def matmul_kernel(M, N, K, block_M=None, block_N=None, block_K=None,
+                  in_dtype="bfloat16", out_dtype=None, accum_dtype="float32",
+                  trans_A=False, trans_B=False, relu=False, num_stages=2):
+    out_dtype = out_dtype or in_dtype
+    if block_M is None:
+        hints = MatmulTemplate(M, N, K, in_dtype, accum_dtype).hints(1)
+        cfg = hints[0].config if hints else {"block_M": 128, "block_N": 128,
+                                             "block_K": 128}
+        block_M, block_N, block_K = (cfg["block_M"], cfg["block_N"],
+                                     cfg["block_K"])
+    a_shape = (K, M) if trans_A else (M, K)
+    b_shape = (N, K) if trans_B else (K, N)
+    a_tile = (block_K, block_M) if trans_A else (block_M, block_K)
+    b_tile = (block_N, block_K) if trans_B else (block_K, block_N)
+
+    @T.prim_func
+    def gemm(A: T.Tensor(a_shape, in_dtype),
+             B: T.Tensor(b_shape, in_dtype),
+             C: T.Tensor((M, N), out_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_s = T.alloc_shared(a_tile, in_dtype)
+            B_s = T.alloc_shared(b_tile, in_dtype)
+            C_l = T.alloc_fragment((block_M, block_N), accum_dtype)
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                if trans_A:
+                    T.copy(A[ko * block_K, by * block_M], A_s)
+                else:
+                    T.copy(A[by * block_M, ko * block_K], A_s)
+                if trans_B:
+                    T.copy(B[bx * block_N, ko * block_K], B_s)
+                else:
+                    T.copy(B[ko * block_K, bx * block_N], B_s)
+                T.gemm(A_s, B_s, C_l, transpose_A=trans_A,
+                       transpose_B=trans_B)
+            if relu:
+                for i, j in T.Parallel(block_M, block_N):
+                    C_l[i, j] = T.max(C_l[i, j], 0)
+            T.copy(C_l, C[by * block_M, bx * block_N])
+
+    return _tl_compile(gemm)
+
+
+def matmul(a, b, trans_A: bool = False, trans_B: bool = False,
+           out_dtype: Optional[str] = None, relu: bool = False,
+           block_M=None, block_N=None, block_K=None):
+    """C = op(A) @ op(B) through the tile pipeline."""
+    M = a.shape[1] if trans_A else a.shape[0]
+    K = a.shape[0] if trans_A else a.shape[1]
+    N = b.shape[0] if trans_B else b.shape[1]
+    k = matmul_kernel(M, N, K, block_M, block_N, block_K,
+                      in_dtype=str(a.dtype), out_dtype=out_dtype,
+                      trans_A=trans_A, trans_B=trans_B, relu=relu)
+    return k(a, b)
