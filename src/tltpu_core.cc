@@ -1,0 +1,250 @@
+// tltpu native core: layout algebra + mesh collective schedule synthesis.
+//
+// Native-equivalent of the reference's C++ compiler-core pieces that remain
+// semantic on TPU (cf. /root/reference/src/layout/layout.cc — affine
+// Layout/Fragment algebra; /root/reference/src/op/comm.cc — collectives
+// synthesized into primitive NoC broadcast steps). Exposed through a plain
+// C ABI consumed via ctypes (tilelang_mesh_tpu/layout/native.py), with a
+// pure-Python fallback kept in lockstep by parity tests
+// (tests/test_native.py).
+//
+// Build: make -C src  ->  src/libtltpu.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Affine layout algebra.
+//
+// A layout is an affine map from an n-d logical index to a linear offset:
+//   offset(i) = sum_d strides[d] * i[d]
+// ---------------------------------------------------------------------------
+
+// offset for a single index. Returns -1 on rank mismatch.
+int64_t tl_layout_offset(const int64_t* strides, const int64_t* index,
+                         int32_t rank) {
+  int64_t off = 0;
+  for (int32_t d = 0; d < rank; ++d) off += strides[d] * index[d];
+  return off;
+}
+
+// Row-major strides for a shape.
+void tl_layout_row_major(const int64_t* shape, int32_t rank,
+                         int64_t* strides_out) {
+  int64_t s = 1;
+  for (int32_t d = rank - 1; d >= 0; --d) {
+    strides_out[d] = s;
+    s *= shape[d];
+  }
+}
+
+// Compose: C = A ∘ B, where B maps an index to an offset in A's *logical*
+// row-major space. Both must have matching total sizes for a permutation /
+// reshape composition. Concretely: given layout A over shape_a and a
+// "view" B described by (shape_b, strides_b into A-logical-space), produce
+// strides_c so that offset_C(i) = offset_A(unflatten_a(offset_B(i))).
+// Works for permutation-style views where each B stride lands on an exact
+// A-logical coordinate.
+int32_t tl_layout_compose(const int64_t* shape_a, const int64_t* strides_a,
+                          int32_t rank_a, const int64_t* strides_b,
+                          int32_t rank_b, int64_t* strides_out) {
+  // A-logical row-major strides
+  std::vector<int64_t> rm(rank_a);
+  tl_layout_row_major(shape_a, rank_a, rm.data());
+  for (int32_t d = 0; d < rank_b; ++d) {
+    // decompose b-stride into A logical coords, then re-linearize with
+    // strides_a
+    int64_t rem = strides_b[d];
+    int64_t out = 0;
+    for (int32_t ad = 0; ad < rank_a; ++ad) {
+      int64_t c = rem / rm[ad];
+      rem -= c * rm[ad];
+      out += c * strides_a[ad];
+    }
+    if (rem != 0) return -1;  // not decomposable
+    strides_out[d] = out;
+  }
+  return 0;
+}
+
+// Inverse of a compact permutation layout: the offset space factors as a
+// mixed radix over the dims sorted by descending stride; the inverse maps
+// that factorization back to the logical row-major flat index. The layout
+// is invertible iff sorting dims by stride yields a compact mixed radix
+// (each stride equals the product of all smaller-stride dim sizes).
+// shape_out = sizes in stride-descending order; strides_out[d] = row-major
+// stride of the corresponding original dim. Returns 0 ok, -1 otherwise.
+int32_t tl_layout_inverse(const int64_t* shape, const int64_t* strides,
+                          int32_t rank, int64_t* shape_out,
+                          int64_t* strides_out) {
+  std::vector<int32_t> order(rank);
+  for (int32_t d = 0; d < rank; ++d) order[d] = d;
+  for (int32_t i = 0; i < rank; ++i)  // stable sort desc by stride
+    for (int32_t j = i + 1; j < rank; ++j)
+      if (strides[order[j]] > strides[order[i]]) {
+        int32_t t = order[i];
+        order[i] = order[j];
+        order[j] = t;
+      }
+  int64_t expected = 1;
+  for (int32_t k = rank - 1; k >= 0; --k) {
+    int32_t d = order[k];
+    if (strides[d] != expected) return -1;
+    expected *= shape[d];
+  }
+  std::vector<int64_t> rm(rank);
+  tl_layout_row_major(shape, rank, rm.data());
+  for (int32_t k = 0; k < rank; ++k) {
+    shape_out[k] = shape[order[k]];
+    strides_out[k] = rm[order[k]];
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// TPU (sublane, lane) tiling math — the packing rules Mosaic applies to
+// VMEM tiles; used by the carver/analyzer for true footprint estimates.
+// ---------------------------------------------------------------------------
+
+static int64_t cdiv(int64_t a, int64_t b) { return (a + b - 1) / b; }
+
+// Padded VMEM bytes for a logical (rows, cols) tile of dtype_bits.
+int64_t tl_vmem_bytes(int64_t rows, int64_t cols, int32_t dtype_bits) {
+  int64_t sublane = 8;
+  if (dtype_bits == 16) sublane = 16;
+  if (dtype_bits == 8) sublane = 32;
+  int64_t lane = 128;
+  int64_t padded_rows = cdiv(rows, sublane) * sublane;
+  int64_t padded_cols = cdiv(cols, lane) * lane;
+  return padded_rows * padded_cols * dtype_bits / 8;
+}
+
+// ---------------------------------------------------------------------------
+// Collective schedule synthesis.
+//
+// Mirrors the algorithm structure of the reference's AllgatherOp /
+// AllreduceOp lowering (comm.cc:479-918): everything decomposes into
+// primitive directed broadcasts {src_core, direction, dst_offset_chunks}.
+// On TPU these steps become remote-DMA rounds (or document the XLA
+// collective the SPMD lowering emits); they also drive hop-count cost
+// modeling.
+//
+// A step is 4 ints: {src_row, src_col, direction(0=h,1=v), dst_chunk}.
+// ---------------------------------------------------------------------------
+
+#define DIR_H 0
+#define DIR_V 1
+#define DIR_ALL 2
+
+// Broadcast from (sr, sc) along direction. 2-D ("all") = one vertical
+// broadcast down the source column, then each row's holder broadcasts
+// horizontally (cf. comm.cc:196-216). Returns #steps.
+int32_t tl_broadcast_schedule(int32_t rows, int32_t cols, int32_t sr,
+                              int32_t sc, int32_t dir, int32_t* steps_out) {
+  int32_t n = 0;
+  auto emit = [&](int32_t r, int32_t c, int32_t d, int32_t chunk) {
+    steps_out[n * 4 + 0] = r;
+    steps_out[n * 4 + 1] = c;
+    steps_out[n * 4 + 2] = d;
+    steps_out[n * 4 + 3] = chunk;
+    ++n;
+  };
+  if (dir == DIR_H) {
+    if (cols > 1) emit(sr, sc, DIR_H, 0);
+  } else if (dir == DIR_V) {
+    if (rows > 1) emit(sr, sc, DIR_V, 0);
+  } else {
+    if (rows > 1) emit(sr, sc, DIR_V, 0);
+    for (int32_t r = 0; r < rows; ++r)
+      if (cols > 1) emit(r, sc, DIR_H, 0);
+  }
+  return n;
+}
+
+// All-gather along direction: every participant broadcasts its chunk to its
+// peers; receiver writes it at the sender's rank offset
+// (cf. comm.cc:479-596: "all" = horizontal phase then vertical phase of
+// row-bundles). Returns #steps.
+int32_t tl_allgather_schedule(int32_t rows, int32_t cols, int32_t dir,
+                              int32_t* steps_out) {
+  int32_t n = 0;
+  auto emit = [&](int32_t r, int32_t c, int32_t d, int32_t chunk) {
+    steps_out[n * 4 + 0] = r;
+    steps_out[n * 4 + 1] = c;
+    steps_out[n * 4 + 2] = d;
+    steps_out[n * 4 + 3] = chunk;
+    ++n;
+  };
+  if (dir == DIR_H) {
+    for (int32_t r = 0; r < rows; ++r)
+      for (int32_t c = 0; c < cols; ++c) emit(r, c, DIR_H, c);
+  } else if (dir == DIR_V) {
+    for (int32_t c = 0; c < cols; ++c)
+      for (int32_t r = 0; r < rows; ++r) emit(r, c, DIR_V, r);
+  } else {
+    // phase 1: gather within rows (each core ends with its row bundle)
+    for (int32_t r = 0; r < rows; ++r)
+      for (int32_t c = 0; c < cols; ++c) emit(r, c, DIR_H, c);
+    // phase 2: gather row bundles down columns
+    for (int32_t c = 0; c < cols; ++c)
+      for (int32_t r = 0; r < rows; ++r) emit(r, c, DIR_V, r);
+  }
+  return n;
+}
+
+// All-reduce = local reduce + row allgather + reduce + col allgather +
+// reduce (cf. comm.cc:783-918). Emits the gather steps; reduction points
+// are implicit after each phase. Returns #steps.
+int32_t tl_allreduce_schedule(int32_t rows, int32_t cols, int32_t dir,
+                              int32_t* steps_out) {
+  if (dir == DIR_H) return tl_allgather_schedule(rows, cols, DIR_H,
+                                                 steps_out);
+  if (dir == DIR_V) return tl_allgather_schedule(rows, cols, DIR_V,
+                                                 steps_out);
+  int32_t n = tl_allgather_schedule(rows, cols, DIR_H, steps_out);
+  n += tl_allgather_schedule(rows, cols, DIR_V, steps_out + n * 4);
+  return n;
+}
+
+// Hop-count cost of a schedule on a 2-D torus-less mesh: a horizontal
+// broadcast from column c reaches max(c, cols-1-c) hops, etc. Used by the
+// analyzer's comm cost model.
+int64_t tl_schedule_hops(const int32_t* steps, int32_t n_steps, int32_t rows,
+                         int32_t cols) {
+  int64_t hops = 0;
+  for (int32_t i = 0; i < n_steps; ++i) {
+    int32_t r = steps[i * 4], c = steps[i * 4 + 1], d = steps[i * 4 + 2];
+    if (d == DIR_H) {
+      int32_t right = cols - 1 - c;
+      hops += (c > right ? c : right);
+    } else {
+      int32_t down = rows - 1 - r;
+      hops += (r > down ? r : down);
+    }
+  }
+  return hops;
+}
+
+// ---------------------------------------------------------------------------
+// Blockwise zig-zag ("ZZ") hierarchical layout, the mesh layout the
+// reference builds in hierarchical_layout.cc (make_blockwise_zz_layout):
+// blocks are laid out in row-major over the mesh but odd rows traverse
+// columns in reverse, keeping neighboring blocks on neighboring cores.
+// Returns for each (block_row, block_col) the owning linear core id.
+// ---------------------------------------------------------------------------
+void tl_blockwise_zz_owners(int32_t rows, int32_t cols,
+                            int32_t* owners_out) {
+  for (int32_t r = 0; r < rows; ++r) {
+    for (int32_t c = 0; c < cols; ++c) {
+      int32_t cc = (r % 2 == 0) ? c : (cols - 1 - c);
+      owners_out[r * cols + c] = r * cols + cc;
+    }
+  }
+}
+
+int32_t tl_native_abi_version() { return 1; }
+
+}  // extern "C"

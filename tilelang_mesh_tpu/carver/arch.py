@@ -34,14 +34,24 @@ class TPUArch:
 
     def fits_vmem(self, *buffers: Tuple[Tuple[int, ...], str],
                   budget: float = 0.9) -> bool:
+        return self.buffers_bytes(*buffers) <= budget * self.vmem_bytes
+
+    def buffers_bytes(self, *buffers: Tuple[Tuple[int, ...], str]) -> int:
+        """True padded VMEM footprint using the (sublane, lane) packing
+        rules (native tl_vmem_bytes when built)."""
         from ..ir import dtype_bits
+        from ..layout import python_impl as lpy
+        from ..layout import native as lnat
         total = 0
         for shape, dtype in buffers:
-            n = 1
-            for s in shape:
-                n *= s
-            total += n * dtype_bits(dtype) // 8
-        return total <= budget * self.vmem_bytes
+            bits = dtype_bits(dtype)
+            rows = 1
+            for s in shape[:-1]:
+                rows *= s
+            cols = shape[-1] if shape else 1
+            b = lnat.vmem_bytes(rows, cols, bits)
+            total += b if b is not None else lpy.vmem_bytes(rows, cols, bits)
+        return total
 
 
 TPU_V4 = TPUArch("tpu_v4", vmem_bytes=16 * 2 ** 20, hbm_gbps=1200.0,

@@ -1,0 +1,172 @@
+"""Layout engine: affine layouts, TPU tiling math, mesh block layouts.
+
+Reference: /root/reference/src/layout/ (Layout/Fragment algebra,
+hierarchical_layout.cc) + tilelang/layout/. On TPU the "fragment" concept —
+which thread holds which element — becomes which (sublane, lane) cell holds
+which element; Mosaic owns the physical packing, so this engine serves the
+planner/carver (footprints, composition) and the mesh tier (blockwise-ZZ
+core ownership), backed by the native library when built.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from . import native
+from . import python_impl as py
+
+
+def _dispatch(name, *args):
+    fn = getattr(native, name, None)
+    if fn is not None:
+        r = fn(*args)
+        if r is not None:
+            return r
+    return getattr(py, name)(*args)
+
+
+class Layout:
+    """An affine map from an n-d logical index to a linear offset."""
+
+    def __init__(self, shape: Sequence[int],
+                 strides: Optional[Sequence[int]] = None):
+        self.shape = tuple(int(s) for s in shape)
+        self.strides = tuple(int(s) for s in (
+            strides if strides is not None else py.row_major(self.shape)))
+        if len(self.shape) != len(self.strides):
+            raise ValueError("shape/strides rank mismatch")
+
+    def __call__(self, *index) -> int:
+        if len(index) == 1 and isinstance(index[0], (tuple, list)):
+            index = tuple(index[0])
+        return _dispatch("layout_offset", self.strides, index)
+
+    def compose(self, view: "Layout") -> "Layout":
+        """self ∘ view: view's strides address self's logical row-major
+        space."""
+        strides = _dispatch("layout_compose", self.shape, self.strides,
+                            view.strides)
+        return Layout(view.shape, strides)
+
+    def inverse(self) -> "Layout":
+        shape, strides = _dispatch("layout_inverse", self.shape,
+                                   self.strides)
+        return Layout(shape, strides)
+
+    def is_row_major(self) -> bool:
+        return list(self.strides) == py.row_major(self.shape)
+
+    def __repr__(self):
+        return f"Layout(shape={self.shape}, strides={self.strides})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Layout) and self.shape == other.shape
+                and self.strides == other.strides)
+
+    def __hash__(self):
+        return hash((self.shape, self.strides))
+
+
+class Fragment(Layout):
+    """A layout plus the (sublane, lane) cell assignment of each element —
+    the TPU re-reading of the reference's thread fragment
+    (src/layout/layout.cc Fragment: layout + thread-replication dims)."""
+
+    def __init__(self, shape, strides=None, dtype_bits: int = 32):
+        super().__init__(shape, strides)
+        self.dtype_bits = dtype_bits
+        self.sublane = {16: 16, 8: 32}.get(dtype_bits, 8)
+        self.lane = 128
+
+    def cell(self, *index) -> Tuple[int, int]:
+        """(sublane, lane) cell of an element in the packed tile."""
+        off = self(*index)
+        cols = self.shape[-1] if self.shape else 1
+        r, c = divmod(off, cols)
+        return (r % self.sublane, c % self.lane)
+
+    def vmem_bytes(self) -> int:
+        rows = 1
+        for s in self.shape[:-1]:
+            rows *= s
+        cols = self.shape[-1] if self.shape else 1
+        return _dispatch("vmem_bytes", rows, cols, self.dtype_bits)
+
+
+def make_swizzled_layout(rows: int, cols: int, dtype_bits: int = 16
+                         ) -> Fragment:
+    """Bank-swizzle analog: on TPU Mosaic picks physical tiling, so the
+    canonical packed layout IS the swizzled layout (no smem banks to dodge).
+    Returns the padded row-major fragment."""
+    return Fragment((rows, cols), dtype_bits=dtype_bits)
+
+
+class HierarchicalLayout:
+    """Multi-level dims/strides/groups layout (reference
+    hierarchical_layout.cc): logical dims factor into hierarchical dims;
+    groups map logical dim -> [start, end) range of hierarchical dims."""
+
+    def __init__(self, dims: Sequence[int], strides: Sequence[int],
+                 groups: Sequence[Tuple[int, int]]):
+        self.dims = tuple(int(d) for d in dims)
+        self.strides = tuple(int(s) for s in strides)
+        self.groups = tuple((int(a), int(b)) for a, b in groups)
+
+    def logical_shape(self) -> Tuple[int, ...]:
+        out = []
+        for a, b in self.groups:
+            n = 1
+            for d in range(a, b):
+                n *= self.dims[d]
+            out.append(n)
+        return tuple(out)
+
+    def offset(self, index: Sequence[int]) -> int:
+        off = 0
+        for (a, b), idx in zip(self.groups, index):
+            # split the logical index over hierarchical dims (row-major
+            # within the group)
+            sizes = self.dims[a:b]
+            rem = idx
+            for d in range(b - a):
+                tail = 1
+                for s in sizes[d + 1:]:
+                    tail *= s
+                c = rem // tail
+                rem -= c * tail
+                off += c * self.strides[a + d]
+        return off
+
+    def __repr__(self):
+        return (f"HierarchicalLayout(dims={self.dims}, "
+                f"strides={self.strides}, groups={self.groups})")
+
+
+def make_hierarchical_layout(dims, strides, groups) -> HierarchicalLayout:
+    return HierarchicalLayout(dims, strides, groups)
+
+
+def make_blockwise_zz_layout(nrows: int, ncols: int) -> List[int]:
+    """Mesh blockwise zig-zag block->core ownership (reference
+    make_blockwise_zz_layout): row-major block sweep, odd rows reversed so
+    consecutive blocks sit on ICI-adjacent cores."""
+    return _dispatch("blockwise_zz_owners", nrows, ncols)
+
+
+# -- collective schedules (native-backed) ------------------------------------
+
+
+def broadcast_schedule(rows, cols, src, direction):
+    return _dispatch("broadcast_schedule", rows, cols, src, direction)
+
+
+def allgather_schedule(rows, cols, direction):
+    return _dispatch("allgather_schedule", rows, cols, direction)
+
+
+def allreduce_schedule(rows, cols, direction):
+    return _dispatch("allreduce_schedule", rows, cols, direction)
+
+
+def schedule_hops(steps, rows, cols):
+    return _dispatch("schedule_hops", steps, rows, cols)

@@ -1,0 +1,180 @@
+"""ctypes bindings for the native core (src/tltpu_core.cc).
+
+The library is built lazily with `make -C src` on first use; every entry
+point has a pure-Python fallback (python_impl.py) kept equivalent by
+tests/test_native.py, so the framework works on machines without a
+toolchain (TL_TPU_DISABLE_NATIVE=1 forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from ..env import env
+
+_SRC_DIR = Path(__file__).resolve().parents[2] / "src"
+_LIB_PATH = _SRC_DIR / "libtltpu.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", str(_SRC_DIR)],
+                           capture_output=True, timeout=120)
+        return r.returncode == 0 and _LIB_PATH.exists()
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if env.TL_TPU_DISABLE_NATIVE:
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _LIB_PATH.exists() and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.tl_layout_offset.restype = ctypes.c_int64
+        lib.tl_layout_offset.argtypes = [i64p, i64p, ctypes.c_int32]
+        lib.tl_layout_row_major.argtypes = [i64p, ctypes.c_int32, i64p]
+        lib.tl_layout_compose.restype = ctypes.c_int32
+        lib.tl_layout_compose.argtypes = [i64p, i64p, ctypes.c_int32, i64p,
+                                          ctypes.c_int32, i64p]
+        lib.tl_layout_inverse.restype = ctypes.c_int32
+        lib.tl_layout_inverse.argtypes = [i64p, i64p, ctypes.c_int32, i64p,
+                                          i64p]
+        lib.tl_vmem_bytes.restype = ctypes.c_int64
+        lib.tl_vmem_bytes.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_int32]
+        lib.tl_broadcast_schedule.restype = ctypes.c_int32
+        lib.tl_broadcast_schedule.argtypes = [ctypes.c_int32] * 5 + [i32p]
+        lib.tl_allgather_schedule.restype = ctypes.c_int32
+        lib.tl_allgather_schedule.argtypes = [ctypes.c_int32] * 3 + [i32p]
+        lib.tl_allreduce_schedule.restype = ctypes.c_int32
+        lib.tl_allreduce_schedule.argtypes = [ctypes.c_int32] * 3 + [i32p]
+        lib.tl_schedule_hops.restype = ctypes.c_int64
+        lib.tl_schedule_hops.argtypes = [i32p, ctypes.c_int32,
+                                         ctypes.c_int32, ctypes.c_int32]
+        lib.tl_blockwise_zz_owners.argtypes = [ctypes.c_int32,
+                                               ctypes.c_int32, i32p]
+        lib.tl_native_abi_version.restype = ctypes.c_int32
+        if lib.tl_native_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _arr64(vals: Sequence[int]):
+    return (ctypes.c_int64 * len(vals))(*vals)
+
+
+def _arr32(vals: Sequence[int]):
+    return (ctypes.c_int32 * len(vals))(*vals)
+
+
+# -- wrappers (None when native unavailable) --------------------------------
+
+
+def layout_offset(strides, index) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    return int(lib.tl_layout_offset(_arr64(strides), _arr64(index),
+                                    len(strides)))
+
+
+def layout_compose(shape_a, strides_a, strides_b) -> Optional[List[int]]:
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_int64 * len(strides_b))()
+    rc = lib.tl_layout_compose(_arr64(shape_a), _arr64(strides_a),
+                               len(shape_a), _arr64(strides_b),
+                               len(strides_b), out)
+    if rc != 0:
+        raise ValueError("layout composition not decomposable")
+    return list(out)
+
+
+def layout_inverse(shape, strides) -> Optional[Tuple[List[int], List[int]]]:
+    lib = load()
+    if lib is None:
+        return None
+    so = (ctypes.c_int64 * len(shape))()
+    st = (ctypes.c_int64 * len(shape))()
+    rc = lib.tl_layout_inverse(_arr64(shape), _arr64(strides), len(shape),
+                               so, st)
+    if rc != 0:
+        raise ValueError("layout is not an invertible affine permutation")
+    return list(so), list(st)
+
+
+def vmem_bytes(rows: int, cols: int, dtype_bits: int) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    return int(lib.tl_vmem_bytes(rows, cols, dtype_bits))
+
+
+def broadcast_schedule(rows, cols, src, direction) -> Optional[list]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int32 * (4 * (rows + cols + rows * cols + 4)))()
+    n = lib.tl_broadcast_schedule(rows, cols, src[0], src[1], direction, buf)
+    return [tuple(buf[i * 4:(i + 1) * 4]) for i in range(n)]
+
+
+def allgather_schedule(rows, cols, direction) -> Optional[list]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int32 * (4 * (2 * rows * cols + 4)))()
+    n = lib.tl_allgather_schedule(rows, cols, direction, buf)
+    return [tuple(buf[i * 4:(i + 1) * 4]) for i in range(n)]
+
+
+def allreduce_schedule(rows, cols, direction) -> Optional[list]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int32 * (4 * (2 * rows * cols + 4)))()
+    n = lib.tl_allreduce_schedule(rows, cols, direction, buf)
+    return [tuple(buf[i * 4:(i + 1) * 4]) for i in range(n)]
+
+
+def schedule_hops(steps, rows, cols) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    flat = []
+    for s in steps:
+        flat.extend(s)
+    return int(lib.tl_schedule_hops(_arr32(flat), len(steps), rows, cols))
+
+
+def blockwise_zz_owners(rows, cols) -> Optional[list]:
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_int32 * (rows * cols))()
+    lib.tl_blockwise_zz_owners(rows, cols, out)
+    return list(out)

@@ -1,0 +1,130 @@
+"""Pure-Python reference implementations of the native core.
+
+Kept in algorithmic lockstep with src/tltpu_core.cc; tests/test_native.py
+asserts bit-equality between the two whenever the .so builds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+DIR_H, DIR_V, DIR_ALL = 0, 1, 2
+
+
+def layout_offset(strides: Sequence[int], index: Sequence[int]) -> int:
+    return sum(s * i for s, i in zip(strides, index))
+
+
+def row_major(shape: Sequence[int]) -> List[int]:
+    out = [0] * len(shape)
+    s = 1
+    for d in range(len(shape) - 1, -1, -1):
+        out[d] = s
+        s *= shape[d]
+    return out
+
+
+def layout_compose(shape_a, strides_a, strides_b) -> List[int]:
+    rm = row_major(shape_a)
+    out = []
+    for sb in strides_b:
+        rem, acc = sb, 0
+        for ad in range(len(shape_a)):
+            c = rem // rm[ad]
+            rem -= c * rm[ad]
+            acc += c * strides_a[ad]
+        if rem != 0:
+            raise ValueError("layout composition not decomposable")
+        out.append(acc)
+    return out
+
+
+def layout_inverse(shape, strides) -> Tuple[List[int], List[int]]:
+    """Invert a compact permutation layout: sort dims by descending stride;
+    invertible iff that yields a compact mixed radix. Mirrors
+    tl_layout_inverse in src/tltpu_core.cc."""
+    rank = len(shape)
+    order = sorted(range(rank), key=lambda d: -strides[d])
+    expected = 1
+    for k in range(rank - 1, -1, -1):
+        d = order[k]
+        if strides[d] != expected:
+            raise ValueError("layout is not an invertible affine permutation")
+        expected *= shape[d]
+    rm = row_major(shape)
+    return ([shape[d] for d in order], [rm[d] for d in order])
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def vmem_bytes(rows: int, cols: int, dtype_bits: int) -> int:
+    sublane = {16: 16, 8: 32}.get(dtype_bits, 8)
+    lane = 128
+    return (_cdiv(rows, sublane) * sublane) * (_cdiv(cols, lane) * lane) * \
+        dtype_bits // 8
+
+
+def broadcast_schedule(rows, cols, src, direction) -> list:
+    sr, sc = src
+    steps = []
+    if direction == DIR_H:
+        if cols > 1:
+            steps.append((sr, sc, DIR_H, 0))
+    elif direction == DIR_V:
+        if rows > 1:
+            steps.append((sr, sc, DIR_V, 0))
+    else:
+        if rows > 1:
+            steps.append((sr, sc, DIR_V, 0))
+        for r in range(rows):
+            if cols > 1:
+                steps.append((r, sc, DIR_H, 0))
+    return steps
+
+
+def allgather_schedule(rows, cols, direction) -> list:
+    steps = []
+    if direction == DIR_H:
+        for r in range(rows):
+            for c in range(cols):
+                steps.append((r, c, DIR_H, c))
+    elif direction == DIR_V:
+        for c in range(cols):
+            for r in range(rows):
+                steps.append((r, c, DIR_V, r))
+    else:
+        for r in range(rows):
+            for c in range(cols):
+                steps.append((r, c, DIR_H, c))
+        for c in range(cols):
+            for r in range(rows):
+                steps.append((r, c, DIR_V, r))
+    return steps
+
+
+def allreduce_schedule(rows, cols, direction) -> list:
+    if direction in (DIR_H, DIR_V):
+        return allgather_schedule(rows, cols, direction)
+    return allgather_schedule(rows, cols, DIR_H) + \
+        allgather_schedule(rows, cols, DIR_V)
+
+
+def schedule_hops(steps, rows, cols) -> int:
+    hops = 0
+    for r, c, d, _ in steps:
+        if d == DIR_H:
+            hops += max(c, cols - 1 - c)
+        else:
+            hops += max(r, rows - 1 - r)
+    return hops
+
+
+def blockwise_zz_owners(rows, cols) -> list:
+    out = []
+    for r in range(rows):
+        for c in range(cols):
+            cc = c if r % 2 == 0 else cols - 1 - c
+            out.append(r * cols + cc)
+    return out
