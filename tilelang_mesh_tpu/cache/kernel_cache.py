@@ -20,6 +20,10 @@ from ..env import env
 KERNEL_SOURCE_FILE = "kernel.py"
 ARTIFACT_FILE = "artifact.json"
 
+# Bump whenever codegen output changes for the same IR — generated sources
+# cached under older versions must not be reused.
+CODEGEN_VERSION = 2
+
 
 class KernelCache:
     _instance = None
@@ -42,6 +46,7 @@ class KernelCache:
         h.update(repr(out_idx).encode())
         h.update(json.dumps(pass_cfg, sort_keys=True, default=str).encode())
         h.update(__version__.encode())
+        h.update(str(CODEGEN_VERSION).encode())
         return h.hexdigest()
 
     def get(self, key: str):

@@ -46,6 +46,10 @@ _CALLS = {
     "sigmoid": "jax.nn.sigmoid", "abs": "jnp.abs", "pow": "jnp.power",
     "fmod": "jnp.fmod", "where": "jnp.where",
     "logical_not": "jnp.logical_not",
+    "shift_right": "jnp.right_shift",
+    "shift_left": "jnp.left_shift",
+    "bitwise_and": "jnp.bitwise_and", "bitwise_or": "jnp.bitwise_or",
+    "bitwise_xor": "jnp.bitwise_xor",
 }
 
 

@@ -51,6 +51,8 @@ from .math_ops import (exp, exp2, exp10, log, log2, log10, log1p, sqrt, rsqrt,
                        atan2, erf, floor, ceil, round, trunc, sigmoid, abs,
                        max, min, pow, fmod, max_value, min_value, infinity,
                        if_then_else, Select, clamp, cast, reinterpret,
+                       shift_right, shift_left, bitwise_and, bitwise_or,
+                       bitwise_xor,
                        ceildiv, floordiv, floormod, truncdiv, truncmod,
                        __exp, __exp2, __exp10, __log, __log2, __log10, __sin,
                        __cos, __tan, __pow)

@@ -79,6 +79,31 @@ def abs(x):
     return Call("abs", [x], x.dtype)
 
 
+def shift_right(x, n):
+    x, n = convert(x), convert(n)
+    return Call("shift_right", [x, n], x.dtype)
+
+
+def shift_left(x, n):
+    x, n = convert(x), convert(n)
+    return Call("shift_left", [x, n], x.dtype)
+
+
+def bitwise_and(a, b):
+    a, b = convert(a), convert(b)
+    return Call("bitwise_and", [a, b], promote_dtypes(a.dtype, b.dtype))
+
+
+def bitwise_or(a, b):
+    a, b = convert(a), convert(b)
+    return Call("bitwise_or", [a, b], promote_dtypes(a.dtype, b.dtype))
+
+
+def bitwise_xor(a, b):
+    a, b = convert(a), convert(b)
+    return Call("bitwise_xor", [a, b], promote_dtypes(a.dtype, b.dtype))
+
+
 def max(a, b, *rest):
     from ..ir.expr import _binop
     r = _binop("max", a, b)

@@ -6,3 +6,6 @@ library (SURVEY §2.4): GEMM variants, FlashAttention, normalization, etc.
 
 from .gemm import matmul, matmul_kernel
 from .flash_attention import flash_attention, mha_fwd_kernel
+from .flash_decoding import flash_decode, flash_decode_paged
+from .mla import mla_decode, mla_decode_reference
+from .dequant_gemm import dequant_matmul, dequant_gemm_kernel

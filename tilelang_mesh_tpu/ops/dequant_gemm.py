@@ -1,0 +1,79 @@
+"""w4a16 dequantize GEMM (BASELINE config #3).
+
+Behavioral equivalent of /root/reference/examples/dequantize_gemm/: int4
+weights dequantized in-kernel then fed to the matrix unit. TPU re-design:
+weights use the *planar* pack (quantize/quantization.py
+quantize_int4_planar) so the unpack is two full-tile mask/shift VPU ops and
+both K-halves of A stay contiguous — no LOP3 bit permutations, no strided
+stores. C = A @ dequant(B).
+"""
+
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+@functools.lru_cache(maxsize=None)
+def dequant_gemm_kernel(M, N, K, block_M=128, block_N=128, block_K2=128,
+                        group_size=None, in_dtype="bfloat16",
+                        accum_dtype="float32", num_stages=2):
+    """A (M, 2, K/2) planar-view activations; Bp (K/2, N) packed int4;
+    S (2*(K/2/gs), N) scales; C (M, N).
+
+    group_size defaults to block_K2 (one scale row per K-tile per half).
+    """
+    K2 = K // 2
+    gs = group_size or block_K2
+    assert gs == block_K2, \
+        "group_size must equal block_K2 (one scale row per tile)"
+    assert K2 % block_K2 == 0
+    G2 = K2 // gs  # groups per half
+
+    @T.prim_func
+    def main(A: T.Tensor((M, 2, K2), in_dtype),
+             Bp: T.Tensor((K2, N), "uint8"),
+             S: T.Tensor((2, G2, N), "float32"),
+             C: T.Tensor((M, N), in_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_s = T.alloc_shared((block_M, 2, block_K2), in_dtype)
+            Bp_s = T.alloc_shared((block_K2, block_N), "uint8")
+            S_s = T.alloc_shared((2, 1, block_N), "float32")
+            B_lo = T.alloc_fragment((block_K2, block_N), in_dtype)
+            B_hi = T.alloc_fragment((block_K2, block_N), in_dtype)
+            C_l = T.alloc_fragment((block_M, block_N), accum_dtype)
+            T.clear(C_l)
+            for ko in T.Pipelined(K2 // block_K2, num_stages=num_stages):
+                T.copy(A[by * block_M, 0, ko * block_K2], A_s)
+                T.copy(Bp[ko * block_K2, bx * block_N], Bp_s)
+                # both halves' scale rows for this K-tile in one block copy
+                T.copy(S[0, ko, bx * block_N], S_s)
+                for i, j in T.Parallel(block_K2, block_N):
+                    B_lo[i, j] = T.cast(
+                        T.cast(T.bitwise_and(Bp_s[i, j], 0xF), "float32")
+                        - 8.0, "float32") * S_s[0, 0, j]
+                for i, j in T.Parallel(block_K2, block_N):
+                    B_hi[i, j] = T.cast(
+                        T.cast(T.shift_right(Bp_s[i, j], 4), "float32")
+                        - 8.0, "float32") * S_s[1, 0, j]
+                T.gemm(A_s[:, 0, :], B_lo, C_l)
+                T.gemm(A_s[:, 1, :], B_hi, C_l)
+            T.copy(C_l, C[by * block_M, bx * block_N])
+
+    return _tl_compile(main)
+
+
+def dequant_matmul(a, packed, scales, group_size=None, block_M=128,
+                   block_N=128, block_K2=128):
+    """a (M, K) float; packed (K/2, N) uint8 planar; scales (2G, N)."""
+    M, K = a.shape
+    K2, N = packed.shape
+    assert K == 2 * K2
+    bk2 = min(block_K2, K2)
+    k = dequant_gemm_kernel(M, N, K, block_M, block_N, bk2,
+                            group_size=min(group_size or bk2, K2),
+                            in_dtype=str(a.dtype))
+    G2 = K2 // bk2
+    return k(a.reshape(M, 2, K2), packed, scales.reshape(2, G2, N))

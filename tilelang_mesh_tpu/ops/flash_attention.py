@@ -11,7 +11,6 @@ Backward: jax AD over a rematerialized reference attention (checkpointed);
 a dedicated Pallas backward kernel is the planned follow-up.
 """
 
-from __future__ import annotations
 
 import functools
 import math

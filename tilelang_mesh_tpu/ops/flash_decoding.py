@@ -1,0 +1,121 @@
+"""Flash decoding: single-token attention against a long KV cache with
+split-KV parallel reduction (BASELINE config #4).
+
+Behavioral equivalent of /root/reference/examples/flash_decoding/: the KV
+cache is split into chunks processed in parallel grid steps; each split
+emits an unnormalized partial (o, m, l) and a tiny XLA epilogue combines
+them — the split axis is a *parallel* Pallas grid dimension, so Mosaic
+overlaps chunk DMA freely. Paged KV: pages are gathered to contiguous form
+at the XLA level (jnp.take) before the kernel; in-kernel page walking via
+scalar prefetch is the planned follow-up.
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def decode_kernel(B, H, S, D, n_split, block_N, sm_scale, dtype,
+                  num_stages=2):
+    chunk = S // n_split
+    scale = sm_scale * _LOG2E
+
+    @T.prim_func
+    def dec(Q: T.Tensor((B, H, 1, D), dtype),
+            K: T.Tensor((B, H, S, D), dtype),
+            V: T.Tensor((B, H, S, D), dtype),
+            Op: T.Tensor((B, H, n_split, D), "float32"),
+            Mp: T.Tensor((B, H, n_split), "float32"),
+            Lp: T.Tensor((B, H, n_split), "float32")):
+        with T.Kernel(n_split, H, B) as (bs, by, bz):
+            Q_s = T.alloc_shared((1, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            S_f = T.alloc_fragment((1, block_N), "float32")
+            P_f = T.alloc_fragment((1, block_N), dtype)
+            acc = T.alloc_fragment((1, D), "float32")
+            m_prev = T.alloc_fragment((1,), "float32")
+            m_new = T.alloc_fragment((1,), "float32")
+            m_cur = T.alloc_fragment((1,), "float32")
+            l = T.alloc_fragment((1,), "float32")
+            l_cur = T.alloc_fragment((1,), "float32")
+
+            T.copy(Q[bz, by, 0, 0], Q_s)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for kb in T.Pipelined(T.ceildiv(chunk, block_N),
+                                  num_stages=num_stages):
+                T.copy(K[bz, by, bs * chunk + kb * block_N, 0], K_s)
+                T.copy(V[bz, by, bs * chunk + kb * block_N, 0], V_s)
+                T.gemm(Q_s, K_s, S_f, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(1, block_N):
+                    S_f[i, j] = S_f[i, j] * scale
+                T.reduce_max(S_f, m_cur, dim=1)
+                for i in T.Parallel(1):
+                    m_new[i] = T.max(m_prev[i], m_cur[i])
+                for i, j in T.Parallel(1, block_N):
+                    S_f[i, j] = T.exp2(S_f[i, j] - m_new[i])
+                T.reduce_sum(S_f, l_cur, dim=1)
+                for i in T.Parallel(1):
+                    l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                for i, j in T.Parallel(1, D):
+                    acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                T.copy(S_f, P_f)
+                T.gemm(P_f, V_s, acc)
+                for i in T.Parallel(1):
+                    m_prev[i] = m_new[i]
+
+            T.copy(acc, Op[bz, by, bs, 0])
+            T.copy(m_prev, Mp[bz, by, bs])
+            T.copy(l, Lp[bz, by, bs])
+
+    return _tl_compile(dec)
+
+
+def flash_decode(q, k, v, sm_scale=None, n_split=None, block_N=128):
+    """q (B, H, 1, D); k/v (B, H, S, D) -> (B, H, 1, D)."""
+    import jax.numpy as jnp
+
+    B, H, _, D = q.shape
+    S = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    if n_split is None:
+        n_split = max(1, min(8, S // max(block_N, 1)))
+    while S % n_split or (S // n_split) % min(block_N, S // n_split):
+        n_split -= 1
+    block_N = min(block_N, S // n_split)
+
+    kern = decode_kernel(B, H, S, D, n_split, block_N, float(sm_scale),
+                         str(q.dtype))
+    op, mp, lp = kern(q, k, v)
+    # combine splits (all in the exp2 domain used by the kernel)
+    m_max = jnp.max(mp, axis=-1, keepdims=True)             # (B,H,1)
+    alpha = jnp.exp2(mp - m_max)                            # (B,H,ns)
+    l_tot = jnp.sum(lp * alpha, -1)[..., None]              # (B,H,1)
+    o = jnp.sum(op * alpha[..., None], axis=2)              # (B,H,D)
+    return (o / l_tot)[:, :, None, :].astype(q.dtype)
+
+
+def flash_decode_paged(q, kv_pages, v_pages, page_table, sm_scale=None,
+                       block_N=128):
+    """Paged KV decode: pages (n_pages, page_size, H, D) + page_table
+    (B, pages_per_seq) gathered to contiguous KV at the XLA level, then the
+    split-KV kernel (cf. reference example_mla_decode_paged.py behavior)."""
+    import jax.numpy as jnp
+
+    B = page_table.shape[0]
+    n_pages, page_size, H, D = kv_pages.shape
+    k = jnp.take(kv_pages, page_table, axis=0)   # (B, pp, ps, H, D)
+    v = jnp.take(v_pages, page_table, axis=0)
+    S = page_table.shape[1] * page_size
+    k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    return flash_decode(q, k, v, sm_scale=sm_scale, block_N=block_N)

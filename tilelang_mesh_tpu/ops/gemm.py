@@ -5,7 +5,6 @@ The analog of the reference's benchmark/matmul kernels
 callables with carver-driven tile selection.
 """
 
-from __future__ import annotations
 
 import functools
 from typing import Optional

@@ -1,0 +1,126 @@
+"""DeepSeek MLA (multi-head latent attention) decode kernel
+(BASELINE config #4).
+
+Behavioral equivalent of /root/reference/examples/deepseek_mla/: queries are
+absorbed into the latent space, so all heads attend over one shared latent
+KV cache ``ckv (B, S, dc)`` plus a small rope channel ``kpe (B, S, dr)``.
+TPU design: heads ride the *sublane* axis of one score tile (H, block_N) —
+one MXU gemm per chunk for the latent part and one for rope — with split-KV
+parallel reduction like flash decoding.
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def mla_decode_kernel(B, H, S, dc, dr, n_split, block_N, sm_scale, dtype,
+                      num_stages=2):
+    chunk = S // n_split
+    scale = sm_scale * _LOG2E
+
+    @T.prim_func
+    def mla(Qc: T.Tensor((B, H, dc), dtype),
+            Qr: T.Tensor((B, H, dr), dtype),
+            CKV: T.Tensor((B, S, dc), dtype),
+            KPE: T.Tensor((B, S, dr), dtype),
+            Op: T.Tensor((B, n_split, H, dc), "float32"),
+            Mp: T.Tensor((B, n_split, H), "float32"),
+            Lp: T.Tensor((B, n_split, H), "float32")):
+        with T.Kernel(n_split, B) as (bs, bz):
+            Qc_s = T.alloc_shared((H, dc), dtype)
+            Qr_s = T.alloc_shared((H, dr), dtype)
+            C_s = T.alloc_shared((block_N, dc), dtype)
+            R_s = T.alloc_shared((block_N, dr), dtype)
+            S_f = T.alloc_fragment((H, block_N), "float32")
+            P_f = T.alloc_fragment((H, block_N), dtype)
+            acc = T.alloc_fragment((H, dc), "float32")
+            m_prev = T.alloc_fragment((H,), "float32")
+            m_new = T.alloc_fragment((H,), "float32")
+            m_cur = T.alloc_fragment((H,), "float32")
+            l = T.alloc_fragment((H,), "float32")
+            l_cur = T.alloc_fragment((H,), "float32")
+
+            T.copy(Qc[bz, 0, 0], Qc_s)
+            T.copy(Qr[bz, 0, 0], Qr_s)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for kb in T.Pipelined(T.ceildiv(chunk, block_N),
+                                  num_stages=num_stages):
+                T.copy(CKV[bz, bs * chunk + kb * block_N, 0], C_s)
+                T.copy(KPE[bz, bs * chunk + kb * block_N, 0], R_s)
+                # scores: latent + rope parts, both on the MXU
+                T.gemm(Qc_s, C_s, S_f, transpose_B=True, clear_accum=True)
+                T.gemm(Qr_s, R_s, S_f, transpose_B=True)
+                for i, j in T.Parallel(H, block_N):
+                    S_f[i, j] = S_f[i, j] * scale
+                T.reduce_max(S_f, m_cur, dim=1)
+                for i in T.Parallel(H):
+                    m_new[i] = T.max(m_prev[i], m_cur[i])
+                for i, j in T.Parallel(H, block_N):
+                    S_f[i, j] = T.exp2(S_f[i, j] - m_new[i])
+                T.reduce_sum(S_f, l_cur, dim=1)
+                for i in T.Parallel(H):
+                    l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                for i, j in T.Parallel(H, dc):
+                    acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                T.copy(S_f, P_f)
+                T.gemm(P_f, C_s, acc)
+                for i in T.Parallel(H):
+                    m_prev[i] = m_new[i]
+
+            T.copy(acc, Op[bz, bs, 0, 0])
+            T.copy(m_prev, Mp[bz, bs, 0])
+            T.copy(l, Lp[bz, bs, 0])
+
+    return _tl_compile(mla)
+
+
+def mla_decode(q_latent, q_rope, ckv, kpe, sm_scale=None, n_split=None,
+               block_N=128):
+    """q_latent (B, H, dc); q_rope (B, H, dr); ckv (B, S, dc);
+    kpe (B, S, dr) -> attention output in latent space (B, H, dc)."""
+    import jax.numpy as jnp
+
+    B, H, dc = q_latent.shape
+    dr = q_rope.shape[-1]
+    S = ckv.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(dc + dr)
+    if n_split is None:
+        n_split = max(1, min(8, S // max(block_N, 1)))
+    while S % n_split or (S // n_split) % min(block_N, S // n_split):
+        n_split -= 1
+    block_N = min(block_N, S // n_split)
+
+    kern = mla_decode_kernel(B, H, S, dc, dr, n_split, block_N,
+                             float(sm_scale), str(q_latent.dtype))
+    op, mp, lp = kern(q_latent, q_rope, ckv, kpe)
+    m_max = jnp.max(mp, axis=1, keepdims=True)            # (B,1,H)
+    alpha = jnp.exp2(mp - m_max)                          # (B,ns,H)
+    l_tot = jnp.sum(lp * alpha, axis=1)                   # (B,H)
+    o = jnp.sum(op * alpha[..., None], axis=1)            # (B,H,dc)
+    return (o / l_tot[..., None]).astype(q_latent.dtype)
+
+
+def mla_decode_reference(q_latent, q_rope, ckv, kpe, sm_scale=None):
+    import jax
+    import jax.numpy as jnp
+    B, H, dc = q_latent.shape
+    dr = q_rope.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(dc + dr)
+    s = (jnp.einsum("bhc,bsc->bhs", q_latent.astype(jnp.float32),
+                    ckv.astype(jnp.float32)) +
+         jnp.einsum("bhr,bsr->bhs", q_rope.astype(jnp.float32),
+                    kpe.astype(jnp.float32))) * sm_scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bsc->bhc", p,
+                      ckv.astype(jnp.float32)).astype(q_latent.dtype)

@@ -1,0 +1,3 @@
+from .quantization import (pack_int4, unpack_int4_ref, quantize_int4_groups,
+                           dequantize_int4_ref, quantize_int4_planar,
+                           dequantize_int4_planar_ref)

@@ -1,0 +1,91 @@
+"""Weight quantization helpers (host side).
+
+Reference: /root/reference/tilelang/quantize/ (lop3/mxfp dequant
+permutations). The GPU build permutes bits for LOP3 instructions; on TPU the
+VPU unpacks with plain shifts/masks, so the host side is a straight pack and
+the in-kernel unpack lives in ops/dequant_gemm.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def quantize_int4_groups(w: np.ndarray, group_size: int = 128):
+    """Symmetric per-group int4 quantization along axis 0 (the K axis).
+
+    Returns (packed uint8 (K//2, N), scales float32 (K//group_size, N)).
+    """
+    K, N = w.shape
+    assert K % group_size == 0
+    wg = w.reshape(K // group_size, group_size, N)
+    scales = np.abs(wg).max(axis=1) / 7.0 + 1e-8            # (G, N)
+    q = np.clip(np.round(wg / scales[:, None, :]), -8, 7)   # (G, gs, N)
+    q = q.reshape(K, N).astype(np.int8)
+    packed = pack_int4(q)
+    return packed, scales.astype(np.float32)
+
+
+def quantize_int4_planar(w: np.ndarray, group_size: int = 128):
+    """Planar int4 pack for the TPU dequant-GEMM kernel
+    (ops/dequant_gemm.py): byte (r, n) holds original rows r (lo nibble)
+    and r + K/2 (hi nibble), so the in-kernel unpack is two full-tile
+    mask/shift VPU ops with contiguous A halves — the TPU re-design of the
+    reference's LOP3 bit-permutation trick (tilelang/quantize/lop3.py).
+
+    Returns (packed uint8 (K/2, N), scales float32 (K//group_size, N))
+    with scale groups laid out [lo-half groups..., hi-half groups...].
+    """
+    K, N = w.shape
+    assert K % 2 == 0 and (K // 2) % group_size == 0, \
+        "need K/2 divisible by group_size"
+    K2 = K // 2
+    halves = np.stack([w[:K2], w[K2:]])           # (2, K2, N)
+    g = K2 // group_size
+    wg = halves.reshape(2, g, group_size, N)
+    scales = np.abs(wg).max(axis=2) / 7.0 + 1e-8  # (2, g, N)
+    q = np.clip(np.round(wg / scales[:, :, None, :]), -8, 7)
+    q = q.reshape(2, K2, N).astype(np.int8)
+    u = (q.astype(np.int16) + 8).astype(np.uint8)
+    packed = (u[0] | (u[1] << 4)).astype(np.uint8)  # (K2, N)
+    return packed, scales.reshape(2 * g, N).astype(np.float32)
+
+
+def dequantize_int4_planar_ref(packed: np.ndarray, scales: np.ndarray,
+                               group_size: int = 128) -> np.ndarray:
+    K2, N = packed.shape
+    g = K2 // group_size
+    lo = (packed & 0xF).astype(np.float32) - 8
+    hi = ((packed >> 4) & 0xF).astype(np.float32) - 8
+    s = scales.reshape(2, g, N)
+    lo = (lo.reshape(g, group_size, N) * s[0][:, None, :]).reshape(K2, N)
+    hi = (hi.reshape(g, group_size, N) * s[1][:, None, :]).reshape(K2, N)
+    return np.concatenate([lo, hi], axis=0)
+
+
+def pack_int4(q: np.ndarray) -> np.ndarray:
+    """Pack int8 values in [-8, 7] along axis 0, two per byte:
+    byte = (q[2i+1]+8) << 4 | (q[2i]+8)."""
+    K, N = q.shape
+    assert K % 2 == 0
+    u = (q.astype(np.int16) + 8).astype(np.uint8)
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+
+
+def unpack_int4_ref(packed: np.ndarray) -> np.ndarray:
+    """Reference unpack (numpy): inverse of pack_int4."""
+    lo = (packed & 0xF).astype(np.int16) - 8
+    hi = ((packed >> 4) & 0xF).astype(np.int16) - 8
+    K2, N = packed.shape
+    out = np.empty((K2 * 2, N), np.int16)
+    out[0::2] = lo
+    out[1::2] = hi
+    return out.astype(np.int8)
+
+
+def dequantize_int4_ref(packed: np.ndarray, scales: np.ndarray,
+                        group_size: int = 128) -> np.ndarray:
+    q = unpack_int4_ref(packed).astype(np.float32)
+    K, N = q.shape
+    return (q.reshape(K // group_size, group_size, N) *
+            scales[:, None, :]).reshape(K, N)
