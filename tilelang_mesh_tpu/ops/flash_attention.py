@@ -22,8 +22,11 @@ from ..jit import compile as _tl_compile
 
 @functools.lru_cache(maxsize=None)
 def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
-                    dtype, num_stages):
+                    dtype, num_stages, return_partials=False):
     scale = sm_scale * 1.44269504  # use exp2: exp(x*s) = exp2(x*s*log2e)
+    if return_partials:
+        return _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N,
+                                       causal, scale, dtype, num_stages)
 
     @T.prim_func
     def mha_fwd(Q: T.Tensor((B, H, Sq, D), dtype),
@@ -94,6 +97,87 @@ class _always:
 
     def __exit__(self, *a):
         return False
+
+
+def _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N, causal,
+                            scale, dtype, num_stages):
+    """Same online-softmax loop but emits the UNNORMALIZED accumulator plus
+    per-row (m, l) stats in the exp2 domain — the mergeable form ring
+    attention and other sequence-parallel consumers need."""
+
+    @T.prim_func
+    def mha_fwd_partial(Q: T.Tensor((B, H, Sq, D), dtype),
+                        K: T.Tensor((B, H, Sk, D), dtype),
+                        V: T.Tensor((B, H, Sk, D), dtype),
+                        O: T.Tensor((B, H, Sq, D), "float32"),
+                        M: T.Tensor((B, H, Sq), "float32"),
+                        L: T.Tensor((B, H, Sq), "float32")):
+        with T.Kernel(T.ceildiv(Sq, block_M), H, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            P = T.alloc_fragment((block_M, block_N), dtype)
+            acc = T.alloc_fragment((block_M, D), "float32")
+            m_prev = T.alloc_fragment((block_M,), "float32")
+            m_new = T.alloc_fragment((block_M,), "float32")
+            m_cur = T.alloc_fragment((block_M,), "float32")
+            l = T.alloc_fragment((block_M,), "float32")
+            l_cur = T.alloc_fragment((block_M,), "float32")
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                with T.If(kb * block_N <= bx * block_M + (block_M - 1)) \
+                        if causal else _always():
+                    T.copy(K[bz, by, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by, kb * block_N, 0], V_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    if causal:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                bx * block_M + i >= kb * block_N + j,
+                                S[i, j] * scale,
+                                -T.infinity("float32"))
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = S[i, j] * scale
+                    T.reduce_max(S, m_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        m_new[i] = T.max(m_prev[i], m_cur[i])
+                    for i, j in T.Parallel(block_M, block_N):
+                        S[i, j] = T.exp2(S[i, j] - m_new[i])
+                    T.reduce_sum(S, l_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                    for i, j in T.Parallel(block_M, D):
+                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                    T.copy(S, P)
+                    T.gemm(P, V_s, acc)
+                    for i in T.Parallel(block_M):
+                        m_prev[i] = m_new[i]
+
+            T.copy(acc, O[bz, by, bx * block_M, 0])
+            T.copy(m_prev, M[bz, by, bx * block_M])
+            T.copy(l, L[bz, by, bx * block_M])
+
+    return _tl_compile(mha_fwd_partial)
+
+
+def flash_attention_partial(q, k, v, causal, sm_scale, block_M=128,
+                            block_N=128, num_stages=2):
+    """Unnormalized blockwise attention: returns (acc_f32, m, l) in the
+    exp2 domain for cross-shard merging."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    kern = _mha_fwd_kernel(B, H, Sq, Sk, D, min(block_M, Sq),
+                           min(block_N, Sk), bool(causal), float(sm_scale),
+                           str(q.dtype), num_stages, return_partials=True)
+    return kern(q, k, v)
 
 
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):

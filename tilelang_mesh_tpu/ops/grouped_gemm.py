@@ -1,0 +1,48 @@
+"""Grouped (batched-expert) GEMM: out[e] = X[e] @ W[e].
+
+Behavioral equivalent of /root/reference/examples/grouped_gemm/ and the
+compute core of fusedmoe. TPU design: the expert index is an extra parallel
+Pallas grid dimension — every expert's tiles ride the same pipelined K loop,
+so Mosaic interleaves DMA across experts instead of launching per-expert
+kernels.
+"""
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+@functools.lru_cache(maxsize=None)
+def grouped_gemm_kernel(E, M, N, K, block_M=128, block_N=128, block_K=128,
+                        in_dtype="bfloat16", accum_dtype="float32",
+                        out_dtype=None, num_stages=2):
+    out_dtype = out_dtype or in_dtype
+
+    @T.prim_func
+    def ggemm(X: T.Tensor((E, M, K), in_dtype),
+              W: T.Tensor((E, K, N), in_dtype),
+              O: T.Tensor((E, M, N), out_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M), E) \
+                as (bx, by, be):
+            X_s = T.alloc_shared((block_M, block_K), in_dtype)
+            W_s = T.alloc_shared((block_K, block_N), in_dtype)
+            O_l = T.alloc_fragment((block_M, block_N), accum_dtype)
+            T.clear(O_l)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                T.copy(X[be, by * block_M, ko * block_K], X_s)
+                T.copy(W[be, ko * block_K, bx * block_N], W_s)
+                T.gemm(X_s, W_s, O_l)
+            T.copy(O_l, O[be, by * block_M, bx * block_N])
+
+    return _tl_compile(ggemm)
+
+
+def grouped_matmul(x, w, block_M=128, block_N=128, block_K=128):
+    """x (E, M, K) @ w (E, K, N) -> (E, M, N)."""
+    E, M, K = x.shape
+    N = w.shape[-1]
+    k = grouped_gemm_kernel(E, M, N, K, min(block_M, M), min(block_N, N),
+                            min(block_K, K), in_dtype=str(x.dtype))
+    return k(x, w)

@@ -1,0 +1,80 @@
+"""Ring attention: sequence-parallel causal attention over an ICI ring.
+
+The reference has no sequence parallelism (SURVEY §5.7); its mesh
+collectives are the building blocks that make it expressible. This module is
+the composed result on TPU: Q/K/V are sharded over a 1-D mesh axis; each
+step runs the framework's *partial* flash kernel (unnormalized acc + exp2
+(m, l) stats) on the local Q against the currently-held KV shard, then the
+KV shard rotates one hop via ``lax.ppermute`` — XLA overlaps the permute
+with the next step's compute. Causality across shards: the diagonal step
+uses the causal kernel, lower-triangle source shards use the full kernel,
+upper-triangle contributions are masked to (-inf, 0).
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _merge(state, part, include):
+    """Merge a new partial (o, m, l) into the running state, gated by
+    `include` (traced bool)."""
+    o, m, l = state
+    oi, mi, li = part
+    neg_inf = jnp.float32(-jnp.inf)
+    mi = jnp.where(include, mi, neg_inf)
+    m_new = jnp.maximum(m, mi)
+    alpha = jnp.exp2(m - m_new)
+    beta = jnp.where(include, jnp.exp2(mi - m_new), 0.0)
+    o_new = o * alpha[..., None] + oi * beta[..., None]
+    l_new = l * alpha + li * beta
+    return (o_new, m_new, l_new)
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                         sm_scale: Optional[float] = None,
+                         block_M: int = 128, block_N: int = 128):
+    """Per-shard ring attention; call inside shard_map. q/k/v are the local
+    sequence shards (B, H, S_local, D); returns the local output shard."""
+    from ..ops.flash_attention import flash_attention_partial
+
+    B, H, S, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    P = jax.lax.axis_size(axis_name)
+    r = jax.lax.axis_index(axis_name)
+
+    # step 0: the diagonal block (always included; causal within the shard)
+    o, m, l = flash_attention_partial(q, k, v, causal, sm_scale,
+                                      block_M, block_N)
+    kv = (k, v)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    for s in range(1, P):
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        src = (r - s) % P
+        part = flash_attention_partial(q, kv[0], kv[1], False, sm_scale,
+                                       block_M, block_N)
+        include = (src < r) | jnp.asarray(not causal)
+        o, m, l = _merge((o, m, l), part, include)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        block_M: int = 128, block_N: int = 128):
+    """Jitted global-view ring attention over `mesh[axis_name]`:
+    fn(q, k, v) with global (B, H, S, D) arrays sequence-sharded on S."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+
+    def local(q, k, v):
+        return ring_attention_local(q, k, v, axis_name, causal, sm_scale,
+                                    block_M, block_N)
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    return jax.jit(f)
