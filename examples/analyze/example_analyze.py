@@ -1,0 +1,32 @@
+"""Static perf analysis of a kernel (reference examples/analyze:
+tilelang/tools/Analyzer)."""
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.tools import Analyzer
+from tilelang_mesh_tpu.carver import TPU_V5E, TPU_V5P
+
+
+def main(M=4096, N=4096, K=4096):
+    @T.prim_func
+    def gemm(A: T.Tensor((M, K), "bfloat16"),
+             B: T.Tensor((K, N), "bfloat16"),
+             C: T.Tensor((M, N), "bfloat16")):
+        with T.Kernel(T.ceildiv(N, 256), T.ceildiv(M, 256)) as (bx, by):
+            A_s = T.alloc_shared((256, 512), "bfloat16")
+            B_s = T.alloc_shared((512, 256), "bfloat16")
+            C_l = T.alloc_fragment((256, 256), "float32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, 512), num_stages=2):
+                T.copy(A[by * 256, ko * 512], A_s)
+                T.copy(B[ko * 512, bx * 256], B_s)
+                T.gemm(A_s, B_s, C_l)
+            T.copy(C_l, C[by * 256, bx * 256])
+
+    for arch in (TPU_V5E, TPU_V5P):
+        r = Analyzer.analysis(gemm, arch)
+        print(f"{arch.name}: {r}")
+
+
+if __name__ == "__main__":
+    main()

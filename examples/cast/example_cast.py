@@ -1,0 +1,32 @@
+"""Dtype cast kernel (reference examples/cast)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def cast_kernel(M, N, bm, src_dtype, dst_dtype):
+    @T.prim_func
+    def cast(A: T.Tensor((M, N), src_dtype),
+             B: T.Tensor((M, N), dst_dtype)):
+        with T.Kernel(T.ceildiv(M, bm)) as bx:
+            s = T.alloc_shared((bm, N), src_dtype)
+            T.copy(A[bx * bm, 0], s)
+            T.copy(s, B[bx * bm, 0])
+    return tilelang.compile(cast)
+
+
+def main(M=512, N=256):
+    k = cast_kernel(M, N, 128, "float32", "bfloat16")
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((M, N), dtype=np.float32)
+    out = np.asarray(k(a), np.float32)
+    ref = np.asarray(jnp.asarray(a, jnp.bfloat16), np.float32)
+    np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
+    print("cast f32 -> bf16 correct.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Direct elementwise kernel over the grid (reference examples/elementwise)."""
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def add_kernel(M, N, bm, bn, dtype="float32"):
+    @T.prim_func
+    def add(A: T.Tensor((M, N), dtype),
+            B: T.Tensor((M, N), dtype),
+            C: T.Tensor((M, N), dtype)):
+        with T.Kernel(T.ceildiv(N, bn), T.ceildiv(M, bm)) as (bx, by):
+            for i, j in T.Parallel(bm, bn):
+                C[by * bm + i, bx * bn + j] = \
+                    A[by * bm + i, bx * bn + j] + B[by * bm + i, bx * bn + j]
+    return tilelang.compile(add)
+
+
+def main(M=512, N=512):
+    k = add_kernel(M, N, 128, 128)
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((M, N), dtype=np.float32)
+    b = rng.standard_normal((M, N), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(k(a, b)), a + b, rtol=1e-6,
+                               atol=1e-6)
+    print("elementwise add correct.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Expert-parallel fused MoE over a device mesh with ICI all-to-all
+(reference examples/fusedmoe; BASELINE config #5)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.parallel.moe import make_moe_layer, moe_reference
+
+
+def main(T=512, d=128, f=256, E=8, top_k=2):
+    n = min(len(jax.devices()), E)
+    while E % n:
+        n -= 1
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:n]), ("ep",))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((T, d)) * 0.5, jnp.float32)
+    wr = jnp.asarray(rng.standard_normal((d, E)) * 0.5, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, d, f)) * 0.2, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((E, f, d)) * 0.2, jnp.float32)
+    layer = make_moe_layer(mesh, "ep", top_k=top_k, capacity_factor=8.0)
+    out = layer(x, wr, w1, w2)
+    ref = moe_reference(x, wr, w1, w2, top_k)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-2,
+                               atol=3e-1)
+    print(f"fused MoE over {n}-device ep mesh matches dense reference.")
+
+
+if __name__ == "__main__":
+    main()

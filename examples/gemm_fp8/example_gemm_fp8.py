@@ -1,0 +1,22 @@
+"""fp8 (e4m3) GEMM (reference benchmark/matmul_fp8)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+
+def main(M=512, N=512, K=512):
+    k = matmul_kernel(M, N, K, 128, 128, 128, in_dtype="float8_e4m3fn",
+                      out_dtype="float32")
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.3, jnp.float8_e4m3fn)
+    b = jnp.asarray(rng.standard_normal((K, N)) * 0.3, jnp.float8_e4m3fn)
+    out = k(a, b)
+    ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=5e-2, atol=5e-1)
+    print("fp8 GEMM matches fp32 reference of fp8-rounded inputs.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Sequence-parallel ring attention over an ICI ring (composition of the
+mesh collectives + partial flash kernel; SURVEY §5.7 flagship demo)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.flash_attention import _reference_attention
+from tilelang_mesh_tpu.parallel.ring_attention import make_ring_attention
+
+
+def main(B=1, H=2, S=1024, D=64):
+    n = 4 if len(jax.devices()) >= 4 else 1
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:n]), ("sp",))
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    fn = make_ring_attention(mesh, "sp", causal=True)
+    out = fn(q, k, v)
+    ref = _reference_attention(q, k, v, True, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=2e-2)
+    print(f"ring attention over {n} devices matches full causal attention "
+          f"(seq {S} split into {S // n}-token shards).")
+
+
+if __name__ == "__main__":
+    main()

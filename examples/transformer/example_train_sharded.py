@@ -1,0 +1,34 @@
+"""Train the flagship transformer with the megatron-style dp x tp sharded
+step (the model tier built on the kernel library)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.models import (ModelConfig, init_params,
+                                      make_sharded_train_step)
+
+
+def main(steps=3):
+    n = len(jax.devices())
+    tp = 2 if n % 2 == 0 and n >= 2 else 1
+    dp = n // tp
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    cfg = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                      d_ff=128, max_seq=64, use_flash=on_tpu)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    init, make = make_sharded_train_step(cfg, mesh, lr=1e-2)
+    opt_state = init(params)
+    step = make(params, opt_state)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (dp * 2, cfg.max_seq + 1)),
+                         jnp.int32)
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        print(f"step {i}: loss {float(loss):.4f}  (mesh dp={dp} tp={tp})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Run every example end-to-end (the reference's test_example_* pattern:
+examples double as integration tests, SURVEY §2.4)."""
+
+import pathlib
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES_DIR = pathlib.Path(__file__).resolve().parents[1] / "examples"
+
+_EXAMPLES = sorted(p for p in EXAMPLES_DIR.rglob("example_*.py"))
+_SCRIPTS = [EXAMPLES_DIR / "quickstart.py"] + _EXAMPLES
+
+
+@pytest.mark.parametrize("script", _SCRIPTS,
+                         ids=[str(p.relative_to(EXAMPLES_DIR))
+                              for p in _SCRIPTS])
+def test_example(script):
+    mod = runpy.run_path(str(script))
+    assert "main" in mod, f"{script} must define main()"
+    mod["main"]()

@@ -122,8 +122,21 @@ class Region:
                  shape: Sequence[Any]):
         self.buffer = buffer
         self.base = tuple(convert(b) for b in base)
-        self.shape = tuple(as_int(s) if as_int(s) is not None else convert(s)
-                           for s in shape)
+        self.shape = tuple(self._fold(s) for s in shape)
+
+    @staticmethod
+    def _fold(s):
+        v = as_int(s)
+        if v is not None:
+            return v
+        from .expr import affine_decompose
+        e = convert(s)
+        dec = affine_decompose(e)
+        if dec is not None:
+            coeffs, const = dec
+            if not coeffs:  # symbolic terms cancelled, e.g. (k+1)*b - k*b
+                return const
+        return e
 
     @property
     def dtype(self):
