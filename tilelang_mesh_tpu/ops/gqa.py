@@ -1,0 +1,94 @@
+"""Grouped-query attention: Hq query heads share Hkv < Hq KV heads
+(reference examples/flash_attention GQA variants).
+
+The KV head for query head h is h // (Hq // Hkv): the planner lowers that
+`//` into the K/V BlockSpec index maps directly, so every query-head grid
+step fetches its group's KV tiles through the same pipelined path as MHA.
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from .flash_attention import _always
+
+
+@functools.lru_cache(maxsize=None)
+def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
+                   sm_scale, dtype, num_stages=2):
+    assert Hq % Hkv == 0
+    group = Hq // Hkv
+    scale = sm_scale * 1.44269504
+
+    @T.prim_func
+    def gqa_fwd(Q: T.Tensor((B, Hq, Sq, D), dtype),
+                K: T.Tensor((B, Hkv, Sk, D), dtype),
+                V: T.Tensor((B, Hkv, Sk, D), dtype),
+                O: T.Tensor((B, Hq, Sq, D), dtype)):
+        with T.Kernel(T.ceildiv(Sq, block_M), Hq, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            P = T.alloc_fragment((block_M, block_N), dtype)
+            acc = T.alloc_fragment((block_M, D), "float32")
+            m_prev = T.alloc_fragment((block_M,), "float32")
+            m_new = T.alloc_fragment((block_M,), "float32")
+            m_cur = T.alloc_fragment((block_M,), "float32")
+            l = T.alloc_fragment((block_M,), "float32")
+            l_cur = T.alloc_fragment((block_M,), "float32")
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                with T.If(kb * block_N <= bx * block_M + (block_M - 1)) \
+                        if causal else _always():
+                    T.copy(K[bz, by // group, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by // group, kb * block_N, 0], V_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    if causal:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                bx * block_M + i >= kb * block_N + j,
+                                S[i, j] * scale, -T.infinity("float32"))
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = S[i, j] * scale
+                    T.reduce_max(S, m_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        m_new[i] = T.max(m_prev[i], m_cur[i])
+                    for i, j in T.Parallel(block_M, block_N):
+                        S[i, j] = T.exp2(S[i, j] - m_new[i])
+                    T.reduce_sum(S, l_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                    for i, j in T.Parallel(block_M, D):
+                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                    T.copy(S, P)
+                    T.gemm(P, V_s, acc)
+                    for i in T.Parallel(block_M):
+                        m_prev[i] = m_new[i]
+
+            for i, j in T.Parallel(block_M, D):
+                acc[i, j] = acc[i, j] / l[i]
+            T.copy(acc, O[bz, by, bx * block_M, 0])
+
+    return _tl_compile(gqa_fwd)
+
+
+def gqa_attention(q, k, v, causal=False, sm_scale=None, block_M=128,
+                  block_N=128):
+    """q (B, Hq, Sq, D); k/v (B, Hkv, Sk, D) with Hkv | Hq."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    kern = gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, min(block_M, Sq),
+                          min(block_N, Sk), bool(causal), float(sm_scale),
+                          str(q.dtype))
+    return kern(q, k, v)

@@ -1,0 +1,78 @@
+"""Chunked (causal) linear attention.
+
+Behavioral equivalent of /root/reference/examples/linear_attention/ (chunked
+recurrent form): within a chunk the causal product is quadratic on the MXU;
+across chunks a (D_k, D_v) state carries the prefix sum. The chunk loop is a
+serial in-kernel loop (true recurrence), so K/V/Q chunk fetches use explicit
+DMA — the fallback path of the planner — while all three matmuls per chunk
+hit the MXU.
+
+    o_t = q_t · sum_{s<=t} k_s^T v_s   (optionally feature-mapped q, k)
+"""
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+@functools.lru_cache(maxsize=None)
+def linear_attention_kernel(B, H, S, DK, DV, chunk, dtype="float32",
+                            accum_dtype="float32"):
+    NC = S // chunk
+
+    @T.prim_func
+    def lin_attn(Q: T.Tensor((B, H, S, DK), dtype),
+                 K: T.Tensor((B, H, S, DK), dtype),
+                 V: T.Tensor((B, H, S, DV), dtype),
+                 O: T.Tensor((B, H, S, DV), dtype)):
+        with T.Kernel(H, B) as (by, bz):
+            Q_s = T.alloc_shared((chunk, DK), dtype)
+            K_s = T.alloc_shared((chunk, DK), dtype)
+            V_s = T.alloc_shared((chunk, DV), dtype)
+            state = T.alloc_fragment((DK, DV), accum_dtype)
+            attn = T.alloc_fragment((chunk, chunk), accum_dtype)
+            attn_c = T.alloc_fragment((chunk, chunk), dtype)
+            out = T.alloc_fragment((chunk, DV), accum_dtype)
+            out_c = T.alloc_fragment((chunk, DV), dtype)
+            T.fill(state, 0)
+            for c in T.serial(NC):
+                T.copy(Q[bz, by, c * chunk, 0], Q_s)
+                T.copy(K[bz, by, c * chunk, 0], K_s)
+                T.copy(V[bz, by, c * chunk, 0], V_s)
+                # inter-chunk: q @ carried state
+                T.gemm(Q_s, state, out, clear_accum=True)
+                # intra-chunk: causal-masked quadratic part
+                T.gemm(Q_s, K_s, attn, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(chunk, chunk):
+                    attn[i, j] = T.if_then_else(i >= j, attn[i, j], 0.0)
+                T.copy(attn, attn_c)
+                T.gemm(attn_c, V_s, out)
+                # state += k^T v
+                T.gemm(K_s, V_s, state, transpose_A=True)
+                T.copy(out, out_c)
+                T.copy(out_c, O[bz, by, c * chunk, 0])
+
+    return _tl_compile(lin_attn)
+
+
+def linear_attention(q, k, v, chunk=128):
+    """Causal linear attention o_t = q_t @ sum_{s<=t} k_s^T v_s."""
+    B, H, S, DK = q.shape
+    DV = v.shape[-1]
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk //= 2
+    kern = linear_attention_kernel(B, H, S, DK, DV, chunk, str(q.dtype))
+    return kern(q, k, v)
+
+
+def linear_attention_reference(q, k, v):
+    import jax.numpy as jnp
+    s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    S = q.shape[2]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s, 0.0)
+    return jnp.einsum("bhst,bhtv->bhsv", s,
+                      v.astype(jnp.float32)).astype(q.dtype)

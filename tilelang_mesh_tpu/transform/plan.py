@@ -34,13 +34,16 @@ class PlanError(Exception):
 @dataclass
 class BlockDim:
     """One dimension of a BlockSpec: size (None = squeezed unit dim),
-    index-map terms in block units."""
+    index-map terms in block units, and an optional post-division applied
+    to the whole index expression (GQA-style `head // group` maps; only
+    legal on squeezed unit dims)."""
     size: Optional[int]
     terms: Tuple[Tuple[int, int], ...]  # ((grid_axis, coeff_blocks), ...)
     const: int
+    post_div: int = 1
 
     def key(self):
-        return (self.size, self.terms, self.const)
+        return (self.size, self.terms, self.const, self.post_div)
 
 
 @dataclass
@@ -106,6 +109,8 @@ class KernelPlan:
                         for a, c in d.terms) or "0"
                     if d.const:
                         t += f" + {d.const}"
+                    if d.post_div != 1:
+                        t = f"({t})//{d.post_div}"
                     dims.append(f"{d.size}@({t})")
                 desc = f"block[{', '.join(dims)}]"
                 if p.alias is not None:
@@ -136,9 +141,18 @@ def _region_block_dims(region: Region, axes: List[GridAxis],
     rank = len(region.base)
     n_squeeze = rank - (squeeze_to_rank or rank)
     for d, (base, size) in enumerate(zip(region.base, shape)):
+        post_div = 1
         lin = linearize(base, axis_vars)
         if lin is None:
-            return None
+            # GQA-style `expr // const` on a unit dim that will be squeezed
+            from ..ir.expr import BinOp, IntImm
+            if (isinstance(base, BinOp) and base.op == "//"
+                    and isinstance(base.b, IntImm) and size == 1
+                    and d < n_squeeze):
+                lin = linearize(base.a, axis_vars)
+                post_div = base.b.value
+            if lin is None:
+                return None
         coeffs, const = lin
         if size <= 0:
             return None
@@ -156,7 +170,9 @@ def _region_block_dims(region: Region, axes: List[GridAxis],
         blk = size
         if d < n_squeeze and size == 1:
             blk = None  # squeeze leading unit dims to match on-chip rank
-        dims.append(BlockDim(blk, tuple(terms), const // size))
+        if post_div != 1 and blk is not None:
+            return None  # divided maps only legal on squeezed unit dims
+        dims.append(BlockDim(blk, tuple(terms), const // size, post_div))
     return dims
 
 
