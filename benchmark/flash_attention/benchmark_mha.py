@@ -1,0 +1,44 @@
+"""FlashAttention fwd latency/throughput (reference
+examples/flash_attention/README benchmark behavior; BASELINE config #2)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax.numpy as jnp
+    sys.path.insert(0, ".")
+    from bench import _time_fn
+    from tilelang_mesh_tpu.ops.flash_attention import mha_fwd_kernel
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    B, H = 1, 16
+    cases = [(1024, 64), (1024, 128)] if args.quick else \
+        [(1024, 64), (2048, 64), (4096, 64), (1024, 128), (2048, 128),
+         (4096, 128)]
+    print("| seq | head_dim | causal | latency ms | TFLOPS |")
+    print("|---|---|---|---|---|")
+    rng = np.random.default_rng(0)
+    for S, D in cases:
+        for causal in (False, True):
+            q = jnp.asarray(rng.standard_normal((B, H, S, D)) * 0.3,
+                            jnp.bfloat16)
+            k = jnp.asarray(rng.standard_normal((B, H, S, D)) * 0.3,
+                            jnp.bfloat16)
+            v = jnp.asarray(rng.standard_normal((B, H, S, D)) * 0.3,
+                            jnp.bfloat16)
+            kern = mha_fwd_kernel(B, H, S, S, D, causal=causal,
+                                  dtype="bfloat16")
+            dt = _time_fn(kern.func, (q, k, v), rep=20)
+            flops = 4.0 * B * H * S * S * D * (0.5 if causal else 1.0)
+            print(f"| {S} | {D} | {causal} | {dt * 1e3:.3f} | "
+                  f"{flops / dt / 1e12:.1f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Mamba2 chunk-scan benchmark (reference benchmark/mamba2/README table:
+b=8, h=80, chunk=256, d=64, dstate=128, seq 1k..8k)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax.numpy as jnp
+    sys.path.insert(0, ".")
+    from bench import _time_fn
+    from tilelang_mesh_tpu.ops.mamba2 import mamba2_chunk_scan_kernel
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=80)
+    args = ap.parse_args()
+
+    B, H, P, N, chunk = args.batch, args.heads, 64, 128, 256
+    seqs = (1024,) if args.quick else (1024, 2048, 4096, 8192)
+    rng = np.random.default_rng(0)
+    print("| seq | latency ms | TFLOPS |")
+    print("|---|---|---|")
+    for S in seqs:
+        kern = mamba2_chunk_scan_kernel(B, S, H, P, N, chunk, "bfloat16")
+        x = jnp.asarray(rng.standard_normal((B, H, S, P)) * 0.3,
+                        jnp.bfloat16)
+        dt = jnp.asarray(rng.uniform(0.01, 0.1, (B, H, S)), jnp.float32)
+        A = jnp.asarray(-rng.uniform(0.5, 1.5, (H,)), jnp.float32)
+        Bm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.bfloat16)
+        Cm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.bfloat16)
+        t = _time_fn(kern.func, (x, dt, A, Bm, Cm), rep=10)
+        # FLOPs: per chunk: CB^T (Q^2 N) + attn@X (Q^2 P) + C@state (Q N P)
+        # + state update (Q N P), x2 for MAC
+        nc = S // chunk
+        flops = 2.0 * B * H * nc * (chunk * chunk * N + chunk * chunk * P +
+                                    2 * chunk * N * P)
+        print(f"| {S} | {t * 1e3:.3f} | {flops / t / 1e12:.1f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""bf16 GEMM throughput sweep — mirror of the reference's headline table
+(/root/reference/benchmark/matmul: 8192x8192xK for K in 256..16384).
+
+Run on TPU: python benchmark/matmul/benchmark_matmul.py [--quick]
+Prints a markdown table of TFLOPS per K plus the hand-written-Pallas ratio.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def bench_shape(M, N, K, configs, rep=20):
+    import jax.numpy as jnp
+    sys.path.insert(0, ".")
+    from bench import _time_fn, _hand_pallas_matmul
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.1, jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.bfloat16)
+    flops = 2.0 * M * N * K
+
+    best_ours, best_ref = None, None
+    for cfg in configs:
+        try:
+            k = matmul_kernel(M, N, K, in_dtype="bfloat16", **cfg)
+            dt = _time_fn(k.func, (a, b), rep=rep)
+            best_ours = dt if best_ours is None else min(best_ours, dt)
+        except Exception as e:
+            print(f"# ours {cfg}: {e}", file=sys.stderr)
+        try:
+            ref = _hand_pallas_matmul(M, N, K, cfg["block_M"],
+                                      cfg["block_N"], cfg["block_K"])
+            dt = _time_fn(ref, (a, b), rep=rep)
+            best_ref = dt if best_ref is None else min(best_ref, dt)
+        except Exception as e:
+            print(f"# ref {cfg}: {e}", file=sys.stderr)
+    ours = flops / best_ours / 1e12 if best_ours else float("nan")
+    refv = flops / best_ref / 1e12 if best_ref else float("nan")
+    return ours, refv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--mn", type=int, default=8192)
+    args = ap.parse_args()
+
+    M = N = args.mn
+    ks = (256, 1024, 4096) if args.quick else (256, 512, 1024, 2048, 4096,
+                                               8192, 16384)
+    configs = [{"block_M": 256, "block_N": 256, "block_K": 512},
+               {"block_M": 512, "block_N": 256, "block_K": 256},
+               {"block_M": 256, "block_N": 512, "block_K": 512}]
+    print(f"| K | tile-DSL TFLOPS | hand-Pallas TFLOPS | ratio |")
+    print(f"|---|---|---|---|")
+    for K in ks:
+        cfgs = [c for c in configs if c["block_K"] <= K] or \
+            [{"block_M": 256, "block_N": 256, "block_K": K}]
+        ours, ref = bench_shape(M, N, K, cfgs)
+        print(f"| {K} | {ours:.1f} | {ref:.1f} | {ours / ref:.3f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,2 +1,4 @@
 from .checkers import (NestedLoopChecker, FragmentLoopChecker,
                        run_semantic_checks, SemanticError)
+from .layout_visual import (visualize_plan, visualize_fragment,
+                            visualize_mesh_blocks)

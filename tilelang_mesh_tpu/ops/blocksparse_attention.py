@@ -1,0 +1,107 @@
+"""Block-sparse attention: a per-(query-block, key-block) mask skips whole
+tiles (reference examples/blocksparse_attention).
+
+The block mask rides a (1,1) BlockSpec indexed by the query-block and
+KV-block grid axes; a masked tile's entire body is predicated out, so
+skipped blocks cost neither MXU flops nor VPU work (their tile fetches are
+still scheduled by the pipeline — acceptable on TPU where the fetch
+overlaps compute).
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+@functools.lru_cache(maxsize=None)
+def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
+                           dtype, num_stages=2):
+    scale = sm_scale * 1.44269504
+
+    @T.prim_func
+    def bs_mha(Q: T.Tensor((B, H, Sq, D), dtype),
+               K: T.Tensor((B, H, Sk, D), dtype),
+               V: T.Tensor((B, H, Sk, D), dtype),
+               BlockMask: T.Tensor((B, H, Sq // block_M, Sk // block_N),
+                                   "int32"),
+               O: T.Tensor((B, H, Sq, D), dtype)):
+        with T.Kernel(T.ceildiv(Sq, block_M), H, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            P = T.alloc_fragment((block_M, block_N), dtype)
+            acc = T.alloc_fragment((block_M, D), "float32")
+            m_prev = T.alloc_fragment((block_M,), "float32")
+            m_new = T.alloc_fragment((block_M,), "float32")
+            m_cur = T.alloc_fragment((block_M,), "float32")
+            l = T.alloc_fragment((block_M,), "float32")
+            l_cur = T.alloc_fragment((block_M,), "float32")
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                with T.If(BlockMask[bz, by, bx, kb] != 0):
+                    T.copy(K[bz, by, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by, kb * block_N, 0], V_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        S[i, j] = S[i, j] * scale
+                    T.reduce_max(S, m_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        m_new[i] = T.max(m_prev[i], m_cur[i])
+                    for i, j in T.Parallel(block_M, block_N):
+                        S[i, j] = T.exp2(S[i, j] - m_new[i])
+                    T.reduce_sum(S, l_cur, dim=1)
+                    for i in T.Parallel(block_M):
+                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                    for i, j in T.Parallel(block_M, D):
+                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                    T.copy(S, P)
+                    T.gemm(P, V_s, acc)
+                    for i in T.Parallel(block_M):
+                        m_prev[i] = m_new[i]
+
+            # rows whose every block is masked produce l == 0 -> emit zeros
+            for i, j in T.Parallel(block_M, D):
+                acc[i, j] = T.if_then_else(l[i] > 0.0, acc[i, j] / l[i], 0.0)
+            T.copy(acc, O[bz, by, bx * block_M, 0])
+
+    return _tl_compile(bs_mha)
+
+
+def blocksparse_attention(q, k, v, block_mask, sm_scale=None, block_M=128,
+                          block_N=128):
+    """block_mask (B, H, Sq//block_M, Sk//block_N) nonzero = attend."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    kern = blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N,
+                                  float(sm_scale), str(q.dtype))
+    return kern(q, k, v, block_mask)
+
+
+def blocksparse_reference(q, k, v, block_mask, block_M, block_N,
+                          sm_scale=None):
+    import jax.numpy as jnp
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    dense = jnp.repeat(jnp.repeat(block_mask != 0, block_M, 2), block_N, 3)
+    s = jnp.where(dense, s, -jnp.inf)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(jnp.isfinite(m), jnp.exp(s - m), 0.0)
+    denom = p.sum(-1, keepdims=True)
+    p = jnp.where(denom > 0, p / denom, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

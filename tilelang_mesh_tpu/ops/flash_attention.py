@@ -197,10 +197,16 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     block_M: int = 128, block_N: int = 128,
-                    num_stages: int = 2):
-    """Differentiable multi-head attention; forward runs the tile kernel,
-    backward rematerializes through jax AD."""
+                    num_stages: int = 2, backward: str = "kernel"):
+    """Differentiable multi-head attention on the tile kernels.
+
+    backward="kernel" (default): the forward under AD runs the partial
+    kernel (saving the log-sum-exp) and the backward runs the dKdV/dQ tile
+    kernels. backward="reference": rematerialize through jax AD of the
+    dense reference (debugging fallback).
+    """
     import jax
+    import jax.numpy as jnp
 
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -217,15 +223,29 @@ def flash_attention(q, k, v, causal: bool = False,
     def fa(q, k, v):
         return kernel(q, k, v)
 
-    def fwd(q, k, v):
-        return fa(q, k, v), (q, k, v)
+    if backward == "kernel":
+        def fwd(q, k, v):
+            acc, m, l = flash_attention_partial(q, k, v, causal, sm_scale,
+                                                block_M, block_N, num_stages)
+            o = (acc / l[..., None]).astype(q.dtype)
+            lse2 = m + jnp.log2(l)
+            return o, (q, k, v, o, lse2)
 
-    def bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
-                                                    sm_scale), q, k, v)
-        return vjp(g)
+        def bwd(res, g):
+            from .flash_attention_bwd import flash_attention_bwd
+            q, k, v, o, lse2 = res
+            return flash_attention_bwd(q, k, v, o, lse2, g, causal,
+                                       sm_scale, block_M, block_N)
+    else:
+        def fwd(q, k, v):
+            return fa(q, k, v), (q, k, v)
+
+        def bwd(res, g):
+            q, k, v = res
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
+                                                        sm_scale), q, k, v)
+            return vjp(g)
 
     fa.defvjp(fwd, bwd)
     return fa(q, k, v)

@@ -1,0 +1,129 @@
+"""Mamba2 chunk-scan (SSD) kernel — the reference's published-numbers
+benchmark family (/root/reference/benchmark/mamba2, BASELINE table).
+
+State-space duality form, chunked: within a chunk the token-token
+interaction is a decay-masked quadratic product on the MXU; across chunks a
+(N, P) state per head carries the recurrence. Chunk loop is a serial
+in-kernel recurrence (like linear attention) with all matmuls on the MXU.
+
+Shapes (single B/C group, the benchmark's layout):
+  x  (B, S, H, P)   inputs (P = head dim)
+  dt (B, S, H)      positive step sizes (post-softplus)
+  A  (H,)           negative state decay rates
+  Bm (B, S, N)      input projection (N = state dim)
+  Cm (B, S, N)      output projection
+  y  (B, S, H, P)
+"""
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+@functools.lru_cache(maxsize=None)
+def mamba2_chunk_scan_kernel(B, S, H, P, N, chunk, dtype="float32"):
+    NC = S // chunk
+
+    @T.prim_func
+    def ssd(X: T.Tensor((B, H, S, P), dtype),
+            DT: T.Tensor((B, H, S), "float32"),
+            A: T.Tensor((H,), "float32"),
+            Bm: T.Tensor((B, S, N), dtype),
+            Cm: T.Tensor((B, S, N), dtype),
+            Y: T.Tensor((B, H, S, P), dtype)):
+        with T.Kernel(H, B) as (bh, bz):
+            X_s = T.alloc_shared((chunk, P), dtype)
+            B_s = T.alloc_shared((chunk, N), dtype)
+            C_s = T.alloc_shared((chunk, N), dtype)
+            dt_s = T.alloc_shared((chunk,), "float32")
+            a_v = T.alloc_shared((1,), "float32")
+            cum = T.alloc_fragment((chunk,), "float32")
+            bdec = T.alloc_fragment((chunk, N), dtype)
+            cdec = T.alloc_fragment((chunk, N), dtype)
+            att = T.alloc_fragment((chunk, chunk), "float32")
+            att_c = T.alloc_fragment((chunk, chunk), dtype)
+            state = T.alloc_fragment((N, P), "float32")
+            state_c = T.alloc_fragment((N, P), dtype)
+            out = T.alloc_fragment((chunk, P), "float32")
+            out_c = T.alloc_fragment((chunk, P), dtype)
+
+            T.copy(A[bh], a_v)
+            T.fill(state, 0)
+            for c in T.serial(NC):
+                T.copy(X[bz, bh, c * chunk, 0], X_s)
+                T.copy(DT[bz, bh, c * chunk], dt_s)
+                T.copy(Bm[bz, c * chunk, 0], B_s)
+                T.copy(Cm[bz, c * chunk, 0], C_s)
+                # cumulative decay within the chunk (inclusive)
+                T.cumsum(dt_s, cum, dim=0)
+                for i in T.Parallel(chunk):
+                    cum[i] = cum[i] * a_v[0]
+                # decayed projections:
+                #   cdec_t = C_t * exp(cum_t)        (applies decay to output)
+                #   bdec_t = B_t * dt_t * exp(-cum_t) (removes decay at input)
+                for i, j in T.Parallel(chunk, N):
+                    cdec[i, j] = C_s[i, j] * T.exp(cum[i])
+                for i, j in T.Parallel(chunk, N):
+                    bdec[i, j] = B_s[i, j] * dt_s[i] * T.exp(0.0 - cum[i])
+                # intra-chunk: (C exp(cum)) @ (B dt exp(-cum))^T, causal
+                T.gemm(cdec, bdec, att, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(chunk, chunk):
+                    att[i, j] = T.if_then_else(i >= j, att[i, j], 0.0)
+                T.copy(att, att_c)
+                T.gemm(att_c, X_s, out, clear_accum=True)
+                # inter-chunk: C exp(cum) @ carried state
+                T.copy(state, state_c)
+                T.gemm(cdec, state_c, out)
+                T.copy(out, out_c)
+                T.copy(out_c, Y[bz, bh, c * chunk, 0])
+                # state update: decay old state + inject chunk
+                #   state = exp(cum_last) * state + bdec_scaled^T @ x
+                # where bdec_scaled_t = B_t dt_t exp(cum_last - cum_t)
+                for i, j in T.Parallel(chunk, N):
+                    bdec[i, j] = bdec[i, j] * T.exp(cum[chunk - 1])
+                for i, j in T.Parallel(N, P):
+                    state[i, j] = state[i, j] * T.exp(cum[chunk - 1])
+                T.gemm(bdec, X_s, state, transpose_A=True)
+
+    return _tl_compile(ssd)
+
+
+def mamba2_chunk_scan(x, dt, A, Bm, Cm, chunk=128):
+    """x (B, S, H, P); dt (B, S, H); A (H,); Bm/Cm (B, S, N)."""
+    import jax.numpy as jnp
+    B, S, H, P = x.shape
+    N = Bm.shape[-1]
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk //= 2
+    kern = mamba2_chunk_scan_kernel(B, S, H, P, N, chunk, str(x.dtype))
+    xt = x.transpose(0, 2, 1, 3)           # (B, H, S, P)
+    dtt = dt.transpose(0, 2, 1)            # (B, H, S)
+    y = kern(xt, dtt.astype(jnp.float32), A.astype(jnp.float32), Bm, Cm)
+    return y.transpose(0, 2, 1, 3)
+
+
+def mamba2_reference(x, dt, A, Bm, Cm):
+    """Sequential SSM recurrence: h_t = exp(A dt_t) h_{t-1} +
+    dt_t B_t x_t ; y_t = C_t h_t."""
+    import jax
+    import jax.numpy as jnp
+    B, S, H, P = x.shape
+    N = Bm.shape[-1]
+
+    def step(h, inputs):
+        x_t, dt_t, b_t, c_t = inputs      # (B,H,P), (B,H), (B,N), (B,N)
+        decay = jnp.exp(A[None, :] * dt_t)             # (B,H)
+        inject = jnp.einsum("bn,bh,bhp->bhnp", b_t, dt_t, x_t)
+        h = h * decay[..., None, None] + inject
+        y_t = jnp.einsum("bn,bhnp->bhp", c_t, h)
+        return h, y_t
+
+    h0 = jnp.zeros((B, H, N, P), jnp.float32)
+    xs = (x.transpose(1, 0, 2, 3).astype(jnp.float32),
+          dt.transpose(1, 0, 2).astype(jnp.float32),
+          Bm.transpose(1, 0, 2).astype(jnp.float32),
+          Cm.transpose(1, 0, 2).astype(jnp.float32))
+    _, ys = jax.lax.scan(step, h0, xs)
+    return ys.transpose(1, 0, 2, 3).astype(x.dtype)  # (B,S,H,P)

@@ -432,6 +432,7 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                     visit(s.body.stmts,
                           serial_vars + list(s.loop_vars), par_vars)
             elif isinstance(s, IfThenElse):
+                visit_expr_globals(s.cond, serial_vars, par_vars)
                 visit(s.then_body.stmts, serial_vars, par_vars)
                 if s.else_body:
                     visit(s.else_body.stmts, serial_vars, par_vars)
