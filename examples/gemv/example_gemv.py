@@ -1,0 +1,24 @@
+"""GEMV (reference examples/gemv/example_gemv.py: C = A @ B.T with A (K,),
+B (N, K)). On TPU the reduction rides the MXU as a (1, bk) x (bk, bn) gemm
+per N block instead of per-thread scalar accumulation."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops import gemv
+
+
+def main(N=384, K=512):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((K,)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((N, K)) * 0.1, jnp.float32)
+    c = gemv(a, b, out_dtype="float32")
+    np.testing.assert_allclose(np.asarray(c),
+                               np.asarray(b) @ np.asarray(a),
+                               rtol=1e-4, atol=1e-4)
+    print("gemv correct.")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,3 +37,19 @@ def test_mamba2_multi_chunk_state_carry():
     ref = mamba2_reference(x, dt, A, Bm, Cm)
     assert_allclose(np.asarray(y_small_chunks), np.asarray(ref), rtol=2e-2,
                     atol=2e-2)
+
+
+def test_mamba2_long_chunk_large_decay_no_overflow():
+    """Strong decay over a long chunk: the factored exp(+|A| cumsum(dt))
+    form overflows f32 (exp arg > 88); the pairwise segsum form must not."""
+    B, S, H, P, N = 1, 256, 1, 32, 32
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((B, S, H, P)) * 0.5, jnp.float32)
+    dt = jnp.asarray(rng.uniform(0.4, 0.6, (B, S, H)), jnp.float32)
+    A = jnp.asarray([-1.0], jnp.float32)   # |A| * sum(dt) ~ 128 >> 88
+    Bm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.float32)
+    Cm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.float32)
+    y = mamba2_chunk_scan(x, dt, A, Bm, Cm, chunk=256)
+    ref = mamba2_reference(x, dt, A, Bm, Cm)
+    assert np.isfinite(np.asarray(y)).all()
+    assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-2, atol=2e-2)

@@ -16,3 +16,5 @@ from .linear_attention import linear_attention
 from .mamba2 import mamba2_chunk_scan, mamba2_reference
 from .blocksparse_attention import blocksparse_attention
 from .grouped_gemm import grouped_matmul, grouped_gemm_kernel
+from .gemm_variants import (matmul_splitk, matmul_streamk, gemv,
+                            blocksparse_matmul)

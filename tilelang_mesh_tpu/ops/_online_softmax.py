@@ -1,0 +1,64 @@
+"""Shared online-softmax building blocks for the attention kernel family.
+
+Every blockwise-attention kernel (flash fwd, partial fwd, block-sparse, GQA,
+flash-decode) performs the same per-KV-block update on its running
+(max, sum, accumulator) statistics; this module is the single home for that
+update so numerics fixes apply everywhere at once (cf. the reference's
+shared softmax macros across examples/flash_attention/*).
+
+All statistics live in the exp2 domain: callers pre-scale scores by
+``sm_scale * log2(e)`` and use ``exp2`` throughout, which replaces every
+transcendental with the VPU's native exp2.
+"""
+
+import tilelang_mesh_tpu.language as T
+
+
+def alloc_softmax_state(block_M, block_N, D, p_dtype):
+    """Allocate the standard statistic/scratch buffers: returns a dict with
+    S (scores f32), P (probs, kernel dtype), acc (f32), and the five per-row
+    stat vectors."""
+    return dict(
+        S=T.alloc_fragment((block_M, block_N), "float32"),
+        P=T.alloc_fragment((block_M, block_N), p_dtype),
+        acc=T.alloc_fragment((block_M, D), "float32"),
+        m_prev=T.alloc_fragment((block_M,), "float32"),
+        m_new=T.alloc_fragment((block_M,), "float32"),
+        m_cur=T.alloc_fragment((block_M,), "float32"),
+        l=T.alloc_fragment((block_M,), "float32"),
+        l_cur=T.alloc_fragment((block_M,), "float32"),
+    )
+
+
+def init_softmax_state(st):
+    T.fill(st["acc"], 0)
+    T.fill(st["l"], 0)
+    T.fill(st["m_prev"], -T.infinity("float32"))
+
+
+def online_softmax_update(st, V_s, block_M, block_N, D):
+    """One online-softmax step over the scores in st['S'] (already scaled to
+    the exp2 domain and masked): rescale running stats, accumulate P @ V.
+
+    Emits (at trace time) the canonical update:
+        m_new = max(m_prev, rowmax(S)); S = exp2(S - m_new)
+        l = l * exp2(m_prev - m_new) + rowsum(S)
+        acc = acc * exp2(m_prev - m_new) + S @ V
+    """
+    S, P, acc = st["S"], st["P"], st["acc"]
+    m_prev, m_new, m_cur = st["m_prev"], st["m_new"], st["m_cur"]
+    l, l_cur = st["l"], st["l_cur"]
+    T.reduce_max(S, m_cur, dim=1)
+    for i in T.Parallel(block_M):
+        m_new[i] = T.max(m_prev[i], m_cur[i])
+    for i, j in T.Parallel(block_M, block_N):
+        S[i, j] = T.exp2(S[i, j] - m_new[i])
+    T.reduce_sum(S, l_cur, dim=1)
+    for i in T.Parallel(block_M):
+        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+    for i, j in T.Parallel(block_M, D):
+        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+    T.copy(S, P)
+    T.gemm(P, V_s, acc)
+    for i in T.Parallel(block_M):
+        m_prev[i] = m_new[i]

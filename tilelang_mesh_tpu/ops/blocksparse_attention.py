@@ -13,6 +13,8 @@ import math
 
 import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
 
 
 @functools.lru_cache(maxsize=None)
@@ -31,19 +33,11 @@ def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
             Q_s = T.alloc_shared((block_M, D), dtype)
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            P = T.alloc_fragment((block_M, block_N), dtype)
-            acc = T.alloc_fragment((block_M, D), "float32")
-            m_prev = T.alloc_fragment((block_M,), "float32")
-            m_new = T.alloc_fragment((block_M,), "float32")
-            m_cur = T.alloc_fragment((block_M,), "float32")
-            l = T.alloc_fragment((block_M,), "float32")
-            l_cur = T.alloc_fragment((block_M,), "float32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
+            S = st["S"]
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
-            T.fill(acc, 0)
-            T.fill(l, 0)
-            T.fill(m_prev, -T.infinity("float32"))
+            init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
                                   num_stages=num_stages):
@@ -53,22 +47,10 @@ def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
                     T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
                     for i, j in T.Parallel(block_M, block_N):
                         S[i, j] = S[i, j] * scale
-                    T.reduce_max(S, m_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        m_new[i] = T.max(m_prev[i], m_cur[i])
-                    for i, j in T.Parallel(block_M, block_N):
-                        S[i, j] = T.exp2(S[i, j] - m_new[i])
-                    T.reduce_sum(S, l_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
-                    for i, j in T.Parallel(block_M, D):
-                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
-                    T.copy(S, P)
-                    T.gemm(P, V_s, acc)
-                    for i in T.Parallel(block_M):
-                        m_prev[i] = m_new[i]
+                    online_softmax_update(st, V_s, block_M, block_N, D)
 
             # rows whose every block is masked produce l == 0 -> emit zeros
+            acc, l = st["acc"], st["l"]
             for i, j in T.Parallel(block_M, D):
                 acc[i, j] = T.if_then_else(l[i] > 0.0, acc[i, j] / l[i], 0.0)
             T.copy(acc, O[bz, by, bx * block_M, 0])
@@ -81,6 +63,17 @@ def blocksparse_attention(q, k, v, block_mask, sm_scale=None, block_M=128,
     """block_mask (B, H, Sq//block_M, Sk//block_N) nonzero = attend."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    block_M = min(block_M, Sq)
+    block_N = min(block_N, Sk)
+    if Sq % block_M or Sk % block_N:
+        raise ValueError(
+            f"blocksparse_attention needs Sq % block_M == 0 and "
+            f"Sk % block_N == 0, got Sq={Sq}, Sk={Sk}, block_M={block_M}, "
+            f"block_N={block_N}")
+    expect = (B, H, Sq // block_M, Sk // block_N)
+    if tuple(block_mask.shape) != expect:
+        raise ValueError(f"block_mask shape {tuple(block_mask.shape)} does "
+                         f"not match grid {expect}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     kern = blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N,

@@ -7,8 +7,15 @@ axis (Mosaic double-buffers the K/V tiles), scores/stat updates vectorize
 onto the VPU, both GEMMs hit the MXU with f32 accumulation. Causal masking
 skips fully-masked KV blocks via predicated execution.
 
-Backward: jax AD over a rematerialized reference attention (checkpointed);
-a dedicated Pallas backward kernel is the planned follow-up.
+Causal convention is TOP-LEFT aligned (query i attends keys j <= i) in every
+kernel and reference here, matching the reference examples (which assume
+Sq == Sk).
+
+Backward (flash_attention, backward="kernel", the default): the forward
+under AD runs the partial kernel (saving the log-sum-exp) and the backward
+runs the dKdV/dQ tile kernels in ops/flash_attention_bwd.py.
+backward="reference" rematerializes through jax AD of the dense reference
+as a debugging fallback.
 """
 
 
@@ -18,6 +25,24 @@ from typing import Optional
 
 import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+
+
+def _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx, kb, block_M,
+                          block_N):
+    """S = mask(scale * Q @ K^T) in the exp2 domain (trace-time emission)."""
+    S = st["S"]
+    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+    if causal:
+        for i, j in T.Parallel(block_M, block_N):
+            S[i, j] = T.if_then_else(
+                bx * block_M + i >= kb * block_N + j,
+                S[i, j] * scale,
+                -T.infinity("float32"))
+    else:
+        for i, j in T.Parallel(block_M, block_N):
+            S[i, j] = S[i, j] * scale
 
 
 @functools.lru_cache(maxsize=None)
@@ -37,19 +62,10 @@ def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
             Q_s = T.alloc_shared((block_M, D), dtype)
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            P = T.alloc_fragment((block_M, block_N), dtype)
-            acc = T.alloc_fragment((block_M, D), "float32")
-            m_prev = T.alloc_fragment((block_M,), "float32")
-            m_new = T.alloc_fragment((block_M,), "float32")
-            m_cur = T.alloc_fragment((block_M,), "float32")
-            l = T.alloc_fragment((block_M,), "float32")
-            l_cur = T.alloc_fragment((block_M,), "float32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
-            T.fill(acc, 0)
-            T.fill(l, 0)
-            T.fill(m_prev, -T.infinity("float32"))
+            init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
                                   num_stages=num_stages):
@@ -57,31 +73,11 @@ def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
                         if causal else _always():
                     T.copy(K[bz, by, kb * block_N, 0], K_s)
                     T.copy(V[bz, by, kb * block_N, 0], V_s)
-                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    if causal:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                bx * block_M + i >= kb * block_N + j,
-                                S[i, j] * scale,
-                                -T.infinity("float32"))
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = S[i, j] * scale
-                    T.reduce_max(S, m_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        m_new[i] = T.max(m_prev[i], m_cur[i])
-                    for i, j in T.Parallel(block_M, block_N):
-                        S[i, j] = T.exp2(S[i, j] - m_new[i])
-                    T.reduce_sum(S, l_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
-                    for i, j in T.Parallel(block_M, D):
-                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
-                    T.copy(S, P)
-                    T.gemm(P, V_s, acc)
-                    for i in T.Parallel(block_M):
-                        m_prev[i] = m_new[i]
+                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                                          kb, block_M, block_N)
+                    online_softmax_update(st, V_s, block_M, block_N, D)
 
+            acc, l = st["acc"], st["l"]
             for i, j in T.Parallel(block_M, D):
                 acc[i, j] = acc[i, j] / l[i]
             T.copy(acc, O[bz, by, bx * block_M, 0])
@@ -116,19 +112,10 @@ def _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N, causal,
             Q_s = T.alloc_shared((block_M, D), dtype)
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            P = T.alloc_fragment((block_M, block_N), dtype)
-            acc = T.alloc_fragment((block_M, D), "float32")
-            m_prev = T.alloc_fragment((block_M,), "float32")
-            m_new = T.alloc_fragment((block_M,), "float32")
-            m_cur = T.alloc_fragment((block_M,), "float32")
-            l = T.alloc_fragment((block_M,), "float32")
-            l_cur = T.alloc_fragment((block_M,), "float32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
-            T.fill(acc, 0)
-            T.fill(l, 0)
-            T.fill(m_prev, -T.infinity("float32"))
+            init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
                                   num_stages=num_stages):
@@ -136,34 +123,13 @@ def _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N, causal,
                         if causal else _always():
                     T.copy(K[bz, by, kb * block_N, 0], K_s)
                     T.copy(V[bz, by, kb * block_N, 0], V_s)
-                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    if causal:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                bx * block_M + i >= kb * block_N + j,
-                                S[i, j] * scale,
-                                -T.infinity("float32"))
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = S[i, j] * scale
-                    T.reduce_max(S, m_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        m_new[i] = T.max(m_prev[i], m_cur[i])
-                    for i, j in T.Parallel(block_M, block_N):
-                        S[i, j] = T.exp2(S[i, j] - m_new[i])
-                    T.reduce_sum(S, l_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
-                    for i, j in T.Parallel(block_M, D):
-                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
-                    T.copy(S, P)
-                    T.gemm(P, V_s, acc)
-                    for i in T.Parallel(block_M):
-                        m_prev[i] = m_new[i]
+                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                                          kb, block_M, block_N)
+                    online_softmax_update(st, V_s, block_M, block_N, D)
 
-            T.copy(acc, O[bz, by, bx * block_M, 0])
-            T.copy(m_prev, M[bz, by, bx * block_M])
-            T.copy(l, L[bz, by, bx * block_M])
+            T.copy(st["acc"], O[bz, by, bx * block_M, 0])
+            T.copy(st["m_prev"], M[bz, by, bx * block_M])
+            T.copy(st["l"], L[bz, by, bx * block_M])
 
     return _tl_compile(mha_fwd_partial)
 
@@ -185,8 +151,10 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
+        # top-left aligned (query i attends keys j <= i), matching the tile
+        # kernels above
         Sq, Sk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
         s = jnp.where(mask, s, -jnp.inf)
     p = jnp.exp(s - s.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)

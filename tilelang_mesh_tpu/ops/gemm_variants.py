@@ -1,0 +1,236 @@
+"""GEMM scheduling variants: split-K, stream-K, GEMV, block-sparse GEMM.
+
+Behavioral equivalents of the reference's scheduling examples
+(/root/reference/examples/gemm_splitk/example_tilelang_gemm_splitk.py,
+gemm_streamk/example_tilelang_gemm_streamk.py, gemv/example_gemv.py,
+blocksparse_gemm/example_blocksparse_gemm.py) re-designed for TPU:
+
+* split-K: the reference accumulates partials with ``T.atomic_add`` into C.
+  TPU has no global-memory atomics, so each split writes its partial tile and
+  a tiny XLA epilogue sums over the split axis (same pattern the flash-decode
+  split-KV kernel uses).
+* stream-K: the reference balances (tile, k-chunk) work units over persistent
+  CTAs with an atomic fixup. Here the host plans contiguous work segments
+  (tile, k0, k_len) that exactly load-balance the flat iteration space, the
+  kernel runs one grid step per segment with a *dynamic-extent* K loop and
+  dynamic-offset DMA (tile ids live in scalar descriptors), and the fixup is
+  an XLA ``segment_sum`` over segment partials.
+* GEMV: one MXU gemm row per N-block; A rides a (1, bk) block so the whole
+  reduction stays on the MXU rather than scalar lanes.
+* block-sparse GEMM: a (M/bm, N/bn) mask predicates whole output tiles, like
+  the block-sparse attention kernel predicates KV tiles.
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+# ---------------------------------------------------------------------------
+# split-K
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def splitk_kernel(M, N, K, n_split, block_M, block_N, block_K, in_dtype,
+                  num_stages=2):
+    split_len = K // n_split
+
+    @T.prim_func
+    def gemm_splitk(A: T.Tensor((M, K), in_dtype),
+                    B: T.Tensor((K, N), in_dtype),
+                    Cp: T.Tensor((n_split, M, N), "float32")):
+        with T.Kernel(n_split, T.ceildiv(N, block_N),
+                      T.ceildiv(M, block_M)) as (bs, bx, by):
+            A_s = T.alloc_shared((block_M, block_K), in_dtype)
+            B_s = T.alloc_shared((block_K, block_N), in_dtype)
+            C_l = T.alloc_fragment((block_M, block_N), "float32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(split_len, block_K),
+                                  num_stages=num_stages):
+                T.copy(A[by * block_M, bs * split_len + ko * block_K], A_s)
+                T.copy(B[bs * split_len + ko * block_K, bx * block_N], B_s)
+                T.gemm(A_s, B_s, C_l)
+            T.copy(C_l, Cp[bs, by * block_M, bx * block_N])
+
+    return _tl_compile(gemm_splitk)
+
+
+def matmul_splitk(a, b, n_split=4, block_M=128, block_N=128, block_K=128,
+                  out_dtype=None):
+    """C = A @ B with the K reduction split over ``n_split`` parallel grid
+    steps; partials are combined by XLA (reference uses atomic_add)."""
+    import jax.numpy as jnp
+
+    M, K = a.shape
+    N = b.shape[1]
+    while K % n_split:
+        n_split -= 1
+    split_len = K // n_split
+    block_K = min(block_K, split_len)
+    while split_len % block_K:
+        block_K -= 1
+    kern = splitk_kernel(M, N, K, n_split, block_M, block_N, block_K,
+                         str(a.dtype))
+    cp = kern(a, b)
+    return jnp.sum(cp, axis=0).astype(out_dtype or a.dtype)
+
+
+# ---------------------------------------------------------------------------
+# stream-K
+# ---------------------------------------------------------------------------
+
+def _streamk_segments(n_tiles, k_iters, n_programs):
+    """Balance the flat (tile, k-chunk) iteration space over programs;
+    split each program's contiguous range at tile boundaries."""
+    total = n_tiles * k_iters
+    per = -(-total // n_programs)
+    segs = []
+    for p in range(n_programs):
+        s, e = p * per, min(total, (p + 1) * per)
+        while s < e:
+            tile = s // k_iters
+            k0 = s % k_iters
+            k_len = min(k_iters - k0, e - s)
+            segs.append((tile, k0, k_len))
+            s += k_len
+    return segs
+
+
+@functools.lru_cache(maxsize=None)
+def streamk_kernel(M, N, K, n_seg, block_M, block_N, block_K, in_dtype):
+    @T.prim_func
+    def gemm_streamk(A: T.Tensor((M, K), in_dtype),
+                     B: T.Tensor((K, N), in_dtype),
+                     TileM: T.Tensor((n_seg,), "int32"),
+                     TileN: T.Tensor((n_seg,), "int32"),
+                     KStart: T.Tensor((n_seg,), "int32"),
+                     KLen: T.Tensor((n_seg,), "int32"),
+                     Part: T.Tensor((n_seg, block_M, block_N), "float32")):
+        with T.Kernel(n_seg) as sid:
+            A_s = T.alloc_shared((block_M, block_K), in_dtype)
+            B_s = T.alloc_shared((block_K, block_N), in_dtype)
+            acc = T.alloc_fragment((block_M, block_N), "float32")
+            tm = T.alloc_var("int32")
+            tn = T.alloc_var("int32")
+            k0 = T.alloc_var("int32")
+            kl = T.alloc_var("int32")
+            tm[0] = TileM[sid]
+            tn[0] = TileN[sid]
+            k0[0] = KStart[sid]
+            kl[0] = KLen[sid]
+            T.clear(acc)
+            for i in T.serial(kl[0]):
+                T.copy(A[tm[0] * block_M, (k0[0] + i) * block_K], A_s)
+                T.copy(B[(k0[0] + i) * block_K, tn[0] * block_N], B_s)
+                T.gemm(A_s, B_s, acc)
+            T.copy(acc, Part[sid, 0, 0])
+
+    return _tl_compile(gemm_streamk)
+
+
+def matmul_streamk(a, b, n_programs=8, block_M=128, block_N=128, block_K=128,
+                   out_dtype=None):
+    """Stream-K GEMM: host-balanced (tile, k-range) segments, one grid step
+    per segment, XLA segment-sum fixup across segments of the same tile."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    M, K = a.shape
+    N = b.shape[1]
+    assert M % block_M == 0 and N % block_N == 0 and K % block_K == 0
+    nM, nN = M // block_M, N // block_N
+    k_iters = K // block_K
+    segs = _streamk_segments(nM * nN, k_iters, n_programs)
+    n_seg = len(segs)
+    tiles = np.array([s[0] for s in segs], np.int32)
+    tile_m = jnp.asarray(tiles // nN, jnp.int32)
+    tile_n = jnp.asarray(tiles % nN, jnp.int32)
+    k_start = jnp.asarray([s[1] for s in segs], jnp.int32)
+    k_len = jnp.asarray([s[2] for s in segs], jnp.int32)
+
+    kern = streamk_kernel(M, N, K, n_seg, block_M, block_N, block_K,
+                          str(a.dtype))
+    part = kern(a, b, tile_m, tile_n, k_start, k_len)
+    fixed = jax.ops.segment_sum(part, jnp.asarray(tiles), num_segments=nM * nN)
+    c = fixed.reshape(nM, nN, block_M, block_N).transpose(0, 2, 1, 3)
+    return c.reshape(M, N).astype(out_dtype or a.dtype)
+
+
+# ---------------------------------------------------------------------------
+# GEMV
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def gemv_kernel(N, K, block_N, block_K, in_dtype, out_dtype,
+                num_stages=2):
+    @T.prim_func
+    def gemv(A: T.Tensor((1, K), in_dtype),
+             B: T.Tensor((N, K), in_dtype),
+             C: T.Tensor((1, N), out_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N)) as bx:
+            A_s = T.alloc_shared((1, block_K), in_dtype)
+            B_s = T.alloc_shared((block_N, block_K), in_dtype)
+            acc = T.alloc_fragment((1, block_N), "float32")
+            T.clear(acc)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                T.copy(A[0, ko * block_K], A_s)
+                T.copy(B[bx * block_N, ko * block_K], B_s)
+                T.gemm(A_s, B_s, acc, transpose_B=True)
+            T.copy(acc, C[0, bx * block_N])
+
+    return _tl_compile(gemv)
+
+
+def gemv(a, b, out_dtype=None, block_N=128, block_K=512):
+    """c = B @ a with a (K,), B (N, K) -> (N,)  (reference example_gemv.py
+    computes A @ B.T with the same operand layout)."""
+    K, = a.shape
+    N = b.shape[0]
+    block_K = min(block_K, K)
+    kern = gemv_kernel(N, K, block_N, block_K, str(a.dtype),
+                       out_dtype or str(a.dtype))
+    return kern(a.reshape(1, K), b)[0]
+
+
+# ---------------------------------------------------------------------------
+# block-sparse GEMM
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def blocksparse_gemm_kernel(M, N, K, block_M, block_N, block_K, in_dtype,
+                            out_dtype, num_stages=2):
+    @T.prim_func
+    def bs_gemm(A: T.Tensor((M, K), in_dtype),
+                B: T.Tensor((K, N), in_dtype),
+                BlockMask: T.Tensor((M // block_M, N // block_N), "int32"),
+                C: T.Tensor((M, N), out_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N),
+                      T.ceildiv(M, block_M)) as (bx, by):
+            A_s = T.alloc_shared((block_M, block_K), in_dtype)
+            B_s = T.alloc_shared((block_K, block_N), in_dtype)
+            C_l = T.alloc_fragment((block_M, block_N), "float32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                with T.If(BlockMask[by, bx] != 0):
+                    T.copy(A[by * block_M, ko * block_K], A_s)
+                    T.copy(B[ko * block_K, bx * block_N], B_s)
+                    T.gemm(A_s, B_s, C_l)
+            T.copy(C_l, C[by * block_M, bx * block_N])
+
+    return _tl_compile(bs_gemm)
+
+
+def blocksparse_matmul(a, b, block_mask, block_M=128, block_N=128,
+                       block_K=128, out_dtype=None):
+    """C tiles where block_mask (M/bm, N/bn) is nonzero; zeros elsewhere."""
+    M, K = a.shape
+    N = b.shape[1]
+    kern = blocksparse_gemm_kernel(M, N, K, block_M, block_N,
+                                   min(block_K, K), str(a.dtype),
+                                   out_dtype or str(a.dtype))
+    return kern(a, b, block_mask)
