@@ -1,0 +1,49 @@
+"""MInference vertical-slash sparse attention (reference
+examples/minference/example_vertical_slash_sparse_attn.py behavior),
+including the estimation step that picks the vertical/slash indices from
+last-window attention mass."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.minference import (
+    vertical_slash_sparse_attention, vs_sparse_reference)
+
+
+def _estimate_indices(q, k, n_vertical, n_slash, last_q=32):
+    """Pick columns/diagonals with the largest attention mass from the last
+    `last_q` queries (cf. reference main():567-581)."""
+    B, H, S, D = q.shape
+    qk = jnp.einsum("bhqd,bhkd->bhqk", q[:, :, -last_q:], k) / np.sqrt(D)
+    qi = jnp.arange(S - last_q, S)[:, None]
+    kj = jnp.arange(S)[None, :]
+    qk = jnp.where(qi >= kj, qk, -jnp.inf)
+    p = jax.nn.softmax(qk, axis=-1)
+    vertical = p.sum(2)                                   # (B,H,S)
+    v_idx = jnp.argsort(-vertical, axis=-1)[..., :n_vertical]
+    # diagonal mass: offset o = qi - kj
+    offs = (qi - kj)                                      # (last_q, S)
+    slash = jnp.zeros((B, H, S), jnp.float32)
+    slash = slash.at[:, :, jnp.clip(offs, 0, S - 1)].add(
+        jnp.where(offs >= 0, p, 0.0))
+    s_idx = jnp.argsort(-slash, axis=-1)[..., :n_slash]
+    return v_idx.astype(jnp.int32), s_idx.astype(jnp.int32)
+
+
+def main(B=1, H=2, S=256, D=64, n_vertical=16, n_slash=8):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v_idx, s_idx = _estimate_indices(q, k, n_vertical, n_slash)
+    out = vertical_slash_sparse_attention(q, k, v, v_idx, s_idx,
+                                          block_M=64, block_N=64)
+    ref = vs_sparse_reference(q, k, v, v_idx, s_idx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    print("vertical-slash sparse attention matches reference.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Seer attention: learned-gate block-sparse causal attention (reference
+examples/seer_attention/block_sparse_attn_tilelang.py behavior)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.seer_attention import (seer_attention,
+                                                  seer_reference)
+
+
+def main(B=1, H=2, S=256, D=64, bm=64, bn=64, topk=2):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    gates = jnp.asarray(rng.standard_normal((B, H, S // bm, S // bn)),
+                        jnp.float32)
+    out = seer_attention(q, k, v, gates, topk=topk, block_M=bm, block_N=bn)
+    ref = seer_reference(q, k, v, gates, topk, bm, bn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    print(f"seer attention (top-{topk} gated blocks) matches reference.")
+
+
+if __name__ == "__main__":
+    main()

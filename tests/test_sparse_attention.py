@@ -1,0 +1,194 @@
+"""NSA + attention-sink numerics (reference examples/deepseek_nsa and
+examples/attention_sink test behavior)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.attention_sink import (attention_sink,
+                                                  attention_sink_reference)
+from tilelang_mesh_tpu.ops.nsa import (nsa_attention, nsa_decode,
+                                       nsa_reference)
+from tilelang_mesh_tpu.utils.tensor import assert_allclose
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+# ---------------------------------------------------------------- sink ----
+@pytest.mark.parametrize("window", [None, 48])
+def test_attention_sink_mha(window):
+    B, H, S, D = 1, 2, 128, 64
+    q, k, v = (_rand((B, H, S, D), i) for i in range(3))
+    sinks = _rand((H,), 7)
+    out = attention_sink(q, k, v, sinks, causal=True, window_size=window,
+                         block_M=32, block_N=32)
+    ref = attention_sink_reference(q, k, v, sinks, causal=True,
+                                   window_size=window)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_attention_sink_gqa():
+    B, Hq, Hkv, S, D = 1, 4, 2, 128, 64
+    q = _rand((B, Hq, S, D), 0)
+    k = _rand((B, Hkv, S, D), 1)
+    v = _rand((B, Hkv, S, D), 2)
+    sinks = _rand((Hq,), 3)
+    out = attention_sink(q, k, v, sinks, causal=True, block_M=64, block_N=64)
+    ref = attention_sink_reference(q, k, v, sinks, causal=True)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_attention_sink_noncausal():
+    B, H, S, D = 1, 1, 64, 32
+    q, k, v = (_rand((B, H, S, D), 10 + i) for i in range(3))
+    sinks = jnp.asarray([0.5], jnp.float32)
+    out = attention_sink(q, k, v, sinks, causal=False, block_M=32,
+                         block_N=32)
+    ref = attention_sink_reference(q, k, v, sinks, causal=False)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+# ----------------------------------------------------------------- nsa ----
+def _nsa_inputs(B, Tq, HQ, H, D, S, BS, seed=0):
+    """Random inputs with valid causal block selections (each token always
+    selects its own block, like the reference test generator)."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, Tq, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    g_slc = jnp.asarray(rng.uniform(0.2, 1.0, (B, Tq, HQ)), jnp.float32)
+    g_swa = jnp.asarray(rng.uniform(0.2, 1.0, (B, Tq, HQ)), jnp.float32)
+    bi = np.zeros((B, Tq, H, S), np.int64)
+    for b in range(B):
+        for t in range(Tq):
+            own = t // BS
+            for h in range(H):
+                picks = rng.choice(own + 1, size=min(S, own + 1),
+                                   replace=False)
+                row = np.full(S, -1)
+                row[:len(picks)] = picks
+                if own not in picks:
+                    row[0] = own
+                bi[b, t, h] = row
+    return q, k, v, g_slc, g_swa, jnp.asarray(bi, jnp.int32)
+
+
+def test_nsa_fwd_selected_only():
+    B, Tq, HQ, H, D, S, BS = 1, 64, 4, 2, 32, 3, 16
+    q, k, v, g_slc, g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS)
+    out = nsa_attention(q, k, v, g_slc, g_swa, bi, block_size=BS)
+    ref = nsa_reference(q, k, v, g_slc, g_swa, bi, block_size=BS)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_nsa_fwd_with_window():
+    B, Tq, HQ, H, D, S, BS = 1, 64, 2, 1, 32, 2, 16
+    W = 24
+    q, k, v, g_slc, g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS, seed=1)
+    out = nsa_attention(q, k, v, g_slc, g_swa, bi, block_size=BS,
+                        window_size=W)
+    ref = nsa_reference(q, k, v, g_slc, g_swa, bi, block_size=BS,
+                        window_size=W)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_nsa_fwd_block_counts():
+    B, Tq, HQ, H, D, S, BS = 1, 32, 2, 1, 16, 2, 8
+    q, k, v, g_slc, g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS, seed=2)
+    rng = np.random.default_rng(3)
+    cnts = jnp.asarray(rng.integers(1, S + 1, (B, Tq, H)), jnp.int32)
+    out = nsa_attention(q, k, v, g_slc, g_swa, bi, block_counts=cnts,
+                        block_size=BS)
+    ref = nsa_reference(q, k, v, g_slc, g_swa, bi, block_counts=cnts,
+                        block_size=BS)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_nsa_decode():
+    B, Tk, HQ, H, D, S, BS = 1, 64, 4, 2, 32, 3, 16
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((B, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+    g = jnp.asarray(rng.uniform(0.2, 1.0, (B, HQ)), jnp.float32)
+    bi = np.stack([rng.choice(Tk // BS, S, replace=False)
+                   for _ in range(B * H)]).reshape(B, H, S)
+    bi = jnp.asarray(bi, jnp.int32)
+    out = nsa_decode(q, k, v, g, bi, block_size=BS)
+    # decode == fwd at the last token with those selections
+    g_full = jnp.zeros((B, Tk, HQ), jnp.float32).at[:, -1].set(g)
+    bi_full = jnp.broadcast_to(bi[:, None], (B, Tk, H, S))
+    ref = nsa_reference(jnp.broadcast_to(q[:, None], (B, Tk, HQ, D)),
+                        k, v, g_full, jnp.zeros_like(g_full), bi_full,
+                        block_size=BS)[:, -1]
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------- seer ----------
+def test_seer_attention():
+    from tilelang_mesh_tpu.ops.seer_attention import (seer_attention,
+                                                      seer_reference)
+    B, H, S, D, bm, bn = 1, 2, 128, 32, 32, 32
+    q, k, v = (_rand((B, H, S, D), 20 + i) for i in range(3))
+    gates = _rand((B, H, S // bm, S // bn), 23)
+    out = seer_attention(q, k, v, gates, topk=2, block_M=bm, block_N=bn)
+    ref = seer_reference(q, k, v, gates, 2, bm, bn)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_blocksparse_causal():
+    from tilelang_mesh_tpu.ops.blocksparse_attention import (
+        blocksparse_attention, blocksparse_reference)
+    B, H, S, D, bm, bn = 1, 1, 128, 32, 32, 32
+    q, k, v = (_rand((B, H, S, D), 30 + i) for i in range(3))
+    rng = np.random.default_rng(33)
+    mask = jnp.asarray(rng.integers(0, 2, (B, H, S // bm, S // bn)),
+                       jnp.int32)
+    # keep the diagonal on so no row is fully masked
+    mask = mask.at[:, :, jnp.arange(S // bm), jnp.arange(S // bn)].set(1)
+    out = blocksparse_attention(q, k, v, mask, block_M=bm, block_N=bn,
+                                causal=True)
+    ref = blocksparse_reference(q, k, v, mask, bm, bn, causal=True)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+# ----------------------------------------------------- minference ---------
+def test_vertical_slash_sparse():
+    from tilelang_mesh_tpu.ops.minference import (
+        vertical_slash_sparse_attention, vs_sparse_reference)
+    B, H, S, D = 1, 2, 256, 32
+    q, k, v = (_rand((B, H, S, D), 40 + i) for i in range(3))
+    rng = np.random.default_rng(44)
+    v_idx = jnp.asarray(np.stack(
+        [rng.choice(S, 8, replace=False) for _ in range(B * H)]
+    ).reshape(B, H, 8), jnp.int32)
+    # always include the main diagonal so every row attends something
+    s_idx = jnp.asarray(np.stack(
+        [np.concatenate([[0], rng.choice(np.arange(1, S), 3, replace=False)])
+         for _ in range(B * H)]).reshape(B, H, 4), jnp.int32)
+    out = vertical_slash_sparse_attention(q, k, v, v_idx, s_idx,
+                                          block_M=64, block_N=64)
+    ref = vs_sparse_reference(q, k, v, v_idx, s_idx)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_seer_rectangular_blocks():
+    """block_M != block_N: causal block-visibility must use element ranges
+    (regression: kb <= qb is wrong for rectangular blocks)."""
+    from tilelang_mesh_tpu.ops.seer_attention import (seer_attention,
+                                                      seer_reference,
+                                                      seer_block_mask)
+    B, H, S, D, bm, bn = 1, 1, 128, 32, 64, 32
+    q, k, v = (_rand((B, H, S, D), 50 + i) for i in range(3))
+    gates = _rand((B, H, S // bm, S // bn), 53)
+    # with topk == nK every causally visible block must be selected: the
+    # diagonal key blocks of query block 1 (kb=2,3) must be live
+    mask = seer_block_mask(gates, topk=S // bn, block_M=bm, block_N=bn)
+    assert bool(mask[0, 0, 1, 2]) and bool(mask[0, 0, 1, 3])
+    out = seer_attention(q, k, v, gates, topk=2, block_M=bm, block_N=bn)
+    ref = seer_reference(q, k, v, gates, 2, bm, bn)
+    assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)

@@ -50,7 +50,9 @@ def online_softmax_update(st, V_s, block_M, block_N, D):
     l, l_cur = st["l"], st["l_cur"]
     T.reduce_max(S, m_cur, dim=1)
     for i in T.Parallel(block_M):
-        m_new[i] = T.max(m_prev[i], m_cur[i])
+        # -1e30 floor keeps fully-masked rows finite (exp2(-inf - -inf)
+        # would be NaN); a no-op whenever any key is visible
+        m_new[i] = T.max(m_prev[i], T.max(m_cur[i], -1e30))
     for i, j in T.Parallel(block_M, block_N):
         S[i, j] = T.exp2(S[i, j] - m_new[i])
     T.reduce_sum(S, l_cur, dim=1)

@@ -1,0 +1,147 @@
+"""Attention with per-head sink logits (gpt-oss / StreamingLLM style).
+
+Behavioral equivalent of the reference's examples/attention_sink
+(example_mha_sink_fwd_bhsd.py, example_gqa_sink_fwd_bhsd_wgmma_pipelined.py):
+standard blockwise online-softmax attention where each head owns a learnable
+"sink" logit that joins the softmax denominator without contributing a
+value — after the KV loop the running sum picks up exp(sink - m).
+
+TPU design notes: identical pipelined KV loop as ops/flash_attention.py
+(MXU GEMMs, VPU stat updates, Mosaic double-buffered K/V tiles); the sink
+contribution is one extra VPU vector op after the loop. Optional sliding
+window masks at block granularity so fully-outside KV tiles are skipped via
+the same predicated-execution path causal masking uses.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+from .flash_attention import _always
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
+                    window, sm_scale, dtype, num_stages=2):
+    """window <= 0 means no sliding window. Sinks are float32 (Hq,)."""
+    assert Hq % Hkv == 0
+    group = Hq // Hkv
+    scale = sm_scale * _LOG2E
+
+    def _block_live(kb, bx):
+        """Trace-time predicate: this KV block intersects some query row's
+        visible range."""
+        conds = []
+        if causal:
+            conds.append(kb * block_N <= bx * block_M + (block_M - 1))
+        if window > 0:
+            # newest visible key for the oldest query row in the tile
+            conds.append(kb * block_N + (block_N - 1) >=
+                         bx * block_M - (window - 1))
+        if not conds:
+            return None
+        c = conds[0]
+        for extra in conds[1:]:
+            c = c & extra
+        return c
+
+    @T.prim_func
+    def sink_fwd(Q: T.Tensor((B, Hq, Sq, D), dtype),
+                 K: T.Tensor((B, Hkv, Sk, D), dtype),
+                 V: T.Tensor((B, Hkv, Sk, D), dtype),
+                 Sinks: T.Tensor((Hq,), "float32"),
+                 O: T.Tensor((B, Hq, Sq, D), dtype)):
+        with T.Kernel(T.ceildiv(Sq, block_M), Hq, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            sink = T.alloc_shared((1,), "float32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
+            S = st["S"]
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.copy(Sinks[by], sink)
+            init_softmax_state(st)
+
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                live = _block_live(kb, bx)
+                with T.If(live) if live is not None else _always():
+                    T.copy(K[bz, by // group, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by // group, kb * block_N, 0], V_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        qi = bx * block_M + i
+                        kj = kb * block_N + j
+                        vis = (qi >= kj) if causal else (kj < Sk)
+                        if window > 0:
+                            vis = vis & (kj > qi - window)
+                        S[i, j] = T.if_then_else(
+                            vis, S[i, j] * scale, -T.infinity("float32"))
+                    online_softmax_update(st, V_s, block_M, block_N, D)
+
+            # the sink joins the denominator as one extra (value-less) logit
+            # (cf. reference example_mha_sink_fwd_bhsd.py:177)
+            acc, l, m_prev = st["acc"], st["l"], st["m_prev"]
+            for i in T.Parallel(block_M):
+                l[i] = l[i] + T.exp2(sink[0] * _LOG2E - m_prev[i])
+            for i, j in T.Parallel(block_M, D):
+                acc[i, j] = acc[i, j] / l[i]
+            T.copy(acc, O[bz, by, bx * block_M, 0])
+
+    return _tl_compile(sink_fwd)
+
+
+def attention_sink(q, k, v, sinks, causal: bool = True,
+                   window_size: Optional[int] = None,
+                   sm_scale: Optional[float] = None,
+                   block_M: int = 128, block_N: int = 128,
+                   num_stages: int = 2):
+    """Sink attention: q (B, Hq, Sq, D); k/v (B, Hkv, Sk, D), Hkv | Hq;
+    sinks (Hq,) float32 per-head sink logits. window_size=None disables the
+    sliding window (full causal/dense attention + sink)."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    window = 0 if window_size is None else int(window_size)
+    kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, min(block_M, Sq),
+                           min(block_N, Sk), bool(causal), window,
+                           float(sm_scale), str(q.dtype), num_stages)
+    import jax.numpy as jnp
+    return kern(q, k, v, jnp.asarray(sinks, jnp.float32))
+
+
+def attention_sink_reference(q, k, v, sinks, causal=True, window_size=None,
+                             sm_scale=None):
+    """Dense reference (matches the reference's torch ref_program):
+    softmax over [scores, sink] where the sink column carries no value."""
+    import jax.numpy as jnp
+
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    group = Hq // Hkv
+    kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kf) * sm_scale
+    qi = jnp.arange(Sq)[:, None]
+    kj = jnp.arange(Sk)[None, :]
+    mask = jnp.ones((Sq, Sk), bool)
+    if causal:
+        mask = mask & (qi >= kj)
+    if window_size is not None:
+        mask = mask & (kj > qi - window_size)
+    s = jnp.where(mask, s, -jnp.inf)
+    sink = jnp.asarray(sinks, jnp.float32).reshape(1, Hq, 1, 1)
+    m = jnp.maximum(s.max(-1, keepdims=True), sink)
+    p = jnp.exp(s - m)
+    denom = p.sum(-1, keepdims=True) + jnp.exp(sink - m)
+    return jnp.einsum("bhqk,bhkd->bhqd", p / denom, vf).astype(q.dtype)

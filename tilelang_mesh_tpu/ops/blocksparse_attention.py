@@ -19,7 +19,7 @@ from ._online_softmax import (alloc_softmax_state, init_softmax_state,
 
 @functools.lru_cache(maxsize=None)
 def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
-                           dtype, num_stages=2):
+                           dtype, num_stages=2, causal=False):
     scale = sm_scale * 1.44269504
 
     @T.prim_func
@@ -41,12 +41,22 @@ def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
                                   num_stages=num_stages):
-                with T.If(BlockMask[bz, by, bx, kb] != 0):
+                live = BlockMask[bz, by, bx, kb] != 0
+                if causal:
+                    live = live & (kb * block_N <=
+                                   bx * block_M + (block_M - 1))
+                with T.If(live):
                     T.copy(K[bz, by, kb * block_N, 0], K_s)
                     T.copy(V[bz, by, kb * block_N, 0], V_s)
                     T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    for i, j in T.Parallel(block_M, block_N):
-                        S[i, j] = S[i, j] * scale
+                    if causal:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                bx * block_M + i >= kb * block_N + j,
+                                S[i, j] * scale, -T.infinity("float32"))
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = S[i, j] * scale
                     online_softmax_update(st, V_s, block_M, block_N, D)
 
             # rows whose every block is masked produce l == 0 -> emit zeros
@@ -59,8 +69,10 @@ def blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N, sm_scale,
 
 
 def blocksparse_attention(q, k, v, block_mask, sm_scale=None, block_M=128,
-                          block_N=128):
-    """block_mask (B, H, Sq//block_M, Sk//block_N) nonzero = attend."""
+                          block_N=128, causal=False):
+    """block_mask (B, H, Sq//block_M, Sk//block_N) nonzero = attend;
+    causal=True additionally applies the elementwise causal mask (the
+    seer-attention configuration)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_M = min(block_M, Sq)
@@ -77,12 +89,13 @@ def blocksparse_attention(q, k, v, block_mask, sm_scale=None, block_M=128,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     kern = blocksparse_mha_kernel(B, H, Sq, Sk, D, block_M, block_N,
-                                  float(sm_scale), str(q.dtype))
+                                  float(sm_scale), str(q.dtype),
+                                  causal=bool(causal))
     return kern(q, k, v, block_mask)
 
 
 def blocksparse_reference(q, k, v, block_mask, block_M, block_N,
-                          sm_scale=None):
+                          sm_scale=None, causal=False):
     import jax.numpy as jnp
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -91,6 +104,8 @@ def blocksparse_reference(q, k, v, block_mask, block_M, block_N,
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     dense = jnp.repeat(jnp.repeat(block_mask != 0, block_M, 2), block_N, 3)
+    if causal:
+        dense = dense & jnp.tril(jnp.ones((Sq, Sk), bool))
     s = jnp.where(dense, s, -jnp.inf)
     m = jnp.max(s, -1, keepdims=True)
     p = jnp.where(jnp.isfinite(m), jnp.exp(s - m), 0.0)

@@ -1,0 +1,167 @@
+"""MInference vertical-slash sparse attention.
+
+Behavioral equivalent of the reference's examples/minference
+(example_vertical_slash_sparse_attn.py): causal attention restricted to
+(a) a per-head set of "vertical" key columns v_idx and (b) a per-head set
+of "slash" diagonals s_idx, where a slash s makes key kj visible to query
+qi iff qi - kj == s (s = 0 is the main diagonal).
+
+TPU design: the reference converts indices to per-block CSR metadata with a
+CUDA helper kernel; here the block-level mask is a tiny XLA computation and
+the element-level mask is evaluated on the VPU inside the tile kernel — the
+vertical part streams a dense 0/1 column mask tile, the slash part compares
+the tile's (qi - kj) iota against the (few) slash offsets. Dead tiles are
+predicated out exactly like blocksparse_attention, so skipped blocks cost
+no MXU work.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def vs_sparse_kernel(B, H, Sq, Sk, D, Ns, block_M, block_N, sm_scale,
+                     dtype, num_stages=2):
+    scale = sm_scale * _LOG2E
+    nK = Sk // block_N
+
+    @T.prim_func
+    def vs_attn(Q: T.Tensor((B, H, Sq, D), dtype),
+                K: T.Tensor((B, H, Sk, D), dtype),
+                V: T.Tensor((B, H, Sk, D), dtype),
+                Vmask: T.Tensor((B, H, Sk), "int32"),
+                SIdx: T.Tensor((B, H, Ns), "int32"),
+                BlockMask: T.Tensor((B, H, Sq // block_M, nK), "int32"),
+                O: T.Tensor((B, H, Sq, D), dtype)):
+        with T.Kernel(T.ceildiv(Sq, block_M), H, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            vm_s = T.alloc_shared((block_N,), "int32")
+            sl_s = T.alloc_shared((Ns,), "int32")
+            Vis = T.alloc_fragment((block_M, block_N), "int32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
+            S = st["S"]
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.copy(SIdx[bz, by, 0], sl_s)
+            init_softmax_state(st)
+
+            for kb in T.Pipelined(nK, num_stages=num_stages):
+                live = (BlockMask[bz, by, bx, kb] != 0) & \
+                       (kb * block_N <= bx * block_M + (block_M - 1))
+                with T.If(live):
+                    T.copy(K[bz, by, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by, kb * block_N, 0], V_s)
+                    T.copy(Vmask[bz, by, kb * block_N], vm_s)
+                    for i, j in T.Parallel(block_M, block_N):
+                        Vis[i, j] = vm_s[j]
+                    for n in T.serial(Ns):
+                        for i, j in T.Parallel(block_M, block_N):
+                            Vis[i, j] = Vis[i, j] | T.cast(
+                                (bx * block_M + i) - (kb * block_N + j)
+                                == sl_s[n], "int32")
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        S[i, j] = T.if_then_else(
+                            (Vis[i, j] != 0) &
+                            (bx * block_M + i >= kb * block_N + j),
+                            S[i, j] * scale, -T.infinity("float32"))
+                    online_softmax_update(st, V_s, block_M, block_N, D)
+
+            acc, l = st["acc"], st["l"]
+            for i, j in T.Parallel(block_M, D):
+                acc[i, j] = T.if_then_else(l[i] > 0.0, acc[i, j] / l[i], 0.0)
+            T.copy(acc, O[bz, by, bx * block_M, 0])
+
+    return _tl_compile(vs_attn)
+
+
+def _build_masks(v_idx, s_idx, Sq, Sk, block_M, block_N):
+    """XLA-level metadata: dense 0/1 vertical column mask + block-level
+    liveness (the analog of the reference's convert_vertical_slash_indexes
+    CUDA helper)."""
+    import jax.numpy as jnp
+
+    B, H, Nv = v_idx.shape
+    Ns = s_idx.shape[-1]
+    nQ, nK = Sq // block_M, Sk // block_N
+
+    cols = jnp.arange(Sk)
+    vmask = (cols[None, None, :, None] == v_idx[:, :, None, :]).any(-1)
+    vmask = vmask.astype(jnp.int32)                              # (B,H,Sk)
+
+    # vertical blocks: key block kb live if any selected column lands in it
+    vb = jnp.zeros((B, H, nK), bool).at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(H)[None, :, None],
+        jnp.clip(v_idx // block_N, 0, nK - 1)].set(True)
+    vblock = jnp.broadcast_to(vb[:, :, None, :], (B, H, nQ, nK))
+
+    # slash s intersects tile (qb, kb) iff s falls in the tile's qi-kj range
+    qb = jnp.arange(nQ)[:, None, None]
+    kb = jnp.arange(nK)[None, :, None]
+    s = s_idx[:, :, None, None, :]                    # (B,H,1,1,Ns)
+    lo = qb * block_M - kb * block_N - (block_N - 1)
+    hi = qb * block_M + (block_M - 1) - kb * block_N
+    sblock = ((s >= lo[None, None]) & (s <= hi[None, None])).any(-1)
+
+    causal_b = (kb[..., 0] * block_N <= qb[..., 0] * block_M + block_M - 1)
+    block_mask = ((vblock | sblock) & causal_b).astype(jnp.int32)
+    return vmask, block_mask
+
+
+def vertical_slash_sparse_attention(q, k, v, v_idx, s_idx,
+                                    sm_scale: Optional[float] = None,
+                                    block_M: int = 64, block_N: int = 64):
+    """q/k/v (B, H, S, D); v_idx (B, H, Nv) selected key columns;
+    s_idx (B, H, Ns) selected diagonals (qi - kj distances)."""
+    import jax.numpy as jnp
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_M = min(block_M, Sq)
+    block_N = min(block_N, Sk)
+    if Sq % block_M or Sk % block_N:
+        raise ValueError("sequence length must divide the block size")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    v_idx = jnp.asarray(v_idx, jnp.int32)
+    s_idx = jnp.asarray(s_idx, jnp.int32)
+    vmask, block_mask = _build_masks(v_idx, s_idx, Sq, Sk, block_M, block_N)
+    kern = vs_sparse_kernel(B, H, Sq, Sk, D, s_idx.shape[-1], block_M,
+                            block_N, float(sm_scale), str(q.dtype))
+    return kern(q, k, v, vmask, s_idx, block_mask)
+
+
+def vs_sparse_reference(q, k, v, v_idx, s_idx, sm_scale=None):
+    import jax.numpy as jnp
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    qi = jnp.arange(Sq)[:, None]
+    kj = jnp.arange(Sk)[None, :]
+    vmask = (jnp.arange(Sk)[None, None, None, :] ==
+             jnp.asarray(v_idx)[:, :, :, None]).any(2)   # (B,H,Sk)
+    smask = ((qi - kj)[None, None, :, :, None] ==
+             jnp.asarray(s_idx)[:, :, None, None, :]).any(-1)  # (B,H,Sq,Sk)
+    vis = (vmask[:, :, None, :] | smask) & (qi >= kj)[None, None]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(vis, s, -jnp.inf)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(jnp.isfinite(m), jnp.exp(s - m), 0.0)
+    denom = p.sum(-1, keepdims=True)
+    p = jnp.where(denom > 0, p / denom, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

@@ -1,0 +1,268 @@
+"""Native Sparse Attention (DeepSeek NSA) forward + decode.
+
+Behavioral equivalent of the reference's examples/deepseek_nsa
+(example_tilelang_nsa_fwd.py / _decode.py, semantics fixed by
+reference.py:naive_nsa): every query token attends (a) a per-token set of S
+selected KV blocks of size `block_size`, gated by g_slc, and (b) an optional
+sliding window, gated by g_swa. GQA grouping: the G = HQ//H query heads that
+share a KV head are processed together so the score GEMM is (G, D)@(D, BS)
+on the MXU.
+
+TPU design: one grid program per (token, kv-head, batch). The selected block
+ids live in an int32 VMEM buffer (scalar-prefetched); each iteration DMAs
+the chosen K/V block from HBM at a data-dependent offset (Mosaic dynamic-
+slice DMA — the TPU analog of the reference kernel's gather loads) and folds
+it into a running online softmax. Invalid / future / beyond-count blocks are
+skipped by predicated execution, so no garbage traffic is issued.
+"""
+
+import functools
+import math
+from typing import Optional, Union
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+
+_LOG2E = 1.44269504
+
+
+def _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale, mask_of):
+    """One gathered-block online-softmax step; mask_of(j) gives the
+    visibility predicate for key slot j (trace-time closure)."""
+    S_f = st["S"]
+    T.gemm(Q_s, K_s, S_f, transpose_B=True, clear_accum=True)
+    for i, j in T.Parallel(G, BS):
+        S_f[i, j] = T.if_then_else(mask_of(j), S_f[i, j] * scale,
+                                   -T.infinity("float32"))
+    online_softmax_update(st, V_s, G, BS, D)
+
+
+@functools.lru_cache(maxsize=None)
+def nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, BS, window, sm_scale, dtype):
+    """Selected + sliding-window NSA forward. Layouts (kernel-side):
+    Q/O (B, Tq, H, G, D), K/V (B, H, Tk, D), BI (B, Tq, H, S) int32,
+    gates (B, Tq, H, G) f32, counts (B, Tq, H) int32."""
+    scale = sm_scale * _LOG2E
+    NW = -(-window // BS) + 1 if window > 0 else 0  # window blocks + stub
+
+    @T.prim_func
+    def nsa_fwd(Q: T.Tensor((B, Tq, H, G, D), dtype),
+                K: T.Tensor((B, H, Tk, D), dtype),
+                V: T.Tensor((B, H, Tk, D), dtype),
+                BI: T.Tensor((B, Tq, H, S), "int32"),
+                Cnt: T.Tensor((B, Tq, H), "int32"),
+                Gslc: T.Tensor((B, Tq, H, G), "float32"),
+                Gswa: T.Tensor((B, Tq, H, G), "float32"),
+                O: T.Tensor((B, Tq, H, G, D), dtype)):
+        with T.Kernel(Tq, H, B) as (t, by, bz):
+            Q_s = T.alloc_shared((G, D), dtype)
+            K_s = T.alloc_shared((BS, D), dtype)
+            V_s = T.alloc_shared((BS, D), dtype)
+            Idx = T.alloc_shared((S,), "int32")
+            cnt = T.alloc_shared((1,), "int32")
+            gs = T.alloc_shared((G,), "float32")
+            st = alloc_softmax_state(G, BS, D, dtype)
+            acc, l = st["acc"], st["l"]
+            out = T.alloc_fragment((G, D), "float32")
+
+            T.copy(Q[bz, t, by, 0, 0], Q_s)
+            T.copy(BI[bz, t, by, 0], Idx)
+            T.copy(Cnt[bz, t, by], cnt)
+            T.copy(Gslc[bz, t, by, 0], gs)
+            init_softmax_state(st)
+
+            # --- selected-block attention ---
+            for s in T.serial(S):
+                blk = Idx[s]
+                with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t)):
+                    T.copy(K[bz, by, blk * BS, 0], K_s)
+                    T.copy(V[bz, by, blk * BS, 0], V_s)
+                    _gathered_block_update(
+                        st, Q_s, K_s, V_s, G, BS, D, scale,
+                        mask_of=lambda j, b=blk: b * BS + j <= t)
+            for i, j in T.Parallel(G, D):
+                out[i, j] = acc[i, j] / T.max(l[i], 1e-30) * gs[i]
+
+            if window > 0:
+                T.copy(Gswa[bz, t, by, 0], gs)
+                init_softmax_state(st)
+                for wi in T.serial(NW):
+                    wb = t // BS - (NW - 1) + wi
+                    with T.If((wb >= 0) & (wb * BS <= t)):
+                        T.copy(K[bz, by, wb * BS, 0], K_s)
+                        T.copy(V[bz, by, wb * BS, 0], V_s)
+                        _gathered_block_update(
+                            st, Q_s, K_s, V_s, G, BS, D, scale,
+                            mask_of=lambda j, b=wb: (b * BS + j <= t) &
+                                                    (b * BS + j > t - window))
+                for i, j in T.Parallel(G, D):
+                    out[i, j] = (out[i, j] +
+                                 acc[i, j] / T.max(l[i], 1e-30) * gs[i])
+
+            T.copy(out, O[bz, t, by, 0, 0])
+
+    return _tl_compile(nsa_fwd)
+
+
+def nsa_attention(q, k, v, g_slc, g_swa, block_indices,
+                  block_counts: Optional[Union[int, object]] = None,
+                  block_size: int = 64, window_size: int = 0,
+                  scale: Optional[float] = None):
+    """NSA forward, reference layout (reference.py:naive_nsa, head_first
+    False): q (B, T, HQ, D); k/v (B, T, H, D); g_slc/g_swa (B, T, HQ);
+    block_indices (B, T, H, S); block_counts int or (B, T, H)."""
+    import jax.numpy as jnp
+
+    B, Tq, HQ, D = q.shape
+    H = k.shape[2]
+    Tk = k.shape[1]
+    G = HQ // H
+    S = block_indices.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if block_counts is None:
+        cnt = jnp.full((B, Tq, H), S, jnp.int32)
+    elif isinstance(block_counts, int):
+        cnt = jnp.full((B, Tq, H), block_counts, jnp.int32)
+    else:
+        cnt = jnp.asarray(block_counts, jnp.int32)
+
+    q5 = q.reshape(B, Tq, H, G, D)
+    kh = jnp.transpose(k, (0, 2, 1, 3))  # (B, H, Tk, D)
+    vh = jnp.transpose(v, (0, 2, 1, 3))
+    gs = jnp.asarray(g_slc, jnp.float32).reshape(B, Tq, H, G)
+    gw = jnp.asarray(g_swa, jnp.float32).reshape(B, Tq, H, G)
+    bi = jnp.asarray(block_indices, jnp.int32)
+
+    kern = nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, int(block_size),
+                          int(window_size), float(scale), str(q.dtype))
+    o = kern(q5, kh, vh, bi, cnt, gs, gw)
+    return o.reshape(B, Tq, HQ, D)
+
+
+@functools.lru_cache(maxsize=None)
+def nsa_decode_kernel(B, H, G, Tk, D, S, BS, sm_scale, dtype):
+    """Single-token decode: the causal bound is the static context length."""
+    scale = sm_scale * _LOG2E
+    t_last = Tk - 1
+
+    @T.prim_func
+    def nsa_dec(Q: T.Tensor((B, H, G, D), dtype),
+                K: T.Tensor((B, H, Tk, D), dtype),
+                V: T.Tensor((B, H, Tk, D), dtype),
+                BI: T.Tensor((B, H, S), "int32"),
+                Cnt: T.Tensor((B, H), "int32"),
+                Gslc: T.Tensor((B, H, G), "float32"),
+                O: T.Tensor((B, H, G, D), dtype)):
+        with T.Kernel(H, B) as (by, bz):
+            Q_s = T.alloc_shared((G, D), dtype)
+            K_s = T.alloc_shared((BS, D), dtype)
+            V_s = T.alloc_shared((BS, D), dtype)
+            Idx = T.alloc_shared((S,), "int32")
+            cnt = T.alloc_shared((1,), "int32")
+            gs = T.alloc_shared((G,), "float32")
+            st = alloc_softmax_state(G, BS, D, dtype)
+            acc, l = st["acc"], st["l"]
+
+            T.copy(Q[bz, by, 0, 0], Q_s)
+            T.copy(BI[bz, by, 0], Idx)
+            T.copy(Cnt[bz, by], cnt)
+            T.copy(Gslc[bz, by, 0], gs)
+            init_softmax_state(st)
+
+            for s in T.serial(S):
+                blk = Idx[s]
+                with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t_last)):
+                    T.copy(K[bz, by, blk * BS, 0], K_s)
+                    T.copy(V[bz, by, blk * BS, 0], V_s)
+                    _gathered_block_update(
+                        st, Q_s, K_s, V_s, G, BS, D, scale,
+                        mask_of=lambda j, b=blk: b * BS + j <= t_last)
+
+            for i, j in T.Parallel(G, D):
+                acc[i, j] = acc[i, j] / T.max(l[i], 1e-30) * gs[i]
+            T.copy(acc, O[bz, by, 0, 0])
+
+    return _tl_compile(nsa_dec)
+
+
+def nsa_decode(q, k, v, g_slc, block_indices, block_counts=None,
+               block_size: int = 64, scale: Optional[float] = None):
+    """Decode step: q (B, HQ, D) attends selected blocks of k/v
+    (B, Tk, H, D); block_indices (B, H, S); g_slc (B, HQ)."""
+    import jax.numpy as jnp
+
+    B, HQ, D = q.shape
+    Tk, H = k.shape[1], k.shape[2]
+    G = HQ // H
+    S = block_indices.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if block_counts is None:
+        cnt = jnp.full((B, H), S, jnp.int32)
+    elif isinstance(block_counts, int):
+        cnt = jnp.full((B, H), block_counts, jnp.int32)
+    else:
+        cnt = jnp.asarray(block_counts, jnp.int32)
+
+    q4 = q.reshape(B, H, G, D)
+    kh = jnp.transpose(k, (0, 2, 1, 3))
+    vh = jnp.transpose(v, (0, 2, 1, 3))
+    gs = jnp.asarray(g_slc, jnp.float32).reshape(B, H, G)
+    kern = nsa_decode_kernel(B, H, G, Tk, D, S, int(block_size),
+                             float(scale), str(q.dtype))
+    o = kern(q4, kh, vh, jnp.asarray(block_indices, jnp.int32), cnt, gs)
+    return o.reshape(B, HQ, D)
+
+
+def nsa_reference(q, k, v, g_slc, g_swa, block_indices, block_counts=None,
+                  block_size=64, window_size=0, scale=None):
+    """Dense jax reference of naive_nsa (reference.py:9) for testing."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    B, Tq, HQ, D = q.shape
+    H = k.shape[2]
+    G = HQ // H
+    BS = block_size
+    S = block_indices.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    qf = np.asarray(q, np.float32)
+    kf = np.asarray(k, np.float32)
+    vf = np.asarray(v, np.float32)
+    gsl = np.asarray(g_slc, np.float32)
+    gsw = np.asarray(g_swa, np.float32)
+    bi = np.asarray(block_indices)
+    if block_counts is None:
+        cnts = np.full((B, Tq, H), S)
+    elif isinstance(block_counts, int):
+        cnts = np.full((B, Tq, H), block_counts)
+    else:
+        cnts = np.asarray(block_counts)
+
+    out = np.zeros((B, Tq, HQ, D), np.float32)
+    for b in range(B):
+        for t in range(Tq):
+            for h in range(HQ):
+                hk = h // G
+                sel = bi[b, t, hk][:cnts[b, t, hk]]
+                idx = (sel[:, None] * BS + np.arange(BS)[None, :]).ravel()
+                valid = (idx >= 0) & (idx <= t) & (sel >= 0).repeat(BS)
+                sc = qf[b, t, h] @ kf[b, np.clip(idx, 0, Tq - 1), hk].T
+                sc = np.where(valid, sc * scale, -np.inf)
+                if np.any(valid):
+                    p = np.exp(sc - sc.max())
+                    p = p / p.sum()
+                    out[b, t, h] = (p @ vf[b, np.clip(idx, 0, Tq - 1), hk]) \
+                        * gsl[b, t, h]
+                if window_size > 0:
+                    lo = max(0, t - window_size + 1)
+                    sw = qf[b, t, h] @ kf[b, lo:t + 1, hk].T * scale
+                    pw = np.exp(sw - sw.max())
+                    pw = pw / pw.sum()
+                    out[b, t, h] += (pw @ vf[b, lo:t + 1, hk]) * gsw[b, t, h]
+    return jnp.asarray(out, q.dtype)

@@ -1,0 +1,60 @@
+"""Seer-attention: learned-gate block-sparse causal attention.
+
+Behavioral equivalent of the reference's examples/seer_attention
+(block_sparse_attn_tilelang.py): a downsampled gate score per
+(query-block, key-block) selects which KV tiles each query block attends;
+the kernel is causal block-sparse attention over that mask. The "seer"
+part — deriving the block mask from pooled gate logits via top-k — happens
+at the XLA level (a tiny top-k over the block grid), the heavy part rides
+the tile kernel.
+"""
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .blocksparse_attention import (blocksparse_attention,
+                                    blocksparse_reference)
+
+
+def seer_block_mask(gate_logits, topk: int, block_M: int, block_N: int,
+                    causal: bool = True):
+    """gate_logits (B, H, nQ, nK) -> int32 mask selecting the top-k key
+    blocks per query block (causally-valid blocks only)."""
+    B, H, nQ, nK = gate_logits.shape
+    g = jnp.asarray(gate_logits, jnp.float32)
+    if causal:
+        # key block kb is (partially) visible to query block qb iff its
+        # first key is <= the block's newest query row
+        qb = jnp.arange(nQ)[:, None]
+        kb = jnp.arange(nK)[None, :]
+        g = jnp.where(kb * block_N <= qb * block_M + block_M - 1, g,
+                      -jnp.inf)
+    k = min(topk, nK)
+    thresh = jnp.sort(g, axis=-1)[..., nK - k][..., None]
+    mask = (g >= thresh) & jnp.isfinite(g)
+    return mask.astype(jnp.int32)
+
+
+def seer_attention(q, k, v, gate_logits, topk: int = 4,
+                   sm_scale: Optional[float] = None,
+                   block_M: int = 128, block_N: int = 128):
+    """q/k/v (B, H, S, D); gate_logits (B, H, S//block_M, S//block_N)
+    learned block-level gates; each query block attends its top-k gated key
+    blocks, causally masked."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_M = min(block_M, Sq)
+    block_N = min(block_N, Sk)
+    mask = seer_block_mask(gate_logits, topk, block_M, block_N, causal=True)
+    return blocksparse_attention(q, k, v, mask, sm_scale=sm_scale,
+                                 block_M=block_M, block_N=block_N,
+                                 causal=True)
+
+
+def seer_reference(q, k, v, gate_logits, topk, block_M, block_N,
+                   sm_scale: Optional[float] = None):
+    mask = seer_block_mask(gate_logits, topk, block_M, block_N, causal=True)
+    return blocksparse_reference(q, k, v, mask, block_M, block_N,
+                                 sm_scale=sm_scale, causal=True)
