@@ -1,0 +1,35 @@
+"""Autotuned Conv2D: sweep the F-tile over the profiler, pick the best
+(reference examples/convolution/example_convolution_autotune.py flow)."""
+
+import pathlib
+import sys
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from example_convolution import convolution, ref_conv2d  # noqa: E402
+
+
+def main(N=2, C=128, H=16, W=16, F=256, K=3, S=1, D=1, P=1):
+    configs = [{"block_F": bf} for bf in (64, 128, 256) if bf <= F]
+    tuned = tilelang.autotune(configs=configs, warmup=1, rep=3)(convolution)
+    kernel = tuned(N, C, H, W, F, K, S, D, P)
+    print(f"best config: {kernel.config} @ {kernel.latency:.3f} ms")
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((N, H, W, C), dtype=np.float32)
+    weight = rng.standard_normal((K, K, C, F), dtype=np.float32)
+    padded = np.pad(data, ((0, 0), (P, P), (P, P), (0, 0)))
+    OH = (H + 2 * P - D * (K - 1) - 1) // S + 1
+    OW = (W + 2 * P - D * (K - 1) - 1) // S + 1
+    out = np.empty((N, OH, OW, F), dtype=np.float32)
+    kernel(padded, weight, out)
+    ref = np.asarray(ref_conv2d(data, weight, S, P, D))
+    np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-1)
+    print("autotuned conv2d correct.")
+
+
+if __name__ == "__main__":
+    main()

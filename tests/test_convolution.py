@@ -1,0 +1,29 @@
+"""Conv2D kernel vs lax.conv_general_dilated (reference
+examples/convolution/test_example_convolution.py behavior)."""
+
+import numpy as np
+import pytest
+
+from examples.convolution.example_convolution import convolution, ref_conv2d
+
+
+@pytest.mark.parametrize("N,C,H,W,F,K,S,D,P", [
+    (2, 128, 16, 16, 128, 3, 1, 1, 1),   # the canonical 3x3 same conv
+    (1, 64, 17, 17, 128, 3, 2, 1, 1),    # stride 2, odd spatial
+    (1, 32, 16, 16, 64, 3, 1, 2, 2),     # dilation 2
+    (1, 128, 8, 8, 128, 1, 1, 1, 0),     # 1x1 conv == GEMM
+    (1, 32, 12, 12, 64, 5, 2, 1, 2),     # 5x5 stride 2
+])
+def test_conv2d(N, C, H, W, F, K, S, D, P):
+    kernel = convolution(N, C, H, W, F, K, S, D, P,
+                         block_F=min(128, F))
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((N, H, W, C), dtype=np.float32)
+    weight = rng.standard_normal((K, K, C, F), dtype=np.float32)
+    padded = np.pad(data, ((0, 0), (P, P), (P, P), (0, 0)))
+    OH = (H + 2 * P - D * (K - 1) - 1) // S + 1
+    OW = (W + 2 * P - D * (K - 1) - 1) // S + 1
+    out = np.empty((N, OH, OW, F), dtype=np.float32)
+    kernel(padded, weight, out)
+    ref = np.asarray(ref_conv2d(data, weight, S, P, D))
+    np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-1)

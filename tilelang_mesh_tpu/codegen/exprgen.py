@@ -169,8 +169,11 @@ class ExprGen:
 
     def analyze_indices(self, buffer: Buffer, indices: Sequence[Any]):
         """Split access indices into per-dim (kind, payload):
-        ('var', var, residual_expr) | ('scalar', expr). Raises when the
-        pattern is not one par var with unit stride per dim."""
+        ('var', var, residual_expr, stride) | ('scalar', expr) |
+        ('fused', [vars outer->inner], residual_expr, span) — the fused kind
+        covers several tightly-nested par vars sharing one index dim (e.g.
+        ``buf[p * k + j]``), loaded as a span-long slice + reshape. Raises
+        when a dim uses a par var non-affinely or nesting is loose."""
         from ..ir.expr import affine_decompose, rebuild_affine
         out = []
         for i in indices:
@@ -194,15 +197,33 @@ class ExprGen:
                 out.append(("scalar", rebuild_affine(rest, const)
                             if rest or not isinstance(i, slice) else i))
                 continue
-            if len(pterms) > 1:
-                raise ExprGenError("an index dim mixes two T.Parallel vars")
-            (v, c), = pterms.values()
-            if c != 1:
-                raise ExprGenError(
-                    f"T.Parallel var {v.name} used with stride {c}; only "
-                    "unit-stride elementwise access vectorizes")
             residual = rebuild_affine(rest, const)
-            out.append(("var", v, residual))
+            ext_of = {id(v): e for v, e in self.par_vars}
+            if len(pterms) == 1:
+                (v, c), = pterms.values()
+                if c < 1:
+                    raise ExprGenError(
+                        f"T.Parallel var {v.name} used with negative "
+                        f"stride {c}")
+                out.append(("var", v, residual, c))
+                continue
+            # Fused axis: several par vars in one index dim, e.g.
+            # buf[i, p * k + j]. Require tight nesting (coeff of each var
+            # equals the span of the vars inside it) with unit innermost
+            # stride, so the access is a contiguous slice + reshape.
+            terms = sorted(pterms.values(), key=lambda vc: -vc[1])
+            if terms[-1][1] != 1:
+                raise ExprGenError(
+                    "fused-axis access needs unit stride on the innermost "
+                    f"T.Parallel var (got {terms[-1][1]})")
+            span = 1
+            for v, c in reversed(terms):
+                if c != span:
+                    raise ExprGenError(
+                        f"T.Parallel vars in one index dim must nest "
+                        f"tightly: {v.name} has stride {c}, expected {span}")
+                span *= ext_of[id(v)]
+            out.append(("fused", [v for v, _ in terms], residual, span))
         return out
 
     def _vector_load(self, e: BufferLoad) -> str:
@@ -215,22 +236,45 @@ class ExprGen:
                 "T.copy it into an on-chip buffer before reading")
         dims = self.analyze_indices(e.buffer, acc.local_indices(e.indices))
         parts, axes_vars = [], []
+        expanded, need_reshape = [], False
+        ext_of = dict((id(vv), xx) for vv, xx in self.par_vars)
         shape = acc.kernel_shape()
         for d, spec in enumerate(dims):
             if spec[0] == "scalar":
                 parts.append(self.scalar(spec[1]))
-            else:
-                _, v, resid = spec
-                ext = dict((id(vv), xx) for vv, xx in self.par_vars)[id(v)]
+            elif spec[0] == "fused":
+                _, vs, resid, span = spec
                 r = as_int(resid)
-                if r == 0 and shape[d] == ext:
+                if r == 0 and shape[d] == span:
+                    parts.append(":")
+                elif r is not None:
+                    parts.append(f"{r}:{r + span}")
+                else:
+                    parts.append(f"pl.ds({self.scalar(resid)}, {span})")
+                axes_vars.extend(vs)
+                expanded.extend(ext_of[id(v)] for v in vs)
+                need_reshape = True
+            else:
+                _, v, resid, stride = spec
+                ext = ext_of[id(v)]
+                r = as_int(resid)
+                if stride != 1:
+                    if r is None:
+                        raise ExprGenError(
+                            f"strided access on {v.name} needs a static base "
+                            "offset (pl.ds has no step)")
+                    parts.append(f"{r}:{r + ext * stride}:{stride}")
+                elif r == 0 and shape[d] == ext:
                     parts.append(":")
                 elif r is not None:
                     parts.append(f"{r}:{r + ext}")
                 else:
                     parts.append(f"pl.ds({self.scalar(resid)}, {ext})")
                 axes_vars.append(v)
+                expanded.append(ext)
         src = acc.load_sliced(parts)
+        if need_reshape:
+            src = f"jnp.reshape({src}, {tuple(expanded)})"
         return self._align_axes(src, axes_vars)
 
     def _align_axes(self, src: str, axes_vars: List[Var]) -> str:

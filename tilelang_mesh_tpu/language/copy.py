@@ -69,6 +69,8 @@ def clear(dst: Any):
 def c2d_im2col(img: Buffer, col: Buffer, nhw_step, c_step, kernel, stride,
                dilation, pad):
     raise NotImplementedError(
-        "T.c2d_im2col (TMA im2col) is not implemented yet; express "
-        "convolution as jax.lax.conv_general_dilated or an explicit im2col "
-        "GEMM schedule")
+        "T.c2d_im2col is a TMA-hardware gather (reference src/op/copy.cc "
+        "Conv2DIm2ColOp); TPUs have no im2col engine and a gather wastes "
+        "HBM bandwidth. Express conv as K*K shifted-window GEMMs instead — "
+        "every tap is a contiguous/strided VMEM slice feeding the MXU; see "
+        "examples/convolution/example_convolution.py")

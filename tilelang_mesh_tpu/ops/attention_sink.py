@@ -111,8 +111,13 @@ def attention_sink(q, k, v, sinks, causal: bool = True,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     window = 0 if window_size is None else int(window_size)
-    kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, min(block_M, Sq),
-                           min(block_N, Sk), bool(causal), window,
+    block_M, block_N = min(block_M, Sq), min(block_N, Sk)
+    if Sq % block_M or Sk % block_N:
+        raise ValueError(
+            f"attention_sink needs Sq % block_M == 0 and Sk % block_N == 0 "
+            f"(got Sq={Sq}, Sk={Sk}, block_M={block_M}, block_N={block_N})")
+    kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M,
+                           block_N, bool(causal), window,
                            float(sm_scale), str(q.dtype), num_stages)
     import jax.numpy as jnp
     return kern(q, k, v, jnp.asarray(sinks, jnp.float32))
