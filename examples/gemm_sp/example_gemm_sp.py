@@ -1,0 +1,59 @@
+"""2:4 structured-sparse GEMM (reference examples/gemm_sp/example_gemm_sp.py).
+
+The reference compresses A with CUTLASS metadata and hits mma.sp; here the
+host compresses with the int8 slot format (utils/sparse.py), the kernel
+streams the half-width values + metadata from HBM (half the A bandwidth of
+a dense GEMM) and T.gemm_sp decompresses each tile in VMEM before a dense
+MXU dot.
+"""
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.utils.sparse import compress, randn_semi_sparse
+
+
+@tilelang.jit
+def matmul_sp(M, N, K, block_M=128, block_N=128, block_K=128,
+              dtype="float32", accum_dtype="float32", num_stages=2):
+    @T.prim_func
+    def gemm_sp_kernel(
+            A_sparse: T.Tensor((M, K // 2), dtype),
+            E: T.Tensor((M, K // 2), "int8"),
+            B: T.Tensor((K, N), dtype),
+            C: T.Tensor((M, N), accum_dtype)):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_shared = T.alloc_shared((block_M, block_K // 2), dtype)
+            E_shared = T.alloc_shared((block_M, block_K // 2), "int8")
+            B_shared = T.alloc_shared((block_K, block_N), dtype)
+            C_local = T.alloc_fragment((block_M, block_N), accum_dtype)
+            T.clear(C_local)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                T.copy(A_sparse[by * block_M, ko * block_K // 2], A_shared)
+                T.copy(E[by * block_M, ko * block_K // 2], E_shared)
+                T.copy(B[ko * block_K, bx * block_N], B_shared)
+                T.gemm_sp(A_shared, E_shared, B_shared, C_local)
+            T.copy(C_local, C[by * block_M, bx * block_N])
+
+    return gemm_sp_kernel
+
+
+def main(M=256, N=256, K=256):
+    a = randn_semi_sparse(M, K, dtype=np.float32, seed=0)
+    b = np.random.default_rng(1).standard_normal((K, N), dtype=np.float32)
+    a_sparse, e = compress(a)
+    assert a_sparse.shape == (M, K // 2) and e.dtype == np.int8
+
+    kernel = matmul_sp(M, N, K)
+    c = np.empty((M, N), dtype=np.float32)
+    kernel(a_sparse, e, b, c)
+    np.testing.assert_allclose(c, a @ b, rtol=1e-2, atol=1e-1)
+    print(f"2:4 sparse GEMM {M}x{N}x{K}: matches dense reference ✓ "
+          f"(A bytes halved: {a.nbytes} -> {a_sparse.nbytes + e.nbytes})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""lazy_jit: shape-from-tensor kernel specialization (reference
+examples/lazy_jit/lazyjit.ipynb + tilelang/jit/__init__.py:547).
+
+Declare shapes with T.dynamic symbols; the first call with each concrete
+shape traces + compiles a specialized kernel (XLA needs static shapes), and
+later calls reuse the per-shape cache — the pragmatic answer to dynamic
+shapes on TPU (SURVEY §7 hard-parts)."""
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+M = T.dynamic("m")   # number of tokens: varies call to call
+N, K = 256, 256
+BM = 64
+
+
+@tilelang.lazy_jit(out_idx=[2])
+def matmul(A: T.Tensor((M, K), "float32"),
+           B: T.Tensor((K, N), "float32"),
+           C: T.Tensor((M, N), "float32")):
+    with T.Kernel(T.ceildiv(M, BM), T.ceildiv(N, 128)) as (bx, by):
+        A_s = T.alloc_shared((BM, K), "float32")
+        B_s = T.alloc_shared((K, 128), "float32")
+        C_l = T.alloc_fragment((BM, 128), "float32")
+        T.copy(A[bx * BM, 0], A_s)
+        T.copy(B[0, by * 128], B_s)
+        T.gemm(A_s, B_s, C_l, clear_accum=True)
+        T.copy(C_l, C[bx * BM, by * 128])
+
+
+def main():
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    for m in (64, 192, 64, 320):
+        a = rng.standard_normal((m, K), dtype=np.float32)
+        c = np.asarray(matmul(a, b))
+        np.testing.assert_allclose(c, a @ b, rtol=1e-2, atol=1e-1)
+        print(f"m={m:4d}: correct "
+              f"({len(matmul._kernels)} specialized kernel(s) cached)")
+    assert len(matmul._kernels) == 3, "m=64 must hit the cache"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""BitNet b1.58 int8 x int2 kernels (reference examples/bitnet-1.58b
+kernel_benchmark correctness checks)."""
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu.ops.bitnet import (bitnet_gemm_kernel, bitnet_linear,
+                                          bitnet_linear_reference,
+                                          pack_ternary, unpack_ternary)
+
+
+def test_pack_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.integers(-1, 2, (64, 32)).astype(np.int8)
+    np.testing.assert_array_equal(unpack_ternary(pack_ternary(w)), w)
+
+
+def test_pack_rejects_non_ternary():
+    with pytest.raises(ValueError, match="ternary"):
+        pack_ternary(np.full((4, 4), 2, np.int8))
+
+
+@pytest.mark.parametrize("M,N,K", [(1, 128, 256), (64, 128, 256),
+                                   (128, 256, 512)])
+def test_bitnet_gemm_exact(M, N, K):
+    rng = np.random.default_rng(1)
+    w = rng.integers(-1, 2, (K, N)).astype(np.int8)
+    a = rng.integers(-128, 128, (M, K)).astype(np.int8)
+    c = np.asarray(bitnet_gemm_kernel(M, N, K)(a, pack_ternary(w)))
+    np.testing.assert_array_equal(
+        c, a.astype(np.int32) @ w.astype(np.int32))
+
+
+def test_bitnet_linear_matches_emulation():
+    rng = np.random.default_rng(2)
+    K, N = 256, 128
+    w = rng.integers(-1, 2, (K, N)).astype(np.int8)
+    x = rng.standard_normal((2, 8, K)).astype(np.float32)
+    y = np.asarray(bitnet_linear(x, pack_ternary(w), 3.0))
+    ref = np.asarray(bitnet_linear_reference(x, w, 3.0))
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)

@@ -86,3 +86,25 @@ def test_fused_axis_requires_tight_nesting():
 
     with pytest.raises(Exception, match="nest tightly|stride"):
         tilelang.compile(bad)
+
+
+def test_bitwise_invert_and_reflected_shift():
+    M, N = 8, 128
+
+    @T.prim_func
+    def bits(A: T.Tensor((M, N), "int32"),
+             B: T.Tensor((M, N), "int32")):
+        with T.Kernel(1) as bx:
+            a = T.alloc_shared((M, N), "int32")
+            b = T.alloc_shared((M, N), "int32")
+            T.copy(A, a)
+            for i, j in T.Parallel(M, N):
+                # ~mask & v plus a reflected shift: 1 << (v & 3)
+                b[i, j] = (a[i, j] & ~3) + (1 << (a[i, j] & 3))
+            T.copy(b, B)
+
+    k = tilelang.compile(bits)
+    a = np.random.default_rng(0).integers(0, 1 << 20, (M, N)).astype(
+        np.int32)
+    ref = (a & ~3) + (1 << (a & 3))
+    np.testing.assert_array_equal(np.asarray(k(a)), ref)

@@ -1,0 +1,71 @@
+"""2:4 structured-sparse GEMM: T.gemm_sp + utils.sparse
+(reference examples/gemm_sp/test_example_gemm_sp.py behavior)."""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.utils.sparse import (compress, decompress,
+                                            randn_semi_sparse)
+
+
+def test_compress_roundtrip():
+    a = randn_semi_sparse(64, 128, seed=3)
+    vals, meta = compress(a)
+    assert vals.shape == (64, 64) and meta.dtype == np.int8
+    assert meta.min() >= 0 and meta.max() <= 3
+    np.testing.assert_array_equal(decompress(vals, meta), a)
+
+
+def test_compress_rejects_dense():
+    a = np.ones((4, 8), np.float32)  # 4 nonzeros per group
+    with pytest.raises(ValueError, match="not 2:4 sparse"):
+        compress(a)
+
+
+@pytest.mark.parametrize("M,N,K", [(128, 128, 128), (256, 128, 512)])
+def test_gemm_sp(M, N, K):
+    @T.prim_func
+    def kern(A_sparse: T.Tensor((M, K // 2), "float32"),
+             E: T.Tensor((M, K // 2), "int8"),
+             B: T.Tensor((K, N), "float32"),
+             C: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            A_s = T.alloc_shared((M, K // 2), "float32")
+            E_s = T.alloc_shared((M, K // 2), "int8")
+            B_s = T.alloc_shared((K, N), "float32")
+            C_l = T.alloc_fragment((M, N), "float32")
+            T.copy(A_sparse, A_s)
+            T.copy(E, E_s)
+            T.copy(B, B_s)
+            T.gemm_sp(A_s, E_s, B_s, C_l, clear_accum=True)
+            T.copy(C_l, C)
+
+    k = tilelang.compile(kern)
+    a = randn_semi_sparse(M, K, seed=0)
+    vals, meta = compress(a)
+    b = np.random.default_rng(1).standard_normal((K, N), dtype=np.float32)
+    c = np.empty((M, N), np.float32)
+    k(vals, meta, b, c)
+    np.testing.assert_allclose(c, a @ b, rtol=1e-2, atol=1e-1)
+
+
+def test_gemm_sp_rejects_sliced_operand():
+    M, K, N = 64, 128, 64
+
+    with pytest.raises(Exception, match="whole tiles"):
+        @T.prim_func
+        def kern(A_sparse: T.Tensor((M, K // 2), "float32"),
+                 E: T.Tensor((M, K // 2), "int8"),
+                 B: T.Tensor((K, N), "float32"),
+                 C: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                A_s = T.alloc_shared((M, K // 2), "float32")
+                E_s = T.alloc_shared((M, K // 2), "int8")
+                B_s = T.alloc_shared((K, N), "float32")
+                C_l = T.alloc_fragment((M, N), "float32")
+                T.gemm_sp(A_s[0:32, 0:K // 2], E_s[0:32, 0:K // 2],
+                          B_s, C_l)
+
+        tilelang.compile(kern)

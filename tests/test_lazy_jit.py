@@ -1,0 +1,121 @@
+"""lazy_jit shape specialization + dynamic dims + compile flags
+(reference testing/python/jit + examples/dynamic_shape behavior)."""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def _make_lazy(out_idx=None):
+    M = T.dynamic("m")
+    N = 128
+
+    @tilelang.lazy_jit(out_idx=out_idx)
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(T.ceildiv(M, 64)) as bx:
+            s = T.alloc_shared((64, N), "float32")
+            T.copy(A[bx * 64, 0], s)
+            for i, j in T.Parallel(64, N):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, B[bx * 64, 0])
+
+    return scale
+
+
+def test_lazy_jit_specializes_per_shape():
+    scale = _make_lazy(out_idx=[1])
+    for m in (64, 128, 64, 192):
+        a = np.random.default_rng(m).standard_normal((m, 128),
+                                                     dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(scale(a)), a * 2, rtol=1e-5)
+    assert len(scale._kernels) == 3  # m=64 reused
+
+
+def test_lazy_jit_output_arg_convention():
+    scale = _make_lazy()
+    a = np.random.default_rng(0).standard_normal((64, 128),
+                                                 dtype=np.float32)
+    out = np.empty_like(a)
+    scale(a, out)
+    np.testing.assert_allclose(out, a * 2, rtol=1e-5)
+
+
+def test_lazy_jit_wrong_arity():
+    scale = _make_lazy(out_idx=[1])
+    with pytest.raises(TypeError, match="input tensors"):
+        scale(np.zeros((64, 128), np.float32), np.zeros((64, 128),
+                                                        np.float32))
+
+
+def test_lazy_jit_inconsistent_dims():
+    M = T.dynamic("m")
+
+    @tilelang.lazy_jit(out_idx=[2])
+    def add(A: T.Tensor((M, 128), "float32"),
+            B: T.Tensor((M, 128), "float32"),
+            C: T.Tensor((M, 128), "float32")):
+        with T.Kernel(T.ceildiv(M, 64)) as bx:
+            s = T.alloc_shared((64, 128), "float32")
+            t = T.alloc_shared((64, 128), "float32")
+            T.copy(A[bx * 64, 0], s)
+            T.copy(B[bx * 64, 0], t)
+            for i, j in T.Parallel(64, 128):
+                s[i, j] = s[i, j] + t[i, j]
+            T.copy(s, C[bx * 64, 0])
+
+    with pytest.raises(ValueError):
+        add(np.zeros((64, 128), np.float32), np.zeros((128, 128),
+                                                      np.float32))
+
+
+def test_pass_configs_reach_pallas_call():
+    @T.prim_func
+    def copy(A: T.Tensor((128, 128), "float32"),
+             B: T.Tensor((128, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((128, 128), "float32")
+            T.copy(A, s)
+            T.copy(s, B)
+
+    k = tilelang.compile(
+        copy, pass_configs={"tl.tpu.vmem_limit_bytes": 32 * 1024 * 1024})
+    assert "vmem_limit_bytes" in k.get_kernel_source()
+
+
+def test_lazy_jit_tail_guard_uses_dyn_var():
+    # body references M beyond shapes: bounds guard must compile per shape
+    M = T.dynamic("m")
+
+    @tilelang.lazy_jit(out_idx=[1])
+    def relu_tail(A: T.Tensor((M, 128), "float32"),
+                  B: T.Tensor((M, 128), "float32")):
+        with T.Kernel(T.ceildiv(M, 64)) as bx:
+            s = T.alloc_shared((64, 128), "float32")
+            T.copy(A[bx * 64, 0], s)
+            for i, j in T.Parallel(64, 128):
+                s[i, j] = T.if_then_else(bx * 64 + i < M,
+                                         T.max(s[i, j], 0.0), 0.0)
+            T.copy(s, B[bx * 64, 0])
+
+    a = np.random.default_rng(0).standard_normal((128, 128),
+                                                 dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(relu_tail(a)), np.maximum(a, 0),
+                               rtol=1e-5)
+
+
+def test_lazy_jit_out_idx_out_of_range():
+    M = T.dynamic("m")
+
+    @tilelang.lazy_jit(out_idx=[5])
+    def k(A: T.Tensor((M, 128), "float32"),
+          B: T.Tensor((M, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((64, 128), "float32")
+            T.copy(A[0, 0], s)
+            T.copy(s, B[0, 0])
+
+    with pytest.raises(IndexError, match="out_idx"):
+        k(np.zeros((64, 128), np.float32))

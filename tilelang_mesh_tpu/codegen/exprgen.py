@@ -49,7 +49,7 @@ _CALLS = {
     "shift_right": "jnp.right_shift",
     "shift_left": "jnp.left_shift",
     "bitwise_and": "jnp.bitwise_and", "bitwise_or": "jnp.bitwise_or",
-    "bitwise_xor": "jnp.bitwise_xor",
+    "bitwise_xor": "jnp.bitwise_xor", "bitwise_not": "jnp.bitwise_not",
 }
 
 
@@ -78,6 +78,8 @@ class ExprGen:
             try:
                 return self.var_env[id(e)]
             except KeyError:
+                if e._bound is not None:  # dyn dim inside a lazy_jit compile
+                    return str(e._bound)
                 raise ExprGenError(f"unbound variable {e.name} in expression")
         if isinstance(e, IntImm):
             return str(e.value)
@@ -226,19 +228,18 @@ class ExprGen:
             out.append(("fused", [v for v, _ in terms], residual, span))
         return out
 
-    def _vector_load(self, e: BufferLoad) -> str:
-        acc = self.accessors.get(e.buffer.uid)
-        if acc is None:
-            raise ExprGenError(f"no accessor for buffer {e.buffer.name}")
-        if acc.kind == "any":
-            raise ExprGenError(
-                f"buffer {e.buffer.name} is HBM-resident (no block mapping); "
-                "T.copy it into an on-chip buffer before reading")
-        dims = self.analyze_indices(e.buffer, acc.local_indices(e.indices))
-        parts, axes_vars = [], []
-        expanded, need_reshape = [], False
-        ext_of = dict((id(vv), xx) for vv, xx in self.par_vars)
-        shape = acc.kernel_shape()
+    def slice_parts(self, dims, shape, extents,
+                    err=None) -> Tuple[list, list, list, bool]:
+        """Print analyzed index dims as subscript parts.
+
+        dims: analyze_indices output; shape: per-dim kernel-visible sizes;
+        extents: {id(Var): extent}. Returns (parts, axes_vars in loaded
+        order, expanded per-axis extents, fused_any). Shared by vector
+        loads and Parallel stores so slicing rules cannot drift.
+        """
+        err = err or ExprGenError
+        parts, axes_vars, expanded = [], [], []
+        fused_any = False
         for d, spec in enumerate(dims):
             if spec[0] == "scalar":
                 parts.append(self.scalar(spec[1]))
@@ -252,17 +253,17 @@ class ExprGen:
                 else:
                     parts.append(f"pl.ds({self.scalar(resid)}, {span})")
                 axes_vars.extend(vs)
-                expanded.extend(ext_of[id(v)] for v in vs)
-                need_reshape = True
+                expanded.extend(extents[id(v)] for v in vs)
+                fused_any = True
             else:
                 _, v, resid, stride = spec
-                ext = ext_of[id(v)]
+                ext = extents[id(v)]
                 r = as_int(resid)
                 if stride != 1:
                     if r is None:
-                        raise ExprGenError(
-                            f"strided access on {v.name} needs a static base "
-                            "offset (pl.ds has no step)")
+                        raise err(
+                            f"strided access on {v.name} needs a static "
+                            "base offset (pl.ds has no step)")
                     parts.append(f"{r}:{r + ext * stride}:{stride}")
                 elif r == 0 and shape[d] == ext:
                     parts.append(":")
@@ -272,8 +273,22 @@ class ExprGen:
                     parts.append(f"pl.ds({self.scalar(resid)}, {ext})")
                 axes_vars.append(v)
                 expanded.append(ext)
+        return parts, axes_vars, expanded, fused_any
+
+    def _vector_load(self, e: BufferLoad) -> str:
+        acc = self.accessors.get(e.buffer.uid)
+        if acc is None:
+            raise ExprGenError(f"no accessor for buffer {e.buffer.name}")
+        if acc.kind == "any":
+            raise ExprGenError(
+                f"buffer {e.buffer.name} is HBM-resident (no block mapping); "
+                "T.copy it into an on-chip buffer before reading")
+        dims = self.analyze_indices(e.buffer, acc.local_indices(e.indices))
+        ext_of = dict((id(vv), xx) for vv, xx in self.par_vars)
+        parts, axes_vars, expanded, fused = self.slice_parts(
+            dims, acc.kernel_shape(), ext_of)
         src = acc.load_sliced(parts)
-        if need_reshape:
+        if fused:
             src = f"jnp.reshape({src}, {tuple(expanded)})"
         return self._align_axes(src, axes_vars)
 

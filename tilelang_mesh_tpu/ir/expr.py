@@ -129,9 +129,52 @@ class PrimExpr:
     def __hash__(self):
         return id(self)
 
-    def __and__(self, o): return _binop("and", self, o)
-    def __or__(self, o): return _binop("or", self, o)
-    def __invert__(self): return Call("logical_not", [self], "bool")
+    # `&`/`|` follow TVM-script semantics: logical on bools, bitwise on ints
+    def __and__(self, o):
+        oo = convert(o)
+        if self.dtype == "bool" and oo.dtype == "bool":
+            return _binop("and", self, oo)
+        return Call("bitwise_and", [self, oo],
+                    promote_dtypes(self.dtype, oo.dtype))
+
+    def __rand__(self, o): return self.__and__(o)
+
+    def __or__(self, o):
+        oo = convert(o)
+        if self.dtype == "bool" and oo.dtype == "bool":
+            return _binop("or", self, oo)
+        return Call("bitwise_or", [self, oo],
+                    promote_dtypes(self.dtype, oo.dtype))
+
+    def __ror__(self, o): return self.__or__(o)
+
+    def __xor__(self, o):
+        oo = convert(o)
+        return Call("bitwise_xor", [self, oo],
+                    promote_dtypes(self.dtype, oo.dtype))
+
+    def __rxor__(self, o): return self.__xor__(o)
+
+    def __rshift__(self, o):
+        oo = convert(o)
+        return Call("shift_right", [self, oo], self.dtype)
+
+    def __rrshift__(self, o):
+        oo = convert(o)
+        return Call("shift_right", [oo, self], oo.dtype)
+
+    def __lshift__(self, o):
+        oo = convert(o)
+        return Call("shift_left", [self, oo], self.dtype)
+
+    def __rlshift__(self, o):
+        oo = convert(o)
+        return Call("shift_left", [oo, self], oo.dtype)
+
+    def __invert__(self):
+        if self.dtype == "bool":
+            return Call("logical_not", [self], "bool")
+        return Call("bitwise_not", [self], self.dtype)
 
     def __bool__(self):
         raise TypeError(
@@ -158,6 +201,7 @@ class Var(PrimExpr):
         self.dtype = canon_dtype(dtype)
         Var._counter[0] += 1
         self.uid = Var._counter[0]
+        self._bound = None  # concrete value during lazy_jit re-trace
 
     def same_as(self, other) -> bool:
         return self is other
@@ -262,6 +306,8 @@ def convert(v: Any) -> PrimExpr:
 def _const_val(e: PrimExpr) -> Optional[Union[int, float, bool]]:
     if isinstance(e, (IntImm, FloatImm, BoolImm)):
         return e.value
+    if isinstance(e, Var):
+        return e._bound
     return None
 
 
@@ -327,13 +373,55 @@ def const(value, dtype=None) -> PrimExpr:
     return e
 
 
+def substitute(e: Any, env: dict) -> Any:
+    """Replace Vars (by id or via their lazy_jit binding) with concrete
+    values, folding as it rebuilds."""
+    if isinstance(e, Var):
+        v = env.get(id(e), e._bound)
+        return convert(v) if v is not None else e
+    if isinstance(e, BinOp):
+        return _binop(e.op, substitute(e.a, env), substitute(e.b, env))
+    if isinstance(e, Cast):
+        return Cast(substitute(e.value, env), e.dtype)
+    if isinstance(e, Call):
+        return Call(e.name, [a if isinstance(a, str) else
+                             substitute(a, env) for a in e.args], e.dtype)
+    return e
+
+
 def as_int(e: Any) -> Optional[int]:
-    """Return a concrete Python int if the expression is statically known."""
+    """Return a concrete Python int if the expression is statically known.
+
+    During a lazy_jit re-trace, dyn Vars carry a concrete binding
+    (Var.bind/_bound) and fold like constants — that is what makes
+    `T.Kernel(T.ceildiv(M, bm))` with M = T.dynamic(...) compile per
+    call-site shape.
+    """
     if isinstance(e, int):
         return e
     if isinstance(e, IntImm):
         return e.value
+    if isinstance(e, Var) and e._bound is not None:
+        return e._bound
+    if isinstance(e, BinOp) and _any_bound_var(e):
+        se = substitute(e, {})
+        if isinstance(se, IntImm):
+            return se.value
     return None
+
+
+def _any_bound_var(e: Any) -> bool:
+    """Cheap pre-check so as_int only rebuilds when a binding can fold it."""
+    if isinstance(e, Var):
+        return e._bound is not None
+    if isinstance(e, BinOp):
+        return _any_bound_var(e.a) or _any_bound_var(e.b)
+    if isinstance(e, Cast):
+        return _any_bound_var(e.value)
+    if isinstance(e, Call):
+        return any(_any_bound_var(a) for a in e.args
+                   if not isinstance(a, str))
+    return False
 
 
 def ceildiv(a, b):

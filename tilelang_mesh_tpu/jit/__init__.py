@@ -170,13 +170,28 @@ class LazyJITImpl:
         sig = inspect.signature(self.fn)
         names = list(sig.parameters)
         annots = [sig.parameters[n].annotation for n in names]
-        if len(tensors) != len(names):
-            raise TypeError(f"lazy_jit kernel takes {len(names)} tensors, "
-                            f"got {len(tensors)}")
+        out_idx = self.jit_kwargs.get("out_idx")
+        if out_idx is not None:
+            # outputs are allocated by the kernel: the caller passes inputs
+            # only, and dims are solved from them (reference lazy_jit
+            # shape-from-tensor path, tilelang/jit/__init__.py:547)
+            idxs = [out_idx] if isinstance(out_idx, int) else list(out_idx)
+            for i in idxs:
+                if not -len(names) <= i < len(names):
+                    raise IndexError(
+                        f"out_idx {i} out of range for {len(names)} kernel "
+                        f"params")
+            outs = {i % len(names) for i in idxs}
+            in_pos = [i for i in range(len(names)) if i not in outs]
+        else:
+            in_pos = list(range(len(names)))
+        if len(tensors) != len(in_pos):
+            raise TypeError(f"lazy_jit kernel takes {len(in_pos)} input "
+                            f"tensors, got {len(tensors)}")
         binding: dict = {}
-        for pname, annot, t in zip(names, annots, tensors):
-            if isinstance(annot, TensorAnnot):
-                _solve_dims(annot.shape, t.shape, binding, pname)
+        for i, t in zip(in_pos, tensors):
+            if isinstance(annots[i], TensorAnnot):
+                _solve_dims(annots[i].shape, t.shape, binding, names[i])
         env_map = {k: v for k, (_, v) in binding.items()}
         shape_key = tuple(sorted((v.name, val)
                                  for v, val in binding.values()))
@@ -195,10 +210,19 @@ class LazyJITImpl:
             try:
                 for n, a in zip(names, concrete):
                     fn.__annotations__[n] = a
+                # bind dyn Vars so body uses (grid extents, bounds checks)
+                # fold to this call-site's concrete shape; compile must run
+                # inside the binding scope too — exprs traced un-foldable
+                # (e.g. tail guards `i < M`) still hold the Var and only
+                # resolve while its binding is live
+                for var, val in binding.values():
+                    var._bound = val
                 pf = trace_prim_func(fn)
+                kernel = compile(pf, **self.jit_kwargs)
             finally:
                 fn.__annotations__.update(orig)
-            kernel = compile(pf, **self.jit_kwargs)
+                for var, _ in binding.values():
+                    var._bound = None
             self._kernels[shape_key] = kernel
         return kernel(*tensors)
 

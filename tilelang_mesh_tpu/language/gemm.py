@@ -55,8 +55,50 @@ def gemm(A: Any, B: Any, C: Any, transpose_A: bool = False,
                     clear_accum, k_pack, wg_wait))
 
 
-def gemm_sp(A_sparse, E, B, C, **kwargs):
-    raise NotImplementedError(
-        "2:4 structured-sparse GEMM has no MXU instruction on TPU; "
-        "densify the operand or use a blocksparse schedule "
-        "(ops.blocksparse)")
+def gemm_sp(A_sparse, E, B, C, transpose_A: bool = False,
+            transpose_B: bool = False,
+            policy: GemmWarpPolicy = GemmWarpPolicy.Square,
+            clear_accum: bool = False, **kwargs):
+    """C += decompress(A_sparse, E) @ op(B) — 2:4 structured-sparse GEMM.
+
+    Reference: src/op/gemm_sp.cc lowers to mma.sp with CUTLASS-packed
+    metadata. TPUs have no sparse-MXU instruction, so this expands to a
+    VPU decompress (compare-select against the int8 slot metadata of
+    utils/sparse.py compress) into a VMEM scratch tile followed by a dense
+    MXU T.gemm — the HBM saving on the sparse operand is kept, the FLOPs
+    are dense.
+
+    A_sparse: (M, K//2) VMEM tile of kept values; E: (M, K//2) int8 slot
+    indices (0..3 within each K-group of 4); B: (K, N); C: (M, N) fragment.
+    """
+    if transpose_A:
+        raise NotImplementedError(
+            "gemm_sp with transpose_A: store A_sparse row-major (the "
+            "decompress scratch is row-major)")
+    from .allocate import alloc_shared
+    from .loop import Parallel
+    from .math_ops import if_then_else
+
+    A_r, E_r = to_region(A_sparse), to_region(E)
+    a_s, e_s = A_r.static_shape(), E_r.static_shape()
+    if a_s is None or len(a_s) != 2:
+        raise ValueError("gemm_sp needs a static 2-D A_sparse tile")
+    if e_s != a_s:
+        raise ValueError(
+            f"gemm_sp metadata shape {e_s} must match values {a_s}")
+    M, half = a_s
+    if half % 2:
+        raise ValueError("A_sparse second dim must be even (pairs per "
+                         "4-group)")
+    K = half * 2
+    if not (A_r.is_full() and E_r.is_full()):
+        raise ValueError("gemm_sp operands must be whole tiles (pass the "
+                         "buffers, not slices)")
+    Ab, Eb = A_r.buffer, E_r.buffer
+    dense = alloc_shared((M, K), Ab.dtype)
+    for i, g, p in Parallel(M, K // 4, 4):
+        dense[i, g * 4 + p] = (
+            if_then_else(Eb[i, g * 2] == p, Ab[i, g * 2], 0.0) +
+            if_then_else(Eb[i, g * 2 + 1] == p, Ab[i, g * 2 + 1], 0.0))
+    gemm(dense, B, C, transpose_A=False, transpose_B=transpose_B,
+         policy=policy, clear_accum=clear_accum)

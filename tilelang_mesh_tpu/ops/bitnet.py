@@ -1,0 +1,117 @@
+"""BitNet b1.58 ternary-weight linear ops.
+
+Behavioral mirror of the reference's examples/bitnet-1.58b kernels
+(kernel_benchmark/tilelang_bitnet_158_int8xint2_prefill.py /_decode.py +
+utils_quant.py BitLinear): weights are ternary {-1, 0, 1} packed four to an
+int8 byte, activations are per-token absmax-quantized int8, the GEMM runs
+int8 x int8 -> int32 and dequantizes by (activation_scale x weight_scale).
+
+TPU redesign: the reference decodes int2->int8 with a PTX bit-twiddle inside
+the MMA pipeline; here the decode is a VPU compare/shift over the packed
+tile in VMEM (fused-axis unpack) and the matmul is the MXU's native
+int8 path (jax.lax.dot_general with int32 accumulation).
+"""
+
+import functools
+
+import numpy as np
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+
+def pack_ternary(w: np.ndarray) -> np.ndarray:
+    """Pack a ternary (K, N) matrix into (K//4, N) int8, 2 bits per value.
+
+    Values must be in {-1, 0, 1}; stored biased (+1) so each field is
+    unsigned 0..2 (reference general_compress + interleave_weight,
+    tilelang_bitnet_158_int8xint2_decode.py:178-197 — the interleave step
+    is CUDA-lane-specific and dropped here).
+    """
+    K, N = w.shape
+    if K % 4:
+        raise ValueError(f"K must be a multiple of 4, got {K}")
+    if not np.isin(w, (-1, 0, 1)).all():
+        raise ValueError("weights must be ternary {-1, 0, 1}")
+    biased = (w.astype(np.int32) + 1).reshape(K // 4, 4, N)
+    packed = (biased[:, 0] | (biased[:, 1] << 2) | (biased[:, 2] << 4)
+              | (biased[:, 3] << 6))
+    return packed.astype(np.uint8).view(np.int8)
+
+
+def unpack_ternary(packed: np.ndarray) -> np.ndarray:
+    """Host inverse of pack_ternary (reference decode_i2s_to_i8s semantics)."""
+    Kq, N = packed.shape
+    u = packed.view(np.uint8).astype(np.int32)
+    fields = np.stack([(u >> (2 * i)) & 3 for i in range(4)], axis=1)
+    return (fields - 1).reshape(Kq * 4, N).astype(np.int8)
+
+
+@functools.lru_cache(maxsize=None)
+def bitnet_gemm_kernel(M, N, K, block_M=128, block_N=128, block_K=256,
+                       num_stages=2):
+    """int8 activations x int2-packed ternary weights -> int32."""
+    block_M = min(block_M, M)
+    block_N = min(block_N, N)
+    block_K = min(block_K, K)
+
+    @T.prim_func
+    def bitnet_gemm(A: T.Tensor((M, K), "int8"),
+                    Wp: T.Tensor((K // 4, N), "int8"),
+                    C: T.Tensor((M, N), "int32")):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_s = T.alloc_shared((block_M, block_K), "int8")
+            Wp_s = T.alloc_shared((block_K // 4, block_N), "int8")
+            W_s = T.alloc_shared((block_K, block_N), "int8")
+            C_l = T.alloc_fragment((block_M, block_N), "int32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, block_K),
+                                  num_stages=num_stages):
+                T.copy(A[by * block_M, ko * block_K], A_s)
+                T.copy(Wp[ko * block_K // 4, bx * block_N], Wp_s)
+                for g, p, j in T.Parallel(block_K // 4, 4, block_N):
+                    W_s[g * 4 + p, j] = (
+                        T.shift_right(Wp_s[g, j], 2 * p) & 3) - 1
+                T.gemm(A_s, W_s, C_l)
+            T.copy(C_l, C[by * block_M, bx * block_N])
+
+    return _tl_compile(bitnet_gemm)
+
+
+def quantize_activations(x):
+    """Per-token absmax quantization to int8 (reference utils_quant.py
+    BitLinear.activation_quant: scale = 127 / absmax per row)."""
+    import jax.numpy as jnp
+    absmax = jnp.clip(jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-5,
+                      None)
+    scale = 127.0 / absmax
+    q = jnp.clip(jnp.round(x * scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def bitnet_linear(x, packed_w, w_scale):
+    """y = x @ W / (act_scale * w_scale) with W ternary int2-packed.
+
+    x: (..., K) float; packed_w: (K//4, N) int8; w_scale: scalar — the
+    1/mean(|w|) factor of BitLinear weight_quant. Returns float32.
+    """
+    import jax.numpy as jnp
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = packed_w.shape[1]
+    x2 = x.reshape(-1, K)
+    q, scale = quantize_activations(x2)
+    kern = bitnet_gemm_kernel(x2.shape[0], N, K)
+    acc = kern(q, packed_w)
+    y = acc.astype(jnp.float32) / (scale * w_scale)
+    return y.reshape(*lead, N)
+
+
+def bitnet_linear_reference(x, w_ternary, w_scale):
+    """Float emulation of BitLinear for tests (reference utils_quant.py)."""
+    import jax.numpy as jnp
+    q, scale = quantize_activations(x.reshape(-1, x.shape[-1]))
+    acc = q.astype(jnp.int32) @ w_ternary.astype(jnp.int32)
+    y = acc.astype(jnp.float32) / (scale * w_scale)
+    return y.reshape(*x.shape[:-1], w_ternary.shape[1])

@@ -1,0 +1,69 @@
+"""2:4 structured-sparsity host utilities.
+
+Mirror of the reference's tilelang/utils/sparse.py (compress /
+randn_semi_sparse, which delegate to CUTLASS/torch packed formats). TPU
+re-design: there is no sparse-MXU instruction, so kernels decompress tiles
+in VMEM and run the dense MXU — the win is the halved HBM traffic on the
+sparse operand. The metadata format is therefore chosen for VPU decompress,
+not for an mma.sp instruction: one int8 per kept value giving its slot
+(0..3) inside its group of four along K.
+
+  A (M, K), 2:4 sparse  ->  A_sparse (M, K//2) values, E (M, K//2) int8
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def randn_semi_sparse(M: int, K: int, dtype=np.float32,
+                      seed: int = 0) -> np.ndarray:
+    """Random dense matrix with exact 2:4 sparsity along K
+    (reference tilelang/utils/sparse.py:108 randn_semi_sparse)."""
+    if K % 4:
+        raise ValueError(f"K must be a multiple of 4, got {K}")
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((M, K)).astype(dtype)
+    groups = a.reshape(M, K // 4, 4)
+    # keep the two largest |x| per group, zero the rest
+    order = np.argsort(-np.abs(groups), axis=2)
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :, :2], True, axis=2)
+    return (groups * mask).reshape(M, K)
+
+
+def compress(A: np.ndarray):
+    """Compress a 2:4-sparse (M, K) matrix into (values, metadata)
+    (reference tilelang/utils/sparse.py:76 compress).
+
+    Returns (A_sparse (M, K//2) of A.dtype, E (M, K//2) int8) where
+    E[i, g*2+s] is the position (0..3) of value A_sparse[i, g*2+s] inside
+    K-group g. Groups with fewer than two nonzeros keep zeros in the unused
+    slots (their positions are the remaining indices, in order).
+    """
+    M, K = A.shape
+    if K % 4:
+        raise ValueError(f"K must be a multiple of 4, got {K}")
+    groups = A.reshape(M, K // 4, 4)
+    nonzero = groups != 0
+    if (nonzero.sum(axis=2) > 2).any():
+        raise ValueError("matrix is not 2:4 sparse: a group of 4 along K "
+                         "has more than 2 nonzeros")
+    # stable order: nonzero positions first, then zeros — always 2 slots
+    key = np.where(nonzero, 0, 1) * 4 + np.arange(4)
+    order = np.argsort(key, axis=2, kind="stable")[:, :, :2]
+    order.sort(axis=2)  # keep original K order between the two kept slots
+    vals = np.take_along_axis(groups, order, axis=2)
+    return (vals.reshape(M, K // 2).astype(A.dtype),
+            order.reshape(M, K // 2).astype(np.int8))
+
+
+def decompress(A_sparse: np.ndarray, E: np.ndarray) -> np.ndarray:
+    """Inverse of compress (host reference for tests)."""
+    M, half = A_sparse.shape
+    K = half * 2
+    out = np.zeros((M, K // 4, 4), dtype=A_sparse.dtype)
+    vals = A_sparse.reshape(M, K // 4, 2)
+    idx = E.reshape(M, K // 4, 2).astype(np.int64)
+    np.put_along_axis(out, idx, vals, axis=2)
+    return out.reshape(M, K)
