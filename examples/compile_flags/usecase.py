@@ -54,7 +54,7 @@ def main():
         np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
         print(f"{name:10s} compile: correct (tol {tol})")
     src = k_tuned.get_kernel_source()
-    assert "vmem_limit_bytes" in src or "dimension_semantics" in src, \
+    assert f"vmem_limit_bytes={64 * 1024 * 1024}" in src, \
         "pass configs must reach the generated pallas_call"
     print("pass_configs reached the generated kernel ✓")
 

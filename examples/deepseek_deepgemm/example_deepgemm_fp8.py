@@ -23,6 +23,10 @@ def deepgemm_fp8(M, N, K, block_N=128, out_dtype="float32",
                  num_stages=2):
     block_M, block_K = 128, GROUP
     k_groups = (K + GROUP - 1) // GROUP
+    if block_N % GROUP:
+        raise ValueError(f"block_N ({block_N}) must be a multiple of the "
+                         f"scale group size {GROUP}")
+    n_segs = block_N // GROUP  # scale rows covered by one N block
 
     @T.prim_func
     def gemm_fp8_blockscaled(
@@ -36,7 +40,7 @@ def deepgemm_fp8(M, N, K, block_N=128, out_dtype="float32",
             A_s = T.alloc_shared((block_M, block_K), "float8_e4m3fn")
             B_s = T.alloc_shared((block_N, block_K), "float8_e4m3fn")
             sa_s = T.alloc_shared((block_M, 1), "float32")
-            sb_s = T.alloc_shared((1, 1), "float32")
+            sb_s = T.alloc_shared((n_segs, 1), "float32")
             C_partial = T.alloc_fragment((block_M, block_N), "float32")
             C_accum = T.alloc_fragment((block_M, block_N), "float32")
             T.clear(C_accum)
@@ -45,12 +49,15 @@ def deepgemm_fp8(M, N, K, block_N=128, out_dtype="float32",
                 T.copy(A[by * block_M, k * block_K], A_s)
                 T.copy(B[bx * block_N, k * block_K], B_s)
                 T.copy(scales_a[by * block_M, k], sa_s)
-                T.copy(scales_b[bx * block_N // GROUP, k], sb_s)
+                T.copy(scales_b[bx * n_segs, k], sb_s)
                 T.gemm(A_s, B_s, C_partial, transpose_B=True,
                        clear_accum=True)
-                for i, j in T.Parallel(block_M, block_N):
-                    C_accum[i, j] += (C_partial[i, j] *
-                                      (sa_s[i, 0] * sb_s[0, 0]))
+                # each GROUP-wide N segment carries its own B scale
+                for seg in range(n_segs):
+                    for i, j in T.Parallel(block_M, GROUP):
+                        C_accum[i, seg * GROUP + j] += (
+                            C_partial[i, seg * GROUP + j] *
+                            (sa_s[i, 0] * sb_s[seg, 0]))
             T.copy(C_accum, C[by * block_M, bx * block_N])
 
     return gemm_fp8_blockscaled

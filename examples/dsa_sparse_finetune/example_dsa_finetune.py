@@ -1,0 +1,66 @@
+"""Sparse fine-tuning through DSA attention (reference
+examples/dsa_sparse_finetune: dsa.py + sparse_mla_bwd.py).
+
+The sparse MLA op is made differentiable with jax.custom_vjp: the forward
+pass runs the gather kernel (with LSE saved), the backward recomputes
+through an XLA take_along_axis gather — gradients flow into both the
+queries and the latent KV cache, which is exactly what DSA fine-tuning
+updates. A tiny training loop drives the loss down to show the path works
+end to end.
+"""
+
+import numpy as np
+
+from tilelang_mesh_tpu.ops.dsa import (lightning_indexer, make_sparse_mla,
+                                       sparse_mla_reference, topk_selector)
+
+
+def main(B=1, S=32, Skv=64, H=4, D=128, DT=64, topk=16):
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+
+    # select tokens once with the indexer (indices are not differentiated,
+    # matching the reference finetune setup)
+    q_idx = rng.standard_normal((B, S, 4, 32), dtype=np.float32)
+    k_idx = rng.standard_normal((B, Skv, 32), dtype=np.float32)
+    w = rng.standard_normal((B, S, 4)).astype(np.float32)
+    indices = np.asarray(topk_selector(lightning_indexer(q_idx, k_idx, w),
+                                       topk))
+
+    sparse_mla = make_sparse_mla(block_I=16)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D + DT),
+                                        dtype=np.float32))
+    kv = jnp.asarray(rng.standard_normal((B, Skv, D + DT),
+                                         dtype=np.float32))
+    target = jnp.asarray(rng.standard_normal((B, S, H, D),
+                                             dtype=np.float32))
+
+    def loss_fn(q, kv):
+        o = sparse_mla(q, kv, indices)
+        return jnp.mean((o.astype(jnp.float32) - target) ** 2)
+
+    # gradient check vs the pure-XLA dense-gather reference
+    ref_loss = lambda q, kv: jnp.mean(
+        (sparse_mla_reference(q, kv, indices)[0].astype(jnp.float32)
+         - target) ** 2)
+    g_kernel = jax.grad(loss_fn, argnums=(0, 1))(q, kv)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1))(q, kv)
+    for a, b, name in zip(g_kernel, g_ref, ("dq", "dkv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-3)
+    print("grads through sparse MLA match the dense-gather reference ✓")
+
+    losses = []
+    lr = 0.05
+    for step in range(8):
+        l, (dq, dkv) = jax.value_and_grad(loss_fn, argnums=(0, 1))(q, kv)
+        q, kv = q - lr * dq, kv - lr * dkv
+        losses.append(float(l))
+    assert losses[-1] < losses[0], f"loss must fall: {losses}"
+    print(f"finetune loop: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+          f"over {len(losses)} steps ✓")
+
+
+if __name__ == "__main__":
+    main()

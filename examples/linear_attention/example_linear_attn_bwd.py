@@ -1,0 +1,54 @@
+"""Linear attention backward via operand-swapped reuse of the forward
+kernel (reference examples/linear_attention/example_linear_attn_bwd.py).
+
+For o_t = sum_{s<=t} (q_t.k_s) v_s the three gradients are themselves
+causal/anti-causal linear attentions:
+
+  dq_t = sum_{s<=t} (do_t.v_s) k_s          = linattn(do, v, k)
+  dv_t = sum_{i>=t} (k_t.q_i) do_i          = rev(linattn(rev k, rev q, rev do))
+  dk_t = sum_{i>=t} (v_t.do_i) q_i          = rev(linattn(rev v, rev do, rev q))
+
+so the backward pass is three invocations of the SAME chunked MXU kernel —
+no separate bwd kernel needed (the reference writes one by hand in CUDA).
+"""
+
+import numpy as np
+
+from tilelang_mesh_tpu.ops.linear_attention import (
+    linear_attention, linear_attention_reference)
+
+
+def linear_attention_grads(q, k, v, do, chunk=128):
+    import jax.numpy as jnp
+    rev = lambda x: jnp.flip(x, axis=2)
+    dq = linear_attention(do, v, k, chunk=chunk)
+    dv = rev(linear_attention(rev(k), rev(q), rev(do), chunk=chunk))
+    dk = rev(linear_attention(rev(v), rev(do), rev(q), chunk=chunk))
+    return dq, dk, dv
+
+
+def main(B=1, H=2, S=256, D=64):
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((B, H, S, D), dtype=np.float32) * 0.3
+    k = rng.standard_normal((B, H, S, D), dtype=np.float32) * 0.3
+    v = rng.standard_normal((B, H, S, D), dtype=np.float32)
+    do = rng.standard_normal((B, H, S, D), dtype=np.float32)
+
+    dq, dk, dv = linear_attention_grads(q, k, v, do)
+    # autodiff reference through the dense formulation
+    f = lambda q, k, v: jnp.sum(
+        linear_attention_reference(q, k, v).astype(jnp.float32) *
+        jnp.asarray(do))
+    rq, rk, rv = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b, n in ((dq, rq, "dq"), (dk, rk, "dk"), (dv, rv, "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-2, atol=1e-1)
+    print("linear attention bwd: three operand-swapped fwd kernels "
+          "reproduce autodiff grads ✓")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Retention (RetNet) forward: linear attention with per-head exponential
+decay (reference examples/linear_attention/example_retention_fwd.py)."""
+
+import numpy as np
+
+from tilelang_mesh_tpu.ops.linear_attention import (retention,
+                                                    retention_reference)
+
+
+def main(B=1, H=4, S=256, D=64):
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((B, H, S, D), dtype=np.float32) * 0.3
+    k = rng.standard_normal((B, H, S, D), dtype=np.float32) * 0.3
+    v = rng.standard_normal((B, H, S, D), dtype=np.float32)
+    gamma = 1.0 - 2.0 ** (-5.0 - np.arange(H, dtype=np.float32))
+    out = np.asarray(retention(q, k, v, gamma, chunk=64))
+    ref = np.asarray(retention_reference(q, k, v, gamma))
+    np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
+    print(f"retention fwd (decays {np.round(gamma, 4)}): chunked == dense ✓")
+
+
+if __name__ == "__main__":
+    main()

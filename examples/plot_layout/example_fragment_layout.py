@@ -1,0 +1,22 @@
+"""Render fragment (sublane, lane) packings (reference examples/plot_layout/
+fragment_mma_load_a.py — which plots CUDA mma thread fragments; on TPU the
+analog is the dtype-dependent (sublane, lane) VMEM tile packing)."""
+
+from tilelang_mesh_tpu.analysis import (visualize_fragment,
+                                        visualize_mesh_blocks)
+
+
+def main():
+    for bits in (32, 16, 8):
+        txt = visualize_fragment(16, 256, dtype_bits=bits, max_rows=4,
+                                 max_cols=6)
+        print(txt)
+        assert "sublane=" in txt and "lane=" in txt
+    mesh = visualize_mesh_blocks(4, 4)
+    print(mesh)
+    assert "4x4 mesh" in mesh
+    print("fragment + mesh layout maps rendered ✓")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Split-phase DMA (T.copy_async / T.copy_wait / T.alloc_semaphore) —
+TPU-native warp-specialization analog (reference
+src/transform/warp_specialized_rewriter.cc behavior)."""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def test_double_buffered_gemm():
+    M, N, K, BK = 128, 128, 512, 128
+    nstep = K // BK
+
+    @T.prim_func
+    def db(A: T.Tensor((M, K), "float32"),
+           B: T.Tensor((K, N), "float32"),
+           C: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            A_s = T.alloc_shared((2, M, BK), "float32")
+            B_s = T.alloc_shared((2, BK, N), "float32")
+            acc = T.alloc_fragment((M, N), "float32")
+            sems = T.alloc_semaphore(4)
+            T.clear(acc)
+            T.copy_async(A[0, 0], A_s[0, 0:M, 0:BK], sems, 0)
+            T.copy_async(B[0, 0], B_s[0, 0:BK, 0:N], sems, 2)
+            for ko in range(nstep):
+                cur, nxt = ko % 2, (ko + 1) % 2
+                if ko + 1 < nstep:
+                    T.copy_async(A[0, (ko + 1) * BK],
+                                 A_s[nxt, 0:M, 0:BK], sems, nxt)
+                    T.copy_async(B[(ko + 1) * BK, 0],
+                                 B_s[nxt, 0:BK, 0:N], sems, 2 + nxt)
+                T.copy_wait(A[0, ko * BK], A_s[cur, 0:M, 0:BK], sems, cur)
+                T.copy_wait(B[ko * BK, 0], B_s[cur, 0:BK, 0:N],
+                            sems, 2 + cur)
+                T.gemm(A_s[cur, 0:M, 0:BK], B_s[cur, 0:BK, 0:N], acc)
+            T.copy(acc, C)
+
+    k = tilelang.compile(db)
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((M, K), dtype=np.float32)
+    b = rng.standard_normal((K, N), dtype=np.float32)
+    c = np.empty((M, N), np.float32)
+    k(a, b, c)
+    np.testing.assert_allclose(c, a @ b, rtol=1e-2, atol=1e-1)
+
+
+def test_async_vmem_to_hbm_store():
+    M, N = 128, 256
+
+    @T.prim_func
+    def st(A: T.Tensor((M, N), "float32"),
+           B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            sems = T.alloc_semaphore(1)
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] + 1.0
+            T.copy_async(s, B, sems, 0)
+            T.copy_wait(s, B, sems, 0)
+
+    k = tilelang.compile(st)
+    a = np.random.default_rng(1).standard_normal((M, N), dtype=np.float32)
+    out = np.empty_like(a)
+    k(a, out)
+    np.testing.assert_allclose(out, a + 1, rtol=1e-6)
+
+
+def test_copy_async_requires_semaphore_buffer():
+    with pytest.raises(Exception, match="alloc_semaphore"):
+        @T.prim_func
+        def bad(A: T.Tensor((64, 64), "float32"),
+                B: T.Tensor((64, 64), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((64, 64), "float32")
+                notsem = T.alloc_shared((4,), "int32")
+                T.copy_async(A, s, notsem, 0)
+
+        tilelang.compile(bad)
+
+
+def test_copy_async_rejects_dtype_conversion():
+    with pytest.raises(Exception, match="convert dtypes"):
+        @T.prim_func
+        def bad(A: T.Tensor((64, 64), "float32"),
+                B: T.Tensor((64, 64), "bfloat16")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((64, 64), "bfloat16")
+                sems = T.alloc_semaphore(1)
+                T.copy_async(A, s, sems, 0)
+
+        tilelang.compile(bad)

@@ -1,0 +1,67 @@
+"""DeepSeek V3.2 DSA kernels: indexer, selector, sparse MLA
+(reference examples/deepseek_v32/test_tilelang_example_deepseek_v32.py
+behavior)."""
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu.ops.dsa import (lightning_indexer, sparse_mla_fwd,
+                                       sparse_mla_reference, topk_selector)
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    rng = np.random.default_rng(0)
+    B, S, Skv, HI, DI = 1, 64, 128, 4, 32
+    q_idx = rng.standard_normal((B, S, HI, DI), dtype=np.float32)
+    k_idx = rng.standard_normal((B, Skv, DI), dtype=np.float32)
+    w = rng.standard_normal((B, S, HI)).astype(np.float32)
+    logits = np.asarray(lightning_indexer(q_idx, k_idx, w))
+    return rng, q_idx, k_idx, w, logits
+
+
+def test_indexer_matches_dense(pipeline):
+    _, q_idx, k_idx, w, logits = pipeline
+    ref = np.einsum("bthd,bjd->bthj", q_idx, k_idx)
+    ref = (np.maximum(ref, 0) * w[:, :, :, None]).sum(axis=2)
+    S, Skv = logits.shape[1:]
+    mask = np.arange(Skv)[None, None, :] <= np.arange(S)[None, :, None]
+    ref = np.where(mask, ref, -np.inf)
+    np.testing.assert_allclose(logits, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_selector_matches_argsort(pipeline):
+    _, _, _, _, logits = pipeline
+    topk = 32
+    idx = np.asarray(topk_selector(logits, topk))
+    full = np.where(np.isfinite(logits), logits, -np.inf)
+    ref = np.argsort(-full, axis=-1, kind="stable")[..., :topk].astype(
+        np.int32)
+    vis = np.isfinite(logits).sum(axis=-1)
+    for t in range(logits.shape[1]):
+        ref[0, t, vis[0, t]:] = -1
+    np.testing.assert_array_equal(idx, ref)
+
+
+def test_sparse_mla_fwd(pipeline):
+    rng, _, _, _, logits = pipeline
+    idx = np.asarray(topk_selector(logits, 32))
+    B, S = logits.shape[:2]
+    Skv = logits.shape[2]
+    H, D, DT = 8, 128, 64
+    q = rng.standard_normal((B, S, H, D + DT), dtype=np.float32)
+    kv = rng.standard_normal((B, Skv, D + DT), dtype=np.float32)
+    o, lse = sparse_mla_fwd(q, kv, idx, block_I=16)
+    o_ref, lse_ref = sparse_mla_reference(q, kv, idx)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_mla_rejects_indivisible_topk():
+    q = np.zeros((1, 8, 4, 192), np.float32)
+    kv = np.zeros((1, 16, 192), np.float32)
+    idx = np.zeros((1, 8, 30), np.int32)
+    with pytest.raises(ValueError, match="multiple of block_I"):
+        sparse_mla_fwd(q, kv, idx, block_I=16)

@@ -28,6 +28,17 @@ def dma(src, dst, sem):
     cp.wait()
 
 
+def dma_start(src, dst, sem):
+    """Issue a split-phase DMA (T.copy_async); completion lands on sem."""
+    pltpu.make_async_copy(src, dst, sem).start()
+
+
+def dma_wait(src, dst, sem):
+    """Block on a split-phase DMA (T.copy_wait). The descriptor is rebuilt
+    from equally-shaped refs; only the transfer size and semaphore matter."""
+    pltpu.make_async_copy(src, dst, sem).wait()
+
+
 def max_value(dtype):
     d = jnp.dtype(dtype)
     if jnp.issubdtype(d, jnp.floating):

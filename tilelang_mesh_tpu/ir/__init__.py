@@ -6,7 +6,8 @@ from .expr import (PrimExpr, Var, IntImm, FloatImm, BoolImm, StringImm,
                    canon_dtype, dtype_bits, dtype_is_float, dtype_is_int,
                    promote_dtypes, linearize, free_vars)
 from .buffer import Buffer, Region, to_region
-from .stmt import (Stmt, SeqStmt, AllocStmt, KernelNode, ForNest, IfThenElse,
+from .stmt import (Stmt, SeqStmt, AllocStmt, AsyncCopyStmt, KernelNode,
+                   ForNest, IfThenElse,
                    BufferStoreStmt, EvaluateStmt, CopyStmt, GemmStmt, FillStmt,
                    ReduceStmt, CumSumStmt, AtomicStmt, PrintStmt, AssertStmt,
                    CommStmt, CommBroadcast, CommPut, CommAllGather,

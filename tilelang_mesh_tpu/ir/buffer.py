@@ -19,7 +19,7 @@ from typing import Any, Optional, Sequence, Tuple
 from .expr import (PrimExpr, BufferLoad, Var, canon_dtype, convert, as_int)
 
 SCOPES = ("global", "shared", "shared.dyn", "fragment", "local", "local.var",
-          "smem")
+          "smem", "sem")
 
 
 class Buffer:

@@ -382,7 +382,7 @@ def substitute(e: Any, env: dict) -> Any:
     if isinstance(e, BinOp):
         return _binop(e.op, substitute(e.a, env), substitute(e.b, env))
     if isinstance(e, Cast):
-        return Cast(substitute(e.value, env), e.dtype)
+        return Cast(e.dtype, substitute(e.value, env))
     if isinstance(e, Call):
         return Call(e.name, [a if isinstance(a, str) else
                              substitute(a, env) for a in e.args], e.dtype)

@@ -145,6 +145,11 @@ class _Printer:
     def p_CopyStmt(self, s):
         self.emit(f"copy({region_str(s.src)} -> {region_str(s.dst)})")
 
+    def p_AsyncCopyStmt(self, s):
+        self.emit(f"copy_{s.phase}({region_str(s.src)} -> "
+                  f"{region_str(s.dst)}, sem={s.sem.name}"
+                  f"[{expr_str(s.slot)}])")
+
     def p_GemmStmt(self, s):
         flags = ""
         if s.trans_A:

@@ -106,6 +106,22 @@ class CopyStmt(Stmt):
         self.coalesced_width = coalesced_width
 
 
+class AsyncCopyStmt(Stmt):
+    """T.copy_async / T.copy_wait — explicit split-phase DMA with a user
+    semaphore slot. The TPU-native form of the reference's warp-specialized
+    producer/consumer overlap (src/transform/warp_specialized_rewriter.cc):
+    instead of producer warps + mbarriers, the kernel issues the DMA early
+    ("start") and blocks on its semaphore right before use ("wait")."""
+
+    def __init__(self, src: Region, dst: Region, sem, slot, phase: str):
+        assert phase in ("start", "wait")
+        self.src = src
+        self.dst = dst
+        self.sem = sem          # the T.alloc_semaphore buffer
+        self.slot = slot        # index into the semaphore array
+        self.phase = phase
+
+
 class GemmStmt(Stmt):
     """T.gemm — cf. reference src/op/gemm.cc. Lowers to one MXU dot
     (jnp.dot with f32 accumulation) instead of the CUTLASS template zoo."""

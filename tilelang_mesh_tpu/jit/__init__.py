@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -158,6 +159,9 @@ def _subst_shape(shape, env_map):
     return tuple(out)
 
 
+_LAZY_BIND_LOCK = threading.Lock()
+
+
 class LazyJITImpl:
     def __init__(self, fn: Callable, **jit_kwargs):
         functools.update_wrapper(self, fn)
@@ -206,23 +210,29 @@ class LazyJITImpl:
                 else:
                     concrete.append(annot)
             fn = self.fn
-            orig = dict(fn.__annotations__)
-            try:
-                for n, a in zip(names, concrete):
-                    fn.__annotations__[n] = a
-                # bind dyn Vars so body uses (grid extents, bounds checks)
-                # fold to this call-site's concrete shape; compile must run
-                # inside the binding scope too — exprs traced un-foldable
-                # (e.g. tail guards `i < M`) still hold the Var and only
-                # resolve while its binding is live
-                for var, val in binding.values():
-                    var._bound = val
-                pf = trace_prim_func(fn)
-                kernel = compile(pf, **self.jit_kwargs)
-            finally:
-                fn.__annotations__.update(orig)
-                for var, _ in binding.values():
-                    var._bound = None
+            # Var._bound is process-global mutable state: serialize all
+            # lazy_jit specializations so a concurrent trace (par_compile
+            # runs a ThreadPoolExecutor in this module) can never fold
+            # against another call-site's shape
+            with _LAZY_BIND_LOCK:
+                orig = dict(fn.__annotations__)
+                try:
+                    for n, a in zip(names, concrete):
+                        fn.__annotations__[n] = a
+                    # bind dyn Vars so body uses (grid extents, bounds
+                    # checks) fold to this call-site's concrete shape;
+                    # compile must run inside the binding scope too —
+                    # exprs traced un-foldable (e.g. tail guards `i < M`)
+                    # still hold the Var and only resolve while its
+                    # binding is live
+                    for var, val in binding.values():
+                        var._bound = val
+                    pf = trace_prim_func(fn)
+                    kernel = compile(pf, **self.jit_kwargs)
+                finally:
+                    fn.__annotations__.update(orig)
+                    for var, _ in binding.values():
+                        var._bound = None
             self._kernels[shape_key] = kernel
         return kernel(*tensors)
 

@@ -24,11 +24,11 @@ from .kernel import Kernel
 
 # allocation
 from .allocate import (alloc_shared, alloc_fragment, alloc_local, alloc_var,
-                       alloc_reducer, alloc_barrier, alloc_tmem,
-                       alloc_descriptor)
+                       alloc_reducer, alloc_semaphore, alloc_barrier,
+                       alloc_tmem, alloc_descriptor)
 
 # data movement
-from .copy import copy, fill, clear, c2d_im2col
+from .copy import copy, copy_async, copy_wait, fill, clear, c2d_im2col
 
 # compute
 from .gemm import gemm, gemm_sp, GemmWarpPolicy

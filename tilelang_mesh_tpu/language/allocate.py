@@ -54,9 +54,19 @@ def _gpu_only(what: str, hint: str):
     return f
 
 
+def alloc_semaphore(n: int = 1) -> Buffer:
+    """An array of n DMA semaphores for split-phase T.copy_async /
+    T.copy_wait (the TPU analog of the reference's T.alloc_barrier +
+    warp-specialized producer/consumer, tilelang/language/allocate.py
+    alloc_barrier)."""
+    b = require_builder()
+    return b.alloc_buffer((int(n),), "int32", "sem", "sem")
+
+
 alloc_barrier = _gpu_only(
-    "alloc_barrier", "Pallas semaphores (pltpu.SemaphoreType) are allocated "
-    "by the compiler for DMA; use T.Pipelined for overlap")
+    "alloc_barrier", "mbarriers do not exist on TPU; allocate DMA "
+    "semaphores with T.alloc_semaphore(n) and pair T.copy_async/"
+    "T.copy_wait for producer/consumer overlap")
 alloc_tmem = _gpu_only(
     "alloc_tmem", "tcgen05 tensor memory does not exist on TPU; accumulate in "
     "a T.alloc_fragment buffer")

@@ -41,20 +41,69 @@ def copy(src: Any, dst: Any, coalesced_width: Optional[int] = None,
     dst_hint = _extent_hint(dst)
     src_r = to_region(src, extent_hint=dst_hint)
     dst_r = to_region(dst, extent_hint=src_hint or tuple(src_r.shape))
-    # validate extents where static
-    ss, ds = src_r.static_shape(), dst_r.static_shape()
-    if ss is not None and ds is not None:
-        # right-aligned broadcast compare (leading 1s allowed)
-        a, c = list(ss), list(ds)
-        while len(a) < len(c):
-            a.insert(0, 1)
-        while len(c) < len(a):
-            c.insert(0, 1)
-        for x, y in zip(a, c):
-            if x != y and x != 1 and y != 1:
-                raise ValueError(
-                    f"T.copy extent mismatch: src {ss} vs dst {ds}")
+    _validate_extents(src_r, dst_r, "T.copy")
     b.emit(CopyStmt(src_r, dst_r, coalesced_width))
+
+
+def _validate_extents(src_r, dst_r, what: str):
+    """Right-aligned broadcast compare of static extents (leading 1s ok)."""
+    ss, ds = src_r.static_shape(), dst_r.static_shape()
+    if ss is None or ds is None:
+        return
+    a, c = list(ss), list(ds)
+    while len(a) < len(c):
+        a.insert(0, 1)
+    while len(c) < len(a):
+        c.insert(0, 1)
+    for x, y in zip(a, c):
+        if x != y and x != 1 and y != 1:
+            raise ValueError(f"{what} extent mismatch: src {ss} vs dst {ds}")
+
+
+def _async_stmt(src, dst, sem, slot, phase):
+    from ..ir import AsyncCopyStmt, Buffer as _Buf
+    b = require_builder()
+
+    def fit(hint, obj):
+        # drop leading unit extents so a sliced-region hint can describe a
+        # lower-rank element-base operand (A_s[0, 0:M, 0:K] -> A[i, j])
+        if hint is None or not isinstance(obj, (Buffer, BufferLoad)):
+            return hint
+        rank = obj.ndim if isinstance(obj, Buffer) else obj.buffer.ndim
+        h = list(hint)
+        while len(h) > rank and h[0] == 1:
+            h.pop(0)
+        return tuple(h)
+
+    src_hint = _extent_hint(src)
+    dst_hint = _extent_hint(dst)
+    src_r = to_region(src, extent_hint=fit(dst_hint, src))
+    dst_r = to_region(dst, extent_hint=fit(src_hint, dst) or
+                      tuple(src_r.shape))
+    if not isinstance(sem, _Buf) or sem.scope != "sem":
+        raise ValueError("sem must come from T.alloc_semaphore(n)")
+    if src_r.buffer.dtype != dst_r.buffer.dtype:
+        raise ValueError("T.copy_async cannot convert dtypes; stage through "
+                         "VMEM and cast")
+    _validate_extents(src_r, dst_r, f"T.copy_{phase}")
+    b.emit(AsyncCopyStmt(src_r, dst_r, sem, convert(slot), phase))
+
+
+def copy_async(src: Any, dst: Any, sem, slot=0):
+    """Start an async DMA; completion is signalled on sem[slot].
+
+    The split-phase form of T.copy: issue early, overlap compute, then
+    T.copy_wait before use — the TPU-native expression of the reference's
+    warp-specialized producer/consumer (warp_specialized_rewriter.cc)."""
+    _async_stmt(src, dst, sem, slot, "start")
+
+
+def copy_wait(src: Any, dst: Any, sem, slot=0):
+    """Block until the DMA issued with the same (shape, sem[slot]) lands.
+
+    src/dst restate the copy being awaited (their indices may differ from
+    the issuing iteration; shapes and the semaphore slot must match)."""
+    _async_stmt(src, dst, sem, slot, "wait")
 
 
 def fill(dst: Any, value):

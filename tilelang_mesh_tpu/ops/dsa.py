@@ -1,0 +1,270 @@
+"""DeepSeek V3.2 sparse attention (DSA): lightning indexer, top-k token
+selector, sparse MLA forward, and the differentiable wrapper used for
+sparse fine-tuning.
+
+Behavioral mirror of the reference's examples/deepseek_v32
+(fp8_lighting_indexer.py, topk_selector.py, sparse_mla_fwd.py) and
+examples/dsa_sparse_finetune (dsa.py, sparse_mla_bwd.py):
+
+  1. indexer:   logits[b,t,j] = sum_h w[b,t,h] * relu(qI[b,t,h,:]·kI[b,j,:])
+  2. selector:  per (b, t) causal top-k token ids from the logits
+  3. sparse MLA fwd: each query token attends only its top-k tokens of the
+     shared latent KV (dim + tail rope dims); returns (O, LSE)
+  4. sparse_mla: custom-vjp wrapper — forward runs the gather kernel, the
+     backward recomputes through an XLA take_along_axis gather (the
+     reference writes sparse_mla_bwd.py as a second gather kernel; on TPU
+     the XLA gather path is the pragmatic bwd at finetune scale).
+
+TPU design notes: the per-token KV gather is a serial in-kernel DMA loop at
+data-dependent offsets (the NSA block-gather pattern at token granularity);
+scores/softmax run in the exp2 domain on the MXU/VPU.
+"""
+
+import functools
+import math
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+
+_LOG2E = 1.44269504
+
+
+# ---------------------------------------------------------------------------
+# 1. lightning indexer
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def lightning_indexer_kernel(B, S, Skv, HI, DI, block_T, dtype):
+    """Index logits with causal mask: (B, S, Skv) f32.
+
+    QI (B, S, HI, DI), KI (B, Skv, DI), W (B, S, HI) f32.
+    Reference: deepseek_v32/fp8_lighting_indexer.py
+    mqa_attn_return_logits_kernel (relu(q·k) head-reduced by weights).
+    """
+    @T.prim_func
+    def indexer(QI: T.Tensor((B, S, HI, DI), dtype),
+                KI: T.Tensor((B, Skv, DI), dtype),
+                W: T.Tensor((B, S, HI), "float32"),
+                L: T.Tensor((B, S, Skv), "float32")):
+        with T.Kernel(T.ceildiv(S, block_T), B) as (bt, bz):
+            k_s = T.alloc_shared((Skv, DI), dtype)
+            q_s = T.alloc_shared((block_T, DI), dtype)
+            w_s = T.alloc_shared((block_T, HI), "float32")
+            s_f = T.alloc_fragment((block_T, Skv), "float32")
+            out = T.alloc_fragment((block_T, Skv), "float32")
+            T.copy(KI[bz, 0, 0], k_s)
+            T.copy(W[bz, bt * block_T, 0], w_s)
+            T.fill(out, 0)
+            for h in range(HI):
+                T.copy(QI[bz, bt * block_T:(bt + 1) * block_T, h, 0:DI],
+                       q_s)
+                T.gemm(q_s, k_s, s_f, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(block_T, Skv):
+                    out[i, j] = out[i, j] + T.max(s_f[i, j], 0) * w_s[i, h]
+            # causal mask: key j visible to query t when j <= t
+            for i, j in T.Parallel(block_T, Skv):
+                out[i, j] = T.if_then_else(
+                    j <= bt * block_T + i, out[i, j],
+                    -T.infinity("float32"))
+            T.copy(out, L[bz, bt * block_T, 0])
+
+    return _tl_compile(indexer)
+
+
+def lightning_indexer(q_index, k_index, weights, block_T=64):
+    """q_index (B, S, HI, DI), k_index (B, Skv, DI), weights (B, S, HI)."""
+    B, S, HI, DI = q_index.shape
+    Skv = k_index.shape[1]
+    kern = lightning_indexer_kernel(B, S, Skv, HI, DI, min(block_T, S),
+                                    str(q_index.dtype))
+    return kern(q_index, k_index, weights)
+
+
+# ---------------------------------------------------------------------------
+# 2. top-k token selector
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def topk_selector_kernel(B, S, Skv, topk, block_T):
+    """Per-row top-k indices (iterative argmax-and-mask, reference
+    deepseek_v32/topk_selector.py). Masked (-inf) entries select index -1
+    when fewer than topk keys are visible."""
+    @T.prim_func
+    def select(L: T.Tensor((B, S, Skv), "float32"),
+               I: T.Tensor((B, S, topk), "int32")):
+        with T.Kernel(T.ceildiv(S, block_T), B) as (bt, bz):
+            frag = T.alloc_fragment((block_T, Skv), "float32")
+            mx = T.alloc_fragment((block_T,), "float32")
+            emx = T.alloc_fragment((block_T, Skv), "int32")
+            mi = T.alloc_fragment((block_T,), "int32")
+            idx = T.alloc_fragment((block_T, topk), "int32")
+            T.copy(L[bz, bt * block_T, 0], frag)
+            for k in range(topk):
+                T.reduce_max(frag, mx, dim=1, clear=True)
+                for i, j in T.Parallel(block_T, Skv):
+                    emx[i, j] = T.if_then_else(
+                        (mx[i] == frag[i, j]) & (mx[i] > -1e30),
+                        -j, -(Skv + 1))
+                T.reduce_max(emx, mi, dim=1, clear=True)
+                for i, j in T.Parallel(block_T, Skv):
+                    frag[i, j] = T.if_then_else(
+                        mi[i] == -j, -T.infinity("float32"), frag[i, j])
+                for i in T.Parallel(block_T):
+                    idx[i, k] = T.if_then_else(mi[i] == -(Skv + 1),
+                                               -1, -mi[i])
+            T.copy(idx, I[bz, bt * block_T, 0])
+
+    return _tl_compile(select)
+
+
+def topk_selector(logits, topk, block_T=64):
+    B, S, Skv = logits.shape
+    kern = topk_selector_kernel(B, S, Skv, topk, min(block_T, S))
+    return kern(logits)
+
+
+# ---------------------------------------------------------------------------
+# 3. sparse MLA forward
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI, sm_scale, dtype):
+    """Per-token gathered MLA attention.
+
+    Q (B, S, H, D+DT); KV (B, Skv, D+DT) shared latent (kv_group=1);
+    Indices (B, S, topk) int32 (-1 = invalid); O (B, S, H, D);
+    Lse (B, S, H) f32 (natural-log domain).
+    Reference: deepseek_v32/sparse_mla_fwd.py.
+    """
+    scale = sm_scale * _LOG2E
+    n_blk = topk // BI
+
+    @T.prim_func
+    def mla_fwd(Q: T.Tensor((B, S, H, D + DT), dtype),
+                KV: T.Tensor((B, Skv, D + DT), dtype),
+                Ind: T.Tensor((B, S, topk), "int32"),
+                O: T.Tensor((B, S, H, D), dtype),
+                Lse: T.Tensor((B, S, H), "float32")):
+        with T.Kernel(S, B) as (t, bz):
+            Q_s = T.alloc_shared((H, D + DT), dtype)
+            KV_s = T.alloc_shared((BI, D + DT), dtype)
+            Idx = T.alloc_shared((topk,), "int32")
+            st = alloc_softmax_state(H, BI, D, dtype)
+            S_f, acc, l = st["S"], st["acc"], st["l"]
+            out = T.alloc_fragment((H, D), "float32")
+            lse = T.alloc_fragment((H,), "float32")
+
+            T.copy(Q[bz, t, 0, 0], Q_s)
+            T.copy(Ind[bz, t, 0], Idx)
+            init_softmax_state(st)
+            for ib in T.serial(n_blk):
+                # zero the tile: rows of invalid (-1) indices must hold 0s,
+                # not scratch garbage — P@V multiplies them by 0 and
+                # 0 * garbage-NaN would poison the accumulator
+                T.fill(KV_s, 0)
+                # token-granular gather: one DMA per selected KV row
+                for r in T.serial(BI):
+                    with T.If(Idx[ib * BI + r] >= 0):
+                        T.copy(KV[bz, Idx[ib * BI + r], 0],
+                               KV_s[r, 0:D + DT])
+                T.gemm(Q_s, KV_s, S_f, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(H, BI):
+                    S_f[i, j] = T.if_then_else(
+                        (Idx[ib * BI + j] >= 0) & (Idx[ib * BI + j] <= t),
+                        S_f[i, j] * scale, -T.infinity("float32"))
+                online_softmax_update(st, KV_s[0:BI, 0:D], H, BI, D)
+            for i, j in T.Parallel(H, D):
+                out[i, j] = acc[i, j] / T.max(l[i], 1e-30)
+            for i in T.Parallel(H):
+                # back to natural log: lse = m + log2(l) all over log2e
+                lse[i] = (st["m_prev"][i] + T.log2(T.max(l[i], 1e-30))) \
+                    / _LOG2E
+            T.copy(out, O[bz, t, 0, 0])
+            T.copy(lse, Lse[bz, t, 0])
+
+    return _tl_compile(mla_fwd)
+
+
+def sparse_mla_fwd(q, kv, indices, sm_scale=None, block_I=64):
+    """q (B, S, H, D+DT) with D = kv latent dim, DT = rope tail; kv
+    (B, Skv, D+DT); indices (B, S, topk). Returns (o (B,S,H,D), lse)."""
+    B, S, H, Dfull = q.shape
+    Skv = kv.shape[1]
+    topk = indices.shape[-1]
+    DT = 64 if Dfull % 128 else 0  # rope tail convention: D multiple of 128
+    D = Dfull - DT
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(Dfull)
+    BI = min(block_I, topk)
+    if topk % BI:
+        raise ValueError(f"topk ({topk}) must be a multiple of block_I "
+                         f"({BI})")
+    kern = sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI,
+                                 float(sm_scale), str(q.dtype))
+    return kern(q, kv, indices)
+
+
+def sparse_mla_reference(q, kv, indices, sm_scale=None):
+    """Dense gather emulation (reference ref_sparse_mla_fwd_interface)."""
+    import jax.numpy as jnp
+    B, S, H, Dfull = q.shape
+    DT = 64 if Dfull % 128 else 0
+    D = Dfull - DT
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(Dfull)
+    topk = indices.shape[-1]
+    safe = jnp.maximum(indices, 0)
+    g = jnp.take_along_axis(kv[:, None, :, :],
+                            safe[:, :, :, None].repeat(Dfull, -1), axis=2)
+    # g: (B, S, topk, Dfull)
+    scores = jnp.einsum("bshd,bskd->bshk", q.astype(jnp.float32),
+                        g.astype(jnp.float32)) * sm_scale
+    t_ids = jnp.arange(S)[None, :, None]
+    valid = (indices >= 0) & (indices <= t_ids)
+    scores = jnp.where(valid[:, :, None, :], scores, -jnp.inf)
+    m = scores.max(axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = p.sum(axis=-1, keepdims=True)
+    o = jnp.einsum("bshk,bskd->bshd", p / jnp.maximum(l, 1e-30),
+                   g[..., :D].astype(jnp.float32))
+    lse = (m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)))
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# 4. differentiable sparse MLA (dsa_sparse_finetune)
+# ---------------------------------------------------------------------------
+
+def make_sparse_mla(sm_scale=None, block_I=64):
+    """Returns a differentiable sparse_mla(q, kv, indices) -> o.
+
+    Forward runs the gather kernel; backward recomputes through the XLA
+    gather (reference dsa_sparse_finetune/sparse_mla_bwd.py writes this as
+    a second tile kernel; the XLA path is equivalent math at finetune
+    scale and lets jax.grad flow into q and kv)."""
+    import jax
+
+    @jax.custom_vjp
+    def sparse_mla(q, kv, indices):
+        o, _ = sparse_mla_fwd(q, kv, indices, sm_scale=sm_scale,
+                              block_I=block_I)
+        return o
+
+    def fwd(q, kv, indices):
+        o, lse = sparse_mla_fwd(q, kv, indices, sm_scale=sm_scale,
+                                block_I=block_I)
+        return o, (q, kv, indices)
+
+    def bwd(res, do):
+        q, kv, indices = res
+        def ref(qq, kk):
+            o, _ = sparse_mla_reference(qq, kk, indices, sm_scale=sm_scale)
+            return o
+        _, vjp = __import__("jax").vjp(ref, q, kv)
+        dq, dkv = vjp(do)
+        return dq, dkv, None
+
+    sparse_mla.defvjp(fwd, bwd)
+    return sparse_mla

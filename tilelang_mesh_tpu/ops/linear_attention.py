@@ -76,3 +76,87 @@ def linear_attention_reference(q, k, v):
     s = jnp.where(mask, s, 0.0)
     return jnp.einsum("bhst,bhtv->bhsv", s,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def retention_kernel(B, H, S, DK, DV, chunk, dtype="float32"):
+    """Retention (RetNet) forward: linear attention with per-head
+    exponential decay gamma (reference examples/linear_attention/
+    example_retention_fwd.py). Chunked form: intra-chunk decay matrix
+    gamma^(i-j), inter-chunk state decayed by gamma^chunk."""
+    NC = S // chunk
+
+    @T.prim_func
+    def retention(Q: T.Tensor((B, H, S, DK), dtype),
+                  K: T.Tensor((B, H, S, DK), dtype),
+                  V: T.Tensor((B, H, S, DV), dtype),
+                  Gamma: T.Tensor((H,), "float32"),
+                  O: T.Tensor((B, H, S, DV), dtype)):
+        with T.Kernel(H, B) as (by, bz):
+            Q_s = T.alloc_shared((chunk, DK), dtype)
+            K_s = T.alloc_shared((chunk, DK), dtype)
+            Kd_s = T.alloc_shared((chunk, DK), dtype)
+            V_s = T.alloc_shared((chunk, DV), dtype)
+            g_s = T.alloc_shared((1,), "float32")
+            state = T.alloc_fragment((DK, DV), "float32")
+            attn = T.alloc_fragment((chunk, chunk), "float32")
+            attn_c = T.alloc_fragment((chunk, chunk), dtype)
+            out = T.alloc_fragment((chunk, DV), "float32")
+            out_c = T.alloc_fragment((chunk, DV), dtype)
+            T.copy(Gamma[by], g_s)
+            T.fill(state, 0)
+            for c in T.serial(NC):
+                T.copy(Q[bz, by, c * chunk, 0], Q_s)
+                T.copy(K[bz, by, c * chunk, 0], K_s)
+                T.copy(V[bz, by, c * chunk, 0], V_s)
+                # inter-chunk: gamma^(i+1) * q_i @ state
+                T.gemm(Q_s, state, out, clear_accum=True)
+                for i, j in T.Parallel(chunk, DV):
+                    out[i, j] = out[i, j] * T.exp2(
+                        T.log2(g_s[0]) * (i + 1))
+                # intra-chunk: gamma^(i-j) causal mask
+                T.gemm(Q_s, K_s, attn, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(chunk, chunk):
+                    attn[i, j] = T.if_then_else(
+                        i >= j,
+                        attn[i, j] * T.exp2(T.log2(g_s[0]) * (i - j)), 0.0)
+                T.copy(attn, attn_c)
+                T.gemm(attn_c, V_s, out)
+                # state = gamma^chunk * state + (gamma^(chunk-1-j) k_j)^T v_j
+                for i, j in T.Parallel(chunk, DK):
+                    Kd_s[i, j] = K_s[i, j] * T.exp2(
+                        T.log2(g_s[0]) * (chunk - 1 - i))
+                for i, j in T.Parallel(DK, DV):
+                    state[i, j] = state[i, j] * T.exp2(
+                        T.log2(g_s[0]) * chunk)
+                T.gemm(Kd_s, V_s, state, transpose_A=True)
+                T.copy(out, out_c)
+                T.copy(out_c, O[bz, by, c * chunk, 0])
+
+    return _tl_compile(retention)
+
+
+def retention(q, k, v, gamma, chunk=64):
+    """RetNet retention: o_t = sum_{s<=t} gamma^(t-s) (q_t.k_s) v_s."""
+    import numpy as np
+    B, H, S, DK = q.shape
+    DV = v.shape[-1]
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk //= 2
+    kern = retention_kernel(B, H, S, DK, DV, chunk, str(q.dtype))
+    return kern(q, k, v, np.asarray(gamma, np.float32))
+
+
+def retention_reference(q, k, v, gamma):
+    import jax.numpy as jnp
+    S = q.shape[2]
+    s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    t_i = jnp.arange(S)[:, None]
+    t_j = jnp.arange(S)[None, :]
+    decay = jnp.where(t_i >= t_j,
+                      jnp.asarray(gamma, jnp.float32)[:, None, None]
+                      ** (t_i - t_j), 0.0)
+    return jnp.einsum("bhst,bhtv->bhsv", s * decay[None],
+                      v.astype(jnp.float32)).astype(q.dtype)

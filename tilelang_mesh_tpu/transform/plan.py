@@ -21,7 +21,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..ir import (AllocStmt, AtomicStmt, Buffer, BufferLoad, BufferStoreStmt,
+from ..ir import (AllocStmt, AsyncCopyStmt, AtomicStmt, Buffer, BufferLoad,
+                  BufferStoreStmt,
                   CommStmt, CopyStmt, CumSumStmt, FillStmt, ForNest, GemmStmt,
                   IfThenElse, KernelNode, PrimFunc, Region, ReduceStmt,
                   SeqStmt, Stmt, as_int, collect, linearize, free_vars)
@@ -212,7 +213,7 @@ def _writers(stmts_root: Stmt) -> Dict[int, int]:
         counts[buf.uid] = counts.get(buf.uid, 0) + 1
 
     def visit(s):
-        if isinstance(s, CopyStmt):
+        if isinstance(s, (CopyStmt, AsyncCopyStmt)):
             bump(s.dst.buffer)
         elif isinstance(s, (FillStmt,)):
             bump(s.dst.buffer)
@@ -403,6 +404,13 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                 continue
             if isinstance(s, CopyStmt):
                 consider_copy(s, False, serial_vars)
+            elif isinstance(s, AsyncCopyStmt):
+                # split-phase DMA is explicit by design: never BlockSpec-map
+                # or alias its global operands
+                if s.src.buffer.scope == "global":
+                    _merge_param(plans, s.src.buffer, "in", None, None)
+                if s.dst.buffer.scope == "global":
+                    _merge_param(plans, s.dst.buffer, "out", None, None)
             elif isinstance(s, GemmStmt):
                 consider_region_read(s.A, serial_vars)
                 consider_region_read(s.B, serial_vars)
