@@ -25,7 +25,9 @@ def test_indexer_matches_dense(pipeline):
     ref = np.einsum("bthd,bjd->bthj", q_idx, k_idx)
     ref = (np.maximum(ref, 0) * w[:, :, :, None]).sum(axis=2)
     S, Skv = logits.shape[1:]
-    mask = np.arange(Skv)[None, None, :] <= np.arange(S)[None, :, None]
+    # queries default to the tail of the KV timeline: offset = Skv - S
+    mask = (np.arange(Skv)[None, None, :] <=
+            (Skv - S) + np.arange(S)[None, :, None])
     ref = np.where(mask, ref, -np.inf)
     np.testing.assert_allclose(logits, ref, rtol=1e-3, atol=1e-3)
 
@@ -65,3 +67,44 @@ def test_sparse_mla_rejects_indivisible_topk():
     idx = np.zeros((1, 8, 30), np.int32)
     with pytest.raises(ValueError, match="multiple of block_I"):
         sparse_mla_fwd(q, kv, idx, block_I=16)
+
+
+def test_indexer_non_divisible_seq():
+    rng = np.random.default_rng(5)
+    B, S, Skv, HI, DI = 1, 96, 96, 2, 32  # S % 64 != 0
+    q_idx = rng.standard_normal((B, S, HI, DI), dtype=np.float32)
+    k_idx = rng.standard_normal((B, Skv, DI), dtype=np.float32)
+    w = rng.standard_normal((B, S, HI)).astype(np.float32)
+    logits = np.asarray(lightning_indexer(q_idx, k_idx, w))
+    ref = np.einsum("bthd,bjd->bthj", q_idx, k_idx)
+    ref = (np.maximum(ref, 0) * w[:, :, :, None]).sum(axis=2)
+    mask = np.arange(Skv)[None, None, :] <= np.arange(S)[None, :, None]
+    ref = np.where(mask, ref, -np.inf)
+    np.testing.assert_allclose(logits, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_indexer_cache_offset():
+    # queries are the TAIL of a longer KV timeline: every key must be
+    # reachable by the last query
+    rng = np.random.default_rng(6)
+    B, S, Skv = 1, 32, 64
+    q_idx = rng.standard_normal((B, S, 2, 32), dtype=np.float32)
+    k_idx = rng.standard_normal((B, Skv, 32), dtype=np.float32)
+    w = np.abs(rng.standard_normal((B, S, 2))).astype(np.float32)
+    logits = np.asarray(lightning_indexer(q_idx, k_idx, w))
+    off = Skv - S
+    mask = (np.arange(Skv)[None, None, :] <=
+            off + np.arange(S)[None, :, None])
+    assert np.isfinite(logits[0, -1]).all(), \
+        "last query must see the whole cache"
+    assert (np.isfinite(logits) == mask).all()
+
+
+def test_sparse_mla_tail_dim_required_when_ambiguous():
+    q = np.zeros((1, 8, 4, 256), np.float32)  # 256 % 128 == 0: ambiguous
+    kv = np.zeros((1, 16, 256), np.float32)
+    idx = np.zeros((1, 8, 16), np.int32)
+    with pytest.raises(ValueError, match="tail_dim"):
+        sparse_mla_fwd(q, kv, idx, block_I=16)
+    o, lse = sparse_mla_fwd(q, kv, idx, block_I=16, tail_dim=64)
+    assert o.shape == (1, 8, 4, 192)

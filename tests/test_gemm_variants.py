@@ -1,6 +1,7 @@
 """Split-K / stream-K / GEMV / block-sparse GEMM vs dense references."""
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -75,3 +76,27 @@ def test_blocksparse_gemm():
     dense_mask = np.kron(np.asarray(mask), np.ones((bm, bn))) != 0
     assert_allclose(out[dense_mask], ref[dense_mask], rtol=1e-4, atol=1e-4)
     assert np.abs(out[~dense_mask]).max() == 0.0
+
+
+def test_varlen_grouped_gemm():
+    import jax.numpy as jnp
+    from tilelang_mesh_tpu.ops.grouped_gemm import (
+        varlen_grouped_matmul, varlen_grouped_matmul_reference)
+    rng = np.random.default_rng(3)
+    sizes = (130, 0, 64, 257)
+    K, N = 128, 128
+    a = jnp.asarray(rng.standard_normal((sum(sizes), K)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((len(sizes), K, N)), jnp.float32)
+    out = varlen_grouped_matmul(a, b, sizes)
+    ref = varlen_grouped_matmul_reference(a, b, sizes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-2, atol=1e-1)
+
+
+def test_varlen_grouped_gemm_validates():
+    import jax.numpy as jnp
+    from tilelang_mesh_tpu.ops.grouped_gemm import varlen_grouped_matmul
+    a = jnp.zeros((10, 32), jnp.float32)
+    b = jnp.zeros((2, 32, 32), jnp.float32)
+    with pytest.raises(ValueError, match="sum"):
+        varlen_grouped_matmul(a, b, (4, 4))

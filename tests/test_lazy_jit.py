@@ -82,7 +82,27 @@ def test_pass_configs_reach_pallas_call():
 
     k = tilelang.compile(
         copy, pass_configs={"tl.tpu.vmem_limit_bytes": 32 * 1024 * 1024})
-    assert "vmem_limit_bytes" in k.get_kernel_source()
+    assert f"vmem_limit_bytes={32 * 1024 * 1024}" in k.get_kernel_source()
+
+
+def test_dimension_semantics_config_including_bare_string():
+    def make():
+        @T.prim_func
+        def copy2(A: T.Tensor((128, 128), "float32"),
+                  B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                T.copy(A, s)
+                T.copy(s, B)
+        return copy2
+
+    k = tilelang.compile(
+        make(), pass_configs={"tl.tpu.dimension_semantics": ("arbitrary",)})
+    assert 'dimension_semantics=("arbitrary",)' in k.get_kernel_source()
+    # a bare string must normalize to a 1-tuple, not iterate per character
+    k2 = tilelang.compile(
+        make(), pass_configs={"tl.tpu.dimension_semantics": "arbitrary"})
+    assert 'dimension_semantics=("arbitrary",)' in k2.get_kernel_source()
 
 
 def test_lazy_jit_tail_guard_uses_dyn_var():

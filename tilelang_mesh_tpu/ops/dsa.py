@@ -36,10 +36,12 @@ _LOG2E = 1.44269504
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def lightning_indexer_kernel(B, S, Skv, HI, DI, block_T, dtype):
+def lightning_indexer_kernel(B, S, Skv, HI, DI, block_T, q_offset, dtype):
     """Index logits with causal mask: (B, S, Skv) f32.
 
-    QI (B, S, HI, DI), KI (B, Skv, DI), W (B, S, HI) f32.
+    QI (B, S, HI, DI), KI (B, Skv, DI), W (B, S, HI) f32. Query t sits at
+    absolute position q_offset + t in the KV timeline (q_offset = Skv - S
+    when the S queries are the tail of an Skv-long cache).
     Reference: deepseek_v32/fp8_lighting_indexer.py
     mqa_attn_return_logits_kernel (relu(q·k) head-reduced by weights).
     """
@@ -63,22 +65,31 @@ def lightning_indexer_kernel(B, S, Skv, HI, DI, block_T, dtype):
                 T.gemm(q_s, k_s, s_f, transpose_B=True, clear_accum=True)
                 for i, j in T.Parallel(block_T, Skv):
                     out[i, j] = out[i, j] + T.max(s_f[i, j], 0) * w_s[i, h]
-            # causal mask: key j visible to query t when j <= t
+            # causal mask: key j visible when j <= q_offset + t
             for i, j in T.Parallel(block_T, Skv):
                 out[i, j] = T.if_then_else(
-                    j <= bt * block_T + i, out[i, j],
+                    j <= q_offset + bt * block_T + i, out[i, j],
                     -T.infinity("float32"))
             T.copy(out, L[bz, bt * block_T, 0])
 
     return _tl_compile(indexer)
 
 
-def lightning_indexer(q_index, k_index, weights, block_T=64):
-    """q_index (B, S, HI, DI), k_index (B, Skv, DI), weights (B, S, HI)."""
+def lightning_indexer(q_index, k_index, weights, block_T=64,
+                      q_offset=None):
+    """q_index (B, S, HI, DI), k_index (B, Skv, DI), weights (B, S, HI).
+
+    q_offset: absolute position of query 0 in the KV timeline; defaults to
+    Skv - S (queries are the cache tail)."""
     B, S, HI, DI = q_index.shape
     Skv = k_index.shape[1]
-    kern = lightning_indexer_kernel(B, S, Skv, HI, DI, min(block_T, S),
-                                    str(q_index.dtype))
+    if q_offset is None:
+        q_offset = Skv - S
+    block_T = min(block_T, S)
+    while S % block_T:
+        block_T //= 2
+    kern = lightning_indexer_kernel(B, S, Skv, HI, DI, block_T,
+                                    int(q_offset), str(q_index.dtype))
     return kern(q_index, k_index, weights)
 
 
@@ -121,7 +132,10 @@ def topk_selector_kernel(B, S, Skv, topk, block_T):
 
 def topk_selector(logits, topk, block_T=64):
     B, S, Skv = logits.shape
-    kern = topk_selector_kernel(B, S, Skv, topk, min(block_T, S))
+    block_T = min(block_T, S)
+    while S % block_T:
+        block_T //= 2
+    kern = topk_selector_kernel(B, S, Skv, topk, block_T)
     return kern(logits)
 
 
@@ -130,7 +144,8 @@ def topk_selector(logits, topk, block_T=64):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI, sm_scale, dtype):
+def sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI, q_offset,
+                          sm_scale, dtype):
     """Per-token gathered MLA attention.
 
     Q (B, S, H, D+DT); KV (B, Skv, D+DT) shared latent (kv_group=1);
@@ -172,7 +187,8 @@ def sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI, sm_scale, dtype):
                 T.gemm(Q_s, KV_s, S_f, transpose_B=True, clear_accum=True)
                 for i, j in T.Parallel(H, BI):
                     S_f[i, j] = T.if_then_else(
-                        (Idx[ib * BI + j] >= 0) & (Idx[ib * BI + j] <= t),
+                        (Idx[ib * BI + j] >= 0) &
+                        (Idx[ib * BI + j] <= q_offset + t),
                         S_f[i, j] * scale, -T.infinity("float32"))
                 online_softmax_update(st, KV_s[0:BI, 0:D], H, BI, D)
             for i, j in T.Parallel(H, D):
@@ -187,33 +203,54 @@ def sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI, sm_scale, dtype):
     return _tl_compile(mla_fwd)
 
 
-def sparse_mla_fwd(q, kv, indices, sm_scale=None, block_I=64):
+def _tail_split(Dfull, tail_dim):
+    if tail_dim is None:
+        if Dfull % 128 == 0:
+            raise ValueError(
+                f"q feature dim {Dfull} is a multiple of 128: pass "
+                "tail_dim explicitly (the default heuristic — tail 64 when "
+                "D+tail is not 128-aligned — cannot infer the rope split)")
+        tail_dim = 64
+    if not 0 <= tail_dim < Dfull:
+        raise ValueError(f"tail_dim {tail_dim} out of range for feature "
+                         f"dim {Dfull}")
+    return Dfull - tail_dim, tail_dim
+
+
+def sparse_mla_fwd(q, kv, indices, sm_scale=None, block_I=64,
+                   tail_dim=None, q_offset=None):
     """q (B, S, H, D+DT) with D = kv latent dim, DT = rope tail; kv
-    (B, Skv, D+DT); indices (B, S, topk). Returns (o (B,S,H,D), lse)."""
+    (B, Skv, D+DT); indices (B, S, topk). q_offset: absolute position of
+    query 0 in the KV timeline (default Skv - S). Returns
+    (o (B,S,H,D), lse)."""
     B, S, H, Dfull = q.shape
     Skv = kv.shape[1]
     topk = indices.shape[-1]
-    DT = 64 if Dfull % 128 else 0  # rope tail convention: D multiple of 128
-    D = Dfull - DT
+    D, DT = _tail_split(Dfull, tail_dim)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(Dfull)
+    if q_offset is None:
+        q_offset = Skv - S
     BI = min(block_I, topk)
     if topk % BI:
         raise ValueError(f"topk ({topk}) must be a multiple of block_I "
                          f"({BI})")
     kern = sparse_mla_fwd_kernel(B, S, Skv, H, D, DT, topk, BI,
-                                 float(sm_scale), str(q.dtype))
+                                 int(q_offset), float(sm_scale),
+                                 str(q.dtype))
     return kern(q, kv, indices)
 
 
-def sparse_mla_reference(q, kv, indices, sm_scale=None):
+def sparse_mla_reference(q, kv, indices, sm_scale=None, tail_dim=None,
+                         q_offset=None):
     """Dense gather emulation (reference ref_sparse_mla_fwd_interface)."""
     import jax.numpy as jnp
     B, S, H, Dfull = q.shape
-    DT = 64 if Dfull % 128 else 0
-    D = Dfull - DT
+    D, DT = _tail_split(Dfull, tail_dim)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(Dfull)
+    if q_offset is None:
+        q_offset = kv.shape[1] - S
     topk = indices.shape[-1]
     safe = jnp.maximum(indices, 0)
     g = jnp.take_along_axis(kv[:, None, :, :],
@@ -222,7 +259,7 @@ def sparse_mla_reference(q, kv, indices, sm_scale=None):
     scores = jnp.einsum("bshd,bskd->bshk", q.astype(jnp.float32),
                         g.astype(jnp.float32)) * sm_scale
     t_ids = jnp.arange(S)[None, :, None]
-    valid = (indices >= 0) & (indices <= t_ids)
+    valid = (indices >= 0) & (indices <= q_offset + t_ids)
     scores = jnp.where(valid[:, :, None, :], scores, -jnp.inf)
     m = scores.max(axis=-1, keepdims=True)
     p = jnp.exp(scores - m)
@@ -237,7 +274,7 @@ def sparse_mla_reference(q, kv, indices, sm_scale=None):
 # 4. differentiable sparse MLA (dsa_sparse_finetune)
 # ---------------------------------------------------------------------------
 
-def make_sparse_mla(sm_scale=None, block_I=64):
+def make_sparse_mla(sm_scale=None, block_I=64, tail_dim=None):
     """Returns a differentiable sparse_mla(q, kv, indices) -> o.
 
     Forward runs the gather kernel; backward recomputes through the XLA
@@ -249,20 +286,23 @@ def make_sparse_mla(sm_scale=None, block_I=64):
     @jax.custom_vjp
     def sparse_mla(q, kv, indices):
         o, _ = sparse_mla_fwd(q, kv, indices, sm_scale=sm_scale,
-                              block_I=block_I)
+                              block_I=block_I, tail_dim=tail_dim)
         return o
 
     def fwd(q, kv, indices):
         o, lse = sparse_mla_fwd(q, kv, indices, sm_scale=sm_scale,
-                                block_I=block_I)
+                                block_I=block_I, tail_dim=tail_dim)
         return o, (q, kv, indices)
 
     def bwd(res, do):
         q, kv, indices = res
+
         def ref(qq, kk):
-            o, _ = sparse_mla_reference(qq, kk, indices, sm_scale=sm_scale)
+            o, _ = sparse_mla_reference(qq, kk, indices, sm_scale=sm_scale,
+                                        tail_dim=tail_dim)
             return o
-        _, vjp = __import__("jax").vjp(ref, q, kv)
+
+        _, vjp = jax.vjp(ref, q, kv)
         dq, dkv = vjp(do)
         return dq, dkv, None
 

@@ -46,3 +46,108 @@ def grouped_matmul(x, w, block_M=128, block_N=128, block_K=128):
     k = grouped_gemm_kernel(E, M, N, K, min(block_M, M), min(block_N, N),
                             min(block_K, K), in_dtype=str(x.dtype))
     return k(x, w)
+
+
+# ---------------------------------------------------------------------------
+# Varlen (ragged) grouped GEMM — MoE token-sorted layout
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def varlen_grouped_gemm_kernel(rows_pad, TB, E, K, N, block_M, block_N,
+                               block_K, in_dtype, trans_b=False):
+    """Ragged grouped GEMM (reference examples/grouped_gemm/
+    example_grouped_gemm_fwd.py): A holds all groups' rows concatenated;
+    each m-block's (expert, row-start) comes from host-precomputed int32
+    metadata (the group sizes are static, so the search the reference does
+    in-kernel folds to a table lookup). The output is written to a
+    block-padded layout so every store is a full BlockSpec tile; the host
+    wrapper drops pad rows.
+    """
+    b_shape = (E, N, K) if trans_b else (E, K, N)
+
+    @T.prim_func
+    def vggemm(A: T.Tensor((rows_pad, K), in_dtype),  # padded rows
+               B: T.Tensor(b_shape, in_dtype),
+               BlkExp: T.Tensor((TB,), "int32"),
+               BlkRow: T.Tensor((TB,), "int32"),
+               C: T.Tensor((TB * block_M, N), "float32")):
+        with T.Kernel(TB, T.ceildiv(N, block_N)) as (bx, by):
+            A_s = T.alloc_shared((block_M, block_K), in_dtype)
+            B_s = T.alloc_shared((block_K, block_N), in_dtype)
+            e_s = T.alloc_shared((1,), "int32")
+            r_s = T.alloc_shared((1,), "int32")
+            acc = T.alloc_fragment((block_M, block_N), "float32")
+            T.copy(BlkExp[bx], e_s)
+            T.copy(BlkRow[bx], r_s)
+            T.clear(acc)
+            for ko in T.Pipelined(T.ceildiv(K, block_K), num_stages=2):
+                T.copy(A[r_s[0], ko * block_K], A_s)
+                if trans_b:
+                    T.copy(B[e_s[0], by * block_N, ko * block_K], B_s,
+                           coalesced_width=None)
+                    T.gemm(A_s, B_s, acc, transpose_B=True)
+                else:
+                    T.copy(B[e_s[0], ko * block_K, by * block_N], B_s)
+                    T.gemm(A_s, B_s, acc)
+            T.copy(acc, C[bx * block_M, by * block_N])
+
+    return _tl_compile(vggemm)
+
+
+def _varlen_meta(sizes, block_M):
+    """block -> (expert, row_start) tables + padded gather indices."""
+    import numpy as np
+    offs, row_of_block, exp_of_block, out_rows = [0], [], [], []
+    for s in sizes:
+        offs.append(offs[-1] + int(s))
+    pad_base = 0
+    for e, s in enumerate(sizes):
+        nb = -(-int(s) // block_M) if s else 0
+        for b in range(nb):
+            exp_of_block.append(e)
+            row_of_block.append(offs[e] + b * block_M)
+        out_rows.extend(range(pad_base, pad_base + int(s)))
+        pad_base += nb * block_M
+    return (np.asarray(exp_of_block, np.int32),
+            np.asarray(row_of_block, np.int32),
+            np.asarray(out_rows, np.int64))
+
+
+def varlen_grouped_matmul(a, b, sizes, block_M=128, block_N=128,
+                          block_K=128, trans_b=False):
+    """a (sum(sizes), K) x b (E, K, N) -> (sum(sizes), N), group g of rows
+    multiplying b[g]. `sizes` must be a static python sequence."""
+    import jax.numpy as jnp
+    import numpy as np
+    sizes = tuple(int(s) for s in sizes)
+    E = b.shape[0]
+    K = a.shape[1]
+    N = b.shape[1] if trans_b else b.shape[2]
+    if len(sizes) != E:
+        raise ValueError(f"len(sizes) ({len(sizes)}) != groups in b ({E})")
+    if sum(sizes) != a.shape[0]:
+        raise ValueError(f"sum(sizes) ({sum(sizes)}) != rows of a "
+                         f"({a.shape[0]})")
+    block_K = min(block_K, K)
+    block_N = min(block_N, N)
+    exp_blk, row_blk, out_rows = _varlen_meta(sizes, block_M)
+    TB = len(exp_blk)
+    # pad A so the last block of each group can read block_M full rows
+    a_pad = jnp.concatenate(
+        [a, jnp.zeros((block_M, K), a.dtype)], axis=0)
+    kern = varlen_grouped_gemm_kernel(a_pad.shape[0], TB, E, K, N,
+                                      block_M, block_N,
+                                      block_K, str(a.dtype), trans_b)
+    c_pad = kern(a_pad, b, exp_blk, row_blk)
+    return c_pad[jnp.asarray(out_rows)]
+
+
+def varlen_grouped_matmul_reference(a, b, sizes, trans_b=False):
+    import jax.numpy as jnp
+    out, off = [], 0
+    for e, s in enumerate(sizes):
+        w = b[e].T if trans_b else b[e]
+        out.append(a[off:off + s].astype(jnp.float32) @
+                   w.astype(jnp.float32))
+        off += s
+    return jnp.concatenate(out, axis=0)

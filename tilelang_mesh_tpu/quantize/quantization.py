@@ -89,3 +89,58 @@ def dequantize_int4_ref(packed: np.ndarray, scales: np.ndarray,
     K, N = q.shape
     return (q.reshape(K // group_size, group_size, N) *
             scales[:, None, :]).reshape(K, N)
+
+
+# ---------------------------------------------------------------------------
+# MXFP4: e2m1 elements + e8m0 shared scale per 32-element K group
+# (reference tilelang/quantize/mxfp.py; OCP MX spec)
+# ---------------------------------------------------------------------------
+
+_E2M1_VALUES = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0],
+                        dtype=np.float32)
+
+
+def quantize_mxfp4(w: np.ndarray, group_size: int = 32):
+    """Quantize (K, N) to MXFP4: returns (codes (K, N) uint8 in [0,16),
+    scale_exp (K//group, N) uint8 e8m0 biased exponents)."""
+    K, N = w.shape
+    if K % group_size:
+        raise ValueError(f"K must be a multiple of {group_size}")
+    g = w.reshape(K // group_size, group_size, N)
+    absmax = np.abs(g).max(axis=1)
+    # e8m0 scale: power of two s.t. absmax/scale <= 6 (max e2m1 magnitude)
+    exp = np.ceil(np.log2(np.maximum(absmax, 1e-30) / 6.0))
+    exp = np.clip(exp, -127, 127)
+    scale = 2.0 ** exp
+    scaled = g / scale[:, None, :]
+    mag = np.abs(scaled)
+    # nearest e2m1 magnitude
+    idx = np.argmin(np.abs(mag[..., None] - _E2M1_VALUES), axis=-1)
+    sign = (scaled < 0).astype(np.uint8)
+    codes = (sign << 3) | idx.astype(np.uint8)
+    return (codes.reshape(K, N).astype(np.uint8),
+            (exp + 127).astype(np.uint8))
+
+
+def pack_mxfp4(codes: np.ndarray) -> np.ndarray:
+    """Pack two fp4 codes per byte along K: (K, N) -> (K//2, N) int8."""
+    K, N = codes.shape
+    lo = codes[0::2].astype(np.uint8)
+    hi = codes[1::2].astype(np.uint8)
+    return (lo | (hi << 4)).view(np.int8)
+
+
+def dequantize_mxfp4_ref(packed: np.ndarray, scale_exp: np.ndarray,
+                         group_size: int = 32) -> np.ndarray:
+    """Host reference inverse."""
+    Kh, N = packed.shape
+    u = packed.view(np.uint8)
+    codes = np.empty((Kh * 2, N), np.uint8)
+    codes[0::2] = u & 0xF
+    codes[1::2] = u >> 4
+    mag = _E2M1_VALUES[codes & 0x7]
+    val = np.where(codes >> 3, -mag, mag)
+    scale = 2.0 ** (scale_exp.astype(np.float32) - 127.0)
+    K = Kh * 2
+    return (val.reshape(K // group_size, group_size, N) *
+            scale[:, None, :]).reshape(K, N).astype(np.float32)
