@@ -245,6 +245,174 @@ void tl_blockwise_zz_owners(int32_t rows, int32_t cols,
   }
 }
 
-int32_t tl_native_abi_version() { return 1; }
+int32_t tl_native_abi_version() { return 2; }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Liveness-based VMEM packing (native allocator).
+//
+// Native-equivalent of the reference's storage reuse passes
+// (/root/reference/src/transform/storage_rewrite.cc and
+// merge_shared_memory_allocations.cc — liveness-interval analysis +
+// best-fit packing of shared-memory buffers). Here the scarce arena is
+// VMEM: buffers whose [first_use, last_use] statement intervals are
+// disjoint may share offsets.
+//
+// Inputs: per-buffer byte sizes and statement-index live ranges.
+// Output: byte offset per buffer; returns the packed arena size in bytes,
+// or -1 on bad input. Greedy by (size desc, first_use) with lowest-fit
+// placement — the same strategy class the reference uses.
+// ---------------------------------------------------------------------------
+
+int64_t tl_vmem_pack(const int64_t* sizes, const int32_t* first_use,
+                     const int32_t* last_use, int32_t n, int64_t align,
+                     int64_t* offsets_out) {
+  if (n < 0 || align <= 0) return -1;
+  std::vector<int32_t> order(n);
+  for (int32_t i = 0; i < n; ++i) order[i] = i;
+  // big buffers first, ties broken by earlier birth
+  for (int32_t i = 1; i < n; ++i)
+    for (int32_t j = i; j > 0; --j) {
+      bool swap = sizes[order[j]] > sizes[order[j - 1]] ||
+                  (sizes[order[j]] == sizes[order[j - 1]] &&
+                   first_use[order[j]] < first_use[order[j - 1]]);
+      if (swap) { int32_t t = order[j]; order[j] = order[j - 1];
+                  order[j - 1] = t; } else break;
+    }
+  std::vector<int64_t> placed_off;
+  std::vector<int64_t> placed_end;
+  std::vector<int32_t> placed_id;
+  int64_t arena = 0;
+  for (int32_t oi = 0; oi < n; ++oi) {
+    int32_t b = order[oi];
+    if (sizes[b] < 0 || last_use[b] < first_use[b]) return -1;
+    int64_t sz = ((sizes[b] + align - 1) / align) * align;
+    // candidate offsets: 0 and the end of every live-overlapping buffer
+    int64_t best = -1;
+    for (int64_t cand_i = -1; cand_i < (int64_t)placed_id.size(); ++cand_i) {
+      int64_t cand = cand_i < 0 ? 0 : placed_end[cand_i];
+      bool ok = true;
+      for (size_t p = 0; p < placed_id.size(); ++p) {
+        int32_t q = placed_id[p];
+        bool live_overlap = !(last_use[q] < first_use[b] ||
+                              last_use[b] < first_use[q]);
+        bool addr_overlap = cand < placed_end[p] &&
+                            placed_off[p] < cand + sz;
+        if (live_overlap && addr_overlap) { ok = false; break; }
+      }
+      if (ok && (best < 0 || cand < best)) best = cand;
+    }
+    offsets_out[b] = best;
+    placed_off.push_back(best);
+    placed_end.push_back(best + sz);
+    placed_id.push_back(b);
+    if (best + sz > arena) arena = best + sz;
+  }
+  return arena;
+}
+
+// ---------------------------------------------------------------------------
+// Affine linearization over an encoded expression tree (native
+// graph-builder piece; mirror of tilelang_mesh_tpu/ir/expr.py linearize —
+// itself the workhorse the reference buries in layout_inference.cc /
+// arith analysis). The Python side encodes the tree bottom-up:
+//   op[i]: 0=CONST (a[i]=value), 1=VAR (a[i]=var slot),
+//          2=ADD, 3=SUB, 4=MUL, 5=FLOORDIV  (a[i], b[i] = child nodes)
+// Children must precede parents. Result: coeffs per var slot + constant.
+// Returns 1 on success, 0 when the tree is not affine over the slots.
+// ---------------------------------------------------------------------------
+
+int32_t tl_affine_linearize(const int32_t* op, const int64_t* a,
+                            const int64_t* b, int32_t n_nodes,
+                            int32_t n_vars, int64_t* coeffs_out,
+                            int64_t* const_out) {
+  if (n_nodes <= 0 || n_vars < 0) return 0;
+  std::vector<std::vector<int64_t>> C(n_nodes,
+                                      std::vector<int64_t>(n_vars, 0));
+  std::vector<int64_t> K(n_nodes, 0);
+  std::vector<char> ok(n_nodes, 0);
+  for (int32_t i = 0; i < n_nodes; ++i) {
+    switch (op[i]) {
+      case 0: K[i] = a[i]; ok[i] = 1; break;
+      case 1:
+        if (a[i] < 0 || a[i] >= n_vars) return 0;
+        C[i][a[i]] = 1; ok[i] = 1; break;
+      case 2: case 3: {
+        int64_t x = a[i], y = b[i];
+        if (x < 0 || x >= i || y < 0 || y >= i || !ok[x] || !ok[y]) return 0;
+        int64_t s = op[i] == 2 ? 1 : -1;
+        for (int32_t v = 0; v < n_vars; ++v) C[i][v] = C[x][v] + s * C[y][v];
+        K[i] = K[x] + s * K[y]; ok[i] = 1; break;
+      }
+      case 4: {
+        int64_t x = a[i], y = b[i];
+        if (x < 0 || x >= i || y < 0 || y >= i || !ok[x] || !ok[y]) return 0;
+        bool xc = true, yc = true;
+        for (int32_t v = 0; v < n_vars; ++v) {
+          if (C[x][v]) xc = false;
+          if (C[y][v]) yc = false;
+        }
+        if (!xc && !yc) return 0;  // non-linear
+        if (xc) { int64_t t = x; x = y; y = t; }
+        for (int32_t v = 0; v < n_vars; ++v) C[i][v] = C[x][v] * K[y];
+        K[i] = K[x] * K[y]; ok[i] = 1; break;
+      }
+      case 5: {
+        int64_t x = a[i], y = b[i];
+        if (x < 0 || x >= i || y < 0 || y >= i || !ok[x] || !ok[y]) return 0;
+        for (int32_t v = 0; v < n_vars; ++v) if (C[y][v]) return 0;
+        int64_t d = K[y];
+        if (d == 0) return 0;
+        for (int32_t v = 0; v < n_vars; ++v)
+          if (C[x][v] % d != 0) return 0;
+        if (K[x] % d != 0) return 0;
+        for (int32_t v = 0; v < n_vars; ++v) C[i][v] = C[x][v] / d;
+        K[i] = K[x] / d; ok[i] = 1; break;
+      }
+      default: return 0;
+    }
+  }
+  for (int32_t v = 0; v < n_vars; ++v) coeffs_out[v] = C[n_nodes - 1][v];
+  *const_out = K[n_nodes - 1];
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// Stream-K work partitioner (native scheduler piece; mirror of
+// ops/gemm_variants._streamk_segments — the reference's stream-K example
+// schedules, examples/gemm_streamk). Splits the flat (tile, k-chunk)
+// iteration space evenly over programs, breaking each program's range at
+// tile boundaries. Outputs parallel arrays (tile, k0, k_len); returns the
+// segment count (call with outputs null to size), or -1 on bad input.
+// ---------------------------------------------------------------------------
+
+int32_t tl_streamk_partition(int32_t n_tiles, int32_t k_iters,
+                             int32_t n_programs, int32_t* tile_out,
+                             int32_t* k0_out, int32_t* klen_out) {
+  if (n_tiles <= 0 || k_iters <= 0 || n_programs <= 0) return -1;
+  int64_t total = (int64_t)n_tiles * k_iters;
+  int64_t per = (total + n_programs - 1) / n_programs;
+  int32_t n = 0;
+  for (int32_t p = 0; p < n_programs; ++p) {
+    int64_t s = (int64_t)p * per;
+    int64_t e = s + per < total ? s + per : total;
+    while (s < e) {
+      int64_t tile = s / k_iters;
+      int64_t k0 = s % k_iters;
+      int64_t klen = k_iters - k0 < e - s ? k_iters - k0 : e - s;
+      if (tile_out) {
+        tile_out[n] = (int32_t)tile;
+        k0_out[n] = (int32_t)k0;
+        klen_out[n] = (int32_t)klen;
+      }
+      ++n;
+      s += klen;
+    }
+  }
+  return n;
+}
+
+}  // extern "C" (second block)

@@ -91,6 +91,12 @@ def test_varlen_grouped_gemm():
     ref = varlen_grouped_matmul_reference(a, b, sizes)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-2, atol=1e-1)
+    # trans_b path with rectangular (block_N != block_K) tiles
+    bt = jnp.transpose(b, (0, 2, 1))
+    out_t = varlen_grouped_matmul(a, bt, sizes, trans_b=True,
+                                  block_N=128, block_K=64)
+    np.testing.assert_allclose(np.asarray(out_t), np.asarray(ref),
+                               rtol=1e-2, atol=1e-1)
 
 
 def test_varlen_grouped_gemm_validates():

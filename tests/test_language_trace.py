@@ -61,7 +61,8 @@ plan(matmul_relu_kernel):
   in    A: block[128@(by), 64@(ko)] alias=shared
   in    B: block[64@(ko), 128@(bx)] alias=shared_1
   out   C: block[128@(by), 128@(bx)]
-  scratch frag: (128, 128) float32 [fragment]
+  scratch frag: (128, 128) float32 [fragment] @0
+  vmem arena: 65536 bytes (liveness-packed)
   phases: init=1 main=3 epi=2
 """
 

@@ -115,3 +115,68 @@ def test_fragment_cell_and_footprint():
     assert f.vmem_bytes() == 112 * 128 * 2
     assert f.cell(0, 0) == (0, 0)
     assert f.cell(17, 129 % 100) == (17 % 16, 29 % 128)
+
+
+def test_vmem_pack_parity_and_reuse():
+    from tilelang_mesh_tpu.layout import native as lnat
+    from tilelang_mesh_tpu.layout import python_impl as lpy
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        n = int(rng.integers(1, 10))
+        sizes = [int(rng.integers(1, 1 << 16)) for _ in range(n)]
+        first = [int(rng.integers(0, 20)) for _ in range(n)]
+        last = [f + int(rng.integers(0, 20)) for f in first]
+        py = lpy.vmem_pack(sizes, first, last)
+        assert py is not None
+        arena_py, off_py = py
+        if lnat.available():
+            nat = lnat.vmem_pack(sizes, first, last)
+            assert nat == (arena_py, off_py)
+        # validity: live-overlapping buffers must not address-overlap
+        align = 512
+        for i in range(n):
+            for j in range(i + 1, n):
+                live = not (last[j] < first[i] or last[i] < first[j])
+                szi = -(-sizes[i] // align) * align
+                szj = -(-sizes[j] // align) * align
+                addr = (off_py[i] < off_py[j] + szj and
+                        off_py[j] < off_py[i] + szi)
+                assert not (live and addr), (sizes, first, last, off_py)
+    # disjoint lifetimes must actually share memory
+    arena, _ = lpy.vmem_pack([4096, 4096], [0, 5], [4, 9])
+    assert arena == 4096
+
+
+def test_streamk_partition_parity():
+    from tilelang_mesh_tpu.layout import native as lnat
+    from tilelang_mesh_tpu.layout import python_impl as lpy
+    for nt, ki, np_ in ((3, 4, 2), (7, 5, 3), (1, 1, 4), (16, 8, 5)):
+        py = lpy.streamk_partition(nt, ki, np_)
+        # covers the whole space exactly once
+        covered = sorted((t, k0 + d) for t, k0, kl in py for d in range(kl))
+        assert covered == [(t, k) for t in range(nt) for k in range(ki)]
+        if lnat.available():
+            assert [tuple(s) for s in
+                    lnat.streamk_partition(nt, ki, np_)] == py
+
+
+def test_affine_linearize_native_parity():
+    from tilelang_mesh_tpu.ir import Var, linearize
+    from tilelang_mesh_tpu.layout import native as lnat
+    if not lnat.available():
+        return
+    x, y = Var("x"), Var("y")
+    cases = [
+        (x * 4 + y + 3, {x: 4, y: 1}, 3),
+        ((x * 8 + y * 4) // 4, {x: 2, y: 1}, 0),
+        (x * 2 + x * 3, {x: 5}, 0),
+        ((x + 1) * 6 - y * 6, {x: 6, y: -6}, 6),
+    ]
+    for expr, coeffs, const in cases:
+        r = linearize(expr, [x, y])
+        assert r is not None
+        got_c, got_k = r
+        assert {v: c for v, c in got_c.items()} == coeffs and got_k == const
+    # non-affine -> None through both paths
+    assert linearize(x * y, [x, y]) is None
+    assert linearize((x * 3 + 1) // 2, [x, y]) is None

@@ -499,13 +499,69 @@ def rebuild_affine(coeffs, const) -> PrimExpr:
     return out
 
 
+_AFFINE_OPS = {"+": 2, "-": 3, "*": 4, "//": 5}
+
+
+def _encode_affine(expr, slot_of):
+    """Flatten an expr tree to the postfix arrays tl_affine_linearize
+    consumes; returns (ops, a, b) or None when a node falls outside the
+    affine grammar (same rejections as the python path)."""
+    ops, aa, bb = [], [], []
+
+    def go(e):
+        e = convert(e)
+        if isinstance(e, IntImm):
+            ops.append(0)
+            aa.append(e.value)
+            bb.append(0)
+            return len(ops) - 1
+        if isinstance(e, Var):
+            s = slot_of.get(id(e))
+            if s is None:
+                return None
+            ops.append(1)
+            aa.append(s)
+            bb.append(0)
+            return len(ops) - 1
+        if isinstance(e, BinOp) and e.op in _AFFINE_OPS:
+            x = go(e.a)
+            if x is None:
+                return None
+            y = go(e.b)
+            if y is None:
+                return None
+            ops.append(_AFFINE_OPS[e.op])
+            aa.append(x)
+            bb.append(y)
+            return len(ops) - 1
+        return None
+
+    return (ops, aa, bb) if go(expr) is not None else None
+
+
 def linearize(expr: PrimExpr, wrt: Sequence[Var]):
     """Decompose ``expr`` as ``sum(coeff[v] * v) + const`` over vars in `wrt`.
 
     Returns (coeffs: dict[Var, int], const: int) or None if the expression is
     not affine with integer-constant coefficients over those vars, or mentions
-    a var outside `wrt`.
+    a var outside `wrt`. Dispatches to the native core's
+    tl_affine_linearize when built (src/tltpu_core.cc); the python path
+    below is the behavioural reference (parity: tests/test_native.py).
     """
+    from ..layout import native as _nat
+    if _nat.available():
+        slot_of = {id(v): i for i, v in enumerate(wrt)}
+        enc = _encode_affine(expr, slot_of)
+        if enc is not None:
+            r = _nat.affine_linearize(enc[0], enc[1], enc[2], len(wrt))
+            if r is None:
+                return None
+            coeffs, k = r
+            return ({v: coeffs[i] for i, v in enumerate(wrt)
+                     if coeffs[i] != 0}, k)
+        # fall through: encoding rejected the tree exactly where the python
+        # path would — but keep python as the single source of truth for
+        # the None decision
     wrt_set = set(id(v) for v in wrt)
 
     def go(e):
@@ -527,6 +583,9 @@ def linearize(expr: PrimExpr, wrt: Sequence[Var]):
                 out = dict(ca)
                 for k, v in cb.items():
                     out[k] = out.get(k, 0) + sign * v
+                # prune cancelled vars so (x - x) * y stays linear — keeps
+                # parity with the native tl_affine_linearize zero check
+                out = {k: v for k, v in out.items() if v != 0}
                 return out, ka + sign * kb
             if e.op == "*":
                 ra, rb = go(e.a), go(e.b)
@@ -538,8 +597,9 @@ def linearize(expr: PrimExpr, wrt: Sequence[Var]):
                     return None  # non-linear
                 if not ca:
                     ca, ka, cb, kb = cb, kb, ca, ka
-                # now cb empty: multiply by constant kb
-                return {k: v * kb for k, v in ca.items()}, ka * kb
+                # now cb empty: multiply by constant kb (prune kb == 0)
+                return ({k: v * kb for k, v in ca.items() if v * kb != 0},
+                        ka * kb)
             if e.op == "//":
                 ra, rb = go(e.a), go(e.b)
                 if ra is None or rb is None:

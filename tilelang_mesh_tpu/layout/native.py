@@ -71,8 +71,20 @@ def load() -> Optional[ctypes.CDLL]:
                                          ctypes.c_int32, ctypes.c_int32]
         lib.tl_blockwise_zz_owners.argtypes = [ctypes.c_int32,
                                                ctypes.c_int32, i32p]
+        lib.tl_vmem_pack.restype = ctypes.c_int64
+        lib.tl_vmem_pack.argtypes = [i64p, i32p, i32p, ctypes.c_int32,
+                                     ctypes.c_int64, i64p]
+        lib.tl_affine_linearize.restype = ctypes.c_int32
+        lib.tl_affine_linearize.argtypes = [i32p, i64p, i64p,
+                                            ctypes.c_int32, ctypes.c_int32,
+                                            i64p,
+                                            ctypes.POINTER(ctypes.c_int64)]
+        lib.tl_streamk_partition.restype = ctypes.c_int32
+        lib.tl_streamk_partition.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                             ctypes.c_int32, i32p, i32p,
+                                             i32p]
         lib.tl_native_abi_version.restype = ctypes.c_int32
-        if lib.tl_native_abi_version() != 1:
+        if lib.tl_native_abi_version() != 2:
             return None
         _lib = lib
         return _lib
@@ -178,3 +190,54 @@ def blockwise_zz_owners(rows, cols) -> Optional[list]:
     out = (ctypes.c_int32 * (rows * cols))()
     lib.tl_blockwise_zz_owners(rows, cols, out)
     return list(out)
+
+
+def vmem_pack(sizes: Sequence[int], first_use: Sequence[int],
+              last_use: Sequence[int],
+              align: int = 512) -> Optional[Tuple[int, List[int]]]:
+    """Liveness-based VMEM packing. Returns (arena_bytes, offsets)."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(sizes)
+    out = (ctypes.c_int64 * n)()
+    total = lib.tl_vmem_pack(_arr64(sizes), _arr32(first_use),
+                             _arr32(last_use), n, align, out)
+    if total < 0:
+        return None
+    return int(total), list(out)
+
+
+def affine_linearize(ops: Sequence[int], a: Sequence[int],
+                     b: Sequence[int],
+                     n_vars: int) -> Optional[Tuple[List[int], int]]:
+    """Affine-decompose an encoded expr tree: (coeffs per slot, const)."""
+    lib = load()
+    if lib is None:
+        return None
+    coeffs = (ctypes.c_int64 * max(n_vars, 1))()
+    const = ctypes.c_int64()
+    rc = lib.tl_affine_linearize(_arr32(ops), _arr64(a), _arr64(b),
+                                 len(ops), n_vars, coeffs,
+                                 ctypes.byref(const))
+    if rc != 1:
+        return None
+    return list(coeffs)[:n_vars], int(const.value)
+
+
+def streamk_partition(n_tiles: int, k_iters: int,
+                      n_programs: int) -> Optional[List[Tuple[int, int,
+                                                              int]]]:
+    """Stream-K segments [(tile, k0, k_len)] balanced over programs."""
+    lib = load()
+    if lib is None:
+        return None
+    n = lib.tl_streamk_partition(n_tiles, k_iters, n_programs, None, None,
+                                 None)
+    if n < 0:
+        return None
+    t = (ctypes.c_int32 * n)()
+    k0 = (ctypes.c_int32 * n)()
+    kl = (ctypes.c_int32 * n)()
+    lib.tl_streamk_partition(n_tiles, k_iters, n_programs, t, k0, kl)
+    return list(zip(t, k0, kl))

@@ -128,3 +128,48 @@ def blockwise_zz_owners(rows, cols) -> list:
             cc = c if r % 2 == 0 else cols - 1 - c
             out.append(r * cols + cc)
     return out
+
+
+def vmem_pack(sizes, first_use, last_use, align: int = 512):
+    """Pure-python mirror of tl_vmem_pack (liveness best-fit packing)."""
+    n = len(sizes)
+    order = sorted(range(n), key=lambda i: (-sizes[i], first_use[i]))
+    placed = []  # (off, end, idx)
+    offsets = [0] * n
+    arena = 0
+    for b in order:
+        if sizes[b] < 0 or last_use[b] < first_use[b]:
+            return None
+        sz = _cdiv(sizes[b], align) * align
+        cands = [0] + [end for _, end, _ in placed]
+        best = None
+        for cand in cands:
+            ok = True
+            for off, end, q in placed:
+                live = not (last_use[q] < first_use[b]
+                            or last_use[b] < first_use[q])
+                addr = cand < end and off < cand + sz
+                if live and addr:
+                    ok = False
+                    break
+            if ok and (best is None or cand < best):
+                best = cand
+        offsets[b] = best
+        placed.append((best, best + sz, b))
+        arena = max(arena, best + sz)
+    return arena, offsets
+
+
+def streamk_partition(n_tiles, k_iters, n_programs):
+    """Pure-python mirror of tl_streamk_partition."""
+    total = n_tiles * k_iters
+    per = -(-total // n_programs)
+    segs = []
+    for p in range(n_programs):
+        s, e = p * per, min(total, (p + 1) * per)
+        while s < e:
+            tile, k0 = divmod(s, k_iters)
+            klen = min(k_iters - k0, e - s)
+            segs.append((tile, k0, klen))
+            s += klen
+    return segs

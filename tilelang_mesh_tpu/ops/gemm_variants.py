@@ -83,18 +83,14 @@ def matmul_splitk(a, b, n_split=4, block_M=128, block_N=128, block_K=128,
 
 def _streamk_segments(n_tiles, k_iters, n_programs):
     """Balance the flat (tile, k-chunk) iteration space over programs;
-    split each program's contiguous range at tile boundaries."""
-    total = n_tiles * k_iters
-    per = -(-total // n_programs)
-    segs = []
-    for p in range(n_programs):
-        s, e = p * per, min(total, (p + 1) * per)
-        while s < e:
-            tile = s // k_iters
-            k0 = s % k_iters
-            k_len = min(k_iters - k0, e - s)
-            segs.append((tile, k0, k_len))
-            s += k_len
+    split each program's contiguous range at tile boundaries. Native
+    scheduler (src/tltpu_core.cc tl_streamk_partition) with the python
+    mirror as fallback."""
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+    segs = lnat.streamk_partition(n_tiles, k_iters, n_programs)
+    if segs is None:
+        segs = lpy.streamk_partition(n_tiles, k_iters, n_programs)
     return segs
 
 

@@ -73,7 +73,8 @@ def varlen_grouped_gemm_kernel(rows_pad, TB, E, K, N, block_M, block_N,
                C: T.Tensor((TB * block_M, N), "float32")):
         with T.Kernel(TB, T.ceildiv(N, block_N)) as (bx, by):
             A_s = T.alloc_shared((block_M, block_K), in_dtype)
-            B_s = T.alloc_shared((block_K, block_N), in_dtype)
+            B_s = T.alloc_shared((block_N, block_K) if trans_b else
+                                 (block_K, block_N), in_dtype)
             e_s = T.alloc_shared((1,), "int32")
             r_s = T.alloc_shared((1,), "int32")
             acc = T.alloc_fragment((block_M, block_N), "float32")
@@ -83,8 +84,7 @@ def varlen_grouped_gemm_kernel(rows_pad, TB, E, K, N, block_M, block_N,
             for ko in T.Pipelined(T.ceildiv(K, block_K), num_stages=2):
                 T.copy(A[r_s[0], ko * block_K], A_s)
                 if trans_b:
-                    T.copy(B[e_s[0], by * block_N, ko * block_K], B_s,
-                           coalesced_width=None)
+                    T.copy(B[e_s[0], by * block_N, ko * block_K], B_s)
                     T.gemm(A_s, B_s, acc, transpose_B=True)
                 else:
                     T.copy(B[e_s[0], ko * block_K, by * block_N], B_s)

@@ -21,12 +21,17 @@ class AnalysisResult:
     total_bytes: int
     expected_latency_ms: float
     bound: str  # "compute" | "memory"
+    vmem_arena_bytes: int = 0      # liveness-packed scratch footprint
+    vmem_ok: bool = True           # fits the arch's per-core VMEM
 
     def __repr__(self):
+        vm = f", vmem={self.vmem_arena_bytes}B" \
+             f"{'' if self.vmem_ok else ' OVER BUDGET'}" \
+            if self.vmem_arena_bytes else ""
         return (f"AnalysisResult(flops={self.total_flops:.3e}, "
                 f"bytes={self.total_bytes:.3e}, "
                 f"expected={self.expected_latency_ms:.4f} ms, "
-                f"{self.bound}-bound)")
+                f"{self.bound}-bound{vm})")
 
 
 class Analyzer:
@@ -95,7 +100,15 @@ class Analyzer:
         t_compute = flops[0] / (self.arch.bf16_tflops * 1e12)
         t_mem = mem_bytes[0] / (self.arch.hbm_gbps * 1e9)
         expected = max(t_compute, t_mem)
+        # liveness-packed scratch footprint via the native allocator
+        from ..transform.plan import PlanError, plan_kernel
+        try:
+            vmem = plan_kernel(func).vmem_arena
+        except PlanError:
+            vmem = 0  # unplannable func: no footprint to report
         return AnalysisResult(
             total_flops=flops[0], total_bytes=mem_bytes[0],
             expected_latency_ms=expected * 1e3,
-            bound="compute" if t_compute >= t_mem else "memory")
+            bound="compute" if t_compute >= t_mem else "memory",
+            vmem_arena_bytes=vmem,
+            vmem_ok=vmem <= self.arch.vmem_bytes)

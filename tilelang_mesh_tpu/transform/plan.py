@@ -79,6 +79,12 @@ class KernelPlan:
     pipeline_axis: Optional[int]             # grid axis index of ko, or None
     aliased_copies: List[CopyStmt] = field(default_factory=list)
     annotations: Dict[str, Any] = field(default_factory=dict)
+    # liveness-packed VMEM accounting (native tl_vmem_pack / python mirror):
+    # arena bytes if disjoint-lifetime scratch shared storage, and the
+    # per-buffer offsets — advisory (Mosaic owns real allocation), surfaced
+    # through describe()/Analyzer for budget checks
+    vmem_arena: int = 0
+    vmem_offsets: Dict[int, int] = field(default_factory=dict)
 
     def param_for(self, buf: Buffer) -> Optional[ParamPlan]:
         for p in self.params:
@@ -120,8 +126,13 @@ class KernelPlan:
                 desc = "any(hbm)"
             lines.append(f"  {p.role:5s} {p.buffer.name}: {desc}")
         for b in self.scratch:
+            off = self.vmem_offsets.get(b.uid)
+            at = f" @{off}" if off is not None else ""
             lines.append(f"  scratch {b.name}: {tuple(b.shape)} {b.dtype} "
-                         f"[{b.scope}]")
+                         f"[{b.scope}]{at}")
+        if self.vmem_arena:
+            lines.append(f"  vmem arena: {self.vmem_arena} bytes "
+                         "(liveness-packed)")
         lines.append(f"  phases: init={len(self.init_stmts)} "
                      f"main={len(self.main_stmts)} epi={len(self.epi_stmts)}")
         return "\n".join(lines) + "\n"
@@ -467,10 +478,114 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     aliased_bufs = {p.alias.uid for p in params if p.alias is not None}
     scratch = [b for b in allocs if b.uid not in aliased_bufs]
 
+    vmem_arena, vmem_offsets = _pack_scratch(
+        scratch, init_stmts + main_stmts + epi_stmts)
+
     return KernelPlan(
         func=func, grid=grid, params=params, scratch=scratch,
         init_stmts=init_stmts, main_stmts=main_stmts, epi_stmts=epi_stmts,
         pipeline_axis=pipeline_axis,
         aliased_copies=aliased_copies,
         annotations=dict(func.attrs.get("kernel_annotations", {})),
+        vmem_arena=vmem_arena, vmem_offsets=vmem_offsets,
     )
+
+
+def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt]):
+    """Statement-granular liveness + best-fit packing of scratch VMEM
+    (native allocator src/tltpu_core.cc tl_vmem_pack; the reference does
+    this in storage_rewrite.cc / merge_shared_memory_allocations.cc)."""
+    from ..ir import walk
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+
+    uids = {b.uid: i for i, b in enumerate(scratch)
+            if b.scope != "sem"}
+    if not uids:
+        return 0, {}
+    n = len(uids)
+    first = [None] * n
+    last = [0] * n
+
+    def see(buf, t):
+        i = uids.get(getattr(buf, "uid", None))
+        if i is None:
+            return
+        if first[i] is None:
+            first[i] = t
+        last[i] = t
+
+    for t, top in enumerate(stmts):
+        def visit(s, t=t):
+            for attr in ("src", "dst", "A", "B", "C", "value", "sem"):
+                r = getattr(s, attr, None)
+                if isinstance(r, Region):
+                    see(r.buffer, t)
+                elif isinstance(r, Buffer):
+                    see(r, t)
+                elif isinstance(r, BufferLoad):
+                    see(r.buffer, t)
+            if isinstance(s, BufferStoreStmt):
+                see(s.buffer, t)
+            for e in getattr(s, "exprs", []) or []:
+                if isinstance(e, BufferLoad):
+                    see(e.buffer, t)
+
+        walk(top, visit)
+        # expressions inside loads nested in values
+        def deep(e, t=t):
+            if isinstance(e, BufferLoad):
+                see(e.buffer, t)
+                for i in e.indices:
+                    if not isinstance(i, slice):
+                        deep(i)
+            else:
+                from ..ir.expr import BinOp, Call, Cast
+                if isinstance(e, BinOp):
+                    deep(e.a)
+                    deep(e.b)
+                elif isinstance(e, Call):
+                    for x in e.args:
+                        if not isinstance(x, str):
+                            deep(x)
+                elif isinstance(e, Cast):
+                    deep(e.value)
+
+        def vals(s, t=t):
+            v = getattr(s, "value", None)
+            if v is not None and not isinstance(v, (Region, Buffer)):
+                deep(v)
+        walk(top, vals)
+
+    sizes, fu, lu, idx_of = [], [], [], []
+    rev = {i: uid for uid, i in uids.items()}
+    for i in range(n):
+        b = next(bb for bb in scratch if bb.uid == rev[i])
+        shape = [as_int(x) for x in b.shape]
+        if any(x is None for x in shape):
+            return 0, {}
+        from ..ir import dtype_bits
+        bits = dtype_bits(b.dtype)
+        # true (sublane, lane)-padded footprint: the tiling applies to the
+        # trailing 2-D slice; leading dims multiply (the same rule
+        # tests/test_native.py asserts for Fragment.vmem_bytes)
+        rows = shape[-2] if len(shape) >= 2 else 1
+        cols = shape[-1] if shape else 1
+        tile = lnat.vmem_bytes(rows, cols, bits)
+        if tile is None:
+            tile = lpy.vmem_bytes(rows, cols, bits)
+        lead = 1
+        for x in shape[:-2]:
+            lead *= x
+        sz = tile * lead
+        sizes.append(sz)
+        fu.append(first[i] if first[i] is not None else 0)
+        lu.append(max(last[i], fu[-1]))
+        idx_of.append(rev[i])
+    packed = lnat.vmem_pack(sizes, fu, lu)
+    if packed is None:
+        packed = lpy.vmem_pack(sizes, fu, lu)
+    if packed is None:
+        return 0, {}
+    arena, offsets = packed
+    return arena, {idx_of[i]: offsets[i] for i in range(n)}
