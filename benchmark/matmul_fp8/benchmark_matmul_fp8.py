@@ -1,0 +1,52 @@
+"""fp8 GEMM throughput sweep — mirror of the reference's
+benchmark/matmul_fp8 table (8192x8192xK sweeps on H800; here e4m3 through
+the tile pipeline on the local TPU).
+
+Run: python benchmark/matmul_fp8/benchmark_matmul_fp8.py [--quick]
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax.numpy as jnp
+    sys.path.insert(0, ".")
+    from bench import _time_fn
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--mn", type=int, default=2048)
+    args = ap.parse_args()
+
+    M = N = args.mn
+    ks = (512, 1024) if args.quick else (256, 512, 1024, 2048, 4096)
+    rng = np.random.default_rng(0)
+    print(f"| M=N={M} | K | latency ms | TFLOPS |")
+    print("|---|---|---|---|")
+    for K in ks:
+        a = jnp.asarray(rng.standard_normal((M, K)) * 0.1,
+                        jnp.float8_e4m3fn)
+        b = jnp.asarray(rng.standard_normal((K, N)) * 0.1,
+                        jnp.float8_e4m3fn)
+        best = None
+        for cfg in ({"block_M": 256, "block_N": 256, "block_K": 512},
+                    {"block_M": 512, "block_N": 256, "block_K": 256}):
+            try:
+                kern = matmul_kernel(M, N, K, in_dtype="float8_e4m3fn",
+                                     out_dtype="float32", **cfg)
+                dt = _time_fn(kern.func, (a, b), rep=20)
+                best = dt if best is None else min(best, dt)
+            except Exception as e:
+                print(f"# cfg {cfg} failed: {e}", file=sys.stderr)
+        if best is not None:
+            fl = 2.0 * M * N * K
+            print(f"| {M} | {K} | {best * 1e3:.3f} | "
+                  f"{fl / best / 1e12:.1f} |")
+
+
+if __name__ == "__main__":
+    main()

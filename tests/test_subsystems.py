@@ -1,0 +1,170 @@
+"""Auxiliary-subsystem coverage: kernel cache, autotuner, profiler,
+carver, par_compile, env flags (reference testing/python/{cache,autotune,
+profiler,carver,env} dirs, SURVEY §4/§5)."""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.cache.kernel_cache import KernelCache
+
+
+def _scale_func(mult=2.0, M=64, N=128):
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * mult
+            T.copy(s, B)
+    return scale
+
+
+class TestKernelCache:
+    def test_key_depends_on_ir_target_and_configs(self):
+        f = _scale_func()
+        script = f.func.script()
+        k1 = KernelCache.key_for(script, "cpu", None, {})
+        assert k1 == KernelCache.key_for(script, "cpu", None, {})
+        assert k1 != KernelCache.key_for(script, "tpu", None, {})
+        assert k1 != KernelCache.key_for(script, "cpu", [1], {})
+        assert k1 != KernelCache.key_for(script, "cpu", None,
+                                         {"tl.enable_fast_math": True})
+        assert k1 != KernelCache.key_for(script + " ", "cpu", None, {})
+
+    def test_memory_hit_returns_same_kernel(self):
+        f = _scale_func(mult=3.0)
+        k1 = tilelang.compile(f)
+        k2 = tilelang.compile(f)
+        assert k1 is k2  # memory tier
+
+    def test_disk_artifact_roundtrip(self):
+        f = _scale_func(mult=5.0, M=96)
+        k1 = tilelang.compile(f)
+        tilelang.cache.kernel_cache._CACHE.clear()  # drop memory tier only
+        k2 = tilelang.compile(f)
+        assert k1 is not k2
+        a = np.random.default_rng(0).standard_normal((96, 128),
+                                                     dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(k2(a)), a * 5, rtol=1e-6)
+        assert k2.get_kernel_source() == k1.get_kernel_source()
+
+
+class TestAutotuner:
+    def test_picks_fastest_and_caches(self):
+        calls = []
+
+        @tilelang.jit
+        def factory(M, N, block_M=32):
+            calls.append(block_M)
+
+            @T.prim_func
+            def k(A: T.Tensor((M, N), "float32"),
+                  B: T.Tensor((M, N), "float32")):
+                with T.Kernel(T.ceildiv(M, block_M)) as bx:
+                    s = T.alloc_shared((block_M, N), "float32")
+                    T.copy(A[bx * block_M, 0], s)
+                    T.copy(s, B[bx * block_M, 0])
+            return k
+
+        tuned = tilelang.autotune(configs=[{"block_M": 32},
+                                           {"block_M": 64}],
+                                  warmup=1, rep=2)(factory)
+        kernel = tuned(128, 128)
+        assert kernel.config in ({"block_M": 32}, {"block_M": 64})
+        assert kernel.latency > 0
+        assert set(calls) == {32, 64}  # every config compiled
+
+    def test_bad_config_is_skipped(self):
+        @tilelang.jit
+        def factory(M, block_M=32):
+            if block_M == 999:
+                raise RuntimeError("boom")
+
+            @T.prim_func
+            def k(A: T.Tensor((M, 128), "float32"),
+                  B: T.Tensor((M, 128), "float32")):
+                with T.Kernel(T.ceildiv(M, block_M)) as bx:
+                    s = T.alloc_shared((block_M, 128), "float32")
+                    T.copy(A[bx * block_M, 0], s)
+                    T.copy(s, B[bx * block_M, 0])
+            return k
+
+        tuned = tilelang.autotune(configs=[{"block_M": 999},
+                                           {"block_M": 64}],
+                                  warmup=1, rep=2)(factory)
+        kernel = tuned(128)
+        assert kernel.config == {"block_M": 64}
+
+    def test_all_configs_failing_raises(self):
+        @tilelang.jit
+        def factory(M, block_M=0):
+            raise RuntimeError("nope")
+
+        tuned = tilelang.autotune(configs=[{"block_M": 1}], warmup=1,
+                                  rep=1)(factory)
+        with pytest.raises(Exception):
+            tuned(128)
+
+
+class TestProfiler:
+    def test_do_bench_and_allclose(self):
+        k = tilelang.compile(_scale_func(mult=2.0))
+        prof = k.get_profiler()
+        lat = prof.do_bench(warmup=1, rep=3, backend="wall")
+        assert lat > 0
+        prof.assert_allclose(lambda a: a * 2, rtol=1e-5, atol=1e-5)
+
+    def test_allclose_catches_mismatch(self):
+        k = tilelang.compile(_scale_func(mult=2.0))
+        with pytest.raises(AssertionError):
+            k.get_profiler().assert_allclose(lambda a: a * 3, rtol=1e-3,
+                                             atol=1e-3)
+
+
+class TestCarver:
+    def test_hints_fit_vmem(self):
+        from tilelang_mesh_tpu.carver import MatmulTemplate
+        from tilelang_mesh_tpu.carver.arch import auto_arch
+        arch = auto_arch()
+        hints = MatmulTemplate(4096, 4096, 4096, "bfloat16").hints(topk=5)
+        assert hints
+        for h in hints:
+            cfg = h.config
+            assert arch.fits_vmem(
+                ((cfg["block_M"], cfg["block_K"]), "bfloat16"),
+                ((cfg["block_K"], cfg["block_N"]), "bfloat16"),
+                ((cfg["block_M"], cfg["block_N"]), "float32"))
+
+    def test_hints_shrink_for_small_problems(self):
+        from tilelang_mesh_tpu.carver import MatmulTemplate
+        hints = MatmulTemplate(64, 64, 64, "float32").hints(topk=3)
+        for h in hints:
+            assert h.config["block_M"] <= 64
+
+
+class TestParCompile:
+    def test_par_compile_matches_serial(self):
+        funcs = [_scale_func(mult=float(m), M=32 * m) for m in (1, 2, 3)]
+        kernels = tilelang.par_compile(funcs)
+        assert len(kernels) == 3
+        for m, k in zip((1, 2, 3), kernels):
+            a = np.random.default_rng(m).standard_normal(
+                (32 * m, 128), dtype=np.float32)
+            np.testing.assert_allclose(np.asarray(k(a)), a * m, rtol=1e-6)
+
+
+class TestEnv:
+    def test_env_flags_have_defaults(self):
+        from tilelang_mesh_tpu.env import env
+        assert isinstance(env.TL_TPU_NUM_COMPILE_THREADS, int)
+        assert env.TL_TPU_NUM_COMPILE_THREADS >= 1
+        assert isinstance(env.TL_TPU_CACHE_DIR, str)
+
+    def test_force_interpret_flag(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_FORCE_INTERPRET", "1")
+        from tilelang_mesh_tpu.env import env
+        assert env.TL_TPU_FORCE_INTERPRET
