@@ -7,6 +7,16 @@ instead.
 """
 
 import os
+import tempfile
+
+# Hermetic caches: a warm kernel/autotune cache from a previous run (or the
+# user's home dir) must not change test behavior — see round-1 advisor
+# finding on test_picks_fastest_and_caches. Done at import time so the dirs
+# are in place before tilelang_mesh_tpu reads env vars.
+_CACHE_TMP = tempfile.mkdtemp(prefix="tltpu-test-cache-")
+os.environ.setdefault("TL_TPU_CACHE_DIR", os.path.join(_CACHE_TMP, "kernels"))
+os.environ.setdefault("TL_TPU_AUTOTUNE_CACHE_DIR",
+                      os.path.join(_CACHE_TMP, "autotune"))
 
 _ON_TPU = os.environ.get("TL_TPU_TEST_DEVICE", "cpu") == "tpu"
 

@@ -77,6 +77,50 @@ class TestAutotuner:
         assert kernel.config in ({"block_M": 32}, {"block_M": 64})
         assert kernel.latency > 0
         assert set(calls) == {32, 64}  # every config compiled
+        # sweep capture: one record per candidate, each with a latency
+        assert len(kernel.autotune_results) == 2
+        assert all(r["latency_ms"] is not None
+                   for r in kernel.autotune_results)
+
+        # Warm disk cache: a fresh tuner for the same (source, args, configs)
+        # compiles only the cached winner and reports from_cache.
+        calls.clear()
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        res = AutoTuner(factory, [{"block_M": 32}, {"block_M": 64}],
+                        warmup=1, rep=2).run(128, 128)
+        assert res.from_cache
+        assert res.config == kernel.config
+        # Only the winner is instantiated (jit's own memory cache may even
+        # absorb that, so at most one factory call — never a full re-sweep).
+        assert len(calls) <= 1
+
+    def test_cache_isolated_per_config_list(self, monkeypatch, tmp_path):
+        # Cache key covers the config list: changing candidates re-tunes.
+        monkeypatch.setenv("TL_TPU_AUTOTUNE_CACHE_DIR", str(tmp_path))
+        calls = []
+
+        @tilelang.jit
+        def factory(M, block_M=32):
+            calls.append(block_M)
+
+            @T.prim_func
+            def k(A: T.Tensor((M, 128), "float32"),
+                  B: T.Tensor((M, 128), "float32")):
+                with T.Kernel(T.ceildiv(M, block_M)) as bx:
+                    s = T.alloc_shared((block_M, 128), "float32")
+                    T.copy(A[bx * block_M, 0], s)
+                    T.copy(s, B[bx * block_M, 0])
+            return k
+
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        AutoTuner(factory, [{"block_M": 32}], warmup=1, rep=1).run(128)
+        assert calls == [32]
+        calls.clear()
+        res = AutoTuner(factory, [{"block_M": 32}, {"block_M": 64}],
+                        warmup=1, rep=1).run(128)
+        assert not res.from_cache  # different config list -> fresh sweep
+        assert 64 in calls  # the new candidate was compiled and benchmarked
+        assert len(res.all_results) == 2
 
     def test_bad_config_is_skipped(self):
         @tilelang.jit

@@ -16,12 +16,13 @@ and args.
 
 from __future__ import annotations
 
+import concurrent.futures
 import functools
 import hashlib
 import json
 import logging
 import inspect
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..env import env
@@ -36,23 +37,49 @@ class AutotuneResult:
     config: Dict[str, Any]
     latency_ms: float
     kernel: Any = None
+    # Full sweep capture (reference tuner.py:244-288): one record per
+    # candidate, so callers can inspect the whole search, not just the winner.
+    all_results: List[Dict[str, Any]] = field(default_factory=list)
+    from_cache: bool = False
+
+
+def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
+    """Run fn with a wall-clock timeout (reference tuner.py:51).
+
+    Uses a worker thread: a hung XLA compile or device sync can't be
+    interrupted in-process, but the sweep moves on and the config is
+    recorded as failed instead of wedging the whole search.
+    """
+    if timeout is None:
+        return fn(*args, **kwargs)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(fn, *args, **kwargs)
+        return fut.result(timeout=timeout)
 
 
 class AutoTuner:
     def __init__(self, fn: Callable, configs: Sequence[Dict[str, Any]],
                  warmup: int = 3, rep: int = 20,
                  supply_type: TensorSupplyType = TensorSupplyType.Auto,
-                 cache_results: bool = True):
+                 cache_results: bool = True,
+                 timeout: Optional[float] = None):
         self.fn = fn
         self.configs = list(configs)
         self.warmup = warmup
         self.rep = rep
         self.supply_type = supply_type
         self.cache_results = cache_results
+        self.timeout = timeout
 
     # ------------------------------------------------------------------
     def _disk_key(self, args, kwargs) -> str:
+        from .. import __version__
+        from ..cache.kernel_cache import CODEGEN_VERSION
+
         h = hashlib.sha256()
+        # Version the cache like the kernel cache does: a codegen change can
+        # shift which config wins, so stale records must not survive it.
+        h.update(f"{__version__}:{CODEGEN_VERSION}".encode())
         try:
             src = inspect.getsource(getattr(self.fn, "fn", self.fn))
         except (OSError, TypeError):
@@ -69,39 +96,52 @@ class AutoTuner:
         cache_f = env.autotune_dir() / f"{key}.json"
         if self.cache_results and cache_f.exists():
             try:
-                best_cfg = json.loads(cache_f.read_text())["config"]
-                kernel = self.fn(*args, **{**kwargs, **best_cfg})
                 rec = json.loads(cache_f.read_text())
-                return AutotuneResult(best_cfg, rec["latency_ms"], kernel)
+                best_cfg = rec["config"]
+                kernel = self.fn(*args, **{**kwargs, **best_cfg})
+                return AutotuneResult(best_cfg, rec["latency_ms"], kernel,
+                                      rec.get("all_results", []),
+                                      from_cache=True)
             except Exception:
                 pass
 
         best: Optional[AutotuneResult] = None
-        for cfg in self.configs:
+        captured: List[Dict[str, Any]] = []
+        n = len(self.configs)
+        for i, cfg in enumerate(self.configs):
             try:
-                kernel = self.fn(*args, **{**kwargs, **cfg})
-                prof = Profiler(kernel, self.supply_type)
-                lat = prof.do_bench(warmup=self.warmup, rep=self.rep)
+                def _one():
+                    kernel = self.fn(*args, **{**kwargs, **cfg})
+                    prof = Profiler(kernel, self.supply_type)
+                    return kernel, prof.do_bench(warmup=self.warmup,
+                                                 rep=self.rep)
+                kernel, lat = run_with_timeout(_one, self.timeout)
             except Exception as e:  # config isolation (tuner.py:51)
                 logger.debug("autotune config %s failed: %s", cfg, e)
+                captured.append({"config": cfg, "latency_ms": None,
+                                 "error": f"{type(e).__name__}: {e}"})
                 continue
-            logger.info("autotune %s -> %.4f ms", cfg, lat)
+            logger.info("autotune [%d/%d] %s -> %.4f ms", i + 1, n, cfg, lat)
+            captured.append({"config": cfg, "latency_ms": lat})
             if best is None or lat < best.latency_ms:
                 best = AutotuneResult(cfg, lat, kernel)
         if best is None:
             raise RuntimeError("autotune: every candidate config failed")
+        best.all_results = captured
         if self.cache_results:
             cache_f.write_text(json.dumps(
-                {"config": best.config, "latency_ms": best.latency_ms}))
+                {"config": best.config, "latency_ms": best.latency_ms,
+                 "all_results": captured}))
         return best
 
 
 class AutoTuneImpl:
     def __init__(self, fn: Callable, configs, warmup: int, rep: int,
-                 supply_type: TensorSupplyType, cache_results: bool):
+                 supply_type: TensorSupplyType, cache_results: bool,
+                 timeout: Optional[float] = None):
         functools.update_wrapper(self, fn)
         self.tuner = AutoTuner(fn, configs, warmup, rep, supply_type,
-                               cache_results)
+                               cache_results, timeout)
         self._cache: Dict[Any, Any] = {}
 
     def __call__(self, *args, **kwargs):
@@ -111,6 +151,7 @@ class AutoTuneImpl:
             kernel = res.kernel
             kernel.latency = res.latency_ms
             kernel.config = res.config
+            kernel.autotune_results = res.all_results
             self._cache[key] = kernel
         return self._cache[key]
 
@@ -119,13 +160,14 @@ def autotune(fn: Optional[Callable] = None, *,
              configs: Optional[Sequence[Dict[str, Any]]] = None,
              warmup: int = 3, rep: int = 20,
              supply_type: TensorSupplyType = TensorSupplyType.Auto,
-             cache_results: bool = True, **_ignored):
+             cache_results: bool = True, timeout: Optional[float] = None,
+             **_ignored):
     if configs is None:
         raise ValueError("autotune requires configs=[...]")
 
     def wrap(f):
         return AutoTuneImpl(f, configs, warmup, rep, supply_type,
-                            cache_results)
+                            cache_results, timeout)
 
     if fn is not None:
         return wrap(fn)

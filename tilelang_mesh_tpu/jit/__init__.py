@@ -197,7 +197,10 @@ class LazyJITImpl:
             if isinstance(annots[i], TensorAnnot):
                 _solve_dims(annots[i].shape, t.shape, binding, names[i])
         env_map = {k: v for k, (_, v) in binding.items()}
-        shape_key = tuple(sorted((v.name, val)
+        # Key by the Var's unique uid, not its name: two distinct dyn vars
+        # sharing a name would otherwise collide after sorting and silently
+        # return the wrong cached specialization (round-1 advisor finding).
+        shape_key = tuple(sorted((v.uid, val)
                                  for v, val in binding.values()))
         kernel = self._kernels.get(shape_key)
         if kernel is None:

@@ -58,7 +58,8 @@ def gemm(A: Any, B: Any, C: Any, transpose_A: bool = False,
 def gemm_sp(A_sparse, E, B, C, transpose_A: bool = False,
             transpose_B: bool = False,
             policy: GemmWarpPolicy = GemmWarpPolicy.Square,
-            clear_accum: bool = False, **kwargs):
+            clear_accum: bool = False, k_pack: int = 1, wg_wait: int = 0,
+            **kwargs):
     """C += decompress(A_sparse, E) @ op(B) — 2:4 structured-sparse GEMM.
 
     Reference: src/op/gemm_sp.cc lowers to mma.sp with CUTLASS-packed
@@ -71,6 +72,12 @@ def gemm_sp(A_sparse, E, B, C, transpose_A: bool = False,
     A_sparse: (M, K//2) VMEM tile of kept values; E: (M, K//2) int8 slot
     indices (0..3 within each K-group of 4); B: (K, N); C: (M, N) fragment.
     """
+    if kwargs:
+        # Reject unknown options instead of silently discarding them —
+        # a misspelled reference kwarg must not pass (round-1 advisor
+        # finding). k_pack/wg_wait are accepted for API parity; they tune
+        # MMA packing / warpgroup waits, which Mosaic owns on TPU.
+        raise TypeError(f"gemm_sp got unexpected kwargs: {sorted(kwargs)}")
     if transpose_A:
         raise NotImplementedError(
             "gemm_sp with transpose_A: store A_sparse row-major (the "

@@ -479,7 +479,9 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     scratch = [b for b in allocs if b.uid not in aliased_bufs]
 
     vmem_arena, vmem_offsets = _pack_scratch(
-        scratch, init_stmts + main_stmts + epi_stmts)
+        scratch, init_stmts + main_stmts + epi_stmts,
+        main_range=((len(init_stmts), len(init_stmts) + len(main_stmts))
+                    if pipeline_axis is not None else None))
 
     return KernelPlan(
         func=func, grid=grid, params=params, scratch=scratch,
@@ -491,10 +493,18 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     )
 
 
-def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt]):
+def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt],
+                  main_range=None):
     """Statement-granular liveness + best-fit packing of scratch VMEM
     (native allocator src/tltpu_core.cc tl_vmem_pack; the reference does
-    this in storage_rewrite.cc / merge_shared_memory_allocations.cc)."""
+    this in storage_rewrite.cc / merge_shared_memory_allocations.cc).
+
+    main_range=(lo, hi) marks the half-open statement range of a pipelined
+    main phase: those statements re-execute once per grid step along the
+    pipeline axis, so any buffer referenced there is loop-carried — its
+    live interval is widened to the whole phase (a value written in one
+    iteration may be read in the next, which statement-granular intervals
+    cannot see; round-1 advisor finding)."""
     from ..ir import walk
     from ..layout import native as lnat
     from ..layout import python_impl as lpy
@@ -556,6 +566,13 @@ def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt]):
             if v is not None and not isinstance(v, (Region, Buffer)):
                 deep(v)
         walk(top, vals)
+
+    if main_range is not None:
+        lo, hi = main_range
+        for i in range(n):
+            if first[i] is not None and first[i] < hi and last[i] >= lo:
+                first[i] = min(first[i], lo)
+                last[i] = max(last[i], hi - 1)
 
     sizes, fu, lu, idx_of = [], [], [], []
     rev = {i: uid for uid, i in uids.items()}
