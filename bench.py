@@ -55,29 +55,101 @@ def _hand_pallas_matmul(M, N, K, bm, bn, bk):
     )
 
 
-def _time_fn(fn, args, rep):
-    """In-graph loop timing (optimization_barrier-tied, see profiler)."""
+_TARGET_LOOP_S = 1.0   # in-loop work per timed call; >> fixed-cost noise
+_MAX_REP = 200_000
+
+
+def _make_runner(fn, args):
+    """jit(run(n, *args)): n iterations of fn inside one fori_loop, outputs
+    tied into the carry with optimization_barrier so XLA can't hoist or
+    dead-code them, reduced to ONE scalar fetched to host (4-byte
+    transfer) to synchronize. n is a RUNTIME value: one compile serves
+    every rep count.
+
+    Round 1 timed `np.asarray(full_result)`, which shipped the whole output
+    over the device tunnel (~seconds for large outputs) and swamped the
+    kernel time; `jax.block_until_ready` does not synchronize on this
+    platform, so a value fetch is the only honest fence.
+    """
     import jax
+    import jax.numpy as jnp
 
     def body(i, carry):
         outs = fn(*carry)
         outs = outs if isinstance(outs, tuple) else (outs,)
         tied = jax.lax.optimization_barrier(tuple(carry) + outs)
-        return tuple(tied[:len(carry)])
+        return tuple(tied[:len(carry)]), tied[len(carry)]
 
-    @functools.partial(jax.jit, static_argnames=("n",))
+    @jax.jit
     def run(n, *ins):
-        return jax.lax.fori_loop(0, n, body, tuple(ins))
+        # seed the output slot with one real evaluation so the carry's
+        # shape/dtype matches fn's first output (it need not match ins[0])
+        outs0 = fn(*ins)
+        outs0 = outs0 if isinstance(outs0, tuple) else (outs0,)
+        _, last = jax.lax.fori_loop(
+            0, n, lambda i, c: body(i, c[0]), (tuple(ins), outs0[0]))
+        return last.ravel()[0].astype(jnp.float32)
 
-    r = run(3, *args)
-    np.asarray(r[0]).ravel()[:1]  # force
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        r = run(rep, *args)
-        np.asarray(r[0]).ravel()[:1]
-        best = min(best, (time.perf_counter() - t0) / rep)
-    return best
+    return run
+
+
+def _t(run, n, args):
+    t0 = time.perf_counter()
+    float(run(n, *args))
+    return time.perf_counter() - t0
+
+
+def _calibrate(run, args):
+    """Grow n until the loop body accounts for ~_TARGET_LOOP_S of wall time
+    beyond the fixed per-call cost (~65 ms tunnel RPC on this setup)."""
+    float(run(1, *args))  # compile + warm
+    t1 = min(_t(run, 1, args) for _ in range(2))
+    n = 8
+    while n < _MAX_REP:
+        tn = _t(run, n, args)
+        if tn - t1 >= _TARGET_LOOP_S:
+            return n
+        dt = max((tn - t1) / (n - 1), 1e-7)
+        n = min(max(int(1.3 * _TARGET_LOOP_S / dt), n * 4), _MAX_REP)
+    return _MAX_REP
+
+
+def _slope(run, args, rep_hi):
+    """One slope sample: (T(hi) - T(lo)) / (hi - lo), cancelling every
+    fixed per-call cost (dispatch, tunnel RPC, scalar readback)."""
+    rep_lo = max(1, rep_hi // 4)
+    t_lo = _t(run, rep_lo, args)
+    t_hi = _t(run, rep_hi, args)
+    return max((t_hi - t_lo) / (rep_hi - rep_lo), 1e-9)
+
+
+def _time_fn(fn, args, rep=None, rounds=3):
+    """Median per-iteration device time of fn(*args), adaptive rep count.
+
+    The device behind the tunnel is shared: throughput drifts, so each
+    estimate is the median of `rounds` slope samples.
+    """
+    run = _make_runner(fn, args)
+    rep_hi = _calibrate(run, args) if rep is None else rep
+    samples = sorted(_slope(run, args, rep_hi) for _ in range(rounds))
+    return samples[len(samples) // 2]
+
+
+def _compare(ours_fn, ref_fn, args, rounds=3):
+    """Interleaved A/B timing: per-round (ours, ref) slope pairs taken
+    back-to-back so device-throughput drift cancels in the ratio; returns
+    (dt_ours, dt_ref, vs_baseline) with the per-round median ratio."""
+    run_o = _make_runner(ours_fn, args)
+    run_r = _make_runner(ref_fn, args)
+    rep_o = _calibrate(run_o, args)
+    rep_r = _calibrate(run_r, args)
+    pairs = [(_slope(run_o, args, rep_o), _slope(run_r, args, rep_r))
+             for _ in range(rounds)]
+    ratios = sorted(r / o for o, r in pairs)
+    vs = ratios[len(ratios) // 2]
+    dts_o = sorted(o for o, _ in pairs)
+    dts_r = sorted(r for _, r in pairs)
+    return (dts_o[len(dts_o) // 2], dts_r[len(dts_r) // 2], vs)
 
 
 def main():

@@ -57,6 +57,15 @@ class ExprGenError(Exception):
     pass
 
 
+class ParCtx(list):
+    """Vectorization context: list of (Var, extent) canonical axes, plus
+    `pad`: True when this is a 1-var nest whose compute space is (M, 1)
+    column vectors (set when any accessed buffer uses pad1 storage, so the
+    nest's elementwise math stays sublane-aligned end to end)."""
+
+    pad = False
+
+
 class ExprGen:
     """Prints tile-IR expressions as Python source.
 
@@ -164,6 +173,8 @@ class ExprGen:
                 pos = [i for i, (v, _) in enumerate(self.par_vars)
                        if id(v) == id(e)][0]
                 shape = tuple(x for _, x in self.par_vars)
+                if getattr(self.par_vars, "pad", False):
+                    shape = shape + (1,)
                 return (f"jax.lax.broadcasted_iota(jnp.int32, "
                         f"{shape}, {pos})")
             return self.scalar(e)
@@ -229,7 +240,7 @@ class ExprGen:
         return out
 
     def slice_parts(self, dims, shape, extents,
-                    err=None) -> Tuple[list, list, list, bool]:
+                    err=None, acc=None) -> Tuple[list, list, list, bool]:
         """Print analyzed index dims as subscript parts.
 
         dims: analyze_indices output; shape: per-dim kernel-visible sizes;
@@ -238,6 +249,11 @@ class ExprGen:
         loads and Parallel stores so slicing rules cannot drift.
         """
         err = err or ExprGenError
+
+        def ds(start_src, size):
+            if acc is not None:
+                return acc.ds_part(start_src, size)
+            return f"pl.ds({start_src}, {size})"
         parts, axes_vars, expanded = [], [], []
         fused_any = False
         for d, spec in enumerate(dims):
@@ -251,7 +267,7 @@ class ExprGen:
                 elif r is not None:
                     parts.append(f"{r}:{r + span}")
                 else:
-                    parts.append(f"pl.ds({self.scalar(resid)}, {span})")
+                    parts.append(ds(self.scalar(resid), span))
                 axes_vars.extend(vs)
                 expanded.extend(extents[id(v)] for v in vs)
                 fused_any = True
@@ -270,7 +286,7 @@ class ExprGen:
                 elif r is not None:
                     parts.append(f"{r}:{r + ext}")
                 else:
-                    parts.append(f"pl.ds({self.scalar(resid)}, {ext})")
+                    parts.append(ds(self.scalar(resid), ext))
                 axes_vars.append(v)
                 expanded.append(ext)
         return parts, axes_vars, expanded, fused_any
@@ -286,10 +302,46 @@ class ExprGen:
         dims = self.analyze_indices(e.buffer, acc.local_indices(e.indices))
         ext_of = dict((id(vv), xx) for vv, xx in self.par_vars)
         parts, axes_vars, expanded, fused = self.slice_parts(
-            dims, acc.kernel_shape(), ext_of)
+            dims, acc.kernel_shape(), ext_of, acc=acc)
+        pad_mode = getattr(self.par_vars, "pad", False)
+        if getattr(acc, "pad1", False):
+            return self._padded_load(acc, parts, axes_vars, tuple(expanded),
+                                     fused, pad_mode)
         src = acc.load_sliced(parts)
         if fused:
             src = f"jnp.reshape({src}, {tuple(expanded)})"
+        src = self._align_axes(src, axes_vars)
+        if pad_mode and axes_vars:
+            # (M,) logical operand joining a (M, 1) compute space
+            # (scalar loads broadcast without help)
+            src = f"jnp.expand_dims({src}, (1,))"
+        return src
+
+    def _padded_load(self, acc, parts, axes_vars, expanded, fused,
+                     pad_mode) -> str:
+        """Load from a (M, 1)-stored 1-D buffer, aligned to the nest.
+
+        Fast paths keep the column shape (no relayout): the whole-vector
+        load in a padded 1-var nest, and the row-var position of a 2-D
+        nest (a (M, 1) operand broadcasts over (M, N) for free). Anything
+        else — fused multi-var access included — reshapes through the
+        logical view; correct, but a relayout, so such uses belong
+        outside the hot loop."""
+        if not axes_vars:  # scalar-indexed element
+            return acc.load_elem([p for p in parts])
+        src = acc.load_sliced(parts)  # physical (prod(expanded), 1)
+        canon = [v for v, _ in self.par_vars]
+        if fused or len(axes_vars) != 1:
+            src = f"jnp.reshape({src}, {expanded})"
+            return self._align_axes(src, list(axes_vars))
+        if len(canon) == 1:
+            return src if pad_mode else f"jnp.reshape({src}, (-1,))"
+        pos = {id(v): i for i, v in enumerate(canon)}[id(axes_vars[0])]
+        if pos == len(canon) - 2:
+            if pos == 0:
+                return src
+            return f"jnp.expand_dims({src}, {tuple(range(pos))})"
+        src = f"jnp.reshape({src}, (-1,))"
         return self._align_axes(src, axes_vars)
 
     def _align_axes(self, src: str, axes_vars: List[Var]) -> str:

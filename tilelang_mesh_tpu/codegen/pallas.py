@@ -14,16 +14,38 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
                   BufferLoad,
-                  BufferStoreStmt, CommStmt, CopyStmt, CumSumStmt,
+                  BufferStoreStmt, Cast, CommStmt, CopyStmt, CumSumStmt,
                   EvaluateStmt, FillStmt, ForNest, GemmStmt, IfThenElse,
                   PrintStmt, PrimFunc, ReduceStmt, Region, SeqStmt, Stmt,
-                  as_int, dtype_is_float)
+                  Var, as_int, dtype_is_float)
 from ..transform.plan import BlockDim, KernelPlan, ParamPlan, PlanError
 from .exprgen import ExprGen, ExprGenError, jnp_dtype
 
 
 class CodegenError(Exception):
     pass
+
+
+def _for_each_load(e, fn):
+    """Call fn(load) for every BufferLoad inside expression e, recursing
+    into call args, binop operands, casts, and index expressions. The one
+    expression walker shared by _plan_locals, _param_guards, and
+    _emit_parallel, so their coverage cannot drift."""
+    if isinstance(e, BufferLoad):
+        fn(e)
+        for i in e.indices:
+            if not isinstance(i, slice):
+                _for_each_load(i, fn)
+        return
+    for a in getattr(e, "args", []) or []:
+        if not isinstance(a, str):
+            _for_each_load(a, fn)
+    for at in ("a", "b"):
+        sub = getattr(e, at, None)
+        if sub is not None:
+            _for_each_load(sub, fn)
+    if isinstance(e, Cast):
+        _for_each_load(e.value, fn)
 
 
 class Writer:
@@ -53,16 +75,31 @@ class _Indent:
 
 
 class BufferAccessor:
-    """How a buffer is addressed inside the generated kernel body."""
+    """How a buffer is addressed inside the generated kernel body.
+
+    pad1: logically 1-D VMEM scratch stored as a (M, 1) column vector.
+    A bare (M,) vector lives on the 128-wide lane axis, so broadcasting it
+    over the rows of a (M, N) tile costs a lane->sublane relayout on every
+    use — the dominant cost in online-softmax stats. Column storage makes
+    the row broadcast free; the layout is this codegen's analog of the
+    reference's Fragment layout inference (src/layout/layout.cc).
+    """
 
     def __init__(self, buffer: Buffer, ref: str, kind: str,
                  block_dims: Optional[List[BlockDim]] = None,
-                 grid_names: Optional[List[str]] = None):
+                 grid_names: Optional[List[str]] = None,
+                 pad1: bool = False, local: bool = False):
         self.buffer = buffer
         self.ref = ref
         self.kind = kind  # 'block' | 'scratch' | 'any' | 'smem'
         self.block_dims = block_dims
         self.grid_names = grid_names
+        self.pad1 = pad1
+        # local=True: SSA-promoted fragment — a Python value in the
+        # generated source, not a VMEM scratch ref (see _plan_locals).
+        # Loads work unchanged (jnp values support [...]/slicing); stores
+        # must go through store_target() and be full-tile.
+        self.local = local
 
     # -- index translation ---------------------------------------------------
     def local_indices(self, indices) -> list:
@@ -105,14 +142,40 @@ class BufferAccessor:
 
     # -- source emission -----------------------------------------------------
     def load_elem(self, idx_srcs: List[str]) -> str:
+        if self.pad1:
+            idx_srcs = list(idx_srcs) + ["0"]
         if not idx_srcs:
             return f"{self.ref}[...]"
         return f"{self.ref}[{', '.join(idx_srcs)}]"
 
     def load_sliced(self, parts: List[str]) -> str:
+        if self.pad1:
+            parts = list(parts) + [":"]
         if all(p == ":" for p in parts):
             return f"{self.ref}[...]"
         return f"{self.ref}[{', '.join(parts)}]"
+
+    def store_parts(self, parts: List[str]) -> List[str]:
+        """Physical subscript parts for a store target."""
+        return list(parts) + [":"] if self.pad1 else list(parts)
+
+    def store_target(self, parts: List[str]) -> str:
+        """LHS source for a full/partial store. SSA-promoted buffers only
+        ever see full-tile defs (guaranteed by _plan_locals), so the
+        target is the bare name."""
+        if self.local:
+            return self.ref
+        parts = self.store_parts(parts)
+        return f"{self.ref}[{', '.join(parts)}]"
+
+    def ds_part(self, start_src: str, size: int) -> str:
+        """A dynamic-start slice part. pl.ds only works on refs; an
+        SSA-promoted value is sliced with plain Python slices (its dynamic
+        starts are unroll-time ints — _plan_locals rejects traced
+        indices)."""
+        if self.local:
+            return f"({start_src}):({start_src}) + {size}"
+        return f"pl.ds({start_src}, {size})"
 
     def full(self) -> str:
         return f"{self.ref}[...]"
@@ -136,6 +199,7 @@ class PallasCodegen:
 
     def generate(self) -> str:
         plan = self.plan
+        self._localized = self._plan_locals()
         self._setup_accessors()
         self._scan_dma_usage()
 
@@ -155,6 +219,219 @@ class PallasCodegen:
         w.w("")
         self._emit_build()
         return w.text()
+
+    # ------------------------------------------------------------------
+    def _plan_locals(self) -> set:
+        """Fragment SSA promotion (mem2reg) — this codegen's analog of the
+        reference's StorageRewrite (src/transform/storage_rewrite.cc).
+
+        A scratch fragment qualifies when its whole life is: fully
+        overwritten first, then read/accumulated, all within ONE phase and
+        one control scope chain. Such a buffer never needs VMEM backing —
+        it becomes a Python local in the generated source, so Mosaic sees
+        an SSA value chain instead of memref round-trips between every
+        statement (the difference is ~1.5x on attention-class kernels).
+
+        Loop-carried state (read-before-def in the pipelined main phase,
+        or live across init/main/epi) stays in scratch, as do buffers with
+        partial stores, DMA/atomic/semaphore uses, or conditional defs
+        that escape their scope."""
+
+        cand = {b.uid for b in self.plan.scratch
+                if b.scope not in ("local.var", "smem", "sem")}
+        if not cand:
+            return set()
+        # DMA partners (HBM-resident params) need .at refs
+        any_bufs = {p.buffer.uid for p in self.plan.params
+                    if p.mode == "any"}
+        recs: Dict[int, list] = {}   # uid -> [(kind, phase, scope, seq)]
+        disq = set()
+        seq = [0]
+        # traced ints: lax.fori loop vars plus grid vars (pl.program_id) —
+        # plain slicing of a Python value can't take a traced start index
+        # (pl.ds is ref-only)
+        traced_ids: set = {id(a.var) for a in self.plan.grid}
+
+        def idx_traced(indices) -> bool:
+            from ..ir import free_vars
+            for i in indices:
+                if isinstance(i, slice):
+                    continue
+                if any(id(v) in traced_ids for v in free_vars(i)):
+                    return True
+            return False
+
+        def rec(uid, kind, phase, scope):
+            if uid in cand:
+                recs.setdefault(uid, []).append((kind, phase, tuple(scope),
+                                                 seq[0]))
+            seq[0] += 1
+
+        def expr_uses(e, phase, scope):
+            def on_load(ld):
+                rec(ld.buffer.uid, "use", phase, scope)
+                if idx_traced(ld.indices):
+                    disq.add(ld.buffer.uid)
+            _for_each_load(e, on_load)
+
+        def region_rec(r: Region, kind, phase, scope):
+            full = r.is_full() if hasattr(r, "is_full") else False
+            if idx_traced(r.base):
+                disq.add(r.buffer.uid)
+            if kind in ("def", "rmw") and not full:
+                disq.add(r.buffer.uid)
+                rec(r.buffer.uid, "use", phase, scope)
+            else:
+                rec(r.buffer.uid, kind, phase, scope)
+            for b in r.base:
+                if not isinstance(b, slice):
+                    expr_uses(b, phase, scope)
+
+        scope_n = [0]
+
+        def child(scope):
+            scope_n[0] += 1
+            return scope + [scope_n[0]]
+
+        def scan(s, phase, scope, par_nest):
+            if isinstance(s, AllocStmt) or isinstance(s, EvaluateStmt):
+                return
+            if isinstance(s, SeqStmt):
+                for c in s.stmts:
+                    scan(c, phase, scope, par_nest)
+            elif isinstance(s, CopyStmt):
+                if s.src.buffer.uid in any_bufs or \
+                        s.dst.buffer.uid in any_bufs:
+                    # lowers to rt.dma, which needs .at[] on a real ref
+                    disq.add(s.src.buffer.uid)
+                    disq.add(s.dst.buffer.uid)
+                region_rec(s.src, "use", phase, scope)
+                region_rec(s.dst, "def", phase, scope)
+            elif isinstance(s, AsyncCopyStmt):
+                disq.add(s.src.buffer.uid)
+                disq.add(s.dst.buffer.uid)
+                disq.add(s.sem.uid)
+            elif isinstance(s, GemmStmt):
+                region_rec(s.A, "use", phase, scope)
+                region_rec(s.B, "use", phase, scope)
+                region_rec(s.C, "def" if s.clear_accum else "rmw",
+                           phase, scope)
+            elif isinstance(s, FillStmt):
+                region_rec(s.dst, "def", phase, scope)
+                expr_uses(s.value, phase, scope)
+            elif isinstance(s, ReduceStmt):
+                rec(s.src.uid, "use", phase, scope)
+                rec(s.dst.uid, "def" if s.clear else "rmw", phase, scope)
+            elif isinstance(s, CumSumStmt):
+                rec(s.src.uid, "use", phase, scope)
+                rec(s.dst.uid, "def", phase, scope)
+            elif isinstance(s, AtomicStmt):
+                disq.add(s.dst.buffer.uid)
+                if isinstance(s.value, Region):
+                    region_rec(s.value, "use", phase, scope)
+                else:
+                    expr_uses(s.value, phase, scope)
+            elif isinstance(s, PrintStmt):
+                if isinstance(s.obj, Buffer):
+                    rec(s.obj.uid, "use", phase, scope)
+                else:
+                    expr_uses(s.obj, phase, scope)
+            elif isinstance(s, AssertStmt):
+                expr_uses(s.cond, phase, scope)
+            elif isinstance(s, IfThenElse):
+                expr_uses(s.cond, phase, scope)
+                sc = child(scope)
+                for c in s.then_body.stmts:
+                    scan(c, phase, sc, par_nest)
+                if s.else_body is not None:
+                    sc2 = child(scope)
+                    for c in s.else_body.stmts:
+                        scan(c, phase, sc2, par_nest)
+            elif isinstance(s, ForNest):
+                for e in s.extents:
+                    expr_uses(e, phase, scope)
+                if s.kind in ("parallel", "vectorized"):
+                    nest = par_nest + list(zip(s.loop_vars,
+                                               [as_int(e) for e in s.extents]))
+                    for c in s.body.stmts:
+                        scan(c, phase, scope, nest)
+                elif s.kind == "unroll" or (as_int(s.extents[0]) is not None
+                                            and as_int(s.extents[0]) <= 4):
+                    for c in s.body.stmts:
+                        scan(c, phase, scope, par_nest)
+                else:  # lax.fori_loop body = its own function scope
+                    sc = child(scope)
+                    for v in s.loop_vars:
+                        traced_ids.add(id(v))
+                    for c in s.body.stmts:
+                        scan(c, phase, sc, par_nest)
+            elif isinstance(s, BufferStoreStmt):
+                expr_uses(s.value, phase, scope)
+                for i in s.indices:
+                    if not isinstance(i, slice):
+                        expr_uses(i, phase, scope)
+                uid = s.buffer.uid
+                if uid in cand:
+                    if idx_traced(s.indices):
+                        disq.add(uid)
+                    # full def iff indices are exactly the par nest vars,
+                    # one per dim, covering each dim
+                    shape = [as_int(x) for x in s.buffer.shape]
+                    ext_of = {id(v): e for v, e in par_nest}
+                    full = len(s.indices) == len(shape) and \
+                        None not in shape
+                    used = set()
+                    if full:
+                        for idx, dim in zip(s.indices, shape):
+                            if not (isinstance(idx, Var) and
+                                    id(idx) in ext_of and
+                                    ext_of[id(idx)] == dim and
+                                    id(idx) not in used):
+                                full = False
+                                break
+                            used.add(id(idx))
+                    if full:
+                        rec(uid, "def", phase, scope)
+                    else:
+                        disq.add(uid)
+                        rec(uid, "use", phase, scope)
+            elif isinstance(s, CommStmt):
+                for at in ("src", "dst"):
+                    r = getattr(s, at, None)
+                    if isinstance(r, Region):
+                        disq.add(r.buffer.uid)
+
+        for phase, stmts in (("init", self.plan.init_stmts),
+                             ("main", self.plan.main_stmts),
+                             ("epi", self.plan.epi_stmts)):
+            for s in stmts:
+                scan(s, phase, [0], [])
+
+        out = set()
+        for uid in cand:
+            if uid in disq or uid in any_bufs:
+                continue
+            rs = recs.get(uid)
+            if not rs:
+                continue
+            phases = {p for _, p, _, _ in rs}
+            if len(phases) != 1:
+                continue
+            rs = sorted(rs, key=lambda r: r[3])
+            if rs[0][0] != "def":
+                continue
+            # defs and rmws REBIND the Python name, so they must all sit in
+            # one scope (a rebind inside a pl.when / fori body function
+            # neither escapes nor sees the outer binding); plain reads may
+            # be in any descendant scope (closure capture).
+            bind_scopes = {sc for k, _, sc, _ in rs if k in ("def", "rmw")}
+            if len(bind_scopes) != 1:
+                continue
+            s0 = next(iter(bind_scopes))
+            if any(sc[:len(s0)] != s0 for _, _, sc, _ in rs):
+                continue
+            out.add(uid)
+        return out
 
     # ------------------------------------------------------------------
     def _setup_accessors(self):
@@ -181,9 +458,46 @@ class PallasCodegen:
                                  p.block_dims)
             acc.set_axis_vars(self._grid_axis_vars)
             self.accessors[p.buffer.uid] = acc
+        padded = self._decide_pad1()
         for b in plan.scratch:
             kind = "smem" if b.scope in ("local.var", "smem") else "scratch"
-            self.accessors[b.uid] = BufferAccessor(b, f"{b.name}_s", kind)
+            if b.uid in self._localized:
+                self.accessors[b.uid] = BufferAccessor(
+                    b, f"{b.name}_l", "scratch", pad1=b.uid in padded,
+                    local=True)
+            else:
+                self.accessors[b.uid] = BufferAccessor(
+                    b, f"{b.name}_s", kind, pad1=b.uid in padded)
+
+    def _decide_pad1(self) -> set:
+        """1-D VMEM scratch buffers stored as (M, 1) columns (see
+        BufferAccessor.pad1). Buffers that take part in a DMA against an
+        HBM-resident param keep their logical shape (DMA endpoints must
+        match byte-for-byte)."""
+        from ..ir import walk
+        padded = set()
+        for b in self.plan.scratch:
+            if b.scope in ("local.var", "smem", "sem"):
+                continue
+            if len(b.shape) == 1 and as_int(b.shape[0]) is not None:
+                padded.add(b.uid)
+        if not padded:
+            return padded
+        any_bufs = {p.buffer.uid for p in self.plan.params
+                    if p.mode == "any"}
+
+        def chk(s):
+            if isinstance(s, (CopyStmt, AsyncCopyStmt)):
+                su, du = s.src.buffer.uid, s.dst.buffer.uid
+                if su in any_bufs:
+                    padded.discard(du)
+                if du in any_bufs:
+                    padded.discard(su)
+        for stmts in (self.plan.init_stmts, self.plan.main_stmts,
+                      self.plan.epi_stmts):
+            for s in stmts:
+                walk(s, chk)
+        return padded
 
     def _scan_dma_usage(self):
         from ..ir import walk
@@ -206,7 +520,8 @@ class PallasCodegen:
         args = [f"{p.buffer.name}_in_ref" if p.role == "inout"
                 else f"{p.buffer.name}_ref" for p in self.plan.inputs]
         args += [f"{p.buffer.name}_ref" for p in self.plan.outputs]
-        args += [f"{b.name}_s" for b in self.plan.scratch]
+        args += [f"{b.name}_s" for b in self.plan.scratch
+                 if b.uid not in self._localized]
         if self._uses_dma:
             args.append("_dma_sem")
         return args
@@ -339,7 +654,7 @@ class PallasCodegen:
             elif bi is not None:
                 parts.append(f"{bi}:{bi + sz}")
             else:
-                parts.append(f"pl.ds({eg.scalar(b)}, {sz})")
+                parts.append(acc.ds_part(eg.scalar(b), sz))
         return parts
 
     def _region_load(self, region: Region, eg: ExprGen,
@@ -377,12 +692,21 @@ class PallasCodegen:
             kept = tuple(d_shape)
         # effective src shape after squeeze
         eff = tuple(s_shape[max(0, len(s_shape) - len(kept)):])
-        if eff != kept:
+        if src_acc.pad1 and not dst_acc.pad1:
+            # (N, 1) column -> logical (N,), then broadcast if the dst is
+            # wider (one relayout, at the copy)
+            val = f"jnp.reshape({val}, {eff})"
+            if eff != kept:
+                val = f"jnp.broadcast_to({val}, {kept})"
+        elif dst_acc.pad1 and not src_acc.pad1:
+            val = f"jnp.reshape({val}, {kept + (1,)})"
+        elif eff != kept:
             val = f"jnp.broadcast_to({val}, {kept})"
         if s.src.buffer.dtype != s.dst.buffer.dtype:
             val = f"({val}).astype({jnp_dtype(s.dst.buffer.dtype)})"
-        parts = self._region_parts(s.dst, eg, drop_to_rank=None)
-        w.w(f"{dst_acc.ref}[{', '.join(parts)}] = {val}")
+        tgt = dst_acc.store_target(self._region_parts(s.dst, eg,
+                                                      drop_to_rank=None))
+        w.w(f"{tgt} = {val}")
         return True
 
     def _emit_dma(self, src: Region, dst: Region, sem: str, fn: str,
@@ -430,11 +754,13 @@ class PallasCodegen:
                f"preferred_element_type={pref})")
         c_acc = self.accessors[c_buf.uid]
         parts = self._region_parts(s.C, eg)
-        tgt = f"{c_acc.ref}[{', '.join(parts)}]"
+        tgt = c_acc.store_target(parts)
+        src_ref = f"{c_acc.ref}[{', '.join(c_acc.store_parts(parts))}]" \
+            if not c_acc.local else c_acc.ref
         if s.clear_accum:
             w.w(f"{tgt} = ({dot}).astype({acc_dt})")
         else:
-            w.w(f"{tgt} = {tgt} + ({dot}).astype({acc_dt})")
+            w.w(f"{tgt} = {src_ref} + ({dot}).astype({acc_dt})")
         return True
 
     def _emit_fill(self, s: FillStmt) -> bool:
@@ -444,34 +770,48 @@ class PallasCodegen:
         if acc.kind == "any":
             raise CodegenError(f"cannot fill HBM-resident buffer "
                                f"{s.dst.buffer.name} in-kernel")
-        parts = self._region_parts(s.dst, eg)
+        tgt = acc.store_target(self._region_parts(s.dst, eg))
         shape = s.dst.static_shape()
         if acc.kind == "block" and acc.block_dims is not None:
             shape = tuple(s2 for s2, bd in zip(shape, acc.block_dims)
                           if bd.size is not None)
+        shape = tuple(shape) + ((1,) if acc.pad1 else ())
         dt = jnp_dtype(s.dst.buffer.dtype)
-        w.w(f"{acc.ref}[{', '.join(parts)}] = "
-            f"jnp.full({tuple(shape)}, {eg.scalar(s.value)}, {dt})")
+        w.w(f"{tgt} = jnp.full({shape}, {eg.scalar(s.value)}, {dt})")
         return True
 
     def _emit_reduce(self, s: ReduceStmt) -> bool:
         w = self.w
         src = self.accessors[s.src.uid]
         dst = self.accessors[s.dst.uid]
-        keepdims = s.src.ndim == s.dst.ndim
+        keepdims = s.src.ndim == s.dst.ndim or dst.pad1
+        src_v = src.full()
+        if src.pad1 and not dst.pad1:
+            # drop the phantom column axis so dims/keepdims stay logical
+            src_v = f"jnp.reshape({src_v}, (-1,))"
         old = dst.full() if not s.clear else "None"
-        val = (f"rt.reduce({s.kind!r}, {src.full()}, {s.dim}, {keepdims}, "
+        val = (f"rt.reduce({s.kind!r}, {src_v}, {s.dim}, {keepdims}, "
                f"old={old})")
+        if dst.pad1 and s.dim == 0 and s.src.ndim == 2:
+            # sublane reduce yields (1, N); the column store needs (N, 1)
+            n = as_int(s.dst.shape[0])
+            val = f"jnp.reshape({val}, ({n}, 1))"
         if s.src.dtype != s.dst.dtype and s.clear:
             val = f"({val}).astype({jnp_dtype(s.dst.dtype)})"
-        w.w(f"{dst.full()} = {val}")
+        tgt = dst.ref if dst.local else dst.full()
+        w.w(f"{tgt} = {val}")
         return True
 
     def _emit_cumsum(self, s: CumSumStmt) -> bool:
         src = self.accessors[s.src.uid]
         dst = self.accessors[s.dst.uid]
-        self.w.w(f"{dst.full()} = rt.cumsum({src.full()}, {s.dim}, "
-                 f"{s.reverse}).astype({jnp_dtype(s.dst.dtype)})")
+        val = f"rt.cumsum({src.full()}, {s.dim}, {s.reverse})"
+        if src.pad1 != dst.pad1:
+            shp = tuple(as_int(x) for x in s.dst.shape) + \
+                ((1,) if dst.pad1 else ())
+            val = f"jnp.reshape({val}, {shp})"
+        tgt = dst.ref if dst.local else dst.full()
+        self.w.w(f"{tgt} = ({val}).astype({jnp_dtype(s.dst.dtype)})")
         return True
 
     def _emit_for(self, s: ForNest, par_ctx) -> bool:
@@ -510,10 +850,28 @@ class PallasCodegen:
         return True
 
     def _emit_parallel(self, s: ForNest) -> bool:
+        from .exprgen import ParCtx
         exts = [as_int(e) for e in s.extents]
         if any(e is None for e in exts):
             raise CodegenError("T.Parallel extents must be static")
-        par_vars = list(zip(s.loop_vars, exts))
+        par_vars = ParCtx(zip(s.loop_vars, exts))
+        if len(par_vars) == 1:
+            # 1-var nests compute in (M, 1) column space when any buffer
+            # they touch is column-stored (see BufferAccessor.pad1)
+            from ..ir import walk
+            touched = []
+
+            def see(x):
+                if isinstance(x, BufferStoreStmt):
+                    touched.append(x.buffer.uid)
+                v = getattr(x, "value", None)
+                if v is not None and not isinstance(v, (Region, Buffer)):
+                    _for_each_load(v,
+                                   lambda ld: touched.append(ld.buffer.uid))
+            walk(s.body, see)
+            par_vars.pad = any(
+                getattr(self.accessors.get(u), "pad1", False)
+                for u in touched)
         self._emit_stmts(s.body.stmts, par_vars)
         return True
 
@@ -545,6 +903,8 @@ class PallasCodegen:
             # scalar store
             eg = self._eg(None)
             idx = [eg.scalar(i) for i in acc.local_indices(list(s.indices))]
+            if acc.pad1:
+                idx.append("0")
             val = eg.scalar(s.value)
             if s.value.dtype != s.buffer.dtype:
                 val = f"rt.cast({val}, {jnp_dtype(s.buffer.dtype)})"
@@ -558,13 +918,14 @@ class PallasCodegen:
                           if bd.size is not None]
         ext_of = dict((id(vv), xx) for vv, xx in par_ctx)
         parts, axes_vars, _, fused_any = eg.slice_parts(
-            dims, kept_shape, ext_of, err=CodegenError)
+            dims, kept_shape, ext_of, err=CodegenError, acc=acc)
         canon = [v for v, _ in par_ctx]
         if {id(v) for v in axes_vars} != {id(v) for v in canon}:
             raise CodegenError(
                 "a T.Parallel store must use every loop var exactly once "
                 "(reductions go through T.reduce_*)")
         val = eg.vector(s.value)
+        pad_mode = getattr(par_ctx, "pad", False)
         # value axes are canonical order; store axes may be permuted
         canon_pos = {id(v): i for i, v in enumerate(canon)}
         store_order = [canon_pos[id(v)] for v in axes_vars]
@@ -572,7 +933,13 @@ class PallasCodegen:
             perm = tuple(store_order)
             val = f"jnp.transpose({val}, {_argsort(perm)})"
         shape = tuple(ext_of[id(v)] for v in axes_vars)
-        val = f"jnp.broadcast_to({val}, {shape})"
+        if pad_mode:
+            # value space is (M, 1) columns
+            val = f"jnp.broadcast_to({val}, {shape + (1,)})"
+            if not acc.pad1:
+                val = f"jnp.reshape({val}, {shape})"
+        else:
+            val = f"jnp.broadcast_to({val}, {shape})"
         if fused_any:
             # collapse each fused var group back into its single buffer dim
             tgt_shape = []
@@ -581,10 +948,12 @@ class PallasCodegen:
                     tgt_shape.append(spec[3])
                 elif spec[0] == "var":
                     tgt_shape.append(ext_of[id(spec[1])])
+            if acc.pad1:
+                tgt_shape.append(1)  # column storage
             val = f"jnp.reshape({val}, {tuple(tgt_shape)})"
         if s.value.dtype != s.buffer.dtype:
             val = f"({val}).astype({jnp_dtype(s.buffer.dtype)})"
-        w.w(f"{acc.ref}[{', '.join(parts)}] = {val}")
+        w.w(f"{acc.store_target(parts)} = {val}")
         return True
 
     def _emit_atomic(self, s: AtomicStmt, par_ctx) -> bool:
@@ -594,11 +963,16 @@ class PallasCodegen:
             raise CodegenError("atomic ops on HBM-resident buffers are not "
                                "supported on TPU; accumulate in VMEM")
         eg = self._eg(par_ctx)
-        parts = self._region_parts(s.dst, eg)
+        parts = acc.store_parts(self._region_parts(s.dst, eg))
         tgt = f"{acc.ref}[{', '.join(parts)}]"
         if isinstance(s.value, Region):
             val = self._region_load(s.value, eg,
                                     squeeze_to=len(s.dst.static_shape() or ()))
+            v_acc = self.accessors[s.value.buffer.uid]
+            if v_acc.pad1 != acc.pad1:
+                shp = tuple(s.dst.static_shape() or ()) + \
+                    ((1,) if acc.pad1 else ())
+                val = f"jnp.reshape({val}, {shp})"
         elif par_ctx:
             val = eg.vector(s.value)
         else:
@@ -622,6 +996,75 @@ class PallasCodegen:
         return True
 
     # ------------------------------------------------------------------
+    def _param_guards(self) -> Dict[int, Any]:
+        """Conditional prefetch redirection (the trick jax's flash kernel
+        hand-codes in its kv_index_map): a block param whose every main-
+        phase read sits under an IfThenElse over grid vars gets, for index
+        dims driven by the pipeline axis, `where(cond, idx, 0)` — on
+        skipped grid steps the pipeline re-requests a block it would fetch
+        anyway instead of streaming one nobody reads. Returns
+        uid -> guard cond expr."""
+        from ..ir import free_vars, walk
+        pa = self.plan.pipeline_axis
+        if pa is None:
+            return {}
+        grid_ids = {id(a.var) for a in self.plan.grid}
+        pa_var = self.plan.grid[pa].var
+
+        def reads_of(stmts):
+            seen = set()
+
+            def chk(x):
+                for attr in ("src", "A", "B"):
+                    r = getattr(x, attr, None)
+                    if isinstance(r, Region):
+                        seen.add(r.buffer.uid)
+                # read-modify-write targets are reads too
+                if isinstance(x, GemmStmt) and not x.clear_accum:
+                    seen.add(x.C.buffer.uid)
+                if isinstance(x, ReduceStmt) and not x.clear:
+                    seen.add(x.dst.uid)
+                if isinstance(x, AtomicStmt):
+                    seen.add(x.dst.buffer.uid)
+                if isinstance(x, PrintStmt) and isinstance(x.obj, Buffer):
+                    seen.add(x.obj.uid)
+                if isinstance(x, IfThenElse):
+                    _for_each_load(x.cond,
+                                   lambda ld: seen.add(ld.buffer.uid))
+                for at in ("value", "cond", "obj"):
+                    v = getattr(x, at, None)
+                    if v is not None and not isinstance(
+                            v, (Region, Buffer, Stmt, str)):
+                        _for_each_load(v,
+                                       lambda ld: seen.add(ld.buffer.uid))
+                if isinstance(x, BufferStoreStmt):
+                    for i in x.indices:
+                        if not isinstance(i, slice):
+                            _for_each_load(
+                                i, lambda ld: seen.add(ld.buffer.uid))
+            for s in stmts:
+                walk(s, chk)
+            return seen
+
+        guarded: Dict[int, Any] = {}
+        unguarded = set()
+        unguarded |= reads_of(self.plan.init_stmts)
+        unguarded |= reads_of(self.plan.epi_stmts)
+        for s in self.plan.main_stmts:
+            if isinstance(s, IfThenElse) and s.else_body is None and \
+                    all(id(v) in grid_ids for v in free_vars(s.cond)) and \
+                    any(v is pa_var for v in free_vars(s.cond)):
+                for uid in reads_of(s.then_body.stmts):
+                    if uid in guarded and guarded[uid] is not s.cond:
+                        unguarded.add(uid)
+                    guarded[uid] = s.cond
+            else:
+                unguarded |= reads_of([s])
+        param_uids = {p.buffer.uid for p in self.plan.params
+                      if p.mode == "block"}
+        return {uid: c for uid, c in guarded.items()
+                if uid not in unguarded and uid in param_uids}
+
     def _emit_build(self):
         w = self.w
         plan = self.plan
@@ -631,9 +1074,11 @@ class PallasCodegen:
         w.w("def build(interpret=False):")
         with w.block():
             gargs = ", ".join(f"_i{i}" for i in range(len(grid)))
+            guards = self._param_guards()
             in_specs = []
             for p in plan.inputs:
-                in_specs.append(self._spec_src(p, gargs))
+                in_specs.append(self._spec_src(p, gargs,
+                                               guards.get(p.buffer.uid)))
             out_specs = []
             out_shapes = []
             for p in plan.outputs:
@@ -656,10 +1101,14 @@ class PallasCodegen:
             w.w("scratch_shapes = [")
             with w.block():
                 for b in plan.scratch:
+                    if b.uid in self._localized:
+                        continue
                     shp = tuple(as_int(x) for x in b.shape)
                     if b.scope == "sem":
                         w.w(f"pltpu.SemaphoreType.DMA({shp}),")
                         continue
+                    if self.accessors[b.uid].pad1:
+                        shp = shp + (1,)
                     space = "pltpu.SMEM" if b.scope in ("local.var", "smem") \
                         else "pltpu.VMEM"
                     w.w(f"{space}({shp}, {jnp_dtype(b.dtype)}),")
@@ -716,9 +1165,18 @@ class PallasCodegen:
                     w.w("return tuple(r)")
             w.w("return call")
 
-    def _spec_src(self, p: ParamPlan, gargs: str) -> str:
+    def _spec_src(self, p: ParamPlan, gargs: str, guard=None) -> str:
         if p.mode == "any":
             return "pl.BlockSpec(memory_space=pl.ANY)"
+        pa = self.plan.pipeline_axis
+        guard_src = None
+        if guard is not None:
+            env = {id(a.var): f"_i{i}"
+                   for i, a in enumerate(self.plan.grid)}
+            try:
+                guard_src = ExprGen(env, {}).scalar(guard)
+            except ExprGenError:
+                guard_src = None
         dims = p.block_dims
         shape = "(" + ", ".join(str(d.size) for d in dims) + \
             ("," if len(dims) == 1 else "") + ")"
@@ -730,6 +1188,11 @@ class PallasCodegen:
             e = " + ".join(terms) if terms else "0"
             if d.post_div != 1:
                 e = f"({e}) // {d.post_div}"
+            if guard_src is not None and \
+                    any(a == pa for a, _ in d.terms):
+                # skipped step: re-request block 0 (already fetched for a
+                # neighboring step) instead of streaming an unread block
+                e = f"jnp.where({guard_src}, {e}, 0)"
             idx_parts.append(e)
         idx = ", ".join(idx_parts)
         if len(dims) == 1:
