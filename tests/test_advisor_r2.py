@@ -1,0 +1,118 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md):
+
+1. autotuner run_with_timeout must return promptly when a config wedges
+   (previously blocked in ThreadPoolExecutor.__exit__ until the hung fn
+   finished).
+2. prefetch-guard redirection must never apply to inout params
+   (previously corrupted untouched blocks of an aliased tensor).
+3. SSA promotion must be disqualified for buffers indexed through a
+   BufferLoad (e.g. an SMEM scalar) — previously a trace-time TypeError.
+4. pad1 column layout must be dropped for both endpoints of split-phase
+   DMA (previously mismatched .at[] window shapes between two VMEM
+   scratches).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+
+def test_run_with_timeout_abandons_hung_config():
+    import concurrent.futures
+
+    from tilelang_mesh_tpu.autotuner import run_with_timeout
+
+    t0 = time.perf_counter()
+    with pytest.raises(concurrent.futures.TimeoutError):
+        run_with_timeout(time.sleep, 0.3, 3.0)
+    elapsed = time.perf_counter() - t0
+    # the old context-manager version blocked ~3.0s here
+    assert elapsed < 1.5, f"timeout did not abandon the worker ({elapsed:.2f}s)"
+
+
+def test_run_with_timeout_propagates_errors_and_results():
+    from tilelang_mesh_tpu.autotuner import run_with_timeout
+
+    assert run_with_timeout(lambda x: x + 1, 5.0, 41) == 42
+    with pytest.raises(ValueError, match="boom"):
+        run_with_timeout(
+            lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0)
+
+
+def test_prefetch_guard_not_applied_to_inout_param():
+    """An inout tensor read only on pipeline step 0 must keep its other
+    blocks intact: guard redirection on the input spec would write
+    block-0 data over them via the unguarded output spec."""
+    NB, BM, BN = 4, 8, 128
+
+    @T.prim_func
+    def bump_first(X: T.Tensor((NB * BM, BN), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((BM, BN), "float32")
+            for ko in T.Pipelined(NB):
+                with T.If(ko == 0):
+                    T.copy(X[ko * BM, 0], s)
+                    for i, j in T.Parallel(BM, BN):
+                        s[i, j] = s[i, j] + 1.0
+                    T.copy(s, X[ko * BM, 0])
+
+    k = tilelang.compile(bump_first)
+    x = np.arange(NB * BM * BN, dtype=np.float32).reshape(NB * BM, BN)
+    orig = x.copy()
+    k(x)
+    np.testing.assert_allclose(x[:BM], orig[:BM] + 1.0)
+    np.testing.assert_allclose(x[BM:], orig[BM:])
+
+
+def test_ssa_promotion_rejects_buffer_load_index():
+    """A fragment read at a row index loaded from an SMEM scalar must not
+    be promoted to a Python local (plain slices can't take traced
+    starts); it must stay in VMEM scratch and still produce the right
+    answer."""
+    R, C = 8, 128
+
+    @T.prim_func
+    def pick_row(A: T.Tensor((R, C), "float32"),
+                 O: T.Tensor((1, C), "float32")):
+        with T.Kernel(1) as bx:
+            f = T.alloc_fragment((R, C), "float32")
+            iv = T.alloc_var("int32")
+            for i, j in T.Parallel(R, C):
+                f[i, j] = A[i, j] * 2.0
+            iv[0] = 3
+            T.copy(f[iv[0], 0], O)
+
+    k = tilelang.compile(pick_row)
+    a = np.random.default_rng(0).standard_normal((R, C)).astype(np.float32)
+    out = np.empty((1, C), np.float32)
+    k(a, out)
+    np.testing.assert_allclose(out[0], a[3] * 2.0, rtol=1e-6)
+
+
+def test_pad1_dropped_for_async_copy_between_scratches():
+    """Split-phase DMA between two VMEM scratches where one endpoint
+    would otherwise be (N,1)-padded: rt.dma windows both sides with
+    .at[] and applies no pad column, so the shapes must agree."""
+    N = 128
+
+    @T.prim_func
+    def relay(A: T.Tensor((N,), "float32"), O: T.Tensor((N,), "float32")):
+        with T.Kernel(1) as bx:
+            s1 = T.alloc_shared((N,), "float32")
+            s2 = T.alloc_shared((N,), "float32")
+            sems = T.alloc_semaphore(2)
+            T.copy_async(A, s1, sems, 0)
+            T.copy_wait(A, s1, sems, 0)
+            T.copy_async(s1, s2, sems, 1)
+            T.copy_wait(s1, s2, sems, 1)
+            T.copy(s2, O)
+
+    k = tilelang.compile(relay)
+    a = np.random.default_rng(1).standard_normal((N,)).astype(np.float32)
+    out = np.empty_like(a)
+    k(a, out)
+    np.testing.assert_allclose(out, a, rtol=1e-6)

@@ -46,15 +46,36 @@ class AutotuneResult:
 def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
     """Run fn with a wall-clock timeout (reference tuner.py:51).
 
-    Uses a worker thread: a hung XLA compile or device sync can't be
-    interrupted in-process, but the sweep moves on and the config is
-    recorded as failed instead of wedging the whole search.
+    Uses a daemon worker thread and abandons it on timeout: a hung XLA
+    compile or device sync can't be interrupted in-process, but the sweep
+    must move on immediately — so the executor is shut down with
+    wait=False (never inside a `with` block, whose __exit__ would block
+    on the wedged worker until it finishes).
     """
     if timeout is None:
         return fn(*args, **kwargs)
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
-        fut = ex.submit(fn, *args, **kwargs)
-        return fut.result(timeout=timeout)
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _worker():
+        try:
+            q.put((True, fn(*args, **kwargs)))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            q.put((False, e))
+
+    t = threading.Thread(target=_worker, daemon=True,
+                         name="tl-autotune-timeout")
+    t.start()
+    try:
+        ok, val = q.get(timeout=timeout)
+    except queue.Empty:
+        raise concurrent.futures.TimeoutError(
+            f"config exceeded {timeout}s; worker abandoned")
+    if not ok:
+        raise val
+    return val
 
 
 class AutoTuner:

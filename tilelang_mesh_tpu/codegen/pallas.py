@@ -259,6 +259,13 @@ class PallasCodegen:
                     continue
                 if any(id(v) in traced_ids for v in free_vars(i)):
                     return True
+                # Loads from refs (e.g. an SMEM scalar sm[0]) are always
+                # traced values even though they carry no free Vars —
+                # a Python slice of a promoted local can't take them.
+                loads = [0]
+                _for_each_load(i, lambda ld: loads.__setitem__(0, 1))
+                if loads[0]:
+                    return True
             return False
 
         def rec(uid, kind, phase, scope):
@@ -487,7 +494,14 @@ class PallasCodegen:
                     if p.mode == "any"}
 
         def chk(s):
-            if isinstance(s, (CopyStmt, AsyncCopyStmt)):
+            if isinstance(s, AsyncCopyStmt):
+                # Split-phase DMA always lowers through rt.dma, which
+                # windows both endpoints with .at[] and never applies the
+                # pad column — so neither endpoint may be padded, even
+                # when both are VMEM scratch.
+                padded.discard(s.src.buffer.uid)
+                padded.discard(s.dst.buffer.uid)
+            elif isinstance(s, CopyStmt):
                 su, du = s.src.buffer.uid, s.dst.buffer.uid
                 if su in any_bufs:
                     padded.discard(du)
@@ -1060,8 +1074,11 @@ class PallasCodegen:
                     guarded[uid] = s.cond
             else:
                 unguarded |= reads_of([s])
+        # Pure inputs only: an inout param is aliased into both in_specs
+        # and out_specs, and redirecting only its input index_map would
+        # write block-0 data back over untouched blocks on skipped steps.
         param_uids = {p.buffer.uid for p in self.plan.params
-                      if p.mode == "block"}
+                      if p.mode == "block" and p.role == "in"}
         return {uid: c for uid, c in guarded.items()
                 if uid not in unguarded and uid in param_uids}
 

@@ -44,6 +44,12 @@ class JITKernel:
                               if p.role in ("in", "inout")]
         self._out_positions = [i for i, p in enumerate(art.params)
                                if p.role == "out"]
+        # result index -> input index, for in-place (inout) params: the
+        # reference mutates these in place, so non-jax inputs get the
+        # result copied back (kernel.py __call__).
+        self._inout_results = [
+            (oi, self._in_params.index(p))
+            for oi, p in enumerate(self._out_params) if p.role == "inout"]
 
     # ------------------------------------------------------------------
     def __call__(self, *args, stream=None, **kwargs):
@@ -62,15 +68,22 @@ class JITKernel:
         self._check_shapes(jax_ins)
         result = self.func(*jax_ins)
         results = result if isinstance(result, tuple) else (result,)
+        import jax as _jax
+        wrote_back = False
+        for oi, ii in self._inout_results:
+            if not isinstance(ins[ii], _jax.Array):
+                copy_back(ins[ii], results[oi])
+                wrote_back = True
         if outs_provided:
-            import jax as _jax
-            wrote_back = False
-            for dst, src in zip(outs_provided, results):
+            out_results = [r for r, p in zip(results, self._out_params)
+                           if p.role == "out"]
+            for dst, src in zip(outs_provided, out_results):
                 if not isinstance(dst, _jax.Array):
                     copy_back(dst, src)
                     wrote_back = True
-            if wrote_back:
-                return None if len(results) == 1 else None
+        if wrote_back and (outs_provided or
+                           len(self._inout_results) == len(results)):
+            return None
         return results[0] if len(results) == 1 else results
 
     def _check_shapes(self, jax_ins):
