@@ -7,7 +7,7 @@ import tilelang_mesh_tpu as tilelang
 import tilelang_mesh_tpu.language as T
 
 
-def _quickstart(M=256, N=256, K=256, bm=128, bn=128, bk=64):
+def _quickstart(M=256, N=256, K=256, bm=128, bn=128, bk=128):
     @T.prim_func
     def matmul_relu_kernel(
             A: T.Tensor((M, K), "float32"),
@@ -33,14 +33,14 @@ def _quickstart(M=256, N=256, K=256, bm=128, bn=128, bk=64):
 GOLDEN_QUICKSTART = """\
 def matmul_relu_kernel(A: Tensor((256, 256), float32), B: Tensor((256, 256), float32), C: Tensor((256, 256), float32)):
   with Kernel((2, 2), threads=128) as (bx, by,):
-    shared = alloc((128, 64), float32, scope=shared)
-    shared_1 = alloc((64, 128), float32, scope=shared)
+    shared = alloc((128, 128), float32, scope=shared)
+    shared_1 = alloc((128, 128), float32, scope=shared)
     frag = alloc((128, 128), float32, scope=fragment)
     fill(frag[(0, 0); (128, 128)], 0)
-    for (ko,) in pipelined((4), num_stages=3):
-      copy(A[(by * 128, ko * 64); (128, 64)] -> shared[(0, 0); (128, 64)])
-      copy(B[(ko * 64, bx * 128); (64, 128)] -> shared_1[(0, 0); (64, 128)])
-      gemm(shared[(0, 0); (128, 64)], shared_1[(0, 0); (64, 128)] -> frag[(0, 0); (128, 128)])
+    for (ko,) in pipelined((2), num_stages=3):
+      copy(A[(by * 128, ko * 128); (128, 128)] -> shared[(0, 0); (128, 128)])
+      copy(B[(ko * 128, bx * 128); (128, 128)] -> shared_1[(0, 0); (128, 128)])
+      gemm(shared[(0, 0); (128, 128)], shared_1[(0, 0); (128, 128)] -> frag[(0, 0); (128, 128)])
     for (i, j,) in parallel((128, 128)):
       frag[i, j] = max(frag[i, j], 0)
     copy(frag[(0, 0); (128, 128)] -> C[(by * 128, bx * 128); (128, 128)])
@@ -57,6 +57,25 @@ def test_trace_is_deterministic():
 
 GOLDEN_PLAN = """\
 plan(matmul_relu_kernel):
+  grid = [by:2:parallel, bx:2:parallel, ko:2:arbitrary]
+  in    A: block[128@(by), 128@(ko)] alias=shared
+  in    B: block[128@(ko), 128@(bx)] alias=shared_1
+  out   C: block[128@(by), 128@(bx)]
+  scratch frag: (128, 128) float32 [fragment] @0
+  vmem arena: 65536 bytes (liveness-packed)
+  phases: init=1 main=3 epi=2
+"""
+
+
+# bk=64 makes A's minor block dim 64 on a 256-wide axis — illegal under
+# Mosaic's (8, 128) min-tile rule, and its ko-dependent lane offset can't
+# be widened away (Mosaic requires provably 128-aligned lane starts, DMA
+# included) — so the plan keeps the block mapping (interpret mode
+# executes it) and the generated build() raises a clear error on the
+# real-TPU path. B's 64 sits on the second-minor axis (divisible by 8)
+# and is legal as-is.
+GOLDEN_PLAN_WIDENED = """\
+plan(matmul_relu_kernel):
   grid = [by:2:parallel, bx:2:parallel, ko:4:arbitrary]
   in    A: block[128@(by), 64@(ko)] alias=shared
   in    B: block[64@(ko), 128@(bx)] alias=shared_1
@@ -65,6 +84,33 @@ plan(matmul_relu_kernel):
   vmem arena: 65536 bytes (liveness-packed)
   phases: init=1 main=3 epi=2
 """
+
+
+def test_min_tile_illegal_lane_block_raises_on_tpu_path():
+    """The same bk=64 kernel must raise the clear Mosaic-legality error
+    when built for a real TPU (interpret=False)."""
+    art = tilelang.lower(_quickstart(bk=64), target="cpu")
+    ns = {}
+    exec(compile(art.kernel_source, "<test>", "exec"), ns)
+    with pytest.raises(NotImplementedError, match="128-aligned"):
+        ns["build"](interpret=False)
+
+
+def test_min_tile_widening_plan_golden():
+    art = tilelang.lower(_quickstart(bk=64), target="cpu")
+    assert art.plan_desc == GOLDEN_PLAN_WIDENED
+
+
+def test_min_tile_widened_kernel_executes():
+    import numpy as np
+    k = tilelang.compile(_quickstart(bk=64))
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((256, 256), dtype=np.float32)
+    b = rng.standard_normal((256, 256), dtype=np.float32)
+    c = np.empty((256, 256), np.float32)
+    k(a, b, c)
+    np.testing.assert_allclose(c, np.maximum(a @ b, 0), rtol=2e-2,
+                               atol=2e-1)
 
 
 def test_quickstart_plan_golden():

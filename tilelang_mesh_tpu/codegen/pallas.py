@@ -446,11 +446,10 @@ class PallasCodegen:
         for i, a in enumerate(plan.grid):
             self.var_env[id(a.var)] = f"_g{i}"
         # params: inputs then outputs (matching pallas ref order)
+        # ParamPlan.mode values double as accessor kinds
         for p in plan.inputs:
             ref = f"{p.buffer.name}_ref"
-            acc = BufferAccessor(p.buffer, ref,
-                                 "block" if p.mode == "block" else "any",
-                                 p.block_dims)
+            acc = BufferAccessor(p.buffer, ref, p.mode, p.block_dims)
             acc.set_axis_vars(self._grid_axis_vars)
             self.accessors[p.buffer.uid] = acc
             if p.alias is not None:
@@ -460,9 +459,7 @@ class PallasCodegen:
             if p.buffer.uid in self.accessors:
                 continue  # inout already registered
             ref = f"{p.buffer.name}_ref"
-            acc = BufferAccessor(p.buffer, ref,
-                                 "block" if p.mode == "block" else "any",
-                                 p.block_dims)
+            acc = BufferAccessor(p.buffer, ref, p.mode, p.block_dims)
             acc.set_axis_vars(self._grid_axis_vars)
             self.accessors[p.buffer.uid] = acc
         padded = self._decide_pad1()
@@ -763,9 +760,19 @@ class PallasCodegen:
         c_buf = s.C.buffer
         acc_dt = jnp_dtype(c_buf.dtype)
         pref = acc_dt if dtype_is_float(c_buf.dtype) else "jnp.int32"
+        # f32 operands: Mosaic's default MXU dot is a single bf16 pass
+        # (~1e-2 relative error); request HIGHEST (multi-pass) so f32
+        # tile GEMMs match the reference's true-fp32 semantics. bf16/fp8
+        # inputs keep the fast default. Overridable via pass config
+        # tl.tpu.matmul_precision.
+        prec = self.cfg.get("tl.tpu.matmul_precision")
+        if prec is None and s.A.buffer.dtype == "float32" \
+                and s.B.buffer.dtype == "float32":
+            prec = "highest"
+        prec_arg = f", precision='{prec}'" if prec else ""
         dot = (f"jax.lax.dot_general({a}, {b}, "
                f"dimension_numbers=((({ca},), ({cb},)), ((), ())), "
-               f"preferred_element_type={pref})")
+               f"preferred_element_type={pref}{prec_arg})")
         c_acc = self.accessors[c_buf.uid]
         parts = self._region_parts(s.C, eg)
         tgt = c_acc.store_target(parts)
@@ -1090,6 +1097,12 @@ class PallasCodegen:
         w.w("")
         w.w("def build(interpret=False):")
         with w.block():
+            notes = [p.tpu_note for p in plan.params
+                     if getattr(p, "tpu_note", None)]
+            if notes:
+                w.w("if not interpret:")
+                with w.block():
+                    w.w(f"raise NotImplementedError({'; '.join(notes)!r})")
             gargs = ", ".join(f"_i{i}" for i in range(len(grid)))
             guards = self._param_guards()
             in_specs = []
@@ -1185,6 +1198,10 @@ class PallasCodegen:
     def _spec_src(self, p: ParamPlan, gargs: str, guard=None) -> str:
         if p.mode == "any":
             return "pl.BlockSpec(memory_space=pl.ANY)"
+        if p.mode == "smem":
+            # whole array resident in scalar memory: Mosaic allows
+            # arbitrary dynamic scalar indexing there (mask tables etc.)
+            return "pl.BlockSpec(memory_space=pltpu.SMEM)"
         pa = self.plan.pipeline_axis
         guard_src = None
         if guard is not None:

@@ -69,20 +69,21 @@ class JITKernel:
         result = self.func(*jax_ins)
         results = result if isinstance(result, tuple) else (result,)
         import jax as _jax
-        wrote_back = False
+        delivered = set()
         for oi, ii in self._inout_results:
             if not isinstance(ins[ii], _jax.Array):
                 copy_back(ins[ii], results[oi])
-                wrote_back = True
+                delivered.add(oi)
         if outs_provided:
-            out_results = [r for r, p in zip(results, self._out_params)
+            out_indices = [oi for oi, p in enumerate(self._out_params)
                            if p.role == "out"]
-            for dst, src in zip(outs_provided, out_results):
+            for oi, dst in zip(out_indices, outs_provided):
                 if not isinstance(dst, _jax.Array):
-                    copy_back(dst, src)
-                    wrote_back = True
-        if wrote_back and (outs_provided or
-                           len(self._inout_results) == len(results)):
+                    copy_back(dst, results[oi])
+                    delivered.add(oi)
+        # reference-style in-place call: only when EVERY result reached
+        # the caller through a copy-back may the return value be dropped
+        if delivered and len(delivered) == len(results):
             return None
         return results[0] if len(results) == 1 else results
 

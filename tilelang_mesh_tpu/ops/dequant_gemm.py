@@ -15,6 +15,16 @@ import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
 
 
+def _unpack_nibble(byte_expr, hi: bool):
+    """int4 nibble -> centered float32 lanes. Mosaic legalizes neither
+    uint8->f32 casts nor uint8 shifts (arith.shrui): widen to int32
+    FIRST, then mask/shift/convert on the int32 lanes."""
+    b = T.cast(byte_expr, "int32")
+    if hi:
+        b = T.shift_right(b, 4)
+    return T.cast(T.bitwise_and(b, 0xF), "float32") - 8.0
+
+
 @functools.lru_cache(maxsize=None)
 def dequant_gemm_kernel(M, N, K, block_M=128, block_N=128, block_K2=128,
                         group_size=None, in_dtype="bfloat16",
@@ -40,24 +50,25 @@ def dequant_gemm_kernel(M, N, K, block_M=128, block_N=128, block_K2=128,
                 as (bx, by):
             A_s = T.alloc_shared((block_M, 2, block_K2), in_dtype)
             Bp_s = T.alloc_shared((block_K2, block_N), "uint8")
-            S_s = T.alloc_shared((2, 1, block_N), "float32")
+            # whole scale slab for this N-tile (2*G2*block_N f32 — a few
+            # tens of KB), hoisted out of the K loop: a (2,1,block_N)
+            # per-tile block would violate Mosaic's (8,128) min-tile rule
+            # on a real TPU (second-minor extent 1 < 8 and != G2)
+            S_s = T.alloc_shared((2, G2, block_N), "float32")
             B_lo = T.alloc_fragment((block_K2, block_N), in_dtype)
             B_hi = T.alloc_fragment((block_K2, block_N), in_dtype)
             C_l = T.alloc_fragment((block_M, block_N), accum_dtype)
             T.clear(C_l)
+            T.copy(S[0, 0, bx * block_N], S_s)
             for ko in T.Pipelined(K2 // block_K2, num_stages=num_stages):
                 T.copy(A[by * block_M, 0, ko * block_K2], A_s)
                 T.copy(Bp[ko * block_K2, bx * block_N], Bp_s)
-                # both halves' scale rows for this K-tile in one block copy
-                T.copy(S[0, ko, bx * block_N], S_s)
                 for i, j in T.Parallel(block_K2, block_N):
-                    B_lo[i, j] = T.cast(
-                        T.cast(T.bitwise_and(Bp_s[i, j], 0xF), "float32")
-                        - 8.0, "float32") * S_s[0, 0, j]
+                    B_lo[i, j] = _unpack_nibble(Bp_s[i, j], hi=False) \
+                        * S_s[0, ko, j]
                 for i, j in T.Parallel(block_K2, block_N):
-                    B_hi[i, j] = T.cast(
-                        T.cast(T.shift_right(Bp_s[i, j], 4), "float32")
-                        - 8.0, "float32") * S_s[1, 0, j]
+                    B_hi[i, j] = _unpack_nibble(Bp_s[i, j], hi=True) \
+                        * S_s[1, ko, j]
                 T.gemm(A_s[:, 0, :], B_lo, C_l)
                 T.gemm(A_s[:, 1, :], B_hi, C_l)
             T.copy(C_l, C[by * block_M, bx * block_N])
@@ -77,3 +88,76 @@ def dequant_matmul(a, packed, scales, group_size=None, block_M=128,
                             in_dtype=str(a.dtype))
     G2 = K2 // bk2
     return k(a.reshape(M, 2, K2), packed, scales.reshape(2, G2, N))
+
+
+@functools.lru_cache(maxsize=None)
+def dequant_int4_kernel(K2, N, block_K2=512, block_N=512,
+                        out_dtype="bfloat16"):
+    """Standalone int4->bf16 dequant pass: packed (K2, N) uint8 planar +
+    scales (2, G2, N) -> full-width B (2*K2, N) with the lo nibbles in rows
+    [0, K2) and hi nibbles in rows [K2, 2*K2), ready for a plain GEMM.
+
+    group_size is fixed at block_K2 so the scale row for a tile is just
+    the grid index (no in-kernel integer division)."""
+    G2 = K2 // block_K2
+
+    @T.prim_func
+    def dq(Bp: T.Tensor((K2, N), "uint8"),
+           S: T.Tensor((2, G2, N), "float32"),
+           Bd: T.Tensor((2 * K2, N), out_dtype)):
+        with T.Kernel(T.ceildiv(K2, block_K2), T.ceildiv(N, block_N)) \
+                as (bk, bn):
+            Bp_s = T.alloc_shared((block_K2, block_N), "uint8")
+            S_s = T.alloc_shared((2, G2, block_N), "float32")
+            lo = T.alloc_fragment((block_K2, block_N), out_dtype)
+            hi = T.alloc_fragment((block_K2, block_N), out_dtype)
+            T.copy(Bp[bk * block_K2, bn * block_N], Bp_s)
+            T.copy(S[0, 0, bn * block_N], S_s)
+            for i, j in T.Parallel(block_K2, block_N):
+                lo[i, j] = _unpack_nibble(Bp_s[i, j], hi=False) \
+                    * S_s[0, bk, j]
+            for i, j in T.Parallel(block_K2, block_N):
+                hi[i, j] = _unpack_nibble(Bp_s[i, j], hi=True) \
+                    * S_s[1, bk, j]
+            T.copy(lo, Bd[bk * block_K2, bn * block_N])
+            T.copy(hi, Bd[K2 + bk * block_K2, bn * block_N])
+
+    return _tl_compile(dq)
+
+
+def dequant_matmul_twopass(a, packed, scales, block_M=1024, block_N=1024,
+                           block_K=512, dq_block=512):
+    """Two-pass w4a16: materialize bf16 weights once (VPU pass over the
+    packed bytes, ~K*N/2 bytes read), then one large-tile GEMM.
+
+    The TPU-first answer for compute-bound shapes: the fused kernel
+    (dequant_gemm_kernel) re-unpacks the weight tile for every M-block,
+    so its VPU work scales with M/block_M; materializing makes the unpack
+    O(K*N) once and lets the GEMM run at full MXU tile sizes. Use the
+    fused kernel for skinny-M (decode) shapes, this one for prefill."""
+    from .gemm import matmul_kernel
+
+    M, K = a.shape
+    K2, N = packed.shape
+    assert K == 2 * K2
+    # quantization group size is encoded in the scales shape; the dequant
+    # kernel needs one scale row per K-tile, so the tile IS the group
+    gs = 2 * K2 // scales.shape[0] if scales.ndim == 2 else \
+        K2 // scales.shape[1]
+    assert K2 % gs == 0, \
+        f"scales rows {scales.shape} do not evenly group K/2={K2}"
+    dq_blk = min(dq_block, K2, gs)
+    if dq_blk != gs:
+        raise ValueError(
+            f"dequant_matmul_twopass needs group_size ({gs}) == dequant "
+            f"tile ({dq_blk}); re-quantize with group_size={dq_blk} or "
+            f"pass dq_block={gs}")
+    G2 = K2 // dq_blk
+    dq = dequant_int4_kernel(K2, N, block_K2=dq_blk,
+                             block_N=min(dq_block, N),
+                             out_dtype=str(a.dtype))
+    bd = dq(packed, scales.reshape(2, G2, N))
+    mm = matmul_kernel(M, N, K, block_M=min(block_M, M),
+                       block_N=min(block_N, N), block_K=min(block_K, K),
+                       in_dtype=str(a.dtype))
+    return mm(a, bd)

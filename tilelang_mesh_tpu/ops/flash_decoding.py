@@ -25,14 +25,22 @@ def decode_kernel(B, H, S, D, n_split, block_N, sm_scale, dtype,
     chunk = S // n_split
     scale = sm_scale * _LOG2E
 
+    # Stats layouts keep every grid-var index off the lane (minor) axis:
+    # Mosaic only allows dynamic lane offsets that are 128-aligned, while
+    # dynamic sublane offsets are unrestricted — so the head index rides
+    # the sublane axis and the lane axis is D (Op) or a unit dim (Mp/Lp).
     @T.prim_func
     def dec(Q: T.Tensor((B, H, 1, D), dtype),
             K: T.Tensor((B, H, S, D), dtype),
             V: T.Tensor((B, H, S, D), dtype),
-            Op: T.Tensor((B, H, n_split, D), "float32"),
-            Mp: T.Tensor((B, H, n_split), "float32"),
-            Lp: T.Tensor((B, H, n_split), "float32")):
-        with T.Kernel(n_split, H, B) as (bs, by, bz):
+            Op: T.Tensor((B, n_split, H, D), "float32"),
+            Mp: T.Tensor((B, n_split, H, 1), "float32"),
+            Lp: T.Tensor((B, n_split, H, 1), "float32")):
+        # by (head) is the kernel's FIRST axis and therefore the
+        # innermost grid dim: the Op/Mp/Lp output blocks are indexed by
+        # (bz, bs) only, so their widened head-axis revisits must be
+        # consecutive grid steps for Pallas's output-revisit semantics
+        with T.Kernel(H, n_split, B) as (by, bs, bz):
             Q_s = T.alloc_shared((1, D), dtype)
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
@@ -72,9 +80,9 @@ def decode_kernel(B, H, S, D, n_split, block_N, sm_scale, dtype,
                 for i in T.Parallel(1):
                     m_prev[i] = m_new[i]
 
-            T.copy(acc, Op[bz, by, bs, 0])
-            T.copy(m_prev, Mp[bz, by, bs])
-            T.copy(l, Lp[bz, by, bs])
+            T.copy(acc, Op[bz, bs, by, 0])
+            T.copy(m_prev, Mp[bz, bs, by, 0])
+            T.copy(l, Lp[bz, bs, by, 0])
 
     return _tl_compile(dec)
 
@@ -96,16 +104,19 @@ def flash_decode(q, k, v, sm_scale=None, n_split=None, block_N=128):
     kern = decode_kernel(B, H, S, D, n_split, block_N, float(sm_scale),
                          str(q.dtype))
     op, mp, lp = kern(q, k, v)
-    # combine splits (all in the exp2 domain used by the kernel)
-    m_max = jnp.max(mp, axis=-1, keepdims=True)             # (B,H,1)
-    alpha = jnp.exp2(mp - m_max)                            # (B,H,ns)
-    l_tot = jnp.sum(lp * alpha, -1)[..., None]              # (B,H,1)
-    o = jnp.sum(op * alpha[..., None], axis=2)              # (B,H,D)
+    # combine splits (all in the exp2 domain used by the kernel);
+    # op (B,ns,H,D), mp/lp (B,ns,H,1)
+    mp = mp[..., 0]                                         # (B,ns,H)
+    lp = lp[..., 0]
+    m_max = jnp.max(mp, axis=1, keepdims=True)              # (B,1,H)
+    alpha = jnp.exp2(mp - m_max)                            # (B,ns,H)
+    l_tot = jnp.sum(lp * alpha, axis=1)[..., None]          # (B,H,1)
+    o = jnp.sum(op * alpha[..., None], axis=1)              # (B,H,D)
     return (o / l_tot)[:, :, None, :].astype(q.dtype)
 
 
 def flash_decode_paged(q, kv_pages, v_pages, page_table, sm_scale=None,
-                       block_N=128):
+                       block_N=128, n_split=None):
     """Paged KV decode: pages (n_pages, page_size, H, D) + page_table
     (B, pages_per_seq) gathered to contiguous KV at the XLA level, then the
     split-KV kernel (cf. reference example_mla_decode_paged.py behavior)."""
@@ -118,4 +129,5 @@ def flash_decode_paged(q, kv_pages, v_pages, page_table, sm_scale=None,
     S = page_table.shape[1] * page_size
     k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    return flash_decode(q, k, v, sm_scale=sm_scale, block_N=block_N)
+    return flash_decode(q, k, v, sm_scale=sm_scale, block_N=block_N,
+                        n_split=n_split)

@@ -65,12 +65,18 @@ def matmul_splitk(a, b, n_split=4, block_M=128, block_N=128, block_K=128,
 
     M, K = a.shape
     N = b.shape[1]
-    while K % n_split:
+    # Mosaic lane rule: A/B's K-axis block must be a multiple of 128 (or
+    # the whole axis), so splits are only taken at 128-aligned chunk
+    # sizes; otherwise fall back to a single full-K chunk.
+    while n_split > 1 and (K % n_split or (K // n_split) % 128):
         n_split -= 1
     split_len = K // n_split
-    block_K = min(block_K, split_len)
-    while split_len % block_K:
-        block_K -= 1
+    if split_len % 128 == 0:
+        block_K = max(128, min(block_K, split_len) // 128 * 128)
+        while split_len % block_K:
+            block_K -= 128
+    else:
+        block_K = split_len  # full-axis block (always legal)
     kern = splitk_kernel(M, N, K, n_split, block_M, block_N, block_K,
                          str(a.dtype))
     cp = kern(a, b)

@@ -75,19 +75,19 @@ def varlen_grouped_gemm_kernel(rows_pad, TB, E, K, N, block_M, block_N,
             A_s = T.alloc_shared((block_M, block_K), in_dtype)
             B_s = T.alloc_shared((block_N, block_K) if trans_b else
                                  (block_K, block_N), in_dtype)
-            e_s = T.alloc_shared((1,), "int32")
-            r_s = T.alloc_shared((1,), "int32")
             acc = T.alloc_fragment((block_M, block_N), "float32")
-            T.copy(BlkExp[bx], e_s)
-            T.copy(BlkRow[bx], r_s)
             T.clear(acc)
+            # the per-block metadata is read straight out of the SMEM-
+            # resident tables (planner smem promotion): staging it through
+            # an alloc_var would make the tables region-used and force an
+            # illegal (1,)-block VMEM residency on real TPUs
             for ko in T.Pipelined(T.ceildiv(K, block_K), num_stages=2):
-                T.copy(A[r_s[0], ko * block_K], A_s)
+                T.copy(A[BlkRow[bx], ko * block_K], A_s)
                 if trans_b:
-                    T.copy(B[e_s[0], by * block_N, ko * block_K], B_s)
+                    T.copy(B[BlkExp[bx], by * block_N, ko * block_K], B_s)
                     T.gemm(A_s, B_s, acc, transpose_B=True)
                 else:
-                    T.copy(B[e_s[0], ko * block_K, by * block_N], B_s)
+                    T.copy(B[BlkExp[bx], ko * block_K, by * block_N], B_s)
                     T.gemm(A_s, B_s, acc)
             T.copy(acc, C[bx * block_M, by * block_N])
 
@@ -144,10 +144,15 @@ def varlen_grouped_matmul(a, b, sizes, block_M=128, block_N=128,
 
 def varlen_grouped_matmul_reference(a, b, sizes, trans_b=False):
     import jax.numpy as jnp
+    import jax
     out, off = [], 0
     for e, s in enumerate(sizes):
         w = b[e].T if trans_b else b[e]
-        out.append(a[off:off + s].astype(jnp.float32) @
-                   w.astype(jnp.float32))
+        # highest precision: on TPU the default f32 dot is a single bf16
+        # MXU pass, which would make this "reference" less exact than the
+        # tile kernel it validates
+        out.append(jnp.matmul(a[off:off + s].astype(jnp.float32),
+                              w.astype(jnp.float32),
+                              precision=jax.lax.Precision.HIGHEST))
         off += s
     return jnp.concatenate(out, axis=0)

@@ -51,9 +51,13 @@ class BlockDim:
 class ParamPlan:
     buffer: Buffer
     role: str = "in"          # in | out | inout
-    mode: str = "block"       # block | any
+    mode: str = "block"       # block | any | smem
     block_dims: Optional[List[BlockDim]] = None
     alias: Optional[Buffer] = None   # on-chip buffer aliased to this block
+    # set when the chosen residency only works in interpret mode (e.g.
+    # unaligned lane windows Mosaic cannot express); codegen turns it
+    # into a clear error on the real-TPU path
+    tpu_note: Optional[str] = None
 
     def block_key(self):
         return None if self.block_dims is None else tuple(
@@ -122,6 +126,8 @@ class KernelPlan:
                 desc = f"block[{', '.join(dims)}]"
                 if p.alias is not None:
                     desc += f" alias={p.alias.name}"
+            elif p.mode == "smem":
+                desc = "smem(full)"
             else:
                 desc = "any(hbm)"
             lines.append(f"  {p.role:5s} {p.buffer.name}: {desc}")
@@ -216,6 +222,135 @@ def _merge_param(plans: Dict[int, ParamPlan], buf: Buffer, role: str,
         p.alias = alias
 
 
+_SMEM_PARAM_LIMIT = 16 * 1024  # bytes of SMEM a single param may claim
+
+
+def _min_tile_illegal(p: ParamPlan) -> bool:
+    """Would this block mapping violate Mosaic's (8, 128) trailing-dims
+    rule (squeezed unit dims count as extent 1)?"""
+    shape = [as_int(s) for s in p.buffer.shape]
+    if not shape or any(s is None for s in shape):
+        return False
+    nd = len(shape)
+    for pos, min_mult in ((1, 128), (2, 8)):
+        if nd < pos:
+            continue
+        bd = p.block_dims[nd - pos]
+        blk = bd.size if bd.size is not None else 1
+        if blk != shape[nd - pos] and blk % min_mult:
+            return True
+    return False
+
+
+def _region_used_bufs(stmts: List[Stmt]) -> set:
+    """uids of global buffers accessed as regions (copies/gemms/...) —
+    as opposed to pure scalar element loads."""
+    used = set()
+
+    def chk(s):
+        for attr in ("src", "dst", "A", "B", "C"):
+            r = getattr(s, attr, None)
+            if isinstance(r, Region) and r.buffer.scope == "global":
+                used.add(r.buffer.uid)
+    from ..ir import walk
+    for s in stmts:
+        walk(s, chk)
+    return used
+
+
+def _smem_promote(p: ParamPlan, region_used: set) -> bool:
+    """Small read-only params whose every access is a scalar element load
+    (sparsity masks, stream-K partition tables, varlen row maps) live
+    whole in SMEM: Mosaic reads scalars from SMEM with arbitrary dynamic
+    indices, where a (1,1,..) VMEM block would break the min-tile rule.
+    The analog of the reference's scalar kernel arguments / jax flash's
+    scalar-prefetch segment ids."""
+    buf = p.buffer
+    if p.role != "in" or p.mode != "block" or p.block_dims is None:
+        return False
+    if buf.uid in region_used:
+        return False
+    if not _min_tile_illegal(p):
+        return False
+    shape = [as_int(s) for s in buf.shape]
+    if any(s is None for s in shape):
+        return False
+    from ..ir.expr import dtype_bits
+    nbytes = max(1, dtype_bits(buf.dtype) // 8)
+    for s in shape:
+        nbytes *= s
+    if nbytes > _SMEM_PARAM_LIMIT:
+        return False
+    p.mode = "smem"
+    p.block_dims = None
+    p.alias = None
+    return True
+
+
+def _widen_min_tile(p: ParamPlan) -> None:
+    """Mosaic requires a block's last-two dims (squeezed unit dims count
+    as extent 1) to be divisible by (8, 128) respectively or equal to the
+    full array extent. Widen a violating trailing dim to the whole axis:
+    its index-map component becomes 0 and every in-kernel access keeps
+    its original (possibly grid-var) index, which the accessor emits as a
+    dynamic start. For outputs this relies on the widened axis being
+    swept by grid vars, whose kinds are demoted to "arbitrary" by
+    _demote_revisited_axes so Mosaic keeps the block resident across the
+    revisit sequence. (The reference solves the analogous problem by
+    backtracking over layouts in layout_inference.cc:928-939; on TPU the
+    legal-layout set is the Mosaic tiling rule, so widening is exact.)"""
+    shape = [as_int(s) for s in p.buffer.shape]
+    if not shape or any(s is None for s in shape):
+        return
+    nd = len(shape)
+    changed = False
+    for pos, min_mult in ((1, 128), (2, 8)):  # (minor, second-minor)
+        if nd < pos:
+            continue
+        i = nd - pos
+        bd = p.block_dims[i]
+        blk = bd.size if bd.size is not None else 1
+        if blk == shape[i] or blk % min_mult == 0:
+            continue
+        if pos == 1 and (bd.terms or (bd.const * blk) % 128):
+            # Widening the lane (minor) dim would keep the original index
+            # as a dynamic/unaligned start, and Mosaic only accepts lane
+            # offsets it can prove are multiples of 128 (DMA windows
+            # included). Keep the block mapping — interpret mode executes
+            # it — and give the real-TPU path a clear error instead of a
+            # Mosaic crash. (Small scalar-read params get SMEM residency
+            # before this check and never reach here.)
+            p.tpu_note = (
+                f"param '{p.buffer.name}': a {blk}-wide block on the "
+                f"minor (lane) axis of shape {tuple(shape)} is not "
+                f"Mosaic-legal (lane offsets must be 128-aligned); use a "
+                f"minor block size that is a multiple of 128 or covers "
+                f"the whole axis")
+            return
+        p.block_dims[i] = BlockDim(shape[i], (), 0, 1)
+        changed = True
+    if changed:
+        # a widened block no longer matches the on-chip copy partner:
+        # keep the explicit copy instead of BlockSpec aliasing
+        p.alias = None
+
+
+def _demote_revisited_axes(grid: List[GridAxis],
+                           params: List[ParamPlan]) -> None:
+    """Any grid axis absent from some block-mode output's index map
+    revisits that output's block across its steps; Mosaic only keeps the
+    block resident (and flushes once) for non-parallel dims, so demote
+    those axes to "arbitrary"."""
+    for p in params:
+        if p.role not in ("out", "inout") or p.mode != "block" \
+                or p.block_dims is None:
+            continue
+        used = {a for d in p.block_dims for a, _ in d.terms}
+        for i, ax in enumerate(grid):
+            if i not in used and ax.kind == "parallel":
+                ax.kind = "arbitrary"
+
+
 def _writers(stmts_root: Stmt) -> Dict[int, int]:
     """buffer uid -> number of statements that write it."""
     counts: Dict[int, int] = {}
@@ -304,6 +439,8 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     def consider_copy(stmt: CopyStmt, in_mapped_loop: bool,
                       serial_vars: list):
         src, dst = stmt.src, stmt.dst
+        _visit_region_base(src, serial_vars, [])
+        _visit_region_base(dst, serial_vars, [])
         sg = src.buffer.scope == "global"
         dg = dst.buffer.scope == "global"
         if sg and not dg:
@@ -327,7 +464,16 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
             _merge_param(plans, src.buffer, "in", None, None)
             _merge_param(plans, dst.buffer, "out", None, None)
 
-    def consider_region_read(region: Region, serial_vars: list):
+    def _visit_region_base(region: Region, serial_vars, par_vars):
+        # global loads used as indices (e.g. SMEM-promoted lookup tables
+        # in a gather-style copy base) are elementwise reads too
+        for b in region.base:
+            if not isinstance(b, slice):
+                visit_expr_globals(b, serial_vars, par_vars)
+
+    def consider_region_read(region: Region, serial_vars: list,
+                             par_vars: list = ()):
+        _visit_region_base(region, serial_vars, list(par_vars))
         if region.buffer.scope == "global":
             if serial_vars:
                 _merge_param(plans, region.buffer, "in", None, None)
@@ -335,7 +481,9 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                 dims = _region_block_dims(region, grid, None)
                 _merge_param(plans, region.buffer, "in", dims, None)
 
-    def consider_region_write(region: Region, serial_vars: list):
+    def consider_region_write(region: Region, serial_vars: list,
+                              par_vars: list = ()):
+        _visit_region_base(region, serial_vars, list(par_vars))
         if region.buffer.scope == "global":
             if serial_vars:
                 _merge_param(plans, region.buffer, "out", None, None)
@@ -463,6 +611,7 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     visit(epi_stmts, [], [])
 
     # ---- finalize ---------------------------------------------------------
+    region_used_bufs = _region_used_bufs(init_stmts + main_stmts + epi_stmts)
     params: List[ParamPlan] = []
     for b in global_params:
         p = plans[b.uid]
@@ -473,7 +622,11 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
             p.block_dims = None
         if p.mode == "block" and p.block_dims is None:
             p.mode = "any"
+        if p.mode == "block":
+            if not _smem_promote(p, region_used_bufs):
+                _widen_min_tile(p)
         params.append(p)
+    _demote_revisited_axes(grid, params)
 
     aliased_bufs = {p.alias.uid for p in params if p.alias is not None}
     scratch = [b for b in allocs if b.uid not in aliased_bufs]
