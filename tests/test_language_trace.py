@@ -161,3 +161,77 @@ def test_gpu_only_constructs_raise():
                 T.alloc_tmem((8, 128), "float32")
             with pytest.raises(NotImplementedError):
                 T.thread_binding()
+
+
+def test_non_consecutive_output_revisit_flagged():
+    """An output whose block is revisited across a non-innermost grid
+    axis (the pre-round-3 flash-decoding shape) must carry a tpu_note so
+    the real-TPU build fails loudly instead of corrupting the output."""
+    NS, H, B, D = 2, 4, 2, 128
+
+    @T.prim_func
+    def bad(X: T.Tensor((B, NS, H, D), "float32"),
+            O: T.Tensor((B, NS, H, D), "float32")):
+        # T.Kernel(NS, H, B) -> grid (bz, by, bs): bs innermost, but O's
+        # index omits by (middle axis) once the head dim is widened
+        with T.Kernel(NS, H, B) as (bs, by, bz):
+            f = T.alloc_fragment((1, D), "float32")
+            T.copy(X[bz, bs, by, 0], f)
+            for i, j in T.Parallel(1, D):
+                f[i, j] = f[i, j] + 1.0
+            T.copy(f, O[bz, bs, by, 0])
+
+    art = tilelang.lower(bad, target="cpu")
+    ns = {}
+    exec(compile(art.kernel_source, "<test>", "exec"), ns)
+    with pytest.raises(NotImplementedError, match="consecutive"):
+        ns["build"](interpret=False)
+    # interpret mode still executes (and is correct there)
+    import numpy as np
+    k = tilelang.compile(bad)
+    x = np.random.default_rng(0).standard_normal(
+        (B, NS, H, D)).astype(np.float32)
+    out = np.empty_like(x)
+    k(x, out)
+    np.testing.assert_allclose(out, x + 1.0, rtol=1e-6)
+
+
+def test_innermost_output_revisit_not_flagged():
+    """The corrected axis order (revisited axis innermost) must build
+    without a tpu_note."""
+    NS, H, B, D = 2, 4, 2, 128
+
+    @T.prim_func
+    def good(X: T.Tensor((B, NS, H, D), "float32"),
+             O: T.Tensor((B, NS, H, D), "float32")):
+        with T.Kernel(H, NS, B) as (by, bs, bz):
+            f = T.alloc_fragment((1, D), "float32")
+            T.copy(X[bz, bs, by, 0], f)
+            for i, j in T.Parallel(1, D):
+                f[i, j] = f[i, j] + 1.0
+            T.copy(f, O[bz, bs, by, 0])
+
+    art = tilelang.lower(good, target="cpu")
+    assert "NotImplementedError" not in art.kernel_source
+
+
+def test_trailing_unit_axis_revisit_not_flagged():
+    """An extent-1 grid axis in an innermost position contributes one
+    step and cannot interleave revisits: the consecutiveness check must
+    compare against the suffix of stepping (extent>1) axes only."""
+    NS, H, B, D = 2, 4, 2, 128
+
+    @T.prim_func
+    def ok(X: T.Tensor((B, NS, H, D), "float32"),
+           O: T.Tensor((B, NS, H, D), "float32")):
+        # unit axis bx is innermost; by (revisited) is next — still
+        # consecutive because bx never steps
+        with T.Kernel(1, H, NS, B) as (bx, by, bs, bz):
+            f = T.alloc_fragment((1, D), "float32")
+            T.copy(X[bz, bs, by, 0], f)
+            for i, j in T.Parallel(1, D):
+                f[i, j] = f[i, j] + 1.0
+            T.copy(f, O[bz, bs, by, 0])
+
+    art = tilelang.lower(ok, target="cpu")
+    assert "NotImplementedError" not in art.kernel_source

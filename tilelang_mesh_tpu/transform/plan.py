@@ -340,15 +340,36 @@ def _demote_revisited_axes(grid: List[GridAxis],
     """Any grid axis absent from some block-mode output's index map
     revisits that output's block across its steps; Mosaic only keeps the
     block resident (and flushes once) for non-parallel dims, so demote
-    those axes to "arbitrary"."""
+    those axes to "arbitrary".
+
+    Pallas additionally requires output revisits to be CONSECUTIVE grid
+    steps: the omitted axes must form the innermost suffix of the grid,
+    or the block is flushed and refetched from an unwritten buffer
+    between revisits — silently wrong results on real TPUs (interpret
+    mode masks it). Kernels that violate this get a tpu_note so the
+    real-TPU path fails loudly with reordering guidance."""
     for p in params:
         if p.role not in ("out", "inout") or p.mode != "block" \
                 or p.block_dims is None:
             continue
         used = {a for d in p.block_dims for a, _ in d.terms}
-        for i, ax in enumerate(grid):
-            if i not in used and ax.kind == "parallel":
-                ax.kind = "arbitrary"
+        omitted = [i for i, ax in enumerate(grid)
+                   if i not in used and ax.extent > 1]
+        for i in omitted:
+            if grid[i].kind == "parallel":
+                grid[i].kind = "arbitrary"
+        # consecutive == the omitted axes are the innermost suffix of the
+        # axes that actually step (extent-1 axes contribute one step and
+        # can sit anywhere)
+        stepping = [i for i, ax in enumerate(grid) if ax.extent > 1]
+        if omitted and omitted != stepping[len(stepping) - len(omitted):]:
+            names = ", ".join(grid[i].var.name for i in omitted)
+            p.tpu_note = (
+                f"output '{p.buffer.name}': its block is revisited "
+                f"across non-innermost grid axes ({names}); Pallas "
+                f"requires output revisits to be consecutive grid steps "
+                f"— reorder T.Kernel axes so the axes absent from this "
+                f"output's index come first (innermost)")
 
 
 def _writers(stmts_root: Stmt) -> Dict[int, int]:
@@ -629,6 +650,11 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     _demote_revisited_axes(grid, params)
 
     aliased_bufs = {p.alias.uid for p in params if p.alias is not None}
+    # keep aliased_copies consistent with the params' final alias state:
+    # widening/SMEM promotion may have cleared an alias after its copy
+    # was recorded, and that copy must now really execute
+    aliased_copies = [c for c in aliased_copies
+                      if c.dst.buffer.uid in aliased_bufs]
     scratch = [b for b in allocs if b.uid not in aliased_bufs]
 
     vmem_arena, vmem_offsets = _pack_scratch(
