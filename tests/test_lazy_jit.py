@@ -139,3 +139,79 @@ def test_lazy_jit_out_idx_out_of_range():
 
     with pytest.raises(IndexError, match="out_idx"):
         k(np.zeros((64, 128), np.float32))
+
+
+def test_dynamic_bucket_one_compile_serves_many_lengths():
+    """dynamic_bucket: the dyn dim is rounded up to the bucket, inputs
+    zero-padded and outputs sliced — one compiled kernel serves every
+    length in the bucket (reference symbolics.py compile-once behavior,
+    realized under XLA's static-shape rule)."""
+    M = T.dynamic("m")
+    N, BK = 128, 128
+
+    @tilelang.lazy_jit(out_idx=[2], dynamic_bucket=128)
+    def matvecish(A: T.Tensor((M, N), "float32"),
+                  B: T.Tensor((N, N), "float32"),
+                  C: T.Tensor((M, N), "float32")):
+        with T.Kernel(T.ceildiv(M, BK)) as bx:
+            A_s = T.alloc_shared((BK, N), "float32")
+            B_s = T.alloc_shared((N, N), "float32")
+            acc = T.alloc_fragment((BK, N), "float32")
+            T.copy(A[bx * BK, 0], A_s)
+            T.copy(B, B_s)
+            T.clear(acc)
+            T.gemm(A_s, B_s, acc)
+            T.copy(acc, C[bx * BK, 0])
+
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal((128, 128), dtype=np.float32)
+    for m in (100, 60, 128):          # all inside one 128 bucket
+        a = rng.standard_normal((m, 128), dtype=np.float32)
+        out = np.asarray(matvecish(a, b))
+        assert out.shape == (m, 128)
+        np.testing.assert_allclose(out, a @ b, rtol=2e-2, atol=2e-1)
+    assert len(matvecish._kernels) == 1, "one compile must serve the bucket"
+    # a length in the next bucket specializes exactly once more
+    a = rng.standard_normal((200, 128), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(matvecish(a, b)), a @ b,
+                               rtol=2e-2, atol=2e-1)
+    assert len(matvecish._kernels) == 2
+
+
+def test_dynamic_bucket_with_runtime_length_mask():
+    """Exact semantics under padding: the kernel takes the TRUE length as
+    a runtime scalar operand and masks — the pattern normalizing kernels
+    (softmax/mean) must use, since zero padding is only an identity for
+    sum-like ops."""
+    M = T.dynamic("m")
+    CAP_BLK = 128
+
+    @tilelang.lazy_jit(out_idx=[2], dynamic_bucket=CAP_BLK)
+    def row_mean(X: T.Tensor((M, 128), "float32"),
+                 L: T.Tensor((1,), "int32"),
+                 O: T.Tensor((1, 128), "float32")):
+        with T.Kernel(1) as bx:
+            acc = T.alloc_fragment((128,), "float32")
+            tmp = T.alloc_fragment((CAP_BLK, 128), "float32")
+            s = T.alloc_shared((CAP_BLK, 128), "float32")
+            T.fill(acc, 0)
+            # block count folds against the BUCKETED capacity M at trace
+            # time; rows past the true length L are masked out
+            for ko in T.serial(T.ceildiv(M, CAP_BLK)):
+                T.copy(X[ko * CAP_BLK, 0], s)
+                for i, j in T.Parallel(CAP_BLK, 128):
+                    tmp[i, j] = T.if_then_else(
+                        ko * CAP_BLK + i < L[0], s[i, j], 0.0)
+                T.reduce_sum(tmp, acc, dim=0, clear=False)
+            for j in T.Parallel(128):
+                acc[j] = acc[j] / T.cast(L[0], "float32")
+            T.copy(acc, O[0, 0])
+
+    rng = np.random.default_rng(1)
+    for m in (100, 60):
+        x = rng.standard_normal((m, 128), dtype=np.float32)
+        ln = np.asarray([m], np.int32)
+        out = np.asarray(row_mean(x, ln))
+        np.testing.assert_allclose(out[0], x.mean(axis=0), rtol=1e-4,
+                                   atol=1e-4)
+    assert len(row_mean._kernels) == 1

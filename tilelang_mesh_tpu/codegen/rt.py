@@ -102,6 +102,11 @@ def reduce(kind, x, axis, keepdims, old=None):
     """Tile reduction; combines with `old` when clear=False."""
     r = _REDUCE_FNS[kind](x, axis, keepdims)
     if old is not None:
+        if old.shape != r.shape and old.size == r.size:
+            # `old` may carry the accumulator's storage layout (e.g. the
+            # pad1 (N,1) column form) while r is the logical (1,N)/(N,)
+            # shape — same elements, different orientation
+            old = old.reshape(r.shape)
         r = _COMBINE_FNS[kind](old, r.astype(old.dtype))
     return r
 

@@ -163,10 +163,32 @@ _LAZY_BIND_LOCK = threading.Lock()
 
 
 class LazyJITImpl:
-    def __init__(self, fn: Callable, **jit_kwargs):
+    def __init__(self, fn: Callable, dynamic_bucket: Optional[int] = None,
+                 **jit_kwargs):
         functools.update_wrapper(self, fn)
         self.fn = fn
         self.jit_kwargs = jit_kwargs
+        # Bucketed symbolic dims (the TPU answer to the reference's
+        # T.dynamic compile-once kernels, tilelang/language/symbolics.py):
+        # XLA requires static shapes, so a dyn dim is rounded UP to the
+        # next multiple of `dynamic_bucket`, inputs are zero-padded and
+        # dyn output dims sliced back — ONE compiled kernel then serves
+        # every length in the bucket instead of one kernel per length.
+        # Zero padding is an identity for GEMM/elementwise/reduce-sum
+        # kernels; kernels with normalizing semantics (softmax, mean)
+        # must take the true length as an explicit scalar operand and
+        # mask, like the varlen/blocksparse kernels do.
+        if dynamic_bucket is not None:
+            if not isinstance(dynamic_bucket, int) or dynamic_bucket <= 0:
+                raise ValueError(
+                    f"lazy_jit: dynamic_bucket must be a positive int, "
+                    f"got {dynamic_bucket!r}")
+            if jit_kwargs.get("out_idx") is None:
+                raise ValueError(
+                    "lazy_jit(dynamic_bucket=...) requires out_idx: the "
+                    "wrapper must own the output buffers to slice their "
+                    "padded dyn dims back")
+        self.dynamic_bucket = dynamic_bucket
         self._kernels = {}
 
     def __call__(self, *tensors):
@@ -196,6 +218,11 @@ class LazyJITImpl:
         for i, t in zip(in_pos, tensors):
             if isinstance(annots[i], TensorAnnot):
                 _solve_dims(annots[i].shape, t.shape, binding, names[i])
+        true_vals = {k: v for k, (_, v) in binding.items()}
+        if self.dynamic_bucket:
+            b = self.dynamic_bucket
+            binding = {k: (var, -(-val // b) * b)
+                       for k, (var, val) in binding.items()}
         env_map = {k: v for k, (_, v) in binding.items()}
         # Key by the Var's unique uid, not its name: two distinct dyn vars
         # sharing a name would otherwise collide after sorting and silently
@@ -237,14 +264,73 @@ class LazyJITImpl:
                     for var, _ in binding.values():
                         var._bound = None
             self._kernels[shape_key] = kernel
-        return kernel(*tensors)
+        if not self.dynamic_bucket:
+            return kernel(*tensors)
+        return self._call_padded(kernel, tensors, in_pos, names, annots,
+                                 binding, true_vals)
+
+    def _call_padded(self, kernel, tensors, in_pos, names, annots,
+                     binding, true_vals):
+        """Bucketed call: zero-pad every input's dyn dims to the bucketed
+        capacity, run the (bucket-shaped) kernel, slice dyn output dims
+        back to their true extents."""
+        import jax.numpy as jnp
+
+        from ..ir import Var
+        from ..language.annot import TensorAnnot
+
+        padded = []
+        for i, t in zip(in_pos, tensors):
+            annot = annots[i]
+            if isinstance(annot, TensorAnnot):
+                t = jnp.asarray(t)
+                pads = []
+                needs = False
+                for dim, actual in zip(annot.shape, t.shape):
+                    if isinstance(dim, Var) and id(dim) in binding:
+                        cap = binding[id(dim)][1]
+                        pads.append((0, cap - int(actual)))
+                        needs = needs or cap != int(actual)
+                    else:
+                        pads.append((0, 0))
+                if needs:
+                    t = jnp.pad(t, pads)
+            padded.append(t)
+        out_params = kernel.out_params
+        if any(p.role == "inout" for p in out_params):
+            bad = [p.name for p in out_params if p.role == "inout"]
+            raise NotImplementedError(
+                f"lazy_jit(dynamic_bucket=...) does not support in-place "
+                f"(inout) params ({', '.join(bad)}): the padded-shape "
+                f"result cannot be copied back into the caller's unpadded "
+                f"buffer; write to a separate output tensor instead")
+        result = kernel(*padded)
+        results = result if isinstance(result, tuple) else (result,)
+        # results follow the kernel's out_params order; map each back to
+        # its signature annotation by name to find its dyn dims
+        pos_of = {n: i for i, n in enumerate(names)}
+        sliced = []
+        for r, p in zip(results, out_params):
+            annot = annots[pos_of[p.name]]
+            if isinstance(annot, TensorAnnot):
+                idx = []
+                for dim, actual in zip(annot.shape, r.shape):
+                    if isinstance(dim, Var) and id(dim) in true_vals:
+                        idx.append(slice(0, true_vals[id(dim)]))
+                    else:
+                        idx.append(slice(None))
+                r = r[tuple(idx)]
+            sliced.append(r)
+        return sliced[0] if len(sliced) == 1 else tuple(sliced)
 
 
 def lazy_jit(fn: Optional[Callable] = None, *, out_idx=None,
              target: str = "auto", verbose: bool = False,
-             pass_configs: Optional[dict] = None, **_ignored):
+             pass_configs: Optional[dict] = None,
+             dynamic_bucket: Optional[int] = None, **_ignored):
     def wrap(f):
-        return LazyJITImpl(f, out_idx=out_idx, target=target,
+        return LazyJITImpl(f, dynamic_bucket=dynamic_bucket,
+                           out_idx=out_idx, target=target,
                            verbose=verbose, pass_configs=pass_configs)
     if fn is not None:
         return wrap(fn)

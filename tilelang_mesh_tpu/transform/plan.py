@@ -266,12 +266,17 @@ def _smem_promote(p: ParamPlan, region_used: set) -> bool:
     The analog of the reference's scalar kernel arguments / jax flash's
     scalar-prefetch segment ids."""
     buf = p.buffer
-    if p.role != "in" or p.mode != "block" or p.block_dims is None:
+    if p.role != "in":
         return False
     if buf.uid in region_used:
         return False
-    if not _min_tile_illegal(p):
+    if p.mode == "block":
+        if p.block_dims is None or not _min_tile_illegal(p):
+            return False  # a legal block mapping beats SMEM residency
+    elif p.mode != "any":
         return False
+    # mode "any" + no region use means every access is a scalar element
+    # load (e.g. under a serial loop) — HBM cannot serve those at all
     shape = [as_int(s) for s in buf.shape]
     if any(s is None for s in shape):
         return False
@@ -641,10 +646,13 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
             p.role = "in"
             p.mode = "any"
             p.block_dims = None
+            params.append(p)
+            continue
         if p.mode == "block" and p.block_dims is None:
             p.mode = "any"
-        if p.mode == "block":
-            if not _smem_promote(p, region_used_bufs):
+        if p.mode in ("block", "any"):
+            if not _smem_promote(p, region_used_bufs) \
+                    and p.mode == "block":
                 _widen_min_tile(p)
         params.append(p)
     _demote_revisited_axes(grid, params)
