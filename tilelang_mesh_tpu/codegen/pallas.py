@@ -537,6 +537,28 @@ class PallasCodegen:
             args.append("_dma_sem")
         return args
 
+    def _emit_atomic_seeds(self):
+        """Atomic destinations accumulate into the tensor's ORIGINAL
+        contents: seed each block's out window from the aliased input
+        ref at its first visit (Pallas output windows are otherwise
+        undefined until written — reading one is garbage on real TPUs
+        even though interpret mode hands back zeros). The atomic flag
+        and revisit axes come from the plan so the seed predicate can
+        never drift from the residency/demotion decisions."""
+        w = self.w
+        for p in self.plan.params:
+            if not p.atomic or p.mode != "block" or p.role != "inout":
+                continue
+            name = p.buffer.name
+            if p.revisit_axes:
+                pred = " & ".join(f"(_g{i} == 0)" for i in p.revisit_axes)
+                w.w(f"@pl.when({pred})")
+                w.w(f"def _seed_{name}():")
+                with w.block():
+                    w.w(f"{name}_ref[...] = {name}_in_ref[...]")
+            else:
+                w.w(f"{name}_ref[...] = {name}_in_ref[...]")
+
     def _emit_kernel_fn(self):
         w = self.w
         plan = self.plan
@@ -544,6 +566,7 @@ class PallasCodegen:
         with w.block():
             for i, a in enumerate(plan.grid):
                 w.w(f"_g{i} = pl.program_id({i})  # {a.var.name}")
+            self._emit_atomic_seeds()
             pa = plan.pipeline_axis
             if pa is not None and plan.init_stmts:
                 w.w(f"@pl.when(_g{pa} == 0)")
@@ -981,9 +1004,42 @@ class PallasCodegen:
         w = self.w
         acc = self.accessors[s.dst.buffer.uid]
         if acc.kind == "any":
-            raise CodegenError("atomic ops on HBM-resident buffers are not "
-                               "supported on TPU; accumulate in VMEM")
-        eg = self._eg(par_ctx)
+            raise CodegenError(
+                "atomic ops on HBM-resident buffers are not supported on "
+                "TPU; make the destination access block-affine (so it can "
+                "be mapped as an inout block) or accumulate in VMEM")
+        if par_ctx:
+            # Element atomic inside T.Parallel: the loop body vectorizes
+            # onto VPU lanes, so a read-modify-write with COLLIDING
+            # destinations (two lanes hitting one element) would drop
+            # updates. Lower it as a synthesized store whose value reads
+            # the target — the Parallel store legality rule (every loop
+            # var used exactly once) then rejects exactly the colliding
+            # cases. Cf. reference src/op/atomic_add.cc, which likewise
+            # only vectorizes provably disjoint atomics.
+            shape = s.dst.static_shape()
+            if shape is None or any(x != 1 for x in shape) or \
+                    isinstance(s.value, Region):
+                raise CodegenError(
+                    "tile-region atomics inside T.Parallel are not "
+                    "supported; apply the atomic elementwise (e.g. "
+                    "T.atomic_add(C[i, j], s[i, j])) or hoist it out of "
+                    "the loop")
+            from ..ir import BinOp, BufferLoad
+            load = BufferLoad(s.dst.buffer, tuple(s.dst.base))
+            op = "+" if s.op == "add" else s.op  # BinOp knows min/max
+            expr = BinOp(op, load, s.value)
+            synth = BufferStoreStmt(s.dst.buffer, tuple(s.dst.base), expr)
+            try:
+                return self._emit_store(synth, par_ctx)
+            except CodegenError as e:
+                raise CodegenError(
+                    f"T.atomic_{s.op} inside T.Parallel must address a "
+                    f"distinct destination element per loop iteration "
+                    f"(colliding lanes would lose updates on the VPU); "
+                    f"use T.reduce_* or an alloc_reducer for reductions "
+                    f"[{e}]") from None
+        eg = self._eg(None)
         parts = acc.store_parts(self._region_parts(s.dst, eg))
         tgt = f"{acc.ref}[{', '.join(parts)}]"
         if isinstance(s.value, Region):
@@ -994,8 +1050,6 @@ class PallasCodegen:
                 shp = tuple(s.dst.static_shape() or ()) + \
                     ((1,) if acc.pad1 else ())
                 val = f"jnp.reshape({val}, {shp})"
-        elif par_ctx:
-            val = eg.vector(s.value)
         else:
             val = eg.scalar(s.value)
         op = {"add": f"{tgt} + {val}",

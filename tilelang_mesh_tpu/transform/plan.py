@@ -58,6 +58,12 @@ class ParamPlan:
     # unaligned lane windows Mosaic cannot express); codegen turns it
     # into a clear error on the real-TPU path
     tpu_note: Optional[str] = None
+    # atomic destination: codegen seeds the out window from the aliased
+    # input at each block's first visit (accumulate-into-existing)
+    atomic: bool = False
+    # grid axes (indices) across which this output's block is revisited —
+    # filled by _demote_revisited_axes; codegen's seed predicate uses it
+    revisit_axes: List[int] = field(default_factory=list)
 
     def block_key(self):
         return None if self.block_dims is None else tuple(
@@ -360,6 +366,7 @@ def _demote_revisited_axes(grid: List[GridAxis],
         used = {a for d in p.block_dims for a, _ in d.terms}
         omitted = [i for i, ax in enumerate(grid)
                    if i not in used and ax.extent > 1]
+        p.revisit_axes = omitted
         for i in omitted:
             if grid[i].kind == "parallel":
                 grid[i].kind = "arbitrary"
@@ -606,9 +613,31 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                 consider_region_write(s.dst, serial_vars)
             elif isinstance(s, AtomicStmt):
                 if s.dst.buffer.scope == "global":
-                    _merge_param(plans, s.dst.buffer, "inout", None, None)
+                    # a global atomic destination is an accumulate into
+                    # the tensor's EXISTING contents (reference
+                    # src/op/atomic_add.cc semantics): map it as an inout
+                    # block so the original data is fetched via aliasing
+                    # and the out window seeded at each block's first
+                    # visit (codegen _emit_atomic_seeds)
+                    _visit_region_base(s.dst, serial_vars, list(par_vars))
+                    if serial_vars:
+                        _merge_param(plans, s.dst.buffer, "inout", None,
+                                     None)
+                    elif par_vars:
+                        _elementwise_access(
+                            BufferLoad(s.dst.buffer, tuple(s.dst.base)),
+                            "inout", serial_vars, par_vars)
+                    else:
+                        vr = s.value.buffer.ndim \
+                            if isinstance(s.value, Region) else None
+                        dims = _region_block_dims(s.dst, grid, vr)
+                        _merge_param(plans, s.dst.buffer, "inout", dims,
+                                     None)
+                    plans[s.dst.buffer.uid].atomic = True
                 if isinstance(s.value, Region):
                     consider_region_read(s.value, serial_vars)
+                else:
+                    visit_expr_globals(s.value, serial_vars, par_vars)
             elif isinstance(s, BufferStoreStmt):
                 if s.buffer.scope == "global":
                     _elementwise_access(s, "out", serial_vars, par_vars)
