@@ -14,38 +14,20 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
                   BufferLoad,
-                  BufferStoreStmt, Cast, CommStmt, CopyStmt, CumSumStmt,
+                  BufferStoreStmt, CommStmt, CopyStmt, CumSumStmt,
                   EvaluateStmt, FillStmt, ForNest, GemmStmt, IfThenElse,
-                  PrintStmt, PrimFunc, ReduceStmt, Region, SeqStmt, Stmt,
-                  Var, as_int, dtype_is_float)
-from ..transform.plan import BlockDim, KernelPlan, ParamPlan, PlanError
+                  PrintStmt, ReduceStmt, Region, SeqStmt, Stmt,
+                  as_int, dtype_is_float, for_each_load)
+from ..transform.mem2reg import plan_locals
+from ..transform.pad1 import decide_pad1
+from ..transform.plan import BlockDim, KernelPlan, ParamPlan
+from ..transform.prefetch_guard import param_guards
 from .exprgen import ExprGen, ExprGenError, jnp_dtype
 
 
 class CodegenError(Exception):
     pass
 
-
-def _for_each_load(e, fn):
-    """Call fn(load) for every BufferLoad inside expression e, recursing
-    into call args, binop operands, casts, and index expressions. The one
-    expression walker shared by _plan_locals, _param_guards, and
-    _emit_parallel, so their coverage cannot drift."""
-    if isinstance(e, BufferLoad):
-        fn(e)
-        for i in e.indices:
-            if not isinstance(i, slice):
-                _for_each_load(i, fn)
-        return
-    for a in getattr(e, "args", []) or []:
-        if not isinstance(a, str):
-            _for_each_load(a, fn)
-    for at in ("a", "b"):
-        sub = getattr(e, at, None)
-        if sub is not None:
-            _for_each_load(sub, fn)
-    if isinstance(e, Cast):
-        _for_each_load(e.value, fn)
 
 
 class Writer:
@@ -222,223 +204,8 @@ class PallasCodegen:
 
     # ------------------------------------------------------------------
     def _plan_locals(self) -> set:
-        """Fragment SSA promotion (mem2reg) — this codegen's analog of the
-        reference's StorageRewrite (src/transform/storage_rewrite.cc).
-
-        A scratch fragment qualifies when its whole life is: fully
-        overwritten first, then read/accumulated, all within ONE phase and
-        one control scope chain. Such a buffer never needs VMEM backing —
-        it becomes a Python local in the generated source, so Mosaic sees
-        an SSA value chain instead of memref round-trips between every
-        statement (the difference is ~1.5x on attention-class kernels).
-
-        Loop-carried state (read-before-def in the pipelined main phase,
-        or live across init/main/epi) stays in scratch, as do buffers with
-        partial stores, DMA/atomic/semaphore uses, or conditional defs
-        that escape their scope."""
-
-        cand = {b.uid for b in self.plan.scratch
-                if b.scope not in ("local.var", "smem", "sem")}
-        if not cand:
-            return set()
-        # DMA partners (HBM-resident params) need .at refs
-        any_bufs = {p.buffer.uid for p in self.plan.params
-                    if p.mode == "any"}
-        recs: Dict[int, list] = {}   # uid -> [(kind, phase, scope, seq)]
-        disq = set()
-        seq = [0]
-        # traced ints: lax.fori loop vars plus grid vars (pl.program_id) —
-        # plain slicing of a Python value can't take a traced start index
-        # (pl.ds is ref-only)
-        traced_ids: set = {id(a.var) for a in self.plan.grid}
-
-        def idx_traced(indices) -> bool:
-            from ..ir import free_vars
-            for i in indices:
-                if isinstance(i, slice):
-                    continue
-                if any(id(v) in traced_ids for v in free_vars(i)):
-                    return True
-                # Loads from refs (e.g. an SMEM scalar sm[0]) are always
-                # traced values even though they carry no free Vars —
-                # a Python slice of a promoted local can't take them.
-                loads = [0]
-                _for_each_load(i, lambda ld: loads.__setitem__(0, 1))
-                if loads[0]:
-                    return True
-            return False
-
-        def rec(uid, kind, phase, scope):
-            if uid in cand:
-                recs.setdefault(uid, []).append((kind, phase, tuple(scope),
-                                                 seq[0]))
-            seq[0] += 1
-
-        def expr_uses(e, phase, scope):
-            def on_load(ld):
-                rec(ld.buffer.uid, "use", phase, scope)
-                if idx_traced(ld.indices):
-                    disq.add(ld.buffer.uid)
-            _for_each_load(e, on_load)
-
-        def region_rec(r: Region, kind, phase, scope):
-            full = r.is_full() if hasattr(r, "is_full") else False
-            if idx_traced(r.base):
-                disq.add(r.buffer.uid)
-            if kind in ("def", "rmw") and not full:
-                disq.add(r.buffer.uid)
-                rec(r.buffer.uid, "use", phase, scope)
-            else:
-                rec(r.buffer.uid, kind, phase, scope)
-            for b in r.base:
-                if not isinstance(b, slice):
-                    expr_uses(b, phase, scope)
-
-        scope_n = [0]
-
-        def child(scope):
-            scope_n[0] += 1
-            return scope + [scope_n[0]]
-
-        def scan(s, phase, scope, par_nest):
-            if isinstance(s, AllocStmt) or isinstance(s, EvaluateStmt):
-                return
-            if isinstance(s, SeqStmt):
-                for c in s.stmts:
-                    scan(c, phase, scope, par_nest)
-            elif isinstance(s, CopyStmt):
-                if s.src.buffer.uid in any_bufs or \
-                        s.dst.buffer.uid in any_bufs:
-                    # lowers to rt.dma, which needs .at[] on a real ref
-                    disq.add(s.src.buffer.uid)
-                    disq.add(s.dst.buffer.uid)
-                region_rec(s.src, "use", phase, scope)
-                region_rec(s.dst, "def", phase, scope)
-            elif isinstance(s, AsyncCopyStmt):
-                disq.add(s.src.buffer.uid)
-                disq.add(s.dst.buffer.uid)
-                disq.add(s.sem.uid)
-            elif isinstance(s, GemmStmt):
-                region_rec(s.A, "use", phase, scope)
-                region_rec(s.B, "use", phase, scope)
-                region_rec(s.C, "def" if s.clear_accum else "rmw",
-                           phase, scope)
-            elif isinstance(s, FillStmt):
-                region_rec(s.dst, "def", phase, scope)
-                expr_uses(s.value, phase, scope)
-            elif isinstance(s, ReduceStmt):
-                rec(s.src.uid, "use", phase, scope)
-                rec(s.dst.uid, "def" if s.clear else "rmw", phase, scope)
-            elif isinstance(s, CumSumStmt):
-                rec(s.src.uid, "use", phase, scope)
-                rec(s.dst.uid, "def", phase, scope)
-            elif isinstance(s, AtomicStmt):
-                disq.add(s.dst.buffer.uid)
-                if isinstance(s.value, Region):
-                    region_rec(s.value, "use", phase, scope)
-                else:
-                    expr_uses(s.value, phase, scope)
-            elif isinstance(s, PrintStmt):
-                if isinstance(s.obj, Buffer):
-                    rec(s.obj.uid, "use", phase, scope)
-                else:
-                    expr_uses(s.obj, phase, scope)
-            elif isinstance(s, AssertStmt):
-                expr_uses(s.cond, phase, scope)
-            elif isinstance(s, IfThenElse):
-                expr_uses(s.cond, phase, scope)
-                sc = child(scope)
-                for c in s.then_body.stmts:
-                    scan(c, phase, sc, par_nest)
-                if s.else_body is not None:
-                    sc2 = child(scope)
-                    for c in s.else_body.stmts:
-                        scan(c, phase, sc2, par_nest)
-            elif isinstance(s, ForNest):
-                for e in s.extents:
-                    expr_uses(e, phase, scope)
-                if s.kind in ("parallel", "vectorized"):
-                    nest = par_nest + list(zip(s.loop_vars,
-                                               [as_int(e) for e in s.extents]))
-                    for c in s.body.stmts:
-                        scan(c, phase, scope, nest)
-                elif s.kind == "unroll" or (as_int(s.extents[0]) is not None
-                                            and as_int(s.extents[0]) <= 4):
-                    for c in s.body.stmts:
-                        scan(c, phase, scope, par_nest)
-                else:  # lax.fori_loop body = its own function scope
-                    sc = child(scope)
-                    for v in s.loop_vars:
-                        traced_ids.add(id(v))
-                    for c in s.body.stmts:
-                        scan(c, phase, sc, par_nest)
-            elif isinstance(s, BufferStoreStmt):
-                expr_uses(s.value, phase, scope)
-                for i in s.indices:
-                    if not isinstance(i, slice):
-                        expr_uses(i, phase, scope)
-                uid = s.buffer.uid
-                if uid in cand:
-                    if idx_traced(s.indices):
-                        disq.add(uid)
-                    # full def iff indices are exactly the par nest vars,
-                    # one per dim, covering each dim
-                    shape = [as_int(x) for x in s.buffer.shape]
-                    ext_of = {id(v): e for v, e in par_nest}
-                    full = len(s.indices) == len(shape) and \
-                        None not in shape
-                    used = set()
-                    if full:
-                        for idx, dim in zip(s.indices, shape):
-                            if not (isinstance(idx, Var) and
-                                    id(idx) in ext_of and
-                                    ext_of[id(idx)] == dim and
-                                    id(idx) not in used):
-                                full = False
-                                break
-                            used.add(id(idx))
-                    if full:
-                        rec(uid, "def", phase, scope)
-                    else:
-                        disq.add(uid)
-                        rec(uid, "use", phase, scope)
-            elif isinstance(s, CommStmt):
-                for at in ("src", "dst"):
-                    r = getattr(s, at, None)
-                    if isinstance(r, Region):
-                        disq.add(r.buffer.uid)
-
-        for phase, stmts in (("init", self.plan.init_stmts),
-                             ("main", self.plan.main_stmts),
-                             ("epi", self.plan.epi_stmts)):
-            for s in stmts:
-                scan(s, phase, [0], [])
-
-        out = set()
-        for uid in cand:
-            if uid in disq or uid in any_bufs:
-                continue
-            rs = recs.get(uid)
-            if not rs:
-                continue
-            phases = {p for _, p, _, _ in rs}
-            if len(phases) != 1:
-                continue
-            rs = sorted(rs, key=lambda r: r[3])
-            if rs[0][0] != "def":
-                continue
-            # defs and rmws REBIND the Python name, so they must all sit in
-            # one scope (a rebind inside a pl.when / fori body function
-            # neither escapes nor sees the outer binding); plain reads may
-            # be in any descendant scope (closure capture).
-            bind_scopes = {sc for k, _, sc, _ in rs if k in ("def", "rmw")}
-            if len(bind_scopes) != 1:
-                continue
-            s0 = next(iter(bind_scopes))
-            if any(sc[:len(s0)] != s0 for _, _, sc, _ in rs):
-                continue
-            out.add(uid)
-        return out
+        """Fragment SSA promotion (mem2reg); see transform/mem2reg.py."""
+        return plan_locals(self.plan)
 
     # ------------------------------------------------------------------
     def _setup_accessors(self):
@@ -474,41 +241,8 @@ class PallasCodegen:
                     b, f"{b.name}_s", kind, pad1=b.uid in padded)
 
     def _decide_pad1(self) -> set:
-        """1-D VMEM scratch buffers stored as (M, 1) columns (see
-        BufferAccessor.pad1). Buffers that take part in a DMA against an
-        HBM-resident param keep their logical shape (DMA endpoints must
-        match byte-for-byte)."""
-        from ..ir import walk
-        padded = set()
-        for b in self.plan.scratch:
-            if b.scope in ("local.var", "smem", "sem"):
-                continue
-            if len(b.shape) == 1 and as_int(b.shape[0]) is not None:
-                padded.add(b.uid)
-        if not padded:
-            return padded
-        any_bufs = {p.buffer.uid for p in self.plan.params
-                    if p.mode == "any"}
-
-        def chk(s):
-            if isinstance(s, AsyncCopyStmt):
-                # Split-phase DMA always lowers through rt.dma, which
-                # windows both endpoints with .at[] and never applies the
-                # pad column — so neither endpoint may be padded, even
-                # when both are VMEM scratch.
-                padded.discard(s.src.buffer.uid)
-                padded.discard(s.dst.buffer.uid)
-            elif isinstance(s, CopyStmt):
-                su, du = s.src.buffer.uid, s.dst.buffer.uid
-                if su in any_bufs:
-                    padded.discard(du)
-                if du in any_bufs:
-                    padded.discard(su)
-        for stmts in (self.plan.init_stmts, self.plan.main_stmts,
-                      self.plan.epi_stmts):
-            for s in stmts:
-                walk(s, chk)
-        return padded
+        """1-D scratch stored as (M, 1) columns; see transform/pad1.py."""
+        return decide_pad1(self.plan)
 
     def _scan_dma_usage(self):
         from ..ir import walk
@@ -910,7 +644,7 @@ class PallasCodegen:
                     touched.append(x.buffer.uid)
                 v = getattr(x, "value", None)
                 if v is not None and not isinstance(v, (Region, Buffer)):
-                    _for_each_load(v,
+                    for_each_load(v,
                                    lambda ld: touched.append(ld.buffer.uid))
             walk(s.body, see)
             par_vars.pad = any(
@@ -1072,76 +806,10 @@ class PallasCodegen:
 
     # ------------------------------------------------------------------
     def _param_guards(self) -> Dict[int, Any]:
-        """Conditional prefetch redirection (the trick jax's flash kernel
-        hand-codes in its kv_index_map): a block param whose every main-
-        phase read sits under an IfThenElse over grid vars gets, for index
-        dims driven by the pipeline axis, `where(cond, idx, 0)` — on
-        skipped grid steps the pipeline re-requests a block it would fetch
-        anyway instead of streaming one nobody reads. Returns
-        uid -> guard cond expr."""
-        from ..ir import free_vars, walk
-        pa = self.plan.pipeline_axis
-        if pa is None:
-            return {}
-        grid_ids = {id(a.var) for a in self.plan.grid}
-        pa_var = self.plan.grid[pa].var
-
-        def reads_of(stmts):
-            seen = set()
-
-            def chk(x):
-                for attr in ("src", "A", "B"):
-                    r = getattr(x, attr, None)
-                    if isinstance(r, Region):
-                        seen.add(r.buffer.uid)
-                # read-modify-write targets are reads too
-                if isinstance(x, GemmStmt) and not x.clear_accum:
-                    seen.add(x.C.buffer.uid)
-                if isinstance(x, ReduceStmt) and not x.clear:
-                    seen.add(x.dst.uid)
-                if isinstance(x, AtomicStmt):
-                    seen.add(x.dst.buffer.uid)
-                if isinstance(x, PrintStmt) and isinstance(x.obj, Buffer):
-                    seen.add(x.obj.uid)
-                if isinstance(x, IfThenElse):
-                    _for_each_load(x.cond,
-                                   lambda ld: seen.add(ld.buffer.uid))
-                for at in ("value", "cond", "obj"):
-                    v = getattr(x, at, None)
-                    if v is not None and not isinstance(
-                            v, (Region, Buffer, Stmt, str)):
-                        _for_each_load(v,
-                                       lambda ld: seen.add(ld.buffer.uid))
-                if isinstance(x, BufferStoreStmt):
-                    for i in x.indices:
-                        if not isinstance(i, slice):
-                            _for_each_load(
-                                i, lambda ld: seen.add(ld.buffer.uid))
-            for s in stmts:
-                walk(s, chk)
-            return seen
-
-        guarded: Dict[int, Any] = {}
-        unguarded = set()
-        unguarded |= reads_of(self.plan.init_stmts)
-        unguarded |= reads_of(self.plan.epi_stmts)
-        for s in self.plan.main_stmts:
-            if isinstance(s, IfThenElse) and s.else_body is None and \
-                    all(id(v) in grid_ids for v in free_vars(s.cond)) and \
-                    any(v is pa_var for v in free_vars(s.cond)):
-                for uid in reads_of(s.then_body.stmts):
-                    if uid in guarded and guarded[uid] is not s.cond:
-                        unguarded.add(uid)
-                    guarded[uid] = s.cond
-            else:
-                unguarded |= reads_of([s])
-        # Pure inputs only: an inout param is aliased into both in_specs
-        # and out_specs, and redirecting only its input index_map would
-        # write block-0 data back over untouched blocks on skipped steps.
-        param_uids = {p.buffer.uid for p in self.plan.params
-                      if p.mode == "block" and p.role == "in"}
-        return {uid: c for uid, c in guarded.items()
-                if uid not in unguarded and uid in param_uids}
+        """Conditional prefetch redirection; see transform/prefetch_guard.py
+        (analysis) — this printer only renders where(cond, idx, 0) into the
+        affected index_maps."""
+        return param_guards(self.plan)
 
     def _emit_build(self):
         w = self.w

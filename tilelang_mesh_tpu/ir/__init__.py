@@ -4,7 +4,7 @@ from .expr import (PrimExpr, Var, IntImm, FloatImm, BoolImm, StringImm,
                    BinOp, Call, Cast, BufferLoad, convert, const, as_int,
                    ceildiv,
                    canon_dtype, dtype_bits, dtype_is_float, dtype_is_int,
-                   promote_dtypes, linearize, free_vars)
+                   promote_dtypes, linearize, free_vars, for_each_load)
 from .buffer import Buffer, Region, to_region
 from .stmt import (Stmt, SeqStmt, AllocStmt, AsyncCopyStmt, KernelNode,
                    ForNest, IfThenElse,

@@ -653,3 +653,26 @@ def free_vars(expr: Any) -> list:
                     go(convert(i))
     go(convert(expr) if not isinstance(expr, PrimExpr) else expr)
     return out
+
+
+def for_each_load(e: Any, fn) -> None:
+    """Call fn(load) for every BufferLoad inside expression e, recursing
+    into call args, binop operands, casts, and index expressions. The one
+    expression walker shared by the codegen-prep passes (transform.mem2reg,
+    transform.prefetch_guard) and the emitters in codegen.pallas, so their
+    coverage cannot drift."""
+    if isinstance(e, BufferLoad):
+        fn(e)
+        for i in e.indices:
+            if not isinstance(i, slice):
+                for_each_load(i, fn)
+        return
+    for a in getattr(e, "args", []) or []:
+        if not isinstance(a, str):
+            for_each_load(a, fn)
+    for at in ("a", "b"):
+        sub = getattr(e, at, None)
+        if sub is not None:
+            for_each_load(sub, fn)
+    if isinstance(e, Cast):
+        for_each_load(e.value, fn)

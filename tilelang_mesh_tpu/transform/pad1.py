@@ -1,0 +1,47 @@
+"""Column-layout decision for 1-D VMEM fragments.
+
+A bare (M,) vector lives on the 128-wide lane axis, so broadcasting it over
+the rows of a (M, N) tile costs a lane->sublane relayout on every use — the
+dominant cost in online-softmax stats. Storing the fragment as a (M, 1)
+column makes the row broadcast free; this is the codegen pipeline's analog
+of the reference's Fragment layout inference
+(/root/reference/src/layout/layout.cc).
+
+Exclusions: buffers that take part in a DMA keep their logical shape, since
+rt.dma windows both endpoints with .at[] and never applies the pad column —
+that covers both HBM-resident partners of a sync T.copy and BOTH endpoints
+of any split-phase AsyncCopyStmt, even VMEM-to-VMEM ones (round-2 advisor
+finding).
+"""
+
+from __future__ import annotations
+
+from ..ir import AsyncCopyStmt, CopyStmt, as_int, walk
+
+
+def decide_pad1(plan) -> set:
+    """Return the set of scratch-buffer uids to store as (M, 1) columns."""
+    padded = set()
+    for b in plan.scratch:
+        if b.scope in ("local.var", "smem", "sem"):
+            continue
+        if len(b.shape) == 1 and as_int(b.shape[0]) is not None:
+            padded.add(b.uid)
+    if not padded:
+        return padded
+    any_bufs = {p.buffer.uid for p in plan.params if p.mode == "any"}
+
+    def chk(s):
+        if isinstance(s, AsyncCopyStmt):
+            padded.discard(s.src.buffer.uid)
+            padded.discard(s.dst.buffer.uid)
+        elif isinstance(s, CopyStmt):
+            su, du = s.src.buffer.uid, s.dst.buffer.uid
+            if su in any_bufs:
+                padded.discard(du)
+            if du in any_bufs:
+                padded.discard(su)
+    for stmts in (plan.init_stmts, plan.main_stmts, plan.epi_stmts):
+        for s in stmts:
+            walk(s, chk)
+    return padded

@@ -1,0 +1,87 @@
+"""Conditional prefetch redirection analysis.
+
+The trick jax's flash-attention kernel hand-codes in its kv_index_map,
+derived here automatically: a block param whose every main-phase read sits
+under an IfThenElse over grid vars gets, for index dims driven by the
+pipeline axis, ``where(cond, idx, 0)`` — on skipped grid steps the Pallas
+pipeline re-requests a block it would fetch anyway instead of streaming one
+nobody reads (causal attention skips ~half the KV stream this way).
+
+Pure inputs only: an inout param is aliased into both in_specs and
+out_specs, and redirecting only its input index_map would write block-0
+data back over untouched blocks on skipped steps (round-2 advisor finding).
+
+Analysis lives here, printing lives in codegen/pallas.py — matching the
+reference's pass/codegen separation (layout_inference.cc vs
+codegen_cuda.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..ir import (AtomicStmt, Buffer, BufferStoreStmt, GemmStmt, IfThenElse,
+                  PrintStmt, ReduceStmt, Region, Stmt, for_each_load,
+                  free_vars, walk)
+
+
+def param_guards(plan) -> Dict[int, Any]:
+    """Return uid -> guard condition expr for block params whose main-phase
+    reads are all under one grid-var IfThenElse involving the pipeline
+    axis."""
+    pa = plan.pipeline_axis
+    if pa is None:
+        return {}
+    grid_ids = {id(a.var) for a in plan.grid}
+    pa_var = plan.grid[pa].var
+
+    def reads_of(stmts):
+        seen = set()
+
+        def chk(x):
+            for attr in ("src", "A", "B"):
+                r = getattr(x, attr, None)
+                if isinstance(r, Region):
+                    seen.add(r.buffer.uid)
+            # read-modify-write targets are reads too
+            if isinstance(x, GemmStmt) and not x.clear_accum:
+                seen.add(x.C.buffer.uid)
+            if isinstance(x, ReduceStmt) and not x.clear:
+                seen.add(x.dst.uid)
+            if isinstance(x, AtomicStmt):
+                seen.add(x.dst.buffer.uid)
+            if isinstance(x, PrintStmt) and isinstance(x.obj, Buffer):
+                seen.add(x.obj.uid)
+            if isinstance(x, IfThenElse):
+                for_each_load(x.cond, lambda ld: seen.add(ld.buffer.uid))
+            for at in ("value", "cond", "obj"):
+                v = getattr(x, at, None)
+                if v is not None and not isinstance(
+                        v, (Region, Buffer, Stmt, str)):
+                    for_each_load(v, lambda ld: seen.add(ld.buffer.uid))
+            if isinstance(x, BufferStoreStmt):
+                for i in x.indices:
+                    if not isinstance(i, slice):
+                        for_each_load(i, lambda ld: seen.add(ld.buffer.uid))
+        for s in stmts:
+            walk(s, chk)
+        return seen
+
+    guarded: Dict[int, Any] = {}
+    unguarded = set()
+    unguarded |= reads_of(plan.init_stmts)
+    unguarded |= reads_of(plan.epi_stmts)
+    for s in plan.main_stmts:
+        if isinstance(s, IfThenElse) and s.else_body is None and \
+                all(id(v) in grid_ids for v in free_vars(s.cond)) and \
+                any(v is pa_var for v in free_vars(s.cond)):
+            for uid in reads_of(s.then_body.stmts):
+                if uid in guarded and guarded[uid] is not s.cond:
+                    unguarded.add(uid)
+                guarded[uid] = s.cond
+        else:
+            unguarded |= reads_of([s])
+    param_uids = {p.buffer.uid for p in plan.params
+                  if p.mode == "block" and p.role == "in"}
+    return {uid: c for uid, c in guarded.items()
+            if uid not in unguarded and uid in param_uids}
