@@ -1,0 +1,349 @@
+"""Plan-inference hardening (round-3): DMA auto-staging of HBM accesses,
+modular block-index maps, and VMEM-budget backtracking.
+
+Round-2 verdict #4: the single-pass affine matcher dropped any
+non-block-affine param to HBM residency, after which compute reads raised
+at codegen. These tests pin the new behavior: such programs now compile
+and run through synthesized DMA staging (transform/stage_hbm.py), modular
+rasterization maps plan as BlockSpecs with expression index maps, and a
+plan that exceeds the VMEM budget demotes copy-only windows to DMA
+instead of letting Mosaic fail downstream. Cf. reference
+layout_inference.cc:306-939 (constraint search + backtracking).
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.transform.plan import plan_kernel
+
+
+def _param(plan, name):
+    for p in plan.params:
+        if p.buffer.name == name:
+            return p
+    raise AssertionError(f"no param {name}")
+
+
+# ---------------------------------------------------------------------------
+# DMA auto-staging
+# ---------------------------------------------------------------------------
+
+def test_staged_gemm_operand_under_serial_loop():
+    """A GEMM operand windowed by a serial loop var is not block-affine in
+    the grid; it must be staged through DMA, not raise 'stayed in HBM'."""
+    NB, M, K, N = 4, 16, 128, 128
+
+    @T.prim_func
+    def acc_gemm(A: T.Tensor((NB * M, K), "float32"),
+                 B: T.Tensor((K, N), "float32"),
+                 O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            Bs = T.alloc_shared((K, N), "float32")
+            Cl = T.alloc_fragment((M, N), "float32")
+            T.copy(B, Bs)
+            T.fill(Cl, 0.0)
+            for k in T.serial(NB):
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, Cl)
+            T.copy(Cl, O)
+
+    plan = plan_kernel(acc_gemm.func)
+    assert _param(plan, "A").mode == "any"
+    assert any(b.name.startswith("stage_A") for b in plan.scratch), \
+        [b.name for b in plan.scratch]
+
+    k = tilelang.compile(acc_gemm)
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((NB * M, K)).astype(np.float32)
+    b = rng.standard_normal((K, N)).astype(np.float32)
+    out = np.empty((M, N), np.float32)
+    k(a, b, out)
+    ref = sum(a[i * M:(i + 1) * M] @ b for i in range(NB))
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_staged_elementwise_load_in_parallel_nest():
+    """Elementwise reads of an HBM-resident param inside T.Parallel are
+    staged as one DMA'd window per nest."""
+    NB, M, N = 3, 8, 128
+
+    @T.prim_func
+    def acc_rows(A: T.Tensor((NB * M, N), "float32"),
+                 O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((M, N), "float32")
+            T.fill(s, 0.0)
+            for k in T.serial(NB):
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = s[i, j] + A[k * M + i, j] * 2.0
+            T.copy(s, O)
+
+    plan = plan_kernel(acc_rows.func)
+    assert _param(plan, "A").mode == "any"
+    assert any(b.name.startswith("stage_A") for b in plan.scratch)
+
+    k = tilelang.compile(acc_rows)
+    a = np.random.default_rng(1).standard_normal(
+        (NB * M, N)).astype(np.float32)
+    out = np.empty((M, N), np.float32)
+    k(a, out)
+    ref = 2.0 * sum(a[i * M:(i + 1) * M] for i in range(NB))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_staged_elementwise_store_in_parallel_nest():
+    """Elementwise writes to an HBM-resident param are staged in VMEM and
+    flushed by one DMA after the nest."""
+    NB, M, N = 3, 8, 128
+
+    @T.prim_func
+    def scatter_rows(A: T.Tensor((M, N), "float32"),
+                     O: T.Tensor((NB * M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for k in T.serial(NB):
+                for i, j in T.Parallel(M, N):
+                    O[k * M + i, j] = s[i, j] + T.cast(k, "float32")
+            T.copy(s, O[0, 0])  # keep O also copy-written: conflicting
+            # patterns force residency 'any' even without the serial loop
+
+    plan = plan_kernel(scatter_rows.func)
+    assert _param(plan, "O").mode == "any"
+
+    k = tilelang.compile(scatter_rows)
+    a = np.random.default_rng(2).standard_normal((M, N)).astype(np.float32)
+    out = np.empty((NB * M, N), np.float32)
+    k(a, out)
+    ref = np.concatenate([a + float(i) for i in range(NB)])
+    ref[:M] = a  # final T.copy overwrites block 0
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_hbm_error_only_for_genuinely_unlowerable():
+    """Strided (coeff != 1) par access cannot be staged as a contiguous
+    window; it must still fail with the clear HBM message."""
+    M, N = 8, 128
+
+    @T.prim_func
+    def strided(A: T.Tensor((2 * M, N), "float32"),
+                O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((M, N), "float32")
+            for k in T.serial(2):
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = A[i * 2, j]
+            T.copy(s, O)
+
+    with pytest.raises(Exception, match="HBM|stage|block"):
+        tilelang.compile(strided)
+
+
+# ---------------------------------------------------------------------------
+# modular index maps
+# ---------------------------------------------------------------------------
+
+def test_modular_block_index_map():
+    """A[(bx % 2) * BM] plans as a BlockSpec with an expression index map
+    (not HBM residency)."""
+    BM, N, G = 8, 128, 4
+
+    @T.prim_func
+    def wrap(A: T.Tensor((2 * BM, N), "float32"),
+             O: T.Tensor((G * BM, N), "float32")):
+        with T.Kernel(G) as bx:
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[(bx % 2) * BM, 0], s)
+            for i, j in T.Parallel(BM, N):
+                s[i, j] = s[i, j] + 1.0
+            T.copy(s, O[bx * BM, 0])
+
+    plan = plan_kernel(wrap.func)
+    pa = _param(plan, "A")
+    assert pa.mode == "block", plan.describe()
+    assert any(d.expr is not None for d in pa.block_dims)
+    assert "%" in plan.describe()
+
+    k = tilelang.compile(wrap)
+    a = np.random.default_rng(3).standard_normal(
+        (2 * BM, N)).astype(np.float32)
+    out = np.empty((G * BM, N), np.float32)
+    k(a, out)
+    ref = np.concatenate([a[(g % 2) * BM:((g % 2) + 1) * BM] + 1.0
+                          for g in range(G)])
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_swizzled_block_index_map():
+    """Rasterization-style map mixing // and %: block index
+    (bx // 2) + (bx % 2) * 2 over a 4-block axis."""
+    BM, N = 8, 128
+
+    @T.prim_func
+    def swz(A: T.Tensor((4 * BM, N), "float32"),
+            O: T.Tensor((4 * BM, N), "float32")):
+        with T.Kernel(4) as bx:
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[((bx // 2) + (bx % 2) * 2) * BM, 0], s)
+            T.copy(s, O[bx * BM, 0])
+
+    plan = plan_kernel(swz.func)
+    assert _param(plan, "A").mode == "block", plan.describe()
+
+    k = tilelang.compile(swz)
+    a = np.random.default_rng(4).standard_normal(
+        (4 * BM, N)).astype(np.float32)
+    out = np.empty_like(a)
+    k(a, out)
+    perm = [(g // 2) + (g % 2) * 2 for g in range(4)]
+    ref = np.concatenate([a[p * BM:(p + 1) * BM] for p in perm])
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# VMEM-budget backtracking
+# ---------------------------------------------------------------------------
+
+def _two_input_kernel():
+    M, N = 64, 256
+
+    @T.prim_func
+    def add2(A: T.Tensor((M, N), "float32"),
+             B: T.Tensor((M, N), "float32"),
+             O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            sa = T.alloc_shared((M, N), "float32")
+            sb = T.alloc_shared((M, N), "float32")
+            T.copy(A, sa)
+            T.copy(B, sb)
+            for i, j in T.Parallel(M, N):
+                sa[i, j] = sa[i, j] + sb[i, j]
+            T.copy(sa, O)
+    return add2, M, N
+
+
+def test_vmem_backoff_demotes_largest_copy_only_param():
+    add2, M, N = _two_input_kernel()
+    # generous budget: everything rides BlockSpecs
+    plan = plan_kernel(add2.func)
+    assert _param(plan, "A").mode == "block"
+    assert _param(plan, "B").mode == "block"
+    # starve the budget: one 64 KiB copy-only window is demoted to
+    # DMA-fed HBM residency; the rest keep their BlockSpecs
+    add2b, _, _ = _two_input_kernel()
+    plan2 = plan_kernel(add2b.func,
+                        {"tl.tpu.vmem_budget_bytes": 200 * 1024})
+    modes = {p.buffer.name: p.mode for p in plan2.params}
+    assert modes["A"] == "any", plan2.describe()
+    assert modes["B"] == "block" and modes["O"] == "block"
+
+    # and the demoted plan still runs correctly
+    k = tilelang.compile(add2b,
+                         pass_configs={"tl.tpu.vmem_budget_bytes": 200 * 1024})
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((M, N)).astype(np.float32)
+    b = rng.standard_normal((M, N)).astype(np.float32)
+    out = np.empty((M, N), np.float32)
+    k(a, b, out)
+    np.testing.assert_allclose(out, a + b, rtol=1e-6)
+
+
+def test_vmem_backoff_keeps_compute_read_params():
+    """A param read directly by T.gemm is not copy-only; the backoff must
+    not demote it (staging notwithstanding, block residency is required
+    for correctness of the accumulator aliasing) — it stays block even
+    under a starved budget."""
+    M = 128
+
+    @T.prim_func
+    def mm(A: T.Tensor((M, M), "float32"), B: T.Tensor((M, M), "float32"),
+           O: T.Tensor((M, M), "float32")):
+        with T.Kernel(1) as bx:
+            Cl = T.alloc_fragment((M, M), "float32")
+            T.gemm(A, B, Cl, clear_accum=True)
+            T.copy(Cl, O)
+
+    plan = plan_kernel(mm.func, {"tl.tpu.vmem_budget_bytes": 4096})
+    assert _param(plan, "A").mode == "block"
+    assert _param(plan, "B").mode == "block"
+
+
+# ---------------------------------------------------------------------------
+# round-3 review regressions
+# ---------------------------------------------------------------------------
+
+def test_guarded_store_to_hbm_param_is_rejected_not_corrupted():
+    """A store to an HBM-resident param under a T.If INSIDE the Parallel
+    nest must not be staged: the unconditional post-nest flush would
+    clobber destination blocks whose guard was false. It stays a loud
+    compile error."""
+    M, N = 8, 128
+
+    @T.prim_func
+    def guarded(A: T.Tensor((M, N), "float32"),
+                O: T.Tensor((2 * M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for k in T.serial(2):
+                for i, j in T.Parallel(M, N):
+                    with T.If(k == 0):
+                        O[k * M + i, j] = s[i, j]
+            T.copy(s, O[0, 0])
+
+    with pytest.raises(Exception, match="HBM|stage"):
+        tilelang.compile(guarded)
+
+
+def test_nonconsecutive_modular_output_revisit_gets_tpu_note():
+    """O[(bx % 2) * BM] over 4 grid steps revisits block 0 at steps 0 and
+    2 — non-consecutive; the plan must carry a real-TPU error note
+    (interpret mode masks the corruption)."""
+    BM, N = 8, 128
+
+    @T.prim_func
+    def wrapout(A: T.Tensor((4 * BM, N), "float32"),
+                O: T.Tensor((2 * BM, N), "float32")):
+        with T.Kernel(4) as bx:
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[bx * BM, 0], s)
+            T.copy(s, O[(bx % 2) * BM, 0])
+
+    plan = plan_kernel(wrapout.func)
+    po = _param(plan, "O")
+    assert po.mode == "block"
+    assert po.tpu_note is not None and "consecutive" in po.tpu_note
+
+
+def test_consecutive_modular_output_revisit_is_legal():
+    """O[(bx // 2) * BM] revisits each block on consecutive steps
+    (0,0,1,1): legal — no tpu_note, axis demoted to arbitrary, revisit
+    recorded."""
+    BM, N = 8, 128
+
+    @T.prim_func
+    def gather2(A: T.Tensor((4 * BM, N), "float32"),
+                O: T.Tensor((2 * BM, N), "float32")):
+        with T.Kernel(4) as bx:
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[bx * BM, 0], s)
+            for i, j in T.Parallel(BM, N):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, O[(bx // 2) * BM, 0])
+
+    plan = plan_kernel(gather2.func)
+    po = _param(plan, "O")
+    assert po.mode == "block"
+    assert po.tpu_note is None, po.tpu_note
+    assert po.revisit_axes == [0]
+    assert plan.grid[0].kind == "arbitrary"
+
+    k = tilelang.compile(gather2)
+    a = np.random.default_rng(6).standard_normal(
+        (4 * BM, N)).astype(np.float32)
+    out = np.empty((2 * BM, N), np.float32)
+    k(a, out)
+    # last writer per output block wins: bx=1 -> block 0, bx=3 -> block 1
+    ref = np.concatenate([a[BM:2 * BM] * 2.0, a[3 * BM:] * 2.0])
+    np.testing.assert_allclose(out, ref, rtol=1e-6)

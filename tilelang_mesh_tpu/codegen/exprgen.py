@@ -166,7 +166,9 @@ class ExprGen:
         if isinstance(e, Call):
             return self._call(e, self.vector)
         if isinstance(e, Cast):
-            return f"({self.vector(e.value)}).astype({jnp_dtype(e.dtype)})"
+            # rt.cast also handles unroll-time python scalars (a plain
+            # .astype would fail on an int loop var)
+            return f"rt.cast({self.vector(e.value)}, {jnp_dtype(e.dtype)})"
         if isinstance(e, Var):
             if id(e) in self._par_ids:
                 # a bare loop var used as a value -> iota along its axis

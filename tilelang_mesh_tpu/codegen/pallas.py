@@ -17,7 +17,7 @@ from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
                   BufferStoreStmt, CommStmt, CopyStmt, CumSumStmt,
                   EvaluateStmt, FillStmt, ForNest, GemmStmt, IfThenElse,
                   PrintStmt, ReduceStmt, Region, SeqStmt, Stmt,
-                  as_int, dtype_is_float, for_each_load)
+                  as_int, dtype_is_float, for_each_load, free_vars)
 from ..transform.mem2reg import plan_locals
 from ..transform.pad1 import decide_pad1
 from ..transform.plan import BlockDim, KernelPlan, ParamPlan
@@ -99,13 +99,18 @@ class BufferAccessor:
                 out.append(idx)
                 continue
             local = convert(idx)
-            for axis, coef in bd.terms:
-                # subtract grid offset: var * coef_blocks * block_size
-                from ..ir import Var
-                gv = self._axis_var(axis)
-                local = _binop("-", local, _binop("*", gv, coef * bd.size))
-            if bd.const:
-                local = _binop("-", local, bd.const * bd.size)
+            if bd.expr is not None:
+                # modular map: offset = expr(grid) * block_size
+                local = _binop("-", local,
+                               _binop("*", bd.expr, bd.size))
+            else:
+                for axis, coef in bd.terms:
+                    # subtract grid offset: var * coef_blocks * block_size
+                    gv = self._axis_var(axis)
+                    local = _binop("-", local,
+                                   _binop("*", gv, coef * bd.size))
+                if bd.const:
+                    local = _binop("-", local, bd.const * bd.size)
             out.append(local)
         return out
 
@@ -937,15 +942,25 @@ class PallasCodegen:
         shape = "(" + ", ".join(str(d.size) for d in dims) + \
             ("," if len(dims) == 1 else "") + ")"
         idx_parts = []
+        grid_env = {id(a.var): f"_i{i}"
+                    for i, a in enumerate(self.plan.grid)}
         for d in dims:
-            terms = [f"_i{a}" if c == 1 else f"_i{a}*{c}" for a, c in d.terms]
-            if d.const:
-                terms.append(str(d.const))
-            e = " + ".join(terms) if terms else "0"
-            if d.post_div != 1:
-                e = f"({e}) // {d.post_div}"
-            if guard_src is not None and \
-                    any(a == pa for a, _ in d.terms):
+            if d.expr is not None:
+                # modular/swizzled block-index expression over grid vars
+                e = f"({ExprGen(grid_env, {}).scalar(d.expr)})"
+                uses_pa = pa is not None and any(
+                    v is self.plan.grid[pa].var
+                    for v in free_vars(d.expr))
+            else:
+                terms = [f"_i{a}" if c == 1 else f"_i{a}*{c}"
+                         for a, c in d.terms]
+                if d.const:
+                    terms.append(str(d.const))
+                e = " + ".join(terms) if terms else "0"
+                if d.post_div != 1:
+                    e = f"({e}) // {d.post_div}"
+                uses_pa = any(a == pa for a, _ in d.terms)
+            if guard_src is not None and uses_pa:
                 # skipped step: re-request block 0 (already fetched for a
                 # neighboring step) instead of streaming an unread block
                 e = f"jnp.where({guard_src}, {e}, 0)"

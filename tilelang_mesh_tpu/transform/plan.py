@@ -37,14 +37,33 @@ class BlockDim:
     """One dimension of a BlockSpec: size (None = squeezed unit dim),
     index-map terms in block units, and an optional post-division applied
     to the whole index expression (GQA-style `head // group` maps; only
-    legal on squeezed unit dims)."""
+    legal on squeezed unit dims).
+
+    ``expr`` carries a non-linear block-index expression over grid vars
+    (modular rasterization maps like ``(bx % W)`` or swizzles mixing
+    ``//`` and ``%``) when the affine (terms, const) form cannot express
+    the map; the reference's symbolic simplifier handles these in
+    src/transform/simplify.cc. When set, terms/const are unused."""
     size: Optional[int]
     terms: Tuple[Tuple[int, int], ...]  # ((grid_axis, coeff_blocks), ...)
     const: int
     post_div: int = 1
+    expr: Any = None                    # block-index expr over grid vars
 
     def key(self):
-        return (self.size, self.terms, self.const, self.post_div)
+        from ..ir.printer import expr_str
+        e = expr_str(self.expr) if self.expr is not None else None
+        return (self.size, self.terms, self.const, self.post_div, e)
+
+    def grid_axes_used(self, grid: "List[GridAxis]") -> set:
+        """Grid axis indices this dim's index map depends on."""
+        used = {a for a, _ in self.terms}
+        if self.expr is not None:
+            by_id = {id(a.var): i for i, a in enumerate(grid)}
+            for v in free_vars(self.expr):
+                if id(v) in by_id:
+                    used.add(by_id[id(v)])
+        return used
 
 
 @dataclass
@@ -120,14 +139,18 @@ class KernelPlan:
             if p.mode == "block":
                 dims = []
                 for d in p.block_dims:
-                    t = " + ".join(
-                        (f"{self.grid[a].var.name}" if c == 1
-                         else f"{self.grid[a].var.name}*{c}")
-                        for a, c in d.terms) or "0"
-                    if d.const:
-                        t += f" + {d.const}"
-                    if d.post_div != 1:
-                        t = f"({t})//{d.post_div}"
+                    if d.expr is not None:
+                        from ..ir.printer import expr_str
+                        t = expr_str(d.expr)
+                    else:
+                        t = " + ".join(
+                            (f"{self.grid[a].var.name}" if c == 1
+                             else f"{self.grid[a].var.name}*{c}")
+                            for a, c in d.terms) or "0"
+                        if d.const:
+                            t += f" + {d.const}"
+                        if d.post_div != 1:
+                            t = f"({t})//{d.post_div}"
                     dims.append(f"{d.size}@({t})")
                 desc = f"block[{', '.join(dims)}]"
                 if p.alias is not None:
@@ -153,6 +176,64 @@ class KernelPlan:
 # ---------------------------------------------------------------------------
 
 
+def _div_exact(e, k: int):
+    """Structurally divide expression e by integer k (e == result * k),
+    or None. Handles +, -, *, and % (since (k*a) % (k*b) == k*(a % b) for
+    non-negative operands — grid indices are). The reach this gives the
+    planner over pure ``linearize`` is exactly modular index maps:
+    ``(bx % W) * bs`` or swizzled ``((bx // g) * g + ...) * bs`` bases."""
+    from ..ir.expr import IntImm, Var as _Var, _binop
+    if k == 1:
+        return e
+    e = convert_expr(e)
+    if isinstance(e, IntImm):
+        return IntImm(e.value // k) if e.value % k == 0 else None
+    if isinstance(e, _Var):
+        return None
+    from ..ir.expr import BinOp
+    if isinstance(e, BinOp):
+        if e.op in ("+", "-"):
+            a, b = _div_exact(e.a, k), _div_exact(e.b, k)
+            if a is None or b is None:
+                return None
+            return _binop(e.op, a, b)
+        if e.op == "*":
+            for num, other in ((e.a, e.b), (e.b, e.a)):
+                iv = as_int(num)
+                if iv is not None and iv % k == 0:
+                    q = iv // k
+                    return other if q == 1 else _binop("*", other, q)
+            a = _div_exact(e.a, k)
+            if a is not None:
+                return _binop("*", a, e.b)
+            b = _div_exact(e.b, k)
+            if b is not None:
+                return _binop("*", e.a, b)
+            return None
+        if e.op == "%":
+            a, b = _div_exact(e.a, k), _div_exact(e.b, k)
+            if a is None or b is None:
+                return None
+            return _binop("%", a, b)
+    return None
+
+
+def convert_expr(e):
+    from ..ir.expr import convert
+    return convert(e)
+
+
+def _grid_only_expr(e, axes: List[GridAxis]) -> bool:
+    """True when e references only grid vars (no loads, no other vars)."""
+    from ..ir import for_each_load
+    grid_ids = {id(a.var) for a in axes}
+    if any(id(v) not in grid_ids for v in free_vars(e)):
+        return False
+    n = [0]
+    for_each_load(convert_expr(e), lambda ld: n.__setitem__(0, 1))
+    return not n[0]
+
+
 def _region_block_dims(region: Region, axes: List[GridAxis],
                        squeeze_to_rank: Optional[int]) -> Optional[List[BlockDim]]:
     """Try to express a region as a BlockSpec over the grid axes."""
@@ -175,6 +256,17 @@ def _region_block_dims(region: Region, axes: List[GridAxis],
                     and d < n_squeeze):
                 lin = linearize(base.a, axis_vars)
                 post_div = base.b.value
+            if lin is None and size > 0 and _grid_only_expr(base, axes):
+                # modular / swizzled map: base = f(grid) * size with f
+                # non-affine (e.g. (bx % W) * bs) — carry f as the dim's
+                # block-index expression
+                f = _div_exact(base, size)
+                if f is not None:
+                    blk = size
+                    if d < n_squeeze and size == 1:
+                        blk = None
+                    dims.append(BlockDim(blk, (), 0, 1, expr=f))
+                    continue
             if lin is None:
                 return None
         coeffs, const = lin
@@ -323,7 +415,8 @@ def _widen_min_tile(p: ParamPlan) -> None:
         blk = bd.size if bd.size is not None else 1
         if blk == shape[i] or blk % min_mult == 0:
             continue
-        if pos == 1 and (bd.terms or (bd.const * blk) % 128):
+        if pos == 1 and (bd.terms or bd.expr is not None
+                         or (bd.const * blk) % 128):
             # Widening the lane (minor) dim would keep the original index
             # as a dynamic/unaligned start, and Mosaic only accepts lane
             # offsets it can prove are multiples of 128 (DMA windows
@@ -346,6 +439,109 @@ def _widen_min_tile(p: ParamPlan) -> None:
         p.alias = None
 
 
+def _eval_expr(e, env: Dict[int, int]) -> Optional[int]:
+    """Evaluate an integer IR expression under a var assignment."""
+    from ..ir.expr import BinOp, BoolImm, Cast, IntImm
+    from ..ir.expr import Var as _Var
+    e = convert_expr(e)
+    if isinstance(e, IntImm):
+        return e.value
+    if isinstance(e, BoolImm):
+        return int(e.value)
+    if isinstance(e, _Var):
+        return env.get(id(e))
+    if isinstance(e, Cast):
+        return _eval_expr(e.value, env)
+    if isinstance(e, BinOp):
+        a, b = _eval_expr(e.a, env), _eval_expr(e.b, env)
+        if a is None or b is None:
+            return None
+        try:
+            return {"+": lambda: a + b, "-": lambda: a - b,
+                    "*": lambda: a * b, "//": lambda: a // b,
+                    "%": lambda: a % b,
+                    "min": lambda: min(a, b),
+                    "max": lambda: max(a, b)}[e.op]()
+        except (KeyError, ZeroDivisionError):
+            return None
+    return None
+
+
+_REVISIT_ENUM_CAP = 1 << 16
+
+
+def _expr_map_revisit_check(grid: List[GridAxis], p: ParamPlan) -> None:
+    """Output-revisit legality for non-affine (expr) index maps, where the
+    per-axis omitted-suffix analysis does not apply: a map like
+    ``(bx % 2)`` uses the axis but NON-INJECTIVELY, revisiting block 0 at
+    bx = 0 and bx = 2 — non-consecutive steps, which Pallas handles by
+    flushing and refetching an unwritten output block (silent corruption
+    on real TPUs). Enumerate the grid (row-major, last axis fastest — the
+    Pallas iteration order) and require every distinct block tuple's
+    visits to be one contiguous run; demote every contributing axis to
+    'arbitrary' when revisits exist at all."""
+    extents = [a.extent for a in grid]
+    total = 1
+    for e in extents:
+        total *= e
+    if total > _REVISIT_ENUM_CAP:
+        p.tpu_note = (
+            f"output '{p.buffer.name}': a non-affine block index map over "
+            f"a grid of {total} steps cannot be verified for consecutive "
+            f"revisits; use an affine index map or a smaller grid")
+        return
+    env_vars = [a.var for a in grid]
+    keys: Dict[tuple, tuple] = {}   # grid point -> block tuple
+    seen: Dict[tuple, int] = {}     # block tuple -> last step seen
+    bad = False
+    step = 0
+    import itertools
+    for point in itertools.product(*[range(e) for e in extents]):
+        env = {id(v): x for v, x in zip(env_vars, point)}
+        key = []
+        for d in p.block_dims:
+            if d.expr is not None:
+                v = _eval_expr(d.expr, env)
+                if v is None:
+                    p.tpu_note = (
+                        f"output '{p.buffer.name}': its block index map "
+                        f"could not be evaluated for revisit legality")
+                    return
+                key.append(v)
+            else:
+                idx = sum(env[id(grid[a].var)] * c for a, c in d.terms) \
+                    + d.const
+                key.append(idx // d.post_div)
+        key = tuple(key)
+        keys[point] = key
+        if key in seen:
+            if seen[key] != step - 1:
+                bad = True
+        seen[key] = step
+        step += 1
+    # an axis revisits the output if stepping it ALONE can leave the
+    # block unchanged (covers both omission and non-injective maps)
+    revisit = set()
+    for point, key in keys.items():
+        for i in range(len(extents)):
+            if i in revisit or point[i] == 0:
+                continue
+            prev = point[:i] + (point[i] - 1,) + point[i + 1:]
+            if keys[prev] == key:
+                revisit.add(i)
+    if revisit:
+        p.revisit_axes = sorted(revisit | set(p.revisit_axes))
+        for i in p.revisit_axes:
+            if grid[i].kind == "parallel":
+                grid[i].kind = "arbitrary"
+    if bad:
+        p.tpu_note = (
+            f"output '{p.buffer.name}': its non-affine block index map "
+            f"revisits a block on non-consecutive grid steps; Pallas "
+            f"requires output revisits to be consecutive — restructure "
+            f"the index map (e.g. make the modular axis innermost)")
+
+
 def _demote_revisited_axes(grid: List[GridAxis],
                            params: List[ParamPlan]) -> None:
     """Any grid axis absent from some block-mode output's index map
@@ -363,7 +559,14 @@ def _demote_revisited_axes(grid: List[GridAxis],
         if p.role not in ("out", "inout") or p.mode != "block" \
                 or p.block_dims is None:
             continue
-        used = {a for d in p.block_dims for a, _ in d.terms}
+        if any(d.expr is not None for d in p.block_dims):
+            # non-affine maps need the enumeration-based check: the
+            # suffix analysis below assumes axis-in-terms == injective
+            _expr_map_revisit_check(grid, p)
+            continue
+        used = set()
+        for d in p.block_dims:
+            used |= d.grid_axes_used(grid)
         omitted = [i for i, ax in enumerate(grid)
                    if i not in used and ax.extent > 1]
         p.revisit_axes = omitted
@@ -382,6 +585,143 @@ def _demote_revisited_axes(grid: List[GridAxis],
                 f"requires output revisits to be consecutive grid steps "
                 f"— reorder T.Kernel axes so the axes absent from this "
                 f"output's index come first (innermost)")
+
+
+_DEFAULT_VMEM_BUDGET = 15 * 2 ** 20  # ~0.9 of the 16 MiB per-core VMEM
+
+
+def _copy_only_uids(stmts: List[Stmt], params: List["ParamPlan"]) -> set:
+    """Global params whose every access is a CopyStmt/AsyncCopyStmt region
+    endpoint — the ones that can be demoted to HBM residency with a plain
+    DMA lowering (no staging rewrite needed)."""
+    from ..ir import for_each_load, walk
+    bad = set()
+
+    def expr_bad(e):
+        def on(ld):
+            if ld.buffer.scope == "global":
+                bad.add(ld.buffer.uid)
+        for_each_load(e, on)
+
+    def chk(x):
+        if isinstance(x, (CopyStmt, AsyncCopyStmt)):
+            for r in (x.src, x.dst):
+                for b in r.base:
+                    if not isinstance(b, slice):
+                        expr_bad(b)
+            return
+        if isinstance(x, GemmStmt):
+            for r in (x.A, x.B, x.C):
+                if r.buffer.scope == "global":
+                    bad.add(r.buffer.uid)
+            return
+        if isinstance(x, FillStmt):
+            if x.dst.buffer.scope == "global":
+                bad.add(x.dst.buffer.uid)
+            expr_bad(x.value)
+            return
+        if isinstance(x, AtomicStmt):
+            bad.add(x.dst.buffer.uid)
+            if isinstance(x.value, Region):
+                if x.value.buffer.scope == "global":
+                    bad.add(x.value.buffer.uid)
+            else:
+                expr_bad(x.value)
+            return
+        if isinstance(x, BufferStoreStmt):
+            if x.buffer.scope == "global":
+                bad.add(x.buffer.uid)
+            expr_bad(x.value)
+            for i in x.indices:
+                if not isinstance(i, slice):
+                    expr_bad(i)
+            return
+        if isinstance(x, IfThenElse):
+            expr_bad(x.cond)
+            return
+        if isinstance(x, ForNest):
+            for e in x.extents:
+                expr_bad(e)
+            return
+        if isinstance(x, CommStmt):
+            # comm lowering is planned against the param's residency;
+            # never demote a collective operand behind its back
+            for at in ("src", "dst"):
+                r = getattr(x, at, None)
+                if isinstance(r, Region) and r.buffer.scope == "global":
+                    bad.add(r.buffer.uid)
+            return
+        for at in ("cond", "obj", "value"):
+            v = getattr(x, at, None)
+            if v is not None and not isinstance(v, (Region, Buffer, Stmt,
+                                                    str)):
+                expr_bad(v)
+
+    for s in stmts:
+        walk(s, chk)
+    return {p.buffer.uid for p in params} - bad
+
+
+def _block_param_bytes(p: "ParamPlan", grid: List["GridAxis"]) -> int:
+    """Padded VMEM footprint of one BlockSpec window, doubled when the
+    block streams across a stepping grid axis (Mosaic double-buffers the
+    pipeline)."""
+    from ..ir import dtype_bits
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+    sizes = [d.size for d in p.block_dims if d.size is not None] or [1]
+    rows = 1
+    for s in sizes[:-1]:
+        rows *= s
+    cols = sizes[-1]
+    bits = dtype_bits(p.buffer.dtype)
+    b = lnat.vmem_bytes(rows, cols, bits)
+    if b is None:
+        b = lpy.vmem_bytes(rows, cols, bits)
+    used = set()
+    for d in p.block_dims:
+        used |= d.grid_axes_used(grid)
+    streamed = any(grid[a].extent > 1 for a in used)
+    return b * (2 if streamed else 1)
+
+
+def _vmem_backoff(grid: List["GridAxis"], params: List["ParamPlan"],
+                  allocs: List[Buffer], stmts: List[Stmt],
+                  pass_cfg: dict) -> None:
+    """Backtrack over residency choices when the planned VMEM footprint
+    (BlockSpec windows + scratch) exceeds the budget: demote the largest
+    copy-only block params to HBM residency (their copies become explicit
+    DMA) until the plan fits. The TPU realization of the reference's
+    layout-inference backtracking (layout_inference.cc:928-939), where the
+    search is over fragment layouts; here the only degree of freedom is
+    which windows ride the BlockSpec pipeline."""
+    budget = pass_cfg.get("tl.tpu.vmem_budget_bytes") \
+        or pass_cfg.get("tl.tpu.vmem_limit_bytes") \
+        or _DEFAULT_VMEM_BUDGET
+    budget = int(budget)
+
+    def estimate() -> int:
+        aliased = {p.alias.uid for p in params if p.alias is not None}
+        scratch = [b for b in allocs if b.uid not in aliased]
+        arena, _ = _pack_scratch(scratch, stmts)
+        blocks = sum(_block_param_bytes(p, grid) for p in params
+                     if p.mode == "block" and p.block_dims)
+        return arena + blocks
+
+    if estimate() <= budget:
+        return
+    copy_only = _copy_only_uids(stmts, params)
+    while estimate() > budget:
+        cands = [p for p in params
+                 if p.mode == "block" and p.block_dims and not p.atomic
+                 and p.buffer.uid in copy_only]
+        if not cands:
+            return  # nothing safely demotable; Mosaic reports the overflow
+        victim = max(cands, key=lambda p: _block_param_bytes(p, grid))
+        victim.mode = "any"
+        victim.block_dims = None
+        victim.alias = None
+        victim.tpu_note = None
 
 
 def _writers(stmts_root: Stmt) -> Dict[int, int]:
@@ -463,6 +803,7 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
     writer_counts = _writers(func.body)
 
     aliased_copies: List[CopyStmt] = []
+    vector_elem_bufs: set = set()   # globals loaded with Parallel-var indices
 
     def loop_ctx_axes(extra_vars) -> List[GridAxis]:
         # axes visible to an access: the grid plus (for elementwise accesses)
@@ -550,6 +891,15 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                             par_vars: list):
         buf = load_or_store.buffer
         indices = load_or_store.indices
+        # a load whose index depends on a Parallel var vectorizes onto VPU
+        # lanes — SMEM residency can only serve SCALAR reads, so remember
+        # these for the _smem_promote veto (staging serves them instead)
+        par_ids_ = {id(v) for v, _ in par_vars}
+        for idx in indices:
+            if not isinstance(idx, slice) and \
+                    any(id(v) in par_ids_ for v in free_vars(idx)):
+                vector_elem_bufs.add(buf.uid)
+                break
         if serial_vars:
             _merge_param(plans, buf, role, None, None)
             return
@@ -667,6 +1017,9 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
 
     # ---- finalize ---------------------------------------------------------
     region_used_bufs = _region_used_bufs(init_stmts + main_stmts + epi_stmts)
+    # SMEM can only serve scalar reads: vector-loaded params must not be
+    # promoted (DMA staging serves them)
+    region_used_bufs |= vector_elem_bufs
     params: List[ParamPlan] = []
     for b in global_params:
         p = plans[b.uid]
@@ -684,6 +1037,15 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
                     and p.mode == "block":
                 _widen_min_tile(p)
         params.append(p)
+
+    # auto-stage unservable HBM accesses through DMA windows FIRST, so the
+    # budget backoff's estimate sees the staging buffers it adds; backoff
+    # then only demotes copy-only params, which need no staging of their own
+    from .stage_hbm import stage_hbm_accesses
+    allocs = allocs + stage_hbm_accesses(params, init_stmts, main_stmts,
+                                         epi_stmts)
+    _vmem_backoff(grid, params, allocs,
+                  init_stmts + main_stmts + epi_stmts, pass_cfg)
     _demote_revisited_axes(grid, params)
 
     aliased_bufs = {p.alias.uid for p in params if p.alias is not None}
