@@ -347,3 +347,91 @@ def test_consecutive_modular_output_revisit_is_legal():
     # last writer per output block wins: bx=1 -> block 0, bx=3 -> block 1
     ref = np.concatenate([a[BM:2 * BM] * 2.0, a[3 * BM:] * 2.0])
     np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_cross_axis_consecutive_revisit_demotes_axes():
+    """((bx + by) % 2) revisits a block across an axis boundary on
+    consecutive steps: every stepping axis must lose 'parallel' semantics
+    even though stepping either axis alone always changes the block."""
+    BM, N = 8, 128
+
+    @T.prim_func
+    def diag(A: T.Tensor((4 * BM, N), "float32"),
+             O: T.Tensor((2 * BM, N), "float32")):
+        with T.Kernel(2, 2) as (bx, by):
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[(by * 2 + bx) * BM, 0], s)
+            T.copy(s, O[((bx + by) % 2) * BM, 0])
+
+    plan = plan_kernel(diag.func)
+    po = _param(plan, "O")
+    assert po.mode == "block", plan.describe()
+    # block sequence over the (by, bx) grid is 0,1,1,0: block 1 is
+    # revisited consecutively across a row step -> both axes arbitrary
+    assert all(a.kind == "arbitrary" for a in plan.grid), plan.describe()
+    assert po.revisit_axes == [0, 1]
+
+
+def test_staged_scalar_index_load_in_copy_base():
+    """A copy whose window base loads from an HBM-resident index table:
+    the table element is staged through a (1,)-element DMA and the copy
+    base rewritten (previously a tuple-compare TypeError)."""
+    M, N, NB = 8, 128, 4
+    TBL = 8192  # 32 KiB of int32: too big for SMEM promotion
+
+    @T.prim_func
+    def gather(A: T.Tensor((NB * M, N), "float32"),
+               IT: T.Tensor((TBL,), "int32"),
+               O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            for k in T.serial(1):
+                T.copy(A[IT[k] * M, 0], s)
+            T.copy(s, O)
+
+    plan = plan_kernel(gather.func)
+    assert _param(plan, "IT").mode == "any"
+    k = tilelang.compile(gather)
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((NB * M, N)).astype(np.float32)
+    it = np.zeros((TBL,), np.int32)
+    it[0] = 2
+    out = np.empty((M, N), np.float32)
+    k(a, it, out)
+    np.testing.assert_allclose(out, a[2 * M:3 * M], rtol=1e-6)
+
+
+def test_staging_dedups_identical_windows_across_statements():
+    """Two adjacent GEMMs reading the same HBM window share ONE staged
+    buffer and one DMA (per-statement caches doubled HBM traffic)."""
+    M, K, N = 16, 128, 128
+
+    @T.prim_func
+    def twice(A: T.Tensor((2 * M, K), "float32"),
+              B: T.Tensor((K, N), "float32"),
+              O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            Bs = T.alloc_shared((K, N), "float32")
+            C1 = T.alloc_fragment((M, N), "float32")
+            C2 = T.alloc_fragment((M, N), "float32")
+            T.copy(B, Bs)
+            for k in T.serial(2):
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, C1,
+                       clear_accum=True)
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, C2,
+                       clear_accum=True)
+            for i, j in T.Parallel(M, N):
+                C1[i, j] = C1[i, j] + C2[i, j]
+            T.copy(C1, O)
+
+    plan = plan_kernel(twice.func)
+    stages = [b for b in plan.scratch if b.name.startswith("stage_A")]
+    assert len(stages) == 1, [b.name for b in plan.scratch]
+
+    k = tilelang.compile(twice)
+    rng = np.random.default_rng(8)
+    a = rng.standard_normal((2 * M, K)).astype(np.float32)
+    b = rng.standard_normal((K, N)).astype(np.float32)
+    out = np.empty((M, N), np.float32)
+    k(a, b, out)
+    np.testing.assert_allclose(out, 2 * (a[M:] @ b), rtol=2e-2, atol=2e-2)

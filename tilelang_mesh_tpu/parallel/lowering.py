@@ -183,6 +183,7 @@ def lower_mesh(func: PrimFunc, target: str,
         if kind == "comm":
             schedule_lines.append(f"  [{i}] collective "
                                   f"{_comm_desc(payload, nrow, ncol)}")
+            schedule_lines.extend(_comm_schedule_lines(payload, nrow, ncol))
             compiled_segments.append({"kind": "comm", "op": payload})
             continue
         reads, writes = seg_rw[i]
@@ -312,6 +313,89 @@ def _comm_desc(c: CommStmt, nrow: int, ncol: int) -> str:
     if isinstance(c, CommFence):
         return "fence()"
     return type(c).__name__
+
+
+def _schedule_steps(kind: str, nrow: int, ncol: int, direction: int,
+                    src=None) -> list:
+    """Synthesized NoC step schedule (native tltpu_core, python mirror as
+    fallback) — the analog of the reference's per-core tl.broadcast_
+    sequences (comm.cc:479-918)."""
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+    if kind == "broadcast":
+        s = lnat.broadcast_schedule(nrow, ncol, src, direction)
+        return s if s is not None else lpy.broadcast_schedule(
+            nrow, ncol, src, direction)
+    if kind == "all_gather":
+        s = lnat.allgather_schedule(nrow, ncol, direction)
+        return s if s is not None else lpy.allgather_schedule(
+            nrow, ncol, direction)
+    s = lnat.allreduce_schedule(nrow, ncol, direction)
+    return s if s is not None else lpy.allreduce_schedule(
+        nrow, ncol, direction)
+
+
+def _xla_lowering_desc(c: CommStmt, nrow: int, ncol: int) -> str:
+    """One line naming the XLA collective _apply_comm emits for this op —
+    kept in lockstep with _apply_comm so the golden schedule text IS the
+    lowering contract."""
+    ax = {0: "'y'", 1: "'x'", 2: "('x', 'y')"}
+    if isinstance(c, CommBroadcast):
+        r0, c0 = c.src_core // ncol, c.src_core % ncol
+        tgt = {0: f"row {r0}", 1: f"col {c0}", 2: "all cores"}[c.direction]
+        return (f"xla: psum(mask(core==({r0}, {c0})), {ax[c.direction]})"
+                f" -> {tgt}")
+    if isinstance(c, CommPut):
+        sr, sc = c.src_core // ncol, c.src_core % ncol
+        dr, dc = c.dst_core // ncol, c.dst_core % ncol
+        return (f"xla: psum(mask(core==({sr}, {sc})), ('x', 'y'))"
+                f" -> core ({dr}, {dc})")
+    if isinstance(c, CommAllGather):
+        return f"xla: all_gather(axis={ax[c.direction]})"
+    if isinstance(c, CommAllReduce):
+        prim = {"sum": "psum", "abssum": "psum", "max": "pmax",
+                "absmax": "pmax", "min": "pmin"}.get(
+            c.reduce_type, "all_gather+local")
+        return (f"xla: local reduce(dim={c.dim}) + "
+                f"{prim}(axis={ax[c.direction]})")
+    return "xla: optimization_barrier(live values)"
+
+
+def _comm_schedule_lines(c: CommStmt, nrow: int, ncol: int) -> list:
+    """Indented schedule detail under a collective's headline: the
+    synthesized NoC step sequence and the XLA collective that realizes it
+    in the SPMD lowering. Golden-compared by tests/test_comm.py the way
+    the reference compares full lowered IR
+    (test_tilelang_language_comm.py:55-103)."""
+    dirname = {0: "h", 1: "v"}
+    lines = []
+    steps = None
+    if isinstance(c, CommBroadcast):
+        r0, c0 = c.src_core // ncol, c.src_core % ncol
+        steps = _schedule_steps("broadcast", nrow, ncol, c.direction,
+                                (r0, c0))
+    elif isinstance(c, CommAllGather):
+        steps = _schedule_steps("all_gather", nrow, ncol, c.direction)
+    elif isinstance(c, CommAllReduce):
+        steps = _schedule_steps("all_reduce", nrow, ncol, c.direction)
+    elif isinstance(c, CommPut):
+        sr, sc = c.src_core // ncol, c.src_core % ncol
+        dr, dc = c.dst_core // ncol, c.dst_core % ncol
+        hops = abs(sr - dr) + abs(sc - dc)
+        lines.append(f"        noc[0]: put core({sr}, {sc}) -> "
+                     f"core({dr}, {dc}) hops={hops}")
+    if steps is not None:
+        from ..layout import native as lnat
+        from ..layout import python_impl as lpy
+        for j, (r, cc, d, chunk) in enumerate(steps):
+            lines.append(f"        noc[{j}]: bcast core({r}, {cc}) "
+                         f"dir={dirname[d]} chunk={chunk}")
+        hops = lnat.schedule_hops(steps, nrow, ncol)
+        if hops is None:
+            hops = lpy.schedule_hops(steps, nrow, ncol)
+        lines.append(f"        cost: {len(steps)} steps, {hops} hops")
+    lines.append(f"        {_xla_lowering_desc(c, nrow, ncol)}")
+    return lines
 
 
 # ---------------------------------------------------------------------------

@@ -520,7 +520,7 @@ def _expr_map_revisit_check(grid: List[GridAxis], p: ParamPlan) -> None:
         seen[key] = step
         step += 1
     # an axis revisits the output if stepping it ALONE can leave the
-    # block unchanged (covers both omission and non-injective maps)
+    # block unchanged (covers both omission and non-injective maps) ...
     revisit = set()
     for point, key in keys.items():
         for i in range(len(extents)):
@@ -529,6 +529,19 @@ def _expr_map_revisit_check(grid: List[GridAxis], p: ParamPlan) -> None:
             prev = point[:i] + (point[i] - 1,) + point[i + 1:]
             if keys[prev] == key:
                 revisit.add(i)
+    # ... and a CONSECUTIVE-step revisit that changes several axes at once
+    # (e.g. (bx + by) % 4 revisiting across a row boundary) must demote
+    # every axis that steps between the two visits, or Mosaic's parallel
+    # dimension semantics could reorder the two writes apart
+    prev_point, prev_key = None, None
+    import itertools as _it
+    for point in _it.product(*[range(e) for e in extents]):
+        key = keys[point]
+        if prev_key is not None and key == prev_key:
+            for i in range(len(extents)):
+                if point[i] != prev_point[i]:
+                    revisit.add(i)
+        prev_point, prev_key = point, key
     if revisit:
         p.revisit_axes = sorted(revisit | set(p.revisit_axes))
         for i in p.revisit_axes:

@@ -155,7 +155,7 @@ class _Stager:
                 if staged is not None:
                     return staged
                 return BufferLoad(e.buffer, idx)  # codegen reports clearly
-            if idx != e.indices:
+            if any(x is not y for x, y in zip(idx, e.indices)):
                 return BufferLoad(e.buffer, idx)
             return e
         if isinstance(e, BinOp):
@@ -182,7 +182,7 @@ class _Stager:
         base = tuple(b if isinstance(b, slice)
                      else self.rewrite_expr(b, par_ids, pre, cache)
                      for b in region.base)
-        if base != region.base:
+        if any(x is not y for x, y in zip(base, region.base)):
             return Region(region.buffer, base, region.shape)
         return region
 
@@ -191,17 +191,41 @@ class _Stager:
         return buf.scope == "global" and buf.uid in self.any_uids
 
     # -- statement rewriting -------------------------------------------------
+    def _writes_any_param(self, s: Stmt) -> bool:
+        """Does this statement (or a child) write an any-mode param? Such
+        a write makes previously staged windows of it stale."""
+        from ..ir import walk
+        hit = [False]
+
+        def chk(x):
+            for at in ("dst",):
+                r = getattr(x, at, None)
+                if isinstance(r, Region) and self._is_any(r):
+                    hit[0] = True
+            if isinstance(x, BufferStoreStmt) and self._is_any(x.buffer):
+                hit[0] = True
+        walk(s, chk)
+        return hit[0]
+
     def rewrite_stmts(self, stmts: List[Stmt],
                       par_ids: Dict[int, int]) -> List[Stmt]:
         out: List[Stmt] = []
+        # one read-window dedup cache per statement LIST: adjacent
+        # statements reading the same HBM window share one staged buffer
+        # and one DMA; invalidated by any write to an any-mode param
+        cache: Dict[str, Buffer] = {}
         for s in stmts:
-            out.extend(self.rewrite_stmt(s, par_ids))
+            out.extend(self.rewrite_stmt(s, par_ids, cache))
+            if self._writes_any_param(s):
+                cache.clear()
         return out
 
-    def rewrite_stmt(self, s: Stmt, par_ids: Dict[int, int]) -> List[Stmt]:
+    def rewrite_stmt(self, s: Stmt, par_ids: Dict[int, int],
+                     cache: Optional[Dict[str, Buffer]] = None) -> List[Stmt]:
         pre: List[Stmt] = []
         post: List[Stmt] = []
-        cache: Dict[str, Buffer] = {}
+        if cache is None:
+            cache = {}
 
         if isinstance(s, SeqStmt):
             s.stmts = self.rewrite_stmts(list(s.stmts), par_ids)
@@ -216,14 +240,19 @@ class _Stager:
             return pre + [s]
         if isinstance(s, ForNest):
             if s.kind in ("parallel", "vectorized"):
+                # a nest with a non-static extent cannot be staged: its
+                # loop vars would leak into hoisted window bases as
+                # unbound remainders — decline (guarded mode stages
+                # nothing and keeps the loud codegen errors)
+                dyn = any(as_int(e) is None for e in s.extents)
                 inner = dict(par_ids)
-                for v, e in zip(s.loop_vars, s.extents):
-                    ev = as_int(e)
-                    if ev is not None:
-                        inner[id(v)] = ev
+                if not dyn:
+                    for v, e in zip(s.loop_vars, s.extents):
+                        inner[id(v)] = as_int(e)
                 body_pre, body_post = [], []
                 s.body.stmts = self._rewrite_par_body(
-                    list(s.body.stmts), inner, body_pre, body_post)
+                    list(s.body.stmts), inner, body_pre, body_post,
+                    guarded=dyn)
                 # window copies are loop-invariant w.r.t. the nest: hoist
                 return body_pre + [s] + body_post
             s.body.stmts = self.rewrite_stmts(list(s.body.stmts), par_ids)
