@@ -299,12 +299,14 @@ def cfg_flash(D, S=2048, B=2, H=16, causal=True):
 
     ref_out = ref(q, k, v)
     check = functools.partial(_check_close, ref=ref_out, rel_tol=3e-2)
-    # Sweep block shapes (carver-style ladder; bigger blocks amortize the
-    # softmax VPU work against the MXU gemms). (512,512) at d=128 faults
-    # the TPU worker (VMEM overrun) — candidates stay within budget and
-    # every candidate is numerically cross-checked before it can win.
-    cands = [(512, 512), (256, 512), (256, 256)] if D <= 64 else \
-        [(256, 512), (256, 256), (128, 256)]
+    # Candidate ladder from the carver's roofline-ranked policy (its
+    # scoped-VMEM budget excludes the configs that fault the TPU worker,
+    # e.g. (512,512) at d=128); every candidate is still numerically
+    # cross-checked before it can win.
+    from tilelang_mesh_tpu.carver import FlashAttentionTemplate
+    hints = FlashAttentionTemplate(S, S, D, batch_heads=B * H,
+                                   causal=causal).hints(3)
+    cands = [(h.config["block_M"], h.config["block_N"]) for h in hints]
     _, kern_fn, _ = _pick_best(
         [(f"({bm},{bn})",
           lambda bm=bm, bn=bn: mha_fwd_kernel(

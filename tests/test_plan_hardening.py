@@ -435,3 +435,39 @@ def test_staging_dedups_identical_windows_across_statements():
     out = np.empty((M, N), np.float32)
     k(a, b, out)
     np.testing.assert_allclose(out, 2 * (a[M:] @ b), rtol=2e-2, atol=2e-2)
+
+
+def test_staged_window_cache_invalidated_by_parallel_store():
+    """A T.Parallel store to the any-mode param between two reads of the
+    same window must invalidate the staged-read cache (review repro: the
+    second gemm consumed the stale pre-write DMA)."""
+    M, K, N = 16, 128, 128
+
+    @T.prim_func
+    def rmw(A: T.Tensor((2 * M, K), "float32"),
+            B: T.Tensor((K, N), "float32"),
+            O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            Bs = T.alloc_shared((K, N), "float32")
+            C1 = T.alloc_fragment((M, N), "float32")
+            C2 = T.alloc_fragment((M, N), "float32")
+            T.copy(B, Bs)
+            for k in T.serial(2):
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, C1,
+                       clear_accum=True)
+                for i, j in T.Parallel(M, K):
+                    A[k * M + i, j] = 0.0
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, C2,
+                       clear_accum=True)
+            for i, j in T.Parallel(M, N):
+                C1[i, j] = C1[i, j] + C2[i, j]
+            T.copy(C1, O)
+
+    k = tilelang.compile(rmw)
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((2 * M, K)).astype(np.float32)
+    b = rng.standard_normal((K, N)).astype(np.float32)
+    out = np.empty((M, N), np.float32)
+    k(a.copy(), b, out)
+    # second gemm must see the zeroed window: out == A_1 @ B, not 2*A_1@B
+    np.testing.assert_allclose(out, a[M:] @ b, rtol=2e-2, atol=2e-2)

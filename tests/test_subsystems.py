@@ -189,6 +189,72 @@ class TestCarver:
         for h in hints:
             assert h.config["block_M"] <= 64
 
+    def test_roofline_policy_prefers_mxu_saturating_tiles(self):
+        """A 128x128-aligned tile must outrank an MXU-starved 8-wide tile
+        (round-3: cost-ranked policy vs the old heuristic order)."""
+        from tilelang_mesh_tpu.carver import Candidate, DefaultPolicy
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        pol = DefaultPolicy(TPU_V5E)
+        good = Candidate({"block_M": 256, "block_N": 256, "block_K": 512},
+                         flops=2.0 * 4096 ** 3, hbm_bytes=3 * 4096 ** 2 * 2,
+                         vmem_bytes=1 << 20, n_tiles=2048, utilization=1.0)
+        bad = Candidate({"block_M": 8, "block_N": 128, "block_K": 512},
+                        flops=2.0 * 4096 ** 3, hbm_bytes=3 * 4096 ** 2 * 2,
+                        vmem_bytes=1 << 16, n_tiles=512 * 32 * 8,
+                        utilization=8 / 128)
+        ranked = pol.rank([bad, good], topk=2)
+        assert ranked[0].config["block_M"] == 256
+        assert ranked[0].predicted_ms < ranked[1].predicted_ms
+
+    def test_conv_template_ranked_hints(self):
+        from tilelang_mesh_tpu.carver import Conv2DTemplate
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        t = Conv2DTemplate(8, 34, 34, 128, 256, 3, 3, arch=TPU_V5E)
+        hints = t.hints(5)
+        assert hints
+        oh, ow = t.out_hw
+        assert (oh, ow) == (32, 32)
+        M = 8 * oh * ow
+        for h in hints:
+            assert M % h.config["block_M"] == 0
+            assert 256 % h.config["block_N"] == 0
+            # per-tile VMEM within the scoped budget
+            assert h.predicted_ms > 0
+
+    def test_gemv_template_is_memory_bound(self):
+        """GEMV ranking must be driven by HBM streaming: predicted time
+        ~= bytes / bandwidth, far above the MXU flops time."""
+        from tilelang_mesh_tpu.carver import GEMVTemplate
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        hints = GEMVTemplate(8192, 8192, arch=TPU_V5E).hints(3)
+        assert hints
+        stream_ms = (8192 * 8192 * 2) / (TPU_V5E.hbm_gbps * 1e9) * 1e3
+        assert hints[0].predicted_ms >= 0.9 * stream_ms
+
+    def test_flash_template_scoped_vmem_budget(self):
+        """The configs that fault a real v5e ((512,512) at d=128) must
+        not be ranked; the measured winners must come first."""
+        from tilelang_mesh_tpu.carver import FlashAttentionTemplate
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        d64 = FlashAttentionTemplate(2048, 2048, 64, batch_heads=32,
+                                     causal=True, arch=TPU_V5E).hints(8)
+        assert d64[0].config == {"block_M": 512, "block_N": 512}
+        d128 = FlashAttentionTemplate(2048, 2048, 128, batch_heads=32,
+                                      causal=True, arch=TPU_V5E).hints(8)
+        assert d128[0].config == {"block_M": 256, "block_N": 512}
+        assert {"block_M": 512, "block_N": 512} not in \
+            [h.config for h in d128]
+
+    def test_general_reduce_template(self):
+        from tilelang_mesh_tpu.carver import GeneralReductionTemplate
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        hints = GeneralReductionTemplate((4096, 4096),
+                                         arch=TPU_V5E).hints(4)
+        assert hints
+        for h in hints:
+            assert 4096 % h.config["block_M"] == 0
+            assert 4096 % h.config["block_N"] == 0
+
 
 class TestParCompile:
     def test_par_compile_matches_serial(self):
@@ -212,3 +278,48 @@ class TestEnv:
         monkeypatch.setenv("TL_TPU_FORCE_INTERPRET", "1")
         from tilelang_mesh_tpu.env import env
         assert env.TL_TPU_FORCE_INTERPRET
+
+
+# ---------------------------------------------------------------------------
+# Mosaic-level introspection (round-3: reference show_ptx/show_sass analog,
+# /root/reference/tilelang/jit/kernel.py:657-734)
+# ---------------------------------------------------------------------------
+
+def _intro_kernel():
+    import tilelang_mesh_tpu.language as T
+
+    @T.prim_func
+    def dbl(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128),
+                                                          "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((8, 128), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(8, 128):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, O)
+    return dbl
+
+
+def test_mosaic_introspection_interpret_mode_raises_clearly():
+    import os
+    if os.environ.get("TL_TPU_TEST_DEVICE", "cpu") == "tpu":
+        pytest.skip("real-TPU path covered by test below")
+    k = tilelang.compile(_intro_kernel())
+    with pytest.raises(NotImplementedError, match="interpret mode"):
+        k.get_mosaic()
+
+
+def test_mosaic_introspection_on_tpu():
+    import os
+    if os.environ.get("TL_TPU_TEST_DEVICE", "cpu") != "tpu":
+        pytest.skip("needs real TPU")
+    import tilelang_mesh_tpu as tilelang
+    k = tilelang.compile(_intro_kernel())
+    mosaic = k.get_mosaic()
+    assert "mosaic" in mosaic and "vmem" in mosaic
+    hlo = k.get_compiled_hlo()
+    assert "tpu_custom_call" in hlo
+    mem = k.get_memory_analysis()
+    assert mem.generated_code_size_in_bytes > 0
+    cost = k.get_cost_analysis()
+    assert isinstance(cost, dict)

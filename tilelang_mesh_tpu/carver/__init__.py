@@ -2,4 +2,6 @@ from .arch import (TPUArch, TPU_V4, TPU_V5E, TPU_V5P, TPU_V6E, auto_arch,
                    TPUMeshArch)
 from .roller import (MatmulTemplate, FlashAttentionTemplate,
                      ElementwiseTemplate, GeneralReductionTemplate,
+                     Conv2DTemplate, GEMVTemplate,
+                     DefaultPolicy, Candidate,
                      recommend_hints, Hint)

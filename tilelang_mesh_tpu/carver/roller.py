@@ -1,29 +1,104 @@
 """Tile-config recommendation ("roller").
 
-Reference: /root/reference/tilelang/carver/roller/ (DefaultPolicy,
-TensorCorePolicy) + template/. Re-founded on TPU constraints: candidate
-tiles are multiples of the dtype's (sublane, lane) packing, scored by an
-arithmetic-intensity model against VMEM capacity — the same role
-TensorCorePolicy's smem/warp model plays for CUDA.
+Reference: /root/reference/tilelang/carver/roller/ (policy/default.py:19
+DefaultPolicy, policy/tensorcore.py TensorCorePolicy) + template/ (matmul,
+conv, gemv, general_reduce, elementwise, flashattention). Re-founded on
+TPU constraints: candidate tiles are multiples of the dtype's
+(sublane, lane) packing, bounded by VMEM capacity, and ranked by a
+ROOFLINE cost model (predicted total latency = per-tile
+max(MXU, VPU, HBM) time x tile count + per-grid-step overhead) against
+the arch model — the same role the reference's smem/warp cost policy
+plays for CUDA, with the analyzer's roofline (tools/analyzer.py) as the
+shared latency vocabulary.
 """
 
 from __future__ import annotations
 
-import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .arch import TPUArch, auto_arch
 from ..ir import dtype_bits
+from .arch import TPUArch, auto_arch
 
 
 @dataclass
 class Hint:
     config: Dict[str, int]
-    score: float
+    score: float          # higher = better (1 / predicted_ms)
+    predicted_ms: float = 0.0
 
     def __repr__(self):
-        return f"Hint({self.config}, score={self.score:.3g})"
+        return (f"Hint({self.config}, score={self.score:.3g}, "
+                f"~{self.predicted_ms:.4f} ms)")
+
+
+@dataclass
+class Candidate:
+    """One tiling choice, described in roofline vocabulary: total work,
+    total HBM traffic, per-tile VMEM footprint, tile count, and the
+    fraction of the MXU/VPU the tile shape keeps busy."""
+    config: Dict[str, int]
+    flops: float            # total useful FLOPs for the whole problem
+    hbm_bytes: float        # total HBM traffic
+    vpu_elems: float = 0.0  # total elementwise work (VPU) in elements
+    vmem_bytes: int = 0     # per-tile VMEM footprint
+    n_tiles: int = 1
+    utilization: float = 1.0  # MXU shape utilization of one tile
+
+
+# per-grid-step fixed overhead (dispatch + window bookkeeping); value in
+# seconds — small, but it is what separates equal-roofline candidates and
+# makes fewer/bigger tiles win, matching measurement
+_TILE_OVERHEAD_S = 1e-6
+_VPU_ELEMS_PER_S = 0.5e12   # ~VPU elementwise throughput (f32 elems/s)
+
+
+class DefaultPolicy:
+    """Roofline-ranked tile policy (reference DefaultPolicy analog).
+
+    Ranks a template's candidates by predicted latency:
+      t = max(flops / (peak * util), hbm_bytes / bw, vpu / vpu_rate)
+          + n_tiles * overhead
+    discarding candidates whose per-tile VMEM exceeds the budget. The
+    default budget models Mosaic's scoped-VMEM stack limit, measured on
+    v5e at ~0.42x of the arch VMEM figure (a 12.6 MB GEMM tile and a
+    7.2 MB flash tile both fault; 6.7 MB runs) — candidates above it
+    compile-fail on real chips, so ranking them wastes sweep slots.
+    Equal-roofline ties break toward squarer tiles, then a larger minor
+    (streaming) dim — the order measurement prefers.
+    """
+
+    def __init__(self, arch: Optional[TPUArch] = None,
+                 vmem_budget: float = 0.42):
+        self.arch = arch or auto_arch()
+        self.vmem_budget = vmem_budget
+
+    def predicted_ms(self, c: Candidate) -> float:
+        arch = self.arch
+        peak = arch.bf16_tflops * 1e12
+        t_mxu = c.flops / (peak * max(c.utilization, 1e-3))
+        t_hbm = c.hbm_bytes / (arch.hbm_gbps * 1e9)
+        t_vpu = c.vpu_elems / _VPU_ELEMS_PER_S
+        return (max(t_mxu, t_hbm, t_vpu)
+                + c.n_tiles * _TILE_OVERHEAD_S) * 1e3
+
+    def rank(self, candidates: List[Candidate],
+             topk: int = 10) -> List[Hint]:
+        budget = self.vmem_budget * self.arch.vmem_bytes
+        hints = []
+        for c in candidates:
+            if c.vmem_bytes > budget:
+                continue
+            ms = self.predicted_ms(c)
+            hints.append(Hint(c.config, 1.0 / max(ms, 1e-9), ms))
+
+        def key(h):
+            dims = [v for k, v in h.config.items() if k.startswith("block")]
+            return (round(h.predicted_ms, 7),
+                    -min(dims) if dims else 0,
+                    -dims[-1] if dims else 0)
+        hints.sort(key=key)
+        return hints[:topk]
 
 
 def _tile_candidates(dim: int, minimum: int, cap: int = 1024) -> List[int]:
@@ -46,35 +121,32 @@ class MatmulTemplate:
     accum_dtype: str = "float32"
     arch: Optional[TPUArch] = None
 
-    def hints(self, topk: int = 10) -> List[Hint]:
+    def candidates(self) -> List[Candidate]:
         arch = self.arch or auto_arch()
         sub, lane = arch.min_tile(self.in_dtype)
         ib = dtype_bits(self.in_dtype) // 8
         ab = dtype_bits(self.accum_dtype) // 8
-        cands = []
+        out = []
+        total_flops = 2.0 * self.M * self.N * self.K
         for bm in _tile_candidates(self.M, max(sub, 128), 1024):
             for bn in _tile_candidates(self.N, lane, 1024):
                 for bk in _tile_candidates(self.K, max(sub, 128), 2048):
-                    # VMEM: A tile + B tile (double-buffered by Mosaic) +
-                    # f32 accumulator
+                    # A streams once per N-block, B once per M-block
+                    n_m, n_n = self.M // bm, self.N // bn
+                    hbm = (self.M * self.K * n_n * ib
+                           + self.K * self.N * n_m * ib
+                           + self.M * self.N * ab)
                     vmem = 2 * (bm * bk + bk * bn) * ib + bm * bn * ab
-                    if vmem > 0.9 * arch.vmem_bytes:
-                        continue
-                    # score: arithmetic intensity x MXU utilization
-                    flops = 2 * bm * bn * bk
-                    bytes_moved = (bm * bk + bk * bn) * ib
-                    intensity = flops / bytes_moved
-                    mxu_util = min(bm / arch.mxu_shape[0], 1.0) * \
+                    util = min(bm / arch.mxu_shape[0], 1.0) * \
                         min(bn / arch.mxu_shape[1], 1.0)
-                    # prefer larger K tiles (fewer grid steps, less accum
-                    # traffic) but cap the benefit
-                    k_bonus = min(bk / 512, 1.0)
-                    score = intensity * mxu_util * (0.5 + 0.5 * k_bonus)
-                    cands.append(Hint(
+                    out.append(Candidate(
                         {"block_M": bm, "block_N": bn, "block_K": bk},
-                        score))
-        cands.sort(key=lambda h: -h.score)
-        return cands[:topk]
+                        total_flops, hbm, 0.0, vmem,
+                        n_m * n_n * (self.K // bk), util))
+        return out
+
+    def hints(self, topk: int = 10) -> List[Hint]:
+        return DefaultPolicy(self.arch).rank(self.candidates(), topk)
 
 
 @dataclass
@@ -83,26 +155,138 @@ class FlashAttentionTemplate:
     seq_k: int
     head_dim: int
     dtype: str = "bfloat16"
+    batch_heads: int = 1
+    causal: bool = False
     arch: Optional[TPUArch] = None
 
-    def hints(self, topk: int = 8) -> List[Hint]:
+    # Mosaic's scoped-VMEM stack bounds one kernel instance well below
+    # the chip's VMEM: the softmax pipeline materializes several f32
+    # score-shaped temporaries (logits/exp/p + relayouts), modeled as
+    # 6x bm*bn*4, and the measured fault boundary on v5e sits near
+    # 0.42x of chip VMEM ((512,512) d=64 runs; (512,512) d=128 faults).
+    _SCORE_TEMPS = 6
+    _SCOPED_BUDGET = 0.42
+
+    def candidates(self) -> List[Candidate]:
         arch = self.arch or auto_arch()
         ib = dtype_bits(self.dtype) // 8
-        cands = []
+        D = self.head_dim
+        frac = 0.5 if self.causal else 1.0
+        total_flops = 4.0 * self.batch_heads * self.seq_q * self.seq_k \
+            * D * frac
+        out = []
         for bm in _tile_candidates(self.seq_q, 128, 1024):
             for bn in _tile_candidates(self.seq_k, 128, 1024):
-                vmem = (bm * self.head_dim * ib          # Q tile
-                        + 2 * 2 * bn * self.head_dim * ib  # K,V double-buf
-                        + bm * bn * 4                     # scores f32
-                        + bm * self.head_dim * 4          # acc f32
-                        + 4 * bm * 4)                     # stats rows
-                if vmem > 0.9 * arch.vmem_bytes:
-                    continue
-                score = min(bm / 256, 1.0) * min(bn / 512, 1.0) + \
-                    0.1 * (bm * bn) / (1024 * 1024)
-                cands.append(Hint({"block_M": bm, "block_N": bn}, score))
-        cands.sort(key=lambda h: -h.score)
-        return cands[:topk]
+                n_q = self.seq_q // bm
+                n_k = max(1, int(self.seq_k // bn * frac))
+                vmem = (bm * D * ib
+                        + 2 * 2 * bn * D * ib
+                        + self._SCORE_TEMPS * bm * bn * 4
+                        + bm * D * 4
+                        + 4 * bm * 4)
+                hbm = self.batch_heads * (
+                    self.seq_q * D * ib                 # Q once
+                    + 2 * self.seq_k * D * ib * n_q * frac  # K,V per q-blk
+                    + self.seq_q * D * ib)              # out
+                vpu = self.batch_heads * self.seq_q * self.seq_k * frac * 8
+                util = min(bm / arch.mxu_shape[0], 1.0) * \
+                    min(bn / arch.mxu_shape[1], 1.0)
+                out.append(Candidate(
+                    {"block_M": bm, "block_N": bn},
+                    total_flops, hbm, vpu, vmem,
+                    self.batch_heads * n_q * n_k, util))
+        return out
+
+    def hints(self, topk: int = 8) -> List[Hint]:
+        pol = DefaultPolicy(self.arch, vmem_budget=self._SCOPED_BUDGET)
+        return pol.rank(self.candidates(), topk)
+
+
+@dataclass
+class Conv2DTemplate:
+    """NHWC conv as implicit GEMM: (N*OH*OW, KH*KW*C) x (KH*KW*C, F)
+    (reference carver/template/conv.py). Tiles the GEMM view; the kernel
+    realizes it with c2d_im2col windows."""
+    N: int
+    H: int
+    W: int
+    C: int
+    F: int
+    KH: int = 3
+    KW: int = 3
+    stride: int = 1
+    in_dtype: str = "bfloat16"
+    accum_dtype: str = "float32"
+    arch: Optional[TPUArch] = None
+
+    @property
+    def out_hw(self) -> Tuple[int, int]:
+        return ((self.H - self.KH) // self.stride + 1,
+                (self.W - self.KW) // self.stride + 1)
+
+    def candidates(self) -> List[Candidate]:
+        arch = self.arch or auto_arch()
+        oh, ow = self.out_hw
+        M = self.N * oh * ow
+        K = self.KH * self.KW * self.C
+        Nn = self.F
+        ib = dtype_bits(self.in_dtype) // 8
+        ab = dtype_bits(self.accum_dtype) // 8
+        total_flops = 2.0 * M * Nn * K
+        out = []
+        for bm in _tile_candidates(M, 128, 1024):
+            for bn in _tile_candidates(Nn, 128, 512):
+                for bk in _tile_candidates(K, min(K, 128), 2048):
+                    n_m, n_n = M // bm, Nn // bn
+                    # im2col reads overlap: each input elem read ~KH*KW
+                    # times unless cached; weights stream per m-block
+                    hbm = (self.N * self.H * self.W * self.C * ib
+                           * self.KH * self.KW / max(self.stride ** 2, 1)
+                           + K * Nn * n_m * ib + M * Nn * ab)
+                    vmem = 2 * (bm * bk + bk * bn) * ib + bm * bn * ab
+                    util = min(bm / arch.mxu_shape[0], 1.0) * \
+                        min(bn / arch.mxu_shape[1], 1.0)
+                    out.append(Candidate(
+                        {"block_M": bm, "block_N": bn, "block_K": bk},
+                        total_flops, hbm, 0.0, vmem,
+                        n_m * n_n * max(1, K // bk), util))
+        return out
+
+    def hints(self, topk: int = 10) -> List[Hint]:
+        return DefaultPolicy(self.arch).rank(self.candidates(), topk)
+
+
+@dataclass
+class GEMVTemplate:
+    """y = A @ x, memory-bound (reference carver/template/gemv.py). The
+    MXU is idle; tiles are ranked purely by HBM streaming efficiency and
+    VPU occupancy."""
+    M: int
+    K: int
+    in_dtype: str = "bfloat16"
+    arch: Optional[TPUArch] = None
+
+    def candidates(self) -> List[Candidate]:
+        arch = self.arch or auto_arch()
+        sub, lane = arch.min_tile(self.in_dtype)
+        ib = dtype_bits(self.in_dtype) // 8
+        out = []
+        for bm in _tile_candidates(self.M, sub, 2048):
+            for bk in _tile_candidates(self.K, lane, 4096):
+                hbm = self.M * self.K * ib + self.K * ib * (self.M // bm) \
+                    + self.M * 4
+                vmem = 2 * (bm * bk + bk) * ib + bm * 4
+                out.append(Candidate(
+                    {"block_M": bm, "block_K": bk},
+                    2.0 * self.M * self.K, hbm,
+                    vpu_elems=1.0 * self.M * self.K,
+                    vmem_bytes=vmem,
+                    n_tiles=(self.M // bm) * (self.K // bk),
+                    utilization=1.0))
+        return out
+
+    def hints(self, topk: int = 8) -> List[Hint]:
+        return DefaultPolicy(self.arch).rank(self.candidates(), topk)
 
 
 @dataclass
@@ -110,27 +294,68 @@ class ElementwiseTemplate:
     shape: Tuple[int, ...]
     dtype: str = "float32"
     arch: Optional[TPUArch] = None
+    ops_per_elem: float = 1.0
 
-    def hints(self, topk: int = 6) -> List[Hint]:
+    def _rows_cols(self):
+        rows = 1
+        for s in self.shape[:-1]:
+            rows *= s
+        return rows, self.shape[-1]
+
+    def candidates(self) -> List[Candidate]:
         arch = self.arch or auto_arch()
-        rows = self.shape[-2] if len(self.shape) >= 2 else 1
-        cols = self.shape[-1]
+        rows, cols = self._rows_cols()
         sub, lane = arch.min_tile(self.dtype)
-        cands = []
+        b = dtype_bits(self.dtype) // 8
+        out = []
         for bm in _tile_candidates(rows, sub, 2048):
             for bn in _tile_candidates(cols, lane, 4096):
-                n = bm * bn * dtype_bits(self.dtype) // 8
-                if n > 0.45 * arch.vmem_bytes:
-                    continue
-                cands.append(Hint({"block_M": bm, "block_N": bn},
-                                  float(n)))
-        cands.sort(key=lambda h: -h.score)
-        return cands[:topk]
+                out.append(Candidate(
+                    {"block_M": bm, "block_N": bn},
+                    0.0, 2.0 * rows * cols * b,
+                    vpu_elems=self.ops_per_elem * rows * cols,
+                    vmem_bytes=2 * bm * bn * b,
+                    n_tiles=(rows // bm) * (cols // bn)))
+        return out
+
+    def hints(self, topk: int = 6) -> List[Hint]:
+        return DefaultPolicy(self.arch, vmem_budget=0.45).rank(
+            self.candidates(), topk)
 
 
 @dataclass
-class GeneralReductionTemplate(ElementwiseTemplate):
-    pass
+class GeneralReductionTemplate:
+    """Row/column reductions (reference carver/template/general_reduce.py):
+    tile the kept axis to VPU sublanes, stream the reduced axis."""
+    shape: Tuple[int, ...]
+    reduce_dim: int = -1
+    dtype: str = "float32"
+    arch: Optional[TPUArch] = None
+
+    def candidates(self) -> List[Candidate]:
+        arch = self.arch or auto_arch()
+        rows = 1
+        for s in self.shape[:-1]:
+            rows *= s
+        cols = self.shape[-1]
+        sub, lane = arch.min_tile(self.dtype)
+        b = dtype_bits(self.dtype) // 8
+        red_last = self.reduce_dim in (-1, len(self.shape) - 1)
+        out = []
+        for bm in _tile_candidates(rows, sub, 2048):
+            for bn in _tile_candidates(cols, lane, 4096):
+                kept = rows if red_last else cols
+                out.append(Candidate(
+                    {"block_M": bm, "block_N": bn},
+                    0.0, (rows * cols + kept) * b,
+                    vpu_elems=1.0 * rows * cols,
+                    vmem_bytes=2 * bm * bn * b + (bm if red_last else bn) * 4,
+                    n_tiles=(rows // bm) * (cols // bn)))
+        return out
+
+    def hints(self, topk: int = 6) -> List[Hint]:
+        return DefaultPolicy(self.arch, vmem_budget=0.45).rank(
+            self.candidates(), topk)
 
 
 def recommend_hints(template, topk: int = 10) -> List[Hint]:

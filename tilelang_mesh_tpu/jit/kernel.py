@@ -116,9 +116,93 @@ class JITKernel:
         return str(jax.make_jaxpr(self._raw_call)(*ins))
 
     def get_lowered_hlo(self) -> str:
-        """StableHLO text of the whole kernel (the SASS analog)."""
-        ins = self._example_inputs()
-        return self.func.lower(*ins).as_text()
+        """Pre-optimization StableHLO text of the jitted wrapper."""
+        return self._lowered().as_text()
+
+    def _lowered(self):
+        if getattr(self, "_lowered_cache", None) is None:
+            self._lowered_cache = self.func.lower(*self._example_inputs())
+        return self._lowered_cache
+
+    def _compiled(self):
+        if getattr(self, "_compiled_cache", None) is None:
+            self._compiled_cache = self._lowered().compile()
+        return self._compiled_cache
+
+    # -- Mosaic/TPU-level artifacts (reference show_ptx/show_sass,
+    #    kernel.py:657-734) --------------------------------------------------
+    def get_mosaic(self) -> str:
+        """The Mosaic MLIR module(s) the kernel actually runs on the TPU —
+        the artifact-level analog of the reference's show_ptx. Extracted
+        from the tpu_custom_call payload (base64 MLIR bytecode) of the
+        lowered module; pre-Mosaic HLO (get_lowered_hlo) stops above this
+        level and is useless for perf debugging the kernel body."""
+        mods = self._mosaic_modules()
+        if not mods:
+            raise NotImplementedError(
+                "no Mosaic module in the lowered program: the kernel is "
+                "running in interpret mode (CPU) or contains no "
+                "pallas_call; compile for a real TPU target to inspect "
+                "Mosaic IR")
+        return "\n".join(f"// ==== mosaic module {i}: @{name} ====\n{text}"
+                         for i, (name, text) in enumerate(mods))
+
+    def _mosaic_modules(self):
+        import base64
+        import json
+        from jax._src.lib.mlir import ir
+        mod = self._lowered().compiler_ir()
+        calls = []
+
+        def walk(op):
+            for r in op.regions:
+                for b in r.blocks:
+                    for o in b.operations:
+                        if "custom_call" in o.operation.name:
+                            calls.append(o)
+                        walk(o.operation)
+        walk(mod.operation)
+        out = []
+        for o in calls:
+            attrs = o.attributes
+            cfg = None
+            for key in ("mhlo.backend_config", "backend_config"):
+                if key in attrs:
+                    cfg = ir.StringAttr(attrs[key]).value
+                    break
+            if not cfg:
+                continue
+            try:
+                body = json.loads(cfg)["custom_call_config"]["body"]
+            except (ValueError, KeyError, TypeError):
+                continue
+            ctx = ir.Context()
+            ctx.allow_unregistered_dialects = True
+            m = ir.Module.parse(base64.b64decode(body), ctx)
+            name = "kernel"
+            try:
+                name = ir.StringAttr(
+                    m.operation.attributes["sym_name"]).value
+            except (KeyError, ValueError):
+                pass
+            out.append((name, str(m)))
+        return out
+
+    def get_compiled_hlo(self) -> str:
+        """Post-optimization, scheduled HLO with chosen layouts (e.g.
+        f32[8,128]{1,0:T(8,128)}) — what XLA actually executes around the
+        Mosaic kernel. Requires a real backend (compiles the kernel)."""
+        return self._compiled().as_text()
+
+    def get_memory_analysis(self):
+        """XLA's CompiledMemoryStats for the compiled kernel (generated
+        code size, argument/output/temp bytes)."""
+        return self._compiled().memory_analysis()
+
+    def get_cost_analysis(self) -> dict:
+        """XLA's cost analysis (FLOPs, bytes accessed) for the compiled
+        kernel."""
+        return dict(self._compiled().cost_analysis() or {})
 
     def _example_inputs(self):
         import jax

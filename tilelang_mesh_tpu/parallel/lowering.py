@@ -335,6 +335,15 @@ def _schedule_steps(kind: str, nrow: int, ncol: int, direction: int,
         nrow, ncol, direction)
 
 
+def _schedule_hops(steps, nrow: int, ncol: int) -> int:
+    """Hop cost of a step schedule (native with python fallback, the same
+    probing rule as _schedule_steps)."""
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+    h = lnat.schedule_hops(steps, nrow, ncol)
+    return h if h is not None else lpy.schedule_hops(steps, nrow, ncol)
+
+
 def _xla_lowering_desc(c: CommStmt, nrow: int, ncol: int) -> str:
     """One line naming the XLA collective _apply_comm emits for this op —
     kept in lockstep with _apply_comm so the golden schedule text IS the
@@ -385,14 +394,10 @@ def _comm_schedule_lines(c: CommStmt, nrow: int, ncol: int) -> list:
         lines.append(f"        noc[0]: put core({sr}, {sc}) -> "
                      f"core({dr}, {dc}) hops={hops}")
     if steps is not None:
-        from ..layout import native as lnat
-        from ..layout import python_impl as lpy
         for j, (r, cc, d, chunk) in enumerate(steps):
             lines.append(f"        noc[{j}]: bcast core({r}, {cc}) "
                          f"dir={dirname[d]} chunk={chunk}")
-        hops = lnat.schedule_hops(steps, nrow, ncol)
-        if hops is None:
-            hops = lpy.schedule_hops(steps, nrow, ncol)
+        hops = _schedule_hops(steps, nrow, ncol)
         lines.append(f"        cost: {len(steps)} steps, {hops} hops")
     lines.append(f"        {_xla_lowering_desc(c, nrow, ncol)}")
     return lines

@@ -534,9 +534,7 @@ def _expr_map_revisit_check(grid: List[GridAxis], p: ParamPlan) -> None:
     # every axis that steps between the two visits, or Mosaic's parallel
     # dimension semantics could reorder the two writes apart
     prev_point, prev_key = None, None
-    import itertools as _it
-    for point in _it.product(*[range(e) for e in extents]):
-        key = keys[point]
+    for point, key in keys.items():   # insertion order == grid order
         if prev_key is not None and key == prev_key:
             for i in range(len(extents)):
                 if point[i] != prev_point[i]:

@@ -215,8 +215,12 @@ class _Stager:
         # and one DMA; invalidated by any write to an any-mode param
         cache: Dict[str, Buffer] = {}
         for s in stmts:
+            # decide BEFORE rewriting: the rewrite replaces any-param
+            # writes with staged-buffer stores (flushes hoisted outside
+            # s), which would hide the write from the scan
+            invalidate = self._writes_any_param(s)
             out.extend(self.rewrite_stmt(s, par_ids, cache))
-            if self._writes_any_param(s):
+            if invalidate:
                 cache.clear()
         return out
 
