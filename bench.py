@@ -550,9 +550,8 @@ def cfg_moe_grouped(E=8, M=512, K=2048, N=2048):
     _, ours, _ = _pick_best(
         [(str(c),
           lambda c=c: (lambda x_, w_: grouped_matmul(
-              x_, w_, block_M=min(c["block_M"], M),
-              block_N=min(c["block_N"], N),
-              block_K=min(c["block_K"], K))),
+              x_, w_, block_M=c["block_M"], block_N=c["block_N"],
+              block_K=c["block_K"])),
           (x, w)) for c in cfgs],
         check, "moe grouped")
 

@@ -245,7 +245,7 @@ void tl_blockwise_zz_owners(int32_t rows, int32_t cols,
   }
 }
 
-int32_t tl_native_abi_version() { return 2; }
+int32_t tl_native_abi_version() { return 3; }
 
 }  // extern "C"
 
@@ -413,6 +413,88 @@ int32_t tl_streamk_partition(int32_t n_tiles, int32_t k_iters,
     }
   }
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// Expression grid evaluation (native pass engine piece; extends the
+// tl_affine_linearize node-program format with the non-affine ops the
+// planner's modular index maps use). Evaluates a node program at EVERY
+// point of an n-d grid in row-major order (last axis fastest — the Pallas
+// grid iteration order) — the hot loop of the output-revisit legality
+// check (transform/plan.py::_expr_map_revisit_check), which enumerates up
+// to 2^16 grid points per output param.
+//
+// opcodes: 0=const(a) 1=var(slot a, a grid axis) 2=add 3=sub 4=mul
+//          5=floordiv 6=floormod 7=min 8=max  (a/b = operand node ids)
+// Division follows python floor semantics (negative intermediates, e.g.
+// bx - by, round toward -inf). Returns 1 ok, 0 on bad program / div0.
+// ---------------------------------------------------------------------------
+
+static inline int64_t tl_floordiv_(int64_t x, int64_t y) {
+  int64_t q = x / y;
+  if ((x % y != 0) && ((x < 0) != (y < 0))) --q;
+  return q;
+}
+
+int32_t tl_expr_eval_grid(const int32_t* op, const int64_t* a,
+                          const int64_t* b, int32_t n_nodes,
+                          const int64_t* extents, int32_t n_axes,
+                          int64_t* out) {
+  if (n_nodes <= 0 || n_axes <= 0) return 0;
+  // validate program shape once
+  for (int32_t i = 0; i < n_nodes; ++i) {
+    if (op[i] == 0) continue;
+    if (op[i] == 1) {
+      if (a[i] < 0 || a[i] >= n_axes) return 0;
+      continue;
+    }
+    if (op[i] < 2 || op[i] > 8) return 0;
+    if (a[i] < 0 || a[i] >= i || b[i] < 0 || b[i] >= i) return 0;
+  }
+  int64_t total = 1;
+  for (int32_t d = 0; d < n_axes; ++d) {
+    if (extents[d] <= 0) return 0;
+    total *= extents[d];
+  }
+  std::vector<int64_t> point(n_axes, 0);
+  std::vector<int64_t> val(n_nodes);
+  for (int64_t step = 0; step < total; ++step) {
+    for (int32_t i = 0; i < n_nodes; ++i) {
+      switch (op[i]) {
+        case 0: val[i] = a[i]; break;
+        case 1: val[i] = point[a[i]]; break;
+        case 2:
+          if (__builtin_add_overflow(val[a[i]], val[b[i]], &val[i]))
+            return 0;
+          break;
+        case 3:
+          if (__builtin_sub_overflow(val[a[i]], val[b[i]], &val[i]))
+            return 0;
+          break;
+        case 4:
+          if (__builtin_mul_overflow(val[a[i]], val[b[i]], &val[i]))
+            return 0;
+          break;
+        case 5:
+          if (val[b[i]] == 0) return 0;
+          val[i] = tl_floordiv_(val[a[i]], val[b[i]]);
+          break;
+        case 6:
+          if (val[b[i]] == 0) return 0;
+          val[i] = val[a[i]] - tl_floordiv_(val[a[i]], val[b[i]]) * val[b[i]];
+          break;
+        case 7: val[i] = val[a[i]] < val[b[i]] ? val[a[i]] : val[b[i]]; break;
+        case 8: val[i] = val[a[i]] > val[b[i]] ? val[a[i]] : val[b[i]]; break;
+      }
+    }
+    out[step] = val[n_nodes - 1];
+    // advance row-major point, last axis fastest
+    for (int32_t d = n_axes - 1; d >= 0; --d) {
+      if (++point[d] < extents[d]) break;
+      point[d] = 0;
+    }
+  }
+  return 1;
 }
 
 }  // extern "C" (second block)

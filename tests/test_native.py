@@ -180,3 +180,80 @@ def test_affine_linearize_native_parity():
     # non-affine -> None through both paths
     assert linearize(x * y, [x, y]) is None
     assert linearize((x * 3 + 1) // 2, [x, y]) is None
+
+
+# ---------------------------------------------------------------------------
+# expression grid evaluation (round-3: tl_expr_eval_grid)
+# ---------------------------------------------------------------------------
+
+def _rand_program(rng, n_axes):
+    """Random valid node program over the eval opcode set."""
+    ops, a, b = [], [], []
+    n = rng.integers(3, 14)
+    for i in range(n):
+        if i < 2 or rng.random() < 0.3:
+            if rng.random() < 0.5:
+                ops.append(0)
+                a.append(int(rng.integers(-7, 17)) or 3)
+                b.append(0)
+            else:
+                ops.append(1)
+                a.append(int(rng.integers(0, n_axes)))
+                b.append(0)
+        else:
+            ops.append(int(rng.integers(2, 9)))
+            a.append(int(rng.integers(0, i)))
+            b.append(int(rng.integers(0, i)))
+    return ops, a, b
+
+
+def test_expr_eval_grid_native_python_parity():
+    from tilelang_mesh_tpu.layout import native as lnat
+    from tilelang_mesh_tpu.layout import python_impl as lpy
+    if not lnat.available():
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(0)
+    checked = 0
+    for _ in range(60):
+        extents = tuple(int(rng.integers(1, 5)) for _ in range(2))
+        ops, a, b = _rand_program(rng, len(extents))
+        gn = lnat.expr_eval_grid(ops, a, b, extents)
+        gp = lpy.expr_eval_grid(ops, a, b, extents)
+        assert (gn is None) == (gp is None), (ops, a, b)
+        if gn is not None:
+            assert gn == gp, (ops, a, b)
+            checked += 1
+    assert checked > 20  # the generator must produce mostly-valid programs
+
+
+def test_expr_eval_grid_matches_ir_eval():
+    """The encoded program must agree with the tree interpreter the plan
+    falls back to (_eval_expr) for a modular map."""
+    from tilelang_mesh_tpu.ir import Var
+    from tilelang_mesh_tpu.ir.expr import encode_expr
+    from tilelang_mesh_tpu.layout import python_impl as lpy
+    from tilelang_mesh_tpu.transform.plan import _eval_expr
+    bx, by = Var("bx", "int32"), Var("by", "int32")
+    e = ((bx + by * 3) % 4) * 2 + (bx // 2)
+    enc = encode_expr(e, {id(bx): 0, id(by): 1})
+    assert enc is not None
+    vals = lpy.expr_eval_grid(*enc, (4, 3))
+    import itertools
+    want = [_eval_expr(e, {id(bx): x, id(by): y})
+            for x, y in itertools.product(range(4), range(3))]
+    assert vals == want
+
+
+def test_expr_eval_grid_floor_semantics():
+    """Negative intermediates must use python floor division, not C
+    truncation."""
+    from tilelang_mesh_tpu.layout import native as lnat
+    from tilelang_mesh_tpu.layout import python_impl as lpy
+    # (x0 - 3) // 2 over x0 in 0..5 -> [-2, -1, -1, 0, 0, 1]
+    ops = [1, 0, 3, 0, 5]
+    a = [0, 3, 0, 2, 2]
+    b = [0, 0, 1, 0, 3]
+    want = [(x - 3) // 2 for x in range(6)]
+    assert lpy.expr_eval_grid(ops, a, b, (6,)) == want
+    if lnat.available():
+        assert lnat.expr_eval_grid(ops, a, b, (6,)) == want

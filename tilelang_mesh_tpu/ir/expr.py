@@ -500,19 +500,24 @@ def rebuild_affine(coeffs, const) -> PrimExpr:
 
 
 _AFFINE_OPS = {"+": 2, "-": 3, "*": 4, "//": 5}
+_EVAL_OPS = {"+": 2, "-": 3, "*": 4, "//": 5, "%": 6, "min": 7, "max": 8}
 
 
-def _encode_affine(expr, slot_of):
-    """Flatten an expr tree to the postfix arrays tl_affine_linearize
-    consumes; returns (ops, a, b) or None when a node falls outside the
-    affine grammar (same rejections as the python path)."""
+def _encode(expr, slot_of, op_table, cast_transparent):
+    """Shared tree -> node-program flattener behind encode_expr (eval
+    grammar) and _encode_affine (affine grammar). One walker so the two
+    paths cannot diverge; the op table and Cast handling are the only
+    degrees of freedom."""
     ops, aa, bb = [], [], []
 
     def go(e):
         e = convert(e)
-        if isinstance(e, IntImm):
+        if cast_transparent and isinstance(e, Cast):
+            return go(e.value)
+        if isinstance(e, IntImm) or (cast_transparent and
+                                     isinstance(e, BoolImm)):
             ops.append(0)
-            aa.append(e.value)
+            aa.append(int(e.value))
             bb.append(0)
             return len(ops) - 1
         if isinstance(e, Var):
@@ -523,20 +528,35 @@ def _encode_affine(expr, slot_of):
             aa.append(s)
             bb.append(0)
             return len(ops) - 1
-        if isinstance(e, BinOp) and e.op in _AFFINE_OPS:
+        if isinstance(e, BinOp) and e.op in op_table:
             x = go(e.a)
             if x is None:
                 return None
             y = go(e.b)
             if y is None:
                 return None
-            ops.append(_AFFINE_OPS[e.op])
+            ops.append(op_table[e.op])
             aa.append(x)
             bb.append(y)
             return len(ops) - 1
         return None
 
     return (ops, aa, bb) if go(expr) is not None else None
+
+
+def encode_expr(expr, slot_of):
+    """Flatten an expr tree to the node program tl_expr_eval_grid
+    consumes (superset of the affine grammar: adds %, min, max; Casts are
+    transparent). Returns (ops, a, b) or None."""
+    return _encode(expr, slot_of, _EVAL_OPS, cast_transparent=True)
+
+
+def _encode_affine(expr, slot_of):
+    """Flatten an expr tree to the postfix arrays tl_affine_linearize
+    consumes; returns (ops, a, b) or None when a node falls outside the
+    affine grammar (same rejections as the python linearize path — Casts
+    included, so the native/python None decisions stay identical)."""
+    return _encode(expr, slot_of, _AFFINE_OPS, cast_transparent=False)
 
 
 def linearize(expr: PrimExpr, wrt: Sequence[Var]):

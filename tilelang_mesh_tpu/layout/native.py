@@ -42,10 +42,30 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if not _LIB_PATH.exists() and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(str(_LIB_PATH))
-        except OSError:
-            return None
+        lib = _open_checked()
+        if lib is None:
+            # stale prebuilt .so (old ABI / missing symbols): rebuild once
+            # rather than crash past the pure-python fallback guarantee.
+            # Unlink first — make is mtime-based and a stale .so newer
+            # than the source would no-op the rebuild.
+            try:
+                _LIB_PATH.unlink()
+            except OSError:
+                pass
+            if not _build():
+                return None
+            # dlopen caches by pathname: re-opening the same path returns
+            # the stale mapping even after the file was replaced. Load the
+            # fresh build through a unique temp path instead.
+            import shutil
+            import tempfile
+            tmp = tempfile.NamedTemporaryFile(prefix="libtltpu-",
+                                              suffix=".so", delete=False)
+            tmp.close()
+            shutil.copy2(_LIB_PATH, tmp.name)
+            lib = _open_checked(tmp.name)
+            if lib is None:
+                return None
         i64p = ctypes.POINTER(ctypes.c_int64)
         i32p = ctypes.POINTER(ctypes.c_int32)
         lib.tl_layout_offset.restype = ctypes.c_int64
@@ -74,6 +94,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tl_vmem_pack.restype = ctypes.c_int64
         lib.tl_vmem_pack.argtypes = [i64p, i32p, i32p, ctypes.c_int32,
                                      ctypes.c_int64, i64p]
+        lib.tl_expr_eval_grid.restype = ctypes.c_int32
+        lib.tl_expr_eval_grid.argtypes = [i32p, i64p, i64p, ctypes.c_int32,
+                                          i64p, ctypes.c_int32, i64p]
         lib.tl_affine_linearize.restype = ctypes.c_int32
         lib.tl_affine_linearize.argtypes = [i32p, i64p, i64p,
                                             ctypes.c_int32, ctypes.c_int32,
@@ -83,11 +106,24 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tl_streamk_partition.argtypes = [ctypes.c_int32, ctypes.c_int32,
                                              ctypes.c_int32, i32p, i32p,
                                              i32p]
-        lib.tl_native_abi_version.restype = ctypes.c_int32
-        if lib.tl_native_abi_version() != 2:
-            return None
         _lib = lib
         return _lib
+
+
+_ABI_VERSION = 3
+
+
+def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    """dlopen + ABI gate BEFORE any symbol binding: a stale library must
+    fall back (or trigger a rebuild), never AttributeError mid-binding."""
+    try:
+        lib = ctypes.CDLL(str(path or _LIB_PATH))
+        lib.tl_native_abi_version.restype = ctypes.c_int32
+        if lib.tl_native_abi_version() != _ABI_VERSION:
+            return None
+        return lib
+    except (OSError, AttributeError):
+        return None
 
 
 def available() -> bool:
@@ -223,6 +259,25 @@ def affine_linearize(ops: Sequence[int], a: Sequence[int],
     if rc != 1:
         return None
     return list(coeffs)[:n_vars], int(const.value)
+
+
+def expr_eval_grid(ops: Sequence[int], a: Sequence[int], b: Sequence[int],
+                   extents: Sequence[int]) -> Optional[List[int]]:
+    """Evaluate an encoded expr program at every grid point (row-major,
+    last axis fastest). None when the native lib is absent or the program
+    is rejected."""
+    lib = load()
+    if lib is None:
+        return None
+    total = 1
+    for e in extents:
+        total *= int(e)
+    out = (ctypes.c_int64 * max(total, 1))()
+    rc = lib.tl_expr_eval_grid(_arr32(ops), _arr64(a), _arr64(b), len(ops),
+                               _arr64(extents), len(extents), out)
+    if rc != 1:
+        return None
+    return list(out)[:total]
 
 
 def streamk_partition(n_tiles: int, k_iters: int,

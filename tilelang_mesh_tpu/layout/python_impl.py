@@ -173,3 +173,56 @@ def streamk_partition(n_tiles, k_iters, n_programs):
             segs.append((tile, k0, klen))
             s += klen
     return segs
+
+
+def expr_eval_grid(ops, a, b, extents):
+    """Python mirror of tl_expr_eval_grid (parity: tests/test_native.py).
+    opcodes: 0=const 1=var(axis slot) 2=+ 3=- 4=* 5=// 6=% 7=min 8=max."""
+    import itertools
+    n = len(ops)
+    if n == 0 or not extents or any(e <= 0 for e in extents):
+        return None  # native rejects these shapes; keep parity
+    for i in range(n):
+        if ops[i] == 0:
+            continue
+        if ops[i] == 1:
+            if not (0 <= a[i] < len(extents)):
+                return None
+            continue
+        if not (2 <= ops[i] <= 8):
+            return None
+        if not (0 <= a[i] < i and 0 <= b[i] < i):
+            return None
+    out = []
+    val = [0] * n
+    for point in itertools.product(*[range(e) for e in extents]):
+        for i in range(n):
+            o = ops[i]
+            if o == 0:
+                val[i] = a[i]
+            elif o == 1:
+                val[i] = point[a[i]]
+            else:
+                x, y = val[a[i]], val[b[i]]
+                if o == 2:
+                    val[i] = x + y
+                elif o == 3:
+                    val[i] = x - y
+                elif o == 4:
+                    val[i] = x * y
+                elif o == 5:
+                    if y == 0:
+                        return None
+                    val[i] = x // y
+                elif o == 6:
+                    if y == 0:
+                        return None
+                    val[i] = x % y
+                elif o == 7:
+                    val[i] = min(x, y)
+                else:
+                    val[i] = max(x, y)
+                if not (-(2 ** 63) <= val[i] < 2 ** 63):
+                    return None  # native rejects int64 overflow; parity
+        out.append(val[n - 1])
+    return out

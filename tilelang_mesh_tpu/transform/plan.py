@@ -491,34 +491,55 @@ def _expr_map_revisit_check(grid: List[GridAxis], p: ParamPlan) -> None:
             f"revisits; use an affine index map or a smaller grid")
         return
     env_vars = [a.var for a in grid]
+    slot_of = {id(v): i for i, v in enumerate(env_vars)}
+    import itertools
+    points = list(itertools.product(*[range(e) for e in extents]))
+
+    # per-dim block-index value arrays over the whole grid; expr dims go
+    # through the native expression engine (tl_expr_eval_grid, python
+    # mirror as fallback) — the hot loop of this check
+    dim_vals: List[List[int]] = []
+    for d in p.block_dims:
+        if d.expr is not None:
+            from ..ir.expr import encode_expr
+            from ..layout import native as lnat
+            from ..layout import python_impl as lpy
+            enc = encode_expr(d.expr, slot_of)
+            vals = None
+            if enc is not None:
+                vals = lnat.expr_eval_grid(enc[0], enc[1], enc[2], extents)
+                if vals is None:
+                    vals = lpy.expr_eval_grid(enc[0], enc[1], enc[2],
+                                              extents)
+            if vals is None:  # unencodable: per-point interpreter
+                vals = []
+                for point in points:
+                    env = {id(v): x for v, x in zip(env_vars, point)}
+                    ev = _eval_expr(d.expr, env)
+                    if ev is None:
+                        p.tpu_note = (
+                            f"output '{p.buffer.name}': its block index "
+                            f"map could not be evaluated for revisit "
+                            f"legality")
+                        return
+                    vals.append(ev)
+        else:
+            vals = [sum(pt[a] * c for a, c in d.terms) + d.const
+                    for pt in points]
+            if d.post_div != 1:
+                vals = [v // d.post_div for v in vals]
+        dim_vals.append(vals)
+
     keys: Dict[tuple, tuple] = {}   # grid point -> block tuple
     seen: Dict[tuple, int] = {}     # block tuple -> last step seen
     bad = False
-    step = 0
-    import itertools
-    for point in itertools.product(*[range(e) for e in extents]):
-        env = {id(v): x for v, x in zip(env_vars, point)}
-        key = []
-        for d in p.block_dims:
-            if d.expr is not None:
-                v = _eval_expr(d.expr, env)
-                if v is None:
-                    p.tpu_note = (
-                        f"output '{p.buffer.name}': its block index map "
-                        f"could not be evaluated for revisit legality")
-                    return
-                key.append(v)
-            else:
-                idx = sum(env[id(grid[a].var)] * c for a, c in d.terms) \
-                    + d.const
-                key.append(idx // d.post_div)
-        key = tuple(key)
+    for step, point in enumerate(points):
+        key = tuple(dv[step] for dv in dim_vals)
         keys[point] = key
         if key in seen:
             if seen[key] != step - 1:
                 bad = True
         seen[key] = step
-        step += 1
     # an axis revisits the output if stepping it ALONE can leave the
     # block unchanged (covers both omission and non-injective maps) ...
     revisit = set()
