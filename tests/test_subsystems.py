@@ -323,3 +323,25 @@ def test_mosaic_introspection_on_tpu():
     assert mem.generated_code_size_in_bytes > 0
     cost = k.get_cost_analysis()
     assert isinstance(cost, dict)
+
+
+def test_layout_visualizer_graphical_formats(tmp_path):
+    """png/pdf/svg rendering parity with the reference's layout_visual
+    (txt output is covered elsewhere)."""
+    pytest.importorskip("matplotlib")
+    from tilelang_mesh_tpu.analysis.layout_visual import (plot_fragment,
+                                                          plot_mesh_blocks,
+                                                          plot_plan)
+    for ext in ("png", "svg", "pdf"):
+        p = tmp_path / f"frag.{ext}"
+        plot_fragment(16, 128, 32, path=str(p))
+        assert p.exists() and p.stat().st_size > 0
+    p = tmp_path / "mesh.png"
+    plot_mesh_blocks(2, 4, path=str(p))
+    assert p.exists() and p.stat().st_size > 0
+    k = tilelang.compile(_scale_func())
+    p = tmp_path / "plan.svg"
+    plot_plan(k.artifact, path=str(p))
+    assert p.exists() and p.stat().st_size > 0
+    with pytest.raises(ValueError, match="unsupported"):
+        plot_fragment(8, 128, path=str(tmp_path / "frag.bmp"))

@@ -51,3 +51,106 @@ def visualize_mesh_blocks(nrows: int, ncols: int) -> str:
         out.append(" ".join(f"{owners[r * ncols + c]:3d}"
                             for c in range(ncols)))
     return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# graphical output (reference layout_visual.py renders txt/png/pdf/svg; the
+# format is chosen by file extension, matplotlib Agg backend — no display)
+# ---------------------------------------------------------------------------
+
+
+def _check_ext(path: Optional[str]):
+    """Validate the output format BEFORE rendering anything (no leaked
+    figures on the error path). Saving forces the Agg backend; the
+    path=None return-the-figure mode leaves the user's backend alone."""
+    if path is None:
+        return
+    ext = path.rsplit(".", 1)[-1].lower()
+    if ext not in ("png", "pdf", "svg"):
+        raise ValueError(f"unsupported format '{ext}' (png/pdf/svg)")
+    import matplotlib
+    matplotlib.use("Agg")
+
+
+def _savefig(fig, path: str):
+    fig.savefig(path, bbox_inches="tight")
+
+
+def plot_fragment(rows: int, cols: int, dtype_bits: int = 32,
+                  path: Optional[str] = None):
+    """Render a Fragment's (sublane, lane) packing as a colored grid —
+    each element cell is colored by its sublane and annotated with its
+    lane. path extension picks png/pdf/svg; returns the figure when path
+    is None."""
+    _check_ext(path)
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    f = Fragment((rows, cols), dtype_bits=dtype_bits)
+    r_show, c_show = min(rows, 64), min(cols, 128)
+    sub = np.zeros((r_show, c_show))
+    for r in range(r_show):
+        for c in range(c_show):
+            sl, _ = f.cell(r, c)
+            sub[r, c] = sl
+    fig, ax = plt.subplots(figsize=(min(12, 1 + c_show / 12),
+                                    min(8, 1 + r_show / 6)))
+    ax.imshow(sub, aspect="auto", interpolation="nearest")
+    ax.set_title(f"Fragment {rows}x{cols} ({dtype_bits}-bit): "
+                 f"sublane={f.sublane} lane={f.lane} "
+                 f"vmem={f.vmem_bytes()}B")
+    ax.set_xlabel("element column (color = sublane)")
+    ax.set_ylabel("element row")
+    if path is not None:
+        _savefig(fig, path)
+        plt.close(fig)
+        return None
+    return fig
+
+
+def plot_mesh_blocks(nrows: int, ncols: int, path: Optional[str] = None):
+    """Render the blockwise zig-zag block->core ownership map."""
+    _check_ext(path)
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    owners = make_blockwise_zz_layout(nrows, ncols)
+    grid = np.asarray(owners).reshape(nrows, ncols)
+    fig, ax = plt.subplots(figsize=(1 + ncols, 1 + nrows))
+    ax.imshow(grid, aspect="equal", interpolation="nearest")
+    for r in range(nrows):
+        for c in range(ncols):
+            ax.text(c, r, str(grid[r, c]), ha="center", va="center")
+    ax.set_title(f"blockwise-ZZ ownership, {nrows}x{ncols} mesh")
+    if path is not None:
+        _savefig(fig, path)
+        plt.close(fig)
+        return None
+    return fig
+
+
+def plot_plan(artifact, path: Optional[str] = None):
+    """Render a compiled kernel's block mappings: one horizontal bar per
+    param showing residency (block / smem / hbm) and block shape."""
+    _check_ext(path)
+    import matplotlib.pyplot as plt
+
+    rows = []
+    for p in artifact.params:
+        rows.append((p.name, p.role, tuple(p.shape)))
+    fig, ax = plt.subplots(figsize=(8, 1 + 0.5 * len(rows)))
+    desc_lines = [ln for ln in artifact.plan_desc.splitlines()
+                  if ln.strip().startswith(("in ", "out", "inout",
+                                            "scratch", "grid"))]
+    for i, (name, role, shape) in enumerate(rows):
+        ax.barh(i, 1.0, height=0.6)
+        ax.text(0.01, i, f"{name} [{role}] {shape}", va="center")
+    ax.set_yticks([])
+    ax.set_xticks([])
+    ax.set_title(f"{artifact.name}: grid={artifact.grid}\n" +
+                 "\n".join(desc_lines[:6]), fontsize=8, loc="left")
+    if path is not None:
+        _savefig(fig, path)
+        plt.close(fig)
+        return None
+    return fig

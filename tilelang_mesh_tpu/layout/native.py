@@ -9,6 +9,7 @@ toolchain (TL_TPU_DISABLE_NATIVE=1 forces the fallback).
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -64,6 +65,10 @@ def load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy2(_LIB_PATH, tmp.name)
             lib = _open_checked(tmp.name)
+            try:
+                os.unlink(tmp.name)  # mapping survives the unlink
+            except OSError:
+                pass
             if lib is None:
                 return None
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -269,6 +274,8 @@ def expr_eval_grid(ops: Sequence[int], a: Sequence[int], b: Sequence[int],
     lib = load()
     if lib is None:
         return None
+    if any(not (-(2 ** 63) <= int(x) < 2 ** 63) for x in a):
+        return None  # const outside int64: ctypes would raise
     total = 1
     for e in extents:
         total *= int(e)

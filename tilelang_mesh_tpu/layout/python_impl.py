@@ -199,6 +199,8 @@ def expr_eval_grid(ops, a, b, extents):
         for i in range(n):
             o = ops[i]
             if o == 0:
+                if not (-(2 ** 63) <= a[i] < 2 ** 63):
+                    return None  # parity: native consts are int64
                 val[i] = a[i]
             elif o == 1:
                 val[i] = point[a[i]]
