@@ -482,7 +482,10 @@ int32_t tl_expr_eval_grid(const int32_t* op, const int64_t* a,
           break;
         case 6:
           if (val[b[i]] == 0) return 0;
-          if (val[a[i]] == INT64_MIN && val[b[i]] == -1) return 0;
+          if (val[a[i]] == INT64_MIN && val[b[i]] == -1) {
+            val[i] = 0;  // mod is representable; only the quotient overflows
+            break;
+          }
           val[i] = val[a[i]] - tl_floordiv_(val[a[i]], val[b[i]]) * val[b[i]];
           break;
         case 7: val[i] = val[a[i]] < val[b[i]] ? val[a[i]] : val[b[i]]; break;

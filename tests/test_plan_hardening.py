@@ -471,3 +471,42 @@ def test_staged_window_cache_invalidated_by_parallel_store():
     k(a.copy(), b, out)
     # second gemm must see the zeroed window: out == A_1 @ B, not 2*A_1@B
     np.testing.assert_allclose(out, a[M:] @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_num_stages_one_opts_out_of_grid_pipelining():
+    """num_stages=1 is a real knob now: the Pipelined loop stays
+    in-kernel (serial + DMA through the user's single VMEM tiles), so
+    streams are single-buffered; >=2 grid-maps to Mosaic's
+    double-buffered pipeline. Numerics identical."""
+    def mk(stages):
+        @T.prim_func
+        def mm(A: T.Tensor((64, 256), "float32"),
+               B: T.Tensor((256, 128), "float32"),
+               O: T.Tensor((64, 128), "float32")):
+            with T.Kernel(1) as bx:
+                As = T.alloc_shared((64, 64), "float32")
+                Bs = T.alloc_shared((64, 128), "float32")
+                Cl = T.alloc_fragment((64, 128), "float32")
+                T.fill(Cl, 0.0)
+                for ko in T.Pipelined(4, num_stages=stages):
+                    T.copy(A[0, ko * 64], As)
+                    T.copy(B[ko * 64, 0], Bs)
+                    T.gemm(As, Bs, Cl)
+                T.copy(Cl, O)
+        return mm
+
+    p2 = plan_kernel(mk(2).func)
+    p1 = plan_kernel(mk(1).func)
+    assert p2.pipeline_axis is not None
+    assert p1.pipeline_axis is None
+    assert _param(p1, "A").mode == "any"   # DMA-staged, single-buffered
+
+    rng = np.random.default_rng(10)
+    a = rng.standard_normal((64, 256)).astype(np.float32)
+    b = rng.standard_normal((256, 128)).astype(np.float32)
+    want = a @ b
+    for st in (1, 2):
+        k = tilelang.compile(mk(st))
+        o = np.empty((64, 128), np.float32)
+        k(a, b, o)
+        np.testing.assert_allclose(o, want, rtol=2e-2, atol=2e-2)

@@ -345,3 +345,40 @@ def test_layout_visualizer_graphical_formats(tmp_path):
     assert p.exists() and p.stat().st_size > 0
     with pytest.raises(ValueError, match="unsupported"):
         plot_fragment(8, 128, path=str(tmp_path / "frag.bmp"))
+
+
+def test_static_oob_window_rejected_with_named_error():
+    """Constant windows past a buffer's extent fail the pre-lower check
+    with the buffer named (LegalizeSafeMemoryAccess's static slice),
+    not a downstream broadcast shape mismatch."""
+    @T.prim_func
+    def oob(A: T.Tensor((8, 128), "float32"),
+            O: T.Tensor((16, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((16, 128), "float32")
+            T.copy(A[4, 0], s)
+            T.copy(s, O)
+
+    with pytest.raises(Exception, match=r"window \[4:20\) exceeds A"):
+        tilelang.compile(oob)
+
+
+def test_ragged_grid_blocks_still_legal():
+    """Grid-var-driven last-block overhang is Pallas-masked, not an
+    error."""
+    import numpy as np
+
+    @T.prim_func
+    def ragged(A: T.Tensor((12, 128), "float32"),
+               O: T.Tensor((12, 128), "float32")):
+        with T.Kernel(2) as bx:
+            s = T.alloc_shared((8, 128), "float32")
+            T.copy(A[bx * 8, 0], s)
+            T.copy(s, O[bx * 8, 0])
+
+    k = tilelang.compile(ragged)
+    a = np.random.default_rng(0).standard_normal((12, 128)).astype(
+        np.float32)
+    out = np.empty_like(a)
+    k(a, out)
+    np.testing.assert_allclose(out, a, rtol=1e-6)

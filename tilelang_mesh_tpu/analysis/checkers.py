@@ -76,9 +76,50 @@ class FragmentLoopChecker:
         return errs
 
 
+class StaticBoundsChecker:
+    """Constant-window bounds legalization — the static slice of the
+    reference's LegalizeSafeMemoryAccess (src/transform/
+    legalize_safe_memory_access.cc, which predicates every access; on TPU
+    Pallas masks ragged grid-mapped blocks itself, so only windows that
+    are provably out of range for EVERY execution need rejecting, and
+    they get a named error instead of a downstream shape mismatch)."""
+
+    def check(self, func: PrimFunc) -> List[str]:
+        from ..ir import Region, as_int
+        errs: List[str] = []
+        seen = set()
+
+        def chk_region(r: Region, what: str):
+            if id(r) in seen:
+                return
+            seen.add(id(r))
+            bshape = r.buffer.static_shape()
+            rshape = r.static_shape()
+            if bshape is None or rshape is None:
+                return
+            for d, (b, sz, dim) in enumerate(zip(r.base, rshape, bshape)):
+                bi = as_int(b)
+                if bi is None:
+                    continue  # dynamic starts are clamped/masked at run
+                if bi < 0 or bi + sz > dim:
+                    errs.append(
+                        f"{what}: window [{bi}:{bi + sz}) exceeds "
+                        f"{r.buffer.name} dim {d} (extent {dim})")
+
+        def note(s):
+            for at in ("src", "dst", "A", "B", "C", "value",
+                       "send", "recv", "buffer", "out"):
+                r = getattr(s, at, None)
+                if isinstance(r, Region):
+                    chk_region(r, f"{type(s).__name__}.{at}")
+        walk(func.body, note)
+        return errs
+
+
 def run_semantic_checks(func: PrimFunc) -> None:
     errs: List[str] = []
-    for checker in (NestedLoopChecker(), FragmentLoopChecker()):
+    for checker in (NestedLoopChecker(), FragmentLoopChecker(),
+                    StaticBoundsChecker()):
         errs.extend(checker.check(func))
     if func.kernel_node() is None:
         errs.append("kernel body has no `with T.Kernel(...)` frame")

@@ -61,15 +61,14 @@ def visualize_mesh_blocks(nrows: int, ncols: int) -> str:
 
 def _check_ext(path: Optional[str]):
     """Validate the output format BEFORE rendering anything (no leaked
-    figures on the error path). Saving forces the Agg backend; the
-    path=None return-the-figure mode leaves the user's backend alone."""
+    figures on the error path). fig.savefig picks the writer from the
+    extension regardless of the active backend, so no global
+    matplotlib.use() mutation is needed in either mode."""
     if path is None:
         return
     ext = path.rsplit(".", 1)[-1].lower()
     if ext not in ("png", "pdf", "svg"):
         raise ValueError(f"unsupported format '{ext}' (png/pdf/svg)")
-    import matplotlib
-    matplotlib.use("Agg")
 
 
 def _savefig(fig, path: str):

@@ -22,7 +22,7 @@ ARTIFACT_FILE = "artifact.json"
 
 # Bump whenever codegen output changes for the same IR — generated sources
 # cached under older versions must not be reused.
-CODEGEN_VERSION = 6  # bump on any generated-source change to invalidate disk artifacts
+CODEGEN_VERSION = 7  # bump on any generated-source change to invalidate disk artifacts
 
 
 class KernelCache:

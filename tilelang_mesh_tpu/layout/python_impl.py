@@ -184,6 +184,8 @@ def expr_eval_grid(ops, a, b, extents):
         return None  # native rejects these shapes; keep parity
     for i in range(n):
         if ops[i] == 0:
+            if not (-(2 ** 63) <= a[i] < 2 ** 63):
+                return None  # parity: native consts are int64
             continue
         if ops[i] == 1:
             if not (0 <= a[i] < len(extents)):
@@ -199,8 +201,6 @@ def expr_eval_grid(ops, a, b, extents):
         for i in range(n):
             o = ops[i]
             if o == 0:
-                if not (-(2 ** 63) <= a[i] < 2 ** 63):
-                    return None  # parity: native consts are int64
                 val[i] = a[i]
             elif o == 1:
                 val[i] = point[a[i]]

@@ -806,7 +806,15 @@ def plan_kernel(func: PrimFunc, pass_cfg: Optional[dict] = None) -> KernelPlan:
             isinstance(s, CommStmt) for s in top):
         lp = pipelined[0]
         ext = as_int(lp.extents[0])
-        if ext is not None and len(lp.loop_vars) == 1:
+        if ext is not None and len(lp.loop_vars) == 1 \
+                and lp.num_stages != 1:
+            # num_stages semantics on TPU: grid-mapping hands the loop to
+            # Mosaic's pipeline (double-buffered streams — the hardware's
+            # fixed depth; >=2 means "let Mosaic pipeline"). An EXPLICIT
+            # num_stages=1 opts out: the loop stays in-kernel (serial
+            # fori + DMA staging), single-buffering the streams to halve
+            # their VMEM footprint. Cf. reference inject_pipeline.cc,
+            # where num_stages sizes the smem version ring.
             mapped_loop = lp
     pipeline_axis = None
     if mapped_loop is not None:
