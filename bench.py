@@ -621,11 +621,14 @@ def main():
                                               causal=False)),
         ("fp8_gemm", lambda: cfg_fp8_gemm(*(1024,) * 3 if q
                                           else (4096,) * 3)),
-        ("w4a16_gemm", lambda: cfg_w4a16(*(1024,) * 3 if q
-                                         else (4096,) * 3)),
         ("mla_decode", lambda: cfg_mla_decode(S=1024 if q else 4096)),
         ("paged_decode", lambda: cfg_paged_decode(S=2048 if q else 8192)),
         ("moe_grouped", lambda: cfg_moe_grouped(M=256 if q else 512)),
+        # LAST on purpose: a kernel fault kills the tunnel's TPU worker
+        # for many minutes, losing every config after it — the blast
+        # radius of the riskiest config must not include the others
+        ("w4a16_gemm", lambda: cfg_w4a16(*(1024,) * 3 if q
+                                         else (4096,) * 3)),
     ]
     if args.only:
         keep = set(args.only.split(","))

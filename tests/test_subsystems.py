@@ -382,3 +382,67 @@ def test_ragged_grid_blocks_still_legal():
     out = np.empty_like(a)
     k(a, out)
     np.testing.assert_allclose(out, a, rtol=1e-6)
+
+
+def test_autotune_from_carver_template():
+    """autotune(template=...) derives its config grid from the carver's
+    roofline-ranked hints at tune time (reference: carver hints feed the
+    tuner)."""
+    from tilelang_mesh_tpu.carver import ElementwiseTemplate
+    from tilelang_mesh_tpu.carver.arch import TPU_V5E
+    seen = []
+
+    @tilelang.jit
+    def factory(M, N, block_M=8, block_N=128):
+        seen.append((block_M, block_N))
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(T.ceildiv(M, block_M),
+                          T.ceildiv(N, block_N)) as (bx, by):
+                s = T.alloc_shared((block_M, block_N), "float32")
+                T.copy(A[bx * block_M, by * block_N], s)
+                T.copy(s, B[bx * block_M, by * block_N])
+        return k
+
+    tuned = tilelang.autotune(
+        template=lambda M, N: ElementwiseTemplate((M, N), "float32",
+                                                  arch=TPU_V5E),
+        topk=3, warmup=1, rep=2)(factory)
+    kernel = tuned(64, 256)
+    assert kernel.config in [
+        {"block_M": bm, "block_N": bn} for bm, bn in seen]
+    assert len(kernel.autotune_results) == len(set(seen)) == 3
+    assert kernel.latency > 0
+
+
+def test_autotune_requires_configs_or_template():
+    with pytest.raises(ValueError, match="configs.*or template"):
+        tilelang.autotune(warmup=1)(lambda: None)
+
+
+def test_autotune_template_ignores_factory_kwargs():
+    """Call-site tile overrides go to the factory, not the template: the
+    template callable only receives the kwargs its signature names."""
+    from tilelang_mesh_tpu.carver import ElementwiseTemplate
+    from tilelang_mesh_tpu.carver.arch import TPU_V5E
+
+    @tilelang.jit
+    def factory(M, N, block_M=8, block_N=128):
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(T.ceildiv(M, block_M),
+                          T.ceildiv(N, block_N)) as (bx, by):
+                s = T.alloc_shared((block_M, block_N), "float32")
+                T.copy(A[bx * block_M, by * block_N], s)
+                T.copy(s, B[bx * block_M, by * block_N])
+        return k
+
+    tuned = tilelang.autotune(
+        template=lambda M, N: ElementwiseTemplate((M, N), "float32",
+                                                  arch=TPU_V5E),
+        topk=2, warmup=1, rep=2)(factory)
+    kernel = tuned(64, 256, block_N=128)   # explicit factory kwarg
+    assert kernel.latency > 0

@@ -107,9 +107,10 @@ class StaticBoundsChecker:
                         f"{r.buffer.name} dim {d} (extent {dim})")
 
         def note(s):
-            for at in ("src", "dst", "A", "B", "C", "value",
-                       "send", "recv", "buffer", "out"):
-                r = getattr(s, at, None)
+            # generic scan: every Region-valued attribute of every
+            # statement type, current and future (src/dst/A/B/C/value/
+            # send/recv/buffer/out today)
+            for at, r in vars(s).items():
                 if isinstance(r, Region):
                     chk_region(r, f"{type(s).__name__}.{at}")
         walk(func.body, note)

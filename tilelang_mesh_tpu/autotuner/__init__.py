@@ -79,21 +79,59 @@ def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
 
 
 class AutoTuner:
-    def __init__(self, fn: Callable, configs: Sequence[Dict[str, Any]],
+    def __init__(self, fn: Callable,
+                 configs: Optional[Sequence[Dict[str, Any]]] = None,
                  warmup: int = 3, rep: int = 20,
                  supply_type: TensorSupplyType = TensorSupplyType.Auto,
                  cache_results: bool = True,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 template: Any = None, topk: int = 10):
+        if configs is None and template is None:
+            raise ValueError("AutoTuner needs configs=[...] or template=")
         self.fn = fn
-        self.configs = list(configs)
+        self.configs = list(configs) if configs is not None else None
         self.warmup = warmup
         self.rep = rep
         self.supply_type = supply_type
         self.cache_results = cache_results
         self.timeout = timeout
+        # carver integration (reference: carver hints feed the tuner's
+        # config grid): a template instance, or a callable over the
+        # call-site args returning one — the candidate list then comes
+        # from the roofline-ranked policy at tune time.
+        self.template = template
+        self.topk = topk
+
+    def _resolve_configs(self, args, kwargs) -> List[Dict[str, Any]]:
+        if self.configs is not None:
+            return self.configs
+        from ..carver import recommend_hints
+        if callable(self.template):
+            # pass only the kwargs the template accepts: call-site tile
+            # overrides (block_M=...) are for the factory, not the
+            # template
+            try:
+                sig = inspect.signature(self.template)
+                if any(p.kind == p.VAR_KEYWORD
+                       for p in sig.parameters.values()):
+                    kw = kwargs
+                else:
+                    kw = {k: v for k, v in kwargs.items()
+                          if k in sig.parameters}
+            except (TypeError, ValueError):
+                kw = kwargs
+            t = self.template(*args, **kw)
+        else:
+            t = self.template
+        configs = [h.config for h in recommend_hints(t, self.topk)]
+        if not configs:
+            raise RuntimeError(
+                "autotune: the carver template produced no candidates "
+                "(every tile exceeded the VMEM budget?)")
+        return configs
 
     # ------------------------------------------------------------------
-    def _disk_key(self, args, kwargs) -> str:
+    def _disk_key(self, args, kwargs, configs) -> str:
         from .. import __version__
         from ..cache.kernel_cache import CODEGEN_VERSION
 
@@ -108,12 +146,13 @@ class AutoTuner:
         h.update(src.encode())
         h.update(repr(args).encode())
         h.update(repr(sorted(kwargs.items())).encode())
-        h.update(json.dumps(self.configs, sort_keys=True,
+        h.update(json.dumps(configs, sort_keys=True,
                             default=str).encode())
         return h.hexdigest()
 
     def run(self, *args, **kwargs) -> AutotuneResult:
-        key = self._disk_key(args, kwargs)
+        configs = self._resolve_configs(args, kwargs)
+        key = self._disk_key(args, kwargs, configs)
         cache_f = env.autotune_dir() / f"{key}.json"
         if self.cache_results and cache_f.exists():
             try:
@@ -128,8 +167,8 @@ class AutoTuner:
 
         best: Optional[AutotuneResult] = None
         captured: List[Dict[str, Any]] = []
-        n = len(self.configs)
-        for i, cfg in enumerate(self.configs):
+        n = len(configs)
+        for i, cfg in enumerate(configs):
             try:
                 def _one():
                     kernel = self.fn(*args, **{**kwargs, **cfg})
@@ -159,10 +198,11 @@ class AutoTuner:
 class AutoTuneImpl:
     def __init__(self, fn: Callable, configs, warmup: int, rep: int,
                  supply_type: TensorSupplyType, cache_results: bool,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None, template: Any = None,
+                 topk: int = 10):
         functools.update_wrapper(self, fn)
         self.tuner = AutoTuner(fn, configs, warmup, rep, supply_type,
-                               cache_results, timeout)
+                               cache_results, timeout, template, topk)
         self._cache: Dict[Any, Any] = {}
 
     def __call__(self, *args, **kwargs):
@@ -182,13 +222,24 @@ def autotune(fn: Optional[Callable] = None, *,
              warmup: int = 3, rep: int = 20,
              supply_type: TensorSupplyType = TensorSupplyType.Auto,
              cache_results: bool = True, timeout: Optional[float] = None,
+             template: Any = None, topk: int = 10,
              **_ignored):
-    if configs is None:
-        raise ValueError("autotune requires configs=[...]")
+    """Grid-search tuner. Candidates come from an explicit ``configs``
+    list, or from the carver: ``template=`` takes a carver template
+    instance or a callable over the call-site args returning one, and the
+    roofline-ranked top-``topk`` hints become the config grid::
+
+        @tilelang.autotune(template=lambda M, N, K:
+                           MatmulTemplate(M, N, K, "bfloat16"), topk=6)
+        @tilelang.jit
+        def matmul(M, N, K, block_M=128, block_N=128, block_K=128): ...
+    """
+    if configs is None and template is None:
+        raise ValueError("autotune requires configs=[...] or template=")
 
     def wrap(f):
         return AutoTuneImpl(f, configs, warmup, rep, supply_type,
-                            cache_results, timeout)
+                            cache_results, timeout, template, topk)
 
     if fn is not None:
         return wrap(fn)

@@ -16,7 +16,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def cast(v, dtype):
-    """Dtype cast that also works on python scalars."""
+    """Dtype cast that also works on python scalars. Traced arrays go
+    through .astype — the exact lowering the codegen emitted before
+    scalars were routed here, so Mosaic sees an unchanged convert op."""
+    if hasattr(v, "astype"):
+        return v.astype(dtype)
     return jnp.asarray(v, dtype)
 
 
