@@ -31,12 +31,14 @@ def make_factory(M, N, K, dtype="float32"):
 
 
 def main(M=256, N=256, K=256):
-    hints = MatmulTemplate(M, N, K, "float32").hints(topk=3)
-    print("carver hints:", hints)
-    configs = [h.config for h in hints]
-    tuned = tilelang.autotune(configs=configs, warmup=1, rep=3)(
-        make_factory(M, N, K))
+    # the template IS the config grid: autotune asks the carver's
+    # roofline-ranked policy for candidates at tune time
+    tuned = tilelang.autotune(
+        template=lambda M, N, K: MatmulTemplate(M, N, K, "float32"),
+        topk=3, warmup=1, rep=3)(make_factory(M, N, K))
     kernel = tuned(M, N, K)
+    print("carver candidates:",
+          [r["config"] for r in kernel.autotune_results])
     print(f"best config: {kernel.config} @ {kernel.latency:.3f} ms")
     rng = np.random.default_rng(0)
     a = rng.standard_normal((M, K), dtype=np.float32)
