@@ -1,0 +1,153 @@
+"""Full plan-text goldens for canonical kernels.
+
+The reference pins each transform pass with golden lowered-IR comparisons
+(testing/python/transform/, 18 files of mod.script() string equality).
+The analog here: `plan_kernel(...).describe()` is the deterministic
+pass-pipeline output — these goldens lock grid mapping, residency
+decisions, aliasing, phase splits, and VMEM packing for one kernel per
+planner feature. A planning change now shows up as a readable text diff,
+not an unexplained perf or numerics shift.
+"""
+
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.transform.plan import plan_kernel
+
+
+def test_pipelined_gemm_plan_golden():
+    bm, bn, bk = 128, 128, 64
+    M = N = K = 256
+
+    @T.prim_func
+    def gemm(A: T.Tensor((M, K), "bfloat16"),
+             B: T.Tensor((K, N), "bfloat16"),
+             C: T.Tensor((M, N), "bfloat16")):
+        with T.Kernel(T.ceildiv(N, bn), T.ceildiv(M, bm)) as (bx, by):
+            A_s = T.alloc_shared((bm, bk), "bfloat16")
+            B_s = T.alloc_shared((bk, bn), "bfloat16")
+            C_l = T.alloc_fragment((bm, bn), "float32")
+            T.clear(C_l)
+            for ko in T.Pipelined(T.ceildiv(K, bk)):
+                T.copy(A[by * bm, ko * bk], A_s)
+                T.copy(B[ko * bk, bx * bn], B_s)
+                T.gemm(A_s, B_s, C_l)
+            T.copy(C_l, C[by * bm, bx * bn])
+
+    assert plan_kernel(gemm.func).describe() == """\
+plan(gemm):
+  grid = [by:2:parallel, bx:2:parallel, ko:4:arbitrary]
+  in    A: block[128@(by), 64@(ko)] alias=shared
+  in    B: block[64@(ko), 128@(bx)] alias=shared_1
+  out   C: block[128@(by), 128@(bx)]
+  scratch frag: (128, 128) float32 [fragment] @0
+  vmem arena: 65536 bytes (liveness-packed)
+  phases: init=1 main=3 epi=1
+"""
+
+
+def test_softmax_stats_plan_golden():
+    """Online-softmax shape: 1-D stats fragments, no pipeline axis."""
+    M, N = 8, 128
+
+    @T.prim_func
+    def softmax(A: T.Tensor((M, N), "float32"),
+                O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((M, N), "float32")
+            mx = T.alloc_fragment((M,), "float32")
+            den = T.alloc_fragment((M,), "float32")
+            T.copy(A, s)
+            T.reduce_max(s, mx, dim=1)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = T.exp(s[i, j] - mx[i])
+            T.reduce_sum(s, den, dim=1)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] / den[i]
+            T.copy(s, O)
+
+    assert plan_kernel(softmax.func).describe() == """\
+plan(softmax):
+  grid = [bx:1:parallel]
+  in    A: block[8@(0), 128@(0)]
+  out   O: block[8@(0), 128@(0)]
+  scratch frag: (8, 128) float32 [fragment] @0
+  scratch frag_1: (8,) float32 [fragment] @4096
+  scratch frag_2: (8,) float32 [fragment] @4096
+  vmem arena: 8192 bytes (liveness-packed)
+  phases: init=0 main=6 epi=0
+"""
+
+
+def test_smem_promotion_plan_golden():
+    """A small scalar-read index table lives whole in SMEM."""
+    NB, M, N = 4, 8, 128
+
+    @T.prim_func
+    def gather(A: T.Tensor((NB * M, N), "float32"),
+               TBL: T.Tensor((NB,), "int32"),
+               O: T.Tensor((NB * M, N), "float32")):
+        with T.Kernel(NB) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A[TBL[bx] * M, 0], s)
+            T.copy(s, O[bx * M, 0])
+
+    assert plan_kernel(gather.func).describe() == """\
+plan(gather):
+  grid = [bx:4:parallel]
+  in    A: any(hbm)
+  in    TBL: smem(full)
+  out   O: block[8@(bx), 128@(0)]
+  scratch shared: (8, 128) float32 [shared] @0
+  vmem arena: 4096 bytes (liveness-packed)
+  phases: init=0 main=2 epi=0
+"""
+
+
+def test_staged_serial_window_plan_golden():
+    """Serial-loop GEMM windows: HBM residency + synthesized staging."""
+    NB, M, K, N = 2, 16, 128, 128
+
+    @T.prim_func
+    def accg(A: T.Tensor((NB * M, K), "float32"),
+             B: T.Tensor((K, N), "float32"),
+             O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            Bs = T.alloc_shared((K, N), "float32")
+            Cl = T.alloc_fragment((M, N), "float32")
+            T.copy(B, Bs)
+            T.fill(Cl, 0.0)
+            for k in T.serial(NB):
+                T.gemm(A[k * M:(k + 1) * M, 0:K], Bs, Cl)
+            T.copy(Cl, O)
+
+    assert plan_kernel(accg.func).describe() == """\
+plan(accg):
+  grid = [bx:1:parallel]
+  in    A: any(hbm)
+  in    B: block[128@(0), 128@(0)] alias=shared
+  out   O: block[16@(0), 128@(0)]
+  scratch frag: (16, 128) float32 [fragment] @0
+  scratch stage_A_1: (16, 128) float32 [shared] @8192
+  vmem arena: 16384 bytes (liveness-packed)
+  phases: init=0 main=4 epi=0
+"""
+
+
+def test_modular_map_plan_golden():
+    """Non-affine (bx % 2) block-index expression in the plan text."""
+    BM, N, G = 8, 128, 4
+
+    @T.prim_func
+    def wrap(A: T.Tensor((2 * BM, N), "float32"),
+             O: T.Tensor((G * BM, N), "float32")):
+        with T.Kernel(G) as bx:
+            s = T.alloc_shared((BM, N), "float32")
+            T.copy(A[(bx % 2) * BM, 0], s)
+            T.copy(s, O[bx * BM, 0])
+
+    assert plan_kernel(wrap.func).describe() == """\
+plan(wrap):
+  grid = [bx:4:parallel]
+  in    A: block[8@(bx % 2), 128@(0)] alias=shared
+  out   O: block[8@(bx), 128@(0)]
+  phases: init=0 main=2 epi=0
+"""

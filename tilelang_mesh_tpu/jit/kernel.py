@@ -188,6 +188,27 @@ class JITKernel:
             out.append((name, str(m)))
         return out
 
+    def get_lowered(self, level: str = "mosaic") -> str:
+        """The lowered artifact at the requested level — the accessor the
+        reference exposes as show_ptx/show_sass:
+        'mosaic' (device kernel MLIR), 'optimized_hlo' (post-optimization
+        scheduled HLO; compiles), or 'stablehlo' (pre-optimization — the
+        same artifact as get_lowered_hlo())."""
+        if level == "mosaic":
+            return self.get_mosaic()
+        if level == "optimized_hlo":
+            return self.get_compiled_hlo()
+        if level == "stablehlo":
+            return self.get_lowered_hlo()
+        raise ValueError(f"unknown level {level!r} "
+                         "(mosaic | optimized_hlo | stablehlo)")
+
+    def show_mosaic(self) -> None:
+        print(self.get_mosaic())  # noqa: T201 — reference show_ptx parity
+
+    def show_hlo(self) -> None:
+        print(self.get_compiled_hlo())  # noqa: T201
+
     def get_compiled_hlo(self) -> str:
         """Post-optimization, scheduled HLO with chosen layouts (e.g.
         f32[8,128]{1,0:T(8,128)}) — what XLA actually executes around the
