@@ -30,6 +30,7 @@ import argparse
 import functools
 import json
 import math
+import os
 import sys
 import time
 
@@ -601,13 +602,61 @@ def run_config(name, build, peaks, rounds=3):
     return rec
 
 
+def _probe_device(timeout_s: float):
+    """(ok, error) after a trivial computation, bounded by timeout.
+    A kernel fault kills the tunnel's worker for many minutes and a
+    backend-init attempt then HANGS (not errors); probing on a daemon
+    thread lets the bench abort with a diagnostic line instead of
+    wedging the driver. A fast local failure (broken jax install) is
+    reported as itself, not as a timeout."""
+    import threading
+    ok = [False]
+    err = [None]
+
+    def _t():
+        try:
+            import jax.numpy as jnp
+            jnp.ones((8, 128)).sum().block_until_ready()
+            ok[0] = True
+        except Exception as e:  # relayed in the JSON error line
+            err[0] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_t, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ok[0]:
+        return True, None
+    if err[0] is not None:
+        return False, f"device probe failed: {err[0]}"
+    return False, (f"TPU backend unreachable within {timeout_s:.0f}s "
+                   f"(tunnel worker down? a prior kernel fault keeps it "
+                   f"dead for 20+ min)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small shapes (smoke test, not a benchmark)")
     ap.add_argument("--only", type=str, default=None,
                     help="comma-separated config names")
+    try:
+        probe_default = float(
+            os.environ.get("TL_TPU_BENCH_PROBE_TIMEOUT", 600))
+    except ValueError:
+        probe_default = 600.0
+    ap.add_argument("--probe-timeout", type=float, default=probe_default,
+                    help="seconds to wait for the TPU before aborting "
+                         "with a diagnostic JSON line; <= 0 skips the "
+                         "probe")
     args = ap.parse_args()
+
+    if args.probe_timeout > 0:
+        ok, perr = _probe_device(args.probe_timeout)
+        if not ok:
+            print(json.dumps({
+                "metric": "bench", "value": 0.0, "unit": "TFLOPS",
+                "vs_baseline": 0.0, "error": perr}), flush=True)
+            sys.exit(1)
 
     peaks = _chip_peak_tflops()
     q = args.quick
