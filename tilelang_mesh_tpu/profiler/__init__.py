@@ -111,3 +111,19 @@ class Profiler:
         ins = self._inputs()
         fn = func or self.kernel
         return fn(*ins)
+
+    def trace(self, trace_dir: str, steps: int = 3) -> str:
+        """Capture a jax.profiler device trace of the kernel (the TPU
+        analog of the reference's CUPTI capture backend, SURVEY §5.1):
+        runs the kernel ``steps`` times under ``jax.profiler.trace`` and
+        returns the trace directory, viewable with TensorBoard or
+        xprof."""
+        import jax
+
+        steps = max(1, int(steps))
+        ins = self._inputs()
+        with jax.profiler.trace(trace_dir):
+            for _ in range(steps):
+                r = self.kernel.func(*ins)
+            _consume(r)
+        return trace_dir
