@@ -415,3 +415,21 @@ def test_comm_reduce_type_validation():
                     s = T.alloc_shared((8, 128), "float32")
                     o = T.alloc_shared((8, 1), "float32")
                     T.comm.all_reduce(s, o, "mean", "all")
+
+
+def test_mesh_analyzer_rooflines_collectives():
+    """Analyzer.analysis_mesh: compute segments via the per-core
+    roofline, collectives via the NoC schedule's hop cost."""
+    from tilelang_mesh_tpu.tools.analyzer import Analyzer
+    art = _allreduce_program((2, 4), "all")
+    res = Analyzer.analysis_mesh(art)
+    assert res.n_collectives == 1
+    assert res.comm_ms > 0 and res.compute_ms > 0
+    assert res.expected_latency_ms == res.comm_ms + res.compute_ms
+    assert res.bound in ("comm", "compute")
+    # a smaller mesh with a row-only reduce synthesizes fewer hops, so
+    # its collective costs less under the same chip model
+    art2 = _allreduce_program((2, 2), "h")
+    res2 = Analyzer.analysis_mesh(art2)
+    assert res2.n_collectives == 1
+    assert res2.comm_ms < res.comm_ms

@@ -39,20 +39,17 @@ def _case(rng):
     return g, nblk, kind, fn, ok
 
 
-def _build(g, nblk):
+def _build(g, nblk, fn):
     @T.prim_func
     def k(A: T.Tensor((nblk * BM, BN), "float32"),
           O: T.Tensor((g * BM, BN), "float32")):
         with T.Kernel(g) as bx:
             s = T.alloc_shared((BM, BN), "float32")
-            T.copy(A[_IDX[0](bx) * BM, 0], s)
+            T.copy(A[fn(bx) * BM, 0], s)
             for i, j in T.Parallel(BM, BN):
                 s[i, j] = s[i, j] + 1.0
             T.copy(s, O[bx * BM, 0])
     return k
-
-
-_IDX = [None]
 
 
 @pytest.mark.parametrize("seed", range(12))
@@ -61,8 +58,7 @@ def test_random_tiled_copy_kernel(seed):
     g, nblk, kind, fn, ok = _case(rng)
     if not ok:
         pytest.skip("index map exceeds source blocks (generator reject)")
-    _IDX[0] = fn
-    k = tilelang.compile(_build(g, nblk))
+    k = tilelang.compile(_build(g, nblk, fn))
     a = rng.standard_normal((nblk * BM, BN)).astype(np.float32)
     out = np.empty((g * BM, BN), np.float32)
     k(a, out)

@@ -34,6 +34,20 @@ class AnalysisResult:
                 f"{self.bound}-bound{vm})")
 
 
+@dataclass
+class MeshAnalysisResult:
+    compute_ms: float
+    comm_ms: float
+    expected_latency_ms: float
+    n_collectives: int
+    bound: str  # "compute" | "comm"
+
+    def __repr__(self):
+        return (f"MeshAnalysisResult(compute={self.compute_ms:.4f} ms, "
+                f"comm={self.comm_ms:.4f} ms over {self.n_collectives} "
+                f"collectives, {self.bound}-bound)")
+
+
 class Analyzer:
     def __init__(self, arch: Optional[TPUArch] = None):
         self.arch = arch or auto_arch()
@@ -112,3 +126,68 @@ class Analyzer:
             bound="compute" if t_compute >= t_mem else "memory",
             vmem_arena_bytes=vmem,
             vmem_ok=vmem <= self.arch.vmem_bytes)
+
+    # -- mesh programs -------------------------------------------------------
+    @classmethod
+    def analysis_mesh(cls, artifact, arch: Optional[TPUArch] = None,
+                      mesh_arch=None) -> "MeshAnalysisResult":
+        """Roofline a compiled MESH program: per-segment compute/memory
+        time from the per-core analysis, plus ICI time for each
+        collective from the synthesized NoC schedule's hop cost (the
+        comm tier the reference's Analyzer has no analog for — its comm
+        cost lives in the Sunmmio NoC model)."""
+        if arch is None and mesh_arch is not None:
+            arch = mesh_arch.chip   # one chip model for both tiers
+        return cls(arch)._run_mesh(artifact, mesh_arch)
+
+    def _run_mesh(self, artifact, mesh_arch=None):
+        from ..carver.arch import TPUMeshArch
+        from ..ir import (CommBroadcast, CommPut, CommStmt, dtype_bits)
+        from ..parallel.lowering import (_comm_buffers, _schedule_hops,
+                                         _schedule_steps)
+        segs = artifact.attrs.get("_segments") or []
+        nrow, ncol = artifact.mesh_config
+        march = mesh_arch or TPUMeshArch(self.arch, (nrow, ncol))
+        compute_ms = 0.0
+        comm_ms = 0.0
+        n_comm = 0
+        for seg in segs:
+            if seg["kind"] == "compute":
+                compute_ms += self._run(seg["func"]).expected_latency_ms
+                continue
+            op: CommStmt = seg["op"]
+            n_comm += 1
+            reads, writes = _comm_buffers(op)
+            nbytes = 0
+            for r in reads + writes:
+                n = r.numel()
+                if n:
+                    nbytes = max(nbytes, n * dtype_bits(r.dtype) // 8)
+            # hop count straight from the schedule synthesis (native core)
+            from ..ir import CommAllGather, CommAllReduce
+            if isinstance(op, CommBroadcast):
+                r0, c0 = op.src_core // ncol, op.src_core % ncol
+                steps = _schedule_steps("broadcast", nrow, ncol,
+                                        op.direction, (r0, c0))
+                hops = _schedule_hops(steps, nrow, ncol)
+            elif isinstance(op, CommAllGather):
+                steps = _schedule_steps("all_gather", nrow, ncol,
+                                        op.direction)
+                hops = _schedule_hops(steps, nrow, ncol)
+            elif isinstance(op, CommAllReduce):
+                steps = _schedule_steps("all_reduce", nrow, ncol,
+                                        op.direction)
+                hops = _schedule_hops(steps, nrow, ncol)
+            elif isinstance(op, CommPut):
+                sr, sc = op.src_core // ncol, op.src_core % ncol
+                dr, dc = op.dst_core // ncol, op.dst_core % ncol
+                hops = abs(sr - dr) + abs(sc - dc)
+            else:
+                hops = 0   # barrier/fence: no payload
+            per_link = march.chip.ici_gbps_per_link * 1e9
+            comm_ms += (nbytes * max(hops, 1) / per_link) * 1e3
+        total = compute_ms + comm_ms
+        return MeshAnalysisResult(
+            compute_ms=compute_ms, comm_ms=comm_ms,
+            expected_latency_ms=total, n_collectives=n_comm,
+            bound="comm" if comm_ms > compute_ms else "compute")
