@@ -81,10 +81,22 @@ class TPUMeshProperties:
     carver to size tiles."""
 
     def __init__(self, nrows: int = 4, ncols: int = 4,
-                 vmem_bytes: int = 64 * 2 ** 20,
-                 smem_bytes: int = 1 * 2 ** 20,
-                 ici_gbps: float = 90.0):
+                 vmem_bytes: Optional[int] = None,
+                 smem_bytes: Optional[int] = None,
+                 ici_gbps: Optional[float] = None):
         self.mesh_config = (nrows, ncols)
+        if vmem_bytes is None or smem_bytes is None or ici_gbps is None:
+            # one chip model everywhere (carver arch); only consulted
+            # when a default is actually needed — auto_arch touches the
+            # jax backend, which explicit overrides must not
+            from ..carver.arch import auto_arch
+            chip = auto_arch()
+            if vmem_bytes is None:
+                vmem_bytes = chip.vmem_bytes
+            if smem_bytes is None:
+                smem_bytes = chip.smem_bytes
+            if ici_gbps is None:
+                ici_gbps = chip.ici_gbps_per_link
         self.vmem_bytes = vmem_bytes
         self.smem_bytes = smem_bytes
         self.ici_gbps = ici_gbps

@@ -344,6 +344,43 @@ def _schedule_hops(steps, nrow: int, ncol: int) -> int:
     return h if h is not None else lpy.schedule_hops(steps, nrow, ncol)
 
 
+def comm_cost(c: CommStmt, nrow: int, ncol: int):
+    """(hops, payload_bytes_per_hop) for one collective — the single
+    place op -> schedule -> cost is encoded (used by the schedule text
+    and the mesh analyzer). Payload is the per-hop WIRE chunk: what one
+    scheduled broadcast step carries, not the largest touched region
+    (an all_reduce moves out-sized locally-reduced chunks; an
+    all_gather moves send-sized chunks). Barrier/fence cost nothing.
+    Raises for unknown payload-bearing comm types so a new collective
+    cannot be silently mis-costed."""
+    from ..ir import dtype_bits
+
+    def rbytes(region) -> int:
+        n = region.numel() or 0
+        return n * dtype_bits(region.dtype) // 8
+
+    if isinstance(c, (CommBarrier, CommFence)):
+        return 0, 0
+    if isinstance(c, CommBroadcast):
+        r0, c0 = c.src_core // ncol, c.src_core % ncol
+        steps = _schedule_steps("broadcast", nrow, ncol, c.direction,
+                                (r0, c0))
+        return _schedule_hops(steps, nrow, ncol), rbytes(c.src)
+    if isinstance(c, CommPut):
+        sr, sc = c.src_core // ncol, c.src_core % ncol
+        dr, dc = c.dst_core // ncol, c.dst_core % ncol
+        return abs(sr - dr) + abs(sc - dc), rbytes(c.src)
+    if isinstance(c, CommAllGather):
+        steps = _schedule_steps("all_gather", nrow, ncol, c.direction)
+        return _schedule_hops(steps, nrow, ncol), rbytes(c.send)
+    if isinstance(c, CommAllReduce):
+        steps = _schedule_steps("all_reduce", nrow, ncol, c.direction)
+        return _schedule_hops(steps, nrow, ncol), rbytes(c.out)
+    raise MeshLowerError(
+        f"no cost model for collective {type(c).__name__}; add it to "
+        f"comm_cost so the analyzer cannot silently mis-cost it")
+
+
 def _xla_lowering_desc(c: CommStmt, nrow: int, ncol: int) -> str:
     """One line naming the XLA collective _apply_comm emits for this op —
     kept in lockstep with _apply_comm so the golden schedule text IS the

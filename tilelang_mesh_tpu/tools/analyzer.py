@@ -60,7 +60,8 @@ class Analyzer:
             func = func.func
         return cls(arch)._run(func)
 
-    def _run(self, func: PrimFunc) -> AnalysisResult:
+    def _run(self, func: PrimFunc,
+             with_vmem: bool = True) -> AnalysisResult:
         kn = func.kernel_node()
         grid = 1
         loop_mult = {}
@@ -115,11 +116,15 @@ class Analyzer:
         t_mem = mem_bytes[0] / (self.arch.hbm_gbps * 1e9)
         expected = max(t_compute, t_mem)
         # liveness-packed scratch footprint via the native allocator
-        from ..transform.plan import PlanError, plan_kernel
-        try:
-            vmem = plan_kernel(func).vmem_arena
-        except PlanError:
-            vmem = 0  # unplannable func: no footprint to report
+        # (skipped for mesh segments, whose plans are already computed
+        # and whose vmem fields the mesh summary discards)
+        vmem = 0
+        if with_vmem:
+            from ..transform.plan import PlanError, plan_kernel
+            try:
+                vmem = plan_kernel(func).vmem_arena
+            except PlanError:
+                vmem = 0  # unplannable func: no footprint to report
         return AnalysisResult(
             total_flops=flops[0], total_bytes=mem_bytes[0],
             expected_latency_ms=expected * 1e3,
@@ -142,9 +147,8 @@ class Analyzer:
 
     def _run_mesh(self, artifact, mesh_arch=None):
         from ..carver.arch import TPUMeshArch
-        from ..ir import (CommBroadcast, CommPut, CommStmt, dtype_bits)
-        from ..parallel.lowering import (_comm_buffers, _schedule_hops,
-                                         _schedule_steps)
+        from ..ir import CommStmt
+        from ..parallel.lowering import comm_cost
         segs = artifact.attrs.get("_segments") or []
         nrow, ncol = artifact.mesh_config
         march = mesh_arch or TPUMeshArch(self.arch, (nrow, ncol))
@@ -153,37 +157,14 @@ class Analyzer:
         n_comm = 0
         for seg in segs:
             if seg["kind"] == "compute":
-                compute_ms += self._run(seg["func"]).expected_latency_ms
+                compute_ms += self._run(
+                    seg["func"], with_vmem=False).expected_latency_ms
                 continue
             op: CommStmt = seg["op"]
+            hops, nbytes = comm_cost(op, nrow, ncol)
+            if nbytes == 0:
+                continue   # barrier/fence: no payload, not a collective
             n_comm += 1
-            reads, writes = _comm_buffers(op)
-            nbytes = 0
-            for r in reads + writes:
-                n = r.numel()
-                if n:
-                    nbytes = max(nbytes, n * dtype_bits(r.dtype) // 8)
-            # hop count straight from the schedule synthesis (native core)
-            from ..ir import CommAllGather, CommAllReduce
-            if isinstance(op, CommBroadcast):
-                r0, c0 = op.src_core // ncol, op.src_core % ncol
-                steps = _schedule_steps("broadcast", nrow, ncol,
-                                        op.direction, (r0, c0))
-                hops = _schedule_hops(steps, nrow, ncol)
-            elif isinstance(op, CommAllGather):
-                steps = _schedule_steps("all_gather", nrow, ncol,
-                                        op.direction)
-                hops = _schedule_hops(steps, nrow, ncol)
-            elif isinstance(op, CommAllReduce):
-                steps = _schedule_steps("all_reduce", nrow, ncol,
-                                        op.direction)
-                hops = _schedule_hops(steps, nrow, ncol)
-            elif isinstance(op, CommPut):
-                sr, sc = op.src_core // ncol, op.src_core % ncol
-                dr, dc = op.dst_core // ncol, op.dst_core % ncol
-                hops = abs(sr - dr) + abs(sc - dc)
-            else:
-                hops = 0   # barrier/fence: no payload
             per_link = march.chip.ici_gbps_per_link * 1e9
             comm_ms += (nbytes * max(hops, 1) / per_link) * 1e3
         total = compute_ms + comm_ms
