@@ -22,7 +22,7 @@ def _case(rng):
     """One random kernel spec: grid extent, #blocks in A, index map."""
     g = int(rng.integers(2, 5))            # grid extent
     nblk = int(rng.integers(1, 5))         # blocks in A
-    kind = rng.choice(["affine", "mod", "swizzle"])
+    kind = rng.choice(["affine", "mod", "swizzle", "div"])
     if kind == "affine":
         c = int(rng.integers(0, 2))        # coeff 0 or 1 (whole blocks)
         k = int(rng.integers(0, max(1, nblk - c * (g - 1))))
@@ -32,6 +32,10 @@ def _case(rng):
         m = int(rng.integers(1, nblk + 1))
         fn = lambda bx: bx % m
         ok = m <= nblk
+    elif kind == "div":
+        d = int(rng.integers(1, 4))
+        fn = lambda bx: bx // d
+        ok = (g - 1) // d < nblk
     else:
         # swizzle over an even grid: (bx // 2) + (bx % 2) * (g // 2)
         fn = lambda bx: (bx // 2) + (bx % 2) * (g // 2)
