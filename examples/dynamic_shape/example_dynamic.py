@@ -49,6 +49,23 @@ def matmul_bucketed(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.asarray(matmul_dyn(a, b))[:m]
 
 
+# Since round 3 the manual padding above is built in:
+# lazy_jit(dynamic_bucket=N) rounds dyn dims up, zero-pads inputs, and
+# slices dyn output dims back — one decorator kwarg instead of a wrapper.
+@tilelang.lazy_jit(out_idx=[2], dynamic_bucket=BM)
+def matmul_auto(A: T.Tensor((M, K), "float32"),
+                B: T.Tensor((K, N), "float32"),
+                C: T.Tensor((M, N), "float32")):
+    with T.Kernel(T.ceildiv(M, BM), T.ceildiv(N, 128)) as (bx, by):
+        A_s = T.alloc_shared((BM, K), "float32")
+        B_s = T.alloc_shared((K, 128), "float32")
+        C_l = T.alloc_fragment((BM, 128), "float32")
+        T.copy(A[bx * BM, 0], A_s)
+        T.copy(B[0, by * 128], B_s)
+        T.gemm(A_s, B_s, C_l, clear_accum=True)
+        T.copy(C_l, C[bx * BM, by * 128])
+
+
 def main():
     rng = np.random.default_rng(0)
     b = rng.standard_normal((K, N), dtype=np.float32)
@@ -60,6 +77,15 @@ def main():
               f"({len(matmul_dyn._kernels)} kernels compiled)")
     # 100→128 and 999/777→1024 share buckets: only 3 kernels for 5 shapes
     assert len(matmul_dyn._kernels) == 3
+
+    # built-in bucketing: same shapes through dynamic_bucket=BM
+    for m in (64, 100, 999):
+        a = rng.standard_normal((m, K), dtype=np.float32)
+        c = np.asarray(matmul_auto(a, b))
+        assert c.shape == (m, N)
+        np.testing.assert_allclose(c, a @ b, rtol=1e-2, atol=1e-1)
+    print(f"dynamic_bucket=BM: {len(matmul_auto._kernels)} kernels "
+          f"for 3 shapes")
 
 
 if __name__ == "__main__":
