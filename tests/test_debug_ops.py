@@ -1,0 +1,52 @@
+"""T.print / T.device_assert coverage (reference testing/python/debug +
+tilelang/language/print.py). Device-side printing lowers to
+pl.debug_print; asserts lower to a guarded debug_print (Mosaic has no
+trap op) — both must compile, run, and leave numerics untouched.
+"""
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+M, N = 8, 128
+
+
+def test_print_buffer_and_scalar_compile_and_run():
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            T.print(s, msg="tile")
+            T.print(bx, msg="grid idx")
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] + 1.0
+            T.copy(s, O)
+
+    kern = tilelang.compile(k)
+    src = kern.get_kernel_source()
+    assert src.count("pl.debug_print") == 2
+    a = np.random.default_rng(0).standard_normal((M, N)).astype(np.float32)
+    out = np.empty_like(a)
+    kern(a, out)
+    np.testing.assert_allclose(out, a + 1.0, rtol=1e-6)
+
+
+def test_device_assert_guards_without_perturbing_numerics():
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            T.device_assert(bx >= 0, "grid index sane")
+            T.device_assert(bx > 100, "always fails (prints, no trap)")
+            T.copy(s, O)
+
+    kern = tilelang.compile(k)
+    src = kern.get_kernel_source()
+    assert "DEVICE ASSERT FAILED" in src
+    a = np.random.default_rng(1).standard_normal((M, N)).astype(np.float32)
+    out = np.empty_like(a)
+    kern(a, out)
+    np.testing.assert_allclose(out, a, rtol=1e-6)
