@@ -433,3 +433,30 @@ def test_mesh_analyzer_rooflines_collectives():
     res2 = Analyzer.analysis_mesh(art2)
     assert res2.n_collectives == 1
     assert res2.comm_ms < res.comm_ms
+
+
+def test_comm_cost_contract():
+    """comm_cost: per-hop wire payloads, zero-cost barriers, and a loud
+    error for unknown collective types (no silent mis-costing)."""
+    import pytest as _pytest
+
+    from tilelang_mesh_tpu.ir import (Buffer, CommAllReduce, CommBarrier,
+                                      CommStmt, Region)
+    from tilelang_mesh_tpu.parallel.lowering import (MeshLowerError,
+                                                     comm_cost)
+
+    buf = Buffer("b", (8, 128), "float32", "fragment")
+    out = Buffer("o", (8, 1), "float32", "fragment")
+    ar = CommAllReduce(Region(buf, (0, 0), (8, 128)),
+                       Region(out, (0, 0), (8, 1)), "sum", 2, 1, True)
+    hops, payload = comm_cost(ar, 2, 4)
+    assert payload == 8 * 1 * 4          # the reduced chunk, not the input
+    assert hops == 28                    # matches the golden schedule
+
+    assert comm_cost(CommBarrier(), 2, 4) == (0, 0)
+
+    class Mystery(CommStmt):
+        pass
+
+    with _pytest.raises(MeshLowerError, match="no cost model"):
+        comm_cost(Mystery(), 2, 4)
