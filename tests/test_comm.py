@@ -438,8 +438,6 @@ def test_mesh_analyzer_rooflines_collectives():
 def test_comm_cost_contract():
     """comm_cost: per-hop wire payloads, zero-cost barriers, and a loud
     error for unknown collective types (no silent mis-costing)."""
-    import pytest as _pytest
-
     from tilelang_mesh_tpu.ir import (Buffer, CommAllReduce, CommBarrier,
                                       CommStmt, Region)
     from tilelang_mesh_tpu.parallel.lowering import (MeshLowerError,
@@ -458,5 +456,5 @@ def test_comm_cost_contract():
     class Mystery(CommStmt):
         pass
 
-    with _pytest.raises(MeshLowerError, match="no cost model"):
+    with pytest.raises(MeshLowerError, match="no cost model"):
         comm_cost(Mystery(), 2, 4)
