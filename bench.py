@@ -602,6 +602,34 @@ def run_config(name, build, peaks, rounds=3):
     return rec
 
 
+def _watchdog(fn, what: str, timeout_s: float):
+    """Run fn() on a daemon thread, bounded by timeout_s: a worker that
+    dies mid-call HANGS the jax call (no error), so abandoning the
+    thread is the only way to keep the bench moving. Fast failures are
+    relayed as themselves; a hang raises TimeoutError naming `what`."""
+    import queue
+    import threading
+    qq: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _t():
+        try:
+            qq.put((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            qq.put((False, e))
+
+    t = threading.Thread(target=_t, daemon=True)
+    t.start()
+    try:
+        ok, val = qq.get(timeout=timeout_s)
+    except queue.Empty:
+        raise TimeoutError(
+            f"{what} exceeded {timeout_s:.0f}s (worker wedged?); "
+            f"abandoned") from None
+    if not ok:
+        raise val
+    return val
+
+
 def _probe_device(timeout_s: float):
     """(ok, error) after a trivial computation, bounded by timeout.
     A kernel fault kills the tunnel's worker for many minutes and a
@@ -658,7 +686,6 @@ def main():
                 "vs_baseline": 0.0, "error": perr}), flush=True)
             sys.exit(1)
 
-    peaks = _chip_peak_tflops()
     q = args.quick
     configs = [
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
@@ -683,11 +710,40 @@ def main():
         keep = set(args.only.split(","))
         configs = [(n, b) for n, b in configs if n in keep]
 
+    try:
+        cfg_timeout = float(
+            os.environ.get("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800))
+    except ValueError:
+        cfg_timeout = 1800.0
+    if cfg_timeout <= 0:
+        cfg_timeout = 1800.0   # the watchdog cannot be disabled: a
+        # wedged worker would hang the driver's bench forever
+
+    try:
+        peaks = _watchdog(_chip_peak_tflops, "device model probe",
+                          cfg_timeout)
+    except Exception as e:
+        print(json.dumps({
+            "metric": "bench", "value": 0.0, "unit": "TFLOPS",
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"}),
+            flush=True)
+        sys.exit(1)
+
+    def _run_bounded(name, build):
+        """Per-config watchdog: a worker that dies MID-RUN hangs the jax
+        call (no error), which would wedge the whole bench; a daemon
+        thread bounds each config so partial results still print. A
+        wedged thread keeps the backend lock, so later configs time out
+        quickly rather than hang — bounded total time either way."""
+        return _watchdog(
+            lambda: run_config(name, build, peaks, rounds=1 if q else 3),
+            f"config {name}", cfg_timeout)
+
     results = []
     headline = None
     for name, build in configs:
         try:
-            rec = run_config(name, build, peaks, rounds=1 if q else 3)
+            rec = _run_bounded(name, build)
             results.append(rec)
             if name == "gemm_large":
                 headline = rec
@@ -710,6 +766,11 @@ def main():
     headline["n_configs_ok"] = len(ok)
     headline["n_configs_failed"] = len(configs) - len(ok)
     print(json.dumps(headline), flush=True)
+    # abandoned watchdog threads may still sit inside native jax calls;
+    # interpreter finalization with such threads can abort the process
+    # AFTER the results printed — exit hard instead
+    sys.stdout.flush()
+    os._exit(0 if len(ok) == len(configs) else 2)
 
 
 if __name__ == "__main__":
