@@ -598,7 +598,6 @@ def run_config(name, build, peaks, rounds=3):
         "baseline_ms": round(dt_r * 1e3, 4),
         "config": name,
     }
-    print(json.dumps(rec), flush=True)
     return rec
 
 
@@ -631,34 +630,25 @@ def _watchdog(fn, what: str, timeout_s: float):
 
 
 def _probe_device(timeout_s: float):
-    """(ok, error) after a trivial computation, bounded by timeout.
-    A kernel fault kills the tunnel's worker for many minutes and a
-    backend-init attempt then HANGS (not errors); probing on a daemon
-    thread lets the bench abort with a diagnostic line instead of
+    """(ok, error) after a trivial computation, bounded by timeout via
+    _watchdog. A kernel fault kills the tunnel's worker for many minutes
+    and a backend-init attempt then HANGS (not errors); abandoning the
+    probe thread lets the bench abort with a diagnostic line instead of
     wedging the driver. A fast local failure (broken jax install) is
     reported as itself, not as a timeout."""
-    import threading
-    ok = [False]
-    err = [None]
+    def _p():
+        import jax.numpy as jnp
+        jnp.ones((8, 128)).sum().block_until_ready()
 
-    def _t():
-        try:
-            import jax.numpy as jnp
-            jnp.ones((8, 128)).sum().block_until_ready()
-            ok[0] = True
-        except Exception as e:  # relayed in the JSON error line
-            err[0] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=_t, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if ok[0]:
+    try:
+        _watchdog(_p, "device probe", timeout_s)
         return True, None
-    if err[0] is not None:
-        return False, f"device probe failed: {err[0]}"
-    return False, (f"TPU backend unreachable within {timeout_s:.0f}s "
-                   f"(tunnel worker down? a prior kernel fault keeps it "
-                   f"dead for 20+ min)")
+    except TimeoutError:
+        return False, (f"TPU backend unreachable within {timeout_s:.0f}s "
+                       f"(tunnel worker down? a prior kernel fault keeps "
+                       f"it dead for 20+ min)")
+    except Exception as e:
+        return False, f"device probe failed: {type(e).__name__}: {e}"
 
 
 def main():
@@ -744,6 +734,10 @@ def main():
     for name, build in configs:
         try:
             rec = _run_bounded(name, build)
+            # print HERE, not inside run_config: an abandoned watchdog
+            # thread that later un-wedges must not emit a late success
+            # line for a config already reported as timed out
+            print(json.dumps(rec), flush=True)
             results.append(rec)
             if name == "gemm_large":
                 headline = rec
@@ -770,7 +764,9 @@ def main():
     # interpreter finalization with such threads can abort the process
     # AFTER the results printed — exit hard instead
     sys.stdout.flush()
-    os._exit(0 if len(ok) == len(configs) else 2)
+    os._exit(0)  # partial success stays green (n_configs_failed is in
+    # the headline JSON); abandoned watchdog threads must not abort
+    # interpreter finalization after the results are out
 
 
 if __name__ == "__main__":
