@@ -458,3 +458,12 @@ def test_profiler_trace_capture(tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert found, "no trace files captured"
+
+
+def test_get_lowered_levels():
+    k = tilelang.compile(_scale_func())
+    s = k.get_lowered("stablehlo")
+    assert "module" in s
+    assert s == k.get_lowered_hlo()
+    with pytest.raises(ValueError, match="mosaic | optimized_hlo"):
+        k.get_lowered("ptx")
