@@ -458,3 +458,77 @@ def test_comm_cost_contract():
 
     with pytest.raises(MeshLowerError, match="no cost model"):
         comm_cost(Mystery(), 2, 4)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_collective_chains(seed):
+    """Randomized sequences of shape-preserving collectives (broadcast /
+    put) chained through ping-pong buffers in ONE kernel, executed on
+    the 8-device mesh and checked against a per-core numpy model —
+    composition coverage beyond the single-collective exec tests."""
+    rng = np.random.default_rng(3000 + seed)
+    n_ops = int(rng.integers(2, 5))
+    ops = []
+    for _ in range(n_ops):
+        kind = rng.choice(["broadcast", "put"])
+        src = (int(rng.integers(0, NROW)), int(rng.integers(0, NCOL)))
+        if kind == "broadcast":
+            d = str(rng.choice(["h", "v", "all"]))
+            ops.append(("broadcast", src, d))
+        else:
+            dst = (int(rng.integers(0, NROW)), int(rng.integers(0, NCOL)))
+            ops.append(("put", src, dst))
+
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared(SHAPE, "float32")
+                y = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, x)
+                for op in ops:
+                    # seed dst with the local value: collectives define
+                    # dst only on participating cores
+                    T.copy(x, y)
+                    if op[0] == "broadcast":
+                        T.comm.broadcast(x, y, op[1], op[2])
+                    else:
+                        T.comm.put(x, y, op[1], op[2])
+                    T.comm.barrier()
+                    T.copy(y, x)
+                T.copy(x, B)
+        kern = _compile(k)
+
+    rng2 = np.random.default_rng(seed)
+    a = _shards(rng2)
+    out = np.asarray(kern(a))
+
+    # numpy per-core model
+    state = {(r, c): _core_shard(a, r, c).copy()
+             for r in range(NROW) for c in range(NCOL)}
+    for op in ops:
+        new = {rc: v.copy() for rc, v in state.items()}
+        if op[0] == "broadcast":
+            (r0, c0), d = op[1], op[2]
+            val = state[(r0, c0)]
+            for r in range(NROW):
+                for c in range(NCOL):
+                    if (d == "h" and r == r0) or (d == "v" and c == c0) \
+                            or d == "all":
+                        new[(r, c)] = val.copy()
+        else:
+            src, dst = op[1], op[2]
+            new[dst] = state[src].copy()
+        state = new
+    for r in range(NROW):
+        for c in range(NCOL):
+            got = out[(r * NCOL + c) * SHAPE[0]:
+                      (r * NCOL + c + 1) * SHAPE[0]]
+            np.testing.assert_allclose(
+                got, state[(r, c)], rtol=1e-6, atol=1e-6,
+                err_msg=f"core ({r},{c}) after {ops}")
