@@ -666,6 +666,11 @@ def main():
                     help="seconds to wait for the TPU before aborting "
                          "with a diagnostic JSON line; <= 0 skips the "
                          "probe")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 2 if ANY config failed (CI mode); the "
+                         "default keeps partial sweeps green so a dead "
+                         "tunnel worker late in the run cannot zero the "
+                         "whole capture")
     args = ap.parse_args()
 
     if args.probe_timeout > 0:
@@ -757,16 +762,19 @@ def main():
                    / len(ok))
     headline = dict(headline or ok[0])
     headline["geomean_vs_baseline"] = round(geo, 4)
+    n_failed = len(configs) - len(ok)
     headline["n_configs_ok"] = len(ok)
-    headline["n_configs_failed"] = len(configs) - len(ok)
+    headline["n_configs_failed"] = n_failed
     print(json.dumps(headline), flush=True)
     # abandoned watchdog threads may still sit inside native jax calls;
     # interpreter finalization with such threads can abort the process
     # AFTER the results printed — exit hard instead
     sys.stdout.flush()
-    os._exit(0)  # partial success stays green (n_configs_failed is in
-    # the headline JSON); abandoned watchdog threads must not abort
-    # interpreter finalization after the results are out
+    # hard exit either way: abandoned watchdog threads must not abort
+    # interpreter finalization after the results are out. Default keeps
+    # partial sweeps green (driver capture mode; n_configs_failed is in
+    # the headline JSON); --strict (CI) fails the job on any config loss.
+    os._exit(2 if (args.strict and n_failed) else 0)
 
 
 if __name__ == "__main__":

@@ -196,8 +196,9 @@ def plan_locals(plan) -> set:
                     disq.add(uid)
                     rec(uid, "use", phase, scope)
         elif isinstance(s, CommStmt):
-            for at in ("src", "dst"):
-                r = getattr(s, at, None)
+            # every Region-valued operand (src/dst, send/recv, buffer/out)
+            # needs a real ref for comm lowering — never SSA-promote it
+            for r in vars(s).values():
                 if isinstance(r, Region):
                     disq.add(r.buffer.uid)
 

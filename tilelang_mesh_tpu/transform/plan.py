@@ -677,9 +677,10 @@ def _copy_only_uids(stmts: List[Stmt], params: List["ParamPlan"]) -> set:
             return
         if isinstance(x, CommStmt):
             # comm lowering is planned against the param's residency;
-            # never demote a collective operand behind its back
-            for at in ("src", "dst"):
-                r = getattr(x, at, None)
+            # never demote a collective operand behind its back. Walk every
+            # Region-valued attribute (src/dst, all_gather's send/recv,
+            # all_reduce's buffer/out, and any future variant).
+            for r in vars(x).values():
                 if isinstance(r, Region) and r.buffer.scope == "global":
                     bad.add(r.buffer.uid)
             return

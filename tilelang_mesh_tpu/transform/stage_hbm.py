@@ -34,15 +34,26 @@ from typing import Dict, List, Optional, Tuple
 from ..ir import (AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer, BufferLoad,
                   BufferStoreStmt, CopyStmt, CumSumStmt, FillStmt, ForNest,
                   GemmStmt, IfThenElse, PrintStmt, Region, SeqStmt, Stmt,
-                  as_int, convert)
+                  as_int, convert, for_each_load)
 from ..ir.expr import BinOp, Call, Cast, Var
 from ..ir.printer import expr_str
+
+# attribute names that denote a WRITTEN Region on a statement: plain dst
+# plus the comm destinations (all_gather recv, all_reduce out). One
+# constant shared by the hazard scan and the cache-invalidation walk so
+# the two analyses cannot disagree.
+_WRITE_REGION_ATTRS = ("dst", "recv", "out")
 
 
 class _Stager:
     def __init__(self, any_uids: set):
         self.any_uids = any_uids
         self.new_allocs: List[Buffer] = []
+        # uids whose staging is declined inside the current T.Parallel
+        # nest: a read-after-store of the same any-param would see the
+        # stale pre-nest copy (stores flush only post-nest), so those
+        # buffers keep the loud codegen error instead
+        self._declined: set = set()
         self._n = 0
 
     # -- staging-buffer factory ---------------------------------------------
@@ -149,7 +160,7 @@ class _Stager:
             idx = tuple(i if isinstance(i, slice)
                         else self.rewrite_expr(i, par_ids, pre, cache)
                         for i in e.indices)
-            if e.buffer.scope == "global" and e.buffer.uid in self.any_uids:
+            if self._is_any(e.buffer):
                 staged = self.stage_load(BufferLoad(e.buffer, idx),
                                          par_ids, pre, cache)
                 if staged is not None:
@@ -188,7 +199,122 @@ class _Stager:
 
     def _is_any(self, region_or_buf) -> bool:
         buf = getattr(region_or_buf, "buffer", region_or_buf)
-        return buf.scope == "global" and buf.uid in self.any_uids
+        return (buf.scope == "global" and buf.uid in self.any_uids
+                and buf.uid not in self._declined)
+
+    # -- read-after-store hazard scan ---------------------------------------
+    def _par_hazard_uids(self, stmts: List[Stmt]) -> set:
+        """Any-param uids read AFTER being stored inside one T.Parallel
+        body. Staged reads are hoisted pre-nest and staged stores flush
+        post-nest, so such a read would silently see the stale pre-nest
+        window; staging is declined for those buffers."""
+        written: set = set()
+        hazard: set = set()
+
+        def raw_any(buf) -> bool:
+            return buf.scope == "global" and buf.uid in self.any_uids
+
+        def expr_reads(e, acc):
+            for_each_load(
+                e, lambda ld: acc.add(ld.buffer.uid)
+                if raw_any(ld.buffer) else None)
+
+        def reg_uid(r, reads):
+            """Classify a region operand; its base indices are READS."""
+            if not isinstance(r, Region):
+                return None
+            for b in r.base:
+                if not isinstance(b, slice):
+                    expr_reads(b, reads)
+            if raw_any(r.buffer):
+                return r.buffer.uid
+            return None
+
+        def note(reads: set, writes: set):
+            hazard.update(reads & written)
+            written.update(writes)
+
+        def scan(s):
+            reads: set = set()
+            writes: set = set()
+            if isinstance(s, BufferStoreStmt):
+                expr_reads(s.value, reads)
+                for i in s.indices:
+                    if not isinstance(i, slice):
+                        expr_reads(i, reads)
+                if raw_any(s.buffer):
+                    writes.add(s.buffer.uid)
+                note(reads, writes)
+            elif isinstance(s, FillStmt):
+                expr_reads(s.value, reads)
+                u = reg_uid(s.dst, reads)
+                if u is not None:
+                    writes.add(u)
+                note(reads, writes)
+            elif isinstance(s, CopyStmt):
+                u = reg_uid(s.src, reads)
+                if u is not None:
+                    reads.add(u)
+                u = reg_uid(s.dst, reads)
+                if u is not None:
+                    writes.add(u)
+                note(reads, writes)
+            elif isinstance(s, AtomicStmt):
+                if isinstance(s.value, Region):
+                    u = reg_uid(s.value, reads)
+                    if u is not None:
+                        reads.add(u)
+                else:
+                    expr_reads(s.value, reads)
+                u = reg_uid(s.dst, reads)
+                if u is not None:
+                    reads.add(u)  # rmw
+                    writes.add(u)
+                note(reads, writes)
+            elif isinstance(s, GemmStmt):
+                for r in (s.A, s.B):
+                    u = reg_uid(r, reads)
+                    if u is not None:
+                        reads.add(u)
+                u = reg_uid(s.C, reads)
+                if u is not None:
+                    reads.add(u)  # accumulator rmw
+                    writes.add(u)
+                note(reads, writes)
+            elif isinstance(s, IfThenElse):
+                expr_reads(s.cond, reads)
+                note(reads, set())
+                for b in (s.then_body, s.else_body):
+                    if b is not None:
+                        for c in b.stmts:
+                            scan(c)
+            elif isinstance(s, ForNest):
+                for e in s.extents:
+                    expr_reads(e, reads)
+                note(reads, set())
+                for c in s.body.stmts:
+                    scan(c)
+            elif isinstance(s, SeqStmt):
+                for c in s.stmts:
+                    scan(c)
+            else:
+                # unknown statement kinds: any Region attr whose name
+                # suggests a destination is a write, the rest are reads;
+                # expression attrs are reads
+                for at, v in vars(s).items():
+                    if isinstance(v, Region) and raw_any(v.buffer):
+                        if at in _WRITE_REGION_ATTRS:
+                            writes.add(v.buffer.uid)
+                        else:
+                            reads.add(v.buffer.uid)
+                    elif at in ("value", "cond") and not isinstance(
+                            v, (Region, Stmt, str, type(None))):
+                        expr_reads(v, reads)
+                note(reads, writes)
+
+        for s in stmts:
+            scan(s)
+        return hazard
 
     # -- statement rewriting -------------------------------------------------
     def _writes_any_param(self, s: Stmt) -> bool:
@@ -198,7 +324,9 @@ class _Stager:
         hit = [False]
 
         def chk(x):
-            for at in ("dst",):
+            # 'dst' plus the comm destinations (all_gather recv,
+            # all_reduce out) — any of them overwrites an any-param
+            for at in _WRITE_REGION_ATTRS:
                 r = getattr(x, at, None)
                 if isinstance(r, Region) and self._is_any(r):
                     hit[0] = True
@@ -254,9 +382,15 @@ class _Stager:
                     for v, e in zip(s.loop_vars, s.extents):
                         inner[id(v)] = as_int(e)
                 body_pre, body_post = [], []
-                s.body.stmts = self._rewrite_par_body(
-                    list(s.body.stmts), inner, body_pre, body_post,
-                    guarded=dyn)
+                declined = self._par_hazard_uids(list(s.body.stmts))
+                saved = self._declined
+                self._declined = saved | declined
+                try:
+                    s.body.stmts = self._rewrite_par_body(
+                        list(s.body.stmts), inner, body_pre, body_post,
+                        guarded=dyn)
+                finally:
+                    self._declined = saved
                 # window copies are loop-invariant w.r.t. the nest: hoist
                 return body_pre + [s] + body_post
             s.body.stmts = self.rewrite_stmts(list(s.body.stmts), par_ids)
