@@ -651,6 +651,12 @@ def _probe_device(timeout_s: float):
         return False, f"device probe failed: {type(e).__name__}: {e}"
 
 
+def exit_code(strict: bool, n_failed: int) -> int:
+    """Process exit code policy: partial sweeps stay green (driver
+    capture mode) unless --strict (CI) is set and a config failed."""
+    return 2 if (strict and n_failed) else 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -771,10 +777,8 @@ def main():
     # AFTER the results printed — exit hard instead
     sys.stdout.flush()
     # hard exit either way: abandoned watchdog threads must not abort
-    # interpreter finalization after the results are out. Default keeps
-    # partial sweeps green (driver capture mode; n_configs_failed is in
-    # the headline JSON); --strict (CI) fails the job on any config loss.
-    os._exit(2 if (args.strict and n_failed) else 0)
+    # interpreter finalization after the results are out
+    os._exit(exit_code(args.strict, n_failed))
 
 
 if __name__ == "__main__":

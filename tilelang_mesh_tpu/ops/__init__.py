@@ -8,6 +8,7 @@ from .gemm import matmul, matmul_kernel
 from .flash_attention import (flash_attention, mha_fwd_kernel,
                               flash_attention_partial)
 from .flash_attention_bwd import flash_attention_bwd
+from .flash_attention_varlen import flash_attention_varlen
 from .flash_decoding import flash_decode, flash_decode_paged
 from .mla import mla_decode, mla_decode_reference
 from .dequant_gemm import dequant_matmul, dequant_gemm_kernel

@@ -146,6 +146,45 @@ def flash_attention_partial(q, k, v, causal, sm_scale, block_M=128,
     return kern(q, k, v)
 
 
+def _make_attention_vjp(kernel_call, partial_call, bwd_call, reference_fn,
+                        backward):
+    """Shared custom-vjp scaffolding for the attention family (MHA here,
+    GQA in ops/gqa.py): kernel mode normalizes the partial kernel's
+    (acc, m, l), saves lse2 = m + log2(l) for the backward tile kernels;
+    reference mode rematerializes through jax AD of the dense graph."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def fa(q, k, v):
+        return kernel_call(q, k, v)
+
+    if backward not in ("kernel", "reference"):
+        raise ValueError(
+            f"backward must be 'kernel' or 'reference', got {backward!r}")
+    if backward == "kernel":
+        def fwd(q, k, v):
+            acc, m, l = partial_call(q, k, v)
+            o = (acc / l[..., None]).astype(q.dtype)
+            lse2 = m + jnp.log2(l)
+            return o, (q, k, v, o, lse2)
+
+        def bwd(res, g):
+            q, k, v, o, lse2 = res
+            return bwd_call(q, k, v, o, lse2, g)
+    else:
+        def fwd(q, k, v):
+            return fa(q, k, v), (q, k, v)
+
+        def bwd(res, g):
+            q, k, v = res
+            _, vjp = jax.vjp(reference_fn, q, k, v)
+            return vjp(g)
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     import jax.numpy as jnp
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -187,35 +226,19 @@ def flash_attention(q, k, v, causal: bool = False,
     kernel = _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, bool(causal),
                              float(sm_scale), dtype, num_stages)
 
-    @jax.custom_vjp
-    def fa(q, k, v):
-        return kernel(q, k, v)
+    def _bwd(q, k, v, o, lse2, g):
+        from .flash_attention_bwd import flash_attention_bwd
+        return flash_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale,
+                                   block_M, block_N)
 
-    if backward == "kernel":
-        def fwd(q, k, v):
-            acc, m, l = flash_attention_partial(q, k, v, causal, sm_scale,
-                                                block_M, block_N, num_stages)
-            o = (acc / l[..., None]).astype(q.dtype)
-            lse2 = m + jnp.log2(l)
-            return o, (q, k, v, o, lse2)
-
-        def bwd(res, g):
-            from .flash_attention_bwd import flash_attention_bwd
-            q, k, v, o, lse2 = res
-            return flash_attention_bwd(q, k, v, o, lse2, g, causal,
-                                       sm_scale, block_M, block_N)
-    else:
-        def fwd(q, k, v):
-            return fa(q, k, v), (q, k, v)
-
-        def bwd(res, g):
-            q, k, v = res
-            _, vjp = jax.vjp(
-                lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
-                                                        sm_scale), q, k, v)
-            return vjp(g)
-
-    fa.defvjp(fwd, bwd)
+    fa = _make_attention_vjp(
+        kernel,
+        lambda q, k, v: flash_attention_partial(q, k, v, causal, sm_scale,
+                                                block_M, block_N,
+                                                num_stages),
+        _bwd,
+        lambda q, k, v: _reference_attention(q, k, v, causal, sm_scale),
+        backward)
     return fa(q, k, v)
 
 

@@ -12,141 +12,25 @@ block map (no atomics, which TPU lacks):
 
 All probabilities use the exp2 domain with L = m + log2(l); the chain rule
 factors ln2 * log2e == 1, so dlogits = P * (dP - delta) * sm_scale exactly.
+
+MHA is the group == 1 case of the grouped-query kernels in ops/gqa_bwd.py
+(single home for the backward loops; the GQA kernels emit plain MHA
+indices when group == 1), so the builders below just delegate.
 """
 
-import functools
-import math
 
-import tilelang_mesh_tpu.language as T
-from ..jit import compile as _tl_compile
-from .flash_attention import _always
-
-_LOG2E = 1.44269504
-
-
-@functools.lru_cache(maxsize=None)
 def mha_bwd_dkdv_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
                         dtype, num_stages=2):
-    scale2 = sm_scale * _LOG2E
-
-    @T.prim_func
-    def dkdv(Q: T.Tensor((B, H, Sq, D), dtype),
-             K: T.Tensor((B, H, Sk, D), dtype),
-             V: T.Tensor((B, H, Sk, D), dtype),
-             dO: T.Tensor((B, H, Sq, D), dtype),
-             L: T.Tensor((B, H, Sq), "float32"),
-             Delta: T.Tensor((B, H, Sq), "float32"),
-             dK: T.Tensor((B, H, Sk, D), "float32"),
-             dV: T.Tensor((B, H, Sk, D), "float32")):
-        with T.Kernel(T.ceildiv(Sk, block_N), H, B) as (bx, by, bz):
-            K_s = T.alloc_shared((block_N, D), dtype)
-            V_s = T.alloc_shared((block_N, D), dtype)
-            Q_s = T.alloc_shared((block_M, D), dtype)
-            dO_s = T.alloc_shared((block_M, D), dtype)
-            L_s = T.alloc_shared((block_M,), "float32")
-            De_s = T.alloc_shared((block_M,), "float32")
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            P = T.alloc_fragment((block_M, block_N), dtype)
-            dP = T.alloc_fragment((block_M, block_N), "float32")
-            dS = T.alloc_fragment((block_M, block_N), dtype)
-            dK_a = T.alloc_fragment((block_N, D), "float32")
-            dV_a = T.alloc_fragment((block_N, D), "float32")
-
-            T.copy(K[bz, by, bx * block_N, 0], K_s)
-            T.copy(V[bz, by, bx * block_N, 0], V_s)
-            T.fill(dK_a, 0)
-            T.fill(dV_a, 0)
-
-            for qb in T.Pipelined(T.ceildiv(Sq, block_M),
-                                  num_stages=num_stages):
-                # causal: this KV block only sees q rows >= its first key
-                with T.If(qb * block_M + (block_M - 1) >= bx * block_N) \
-                        if causal else _always():
-                    T.copy(Q[bz, by, qb * block_M, 0], Q_s)
-                    T.copy(dO[bz, by, qb * block_M, 0], dO_s)
-                    T.copy(L[bz, by, qb * block_M], L_s)
-                    T.copy(Delta[bz, by, qb * block_M], De_s)
-                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    if causal:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                qb * block_M + i >= bx * block_N + j,
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.exp2(S[i, j] * scale2 - L_s[i])
-                    T.copy(S, P)
-                    # dV += P^T dO
-                    T.gemm(P, dO_s, dV_a, transpose_A=True)
-                    # dP = dO V^T
-                    T.gemm(dO_s, V_s, dP, transpose_B=True,
-                           clear_accum=True)
-                    for i, j in T.Parallel(block_M, block_N):
-                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
-                    # dK += dS^T Q
-                    T.gemm(dS, Q_s, dK_a, transpose_A=True)
-
-            T.copy(dK_a, dK[bz, by, bx * block_N, 0])
-            T.copy(dV_a, dV[bz, by, bx * block_N, 0])
-
-    return _tl_compile(dkdv)
+    from .gqa_bwd import gqa_bwd_dkdv_kernel
+    return gqa_bwd_dkdv_kernel(B, H, H, Sq, Sk, D, block_M, block_N,
+                               causal, sm_scale, dtype, num_stages)
 
 
-@functools.lru_cache(maxsize=None)
 def mha_bwd_dq_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
                       dtype, num_stages=2):
-    scale2 = sm_scale * _LOG2E
-
-    @T.prim_func
-    def dq(Q: T.Tensor((B, H, Sq, D), dtype),
-           K: T.Tensor((B, H, Sk, D), dtype),
-           V: T.Tensor((B, H, Sk, D), dtype),
-           dO: T.Tensor((B, H, Sq, D), dtype),
-           L: T.Tensor((B, H, Sq), "float32"),
-           Delta: T.Tensor((B, H, Sq), "float32"),
-           dQ: T.Tensor((B, H, Sq, D), "float32")):
-        with T.Kernel(T.ceildiv(Sq, block_M), H, B) as (bx, by, bz):
-            Q_s = T.alloc_shared((block_M, D), dtype)
-            dO_s = T.alloc_shared((block_M, D), dtype)
-            L_s = T.alloc_shared((block_M,), "float32")
-            De_s = T.alloc_shared((block_M,), "float32")
-            K_s = T.alloc_shared((block_N, D), dtype)
-            V_s = T.alloc_shared((block_N, D), dtype)
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            dP = T.alloc_fragment((block_M, block_N), "float32")
-            dS = T.alloc_fragment((block_M, block_N), dtype)
-            dQ_a = T.alloc_fragment((block_M, D), "float32")
-
-            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
-            T.copy(dO[bz, by, bx * block_M, 0], dO_s)
-            T.copy(L[bz, by, bx * block_M], L_s)
-            T.copy(Delta[bz, by, bx * block_M], De_s)
-            T.fill(dQ_a, 0)
-
-            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
-                                  num_stages=num_stages):
-                with T.If(kb * block_N <= bx * block_M + (block_M - 1)) \
-                        if causal else _always():
-                    T.copy(K[bz, by, kb * block_N, 0], K_s)
-                    T.copy(V[bz, by, kb * block_N, 0], V_s)
-                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    if causal:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                bx * block_M + i >= kb * block_N + j,
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.exp2(S[i, j] * scale2 - L_s[i])
-                    T.gemm(dO_s, V_s, dP, transpose_B=True,
-                           clear_accum=True)
-                    for i, j in T.Parallel(block_M, block_N):
-                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
-                    T.gemm(dS, K_s, dQ_a)
-
-            T.copy(dQ_a, dQ[bz, by, bx * block_M, 0])
-
-    return _tl_compile(dq)
+    from .gqa_bwd import gqa_bwd_dq_kernel
+    return gqa_bwd_dq_kernel(B, H, H, Sq, Sk, D, block_M, block_N,
+                             causal, sm_scale, dtype, num_stages)
 
 
 def flash_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale, block_M=128,

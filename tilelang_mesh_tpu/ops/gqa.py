@@ -11,7 +11,9 @@ import math
 
 import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
-from .flash_attention import _always
+from ._online_softmax import (alloc_softmax_state, init_softmax_state,
+                              online_softmax_update)
+from .flash_attention import _always, _scaled_masked_scores
 
 
 @functools.lru_cache(maxsize=None)
@@ -30,19 +32,10 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
             Q_s = T.alloc_shared((block_M, D), dtype)
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
-            S = T.alloc_fragment((block_M, block_N), "float32")
-            P = T.alloc_fragment((block_M, block_N), dtype)
-            acc = T.alloc_fragment((block_M, D), "float32")
-            m_prev = T.alloc_fragment((block_M,), "float32")
-            m_new = T.alloc_fragment((block_M,), "float32")
-            m_cur = T.alloc_fragment((block_M,), "float32")
-            l = T.alloc_fragment((block_M,), "float32")
-            l_cur = T.alloc_fragment((block_M,), "float32")
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
-            T.fill(acc, 0)
-            T.fill(l, 0)
-            T.fill(m_prev, -T.infinity("float32"))
+            init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
                                   num_stages=num_stages):
@@ -50,30 +43,11 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
                         if causal else _always():
                     T.copy(K[bz, by // group, kb * block_N, 0], K_s)
                     T.copy(V[bz, by // group, kb * block_N, 0], V_s)
-                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
-                    if causal:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                bx * block_M + i >= kb * block_N + j,
-                                S[i, j] * scale, -T.infinity("float32"))
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = S[i, j] * scale
-                    T.reduce_max(S, m_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        m_new[i] = T.max(m_prev[i], m_cur[i])
-                    for i, j in T.Parallel(block_M, block_N):
-                        S[i, j] = T.exp2(S[i, j] - m_new[i])
-                    T.reduce_sum(S, l_cur, dim=1)
-                    for i in T.Parallel(block_M):
-                        l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
-                    for i, j in T.Parallel(block_M, D):
-                        acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
-                    T.copy(S, P)
-                    T.gemm(P, V_s, acc)
-                    for i in T.Parallel(block_M):
-                        m_prev[i] = m_new[i]
+                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                                          kb, block_M, block_N)
+                    online_softmax_update(st, V_s, block_M, block_N, D)
 
+            acc, l = st["acc"], st["l"]
             for i, j in T.Parallel(block_M, D):
                 acc[i, j] = acc[i, j] / l[i]
             T.copy(acc, O[bz, by, bx * block_M, 0])
@@ -81,14 +55,102 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
     return _tl_compile(gqa_fwd)
 
 
+@functools.lru_cache(maxsize=None)
+def gqa_fwd_partial_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
+                           sm_scale, dtype, num_stages=2):
+    """Same online-softmax loop but emits the UNNORMALIZED accumulator and
+    per-row (m, l) stats in the exp2 domain — what the backward kernels
+    (ops/gqa_bwd.py) need to rebuild the softmax from L = m + log2(l)."""
+    assert Hq % Hkv == 0
+    group = Hq // Hkv
+    scale = sm_scale * 1.44269504
+
+    @T.prim_func
+    def gqa_fwd_partial(Q: T.Tensor((B, Hq, Sq, D), dtype),
+                        K: T.Tensor((B, Hkv, Sk, D), dtype),
+                        V: T.Tensor((B, Hkv, Sk, D), dtype),
+                        O: T.Tensor((B, Hq, Sq, D), "float32"),
+                        M: T.Tensor((B, Hq, Sq), "float32"),
+                        L: T.Tensor((B, Hq, Sq), "float32")):
+        with T.Kernel(T.ceildiv(Sq, block_M), Hq, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            st = alloc_softmax_state(block_M, block_N, D, dtype)
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            init_softmax_state(st)
+
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                with T.If(kb * block_N <= bx * block_M + (block_M - 1)) \
+                        if causal else _always():
+                    T.copy(K[bz, by // group, kb * block_N, 0], K_s)
+                    T.copy(V[bz, by // group, kb * block_N, 0], V_s)
+                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                                          kb, block_M, block_N)
+                    online_softmax_update(st, V_s, block_M, block_N, D)
+
+            T.copy(st["acc"], O[bz, by, bx * block_M, 0])
+            T.copy(st["m_prev"], M[bz, by, bx * block_M])
+            T.copy(st["l"], L[bz, by, bx * block_M])
+
+    return _tl_compile(gqa_fwd_partial)
+
+
 def gqa_attention(q, k, v, causal=False, sm_scale=None, block_M=128,
-                  block_N=128):
-    """q (B, Hq, Sq, D); k/v (B, Hkv, Sk, D) with Hkv | Hq."""
+                  block_N=128, backward: str = "kernel"):
+    """Differentiable grouped-query attention on the tile kernels.
+
+    q (B, Hq, Sq, D); k/v (B, Hkv, Sk, D) with Hkv | Hq.
+
+    backward="kernel" (default): forward under AD runs the partial kernel
+    (saving m, l) and the backward runs the group-accumulating dKdV / dQ
+    tile kernels (ops/gqa_bwd.py, cf. reference example_gqa_bwd.py).
+    backward="reference": jax AD through the dense reference (debugging
+    fallback).
+    """
+    from .flash_attention import _make_attention_vjp
+
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    kern = gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, min(block_M, Sq),
-                          min(block_N, Sk), bool(causal), float(sm_scale),
-                          str(q.dtype))
-    return kern(q, k, v)
+    bm, bn = min(block_M, Sq), min(block_N, Sk)
+    kern = gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, bm, bn, bool(causal),
+                          float(sm_scale), str(q.dtype))
+
+    def _partial(q, k, v):
+        pk = gqa_fwd_partial_kernel(B, Hq, Hkv, Sq, Sk, D, bm, bn,
+                                    bool(causal), float(sm_scale),
+                                    str(q.dtype))
+        return pk(q, k, v)
+
+    def _bwd(q, k, v, o, lse2, g):
+        from .gqa_bwd import gqa_attention_bwd
+        return gqa_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale,
+                                 bm, bn)
+
+    fa = _make_attention_vjp(
+        kern, _partial, _bwd,
+        lambda q, k, v: _reference_gqa(q, k, v, causal, sm_scale),
+        backward)
+    return fa(q, k, v)
+
+
+def _reference_gqa(q, k, v, causal, sm_scale):
+    """Dense GQA reference (jax AD-able)."""
+    import jax.numpy as jnp
+    group = q.shape[1] // k.shape[1]
+    k = jnp.repeat(k, group, axis=1)
+    v = jnp.repeat(v, group, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

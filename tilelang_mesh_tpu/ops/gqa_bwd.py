@@ -1,0 +1,176 @@
+"""Grouped-query attention backward as tile kernels.
+
+Behavioral equivalent of the reference's
+examples/flash_attention/example_gqa_bwd.py:1 — dK/dV for a KV head
+accumulate contributions from every query head in its group, softmax is
+recomputed from the forward log-sum-exp.
+
+TPU re-design (no atomics, cf. ops/flash_attention_bwd.py): the dKdV
+kernel grids over (KV blocks, KV heads, batch) so each dK/dV output block
+is written exactly once; the query-head group and the Q-block sweep are
+folded into ONE pipelined axis (t -> (head_in_group, q_block)) so Mosaic
+overlaps the Q/dO/L/Delta fetches of the whole group — where the
+reference accumulates per-warp partials and reduces through shared
+memory/TMA, here the group reduction is just more steps on the pipelined
+axis feeding the same VMEM accumulator. The dQ kernel is the MHA dQ with
+the KV head taken as query_head // group.
+"""
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+from .flash_attention import _always
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def gqa_bwd_dkdv_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
+                        sm_scale, dtype, num_stages=2):
+    assert Hq % Hkv == 0
+    group = Hq // Hkv
+    scale2 = sm_scale * _LOG2E
+    nQ = -(-Sq // block_M)
+
+    @T.prim_func
+    def dkdv(Q: T.Tensor((B, Hq, Sq, D), dtype),
+             K: T.Tensor((B, Hkv, Sk, D), dtype),
+             V: T.Tensor((B, Hkv, Sk, D), dtype),
+             dO: T.Tensor((B, Hq, Sq, D), dtype),
+             L: T.Tensor((B, Hq, Sq), "float32"),
+             Delta: T.Tensor((B, Hq, Sq), "float32"),
+             dK: T.Tensor((B, Hkv, Sk, D), "float32"),
+             dV: T.Tensor((B, Hkv, Sk, D), "float32")):
+        with T.Kernel(T.ceildiv(Sk, block_N), Hkv, B) as (bx, by, bz):
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            dO_s = T.alloc_shared((block_M, D), dtype)
+            L_s = T.alloc_shared((block_M,), "float32")
+            De_s = T.alloc_shared((block_M,), "float32")
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            P = T.alloc_fragment((block_M, block_N), dtype)
+            dP = T.alloc_fragment((block_M, block_N), "float32")
+            dS = T.alloc_fragment((block_M, block_N), dtype)
+            dK_a = T.alloc_fragment((block_N, D), "float32")
+            dV_a = T.alloc_fragment((block_N, D), "float32")
+
+            T.copy(K[bz, by, bx * block_N, 0], K_s)
+            T.copy(V[bz, by, bx * block_N, 0], V_s)
+            T.fill(dK_a, 0)
+            T.fill(dV_a, 0)
+
+            # one pipelined axis sweeping (head-in-group, q-block):
+            # t // nQ selects the query head, t % nQ the Q block
+            # (group == 1, the MHA case, keeps the plain indices)
+            for t in T.Pipelined(group * nQ, num_stages=num_stages):
+                hq = by if group == 1 else by * group + t // nQ
+                qb = t if group == 1 else t % nQ
+                with T.If(qb * block_M + (block_M - 1)
+                          >= bx * block_N) if causal else _always():
+                    T.copy(Q[bz, hq, qb * block_M, 0], Q_s)
+                    T.copy(dO[bz, hq, qb * block_M, 0], dO_s)
+                    T.copy(L[bz, hq, qb * block_M], L_s)
+                    T.copy(Delta[bz, hq, qb * block_M], De_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    if causal:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                qb * block_M + i >= bx * block_N + j,
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.exp2(S[i, j] * scale2 - L_s[i])
+                    T.copy(S, P)
+                    # dV += P^T dO  (accumulates across the whole group)
+                    T.gemm(P, dO_s, dV_a, transpose_A=True)
+                    # dP = dO V^T
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
+                    # dK += dS^T Q
+                    T.gemm(dS, Q_s, dK_a, transpose_A=True)
+
+            T.copy(dK_a, dK[bz, by, bx * block_N, 0])
+            T.copy(dV_a, dV[bz, by, bx * block_N, 0])
+
+    return _tl_compile(dkdv)
+
+
+@functools.lru_cache(maxsize=None)
+def gqa_bwd_dq_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
+                      sm_scale, dtype, num_stages=2):
+    assert Hq % Hkv == 0
+    group = Hq // Hkv
+    scale2 = sm_scale * _LOG2E
+
+    @T.prim_func
+    def dq(Q: T.Tensor((B, Hq, Sq, D), dtype),
+           K: T.Tensor((B, Hkv, Sk, D), dtype),
+           V: T.Tensor((B, Hkv, Sk, D), dtype),
+           dO: T.Tensor((B, Hq, Sq, D), dtype),
+           L: T.Tensor((B, Hq, Sq), "float32"),
+           Delta: T.Tensor((B, Hq, Sq), "float32"),
+           dQ: T.Tensor((B, Hq, Sq, D), "float32")):
+        with T.Kernel(T.ceildiv(Sq, block_M), Hq, B) as (bx, by, bz):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            dO_s = T.alloc_shared((block_M, D), dtype)
+            L_s = T.alloc_shared((block_M,), "float32")
+            De_s = T.alloc_shared((block_M,), "float32")
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            dP = T.alloc_fragment((block_M, block_N), "float32")
+            dS = T.alloc_fragment((block_M, block_N), dtype)
+            dQ_a = T.alloc_fragment((block_M, D), "float32")
+
+            T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            T.copy(dO[bz, by, bx * block_M, 0], dO_s)
+            T.copy(L[bz, by, bx * block_M], L_s)
+            T.copy(Delta[bz, by, bx * block_M], De_s)
+            T.fill(dQ_a, 0)
+
+            hk = by if group == 1 else by // group
+            for kb in T.Pipelined(T.ceildiv(Sk, block_N),
+                                  num_stages=num_stages):
+                with T.If(kb * block_N <= bx * block_M + (block_M - 1)) \
+                        if causal else _always():
+                    T.copy(K[bz, hk, kb * block_N, 0], K_s)
+                    T.copy(V[bz, hk, kb * block_N, 0], V_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    if causal:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                bx * block_M + i >= kb * block_N + j,
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.exp2(S[i, j] * scale2 - L_s[i])
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
+                    T.gemm(dS, K_s, dQ_a)
+
+            T.copy(dQ_a, dQ[bz, by, bx * block_M, 0])
+
+    return _tl_compile(dq)
+
+
+def gqa_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale, block_M=128,
+                      block_N=128):
+    """lse2 = m + log2(l) from the forward partial kernel (exp2 domain)."""
+    import jax.numpy as jnp
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    bm, bn = min(block_M, Sq), min(block_N, Sk)
+    dkdv = gqa_bwd_dkdv_kernel(B, Hq, Hkv, Sq, Sk, D, bm, bn, bool(causal),
+                               float(sm_scale), str(q.dtype))
+    dqk = gqa_bwd_dq_kernel(B, Hq, Hkv, Sq, Sk, D, bm, bn, bool(causal),
+                            float(sm_scale), str(q.dtype))
+    dk, dv = dkdv(q, k, v, g, lse2, delta)
+    dq_ = dqk(q, k, v, g, lse2, delta)
+    return (dq_.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))

@@ -203,95 +203,146 @@ class _Stager:
                 and buf.uid not in self._declined)
 
     # -- read-after-store hazard scan ---------------------------------------
-    def _par_hazard_uids(self, stmts: List[Stmt]) -> set:
+    def _par_hazard_uids(self, stmts: List[Stmt],
+                         par_ids: Dict[int, int]) -> set:
         """Any-param uids read AFTER being stored inside one T.Parallel
-        body. Staged reads are hoisted pre-nest and staged stores flush
-        post-nest, so such a read would silently see the stale pre-nest
-        window; staging is declined for those buffers."""
-        written: set = set()
+        body, where the read window may overlap a stored window. Staged
+        reads are hoisted pre-nest and staged stores flush post-nest, so
+        such a read would silently see the stale pre-nest window; staging
+        is declined for those buffers.
+
+        Window-granular: a read of a window provably DISJOINT from every
+        prior store of the same buffer (affine bases differing by a
+        constant >= the extent along some dim) is not a hazard, so
+        store-block-k / read-block-k±1 nests keep staging."""
+        from ..ir.expr import affine_decompose
+
+        written: Dict[int, list] = {}   # uid -> [window | None(=unknown)]
         hazard: set = set()
 
         def raw_any(buf) -> bool:
             return buf.scope == "global" and buf.uid in self.any_uids
 
-        def expr_reads(e, acc):
-            for_each_load(
-                e, lambda ld: acc.add(ld.buffer.uid)
-                if raw_any(ld.buffer) else None)
+        def win_of_indices(indices):
+            """Elementwise access -> per-dim (sym_terms, const, extent);
+            a par var with coeff 1 spans its extent, other vars join the
+            symbolic base. None = unknown window."""
+            dims = []
+            for idx in indices:
+                if isinstance(idx, slice):
+                    return None
+                dec = affine_decompose(idx)
+                if dec is None:
+                    return None
+                coeffs, const = dec
+                ext = 1
+                sym = []
+                for _, (v, c) in coeffs.items():
+                    if id(v) in par_ids:
+                        if c != 1 or ext != 1:
+                            return None
+                        ext = par_ids[id(v)]
+                    else:
+                        sym.append((v.uid, c))
+                dims.append((tuple(sorted(sym)), const, ext))
+            return dims
 
-        def reg_uid(r, reads):
-            """Classify a region operand; its base indices are READS."""
-            if not isinstance(r, Region):
+        def win_of_region(r: Region):
+            shape = r.static_shape()
+            if shape is None:
                 return None
+            dims = []
+            for b, s in zip(r.base, shape):
+                if isinstance(b, slice):
+                    return None
+                dec = affine_decompose(b)
+                if dec is None:
+                    return None
+                coeffs, const = dec
+                sym = []
+                for _, (v, c) in coeffs.items():
+                    if id(v) in par_ids:
+                        return None   # per-lane dynamic window
+                    sym.append((v.uid, c))
+                dims.append((tuple(sorted(sym)), const, s))
+            return dims
+
+        def disjoint(w1, w2) -> bool:
+            if w1 is None or w2 is None or len(w1) != len(w2):
+                return False
+            for (s1, c1, e1), (s2, c2, e2) in zip(w1, w2):
+                if s1 == s2 and (c1 + e1 <= c2 or c2 + e2 <= c1):
+                    return True
+            return False
+
+        def read(uid, win):
+            for sw in written.get(uid, ()):
+                if not disjoint(win, sw):
+                    hazard.add(uid)
+                    return
+
+        def write(uid, win):
+            written.setdefault(uid, []).append(win)
+
+        def expr_reads(e):
+            def on_load(ld):
+                if raw_any(ld.buffer):
+                    read(ld.buffer.uid, win_of_indices(ld.indices))
+            for_each_load(e, on_load)
+
+        def reg_read(r):
+            if not isinstance(r, Region):
+                return
             for b in r.base:
                 if not isinstance(b, slice):
-                    expr_reads(b, reads)
+                    expr_reads(b)
             if raw_any(r.buffer):
-                return r.buffer.uid
-            return None
+                read(r.buffer.uid, win_of_region(r))
 
-        def note(reads: set, writes: set):
-            hazard.update(reads & written)
-            written.update(writes)
+        def reg_write(r):
+            if not isinstance(r, Region):
+                return
+            for b in r.base:
+                if not isinstance(b, slice):
+                    expr_reads(b)
+            if raw_any(r.buffer):
+                write(r.buffer.uid, win_of_region(r))
 
         def scan(s):
-            reads: set = set()
-            writes: set = set()
             if isinstance(s, BufferStoreStmt):
-                expr_reads(s.value, reads)
+                expr_reads(s.value)
                 for i in s.indices:
                     if not isinstance(i, slice):
-                        expr_reads(i, reads)
+                        expr_reads(i)
                 if raw_any(s.buffer):
-                    writes.add(s.buffer.uid)
-                note(reads, writes)
+                    write(s.buffer.uid, win_of_indices(s.indices))
             elif isinstance(s, FillStmt):
-                expr_reads(s.value, reads)
-                u = reg_uid(s.dst, reads)
-                if u is not None:
-                    writes.add(u)
-                note(reads, writes)
+                expr_reads(s.value)
+                reg_write(s.dst)
             elif isinstance(s, CopyStmt):
-                u = reg_uid(s.src, reads)
-                if u is not None:
-                    reads.add(u)
-                u = reg_uid(s.dst, reads)
-                if u is not None:
-                    writes.add(u)
-                note(reads, writes)
+                reg_read(s.src)
+                reg_write(s.dst)
             elif isinstance(s, AtomicStmt):
                 if isinstance(s.value, Region):
-                    u = reg_uid(s.value, reads)
-                    if u is not None:
-                        reads.add(u)
+                    reg_read(s.value)
                 else:
-                    expr_reads(s.value, reads)
-                u = reg_uid(s.dst, reads)
-                if u is not None:
-                    reads.add(u)  # rmw
-                    writes.add(u)
-                note(reads, writes)
+                    expr_reads(s.value)
+                reg_read(s.dst)   # rmw
+                reg_write(s.dst)
             elif isinstance(s, GemmStmt):
-                for r in (s.A, s.B):
-                    u = reg_uid(r, reads)
-                    if u is not None:
-                        reads.add(u)
-                u = reg_uid(s.C, reads)
-                if u is not None:
-                    reads.add(u)  # accumulator rmw
-                    writes.add(u)
-                note(reads, writes)
+                reg_read(s.A)
+                reg_read(s.B)
+                reg_read(s.C)     # accumulator rmw
+                reg_write(s.C)
             elif isinstance(s, IfThenElse):
-                expr_reads(s.cond, reads)
-                note(reads, set())
+                expr_reads(s.cond)
                 for b in (s.then_body, s.else_body):
                     if b is not None:
                         for c in b.stmts:
                             scan(c)
             elif isinstance(s, ForNest):
                 for e in s.extents:
-                    expr_reads(e, reads)
-                note(reads, set())
+                    expr_reads(e)
                 for c in s.body.stmts:
                     scan(c)
             elif isinstance(s, SeqStmt):
@@ -304,13 +355,12 @@ class _Stager:
                 for at, v in vars(s).items():
                     if isinstance(v, Region) and raw_any(v.buffer):
                         if at in _WRITE_REGION_ATTRS:
-                            writes.add(v.buffer.uid)
+                            reg_write(v)
                         else:
-                            reads.add(v.buffer.uid)
+                            reg_read(v)
                     elif at in ("value", "cond") and not isinstance(
                             v, (Region, Stmt, str, type(None))):
-                        expr_reads(v, reads)
-                note(reads, writes)
+                        expr_reads(v)
 
         for s in stmts:
             scan(s)
@@ -382,7 +432,9 @@ class _Stager:
                     for v, e in zip(s.loop_vars, s.extents):
                         inner[id(v)] = as_int(e)
                 body_pre, body_post = [], []
-                declined = self._par_hazard_uids(list(s.body.stmts))
+                declined = (set() if dyn else
+                            self._par_hazard_uids(list(s.body.stmts),
+                                                  inner))
                 saved = self._declined
                 self._declined = saved | declined
                 try:
