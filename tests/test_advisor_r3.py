@@ -193,3 +193,28 @@ def test_bench_exit_code_policy():
     assert bench.exit_code(strict=False, n_failed=3) == 0
     assert bench.exit_code(strict=True, n_failed=0) == 0
     assert bench.exit_code(strict=True, n_failed=1) == 2
+
+
+def test_no_tpu_effect_annotations_warn_once(caplog):
+    """API-parity hint functions must not silently accept: they validate
+    the builder context and warn once that the hint has no TPU effect."""
+    import logging
+
+    import tilelang_mesh_tpu.language.annotations as ann
+    ann._warned.discard("set_max_nreg")
+    with caplog.at_level(logging.WARNING, logger="tilelang_mesh_tpu"):
+        @T.prim_func
+        def k(A: T.Tensor((8, 128), "float32"),
+              O: T.Tensor((8, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((8, 128), "float32")
+                T.set_max_nreg(240, 1)
+                T.set_max_nreg(240, 1)  # second call must not re-warn
+                T.copy(A, s)
+                T.copy(s, O)
+    warns = [r for r in caplog.records if "set_max_nreg" in r.getMessage()]
+    assert len(warns) == 1, f"expected exactly one warning, got {warns}"
+
+    # outside a kernel: loud error, not silent accept
+    with pytest.raises(Exception):
+        T.set_max_nreg(240, 1)

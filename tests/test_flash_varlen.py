@@ -45,9 +45,7 @@ def _pack(x, lens):
 def _run_case(B, maxq, maxk, Hq, Hkv, D, causal, seed, same_lens=False):
     rng = np.random.default_rng(seed)
     lens_q = rng.integers(1, maxq + 1, B)
-    lens_k = rng.integers(1, maxk + 1, B) if not same_lens else lens_q
-    if same_lens:
-        lens_k = lens_q.copy()
+    lens_k = lens_q.copy() if same_lens else rng.integers(1, maxk + 1, B)
     q = rng.standard_normal((B, maxq, Hq, D)).astype(np.float32)
     k = rng.standard_normal((B, maxk, Hkv, D)).astype(np.float32)
     v = rng.standard_normal((B, maxk, Hkv, D)).astype(np.float32)

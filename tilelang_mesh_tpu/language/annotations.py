@@ -9,9 +9,28 @@ annotations -> checked against the layout engine).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict
 
 from .builder import require_builder
+
+logger = logging.getLogger("tilelang_mesh_tpu")
+_warned: set = set()
+
+
+def _no_tpu_effect(what: str, why: str):
+    """API-parity hint accepted for source compatibility but with no TPU
+    effect: validate that it is called inside a kernel and warn ONCE per
+    process so silent-accept cannot hide a user error (cf. the loud
+    _gpu_only allocs in language/allocate.py)."""
+    def f(*args, **kwargs):
+        require_builder()   # misuse outside a kernel still errors
+        if what not in _warned:
+            _warned.add(what)
+            logger.warning("T.%s has no effect on TPU: %s", what, why)
+    f.__name__ = what
+    f.__doc__ = f"Reference API-parity no-op on TPU: {why}"
+    return f
 
 
 def _annotate(key: str, value):
@@ -40,20 +59,20 @@ def annotate_l2_hit_ratio(buffer, ratio: float):
     _annotate("l2_hit_ratio", (getattr(buffer, "name", buffer), ratio))
 
 
-def annotate_restricted_layout(*args, **kwargs):
-    pass
-
-
-def no_set_max_nreg(*args, **kwargs):
-    pass
-
-
-def set_max_nreg(*args, **kwargs):
-    pass
-
-
-def disable_warp_group_reg_alloc(*args, **kwargs):
-    pass
+annotate_restricted_layout = _no_tpu_effect(
+    "annotate_restricted_layout",
+    "Mosaic owns physical layout; restricted-layout constraints are "
+    "GPU-fragment concepts")
+no_set_max_nreg = _no_tpu_effect(
+    "no_set_max_nreg", "there is no per-thread register file to cap on "
+    "the TPU's vector cores")
+set_max_nreg = _no_tpu_effect(
+    "set_max_nreg", "there is no per-thread register file to cap on the "
+    "TPU's vector cores")
+disable_warp_group_reg_alloc = _no_tpu_effect(
+    "disable_warp_group_reg_alloc",
+    "warpgroup register reallocation is a Hopper construct; TPU has no "
+    "warps")
 
 
 def sync_threads():

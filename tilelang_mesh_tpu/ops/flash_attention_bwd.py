@@ -36,15 +36,6 @@ def mha_bwd_dq_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
 def flash_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale, block_M=128,
                         block_N=128):
     """lse2 = m + log2(l) from the forward partial kernel (exp2 domain)."""
-    import jax.numpy as jnp
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
-    bm, bn = min(block_M, Sq), min(block_N, Sk)
-    dkdv = mha_bwd_dkdv_kernel(B, H, Sq, Sk, D, bm, bn, bool(causal),
-                               float(sm_scale), str(q.dtype))
-    dqk = mha_bwd_dq_kernel(B, H, Sq, Sk, D, bm, bn, bool(causal),
-                            float(sm_scale), str(q.dtype))
-    dk, dv = dkdv(q, k, v, g, lse2, delta)
-    dq_ = dqk(q, k, v, g, lse2, delta)
-    return (dq_.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+    from .gqa_bwd import gqa_attention_bwd
+    return gqa_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale,
+                             block_M, block_N)
