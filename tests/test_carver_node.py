@@ -1,0 +1,187 @@
+"""IR-derived autotune candidates (carver/node.py, the PrimFuncNode
+analog — reference carver/roller/node.py:191): autotune() with neither
+configs= nor template= must classify the traced kernel, reconstruct the
+problem dims from its IR, and produce the same space as the hand
+template."""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.carver import FlashAttentionTemplate, MatmulTemplate
+from tilelang_mesh_tpu.carver.node import analyze_prim_func, derive_template
+
+M, N, K = 256, 512, 384
+
+
+def _gemm_factory(M, N, K, block_M=64, block_N=128, block_K=64):
+    @T.prim_func
+    def mm(A: T.Tensor((M, K), "float32"), B: T.Tensor((K, N), "float32"),
+           C: T.Tensor((M, N), "float32")):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            As = T.alloc_shared((block_M, block_K), "float32")
+            Bs = T.alloc_shared((block_K, block_N), "float32")
+            Cl = T.alloc_fragment((block_M, block_N), "float32")
+            T.fill(Cl, 0.0)
+            for ko in T.Pipelined(T.ceildiv(K, block_K)):
+                T.copy(A[by * block_M, ko * block_K], As)
+                T.copy(B[ko * block_K, bx * block_N], Bs)
+                T.gemm(As, Bs, Cl)
+            T.copy(Cl, C[by * block_M, bx * block_N])
+    return tilelang.compile(mm)
+
+
+def test_gemm_problem_dims_reconstructed():
+    """M/N/K recovered from grid extents x traced tile sizes — including
+    the minor-vs-major disambiguation when dims share a tile size."""
+    k = _gemm_factory(M, N, K)
+    t = derive_template(k.prim_func)
+    assert isinstance(t, MatmulTemplate)
+    assert (t.M, t.N, t.K) == (M, N, K)
+    assert t.in_dtype == "float32"
+
+
+def test_gemm_square_tiles_disambiguated():
+    k = _gemm_factory(256, 512, 384, block_M=128, block_N=128,
+                      block_K=128)
+    t = derive_template(k.prim_func)
+    assert (t.M, t.N, t.K) == (256, 512, 384)
+
+
+def test_derived_space_matches_hand_template():
+    """The derived candidate space must equal the hand template's (same
+    classifier target, same problem dims => identical hints)."""
+    k = _gemm_factory(M, N, K)
+    t = derive_template(k.prim_func)
+    hand = MatmulTemplate(M, N, K, in_dtype="float32", arch=t.arch)
+    derived = [h.config for h in t.hints(8)]
+    manual = [h.config for h in hand.hints(8)]
+    assert derived == manual
+
+
+def test_flash_attention_classified():
+    from tilelang_mesh_tpu.ops.flash_attention import mha_fwd_kernel
+    B, H, S, D = 2, 4, 256, 64
+    k = mha_fwd_kernel(B, H, S, S, D, block_M=128, block_N=128,
+                       causal=True, dtype="float32")
+    t = derive_template(k.prim_func)
+    assert isinstance(t, FlashAttentionTemplate)
+    assert t.seq_q == S and t.seq_k == S and t.head_dim == D
+    assert t.batch_heads == B * H
+    assert t.causal is True
+
+
+def test_flash_noncausal_detected():
+    from tilelang_mesh_tpu.ops.flash_attention import mha_fwd_kernel
+    k = mha_fwd_kernel(1, 2, 256, 256, 64, block_M=128, block_N=128,
+                       causal=False, dtype="float32")
+    t = derive_template(k.prim_func)
+    assert t.causal is False
+
+
+def test_autotune_without_template_end_to_end():
+    """autotune() with no configs and no template: derives, sweeps, and
+    the winning kernel computes the right product."""
+    calls = []
+
+    @tilelang.autotune(topk=3, warmup=1, rep=2, cache_results=False)
+    def matmul(M, N, K, block_M=64, block_N=128, block_K=64):
+        calls.append((block_M, block_N, block_K))
+        return _gemm_factory(M, N, K, block_M, block_N, block_K)
+
+    Ms, Ns, Ks = 128, 256, 128
+    kernel = matmul(Ms, Ns, Ks)
+    assert len(set(calls)) >= 2, f"expected a swept space, got {calls}"
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((Ms, Ks)).astype(np.float32)
+    b = rng.standard_normal((Ks, Ns)).astype(np.float32)
+    c = np.empty((Ms, Ns), np.float32)
+    kernel(a, b, c)
+    np.testing.assert_allclose(c, a @ b, rtol=2e-2, atol=2e-2)
+
+
+def test_analyze_collects_structure():
+    k = _gemm_factory(M, N, K)
+    st = analyze_prim_func(k.prim_func)
+    assert len(st.grid) == 2
+    assert len(st.gemms) == 1
+    assert st.gemms[0].loops, "K loop not captured"
+    assert not st.has_exp
+
+
+def test_derive_falls_back_to_elementwise():
+    """A kernel with no MXU work and no reductions gets the elementwise
+    space over its largest static global param (documented fallback)."""
+    from tilelang_mesh_tpu.carver.roller import ElementwiseTemplate
+
+    @T.prim_func
+    def weird(A: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            pass
+
+    t = derive_template(weird)
+    assert isinstance(t, ElementwiseTemplate)
+
+
+def test_elementwise_classified():
+    from tilelang_mesh_tpu.carver.roller import ElementwiseTemplate
+
+    @T.prim_func
+    def scale(A: T.Tensor((64, 256), "float32"),
+              O: T.Tensor((64, 256), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((64, 256), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(64, 256):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, O)
+
+    t = derive_template(scale)
+    assert isinstance(t, ElementwiseTemplate)
+    assert tuple(t.shape) == (64, 256)
+
+
+def test_autotune_typo_kwarg_raises():
+    with pytest.raises(TypeError, match="configs.*template|did you mean"):
+        tilelang.autotune(config=[{"block_M": 128}])
+
+
+def test_positional_tunable_not_swept():
+    """A tunable pinned POSITIONALLY at the call site must be excluded
+    from the derived sweep (not collide with the sweep kwargs)."""
+    seen = []
+
+    @tilelang.autotune(topk=3, warmup=1, rep=2, cache_results=False)
+    def matmul(M, N, K, block_M=64, block_N=128, block_K=64):
+        seen.append(block_M)
+        return _gemm_factory(M, N, K, block_M, block_N, block_K)
+
+    matmul(128, 256, 128, 32)   # block_M pinned positionally
+    assert set(seen) == {32}, f"block_M swept despite being pinned: {seen}"
+
+
+def test_outer_step_loop_not_counted_as_reduction():
+    """An enclosing serial loop that does not step the gemm input
+    windows must not inflate the derived K."""
+    S, Mi, Ki, Ni = 4, 64, 128, 128
+
+    @T.prim_func
+    def multi_step(A: T.Tensor((Mi, Ki), "float32"),
+                   B: T.Tensor((Ki, Ni), "float32"),
+                   O: T.Tensor((Mi, Ni), "float32")):
+        with T.Kernel(1) as bx:
+            As = T.alloc_shared((Mi, Ki), "float32")
+            Bs = T.alloc_shared((Ki, Ni), "float32")
+            Cl = T.alloc_fragment((Mi, Ni), "float32")
+            T.copy(A, As)
+            T.copy(B, Bs)
+            T.fill(Cl, 0.0)
+            for _step in T.serial(S):        # NOT a K axis
+                T.gemm(As, Bs, Cl)
+            T.copy(Cl, O)
+
+    t = derive_template(multi_step)
+    assert isinstance(t, MatmulTemplate)
+    assert t.K == Ki, f"outer step loop inflated K: {t.K}"

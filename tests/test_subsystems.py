@@ -417,9 +417,12 @@ def test_autotune_from_carver_template():
     assert kernel.latency > 0
 
 
-def test_autotune_requires_configs_or_template():
-    with pytest.raises(ValueError, match="configs.*or template"):
-        tilelang.autotune(warmup=1)(lambda: None)
+def test_autotune_without_configs_enters_derive_mode():
+    """No configs and no template is now the IR-derived mode; a factory
+    that cannot be analyzed fails at TUNE time with guidance."""
+    tuner = tilelang.autotune(warmup=1)(lambda: None)
+    with pytest.raises(RuntimeError, match="derive|tunable"):
+        tuner()
 
 
 def test_autotune_template_ignores_factory_kwargs():

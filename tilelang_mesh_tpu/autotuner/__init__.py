@@ -86,8 +86,9 @@ class AutoTuner:
                  cache_results: bool = True,
                  timeout: Optional[float] = None,
                  template: Any = None, topk: int = 10):
-        if configs is None and template is None:
-            raise ValueError("AutoTuner needs configs=[...] or template=")
+        # configs=None and template=None -> IR-derived mode: the factory
+        # is traced once at its default tile params and the carver
+        # classifies the kernel to derive the space (carver/node.py)
         self.fn = fn
         self.configs = list(configs) if configs is not None else None
         self.warmup = warmup
@@ -102,9 +103,59 @@ class AutoTuner:
         self.template = template
         self.topk = topk
 
+    def _tunable_names(self) -> set:
+        """The factory's tunable keyword names: params with defaults."""
+        try:
+            sig = inspect.signature(getattr(self.fn, "fn", self.fn))
+        except (TypeError, ValueError):
+            return set()
+        return {p.name for p in sig.parameters.values()
+                if p.default is not inspect.Parameter.empty}
+
+    def _bound_names(self, args, kwargs) -> set:
+        """Params pinned at the call site, positionally OR by keyword —
+        pinned tunables must not be swept (the factory call would raise
+        'got multiple values')."""
+        try:
+            sig = inspect.signature(getattr(self.fn, "fn", self.fn))
+            return set(sig.bind_partial(*args, **kwargs).arguments)
+        except (TypeError, ValueError):
+            return set(kwargs)
+
+    def _derive_configs(self, args, kwargs) -> List[Dict[str, Any]]:
+        """IR-derived mode (reference PrimFuncNode flow): trace the
+        factory at its default tile params, classify the kernel, emit
+        the ranked space filtered to the factory's tunable kwargs."""
+        from ..carver.node import derive_configs
+        from ..language.builder import PrimFuncObj
+        kernel = self.fn(*args, **kwargs)
+        pf = getattr(kernel, "prim_func", None)
+        if pf is None and isinstance(kernel, PrimFuncObj):
+            pf = kernel   # a bare @T.prim_func factory
+        if not isinstance(pf, PrimFuncObj):
+            raise RuntimeError(
+                "autotune: cannot derive a config space — the factory "
+                "must return a tilelang.compile'd kernel or a "
+                "@T.prim_func (or pass configs=[...] / template=)")
+        names = self._tunable_names() - self._bound_names(args, kwargs)
+        if not names:
+            raise RuntimeError(
+                "autotune: the factory has no tunable keyword params "
+                "(defaults like block_M=128) left to sweep")
+        configs = derive_configs(pf, names, self.topk)
+        if not configs:
+            raise RuntimeError(
+                "autotune: the IR-derived space is empty (every "
+                "candidate exceeded the VMEM budget, or the carver keys "
+                "do not match the factory's tunable kwargs "
+                f"{sorted(names)})")
+        return configs
+
     def _resolve_configs(self, args, kwargs) -> List[Dict[str, Any]]:
         if self.configs is not None:
             return self.configs
+        if self.template is None:
+            return self._derive_configs(args, kwargs)
         from ..carver import recommend_hints
         if callable(self.template):
             # pass only the kwargs the template accepts: call-site tile
@@ -151,8 +202,17 @@ class AutoTuner:
         return h.hexdigest()
 
     def run(self, *args, **kwargs) -> AutotuneResult:
-        configs = self._resolve_configs(args, kwargs)
-        key = self._disk_key(args, kwargs, configs)
+        derive = self.configs is None and self.template is None
+        if derive:
+            # key the cache on the MODE, not the candidate list, so a
+            # cache hit skips the default-config trace entirely
+            configs = None
+            key = self._disk_key(args, kwargs,
+                                 [{"__mode__": "ir-derived",
+                                   "topk": self.topk}])
+        else:
+            configs = self._resolve_configs(args, kwargs)
+            key = self._disk_key(args, kwargs, configs)
         cache_f = env.autotune_dir() / f"{key}.json"
         if self.cache_results and cache_f.exists():
             try:
@@ -164,6 +224,8 @@ class AutoTuner:
                                       from_cache=True)
             except Exception:
                 pass
+        if configs is None:
+            configs = self._derive_configs(args, kwargs)
 
         best: Optional[AutotuneResult] = None
         captured: List[Dict[str, Any]] = []
@@ -233,9 +295,26 @@ def autotune(fn: Optional[Callable] = None, *,
                            MatmulTemplate(M, N, K, "bfloat16"), topk=6)
         @tilelang.jit
         def matmul(M, N, K, block_M=128, block_N=128, block_K=128): ...
+
+    With NEITHER ``configs`` nor ``template``, the space is derived from
+    the kernel's own IR (carver/node.py, the reference PrimFuncNode
+    flow): the factory is traced at its default tile params, classified
+    (GEMM / flash / GEMV / reduction / elementwise), and the problem
+    dims are reconstructed from the traced grid and loop extents::
+
+        @tilelang.autotune          # no template needed
+        @tilelang.jit
+        def matmul(M, N, K, block_M=128, block_N=128, block_K=128): ...
     """
-    if configs is None and template is None:
-        raise ValueError("autotune requires configs=[...] or template=")
+    for k in _ignored:
+        if "config" in k or "template" in k:
+            # a typo ('config=', 'templates=') must not silently fall
+            # through to the IR-derived mode, ignoring the user's list
+            raise TypeError(
+                f"autotune: unknown argument {k!r} — did you mean "
+                f"'configs' or 'template'?")
+        logger.warning("autotune: ignoring unknown argument %r "
+                       "(reference-parity kwarg with no TPU effect)", k)
 
     def wrap(f):
         return AutoTuneImpl(f, configs, warmup, rep, supply_type,

@@ -1,0 +1,319 @@
+"""IR-derived autotune candidates — the PrimFuncNode analog.
+
+Reference: /root/reference/tilelang/carver/roller/node.py:191 (PrimFuncNode
+extracts the tunable structure from the kernel's TIR) and
+policy/default.py:19 (the policy then emits the candidate space). Here the
+traced tile IR is walked directly: the kernel's grid, GEMM tile shapes,
+enclosing reduction loops, softmax markers, and output block maps identify
+the kernel class and reconstruct the PROBLEM dimensions from the grid/loop
+extents times the traced tile sizes — so ``autotune()`` with neither
+``configs=`` nor ``template=`` can derive and rank a tuning space for any
+kernel the classifier recognizes (GEMM, flash-attention, GEMV,
+reduction, elementwise), without a hand-written template.
+
+The factory is traced once at its DEFAULT tile parameters; the derived
+template's config keys (block_M/block_N/block_K) are then matched to the
+factory's tunable keyword names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..ir import (Buffer, CopyStmt, CumSumStmt, FillStmt, ForNest, GemmStmt,
+                  IfThenElse, KernelNode, PrimFunc, ReduceStmt, Region,
+                  SeqStmt, Stmt, as_int)
+from ..ir.expr import BinOp, BufferLoad, Call, Cast, Var, affine_decompose
+from .arch import TPUArch, auto_arch
+
+
+def _shape_of(x) -> Optional[Tuple[int, ...]]:
+    """Static shape of a Region/Buffer operand, None if dynamic."""
+    if isinstance(x, Region):
+        return x.static_shape()
+    if isinstance(x, Buffer):
+        out = []
+        for s in x.shape:
+            v = as_int(s)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
+def _expr_vars(e, acc: set):
+    if isinstance(e, Var):
+        acc.add(id(e))
+    elif isinstance(e, BinOp):
+        _expr_vars(e.a, acc)
+        _expr_vars(e.b, acc)
+    elif isinstance(e, Call):
+        for a in e.args:
+            if not isinstance(a, str):
+                _expr_vars(a, acc)
+    elif isinstance(e, Cast):
+        _expr_vars(e.value, acc)
+    elif isinstance(e, BufferLoad):
+        for i in e.indices:
+            if not isinstance(i, slice):
+                _expr_vars(i, acc)
+
+
+def _has_exp_call(e) -> bool:
+    if isinstance(e, Call):
+        if e.name in ("exp", "exp2", "expf", "exp2f"):
+            return True
+        return any(not isinstance(a, str) and _has_exp_call(a)
+                   for a in e.args)
+    if isinstance(e, BinOp):
+        return _has_exp_call(e.a) or _has_exp_call(e.b)
+    if isinstance(e, Cast):
+        return _has_exp_call(e.value)
+    return False
+
+
+@dataclass
+class _GemmSite:
+    stmt: GemmStmt
+    loops: List[Tuple[Any, int, str]]   # (var, extent, kind) enclosing
+
+
+@dataclass
+class KernelStructure:
+    """What the walk extracts (the PrimFuncNode payload)."""
+    grid: List[Tuple[Any, int]] = field(default_factory=list)
+    gemms: List[_GemmSite] = field(default_factory=list)
+    copies: List[Tuple[CopyStmt, tuple]] = field(default_factory=list)
+    has_exp: bool = False
+    n_reduce: int = 0
+    causal: bool = False
+    global_params: List[Buffer] = field(default_factory=list)
+
+    @property
+    def grid_ids(self) -> set:
+        return {id(v) for v, _ in self.grid}
+
+
+def analyze_prim_func(pf) -> KernelStructure:
+    """Walk a traced kernel and extract its tunable structure."""
+    func: PrimFunc = getattr(pf, "func", pf)
+    st = KernelStructure()
+    st.global_params = [b for b in func.buffer_params
+                       if b.scope == "global"]
+    kn = func.kernel_node()
+    if kn is None:
+        return st
+    st.grid = [(v, int(e)) for v, e in zip(kn.grid_vars, kn.extents)]
+    kv_loop_ids: set = set()
+
+    def scan(stmts, loops):
+        for s in stmts:
+            if isinstance(s, SeqStmt):
+                scan(s.stmts, loops)
+            elif isinstance(s, ForNest):
+                exts = [as_int(e) for e in s.extents]
+                if s.kind in ("serial", "pipelined") and \
+                        all(e is not None for e in exts):
+                    inner = loops + [
+                        (v, e, s.kind)
+                        for v, e in zip(s.loop_vars, exts)]
+                    for v in s.loop_vars:
+                        kv_loop_ids.add(id(v))
+                    scan(s.body.stmts, inner)
+                else:
+                    scan(s.body.stmts, loops)
+            elif isinstance(s, IfThenElse):
+                cond_vars: set = set()
+                _expr_vars(s.cond, cond_vars)
+                if cond_vars & kv_loop_ids and cond_vars & st.grid_ids:
+                    # a guard comparing the reduction-loop position to
+                    # the grid position: the causal-skip idiom. Known
+                    # imprecision: a sliding-window guard matches too —
+                    # acceptable, causal only halves the modeled FLOPs
+                    # in the RANKING (never affects correctness)
+                    st.causal = True
+                scan(s.then_body.stmts, loops)
+                if s.else_body is not None:
+                    scan(s.else_body.stmts, loops)
+            elif isinstance(s, GemmStmt):
+                st.gemms.append(_GemmSite(s, list(loops)))
+            elif isinstance(s, CopyStmt):
+                st.copies.append((s, tuple(loops)))
+            elif isinstance(s, (ReduceStmt, CumSumStmt)):
+                st.n_reduce += 1
+            elif isinstance(s, (FillStmt,)):
+                if _has_exp_call(s.value):
+                    st.has_exp = True
+            else:
+                v = getattr(s, "value", None)
+                if v is not None and not isinstance(v, (Region, Stmt, str)) \
+                        and _has_exp_call(v):
+                    st.has_exp = True
+
+    scan(kn.body.stmts, [])
+    return st
+
+
+def _out_problem_dim(st: KernelStructure, src_uid: int, tile: int,
+                     minor: bool = False) -> int:
+    """Problem size along the output dim whose window is `tile` wide:
+    find the copy src_uid -> global, decompose that dim's base over the
+    grid vars (coeff * grid extent), else the tile itself. ``minor``
+    searches dims minor-first so square tiles (bm == bn) still map the
+    M and N questions to distinct output dims."""
+    for cp, _loops in st.copies:
+        src, dst = cp.src, cp.dst
+        if not isinstance(src, Region) or not isinstance(dst, Region):
+            continue
+        if src.buffer.uid != src_uid or dst.buffer.scope != "global":
+            continue
+        shape = dst.static_shape()
+        if shape is None:
+            continue
+        ext_of = {id(v): e for v, e in st.grid}
+        dims = range(len(shape) - 1, -1, -1) if minor else \
+            range(len(shape))
+        for dim in dims:
+            if shape[dim] != tile:
+                continue
+            b = dst.base[dim]
+            if isinstance(b, slice):
+                continue
+            dec = affine_decompose(b)
+            if not dec:
+                continue
+            coeffs, _const = dec
+            for _, (v, c) in coeffs.items():
+                if id(v) in ext_of and c == tile:
+                    return ext_of[id(v)] * tile
+        # this copy didn't resolve the dim — keep scanning the others
+        # (e.g. a guarded split epilogue writes through two copies)
+    return tile
+
+
+def _operand_uid(x) -> Optional[int]:
+    buf = getattr(x, "buffer", x)
+    return getattr(buf, "uid", None)
+
+
+def _feed_vars(st: KernelStructure, operands) -> set:
+    """ids of vars appearing in the global-side window bases that FEED
+    the given gemm operands: src bases of global->operand copies, plus
+    the operand's own base when it windows a global buffer directly."""
+    uids = {_operand_uid(x) for x in operands}
+    out: set = set()
+    for cp, _loops in st.copies:
+        src, dst = cp.src, cp.dst
+        if not isinstance(src, Region) or not isinstance(dst, Region):
+            continue
+        if dst.buffer.uid in uids and src.buffer.scope == "global":
+            for b in src.base:
+                if not isinstance(b, slice):
+                    _expr_vars(b, out)
+    for x in operands:
+        if isinstance(x, Region) and x.buffer.scope == "global":
+            for b in x.base:
+                if not isinstance(b, slice):
+                    _expr_vars(b, out)
+    return out
+
+
+def _reduction_extent(site: _GemmSite, feed: set) -> int:
+    """Product of enclosing loop extents that actually step the gemm's
+    input windows. A loop whose var appears in no A/B window base is NOT
+    a reduction axis (e.g. an outer multi-step accumulation loop), so
+    operands fully staged outside every loop give extent 1."""
+    red = 1
+    for v, e, _k in site.loops:
+        if id(v) in feed:
+            red *= e
+    return red
+
+
+def derive_template(pf, arch: Optional[TPUArch] = None):
+    """Classify a traced kernel and build the matching carver template
+    with problem dims reconstructed from its IR. Raises ValueError when
+    the kernel shape is not recognized."""
+    from .roller import (ElementwiseTemplate, FlashAttentionTemplate,
+                         GEMVTemplate, GeneralReductionTemplate,
+                         MatmulTemplate)
+    arch = arch or auto_arch()
+    st = analyze_prim_func(pf)
+
+    if st.gemms and st.has_exp and len(st.gemms) >= 2:
+        # blockwise attention: gemm1 = scores (Q @ K^T), gemm2 = P @ V
+        g1 = st.gemms[0].stmt
+        a_sh, c_sh = _shape_of(g1.A), _shape_of(g1.C)
+        if a_sh is None or c_sh is None:
+            raise ValueError("attention operands have dynamic shapes")
+        bm, bn = c_sh[-2], c_sh[-1]
+        D = a_sh[-1]
+        Sq = _out_problem_dim(st, st.gemms[-1].stmt.C.buffer.uid, bm)
+        feed = _feed_vars(st, [g1.B])
+        Sk = bn * _reduction_extent(st.gemms[0], feed)
+        q_grid_used = max(1, Sq // bm)
+        bh = 1
+        for _v, e in st.grid:
+            bh *= e
+        bh = max(1, bh // q_grid_used)
+        dtype = (st.global_params[0].dtype if st.global_params
+                 else "float32")
+        return FlashAttentionTemplate(
+            seq_q=Sq, seq_k=Sk, head_dim=D, dtype=dtype,
+            batch_heads=bh, causal=st.causal, arch=arch)
+
+    if st.gemms:
+        g = st.gemms[0].stmt
+        a_sh, c_sh = _shape_of(g.A), _shape_of(g.C)
+        if a_sh is None or c_sh is None:
+            raise ValueError("gemm operands have dynamic shapes")
+        bm, bn = c_sh[-2], c_sh[-1]
+        bk = a_sh[-1] if a_sh[-2] == bm else a_sh[-2]
+        M = _out_problem_dim(st, g.C.buffer.uid, bm)
+        N = _out_problem_dim(st, g.C.buffer.uid, bn, minor=True)
+        feed = _feed_vars(st, [g.A, g.B])
+        K = bk * _reduction_extent(st.gemms[0], feed)
+        dtype = (st.global_params[0].dtype if st.global_params
+                 else "float32")
+        if bm == 1 or M == 1:
+            return GEMVTemplate(M=max(M, N), K=K, in_dtype=dtype,
+                                arch=arch)
+        return MatmulTemplate(M=M, N=N, K=K, in_dtype=dtype, arch=arch)
+
+    # no MXU work: reduction or elementwise over the largest global param
+    shapes = [s for s in (_shape_of(b) for b in st.global_params)
+              if s is not None]
+    if not shapes:
+        raise ValueError(
+            "cannot derive an autotune space: kernel has no static-shaped "
+            "global params (pass configs=[...] or template=)")
+    import math
+    big = max(shapes, key=lambda s: math.prod(s))
+    dtype = st.global_params[0].dtype
+    if st.n_reduce:
+        return GeneralReductionTemplate(shape=big, dtype=dtype, arch=arch)
+    return ElementwiseTemplate(shape=big, dtype=dtype, arch=arch)
+
+
+def derive_configs(pf, tunable_names, topk: int = 10,
+                   arch: Optional[TPUArch] = None) -> List[Dict[str, int]]:
+    """Ranked configs for a traced kernel, filtered to the factory's
+    tunable keyword names and deduplicated (reference flow: PrimFuncNode
+    -> policy.emit_config -> tuner grid)."""
+    t = derive_template(pf, arch)
+    seen = set()
+    out: List[Dict[str, int]] = []
+    for h in t.hints(topk * 4):
+        cfg = {k: v for k, v in h.config.items() if k in tunable_names}
+        if not cfg:
+            continue
+        key = tuple(sorted(cfg.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(cfg)
+        if len(out) >= topk:
+            break
+    return out

@@ -39,8 +39,12 @@ def compile(func, out_idx: Optional[Sequence[int]] = None,  # noqa: A001
     """
     if not isinstance(func, PrimFuncObj):
         raise TypeError("tilelang.compile expects a @T.prim_func")
-    return cached(func, target=target, out_idx=out_idx,
-                  pass_configs=pass_configs, verbose=verbose)
+    k = cached(func, target=target, out_idx=out_idx,
+               pass_configs=pass_configs, verbose=verbose)
+    # keep the traced IR reachable from the kernel: the carver's
+    # IR-derived autotuning (carver/node.py) re-analyzes it
+    k.prim_func = func
+    return k
 
 
 def par_compile(funcs: Sequence[PrimFuncObj], num_workers: Optional[int] = None,
