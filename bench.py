@@ -11,6 +11,15 @@ and a final headline line (the flagship GEMM) carrying
 vs_baseline >= 0.9 means within 10% of the baseline (the BASELINE.md
 target); > 1.0 means beating it.
 
+Process architecture (round-4 hardening; do not regress): the parent
+process NEVER imports jax — each config runs in its own bounded
+subprocess (--child), so a tunnel worker that faults mid-sweep kills at
+most that one config's process. The parent re-emits each child's JSON
+line as it completes, probes the worker between configs from fresh
+subprocesses (bounded by a total dead-probe budget), waits out a
+post-fault recovery window at startup instead of aborting, and always
+prints the headline geomean over whatever ran: partial capture, rc=0.
+
 Methodology (hard-learned across rounds; do not regress):
 - Timing is the SLOPE of wall time vs in-loop rep count: T(hi)-T(lo) over
   hi-lo cancels every fixed per-call cost (~65 ms tunnel RPC here).
@@ -657,38 +666,11 @@ def exit_code(strict: bool, n_failed: int) -> int:
     return 2 if (strict and n_failed) else 0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true",
-                    help="small shapes (smoke test, not a benchmark)")
-    ap.add_argument("--only", type=str, default=None,
-                    help="comma-separated config names")
-    try:
-        probe_default = float(
-            os.environ.get("TL_TPU_BENCH_PROBE_TIMEOUT", 600))
-    except ValueError:
-        probe_default = 600.0
-    ap.add_argument("--probe-timeout", type=float, default=probe_default,
-                    help="seconds to wait for the TPU before aborting "
-                         "with a diagnostic JSON line; <= 0 skips the "
-                         "probe")
-    ap.add_argument("--strict", action="store_true",
-                    help="exit 2 if ANY config failed (CI mode); the "
-                         "default keeps partial sweeps green so a dead "
-                         "tunnel worker late in the run cannot zero the "
-                         "whole capture")
-    args = ap.parse_args()
-
-    if args.probe_timeout > 0:
-        ok, perr = _probe_device(args.probe_timeout)
-        if not ok:
-            print(json.dumps({
-                "metric": "bench", "value": 0.0, "unit": "TFLOPS",
-                "vs_baseline": 0.0, "error": perr}), flush=True)
-            sys.exit(1)
-
-    q = args.quick
-    configs = [
+def _config_builders(q: bool):
+    """The sweep, riskiest last: a kernel fault kills the tunnel's TPU
+    worker for many minutes, losing every config after it — the blast
+    radius of the riskiest config must not include the others."""
+    return [
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),
@@ -701,68 +683,231 @@ def main():
         ("mla_decode", lambda: cfg_mla_decode(S=1024 if q else 4096)),
         ("paged_decode", lambda: cfg_paged_decode(S=2048 if q else 8192)),
         ("moe_grouped", lambda: cfg_moe_grouped(M=256 if q else 512)),
-        # LAST on purpose: a kernel fault kills the tunnel's TPU worker
-        # for many minutes, losing every config after it — the blast
-        # radius of the riskiest config must not include the others
         ("w4a16_gemm", lambda: cfg_w4a16(*(1024,) * 3 if q
                                          else (4096,) * 3)),
     ]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _child_main(args) -> None:
+    """Run ONE config in this process (spawned by the parent): probe
+    briefly, measure, print the JSON record, hard-exit. In-process
+    watchdogs still bound every jax call — a worker that dies mid-call
+    HANGS the call, and only an abandoned daemon thread plus os._exit
+    keeps this child from wedging (the parent's subprocess timeout is
+    the outer backstop)."""
+    q = args.quick
+    name = args.child
+    builders = dict(_config_builders(q))
+    if name not in builders:
+        print(json.dumps({"config": name, "error": "unknown config"}),
+              flush=True)
+        os._exit(3)
+    probe_s = _env_float("TL_TPU_BENCH_CHILD_PROBE_TIMEOUT", 120)
+    ok, perr = _probe_device(probe_s)
+    if not ok:
+        print(json.dumps({"config": name, "error": perr}), flush=True)
+        os._exit(3)
+    cfg_timeout = _env_float("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800)
+    if cfg_timeout <= 0:
+        cfg_timeout = 1800.0
+    try:
+        peaks = _watchdog(_chip_peak_tflops, "device model probe", probe_s)
+        rec = _watchdog(
+            lambda: run_config(name, builders[name], peaks,
+                               rounds=1 if q else 3),
+            f"config {name}", cfg_timeout)
+    except Exception as e:
+        print(f"# config {name} FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        print(json.dumps({"config": name, "error": str(e)[:300]}),
+              flush=True)
+        sys.stdout.flush()
+        os._exit(3)
+    print(json.dumps(rec), flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _spawn_probe(timeout_s: float) -> bool:
+    """Probe the TPU from a FRESH subprocess (the parent never imports
+    jax, so a wedged backend can never take the orchestrator down)."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax.numpy as jnp; "
+             "jnp.ones((8, 128)).sum().block_until_ready()"],
+            timeout=timeout_s, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def _spawn_config(name: str, q: bool, timeout_s: float):
+    """Run one config in a fresh child process; returns (rec | None,
+    error | None). The child prints its own JSON line, which is re-read
+    from its stdout and re-emitted by the caller; on timeout the whole
+    process group is killed so a wedged jax runtime cannot linger."""
+    import signal
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", name]
+    if q:
+        cmd.append("--quick")
+    try:
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                             start_new_session=True)
+        out, _ = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except Exception:
+            pass
+        p.wait()
+        return None, (f"config subprocess exceeded {timeout_s:.0f}s "
+                      f"(worker wedged?); killed")
+    except Exception as e:
+        return None, f"config subprocess failed: {type(e).__name__}: {e}"
+    rec = None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if cand.get("config") == name:
+            rec = cand
+    if rec is None:
+        return None, f"config subprocess rc={p.returncode}, no record"
+    if "error" in rec:
+        return None, rec["error"]
+    return rec, None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (smoke test, not a benchmark)")
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma-separated config names")
+    ap.add_argument("--child", type=str, default=None,
+                    help=argparse.SUPPRESS)   # internal: run one config
+    ap.add_argument("--in-process", action="store_true",
+                    help="run configs in THIS process (debugging; the "
+                         "default isolates each config in a subprocess "
+                         "so a dead tunnel worker cannot zero the run)")
+    ap.add_argument("--probe-timeout", type=float,
+                    default=_env_float("TL_TPU_BENCH_PROBE_TIMEOUT", 600),
+                    help="total seconds to wait (in 60s polls) for the "
+                         "TPU to answer before starting; <= 0 skips the "
+                         "wait and configs fast-fail individually")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 2 if ANY config failed (CI mode); the "
+                         "default keeps partial sweeps green so a dead "
+                         "tunnel worker late in the run cannot zero the "
+                         "whole capture")
+    args = ap.parse_args()
+
+    if args.child:
+        _child_main(args)
+        return
+
+    q = args.quick
+    configs = _config_builders(q)
     if args.only:
         keep = set(args.only.split(","))
         configs = [(n, b) for n, b in configs if n in keep]
+    names = [n for n, _ in configs]
 
-    try:
-        cfg_timeout = float(
-            os.environ.get("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800))
-    except ValueError:
-        cfg_timeout = 1800.0
+    cfg_timeout = _env_float("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800)
     if cfg_timeout <= 0:
-        cfg_timeout = 1800.0   # the watchdog cannot be disabled: a
-        # wedged worker would hang the driver's bench forever
+        cfg_timeout = 1800.0   # cannot be disabled: a wedged worker
+        # would hang the driver's bench forever
+    inter_probe_s = _env_float("TL_TPU_BENCH_CHILD_PROBE_TIMEOUT", 120)
 
-    try:
-        peaks = _watchdog(_chip_peak_tflops, "device model probe",
-                          cfg_timeout)
-    except Exception as e:
-        print(json.dumps({
-            "metric": "bench", "value": 0.0, "unit": "TFLOPS",
-            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"}),
-            flush=True)
-        sys.exit(1)
-
-    def _run_bounded(name, build):
-        """Per-config watchdog: a worker that dies MID-RUN hangs the jax
-        call (no error), which would wedge the whole bench; a daemon
-        thread bounds each config so partial results still print. A
-        wedged thread keeps the backend lock, so later configs time out
-        quickly rather than hang — bounded total time either way."""
-        return _watchdog(
-            lambda: run_config(name, build, peaks, rounds=1 if q else 3),
-            f"config {name}", cfg_timeout)
+    # startup: WAIT (bounded) for the worker instead of aborting — the
+    # round-3 capture died here with rc=1 while the worker was in its
+    # 20-60 min post-fault recovery window
+    probe_s = _env_float("TL_TPU_BENCH_PARENT_PROBE_TIMEOUT", 75)
+    alive = True
+    if args.probe_timeout > 0 and not args.in_process:
+        deadline = time.time() + args.probe_timeout
+        while True:
+            alive = _spawn_probe(min(probe_s, max(
+                10.0, deadline - time.time())))
+            if alive or time.time() >= deadline:
+                break
+            print(f"# TPU worker unreachable; retrying until the "
+                  f"{args.probe_timeout:.0f}s budget expires",
+                  file=sys.stderr, flush=True)
+            time.sleep(min(60, max(1.0, deadline - time.time())))
+    # probing a DEAD worker burns its full timeout every time; this
+    # budget bounds the total spent on dead probes across the sweep so
+    # a down-all-run worker costs minutes, not hours
+    dead_budget = _env_float("TL_TPU_BENCH_DEAD_PROBE_BUDGET", 300)
 
     results = []
     headline = None
-    for name, build in configs:
-        try:
-            rec = _run_bounded(name, build)
-            # print HERE, not inside run_config: an abandoned watchdog
-            # thread that later un-wedges must not emit a late success
-            # line for a config already reported as timed out
+    builders = dict(configs)
+    peaks = None
+    for name in names:
+        if args.in_process:
+            # legacy single-process path (debugging)
+            try:
+                if peaks is None:
+                    peaks = _watchdog(_chip_peak_tflops,
+                                      "device model probe", cfg_timeout)
+                rec = _watchdog(
+                    lambda: run_config(name, builders[name], peaks,
+                                       rounds=1 if q else 3),
+                    f"config {name}", cfg_timeout)
+                err = None
+            except Exception as e:
+                rec, err = None, f"{type(e).__name__}: {e}"
+        else:
+            if not alive and dead_budget > 0:
+                # re-probe: skip (not hang) while the worker is down,
+                # but notice the moment it recovers
+                t0 = time.time()
+                alive = _spawn_probe(min(inter_probe_s, dead_budget))
+                if not alive:
+                    dead_budget -= time.time() - t0
+            if alive:
+                # the child pays jax import + probes before its own
+                # watchdog starts: give its subprocess that allowance on
+                # top of cfg_timeout so a slow-but-legitimate config is
+                # never misreported as a wedged worker
+                rec, err = _spawn_config(name, q, cfg_timeout + 300)
+                if rec is None and "worker" in (err or "").lower():
+                    alive = False
+            else:
+                rec, err = None, "skipped: TPU worker unreachable"
+        if rec is not None:
             print(json.dumps(rec), flush=True)
             results.append(rec)
             if name == "gemm_large":
                 headline = rec
-        except Exception as e:
-            print(f"# config {name} FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr, flush=True)
-            print(json.dumps({"config": name, "error": str(e)[:300]}),
+        else:
+            print(f"# config {name} FAILED: {err}", file=sys.stderr,
+                  flush=True)
+            print(json.dumps({"config": name, "error": (err or "")[:300]}),
                   flush=True)
 
-    ok = results  # failed configs never reach `results`
+
+    ok = results
     if not ok:
         print(json.dumps({"metric": "bench", "value": 0.0, "unit": "TFLOPS",
                           "vs_baseline": 0.0,
-                          "error": "every config failed"}))
+                          "error": "every config failed"}), flush=True)
         sys.exit(1)
     geo = math.exp(sum(math.log(max(r["vs_baseline"], 1e-6)) for r in ok)
                    / len(ok))
@@ -772,12 +917,9 @@ def main():
     headline["n_configs_ok"] = len(ok)
     headline["n_configs_failed"] = n_failed
     print(json.dumps(headline), flush=True)
-    # abandoned watchdog threads may still sit inside native jax calls;
-    # interpreter finalization with such threads can abort the process
-    # AFTER the results printed — exit hard instead
     sys.stdout.flush()
-    # hard exit either way: abandoned watchdog threads must not abort
-    # interpreter finalization after the results are out
+    # hard exit: in-process mode can hold abandoned watchdog threads
+    # inside native jax calls, which abort interpreter finalization
     os._exit(exit_code(args.strict, n_failed))
 
 

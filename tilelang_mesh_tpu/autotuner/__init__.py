@@ -204,12 +204,16 @@ class AutoTuner:
     def run(self, *args, **kwargs) -> AutotuneResult:
         derive = self.configs is None and self.template is None
         if derive:
-            # key the cache on the MODE, not the candidate list, so a
-            # cache hit skips the default-config trace entirely
+            # key the cache on the MODE + ARCH, not the candidate list,
+            # so a cache hit skips the default-config trace entirely but
+            # a different chip re-derives (the ranked winner is
+            # arch-dependent)
+            from ..carver.arch import auto_arch
             configs = None
             key = self._disk_key(args, kwargs,
                                  [{"__mode__": "ir-derived",
-                                   "topk": self.topk}])
+                                   "topk": self.topk,
+                                   "arch": auto_arch().name}])
         else:
             configs = self._resolve_configs(args, kwargs)
             key = self._disk_key(args, kwargs, configs)
