@@ -379,17 +379,28 @@ def cfg_w4a16(M=4096, N=4096, K=4096, gs=512):
 
     # framework side: fused tile kernel vs two-pass (dequant kernel +
     # large-tile GEMM) — the fused form wins skinny-M, two-pass wins
-    # compute-bound prefill
+    # compute-bound prefill. The two-pass GEMM tile/pipeline is swept:
+    # the r3 capture lost 7.6% to the XLA baseline with the single
+    # hand-picked (1024,1024,512,ns2) shape
+    def _twopass(bm, bn, bk, ns):
+        return lambda a_, p_, s_: dequant_matmul_twopass(
+            a_, p_, s_, block_M=bm, block_N=bn, block_K=bk, dq_block=gs,
+            num_stages=ns)
+
     o_name, ours, args = _pick_best(
         [("fused",
           lambda: dequant_gemm_kernel(M, N, K, block_M=512, block_N=512,
                                       block_K2=gs, group_size=gs,
                                       in_dtype="bfloat16").func,
-          (a_planar, packed, s3)),
-         ("twopass",
-          lambda: (lambda a_, p_, s_: dequant_matmul_twopass(
-              a_, p_, s_, dq_block=gs)),
-          (a, packed, scales))],
+          (a_planar, packed, s3))] +
+        [(f"twopass[{bm}x{bn}x{bk},ns{ns}]",
+          functools.partial(_twopass, bm, bn, bk, ns),
+          (a, packed, scales))
+         for bm, bn, bk, ns in ((1024, 1024, 512, 2),
+                                (1024, 1024, 512, 3),
+                                (512, 1024, 1024, 2),
+                                (1024, 512, 1024, 2),
+                                (512, 2048, 512, 2))],
         check, "w4a16 framework")
 
     # baseline side: hand-written Pallas fused dequant-GEMM vs XLA
@@ -551,17 +562,34 @@ def cfg_moe_grouped(E=8, M=512, K=2048, N=2048):
                           ).astype(x.dtype)
 
     # per-expert matmul configs from the carver's roofline ranking, plus
-    # the round-2 hand-picked shape as a safety candidate
+    # hand-picked shapes and a pipeline-depth sweep: the r3 capture lost
+    # 8% to XLA's batched matmul with the ranked-only candidates
     from tilelang_mesh_tpu.carver import MatmulTemplate
-    cfgs = [h.config for h in MatmulTemplate(M, N, K, "bfloat16").hints(3)]
-    cfgs.append({"block_M": 512, "block_N": 2048, "block_K": 512})
+    cfgs = [dict(h.config, num_stages=2)
+            for h in MatmulTemplate(M, N, K, "bfloat16").hints(3)]
+    cfgs += [
+        {"block_M": 512, "block_N": 2048, "block_K": 512, "num_stages": 2},
+        {"block_M": 512, "block_N": 2048, "block_K": 512, "num_stages": 3},
+        {"block_M": 512, "block_N": 1024, "block_K": 1024, "num_stages": 2},
+        {"block_M": 256, "block_N": 2048, "block_K": 1024, "num_stages": 2},
+        {"block_M": 512, "block_N": 1024, "block_K": 512, "num_stages": 3},
+    ]
+    cfgs = list({tuple(sorted(c.items())): c for c in cfgs}.values())
+
+    def _vmem_est(c):
+        """Riskiest (largest scoped-VMEM) candidates run LAST: a Mosaic
+        fault kills the whole config subprocess and the shared worker."""
+        bm, bn, bk = c["block_M"], c["block_N"], c["block_K"]
+        return (bm * bk + bk * bn) * 2 * c["num_stages"] + bm * bn * 4
+
+    cfgs.sort(key=_vmem_est)
     want = ref(x, w)
     check = functools.partial(_check_close, ref=want, rel_tol=3e-2)
     _, ours, _ = _pick_best(
         [(str(c),
           lambda c=c: (lambda x_, w_: grouped_matmul(
               x_, w_, block_M=c["block_M"], block_N=c["block_N"],
-              block_K=c["block_K"])),
+              block_K=c["block_K"], num_stages=c["num_stages"])),
           (x, w)) for c in cfgs],
         check, "moe grouped")
 

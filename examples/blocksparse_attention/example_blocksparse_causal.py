@@ -1,0 +1,49 @@
+"""Block-sparse attention composed WITH the elementwise causal mask
+(reference examples/blocksparse_attention causal variants — the
+seer-attention configuration): the block mask prunes whole KV tiles,
+causal masking handles the diagonal, and a local-band mask demonstrates
+sliding-window sparsity."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tilelang_mesh_tpu.ops.blocksparse_attention import blocksparse_attention
+
+
+def main(B=1, H=4, S=512, D=64, BM=128, band=2):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+
+    nb = S // BM
+    # local band: query block i attends key blocks (i-band, i]
+    bi = np.arange(nb)
+    mask = ((bi[:, None] - bi[None, :] >= 0) &
+            (bi[:, None] - bi[None, :] < band)).astype(np.int32)
+    block_mask = jnp.asarray(np.broadcast_to(mask, (B, H, nb, nb)))
+
+    out = np.asarray(blocksparse_attention(q, k, v, block_mask,
+                                           block_M=BM, block_N=BM,
+                                           causal=True))
+
+    # dense reference with the same band+causal mask
+    rows = np.arange(S)
+    block = rows // BM
+    vis = ((block[:, None] - block[None, :] >= 0) &
+           (block[:, None] - block[None, :] < band) &
+           (rows[:, None] >= rows[None, :]))
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) \
+        / np.sqrt(D)
+    s = np.where(vis, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+    np.testing.assert_allclose(out, want, rtol=2e-2, atol=2e-2)
+    dens = mask.mean()
+    print(f"block-sparse causal band attention (density {dens:.2f}) "
+          f"matches the dense-masked reference.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Dense grouped GEMM (reference examples/grouped_gemm): out[e] = X[e] @
+W[e] with the expert index as an extra parallel grid dimension, so every
+expert's tiles ride one pipelined K loop (the compute core the fusedmoe
+example builds on; the ragged-batch form is
+example_grouped_gemm_varlen.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tilelang_mesh_tpu.ops.grouped_gemm import grouped_matmul
+
+
+def main(E=4, M=128, K=256, N=256):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((E, M, K)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, K, N)) * 0.1, jnp.float32)
+
+    out = grouped_matmul(x, w, block_M=128, block_N=128, block_K=128)
+    want = np.einsum("emk,ekn->emn", np.asarray(x), np.asarray(w))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-2,
+                               atol=2e-2)
+    print(f"grouped GEMM E={E} {M}x{K}x{N} matches einsum.")
+
+
+if __name__ == "__main__":
+    main()

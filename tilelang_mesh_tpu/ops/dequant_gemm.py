@@ -126,7 +126,7 @@ def dequant_int4_kernel(K2, N, block_K2=512, block_N=512,
 
 
 def dequant_matmul_twopass(a, packed, scales, block_M=1024, block_N=1024,
-                           block_K=512, dq_block=512):
+                           block_K=512, dq_block=512, num_stages=2):
     """Two-pass w4a16: materialize bf16 weights once (VPU pass over the
     packed bytes, ~K*N/2 bytes read), then one large-tile GEMM.
 
@@ -159,5 +159,5 @@ def dequant_matmul_twopass(a, packed, scales, block_M=1024, block_N=1024,
     bd = dq(packed, scales.reshape(2, G2, N))
     mm = matmul_kernel(M, N, K, block_M=min(block_M, M),
                        block_N=min(block_N, N), block_K=min(block_K, K),
-                       in_dtype=str(a.dtype))
+                       in_dtype=str(a.dtype), num_stages=num_stages)
     return mm(a, bd)

@@ -39,12 +39,14 @@ def grouped_gemm_kernel(E, M, N, K, block_M=128, block_N=128, block_K=128,
     return _tl_compile(ggemm)
 
 
-def grouped_matmul(x, w, block_M=128, block_N=128, block_K=128):
+def grouped_matmul(x, w, block_M=128, block_N=128, block_K=128,
+                   num_stages=2):
     """x (E, M, K) @ w (E, K, N) -> (E, M, N)."""
     E, M, K = x.shape
     N = w.shape[-1]
     k = grouped_gemm_kernel(E, M, N, K, min(block_M, M), min(block_N, N),
-                            min(block_K, K), in_dtype=str(x.dtype))
+                            min(block_K, K), in_dtype=str(x.dtype),
+                            num_stages=num_stages)
     return k(x, w)
 
 
