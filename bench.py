@@ -194,6 +194,14 @@ def _pick_best(cands, check, what, rounds=1):
     return best[0]
 
 
+def _gemm_vmem_est(bm, bn, bk, ns):
+    """Scoped-VMEM estimate of a GEMM tile (bf16 operands, f32 acc):
+    used to order sweep candidates smallest-first so the riskiest shape
+    cannot take out the sweep (a Mosaic fault kills the subprocess and
+    the shared tunnel worker)."""
+    return (bm * bk + bk * bn) * 2 * ns + bm * bn * 4
+
+
 def _check_close(ours, ref, rel_tol):
     """Relative Frobenius error — a wrong kernel's latency is
     meaningless, so every config cross-checks before timing."""
@@ -387,20 +395,25 @@ def cfg_w4a16(M=4096, N=4096, K=4096, gs=512):
             a_, p_, s_, block_M=bm, block_N=bn, block_K=bk, dq_block=gs,
             num_stages=ns)
 
+    # smallest scoped-VMEM first — a Mosaic fault kills the whole config
+    # subprocess AND the shared worker, so the riskiest shapes run last;
+    # the historically faulting fused kernel runs at the very end
+    tp_shapes = sorted(((1024, 1024, 512, 2),
+                        (1024, 1024, 512, 3),
+                        (512, 1024, 1024, 2),
+                        (1024, 512, 1024, 2),
+                        (512, 2048, 512, 2)),
+                       key=lambda s: _gemm_vmem_est(*s))
     o_name, ours, args = _pick_best(
+        [(f"twopass[{bm}x{bn}x{bk},ns{ns}]",
+          functools.partial(_twopass, bm, bn, bk, ns),
+          (a, packed, scales))
+         for bm, bn, bk, ns in tp_shapes] +
         [("fused",
           lambda: dequant_gemm_kernel(M, N, K, block_M=512, block_N=512,
                                       block_K2=gs, group_size=gs,
                                       in_dtype="bfloat16").func,
-          (a_planar, packed, s3))] +
-        [(f"twopass[{bm}x{bn}x{bk},ns{ns}]",
-          functools.partial(_twopass, bm, bn, bk, ns),
-          (a, packed, scales))
-         for bm, bn, bk, ns in ((1024, 1024, 512, 2),
-                                (1024, 1024, 512, 3),
-                                (512, 1024, 1024, 2),
-                                (1024, 512, 1024, 2),
-                                (512, 2048, 512, 2))],
+          (a_planar, packed, s3))],
         check, "w4a16 framework")
 
     # baseline side: hand-written Pallas fused dequant-GEMM vs XLA
@@ -576,13 +589,8 @@ def cfg_moe_grouped(E=8, M=512, K=2048, N=2048):
     ]
     cfgs = list({tuple(sorted(c.items())): c for c in cfgs}.values())
 
-    def _vmem_est(c):
-        """Riskiest (largest scoped-VMEM) candidates run LAST: a Mosaic
-        fault kills the whole config subprocess and the shared worker."""
-        bm, bn, bk = c["block_M"], c["block_N"], c["block_K"]
-        return (bm * bk + bk * bn) * 2 * c["num_stages"] + bm * bn * 4
-
-    cfgs.sort(key=_vmem_est)
+    cfgs.sort(key=lambda c: _gemm_vmem_est(
+        c["block_M"], c["block_N"], c["block_K"], c["num_stages"]))
     want = ref(x, w)
     check = functools.partial(_check_close, ref=want, rel_tol=3e-2)
     _, ours, _ = _pick_best(

@@ -148,3 +148,62 @@ def test_varlen_matches_dense_when_full():
                             causal=True, block_M=32, block_N=32)
     dense = np.asarray(dense)[0].transpose(1, 0, 2)
     np.testing.assert_allclose(got, dense, rtol=2e-2, atol=2e-2)
+
+
+def _varlen_grads(causal, Hq, Hkv, seed):
+    """Varlen kernel grads vs jax AD of the per-sequence dense graph."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    lens = [33, 47, 21]
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    total = int(cu[-1])
+    D = 64
+    q = jnp.asarray(rng.standard_normal((total, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, Hkv, D)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((total, Hq, D)), jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention_varlen(
+            q, k, v, cu, cu, causal=causal, block_M=32, block_N=32) * g)
+
+    def ref_dense(q, k, v):
+        group = Hq // Hkv
+        outs = []
+        for b in range(len(lens)):
+            qi = q[cu[b]:cu[b + 1]]
+            ki = jnp.repeat(k[cu[b]:cu[b + 1]], group, axis=1)
+            vi = jnp.repeat(v[cu[b]:cu[b + 1]], group, axis=1)
+            s = jnp.einsum("qhd,khd->hqk", qi, ki) / np.sqrt(D)
+            if causal:
+                Li = qi.shape[0]
+                mask = jnp.tril(jnp.ones((Li, Li), bool))
+                s = jnp.where(mask[None], s, -jnp.inf)
+            p = jnp.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            outs.append(jnp.einsum("hqk,khd->qhd", p, vi))
+        return jnp.concatenate(outs, 0)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref_dense(q, k, v) * g)
+
+    got = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dQ", "dK", "dV"), got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-2, atol=3e-2,
+            err_msg=f"{name} (causal={causal}, Hq={Hq}, Hkv={Hkv})")
+
+
+def test_varlen_bwd_mha():
+    _varlen_grads(causal=False, Hq=2, Hkv=2, seed=0)
+
+
+def test_varlen_bwd_mha_causal():
+    _varlen_grads(causal=True, Hq=2, Hkv=2, seed=1)
+
+
+def test_varlen_bwd_gqa_causal():
+    _varlen_grads(causal=True, Hq=4, Hkv=2, seed=2)

@@ -147,39 +147,51 @@ def flash_attention_partial(q, k, v, causal, sm_scale, block_M=128,
 
 
 def _make_attention_vjp(kernel_call, partial_call, bwd_call, reference_fn,
-                        backward):
+                        backward, n_aux=0):
     """Shared custom-vjp scaffolding for the attention family (MHA here,
-    GQA in ops/gqa.py): kernel mode normalizes the partial kernel's
-    (acc, m, l), saves lse2 = m + log2(l) for the backward tile kernels;
-    reference mode rematerializes through jax AD of the dense graph."""
+    GQA in ops/gqa.py, varlen in ops/flash_attention_varlen.py): kernel
+    mode normalizes the partial kernel's (acc, m, l) — zeroing l == 0
+    rows (fully-masked / varlen pad) — and saves lse2 = m + log2(l) for
+    the backward tile kernels; reference mode rematerializes through jax
+    AD of the dense graph.
+
+    The primal signature is (q, k, v, *aux) with ``n_aux`` trailing
+    non-differentiable operands (varlen's document masks); their
+    cotangents are None."""
     import jax
     import jax.numpy as jnp
 
     @jax.custom_vjp
-    def fa(q, k, v):
-        return kernel_call(q, k, v)
+    def fa(q, k, v, *aux):
+        return kernel_call(q, k, v, *aux)
 
     if backward not in ("kernel", "reference"):
         raise ValueError(
             f"backward must be 'kernel' or 'reference', got {backward!r}")
     if backward == "kernel":
-        def fwd(q, k, v):
-            acc, m, l = partial_call(q, k, v)
-            o = (acc / l[..., None]).astype(q.dtype)
+        def fwd(q, k, v, *aux):
+            acc, m, l = partial_call(q, k, v, *aux)
+            o = jnp.where(l[..., None] > 0, acc / l[..., None],
+                          0.0).astype(q.dtype)
             lse2 = m + jnp.log2(l)
-            return o, (q, k, v, o, lse2)
+            return o, (q, k, v, aux, o, lse2)
 
         def bwd(res, g):
-            q, k, v, o, lse2 = res
-            return bwd_call(q, k, v, o, lse2, g)
+            q, k, v, aux, o, lse2 = res
+            return tuple(bwd_call(q, k, v, *aux, o, lse2, g)) \
+                + (None,) * n_aux
     else:
-        def fwd(q, k, v):
-            return fa(q, k, v), (q, k, v)
+        if reference_fn is None:
+            raise ValueError(
+                "backward='reference' is not available for this op")
+
+        def fwd(q, k, v, *aux):
+            return fa(q, k, v, *aux), (q, k, v)
 
         def bwd(res, g):
             q, k, v = res
             _, vjp = jax.vjp(reference_fn, q, k, v)
-            return vjp(g)
+            return tuple(vjp(g)) + (None,) * n_aux
 
     fa.defvjp(fwd, bwd)
     return fa

@@ -26,6 +26,13 @@ grid with guarded dynamic windows:
 
 GQA is the same kernel with the KV head taken as `query_head // group`
 (cf. ops/gqa.py); MHA is the group == 1 case.
+
+Backward (reference example_gqa_bwd_tma_reduce_varlen.py behavior): the
+same document masks drive the dKdV / dQ recompute kernels — dKdV grids
+over packed KV blocks per KV head with the (query-head-group x q-block)
+sweep folded into one pipelined axis (cf. ops/gqa_bwd.py), dQ mirrors
+the forward grid; the block-liveness table is simply transposed for the
+dKdV sweep. `flash_attention_varlen` is differentiable via custom_vjp.
 """
 
 import functools
@@ -40,16 +47,92 @@ from ._online_softmax import (alloc_softmax_state, init_softmax_state,
 _LOG2E = 1.44269504
 
 
+def _varlen_softmax_loop(Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx,
+                         by, group, block_M, block_N, D, nK, causal,
+                         scale, dtype, num_stages):
+    """Trace-time emission of the shared document-masked online-softmax
+    loop (single home for the mask numerics — both the inference forward
+    and the AD partial forward call this). Returns the softmax state."""
+    Q_s = T.alloc_shared((block_M, D), dtype)
+    K_s = T.alloc_shared((block_N, D), dtype)
+    V_s = T.alloc_shared((block_N, D), dtype)
+    sq_s = T.alloc_shared((block_M,), "int32")
+    sk_s = T.alloc_shared((block_N,), "int32")
+    pq_s = T.alloc_shared((block_M,), "int32")
+    pk_s = T.alloc_shared((block_N,), "int32")
+    st = alloc_softmax_state(block_M, block_N, D, dtype)
+    S = st["S"]
+
+    T.copy(Q[by, bx * block_M, 0], Q_s)
+    T.copy(SeqQ[bx * block_M], sq_s)
+    if causal:
+        T.copy(PosQ[bx * block_M], pq_s)
+    init_softmax_state(st)
+
+    for kb in T.Pipelined(nK, num_stages=num_stages):
+        # liveness already folds in the causal block skip
+        with T.If(BlockLive[bx, kb] != 0):
+            T.copy(K[by // group, kb * block_N, 0], K_s)
+            T.copy(V[by // group, kb * block_N, 0], V_s)
+            T.copy(SeqK[kb * block_N], sk_s)
+            T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+            if causal:
+                # LOCAL positions: correct even when a sequence's
+                # q and k packing offsets differ (lens_q != lens_k)
+                T.copy(PosK[kb * block_N], pk_s)
+                for i, j in T.Parallel(block_M, block_N):
+                    S[i, j] = T.if_then_else(
+                        (sq_s[i] == sk_s[j]) & (pq_s[i] >= pk_s[j]),
+                        S[i, j] * scale, -T.infinity("float32"))
+            else:
+                for i, j in T.Parallel(block_M, block_N):
+                    S[i, j] = T.if_then_else(
+                        sq_s[i] == sk_s[j],
+                        S[i, j] * scale, -T.infinity("float32"))
+            online_softmax_update(st, V_s, block_M, block_N, D)
+    return st
+
+
 @functools.lru_cache(maxsize=None)
 def varlen_fwd_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
-                      sm_scale, dtype, num_stages=2):
+                      sm_scale, dtype, num_stages=2,
+                      return_partials=False):
     """Packed-layout kernel: Q (Hq, Tq, D), K/V (Hkv, Tk, D), plus the
     per-token sequence ids and the block liveness table. Tq/Tk are the
-    padded packed lengths (multiples of block_M/block_N)."""
+    padded packed lengths (multiples of block_M/block_N).
+
+    return_partials: emit the UNNORMALIZED accumulator and (m, l) stats
+    in the exp2 domain instead of the normalized output (the family's
+    convention, cf. ops/flash_attention.py) — what the backward needs."""
     assert Hq % Hkv == 0 and Tq % block_M == 0 and Tk % block_N == 0
     group = Hq // Hkv
     scale = sm_scale * _LOG2E
     nK = Tk // block_N
+
+    if return_partials:
+        @T.prim_func
+        def varlen_fwd_partial(Q: T.Tensor((Hq, Tq, D), dtype),
+                               K: T.Tensor((Hkv, Tk, D), dtype),
+                               V: T.Tensor((Hkv, Tk, D), dtype),
+                               SeqQ: T.Tensor((Tq,), "int32"),
+                               SeqK: T.Tensor((Tk,), "int32"),
+                               PosQ: T.Tensor((Tq,), "int32"),
+                               PosK: T.Tensor((Tk,), "int32"),
+                               BlockLive: T.Tensor((Tq // block_M, nK),
+                                                   "int32"),
+                               O: T.Tensor((Hq, Tq, D), "float32"),
+                               M: T.Tensor((Hq, Tq), "float32"),
+                               L: T.Tensor((Hq, Tq), "float32")):
+            with T.Kernel(T.ceildiv(Tq, block_M), Hq) as (bx, by):
+                st = _varlen_softmax_loop(
+                    Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx, by,
+                    group, block_M, block_N, D, nK, causal, scale, dtype,
+                    num_stages)
+                T.copy(st["acc"], O[by, bx * block_M, 0])
+                T.copy(st["m_prev"], M[by, bx * block_M])
+                T.copy(st["l"], L[by, bx * block_M])
+
+        return _tl_compile(varlen_fwd_partial)
 
     @T.prim_func
     def varlen_fwd(Q: T.Tensor((Hq, Tq, D), dtype),
@@ -62,53 +145,183 @@ def varlen_fwd_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
                    BlockLive: T.Tensor((Tq // block_M, nK), "int32"),
                    O: T.Tensor((Hq, Tq, D), dtype)):
         with T.Kernel(T.ceildiv(Tq, block_M), Hq) as (bx, by):
+            st = _varlen_softmax_loop(
+                Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx, by,
+                group, block_M, block_N, D, nK, causal, scale, dtype,
+                num_stages)
+            # pad rows / rows with every block masked: l == 0 -> zeros
+            # (the reference zeroes invalid rows via output_pad_fn)
+            acc, l = st["acc"], st["l"]
+            for i, j in T.Parallel(block_M, D):
+                acc[i, j] = T.if_then_else(l[i] > 0.0, acc[i, j] / l[i],
+                                           0.0)
+            T.copy(acc, O[by, bx * block_M, 0])
+
+    return _tl_compile(varlen_fwd)
+
+
+@functools.lru_cache(maxsize=None)
+def varlen_bwd_dkdv_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
+                           sm_scale, dtype, num_stages=2):
+    """dK/dV over packed KV blocks: the (query-head-group x q-block)
+    sweep rides one pipelined axis into a single VMEM accumulator
+    (cf. ops/gqa_bwd.py); document masks zero cross-sequence pairs, so
+    pad rows (whose L is -inf) contribute exactly nothing."""
+    assert Hq % Hkv == 0 and Tq % block_M == 0 and Tk % block_N == 0
+    group = Hq // Hkv
+    scale2 = sm_scale * _LOG2E
+    nQ = Tq // block_M
+
+    @T.prim_func
+    def vdkdv(Q: T.Tensor((Hq, Tq, D), dtype),
+              K: T.Tensor((Hkv, Tk, D), dtype),
+              V: T.Tensor((Hkv, Tk, D), dtype),
+              dO: T.Tensor((Hq, Tq, D), dtype),
+              L: T.Tensor((Hq, Tq), "float32"),
+              Delta: T.Tensor((Hq, Tq), "float32"),
+              SeqQ: T.Tensor((Tq,), "int32"),
+              SeqK: T.Tensor((Tk,), "int32"),
+              PosQ: T.Tensor((Tq,), "int32"),
+              PosK: T.Tensor((Tk,), "int32"),
+              BlockLive: T.Tensor((nQ, Tk // block_N), "int32"),
+              dK: T.Tensor((Hkv, Tk, D), "float32"),
+              dV: T.Tensor((Hkv, Tk, D), "float32")):
+        with T.Kernel(T.ceildiv(Tk, block_N), Hkv) as (bx, by):
+            K_s = T.alloc_shared((block_N, D), dtype)
+            V_s = T.alloc_shared((block_N, D), dtype)
             Q_s = T.alloc_shared((block_M, D), dtype)
+            dO_s = T.alloc_shared((block_M, D), dtype)
+            L_s = T.alloc_shared((block_M,), "float32")
+            De_s = T.alloc_shared((block_M,), "float32")
+            sq_s = T.alloc_shared((block_M,), "int32")
+            sk_s = T.alloc_shared((block_N,), "int32")
+            pq_s = T.alloc_shared((block_M,), "int32")
+            pk_s = T.alloc_shared((block_N,), "int32")
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            P = T.alloc_fragment((block_M, block_N), dtype)
+            dP = T.alloc_fragment((block_M, block_N), "float32")
+            dS = T.alloc_fragment((block_M, block_N), dtype)
+            dK_a = T.alloc_fragment((block_N, D), "float32")
+            dV_a = T.alloc_fragment((block_N, D), "float32")
+
+            T.copy(K[by, bx * block_N, 0], K_s)
+            T.copy(V[by, bx * block_N, 0], V_s)
+            T.copy(SeqK[bx * block_N], sk_s)
+            if causal:
+                T.copy(PosK[bx * block_N], pk_s)
+            T.fill(dK_a, 0)
+            T.fill(dV_a, 0)
+
+            for t in T.Pipelined(group * nQ, num_stages=num_stages):
+                hq = by if group == 1 else by * group + t // nQ
+                qb = t if group == 1 else t % nQ
+                with T.If(BlockLive[qb, bx] != 0):
+                    T.copy(Q[hq, qb * block_M, 0], Q_s)
+                    T.copy(dO[hq, qb * block_M, 0], dO_s)
+                    T.copy(L[hq, qb * block_M], L_s)
+                    T.copy(Delta[hq, qb * block_M], De_s)
+                    T.copy(SeqQ[qb * block_M], sq_s)
+                    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+                    if causal:
+                        T.copy(PosQ[qb * block_M], pq_s)
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                (sq_s[i] == sk_s[j]) &
+                                (pq_s[i] >= pk_s[j]),
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    else:
+                        for i, j in T.Parallel(block_M, block_N):
+                            S[i, j] = T.if_then_else(
+                                sq_s[i] == sk_s[j],
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    T.copy(S, P)
+                    T.gemm(P, dO_s, dV_a, transpose_A=True)
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
+                    T.gemm(dS, Q_s, dK_a, transpose_A=True)
+
+            T.copy(dK_a, dK[by, bx * block_N, 0])
+            T.copy(dV_a, dV[by, bx * block_N, 0])
+
+    return _tl_compile(vdkdv)
+
+
+@functools.lru_cache(maxsize=None)
+def varlen_bwd_dq_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
+                         sm_scale, dtype, num_stages=2):
+    assert Hq % Hkv == 0 and Tq % block_M == 0 and Tk % block_N == 0
+    group = Hq // Hkv
+    scale2 = sm_scale * _LOG2E
+    nK = Tk // block_N
+
+    @T.prim_func
+    def vdq(Q: T.Tensor((Hq, Tq, D), dtype),
+            K: T.Tensor((Hkv, Tk, D), dtype),
+            V: T.Tensor((Hkv, Tk, D), dtype),
+            dO: T.Tensor((Hq, Tq, D), dtype),
+            L: T.Tensor((Hq, Tq), "float32"),
+            Delta: T.Tensor((Hq, Tq), "float32"),
+            SeqQ: T.Tensor((Tq,), "int32"),
+            SeqK: T.Tensor((Tk,), "int32"),
+            PosQ: T.Tensor((Tq,), "int32"),
+            PosK: T.Tensor((Tk,), "int32"),
+            BlockLive: T.Tensor((Tq // block_M, nK), "int32"),
+            dQ: T.Tensor((Hq, Tq, D), "float32")):
+        with T.Kernel(T.ceildiv(Tq, block_M), Hq) as (bx, by):
+            Q_s = T.alloc_shared((block_M, D), dtype)
+            dO_s = T.alloc_shared((block_M, D), dtype)
+            L_s = T.alloc_shared((block_M,), "float32")
+            De_s = T.alloc_shared((block_M,), "float32")
             K_s = T.alloc_shared((block_N, D), dtype)
             V_s = T.alloc_shared((block_N, D), dtype)
             sq_s = T.alloc_shared((block_M,), "int32")
             sk_s = T.alloc_shared((block_N,), "int32")
             pq_s = T.alloc_shared((block_M,), "int32")
             pk_s = T.alloc_shared((block_N,), "int32")
-            st = alloc_softmax_state(block_M, block_N, D, dtype)
-            S = st["S"]
+            S = T.alloc_fragment((block_M, block_N), "float32")
+            dP = T.alloc_fragment((block_M, block_N), "float32")
+            dS = T.alloc_fragment((block_M, block_N), dtype)
+            dQ_a = T.alloc_fragment((block_M, D), "float32")
 
             T.copy(Q[by, bx * block_M, 0], Q_s)
+            T.copy(dO[by, bx * block_M, 0], dO_s)
+            T.copy(L[by, bx * block_M], L_s)
+            T.copy(Delta[by, bx * block_M], De_s)
             T.copy(SeqQ[bx * block_M], sq_s)
             if causal:
                 T.copy(PosQ[bx * block_M], pq_s)
-            init_softmax_state(st)
+            T.fill(dQ_a, 0)
 
+            hk = by if group == 1 else by // group
             for kb in T.Pipelined(nK, num_stages=num_stages):
-                # liveness already folds in the causal block skip
                 with T.If(BlockLive[bx, kb] != 0):
-                    T.copy(K[by // group, kb * block_N, 0], K_s)
-                    T.copy(V[by // group, kb * block_N, 0], V_s)
+                    T.copy(K[hk, kb * block_N, 0], K_s)
+                    T.copy(V[hk, kb * block_N, 0], V_s)
                     T.copy(SeqK[kb * block_N], sk_s)
                     T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
                     if causal:
-                        # LOCAL positions: correct even when a sequence's
-                        # q and k packing offsets differ (lens_q != lens_k)
                         T.copy(PosK[kb * block_N], pk_s)
                         for i, j in T.Parallel(block_M, block_N):
                             S[i, j] = T.if_then_else(
                                 (sq_s[i] == sk_s[j]) &
                                 (pq_s[i] >= pk_s[j]),
-                                S[i, j] * scale, -T.infinity("float32"))
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
                     else:
                         for i, j in T.Parallel(block_M, block_N):
                             S[i, j] = T.if_then_else(
                                 sq_s[i] == sk_s[j],
-                                S[i, j] * scale, -T.infinity("float32"))
-                    online_softmax_update(st, V_s, block_M, block_N, D)
+                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(block_M, block_N):
+                        dS[i, j] = S[i, j] * (dP[i, j] - De_s[i]) * sm_scale
+                    T.gemm(dS, K_s, dQ_a)
 
-            # pad rows / rows with every block masked: l == 0 -> zeros
-            # (the reference zeroes invalid rows via output_pad_fn)
-            acc, l = st["acc"], st["l"]
-            for i, j in T.Parallel(block_M, D):
-                acc[i, j] = T.if_then_else(l[i] > 0.0, acc[i, j] / l[i], 0.0)
-            T.copy(acc, O[by, bx * block_M, 0])
+            T.copy(dQ_a, dQ[by, bx * block_M, 0])
 
-    return _tl_compile(varlen_fwd)
+    return _tl_compile(vdq)
 
 
 def _seq_ids(cu_seqlens, t_pad, t_real, fill):
@@ -184,9 +397,27 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
     live = _block_live(seq_q, valid_q, pos_q, seq_k, valid_k, pos_k,
                        block_M, block_N, causal)
 
-    kern = varlen_fwd_kernel(Hq, Hkv, Tqp, Tkp, D, block_M, block_N,
-                             bool(causal), float(sm_scale), str(q.dtype),
-                             num_stages)
-    o = kern(pack(q, Tqp), pack(k, Tkp), pack(v, Tkp), seq_q, seq_k,
-             pos_q, pos_k, live)
+    from .flash_attention import _make_attention_vjp
+    shapes = (Hq, Hkv, Tqp, Tkp, D, block_M, block_N, bool(causal),
+              float(sm_scale), str(q.dtype), num_stages)
+
+    def _bwd(qp, kp, vp, seq_q, seq_k, pos_q, pos_k, live, o, lse2, g):
+        # lse2 = -inf on pad rows (l == 0) makes their backward P
+        # exactly 0 through the document masks
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
+        dk, dv = varlen_bwd_dkdv_kernel(*shapes)(
+            qp, kp, vp, g.astype(qp.dtype), lse2, delta,
+            seq_q, seq_k, pos_q, pos_k, live)
+        dq = varlen_bwd_dq_kernel(*shapes)(
+            qp, kp, vp, g.astype(qp.dtype), lse2, delta,
+            seq_q, seq_k, pos_q, pos_k, live)
+        return (dq.astype(qp.dtype), dk.astype(kp.dtype),
+                dv.astype(vp.dtype))
+
+    fa = _make_attention_vjp(
+        lambda *a: varlen_fwd_kernel(*shapes)(*a),
+        lambda *a: varlen_fwd_kernel(*shapes, return_partials=True)(*a),
+        _bwd, None, "kernel", n_aux=5)
+    o = fa(pack(q, Tqp), pack(k, Tkp), pack(v, Tkp), seq_q, seq_k,
+           pos_q, pos_k, live)
     return jnp.moveaxis(o[:, :Tq, :], 0, 1)
