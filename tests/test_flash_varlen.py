@@ -207,3 +207,100 @@ def test_varlen_bwd_mha_causal():
 
 def test_varlen_bwd_gqa_causal():
     _varlen_grads(causal=True, Hq=4, Hkv=2, seed=2)
+
+
+def test_varlen_bwd_unequal_qk_lens():
+    """Backward with lens_q != lens_k per sequence (cross-attention
+    style): the dKdV transposed-liveness sweep and local-position masks
+    must stay correct when q and k packing offsets differ."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    lens_q = [20, 35, 11]
+    lens_k = [44, 17, 52]
+    cu_q = np.concatenate([[0], np.cumsum(lens_q)]).astype(np.int32)
+    cu_k = np.concatenate([[0], np.cumsum(lens_k)]).astype(np.int32)
+    Hq, Hkv, D = 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((int(cu_q[-1]), Hq, D)),
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((int(cu_k[-1]), Hkv, D)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((int(cu_k[-1]), Hkv, D)),
+                    jnp.float32)
+    g = jnp.asarray(rng.standard_normal((int(cu_q[-1]), Hq, D)),
+                    jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention_varlen(
+            q, k, v, cu_q, cu_k, causal=False, block_M=32,
+            block_N=32) * g)
+
+    def loss_ref(q, k, v):
+        group = Hq // Hkv
+        tot = 0.0
+        for b in range(len(lens_q)):
+            qi = q[cu_q[b]:cu_q[b + 1]]
+            ki = jnp.repeat(k[cu_k[b]:cu_k[b + 1]], group, axis=1)
+            vi = jnp.repeat(v[cu_k[b]:cu_k[b + 1]], group, axis=1)
+            s = jnp.einsum("qhd,khd->hqk", qi, ki) / np.sqrt(D)
+            p = jnp.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            o = jnp.einsum("hqk,khd->qhd", p, vi)
+            tot = tot + jnp.sum(o * g[cu_q[b]:cu_q[b + 1]])
+        return tot
+
+    got = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dQ", "dK", "dV"), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-2, atol=3e-2, err_msg=name)
+
+
+def test_varlen_bwd_causal_unequal_qk_lens():
+    """Causal backward with lens_q != lens_k: LOCAL-position masks in
+    the recompute must mirror the forward's top-left alignment."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(6)
+    lens_q = [18, 30]
+    lens_k = [41, 26]
+    cu_q = np.concatenate([[0], np.cumsum(lens_q)]).astype(np.int32)
+    cu_k = np.concatenate([[0], np.cumsum(lens_k)]).astype(np.int32)
+    H, D = 2, 64
+    q = jnp.asarray(rng.standard_normal((int(cu_q[-1]), H, D)),
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((int(cu_k[-1]), H, D)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((int(cu_k[-1]), H, D)),
+                    jnp.float32)
+    g = jnp.asarray(rng.standard_normal((int(cu_q[-1]), H, D)),
+                    jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention_varlen(
+            q, k, v, cu_q, cu_k, causal=True, block_M=32,
+            block_N=32) * g)
+
+    def loss_ref(q, k, v):
+        tot = 0.0
+        for b in range(len(lens_q)):
+            qi = q[cu_q[b]:cu_q[b + 1]]
+            ki = k[cu_k[b]:cu_k[b + 1]]
+            vi = v[cu_k[b]:cu_k[b + 1]]
+            lq, lk = qi.shape[0], ki.shape[0]
+            s = jnp.einsum("qhd,khd->hqk", qi, ki) / np.sqrt(D)
+            mask = np.arange(lq)[:, None] >= np.arange(lk)[None, :]
+            s = jnp.where(jnp.asarray(mask)[None], s, -jnp.inf)
+            p = jnp.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            o = jnp.einsum("hqk,khd->qhd", p, vi)
+            tot = tot + jnp.sum(o * g[cu_q[b]:cu_q[b + 1]])
+        return tot
+
+    got = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dQ", "dK", "dV"), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-2, atol=3e-2, err_msg=name)

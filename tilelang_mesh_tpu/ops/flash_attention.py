@@ -184,6 +184,13 @@ def _make_attention_vjp(kernel_call, partial_call, bwd_call, reference_fn,
         if reference_fn is None:
             raise ValueError(
                 "backward='reference' is not available for this op")
+        if n_aux:
+            # a dense reference_fn(q, k, v) cannot see the aux mask
+            # operands — its gradients would flow across sequence
+            # boundaries; refuse rather than silently drop the masks
+            raise ValueError(
+                "backward='reference' is not supported for ops with aux "
+                "mask operands (n_aux > 0); use backward='kernel'")
 
         def fwd(q, k, v, *aux):
             return fa(q, k, v, *aux), (q, k, v)

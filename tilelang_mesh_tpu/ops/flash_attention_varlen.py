@@ -160,6 +160,25 @@ def varlen_fwd_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
     return _tl_compile(varlen_fwd)
 
 
+def _varlen_p_recompute(S, sq_s, sk_s, pq_s, pk_s, L_s, scale2, causal,
+                        block_M, block_N):
+    """Trace-time emission of the backward P-recompute under the
+    document masks: P = exp2(S*scale2 - L) where (seq match [and local
+    causal]), else 0 — the single home for the backward mask numerics
+    (both bwd kernels call this; the forward's analog is
+    _varlen_softmax_loop)."""
+    if causal:
+        for i, j in T.Parallel(block_M, block_N):
+            S[i, j] = T.if_then_else(
+                (sq_s[i] == sk_s[j]) & (pq_s[i] >= pk_s[j]),
+                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+    else:
+        for i, j in T.Parallel(block_M, block_N):
+            S[i, j] = T.if_then_else(
+                sq_s[i] == sk_s[j],
+                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+
+
 @functools.lru_cache(maxsize=None)
 def varlen_bwd_dkdv_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
                            sm_scale, dtype, num_stages=2):
@@ -224,16 +243,8 @@ def varlen_bwd_dkdv_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
                     T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
                     if causal:
                         T.copy(PosQ[qb * block_M], pq_s)
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                (sq_s[i] == sk_s[j]) &
-                                (pq_s[i] >= pk_s[j]),
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                sq_s[i] == sk_s[j],
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    _varlen_p_recompute(S, sq_s, sk_s, pq_s, pk_s, L_s,
+                                        scale2, causal, block_M, block_N)
                     T.copy(S, P)
                     T.gemm(P, dO_s, dV_a, transpose_A=True)
                     T.gemm(dO_s, V_s, dP, transpose_B=True,
@@ -303,16 +314,8 @@ def varlen_bwd_dq_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
                     T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
                     if causal:
                         T.copy(PosK[kb * block_N], pk_s)
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                (sq_s[i] == sk_s[j]) &
-                                (pq_s[i] >= pk_s[j]),
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
-                    else:
-                        for i, j in T.Parallel(block_M, block_N):
-                            S[i, j] = T.if_then_else(
-                                sq_s[i] == sk_s[j],
-                                T.exp2(S[i, j] * scale2 - L_s[i]), 0.0)
+                    _varlen_p_recompute(S, sq_s, sk_s, pq_s, pk_s, L_s,
+                                        scale2, causal, block_M, block_N)
                     T.gemm(dO_s, V_s, dP, transpose_B=True,
                            clear_accum=True)
                     for i, j in T.Parallel(block_M, block_N):
