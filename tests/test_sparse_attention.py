@@ -192,3 +192,132 @@ def test_seer_rectangular_blocks():
     out = seer_attention(q, k, v, gates, topk=2, block_M=bm, block_N=bn)
     ref = seer_reference(q, k, v, gates, 2, bm, bn)
     assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def _nsa_dense_jax(q, k, v, g_slc, bi, cnt, BS, scale=None):
+    """jnp-differentiable dense NSA reference (selected branch only)."""
+    import jax.numpy as jnp
+
+    B, Tq, HQ, D = q.shape
+    H = k.shape[2]
+    G = HQ // H
+    S = bi.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    Tk = k.shape[1]
+    # dense visibility (B, Tq, H, Tk) from the block selection
+    t = jnp.arange(Tq)[None, :, None, None]
+    kk = jnp.arange(Tk)[None, None, None, :]
+    s_idx = jnp.arange(S)[None, None, None, :]
+    vis = jnp.zeros((B, Tq, H, Tk), bool)
+    for s in range(S):
+        b_s = bi[..., s]                                     # (B,Tq,H)
+        ok = (b_s >= 0) & (b_s * BS <= t[..., 0]) & \
+             (s < cnt)
+        in_blk = (kk // BS == b_s[..., None]) & ok[..., None]
+        vis = vis | in_blk
+    vis = vis & (kk <= t)
+    s_ = jnp.einsum("bthgd,bkhd->bthgk",
+                    q.reshape(B, Tq, H, G, D), k) * scale
+    s_ = jnp.where(vis[:, :, :, None, :], s_, -jnp.inf)
+    m = s_.max(-1, keepdims=True)
+    p = jnp.exp(s_ - jnp.where(jnp.isfinite(m), m, 0.0))
+    denom = p.sum(-1, keepdims=True)
+    p = jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
+    o = jnp.einsum("bthgk,bkhd->bthgd", p, v)
+    return (o * g_slc.reshape(B, Tq, H, G)[..., None]
+            ).reshape(B, Tq, HQ, D)
+
+
+def test_nsa_bwd_matches_dense_ad():
+    """dQ/dK/dV/dg through the NSA tile backward vs jax AD of the dense
+    selected-branch graph (reference example_tilelang_nsa_bwd.py)."""
+    import jax
+
+    B, Tq, HQ, H, D, S, BS = 1, 32, 4, 2, 32, 3, 8
+    q, k, v, g_slc, _g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS,
+                                             seed=5)
+    cnt = jnp.full((B, Tq, H), S, jnp.int32)
+    go = jnp.asarray(np.random.default_rng(9).standard_normal(
+        (B, Tq, HQ, D)), jnp.float32)
+
+    def loss_kernel(q, k, v, g_slc):
+        o = nsa_attention(q, k, v, g_slc, jnp.zeros_like(g_slc), bi,
+                          block_size=BS, backward="kernel")
+        return jnp.sum(o * go)
+
+    def loss_ref(q, k, v, g_slc):
+        return jnp.sum(_nsa_dense_jax(q, k, v, g_slc, bi, cnt, BS) * go)
+
+    got = jax.grad(loss_kernel, argnums=(0, 1, 2, 3))(q, k, v, g_slc)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, g_slc)
+    for name, a, b in zip(("dQ", "dK", "dV", "dG"), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-2, atol=3e-2, err_msg=name)
+
+
+def test_nsa_bwd_forward_value_matches_fused():
+    """backward='kernel' primal == the fused inference kernel (window
+    off, swa gate irrelevant)."""
+    B, Tq, HQ, H, D, S, BS = 1, 32, 2, 1, 32, 2, 8
+    q, k, v, g_slc, g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS,
+                                            seed=6)
+    a = nsa_attention(q, k, v, g_slc, g_swa, bi, block_size=BS)
+    b = nsa_attention(q, k, v, g_slc, g_swa, bi, block_size=BS,
+                      backward="kernel")
+    assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2)
+
+
+def test_nsa_bwd_rejects_window():
+    B, Tq, HQ, H, D, S, BS = 1, 16, 2, 1, 16, 2, 8
+    q, k, v, g_slc, g_swa, bi = _nsa_inputs(B, Tq, HQ, H, D, S, BS,
+                                            seed=7)
+    with pytest.raises(ValueError, match="window_size == 0"):
+        nsa_attention(q, k, v, g_slc, g_swa, bi, block_size=BS,
+                      window_size=8, backward="kernel")
+
+
+def test_nsa_bwd_duplicate_indices_multiplicity():
+    """A block listed twice in block_indices carries 2x softmax mass in
+    the forward gather; dK/dV must scale by the multiplicity to stay
+    gradients OF the computed primal."""
+    import jax
+
+    B, Tq, HQ, H, D, S, BS = 1, 16, 2, 1, 16, 3, 8
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((B, Tq, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    g = jnp.ones((B, Tq, HQ), jnp.float32)
+    # every token selects block 0 TWICE plus its own block
+    bi = np.zeros((B, Tq, H, S), np.int64)
+    for t in range(Tq):
+        bi[0, t, 0] = [0, 0, t // BS]
+    bi = jnp.asarray(bi, jnp.int32)
+    go = jnp.asarray(rng.standard_normal((B, Tq, HQ, D)), jnp.float32)
+
+    def loss(q, k, v):
+        o = nsa_attention(q, k, v, g, jnp.zeros_like(g), bi,
+                          block_size=BS, backward="kernel")
+        return jnp.sum(o * go)
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # finite-difference check on a k element INSIDE the duplicated block
+    eps = 1e-3
+    k2 = k.at[0, 3, 0, 5].add(eps)
+    fd = (float(loss(q, k2, v)) - float(loss(q, k, v))) / eps
+    np.testing.assert_allclose(float(got[1][0, 3, 0, 5]), fd, rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_nsa_bwd_rejects_nondivisible_kv():
+    B, Tq, HQ, H, D, S, BS = 1, 20, 2, 1, 16, 2, 8   # 20 % 8 != 0
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((B, Tq, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    g = jnp.ones((B, Tq, HQ), jnp.float32)
+    bi = jnp.zeros((B, Tq, H, S), jnp.int32)
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        nsa_attention(q, k, v, g, g, bi, block_size=BS,
+                      backward="kernel")

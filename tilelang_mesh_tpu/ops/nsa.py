@@ -39,6 +39,36 @@ def _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale, mask_of):
     online_softmax_update(st, V_s, G, BS, D)
 
 
+def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
+                          dtype):
+    """Trace-time emission of the selected-branch gather: allocs, input
+    copies, and the predicated per-slot online-softmax loop (single home
+    for the selection predicate — the fused forward, the AD partial
+    forward, and by construction the dQ re-gather all follow it).
+    Returns (st, Q_s, K_s, V_s, cnt) for the caller's epilogue."""
+    Q_s = T.alloc_shared((G, D), dtype)
+    K_s = T.alloc_shared((BS, D), dtype)
+    V_s = T.alloc_shared((BS, D), dtype)
+    Idx = T.alloc_shared((S,), "int32")
+    cnt = T.alloc_shared((1,), "int32")
+    st = alloc_softmax_state(G, BS, D, dtype)
+
+    T.copy(Q[bz, t, by, 0, 0], Q_s)
+    T.copy(BI[bz, t, by, 0], Idx)
+    T.copy(Cnt[bz, t, by], cnt)
+    init_softmax_state(st)
+
+    for s in T.serial(S):
+        blk = Idx[s]
+        with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t)):
+            T.copy(K[bz, by, blk * BS, 0], K_s)
+            T.copy(V[bz, by, blk * BS, 0], V_s)
+            _gathered_block_update(
+                st, Q_s, K_s, V_s, G, BS, D, scale,
+                mask_of=lambda j, b=blk: b * BS + j <= t)
+    return st, Q_s, K_s, V_s, cnt
+
+
 @functools.lru_cache(maxsize=None)
 def nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, BS, window, sm_scale, dtype):
     """Selected + sliding-window NSA forward. Layouts (kernel-side):
@@ -57,31 +87,12 @@ def nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, BS, window, sm_scale, dtype):
                 Gswa: T.Tensor((B, Tq, H, G), "float32"),
                 O: T.Tensor((B, Tq, H, G, D), dtype)):
         with T.Kernel(Tq, H, B) as (t, by, bz):
-            Q_s = T.alloc_shared((G, D), dtype)
-            K_s = T.alloc_shared((BS, D), dtype)
-            V_s = T.alloc_shared((BS, D), dtype)
-            Idx = T.alloc_shared((S,), "int32")
-            cnt = T.alloc_shared((1,), "int32")
-            gs = T.alloc_shared((G,), "float32")
-            st = alloc_softmax_state(G, BS, D, dtype)
+            st, Q_s, K_s, V_s, cnt = _nsa_selected_prelude(
+                Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale, dtype)
             acc, l = st["acc"], st["l"]
+            gs = T.alloc_shared((G,), "float32")
             out = T.alloc_fragment((G, D), "float32")
-
-            T.copy(Q[bz, t, by, 0, 0], Q_s)
-            T.copy(BI[bz, t, by, 0], Idx)
-            T.copy(Cnt[bz, t, by], cnt)
             T.copy(Gslc[bz, t, by, 0], gs)
-            init_softmax_state(st)
-
-            # --- selected-block attention ---
-            for s in T.serial(S):
-                blk = Idx[s]
-                with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t)):
-                    T.copy(K[bz, by, blk * BS, 0], K_s)
-                    T.copy(V[bz, by, blk * BS, 0], V_s)
-                    _gathered_block_update(
-                        st, Q_s, K_s, V_s, G, BS, D, scale,
-                        mask_of=lambda j, b=blk: b * BS + j <= t)
             for i, j in T.Parallel(G, D):
                 out[i, j] = acc[i, j] / T.max(l[i], 1e-30) * gs[i]
 
@@ -106,13 +117,49 @@ def nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, BS, window, sm_scale, dtype):
     return _tl_compile(nsa_fwd)
 
 
+@functools.lru_cache(maxsize=None)
+def nsa_fwd_partial_kernel(B, Tq, H, G, Tk, D, S, BS, sm_scale, dtype):
+    """Selected-branch forward WITHOUT gating, emitting the unnormalized
+    accumulator and (m, l) stats — the residuals the backward kernels
+    (ops/nsa_bwd.py) rebuild the softmax from. Same gather loop as
+    nsa_fwd_kernel's selected branch."""
+    scale = sm_scale * _LOG2E
+
+    @T.prim_func
+    def nsa_fwd_partial(Q: T.Tensor((B, Tq, H, G, D), dtype),
+                        K: T.Tensor((B, H, Tk, D), dtype),
+                        V: T.Tensor((B, H, Tk, D), dtype),
+                        BI: T.Tensor((B, Tq, H, S), "int32"),
+                        Cnt: T.Tensor((B, Tq, H), "int32"),
+                        O: T.Tensor((B, Tq, H, G, D), "float32"),
+                        M: T.Tensor((B, Tq, H, G), "float32"),
+                        L: T.Tensor((B, Tq, H, G), "float32")):
+        with T.Kernel(Tq, H, B) as (t, by, bz):
+            st, _Q_s, _K_s, _V_s, _cnt = _nsa_selected_prelude(
+                Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale, dtype)
+            T.copy(st["acc"], O[bz, t, by, 0, 0])
+            T.copy(st["m_prev"], M[bz, t, by, 0])
+            T.copy(st["l"], L[bz, t, by, 0])
+
+    return _tl_compile(nsa_fwd_partial)
+
+
 def nsa_attention(q, k, v, g_slc, g_swa, block_indices,
                   block_counts: Optional[Union[int, object]] = None,
                   block_size: int = 64, window_size: int = 0,
-                  scale: Optional[float] = None):
+                  scale: Optional[float] = None,
+                  backward: Optional[str] = None):
     """NSA forward, reference layout (reference.py:naive_nsa, head_first
     False): q (B, T, HQ, D); k/v (B, T, H, D); g_slc/g_swa (B, T, HQ);
-    block_indices (B, T, H, S); block_counts int or (B, T, H)."""
+    block_indices (B, T, H, S); block_counts int or (B, T, H).
+
+    backward=None (default): the fused inference kernel (selected +
+    window branches, gates applied in-kernel), not differentiable.
+    backward="kernel": differentiable via the dKdV/dQ tile kernels
+    (ops/nsa_bwd.py); requires window_size == 0, matching the
+    reference's backward (example_tilelang_nsa_bwd.py:599 asserts the
+    same). The gates multiply OUTSIDE the custom_vjp, so d(g_slc) falls
+    out of jax AD."""
     import jax.numpy as jnp
 
     B, Tq, HQ, D = q.shape
@@ -136,10 +183,56 @@ def nsa_attention(q, k, v, g_slc, g_swa, block_indices,
     gw = jnp.asarray(g_swa, jnp.float32).reshape(B, Tq, H, G)
     bi = jnp.asarray(block_indices, jnp.int32)
 
-    kern = nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, int(block_size),
-                          int(window_size), float(scale), str(q.dtype))
-    o = kern(q5, kh, vh, bi, cnt, gs, gw)
-    return o.reshape(B, Tq, HQ, D)
+    if backward is None:
+        kern = nsa_fwd_kernel(B, Tq, H, G, Tk, D, S, int(block_size),
+                              int(window_size), float(scale),
+                              str(q.dtype))
+        o = kern(q5, kh, vh, bi, cnt, gs, gw)
+        return o.reshape(B, Tq, HQ, D)
+
+    if window_size:
+        raise ValueError(
+            "nsa_attention backward requires window_size == 0 (the "
+            "reference backward asserts the same)")
+    if Tk % int(block_size):
+        raise ValueError(
+            f"nsa_attention backward requires the KV length ({Tk}) to "
+            f"be a multiple of block_size ({block_size}): the dKdV "
+            f"sweep writes full KV blocks")
+    from .flash_attention import _make_attention_vjp
+    from .nsa_bwd import (nsa_block_mask, nsa_bwd_dkdv_kernel,
+                          nsa_bwd_dq_kernel)
+    BS = int(block_size)
+    NS = -(-Tk // BS)
+    mask = nsa_block_mask(bi, cnt, Tq, NS, BS)
+    shapes = (B, Tq, H, G, Tk, D, S, BS, float(scale), str(q.dtype))
+
+    def _bwd(q5, kh, vh, bi, cnt, mask, o, lse2, g):
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
+        g_ = g.astype(q5.dtype)
+        dk, dv = nsa_bwd_dkdv_kernel(B, Tq, H, G, Tk, D, NS, BS,
+                                     float(scale), str(q5.dtype))(
+            q5, kh, vh, g_, lse2, delta, mask)
+        dq = nsa_bwd_dq_kernel(*shapes)(
+            q5, kh, vh, g_, lse2, delta, bi, cnt)
+        return (dq.astype(q5.dtype), dk.astype(kh.dtype),
+                dv.astype(vh.dtype))
+
+    def _partial(q5, kh, vh, bi, cnt, mask):
+        return nsa_fwd_partial_kernel(*shapes)(q5, kh, vh, bi, cnt)
+
+    def _primal(q5, kh, vh, bi, cnt, mask):
+        acc, _m, l = _partial(q5, kh, vh, bi, cnt, mask)
+        return jnp.where(l[..., None] > 0, acc / l[..., None],
+                         0.0).astype(q5.dtype)
+
+    fa = _make_attention_vjp(_primal, _partial, _bwd, None, "kernel",
+                             n_aux=3)
+    o_slc = fa(q5, kh, vh, bi, cnt, mask)          # ungated, normalized
+    # gates multiply outside the vjp: d(g_slc) comes from jax AD; dk/dv
+    # flow back through the kh/vh transposes automatically
+    o = o_slc * gs[..., None]
+    return o.reshape(B, Tq, HQ, D).astype(q.dtype)
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,172 @@
+"""Native Sparse Attention backward as tile kernels.
+
+Behavioral equivalent of the reference's
+examples/deepseek_nsa/example_tilelang_nsa_bwd.py:161-530 (selected
+branch; the reference likewise asserts window_size == 0 in its backward,
+example_tilelang_nsa_bwd.py:599). The data-dependent scatter in dK/dV is
+resolved the way the reference's own flash_bwd_block_mask kernel does —
+by INVERTING the per-token block selection into a dense
+(token x kv-block) mask — except the inversion here is a few vectorized
+XLA ops (one_hot + sum) instead of a launch, and the dKdV kernel then
+grids over KV blocks and sweeps tokens with the mask as a predicate, so
+every dK/dV block is written exactly once (no atomics, which TPU lacks).
+
+dQ mirrors the forward's gather loop: per token, re-fetch the selected
+blocks at data-dependent offsets, rebuild P from the saved lse, and
+accumulate dS @ K.
+"""
+
+import functools
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+_LOG2E = 1.44269504
+
+
+@functools.lru_cache(maxsize=None)
+def nsa_bwd_dkdv_kernel(B, Tq, H, G, Tk, D, NS, BS, sm_scale, dtype):
+    """Grid per (kv-block, kv-head, batch); serial token sweep gated by
+    the inverted selection mask (cf. reference flash_bwd_dkv, which
+    makes the same token sweep per KV block)."""
+    scale2 = sm_scale * _LOG2E
+
+    @T.prim_func
+    def nsa_dkdv(Q: T.Tensor((B, Tq, H, G, D), dtype),
+                 K: T.Tensor((B, H, Tk, D), dtype),
+                 V: T.Tensor((B, H, Tk, D), dtype),
+                 dO: T.Tensor((B, Tq, H, G, D), dtype),
+                 L: T.Tensor((B, Tq, H, G), "float32"),
+                 Delta: T.Tensor((B, Tq, H, G), "float32"),
+                 Mask: T.Tensor((B, Tq, H, NS), "int32"),
+                 dK: T.Tensor((B, H, Tk, D), "float32"),
+                 dV: T.Tensor((B, H, Tk, D), "float32")):
+        with T.Kernel(NS, H, B) as (bx, by, bz):
+            K_s = T.alloc_shared((BS, D), dtype)
+            V_s = T.alloc_shared((BS, D), dtype)
+            Q_s = T.alloc_shared((G, D), dtype)
+            dO_s = T.alloc_shared((G, D), dtype)
+            L_s = T.alloc_shared((G,), "float32")
+            De_s = T.alloc_shared((G,), "float32")
+            mcnt = T.alloc_shared((1,), "int32")
+            S_f = T.alloc_fragment((G, BS), "float32")
+            P = T.alloc_fragment((G, BS), dtype)
+            dP = T.alloc_fragment((G, BS), "float32")
+            dS = T.alloc_fragment((G, BS), dtype)
+            dK_a = T.alloc_fragment((BS, D), "float32")
+            dV_a = T.alloc_fragment((BS, D), "float32")
+
+            T.copy(K[bz, by, bx * BS, 0], K_s)
+            T.copy(V[bz, by, bx * BS, 0], V_s)
+            T.fill(dK_a, 0)
+            T.fill(dV_a, 0)
+
+            for t in T.serial(Tq):
+                with T.If(Mask[bz, t, by, bx] != 0):
+                    T.copy(Q[bz, t, by, 0, 0], Q_s)
+                    T.copy(dO[bz, t, by, 0, 0], dO_s)
+                    T.copy(L[bz, t, by, 0], L_s)
+                    T.copy(Delta[bz, t, by, 0], De_s)
+                    T.copy(Mask[bz, t, by, bx], mcnt)
+                    T.gemm(Q_s, K_s, S_f, transpose_B=True,
+                           clear_accum=True)
+                    # mcnt carries the selection MULTIPLICITY: a block
+                    # listed m times in block_indices gets m x the
+                    # softmax mass in the forward gather, so its dK/dV
+                    # contributions scale by m to match the primal
+                    for i, j in T.Parallel(G, BS):
+                        S_f[i, j] = T.if_then_else(
+                            bx * BS + j <= t,
+                            T.exp2(S_f[i, j] * scale2 - L_s[i])
+                            * T.cast(mcnt[0], "float32"), 0.0)
+                    T.copy(S_f, P)
+                    # dV += P^T dO (accumulates across selecting tokens)
+                    T.gemm(P, dO_s, dV_a, transpose_A=True)
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(G, BS):
+                        dS[i, j] = S_f[i, j] * (dP[i, j] - De_s[i]) \
+                            * sm_scale
+                    T.gemm(dS, Q_s, dK_a, transpose_A=True)
+
+            T.copy(dK_a, dK[bz, by, bx * BS, 0])
+            T.copy(dV_a, dV[bz, by, bx * BS, 0])
+
+    return _tl_compile(nsa_dkdv)
+
+
+@functools.lru_cache(maxsize=None)
+def nsa_bwd_dq_kernel(B, Tq, H, G, Tk, D, S, BS, sm_scale, dtype):
+    """Per-token gather loop mirroring the forward: re-fetch the
+    selected blocks, rebuild P, accumulate dQ = sum dS @ K."""
+    scale2 = sm_scale * _LOG2E
+
+    @T.prim_func
+    def nsa_dq(Q: T.Tensor((B, Tq, H, G, D), dtype),
+               K: T.Tensor((B, H, Tk, D), dtype),
+               V: T.Tensor((B, H, Tk, D), dtype),
+               dO: T.Tensor((B, Tq, H, G, D), dtype),
+               L: T.Tensor((B, Tq, H, G), "float32"),
+               Delta: T.Tensor((B, Tq, H, G), "float32"),
+               BI: T.Tensor((B, Tq, H, S), "int32"),
+               Cnt: T.Tensor((B, Tq, H), "int32"),
+               dQ: T.Tensor((B, Tq, H, G, D), "float32")):
+        with T.Kernel(Tq, H, B) as (t, by, bz):
+            Q_s = T.alloc_shared((G, D), dtype)
+            dO_s = T.alloc_shared((G, D), dtype)
+            K_s = T.alloc_shared((BS, D), dtype)
+            V_s = T.alloc_shared((BS, D), dtype)
+            Idx = T.alloc_shared((S,), "int32")
+            cnt = T.alloc_shared((1,), "int32")
+            L_s = T.alloc_shared((G,), "float32")
+            De_s = T.alloc_shared((G,), "float32")
+            S_f = T.alloc_fragment((G, BS), "float32")
+            dP = T.alloc_fragment((G, BS), "float32")
+            dS = T.alloc_fragment((G, BS), dtype)
+            dQ_a = T.alloc_fragment((G, D), "float32")
+
+            T.copy(Q[bz, t, by, 0, 0], Q_s)
+            T.copy(dO[bz, t, by, 0, 0], dO_s)
+            T.copy(BI[bz, t, by, 0], Idx)
+            T.copy(Cnt[bz, t, by], cnt)
+            T.copy(L[bz, t, by, 0], L_s)
+            T.copy(Delta[bz, t, by, 0], De_s)
+            T.fill(dQ_a, 0)
+
+            for s in T.serial(S):
+                blk = Idx[s]
+                with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t)):
+                    T.copy(K[bz, by, blk * BS, 0], K_s)
+                    T.copy(V[bz, by, blk * BS, 0], V_s)
+                    T.gemm(Q_s, K_s, S_f, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(G, BS):
+                        S_f[i, j] = T.if_then_else(
+                            blk * BS + j <= t,
+                            T.exp2(S_f[i, j] * scale2 - L_s[i]), 0.0)
+                    T.gemm(dO_s, V_s, dP, transpose_B=True,
+                           clear_accum=True)
+                    for i, j in T.Parallel(G, BS):
+                        dS[i, j] = S_f[i, j] * (dP[i, j] - De_s[i]) \
+                            * sm_scale
+                    T.gemm(dS, K_s, dQ_a)
+
+            T.copy(dQ_a, dQ[bz, t, by, 0, 0])
+
+    return _tl_compile(nsa_dq)
+
+
+def nsa_block_mask(bi, cnt, Tq, NS, BS):
+    """Invert the per-token selection into a dense (B, Tq, H, NS) int32
+    MULTIPLICITY map (0 = not selected; m > 1 = listed m times, whose
+    forward softmax mass is m-fold) with the causal/count/validity rules
+    folded in — the XLA-ops analog of the reference's
+    flash_bwd_block_mask kernel (example_tilelang_nsa_bwd.py:533)."""
+    import jax
+    import jax.numpy as jnp
+    t = jnp.arange(Tq, dtype=jnp.int32)[None, :, None, None]
+    s_idx = jnp.arange(bi.shape[-1], dtype=jnp.int32)[None, None, None, :]
+    valid = (bi >= 0) & (bi * BS <= t) & (s_idx < cnt[..., None])
+    onehot = jax.nn.one_hot(jnp.where(valid, bi, NS), NS + 1,
+                            dtype=jnp.int32)
+    return onehot.sum(-2)[..., :NS]
