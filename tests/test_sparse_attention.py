@@ -208,7 +208,6 @@ def _nsa_dense_jax(q, k, v, g_slc, bi, cnt, BS, scale=None):
     # dense visibility (B, Tq, H, Tk) from the block selection
     t = jnp.arange(Tq)[None, :, None, None]
     kk = jnp.arange(Tk)[None, None, None, :]
-    s_idx = jnp.arange(S)[None, None, None, :]
     vis = jnp.zeros((B, Tq, H, Tk), bool)
     for s in range(S):
         b_s = bi[..., s]                                     # (B,Tq,H)
@@ -321,3 +320,80 @@ def test_nsa_bwd_rejects_nondivisible_kv():
     with pytest.raises(ValueError, match="multiple of block_size"):
         nsa_attention(q, k, v, g, g, bi, block_size=BS,
                       backward="kernel")
+
+
+def test_nsa_varlen_fwd_matches_per_sequence():
+    """Varlen NSA == per-sequence dense NSA reference: sequence-local
+    block ids, no attention across boundaries."""
+    from tilelang_mesh_tpu.ops.nsa import nsa_attention_varlen
+
+    rng = np.random.default_rng(21)
+    lens = [24, 40, 9]
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    total = int(cu[-1])
+    HQ, H, D, S, BS = 4, 2, 32, 3, 8
+    q = jnp.asarray(rng.standard_normal((total, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+    g = jnp.asarray(rng.uniform(0.2, 1.0, (total, HQ)), jnp.float32)
+    # per-token sequence-LOCAL causal selections incl. the own block
+    bi = np.full((total, H, S), -1, np.int64)
+    for b in range(len(lens)):
+        for tl in range(lens[b]):
+            own = tl // BS
+            for h in range(H):
+                picks = rng.choice(own + 1, size=min(S, own + 1),
+                                   replace=False)
+                row = np.full(S, -1)
+                row[:len(picks)] = picks
+                if own not in picks:
+                    row[0] = own
+                bi[cu[b] + tl, h] = row
+    bi = jnp.asarray(bi, jnp.int32)
+
+    out = np.asarray(nsa_attention_varlen(q, k, v, g, bi, cu,
+                                          block_size=BS))
+
+    # reference: run each sequence through the dense batch NSA reference
+    for b in range(len(lens)):
+        lo, hi = int(cu[b]), int(cu[b + 1])
+        ref = nsa_reference(q[None, lo:hi], k[None, lo:hi],
+                            v[None, lo:hi], g[None, lo:hi],
+                            jnp.zeros((1, hi - lo, HQ), jnp.float32),
+                            bi[None, lo:hi], block_size=BS)
+        np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
+                                   rtol=2e-2, atol=2e-2,
+                                   err_msg=f"sequence {b}")
+
+
+def test_nsa_varlen_no_cross_sequence_leak():
+    """Selecting the LAST local block of a short sequence must not leak
+    the next sequence's keys (window pokes past the boundary)."""
+    from tilelang_mesh_tpu.ops.nsa import nsa_attention_varlen
+
+    rng = np.random.default_rng(22)
+    lens = [12, 20]          # 12 % BS != 0: block 1 of seq 0 is partial
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    total = int(cu[-1])
+    HQ, H, D, S, BS = 2, 1, 16, 2, 8
+    q = jnp.asarray(rng.standard_normal((total, HQ, D)), jnp.float32)
+    k1 = rng.standard_normal((total, H, D)).astype(np.float32)
+    k2 = k1.copy()
+    k2[12:] += 100.0          # perturb ONLY sequence 1's keys
+    v = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+    g = jnp.ones((total, HQ), jnp.float32)
+    bi = np.full((total, H, S), -1, np.int64)
+    for tl in range(12):
+        bi[tl, 0, 0] = tl // BS
+        if tl // BS == 1:
+            bi[tl, 0, 1] = 0
+    for tl in range(20):
+        bi[12 + tl, 0, 0] = tl // BS
+    bi = jnp.asarray(bi, jnp.int32)
+
+    o1 = np.asarray(nsa_attention_varlen(q, jnp.asarray(k1), v, g, bi,
+                                         cu, block_size=BS))
+    o2 = np.asarray(nsa_attention_varlen(q, jnp.asarray(k2), v, g, bi,
+                                         cu, block_size=BS))
+    np.testing.assert_allclose(o1[:12], o2[:12], rtol=1e-5, atol=1e-5,
+                               err_msg="sequence 0 saw sequence 1's keys")

@@ -20,7 +20,7 @@ from .grouped_gemm import grouped_matmul, grouped_gemm_kernel
 from .gemm_variants import (matmul_splitk, matmul_streamk, gemv,
                             blocksparse_matmul)
 from .attention_sink import attention_sink, attention_sink_reference
-from .nsa import nsa_attention, nsa_decode, nsa_reference
+from .nsa import nsa_attention_varlen, nsa_attention, nsa_decode, nsa_reference
 from .seer_attention import seer_attention, seer_block_mask, seer_reference
 from .minference import vertical_slash_sparse_attention, vs_sparse_reference
 from .gdn import gdn_chunk_fwd, gdn_reference

@@ -40,12 +40,17 @@ def _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale, mask_of):
 
 
 def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
-                          dtype):
+                          dtype, TEnd=None, raw_offsets=False):
     """Trace-time emission of the selected-branch gather: allocs, input
     copies, and the predicated per-slot online-softmax loop (single home
     for the selection predicate — the fused forward, the AD partial
-    forward, and by construction the dQ re-gather all follow it).
-    Returns (st, Q_s, K_s, V_s, cnt) for the caller's epilogue."""
+    forward, the varlen forward, and by construction the dQ re-gather
+    all follow it). Returns (st, Q_s, K_s, V_s, cnt).
+
+    raw_offsets: BI entries are raw K/V row offsets (the varlen path,
+    where the wrapper folds the sequence base in) instead of block ids.
+    TEnd: optional (B, Tq) per-token exclusive key bound (the varlen
+    sequence end) added to the visibility mask."""
     Q_s = T.alloc_shared((G, D), dtype)
     K_s = T.alloc_shared((BS, D), dtype)
     V_s = T.alloc_shared((BS, D), dtype)
@@ -56,16 +61,24 @@ def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
     T.copy(Q[bz, t, by, 0, 0], Q_s)
     T.copy(BI[bz, t, by, 0], Idx)
     T.copy(Cnt[bz, t, by], cnt)
+    if TEnd is not None:
+        tend = T.alloc_shared((1,), "int32")
+        T.copy(TEnd[bz, t], tend)
     init_softmax_state(st)
 
     for s in T.serial(S):
-        blk = Idx[s]
-        with T.If((s < cnt[0]) & (blk >= 0) & (blk * BS <= t)):
-            T.copy(K[bz, by, blk * BS, 0], K_s)
-            T.copy(V[bz, by, blk * BS, 0], V_s)
-            _gathered_block_update(
-                st, Q_s, K_s, V_s, G, BS, D, scale,
-                mask_of=lambda j, b=blk: b * BS + j <= t)
+        idx = Idx[s]
+        off = idx if raw_offsets else idx * BS
+        with T.If((s < cnt[0]) & (idx >= 0) & (off <= t)):
+            T.copy(K[bz, by, off, 0], K_s)
+            T.copy(V[bz, by, off, 0], V_s)
+            if TEnd is not None:
+                mask = (lambda j, o=off: (o + j <= t) &
+                        (o + j < tend[0]))
+            else:
+                mask = lambda j, o=off: o + j <= t
+            _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale,
+                                   mask_of=mask)
     return st, Q_s, K_s, V_s, cnt
 
 
@@ -226,13 +239,99 @@ def nsa_attention(q, k, v, g_slc, g_swa, block_indices,
         return jnp.where(l[..., None] > 0, acc / l[..., None],
                          0.0).astype(q5.dtype)
 
-    fa = _make_attention_vjp(_primal, _partial, _bwd, None, "kernel",
+    fa = _make_attention_vjp(_primal, _partial, _bwd, None, backward,
                              n_aux=3)
     o_slc = fa(q5, kh, vh, bi, cnt, mask)          # ungated, normalized
     # gates multiply outside the vjp: d(g_slc) comes from jax AD; dk/dv
     # flow back through the kh/vh transposes automatically
     o = o_slc * gs[..., None]
     return o.reshape(B, Tq, HQ, D).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def nsa_varlen_fwd_kernel(Tq, H, G, Tk, D, S, BS, sm_scale, dtype):
+    """Varlen (cu_seqlens) NSA selected-branch forward over PACKED
+    tokens (reference examples/deepseek_nsa
+    example_tilelang_nsa_fwd_varlen.py behavior). Selected blocks are
+    sequence-LOCAL; the wrapper turns them into raw packed ROW OFFSETS
+    (cu[seq] + blk*BS) so the kernel's data-dependent DMA needs no
+    per-sequence bases, and a per-token sequence-end bound masks keys
+    past the boundary (packed order == position order, so causal is the
+    plain packed comparison)."""
+    scale = sm_scale * _LOG2E
+
+    @T.prim_func
+    def nsa_vfwd(Q: T.Tensor((1, Tq, H, G, D), dtype),
+                 K: T.Tensor((1, H, Tk, D), dtype),
+                 V: T.Tensor((1, H, Tk, D), dtype),
+                 Offs: T.Tensor((1, Tq, H, S), "int32"),
+                 Cnt: T.Tensor((1, Tq, H), "int32"),
+                 TEnd: T.Tensor((1, Tq), "int32"),
+                 Gslc: T.Tensor((1, Tq, H, G), "float32"),
+                 O: T.Tensor((1, Tq, H, G, D), dtype)):
+        with T.Kernel(Tq, H) as (t, by):
+            st, _Q_s, _K_s, _V_s, _cnt = _nsa_selected_prelude(
+                Q, K, V, Offs, Cnt, 0, t, by, S, BS, G, D, scale, dtype,
+                TEnd=TEnd, raw_offsets=True)
+            acc, l = st["acc"], st["l"]
+            gs = T.alloc_shared((G,), "float32")
+            out = T.alloc_fragment((G, D), "float32")
+            T.copy(Gslc[0, t, by, 0], gs)
+            for i, j in T.Parallel(G, D):
+                out[i, j] = acc[i, j] / T.max(l[i], 1e-30) * gs[i]
+            T.copy(out, O[0, t, by, 0, 0])
+
+    return _tl_compile(nsa_vfwd)
+
+
+def nsa_attention_varlen(q, k, v, g_slc, block_indices, cu_seqlens,
+                         block_counts: Optional[Union[int, object]] = None,
+                         block_size: int = 64,
+                         scale: Optional[float] = None):
+    """Ragged-batch NSA (selected branch): q (total, HQ, D); k/v
+    (total, H, D); g_slc (total, HQ); block_indices (total, H, S) with
+    sequence-LOCAL block ids; cu_seqlens (B+1,) int32. No attention
+    crosses a sequence boundary; the kernel needs Tk % block_size == 0
+    only for its last gathered window, handled by masking TEnd."""
+    import jax.numpy as jnp
+
+    from .flash_attention_varlen import _seq_ids
+
+    Tq, HQ, D = q.shape
+    H = k.shape[1]
+    G = HQ // H
+    S = block_indices.shape[-1]
+    BS = int(block_size)
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if block_counts is None:
+        cnt = jnp.full((Tq, H), S, jnp.int32)
+    elif isinstance(block_counts, int):
+        cnt = jnp.full((Tq, H), block_counts, jnp.int32)
+    else:
+        cnt = jnp.asarray(block_counts, jnp.int32)
+
+    cu = jnp.asarray(cu_seqlens, jnp.int32)
+    sid, _pos, valid = _seq_ids(cu, Tq, Tq, fill=-1)
+    start = cu[jnp.clip(sid, 0, cu.shape[0] - 2)]
+    end = cu[jnp.clip(sid, 0, cu.shape[0] - 2) + 1]
+    tend = jnp.where(valid, end, 0).astype(jnp.int32)          # (Tq,)
+    bi = jnp.asarray(block_indices, jnp.int32)
+    # local block id -> raw packed row offset; invalid slots -> -1
+    offs = jnp.where(bi >= 0,
+                     start[:, None, None] + bi * BS, -1).astype(jnp.int32)
+    # a window starting near a sequence end pokes up to BS-1 rows past
+    # it: TEnd masks rows of the NEXT sequence, and one block of zero
+    # padding gives the very last window physical rows to read
+    kp = jnp.pad(jnp.transpose(k, (1, 0, 2)), ((0, 0), (0, BS), (0, 0)))
+    vp = jnp.pad(jnp.transpose(v, (1, 0, 2)), ((0, 0), (0, BS), (0, 0)))
+
+    kern = nsa_varlen_fwd_kernel(Tq, H, G, k.shape[0] + BS, D, S, BS,
+                                 float(scale), str(q.dtype))
+    o = kern(q.reshape(1, Tq, H, G, D), kp[None], vp[None], offs[None],
+             cnt[None], tend[None],
+             jnp.asarray(g_slc, jnp.float32).reshape(1, Tq, H, G))
+    return o.reshape(Tq, HQ, D)
 
 
 @functools.lru_cache(maxsize=None)
