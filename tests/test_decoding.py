@@ -67,3 +67,32 @@ def test_mla_decode():
     ref = mla_decode_reference(qc, qr, ckv, kpe)
     assert out.shape == (B, H, dc)
     assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_paged_decode_inkernel_walk_matches_gather():
+    """The in-kernel page walk over the H-major pool must equal the
+    contiguous (gathered) decode bit-for-bit semantics."""
+    from tilelang_mesh_tpu.ops.flash_decoding import (
+        flash_decode, flash_decode_paged, flash_decode_paged_pool,
+        pages_to_hmajor)
+
+    rng = np.random.default_rng(0)
+    B, H, D, PS, PP, NP = 2, 4, 64, 32, 4, 12
+    q = jnp.asarray(rng.standard_normal((B, H, 1, D)), jnp.float32)
+    kpages = jnp.asarray(rng.standard_normal((NP, PS, H, D)), jnp.float32)
+    vpages = jnp.asarray(rng.standard_normal((NP, PS, H, D)), jnp.float32)
+    table = jnp.asarray(np.stack([
+        rng.choice(NP, PP, replace=False) for _ in range(B)]), jnp.int32)
+
+    # legacy entry (page-array layout): converts + walks in-kernel
+    o_walk = np.asarray(flash_decode_paged(q, kpages, vpages, table))
+    # pool entry directly
+    o_pool = np.asarray(flash_decode_paged_pool(
+        q, pages_to_hmajor(kpages), pages_to_hmajor(vpages), table, PS))
+    # ground truth: gather then contiguous decode
+    k = jnp.take(kpages, table, axis=0).reshape(B, PP * PS, H, D)
+    v = jnp.take(vpages, table, axis=0).reshape(B, PP * PS, H, D)
+    want = np.asarray(flash_decode(q, k.transpose(0, 2, 1, 3),
+                                   v.transpose(0, 2, 1, 3)))
+    np.testing.assert_allclose(o_walk, want, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(o_pool, want, rtol=2e-2, atol=2e-2)

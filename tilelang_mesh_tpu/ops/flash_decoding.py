@@ -5,9 +5,11 @@ Behavioral equivalent of /root/reference/examples/flash_decoding/: the KV
 cache is split into chunks processed in parallel grid steps; each split
 emits an unnormalized partial (o, m, l) and a tiny XLA epilogue combines
 them — the split axis is a *parallel* Pallas grid dimension, so Mosaic
-overlaps chunk DMA freely. Paged KV: pages are gathered to contiguous form
-at the XLA level (jnp.take) before the kernel; in-kernel page walking via
-scalar prefetch is the planned follow-up.
+overlaps chunk DMA freely. Paged KV has two strategies: gather pages to
+contiguous form at the XLA level then run the pipelined kernel
+(`flash_decode_paged`), or walk an H-major page pool IN-KERNEL at
+table-driven DMA offsets with no gather pass
+(`flash_decode_paged_pool`); the bench measures both per chip.
 """
 
 import functools
@@ -104,8 +106,81 @@ def flash_decode(q, k, v, sm_scale=None, n_split=None, block_N=128):
     kern = decode_kernel(B, H, S, D, n_split, block_N, float(sm_scale),
                          str(q.dtype))
     op, mp, lp = kern(q, k, v)
-    # combine splits (all in the exp2 domain used by the kernel);
-    # op (B,ns,H,D), mp/lp (B,ns,H,1)
+    return _combine_splits(q, op, mp, lp)
+
+
+@functools.lru_cache(maxsize=None)
+def paged_decode_kernel(B, H, PP, PS, D, n_split, rows, sm_scale, dtype):
+    """In-kernel page walking: KP/VP are an H-MAJOR page pool
+    (H, n_pages*page_size, D); each split's programs DMA their pages
+    directly at table-driven offsets (the same data-dependent gather as
+    ops/nsa.py), so no XLA-level page materialization pass touches HBM.
+    Emits the split partials the shared combine epilogue merges."""
+    pps = PP // n_split        # pages per split
+    scale = sm_scale * _LOG2E
+
+    @T.prim_func
+    def pdec(Q: T.Tensor((B, H, 1, D), dtype),
+             KP: T.Tensor((H, rows, D), dtype),
+             VP: T.Tensor((H, rows, D), dtype),
+             Tab: T.Tensor((B, PP), "int32"),
+             Op: T.Tensor((B, n_split, H, D), "float32"),
+             Mp: T.Tensor((B, n_split, H, 1), "float32"),
+             Lp: T.Tensor((B, n_split, H, 1), "float32")):
+        # head axis innermost (cf. decode_kernel's layout note)
+        with T.Kernel(H, n_split, B) as (by, bs, bz):
+            Q_s = T.alloc_shared((1, D), dtype)
+            K_s = T.alloc_shared((PS, D), dtype)
+            V_s = T.alloc_shared((PS, D), dtype)
+            tab = T.alloc_shared((PP,), "int32")
+            S_f = T.alloc_fragment((1, PS), "float32")
+            P_f = T.alloc_fragment((1, PS), dtype)
+            acc = T.alloc_fragment((1, D), "float32")
+            m_prev = T.alloc_fragment((1,), "float32")
+            m_new = T.alloc_fragment((1,), "float32")
+            m_cur = T.alloc_fragment((1,), "float32")
+            l = T.alloc_fragment((1,), "float32")
+            l_cur = T.alloc_fragment((1,), "float32")
+
+            T.copy(Q[bz, by, 0, 0], Q_s)
+            T.copy(Tab[bz, 0], tab)
+            T.fill(acc, 0)
+            T.fill(l, 0)
+            T.fill(m_prev, -T.infinity("float32"))
+
+            for p in T.serial(pps):
+                off = tab[bs * pps + p] * PS
+                T.copy(KP[by, off, 0], K_s)
+                T.copy(VP[by, off, 0], V_s)
+                T.gemm(Q_s, K_s, S_f, transpose_B=True, clear_accum=True)
+                for i, j in T.Parallel(1, PS):
+                    S_f[i, j] = S_f[i, j] * scale
+                T.reduce_max(S_f, m_cur, dim=1)
+                for i in T.Parallel(1):
+                    m_new[i] = T.max(m_prev[i], m_cur[i])
+                for i, j in T.Parallel(1, PS):
+                    S_f[i, j] = T.exp2(S_f[i, j] - m_new[i])
+                T.reduce_sum(S_f, l_cur, dim=1)
+                for i in T.Parallel(1):
+                    l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
+                for i, j in T.Parallel(1, D):
+                    acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
+                T.copy(S_f, P_f)
+                T.gemm(P_f, V_s, acc)
+                for i in T.Parallel(1):
+                    m_prev[i] = m_new[i]
+
+            T.copy(acc, Op[bz, bs, by, 0])
+            T.copy(m_prev, Mp[bz, bs, by, 0])
+            T.copy(l, Lp[bz, bs, by, 0])
+
+    return _tl_compile(pdec)
+
+
+def _combine_splits(q, op, mp, lp):
+    """Merge per-split (o, m, l) partials in the exp2 domain (shared by
+    flash_decode and the paged walk)."""
+    import jax.numpy as jnp
     mp = mp[..., 0]                                         # (B,ns,H)
     lp = lp[..., 0]
     m_max = jnp.max(mp, axis=1, keepdims=True)              # (B,1,H)
@@ -115,11 +190,24 @@ def flash_decode(q, k, v, sm_scale=None, n_split=None, block_N=128):
     return (o / l_tot)[:, :, None, :].astype(q.dtype)
 
 
+def pages_to_hmajor(pages):
+    """(n_pages, page_size, H, D) -> the H-major pool layout
+    (H, n_pages*page_size, D) that in-kernel page walking wants. A
+    serving system maintains the pool in this layout persistently; this
+    one-time transform exists for interop and tests."""
+    import jax.numpy as jnp
+    n_pages, ps, H, D = pages.shape
+    return jnp.transpose(pages, (2, 0, 1, 3)).reshape(H, n_pages * ps, D)
+
+
 def flash_decode_paged(q, kv_pages, v_pages, page_table, sm_scale=None,
                        block_N=128, n_split=None):
-    """Paged KV decode: pages (n_pages, page_size, H, D) + page_table
-    (B, pages_per_seq) gathered to contiguous KV at the XLA level, then the
-    split-KV kernel (cf. reference example_mla_decode_paged.py behavior)."""
+    """Paged KV decode, GATHER strategy: pages (n_pages, page_size, H,
+    D) + page_table (B, pages_per_seq) gathered to contiguous KV at the
+    XLA level, then the pipelined split-KV kernel (block_N tiling
+    honored). The alternative is `flash_decode_paged_pool`, which walks
+    an H-major pool in-kernel with no gather pass — the bench measures
+    both and keeps the faster on the target chip."""
     import jax.numpy as jnp
 
     B = page_table.shape[0]
@@ -131,3 +219,22 @@ def flash_decode_paged(q, kv_pages, v_pages, page_table, sm_scale=None,
     v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     return flash_decode(q, k, v, sm_scale=sm_scale, block_N=block_N,
                         n_split=n_split)
+
+
+def flash_decode_paged_pool(q, kp, vp, page_table, page_size,
+                            sm_scale=None, n_split=None):
+    """In-kernel page walk over an H-major pool (H, rows, D)."""
+    B, H, _, D = q.shape
+    PP = page_table.shape[1]
+    rows = kp.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    if n_split is None:
+        n_split = max(1, min(8, PP))
+    while PP % n_split:
+        n_split -= 1
+    import jax.numpy as jnp
+    kern = paged_decode_kernel(B, H, PP, int(page_size), D, n_split,
+                               rows, float(sm_scale), str(q.dtype))
+    op, mp, lp = kern(q, kp, vp, jnp.asarray(page_table, jnp.int32))
+    return _combine_splits(q, op, mp, lp)

@@ -40,7 +40,7 @@ def _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale, mask_of):
 
 
 def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
-                          dtype, TEnd=None, raw_offsets=False):
+                          dtype, raw_offsets=False):
     """Trace-time emission of the selected-branch gather: allocs, input
     copies, and the predicated per-slot online-softmax loop (single home
     for the selection predicate — the fused forward, the AD partial
@@ -49,8 +49,9 @@ def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
 
     raw_offsets: BI entries are raw K/V row offsets (the varlen path,
     where the wrapper folds the sequence base in) instead of block ids.
-    TEnd: optional (B, Tq) per-token exclusive key bound (the varlen
-    sequence end) added to the visibility mask."""
+    Packed causality (o + j <= t) alone enforces varlen sequence
+    boundaries: an offset window poking past its sequence end only
+    reaches rows with packed index > t, which the causal term masks."""
     Q_s = T.alloc_shared((G, D), dtype)
     K_s = T.alloc_shared((BS, D), dtype)
     V_s = T.alloc_shared((BS, D), dtype)
@@ -61,9 +62,6 @@ def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
     T.copy(Q[bz, t, by, 0, 0], Q_s)
     T.copy(BI[bz, t, by, 0], Idx)
     T.copy(Cnt[bz, t, by], cnt)
-    if TEnd is not None:
-        tend = T.alloc_shared((1,), "int32")
-        T.copy(TEnd[bz, t], tend)
     init_softmax_state(st)
 
     for s in T.serial(S):
@@ -72,13 +70,8 @@ def _nsa_selected_prelude(Q, K, V, BI, Cnt, bz, t, by, S, BS, G, D, scale,
         with T.If((s < cnt[0]) & (idx >= 0) & (off <= t)):
             T.copy(K[bz, by, off, 0], K_s)
             T.copy(V[bz, by, off, 0], V_s)
-            if TEnd is not None:
-                mask = (lambda j, o=off: (o + j <= t) &
-                        (o + j < tend[0]))
-            else:
-                mask = lambda j, o=off: o + j <= t
             _gathered_block_update(st, Q_s, K_s, V_s, G, BS, D, scale,
-                                   mask_of=mask)
+                                   mask_of=lambda j, o=off: o + j <= t)
     return st, Q_s, K_s, V_s, cnt
 
 
@@ -255,9 +248,9 @@ def nsa_varlen_fwd_kernel(Tq, H, G, Tk, D, S, BS, sm_scale, dtype):
     example_tilelang_nsa_fwd_varlen.py behavior). Selected blocks are
     sequence-LOCAL; the wrapper turns them into raw packed ROW OFFSETS
     (cu[seq] + blk*BS) so the kernel's data-dependent DMA needs no
-    per-sequence bases, and a per-token sequence-end bound masks keys
-    past the boundary (packed order == position order, so causal is the
-    plain packed comparison)."""
+    per-sequence bases. Packed order == position order, so the plain
+    causal comparison (off + j <= t) also masks every key past the
+    token's own sequence end — no extra bound needed."""
     scale = sm_scale * _LOG2E
 
     @T.prim_func
@@ -266,13 +259,12 @@ def nsa_varlen_fwd_kernel(Tq, H, G, Tk, D, S, BS, sm_scale, dtype):
                  V: T.Tensor((1, H, Tk, D), dtype),
                  Offs: T.Tensor((1, Tq, H, S), "int32"),
                  Cnt: T.Tensor((1, Tq, H), "int32"),
-                 TEnd: T.Tensor((1, Tq), "int32"),
                  Gslc: T.Tensor((1, Tq, H, G), "float32"),
                  O: T.Tensor((1, Tq, H, G, D), dtype)):
         with T.Kernel(Tq, H) as (t, by):
             st, _Q_s, _K_s, _V_s, _cnt = _nsa_selected_prelude(
                 Q, K, V, Offs, Cnt, 0, t, by, S, BS, G, D, scale, dtype,
-                TEnd=TEnd, raw_offsets=True)
+                raw_offsets=True)
             acc, l = st["acc"], st["l"]
             gs = T.alloc_shared((G,), "float32")
             out = T.alloc_fragment((G, D), "float32")
@@ -314,8 +306,8 @@ def nsa_attention_varlen(q, k, v, g_slc, block_indices, cu_seqlens,
     cu = jnp.asarray(cu_seqlens, jnp.int32)
     sid, _pos, valid = _seq_ids(cu, Tq, Tq, fill=-1)
     start = cu[jnp.clip(sid, 0, cu.shape[0] - 2)]
-    end = cu[jnp.clip(sid, 0, cu.shape[0] - 2) + 1]
-    tend = jnp.where(valid, end, 0).astype(jnp.int32)          # (Tq,)
+    # rows past cu[-1] (caller padding) select nothing -> zero output
+    cnt = jnp.where(valid[:, None], cnt, 0)
     bi = jnp.asarray(block_indices, jnp.int32)
     # local block id -> raw packed row offset; invalid slots -> -1
     offs = jnp.where(bi >= 0,
@@ -329,7 +321,7 @@ def nsa_attention_varlen(q, k, v, g_slc, block_indices, cu_seqlens,
     kern = nsa_varlen_fwd_kernel(Tq, H, G, k.shape[0] + BS, D, S, BS,
                                  float(scale), str(q.dtype))
     o = kern(q.reshape(1, Tq, H, G, D), kp[None], vp[None], offs[None],
-             cnt[None], tend[None],
+             cnt[None],
              jnp.asarray(g_slc, jnp.float32).reshape(1, Tq, H, G))
     return o.reshape(Tq, HQ, D)
 
