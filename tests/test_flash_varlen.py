@@ -304,3 +304,39 @@ def test_varlen_bwd_causal_unequal_qk_lens():
     for name, a, b in zip(("dQ", "dK", "dV"), got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-2, atol=3e-2, err_msg=name)
+
+
+def test_varlen_head_sharded_under_shard_map():
+    """Varlen attention composes with jax.sharding: heads sharded over
+    the 8-device mesh via shard_map (each shard runs the packed kernel
+    on its head slice; cu_seqlens replicated) == the unsharded result."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    mesh = Mesh(np.asarray(devs[:8]).reshape(8), ("h",))
+
+    rng = np.random.default_rng(0)
+    total, H, D = 64, 8, 64
+    lens = [30, 34]
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(lens)]
+                                    ).astype(np.int32))
+    q = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, H, D)), jnp.float32)
+
+    def shard_fn(q, k, v, cu):
+        return flash_attention_varlen(q, k, v, cu, cu, causal=True,
+                                      block_M=32, block_N=32)
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, "h", None), P(None, "h", None),
+                  P(None, "h", None), P()),
+        out_specs=P(None, "h", None), check_vma=False)
+    got = np.asarray(jax.jit(sharded)(q, k, v, cu))
+    want = np.asarray(shard_fn(q, k, v, cu))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)

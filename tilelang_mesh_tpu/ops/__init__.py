@@ -24,3 +24,4 @@ from .nsa import nsa_attention_varlen, nsa_attention, nsa_decode, nsa_reference
 from .seer_attention import seer_attention, seer_block_mask, seer_reference
 from .minference import vertical_slash_sparse_attention, vs_sparse_reference
 from .gdn import gdn_chunk_fwd, gdn_reference
+from .dsa import lightning_indexer, topk_selector, sparse_mla_fwd
