@@ -273,24 +273,37 @@ def cfg_gemm(M, N, K, dtype="bfloat16"):
     b = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.dtype(dtype))
 
     hints = MatmulTemplate(M, N, K, dtype).hints(2)
-    cfgs = [h.config for h in hints] or [
-        {"block_M": 256, "block_N": 256, "block_K": 512}]
+    cfgs = [dict(h.config, num_stages=2) for h in hints] or [
+        {"block_M": 256, "block_N": 256, "block_K": 512, "num_stages": 2}]
+    # pipeline-depth variant of the top hint: gemm_large measured 0.87x
+    # of the MXU roofline at ns=2 — deeper staging may close DMA
+    # bubbles. The carver's budget filter assumed ns=2, so re-check the
+    # ns=3 footprint against the measured Mosaic fault boundary (a
+    # fault kills the child AND the shared tunnel worker)
+    from tilelang_mesh_tpu.carver import auto_arch
+    ns3 = dict(cfgs[0], num_stages=3)
+    if _gemm_vmem_est(ns3["block_M"], ns3["block_N"], ns3["block_K"], 3) \
+            <= 0.42 * auto_arch().vmem_bytes:
+        cfgs.append(ns3)
+    cfgs.sort(key=lambda c: _gemm_vmem_est(
+        c["block_M"], c["block_N"], c["block_K"], c["num_stages"]))
 
     want = jnp.dot(a, b, preferred_element_type=jnp.float32)
     check = functools.partial(_check_close, ref=want, rel_tol=3e-2)
 
     _, ours, _ = _pick_best(
         [(str(c),
-          lambda c=c: matmul_kernel(M, N, K, in_dtype=dtype, num_stages=2,
-                                    **c).func,
+          lambda c=c: matmul_kernel(M, N, K, in_dtype=dtype, **c).func,
           (a, b)) for c in cfgs],
         check, "framework gemm")
     _, ref, _ = _pick_best(
-        [(str(c),
-          lambda c=c: _hand_pallas_matmul(M, N, K, c["block_M"],
-                                          c["block_N"], c["block_K"],
-                                          dtype),
-          (a, b)) for c in cfgs],
+        [(str(blk),
+          lambda blk=blk: _hand_pallas_matmul(M, N, K, *blk, dtype),
+          (a, b))
+         # the baseline ignores num_stages: dedup by block shape so the
+         # ns=2/ns=3 pair doesn't compile+time the same kernel twice
+         for blk in dict.fromkeys(
+             (c["block_M"], c["block_N"], c["block_K"]) for c in cfgs)],
         check, "hand-pallas gemm")
     return dict(metric=f"{dtype} GEMM {M}x{N}x{K} (tile DSL vs "
                        f"hand-written Pallas)",

@@ -181,14 +181,7 @@ def test_bench_strict_flag_exists():
 def test_bench_exit_code_policy():
     """--strict fails the process on any config loss; the default keeps
     partial sweeps green (driver capture mode)."""
-    import importlib.util
-    import os
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod", os.path.join(repo, "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _bench_module()
     assert bench.exit_code(strict=False, n_failed=0) == 0
     assert bench.exit_code(strict=False, n_failed=3) == 0
     assert bench.exit_code(strict=True, n_failed=0) == 0
@@ -218,3 +211,52 @@ def test_no_tpu_effect_annotations_warn_once(caplog):
     # outside a kernel: loud error, not silent accept
     with pytest.raises(Exception):
         T.set_max_nreg(240, 1)
+
+
+def _bench_module():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_child_unknown_config_fast_fail():
+    """`--child <unknown>` must emit a parseable error record and exit 3
+    without touching any device (the parent's orchestration contract)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--child", "no_such_config"],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert r.returncode == 3
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["config"] == "no_such_config" and "error" in rec
+
+
+def test_bench_spawn_config_parses_child_record():
+    """_spawn_config must surface the child's error record (not hang or
+    mis-parse) for a config that fails fast."""
+    bench = _bench_module()
+    rec, err = bench._spawn_config("no_such_config", q=True, timeout_s=120)
+    assert rec is None
+    assert "unknown config" in err
+
+
+def test_bench_vmem_estimator_orders_riskiest_last():
+    bench = _bench_module()
+    small = bench._gemm_vmem_est(256, 256, 256, 2)
+    big = bench._gemm_vmem_est(1024, 2048, 512, 3)
+    assert small < big
+    # the num_stages term is load-bearing: same blocks, deeper pipeline
+    # must estimate strictly larger (it multiplies the operand buffers)
+    assert bench._gemm_vmem_est(512, 512, 1024, 3) > \
+        bench._gemm_vmem_est(512, 512, 1024, 2)
