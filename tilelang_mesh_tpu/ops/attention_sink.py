@@ -102,10 +102,18 @@ def attention_sink(q, k, v, sinks, causal: bool = True,
                    window_size: Optional[int] = None,
                    sm_scale: Optional[float] = None,
                    block_M: int = 128, block_N: int = 128,
-                   num_stages: int = 2):
+                   num_stages: int = 2, backward: Optional[str] = None):
     """Sink attention: q (B, Hq, Sq, D); k/v (B, Hkv, Sk, D), Hkv | Hq;
     sinks (Hq,) float32 per-head sink logits. window_size=None disables the
-    sliding window (full causal/dense attention + sink)."""
+    sliding window (full causal/dense attention + sink).
+
+    backward="kernel" (reference example_mha_sink_bwd_bhsd.py /
+    example_gqa_sink_bwd_bhsd.py behavior; requires window_size=None):
+    differentiable in q, k, v AND sinks. The sink only shifts the
+    softmax normalizer, so the sink-less GQA partial's (acc, m, l) plus
+    one XLA fold — l' = l + exp2(sink·log2e − m) — yields exactly the
+    lse the standard dKdV/dQ recompute kernels (ops/gqa_bwd.py) need,
+    and d(sink) is the closed form −Σ p_sink·delta."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     if sm_scale is None:
@@ -116,11 +124,66 @@ def attention_sink(q, k, v, sinks, causal: bool = True,
         raise ValueError(
             f"attention_sink needs Sq % block_M == 0 and Sk % block_N == 0 "
             f"(got Sq={Sq}, Sk={Sk}, block_M={block_M}, block_N={block_N})")
-    kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M,
-                           block_N, bool(causal), window,
-                           float(sm_scale), str(q.dtype), num_stages)
     import jax.numpy as jnp
-    return kern(q, k, v, jnp.asarray(sinks, jnp.float32))
+    if backward is None:
+        kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M,
+                               block_N, bool(causal), window,
+                               float(sm_scale), str(q.dtype), num_stages)
+        return kern(q, k, v, jnp.asarray(sinks, jnp.float32))
+
+    if backward != "kernel":
+        raise ValueError(f"backward must be None or 'kernel', "
+                         f"got {backward!r}")
+    if window:
+        raise ValueError(
+            "attention_sink backward requires window_size=None (the "
+            "dKdV/dQ recompute kernels carry no window mask)")
+    import jax
+    from .gqa import gqa_fwd_partial_kernel
+
+    def _fwd_stats(q, k, v, sinks):
+        pk = gqa_fwd_partial_kernel(B, Hq, Hkv, Sq, Sk, D, block_M,
+                                    block_N, bool(causal),
+                                    float(sm_scale), str(q.dtype),
+                                    num_stages)
+        acc, m, l = pk(q, k, v)                         # sink-less stats
+        sk_col = (jnp.asarray(sinks, jnp.float32)
+                  .reshape(1, Hq, 1) * _LOG2E)
+        l_sink = l + jnp.exp2(sk_col - m)               # sink joins denom
+        o = (acc / l_sink[..., None]).astype(q.dtype)
+        lse2 = m + jnp.log2(l_sink)
+        return o, lse2, sk_col
+
+    @jax.custom_vjp
+    def fa(q, k, v, sinks):
+        # non-differentiated primal: the fused one-pass kernel (the
+        # partial + XLA fold runs only under AD, in fwd below)
+        kern = sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N,
+                               bool(causal), 0, float(sm_scale),
+                               str(q.dtype), num_stages)
+        return kern(q, k, v, sinks)
+
+    def fwd(q, k, v, sinks):
+        o, lse2, sk_col = _fwd_stats(q, k, v, sinks)
+        return o, (q, k, v, o, lse2, sk_col)
+
+    def bwd(res, g):
+        from .gqa_bwd import gqa_attention_bwd
+        q, k, v, o, lse2, sk_col = res
+        # dsink: sink has no value column, so d(o)/d(sink) = -p_sink o
+        # per row => dsink_h = -sum_{b,t} p_sink * (g . o). delta is
+        # computed once here and shared with the recompute kernels.
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        -1)                             # (B, Hq, Sq)
+        dq, dk, dv = gqa_attention_bwd(q, k, v, o, lse2, g, causal,
+                                       sm_scale, block_M, block_N,
+                                       delta=delta)
+        p_sink = jnp.exp2(sk_col - lse2)
+        dsink = -jnp.sum(p_sink * delta, axis=(0, 2))   # (Hq,)
+        return dq, dk, dv, dsink.astype(jnp.float32)
+
+    fa.defvjp(fwd, bwd)
+    return fa(q, k, v, jnp.asarray(sinks, jnp.float32))
 
 
 def attention_sink_reference(q, k, v, sinks, causal=True, window_size=None,

@@ -160,12 +160,15 @@ def gqa_bwd_dq_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
 
 
 def gqa_attention_bwd(q, k, v, o, lse2, g, causal, sm_scale, block_M=128,
-                      block_N=128):
-    """lse2 = m + log2(l) from the forward partial kernel (exp2 domain)."""
+                      block_N=128, delta=None):
+    """lse2 = m + log2(l) from the forward partial kernel (exp2 domain).
+    `delta` (= sum(g*o, -1), f32) may be passed by callers that already
+    computed it (attention_sink's dsink closed form shares it)."""
     import jax.numpy as jnp
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    if delta is None:
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), -1)
     bm, bn = min(block_M, Sq), min(block_N, Sk)
     dkdv = gqa_bwd_dkdv_kernel(B, Hq, Hkv, Sq, Sk, D, bm, bn, bool(causal),
                                float(sm_scale), str(q.dtype))

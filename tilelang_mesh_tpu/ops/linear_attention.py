@@ -56,15 +56,55 @@ def linear_attention_kernel(B, H, S, DK, DV, chunk, dtype="float32",
     return _tl_compile(lin_attn)
 
 
-def linear_attention(q, k, v, chunk=128):
-    """Causal linear attention o_t = q_t @ sum_{s<=t} k_s^T v_s."""
+def linear_attention(q, k, v, chunk=128, backward=None):
+    """Causal linear attention o_t = q_t @ sum_{s<=t} k_s^T v_s.
+
+    backward="kernel" (reference examples/linear_attention/
+    example_linear_attn_bwd.py behavior): the three gradients are the
+    SAME forward kernel with rearranged / time-flipped operands —
+        dQ_t = dO_t Σ_{s<=t} v_s k_s^T   = LA(dO, v, k)
+        dK_s = v_s  Σ_{t>=s} dO_t q_t^T  = flip(LA(flip v, flip dO, flip q))
+        dV_s = k_s  Σ_{t>=s} q_t dO_t^T  = flip(LA(flip k, flip q, flip dO))
+    (suffix sums = prefix sums on the reversed sequence; the causal
+    diagonal is inclusive both ways)."""
     B, H, S, DK = q.shape
     DV = v.shape[-1]
     chunk = min(chunk, S)
     while S % chunk:
         chunk //= 2
     kern = linear_attention_kernel(B, H, S, DK, DV, chunk, str(q.dtype))
-    return kern(q, k, v)
+    if backward is None:
+        return kern(q, k, v)
+    if backward != "kernel":
+        raise ValueError(f"backward must be None or 'kernel', "
+                         f"got {backward!r}")
+    import jax
+    import jax.numpy as jnp
+
+    kern_t = linear_attention_kernel(B, H, S, DV, DK, chunk,
+                                     str(q.dtype))  # output dim DK
+
+    @jax.custom_vjp
+    def fa(q, k, v):
+        return kern(q, k, v)
+
+    def fwd(q, k, v):
+        return fa(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        g = g.astype(q.dtype)
+
+        def flip(x):
+            return jnp.flip(x, axis=2)
+
+        dq = kern_t(g, v, k)
+        dk = flip(kern_t(flip(v), flip(g), flip(q)))
+        dv = flip(kern(flip(k), flip(q), flip(g)))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    fa.defvjp(fwd, bwd)
+    return fa(q, k, v)
 
 
 def linear_attention_reference(q, k, v):
