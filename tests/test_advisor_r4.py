@@ -1,0 +1,139 @@
+"""Regression pins for the round-4 advisor findings (ADVICE.md r4).
+
+1. flash_attention_varlen causal alignment: the top-left (local
+   position) default is documented, and the upstream FlashAttention
+   >= 2.1 bottom-right convention is available via
+   causal_align="bottom-right" (pos_q + len_k - len_q >= pos_k).
+2. nsa_attention_varlen's docstring no longer claims a nonexistent
+   "TEnd" mask; it describes the real mechanism (packed causal
+   predicate + one block of zero padding).
+3. autotune() rejects unknown kwargs with TypeError instead of
+   silently ignoring typos; only the reference-parity no-op kwargs
+   pass through.
+"""
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu.ops import flash_attention_varlen
+
+
+def _ref_dense_align(q, k, v, lens_q, lens_k, align, group):
+    """Per-sequence dense reference with selectable causal alignment."""
+    B, Sq, Hq, D = q.shape
+    out = np.zeros_like(q)
+    for b in range(B):
+        for h in range(Hq):
+            qi = q[b, :lens_q[b], h]
+            ki = k[b, :lens_k[b], h // group]
+            vi = v[b, :lens_k[b], h // group]
+            s = (qi @ ki.T) / np.sqrt(D)
+            lq, lk = s.shape
+            off = (lk - lq) if align == "bottom-right" else 0
+            mask = (np.arange(lq)[:, None] + off) >= np.arange(lk)[None, :]
+            s = np.where(mask, s, -np.inf)
+            with np.errstate(invalid="ignore"):
+                # a fully-masked row (bottom-right, lq > lk) is all -inf
+                p = np.exp(s - s.max(-1, keepdims=True, initial=-np.inf))
+            p = np.nan_to_num(p, nan=0.0)
+            denom = p.sum(-1, keepdims=True)
+            p = np.where(denom > 0, p / np.maximum(denom, 1e-30), 0.0)
+            out[b, :lens_q[b], h] = p @ vi
+    return out
+
+
+def _pack(x, lens):
+    return np.concatenate([x[b, :lens[b]] for b in range(len(lens))], 0)
+
+
+@pytest.mark.parametrize("align", ["top-left", "bottom-right"])
+def test_varlen_causal_alignment(align):
+    """Cross-length causal varlen under both alignment conventions
+    matches the per-sequence dense reference with the same alignment
+    (advisor r4 #1). lens_q != lens_k so the two conventions disagree."""
+    B, Hq, Hkv, D = 3, 4, 2, 32
+    rng = np.random.default_rng(7)
+    lens_q = np.array([17, 5, 40])
+    lens_k = np.array([29, 13, 23])     # mixed: lk > lq and lk < lq
+    maxq, maxk = lens_q.max(), lens_k.max()
+    q = rng.standard_normal((B, maxq, Hq, D)).astype(np.float32)
+    k = rng.standard_normal((B, maxk, Hkv, D)).astype(np.float32)
+    v = rng.standard_normal((B, maxk, Hkv, D)).astype(np.float32)
+    cu_q = np.concatenate([[0], np.cumsum(lens_q)]).astype(np.int32)
+    cu_k = np.concatenate([[0], np.cumsum(lens_k)]).astype(np.int32)
+
+    o = np.asarray(flash_attention_varlen(
+        _pack(q, lens_q), _pack(k, lens_k), _pack(v, lens_k),
+        cu_q, cu_k, causal=True, causal_align=align,
+        block_M=32, block_N=32))
+    ref = _ref_dense_align(q, k, v, lens_q, lens_k, align, group=2)
+    ref_packed = _pack(ref, lens_q)
+    np.testing.assert_allclose(o, ref_packed, rtol=2e-2, atol=2e-2)
+
+
+def test_varlen_alignments_disagree_cross_length():
+    """With lens_q != lens_k the two conventions must produce different
+    outputs — otherwise the parameter is a silent no-op."""
+    B, Hq, Hkv, D = 1, 2, 2, 16
+    rng = np.random.default_rng(8)
+    lens_q, lens_k = np.array([8]), np.array([24])
+    q = rng.standard_normal((B, 8, Hq, D)).astype(np.float32)
+    k = rng.standard_normal((B, 24, Hkv, D)).astype(np.float32)
+    v = rng.standard_normal((B, 24, Hkv, D)).astype(np.float32)
+    cu_q = np.array([0, 8], np.int32)
+    cu_k = np.array([0, 24], np.int32)
+    o_tl = np.asarray(flash_attention_varlen(
+        _pack(q, lens_q), _pack(k, lens_k), _pack(v, lens_k),
+        cu_q, cu_k, causal=True, causal_align="top-left",
+        block_M=8, block_N=8))
+    o_br = np.asarray(flash_attention_varlen(
+        _pack(q, lens_q), _pack(k, lens_k), _pack(v, lens_k),
+        cu_q, cu_k, causal=True, causal_align="bottom-right",
+        block_M=8, block_N=8))
+    assert np.abs(o_tl - o_br).max() > 1e-3
+
+
+def test_varlen_bad_alignment_rejected():
+    q = np.zeros((4, 2, 16), np.float32)
+    cu = np.array([0, 4], np.int32)
+    with pytest.raises(ValueError, match="causal_align"):
+        flash_attention_varlen(q, q, q, cu, cu, causal=True,
+                               causal_align="diagonal")
+
+
+def test_varlen_docstring_documents_alignment():
+    doc = flash_attention_varlen.__doc__
+    assert "top-left" in doc and "bottom-right" in doc
+    assert "len_k - len_q" in doc
+
+
+def test_nsa_varlen_docstring_matches_mechanism():
+    """Advisor r4 #2: no phantom 'TEnd'; the documented mechanism is the
+    packed causal predicate plus zero padding."""
+    import inspect
+
+    from tilelang_mesh_tpu.ops import nsa as nsa_mod
+    doc = nsa_mod.nsa_attention_varlen.__doc__
+    assert "TEnd" not in doc
+    assert "causal predicate" in doc and "zero" in doc
+    # and nothing named TEnd exists in the module to drift back in
+    src = inspect.getsource(nsa_mod)
+    assert "TEnd" not in src
+
+
+def test_autotune_unknown_kwarg_raises():
+    """Advisor r4 #3: a typo must be a TypeError, not a warning."""
+    from tilelang_mesh_tpu.autotuner import autotune
+    with pytest.raises(TypeError, match="warmups"):
+        autotune(warmups=5)
+    with pytest.raises(TypeError, match="topk_"):
+        autotune(topk_=3)
+
+
+def test_autotune_parity_kwargs_still_pass():
+    """The reference's checking kwargs (tuner.py:685-702) remain
+    accepted no-ops so ported call sites keep working."""
+    from tilelang_mesh_tpu.autotuner import autotune
+    deco = autotune(configs=[{"block": 8}], skip_check=True, rtol=1e-2,
+                    atol=1e-2, ref_prog=None)
+    assert callable(deco)

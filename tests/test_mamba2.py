@@ -53,3 +53,23 @@ def test_mamba2_long_chunk_large_decay_no_overflow():
     ref = mamba2_reference(x, dt, A, Bm, Cm)
     assert np.isfinite(np.asarray(y)).all()
     assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_mamba2_xla_baseline_matches_recurrence():
+    """The chunk-parallel XLA baseline (the benchmark's A/B counterpart,
+    bench.py cfg_mamba2_chunk) must itself match the sequential
+    recurrence — a wrong baseline makes the benchmark meaningless."""
+    from tilelang_mesh_tpu.ops.mamba2 import mamba2_chunk_scan_xla
+    B, S, H, P, N = 2, 512, 2, 64, 64
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((B, S, H, P)) * 0.5, jnp.float32)
+    dt = jnp.asarray(rng.uniform(0.01, 0.1, (B, S, H)), jnp.float32)
+    A = jnp.asarray(-rng.uniform(0.5, 1.5, (H,)), jnp.float32)
+    Bm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.float32)
+    Cm = jnp.asarray(rng.standard_normal((B, S, N)) * 0.3, jnp.float32)
+    y = mamba2_chunk_scan_xla(x, dt, A, Bm, Cm, chunk=128)
+    ref = mamba2_reference(x, dt, A, Bm, Cm)
+    assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-3, atol=1e-3)
+    # chunk-size invariance of the baseline
+    y64 = mamba2_chunk_scan_xla(x, dt, A, Bm, Cm, chunk=64)
+    assert_allclose(np.asarray(y64), np.asarray(y), rtol=1e-4, atol=1e-4)

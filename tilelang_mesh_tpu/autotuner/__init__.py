@@ -310,13 +310,25 @@ def autotune(fn: Optional[Callable] = None, *,
         @tilelang.jit
         def matmul(M, N, K, block_M=128, block_N=128, block_K=128): ...
     """
+    # Reference-parity kwargs (reference autotuner/tuner.py:685-702)
+    # that have no TPU effect here: numeric checking is the caller's job
+    # (supply/check hooks assume torch reference programs), and input
+    # caching is implicit in the jit cache. These — and ONLY these —
+    # pass through with a warning; anything else (a typo like
+    # 'warmups=' or 'topk_=') is a hard TypeError instead of silently
+    # falling back to defaults.
+    _PARITY_IGNORED = frozenset({
+        "ref_prog", "supply_prog", "rtol", "atol",
+        "max_mismatched_ratio", "skip_check", "manual_check_prog",
+        "cache_input_tensors",
+    })
     for k in _ignored:
-        if "config" in k or "template" in k:
-            # a typo ('config=', 'templates=') must not silently fall
-            # through to the IR-derived mode, ignoring the user's list
+        if k not in _PARITY_IGNORED:
             raise TypeError(
-                f"autotune: unknown argument {k!r} — did you mean "
-                f"'configs' or 'template'?")
+                f"autotune: unknown argument {k!r} (accepted: configs, "
+                f"template, warmup, rep, supply_type, cache_results, "
+                f"timeout, topk; reference-parity no-ops: "
+                f"{', '.join(sorted(_PARITY_IGNORED))})")
         logger.warning("autotune: ignoring unknown argument %r "
                        "(reference-parity kwarg with no TPU effect)", k)
 

@@ -369,7 +369,8 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
                            causal: bool = False,
                            sm_scale: Optional[float] = None,
                            block_M: int = 128, block_N: int = 128,
-                           num_stages: int = 2):
+                           num_stages: int = 2,
+                           causal_align: str = "top-left"):
     """Ragged-batch attention over packed tensors.
 
     q: (total_q, Hq, D); k, v: (total_k, Hkv, D) with Hkv | Hq (GQA when
@@ -377,8 +378,25 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
     sequence (may be traced — lengths can vary at runtime under one
     compilation). Returns (total_q, Hq, D); rows at or past a sequence's
     end are zero, and no attention crosses a sequence boundary.
+
+    causal_align: when a sequence's q and k lengths differ, the two
+    common conventions place the causal diagonal differently.
+    ``"top-left"`` (default) masks on local positions, ``pos_q >=
+    pos_k`` — query i of a sequence sees its first i+1 keys.
+    ``"bottom-right"`` matches FlashAttention >= 2.1 / the reference's
+    varlen examples: the diagonal is anchored at the END of both
+    sequences (``pos_q + len_k - len_q >= pos_k``), so the LAST query
+    sees every key — the decode/suffix convention. Equal lengths make
+    the two identical. Implemented by offsetting each sequence's local
+    q positions host-side; the kernel mask (and the block-liveness
+    prune) are alignment-agnostic.
     """
     import jax.numpy as jnp
+
+    if causal_align not in ("top-left", "bottom-right"):
+        raise ValueError(
+            f"causal_align must be 'top-left' or 'bottom-right', "
+            f"got {causal_align!r}")
 
     Tq, Hq, D = q.shape
     Tk, Hkv = k.shape[0], k.shape[1]
@@ -397,6 +415,15 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
 
     seq_q, pos_q, valid_q = _seq_ids(cu_seqlens_q, Tqp, Tq, fill=-1)
     seq_k, pos_k, valid_k = _seq_ids(cu_seqlens_k, Tkp, Tk, fill=-2)
+    if causal and causal_align == "bottom-right":
+        # anchor the diagonal at the sequence ends: shift each q row by
+        # its sequence's len_k - len_q so the kernel's local-position
+        # compare realizes pos_q + len_k - len_q >= pos_k
+        nb = cu_seqlens_q.shape[0] - 1
+        off = ((cu_seqlens_k[1:] - cu_seqlens_k[:-1]) -
+               (cu_seqlens_q[1:] - cu_seqlens_q[:-1])).astype(jnp.int32)
+        pos_q = pos_q + jnp.where(
+            seq_q >= 0, off[jnp.clip(seq_q, 0, nb - 1)], 0)
     live = _block_live(seq_q, valid_q, pos_q, seq_k, valid_k, pos_k,
                        block_M, block_N, causal)
 

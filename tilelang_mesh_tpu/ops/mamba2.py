@@ -101,14 +101,77 @@ def mamba2_chunk_scan(x, dt, A, Bm, Cm, chunk=128):
     import jax.numpy as jnp
     B, S, H, P = x.shape
     N = Bm.shape[-1]
-    chunk = min(chunk, S)
-    while S % chunk:
-        chunk //= 2
+    chunk = _norm_chunk(chunk, S)
     kern = mamba2_chunk_scan_kernel(B, S, H, P, N, chunk, str(x.dtype))
     xt = x.transpose(0, 2, 1, 3)           # (B, H, S, P)
     dtt = dt.transpose(0, 2, 1)            # (B, H, S)
     y = kern(xt, dtt.astype(jnp.float32), A.astype(jnp.float32), Bm, Cm)
     return y.transpose(0, 2, 1, 3)
+
+
+def _norm_chunk(chunk, S):
+    """Largest divisor of S that is <= chunk, by halving — the single
+    home for the fallback so the DSL kernel and the XLA baseline always
+    agree on the effective chunk for the same argument."""
+    chunk = min(chunk, S)
+    while S % chunk:
+        chunk //= 2
+    return chunk
+
+
+def mamba2_chunk_scan_xla(x, dt, A, Bm, Cm, chunk=128):
+    """Chunk-parallel SSD in plain jax/XLA — the strong baseline for the
+    benchmark (same algorithm as the DSL kernel, left to XLA to fuse and
+    schedule; behavioral analog of the reference's triton baseline in
+    /root/reference/benchmark/mamba2/benchmark_mamba_chunk_scan.py).
+
+    Same shapes/semantics as :func:`mamba2_chunk_scan`; intra-chunk work
+    is decay-masked batched matmuls, the cross-chunk (N, P) state is a
+    ``lax.scan`` over chunks.
+    """
+    import jax
+    import jax.numpy as jnp
+    B, S, H, P = x.shape
+    N = Bm.shape[-1]
+    chunk = _norm_chunk(chunk, S)
+    NC = S // chunk
+    f32 = jnp.float32
+
+    xc = x.astype(f32).reshape(B, NC, chunk, H, P)
+    dtc = dt.astype(f32).reshape(B, NC, chunk, H)
+    bc = Bm.astype(f32).reshape(B, NC, chunk, N)
+    cc = Cm.astype(f32).reshape(B, NC, chunk, N)
+
+    # cum[b,n,i,h] = A_h * cumsum_i(dt) (inclusive), monotone decreasing
+    cum = jnp.cumsum(dtc, axis=2) * A[None, None, None, :]
+    # intra-chunk: att[i,j] = (C_i.B_j) dt_j exp(cum_i - cum_j), i >= j;
+    # pairwise (segsum) decay so the exp argument never overflows
+    cb = jnp.einsum("bcim,bcjm->bcij", cc, bc)[..., None]      # (B,NC,c,c,1)
+    dec = jnp.exp(jnp.minimum(cum[:, :, :, None, :] -
+                              cum[:, :, None, :, :], 0.0))     # (B,NC,i,j,H)
+    tril = jnp.tril(jnp.ones((chunk, chunk), bool))[None, None, ..., None]
+    att = jnp.where(tril, cb * dec * dtc[:, :, None, :, :], 0.0)
+    intra = jnp.einsum("bcijh,bcjhp->bcihp", att, xc)
+
+    # cross-chunk state: state' = exp(cum_last) state + (B dt e^{dcay})^T x
+    last = cum[:, :, -1:, :]                                   # (B,NC,1,H)
+    bdec = bc[..., None] * (dtc * jnp.exp(last - cum))[..., None, :]
+    inject = jnp.einsum("bcimh,bcihp->bchmp", bdec, xc)        # (B,NC,H,N,P)
+    gate = jnp.exp(last[:, :, 0, :])                           # (B,NC,H)
+
+    def step(state, inp):
+        g, inj, c_e, out_dec = inp
+        y_inter = jnp.einsum("bim,bhmp,bih->bihp", c_e, state, out_dec)
+        state = state * g[..., None, None] + inj
+        return state, y_inter
+
+    xs = (jnp.moveaxis(gate, 1, 0), jnp.moveaxis(inject, 1, 0),
+          jnp.moveaxis(cc, 1, 0).reshape(NC, B, chunk, N),
+          jnp.moveaxis(jnp.exp(cum), 1, 0).reshape(NC, B, chunk, H))
+    state0 = jnp.zeros((B, H, N, P), f32)
+    _, inter = jax.lax.scan(step, state0, xs)
+    y = intra + jnp.moveaxis(inter, 0, 1)
+    return y.reshape(B, S, H, P).astype(x.dtype)
 
 
 def mamba2_reference(x, dt, A, Bm, Cm):

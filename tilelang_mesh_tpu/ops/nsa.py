@@ -283,8 +283,11 @@ def nsa_attention_varlen(q, k, v, g_slc, block_indices, cu_seqlens,
     """Ragged-batch NSA (selected branch): q (total, HQ, D); k/v
     (total, H, D); g_slc (total, HQ); block_indices (total, H, S) with
     sequence-LOCAL block ids; cu_seqlens (B+1,) int32. No attention
-    crosses a sequence boundary; the kernel needs Tk % block_size == 0
-    only for its last gathered window, handled by masking TEnd."""
+    crosses a sequence boundary: packed order == position order, so the
+    kernel's causal predicate (off + j <= t) masks every gathered key
+    past the token's own position — including keys of later sequences —
+    and one block of zero padding appended to K/V gives the last
+    window's DMA physical rows to read."""
     import jax.numpy as jnp
 
     from .flash_attention_varlen import _seq_ids
@@ -313,8 +316,9 @@ def nsa_attention_varlen(q, k, v, g_slc, block_indices, cu_seqlens,
     offs = jnp.where(bi >= 0,
                      start[:, None, None] + bi * BS, -1).astype(jnp.int32)
     # a window starting near a sequence end pokes up to BS-1 rows past
-    # it: TEnd masks rows of the NEXT sequence, and one block of zero
-    # padding gives the very last window physical rows to read
+    # it: the causal predicate (off + j <= t) masks those rows, and one
+    # block of zero padding gives the very last window physical rows to
+    # read
     kp = jnp.pad(jnp.transpose(k, (1, 0, 2)), ((0, 0), (0, BS), (0, 0)))
     vp = jnp.pad(jnp.transpose(v, (1, 0, 2)), ((0, 0), (0, BS), (0, 0)))
 
