@@ -29,20 +29,34 @@ from ._online_softmax import (alloc_softmax_state, init_softmax_state,
                               online_softmax_update)
 
 
-def _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx, kb, block_M,
+def _prescale_q(Q_s, scale, block_M, D, dtype):
+    """Fold ``sm_scale * log2e`` into Q once per row-block (block_M * D
+    VPU ops) instead of into every score element (block_M * block_N per
+    KV block): the scores leave the GEMM already in the exp2 domain, so
+    fully-live blocks need NO elementwise pass at all. Returns the
+    fragment used as the score GEMM's LHS."""
+    Q_f = T.alloc_fragment((block_M, D), dtype)
+    for i, j in T.Parallel(block_M, D):
+        Q_f[i, j] = Q_s[i, j] * scale
+    return Q_f
+
+
+def _scaled_masked_scores(st, Q_f, K_s, causal, bx, kb, block_M,
                           block_N):
-    """S = mask(scale * Q @ K^T) in the exp2 domain (trace-time emission)."""
+    """S = mask(Q_f @ K^T) with Q_f pre-scaled to the exp2 domain
+    (trace-time emission). Causal: the -inf select runs ONLY on
+    diagonal-straddling blocks — fully-live blocks (every key index <=
+    every query index) skip the per-element pass entirely, which is most
+    of the causal VPU overhead at large block_N (benchmark/RESULTS.md
+    roofline: d=128 causal sat at 0.75 Telem/s vs 1.11 non-causal)."""
     S = st["S"]
-    T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+    T.gemm(Q_f, K_s, S, transpose_B=True, clear_accum=True)
     if causal:
-        for i, j in T.Parallel(block_M, block_N):
-            S[i, j] = T.if_then_else(
-                bx * block_M + i >= kb * block_N + j,
-                S[i, j] * scale,
-                -T.infinity("float32"))
-    else:
-        for i, j in T.Parallel(block_M, block_N):
-            S[i, j] = S[i, j] * scale
+        with T.If(kb * block_N + (block_N - 1) > bx * block_M):
+            for i, j in T.Parallel(block_M, block_N):
+                S[i, j] = T.if_then_else(
+                    bx * block_M + i >= kb * block_N + j,
+                    S[i, j], -T.infinity("float32"))
 
 
 @functools.lru_cache(maxsize=None)
@@ -65,6 +79,7 @@ def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
             st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            Q_f = _prescale_q(Q_s, scale, block_M, D, dtype)
             init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
@@ -73,7 +88,7 @@ def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
                         if causal else _always():
                     T.copy(K[bz, by, kb * block_N, 0], K_s)
                     T.copy(V[bz, by, kb * block_N, 0], V_s)
-                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                    _scaled_masked_scores(st, Q_f, K_s, causal, bx,
                                           kb, block_M, block_N)
                     online_softmax_update(st, V_s, block_M, block_N, D)
 
@@ -115,6 +130,7 @@ def _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N, causal,
             st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            Q_f = _prescale_q(Q_s, scale, block_M, D, dtype)
             init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
@@ -123,7 +139,7 @@ def _mha_fwd_partial_kernel(B, H, Sq, Sk, D, block_M, block_N, causal,
                         if causal else _always():
                     T.copy(K[bz, by, kb * block_N, 0], K_s)
                     T.copy(V[bz, by, kb * block_N, 0], V_s)
-                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                    _scaled_masked_scores(st, Q_f, K_s, causal, bx,
                                           kb, block_M, block_N)
                     online_softmax_update(st, V_s, block_M, block_N, D)
 

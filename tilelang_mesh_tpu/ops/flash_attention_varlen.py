@@ -63,7 +63,12 @@ def _varlen_softmax_loop(Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx,
     st = alloc_softmax_state(block_M, block_N, D, dtype)
     S = st["S"]
 
+    from .flash_attention import _prescale_q
+
     T.copy(Q[by, bx * block_M, 0], Q_s)
+    # scale folded into Q once per row-block; the document-mask select
+    # below then needs no per-element multiply
+    Q_f = _prescale_q(Q_s, scale, block_M, D, dtype)
     T.copy(SeqQ[bx * block_M], sq_s)
     if causal:
         T.copy(PosQ[bx * block_M], pq_s)
@@ -75,7 +80,7 @@ def _varlen_softmax_loop(Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx,
             T.copy(K[by // group, kb * block_N, 0], K_s)
             T.copy(V[by // group, kb * block_N, 0], V_s)
             T.copy(SeqK[kb * block_N], sk_s)
-            T.gemm(Q_s, K_s, S, transpose_B=True, clear_accum=True)
+            T.gemm(Q_f, K_s, S, transpose_B=True, clear_accum=True)
             if causal:
                 # LOCAL positions: correct even when a sequence's
                 # q and k packing offsets differ (lens_q != lens_k)
@@ -83,12 +88,12 @@ def _varlen_softmax_loop(Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx,
                 for i, j in T.Parallel(block_M, block_N):
                     S[i, j] = T.if_then_else(
                         (sq_s[i] == sk_s[j]) & (pq_s[i] >= pk_s[j]),
-                        S[i, j] * scale, -T.infinity("float32"))
+                        S[i, j], -T.infinity("float32"))
             else:
                 for i, j in T.Parallel(block_M, block_N):
                     S[i, j] = T.if_then_else(
                         sq_s[i] == sk_s[j],
-                        S[i, j] * scale, -T.infinity("float32"))
+                        S[i, j], -T.infinity("float32"))
             online_softmax_update(st, V_s, block_M, block_N, D)
     return st
 

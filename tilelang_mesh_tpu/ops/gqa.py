@@ -13,7 +13,8 @@ import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
 from ._online_softmax import (alloc_softmax_state, init_softmax_state,
                               online_softmax_update)
-from .flash_attention import _always, _scaled_masked_scores
+from .flash_attention import (_always, _prescale_q,
+                              _scaled_masked_scores)
 
 
 @functools.lru_cache(maxsize=None)
@@ -35,6 +36,7 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
             st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            Q_f = _prescale_q(Q_s, scale, block_M, D, dtype)
             init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
@@ -43,7 +45,7 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
                         if causal else _always():
                     T.copy(K[bz, by // group, kb * block_N, 0], K_s)
                     T.copy(V[bz, by // group, kb * block_N, 0], V_s)
-                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                    _scaled_masked_scores(st, Q_f, K_s, causal, bx,
                                           kb, block_M, block_N)
                     online_softmax_update(st, V_s, block_M, block_N, D)
 
@@ -79,6 +81,7 @@ def gqa_fwd_partial_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
             st = alloc_softmax_state(block_M, block_N, D, dtype)
 
             T.copy(Q[bz, by, bx * block_M, 0], Q_s)
+            Q_f = _prescale_q(Q_s, scale, block_M, D, dtype)
             init_softmax_state(st)
 
             for kb in T.Pipelined(T.ceildiv(Sk, block_N),
@@ -87,7 +90,7 @@ def gqa_fwd_partial_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
                         if causal else _always():
                     T.copy(K[bz, by // group, kb * block_N, 0], K_s)
                     T.copy(V[bz, by // group, kb * block_N, 0], V_s)
-                    _scaled_masked_scores(st, Q_s, K_s, scale, causal, bx,
+                    _scaled_masked_scores(st, Q_f, K_s, causal, bx,
                                           kb, block_M, block_N)
                     online_softmax_update(st, V_s, block_M, block_N, D)
 
