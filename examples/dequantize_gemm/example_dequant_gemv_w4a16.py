@@ -1,0 +1,46 @@
+"""w4a16 dequant GEMV — the decode-time shape where FUSED dequant wins
+(reference examples/dequantize_gemm/example_dequant_gemv_fp16xint4.py
+behavior).
+
+At decode the GEMM is pure bandwidth: the weight matrix is the traffic,
+so reading it as int4 (a quarter of bf16 bytes) and dequantizing in
+VMEM beats any two-pass scheme that materializes bf16 weights through
+HBM. This is the same fused kernel the benchmark sweeps for prefill
+(bench.py::cfg_w4a16), at the shape where it is the clear winner.
+
+M=8, not 1: the VPU/MXU minimum tile is (8, 128), so a lone decode row
+is padded to 8 rows anyway — batching 8 decode tokens (or speculative
+candidates) costs nothing and is the realistic serving shape."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.dequant_gemm import dequant_gemm_kernel
+from tilelang_mesh_tpu.quantize.quantization import (
+    dequantize_int4_planar_ref, quantize_int4_planar)
+
+
+def main(M=8, N=512, K=1024, gs=256):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.1, jnp.bfloat16)
+    w = rng.standard_normal((K, N)).astype(np.float32) * 0.1
+    packed_np, scales_np = quantize_int4_planar(w, group_size=gs)
+
+    K2 = K // 2
+    kern = dequant_gemm_kernel(M, N, K, block_M=M, block_N=128,
+                               block_K2=gs, group_size=gs,
+                               in_dtype="bfloat16")
+    out = kern(a.reshape(M, 2, K2), jnp.asarray(packed_np),
+               jnp.asarray(scales_np).reshape(2, K2 // gs, N))
+    want = np.asarray(a, np.float32) @ dequantize_int4_planar_ref(
+        packed_np, scales_np, group_size=gs)
+    rel = (np.linalg.norm(np.asarray(out, np.float32) - want)
+           / np.linalg.norm(want))
+    assert rel < 4e-2, rel
+    print(f"w4a16 dequant GEMV M={M}: fused in-VMEM dequant correct "
+          f"(rel err {rel:.1e}); weight traffic is K*N/2 bytes vs "
+          f"{2 * K * N} for bf16.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Gated DeltaNet forward as ONE tile kernel (reference examples/gdn
+splits the chunk math into per-piece CUDA kernels: example_wy_fast.py
+computes the WY triangular inverse by per-warp forward substitution,
+example_chunk_delta_h.py carries the state, example_chunk_o.py emits the
+output).
+
+TPU re-design: all pieces fuse into a single kernel (grid (H, B),
+in-kernel chunk recurrence), and the WY inverse (I + A)^{-1} is
+computed by NEUMANN DOUBLING — A is strictly lower triangular so the
+series terminates, and S <- S + N^{2^k} S doubles the covered powers
+per step: ceil(log2(C)) - 1 pairs of C x C MXU matmuls replace the
+C-step serial substitution that would stall the VPU."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.gdn import (gdn_chunk_fwd, gdn_chunk_fwd_tl,
+                                       gdn_reference)
+
+
+def main(B=1, H=2, T=256, K=64, V=64, chunk=64):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, T, K)), jnp.float32)
+    k = rng.standard_normal((B, H, T, K))
+    k = jnp.asarray(k / np.linalg.norm(k, axis=-1, keepdims=True),
+                    jnp.float32)                       # l2-normalized keys
+    v = jnp.asarray(rng.standard_normal((B, H, T, V)), jnp.float32)
+    g = jnp.asarray(rng.uniform(-0.2, 0.0, (B, H, T)), jnp.float32)
+    beta = jnp.asarray(rng.uniform(0.0, 1.0, (B, H, T)), jnp.float32)
+
+    out = gdn_chunk_fwd_tl(q, k, v, g, beta, chunk_size=chunk)
+    ref = gdn_reference(q, k, v, g, beta)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    print(f"tile-kernel GDN (chunk={chunk}) matches the sequential "
+          f"delta rule.")
+
+    xla = gdn_chunk_fwd(q, k, v, g, beta, chunk_size=chunk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xla),
+                               rtol=2e-2, atol=2e-2)
+    print("tile kernel and XLA chunked WY implementations agree "
+          "(the benchmark's A/B pair, bench.py::cfg_gdn_fwd).")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Per-tensor scaled fp8 GEMM — the training/serving recipe (reference
+examples/gemm_fp8 family): activations and weights are cast to e4m3
+with per-tensor scales chosen from their absmax, the MXU runs the fp8
+product, and the epilogue multiplies the two scales back out in f32.
+
+The scale epilogue fuses into the GEMM kernel's output loop — zero
+extra HBM traffic, exactly like the quickstart's ReLU."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+
+E4M3_MAX = 448.0
+
+
+@tilelang.jit
+def scaled_fp8_gemm(M, N, K, block_M, block_N, block_K):
+    @T.prim_func
+    def kern(A: T.Tensor((M, K), "float8_e4m3fn"),
+             B: T.Tensor((K, N), "float8_e4m3fn"),
+             Sc: T.Tensor((1, 1), "float32"),       # s_a * s_b
+             C: T.Tensor((M, N), "float32")):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_s = T.alloc_shared((block_M, block_K), "float8_e4m3fn")
+            B_s = T.alloc_shared((block_K, block_N), "float8_e4m3fn")
+            s_s = T.alloc_shared((1, 1), "float32")
+            C_l = T.alloc_fragment((block_M, block_N), "float32")
+            T.clear(C_l)
+            T.copy(Sc, s_s)
+            for ko in T.Pipelined(T.ceildiv(K, block_K), num_stages=2):
+                T.copy(A[by * block_M, ko * block_K], A_s)
+                T.copy(B[ko * block_K, bx * block_N], B_s)
+                T.gemm(A_s, B_s, C_l)
+            for i, j in T.Parallel(block_M, block_N):
+                C_l[i, j] = C_l[i, j] * s_s[0, 0]   # fused de-scale
+            T.copy(C_l, C[by * block_M, bx * block_N])
+    return kern
+
+
+def main(M=256, N=256, K=256):
+    rng = np.random.default_rng(0)
+    a32 = rng.standard_normal((M, K)).astype(np.float32) * 3.0
+    b32 = rng.standard_normal((K, N)).astype(np.float32) * 0.02
+
+    s_a = float(np.abs(a32).max()) / E4M3_MAX
+    s_b = float(np.abs(b32).max()) / E4M3_MAX
+    a8 = jnp.asarray(a32 / s_a, jnp.float8_e4m3fn)
+    b8 = jnp.asarray(b32 / s_b, jnp.float8_e4m3fn)
+    sc = jnp.full((1, 1), s_a * s_b, jnp.float32)
+
+    kern = scaled_fp8_gemm(M, N, K, 128, 128, 128)
+    out = np.asarray(kern(a8, b8, sc))
+
+    # truth from the actually-representable (rounded) fp8 values
+    ref = (np.asarray(a8, np.float32) @ np.asarray(b8, np.float32)) \
+        * (s_a * s_b)
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 5e-2, rel
+    print(f"per-tensor scaled fp8 GEMM correct (rel err {rel:.1e}; "
+          f"s_a={s_a:.3g}, s_b={s_b:.3g}, de-scale fused in epilogue).")
+
+
+if __name__ == "__main__":
+    main()
