@@ -340,7 +340,7 @@ def cfg_flash(D, S=2048, B=2, H=16, causal=True):
     # cross-checked before it can win.
     from tilelang_mesh_tpu.carver import FlashAttentionTemplate
     hints = FlashAttentionTemplate(S, S, D, batch_heads=B * H,
-                                   causal=causal).hints(3)
+                                   causal=causal).hints(4)
     cands = [(h.config["block_M"], h.config["block_N"]) for h in hints]
     _, kern_fn, _ = _pick_best(
         [(f"({bm},{bn})",

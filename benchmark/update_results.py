@@ -1,0 +1,105 @@
+"""Regenerate the measured-results table in benchmark/RESULTS.md from
+bench.py JSON lines.
+
+Usage:
+    python bench.py | tee /tmp/bench.jsonl
+    python benchmark/update_results.py /tmp/bench.jsonl [--date 2026-07-30]
+
+Only rows present in the input are updated; other rows keep their
+existing (dated) values, so partial sweeps refresh incrementally. The
+table is rewritten in place between the BEGIN/END markers; everything
+else in RESULTS.md is untouched.
+"""
+
+import argparse
+import datetime
+import json
+import pathlib
+import re
+import sys
+
+RESULTS = pathlib.Path(__file__).resolve().parent / "RESULTS.md"
+BEGIN = "<!-- BENCH_TABLE_BEGIN -->"
+END = "<!-- BENCH_TABLE_END -->"
+
+def _config_order():
+    """The sweep order, derived from bench.py itself (no drift): any
+    config bench can emit has a slot, in bench's own risk ordering."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    import bench
+    return [n for n, _ in bench._config_builders(False)]
+
+
+def parse_lines(path):
+    recs = {}
+    for line in pathlib.Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "config" in r and "value" in r and "error" not in r:
+            recs[r["config"]] = r
+    return recs
+
+
+def fmt_row(name, r, date):
+    vs = r["vs_baseline"]
+    vs_s = f"**{vs:.3f}**" if vs >= 1.0 else f"{vs:.3f}"
+    extra = ""
+    if "walk_ms" in r:
+        extra = (f" walk={r['walk_ms']}ms gather={r['gather_ms']}ms")
+    return (f"| {name} | {r['metric']}{extra} | {r['value']} {r['unit']} | "
+            f"{r['latency_ms']} | {r['baseline_ms']} | {vs_s} | {date} |")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("jsonl")
+    ap.add_argument("--date",
+                    default=datetime.date.today().isoformat())
+    args = ap.parse_args()
+
+    recs = parse_lines(args.jsonl)
+    if not recs:
+        print("no valid bench records found", file=sys.stderr)
+        sys.exit(1)
+
+    text = RESULTS.read_text()
+    if BEGIN not in text or END not in text:
+        print(f"{RESULTS} lacks {BEGIN} / {END} markers", file=sys.stderr)
+        sys.exit(1)
+
+    order = _config_order()
+    # any row already in the table stays even if bench.py no longer
+    # lists it (renamed configs keep their history visible)
+    block = text.split(BEGIN)[1].split(END)[0]
+    existing = {}
+    for line in block.splitlines():
+        m = re.match(r"\|\s*(\w+)\s*\|", line)
+        if m and m.group(1) != "config":
+            existing[m.group(1)] = line
+    order += [n for n in existing if n not in order]
+
+    rows = []
+    for name in order:
+        if name in recs:
+            rows.append(fmt_row(name, recs[name], args.date))
+        elif name in existing:
+            rows.append(existing[name])
+
+    header = ("| config | metric | value | ours ms | baseline ms | "
+              "vs_baseline | measured |\n|---|---|---|---|---|---|---|")
+    new_block = f"\n{header}\n" + "\n".join(rows) + "\n"
+    text = text.split(BEGIN)[0] + BEGIN + new_block + END + \
+        text.split(END)[1]
+    RESULTS.write_text(text)
+    n_new = len([n for n in order if n in recs])
+    print(f"updated {n_new} rows ({args.date}); "
+          f"kept {len(rows) - n_new} existing")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""benchmark/update_results.py: bench JSON lines -> RESULTS.md rows,
+incrementally (unmeasured rows keep their old values and dates)."""
+
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_incremental_row_update(tmp_path, monkeypatch):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "update_results", ROOT / "benchmark" / "update_results.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    results = tmp_path / "RESULTS.md"
+    results.write_text(
+        "# header\n\n"
+        f"{mod.BEGIN}\n"
+        "| config | metric | value | ours ms | baseline ms | "
+        "vs_baseline | measured |\n|---|---|---|---|---|---|---|\n"
+        "| gemm_large | old metric | 100.0 TFLOPS | 2.0 | 2.0 | 1.000 "
+        "| 2026-01-01 |\n"
+        "| flash_d64 | old flash | 30.0 TFLOPS | 0.5 | 2.0 | **4.000** "
+        "| 2026-01-01 |\n"
+        f"{mod.END}\n\ntrailer\n")
+    monkeypatch.setattr(mod, "RESULTS", results)
+
+    jl = tmp_path / "bench.jsonl"
+    jl.write_text("\n".join([
+        "# noise line",
+        json.dumps({"config": "gemm_large", "metric": "new metric",
+                    "value": 180.0, "unit": "TFLOPS",
+                    "vs_baseline": 1.05, "latency_ms": 1.9,
+                    "baseline_ms": 2.0}),
+        json.dumps({"config": "paged_decode", "metric": "paged",
+                    "value": 700.0, "unit": "GB/s", "vs_baseline": 0.98,
+                    "latency_ms": 5.0, "baseline_ms": 4.9,
+                    "walk_ms": 5.0, "gather_ms": 5.5}),
+        json.dumps({"config": "broken", "error": "skipped"}),
+    ]))
+    monkeypatch.setattr(sys, "argv",
+                        ["update_results.py", str(jl), "--date",
+                         "2026-07-31"])
+    mod.main()
+
+    out = results.read_text()
+    assert "new metric | 180.0 TFLOPS" in out and "2026-07-31" in out
+    assert "**1.050**" in out                      # win bolded
+    assert "old flash" in out and "2026-01-01" in out   # kept row
+    assert "walk=5.0ms gather=5.5ms" in out        # extras surfaced
+    assert "broken" not in out                     # error lines dropped
+    assert out.startswith("# header") and out.rstrip().endswith("trailer")

@@ -13,7 +13,7 @@ from .flash_decoding import flash_decode, flash_decode_paged
 from .mla import mla_decode, mla_decode_reference
 from .dequant_gemm import dequant_matmul, dequant_gemm_kernel
 from .gqa import gqa_attention
-from .linear_attention import linear_attention
+from .linear_attention import linear_attention, retention
 from .mamba2 import mamba2_chunk_scan, mamba2_reference
 from .blocksparse_attention import blocksparse_attention
 from .grouped_gemm import grouped_matmul, grouped_gemm_kernel
