@@ -528,7 +528,7 @@ def cfg_mla_decode(B=4, H=128, S=4096, dc=512, dr=64):
               a, b, c, d, n_split=ns, block_N=bn)),
           (qc, qr, ckv, kpe))
          for ns, bn in ((1, min(4096, S)), (2, min(2048, S // 2)),
-                        (4, min(1024, S // 4)))],
+                        (4, min(1024, S // 4)), (8, min(512, S // 8)))],
         check, "mla decode")
 
     flops = 2.0 * B * H * S * (dc + dr) + 2.0 * B * H * S * dc

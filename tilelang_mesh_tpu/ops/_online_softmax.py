@@ -54,13 +54,16 @@ def online_softmax_update(st, V_s, block_M, block_N, D):
         # would be NaN); a no-op whenever any key is visible
         m_new[i] = T.max(m_prev[i], T.max(m_cur[i], -1e30))
     for i, j in T.Parallel(block_M, block_N):
+        # one pass: exp2 into the f32 stats buffer AND the gemm-dtype
+        # P (fusing the cast saves a full re-read of S per KV block —
+        # flash is VPU-bound, cf. benchmark/RESULTS.md bound analysis)
         S[i, j] = T.exp2(S[i, j] - m_new[i])
+        P[i, j] = S[i, j]
     T.reduce_sum(S, l_cur, dim=1)
     for i in T.Parallel(block_M):
         l[i] = l[i] * T.exp2(m_prev[i] - m_new[i]) + l_cur[i]
     for i, j in T.Parallel(block_M, D):
         acc[i, j] = acc[i, j] * T.exp2(m_prev[i] - m_new[i])
-    T.copy(S, P)
     T.gemm(P, V_s, acc)
     for i in T.Parallel(block_M):
         m_prev[i] = m_new[i]

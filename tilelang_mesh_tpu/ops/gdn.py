@@ -164,6 +164,45 @@ def gdn_chunk_fwd_tl(q, k, v, g, beta, chunk_size: int = 64,
     return kern(q, k, v, g.astype(jnp.float32), beta.astype(jnp.float32))
 
 
+def gdn_chunk_cumsum(g, chunk):
+    """Within-chunk inclusive log-decay cumsum (reference
+    examples/gdn/example_cumsum.py stage): g (B, H, T) ->
+    gc (B, H, NC, chunk)."""
+    B, H, T = g.shape
+    gf = g.astype(jnp.float32).reshape(B, H, T // chunk, chunk)
+    return jnp.cumsum(gf, axis=-1)
+
+
+def gdn_scaled_dot_kkt(kf, bf, gc, decay=None):
+    """Decay-scaled K K^T, strictly lower (reference
+    examples/gdn/example_chunk_scaled_dot_kkt.py stage):
+    A[i,j] = beta_i (k_i.k_j) exp(gc_i - gc_j) for i > j, else 0.
+    kf (B, H, NC, C, K) f32; bf/gc (B, H, NC, C); decay may be passed
+    in when the caller also needs it (one materialization)."""
+    C = kf.shape[-2]
+    kk = jnp.einsum("bhnik,bhnjk->bhnij", kf, kf)
+    if decay is None:
+        decay = jnp.exp(gc[..., :, None] - gc[..., None, :])
+    tril_s = jnp.tril(jnp.ones((C, C), bool), -1)
+    return jnp.where(tril_s, bf[..., :, None] * kk * decay, 0.0)
+
+
+def gdn_wy_fast(kf, vf, bf, gc, A):
+    """WY representation (reference examples/gdn/example_wy_fast.py
+    stage): T_mat = (I + A)^{-1} via unit-lower triangular solve, then
+    the factors w (state-eating keys) and u (injected values). Returns
+    (w, u, T_mat). The tile kernel computes the same T_mat by Neumann
+    doubling on the MXU (gdn_chunk_fwd_kernel)."""
+    C = A.shape[-1]
+    eye = jnp.eye(C, dtype=jnp.float32)
+    T_mat = jax.scipy.linalg.solve_triangular(
+        A, jnp.broadcast_to(eye, A.shape), lower=True, unit_diagonal=True)
+    w = jnp.einsum("bhnij,bhnjk->bhnik",
+                   T_mat, bf[..., None] * jnp.exp(gc)[..., None] * kf)
+    u = jnp.einsum("bhnij,bhnjv->bhniv", T_mat, bf[..., None] * vf)
+    return w, u, T_mat
+
+
 def gdn_chunk_fwd(q, k, v, g, beta, chunk_size: int = 64,
                   scale: Optional[float] = None,
                   initial_state=None, output_final_state: bool = False):
@@ -181,26 +220,12 @@ def gdn_chunk_fwd(q, k, v, g, beta, chunk_size: int = 64,
     qf = q.astype(jnp.float32).reshape(B, H, N, C, K)
     kf = k.astype(jnp.float32).reshape(B, H, N, C, K)
     vf = v.astype(jnp.float32).reshape(B, H, N, C, V)
-    gf = g.astype(jnp.float32).reshape(B, H, N, C)
     bf = beta.astype(jnp.float32).reshape(B, H, N, C)
 
-    gc = jnp.cumsum(gf, axis=-1)                     # within-chunk cumdecay
-    # A[i,j] = beta_i (k_i.k_j) exp(gc_i - gc_j), strictly lower
-    kk = jnp.einsum("bhnik,bhnjk->bhnij", kf, kf)
+    gc = gdn_chunk_cumsum(g, C)                      # within-chunk cumdecay
     decay = jnp.exp(gc[..., :, None] - gc[..., None, :])
-    tril_s = jnp.tril(jnp.ones((C, C), bool), -1)
-    A = jnp.where(tril_s, bf[..., :, None] * kk * decay, 0.0)
-
-    # T_mat = (I + A)^{-1}: unit lower-triangular solve against I
-    # (unit_diagonal ignores A's zero diagonal, so no eye-add needed)
-    eye = jnp.eye(C, dtype=jnp.float32)
-    T_mat = jax.scipy.linalg.solve_triangular(
-        A, jnp.broadcast_to(eye, A.shape), lower=True, unit_diagonal=True)
-
-    # WY factors: w_i (state-eating keys), u_i (injected values)
-    w = jnp.einsum("bhnij,bhnjk->bhnik",
-                   T_mat, bf[..., None] * jnp.exp(gc)[..., None] * kf)
-    u = jnp.einsum("bhnij,bhnjv->bhniv", T_mat, bf[..., None] * vf)
+    A = gdn_scaled_dot_kkt(kf, bf, gc, decay=decay)
+    w, u, _ = gdn_wy_fast(kf, vf, bf, gc, A)
 
     # intra-chunk attention weights (q_i.k_j) exp(gc_i - gc_j), j <= i
     qk = jnp.einsum("bhnik,bhnjk->bhnij", qf, kf)
