@@ -505,6 +505,60 @@ def cfg_w4a16(M=4096, N=4096, K=4096, gs=512):
                 rel_tol=4e-2, checked=True)
 
 
+def cfg_w4a8(M=4096, N=4096, K=4096):
+    """int4-weight x int8-activation GEMM on the int8 MXU path (2x bf16
+    rate; reference examples/dequantize_gemm/example_dequant_gemm_w4a8.py
+    family). Baseline: XLA's own int8 pipeline over the same packed
+    operands (unpack int4 -> int8, lax.dot int32 accum, f32 epilogue)."""
+    import jax
+    import jax.numpy as jnp
+    from tilelang_mesh_tpu.ops.dequant_gemm import (
+        quantize_w4_per_channel, w4a8_gemm_kernel)
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((M, K)).astype(np.float32)
+    w = rng.standard_normal((K, N)).astype(np.float32) * 0.1
+    packed_np, sw_np = quantize_w4_per_channel(w)
+    from tilelang_mesh_tpu.ops.bitnet import quantize_activations
+    q, a_scale = quantize_activations(jnp.asarray(x))
+    K2 = K // 2
+    qp = q.reshape(M, 2, K2)
+    packed = jnp.asarray(packed_np)
+    sw = jnp.asarray(sw_np).reshape(1, N)
+    sa = (1.0 / a_scale).astype(jnp.float32)
+
+    def ref(qp_, packed_, sw_, sa_):
+        p32 = packed_.astype(jnp.int32)
+        lo = (p32 & 0xF).astype(jnp.int8) - 8
+        hi = (p32 >> 4).astype(jnp.int8) - 8
+        acc = (jax.lax.dot(qp_[:, 0, :], lo,
+                           preferred_element_type=jnp.int32)
+               + jax.lax.dot(qp_[:, 1, :], hi,
+                             preferred_element_type=jnp.int32))
+        return acc.astype(jnp.float32) * sa_ * sw_
+
+    want = ref(qp, packed, sw, sa)
+    check = functools.partial(_check_close, ref=want, rel_tol=1e-3)
+    cfgs = [(min(bm, M), min(bn, N), min(bk2, K2), ns)
+            for bm, bn, bk2, ns in
+            ((128, 256, 512, 2), (256, 256, 512, 2), (128, 512, 512, 2),
+             (256, 512, 256, 2), (256, 256, 1024, 2))]
+    cfgs = list(dict.fromkeys(cfgs))          # dedupe after clamping
+    cfgs.sort(key=lambda c: _gemm_vmem_est(c[0], c[1], c[2] * 2, c[3]))
+    _, ours, _ = _pick_best(
+        [(f"[{bm}x{bn}xk2={bk2},ns{ns}]",
+          lambda bm=bm, bn=bn, bk2=bk2, ns=ns: w4a8_gemm_kernel(
+              M, N, K, bm, bn, bk2, ns).func,
+          (qp, packed, sw, sa)) for bm, bn, bk2, ns in cfgs],
+        check, "w4a8")
+
+    return dict(metric=f"w4a8 int4xint8 GEMM {M}x{N}x{K} (tile DSL vs "
+                       f"XLA int8 dequant+dot)",
+                flops=2.0 * M * N * K, peak_class="i8",
+                ours=ours, ref=jax.jit(ref), args=(qp, packed, sw, sa),
+                rel_tol=1e-3, checked=True)
+
+
 def cfg_mla_decode(B=4, H=128, S=4096, dc=512, dr=64):
     import jax.numpy as jnp
     from tilelang_mesh_tpu.ops.mla import mla_decode, mla_decode_reference
@@ -884,6 +938,8 @@ def _config_builders(q: bool):
             *(1, 4, 512, 64, 64) if q else (8, 16, 4096, 128, 128))),
         ("paged_decode", lambda: cfg_paged_decode(S=2048 if q else 8192)),
         ("moe_grouped", lambda: cfg_moe_grouped(M=256 if q else 512)),
+        ("w4a8_gemm", lambda: cfg_w4a8(*(1024,) * 3 if q
+                                       else (4096,) * 3)),
         ("w4a16_gemm", lambda: cfg_w4a16(*(1024,) * 3 if q
                                          else (4096,) * 3)),
     ]

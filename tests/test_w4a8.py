@@ -1,0 +1,64 @@
+"""w4a8 dequant GEMM: int8 activations x planar int4 weights on the
+int8 MXU path (reference examples/dequantize_gemm/
+example_dequant_gemm_w4a8.py capability).
+
+Correctness bar: the kernel must be EXACT against the integer-math
+reference (the whole K reduction is int32; the only float op is the
+scale epilogue). Accuracy vs f32 is a property of the quantizer, not
+the kernel, and gets a loose sanity bound only."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tilelang_mesh_tpu.ops.bitnet import quantize_activations
+from tilelang_mesh_tpu.ops.dequant_gemm import (quantize_w4_per_channel,
+                                                w4a8_matmul)
+
+
+def _int_reference(x, packed, sw):
+    """Exact integer-math reference of the w4a8 contract."""
+    q, s = quantize_activations(jnp.asarray(x))
+    wd = np.concatenate([(packed.astype(np.int32) & 0xF) - 8,
+                         (packed.astype(np.int32) >> 4) - 8], 0)
+    acc = np.asarray(q, np.int64) @ wd            # exact int
+    return acc.astype(np.float64) / np.asarray(s, np.float64) * sw
+
+
+@pytest.mark.parametrize("M,N,K", [(128, 256, 512), (64, 128, 256)])
+def test_w4a8_exact_vs_int_reference(M, N, K):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((M, K)).astype(np.float32)
+    w = rng.standard_normal((K, N)).astype(np.float32) * 0.1
+    packed, sw = quantize_w4_per_channel(w)
+    out = np.asarray(w4a8_matmul(jnp.asarray(x), packed, sw))
+    ref = _int_reference(x, packed, sw)
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 1e-5, rel
+
+
+def test_w4a8_tracks_f32_gemm_loosely():
+    """Quantizer sanity: int4-per-channel + int8-per-token lands within
+    coarse range of the f32 product on Gaussian data."""
+    rng = np.random.default_rng(1)
+    M, N, K = 128, 128, 512
+    x = rng.standard_normal((M, K)).astype(np.float32)
+    w = rng.standard_normal((K, N)).astype(np.float32) * 0.05
+    packed, sw = quantize_w4_per_channel(w)
+    out = np.asarray(w4a8_matmul(jnp.asarray(x), packed, sw))
+    full = x @ w
+    rel = np.linalg.norm(out - full) / np.linalg.norm(full)
+    assert rel < 0.25, rel
+
+
+def test_w4_pack_roundtrip():
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    packed, sw = quantize_w4_per_channel(w)
+    assert packed.shape == (32, 32) and packed.dtype == np.uint8
+    lo = (packed.astype(np.int32) & 0xF) - 8
+    hi = (packed.astype(np.int32) >> 4) - 8
+    wd = np.concatenate([lo, hi], 0) * sw
+    # dequantized weights within one quantization step everywhere
+    assert np.abs(wd - w).max() <= sw.max() * 1.001

@@ -11,7 +11,8 @@ from .flash_attention_bwd import flash_attention_bwd
 from .flash_attention_varlen import flash_attention_varlen
 from .flash_decoding import flash_decode, flash_decode_paged
 from .mla import mla_decode, mla_decode_reference
-from .dequant_gemm import dequant_matmul, dequant_gemm_kernel
+from .dequant_gemm import (dequant_matmul, dequant_gemm_kernel,
+                           w4a8_matmul, quantize_w4_per_channel)
 from .gqa import gqa_attention
 from .linear_attention import linear_attention, retention
 from .mamba2 import mamba2_chunk_scan, mamba2_reference

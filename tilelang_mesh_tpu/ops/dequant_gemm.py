@@ -15,14 +15,21 @@ import tilelang_mesh_tpu.language as T
 from ..jit import compile as _tl_compile
 
 
-def _unpack_nibble(byte_expr, hi: bool):
-    """int4 nibble -> centered float32 lanes. Mosaic legalizes neither
-    uint8->f32 casts nor uint8 shifts (arith.shrui): widen to int32
-    FIRST, then mask/shift/convert on the int32 lanes."""
+def _unpack_nibble(byte_expr, hi: bool, out_dtype: str = "float32"):
+    """int4 nibble -> centered lanes of out_dtype. Mosaic legalizes
+    neither uint8->f32 casts nor uint8 shifts (arith.shrui): widen to
+    int32 FIRST, then mask/shift/center/convert on the int32 lanes —
+    the single home for the idiom (w4a16 and w4a8 kernels both
+    call it)."""
     b = T.cast(byte_expr, "int32")
     if hi:
         b = T.shift_right(b, 4)
-    return T.cast(T.bitwise_and(b, 0xF), "float32") - 8.0
+    centered = T.bitwise_and(b, 0xF) - 8
+    if out_dtype == "float32":
+        # historical form: convert then center (identical value, keeps
+        # the w4a16 golden sources stable)
+        return T.cast(T.bitwise_and(b, 0xF), "float32") - 8.0
+    return T.cast(centered, out_dtype)
 
 
 @functools.lru_cache(maxsize=None)
@@ -161,3 +168,98 @@ def dequant_matmul_twopass(a, packed, scales, block_M=1024, block_N=1024,
                        block_N=min(block_N, N), block_K=min(block_K, K),
                        in_dtype=str(a.dtype), num_stages=num_stages)
     return mm(a, bd)
+
+
+# ---------------------------------------------------------------------------
+# w4a8: int4 weights x int8 activations on the int8 MXU path
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def w4a8_gemm_kernel(M, N, K, block_M=128, block_N=128, block_K2=256,
+                     num_stages=2):
+    """int8 activations x planar-packed int4 weights -> f32, on the
+    int8 MXU path (2x the bf16 rate; behavioral equivalent of reference
+    examples/dequantize_gemm/example_dequant_gemm_w4a8.py).
+
+    A (M, 2, K/2) planar int8; Bp (K/2, N) packed int4 (uint8); weight
+    scales are PER CHANNEL (N,) f32 and activation scales PER TOKEN
+    (M, 1) f32, so the whole K reduction stays in int32 and the
+    dequantize collapses to one f32 epilogue:
+        C[i, j] = acc_i32[i, j] * s_act[i] * s_w[j].
+    The int4 unpack is two mask/shift VPU ops into int8 lanes — no
+    transcendental work, no f32 until the epilogue."""
+    K2 = K // 2
+    assert K2 % block_K2 == 0
+
+    @T.prim_func
+    def w4a8(A: T.Tensor((M, 2, K2), "int8"),
+             Bp: T.Tensor((K2, N), "uint8"),
+             Sw: T.Tensor((1, N), "float32"),
+             Sa: T.Tensor((M, 1), "float32"),
+             C: T.Tensor((M, N), "float32")):
+        with T.Kernel(T.ceildiv(N, block_N), T.ceildiv(M, block_M)) \
+                as (bx, by):
+            A_s = T.alloc_shared((block_M, 2, block_K2), "int8")
+            Bp_s = T.alloc_shared((block_K2, block_N), "uint8")
+            B_lo = T.alloc_fragment((block_K2, block_N), "int8")
+            B_hi = T.alloc_fragment((block_K2, block_N), "int8")
+            sw_s = T.alloc_shared((1, block_N), "float32")
+            sa_s = T.alloc_shared((block_M, 1), "float32")
+            acc = T.alloc_fragment((block_M, block_N), "int32")
+            out = T.alloc_fragment((block_M, block_N), "float32")
+            T.clear(acc)
+            T.copy(Sw[0, bx * block_N], sw_s)
+            T.copy(Sa[by * block_M, 0], sa_s)
+            for ko in T.Pipelined(T.ceildiv(K2, block_K2),
+                                  num_stages=num_stages):
+                T.copy(A[by * block_M, 0, ko * block_K2], A_s)
+                T.copy(Bp[ko * block_K2, bx * block_N], Bp_s)
+                for i, j in T.Parallel(block_K2, block_N):
+                    B_lo[i, j] = _unpack_nibble(Bp_s[i, j], hi=False,
+                                                out_dtype="int8")
+                    B_hi[i, j] = _unpack_nibble(Bp_s[i, j], hi=True,
+                                                out_dtype="int8")
+                T.gemm(A_s[:, 0, :], B_lo, acc)
+                T.gemm(A_s[:, 1, :], B_hi, acc)
+            for i, j in T.Parallel(block_M, block_N):
+                out[i, j] = T.cast(acc[i, j], "float32") \
+                    * sa_s[i, 0] * sw_s[0, j]
+            T.copy(out, C[by * block_M, bx * block_N])
+
+    return _tl_compile(w4a8)
+
+
+def quantize_w4_per_channel(w):
+    """Per-output-channel symmetric int4 quantization of (K, N) f32
+    weights in the planar pack: returns (packed (K/2, N) uint8,
+    scales (N,) f32) with rows [0, K/2) in the low nibble."""
+    import numpy as np
+    K, N = w.shape
+    assert K % 2 == 0
+    scales = np.maximum(np.abs(w).max(0), 1e-8) / 7.0
+    q = np.clip(np.round(w / scales), -8, 7).astype(np.int32)
+    lo, hi = q[:K // 2] + 8, q[K // 2:] + 8
+    return ((hi << 4) | lo).astype(np.uint8), scales.astype(np.float32)
+
+
+def w4a8_matmul(x, packed, w_scales, block_M=128, block_N=128,
+                block_K2=256, num_stages=2):
+    """x (M, K) float -> per-token int8 quantize -> w4a8 GEMM -> f32.
+
+    Weights come from :func:`quantize_w4_per_channel`."""
+    import jax.numpy as jnp
+
+    from .bitnet import quantize_activations
+
+    M, K = x.shape
+    K2, N = packed.shape
+    assert K == 2 * K2
+    q, a_scale = quantize_activations(x)          # int8, (M, 1) 127/absmax
+    bk2 = min(block_K2, K2)
+    while K2 % bk2:                               # largest divisor <= bk2
+        bk2 -= 1
+    kern = w4a8_gemm_kernel(M, N, K, min(block_M, M), min(block_N, N),
+                            bk2, num_stages)
+    return kern(q.reshape(M, 2, K2), jnp.asarray(packed),
+                jnp.asarray(w_scales).reshape(1, N),
+                (1.0 / a_scale).astype(jnp.float32))
