@@ -1,0 +1,65 @@
+"""The round-5 bench config builders run end-to-end at tiny shapes on
+CPU (conftest forces the cpu backend): pins the builder APIs so a
+kernel-signature change cannot silently break the measurement sweep the
+round depends on.
+
+Numbers produced here are meaningless (interpret mode); only mechanics
+are asserted: builders construct, candidates cross-check, run_config
+emits a well-formed record with the right unit.
+"""
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+_PEAKS = {"bf16": 1e6, "f32": 5e5, "i8": 2e6, "hbm_gbs": 1e6}
+
+
+def _run(name, build):
+    import bench
+    rec = bench.run_config(name, build, _PEAKS, rounds=1)
+    assert rec["config"] == name
+    assert rec["latency_ms"] > 0 and rec["baseline_ms"] > 0
+    assert rec["vs_baseline"] > 0
+    return rec
+
+
+def test_mamba2_chunk_config():
+    import bench
+    rec = _run("mamba2_chunk",
+               lambda: bench.cfg_mamba2_chunk(1, 512, 2, 32, 32))
+    assert rec["unit"] == "TFLOPS"
+
+
+def test_gdn_fwd_config():
+    import bench
+    rec = _run("gdn_fwd", lambda: bench.cfg_gdn_fwd(1, 2, 256, 32, 32))
+    assert rec["unit"] == "TFLOPS"
+    assert "chunk=" in rec["metric"]      # flops follow the winner
+
+
+def test_w4a8_config():
+    import bench
+    rec = _run("w4a8_gemm", lambda: bench.cfg_w4a8(128, 256, 512))
+    assert rec["unit"] == "TFLOPS"
+
+
+def test_paged_decode_config_reports_bandwidth():
+    import bench
+    rec = _run("paged_decode",
+               lambda: bench.cfg_paged_decode(B=1, H=4, S=512, D=64,
+                                              page=128))
+    assert rec["unit"] == "GB/s"
+    assert "walk_ms" in rec and "gather_ms" in rec
+
+
+def test_all_configs_have_builders():
+    import bench
+    names = [n for n, _ in bench._config_builders(False)]
+    assert names[-1] == "w4a16_gemm", "riskiest config must run last"
+    for expected in ("mamba2_chunk", "gdn_fwd", "w4a8_gemm",
+                     "paged_decode"):
+        assert expected in names

@@ -51,13 +51,25 @@ def test_causal_select_is_diagonal_predicated():
     # exactly one masked select...
     wheres = _score_selects(src)
     assert len(wheres) == 1, wheres
-    # ...guarded by a pl.when whose condition involves the KV block
-    # index (the diagonal-straddle predicate), nested under the causal
-    # visited-guard
-    assert src.count("@pl.when") >= 2
-    idx = src.find(wheres[0])
-    before = src[:idx].rsplit("@pl.when", 1)[0]
-    assert "@pl.when" in before   # an outer guard exists too
+    # ...NESTED under two guards (visited-guard, then the
+    # diagonal-straddle predicate): the select's indentation must sit
+    # strictly deeper than the innermost pl.when, which itself sits
+    # strictly deeper than an enclosing pl.when — textual precedence
+    # alone would miss a hoist out of the visited-guard
+    def indent(line):
+        return len(line) - len(line.lstrip())
+
+    lines = src.splitlines()
+    sel_i = next(i for i, l in enumerate(lines) if "jnp.where" in l
+                 and "BlockSpec" not in l)
+    whens = [(i, indent(l)) for i, l in enumerate(lines[:sel_i])
+             if l.lstrip().startswith("@pl.when")]
+    assert whens, "no guard above the select"
+    inner_i, inner_ind = whens[-1]
+    assert indent(lines[sel_i]) > inner_ind, \
+        "select not inside the innermost guard"
+    outer = [w for w in whens[:-1] if w[1] < inner_ind]
+    assert outer, "diagonal guard is not nested inside an outer guard"
 
 
 @pytest.mark.parametrize("causal", [True, False])
