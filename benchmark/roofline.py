@@ -19,6 +19,48 @@ from tilelang_mesh_tpu.carver.arch import TPU_V5E  # noqa: E402
 _VPU_ELEMS_PER_S = 0.5e12   # carver roller model constant (conservative)
 
 
+def _measured_ms():
+    """Latest committed latencies, read from the RESULTS.md table (the
+    same rows benchmark/update_results.py regenerates, via its own
+    markers) — the roofline stays consistent with every fresh sweep."""
+    import importlib.util
+    import pathlib
+    import re
+    here = pathlib.Path(__file__).resolve().parent
+    spec = importlib.util.spec_from_file_location(
+        "_ur", here / "update_results.py")
+    ur = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ur)
+    text = (here / "RESULTS.md").read_text()
+    if ur.BEGIN not in text or ur.END not in text:
+        raise SystemExit(f"RESULTS.md lacks {ur.BEGIN} / {ur.END}")
+    block = text.split(ur.BEGIN)[1].split(ur.END)[0]
+    header = next(l for l in block.splitlines() if "| config |" in l)
+    ours_col = [c.strip() for c in header.strip().strip("|")
+                .split("|")].index("ours ms")
+    out = {}
+    for line in block.splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) > ours_col and re.match(r"^\w+$", cells[0]) \
+                and cells[0] != "config":
+            try:
+                out[cells[0]] = float(cells[ours_col])
+            except ValueError:
+                pass
+    return out
+
+
+def _meas(meas, name):
+    """Loud lookup: a renamed/dropped config must not become a silent
+    NaN row."""
+    if name not in meas:
+        import sys
+        print(f"# roofline: {name} missing from RESULTS.md table",
+              file=sys.stderr)
+        return float("nan")
+    return meas[name]
+
+
 def _roofline(name, flops, hbm_bytes, vpu_elems, measured_ms, note=""):
     arch = TPU_V5E
     peak = arch.bf16_tflops * 1e12
@@ -37,34 +79,35 @@ def _roofline(name, flops, hbm_bytes, vpu_elems, measured_ms, note=""):
 
 
 def rows():
+    meas = _measured_ms()
     out = []
-    # gemm_large: 8192x8192x4096 bf16 (measured 3.191 ms)
+    # gemm_large: 8192x8192x4096 bf16
     M, N, K = 8192, 8192, 4096
     bm, bn = 512, 1024   # measured winning tile class (carver rank-1)
     out.append(_roofline(
         "gemm_large", 2.0 * M * N * K,
         (M * K * (N // bn) + K * N * (M // bm)) * 2 + M * N * 2,
-        0, 3.191))
-    # flash_d64: B=2 H=16 S=2048 d=64 causal (measured 0.523 ms),
+        0, _meas(meas, "gemm_large")))
+    # flash_d64: B=2 H=16 S=2048 d=64 causal,
     # carver FlashAttentionTemplate accounting: 8 VPU elem-ops per score
     BH, S, D, frac = 32, 2048, 64, 0.5
     n_q = S // 256
     out.append(_roofline(
         "flash_d64", 4.0 * BH * S * S * D * frac,
         BH * (S * D * 2 + 2 * S * D * 2 * n_q * frac + S * D * 2),
-        BH * S * S * frac * 8, 0.523,
+        BH * S * S * frac * 8, _meas(meas, "flash_d64"),
         note="softmax VPU work dominates at d=64"))
-    # flash_d128 (measured 0.714 ms)
+    # flash_d128
     D = 128
     out.append(_roofline(
         "flash_d128", 4.0 * BH * S * S * D * frac,
         BH * (S * D * 2 + 2 * S * D * 2 * n_q * frac + S * D * 2),
-        BH * S * S * frac * 8, 0.714))
-    # flash_d128_full (non-causal, measured 0.965 ms)
+        BH * S * S * frac * 8, _meas(meas, "flash_d128")))
+    # flash_d128_full (non-causal)
     out.append(_roofline(
         "flash_d128_full", 4.0 * BH * S * S * D,
         BH * (S * D * 2 + 2 * S * D * 2 * n_q + S * D * 2),
-        BH * S * S * 8, 0.965))
+        BH * S * S * 8, _meas(meas, "flash_d128_full")))
     # w4a16 two-pass: dequant pass (rw 8MB+33MB) + 4096^3 GEMM
     M = N = K = 4096
     bm = bn = 1024
@@ -73,15 +116,15 @@ def rows():
         + 2 * K * N                      # + dequantized-B read
     out.append(_roofline(
         "w4a16_gemm", 2.0 * M * N * K, dq_bytes + mm_bytes,
-        K // 2 * N * 2, 0.839,
+        K // 2 * N * 2, _meas(meas, "w4a16_gemm"),
         note="two-pass: VPU decode is O(KN) once"))
-    # moe_grouped: E=8 per-expert 512x2048x2048 (measured 0.195 ms)
+    # moe_grouped: E=8 per-expert 512x2048x2048
     E, M, K, N = 8, 512, 2048, 2048
     bm, bn = 512, 2048
     out.append(_roofline(
         "moe_grouped", 2.0 * E * M * K * N,
         E * ((M * K * (N // bn) + K * N * (M // bm)) * 2 + M * N * 2),
-        0, 0.195))
+        0, _meas(meas, "moe_grouped")))
     return out
 
 

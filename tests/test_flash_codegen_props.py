@@ -60,8 +60,7 @@ def test_causal_select_is_diagonal_predicated():
         return len(line) - len(line.lstrip())
 
     lines = src.splitlines()
-    sel_i = next(i for i, l in enumerate(lines) if "jnp.where" in l
-                 and "BlockSpec" not in l)
+    sel_i = lines.index(wheres[0])
     whens = [(i, indent(l)) for i, l in enumerate(lines[:sel_i])
              if l.lstrip().startswith("@pl.when")]
     assert whens, "no guard above the select"

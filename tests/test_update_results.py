@@ -53,3 +53,19 @@ def test_incremental_row_update(tmp_path, monkeypatch):
     assert "walk=5.0ms gather=5.5ms" in out        # extras surfaced
     assert "broken" not in out                     # error lines dropped
     assert out.startswith("# header") and out.rstrip().endswith("trailer")
+
+
+def test_roofline_reads_results_table():
+    """benchmark/roofline.py derives measured latencies from RESULTS.md
+    (single source of truth with update_results.py)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "roofline", ROOT / "benchmark" / "roofline.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    meas = mod._measured_ms()
+    assert "gemm_large" in meas and meas["gemm_large"] > 0
+    rows = mod.rows()
+    byname = {r["name"]: r for r in rows}
+    assert abs(byname["gemm_large"]["measured"]
+               - meas["gemm_large"]) < 1e-9
