@@ -61,9 +61,10 @@ def _meas(meas, name):
     return meas[name]
 
 
-def _roofline(name, flops, hbm_bytes, vpu_elems, measured_ms, note=""):
+def _roofline(name, flops, hbm_bytes, vpu_elems, measured_ms, note="",
+              peak_tflops=None):
     arch = TPU_V5E
-    peak = arch.bf16_tflops * 1e12
+    peak = (peak_tflops or arch.bf16_tflops) * 1e12
     t_mxu = flops / peak * 1e3
     t_hbm = hbm_bytes / (arch.hbm_gbps * 1e9) * 1e3
     t_vpu = vpu_elems / _VPU_ELEMS_PER_S * 1e3
@@ -125,6 +126,36 @@ def rows():
         "moe_grouped", 2.0 * E * M * K * N,
         E * ((M * K * (N // bn) + K * N * (M // bm)) * 2 + M * N * 2),
         0, _meas(meas, "moe_grouped")))
+    # round-5 families (rows go live with their first measured sweep;
+    # _meas prints a note and yields NaN until then)
+    # mamba2: B=8 S=4096 H=80 P=64 N=128, chunk 256 — reference README
+    # FLOPs formula; HBM = x/y r+w (bf16) + B/C reads; VPU ~ the decay
+    # matrix + exps per chunk (C^2 per (b,h,chunk) f32 elems)
+    Bm_, S_, H_, P_, N_, C_ = 8, 4096, 80, 64, 128, 256
+    out.append(_roofline(
+        "mamba2_chunk",
+        2.0 * Bm_ * S_ * C_ * H_ * P_ * 0.5 + 2.0 * Bm_ * S_ * H_ * P_ * N_,
+        Bm_ * S_ * (2 * H_ * P_ * 2 + 2 * N_ * 2),
+        Bm_ * H_ * (S_ // C_) * C_ * C_ * 2,
+        _meas(meas, "mamba2_chunk")))
+    # gdn: B=8 H=16 T=4096 K=V=128, chunk 64 (bench formula; VPU ~ two
+    # decay-masked C x C passes per chunk)
+    Bg, Hg, Tg, Kg, Vg, Cg = 8, 16, 4096, 128, 128, 64
+    out.append(_roofline(
+        "gdn_fwd", Bg * Hg * Tg * (Cg * (Kg + Vg) + 6.0 * Kg * Vg),
+        Bg * Hg * Tg * (2 * Kg + 2 * Vg) * 2,
+        Bg * Hg * (Tg // Cg) * Cg * Cg * 2,
+        _meas(meas, "gdn_fwd")))
+    # w4a8 4096^3 on the int8 MXU path (peak = i8 rate); HBM = int8 A
+    # per N-tile + packed int4 B per M-tile + f32 C
+    M = N = K = 4096
+    bm, bn = 256, 512
+    out.append(_roofline(
+        "w4a8_gemm", 2.0 * M * N * K,
+        M * K * (N // bn) + K // 2 * N * (M // bm) + M * N * 4,
+        K // 2 * N * 2, _meas(meas, "w4a8_gemm"),
+        peak_tflops=2 * TPU_V5E.bf16_tflops,
+        note="int8 MXU path: peak is 2x bf16"))
     return out
 
 
