@@ -153,9 +153,12 @@ def rows():
     out.append(_roofline(
         "w4a8_gemm", 2.0 * M * N * K,
         M * K * (N // bn) + K // 2 * N * (M // bm) + M * N * 4,
-        K // 2 * N * 2, _meas(meas, "w4a8_gemm"),
+        # FUSED kernel: the B tile is re-decoded inside every M-tile's
+        # K loop (unlike the two-pass w4a16 row's once-only decode)
+        (M // bm) * K * N, _meas(meas, "w4a8_gemm"),
         peak_tflops=2 * TPU_V5E.bf16_tflops,
-        note="int8 MXU path: peak is 2x bf16"))
+        note="int8 MXU path (2x bf16 peak); fused per-tile decode "
+             "makes the model VPU-bound — sweeps may prefer larger bm"))
     return out
 
 
