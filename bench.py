@@ -539,10 +539,15 @@ def cfg_w4a8(M=4096, N=4096, K=4096):
 
     want = ref(qp, packed, sw, sa)
     check = functools.partial(_check_close, ref=want, rel_tol=1e-3)
+    # the roofline model (benchmark/roofline.py) says the fused decode
+    # is the bound at small block_M — per-tile B re-decode scales with
+    # M/block_M — so the sweep leans into LARGE bm
     cfgs = [(min(bm, M), min(bn, N), min(bk2, K2), ns)
             for bm, bn, bk2, ns in
             ((128, 256, 512, 2), (256, 256, 512, 2), (128, 512, 512, 2),
-             (256, 512, 256, 2), (256, 256, 1024, 2))]
+             (256, 512, 256, 2), (256, 256, 1024, 2),
+             (512, 512, 256, 2), (512, 256, 512, 2),
+             (1024, 256, 256, 2))]
     cfgs = list(dict.fromkeys(cfgs))          # dedupe after clamping
     cfgs.sort(key=lambda c: _gemm_vmem_est(c[0], c[1], c[2] * 2, c[3]))
     _, ours, _ = _pick_best(
