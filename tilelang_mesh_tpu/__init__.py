@@ -18,7 +18,7 @@ Usage mirrors the reference (/root/reference/tilelang/__init__.py)::
         return kernel
 """
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 
 import logging as _logging
 
