@@ -662,8 +662,14 @@ def cfg_paged_decode(B=4, H=32, S=8192, D=128, page=128):
         raise BenchError("no paged decode candidate ran")
     o_name = min(cands, key=lambda n: cands[n][0])
     _, ours, args = cands[o_name]
-    walk_s = cands.get("inkernel-walk", (float("nan"),))[0]
-    gather_s = cands.get("xla-gather", (float("nan"),))[0]
+    # a failed candidate's key is OMITTED rather than recorded as
+    # float('nan'): json.dumps emits NaN as a non-standard token that
+    # breaks strict JSON consumers (ADVICE r5)
+    extra = {}
+    if "inkernel-walk" in cands:
+        extra["walk_ms"] = round(cands["inkernel-walk"][0] * 1e3, 4)
+    if "xla-gather" in cands:
+        extra["gather_ms"] = round(cands["xla-gather"][0] * 1e3, 4)
 
     # decode is bandwidth-bound: the mandatory traffic is one pass over
     # the K and V caches (+ negligible q/o); report achieved GB/s
@@ -676,8 +682,7 @@ def cfg_paged_decode(B=4, H=32, S=8192, D=128, page=128):
                 ours=ours, ref=ref, args=args,
                 ref_args=(q, kv_pages, v_pages, table), rel_tol=4e-2,
                 checked=True,
-                extra={"walk_ms": round(walk_s * 1e3, 4),
-                       "gather_ms": round(gather_s * 1e3, 4)})
+                extra=extra)
 
 
 def cfg_mamba2_chunk(B=8, S=4096, H=80, P=64, N=128):
@@ -762,8 +767,12 @@ def cfg_gdn_fwd(B=8, H=16, Tt=4096, K=128, V=128):
           (q, k, v, g, beta)) for c in (64, 128)],
         check, "gdn tile kernel")
 
-    C = int(o_name.split("=")[1])   # flops follow the WINNING chunk
-    flops = B * H * Tt * (C * (K + V) + 6.0 * K * V)
+    # FLOPs at a FIXED nominal chunk: the C*(K+V) term grows with the
+    # chunk size, so counting the winner's chunk inflates TFLOPS when a
+    # larger chunk wins and breaks comparability across sweeps (ADVICE
+    # r5). Latency still picks the winner; vs_baseline is the headline.
+    C_NOM = 64
+    flops = B * H * Tt * (C_NOM * (K + V) + 6.0 * K * V)
     return dict(metric=f"GDN chunked fwd B={B} H={H} T={Tt} K={K} V={V} "
                        f"{o_name} (tile DSL vs XLA chunked WY)",
                 flops=flops, peak_class="bf16",
@@ -864,6 +873,63 @@ def run_config(name, build, peaks, rounds=3):
     }
     rec.update(spec.get("extra", {}))
     return rec
+
+
+def _attach_observability(rec: dict, name: str) -> dict:
+    """With TL_TPU_TRACE=1 in the child's environment, export this
+    config's trace (Chrome JSON + JSONL under TL_TPU_TRACE_DIR) and
+    embed the artifact paths, the compile-time breakdown by lowering
+    phase, cache tier statistics, and collective accounting into the
+    benchmark record — every BENCH_r* line becomes self-documenting and
+    a failed run leaves a span-attributable trail instead of nothing."""
+    try:
+        from tilelang_mesh_tpu.env import env
+        from tilelang_mesh_tpu.observability import (LOWER_PHASES,
+                                                     metrics_summary,
+                                                     reset, trace_enabled,
+                                                     write_chrome_trace,
+                                                     write_jsonl)
+        if not trace_enabled():
+            return rec
+        d = env.trace_dir()
+        chrome = write_chrome_trace(d / f"bench_{name}.trace.json")
+        jsonl = write_jsonl(d / f"bench_{name}.trace.jsonl")
+        summ = metrics_summary()
+        phase_ms = {ph: round(v["total_ms"], 3)
+                    for ph, v in summ["spans"].items()
+                    if ph in LOWER_PHASES}
+        rec["observability"] = {
+            "trace": str(chrome),
+            "trace_jsonl": str(jsonl),
+            "compile_phase_ms": phase_ms,
+            "cache": summ["cache"],
+            "collectives": summ["collectives"],
+        }
+        # per-config semantics: the next config (--in-process mode runs
+        # many in one process) must not inherit this one's spans/counters
+        reset()
+    except Exception as e:  # tracing must never take down a capture
+        rec["observability"] = {"error": f"{type(e).__name__}: {e}"}
+        _reset_tracer()
+    return rec
+
+
+def _reset_tracer() -> None:
+    """Best-effort per-config tracer reset for the paths that never reach
+    a successful _attach_observability export (failed configs in
+    --in-process mode): without it, the NEXT config's trace would
+    inherit this one's spans and counters.
+
+    Known limit of --in-process (debugging) mode: a config abandoned by
+    the watchdog leaves a zombie thread that may keep recording into
+    later configs' traces after this reset. Per-config attribution is
+    only guaranteed in the default subprocess mode, where the process
+    boundary quarantines it."""
+    try:
+        from tilelang_mesh_tpu.observability import reset
+        reset()
+    except Exception:
+        pass
 
 
 def _watchdog(fn, what: str, timeout_s: float):
@@ -992,6 +1058,7 @@ def _child_main(args) -> None:
               flush=True)
         sys.stdout.flush()
         os._exit(3)
+    rec = _attach_observability(rec, name)
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
     os._exit(0)
@@ -1132,9 +1199,11 @@ def main():
                     lambda: run_config(name, builders[name], peaks,
                                        rounds=1 if q else 3),
                     f"config {name}", cfg_timeout)
+                rec = _attach_observability(rec, name)
                 err = None
             except Exception as e:
                 rec, err = None, f"{type(e).__name__}: {e}"
+                _reset_tracer()
         else:
             if not alive and dead_budget > 0:
                 # re-probe: skip (not hang) while the worker is down,

@@ -48,9 +48,12 @@ def parse_lines(path):
 def fmt_row(name, r, date):
     vs = r["vs_baseline"]
     vs_s = f"**{vs:.3f}**" if vs >= 1.0 else f"{vs:.3f}"
-    extra = ""
-    if "walk_ms" in r:
-        extra = (f" walk={r['walk_ms']}ms gather={r['gather_ms']}ms")
+    # walk_ms / gather_ms are each OMITTED when that candidate failed
+    # (bench.py cfg_paged_decode), so render whichever keys exist
+    extra = "".join(f" {label}={r[key]}ms"
+                    for label, key in (("walk", "walk_ms"),
+                                       ("gather", "gather_ms"))
+                    if key in r)
     return (f"| {name} | {r['metric']}{extra} | {r['value']} {r['unit']} | "
             f"{r['latency_ms']} | {r['baseline_ms']} | {vs_s} | {date} |")
 

@@ -1,0 +1,513 @@
+"""Observability subsystem: tracer, exporters, pipeline instrumentation.
+
+Covers the ISSUE-1 checklist: span nesting (thread-local), disabled-mode
+no-op behavior, cache hit/miss counters across a compile -> recompile
+cycle, collective byte accounting for a ``T.comm.all_reduce`` kernel,
+Chrome-trace / JSONL export round-trips, the ``tools/analyzer.py
+--trace`` breakdown, and the acceptance smoke: ``TL_TPU_TRACE=1`` around
+a real GEMM compile+run yields a valid Chrome trace with all five
+lowering phases and a cache event — and changes no numerics.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import tracer as tr
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Every test starts from an empty process tracer."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+@pytest.fixture()
+def traced(monkeypatch, tmp_path):
+    """Tracing ON with hermetic cache/trace dirs (a shared disk cache
+    would turn this test's compiles into disk hits and skip the
+    lowering phases under test)."""
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+    monkeypatch.setenv("TL_TPU_TRACE_DIR", str(tmp_path / "trace"))
+    tilelang.clear_cache()
+    yield tmp_path
+    tilelang.clear_cache()
+
+
+def _scale_func(mult=2.0, M=64, N=128):
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * mult
+            T.copy(s, B)
+    return scale
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+class TestTracerCore:
+    def test_span_nesting_depth_and_order(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        with t.span("outer", "test"):
+            with t.span("inner", "test", detail=1):
+                pass
+        evs = [e for e in t.events() if e["type"] == "span"]
+        # inner finishes (and records) first
+        assert [e["name"] for e in evs] == ["inner", "outer"]
+        inner, outer = evs
+        assert inner["depth"] == 1 and outer["depth"] == 0
+        assert inner["tid"] == outer["tid"]
+        assert inner["attrs"] == {"detail": 1}
+        assert outer["dur_us"] >= inner["dur_us"] >= 0
+        # the child started no earlier than the parent
+        assert inner["ts_us"] >= outer["ts_us"]
+
+    def test_nesting_is_thread_local(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        depths = {}
+
+        def worker():
+            with t.span("w", "test") as sp:
+                depths["worker"] = sp.depth
+
+        with t.span("main", "test") as sp:
+            depths["main"] = sp.depth
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        # the worker's span must NOT nest under main's open span
+        assert depths == {"main": 0, "worker": 0}
+        tids = {e["name"]: e["tid"] for e in t.events()}
+        assert tids["w"] != tids["main"]
+
+    def test_span_records_error_and_propagates(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom", "test"):
+                raise ValueError("bad plan")
+        ev = t.events()[-1]
+        assert ev["name"] == "boom"
+        assert "ValueError: bad plan" in ev["attrs"]["error"]
+
+    def test_disabled_mode_is_noop(self, monkeypatch):
+        monkeypatch.delenv("TL_TPU_TRACE", raising=False)
+        t = obs.get_tracer()
+        s1 = t.span("a", "test")
+        s2 = t.span("b", "test")
+        # one shared null instance: no allocation per disabled call site
+        assert s1 is s2
+        with s1 as sp:
+            sp.set(key="dropped")
+        t.event("instant", "test")
+        assert t.events() == []
+        # counters stay live even when untraced
+        t.inc("still.counted")
+        assert t.counters()["still.counted"] == 1
+
+    def test_event_cap_drops_and_counts(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        monkeypatch.setenv("TL_TPU_TRACE_MAX_EVENTS", "3")
+        t = obs.get_tracer()
+        for i in range(10):
+            t.event(f"e{i}", "test")
+        assert len(t.events()) == 3
+        assert t.counters()["trace.dropped_events"] == 7
+
+    def test_reset_clears_state(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        t.event("x", "test")
+        t.inc("c")
+        obs.reset()
+        assert t.events() == [] and t.counters() == {}
+
+    def test_span_straddling_reset_is_dropped(self, monkeypatch):
+        """A span opened before reset() (e.g. on an abandoned watchdog
+        thread) must not land in the post-reset event list with a stale
+        clock origin."""
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        stale = t.span("stale", "test")
+        stale.__enter__()
+        obs.reset()
+        with t.span("fresh", "test"):
+            pass
+        stale.__exit__(None, None, None)
+        assert [e["name"] for e in t.events()] == ["fresh"]
+        assert all(e["dur_us"] >= 0 for e in t.events())
+
+    def test_labelled_counters_render(self):
+        t = obs.get_tracer()
+        t.inc("comm.ops", op="all_reduce")
+        t.inc("comm.ops", 2, op="broadcast")
+        c = t.counters()
+        assert c["comm.ops{op=all_reduce}"] == 1
+        assert c["comm.ops{op=broadcast}"] == 2
+
+
+# ---------------------------------------------------------------------------
+# compile pipeline instrumentation
+# ---------------------------------------------------------------------------
+
+PHASES = ("canonicalize", "checks", "plan", "codegen", "artifact")
+
+
+class TestPipelineInstrumentation:
+    def test_cache_counters_across_compile_recompile(self, traced):
+        f = _scale_func(mult=5.0)
+        tilelang.compile(f, target="cpu")
+        c = obs.get_tracer().counters()
+        assert c["cache.memory.miss"] == 1
+        assert c["cache.disk.miss"] == 1
+        assert c["cache.build"] == 1
+        assert c.get("cache.artifact_bytes_written", 0) > 0
+
+        tilelang.compile(f, target="cpu")          # -> memory hit
+        c = obs.get_tracer().counters()
+        assert c["cache.memory.hit"] == 1
+
+        tilelang.clear_cache()                     # memory only
+        tilelang.compile(f, target="cpu")          # -> disk hit
+        c = obs.get_tracer().counters()
+        assert c["cache.memory.miss"] == 2
+        assert c["cache.disk.hit"] == 1
+        assert c["cache.build"] == 1               # never rebuilt
+        assert c.get("cache.artifact_bytes_read", 0) > 0
+
+        summ = obs.metrics_summary()
+        assert summ["cache"]["memory_hit_rate"] == pytest.approx(1 / 3,
+                                                                 abs=1e-3)
+        assert summ["cache"]["disk_hit_rate"] == pytest.approx(1 / 2)
+
+    def test_lowering_phase_spans_recorded(self, traced):
+        tilelang.compile(_scale_func(mult=7.0), target="cpu")
+        spans = [e for e in obs.get_tracer().events()
+                 if e["type"] == "span"]
+        names = [e["name"] for e in spans]
+        for ph in PHASES:
+            assert names.count(ph) == 1, f"phase {ph} missing"
+        by_name = {e["name"]: e for e in spans}
+        root = by_name["lower"]
+        assert root["attrs"]["kernel"] == "scale"
+        assert root["attrs"]["target"] == "cpu"
+        for ph in PHASES:
+            assert by_name[ph]["depth"] > root["depth"]
+
+    def test_jit_callsite_counters(self, traced):
+        @tilelang.jit
+        def factory(mult):
+            return _scale_func(mult=mult)
+
+        factory(2.0)
+        factory(2.0)
+        factory(3.0)
+        c = obs.get_tracer().counters()
+        assert c["jit.callsite.miss"] == 2
+        assert c["jit.callsite.hit"] == 1
+
+    def test_lazy_jit_bucket_events_and_counters(self, traced):
+        M = T.dynamic("m")
+        N, BK = 128, 64
+
+        @tilelang.lazy_jit(out_idx=[1], dynamic_bucket=BK)
+        def scale(A: T.Tensor((M, N), "float32"),
+                  B: T.Tensor((M, N), "float32")):
+            with T.Kernel(T.ceildiv(M, BK)) as bx:
+                s = T.alloc_shared((BK, N), "float32")
+                T.copy(A[bx * BK, 0], s)
+                for i, j in T.Parallel(BK, N):
+                    s[i, j] = s[i, j] * 2.0
+                T.copy(s, B[bx * BK, 0])
+
+        rng = np.random.default_rng(0)
+        for m in (50, 64, 30):            # one 64 bucket -> one compile
+            a = rng.standard_normal((m, N), dtype=np.float32)
+            np.testing.assert_allclose(np.asarray(scale(a)), a * 2,
+                                       rtol=1e-5)
+        c = obs.get_tracer().counters()
+        assert c["jit.lazy.miss"] == 1
+        assert c["jit.lazy.hit"] == 2
+        evs = [e for e in obs.get_tracer().events()
+               if e["type"] == "event" and e["name"] == "jit.lazy_bucket"]
+        assert len(evs) == 3
+        assert evs[0]["attrs"]["bucket"] == BK
+        (d0,) = evs[0]["attrs"]["dims"]
+        assert (d0["dim"], d0["true"], d0["padded"]) == ("m", 50, 64)
+        spec = [e for e in obs.get_tracer().events()
+                if e["type"] == "span"
+                and e["name"] == "jit.lazy_specialize"]
+        assert len(spec) == 1 and spec[0]["attrs"]["shapes"] == {"m": 64}
+
+    def test_autotune_trial_spans(self, traced):
+        def factory(block_M=32):
+            M, N = 64, 128
+            bm = block_M
+
+            @T.prim_func
+            def k(A: T.Tensor((M, N), "float32"),
+                  B: T.Tensor((M, N), "float32")):
+                with T.Kernel(T.ceildiv(M, bm)) as bx:
+                    s = T.alloc_shared((bm, N), "float32")
+                    T.copy(A[bx * bm, 0], s)
+                    for i, j in T.Parallel(bm, N):
+                        s[i, j] = s[i, j] + 1.0
+                    T.copy(s, B[bx * bm, 0])
+            return tilelang.compile(k, target="cpu")
+
+        tuned = tilelang.autotune(configs=[{"block_M": 32},
+                                           {"block_M": 64}],
+                                  warmup=1, rep=2,
+                                  cache_results=False)(factory)
+        tuned()
+        spans = [e for e in obs.get_tracer().events()
+                 if e["type"] == "span" and e["name"] == "autotune.trial"]
+        assert len(spans) == 2
+        assert all(s["attrs"]["outcome"] == "ok" for s in spans)
+        assert all(s["attrs"]["latency_ms"] > 0 for s in spans)
+        runs = [e for e in obs.get_tracer().events()
+                if e["type"] == "span" and e["name"] == "autotune.run"]
+        assert len(runs) == 1 and "best_config" in runs[0]["attrs"]
+
+
+# ---------------------------------------------------------------------------
+# collective accounting
+# ---------------------------------------------------------------------------
+
+MESH = (2, 4)
+
+
+def _allreduce_artifact():
+    from tilelang_mesh_tpu.parallel import mesh_config
+    nrow, ncol = MESH
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: T.MeshTensor((nrow * ncol * 8, 128),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32"),
+              B: T.MeshTensor((nrow * ncol * 8, 1),
+                              T.MeshShardingPolicy(cross_mesh_dim=0),
+                              MESH, "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared((8, 128), "float32")
+                out = T.alloc_shared((8, 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, out, "sum", "all")
+                T.copy(out, B)
+        return tilelang.lower(k, target=f"cpu-mesh[{nrow}x{ncol}]")
+
+
+class TestCollectiveAccounting:
+    def test_all_reduce_bytes_and_axis(self, traced):
+        art = _allreduce_artifact()
+        recs = art.attrs["collectives"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["op"] == "allreduce"
+        assert rec["axis"] == "x,y"
+        assert rec["reduce_type"] == "sum"
+        # per-hop wire payload is the locally-reduced OUT chunk:
+        # 8x1 f32 = 32 bytes
+        assert rec["payload_bytes"] == 32
+        assert rec["hops"] >= 1
+        assert rec["wire_bytes"] == rec["payload_bytes"] * rec["hops"]
+        # ... and the same record landed in the tracer
+        evs = [e for e in obs.get_tracer().events()
+               if e["type"] == "event" and e["name"] == "comm.collective"]
+        assert len(evs) == 1 and evs[0]["attrs"]["op"] == "allreduce"
+        c = obs.get_tracer().counters()
+        assert c["comm.ops{op=allreduce}"] == 1
+        assert c["comm.bytes{op=allreduce}"] == rec["wire_bytes"]
+        assert c["comm.emitted{op=all_reduce}"] == 1
+        summ = obs.metrics_summary()
+        assert summ["collectives"]["ops"] == 1
+        assert summ["collectives"]["bytes"] == rec["wire_bytes"]
+
+    def test_accounting_works_untraced(self, monkeypatch, tmp_path):
+        # counters (but no events) even with tracing off
+        monkeypatch.delenv("TL_TPU_TRACE", raising=False)
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+        art = _allreduce_artifact()
+        assert art.attrs["collectives"][0]["wire_bytes"] > 0
+        assert obs.get_tracer().counters()["comm.ops{op=allreduce}"] == 1
+        assert obs.get_tracer().events() == []
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+class TestExporters:
+    def test_chrome_trace_round_trip(self, traced):
+        tilelang.compile(_scale_func(mult=9.0), target="cpu")
+        path = obs.write_chrome_trace(traced / "t.trace.json")
+        loaded = json.loads(path.read_text())     # strict JSON
+        names = {e["name"] for e in loaded["traceEvents"]}
+        for ph in PHASES:
+            assert ph in names
+        phs = {e["ph"] for e in loaded["traceEvents"]}
+        assert "X" in phs and "C" in phs
+        for e in loaded["traceEvents"]:
+            if e["ph"] == "X":
+                assert e["ts"] >= 0 and e["dur"] >= 0
+                assert isinstance(e["pid"], int)
+                assert isinstance(e["tid"], int)
+
+    def test_jsonl_round_trip(self, traced):
+        tilelang.compile(_scale_func(mult=11.0), target="cpu")
+        path = obs.write_jsonl(traced / "t.jsonl")
+        recs = obs.read_jsonl(path)
+        types = {r["type"] for r in recs}
+        assert types == {"span", "event", "counter"}
+        span_names = [r["name"] for r in recs if r["type"] == "span"]
+        for ph in PHASES:
+            assert ph in span_names
+        counters = {r["name"]: r["value"] for r in recs
+                    if r["type"] == "counter"}
+        assert counters["cache.build"] == 1
+
+    def test_prometheus_snapshot(self, traced):
+        tilelang.compile(_scale_func(mult=13.0), target="cpu")
+        text = obs.to_prometheus_text()
+        assert "# TYPE tl_tpu_cache_build counter" in text
+        assert "tl_tpu_cache_build 1" in text
+        assert "tl_tpu_span_plan_seconds_count 1" in text
+
+    def test_prometheus_one_type_line_per_metric(self):
+        t = obs.get_tracer()
+        t.inc("comm.ops", op="broadcast")
+        t.inc("comm.ops", op="allreduce")
+        text = obs.to_prometheus_text()
+        # exposition format: at most ONE TYPE line per metric name
+        assert text.count("# TYPE tl_tpu_comm_ops counter") == 1
+        assert 'tl_tpu_comm_ops{op="broadcast"} 1' in text
+        assert 'tl_tpu_comm_ops{op="allreduce"} 1' in text
+
+    def test_exporters_empty_tracer(self):
+        assert obs.to_chrome_trace()["traceEvents"] == []
+        assert obs.to_jsonl() == ""
+        assert obs.to_prometheus_text() == ""
+        summ = obs.metrics_summary()
+        assert summ["spans"] == {} and summ["counters"] == {}
+
+    def test_json_safe_attrs_never_break_export(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        t = obs.get_tracer()
+        t.event("weird", "test", obj=object(), nan=float("nan"),
+                tup=(1, 2))
+        blob = json.dumps(obs.to_chrome_trace())   # must not raise
+        args = json.loads(blob)["traceEvents"][0]["args"]
+        assert args["tup"] == [1, 2]
+        assert isinstance(args["obj"], str)
+        assert isinstance(args["nan"], str)        # no bare NaN token
+
+
+# ---------------------------------------------------------------------------
+# analyzer --trace
+# ---------------------------------------------------------------------------
+
+class TestTraceAnalyzer:
+    def test_trace_report_breakdown(self, traced, capsys):
+        from tilelang_mesh_tpu.tools.analyzer import main
+        tilelang.compile(_scale_func(mult=17.0), target="cpu")
+        path = obs.write_jsonl(traced / "t.jsonl")
+        assert main(["--trace", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "compile-time breakdown by lowering phase" in out
+        for ph in PHASES:
+            assert ph in out
+        assert "cache counters:" in out
+        assert "cache.build" in out
+
+    def test_trace_report_collectives_and_empty(self, traced, capsys,
+                                                tmp_path):
+        from tilelang_mesh_tpu.tools.analyzer import format_trace_report
+        _allreduce_artifact()
+        recs = obs.read_jsonl(obs.write_jsonl(traced / "m.jsonl"))
+        out = format_trace_report(recs)
+        assert "collectives (static accounting)" in out
+        assert "allreduce" in out
+        # an empty trace explains itself instead of crashing
+        empty = tmp_path / "e.jsonl"
+        empty.write_text("")
+        assert "no lowering-phase spans" in format_trace_report(
+            obs.read_jsonl(empty))
+
+
+# ---------------------------------------------------------------------------
+# acceptance smoke: TL_TPU_TRACE=1 around a real kernel changes nothing
+# ---------------------------------------------------------------------------
+
+class TestTraceSmoke:
+    def test_gemm_compile_run_under_trace(self, traced):
+        """The ISSUE-1 acceptance shape: tracing a GEMM compile+run
+        yields a valid Chrome trace with all five lowering phases and a
+        cache event, and the kernel's numerics are untouched."""
+        M = N = K = 128
+
+        @T.prim_func
+        def gemm(A: T.Tensor((M, K), "float32"),
+                 B: T.Tensor((K, N), "float32"),
+                 C: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                a = T.alloc_shared((M, K), "float32")
+                b = T.alloc_shared((K, N), "float32")
+                c = T.alloc_fragment((M, N), "float32")
+                T.copy(A, a)
+                T.copy(B, b)
+                T.clear(c)
+                T.gemm(a, b, c)
+                T.copy(c, C)
+
+        k = tilelang.compile(gemm, target="cpu")
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((M, K)).astype(np.float32)
+        b = rng.standard_normal((K, N)).astype(np.float32)
+        out = np.asarray(k(a, b))
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5, atol=1e-5)
+
+        trace = obs.to_chrome_trace()
+        json.loads(json.dumps(trace))              # valid strict JSON
+        names = [e["name"] for e in trace["traceEvents"]]
+        for ph in PHASES:
+            assert ph in names
+        cache_events = [e for e in trace["traceEvents"]
+                        if e.get("cat") == "cache"]
+        assert cache_events, "no cache event in the trace"
+
+    def test_trace_flag_does_not_change_results(self, monkeypatch,
+                                                tmp_path):
+        """Same kernel, tracing off vs on: identical outputs (the
+        fast 'TL_TPU_TRACE=1 adds no failures' tier-1 smoke)."""
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 128)).astype(np.float32)
+
+        monkeypatch.delenv("TL_TPU_TRACE", raising=False)
+        tilelang.clear_cache()
+        k_off = tilelang.compile(_scale_func(mult=2.5), target="cpu")
+        out_off = np.asarray(k_off(x))
+
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        tilelang.clear_cache(disk=True)   # force a full traced rebuild
+        k_on = tilelang.compile(_scale_func(mult=2.5), target="cpu")
+        out_on = np.asarray(k_on(x))
+        np.testing.assert_array_equal(out_off, out_on)
+        assert [e for e in obs.get_tracer().events()
+                if e["name"] == "lower"]

@@ -54,6 +54,10 @@ from .utils.tensor import TensorSupplyType  # noqa: E402
 # autotuner
 from .autotuner import autotune, AutoTuner  # noqa: E402
 
+# observability (tracing + metrics; enable with TL_TPU_TRACE=1)
+from . import observability  # noqa: E402
+from .observability import metrics_summary  # noqa: E402
+
 # transform / pass config
 from .transform.pass_config import PassConfigKey  # noqa: E402
 
@@ -68,5 +72,6 @@ __all__ = [
     "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
     "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
     "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
+    "observability", "metrics_summary",
     "env", "logger", "set_log_level", "__version__",
 ]

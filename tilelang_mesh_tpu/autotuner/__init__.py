@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..env import env
+from ..observability import tracer as _trace
 from ..profiler import Profiler
 from ..utils.tensor import TensorSupplyType
 
@@ -218,41 +219,60 @@ class AutoTuner:
             configs = self._resolve_configs(args, kwargs)
             key = self._disk_key(args, kwargs, configs)
         cache_f = env.autotune_dir() / f"{key}.json"
-        if self.cache_results and cache_f.exists():
+        if self.cache_results:
+            # count hit/miss only when a lookup actually happens:
+            # cache_results=False runs would otherwise read as a 0% rate
             try:
-                rec = json.loads(cache_f.read_text())
-                best_cfg = rec["config"]
-                kernel = self.fn(*args, **{**kwargs, **best_cfg})
-                return AutotuneResult(best_cfg, rec["latency_ms"], kernel,
-                                      rec.get("all_results", []),
-                                      from_cache=True)
+                if cache_f.exists():
+                    rec = json.loads(cache_f.read_text())
+                    best_cfg = rec["config"]
+                    kernel = self.fn(*args, **{**kwargs, **best_cfg})
+                    _trace.inc("autotune.cache.hit")
+                    return AutotuneResult(best_cfg, rec["latency_ms"],
+                                          kernel,
+                                          rec.get("all_results", []),
+                                          from_cache=True)
             except Exception:
                 pass
+            _trace.inc("autotune.cache.miss")
         if configs is None:
             configs = self._derive_configs(args, kwargs)
 
         best: Optional[AutotuneResult] = None
         captured: List[Dict[str, Any]] = []
         n = len(configs)
-        for i, cfg in enumerate(configs):
-            try:
-                def _one():
-                    kernel = self.fn(*args, **{**kwargs, **cfg})
-                    prof = Profiler(kernel, self.supply_type)
-                    return kernel, prof.do_bench(warmup=self.warmup,
-                                                 rep=self.rep)
-                kernel, lat = run_with_timeout(_one, self.timeout)
-            except Exception as e:  # config isolation (tuner.py:51)
-                logger.debug("autotune config %s failed: %s", cfg, e)
-                captured.append({"config": cfg, "latency_ms": None,
-                                 "error": f"{type(e).__name__}: {e}"})
-                continue
-            logger.info("autotune [%d/%d] %s -> %.4f ms", i + 1, n, cfg, lat)
-            captured.append({"config": cfg, "latency_ms": lat})
-            if best is None or lat < best.latency_ms:
-                best = AutotuneResult(cfg, lat, kernel)
-        if best is None:
-            raise RuntimeError("autotune: every candidate config failed")
+        factory = getattr(self.fn, "__name__", "?")
+        with _trace.span("autotune.run", "autotune", factory=factory,
+                         n_configs=n) as run_sp:
+            for i, cfg in enumerate(configs):
+                with _trace.span("autotune.trial", "autotune",
+                                 factory=factory, config=cfg) as sp:
+                    try:
+                        def _one():
+                            kernel = self.fn(*args, **{**kwargs, **cfg})
+                            prof = Profiler(kernel, self.supply_type)
+                            return kernel, prof.do_bench(warmup=self.warmup,
+                                                         rep=self.rep)
+                        kernel, lat = run_with_timeout(_one, self.timeout)
+                    except Exception as e:  # config isolation (tuner.py:51)
+                        logger.debug("autotune config %s failed: %s", cfg, e)
+                        sp.set(outcome="failed",
+                               error=f"{type(e).__name__}: {e}")
+                        _trace.inc("autotune.trials", outcome="failed")
+                        captured.append({"config": cfg, "latency_ms": None,
+                                         "error": f"{type(e).__name__}: {e}"})
+                        continue
+                    sp.set(outcome="ok", latency_ms=lat)
+                    _trace.inc("autotune.trials", outcome="ok")
+                logger.info("autotune [%d/%d] %s -> %.4f ms",
+                            i + 1, n, cfg, lat)
+                captured.append({"config": cfg, "latency_ms": lat})
+                if best is None or lat < best.latency_ms:
+                    best = AutotuneResult(cfg, lat, kernel)
+            if best is None:
+                raise RuntimeError("autotune: every candidate config failed")
+            run_sp.set(best_config=best.config,
+                       best_latency_ms=best.latency_ms)
         best.all_results = captured
         if self.cache_results:
             cache_f.write_text(json.dumps(

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 
 from ..engine.param import CompiledArtifact, KernelParam
 from ..env import env
+from ..observability import tracer as _trace
 
 KERNEL_SOURCE_FILE = "kernel.py"
 ARTIFACT_FILE = "artifact.json"
@@ -71,6 +72,8 @@ class KernelCache:
             return None
         try:
             meta = json.loads(meta_f.read_text())
+            _trace.inc("cache.artifact_bytes_read",
+                       src_f.stat().st_size + meta_f.stat().st_size)
             params = [KernelParam(p["name"], tuple(p["shape"]), p["dtype"],
                                   p["role"]) for p in meta["params"]]
             return CompiledArtifact(
@@ -107,7 +110,11 @@ class KernelCache:
             "attrs": {k: v for k, v in art.attrs.items()
                       if isinstance(v, (str, int, float, bool, list))},
         }
-        (d / ARTIFACT_FILE).write_text(json.dumps(meta, indent=1))
+        meta_text = json.dumps(meta, indent=1)
+        (d / ARTIFACT_FILE).write_text(meta_text)
+        # source + metadata, mirroring what load_artifact counts as read
+        _trace.inc("cache.artifact_bytes_written",
+                   len(art.kernel_source) + len(meta_text))
 
 
 _CACHE = KernelCache()
@@ -130,17 +137,30 @@ def cached(func, target: str = "auto", out_idx=None,
 
     hit = _CACHE.get(key)
     if hit is not None:
+        _trace.inc("cache.memory.hit")
+        _trace.event("cache.hit", "cache", tier="memory",
+                     kernel=getattr(hit.artifact, "name", "?"), key=key)
         return hit
+    _trace.inc("cache.memory.miss")
 
     art = _CACHE.load_artifact(key)
-    if art is None:
-        art = lower(func, target=target, pass_configs=pass_configs)
-        _CACHE.save_artifact(key, art)
-    if art.attrs.get("is_mesh"):
-        from ..parallel.lowering import MeshKernel
-        kernel: Any = MeshKernel(art, out_idx=out_idx)
+    if art is not None:
+        _trace.inc("cache.disk.hit")
+        _trace.event("cache.hit", "cache", tier="disk", kernel=art.name,
+                     key=key)
     else:
-        kernel = JITKernel(art, out_idx=out_idx, verbose=verbose)
+        _trace.inc("cache.disk.miss")
+        _trace.event("cache.miss", "cache", tier="disk", key=key)
+        art = lower(func, target=target, pass_configs=pass_configs)
+        _trace.inc("cache.build")
+        _CACHE.save_artifact(key, art)
+    with _trace.span("kernel_build", "cache", kernel=art.name,
+                     mesh=bool(art.attrs.get("is_mesh"))):
+        if art.attrs.get("is_mesh"):
+            from ..parallel.lowering import MeshKernel
+            kernel: Any = MeshKernel(art, out_idx=out_idx)
+        else:
+            kernel = JITKernel(art, out_idx=out_idx, verbose=verbose)
     _CACHE.put(key, kernel)
     if env.TL_TPU_PRINT_ON_COMPILATION:
         print(f"[tilelang_mesh_tpu] compiled {art.name} for {target} "

@@ -21,6 +21,7 @@ from ..analysis import run_semantic_checks
 from ..codegen.pallas import generate_source
 from ..engine.param import CompiledArtifact, KernelParam
 from ..ir import Buffer, PrimFunc, Var
+from ..observability import tracer as _trace
 from ..transform.pass_config import current_pass_config
 from ..transform.plan import plan_kernel
 from ..utils.target import (determine_target, mesh_dims_from_target,
@@ -45,36 +46,49 @@ def _param_table(plan) -> list:
 
 def lower(func, target: str = "auto",
           pass_configs: Optional[dict] = None) -> CompiledArtifact:
-    """Lower a traced prim_func to a compiled artifact (generated source)."""
+    """Lower a traced prim_func to a compiled artifact (generated source).
+
+    With ``TL_TPU_TRACE=1`` every phase of the pipeline records a span
+    (canonicalize -> checks -> plan -> codegen -> artifact), so a failed
+    or slow compile is attributable to one phase in the exported trace.
+    """
     from ..language.builder import PrimFuncObj
-    if isinstance(func, PrimFuncObj):
-        func = func.func
-    if not isinstance(func, PrimFunc):
-        raise TypeError(f"lower() expects a @T.prim_func, got {type(func)}")
+    with _trace.span("lower", "lower") as root:
+        with _trace.span("canonicalize", "lower"):
+            if isinstance(func, PrimFuncObj):
+                func = func.func
+            if not isinstance(func, PrimFunc):
+                raise TypeError(
+                    f"lower() expects a @T.prim_func, got {type(func)}")
+            target = determine_target(target)
+            cfg = dict(current_pass_config())
+            if pass_configs:
+                for k, v in pass_configs.items():
+                    cfg[getattr(k, "value", str(k))] = v
+        root.set(kernel=func.name, target=target)
 
-    target = determine_target(target)
-    cfg = dict(current_pass_config())
-    if pass_configs:
-        for k, v in pass_configs.items():
-            cfg[getattr(k, "value", str(k))] = v
+        # mesh kernels take the SPMD path
+        if target_is_mesh(target) or func.attrs.get("mesh_config"):
+            from ..parallel.lowering import lower_mesh
+            mesh_cfg = mesh_dims_from_target(target) or \
+                func.attrs.get("mesh_config")
+            return lower_mesh(func, target, mesh_cfg, cfg)
 
-    # mesh kernels take the SPMD path
-    if target_is_mesh(target) or func.attrs.get("mesh_config"):
-        from ..parallel.lowering import lower_mesh
-        mesh_cfg = mesh_dims_from_target(target) or \
-            func.attrs.get("mesh_config")
-        return lower_mesh(func, target, mesh_cfg, cfg)
-
-    run_semantic_checks(func)
-    plan = plan_kernel(func, cfg)
-    source = generate_source(plan, cfg)
-    return CompiledArtifact(
-        name=func.name,
-        params=_param_table(plan),
-        kernel_source=source,
-        target=target,
-        grid=tuple(a.extent for a in plan.grid),
-        ir_script=func.script(),
-        plan_desc=plan.describe(),
-        attrs=dict(func.attrs),
-    )
+        with _trace.span("checks", "lower", kernel=func.name):
+            run_semantic_checks(func)
+        with _trace.span("plan", "lower", kernel=func.name):
+            plan = plan_kernel(func, cfg)
+        with _trace.span("codegen", "lower", kernel=func.name) as sp:
+            source = generate_source(plan, cfg)
+            sp.set(source_bytes=len(source))
+        with _trace.span("artifact", "lower", kernel=func.name):
+            return CompiledArtifact(
+                name=func.name,
+                params=_param_table(plan),
+                kernel_source=source,
+                target=target,
+                grid=tuple(a.extent for a in plan.grid),
+                ir_script=func.script(),
+                plan_desc=plan.describe(),
+                attrs=dict(func.attrs),
+            )

@@ -51,6 +51,12 @@ class Environment:
         str(Path.home() / ".tilelang_mesh_tpu" / "autotune"))
     # native library
     TL_TPU_DISABLE_NATIVE = EnvVar("TL_TPU_DISABLE_NATIVE", False, bool)
+    # observability (observability/tracer.py reads these; keep tracer's
+    # only dependency THIS module so every layer can import it)
+    TL_TPU_TRACE = EnvVar("TL_TPU_TRACE", False, bool)
+    TL_TPU_TRACE_DIR = EnvVar(
+        "TL_TPU_TRACE_DIR", str(Path.home() / ".tilelang_mesh_tpu" / "trace"))
+    TL_TPU_TRACE_MAX_EVENTS = EnvVar("TL_TPU_TRACE_MAX_EVENTS", 100_000, int)
 
     def cache_dir(self) -> Path:
         p = Path(self.TL_TPU_CACHE_DIR)
@@ -59,6 +65,11 @@ class Environment:
 
     def autotune_dir(self) -> Path:
         p = Path(self.TL_TPU_AUTOTUNE_CACHE_DIR)
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def trace_dir(self) -> Path:
+        p = Path(self.TL_TPU_TRACE_DIR)
         p.mkdir(parents=True, exist_ok=True)
         return p
 

@@ -25,6 +25,7 @@ from typing import Any, Callable, List, Optional, Sequence
 from ..cache.kernel_cache import cached
 from ..env import env
 from ..language.builder import PrimFuncObj, trace_prim_func
+from ..observability import tracer as _trace
 from .kernel import JITKernel
 
 
@@ -39,8 +40,10 @@ def compile(func, out_idx: Optional[Sequence[int]] = None,  # noqa: A001
     """
     if not isinstance(func, PrimFuncObj):
         raise TypeError("tilelang.compile expects a @T.prim_func")
-    k = cached(func, target=target, out_idx=out_idx,
-               pass_configs=pass_configs, verbose=verbose)
+    with _trace.span("jit.compile", "jit",
+                     kernel=getattr(func, "name", "?"), target=target):
+        k = cached(func, target=target, out_idx=out_idx,
+                   pass_configs=pass_configs, verbose=verbose)
     # keep the traced IR reachable from the kernel: the carver's
     # IR-derived autotuning (carver/node.py) re-analyzes it
     k.prim_func = func
@@ -86,18 +89,28 @@ class JITImpl:
         key = self._key(args, kwargs)
         k = self._kernels.get(key)
         if k is None:
-            pf = self.fn(*args, **kwargs)
-            if isinstance(pf, JITKernel):
-                k = pf
-            elif isinstance(pf, PrimFuncObj):
-                k = compile(pf, out_idx=self.out_idx, target=self.target,
-                            verbose=self.verbose,
-                            pass_configs=self.pass_configs)
-            else:
-                raise TypeError(
-                    f"@tilelang.jit factory must return a @T.prim_func, got "
-                    f"{type(pf)}")
+            # hit AND miss gated together on tracing: counting misses
+            # alone would read as a 0% hit rate in untraced runs, and
+            # the hit side is the per-dispatch hot path that must not
+            # touch the tracer's lock when tracing is off
+            if _trace.trace_enabled():
+                _trace.inc("jit.callsite.miss")
+            with _trace.span("jit.callsite_compile", "jit",
+                             factory=getattr(self.fn, "__name__", "?")):
+                pf = self.fn(*args, **kwargs)
+                if isinstance(pf, JITKernel):
+                    k = pf
+                elif isinstance(pf, PrimFuncObj):
+                    k = compile(pf, out_idx=self.out_idx, target=self.target,
+                                verbose=self.verbose,
+                                pass_configs=self.pass_configs)
+                else:
+                    raise TypeError(
+                        f"@tilelang.jit factory must return a @T.prim_func, "
+                        f"got {type(pf)}")
             self._kernels[key] = k
+        elif _trace.trace_enabled():
+            _trace.inc("jit.callsite.hit")
         return k
 
 
@@ -227,6 +240,16 @@ class LazyJITImpl:
             b = self.dynamic_bucket
             binding = {k: (var, -(-val // b) * b)
                        for k, (var, val) in binding.items()}
+            if _trace.trace_enabled():   # dispatch hot path: build the
+                # dims payload only when it will be recorded. A list
+                # keyed by (name, uid), not a name-keyed dict: two dyn
+                # Vars sharing a name must not collapse to one entry
+                # (the same collision shape_key below avoids via uid)
+                _trace.event(
+                    "jit.lazy_bucket", "jit", bucket=b,
+                    dims=[{"dim": var.name, "uid": var.uid,
+                           "true": true_vals[k], "padded": val}
+                          for k, (var, val) in binding.items()])
         env_map = {k: v for k, (_, v) in binding.items()}
         # Key by the Var's unique uid, not its name: two distinct dyn vars
         # sharing a name would otherwise collide after sorting and silently
@@ -234,6 +257,12 @@ class LazyJITImpl:
         shape_key = tuple(sorted((v.uid, val)
                                  for v, val in binding.values()))
         kernel = self._kernels.get(shape_key)
+        if _trace.trace_enabled():
+            # hit/miss gated TOGETHER (a miss-only count reads as a 0%
+            # hit rate untraced), and the hit side is the dispatch hot
+            # path that must not take the tracer lock when tracing is off
+            _trace.inc("jit.lazy.hit" if kernel is not None else
+                       "jit.lazy.miss")
         if kernel is None:
             # re-trace with concrete shapes substituted into annotations
             concrete = []
@@ -248,7 +277,11 @@ class LazyJITImpl:
             # lazy_jit specializations so a concurrent trace (par_compile
             # runs a ThreadPoolExecutor in this module) can never fold
             # against another call-site's shape
-            with _LAZY_BIND_LOCK:
+            with _trace.span("jit.lazy_specialize", "jit",
+                             factory=getattr(fn, "__name__", "?"),
+                             shapes={v.name: val
+                                     for v, val in binding.values()}), \
+                    _LAZY_BIND_LOCK:
                 orig = dict(fn.__annotations__)
                 try:
                     for n, a in zip(names, concrete):

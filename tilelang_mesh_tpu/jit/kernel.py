@@ -14,6 +14,7 @@ import functools
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..engine.param import CompiledArtifact
+from ..observability import tracer as _trace
 from ..utils.target import target_is_interpret, target_is_mesh
 from ..utils.tensor import TensorSupplyType, copy_back, to_jax
 
@@ -32,10 +33,12 @@ class JITKernel:
         art = self.artifact
         modname = f"<tl_tpu:{art.name}>"
         ns: dict = {}
-        code = compile(art.kernel_source, modname, "exec")
-        exec(code, ns)
-        interpret = target_is_interpret(art.target)
-        self._raw_call: Callable = ns["build"](interpret=interpret)
+        with _trace.span("jit.exec_source", "jit", kernel=art.name,
+                         source_bytes=len(art.kernel_source)):
+            code = compile(art.kernel_source, modname, "exec")
+            exec(code, ns)
+            interpret = target_is_interpret(art.target)
+            self._raw_call: Callable = ns["build"](interpret=interpret)
         import jax
         self.func = jax.jit(self._raw_call)
         self._in_params = art.in_params

@@ -16,7 +16,9 @@ from __future__ import annotations
 from typing import Iterable, Literal, Optional, Tuple
 
 from ..ir import (Buffer, CommAllGather, CommAllReduce, CommBarrier,
-                  CommBroadcast, CommFence, CommPut, Region, to_region, Call)
+                  CommBroadcast, CommFence, CommPut, Region, to_region, Call,
+                  dtype_bits)
+from ..observability import tracer as _trace
 from ..parallel.device_mesh import (get_device_mesh_config, core_tuple_to_id,
                                     core_id_to_tuple)
 from .builder import require_builder
@@ -72,6 +74,23 @@ def _check_core(core: Tuple[int, int], what: str):
         f"{what} col {core[1]} out of bounds for mesh shape {mesh}."
 
 
+def _record_emit(op: str, payload_buf: Optional[Buffer],
+                 direction: Optional[str] = None):
+    """Trace-time accounting of a T.comm.* emission: op kind, direction
+    and the payload buffer's bytes. The *wire* cost (hops x chunk) is
+    accounted where the schedule is known, in parallel/lowering.py; this
+    records what the DSL asked for, so untraced-at-lowering programs
+    (e.g. plain golden traces) still show up in metrics_summary()."""
+    nbytes = 0
+    if payload_buf is not None:
+        n = payload_buf.numel()
+        if n is not None:
+            nbytes = n * dtype_bits(payload_buf.dtype) // 8
+    _trace.inc("comm.emitted", op=op)
+    _trace.event("comm.emit", "comm", op=op, direction=direction,
+                 payload_bytes=nbytes)
+
+
 def _check_size(size: int, buf: Buffer, what: str = "size"):
     n = buf.numel()
     assert isinstance(size, int) and size >= -1, \
@@ -92,6 +111,7 @@ def broadcast(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
     _check_size(size, src)
     assert direction.lower() in DIRECTION_MAP, \
         f"Invalid direction string: {direction}"
+    _record_emit("broadcast", src, direction.lower())
     b.emit(CommBroadcast(to_region(src), to_region(dst), size, 0,
                          core_tuple_to_id(src_core),
                          DIRECTION_MAP[direction.lower()]))
@@ -105,6 +125,7 @@ def put(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
     _check_core(src_core, "src_core")
     _check_core(dst_core, "dst_core")
     _check_size(size, src)
+    _record_emit("put", src)
     b.emit(CommPut(to_region(src), to_region(dst), size,
                    core_tuple_to_id(src_core), core_tuple_to_id(dst_core)))
 
@@ -135,6 +156,7 @@ def all_gather(send_buffer: Buffer, recv_buffer: Buffer,
         f"Receive buffer shape must be {expected} to hold gathered data from "
         f"{recv_num} cores, but got {got}.")
     _check_size(size, send_buffer)
+    _record_emit("all_gather", send_buffer, d)
     b.emit(CommAllGather(to_region(send_buffer), to_region(recv_buffer),
                          DIRECTION_MAP[d], size))
 
@@ -170,6 +192,7 @@ def all_reduce(buffer: Buffer, out: Buffer, reduce_type: str,
     assert direction.lower() in DIRECTION_MAP, \
         f"Invalid direction string: {direction}"
     assert clear in (True, False), "clear must be a boolean value."
+    _record_emit("all_reduce", out, direction.lower())
     b.emit(CommAllReduce(to_region(buffer), to_region(out), reduce_type,
                          DIRECTION_MAP[direction.lower()], dim, clear))
 
@@ -178,10 +201,12 @@ def barrier(group: Optional[Iterable[Tuple[int, int]]] = None):
     """Synchronize a group of cores (all cores when group is None)."""
     b = require_builder()
     ids = None if group is None else [core_tuple_to_id(c) for c in group]
+    _record_emit("barrier", None)
     b.emit(CommBarrier(ids))
 
 
 def fence():
     """Order communication against subsequent memory operations."""
     b = require_builder()
+    _record_emit("fence", None)
     b.emit(CommFence())

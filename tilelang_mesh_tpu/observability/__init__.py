@@ -1,0 +1,29 @@
+"""Structured tracing + metrics for the whole compile pipeline.
+
+Turn on with ``TL_TPU_TRACE=1``; see ``docs/observability.md``. The
+subsystem has three pieces:
+
+- ``tracer``  — span/event/counter recorder (thread-local nesting,
+  monotonic clock, no-op when disabled; depends only on ``env.py``)
+- ``export``  — Chrome-trace/Perfetto JSON, Prometheus text snapshot,
+  append-only JSONL, and ``metrics_summary()``
+- instrumentation hooks threaded through ``engine/lower.py`` (one span
+  per lowering phase), ``jit/`` (compile latency, factory/lazy cache
+  counters, bucket events), ``cache/kernel_cache.py`` (tier hit/miss +
+  artifact sizes), ``autotuner/`` (per-config trial spans),
+  ``parallel/lowering.py`` + ``language/comm.py`` (static collective
+  accounting: op kind, axis, bytes per lowered kernel)
+"""
+
+from .tracer import (Span, Tracer, event, get_tracer, inc, reset, span,
+                     trace_enabled)
+from .export import (LOWER_PHASES, aggregate_spans, metrics_summary,
+                     read_jsonl, to_chrome_trace, to_jsonl,
+                     to_prometheus_text, write_chrome_trace, write_jsonl)
+
+__all__ = [
+    "Span", "Tracer", "get_tracer", "span", "event", "inc", "reset",
+    "trace_enabled", "LOWER_PHASES", "aggregate_spans", "metrics_summary",
+    "to_chrome_trace", "write_chrome_trace", "to_jsonl", "write_jsonl",
+    "read_jsonl", "to_prometheus_text",
+]

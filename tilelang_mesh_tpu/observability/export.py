@@ -1,0 +1,217 @@
+"""Exporters for the tracer's recorded state.
+
+Three formats, each for a different consumer:
+
+- **Chrome trace / Perfetto JSON** (``to_chrome_trace`` /
+  ``write_chrome_trace``): load the file in https://ui.perfetto.dev or
+  ``chrome://tracing`` to see the compile pipeline as nested lanes per
+  thread. Spans emit as complete ("X") events, instants as "i", and the
+  final counter values as "C" samples.
+- **Prometheus text snapshot** (``to_prometheus_text``): counters plus
+  per-span-name duration sums/counts in the exposition format, for
+  scraping or diffing between runs.
+- **Append-only JSONL** (``to_jsonl`` / ``write_jsonl`` /
+  ``read_jsonl``): one self-describing JSON object per line — the format
+  ``tools/analyzer.py --trace`` and the benchmark artifacts consume.
+
+``metrics_summary()`` condenses the same state into one dict: counters,
+per-span aggregates, cache tier hit rates, and collective byte totals —
+what ``bench.py`` embeds into every BENCH_r* record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from .tracer import Tracer, get_tracer
+
+__all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
+           "write_chrome_trace", "to_jsonl", "write_jsonl", "read_jsonl",
+           "to_prometheus_text", "metrics_summary"]
+
+# the engine/lower.py pipeline span names, in pipeline order — the ONE
+# copy every consumer (analyzer --trace, bench.py embedding, tests)
+# keys its per-phase breakdown on
+LOWER_PHASES = ("canonicalize", "checks", "plan", "codegen", "artifact")
+
+
+def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
+    """The recorded spans/events/counters as a Chrome-trace JSON object
+    (``json.dumps``-able, loads in Perfetto)."""
+    t = tracer or get_tracer()
+    pid = os.getpid()
+    out: List[dict] = []
+    last_ts = 0.0
+    for ev in t.events():
+        last_ts = max(last_ts, ev["ts_us"])
+        if ev["type"] == "span":
+            out.append({"name": ev["name"], "cat": ev["cat"], "ph": "X",
+                        "ts": ev["ts_us"], "dur": ev["dur_us"],
+                        "pid": pid, "tid": ev["tid"],
+                        "args": _json_safe(ev["attrs"])})
+        else:
+            out.append({"name": ev["name"], "cat": ev["cat"], "ph": "i",
+                        "ts": ev["ts_us"], "pid": pid, "tid": ev["tid"],
+                        "s": "t", "args": _json_safe(ev["attrs"])})
+    for name, value in sorted(t.counters().items()):
+        out.append({"name": name, "cat": "counter", "ph": "C",
+                    "ts": last_ts, "pid": pid, "tid": 0,
+                    "args": {"value": value}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, tracer: Optional[Tracer] = None) -> Path:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(to_chrome_trace(tracer)))
+    return p
+
+
+def to_jsonl(tracer: Optional[Tracer] = None) -> str:
+    """One JSON object per line: every span/event in record order, then
+    one ``{"type": "counter"}`` line per counter."""
+    t = tracer or get_tracer()
+    lines = [json.dumps(_json_safe(ev)) for ev in t.events()]
+    lines += [json.dumps({"type": "counter", "name": name, "value": value})
+              for name, value in sorted(t.counters().items())]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_jsonl(path, tracer: Optional[Tracer] = None) -> Path:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(to_jsonl(tracer))
+    return p
+
+
+def read_jsonl(path) -> List[dict]:
+    """Parse a JSONL trace back into records (blank lines skipped)."""
+    out = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if line:
+            out.append(json.loads(line))
+    return out
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_BAD.sub("_", name)
+
+
+def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
+    """Counters and per-span-name duration aggregates in the Prometheus
+    exposition format, prefixed ``tl_tpu_``."""
+    t = tracer or get_tracer()
+    lines: List[str] = []
+    # ONE TYPE line per metric name (the exposition format rejects
+    # duplicates), then every labelled series under it
+    by_name: Dict[str, list] = {}
+    for (name, labels), value in sorted(t.counters_raw().items()):
+        by_name.setdefault(name, []).append((labels, value))
+    for name, series in by_name.items():
+        mname = f"tl_tpu_{_prom_name(name)}"
+        lines.append(f"# TYPE {mname} counter")
+        for labels, value in series:
+            if labels:
+                lab = ",".join(f'{_prom_name(k)}="{v}"' for k, v in labels)
+                lines.append(f"{mname}{{{lab}}} {value:g}")
+            else:
+                lines.append(f"{mname} {value:g}")
+    agg: Dict[str, List[float]] = {}
+    for ev in t.events():
+        if ev["type"] == "span":
+            agg.setdefault(ev["name"], []).append(ev["dur_us"])
+    for name in sorted(agg):
+        durs = agg[name]
+        mname = f"tl_tpu_span_{_prom_name(name)}"
+        lines.append(f"# TYPE {mname}_seconds summary")
+        lines.append(f"{mname}_seconds_count {len(durs)}")
+        lines.append(f"{mname}_seconds_sum {sum(durs) / 1e6:.9g}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _rate(hit: float, miss: float) -> Optional[float]:
+    total = hit + miss
+    return round(hit / total, 4) if total else None
+
+
+def aggregate_spans(records) -> Dict[str, dict]:
+    """name -> {count, total_ms, max_ms} over span-shaped records (live
+    tracer events or parsed JSONL lines) — the ONE aggregation both
+    ``metrics_summary`` and the analyzer's trace report use, so the two
+    consumers can never disagree about the same trace."""
+    out: Dict[str, dict] = {}
+    for ev in records:
+        if ev.get("type") != "span":
+            continue
+        rec = out.setdefault(ev["name"],
+                             {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        ms = ev.get("dur_us", 0) / 1e3
+        rec["count"] += 1
+        rec["total_ms"] = round(rec["total_ms"] + ms, 6)
+        rec["max_ms"] = round(max(rec["max_ms"], ms), 6)
+    return out
+
+
+def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
+    """One condensed dict of everything the tracer knows:
+
+    - ``counters``: every counter, flat name -> value
+    - ``spans``: per span name -> {count, total_ms, max_ms}
+    - ``cache``: per-tier hit/miss totals and hit rates (memory / disk)
+      plus build count — populated from counters, so available even with
+      tracing disabled
+    - ``collectives``: static accounting totals (ops, bytes) from the
+      mesh lowering
+    """
+    t = tracer or get_tracer()
+    counters = t.counters()
+    spans = aggregate_spans(t.events())
+
+    def c(name: str) -> float:
+        return counters.get(name, 0)
+
+    cache = {
+        "memory_hits": c("cache.memory.hit"),
+        "memory_misses": c("cache.memory.miss"),
+        "disk_hits": c("cache.disk.hit"),
+        "disk_misses": c("cache.disk.miss"),
+        "builds": c("cache.build"),
+        "artifact_bytes_written": c("cache.artifact_bytes_written"),
+        "artifact_bytes_read": c("cache.artifact_bytes_read"),
+    }
+    cache["memory_hit_rate"] = _rate(cache["memory_hits"],
+                                     cache["memory_misses"])
+    cache["disk_hit_rate"] = _rate(cache["disk_hits"], cache["disk_misses"])
+    collectives = {
+        "ops": sum(v for k, v in counters.items()
+                   if k.startswith("comm.ops{")
+                   or k == "comm.ops"),
+        "bytes": sum(v for k, v in counters.items()
+                     if k.startswith("comm.bytes{")
+                     or k == "comm.bytes"),
+    }
+    return {"counters": counters, "spans": spans, "cache": cache,
+            "collectives": collectives}
+
+
+def _json_safe(obj: Any):
+    """Coerce attrs to JSON-serializable values (repr fallback) so an
+    exotic attr can never make a trace file unwritable."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (str, int, bool)) or obj is None:
+        return obj
+    if isinstance(obj, float):
+        return obj if obj == obj and obj not in (float("inf"),
+                                                 float("-inf")) else repr(obj)
+    return repr(obj)

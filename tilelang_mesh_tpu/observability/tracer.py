@@ -1,0 +1,243 @@
+"""Span/event recorder: the core of the observability subsystem.
+
+Design (round-5 VERDICT: "the blocking problem is evidence, not code"):
+every layer of the compile pipeline (L6 engine -> L2 language) records
+*spans* (named, nested, monotonic-clocked intervals), *events* (instant
+markers: cache hits, collective accounting, bucket decisions) and
+*counters* (monotonic totals: cache tier hit/miss, bytes moved). The
+recorder is deliberately import-cycle-free — its ONLY intra-package
+dependency is ``env.py`` — so engine/, jit/, cache/, autotuner/,
+parallel/ and language/ can all use it without layering violations.
+
+Cost model:
+
+- **Disabled** (default, ``TL_TPU_TRACE`` unset): ``span()`` returns a
+  shared no-op context manager and ``event()`` returns immediately after
+  one cached env check — no allocation, no lock, no clock read. The
+  tier-1 acceptance bound is < 3% wall-time regression with tracing off.
+- **Counters at compile/cache/lowering boundaries are always on** (they
+  never run inside a kernel's ``__call__`` hot path), so
+  ``metrics_summary()`` reports cache tier hit rates even in untraced
+  production runs. The jit callsite/lazy hit+miss counters are the one
+  exception: they sit on the kernel *dispatch* path, so both sides are
+  gated together on tracing — gating only the hot hit side would read
+  as a false 0% hit rate.
+
+Spans nest per-thread (a thread-local stack provides parent/depth), so
+``par_compile``'s thread pool produces well-formed per-thread lanes in
+the Chrome trace instead of interleaved garbage.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..env import env
+
+__all__ = ["Span", "Tracer", "get_tracer", "span", "event", "inc",
+           "reset", "trace_enabled"]
+
+
+def trace_enabled() -> bool:
+    """One env read — the single gate every recording path checks."""
+    return bool(env.TL_TPU_TRACE)
+
+
+class _NullSpan:
+    """Shared no-op returned when tracing is disabled: zero allocation
+    per call site, ``set()`` accepted and dropped."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live (then finished) interval. Use as a context manager via
+    ``tracer.span(...)``; add attributes mid-flight with ``set()``."""
+
+    __slots__ = ("tracer", "name", "cat", "attrs", "ts_ns", "dur_ns",
+                 "tid", "depth", "epoch")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 attrs: Dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.ts_ns = 0
+        self.dur_ns = 0
+        self.tid = 0
+        self.depth = 0
+        self.epoch = 0
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        t = self.tracer
+        stack = t._stack()
+        self.depth = len(stack)
+        self.tid = threading.get_ident()
+        self.epoch = t._epoch
+        stack.append(self)
+        self.ts_ns = time.monotonic_ns() - t._t0_ns
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.dur_ns = max(0, time.monotonic_ns() - self.tracer._t0_ns
+                          - self.ts_ns)
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            # a failed run must be attributable to its span: record the
+            # error on the span itself, then let it propagate
+            self.attrs["error"] = f"{exc_type.__name__}: {exc_val}"
+        self.tracer._record({
+            "type": "span", "name": self.name, "cat": self.cat,
+            "ts_us": self.ts_ns / 1e3, "dur_us": self.dur_ns / 1e3,
+            "tid": self.tid, "depth": self.depth, "attrs": self.attrs,
+        }, epoch=self.epoch)
+        return False
+
+
+class Tracer:
+    """Process-wide recorder: bounded event list + counter map.
+
+    Thread-safe: events append under a lock; the live-span stack is
+    thread-local. The event list is bounded by ``TL_TPU_TRACE_MAX_EVENTS``
+    — overflow drops the newest event and counts it in the
+    ``trace.dropped_events`` counter instead of growing without bound in
+    a long serving process.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                             float] = {}
+        self._tls = threading.local()
+        self._t0_ns = time.monotonic_ns()
+        # bumped by reset(): a span that straddles a reset (e.g. on an
+        # abandoned watchdog thread) carries the OLD epoch and is
+        # dropped on record instead of landing, with a clock origin it
+        # predates, in the next consumer's event list
+        self._epoch = 0
+
+    # -- recording -----------------------------------------------------------
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def _record(self, ev: dict, epoch: Optional[int] = None) -> None:
+        cap = env.TL_TPU_TRACE_MAX_EVENTS
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return   # span from before a reset(): stale, drop
+            if len(self._events) >= cap:
+                self.inc("trace.dropped_events", _locked=True)
+                return
+            self._events.append(ev)
+
+    def span(self, name: str, cat: str = "compile", **attrs):
+        """A nested timed interval; no-op (shared instance) when tracing
+        is disabled."""
+        if not trace_enabled():
+            return _NULL_SPAN
+        return Span(self, name, cat, attrs)
+
+    def event(self, name: str, cat: str = "compile", **attrs) -> None:
+        """An instant marker (Chrome-trace 'i' phase); dropped when
+        tracing is disabled."""
+        if not trace_enabled():
+            return
+        self._record({
+            "type": "event", "name": name, "cat": cat,
+            "ts_us": (time.monotonic_ns() - self._t0_ns) / 1e3,
+            "tid": threading.get_ident(), "attrs": attrs,
+        })
+
+    def inc(self, name: str, value: float = 1, _locked: bool = False,
+            **labels) -> None:
+        """Increment a monotonic counter. ALWAYS on (cheap, never in a
+        kernel-call hot path) so hit rates survive untraced runs."""
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        if _locked:     # already under self._lock (overflow accounting)
+            self._counters[key] = self._counters.get(key, 0) + value
+            return
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    # -- snapshots -----------------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def counters(self) -> Dict[str, float]:
+        """Flat name -> value map; labelled counters render as
+        ``name{k=v,...}``."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for (name, labels), v in self._counters.items():
+                if labels:
+                    name = (name + "{"
+                            + ",".join(f"{k}={val}" for k, val in labels)
+                            + "}")
+                out[name] = v
+            return out
+
+    def counters_raw(self) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                                   float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        """Drop every recorded event and counter (tests, bench children).
+        Spans still open across the reset are invalidated: their epoch
+        no longer matches, so their eventual close records nothing."""
+        with self._lock:
+            self._events.clear()
+            self._counters.clear()
+            self._epoch += 1
+            self._t0_ns = time.monotonic_ns()
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+# module-level conveniences bound to the process tracer -- the form the
+# instrumentation sites use: ``from ..observability.tracer import span``
+def span(name: str, cat: str = "compile", **attrs):
+    return _TRACER.span(name, cat, **attrs)
+
+
+def event(name: str, cat: str = "compile", **attrs) -> None:
+    _TRACER.event(name, cat, **attrs)
+
+
+def inc(name: str, value: float = 1, **labels) -> None:
+    _TRACER.inc(name, value, **labels)
+
+
+def reset() -> None:
+    _TRACER.reset()

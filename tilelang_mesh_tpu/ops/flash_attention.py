@@ -34,10 +34,18 @@ def _prescale_q(Q_s, scale, block_M, D, dtype):
     VPU ops) instead of into every score element (block_M * block_N per
     KV block): the scores leave the GEMM already in the exp2 domain, so
     fully-live blocks need NO elementwise pass at all. Returns the
-    fragment used as the score GEMM's LHS."""
+    fragment used as the score GEMM's LHS.
+
+    Precision: the product is computed in an f32 intermediate and cast
+    to ``dtype`` ONCE, so a sub-f32 dtype pays exactly one rounding of
+    scaled-Q per element (ADVICE r5). The residual bf16 tradeoff vs the
+    old post-GEMM f32 scaling — Q itself is rounded before the score
+    GEMM, and per-element rounding of Q does not cancel in softmax — is
+    bounded by the same half-ULP as the bf16 GEMM inputs and sits well
+    inside the kernels' existing 3e-2 relative tolerance."""
     Q_f = T.alloc_fragment((block_M, D), dtype)
     for i, j in T.Parallel(block_M, D):
-        Q_f[i, j] = Q_s[i, j] * scale
+        Q_f[i, j] = T.cast(T.cast(Q_s[i, j], "float32") * scale, dtype)
     return Q_f
 
 

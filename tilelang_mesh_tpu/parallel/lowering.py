@@ -27,10 +27,13 @@ from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
                   CommBarrier, CommBroadcast, CommFence, CommPut, CommStmt,
                   CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
                   collect, walk)
+from ..observability import tracer as _trace
 from ..transform.plan import plan_kernel
 from .device_mesh import core_id_to_tuple, make_jax_mesh
 
 _DIRNAMES = {0: "h", 1: "v", 2: "all"}
+# the mesh axis each direction lowers onto in _apply_comm
+_DIR_AXES = {0: "y", 1: "x", 2: "x,y"}
 
 
 class MeshLowerError(Exception):
@@ -113,7 +116,8 @@ def _comm_buffers(c: CommStmt) -> Tuple[List[Region], List[Region]]:
 def lower_mesh(func: PrimFunc, target: str,
                mesh_cfg: Optional[Tuple[int, int]],
                pass_cfg: dict) -> CompiledArtifact:
-    run_semantic_checks(func)
+    with _trace.span("checks", "lower", kernel=func.name, mesh=True):
+        run_semantic_checks(func)
     kn = func.kernel_node()
     if mesh_cfg is None:
         mesh_cfg = func.attrs.get("mesh_config")
@@ -179,12 +183,16 @@ def lower_mesh(func: PrimFunc, target: str,
     global_params = list(func.buffer_params)
     gp_uids = {b.uid for b in global_params}
 
+    collective_recs: List[dict] = []
     for i, (kind, payload) in enumerate(segments):
         if kind == "comm":
             schedule_lines.append(f"  [{i}] collective "
                                   f"{_comm_desc(payload, nrow, ncol)}")
             schedule_lines.extend(_comm_schedule_lines(payload, nrow, ncol))
             compiled_segments.append({"kind": "comm", "op": payload})
+            rec = _account_collective(func.name, payload, nrow, ncol, i)
+            if rec is not None:
+                collective_recs.append(rec)
             continue
         reads, writes = seg_rw[i]
         frag_ins = [alloc_bufs[u] for u in sorted(alloc_bufs)
@@ -193,8 +201,11 @@ def lower_mesh(func: PrimFunc, target: str,
                      if live_out(i, u)]
         seg_func, in_bufs, out_bufs = _make_segment_func(
             func, kn, allocs, payload, frag_ins, frag_outs, i)
-        plan = plan_kernel(seg_func, pass_cfg)
-        src = generate_source(plan, pass_cfg)
+        with _trace.span("plan", "lower", kernel=seg_func.name, mesh=True):
+            plan = plan_kernel(seg_func, pass_cfg)
+        with _trace.span("codegen", "lower", kernel=seg_func.name,
+                         mesh=True):
+            src = generate_source(plan, pass_cfg)
         seg_params = [(p.buffer, p.role) for p in plan.params]
         compiled_segments.append({
             "kind": "compute",
@@ -253,8 +264,39 @@ def lower_mesh(func: PrimFunc, target: str,
         plan_desc=plan_desc, mesh_config=(nrow, ncol),
         attrs={"is_mesh": True, "no_disk_cache": True,
                "_segments": compiled_segments,
-               "_global_params": global_params})
+               "_global_params": global_params,
+               # static collective accounting (JSON-safe): what this
+               # program moves over ICI, per lowered kernel
+               "collectives": collective_recs})
     return art
+
+
+def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
+                        seg_idx: int) -> Optional[dict]:
+    """Static accounting for one lowered collective: op kind, the mesh
+    axis it runs over, and the wire bytes its NoC schedule moves
+    (hops x per-hop payload from comm_cost). Recorded as a tracer event
+    + counters AND returned as a JSON-safe record for the artifact, so
+    a compiled mesh program is self-documenting about its ICI traffic.
+    Barriers/fences (payload-free) return None."""
+    kind = type(c).__name__.replace("Comm", "").lower()
+    hops, payload = comm_cost(c, nrow, ncol)
+    if payload == 0:
+        return None
+    direction = getattr(c, "direction", 2)
+    rec = {"kernel": kernel, "segment": seg_idx, "op": kind,
+           "axis": _DIR_AXES.get(direction, "x,y"),
+           "dir": _DIRNAMES.get(direction, "all"),
+           "payload_bytes": payload, "hops": hops,
+           # exact hops x per-hop payload: a zero-hop collective (e.g.
+           # put onto the same core) moves nothing over the wire
+           "wire_bytes": payload * hops}
+    if isinstance(c, CommAllReduce):
+        rec["reduce_type"] = c.reduce_type
+    _trace.event("comm.collective", "comm", **rec)
+    _trace.inc("comm.ops", op=kind)
+    _trace.inc("comm.bytes", rec["wire_bytes"], op=kind)
+    return rec
 
 
 def _make_segment_func(func: PrimFunc, kn: KernelNode, allocs, stmts,

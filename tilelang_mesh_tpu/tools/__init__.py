@@ -1,1 +1,2 @@
-from .analyzer import Analyzer, AnalysisResult
+from .analyzer import (Analyzer, AnalysisResult, format_trace_report,
+                       summarize_trace)

@@ -3,6 +3,14 @@
 Reference: /root/reference/tilelang/tools/Analyzer.py:33 — walks the IR
 counting T.copy bytes and T.gemm FLOPs against the carver arch model to
 predict latency. Same roofline approach against the TPU arch model.
+
+Also a CLI for the observability subsystem's JSONL traces::
+
+    python -m tilelang_mesh_tpu.tools.analyzer --trace trace.jsonl
+
+prints the per-phase compile-time breakdown, cache tier statistics, and
+collective accounting recorded in a ``TL_TPU_TRACE=1`` run (see
+docs/observability.md).
 """
 
 from __future__ import annotations
@@ -13,6 +21,7 @@ from typing import Optional
 from ..carver.arch import TPUArch, auto_arch
 from ..ir import (CopyStmt, GemmStmt, PrimFunc, ReduceStmt, dtype_bits, walk,
                   as_int)
+from ..observability import LOWER_PHASES
 
 
 @dataclass
@@ -172,3 +181,102 @@ class Analyzer:
             compute_ms=compute_ms, comm_ms=comm_ms,
             expected_latency_ms=total, n_collectives=n_comm,
             bound="comm" if comm_ms > compute_ms else "compute")
+
+
+# ---------------------------------------------------------------------------
+# trace analysis (observability JSONL)
+# ---------------------------------------------------------------------------
+
+# the engine/lower.py pipeline order; phases found in the trace but not
+# listed here (mesh segment spans etc.) print after these
+_PHASE_ORDER = LOWER_PHASES
+
+
+def summarize_trace(records) -> dict:
+    """Aggregate JSONL trace records (observability.read_jsonl) into
+    {phases, spans, counters, collectives}: per-phase total/mean/max ms
+    for the lowering phases, plus everything else worth printing."""
+    from ..observability import aggregate_spans
+    phase_recs, other_recs = [], []
+    collectives = []
+    counters: dict = {}
+    for r in records:
+        t = r.get("type")
+        if t == "counter":
+            counters[r["name"]] = r["value"]
+        elif t == "event" and r.get("name") == "comm.collective":
+            collectives.append(r.get("attrs", {}))
+        elif t == "span":
+            if r.get("cat") == "lower" and r["name"] != "lower":
+                phase_recs.append(r)
+            else:
+                other_recs.append(r)
+    return {"phases": aggregate_spans(phase_recs),
+            "spans": aggregate_spans(other_recs),
+            "counters": counters, "collectives": collectives}
+
+
+def format_trace_report(records) -> str:
+    """Human-readable per-phase compile-time breakdown of a JSONL trace."""
+    s = summarize_trace(records)
+    lines = []
+    phases = s["phases"]
+    if phases:
+        total = sum(p["total_ms"] for p in phases.values())
+        lines.append("compile-time breakdown by lowering phase:")
+        lines.append(f"  {'phase':<14} {'count':>5} {'total_ms':>10} "
+                     f"{'mean_ms':>9} {'max_ms':>9} {'share':>6}")
+        ordered = [p for p in _PHASE_ORDER if p in phases] + \
+            sorted(set(phases) - set(_PHASE_ORDER))
+        for name in ordered:
+            p = phases[name]
+            share = p["total_ms"] / total if total else 0.0
+            lines.append(
+                f"  {name:<14} {p['count']:>5} {p['total_ms']:>10.3f} "
+                f"{p['total_ms'] / p['count']:>9.3f} {p['max_ms']:>9.3f} "
+                f"{share:>6.1%}")
+    else:
+        lines.append("no lowering-phase spans in this trace "
+                     "(was TL_TPU_TRACE=1 set?)")
+    other = s["spans"]
+    if other:
+        lines.append("other spans:")
+        for name in sorted(other, key=lambda n: -other[n]["total_ms"]):
+            p = other[name]
+            lines.append(f"  {name:<24} count={p['count']} "
+                         f"total={p['total_ms']:.3f}ms "
+                         f"max={p['max_ms']:.3f}ms")
+    cache = {k: v for k, v in s["counters"].items()
+             if k.startswith("cache.")}
+    if cache:
+        lines.append("cache counters:")
+        for k in sorted(cache):
+            lines.append(f"  {k:<32} {cache[k]:g}")
+    if s["collectives"]:
+        lines.append("collectives (static accounting):")
+        for c in s["collectives"]:
+            lines.append(
+                f"  {c.get('kernel', '?')}[{c.get('segment', '?')}] "
+                f"{c.get('op', '?'):<11} axis={c.get('axis', '?'):<4} "
+                f"payload={c.get('payload_bytes', 0)}B "
+                f"hops={c.get('hops', 0)} wire={c.get('wire_bytes', 0)}B")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.tools.analyzer",
+        description="Analyze an observability JSONL trace "
+                    "(TL_TPU_TRACE=1 run).")
+    ap.add_argument("--trace", required=True, metavar="FILE",
+                    help="JSONL trace file (observability.write_jsonl / "
+                         "a bench.py artifact)")
+    args = ap.parse_args(argv)
+    from ..observability import read_jsonl
+    print(format_trace_report(read_jsonl(args.trace)))  # noqa: T201 — CLI
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
