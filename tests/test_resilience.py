@@ -1,0 +1,756 @@
+"""Resilience-subsystem chaos suite (docs/robustness.md).
+
+Everything here is DETERMINISTIC: every fault clause is seeded, so the
+suite is tier-1-safe. The ``chaos`` marker tags the end-to-end sweep that
+arms several sites at once — still seeded, but the heaviest test in the
+file.
+"""
+
+import concurrent.futures
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.cache.kernel_cache import (
+    ARTIFACT_FILE, KERNEL_SOURCE_FILE, QUARANTINE_DIR, KernelCache, _CACHE)
+from tilelang_mesh_tpu.env import env
+from tilelang_mesh_tpu.observability import get_tracer
+from tilelang_mesh_tpu.resilience import (
+    CircuitBreaker, DeterministicError, FaultSpec, InjectedFault,
+    RetryPolicy, TLError, TLTimeoutError, TransientError, classify,
+    error_signature, inject, maybe_fail, parse_fault_spec, retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(tmp_path, monkeypatch):
+    """Fresh cache dirs + clean tracer per test: chaos must not leak."""
+    monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+    monkeypatch.setenv("TL_TPU_AUTOTUNE_CACHE_DIR", str(tmp_path / "tune"))
+    monkeypatch.setenv("TL_TPU_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("TL_TPU_RETRY_MAX_MS", "5")
+    monkeypatch.delenv("TL_TPU_FAULTS", raising=False)
+    _CACHE.clear()
+    get_tracer().reset()
+    yield
+    _CACHE.clear()
+    get_tracer().reset()
+
+
+_uniq = iter(range(10_000))
+
+
+def _scale_func(mult):
+    """A fresh prim_func per mult value (distinct cache keys)."""
+    M, N = 64, 128
+
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * mult
+            T.copy(s, B)
+    return scale
+
+
+def _run_scale(kernel, mult):
+    a = np.arange(64 * 128, dtype=np.float32).reshape(64, 128) / 100
+    np.testing.assert_allclose(np.asarray(kernel(a)), a * mult, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+class TestErrors:
+    def test_classify_taxonomy(self):
+        assert classify(TransientError("x")) == "transient"
+        assert classify(DeterministicError("x")) == "deterministic"
+        assert classify(TLTimeoutError("x")) == "timeout"
+        assert classify(OSError("disk")) == "transient"
+        assert classify(concurrent.futures.TimeoutError()) == "timeout"
+        assert classify(TypeError("bad")) == "deterministic"
+        assert classify(ValueError("bad")) == "deterministic"
+
+    def test_timeout_error_is_futures_timeout(self):
+        # pre-taxonomy callers catch concurrent.futures.TimeoutError
+        assert isinstance(TLTimeoutError("t"), concurrent.futures.TimeoutError)
+
+    def test_error_carries_site_and_phase(self):
+        e = TransientError("boom", site="autotune.trial", phase="lower.plan")
+        assert "autotune.trial" in str(e) and "lower.plan" in str(e)
+        assert isinstance(e, TLError)
+
+    def test_error_signature_buckets(self):
+        a = error_signature(ValueError("same message"))
+        b = error_signature(ValueError("same message"))
+        c = error_signature(TypeError("same message"))
+        assert a == b and a != c
+        long = error_signature(ValueError("x" * 500))
+        assert len(long) < 120
+
+
+# ---------------------------------------------------------------------------
+# fault spec grammar + injection
+# ---------------------------------------------------------------------------
+
+class TestFaultSpec:
+    def test_parse_full_grammar(self):
+        specs = parse_fault_spec(
+            "cache.disk.write:p=0.3:seed=7:kind=corrupt;"
+            "lower.*:kind=deterministic:times=2; autotune.trial")
+        assert len(specs) == 3
+        assert specs[0].p == 0.3 and specs[0].seed == 7
+        assert specs[0].kind == "corrupt"
+        assert specs[1].matches("lower.plan")
+        assert specs[1].matches("lower.codegen")
+        assert not specs[1].matches("jit.compile")
+        assert specs[1].times == 2
+        assert specs[2].p == 1.0 and specs[2].kind == "transient"
+
+    @pytest.mark.parametrize("bad", [
+        "site:p=2.0", "site:kind=nonsense", "site:frobnicate=1",
+        "site:p", ":p=0.5",
+    ])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+    def test_seeded_determinism(self):
+        fires1 = [FaultSpec("s", p=0.5, seed=42).should_fire()
+                  or False for _ in range(1)]
+        a = FaultSpec("s", p=0.5, seed=42)
+        b = FaultSpec("s", p=0.5, seed=42)
+        seq_a = [a.should_fire() for _ in range(50)]
+        seq_b = [b.should_fire() for _ in range(50)]
+        assert seq_a == seq_b
+        assert any(seq_a) and not all(seq_a)
+        assert fires1 is not None  # silence lint on the warmup draw
+
+    def test_times_limit(self):
+        s = FaultSpec("s", p=1.0, times=2)
+        assert [s.should_fire() for _ in range(5)] == \
+            [True, True, False, False, False]
+
+    def test_inject_scope_raises_and_counts(self):
+        with inject("autotune.trial", times=1) as spec:
+            with pytest.raises(InjectedFault):
+                maybe_fail("autotune.trial")
+            maybe_fail("autotune.trial")   # times exhausted
+        assert spec._fired == 1
+        maybe_fail("autotune.trial")       # scope closed: inert
+
+    def test_env_spec_arms_sites(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_FAULTS", "lower.plan:kind=deterministic")
+        with pytest.raises(DeterministicError):
+            maybe_fail("lower.plan")
+        maybe_fail("lower.codegen")        # unmatched site: inert
+
+    def test_faults_unset_means_zero_events(self, monkeypatch):
+        """The satellite contract: no TL_TPU_FAULTS, no injected events —
+        even with tracing on and a real compile underway."""
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        get_tracer().reset()
+        k = tilelang.compile(_scale_func(1.25))
+        _run_scale(k, 1.25)
+        evs = [e for e in get_tracer().events()
+               if e.get("name") == "fault.injected"]
+        assert evs == []
+        assert "fault.injected" not in " ".join(get_tracer().counters())
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff / circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def _policy(self):
+        return RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                           max_delay_s=0.0)
+
+    def test_transient_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientError("flaky")
+            return "ok"
+        assert retry_call(flaky, site="t", policy=self._policy()) == "ok"
+        assert len(calls) == 3
+
+    def test_transient_exhausts_attempts(self):
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise TransientError("never")
+        with pytest.raises(TransientError):
+            retry_call(always, site="t", policy=self._policy())
+        assert len(calls) == 3
+
+    def test_deterministic_never_retries(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise TypeError("broken kernel")
+        with pytest.raises(TypeError):
+            retry_call(broken, site="t", policy=self._policy())
+        assert len(calls) == 1
+
+    def test_timeout_retries_exactly_once(self):
+        calls = []
+
+        def wedged():
+            calls.append(1)
+            raise TLTimeoutError("wedged")
+        with pytest.raises(TLTimeoutError):
+            retry_call(wedged, site="t", policy=self._policy())
+        assert len(calls) == 2
+
+    def test_backoff_is_exponential_and_capped(self):
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.3,
+                        jitter=0.0)
+        assert p.delay_s(0) == pytest.approx(0.1)
+        assert p.delay_s(1) == pytest.approx(0.2)
+        assert p.delay_s(3) == pytest.approx(0.3)   # capped
+
+    def test_breaker_opens_at_threshold(self):
+        br = CircuitBreaker(threshold=3)
+        sig = "ValueError:bad tile"
+        assert br.record_failure(sig) is False
+        assert br.record_failure(sig) is False
+        assert not br.is_open(sig)
+        assert br.record_failure(sig) is True    # trip reported once
+        assert br.is_open(sig)
+        assert not br.is_open("other")
+        br.reset(sig)
+        assert not br.is_open(sig)
+
+    def test_open_breaker_suppresses_retries(self):
+        # the signature is already known-deterministic (breaker open):
+        # a transient wearing the same signature gets no retries
+        br = CircuitBreaker(threshold=1)
+        br.record_failure("TransientError:same failure")
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise TransientError("same failure")
+        with pytest.raises(TransientError):
+            retry_call(flaky, site="t", policy=self._policy(), breaker=br)
+        assert len(calls) == 1
+
+    def test_transients_do_not_feed_breaker(self):
+        # retry exists to absorb transients; they must never open the
+        # circuit, no matter how many identical ones occur
+        br = CircuitBreaker(threshold=2)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise TransientError("same failure")
+        with pytest.raises(TransientError):
+            retry_call(flaky, site="t", policy=self._policy(), breaker=br)
+        assert len(calls) == 3     # full retry budget used
+        assert not br.is_open("TransientError:same failure")
+
+    def test_deterministic_failures_feed_breaker(self):
+        br = CircuitBreaker(threshold=2)
+
+        def broken():
+            raise TypeError("bad tile")
+        for _ in range(2):
+            with pytest.raises(TypeError):
+                retry_call(broken, site="t", policy=self._policy(),
+                           breaker=br)
+        assert br.is_open("TypeError:bad tile")
+
+
+# ---------------------------------------------------------------------------
+# crash-safe cache
+# ---------------------------------------------------------------------------
+
+def _disk_entries():
+    return [p for p in env.cache_dir().iterdir()
+            if p.is_dir() and not p.name.startswith(".")]
+
+
+def _quarantined():
+    q = env.cache_dir() / QUARANTINE_DIR
+    return list(q.iterdir()) if q.exists() else []
+
+
+class TestCacheResilience:
+    def test_artifact_has_checksum_and_roundtrips(self):
+        k1 = tilelang.compile(_scale_func(2.5))
+        (entry,) = _disk_entries()
+        meta = json.loads((entry / ARTIFACT_FILE).read_text())
+        assert len(meta["source_sha256"]) == 64
+        _CACHE.clear()
+        k2 = tilelang.compile(_scale_func(2.5))
+        assert k2 is not k1
+        assert k2.get_kernel_source() == k1.get_kernel_source()
+        _run_scale(k2, 2.5)
+
+    def test_no_tmp_files_left_behind(self):
+        tilelang.compile(_scale_func(2.75))
+        (entry,) = _disk_entries()
+        assert not [p for p in entry.iterdir() if ".tmp." in p.name]
+
+    def test_corrupt_source_quarantined_and_rebuilt(self):
+        tilelang.compile(_scale_func(3.5))
+        (entry,) = _disk_entries()
+        (entry / KERNEL_SOURCE_FILE).write_text("truncated garb")
+        _CACHE.clear()
+        before = get_tracer().counters().get("cache.quarantined", 0)
+        k = tilelang.compile(_scale_func(3.5))
+        _run_scale(k, 3.5)
+        assert len(_quarantined()) == 1
+        assert get_tracer().counters()["cache.quarantined"] == before + 1
+        # the rebuilt entry is fresh and valid
+        _CACHE.clear()
+        _run_scale(tilelang.compile(_scale_func(3.5)), 3.5)
+
+    def test_truncated_meta_quarantined(self):
+        tilelang.compile(_scale_func(4.5))
+        (entry,) = _disk_entries()
+        meta_text = (entry / ARTIFACT_FILE).read_text()
+        (entry / ARTIFACT_FILE).write_text(meta_text[: len(meta_text) // 2])
+        _CACHE.clear()
+        _run_scale(tilelang.compile(_scale_func(4.5)), 4.5)
+        assert len(_quarantined()) == 1
+
+    def test_incomplete_entry_quarantined(self):
+        tilelang.compile(_scale_func(5.5))
+        (entry,) = _disk_entries()
+        (entry / ARTIFACT_FILE).unlink()   # torn write: no commit point
+        _CACHE.clear()
+        _run_scale(tilelang.compile(_scale_func(5.5)), 5.5)
+        assert len(_quarantined()) == 1
+
+    def test_repeated_corruption_keeps_both_quarantines(self):
+        for _ in range(2):
+            tilelang.compile(_scale_func(6.5))
+            (entry,) = _disk_entries()
+            (entry / KERNEL_SOURCE_FILE).write_text("bad")
+            _CACHE.clear()
+            tilelang.compile(_scale_func(6.5))
+            (entry,) = _disk_entries()
+            (entry / KERNEL_SOURCE_FILE).write_text("bad")
+            _CACHE.clear()
+        tilelang.compile(_scale_func(6.5))
+        assert len(_quarantined()) >= 2
+
+    def test_write_fault_degrades_to_uncached(self):
+        with inject("cache.disk.write", kind="oserror"):
+            k = tilelang.compile(_scale_func(7.5))
+        _run_scale(k, 7.5)
+        assert _disk_entries() == []      # nothing cached…
+        assert get_tracer().counters()["cache.write_errors"] == 1
+        _CACHE.clear()
+        _run_scale(tilelang.compile(_scale_func(7.5)), 7.5)  # …but rebuilds
+
+    def test_torn_write_fault_caught_by_checksum(self):
+        with inject("cache.disk.write", kind="corrupt"):
+            k = tilelang.compile(_scale_func(8.5))
+        _run_scale(k, 8.5)                # in-memory kernel unaffected
+        _CACHE.clear()
+        _run_scale(tilelang.compile(_scale_func(8.5)), 8.5)
+        assert len(_quarantined()) == 1   # torn entry detected, not reused
+
+    def test_read_fault_is_miss_not_quarantine(self):
+        tilelang.compile(_scale_func(9.5))
+        _CACHE.clear()
+        with inject("cache.disk.read", kind="oserror"):
+            _run_scale(tilelang.compile(_scale_func(9.5)), 9.5)
+        assert _quarantined() == []
+        assert get_tracer().counters()["cache.read_errors"] == 1
+
+    def test_clear_disk_purges_everything(self):
+        tilelang.compile(_scale_func(10.5))
+        (entry,) = _disk_entries()
+        (entry / KERNEL_SOURCE_FILE).write_text("bad")
+        _CACHE.clear()
+        tilelang.compile(_scale_func(10.5))  # creates a quarantine too
+        assert _disk_entries() and _quarantined()
+        _CACHE.clear(disk=True)
+        assert list(env.cache_dir().iterdir()) == []
+
+    def test_key_unchanged_by_resilience_metadata(self):
+        f = _scale_func(11.5)
+        script = f.func.script()
+        assert KernelCache.key_for(script, "cpu", None, {}) == \
+            KernelCache.key_for(script, "cpu", None, {})
+
+
+# ---------------------------------------------------------------------------
+# hardened autotuner
+# ---------------------------------------------------------------------------
+
+def _copy_factory(calls):
+    @tilelang.jit
+    def factory(M, N, block_M=32):
+        calls.append(block_M)
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(T.ceildiv(M, block_M)) as bx:
+                s = T.alloc_shared((block_M, N), "float32")
+                T.copy(A[bx * block_M, 0], s)
+                T.copy(s, B[bx * block_M, 0])
+        return k
+    return factory
+
+
+class TestAutotunerResilience:
+    def test_transient_faults_still_find_winner(self):
+        calls = []
+        factory = _copy_factory(calls)
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        with inject("autotune.trial", p=0.5, seed=3):
+            res = AutoTuner(factory, [{"block_M": 32}, {"block_M": 64}],
+                            warmup=1, rep=2, cache_results=False
+                            ).run(128, 128)
+        assert res.latency_ms > 0
+        assert res.config in ({"block_M": 32}, {"block_M": 64})
+
+    def test_journal_resumes_interrupted_sweep(self):
+        calls = []
+        factory = _copy_factory(calls)
+        from tilelang_mesh_tpu.autotuner import AutoTuner, _config_key
+        configs = [{"block_M": 32}, {"block_M": 64}]
+        tuner = AutoTuner(factory, configs, warmup=1, rep=2,
+                          cache_results=True)
+        key = tuner._disk_key((128, 128), {}, configs)
+        journal = env.autotune_dir() / f"{key}.journal.jsonl"
+        # an interrupted sweep already measured block_M=32 at 0.001 ms
+        journal.write_text(json.dumps(
+            {"config_key": _config_key(configs[0]), "status": "ok",
+             "latency_ms": 0.001}) + "\n")
+        res = tuner.run(128, 128)
+        # the journaled config won without re-benchmarking; its kernel is
+        # built once at the end (so 32 appears once, not warmup+rep times)
+        assert res.config == {"block_M": 32}
+        assert res.latency_ms == 0.001
+        assert res.kernel is not None
+        resumed = [r for r in res.all_results if r.get("resumed")]
+        assert len(resumed) == 1
+        # completed sweep: result durable, journal retired
+        assert not journal.exists()
+        assert (env.autotune_dir() / f"{key}.json").exists()
+
+    def test_journal_skips_deterministic_failures(self):
+        calls = []
+        factory = _copy_factory(calls)
+        from tilelang_mesh_tpu.autotuner import AutoTuner, _config_key
+        configs = [{"block_M": 32}, {"block_M": 64}]
+        tuner = AutoTuner(factory, configs, warmup=1, rep=2,
+                          cache_results=True)
+        key = tuner._disk_key((128, 128), {}, configs)
+        journal = env.autotune_dir() / f"{key}.journal.jsonl"
+        journal.write_text(json.dumps(
+            {"config_key": _config_key(configs[0]), "status": "failed",
+             "kind": "deterministic", "error": "TypeError: broken"}) + "\n")
+        res = tuner.run(128, 128)
+        assert res.config == {"block_M": 64}
+        assert 32 not in calls             # known-bad config never re-paid
+        skipped = [r for r in res.all_results if r.get("skipped")]
+        assert len(skipped) == 1
+
+    def test_sweep_journals_outcomes_as_it_goes(self, monkeypatch):
+        calls = []
+        factory = _copy_factory(calls)
+        from tilelang_mesh_tpu.autotuner import AutoTuner, _append_journal
+        recorded = []
+        monkeypatch.setattr(
+            "tilelang_mesh_tpu.autotuner._append_journal",
+            lambda path, rec: recorded.append((path, rec)) or
+            _append_journal(path, rec))
+        AutoTuner(factory, [{"block_M": 32}, {"block_M": 64}],
+                  warmup=1, rep=2, cache_results=True).run(128, 128)
+        assert len(recorded) == 2
+        assert all(r["status"] == "ok" for _, r in recorded)
+
+    def test_all_failing_still_raises(self):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+
+        def factory(M, N, block_M=32):
+            raise TypeError("factory is broken")
+        with pytest.raises(RuntimeError, match="every candidate"):
+            AutoTuner(factory, [{"block_M": 32}], warmup=1, rep=1,
+                      cache_results=False).run(128, 128)
+
+    def test_breaker_fast_skips_systematic_failures(self, monkeypatch):
+        """Once `threshold` consecutive trials die with one identical
+        deterministic signature, the remaining configs fast-fail without
+        running (no more timeout budget burned on a systemic bug)."""
+        monkeypatch.setenv("TL_TPU_BREAKER_THRESHOLD", "2")
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        calls = []
+
+        def factory(M, N, block_M=32):
+            calls.append(block_M)
+            raise TypeError("systemic codegen bug")
+        configs = [{"block_M": b} for b in (16, 32, 64, 128, 256)]
+        with pytest.raises(RuntimeError, match="every candidate"):
+            AutoTuner(factory, configs, warmup=1, rep=1,
+                      cache_results=False).run(128, 128)
+        assert len(calls) == 2     # trials 3-5 never ran
+        assert get_tracer().counters()["autotune.breaker_skips"] == 3
+
+    def test_success_resets_failure_streak(self, monkeypatch):
+        """Distinct failure signatures / interleaved successes must not
+        trip the fast-skip: only a uniform consecutive streak does."""
+        monkeypatch.setenv("TL_TPU_BREAKER_THRESHOLD", "2")
+        calls = []
+        factory = _copy_factory(calls)
+
+        def flaky_factory(M, N, block_M=32):
+            if block_M in (16, 256):   # distinct errors per config
+                raise TypeError(f"bad tile {block_M}")
+            return factory(M, N, block_M=block_M)
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        res = AutoTuner(flaky_factory,
+                        [{"block_M": 16}, {"block_M": 32},
+                         {"block_M": 256}, {"block_M": 64}],
+                        warmup=1, rep=1, cache_results=False).run(128, 128)
+        assert res.config in ({"block_M": 32}, {"block_M": 64})
+        assert set(calls) == {32, 64}  # both good configs actually ran
+
+    def test_timeout_worker_tracked_and_uniquely_named(self):
+        from tilelang_mesh_tpu.autotuner import (abandoned_worker_count,
+                                                 run_with_timeout)
+        before = get_tracer().counters().get("autotune.abandoned_threads", 0)
+        with pytest.raises(concurrent.futures.TimeoutError) as ei:
+            run_with_timeout(time.sleep, 0.2, 2.0)
+        assert "tl-autotune-timeout-" in str(ei.value)
+        assert abandoned_worker_count() >= 1
+        assert get_tracer().counters()["autotune.abandoned_threads"] == \
+            before + 1
+        with pytest.raises(concurrent.futures.TimeoutError) as ei2:
+            run_with_timeout(time.sleep, 0.2, 2.0)
+        assert str(ei.value) != str(ei2.value)   # unique worker names
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation (interpreter fallback)
+# ---------------------------------------------------------------------------
+
+class TestFallback:
+    def test_compile_fault_falls_back_to_interpreter(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        get_tracer().reset()
+        with inject("jit.compile", times=1):
+            k = tilelang.compile(_scale_func(12.5))
+        assert k._degraded
+        _run_scale(k, 12.5)                # numerically correct output
+        evs = [e for e in get_tracer().events() if e["name"] == "degraded"]
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["kernel"] == "scale"
+        assert get_tracer().counters()["resilience.degraded"] == 1
+
+    def test_fallback_none_fails_fast(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_FALLBACK", "none")
+        with inject("jit.compile", times=1):
+            with pytest.raises(InjectedFault):
+                tilelang.compile(_scale_func(13.5))
+
+    def test_lower_transient_fault_retried_by_cached(self):
+        # one transient lowering fault: the compile path retries and the
+        # kernel still builds + caches
+        with inject("lower.plan", times=1):
+            k = tilelang.compile(_scale_func(14.5))
+        _run_scale(k, 14.5)
+        assert get_tracer().counters().get(
+            "resilience.retry{kind=transient,site=lower}", 0) == 1
+
+    def test_lower_deterministic_fault_propagates(self):
+        with inject("lower.plan", kind="deterministic", times=1):
+            with pytest.raises(DeterministicError):
+                tilelang.compile(_scale_func(15.5))
+
+    def test_degrade_only_for_compile_shaped_errors(self):
+        """User errors (builtin exceptions from user code) must propagate,
+        not silently pin the kernel to the interpreter."""
+        from tilelang_mesh_tpu.jit.kernel import _compile_shaped
+        assert _compile_shaped(InjectedFault("chaos"))
+        assert _compile_shaped(NotImplementedError("mosaic op"))
+        assert not _compile_shaped(ValueError("bad data"))
+        assert not _compile_shaped(TypeError("bad operand"))
+
+    def test_cache_timeout_fault_nonfatal(self):
+        """kind=timeout / kind=deterministic write faults must also
+        degrade to an uncached compile, not abort it."""
+        with inject("cache.disk.write", kind="timeout"):
+            _run_scale(tilelang.compile(_scale_func(18.5)), 18.5)
+        _CACHE.clear()
+        with inject("cache.disk.read", kind="deterministic"):
+            _run_scale(tilelang.compile(_scale_func(18.5)), 18.5)
+        assert get_tracer().counters()["cache.write_errors"] == 1
+        assert get_tracer().counters()["cache.read_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mesh-config validation (satellite)
+# ---------------------------------------------------------------------------
+
+class TestMeshConfigValidation:
+    def test_set_device_mesh_config_rejects_bad_dims(self):
+        from tilelang_mesh_tpu.parallel.device_mesh import (
+            get_device_mesh_config, set_device_mesh_config)
+        keep = get_device_mesh_config()
+        try:
+            for bad in ((0, 4), (4, 0), (-1, 2), (2, -3)):
+                with pytest.raises(ValueError, match=str(bad)):
+                    set_device_mesh_config(*bad)
+            assert get_device_mesh_config() == keep   # unchanged on error
+        finally:
+            set_device_mesh_config(*keep)
+
+    def test_mesh_config_scope_rejects_bad_dims(self):
+        from tilelang_mesh_tpu.parallel.device_mesh import (
+            get_device_mesh_config, mesh_config)
+        with pytest.raises(ValueError, match=r"\(0, 2\)"):
+            with mesh_config(0, 2):
+                pass
+        with mesh_config(2, 2):
+            assert get_device_mesh_config() == (2, 2)
+
+    def test_valid_dims_accepted(self):
+        from tilelang_mesh_tpu.parallel.device_mesh import (
+            get_device_mesh_config, set_device_mesh_config)
+        keep = get_device_mesh_config()
+        try:
+            set_device_mesh_config(1, 1)
+            assert get_device_mesh_config() == (1, 1)
+        finally:
+            set_device_mesh_config(*keep)
+
+
+# ---------------------------------------------------------------------------
+# analyzer --faults (satellite)
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerFaults:
+    def test_faults_report_from_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        get_tracer().reset()
+        with inject("jit.compile", times=1):
+            k = tilelang.compile(_scale_func(16.5))
+        _run_scale(k, 16.5)
+        with inject("lower.plan", times=1):
+            tilelang.compile(_scale_func(17.5))
+        from tilelang_mesh_tpu.observability import write_jsonl
+        trace_f = tmp_path / "trace.jsonl"
+        write_jsonl(trace_f)
+        from tilelang_mesh_tpu.tools.analyzer import (format_faults_report,
+                                                      summarize_faults)
+        from tilelang_mesh_tpu.observability import read_jsonl
+        s = summarize_faults(read_jsonl(trace_f))
+        assert s["injected"]["jit.compile"] == 1
+        assert s["injected"]["lower.plan"] == 1
+        assert s["retries"].get("lower", 0) == 1
+        assert s["degraded"] == {"scale": 1}
+        report = format_faults_report(read_jsonl(trace_f))
+        assert "jit.compile" in report and "degraded" in report
+
+    def test_cli_faults_flag(self, tmp_path, capsys):
+        trace_f = tmp_path / "t.jsonl"
+        trace_f.write_text(json.dumps(
+            {"type": "event", "name": "fault.injected",
+             "attrs": {"site": "autotune.trial", "kind": "transient"}}) +
+            "\n")
+        from tilelang_mesh_tpu.tools.analyzer import main
+        assert main(["--faults", str(trace_f)]) == 0
+        out = capsys.readouterr().out
+        assert "autotune.trial" in out
+
+    def test_cli_requires_an_input(self):
+        from tilelang_mesh_tpu.tools.analyzer import main
+        with pytest.raises(SystemExit):
+            main([])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end seeded chaos sweep (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestEndToEndChaos:
+    def test_armed_pipeline_survives_and_is_observable(self, monkeypatch):
+        """TL_TPU_FAULTS arms disk-write (torn), trial, and compile
+        faults at p=0.3 (seeded); the jit + autotune run must complete
+        with numerically correct results, torn entries must land in
+        .quarantine/, and the trace must show the matching fault/retry/
+        degraded events."""
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        monkeypatch.setenv(
+            "TL_TPU_FAULTS",
+            "cache.disk.write:p=0.3:seed=3:kind=corrupt;"
+            "autotune.trial:p=0.3:seed=12;"
+            "jit.compile:p=0.3:seed=13")
+        get_tracer().reset()
+        # jit + cache path: compile several kernels, then reload each
+        # from disk in a fresh memory tier
+        mults = [21.0, 22.0, 23.0, 24.0, 25.0]
+        for m in mults:
+            _run_scale(tilelang.compile(_scale_func(m)), m)
+        _CACHE.clear()
+        for m in mults:
+            _run_scale(tilelang.compile(_scale_func(m)), m)
+        # autotune path
+        calls = []
+        factory = _copy_factory(calls)
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        res = AutoTuner(factory, [{"block_M": 32}, {"block_M": 64}],
+                        warmup=1, rep=2, cache_results=False).run(128, 128)
+        assert res.latency_ms > 0
+        # every injected fault is observable, and recovery matched it
+        counters = get_tracer().counters()
+        injected = sum(v for k, v in counters.items()
+                       if k.startswith("fault.injected"))
+        assert injected > 0, "p=0.3 over this many visits must fire"
+        names = {e["name"] for e in get_tracer().events()}
+        assert "fault.injected" in names
+        # torn writes were quarantined on reload, never silently reused
+        if any("site=cache.disk.write" in k for k in counters):
+            assert counters.get("cache.quarantined", 0) >= 1
+            assert len(_quarantined()) >= 1
+        if any("site=jit.compile" in k for k in counters):
+            assert counters.get("resilience.degraded", 0) >= 1
+            assert "degraded" in names
+        if any("site=autotune.trial" in k for k in counters):
+            assert "resilience.retry" in names
+
+
+class TestOverheadWhenDisabled:
+    def test_maybe_fail_is_noop_without_arming(self):
+        """With TL_TPU_FAULTS unset the hook must be branch-cheap: no
+        parsing, no RNG, no tracer traffic."""
+        from tilelang_mesh_tpu.resilience import faults
+        assert faults.active_specs() == []
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            maybe_fail("cache.disk.read")
+        dt = time.perf_counter() - t0
+        assert dt < 0.5                    # ~μs/call budget, generous CI bar
+        assert "fault.injected" not in " ".join(get_tracer().counters())
+
+    def test_cached_kernel_call_unchanged(self):
+        """The resilience hooks sit on compile paths only: a cached
+        kernel dispatch records nothing new."""
+        k = tilelang.compile(_scale_func(31.0))
+        _run_scale(k, 31.0)
+        get_tracer().reset()
+        _run_scale(k, 31.0)
+        assert get_tracer().counters() == {}

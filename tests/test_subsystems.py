@@ -52,6 +52,21 @@ class TestKernelCache:
         np.testing.assert_allclose(np.asarray(k2(a)), a * 5, rtol=1e-6)
         assert k2.get_kernel_source() == k1.get_kernel_source()
 
+    def test_clear_disk_gives_clean_slate(self):
+        from tilelang_mesh_tpu.env import env
+        f = _scale_func(mult=7.0, M=96)
+        k1 = tilelang.compile(f)
+        assert any(env.cache_dir().iterdir())
+        # memory-only clear keeps the disk tier…
+        tilelang.cache.kernel_cache._CACHE.clear()
+        assert any(env.cache_dir().iterdir())
+        # …disk=True purges it: the next compile is a full rebuild
+        tilelang.cache.kernel_cache._CACHE.clear(disk=True)
+        assert not any(env.cache_dir().iterdir())
+        k2 = tilelang.compile(f)
+        assert k2 is not k1
+        assert k2.get_kernel_source() == k1.get_kernel_source()
+
 
 class TestAutotuner:
     def test_picks_fastest_and_caches(self):

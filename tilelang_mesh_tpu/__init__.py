@@ -58,6 +58,10 @@ from .autotuner import autotune, AutoTuner  # noqa: E402
 from . import observability  # noqa: E402
 from .observability import metrics_summary  # noqa: E402
 
+# resilience (fault injection via TL_TPU_FAULTS, retry/backoff, circuit
+# breaking, interpreter fallback via TL_TPU_FALLBACK)
+from . import resilience  # noqa: E402
+
 # transform / pass config
 from .transform.pass_config import PassConfigKey  # noqa: E402
 
@@ -72,6 +76,6 @@ __all__ = [
     "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
     "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
     "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
-    "observability", "metrics_summary",
+    "observability", "metrics_summary", "resilience",
     "env", "logger", "set_log_level", "__version__",
 ]

@@ -16,18 +16,23 @@ and args.
 
 from __future__ import annotations
 
-import concurrent.futures
 import functools
 import hashlib
+import itertools
 import json
 import logging
 import inspect
+import threading
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..env import env
 from ..observability import tracer as _trace
 from ..profiler import Profiler
+from ..resilience import faults as _faults
+from ..resilience.errors import TLTimeoutError, classify, error_signature
+from ..resilience.retry import CircuitBreaker, RetryPolicy, retry_call
 from ..utils.tensor import TensorSupplyType
 
 logger = logging.getLogger("tilelang_mesh_tpu.autotune")
@@ -44,19 +49,53 @@ class AutotuneResult:
     from_cache: bool = False
 
 
+# Abandoned-worker accounting: a timed-out trial's daemon thread cannot be
+# killed, only abandoned. Each gets a unique name (debuggable in thread
+# dumps), the total is a tracer counter, and the *still-alive* population
+# is tracked so a sweep leaking wedged compiles warns before it starves
+# the process of threads.
+_worker_seq = itertools.count()
+_abandoned_lock = threading.Lock()
+_abandoned: List[threading.Thread] = []
+
+
+def abandoned_worker_count() -> int:
+    """How many abandoned timeout workers are still alive right now."""
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        return len(_abandoned)
+
+
+def _note_abandoned(t: threading.Thread) -> None:
+    with _abandoned_lock:
+        _abandoned[:] = [w for w in _abandoned if w.is_alive()]
+        _abandoned.append(t)
+        alive = len(_abandoned)
+    _trace.inc("autotune.abandoned_threads")
+    _trace.event("autotune.thread_abandoned", "autotune", thread=t.name,
+                 alive=alive)
+    warn_at = env.TL_TPU_ABANDONED_THREAD_WARN
+    if alive >= warn_at:
+        logger.warning(
+            "%d abandoned autotune workers are still alive (>= "
+            "TL_TPU_ABANDONED_THREAD_WARN=%d): wedged compiles are "
+            "accumulating; consider a longer timeout or fewer configs",
+            alive, warn_at)
+
+
 def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
     """Run fn with a wall-clock timeout (reference tuner.py:51).
 
     Uses a daemon worker thread and abandons it on timeout: a hung XLA
     compile or device sync can't be interrupted in-process, but the sweep
-    must move on immediately — so the executor is shut down with
-    wait=False (never inside a `with` block, whose __exit__ would block
-    on the wedged worker until it finishes).
+    must move on immediately — so the worker is never joined (a `with`
+    executor's __exit__ would block on the wedged worker until it
+    finishes). Abandoned workers are uniquely named and tracked (see
+    ``abandoned_worker_count``).
     """
     if timeout is None:
         return fn(*args, **kwargs)
     import queue
-    import threading
 
     q: "queue.Queue" = queue.Queue(maxsize=1)
 
@@ -67,16 +106,60 @@ def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
             q.put((False, e))
 
     t = threading.Thread(target=_worker, daemon=True,
-                         name="tl-autotune-timeout")
+                         name=f"tl-autotune-timeout-{next(_worker_seq)}")
     t.start()
     try:
         ok, val = q.get(timeout=timeout)
     except queue.Empty:
-        raise concurrent.futures.TimeoutError(
-            f"config exceeded {timeout}s; worker abandoned")
+        _note_abandoned(t)
+        raise TLTimeoutError(
+            f"config exceeded {timeout}s; worker {t.name} abandoned",
+            site="autotune.trial")
     if not ok:
         raise val
     return val
+
+
+# -- sweep journal -----------------------------------------------------------
+# One JSONL line per finished trial, appended as it lands (append + flush:
+# a crash loses at most the in-flight trial). Keyed by the config's sorted
+# JSON so resume matching is insensitive to dict ordering.
+
+def _config_key(cfg: Dict[str, Any]) -> str:
+    return json.dumps(cfg, sort_keys=True, default=str)
+
+
+def _load_journal(path: Optional[Path]) -> Dict[str, dict]:
+    if path is None or not path.exists():
+        return {}
+    out: Dict[str, dict] = {}
+    try:
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # torn final line from an interrupted run
+            out[rec["config_key"]] = rec
+    except OSError:
+        return {}
+    if out:
+        logger.info("autotune: resuming sweep from journal %s "
+                    "(%d trial(s) already done)", path.name, len(out))
+    return out
+
+
+def _append_journal(path: Optional[Path], rec: dict) -> None:
+    if path is None:
+        return
+    try:
+        with path.open("a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+    except OSError as e:   # journal loss degrades resume, never the sweep
+        logger.warning("autotune: journal append failed: %s", e)
 
 
 class AutoTuner:
@@ -238,39 +321,118 @@ class AutoTuner:
         if configs is None:
             configs = self._derive_configs(args, kwargs)
 
+        # Sweep hardening (resilience subsystem): every trial outcome is
+        # journaled to disk as it lands, so an interrupted sweep resumes
+        # where it stopped; transient failures retry with backoff;
+        # repeated identical deterministic failures open the circuit
+        # breaker and stop burning the timeout budget on them.
+        journal_f = cache_f.with_name(f"{key}.journal.jsonl") \
+            if self.cache_results else None
+        prior = _load_journal(journal_f)
+        policy = RetryPolicy.from_env()
+        breaker = CircuitBreaker()
         best: Optional[AutotuneResult] = None
         captured: List[Dict[str, Any]] = []
         n = len(configs)
         factory = getattr(self.fn, "__name__", "?")
+        # consecutive-identical-failure streak: once the breaker is open
+        # for the signature every recent trial died with, the failure is
+        # systematic (a codegen bug, not a bad tile) and remaining
+        # configs fast-fail instead of each burning a full timeout budget
+        streak_sig: Optional[str] = None
+        streak_len = 0
         with _trace.span("autotune.run", "autotune", factory=factory,
-                         n_configs=n) as run_sp:
+                         n_configs=n, resumed_trials=len(prior)) as run_sp:
             for i, cfg in enumerate(configs):
+                ck = _config_key(cfg)
+                prev = prior.get(ck)
+                if streak_sig is not None and \
+                        streak_len >= breaker.threshold and \
+                        breaker.is_open(streak_sig):
+                    _trace.inc("autotune.breaker_skips")
+                    _trace.inc("autotune.trials", outcome="breaker_skipped")
+                    _trace.event("autotune.breaker_skip", "autotune",
+                                 factory=factory, config=ck,
+                                 signature=streak_sig)
+                    captured.append({"config": cfg, "latency_ms": None,
+                                     "error": streak_sig,
+                                     "skipped": "circuit breaker open"})
+                    # journaled WITHOUT kind=deterministic: a resumed
+                    # sweep gives breaker-skipped configs a fresh chance
+                    _append_journal(journal_f, {
+                        "config_key": ck, "status": "failed",
+                        "kind": "breaker_skipped", "error": streak_sig})
+                    continue
+                if prev is not None and prev.get("status") == "ok":
+                    lat = prev["latency_ms"]
+                    _trace.inc("autotune.trials", outcome="resumed")
+                    captured.append({"config": cfg, "latency_ms": lat,
+                                     "resumed": True})
+                    if best is None or lat < best.latency_ms:
+                        best = AutotuneResult(cfg, lat, None)
+                    continue
+                if prev is not None and prev.get("kind") == "deterministic":
+                    # retrying cannot fix it; the journal remembers so a
+                    # resumed sweep never re-pays for a known-bad config
+                    _trace.inc("autotune.trials", outcome="skipped")
+                    captured.append({"config": cfg, "latency_ms": None,
+                                     "error": prev.get("error"),
+                                     "skipped": "journaled deterministic "
+                                                "failure"})
+                    continue
                 with _trace.span("autotune.trial", "autotune",
                                  factory=factory, config=cfg) as sp:
+                    attempts = [0]
+
+                    def _one():
+                        attempts[0] += 1
+                        _faults.maybe_fail("autotune.trial", config=ck)
+                        kernel = self.fn(*args, **{**kwargs, **cfg})
+                        prof = Profiler(kernel, self.supply_type)
+                        return kernel, prof.do_bench(warmup=self.warmup,
+                                                     rep=self.rep)
                     try:
-                        def _one():
-                            kernel = self.fn(*args, **{**kwargs, **cfg})
-                            prof = Profiler(kernel, self.supply_type)
-                            return kernel, prof.do_bench(warmup=self.warmup,
-                                                         rep=self.rep)
-                        kernel, lat = run_with_timeout(_one, self.timeout)
+                        kernel, lat = retry_call(
+                            lambda: run_with_timeout(_one, self.timeout),
+                            site="autotune.trial", policy=policy,
+                            breaker=breaker)
                     except Exception as e:  # config isolation (tuner.py:51)
-                        logger.debug("autotune config %s failed: %s", cfg, e)
-                        sp.set(outcome="failed",
-                               error=f"{type(e).__name__}: {e}")
+                        kind = classify(e)
+                        sig = error_signature(e)
+                        err = f"{type(e).__name__}: {e}"
+                        logger.debug("autotune config %s failed (%s): %s",
+                                     cfg, kind, e)
+                        sp.set(outcome="failed", kind=kind, error=err,
+                               attempts=attempts[0])
                         _trace.inc("autotune.trials", outcome="failed")
+                        if sig == streak_sig:
+                            streak_len += 1
+                        else:
+                            streak_sig, streak_len = sig, 1
                         captured.append({"config": cfg, "latency_ms": None,
-                                         "error": f"{type(e).__name__}: {e}"})
+                                         "error": err, "kind": kind,
+                                         "attempts": attempts[0]})
+                        _append_journal(journal_f, {
+                            "config_key": ck, "status": "failed",
+                            "kind": kind, "error": err,
+                            "attempts": attempts[0]})
                         continue
-                    sp.set(outcome="ok", latency_ms=lat)
+                    sp.set(outcome="ok", latency_ms=lat,
+                           attempts=attempts[0])
                     _trace.inc("autotune.trials", outcome="ok")
+                    streak_sig, streak_len = None, 0
                 logger.info("autotune [%d/%d] %s -> %.4f ms",
                             i + 1, n, cfg, lat)
                 captured.append({"config": cfg, "latency_ms": lat})
+                _append_journal(journal_f, {
+                    "config_key": ck, "status": "ok", "latency_ms": lat})
                 if best is None or lat < best.latency_ms:
                     best = AutotuneResult(cfg, lat, kernel)
             if best is None:
                 raise RuntimeError("autotune: every candidate config failed")
+            if best.kernel is None:
+                # winner came from the resume journal: build it now
+                best.kernel = self.fn(*args, **{**kwargs, **best.config})
             run_sp.set(best_config=best.config,
                        best_latency_ms=best.latency_ms)
         best.all_results = captured
@@ -278,6 +440,11 @@ class AutoTuner:
             cache_f.write_text(json.dumps(
                 {"config": best.config, "latency_ms": best.latency_ms,
                  "all_results": captured}))
+            # the sweep completed and its result is durable: the journal
+            # has served its purpose (keeping it would shadow a user's
+            # deliberate cache delete on the next re-tune)
+            if journal_f is not None:
+                journal_f.unlink(missing_ok=True)
         return best
 
 

@@ -4,12 +4,36 @@ Reference: /root/reference/tilelang/cache/kernel_cache.py (KernelCache:31,
 sha256 key :69-112, disk layout :22-28). Same two-level design (memory ->
 disk -> build); the artifact on disk is the generated Pallas source plus a
 JSON param table instead of .cu/.so files.
+
+Crash-safety contract (resilience subsystem):
+
+- **Atomic writes**: both files land via tmp-file + ``os.replace``; the
+  metadata file is written last and is the commit point, and it carries a
+  sha256 of the source it describes. A crash mid-write leaves either the
+  old entry or a tmp file, never a half-new entry.
+- **Verified loads**: the source checksum is verified on every disk read.
+- **Quarantine, never silent rebuild-in-place**: a corrupt entry is moved
+  to ``<cache>/.quarantine/`` (counted + logged + traced) so the damage
+  stays inspectable, then the kernel rebuilds under a fresh write.
+- **Per-key locking**: concurrent processes serialize writes per key via
+  flock'd lock files under ``<cache>/.locks/`` (released by the kernel on
+  crash), so two builders can't interleave a torn pair of files.
+- **Write failures are non-fatal**: a failed artifact save degrades to an
+  uncached compile (counted as ``cache.write_errors``), never an abort.
+
+Fault sites ``cache.disk.read`` / ``cache.disk.write`` inject here; the
+``kind=corrupt`` write fault persists a deliberately torn artifact to
+exercise the checksum + quarantine path end to end.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
+import logging
+import os
+import shutil
 import threading
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -17,13 +41,56 @@ from typing import Any, Dict, Optional
 from ..engine.param import CompiledArtifact, KernelParam
 from ..env import env
 from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import TLError
+from ..resilience.retry import RetryPolicy, retry_call
+
+try:
+    import fcntl
+except ImportError:          # non-POSIX: locking degrades to process-local
+    fcntl = None  # type: ignore[assignment]
+
+logger = logging.getLogger("tilelang_mesh_tpu.cache")
 
 KERNEL_SOURCE_FILE = "kernel.py"
 ARTIFACT_FILE = "artifact.json"
+QUARANTINE_DIR = ".quarantine"
+LOCKS_DIR = ".locks"
 
-# Bump whenever codegen output changes for the same IR — generated sources
-# cached under older versions must not be reused.
-CODEGEN_VERSION = 7  # bump on any generated-source change to invalidate disk artifacts
+# Bump whenever codegen output OR the on-disk artifact format changes —
+# artifacts cached under older versions must not be reused. (8: artifact
+# metadata gained the source_sha256 integrity checksum.)
+CODEGEN_VERSION = 8
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+@contextlib.contextmanager
+def _key_lock(key: str):
+    """Serialize cross-process writers of one cache entry. flock is
+    advisory and kernel-released on crash, so a dead writer can never
+    wedge the cache. Degrades to the singleton's in-process lock where
+    fcntl is unavailable."""
+    if fcntl is None:
+        yield
+        return
+    lock_dir = env.cache_dir() / LOCKS_DIR
+    lock_dir.mkdir(parents=True, exist_ok=True)
+    fd = os.open(lock_dir / f"{key}.lock", os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def _atomic_write(path: Path, text: str) -> None:
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    tmp.write_text(text)
+    os.replace(tmp, path)
 
 
 class KernelCache:
@@ -56,36 +123,99 @@ class KernelCache:
     def put(self, key: str, kernel):
         self._mem[key] = kernel
 
-    def clear(self):
+    def clear(self, disk: bool = False):
+        """Drop the memory tier; with ``disk=True`` also purge the
+        on-disk tier under ``env.cache_dir()`` (entries, quarantine, and
+        lock files) so tests start from a true clean slate."""
         self._mem.clear()
+        if disk:
+            d = env.cache_dir()
+            for child in d.iterdir():
+                if child.is_dir():
+                    shutil.rmtree(child, ignore_errors=True)
+                else:
+                    with contextlib.suppress(OSError):
+                        child.unlink()
 
     # -- disk ----------------------------------------------------------------
     def _dir(self, key: str) -> Path:
         return env.cache_dir() / key
+
+    def _quarantine(self, key: str, reason: str) -> None:
+        """Move a corrupt entry aside — never rebuild over it in place.
+        The quarantined copy keeps the evidence for postmortem; a numeric
+        suffix avoids clobbering an earlier quarantine of the same key."""
+        d = self._dir(key)
+        qroot = env.cache_dir() / QUARANTINE_DIR
+        qroot.mkdir(parents=True, exist_ok=True)
+        dest = qroot / key
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = qroot / f"{key}.{n}"
+        try:
+            os.replace(d, dest)
+        except OSError:
+            shutil.rmtree(d, ignore_errors=True)
+            dest = None
+        _trace.inc("cache.quarantined")
+        _trace.event("cache.quarantine", "resilience", key=key,
+                     reason=reason, dest=str(dest) if dest else "removed")
+        logger.warning("quarantined corrupt cache entry %s (%s)%s", key,
+                       reason, f" -> {dest}" if dest else "")
 
     def load_artifact(self, key: str) -> Optional[CompiledArtifact]:
         if env.TL_TPU_DISABLE_CACHE:
             return None
         d = self._dir(key)
         src_f, meta_f = d / KERNEL_SOURCE_FILE, d / ARTIFACT_FILE
-        if not (src_f.exists() and meta_f.exists()):
-            return None
         try:
-            meta = json.loads(meta_f.read_text())
-            _trace.inc("cache.artifact_bytes_read",
-                       src_f.stat().st_size + meta_f.stat().st_size)
-            params = [KernelParam(p["name"], tuple(p["shape"]), p["dtype"],
-                                  p["role"]) for p in meta["params"]]
-            return CompiledArtifact(
-                name=meta["name"], params=params,
-                kernel_source=src_f.read_text(), target=meta["target"],
-                grid=tuple(meta["grid"]), ir_script=meta.get("ir_script", ""),
-                plan_desc=meta.get("plan_desc", ""),
-                mesh_config=tuple(meta["mesh_config"])
-                if meta.get("mesh_config") else None,
-                attrs=meta.get("attrs", {}))
-        except Exception:
+            _faults.maybe_fail("cache.disk.read", key=key)
+            # same lock as writers, held through verify+quarantine: a
+            # reader that peeked mid-write would see the source-written/
+            # meta-pending window as a torn entry and quarantine a
+            # healthy one out from under its writer
+            with _key_lock(key):
+                if not (src_f.exists() and meta_f.exists()):
+                    if d.exists():
+                        # a directory without its committed pair is a torn
+                        # write that never reached the meta commit point
+                        self._quarantine(key, "incomplete entry")
+                    return None
+                meta_text = meta_f.read_text()
+                source = src_f.read_text()
+                try:
+                    meta = json.loads(meta_text)
+                    expect = meta["source_sha256"]
+                    actual = _sha256(source)
+                    if actual != expect:
+                        raise ValueError(
+                            f"source checksum mismatch (expect "
+                            f"{expect[:12]}…, got {actual[:12]}…)")
+                    params = [KernelParam(p["name"], tuple(p["shape"]),
+                                          p["dtype"], p["role"])
+                              for p in meta["params"]]
+                    art = CompiledArtifact(
+                        name=meta["name"], params=params,
+                        kernel_source=source, target=meta["target"],
+                        grid=tuple(meta["grid"]),
+                        ir_script=meta.get("ir_script", ""),
+                        plan_desc=meta.get("plan_desc", ""),
+                        mesh_config=tuple(meta["mesh_config"])
+                        if meta.get("mesh_config") else None,
+                        attrs=meta.get("attrs", {}))
+                except Exception as e:  # noqa: BLE001 — malformed entry
+                    self._quarantine(key, f"{type(e).__name__}: {e}")
+                    return None
+        except (OSError, TLError) as e:
+            # an unreadable disk is a miss, not corruption: nothing to
+            # quarantine, the build tier takes over
+            _trace.inc("cache.read_errors")
+            logger.warning("cache read failed for %s: %s", key, e)
             return None
+        _trace.inc("cache.artifact_bytes_read",
+                   len(source) + len(meta_text))
+        return art
 
     def save_artifact(self, key: str, art: CompiledArtifact) -> None:
         if env.TL_TPU_DISABLE_CACHE:
@@ -94,9 +224,16 @@ class KernelCache:
         # kernels are disk-cacheable
         if art.attrs.get("no_disk_cache"):
             return
-        d = self._dir(key)
-        d.mkdir(parents=True, exist_ok=True)
-        (d / KERNEL_SOURCE_FILE).write_text(art.kernel_source)
+        torn = False
+        try:
+            _faults.maybe_fail("cache.disk.write", key=key)
+        except _faults.CorruptionRequest:
+            torn = True   # persist a deliberately torn artifact (chaos)
+        except (OSError, TLError) as e:
+            _trace.inc("cache.write_errors")
+            logger.warning("cache write failed for %s: %s "
+                           "(continuing uncached)", key, e)
+            return
         meta = {
             "name": art.name,
             "target": art.target,
@@ -109,12 +246,28 @@ class KernelCache:
             "mesh_config": list(art.mesh_config) if art.mesh_config else None,
             "attrs": {k: v for k, v in art.attrs.items()
                       if isinstance(v, (str, int, float, bool, list))},
+            "source_sha256": _sha256(art.kernel_source),
         }
         meta_text = json.dumps(meta, indent=1)
-        (d / ARTIFACT_FILE).write_text(meta_text)
+        source = art.kernel_source
+        if torn:
+            source = source[: max(1, len(source) // 2)]
+        try:
+            with _key_lock(key):
+                d = self._dir(key)
+                d.mkdir(parents=True, exist_ok=True)
+                # source first, meta last: meta (with its checksum of the
+                # full source) is the commit point a loader trusts
+                _atomic_write(d / KERNEL_SOURCE_FILE, source)
+                _atomic_write(d / ARTIFACT_FILE, meta_text)
+        except OSError as e:
+            _trace.inc("cache.write_errors")
+            logger.warning("cache write failed for %s: %s "
+                           "(continuing uncached)", key, e)
+            return
         # source + metadata, mirroring what load_artifact counts as read
         _trace.inc("cache.artifact_bytes_written",
-                   len(art.kernel_source) + len(meta_text))
+                   len(source) + len(meta_text))
 
 
 _CACHE = KernelCache()
@@ -151,7 +304,12 @@ def cached(func, target: str = "auto", out_idx=None,
     else:
         _trace.inc("cache.disk.miss")
         _trace.event("cache.miss", "cache", tier="disk", key=key)
-        art = lower(func, target=target, pass_configs=pass_configs)
+        # transient lowering failures (injected chaos, I/O pressure under
+        # par_compile) retry with backoff; deterministic compile errors
+        # propagate immediately (retry.py classification)
+        art = retry_call(
+            lambda: lower(func, target=target, pass_configs=pass_configs),
+            site="lower", policy=RetryPolicy.from_env())
         _trace.inc("cache.build")
         _CACHE.save_artifact(key, art)
     with _trace.span("kernel_build", "cache", kernel=art.name,
@@ -169,7 +327,4 @@ def cached(func, target: str = "auto", out_idx=None,
 
 
 def clear_cache(disk: bool = False):
-    _CACHE.clear()
-    if disk:
-        import shutil
-        shutil.rmtree(env.cache_dir(), ignore_errors=True)
+    _CACHE.clear(disk=disk)

@@ -22,6 +22,7 @@ from ..codegen.pallas import generate_source
 from ..engine.param import CompiledArtifact, KernelParam
 from ..ir import Buffer, PrimFunc, Var
 from ..observability import tracer as _trace
+from ..resilience import faults as _faults
 from ..transform.pass_config import current_pass_config
 from ..transform.plan import plan_kernel
 from ..utils.target import (determine_target, mesh_dims_from_target,
@@ -55,6 +56,7 @@ def lower(func, target: str = "auto",
     from ..language.builder import PrimFuncObj
     with _trace.span("lower", "lower") as root:
         with _trace.span("canonicalize", "lower"):
+            _faults.maybe_fail("lower.canonicalize")
             if isinstance(func, PrimFuncObj):
                 func = func.func
             if not isinstance(func, PrimFunc):
@@ -75,13 +77,17 @@ def lower(func, target: str = "auto",
             return lower_mesh(func, target, mesh_cfg, cfg)
 
         with _trace.span("checks", "lower", kernel=func.name):
+            _faults.maybe_fail("lower.checks", kernel=func.name)
             run_semantic_checks(func)
         with _trace.span("plan", "lower", kernel=func.name):
+            _faults.maybe_fail("lower.plan", kernel=func.name)
             plan = plan_kernel(func, cfg)
         with _trace.span("codegen", "lower", kernel=func.name) as sp:
+            _faults.maybe_fail("lower.codegen", kernel=func.name)
             source = generate_source(plan, cfg)
             sp.set(source_bytes=len(source))
         with _trace.span("artifact", "lower", kernel=func.name):
+            _faults.maybe_fail("lower.artifact", kernel=func.name)
             return CompiledArtifact(
                 name=func.name,
                 params=_param_table(plan),

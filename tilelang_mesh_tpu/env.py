@@ -51,6 +51,15 @@ class Environment:
         str(Path.home() / ".tilelang_mesh_tpu" / "autotune"))
     # native library
     TL_TPU_DISABLE_NATIVE = EnvVar("TL_TPU_DISABLE_NATIVE", False, bool)
+    # resilience (resilience/ reads these; see docs/robustness.md)
+    TL_TPU_FAULTS = EnvVar("TL_TPU_FAULTS", "")          # fault-spec string
+    TL_TPU_FALLBACK = EnvVar("TL_TPU_FALLBACK", "interp")  # interp | none
+    TL_TPU_RETRY_MAX = EnvVar("TL_TPU_RETRY_MAX", 3, int)
+    TL_TPU_RETRY_BASE_MS = EnvVar("TL_TPU_RETRY_BASE_MS", 50.0, float)
+    TL_TPU_RETRY_MAX_MS = EnvVar("TL_TPU_RETRY_MAX_MS", 2000.0, float)
+    TL_TPU_BREAKER_THRESHOLD = EnvVar("TL_TPU_BREAKER_THRESHOLD", 3, int)
+    TL_TPU_ABANDONED_THREAD_WARN = EnvVar(
+        "TL_TPU_ABANDONED_THREAD_WARN", 4, int)
     # observability (observability/tracer.py reads these; keep tracer's
     # only dependency THIS module so every layer can import it)
     TL_TPU_TRACE = EnvVar("TL_TPU_TRACE", False, bool)

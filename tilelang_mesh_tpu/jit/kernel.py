@@ -11,12 +11,31 @@ to_jax) because jax.Array IS the device handle.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..engine.param import CompiledArtifact
+from ..env import env
 from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import TLError
 from ..utils.target import target_is_interpret, target_is_mesh
 from ..utils.tensor import TensorSupplyType, copy_back, to_jax
+
+logger = logging.getLogger("tilelang_mesh_tpu.jit")
+
+
+def _compile_shaped(exc: BaseException) -> bool:
+    """Is this the kind of error the interpreter fallback can help with?
+    XLA/Mosaic compile failures (jax/jaxlib-raised), Mosaic unsupported
+    ops (NotImplementedError), and injected chaos faults — yes. Builtin
+    Python errors from user code (a data-dependent ValueError, a bad
+    operand TypeError) — no: those are user errors, and degrading would
+    silently pin good inputs to the slow interpreter forever."""
+    if isinstance(exc, (TLError, NotImplementedError)):
+        return True
+    mod = type(exc).__module__ or ""
+    return mod.startswith(("jax", "jaxlib"))
 
 
 class JITKernel:
@@ -37,8 +56,16 @@ class JITKernel:
                          source_bytes=len(art.kernel_source)):
             code = compile(art.kernel_source, modname, "exec")
             exec(code, ns)
-            interpret = target_is_interpret(art.target)
-            self._raw_call: Callable = ns["build"](interpret=interpret)
+            self._ns = ns
+            self._interpret = target_is_interpret(art.target)
+            self._degraded = False
+            self._warmed = False   # set after the first successful call
+            try:
+                _faults.maybe_fail("jit.compile", kernel=art.name)
+                self._raw_call: Callable = \
+                    ns["build"](interpret=self._interpret)
+            except Exception as e:  # noqa: BLE001 — degrade or re-raise
+                self._degrade(e, during="build")
         import jax
         self.func = jax.jit(self._raw_call)
         self._in_params = art.in_params
@@ -53,6 +80,27 @@ class JITKernel:
         self._inout_results = [
             (oi, self._in_params.index(p))
             for oi, p in enumerate(self._out_params) if p.role == "inout"]
+
+    def _degrade(self, exc: BaseException, during: str) -> None:
+        """Graceful degradation (``TL_TPU_FALLBACK=interp``, default on):
+        when building or first-compiling the Pallas kernel fails, fall
+        back to the reference interpreter execution path with a
+        once-per-kernel warning and a ``degraded`` trace event instead of
+        raising. ``TL_TPU_FALLBACK=none`` restores fail-fast."""
+        if env.TL_TPU_FALLBACK != "interp" or self._degraded:
+            raise exc
+        self._degraded = True
+        _trace.inc("resilience.degraded")
+        _trace.event("degraded", "resilience", kernel=self.artifact.name,
+                     during=during, error=f"{type(exc).__name__}: {exc}")
+        logger.warning(
+            "kernel %s failed to %s (%s: %s); degrading to the reference "
+            "interpreter (TL_TPU_FALLBACK=interp)", self.artifact.name,
+            "build" if during == "build" else "compile", type(exc).__name__,
+            exc)
+        self._raw_call = self._ns["build"](interpret=True)
+        import jax
+        self.func = jax.jit(self._raw_call)
 
     # ------------------------------------------------------------------
     def __call__(self, *args, stream=None, **kwargs):
@@ -69,7 +117,22 @@ class JITKernel:
                 f"(or all {n_all} params, reference-style), got {len(args)}")
         jax_ins = [to_jax(a) for a in ins]
         self._check_shapes(jax_ins)
-        result = self.func(*jax_ins)
+        if self._warmed:
+            result = self.func(*jax_ins)
+        else:
+            # first call is where XLA/Mosaic actually compiles: a compile
+            # failure here degrades to the interpreter (once) instead of
+            # raising. After one success the guard is off — a post-warmup
+            # error is a runtime fault that must propagate.
+            try:
+                result = self.func(*jax_ins)
+            except Exception as e:  # noqa: BLE001 — degrade or re-raise
+                if self._degraded or self._interpret or \
+                        not _compile_shaped(e):
+                    raise
+                self._degrade(e, during="compile")
+                result = self.func(*jax_ins)
+            self._warmed = True
         results = result if isinstance(result, tuple) else (result,)
         import jax as _jax
         delivered = set()

@@ -198,8 +198,23 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
                      if k.startswith("comm.bytes{")
                      or k == "comm.bytes"),
     }
+
+    def labelled_total(name: str) -> float:
+        return sum(v for k, v in counters.items()
+                   if k == name or k.startswith(name + "{"))
+
+    resilience = {
+        "injected_faults": labelled_total("fault.injected"),
+        "retries": labelled_total("resilience.retry"),
+        "degraded": c("resilience.degraded"),
+        "quarantined": c("cache.quarantined"),
+        "breaker_opens": c("resilience.breaker_open"),
+        "cache_write_errors": c("cache.write_errors"),
+        "cache_read_errors": c("cache.read_errors"),
+        "abandoned_threads": c("autotune.abandoned_threads"),
+    }
     return {"counters": counters, "spans": spans, "cache": cache,
-            "collectives": collectives}
+            "collectives": collectives, "resilience": resilience}
 
 
 def _json_safe(obj: Any):

@@ -26,15 +26,25 @@ def get_device_mesh_config() -> Tuple[int, int]:
     return _DEFAULT_MESH_CONFIG
 
 
+def _validate_mesh_dims(nrows: int, ncols: int) -> Tuple[int, int]:
+    """A mesh needs at least one core per axis; zero or negative dims
+    would silently break every downstream shape check and core-id map."""
+    nrows, ncols = int(nrows), int(ncols)
+    if nrows < 1 or ncols < 1:
+        raise ValueError(
+            f"mesh config dims must be >= 1, got {(nrows, ncols)}")
+    return nrows, ncols
+
+
 def set_device_mesh_config(nrows: int, ncols: int) -> None:
     global _DEFAULT_MESH_CONFIG
-    _DEFAULT_MESH_CONFIG = (int(nrows), int(ncols))
+    _DEFAULT_MESH_CONFIG = _validate_mesh_dims(nrows, ncols)
 
 
 @contextlib.contextmanager
 def mesh_config(nrows: int, ncols: int):
     """Scoped mesh config, used by tests and by MeshTensor tracing."""
-    _CURRENT.append((int(nrows), int(ncols)))
+    _CURRENT.append(_validate_mesh_dims(nrows, ncols))
     try:
         yield (nrows, ncols)
     finally:

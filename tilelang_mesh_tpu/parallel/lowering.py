@@ -28,6 +28,7 @@ from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
                   CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
                   collect, walk)
 from ..observability import tracer as _trace
+from ..resilience import faults as _faults
 from ..transform.plan import plan_kernel
 from .device_mesh import core_id_to_tuple, make_jax_mesh
 
@@ -293,6 +294,7 @@ def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
            "wire_bytes": payload * hops}
     if isinstance(c, CommAllReduce):
         rec["reduce_type"] = c.reduce_type
+    _faults.maybe_fail("comm.collective", kernel=kernel, op=kind)
     _trace.event("comm.collective", "comm", **rec)
     _trace.inc("comm.ops", op=kind)
     _trace.inc("comm.bytes", rec["wire_bytes"], op=kind)

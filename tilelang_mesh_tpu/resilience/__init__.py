@@ -1,0 +1,37 @@
+"""Resilience subsystem: fault injection, retry/backoff, circuit breaking.
+
+The compile/tune path is a long-running service in production: a torn
+cache write, a wedged XLA compile, or a flaky autotune trial must degrade
+the run, not corrupt or abort it. This package provides the three
+building blocks the rest of the pipeline leans on:
+
+- ``faults``  — deterministic fault injection: named sites armed by
+  ``TL_TPU_FAULTS`` (or ``inject()`` in tests), seeded per clause so a
+  chaos run replays exactly (see docs/robustness.md for the grammar)
+- ``errors``  — the ``TLError`` taxonomy (transient / timeout /
+  deterministic) + ``classify()`` for foreign exceptions
+- ``retry``   — jittered exponential backoff (``retry_call``) and a
+  per-failure-signature ``CircuitBreaker``
+
+Consumers: ``cache/kernel_cache.py`` (atomic writes, checksum verify,
+quarantine, per-key locks), ``autotuner/`` (trial classification, retry,
+sweep journal), ``jit/kernel.py`` (interpreter fallback under
+``TL_TPU_FALLBACK=interp``), ``engine/lower.py`` + ``parallel/lowering.py``
+(per-phase fault sites). Everything is observable: injections, retries,
+breaker trips, quarantines, and degradations all land in the tracer.
+"""
+
+from .errors import (DeterministicError, InjectedFault, TLError,
+                     TLTimeoutError, TransientError, classify,
+                     error_signature)
+from .faults import (FAULT_SITES, CorruptionRequest, FaultSpec,
+                     active_specs, inject, maybe_fail, parse_fault_spec)
+from .retry import CircuitBreaker, RetryPolicy, global_breaker, retry_call
+
+__all__ = [
+    "TLError", "TransientError", "DeterministicError", "TLTimeoutError",
+    "InjectedFault", "classify", "error_signature",
+    "FAULT_SITES", "FaultSpec", "CorruptionRequest", "maybe_fail", "inject",
+    "parse_fault_spec", "active_specs",
+    "RetryPolicy", "CircuitBreaker", "retry_call", "global_breaker",
+]

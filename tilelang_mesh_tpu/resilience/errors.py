@@ -1,0 +1,118 @@
+"""Structured error taxonomy for the resilience subsystem.
+
+Every fault the pipeline can recover from is classified into one of three
+kinds, and the retry/fallback machinery keys its decisions on that kind:
+
+- ``transient``     — worth retrying (flaky I/O, injected chaos, OOM-ish
+                      resource pressure that clears). Retried with jittered
+                      exponential backoff up to ``TL_TPU_RETRY_MAX`` times.
+- ``timeout``       — the operation wedged past its wall-clock budget.
+                      Retried at most once (a wedged XLA compile usually
+                      wedges again); counted separately so sweeps can report
+                      "slow" distinctly from "broken".
+- ``deterministic`` — retrying cannot help (type errors, semantic-check
+                      failures, codegen bugs). Never retried; repeated
+                      occurrences of the same signature trip the circuit
+                      breaker so sweeps stop burning time on them.
+
+``TLError`` subclasses carry ``site`` (the fault-site name, e.g.
+``autotune.trial``) and ``phase`` (the pipeline phase, e.g. ``lower.plan``)
+so a failure deep in a worker thread is still attributable in logs and
+traces. Foreign exceptions are mapped by ``classify()``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Optional
+
+__all__ = [
+    "TLError", "TransientError", "DeterministicError", "TLTimeoutError",
+    "InjectedFault", "classify", "error_signature",
+]
+
+
+class TLError(Exception):
+    """Base of the structured error hierarchy. Carries enough context
+    (kind / site / phase) that the retry machinery and the tracer never
+    have to parse messages."""
+
+    kind = "deterministic"
+
+    def __init__(self, message: str, *, site: Optional[str] = None,
+                 phase: Optional[str] = None):
+        super().__init__(message)
+        self.site = site
+        self.phase = phase
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = ", ".join(f"{k}={v}" for k, v in
+                        (("site", self.site), ("phase", self.phase)) if v)
+        return f"{base} [{ctx}]" if ctx else base
+
+
+class TransientError(TLError):
+    """A failure that is expected to clear on retry."""
+    kind = "transient"
+
+
+class DeterministicError(TLError):
+    """A failure retrying cannot fix; trips the circuit breaker."""
+    kind = "deterministic"
+
+
+class TLTimeoutError(TLError, concurrent.futures.TimeoutError):
+    """An operation exceeded its wall-clock budget. Also a
+    ``concurrent.futures.TimeoutError`` so pre-taxonomy callers (and the
+    reference tuner idiom) keep catching it."""
+    kind = "timeout"
+
+
+class InjectedFault(TransientError):
+    """Raised by the fault-injection registry. Subtyped per spec ``kind``
+    via ``as_kind()`` so injected faults flow through the exact same
+    classification path as organic ones."""
+
+    @staticmethod
+    def as_kind(kind: str, site: str) -> TLError:
+        msg = f"injected fault at {site}"
+        if kind == "timeout":
+            return TLTimeoutError(msg, site=site)
+        if kind == "deterministic":
+            return DeterministicError(msg, site=site)
+        if kind == "oserror":
+            return _InjectedOSError(msg)
+        return InjectedFault(msg, site=site)
+
+
+class _InjectedOSError(OSError):
+    """An injected I/O failure — a plain OSError so the cache's organic
+    OSError handling is what gets exercised."""
+
+
+# exception types that are transient regardless of message: I/O pressure
+# and wedged-worker timeouts
+_TRANSIENT_TYPES = (OSError, IOError, ConnectionError, MemoryError)
+_TIMEOUT_TYPES = (concurrent.futures.TimeoutError, TimeoutError)
+
+
+def classify(exc: BaseException) -> str:
+    """Map any exception to ``transient`` / ``timeout`` /
+    ``deterministic``. TLErrors self-classify; foreign exceptions fall
+    back to type-based rules (I/O errors are transient, everything else —
+    TypeError, ValueError, codegen failures — is deterministic)."""
+    if isinstance(exc, TLError):
+        return exc.kind
+    if isinstance(exc, _TIMEOUT_TYPES):
+        return "timeout"
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return "transient"
+    return "deterministic"
+
+
+def error_signature(exc: BaseException, limit: int = 80) -> str:
+    """A stable signature for circuit-breaker bucketing: exception type
+    plus the head of its message (long messages often embed addresses or
+    shapes that would defeat bucketing)."""
+    return f"{type(exc).__name__}:{str(exc)[:limit]}"

@@ -1,0 +1,152 @@
+"""Retry with jittered exponential backoff + per-signature circuit breaker.
+
+Policy knobs come from env (``TL_TPU_RETRY_MAX`` / ``TL_TPU_RETRY_BASE_MS``
+/ ``TL_TPU_RETRY_MAX_MS`` / ``TL_TPU_BREAKER_THRESHOLD``) so an operator
+can harden or loosen a serving process without a code change. Decisions
+key on the error taxonomy (errors.classify):
+
+- transient    — retried up to ``max_attempts`` total attempts
+- timeout      — retried at most once (a wedged compile usually wedges
+                 again; one retry covers scheduler hiccups)
+- deterministic — never retried, and its signature is fed to the circuit
+                 breaker: after ``threshold`` occurrences the breaker
+                 opens and callers (the autotuner sweep) fast-fail
+                 matching work instead of burning the timeout budget on
+                 a failure mode that is already understood.
+
+Every retry emits a ``resilience.retry`` tracer event + counter; every
+breaker trip emits ``resilience.breaker_open``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ..env import env
+from ..observability import tracer as _trace
+from .errors import classify, error_signature
+
+__all__ = ["RetryPolicy", "CircuitBreaker", "retry_call", "global_breaker"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.resilience")
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff: delay(n) = min(base * 2^n, cap),
+    scaled by a uniform jitter in [1-jitter, 1] so synchronized workers
+    (autotune thread pool, multi-process cache writers) decorrelate."""
+
+    max_attempts: int = 3          # total attempts, including the first
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 0.5
+    rng: random.Random = field(default_factory=lambda: random.Random(0),
+                               repr=False)
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(max_attempts=max(1, env.TL_TPU_RETRY_MAX),
+                   base_delay_s=env.TL_TPU_RETRY_BASE_MS / 1e3,
+                   max_delay_s=env.TL_TPU_RETRY_MAX_MS / 1e3)
+
+    def delay_s(self, attempt: int) -> float:
+        raw = min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+        return raw * (1.0 - self.jitter * self.rng.random())
+
+
+class CircuitBreaker:
+    """Per-failure-signature breaker. ``record_failure`` counts identical
+    failures; at ``threshold`` the signature's circuit opens and
+    ``is_open`` reports it until ``reset``. Thread-safe — the autotuner's
+    trial threads share one instance."""
+
+    def __init__(self, threshold: Optional[int] = None):
+        self.threshold = threshold if threshold is not None \
+            else max(1, env.TL_TPU_BREAKER_THRESHOLD)
+        self._lock = threading.Lock()
+        self._failures: Dict[str, int] = {}
+
+    def record_failure(self, signature: str) -> bool:
+        """Count one failure; returns True the moment this signature's
+        circuit opens (exactly once, so callers can log/trace the trip)."""
+        with self._lock:
+            n = self._failures.get(signature, 0) + 1
+            self._failures[signature] = n
+        if n == self.threshold:
+            _trace.inc("resilience.breaker_open")
+            _trace.event("resilience.breaker_open", "resilience",
+                         signature=signature, failures=n)
+            logger.warning("circuit breaker OPEN for %r after %d identical "
+                           "failures", signature, n)
+            return True
+        return False
+
+    def is_open(self, signature: str) -> bool:
+        with self._lock:
+            return self._failures.get(signature, 0) >= self.threshold
+
+    def reset(self, signature: Optional[str] = None) -> None:
+        with self._lock:
+            if signature is None:
+                self._failures.clear()
+            else:
+                self._failures.pop(signature, None)
+
+
+_GLOBAL_BREAKER: Optional[CircuitBreaker] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_breaker() -> CircuitBreaker:
+    """The process-wide breaker shared by autotune sweeps and compile
+    retries, so repeated deterministic failures are recognized across
+    call sites."""
+    global _GLOBAL_BREAKER
+    with _GLOBAL_LOCK:
+        if _GLOBAL_BREAKER is None:
+            _GLOBAL_BREAKER = CircuitBreaker()
+        return _GLOBAL_BREAKER
+
+
+def retry_call(fn: Callable, *, site: str, policy: Optional[RetryPolicy] = None,
+               breaker: Optional[CircuitBreaker] = None,
+               sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` under the retry policy. Deterministic failures
+    propagate immediately (after feeding the breaker); transients retry
+    with backoff; timeouts retry once. Returns fn's value or raises the
+    last error."""
+    policy = policy or RetryPolicy.from_env()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = classify(e)
+            sig = error_signature(e)
+            # only deterministic failures feed the breaker: transients are
+            # exactly what retry exists to absorb, and counting them would
+            # open the circuit on the flakiness it is meant to ride out
+            if breaker is not None and kind == "deterministic":
+                breaker.record_failure(sig)
+            retryable = (kind == "transient" and
+                         attempt + 1 < policy.max_attempts) or \
+                        (kind == "timeout" and attempt == 0 and
+                         policy.max_attempts > 1)
+            if not retryable or (breaker is not None and breaker.is_open(sig)):
+                raise
+            d = policy.delay_s(attempt)
+            attempt += 1
+            _trace.inc("resilience.retry", site=site, kind=kind)
+            _trace.event("resilience.retry", "resilience", site=site,
+                         kind=kind, attempt=attempt, delay_s=round(d, 4),
+                         error=f"{type(e).__name__}: {e}")
+            logger.info("retrying %s after %s (attempt %d/%d, %.0f ms)",
+                        site, type(e).__name__, attempt + 1,
+                        policy.max_attempts, d * 1e3)
+            sleep(d)

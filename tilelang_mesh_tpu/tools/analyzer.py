@@ -263,18 +263,97 @@ def format_trace_report(records) -> str:
     return "\n".join(lines)
 
 
+def summarize_faults(records) -> dict:
+    """Aggregate the resilience events of a JSONL trace: injected faults
+    and retries per site, degradations per kernel, quarantines, and
+    breaker trips — the chaos-run counterpart of ``summarize_trace``."""
+    injected: dict = {}
+    retries: dict = {}
+    degraded: dict = {}
+    quarantines = 0
+    breaker_opens = 0
+    abandoned = 0
+    for r in records:
+        name = r.get("name")
+        attrs = r.get("attrs", {})
+        if r.get("type") == "event":
+            if name == "fault.injected":
+                site = attrs.get("site", "?")
+                injected[site] = injected.get(site, 0) + 1
+            elif name == "resilience.retry":
+                site = attrs.get("site", "?")
+                retries[site] = retries.get(site, 0) + 1
+            elif name == "degraded":
+                k = attrs.get("kernel", "?")
+                degraded[k] = degraded.get(k, 0) + 1
+            elif name == "cache.quarantine":
+                quarantines += 1
+            elif name == "resilience.breaker_open":
+                breaker_opens += 1
+            elif name == "autotune.thread_abandoned":
+                abandoned += 1
+        elif r.get("type") == "counter":
+            # counters survive even when event recording was off or
+            # overflowed; take the max of the two views per bucket
+            if name == "cache.quarantined":
+                quarantines = max(quarantines, int(r["value"]))
+            elif name == "resilience.breaker_open":
+                breaker_opens = max(breaker_opens, int(r["value"]))
+            elif name == "autotune.abandoned_threads":
+                abandoned = max(abandoned, int(r["value"]))
+    return {"injected": injected, "retries": retries, "degraded": degraded,
+            "quarantines": quarantines, "breaker_opens": breaker_opens,
+            "abandoned_threads": abandoned}
+
+
+def format_faults_report(records) -> str:
+    """Human-readable resilience summary of a JSONL trace (CLI
+    ``--faults``): what was injected, what was retried, what degraded."""
+    s = summarize_faults(records)
+    lines = []
+    sites = sorted(set(s["injected"]) | set(s["retries"]))
+    if sites:
+        lines.append("fault injection / retry by site:")
+        lines.append(f"  {'site':<22} {'injected':>8} {'retries':>8}")
+        for site in sites:
+            lines.append(f"  {site:<22} {s['injected'].get(site, 0):>8} "
+                         f"{s['retries'].get(site, 0):>8}")
+    else:
+        lines.append("no injected faults or retries in this trace")
+    if s["degraded"]:
+        lines.append("degraded kernels (interpreter fallback):")
+        for k in sorted(s["degraded"]):
+            lines.append(f"  {k:<32} {s['degraded'][k]}")
+    for label, key in (("quarantined cache entries", "quarantines"),
+                       ("circuit-breaker trips", "breaker_opens"),
+                       ("abandoned autotune workers", "abandoned_threads")):
+        if s[key]:
+            lines.append(f"{label}: {s[key]}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(
         prog="python -m tilelang_mesh_tpu.tools.analyzer",
         description="Analyze an observability JSONL trace "
                     "(TL_TPU_TRACE=1 run).")
-    ap.add_argument("--trace", required=True, metavar="FILE",
+    ap.add_argument("--trace", metavar="FILE",
                     help="JSONL trace file (observability.write_jsonl / "
-                         "a bench.py artifact)")
+                         "a bench.py artifact): print the compile-phase "
+                         "breakdown")
+    ap.add_argument("--faults", metavar="FILE",
+                    help="JSONL trace file: print injected-fault / retry / "
+                         "degradation counts per site (chaos runs, "
+                         "docs/robustness.md)")
     args = ap.parse_args(argv)
+    if not args.trace and not args.faults:
+        ap.error("one of --trace or --faults is required")
     from ..observability import read_jsonl
-    print(format_trace_report(read_jsonl(args.trace)))  # noqa: T201 — CLI
+    if args.trace:
+        print(format_trace_report(read_jsonl(args.trace)))  # noqa: T201
+    if args.faults:
+        print(format_faults_report(read_jsonl(args.faults)))  # noqa: T201
     return 0
 
 
