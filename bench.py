@@ -153,10 +153,30 @@ def _time_fn(fn, args, rep=None, rounds=3):
     return dt
 
 
+def _slope_stats(samples_s, rep):
+    """Latency digest of per-iteration slope samples: p50/p90/p99/MAD in
+    ms plus sample/rep counts — the noise information the perf-diff gate
+    (tools/perfdiff.py) keys its median+MAD thresholds on. Delegates to
+    the profiler's ONE digest implementation (imported lazily: only
+    children import the package; the parent orchestrator must never
+    touch jax)."""
+    from tilelang_mesh_tpu.profiler import _stats_ms
+    st = _stats_ms([x * 1e3 for x in samples_s], reps=rep)
+    return {
+        "p50_ms": round(st["p50_ms"], 5),
+        "p90_ms": round(st["p90_ms"], 5),
+        "p99_ms": round(st["p99_ms"], 5),
+        "mad_ms": round(st["mad_ms"], 6),
+        "samples": st["samples"],
+        "reps": st["reps"],
+    }
+
+
 def _compare(ours_fn, ref_fn, args, rounds=3, ref_args=None):
     """Interleaved A/B timing: per-round (ours, ref) slope pairs taken
     back-to-back so device-throughput drift cancels in the ratio; returns
-    (dt_ours, dt_ref, vs_baseline) with the per-round median ratio."""
+    (dt_ours, dt_ref, vs_baseline, stats_ours, stats_ref) with the
+    per-round median ratio and the per-side latency digests."""
     ref_args = args if ref_args is None else ref_args
     run_o = _make_runner(ours_fn, args)
     run_r = _make_runner(ref_fn, ref_args)
@@ -173,7 +193,9 @@ def _compare(ours_fn, ref_fn, args, rounds=3, ref_args=None):
     vs = ratios[len(ratios) // 2]
     dts_o = sorted(o for o, _ in pairs)
     dts_r = sorted(r for _, r in pairs)
-    return (dts_o[len(dts_o) // 2], dts_r[len(dts_r) // 2], vs)
+    st_o = _slope_stats((o for o, _ in pairs), rep_o)
+    st_r = _slope_stats((r for _, r in pairs), rep_r)
+    return (dts_o[len(dts_o) // 2], dts_r[len(dts_r) // 2], vs, st_o, st_r)
 
 
 def _pick_best(cands, check, what, rounds=1):
@@ -314,6 +336,29 @@ def cfg_gemm(M, N, K, dtype="bfloat16"):
                 flops=2.0 * M * N * K, peak_class="bf16",
                 ours=ours, ref=ref, args=(a, b), rel_tol=3e-2,
                 checked=True)
+
+
+def cfg_gemm_smoke(M=256, N=256, K=256, dtype="float32"):
+    """CI perf-smoke config: tiny GEMM against the plain XLA dot
+    reference. Unlike cfg_gemm it needs no hand-Pallas baseline, so it
+    runs anywhere — CPU interpret mode included — which is what the
+    ci.yml perf-smoke step and the checked-in perf baseline use."""
+    import jax.numpy as jnp
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.1, jnp.dtype(dtype))
+    b = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.dtype(dtype))
+    ours = matmul_kernel(M, N, K, in_dtype=dtype, out_dtype="float32",
+                         block_M=128, block_N=128, block_K=128).func
+
+    def ref(a_, b_):
+        return jnp.dot(a_, b_, preferred_element_type=jnp.float32)
+
+    return dict(metric=f"{dtype} GEMM {M}x{N}x{K} smoke "
+                       f"(tile DSL vs XLA dot)",
+                flops=2.0 * M * N * K, peak_class="f32",
+                ours=ours, ref=ref, args=(a, b), rel_tol=3e-2)
 
 
 def cfg_flash(D, S=2048, B=2, H=16, causal=True):
@@ -844,8 +889,8 @@ def run_config(name, build, peaks, rounds=3):
         ref_out = ref_out[0] if isinstance(ref_out, tuple) else ref_out
         _check_close(ours_out, ref_out, spec["rel_tol"])
 
-    dt_o, dt_r, vs = _compare(spec["ours"], spec["ref"], args,
-                              rounds=rounds, ref_args=ref_args)
+    dt_o, dt_r, vs, st_o, st_r = _compare(spec["ours"], spec["ref"], args,
+                                          rounds=rounds, ref_args=ref_args)
     if spec.get("bytes"):
         # bandwidth-bound config (decode): report achieved GB/s of the
         # mandatory traffic, capped against the chip's HBM bandwidth
@@ -862,6 +907,7 @@ def run_config(name, build, peaks, rounds=3):
         raise BenchError(
             f"{val:.1f} / {ref_val:.1f} (baseline) {unit} exceeds "
             f"physical peak {cap:.0f}: measurement broken")
+    peak = cap / 1.1
     rec = {
         "metric": spec["metric"],
         "value": round(val, 2),
@@ -869,6 +915,17 @@ def run_config(name, build, peaks, rounds=3):
         "vs_baseline": round(vs, 4),
         "latency_ms": round(dt_o * 1e3, 4),
         "baseline_ms": round(dt_r * 1e3, 4),
+        # latency distribution + noise (perf-diff gate inputs)
+        "latency_p50_ms": st_o["p50_ms"],
+        "latency_p90_ms": st_o["p90_ms"],
+        "latency_p99_ms": st_o["p99_ms"],
+        "latency_mad_ms": st_o["mad_ms"],
+        "latency_samples": st_o["samples"],
+        "reps": st_o["reps"],
+        "baseline_mad_ms": st_r["mad_ms"],
+        # roofline: achieved fraction of this chip's relevant peak
+        "peak": round(peak, 1),
+        "utilization": round(val / peak, 4) if peak else None,
         "config": name,
     }
     rec.update(spec.get("extra", {}))
@@ -904,6 +961,7 @@ def _attach_observability(rec: dict, name: str) -> dict:
             "compile_phase_ms": phase_ms,
             "cache": summ["cache"],
             "collectives": summ["collectives"],
+            "runtime": summ.get("runtime", {}),
         }
         # per-config semantics: the next config (--in-process mode runs
         # many in one process) must not inherit this one's spans/counters
@@ -993,6 +1051,7 @@ def _config_builders(q: bool):
     worker for many minutes, losing every config after it — the blast
     radius of the riskiest config must not include the others."""
     return [
+        ("gemm_smoke", lambda: cfg_gemm_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),
@@ -1155,6 +1214,12 @@ def main():
     if args.only:
         keep = set(args.only.split(","))
         configs = [(n, b) for n, b in configs if n in keep]
+    else:
+        # gemm_smoke exists for the CI perf-smoke job (--only) and as a
+        # perf-diff baseline anchor; a default sweep excludes it so the
+        # tiny XLA-dot comparison cannot shift the headline
+        # geomean_vs_baseline of the BENCH_r* trajectory
+        configs = [(n, b) for n, b in configs if n != "gemm_smoke"]
     names = [n for n, _ in configs]
 
     cfg_timeout = _env_float("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800)

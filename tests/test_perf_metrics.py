@@ -1,0 +1,482 @@
+"""Runtime performance observability (ISSUE 3).
+
+Covers: histogram bucket/quantile math, merge, and Prometheus
+``_bucket``/``_sum``/``_count`` rendering; runtime dispatch recording
+off-by-default (zero observations, no per-kernel state allocated) and
+on/sampled when ``TL_TPU_RUNTIME_METRICS=1``; ``metrics_summary()``'s
+``runtime`` section; the noise-aware perf-diff gate (a synthetic 2x
+regression fails, MAD-level jitter passes, the table names the
+regressing config); ``PerfReport`` roofline math against hand-computed
+GEMM FLOPs/bytes; and the multi-output ``_consume``/``do_bench`` fix.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import histogram as hist
+from tilelang_mesh_tpu.observability import runtime as rt
+from tilelang_mesh_tpu.tools import analyzer
+from tilelang_mesh_tpu.tools.perfdiff import (format_perf_diff,
+                                              load_bench_records,
+                                              perf_diff,
+                                              perf_diff_exit_code)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorders(monkeypatch):
+    """Every test starts with empty histograms/rings and runtime
+    recording OFF (the default)."""
+    monkeypatch.delenv("TL_TPU_RUNTIME_METRICS", raising=False)
+    monkeypatch.delenv("TL_TPU_RUNTIME_SAMPLE", raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+@pytest.fixture()
+def hermetic_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+    tilelang.clear_cache()
+    yield tmp_path
+    tilelang.clear_cache()
+
+
+def _scale_func(M=64, N=128):
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, B)
+    return scale
+
+
+# ---------------------------------------------------------------------------
+# histogram math
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    def test_bucket_le_semantics(self):
+        h = hist.Histogram([1.0, 2.0, 4.0])
+        for v, want in [(0.5, 0), (1.0, 0), (1.5, 1), (2.0, 1),
+                        (4.0, 2), (5.0, 3)]:
+            assert h._bucket_index(v) == want, v
+        h.observe(1.0)
+        h.observe(5.0)
+        assert h.counts == [1, 0, 0, 1]
+        assert h.count == 2 and h.sum == 6.0
+        assert h.min == 1.0 and h.max == 5.0
+
+    def test_default_bounds_log_spaced(self):
+        b = hist.default_bounds()
+        assert len(b) == 27
+        for lo, hi in zip(b, b[1:]):
+            assert hi / lo == pytest.approx(2.0)
+        assert b[0] == pytest.approx(1e-6)
+
+    def test_quantiles(self):
+        h = hist.Histogram()
+        for _ in range(90):
+            h.observe(1e-3)
+        for _ in range(10):
+            h.observe(64e-3)
+        # p50 lands in the 1ms bucket, p99 in the 64ms bucket
+        assert h.quantile(0.5) <= 2e-3
+        assert h.quantile(0.99) >= 30e-3
+        assert h.quantile(0.0) == 1e-3        # clamps to observed min
+        assert h.quantile(1.0) == 64e-3       # and max
+        assert h.mean == pytest.approx((90 * 1e-3 + 10 * 64e-3) / 100)
+        assert hist.Histogram().quantile(0.5) is None
+
+    def test_non_finite_observations_dropped(self):
+        h = hist.Histogram()
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        assert h.count == 0
+
+    def test_merge(self):
+        a, b = hist.Histogram(), hist.Histogram()
+        for v in (1e-4, 2e-4, 3e-4):
+            a.observe(v)
+        for v in (1e-2, 2e-2):
+            b.observe(v)
+        a.merge(b)
+        assert a.count == 5
+        assert a.sum == pytest.approx(6e-4 + 3e-2)
+        assert a.max == 2e-2 and a.min == 1e-4
+        with pytest.raises(ValueError):
+            a.merge(hist.Histogram([1.0, 2.0]))
+
+    def test_round_trip_dict(self):
+        h = hist.Histogram()
+        for v in (1e-4, 5e-3, 0.2):
+            h.observe(v)
+        h2 = hist.Histogram.from_dict(json.loads(json.dumps(h.to_dict())))
+        assert h2.count == h.count
+        assert h2.cumulative() == h.cumulative()
+        assert h2.quantile(0.9) == h.quantile(0.9)
+
+    def test_cumulative_is_monotonic_and_totals(self):
+        h = hist.Histogram()
+        for v in (1e-5, 1e-3, 1e-1, 100.0):  # incl. overflow bucket
+            h.observe(v)
+        cum = h.cumulative()
+        assert cum == sorted(cum)
+        assert cum[-1] == h.count == 4
+
+
+class TestPrometheusRendering:
+    def test_bucket_sum_count_series(self):
+        obs.observe(rt.HIST_NAME, 0.002, kernel="gemm", source="dispatch")
+        obs.observe(rt.HIST_NAME, 0.004, kernel="gemm", source="dispatch")
+        text = obs.to_prometheus_text()
+        assert "# TYPE tl_tpu_kernel_latency_seconds histogram" in text
+        lines = text.splitlines()
+        buckets = [l for l in lines
+                   if l.startswith("tl_tpu_kernel_latency_seconds_bucket")]
+        assert buckets, text
+        assert any('le="+Inf"' in l for l in buckets)
+        assert all('kernel="gemm"' in l for l in buckets)
+        # +Inf bucket equals _count; _sum is the observed total
+        inf_val = int([l for l in buckets if 'le="+Inf"' in l][0]
+                      .rsplit(" ", 1)[1])
+        count = int([l for l in lines if
+                     l.startswith("tl_tpu_kernel_latency_seconds_count")][0]
+                    .rsplit(" ", 1)[1])
+        s = float([l for l in lines if
+                   l.startswith("tl_tpu_kernel_latency_seconds_sum")][0]
+                  .rsplit(" ", 1)[1])
+        assert inf_val == count == 2
+        assert s == pytest.approx(0.006)
+        # cumulative counts never decrease along the le ladder
+        vals = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert vals == sorted(vals)
+
+    def test_jsonl_carries_histograms(self):
+        obs.observe(rt.HIST_NAME, 0.001, kernel="k1", source="dispatch")
+        recs = [json.loads(l) for l in obs.to_jsonl().splitlines()]
+        hs = [r for r in recs if r["type"] == "histogram"]
+        assert len(hs) == 1
+        assert hs[0]["name"] == rt.HIST_NAME
+        assert hs[0]["labels"] == {"kernel": "k1", "source": "dispatch"}
+        assert hs[0]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatch recording
+# ---------------------------------------------------------------------------
+
+class TestRuntimeRecording:
+    def test_off_by_default_no_observations(self, hermetic_cache):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        x = np.ones((64, 128), np.float32)
+        for _ in range(3):
+            k(x)
+        # the acceptance bound: zero histogram observations AND no
+        # per-kernel state allocated on the disabled hit path
+        assert obs.get_registry().total_observations() == 0
+        assert rt._states == {}
+        assert rt.recent(k.artifact.name) == []
+        assert obs.metrics_summary()["runtime"] == {}
+
+    def test_enabled_records_and_rings(self, hermetic_cache, monkeypatch):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        x = np.ones((64, 128), np.float32)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        k(x)   # warm-up call: compile time must NOT land in the digest
+        assert obs.get_registry().total_observations() == 0
+        for _ in range(4):
+            k(x)
+        h = obs.get_histogram(rt.HIST_NAME, kernel=k.artifact.name,
+                              source="dispatch")
+        assert h is not None and h.count == 4
+        ring = rt.recent(k.artifact.name)
+        assert len(ring) == 4
+        assert all(r["latency_ms"] > 0 for r in ring)
+        assert all(r["source"] == "dispatch" for r in ring)
+        summ = obs.metrics_summary()["runtime"]
+        assert k.artifact.name in summ
+        digest = summ[k.artifact.name]
+        assert digest["count"] == 4
+        assert digest["p50_ms"] is not None
+        assert digest["p99_ms"] >= digest["p50_ms"] > 0
+        assert digest["sources"] == ["dispatch"]
+
+    def test_sampling_knob(self, hermetic_cache, monkeypatch):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        x = np.ones((64, 128), np.float32)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        monkeypatch.setenv("TL_TPU_RUNTIME_SAMPLE", "3")
+        k(x)   # warm-up: not eligible for sampling
+        for _ in range(7):
+            k(x)
+        h = obs.get_histogram(rt.HIST_NAME, kernel=k.artifact.name,
+                              source="dispatch")
+        assert h is not None and h.count == 2   # warm calls 3 and 6
+
+    def test_ring_buffer_bounded(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_RUNTIME_RING", "4")
+        for i in range(10):
+            rt.record("k", 1e-3 * (i + 1))
+        ring = rt.recent("k")
+        assert len(ring) == 4
+        assert ring[-1]["latency_ms"] == pytest.approx(10.0)
+
+    def test_results_unchanged_by_recording(self, hermetic_cache,
+                                            monkeypatch):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        x = np.arange(64 * 128, dtype=np.float32).reshape(64, 128)
+        off = np.asarray(k(x))
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        on = np.asarray(k(x))
+        np.testing.assert_array_equal(off, on)
+
+
+# ---------------------------------------------------------------------------
+# profiler: stats + multi-output consume + PerfReport
+# ---------------------------------------------------------------------------
+
+class TestProfilerStats:
+    def test_do_bench_stats_fields(self):
+        import jax.numpy as jnp
+        from tilelang_mesh_tpu.profiler import do_bench_stats
+
+        def f(a):
+            return a * 2.0
+
+        stats = do_bench_stats(f, jnp.ones((8, 128)), warmup=1, rep=2,
+                               backend="wall")
+        for key in ("p50_ms", "p90_ms", "p99_ms", "mean_ms", "min_ms",
+                    "max_ms", "mad_ms", "samples", "reps"):
+            assert key in stats, key
+        assert stats["samples"] == stats["reps"] == 2
+        assert stats["min_ms"] <= stats["p50_ms"] <= stats["max_ms"]
+
+    def test_multi_output_wall_timing(self):
+        """The wall backend must block on EVERY output leaf — a
+        multi-output fn times without error and yields positive
+        latency (the old code touched only the first leaf)."""
+        import jax.numpy as jnp
+        from tilelang_mesh_tpu.profiler import do_bench
+
+        def f(a):
+            return a + 1.0, (a * 2.0, a - 1.0)   # nested pytree
+
+        ms = do_bench(f, jnp.ones((8, 128)), warmup=1, rep=3,
+                      backend="wall")
+        assert ms > 0
+
+    def test_perf_report_roofline_math(self, hermetic_cache):
+        from tilelang_mesh_tpu.carver.arch import TPU_V5E
+        from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+        M = N = K = 128
+        k = matmul_kernel(M, N, K, block_M=128, block_N=128, block_K=128,
+                          in_dtype="float32", out_dtype="float32")
+        rep = k.get_profiler().perf_report(rep=2, rounds=2, backend="wall",
+                                           arch=TPU_V5E)
+        # hand-computed GEMM work: 2*M*N*K FLOPs; one pass over A, B, C
+        assert rep.flops == 2 * M * N * K
+        assert rep.bytes_moved == (M * K + K * N + M * N) * 4
+        t_s = rep.latency["p50_ms"] / 1e3
+        assert rep.achieved_tflops == pytest.approx(
+            rep.flops / t_s / 1e12, rel=1e-9)
+        assert rep.achieved_gbps == pytest.approx(
+            rep.bytes_moved / t_s / 1e9, rel=1e-9)
+        assert rep.peak_tflops == TPU_V5E.bf16_tflops
+        assert rep.peak_gbps == TPU_V5E.hbm_gbps
+        assert rep.compute_utilization == pytest.approx(
+            rep.achieved_tflops / TPU_V5E.bf16_tflops)
+        assert rep.memory_utilization == pytest.approx(
+            rep.achieved_gbps / TPU_V5E.hbm_gbps)
+        assert rep.bound in ("compute", "memory")
+        assert rep.kernel == k.artifact.name
+        assert rep.vmem_ok
+        assert rep.ici_wire_bytes == 0 and rep.n_collectives == 0
+        # serializes clean
+        json.dumps(rep.to_dict())
+        # the measured median fed the shared runtime histogram
+        assert obs.metrics_summary()["runtime"][k.artifact.name][
+            "sources"] == ["bench"]
+
+    def test_perf_report_overrides(self, hermetic_cache):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        rep = k.get_profiler().perf_report(
+            rep=1, rounds=1, backend="wall", flops=10 ** 9,
+            bytes_moved=10 ** 6)
+        assert rep.flops == 10 ** 9 and rep.bytes_moved == 10 ** 6
+        assert rep.achieved_tflops is not None
+        assert rep.achieved_gbps is not None
+
+
+# ---------------------------------------------------------------------------
+# autotuner trials feed the histograms
+# ---------------------------------------------------------------------------
+
+class TestAutotuneFeedsHistograms:
+    def test_trial_latencies_recorded(self, hermetic_cache, tmp_path,
+                                      monkeypatch):
+        monkeypatch.setenv("TL_TPU_AUTOTUNE_CACHE_DIR",
+                           str(tmp_path / "autotune"))
+
+        @tilelang.autotune(configs=[{"block_M": 32}, {"block_M": 64}],
+                           warmup=1, rep=1, cache_results=False)
+        @tilelang.jit
+        def scale(M=64, N=128, block_M=64):
+            @T.prim_func
+            def f(A: T.Tensor((M, N), "float32"),
+                  B: T.Tensor((M, N), "float32")):
+                with T.Kernel(M // block_M) as bx:
+                    s = T.alloc_shared((block_M, N), "float32")
+                    T.copy(A[bx * block_M, 0], s)
+                    for i, j in T.Parallel(block_M, N):
+                        s[i, j] = s[i, j] * 2.0
+                    T.copy(s, B[bx * block_M, 0])
+            return f
+
+        scale(64, 128)
+        summ = obs.metrics_summary()["runtime"]
+        auto = [d for d in summ.values() if "autotune" in d["sources"]]
+        assert auto and sum(d["count"] for d in auto) == 2
+
+
+# ---------------------------------------------------------------------------
+# perf-diff gate
+# ---------------------------------------------------------------------------
+
+def _rec(config, p50, mad=0.02, **extra):
+    return {"config": config, "latency_p50_ms": p50,
+            "latency_mad_ms": mad, "reps": 30, **extra}
+
+
+class TestPerfDiff:
+    def test_flags_2x_regression_and_names_config(self):
+        base = [_rec("gemm", 1.0), _rec("flash", 5.0, mad=0.1)]
+        cur = [_rec("gemm", 2.0), _rec("flash", 5.02, mad=0.1)]
+        result = perf_diff(base, cur)
+        assert result["regressions"] == ["gemm"]
+        assert perf_diff_exit_code(result) == 1
+        assert perf_diff_exit_code(result, report_only=True) == 0
+        table = format_perf_diff(result)
+        assert "gemm" in table and "REGRESSION" in table
+        flash_row = [r for r in result["rows"]
+                     if r["config"] == "flash"][0]
+        assert flash_row["verdict"] == "ok"
+
+    def test_mad_level_jitter_passes(self):
+        base = [_rec("gemm", 1.0, mad=0.05), _rec("flash", 5.0, mad=0.2)]
+        cur = [_rec("gemm", 1.04, mad=0.05), _rec("flash", 5.15, mad=0.2)]
+        result = perf_diff(base, cur)
+        assert result["regressions"] == []
+        assert perf_diff_exit_code(result) == 0
+
+    def test_improvement_and_missing_and_new(self):
+        base = [_rec("a", 2.0), _rec("gone", 1.0)]
+        cur = [_rec("a", 1.0), _rec("fresh", 1.0),
+               {"config": "dead", "error": "boom"}]
+        r = perf_diff(base, cur)
+        assert r["improvements"] == ["a"]
+        assert set(r["missing"]) == {"gone", "dead"}
+        assert r["new"] == ["fresh"]
+        assert perf_diff_exit_code(r) == 0   # missing is not a regression
+
+    def test_legacy_median_only_records(self):
+        # pre-percentile artifacts (bare latency_ms, no MAD) still diff:
+        # the relative floor supplies the noise scale
+        base = [{"config": "g", "latency_ms": 1.0}]
+        cur2x = [{"config": "g", "latency_ms": 2.0}]
+        curok = [{"config": "g", "latency_ms": 1.01}]
+        assert perf_diff(base, cur2x)["regressions"] == ["g"]
+        assert perf_diff(base, curok)["regressions"] == []
+
+    def test_zero_mad_uses_relative_floor(self):
+        # a perfectly stable pair must not flag a 1% wobble
+        base = [_rec("g", 1.0, mad=0.0)]
+        cur = [_rec("g", 1.01, mad=0.0)]
+        assert perf_diff(base, cur)["regressions"] == []
+
+    def test_load_shapes(self, tmp_path):
+        recs = [_rec("g", 1.0), {"config": "bad", "error": "x"}]
+        jsonl = tmp_path / "a.jsonl"
+        jsonl.write_text("\n".join(json.dumps(r) for r in recs)
+                         + "\n# comment\n")
+        assert len(load_bench_records(jsonl)) == 2
+        arr = tmp_path / "b.json"
+        arr.write_text(json.dumps(recs))
+        assert len(load_bench_records(arr)) == 2
+        wrapper = tmp_path / "c.json"
+        wrapper.write_text(json.dumps(
+            {"n": 1, "rc": 0,
+             "tail": "\n".join(json.dumps(r) for r in recs)}))
+        assert len(load_bench_records(wrapper)) == 2
+
+
+# ---------------------------------------------------------------------------
+# analyzer CLI
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerCLI:
+    def _write(self, tmp_path, name, recs):
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(r) for r in recs))
+        return p
+
+    def test_perf_diff_exit_codes(self, tmp_path, capsys):
+        b = self._write(tmp_path, "base.json", [_rec("gemm", 1.0)])
+        bad = self._write(tmp_path, "bad.json", [_rec("gemm", 2.0)])
+        ok = self._write(tmp_path, "ok.json", [_rec("gemm", 1.01)])
+        assert analyzer.main(["perf-diff", str(b), str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "gemm" in out and "REGRESSION" in out
+        assert analyzer.main(["perf-diff", str(b), str(ok)]) == 0
+        assert analyzer.main(["perf-diff", str(b), str(bad),
+                              "--report-only"]) == 0
+
+    def test_legacy_flag_spellings(self, tmp_path, capsys):
+        b = self._write(tmp_path, "base.json", [_rec("gemm", 1.0)])
+        bad = self._write(tmp_path, "bad.json", [_rec("gemm", 2.0)])
+        assert analyzer.main(["--perf-diff", str(b), str(bad)]) == 1
+        capsys.readouterr()
+        tr = self._write(tmp_path, "t.jsonl", [
+            {"type": "span", "name": "plan", "cat": "lower",
+             "dur_us": 1000.0}])
+        assert analyzer.main(["--trace", str(tr)]) == 0
+        assert "plan" in capsys.readouterr().out
+        # '=' spelling and combined flags (the pre-subcommand surface)
+        assert analyzer.main([f"--trace={tr}"]) == 0
+        assert "plan" in capsys.readouterr().out
+        assert analyzer.main(["--trace", str(tr),
+                              "--faults", str(tr)]) == 0
+        out = capsys.readouterr().out
+        assert "plan" in out and "no injected faults" in out
+        # a gating perf-diff combined with --trace still fails
+        assert analyzer.main(["--trace", str(tr),
+                              "--perf-diff", str(b), str(bad)]) == 1
+        capsys.readouterr()
+
+    def test_json_output_mode(self, tmp_path, capsys):
+        b = self._write(tmp_path, "base.json", [_rec("gemm", 1.0)])
+        bad = self._write(tmp_path, "bad.json", [_rec("gemm", 2.0)])
+        assert analyzer.main(["perf-diff", str(b), str(bad),
+                              "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressions"] == ["gemm"]
+        tr = self._write(tmp_path, "t.jsonl", [
+            {"type": "span", "name": "codegen", "cat": "lower",
+             "dur_us": 500.0},
+            {"type": "counter", "name": "cache.build", "value": 1}])
+        assert analyzer.main(["trace", str(tr), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "codegen" in doc["phases"]
+        assert doc["counters"]["cache.build"] == 1
+        assert analyzer.main(["faults", str(tr), "--json"]) == 0
+        json.loads(capsys.readouterr().out)

@@ -28,6 +28,7 @@ from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..env import env
+from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..profiler import Profiler
 from ..resilience import faults as _faults
@@ -420,6 +421,14 @@ class AutoTuner:
                     sp.set(outcome="ok", latency_ms=lat,
                            attempts=attempts[0])
                     _trace.inc("autotune.trials", outcome="ok")
+                    # trial medians feed the SAME per-kernel latency
+                    # histograms as runtime dispatch recording, so the
+                    # sweep's distribution shows up in
+                    # metrics_summary()["runtime"] / Prometheus
+                    _runtime.record(
+                        getattr(getattr(kernel, "artifact", None), "name",
+                                factory),
+                        lat / 1e3, source="autotune")
                     streak_sig, streak_len = None, 0
                 logger.info("autotune [%d/%d] %s -> %.4f ms",
                             i + 1, n, cfg, lat)

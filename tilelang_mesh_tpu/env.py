@@ -66,6 +66,11 @@ class Environment:
     TL_TPU_TRACE_DIR = EnvVar(
         "TL_TPU_TRACE_DIR", str(Path.home() / ".tilelang_mesh_tpu" / "trace"))
     TL_TPU_TRACE_MAX_EVENTS = EnvVar("TL_TPU_TRACE_MAX_EVENTS", 100_000, int)
+    # runtime metrics (observability/runtime.py): opt-in per-kernel
+    # dispatch latency histograms + ring buffers
+    TL_TPU_RUNTIME_METRICS = EnvVar("TL_TPU_RUNTIME_METRICS", False, bool)
+    TL_TPU_RUNTIME_SAMPLE = EnvVar("TL_TPU_RUNTIME_SAMPLE", 1, int)
+    TL_TPU_RUNTIME_RING = EnvVar("TL_TPU_RUNTIME_RING", 256, int)
 
     def cache_dir(self) -> Path:
         p = Path(self.TL_TPU_CACHE_DIR)

@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..engine.param import CompiledArtifact
 from ..env import env
+from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import TLError
@@ -117,6 +119,17 @@ class JITKernel:
                 f"(or all {n_all} params, reference-style), got {len(args)}")
         jax_ins = [to_jax(a) for a in ins]
         self._check_shapes(jax_ins)
+        # opt-in runtime recording (TL_TPU_RUNTIME_METRICS=1): sampled
+        # calls pay a device sync for an honest end-to-end latency and
+        # land in the shared kernel.latency histogram + ring buffer.
+        # Warm calls only — the first call's XLA/Mosaic compile time is
+        # already tracked by the jit compile spans, and folding seconds
+        # of compile into a ~ms dispatch digest would wreck p99/max.
+        # Disabled (default): ONE cached env read, no allocation.
+        _rt_t0 = 0.0
+        if self._warmed and _runtime.runtime_enabled() and \
+                _runtime.should_sample(self.artifact.name):
+            _rt_t0 = time.perf_counter()
         if self._warmed:
             result = self.func(*jax_ins)
         else:
@@ -135,6 +148,12 @@ class JITKernel:
             self._warmed = True
         results = result if isinstance(result, tuple) else (result,)
         import jax as _jax
+        if _rt_t0:
+            # block on the FULL result pytree: a multi-output kernel's
+            # latency must include every sibling, not just the first leaf
+            _jax.block_until_ready(results)
+            _runtime.record(self.artifact.name,
+                            time.perf_counter() - _rt_t0)
         delivered = set()
         for oi, ii in self._inout_results:
             if not isinstance(ins[ii], _jax.Array):

@@ -1,29 +1,60 @@
-"""Structured tracing + metrics for the whole compile pipeline.
+"""Structured tracing + metrics for the whole compile pipeline AND the
+runtime dispatch path.
 
-Turn on with ``TL_TPU_TRACE=1``; see ``docs/observability.md``. The
-subsystem has three pieces:
+Turn compile tracing on with ``TL_TPU_TRACE=1`` and runtime latency
+recording on with ``TL_TPU_RUNTIME_METRICS=1``; see
+``docs/observability.md``. The subsystem has four pieces:
 
-- ``tracer``  — span/event/counter recorder (thread-local nesting,
+- ``tracer``    — span/event/counter recorder (thread-local nesting,
   monotonic clock, no-op when disabled; depends only on ``env.py``)
-- ``export``  — Chrome-trace/Perfetto JSON, Prometheus text snapshot,
+- ``histogram`` — log-spaced latency histograms (p50/p90/p99 estimates,
+  mergeable, Prometheus ``_bucket``/``_sum``/``_count`` rendering)
+- ``runtime``   — opt-in per-kernel dispatch recording: sampled call
+  latencies land in the shared ``kernel.latency`` histogram plus a
+  bounded ring buffer of recent calls per kernel signature
+- ``export``    — Chrome-trace/Perfetto JSON, Prometheus text snapshot,
   append-only JSONL, and ``metrics_summary()``
 - instrumentation hooks threaded through ``engine/lower.py`` (one span
   per lowering phase), ``jit/`` (compile latency, factory/lazy cache
-  counters, bucket events), ``cache/kernel_cache.py`` (tier hit/miss +
-  artifact sizes), ``autotuner/`` (per-config trial spans),
-  ``parallel/lowering.py`` + ``language/comm.py`` (static collective
-  accounting: op kind, axis, bytes per lowered kernel)
+  counters, bucket events, runtime dispatch histograms),
+  ``cache/kernel_cache.py`` (tier hit/miss + artifact sizes),
+  ``autotuner/`` (per-config trial spans; trial latencies feed the
+  runtime histograms), ``parallel/lowering.py`` + ``language/comm.py``
+  (static collective accounting: op kind, axis, bytes per lowered
+  kernel)
 """
 
-from .tracer import (Span, Tracer, event, get_tracer, inc, reset, span,
+from . import histogram as _histogram
+from . import runtime as _runtime
+from .tracer import (Span, Tracer, event, get_tracer, inc, span,
                      trace_enabled)
+from .tracer import reset as _tracer_reset
+from .histogram import (Histogram, HistogramRegistry, default_bounds,
+                        get_histogram, get_registry, histograms, observe)
+from .runtime import (HIST_NAME, recent, record, runtime_enabled,
+                      runtime_summary, should_sample)
 from .export import (LOWER_PHASES, aggregate_spans, metrics_summary,
                      read_jsonl, to_chrome_trace, to_jsonl,
                      to_prometheus_text, write_chrome_trace, write_jsonl)
+
+
+def reset() -> None:
+    """Drop every recorded span, event, counter, histogram, and runtime
+    ring buffer (tests, bench children)."""
+    _tracer_reset()
+    _histogram.reset()
+    _runtime.reset()
+
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "span", "event", "inc", "reset",
     "trace_enabled", "LOWER_PHASES", "aggregate_spans", "metrics_summary",
     "to_chrome_trace", "write_chrome_trace", "to_jsonl", "write_jsonl",
     "read_jsonl", "to_prometheus_text",
+    # histogram metric type
+    "Histogram", "HistogramRegistry", "default_bounds", "get_registry",
+    "get_histogram", "histograms", "observe",
+    # runtime dispatch recording
+    "HIST_NAME", "runtime_enabled", "should_sample", "record", "recent",
+    "runtime_summary",
 ]

@@ -27,6 +27,8 @@ import re
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+from . import histogram as _hist
+from . import runtime as _runtime
 from .tracer import Tracer, get_tracer
 
 __all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
@@ -78,6 +80,10 @@ def to_jsonl(tracer: Optional[Tracer] = None) -> str:
     lines = [json.dumps(_json_safe(ev)) for ev in t.events()]
     lines += [json.dumps({"type": "counter", "name": name, "value": value})
               for name, value in sorted(t.counters().items())]
+    lines += [json.dumps({"type": "histogram", "name": name,
+                          "labels": dict(labels), **h.to_dict()})
+              for (name, labels), h in sorted(_hist.histograms())
+              if h.count]
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -134,7 +140,34 @@ def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
         lines.append(f"# TYPE {mname}_seconds summary")
         lines.append(f"{mname}_seconds_count {len(durs)}")
         lines.append(f"{mname}_seconds_sum {sum(durs) / 1e6:.9g}")
+    lines.extend(_prometheus_histogram_lines())
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prometheus_histogram_lines() -> List[str]:
+    """Classic Prometheus histogram exposition for every recorded
+    histogram (values are seconds): cumulative ``_bucket{le=...}``
+    series ending at ``+Inf``, then ``_sum`` and ``_count``."""
+    by_name: Dict[str, list] = {}
+    for (name, labels), h in sorted(_hist.histograms()):
+        if h.count:
+            by_name.setdefault(name, []).append((labels, h))
+    lines: List[str] = []
+    for name, series in by_name.items():
+        mname = f"tl_tpu_{_prom_name(name)}_seconds"
+        lines.append(f"# TYPE {mname} histogram")
+        for labels, h in series:
+            base = [f'{_prom_name(k)}="{v}"' for k, v in labels]
+            cum = h.cumulative()
+            les = [f"{b:g}" for b in h.bounds] + ["+Inf"]
+            for le, c in zip(les, cum):
+                lab = ",".join(base + [f'le="{le}"'])
+                lines.append(f"{mname}_bucket{{{lab}}} {c}")
+            lab = ",".join(base)
+            suffix = f"{{{lab}}}" if lab else ""
+            lines.append(f"{mname}_sum{suffix} {h.sum:.9g}")
+            lines.append(f"{mname}_count{suffix} {h.count}")
+    return lines
 
 
 def _rate(hit: float, miss: float) -> Optional[float]:
@@ -170,6 +203,10 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
       tracing disabled
     - ``collectives``: static accounting totals (ops, bytes) from the
       mesh lowering
+    - ``runtime``: per-kernel latency digests (count, p50/p90/p99/mean/
+      max ms) from the runtime histograms — populated when
+      ``TL_TPU_RUNTIME_METRICS=1`` recorded dispatches, or when the
+      autotuner/profiler fed trial latencies in
     """
     t = tracer or get_tracer()
     counters = t.counters()
@@ -214,7 +251,8 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "abandoned_threads": c("autotune.abandoned_threads"),
     }
     return {"counters": counters, "spans": spans, "cache": cache,
-            "collectives": collectives, "resilience": resilience}
+            "collectives": collectives, "resilience": resilience,
+            "runtime": _runtime.runtime_summary()}
 
 
 def _json_safe(obj: Any):

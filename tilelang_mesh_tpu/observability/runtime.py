@@ -1,0 +1,136 @@
+"""Opt-in per-kernel runtime dispatch recording.
+
+``TL_TPU_RUNTIME_METRICS=1`` turns kernel ``__call__`` latency recording
+on: each sampled dispatch lands in the process-wide ``kernel.latency``
+histogram (labelled by kernel signature and source) and in a bounded
+per-kernel ring buffer of recent calls. Off (the default) the only cost
+on the dispatch path is one cached env read — the same no-op discipline
+as the tracer.
+
+Knobs (see docs/observability.md):
+
+- ``TL_TPU_RUNTIME_METRICS``  — master switch (default off)
+- ``TL_TPU_RUNTIME_SAMPLE=N`` — record every Nth call per kernel
+  (default 1 = every call; sampled calls pay a device sync for an
+  honest end-to-end latency, so N>1 bounds the perturbation)
+- ``TL_TPU_RUNTIME_RING``     — ring-buffer capacity per kernel
+  (default 256)
+
+Sources share one histogram namespace: ``dispatch`` (JITKernel calls),
+``autotune`` (trial medians), ``bench`` (profiler captures) — so
+``metrics_summary()["runtime"]`` and the Prometheus export see every
+latency the process measured, wherever it was measured.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..env import env
+from . import histogram as _hist
+
+__all__ = ["runtime_enabled", "should_sample", "record", "recent",
+           "runtime_summary", "reset", "HIST_NAME"]
+
+# the one histogram family every latency source records into (seconds)
+HIST_NAME = "kernel.latency"
+
+
+def runtime_enabled() -> bool:
+    """One env read — the single gate the dispatch hot path checks."""
+    return bool(env.TL_TPU_RUNTIME_METRICS)
+
+
+class _KernelState:
+    __slots__ = ("seq", "ring")
+
+    def __init__(self, cap: int):
+        self.seq = 0
+        self.ring: deque = deque(maxlen=max(1, cap))
+
+
+_lock = threading.Lock()
+_states: Dict[str, _KernelState] = {}
+
+
+def _state(kernel: str) -> _KernelState:
+    s = _states.get(kernel)
+    if s is None:
+        with _lock:
+            s = _states.get(kernel)
+            if s is None:
+                s = _states[kernel] = _KernelState(env.TL_TPU_RUNTIME_RING)
+    return s
+
+
+def should_sample(kernel: str) -> bool:
+    """Per-kernel 1-in-N sampling decision (call only when enabled)."""
+    s = _state(kernel)
+    n = env.TL_TPU_RUNTIME_SAMPLE
+    with _lock:
+        s.seq += 1
+        return s.seq % max(1, n) == 0
+
+
+def record(kernel: str, seconds: float, source: str = "dispatch") -> None:
+    """One measured call: histogram observation + ring-buffer entry."""
+    _hist.observe(HIST_NAME, seconds, kernel=kernel, source=source)
+    s = _state(kernel)
+    with _lock:
+        s.ring.append({"t": time.time(), "latency_ms": seconds * 1e3,
+                       "source": source})
+
+
+def recent(kernel: str) -> List[dict]:
+    """The ring buffer of recent recorded calls for one kernel,
+    oldest first (bounded by ``TL_TPU_RUNTIME_RING``)."""
+    s = _states.get(kernel)
+    if s is None:
+        return []
+    with _lock:
+        return list(s.ring)
+
+
+def runtime_summary() -> Dict[str, dict]:
+    """Per-kernel latency digest from the shared histograms:
+    {kernel: {count, p50_ms, p90_ms, p99_ms, mean_ms, max_ms,
+    sources}} — the ``metrics_summary()["runtime"]`` payload."""
+    merged: Dict[str, _hist.Histogram] = {}
+    sources: Dict[str, set] = {}
+
+    def _q(h: "_hist.Histogram", q: float) -> Optional[float]:
+        v = h.quantile(q)
+        return round(v * 1e3, 6) if v is not None else None
+
+    for (name, labels), h in _hist.histograms():
+        if name != HIST_NAME or h.count == 0:
+            continue
+        lab = dict(labels)
+        kernel = lab.get("kernel", "?")
+        acc = merged.get(kernel)
+        if acc is None:
+            acc = merged[kernel] = _hist.Histogram(h.bounds)
+        acc.merge(h)
+        sources.setdefault(kernel, set()).add(lab.get("source", "?"))
+    return {
+        kernel: {
+            "count": h.count,
+            "p50_ms": _q(h, 0.50),
+            "p90_ms": _q(h, 0.90),
+            "p99_ms": _q(h, 0.99),
+            "mean_ms": round(h.mean * 1e3, 6) if h.count else None,
+            "max_ms": round(h.max * 1e3, 6) if h.count else None,
+            "sources": sorted(sources.get(kernel, ())),
+        }
+        for kernel, h in sorted(merged.items())
+    }
+
+
+def reset() -> None:
+    """Drop ring buffers and sampling state (histograms are owned by
+    the histogram registry and reset there)."""
+    with _lock:
+        _states.clear()

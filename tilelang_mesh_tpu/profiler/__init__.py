@@ -16,7 +16,8 @@ TPU equivalents:
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -25,14 +26,47 @@ from ..utils.tensor import (TensorSupplyType, assert_allclose,
 
 
 def _consume(r):
-    # touch one element to force full materialization through the tunnel
-    leaves = [x for x in (r if isinstance(r, (tuple, list)) else (r,))]
-    np.asarray(leaves[0]).ravel()[:1]
+    """Force full materialization of EVERY output leaf: block on the
+    whole pytree (a multi-output kernel can return with siblings still
+    in flight — timing only the first leaf undercounts), then fetch one
+    element per leaf (on the tunneled platform block_until_ready alone
+    is not an honest fence; the value fetch is)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(r)
+    jax.block_until_ready(leaves)
+    for x in leaves:
+        np.asarray(x.ravel()[:1] if hasattr(x, "ravel") else x)
 
 
-def do_bench(fn: Callable, *args, warmup: int = 3, rep: int = 30,
-             backend: str = "loop") -> float:
-    """Median latency of fn(*args) in milliseconds."""
+def _stats_ms(samples_ms: Sequence[float], reps: int) -> Dict[str, float]:
+    """Latency digest of per-iteration samples (ms): percentiles, MAD,
+    and the rep counts perf-diff needs to judge noise."""
+    s = np.asarray(sorted(samples_ms), np.float64)
+    med = float(np.median(s))
+    return {
+        "p50_ms": med,
+        "p90_ms": float(np.percentile(s, 90)),
+        "p99_ms": float(np.percentile(s, 99)),
+        "mean_ms": float(s.mean()),
+        "min_ms": float(s[0]),
+        "max_ms": float(s[-1]),
+        "mad_ms": float(np.median(np.abs(s - med))),
+        "samples": int(len(s)),
+        "reps": int(reps),
+    }
+
+
+def do_bench_stats(fn: Callable, *args, warmup: int = 3, rep: int = 30,
+                   backend: str = "loop", rounds: int = 5
+                   ) -> Dict[str, float]:
+    """Latency distribution of fn(*args): p50/p90/p99/mean/min/max/MAD
+    in ms plus sample/rep counts.
+
+    backend="wall": each of ``rep`` per-call wall timings is a sample.
+    backend="loop": each of ``rounds`` in-graph fori_loop runs yields
+    one per-iteration sample (wall / rep) — fewer samples, but each is
+    device-time-accurate behind a high-latency dispatch tunnel.
+    """
     import jax
 
     if backend == "wall":
@@ -44,8 +78,8 @@ def do_bench(fn: Callable, *args, warmup: int = 3, rep: int = 30,
             t0 = time.perf_counter()
             r = fn(*args)
             _consume(r)
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times) * 1e3)
+            times.append((time.perf_counter() - t0) * 1e3)
+        return _stats_ms(times, reps=rep)
 
     # in-graph loop timing
     def loop_body(i, carry):
@@ -62,13 +96,81 @@ def do_bench(fn: Callable, *args, warmup: int = 3, rep: int = 30,
 
     r = run(max(1, warmup), *args)
     _consume(r)
-    best = float("inf")
-    for _ in range(3):
+    samples = []
+    for _ in range(max(1, rounds)):
         t0 = time.perf_counter()
         r = run(rep, *args)
         _consume(r)
-        best = min(best, (time.perf_counter() - t0) / rep)
-    return best * 1e3
+        samples.append((time.perf_counter() - t0) / rep * 1e3)
+    return _stats_ms(samples, reps=rep)
+
+
+def do_bench(fn: Callable, *args, warmup: int = 3, rep: int = 30,
+             backend: str = "loop") -> float:
+    """Median latency of fn(*args) in milliseconds (scalar form of
+    ``do_bench_stats``; loop backend keeps the historical best-of-3
+    semantics via min over 3 rounds)."""
+    if backend == "wall":
+        return do_bench_stats(fn, *args, warmup=warmup, rep=rep,
+                              backend="wall")["p50_ms"]
+    stats = do_bench_stats(fn, *args, warmup=warmup, rep=rep,
+                           backend="loop", rounds=3)
+    return stats["min_ms"]
+
+
+@dataclass
+class PerfReport:
+    """Structured runtime performance report for one kernel: latency
+    distribution, achieved throughput against the ``carver/arch.py``
+    roofline, VMEM footprint, and static ICI traffic. Produced by
+    ``Profiler.perf_report()``; serializes with ``to_dict()`` so bench
+    artifacts and the perf-diff harness can carry it verbatim."""
+
+    kernel: str
+    arch: str
+    latency: Dict[str, float]            # do_bench_stats digest (ms)
+    flops: int = 0
+    bytes_moved: int = 0
+    achieved_tflops: Optional[float] = None
+    achieved_gbps: Optional[float] = None
+    peak_tflops: float = 0.0
+    peak_gbps: float = 0.0
+    compute_utilization: Optional[float] = None   # fraction of MXU peak
+    memory_utilization: Optional[float] = None    # fraction of HBM peak
+    bound: str = "unknown"               # compute | memory | unknown
+    vmem_bytes: int = 0
+    vmem_ok: bool = True
+    ici_wire_bytes: int = 0
+    n_collectives: int = 0
+    collectives: List[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = dict(self.__dict__)
+        d["latency"] = dict(self.latency)
+        d["collectives"] = list(self.collectives)
+        return d
+
+    def __repr__(self):
+        lat = self.latency
+        parts = [f"PerfReport({self.kernel} on {self.arch}: "
+                 f"p50={lat.get('p50_ms', 0):.4f}ms "
+                 f"p99={lat.get('p99_ms', 0):.4f}ms"]
+        if self.achieved_tflops is not None:
+            parts.append(f", {self.achieved_tflops:.2f} TFLOPs "
+                         f"({self.compute_utilization:.1%} of "
+                         f"{self.peak_tflops:g} peak)")
+        if self.achieved_gbps is not None:
+            parts.append(f", {self.achieved_gbps:.1f} GB/s "
+                         f"({self.memory_utilization:.1%} of "
+                         f"{self.peak_gbps:g} peak)")
+        parts.append(f", {self.bound}-bound")
+        if self.vmem_bytes:
+            parts.append(f", vmem={self.vmem_bytes}B"
+                         f"{'' if self.vmem_ok else ' OVER BUDGET'}")
+        if self.ici_wire_bytes:
+            parts.append(f", ici={self.ici_wire_bytes}B over "
+                         f"{self.n_collectives} collectives")
+        return "".join(parts) + ")"
 
 
 class Profiler:
@@ -106,6 +208,85 @@ class Profiler:
             else self._inputs()
         fn = func if func is not None else self.kernel.func
         return do_bench(fn, *ins, warmup=warmup, rep=rep, backend=backend)
+
+    def do_bench_stats(self, func: Optional[Callable] = None,
+                       warmup: int = 3, rep: int = 30,
+                       backend: str = "loop", rounds: int = 5,
+                       input_tensors: Optional[Sequence[Any]] = None
+                       ) -> Dict[str, float]:
+        """Latency distribution (p50/p90/p99/MAD, ms) — the percentile
+        form of ``do_bench``."""
+        ins = list(input_tensors) if input_tensors is not None \
+            else self._inputs()
+        fn = func if func is not None else self.kernel.func
+        return do_bench_stats(fn, *ins, warmup=warmup, rep=rep,
+                              backend=backend, rounds=rounds)
+
+    def perf_report(self, warmup: int = 3, rep: int = 30,
+                    backend: str = "loop", rounds: int = 5,
+                    flops: Optional[int] = None,
+                    bytes_moved: Optional[int] = None,
+                    arch=None,
+                    input_tensors: Optional[Sequence[Any]] = None
+                    ) -> PerfReport:
+        """Measure the kernel and relate it to the hardware roofline.
+
+        FLOPs / HBM bytes default to the static IR analysis
+        (``tools.analyzer.Analyzer``) of the kernel's traced prim_func;
+        pass ``flops=`` / ``bytes_moved=`` to override (e.g. for
+        bandwidth-bound kernels whose mandatory traffic differs from
+        the IR's copy accounting). ICI wire bytes come from the static
+        collective accounting on ``artifact.attrs["collectives"]``.
+        """
+        from ..carver.arch import auto_arch
+        from ..observability import runtime as _runtime
+
+        arch = arch or auto_arch()
+        art = self.kernel.artifact
+        stats = self.do_bench_stats(warmup=warmup, rep=rep,
+                                    backend=backend, rounds=rounds,
+                                    input_tensors=input_tensors)
+        vmem = 0
+        if flops is None or bytes_moved is None:
+            pf = getattr(self.kernel, "prim_func", None)
+            if pf is not None:
+                from ..tools.analyzer import Analyzer
+                try:
+                    res = Analyzer.analysis(pf, arch)
+                    flops = res.total_flops if flops is None else flops
+                    bytes_moved = res.total_bytes if bytes_moved is None \
+                        else bytes_moved
+                    vmem = res.vmem_arena_bytes
+                except Exception:
+                    pass   # unanalyzable IR: report latency only
+        flops = int(flops or 0)
+        bytes_moved = int(bytes_moved or 0)
+        t_s = stats["p50_ms"] / 1e3
+        achieved_tflops = flops / t_s / 1e12 if flops and t_s > 0 else None
+        achieved_gbps = bytes_moved / t_s / 1e9 \
+            if bytes_moved and t_s > 0 else None
+        cu = achieved_tflops / arch.bf16_tflops \
+            if achieved_tflops is not None and arch.bf16_tflops else None
+        mu = achieved_gbps / arch.hbm_gbps \
+            if achieved_gbps is not None and arch.hbm_gbps else None
+        if cu is None and mu is None:
+            bound = "unknown"
+        else:
+            bound = "compute" if (cu or 0) >= (mu or 0) else "memory"
+        colls = [c for c in art.attrs.get("collectives", [])
+                 if isinstance(c, dict)]
+        wire = sum(int(c.get("wire_bytes", 0)) for c in colls)
+        # the measured median feeds the shared per-kernel latency
+        # histogram, so perf reports show up in metrics_summary()
+        _runtime.record(art.name, t_s, source="bench")
+        return PerfReport(
+            kernel=art.name, arch=arch.name, latency=stats,
+            flops=flops, bytes_moved=bytes_moved,
+            achieved_tflops=achieved_tflops, achieved_gbps=achieved_gbps,
+            peak_tflops=arch.bf16_tflops, peak_gbps=arch.hbm_gbps,
+            compute_utilization=cu, memory_utilization=mu, bound=bound,
+            vmem_bytes=vmem, vmem_ok=vmem <= arch.vmem_bytes,
+            ici_wire_bytes=wire, n_collectives=len(colls))
 
     def run_once(self, func: Optional[Callable] = None):
         ins = self._inputs()

@@ -15,6 +15,7 @@ docs/observability.md).
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass
 from typing import Optional
 
@@ -332,29 +333,130 @@ def format_faults_report(records) -> str:
     return "\n".join(lines)
 
 
-def main(argv=None) -> int:
+# ---------------------------------------------------------------------------
+# CLI: trace / faults / perf-diff subcommands (legacy --flag spellings
+# are translated, so existing scripts keep working)
+# ---------------------------------------------------------------------------
+
+def _load_trace(path) -> list:
+    """Shared JSONL loading for the trace-consuming subcommands."""
+    from ..observability import read_jsonl
+    return read_jsonl(path)
+
+
+def _emit(payload: dict, text: str, as_json: bool) -> None:
+    print(json.dumps(payload, indent=2) if as_json else text)  # noqa: T201
+
+
+def _run_trace(path, as_json: bool) -> int:
+    records = _load_trace(path)
+    _emit(summarize_trace(records), format_trace_report(records), as_json)
+    return 0
+
+
+def _run_faults(path, as_json: bool) -> int:
+    records = _load_trace(path)
+    _emit(summarize_faults(records), format_faults_report(records), as_json)
+    return 0
+
+
+def _run_perf_diff(baseline, current, as_json: bool,
+                   threshold_mads: float, min_rel: float,
+                   report_only: bool) -> int:
+    from .perfdiff import (format_perf_diff, load_bench_records, perf_diff,
+                           perf_diff_exit_code)
+    result = perf_diff(load_bench_records(baseline),
+                       load_bench_records(current),
+                       threshold_mads=threshold_mads, min_rel=min_rel)
+    _emit(result, format_perf_diff(result), as_json)
+    return perf_diff_exit_code(result, report_only=report_only)
+
+
+_LEGACY = ("--trace", "--faults", "--perf-diff")
+
+
+def _legacy_main(argv: list) -> int:
+    """The pre-subcommand CLI surface, kept working verbatim: ``--trace
+    F`` / ``--trace=F`` / ``--faults F`` (combinable — each report
+    prints in order) plus ``--perf-diff BASELINE CURRENT``. Shared
+    options (``--json`` etc.) apply to every requested report; the exit
+    code is the worst of the runs (so a gating --perf-diff still
+    fails CI when combined with --trace)."""
     import argparse
     ap = argparse.ArgumentParser(
         prog="python -m tilelang_mesh_tpu.tools.analyzer",
-        description="Analyze an observability JSONL trace "
-                    "(TL_TPU_TRACE=1 run).")
-    ap.add_argument("--trace", metavar="FILE",
-                    help="JSONL trace file (observability.write_jsonl / "
-                         "a bench.py artifact): print the compile-phase "
-                         "breakdown")
-    ap.add_argument("--faults", metavar="FILE",
-                    help="JSONL trace file: print injected-fault / retry / "
-                         "degradation counts per site (chaos runs, "
-                         "docs/robustness.md)")
+        description="Analyze observability artifacts (legacy flag "
+                    "spellings; see the trace/faults/perf-diff "
+                    "subcommands).")
+    ap.add_argument("--trace", metavar="FILE")
+    ap.add_argument("--faults", metavar="FILE")
+    ap.add_argument("--perf-diff", nargs=2,
+                    metavar=("BASELINE", "CURRENT"))
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--threshold-mads", type=float, default=5.0)
+    ap.add_argument("--min-rel", type=float, default=0.05)
+    ap.add_argument("--report-only", action="store_true")
     args = ap.parse_args(argv)
-    if not args.trace and not args.faults:
-        ap.error("one of --trace or --faults is required")
-    from ..observability import read_jsonl
+    if not (args.trace or args.faults or args.perf_diff):
+        ap.error("one of --trace, --faults or --perf-diff is required")
+    rc = 0
     if args.trace:
-        print(format_trace_report(read_jsonl(args.trace)))  # noqa: T201
+        rc = max(rc, _run_trace(args.trace, args.json))
     if args.faults:
-        print(format_faults_report(read_jsonl(args.faults)))  # noqa: T201
-    return 0
+        rc = max(rc, _run_faults(args.faults, args.json))
+    if args.perf_diff:
+        rc = max(rc, _run_perf_diff(args.perf_diff[0], args.perf_diff[1],
+                                    args.json, args.threshold_mads,
+                                    args.min_rel, args.report_only))
+    return rc
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys as _sys
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    if any(a in _LEGACY or a.split("=", 1)[0] in _LEGACY for a in argv):
+        return _legacy_main(argv)
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.tools.analyzer",
+        description="Analyze observability artifacts: JSONL traces "
+                    "(TL_TPU_TRACE=1 runs) and bench perf captures.")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_tr = sub.add_parser(
+        "trace", help="compile-phase breakdown of a JSONL trace")
+    p_tr.add_argument("file", help="JSONL trace "
+                      "(observability.write_jsonl / a bench.py artifact)")
+    p_fl = sub.add_parser(
+        "faults", help="injected-fault / retry / degradation counts per "
+                       "site (chaos runs, docs/robustness.md)")
+    p_fl.add_argument("file", help="JSONL trace file")
+    p_pd = sub.add_parser(
+        "perf-diff", help="noise-aware per-config latency comparison of "
+                          "two bench artifacts; exits 1 on a real "
+                          "regression")
+    p_pd.add_argument("baseline", help="baseline bench artifact "
+                      "(JSONL / JSON / BENCH_r* wrapper)")
+    p_pd.add_argument("current", help="current bench artifact")
+    p_pd.add_argument("--threshold-mads", type=float, default=5.0,
+                      help="regression threshold in MADs of measurement "
+                           "noise (default 5)")
+    p_pd.add_argument("--min-rel", type=float, default=0.05,
+                      help="minimum relative slowdown to flag "
+                           "(default 0.05 = 5%%)")
+    p_pd.add_argument("--report-only", action="store_true",
+                      help="always exit 0 (CI report-only mode)")
+    for p in (p_tr, p_fl, p_pd):
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable JSON output")
+    args = ap.parse_args(argv)
+    if args.cmd == "trace":
+        return _run_trace(args.file, args.json)
+    if args.cmd == "faults":
+        return _run_faults(args.file, args.json)
+    return _run_perf_diff(args.baseline, args.current, args.json,
+                          args.threshold_mads, args.min_rel,
+                          args.report_only)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,200 @@
+"""Noise-aware perf-regression gate over bench artifacts.
+
+``python -m tilelang_mesh_tpu.tools.analyzer perf-diff <baseline> <cur>``
+(also spelled ``--perf-diff``) compares two benchmark captures per
+config and decides, per config, whether the latency moved by more than
+the measurement noise. The decision rule is median + MAD:
+
+    regression  <=>  cur_p50 - base_p50 > threshold_mads * noise
+                     AND (cur_p50 / base_p50 - 1) > min_rel
+
+where ``noise = max(base_mad, cur_mad, rel_floor * base_p50)`` — the
+MAD (median absolute deviation) comes from the percentile fields
+``bench.py`` now emits, and the relative floor keeps a config whose
+reps were too stable (MAD ~ 0) from tripping the gate on scheduler
+jitter. A real 2x slowdown fails the gate; MAD-level wobble passes.
+
+Accepted input shapes (``load_bench_records``):
+
+- bench.py stdout: one JSON record per line (``{"config": ...}``)
+- a JSON array of such records
+- the driver's ``BENCH_r*.json`` wrapper: ``{"tail": "...", ...}`` —
+  records are parsed out of the captured tail
+- ``{"records": [...]}``
+
+Records with an ``error`` field (failed configs) are excluded from the
+comparison but reported, so a config that stopped running entirely is
+visible rather than silently absent.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_bench_records", "perf_diff", "format_perf_diff",
+           "perf_diff_exit_code"]
+
+
+def _records_from_lines(text: str) -> List[dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def load_bench_records(path) -> List[dict]:
+    """Parse a bench artifact (JSONL, JSON array, ``{"records": []}``,
+    or a driver ``BENCH_r*`` wrapper) into a flat list of config
+    records."""
+    text = Path(path).read_text()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return _records_from_lines(text)
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    if isinstance(doc, dict):
+        if isinstance(doc.get("records"), list):
+            return [r for r in doc["records"] if isinstance(r, dict)]
+        if isinstance(doc.get("tail"), str):
+            return _records_from_lines(doc["tail"])
+        return [doc]
+    return []
+
+
+def _by_config(records: List[dict]) -> Tuple[Dict[str, dict], List[str]]:
+    """(config -> best record, failed config names). A headline record
+    (geomean aggregate) repeats a config name — the FIRST record per
+    config wins, which is the per-config measurement."""
+    ok: Dict[str, dict] = {}
+    failed: List[str] = []
+    for r in records:
+        name = r.get("config")
+        if not name:
+            continue
+        if "error" in r:
+            if name not in ok:
+                failed.append(name)
+            continue
+        ok.setdefault(name, r)
+    return ok, [f for f in failed if f not in ok]
+
+
+def _latency_ms(rec: dict) -> Optional[float]:
+    for k in ("latency_p50_ms", "latency_ms"):
+        v = rec.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def _mad_ms(rec: dict) -> Optional[float]:
+    v = rec.get("latency_mad_ms")
+    return float(v) if isinstance(v, (int, float)) and v >= 0 else None
+
+
+def perf_diff(baseline: List[dict], current: List[dict],
+              threshold_mads: float = 5.0, min_rel: float = 0.05,
+              rel_floor: float = 0.02) -> dict:
+    """Compare two bench captures config-by-config. Returns::
+
+        {"rows": [...],          # one per comparable config
+         "regressions": [name],  # real slowdowns (gate fails on these)
+         "improvements": [name],
+         "missing": [name],      # in baseline, absent/failed in current
+         "new": [name],          # in current only
+         "params": {...}}
+    """
+    base_ok, base_failed = _by_config(baseline)
+    cur_ok, cur_failed = _by_config(current)
+    rows: List[dict] = []
+    regressions: List[str] = []
+    improvements: List[str] = []
+    for name in sorted(set(base_ok) & set(cur_ok)):
+        b, c = base_ok[name], cur_ok[name]
+        bl, cl = _latency_ms(b), _latency_ms(c)
+        if bl is None or cl is None:
+            continue
+        noise = max(_mad_ms(b) or 0.0, _mad_ms(c) or 0.0,
+                    rel_floor * bl)
+        delta = cl - bl
+        rel = cl / bl - 1.0
+        if delta > threshold_mads * noise and rel > min_rel:
+            verdict = "REGRESSION"
+            regressions.append(name)
+        elif -delta > threshold_mads * noise and -rel > min_rel:
+            verdict = "improved"
+            improvements.append(name)
+        else:
+            verdict = "ok"
+        rows.append({
+            "config": name,
+            "baseline_ms": round(bl, 6), "current_ms": round(cl, 6),
+            "delta_ms": round(delta, 6), "rel": round(rel, 4),
+            "noise_ms": round(noise, 6), "verdict": verdict,
+        })
+    missing = sorted((set(base_ok) - set(cur_ok)) | set(cur_failed))
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "improvements": improvements,
+        "missing": missing,
+        "new": sorted(set(cur_ok) - set(base_ok)),
+        "failed_baseline": sorted(base_failed),
+        "params": {"threshold_mads": threshold_mads, "min_rel": min_rel,
+                   "rel_floor": rel_floor},
+    }
+
+
+def format_perf_diff(result: dict) -> str:
+    """Human-readable regression table naming every config and its
+    verdict."""
+    lines: List[str] = []
+    rows = result["rows"]
+    if rows:
+        p = result["params"]
+        lines.append(
+            f"perf diff (gate: >{p['threshold_mads']:g} MADs AND "
+            f">{p['min_rel']:.0%} relative):")
+        lines.append(f"  {'config':<20} {'baseline_ms':>12} "
+                     f"{'current_ms':>12} {'delta':>8} {'noise_ms':>10} "
+                     f"verdict")
+        for r in rows:
+            lines.append(
+                f"  {r['config']:<20} {r['baseline_ms']:>12.4f} "
+                f"{r['current_ms']:>12.4f} {r['rel']:>+8.1%} "
+                f"{r['noise_ms']:>10.4f} {r['verdict']}")
+    else:
+        lines.append("perf diff: no comparable configs "
+                     "(do the two artifacts share config names?)")
+    if result["regressions"]:
+        lines.append("REGRESSED: " + ", ".join(result["regressions"]))
+    if result["improvements"]:
+        lines.append("improved: " + ", ".join(result["improvements"]))
+    if result["missing"]:
+        lines.append("missing/failed in current: "
+                     + ", ".join(result["missing"]))
+    if result["new"]:
+        lines.append("new in current: " + ", ".join(result["new"]))
+    if not result["regressions"] and rows:
+        lines.append("no regressions beyond noise")
+    return "\n".join(lines)
+
+
+def perf_diff_exit_code(result: dict, report_only: bool = False) -> int:
+    """CI gate policy: nonzero only on a real regression (never on
+    missing configs — a worker outage must not read as a perf
+    regression), and always zero in report-only mode."""
+    if report_only:
+        return 0
+    return 1 if result["regressions"] else 0
