@@ -338,6 +338,79 @@ def cfg_gemm(M, N, K, dtype="bfloat16"):
                 checked=True)
 
 
+def cfg_mesh_allreduce_smoke(rows=2, cols=2, n=64, m=128):
+    """CI perf-smoke config for the mesh comm path: a 2x2 mesh program
+    whose two same-payload all_reduces are deduped+fused into ONE psum
+    by the collective optimizer (transform/comm_opt.py), timed against
+    the same math written directly as a jax shard_map psum. CPU-safe:
+    the parent injects --xla_force_host_platform_device_count for this
+    config, so the comm-opt win is visible in the perf trajectory and
+    the CI perf-smoke job without TPU hardware."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    import tilelang_mesh_tpu as tilelang
+    import tilelang_mesh_tpu.language as T
+    from tilelang_mesh_tpu.parallel import mesh_config
+    from tilelang_mesh_tpu.parallel.device_mesh import (make_jax_mesh,
+                                                        shard_map_compat)
+
+    if len(jax.devices()) < rows * cols:
+        raise BenchError(
+            f"mesh_allreduce_smoke needs {rows * cols} devices, have "
+            f"{len(jax.devices())} (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={rows * cols})")
+
+    mesh_t = (rows, cols)
+    shard = T.MeshShardingPolicy(cross_mesh_dim=0)
+    with mesh_config(rows, cols):
+        @T.prim_func
+        def k(A: T.MeshTensor((rows * cols * n, m), shard, mesh_t,
+                              "float32"),
+              B: T.MeshTensor((rows * cols * n, 1), shard, mesh_t,
+                              "float32"),
+              C: T.MeshTensor((rows * cols * n, 1), shard, mesh_t,
+                              "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment((n, m), "float32")
+                o1 = T.alloc_fragment((n, 1), "float32")
+                o2 = T.alloc_fragment((n, 1), "float32")
+                T.copy(A, x)
+                # identical payloads: the optimizer dedupes them into one
+                # wire transfer (slot sharing), halving post-opt bytes
+                T.comm.all_reduce(x, o1, "sum", "all", dim=1)
+                T.comm.all_reduce(x, o2, "sum", "all", dim=1)
+                T.copy(o1, B)
+                T.copy(o2, C)
+        kern = tilelang.compile(k, target=f"cpu-mesh[{rows}x{cols}]")
+
+    mesh = make_jax_mesh(rows, cols)
+    spec = P(("x", "y"), None)
+
+    def local(xs):
+        s = lax.psum(jnp.sum(xs, axis=1, keepdims=True), ("x", "y"))
+        return s, s
+
+    ref = jax.jit(shard_map_compat(local, mesh, (spec,), (spec, spec)))
+
+    rng = np.random.default_rng(11)
+    a = jnp.asarray(rng.standard_normal((rows * cols * n, m)) * 0.1,
+                    jnp.float32)
+    extra = {}
+    opt = kern.get_comm_opt() or {}
+    if opt:
+        extra = {"comm_pre_opt_wire_bytes": opt.get("pre_wire_bytes"),
+                 "comm_post_opt_wire_bytes": opt.get("post_wire_bytes"),
+                 "comm_hops_saved": opt.get("hops_saved")}
+    return dict(metric=f"mesh all_reduce smoke {rows}x{cols} n={n} m={m} "
+                       f"(tile DSL comm-opt vs jax shard_map psum)",
+                flops=2.0 * rows * cols * n * m,
+                bytes=float(rows * cols * n * m * 4), peak_class="f32",
+                ours=kern.func, ref=ref, args=(a,), rel_tol=1e-5,
+                extra=extra)
+
+
 def cfg_gemm_smoke(M=256, N=256, K=256, dtype="float32"):
     """CI perf-smoke config: tiny GEMM against the plain XLA dot
     reference. Unlike cfg_gemm it needs no hand-Pallas baseline, so it
@@ -1046,12 +1119,41 @@ def exit_code(strict: bool, n_failed: int) -> int:
     return 2 if (strict and n_failed) else 0
 
 
+# Configs that run without TPU hardware (interpret / host platform):
+# the CI perf-smoke job runs exactly these, and a sweep whose startup
+# probe finds the TPU worker dead still runs them (on the host platform)
+# instead of producing an empty artifact.
+CPU_SAFE_CONFIGS = ("gemm_smoke", "mesh_allreduce_smoke")
+
+
+def _config_env(name: str, tpu_alive: bool) -> dict:
+    """Per-config child-process env overrides: the mesh smoke config
+    needs forced host devices for its 2x2 CPU mesh, and CPU-safe configs
+    fall back to the host platform when the TPU worker is down."""
+    over = {}
+    if name == "mesh_allreduce_smoke":
+        # this config is DEFINED as a host-device mesh smoke (its
+        # checked-in baseline was captured on CPU devices): pin the
+        # platform so a TPU host doesn't silently benchmark the mesh
+        # on TPU against a CPU baseline, and force the host device
+        # count its 2x2 mesh needs
+        over["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            over["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    if not tpu_alive and name in CPU_SAFE_CONFIGS:
+        over["JAX_PLATFORMS"] = "cpu"
+    return over
+
+
 def _config_builders(q: bool):
     """The sweep, riskiest last: a kernel fault kills the tunnel's TPU
     worker for many minutes, losing every config after it — the blast
     radius of the riskiest config must not include the others."""
     return [
         ("gemm_smoke", lambda: cfg_gemm_smoke()),
+        ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),
@@ -1139,19 +1241,25 @@ def _spawn_probe(timeout_s: float) -> bool:
         return False
 
 
-def _spawn_config(name: str, q: bool, timeout_s: float):
+def _spawn_config(name: str, q: bool, timeout_s: float, extra_env=None):
     """Run one config in a fresh child process; returns (rec | None,
     error | None). The child prints its own JSON line, which is re-read
     from its stdout and re-emitted by the caller; on timeout the whole
-    process group is killed so a wedged jax runtime cannot linger."""
+    process group is killed so a wedged jax runtime cannot linger.
+    ``extra_env`` overlays the child's environment (host-platform
+    fallback / forced device counts for the CPU-safe configs)."""
     import signal
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--child", name]
     if q:
         cmd.append("--quick")
+    child_env = None
+    if extra_env:
+        child_env = dict(os.environ)
+        child_env.update(extra_env)
     try:
         p = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
-                             start_new_session=True)
+                             start_new_session=True, env=child_env)
         out, _ = p.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         try:
@@ -1195,9 +1303,10 @@ def main():
                          "so a dead tunnel worker cannot zero the run)")
     ap.add_argument("--probe-timeout", type=float,
                     default=_env_float("TL_TPU_BENCH_PROBE_TIMEOUT", 600),
-                    help="total seconds to wait (in 60s polls) for the "
-                         "TPU to answer before starting; <= 0 skips the "
-                         "wait and configs fast-fail individually")
+                    help="bound (seconds) on the single startup TPU "
+                         "probe; an unreachable worker skips every "
+                         "TPU-only config immediately (CPU-safe configs "
+                         "still run); <= 0 skips the probe entirely")
     ap.add_argument("--strict", action="store_true",
                     help="exit 2 if ANY config failed (CI mode); the "
                          "default keeps partial sweeps green so a dead "
@@ -1215,39 +1324,47 @@ def main():
         keep = set(args.only.split(","))
         configs = [(n, b) for n, b in configs if n in keep]
     else:
-        # gemm_smoke exists for the CI perf-smoke job (--only) and as a
-        # perf-diff baseline anchor; a default sweep excludes it so the
-        # tiny XLA-dot comparison cannot shift the headline
-        # geomean_vs_baseline of the BENCH_r* trajectory
-        configs = [(n, b) for n, b in configs if n != "gemm_smoke"]
+        # the CPU-safe smoke configs exist for the CI perf-smoke job
+        # (--only) and as perf-diff baseline anchors; a default sweep
+        # excludes them so the tiny host-platform comparisons cannot
+        # shift the headline geomean_vs_baseline of the BENCH_r*
+        # trajectory
+        configs = [(n, b) for n, b in configs
+                   if n not in CPU_SAFE_CONFIGS]
     names = [n for n, _ in configs]
 
     cfg_timeout = _env_float("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800)
     if cfg_timeout <= 0:
         cfg_timeout = 1800.0   # cannot be disabled: a wedged worker
         # would hang the driver's bench forever
-    inter_probe_s = _env_float("TL_TPU_BENCH_CHILD_PROBE_TIMEOUT", 120)
 
-    # startup: WAIT (bounded) for the worker instead of aborting — the
-    # round-3 capture died here with rc=1 while the worker was in its
-    # 20-60 min post-fault recovery window
+    # startup: probe the device ONCE, bounded — a dead worker costs one
+    # bounded probe, then every TPU-only config is skipped immediately.
+    # (The round-5 capture instead re-probed per config until the 600s
+    # budget expired, burning ~10 minutes to produce an empty artifact.)
+    # A CPU-safe-only run skips the probe ONLY when JAX_PLATFORMS is
+    # pinned in the environment (the CI fast path): with the platform
+    # unpinned the probe's answer still decides whether _config_env
+    # must force the children onto the host platform, and skipping it
+    # would hand each child to a possibly-dead default backend.
     probe_s = _env_float("TL_TPU_BENCH_PARENT_PROBE_TIMEOUT", 75)
     alive = True
-    if args.probe_timeout > 0 and not args.in_process:
-        deadline = time.time() + args.probe_timeout
-        while True:
-            alive = _spawn_probe(min(probe_s, max(
-                10.0, deadline - time.time())))
-            if alive or time.time() >= deadline:
-                break
-            print(f"# TPU worker unreachable; retrying until the "
-                  f"{args.probe_timeout:.0f}s budget expires",
-                  file=sys.stderr, flush=True)
-            time.sleep(min(60, max(1.0, deadline - time.time())))
-    # probing a DEAD worker burns its full timeout every time; this
-    # budget bounds the total spent on dead probes across the sweep so
-    # a down-all-run worker costs minutes, not hours
-    dead_budget = _env_float("TL_TPU_BENCH_DEAD_PROBE_BUDGET", 300)
+    dead_reason = "unreachable at the startup probe"
+    tpu_needed = any(n not in CPU_SAFE_CONFIGS for n in names) \
+        or not os.environ.get("JAX_PLATFORMS")
+    if args.probe_timeout > 0 and not args.in_process and tpu_needed:
+        alive = _spawn_probe(min(probe_s, args.probe_timeout))
+        if not alive:
+            print("# TPU worker unreachable (probed once); skipping "
+                  "TPU-only configs — CPU-safe configs "
+                  f"({', '.join(CPU_SAFE_CONFIGS)}) still run on the "
+                  "host platform", file=sys.stderr, flush=True)
+    # mid-sweep recovery probes share ONE bounded budget; a worker
+    # already dead at startup gets none (probe once, skip immediately),
+    # while a worker lost mid-sweep — possibly a transient blip — gets
+    # a chance to be noticed recovering
+    dead_budget = _env_float("TL_TPU_BENCH_DEAD_PROBE_BUDGET",
+                             300 if alive else 0)
 
     results = []
     headline = None
@@ -1270,23 +1387,31 @@ def main():
                 rec, err = None, f"{type(e).__name__}: {e}"
                 _reset_tracer()
         else:
-            if not alive and dead_budget > 0:
-                # re-probe: skip (not hang) while the worker is down,
-                # but notice the moment it recovers
+            if not alive and name not in CPU_SAFE_CONFIGS \
+                    and dead_budget > 0:
+                # a worker lost MID-SWEEP may be a transient blip:
+                # re-probe (bounded by the shared dead budget) so a
+                # recovery doesn't forfeit the rest of the sweep. The
+                # startup-dead case never enters here with the default
+                # budget spent on one bounded probe.
                 t0 = time.time()
-                alive = _spawn_probe(min(inter_probe_s, dead_budget))
-                if not alive:
-                    dead_budget -= time.time() - t0
-            if alive:
+                alive = _spawn_probe(min(probe_s, dead_budget))
+                dead_budget -= time.time() - t0
+            if alive or name in CPU_SAFE_CONFIGS:
                 # the child pays jax import + probes before its own
                 # watchdog starts: give its subprocess that allowance on
                 # top of cfg_timeout so a slow-but-legitimate config is
                 # never misreported as a wedged worker
-                rec, err = _spawn_config(name, q, cfg_timeout + 300)
+                rec, err = _spawn_config(name, q, cfg_timeout + 300,
+                                         extra_env=_config_env(name,
+                                                               alive))
                 if rec is None and "worker" in (err or "").lower():
+                    if alive:
+                        dead_reason = (f"lost mid-sweep at config "
+                                       f"{name} ({(err or '')[:120]})")
                     alive = False
             else:
-                rec, err = None, "skipped: TPU worker unreachable"
+                rec, err = None, f"skipped: TPU worker {dead_reason}"
         if rec is not None:
             print(json.dumps(rec), flush=True)
             results.append(rec)

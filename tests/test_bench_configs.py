@@ -65,3 +65,35 @@ def test_all_configs_have_builders():
     for expected in ("mamba2_chunk", "gdn_fwd", "w4a8_gemm",
                      "paged_decode"):
         assert expected in names
+
+
+def test_mesh_allreduce_smoke_config():
+    """The CPU-safe mesh comm-opt smoke: runs on the 8 forced host
+    devices, reports bandwidth, and embeds the collective optimizer's
+    pre/post wire-byte accounting in the record."""
+    import bench
+    rec = _run("mesh_allreduce_smoke",
+               lambda: bench.cfg_mesh_allreduce_smoke(n=16, m=128))
+    assert rec["unit"] == "GB/s"
+    assert rec["comm_post_opt_wire_bytes"] <= rec["comm_pre_opt_wire_bytes"]
+    assert rec["comm_hops_saved"] >= 0
+
+
+def test_cpu_safe_configs_declared():
+    """Probe-once skip logic keys off CPU_SAFE_CONFIGS: both smoke
+    configs must be declared CPU-safe and excluded from the default
+    TPU sweep's geomean."""
+    import bench
+    names = [n for n, _ in bench._config_builders(True)]
+    for n in bench.CPU_SAFE_CONFIGS:
+        assert n in names
+    assert "mesh_allreduce_smoke" in bench.CPU_SAFE_CONFIGS
+    # the mesh smoke child gets forced host devices (injected, or
+    # already present in the ambient flags — conftest sets them here)
+    import os
+    env = bench._config_env("mesh_allreduce_smoke", tpu_alive=True)
+    flags = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    assert "host_platform_device_count" in flags
+    # CPU-safe configs fall back to the host platform on a dead worker
+    env = bench._config_env("gemm_smoke", tpu_alive=False)
+    assert env.get("JAX_PLATFORMS") == "cpu"

@@ -1,0 +1,561 @@
+"""Collective-optimizer (transform/comm_opt.py) tests.
+
+Style mirrors tests/test_comm.py: (1) golden plan_desc texts for each
+rewrite — fused, deduped, eliminated, chunked — the analog of the
+reference's lowered-IR comm goldens; (2) numerical equivalence of the
+optimized vs unoptimized lowering on the 2x2 CPU mesh; (3) the
+TL_TPU_COMM_OPT=0 bypass restoring the exact unoptimized schedule; and
+(4) the pre-/post-optimization wire-byte accounting surfaced through
+attrs["collectives"], attrs["comm_opt"], and metrics_summary().
+"""
+
+import numpy as np
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.parallel import mesh_config
+from tilelang_mesh_tpu.transform import comm_opt_modes, pass_config
+
+MESH = (2, 2)
+NROW, NCOL = MESH
+SHAPE = (8, 128)
+TARGET = f"cpu-mesh[{NROW}x{NCOL}]"
+
+
+def _global(shape=None):
+    shape = shape or (NROW * NCOL * SHAPE[0], SHAPE[1])
+    return T.MeshTensor(shape, T.MeshShardingPolicy(cross_mesh_dim=0),
+                        MESH, "float32")
+
+
+def _shards(rng):
+    return rng.standard_normal((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                               ).astype(np.float32)
+
+
+# ---- programs, one per rewrite ---------------------------------------------
+
+
+def _fused_program():
+    """Two same-axis same-type all_reduces on distinct payloads ->
+    one batched collective with 2 payload slots."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global((NROW * NCOL * SHAPE[0], 1)),
+              C: _global((NROW * NCOL * SHAPE[0], 1))):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                y = T.alloc_fragment(SHAPE, "float32")
+                o1 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                o2 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.copy(A, y)
+                T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                T.comm.all_reduce(y, o2, "sum", "h", dim=1)
+                T.copy(o1, B)
+                T.copy(o2, C)
+        return k
+
+
+def _dedup_program():
+    """A byte-identical duplicate broadcast (dropped) plus a same-payload
+    broadcast to a second destination (shares the wire slot)."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global(), C: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared(SHAPE, "float32")
+                d1 = T.alloc_shared(SHAPE, "float32")
+                d2 = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, x)
+                T.comm.broadcast(x, d1, (0, 1), "h")
+                T.comm.broadcast(x, d1, (0, 1), "h")   # exact duplicate
+                T.comm.broadcast(x, d2, (0, 1), "h")   # same payload
+                T.copy(d1, B)
+                T.copy(d2, C)
+        return k
+
+
+def _dce_program():
+    """An all_reduce whose result is never read again: eliminated, and
+    the neighbouring compute segments merge back into one kernel."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                dead = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, dead, "sum", "v", dim=1)
+                T.copy(x, B)
+        return k
+
+
+def _chunk_program():
+    """A large all_gather feeding a consumer copy segment; with the
+    chunk threshold lowered it splits into pipelined chunks."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(),
+              B: _global((NROW * NCOL, NCOL, SHAPE[0], SHAPE[1]))):
+            with T.Kernel(1) as bx:
+                send = T.alloc_shared(SHAPE, "float32")
+                recv = T.alloc_shared((NCOL, *SHAPE), "float32")
+                T.copy(A, send)
+                T.comm.all_gather(send, recv, "h")
+                T.copy(recv, B[0, 0, 0])
+        return k
+
+
+def _lower(pf, **cfg):
+    if cfg:
+        with pass_config(cfg):
+            return tilelang.lower(pf, target=TARGET)
+    return tilelang.lower(pf, target=TARGET)
+
+
+# ---- golden plan_desc per rewrite ------------------------------------------
+
+
+def test_fused_golden_schedule():
+    assert _lower(_fused_program()).plan_desc == """\
+mesh_program(k) mesh=(2x2) axes=(x,y):
+  [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(frag_lo, frag_1_lo)
+  [1] collective fused[2x allreduce, axis=y, dir=h, slots=2]
+        member[0] slot=0: all_reduce(frag -> frag_2, op=sum, dir=h, dim=1, clear=True)
+        member[1] slot=1: all_reduce(frag_1 -> frag_3, op=sum, dir=h, dim=1, clear=True)
+        noc[0]: bcast core(0, 0) dir=h chunk=0
+        noc[1]: bcast core(0, 1) dir=h chunk=1
+        noc[2]: bcast core(1, 0) dir=h chunk=0
+        noc[3]: bcast core(1, 1) dir=h chunk=1
+        cost: 4 steps, 4 hops
+        xla: local reduce(dim=1) + psum(axis='y') over 2-slot concat payload (2 members)
+  [2] pallas_segment k_seg2 grid=(1,) ins=(frag_2_li, frag_3_li) outs=(B, C)
+  comm_opt[fuse,dce,overlap]: wire 256B -> 256B, hops 8 -> 4
+    * fuse: 2x all_reduce(frag -> frag_2, op=sum, dir=h, dim=1, clear=True) -> 1 batched op
+  param A: role=in spec=PartitionSpec(('x', 'y'), None)
+  param B: role=out spec=PartitionSpec(('x', 'y'), None)
+  param C: role=out spec=PartitionSpec(('x', 'y'), None)
+"""
+
+
+def test_dedup_golden_schedule():
+    assert _lower(_dedup_program()).plan_desc == """\
+mesh_program(k) mesh=(2x2) axes=(x,y):
+  [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(shared_lo)
+  [1] collective fused[2x broadcast, axis=y, dir=h, slots=1]
+        member[0] slot=0: broadcast(shared -> shared_1, src_core=(0, 1), dir=h)
+        member[1] slot=0: broadcast(shared -> shared_2, src_core=(0, 1), dir=h)
+        noc[0]: bcast core(0, 1) dir=h chunk=0
+        cost: 1 steps, 1 hops
+        xla: psum(mask(core==(0, 1)), 'y') -> row 0 over 1-slot concat payload (2 members)
+  [2] pallas_segment k_seg2 grid=(1,) ins=(shared_1_li, shared_2_li) outs=(B, C)
+  comm_opt[fuse,dce,overlap]: wire 12288B -> 4096B, hops 3 -> 1
+    * fuse: dropped duplicate broadcast(shared -> shared_1, src_core=(0, 1), dir=h)
+    * fuse: 2x broadcast(shared -> shared_1, src_core=(0, 1), dir=h) -> 1 batched op (1 shared payload slot)
+  param A: role=in spec=PartitionSpec(('x', 'y'), None)
+  param B: role=out spec=PartitionSpec(('x', 'y'), None)
+  param C: role=out spec=PartitionSpec(('x', 'y'), None)
+"""
+
+
+def test_dce_golden_schedule():
+    assert _lower(_dce_program()).plan_desc == """\
+mesh_program(k) mesh=(2x2) axes=(x,y):
+  [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(B)
+  comm_opt[fuse,dce,overlap]: wire 128B -> 0B, hops 4 -> 0
+    * dce: dropped dead all_reduce(frag -> frag_1, op=sum, dir=v, dim=1, clear=True)
+    * dce: merged adjacent compute segments
+  param A: role=in spec=PartitionSpec(('x', 'y'), None)
+  param B: role=out spec=PartitionSpec(('x', 'y'), None)
+"""
+
+
+def test_chunked_golden_schedule():
+    assert _lower(_chunk_program(),
+                  **{"tl.tpu.comm_chunk_bytes": 1024}).plan_desc == """\
+mesh_program(k) mesh=(2x2) axes=(x,y):
+  [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(shared_lo)
+  [1] collective chunked[4] all_gather(shared -> shared_1, dir=h)
+        noc[0]: bcast core(0, 0) dir=h chunk=0
+        noc[1]: bcast core(0, 1) dir=h chunk=1
+        noc[2]: bcast core(1, 0) dir=h chunk=0
+        noc[3]: bcast core(1, 1) dir=h chunk=1
+        cost: 4 steps, 4 hops
+        overlap: 4 x 1024B chunks, transfer(i+1) || compute(i) (double-buffered)
+        xla: 4 x [all_gather(axis='y')] on leading-axis chunks
+  [2] pallas_segment k_seg2 grid=(1,) ins=(shared_1_li) outs=(B)
+  comm_opt[fuse,dce,overlap]: wire 16384B -> 16384B, hops 4 -> 4
+    * overlap: all_gather(shared -> shared_1, dir=h) -> 4 pipelined chunks (16384B wire over segment [2]'s compute)
+  param A: role=in spec=PartitionSpec(('x', 'y'), None)
+  param B: role=out spec=PartitionSpec(('x', 'y'), None, None, None)
+"""
+
+
+def test_bypass_restores_unoptimized_schedule(monkeypatch):
+    """TL_TPU_COMM_OPT=0 must restore the exact pre-optimizer schedule
+    text (the pre-PR plan_desc format: no comm_opt block, no fused or
+    chunked collectives)."""
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "0")
+    art = _lower(_fused_program())
+    assert art.attrs["comm_opt"] is None
+    assert art.plan_desc == """\
+mesh_program(k) mesh=(2x2) axes=(x,y):
+  [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(frag_lo, frag_1_lo)
+  [1] collective all_reduce(frag -> frag_2, op=sum, dir=h, dim=1, clear=True)
+        noc[0]: bcast core(0, 0) dir=h chunk=0
+        noc[1]: bcast core(0, 1) dir=h chunk=1
+        noc[2]: bcast core(1, 0) dir=h chunk=0
+        noc[3]: bcast core(1, 1) dir=h chunk=1
+        cost: 4 steps, 4 hops
+        xla: local reduce(dim=1) + psum(axis='y')
+  [2] collective all_reduce(frag_1 -> frag_3, op=sum, dir=h, dim=1, clear=True)
+        noc[0]: bcast core(0, 0) dir=h chunk=0
+        noc[1]: bcast core(0, 1) dir=h chunk=1
+        noc[2]: bcast core(1, 0) dir=h chunk=0
+        noc[3]: bcast core(1, 1) dir=h chunk=1
+        cost: 4 steps, 4 hops
+        xla: local reduce(dim=1) + psum(axis='y')
+  [3] pallas_segment k_seg3 grid=(1,) ins=(frag_2_li, frag_3_li) outs=(B, C)
+  param A: role=in spec=PartitionSpec(('x', 'y'), None)
+  param B: role=out spec=PartitionSpec(('x', 'y'), None)
+  param C: role=out spec=PartitionSpec(('x', 'y'), None)
+"""
+
+
+# ---- mode selection ---------------------------------------------------------
+
+
+def test_mode_typo_is_loud(monkeypatch):
+    """A typo'd mode token must raise, not silently disable the pass."""
+    import pytest
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "fsue")
+    with pytest.raises(ValueError, match="unknown TL_TPU_COMM_OPT"):
+        comm_opt_modes()
+
+
+def test_dce_eliminates_dead_chains():
+    """A collective kept alive only by a later dead collective is a
+    dead chain: DCE iterates to fixpoint and removes both."""
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(), B: _global()):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_shared(SHAPE, "float32")
+                    mid = T.alloc_shared(SHAPE, "float32")
+                    dead = T.alloc_shared(SHAPE, "float32")
+                    T.copy(A, x)
+                    T.comm.broadcast(x, mid, (0, 0), "h")
+                    T.comm.barrier()
+                    T.comm.broadcast(mid, dead, (0, 1), "v")
+                    T.copy(x, B)
+            return k
+    art = _lower(prog())
+    # both links of the chain are gone; only the barrier remains (the
+    # dropped ops appear solely in the dce rewrite log lines)
+    assert "collective broadcast" not in art.plan_desc
+    assert art.plan_desc.count("collective") == 1  # barrier()
+    assert art.attrs["comm_opt"]["post_wire_bytes"] == 0
+    assert sum(1 for r in art.attrs["comm_opt"]["rewrites"]
+               if r.startswith("dce: dropped")) == 2
+    _run_pair(prog, 9)
+
+
+def test_mode_parsing(monkeypatch):
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "1")
+    assert comm_opt_modes() == ("fuse", "dce", "overlap")
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "0")
+    assert comm_opt_modes() == ()
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "fuse,dce")
+    assert comm_opt_modes() == ("fuse", "dce")
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "overlap")
+    assert comm_opt_modes() == ("overlap",)
+    # pass config wins over the env var
+    assert comm_opt_modes({"tl.tpu.comm_opt": "0"}) == ()
+
+
+def test_mode_subset_gates_rewrites(monkeypatch):
+    # dce-only: the dead reduce goes away but nothing fuses
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "dce")
+    desc = _lower(_fused_program()).plan_desc
+    assert "fused[" not in desc
+    # fuse-only: nothing is chunked even under the low threshold
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "fuse")
+    desc = _lower(_chunk_program(),
+                  **{"tl.tpu.comm_chunk_bytes": 1024}).plan_desc
+    assert "chunked[" not in desc
+
+
+def test_determinism_across_lowerings():
+    """Two lowerings of the same func produce byte-identical schedules
+    (grouping keys are canonical — kind + mesh axis + operand identity —
+    never dict iteration order)."""
+    for prog in (_fused_program, _dedup_program, _dce_program):
+        pf = prog()
+        assert tilelang.lower(pf, target=TARGET).plan_desc == \
+            tilelang.lower(pf, target=TARGET).plan_desc
+    pf = _chunk_program()
+    cfg = {"tl.tpu.comm_chunk_bytes": 1024}
+    assert _lower(pf, **cfg).plan_desc == _lower(pf, **cfg).plan_desc
+
+
+# ---- numerical equivalence: optimized vs unoptimized ------------------------
+
+
+def _run_pair(prog, seed, **cfg):
+    pf = prog()
+    if cfg:
+        with pass_config(cfg):
+            k_on = tilelang.compile(pf, target=TARGET)
+    else:
+        k_on = tilelang.compile(pf, target=TARGET)
+    with pass_config({"tl.tpu.comm_opt": "0"}):
+        k_off = tilelang.compile(pf, target=TARGET)
+    a = _shards(np.random.default_rng(seed))
+    r_on = k_on(a)
+    r_off = k_off(a)
+    r_on = r_on if isinstance(r_on, tuple) else (r_on,)
+    r_off = r_off if isinstance(r_off, tuple) else (r_off,)
+    assert len(r_on) == len(r_off)
+    for x_on, x_off in zip(r_on, r_off):
+        np.testing.assert_allclose(np.asarray(x_on), np.asarray(x_off),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_fused_allreduce_numerics():
+    _run_pair(_fused_program, 0)
+
+
+def test_dedup_broadcast_numerics():
+    _run_pair(_dedup_program, 1)
+
+
+def test_dce_numerics():
+    _run_pair(_dce_program, 2)
+
+
+def test_chunked_allgather_numerics():
+    _run_pair(_chunk_program, 3, **{"tl.tpu.comm_chunk_bytes": 1024})
+
+
+def test_chunked_allreduce_numerics():
+    """Chunked all_reduce path: big payload, low threshold."""
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(), B: _global((NROW * NCOL * SHAPE[0], 1))):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment(SHAPE, "float32")
+                    o = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    T.copy(A, x)
+                    T.comm.all_reduce(x, o, "sum", "all", dim=1)
+                    T.copy(o, B)
+            return k
+    cfg = {"tl.tpu.comm_chunk_bytes": 8, "tl.tpu.comm_chunks": 4}
+    with pass_config(cfg):
+        desc = tilelang.lower(prog(), target=TARGET).plan_desc
+    assert "chunked[4] all_reduce" in desc
+    _run_pair(prog, 4, **cfg)
+
+
+def test_fused_allgather_numerics():
+    """Two same-axis all_gathers fuse into one batched gather; the
+    split-back must reproduce each member's recv exactly."""
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(),
+                  B: _global((NROW * NCOL, NCOL, SHAPE[0], SHAPE[1])),
+                  C: _global((NROW * NCOL, NCOL, SHAPE[0], SHAPE[1]))):
+                with T.Kernel(1) as bx:
+                    s1 = T.alloc_shared(SHAPE, "float32")
+                    s2 = T.alloc_shared(SHAPE, "float32")
+                    r1 = T.alloc_shared((NCOL, *SHAPE), "float32")
+                    r2 = T.alloc_shared((NCOL, *SHAPE), "float32")
+                    T.copy(A, s1)
+                    T.copy(A, s2)
+                    T.comm.all_gather(s1, r1, "h")
+                    T.comm.all_gather(s2, r2, "h")
+                    T.copy(r1, B[0, 0, 0])
+                    T.copy(r2, C[0, 0, 0])
+            return k
+    art = tilelang.lower(prog(), target=TARGET)
+    assert "fused[2x allgather" in art.plan_desc
+    _run_pair(prog, 6)
+
+
+def test_fused_allgather_all_direction_numerics():
+    """Fused 2-D ('all') gathers: tuple-axis all_gather ordering must
+    survive the concat/split round trip."""
+    n_all = NROW * NCOL
+
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(),
+                  B: _global((NROW * NCOL, n_all, SHAPE[0], SHAPE[1])),
+                  C: _global((NROW * NCOL, n_all, SHAPE[0], SHAPE[1]))):
+                with T.Kernel(1) as bx:
+                    s1 = T.alloc_shared(SHAPE, "float32")
+                    s2 = T.alloc_shared(SHAPE, "float32")
+                    r1 = T.alloc_shared((n_all, *SHAPE), "float32")
+                    r2 = T.alloc_shared((n_all, *SHAPE), "float32")
+                    T.copy(A, s1)
+                    T.copy(A, s2)
+                    T.comm.all_gather(s1, r1, "all")
+                    T.comm.all_gather(s2, r2, "all")
+                    T.copy(r1, B[0, 0, 0])
+                    T.copy(r2, C[0, 0, 0])
+            return k
+    art = tilelang.lower(prog(), target=TARGET)
+    assert "fused[2x allgather" in art.plan_desc
+    _run_pair(prog, 7)
+
+
+def test_fused_mixed_clear_numerics():
+    """clear=False accumulation stays per-member under fusion."""
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(), B: _global((NROW * NCOL * SHAPE[0], 1)),
+                  C: _global((NROW * NCOL * SHAPE[0], 1))):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment(SHAPE, "float32")
+                    o1 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    o2 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    T.copy(A, x)
+                    T.fill(o2, 1.0)
+                    T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                    T.comm.all_reduce(x, o2, "sum", "h", dim=1,
+                                      clear=False)
+                    T.copy(o1, B)
+                    T.copy(o2, C)
+            return k
+    art = tilelang.lower(prog(), target=TARGET)
+    assert "fused[2x allreduce" in art.plan_desc
+    _run_pair(prog, 5)
+
+
+# ---- accounting -------------------------------------------------------------
+
+
+def test_fused_accounting_wire_bytes():
+    """Acceptance: two same-axis all_reduces -> ONE fused collective in
+    plan_desc, and attrs['collectives'] reports post-optimization wire
+    bytes <= pre-optimization bytes."""
+    art = _lower(_fused_program())
+    assert art.plan_desc.count("collective") == 1
+    assert "fused[2x allreduce" in art.plan_desc
+    recs = art.attrs["collectives"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["op"] == "fused_allreduce"
+    assert rec["members"] == 2 and rec["slots"] == 2
+    assert rec["wire_bytes"] <= rec["pre_opt_wire_bytes"]
+    opt = art.attrs["comm_opt"]
+    assert opt["post_wire_bytes"] <= opt["pre_wire_bytes"]
+    assert opt["modes"] == ["fuse", "dce", "overlap"]
+    assert any(r.startswith("fuse:") for r in opt["rewrites"])
+
+
+def test_dedup_halves_wire_bytes():
+    art = _lower(_dedup_program())
+    opt = art.attrs["comm_opt"]
+    # 3 broadcasts emitted, 1 distinct payload crosses the wire
+    assert opt["post_wire_bytes"] * 3 == opt["pre_wire_bytes"]
+    assert opt["hops_saved"] > 0
+    # per-record pre-opt bytes (members + dropped duplicates) agree with
+    # the program-level total
+    recs = art.attrs["collectives"]
+    assert sum(r.get("pre_opt_wire_bytes", r["wire_bytes"])
+               for r in recs) == opt["pre_wire_bytes"]
+
+
+def test_dedup_pair_leaves_single_member_fused_op():
+    """A pure duplicate pair: the survivor becomes a 1-member fused op
+    carrying the dropped duplicate's pre-optimization bytes."""
+    def prog():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _global(), B: _global()):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_shared(SHAPE, "float32")
+                    d = T.alloc_shared(SHAPE, "float32")
+                    T.copy(A, x)
+                    T.comm.broadcast(x, d, (0, 1), "h")
+                    T.comm.broadcast(x, d, (0, 1), "h")  # exact dup
+                    T.copy(d, B)
+            return k
+    art = _lower(prog())
+    assert "fused[1x broadcast" in art.plan_desc
+    rec = art.attrs["collectives"][0]
+    assert rec["members"] == 1
+    assert rec["pre_opt_wire_bytes"] == 2 * rec["wire_bytes"]
+    opt = art.attrs["comm_opt"]
+    assert opt["pre_wire_bytes"] == 2 * opt["post_wire_bytes"]
+    _run_pair(prog, 8)
+
+
+def test_dce_accounting_and_segment_merge():
+    art = _lower(_dce_program())
+    assert art.attrs["collectives"] == []
+    assert "collective" not in art.plan_desc
+    # the two compute segments merged back into ONE kernel
+    assert art.plan_desc.count("pallas_segment") == 1
+    opt = art.attrs["comm_opt"]
+    assert opt["post_wire_bytes"] == 0 and opt["pre_wire_bytes"] > 0
+    assert any(r.startswith("dce:") for r in opt["rewrites"])
+
+
+def test_comm_opt_counters_and_metrics_summary():
+    obs.reset()
+    _lower(_fused_program())
+    c = obs.get_tracer().counters()
+    assert c["comm.opt.rewrites"] >= 1
+    assert c["comm.opt.post_wire_bytes"] <= c["comm.opt.pre_wire_bytes"]
+    summ = obs.metrics_summary()
+    assert summ["collectives"]["post_opt_bytes"] <= \
+        summ["collectives"]["pre_opt_bytes"]
+    obs.reset()
+
+
+def test_mesh_kernel_surfaces_comm_opt():
+    kern = tilelang.compile(_fused_program(), target=TARGET)
+    opt = kern.get_comm_opt()
+    assert opt is not None
+    assert opt["post_wire_bytes"] <= opt["pre_wire_bytes"]
+
+
+def test_analyzer_trace_reports_comm_opt(tmp_path, monkeypatch):
+    """analyzer trace surfaces the optimizer accounting from a JSONL
+    trace (the PR-1 observability pipeline end to end)."""
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    obs.reset()
+    _lower(_fused_program())
+    p = tmp_path / "t.jsonl"
+    obs.write_jsonl(p)
+    from tilelang_mesh_tpu.tools.analyzer import (format_trace_report,
+                                                  summarize_trace)
+    records = obs.read_jsonl(p)
+    rep = format_trace_report(records)
+    assert "collective optimizer (comm_opt)" in rep
+    assert "fused_allreduce" in rep
+    s = summarize_trace(records)
+    assert s["counters"]["comm.opt.rewrites"] >= 1
+    obs.reset()
+
+
+def test_emit_metadata_attached():
+    """language/comm.py attaches emission metadata every optimizer
+    consumer can key off (payload bytes + deterministic sequence)."""
+    from tilelang_mesh_tpu.ir import CommStmt, collect
+    pf = _fused_program()
+    comms = collect(pf.func.body if hasattr(pf, "func") else pf.body,
+                    lambda s: isinstance(s, CommStmt))
+    assert len(comms) == 2
+    for c in comms:
+        assert c.emit_meta["op"] == "all_reduce"
+        assert c.emit_meta["payload_bytes"] > 0
+    assert comms[0].emit_meta["seq"] < comms[1].emit_meta["seq"]

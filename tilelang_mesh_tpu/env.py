@@ -51,6 +51,16 @@ class Environment:
         str(Path.home() / ".tilelang_mesh_tpu" / "autotune"))
     # native library
     TL_TPU_DISABLE_NATIVE = EnvVar("TL_TPU_DISABLE_NATIVE", False, bool)
+    # mesh collective optimizer (transform/comm_opt.py; docs/
+    # mesh_comm_opt.md). "1"/"on" = all rewrites, "0"/"off" = none,
+    # or a comma list of fuse/dce/overlap to enable a subset.
+    TL_TPU_COMM_OPT = EnvVar("TL_TPU_COMM_OPT", "1")
+    # minimum wire bytes before the overlap rewrite chunks a collective
+    TL_TPU_COMM_CHUNK_BYTES = EnvVar("TL_TPU_COMM_CHUNK_BYTES",
+                                     1 << 20, int)
+    # chunk count for the overlap rewrite (clamped to what divides the
+    # payload's leading axis)
+    TL_TPU_COMM_CHUNKS = EnvVar("TL_TPU_COMM_CHUNKS", 4, int)
     # resilience (resilience/ reads these; see docs/robustness.md)
     TL_TPU_FAULTS = EnvVar("TL_TPU_FAULTS", "")          # fault-spec string
     TL_TPU_FALLBACK = EnvVar("TL_TPU_FALLBACK", "interp")  # interp | none

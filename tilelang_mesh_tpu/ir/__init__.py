@@ -11,6 +11,6 @@ from .stmt import (Stmt, SeqStmt, AllocStmt, AsyncCopyStmt, KernelNode,
                    BufferStoreStmt, EvaluateStmt, CopyStmt, GemmStmt, FillStmt,
                    ReduceStmt, CumSumStmt, AtomicStmt, PrintStmt, AssertStmt,
                    CommStmt, CommBroadcast, CommPut, CommAllGather,
-                   CommAllReduce, CommBarrier, CommFence, PrimFunc, walk,
-                   collect)
+                   CommAllReduce, CommBarrier, CommFence, CommFused,
+                   CommChunked, PrimFunc, walk, collect)
 from .printer import expr_str, func_str, region_str

@@ -240,6 +240,55 @@ class CommBarrier(CommStmt):
         self.group = group
 
 
+class CommFused(CommStmt):
+    """N same-kind / same-axis collectives batched into ONE mesh op over
+    their concatenated payloads (transform/comm_opt.py fusion rewrite).
+
+    ``slots[i]`` is the payload slot member ``ops[i]`` reads from:
+    byte-identical members share a slot, so each distinct payload crosses
+    the wire exactly once and is fanned out to every member destination.
+    ``dropped`` holds exact-duplicate ops the rewrite deleted outright;
+    they execute as nothing but stay here so pre-optimization accounting
+    per record matches the program-level totals. A single-member fused op
+    is legal exactly when it carries drops (the dedup survivor)."""
+
+    def __init__(self, ops: List["CommStmt"], slots: List[int],
+                 dropped: Optional[List["CommStmt"]] = None):
+        assert len(ops) == len(slots) and len(ops) >= 1
+        self.ops = list(ops)
+        self.slots = list(slots)
+        self.dropped = list(dropped or [])
+
+    @property
+    def kind(self):
+        return type(self.ops[0])
+
+    @property
+    def direction(self) -> int:
+        return getattr(self.ops[0], "direction", 2)
+
+    @property
+    def n_slots(self) -> int:
+        return len(set(self.slots))
+
+
+class CommChunked(CommStmt):
+    """A collective split into ``chunks`` equal leading-axis chunks
+    issued as independent ops (transform/comm_opt.py overlap rewrite), so
+    the ICI transfer of chunk i+1 can overlap the consumer segment's
+    compute on chunk i — the double-buffered ring schedule of the
+    reference's tile-level comm pipelining."""
+
+    def __init__(self, op: "CommStmt", chunks: int):
+        assert chunks >= 2
+        self.op = op
+        self.chunks = chunks
+
+    @property
+    def direction(self) -> int:
+        return getattr(self.op, "direction", 2)
+
+
 class CommFence(CommStmt):
     pass
 

@@ -74,21 +74,39 @@ def _check_core(core: Tuple[int, int], what: str):
         f"{what} col {core[1]} out of bounds for mesh shape {mesh}."
 
 
+_EMIT_SEQ = [0]
+
+
 def _record_emit(op: str, payload_buf: Optional[Buffer],
-                 direction: Optional[str] = None):
+                 direction: Optional[str] = None) -> dict:
     """Trace-time accounting of a T.comm.* emission: op kind, direction
     and the payload buffer's bytes. The *wire* cost (hops x chunk) is
     accounted where the schedule is known, in parallel/lowering.py; this
     records what the DSL asked for, so untraced-at-lowering programs
-    (e.g. plain golden traces) still show up in metrics_summary()."""
+    (e.g. plain golden traces) still show up in metrics_summary().
+
+    Returns the emission metadata dict; the emit helpers attach it to
+    the CommStmt as ``emit_meta``. The collective optimizer
+    (transform/comm_opt.py) folds the recorded payload bytes into its
+    payload-identity slot keys, so two ops can only share a wire slot
+    when the frontend also agreed on their size."""
     nbytes = 0
     if payload_buf is not None:
         n = payload_buf.numel()
         if n is not None:
             nbytes = n * dtype_bits(payload_buf.dtype) // 8
+    _EMIT_SEQ[0] += 1
     _trace.inc("comm.emitted", op=op)
     _trace.event("comm.emit", "comm", op=op, direction=direction,
                  payload_bytes=nbytes)
+    return {"op": op, "direction": direction, "payload_bytes": nbytes,
+            "seq": _EMIT_SEQ[0]}
+
+
+def _emit_comm(builder, stmt, meta: dict):
+    """Emit a CommStmt carrying its emission metadata."""
+    stmt.emit_meta = meta
+    builder.emit(stmt)
 
 
 def _check_size(size: int, buf: Buffer, what: str = "size"):
@@ -111,10 +129,10 @@ def broadcast(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
     _check_size(size, src)
     assert direction.lower() in DIRECTION_MAP, \
         f"Invalid direction string: {direction}"
-    _record_emit("broadcast", src, direction.lower())
-    b.emit(CommBroadcast(to_region(src), to_region(dst), size, 0,
-                         core_tuple_to_id(src_core),
-                         DIRECTION_MAP[direction.lower()]))
+    meta = _record_emit("broadcast", src, direction.lower())
+    _emit_comm(b, CommBroadcast(to_region(src), to_region(dst), size, 0,
+                                core_tuple_to_id(src_core),
+                                DIRECTION_MAP[direction.lower()]), meta)
 
 
 def put(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
@@ -125,9 +143,10 @@ def put(src: Buffer, dst: Buffer, src_core: Tuple[int, int],
     _check_core(src_core, "src_core")
     _check_core(dst_core, "dst_core")
     _check_size(size, src)
-    _record_emit("put", src)
-    b.emit(CommPut(to_region(src), to_region(dst), size,
-                   core_tuple_to_id(src_core), core_tuple_to_id(dst_core)))
+    meta = _record_emit("put", src)
+    _emit_comm(b, CommPut(to_region(src), to_region(dst), size,
+                          core_tuple_to_id(src_core),
+                          core_tuple_to_id(dst_core)), meta)
 
 
 def all_gather(send_buffer: Buffer, recv_buffer: Buffer,
@@ -156,9 +175,10 @@ def all_gather(send_buffer: Buffer, recv_buffer: Buffer,
         f"Receive buffer shape must be {expected} to hold gathered data from "
         f"{recv_num} cores, but got {got}.")
     _check_size(size, send_buffer)
-    _record_emit("all_gather", send_buffer, d)
-    b.emit(CommAllGather(to_region(send_buffer), to_region(recv_buffer),
-                         DIRECTION_MAP[d], size))
+    meta = _record_emit("all_gather", send_buffer, d)
+    _emit_comm(b, CommAllGather(to_region(send_buffer),
+                                to_region(recv_buffer),
+                                DIRECTION_MAP[d], size), meta)
 
 
 def all_reduce(buffer: Buffer, out: Buffer, reduce_type: str,
@@ -192,9 +212,11 @@ def all_reduce(buffer: Buffer, out: Buffer, reduce_type: str,
     assert direction.lower() in DIRECTION_MAP, \
         f"Invalid direction string: {direction}"
     assert clear in (True, False), "clear must be a boolean value."
-    _record_emit("all_reduce", out, direction.lower())
-    b.emit(CommAllReduce(to_region(buffer), to_region(out), reduce_type,
-                         DIRECTION_MAP[direction.lower()], dim, clear))
+    meta = _record_emit("all_reduce", out, direction.lower())
+    _emit_comm(b, CommAllReduce(to_region(buffer), to_region(out),
+                                reduce_type,
+                                DIRECTION_MAP[direction.lower()], dim,
+                                clear), meta)
 
 
 def barrier(group: Optional[Iterable[Tuple[int, int]]] = None):

@@ -219,7 +219,8 @@ def make_sharded_train_step(cfg: ModelConfig, mesh, lr: float = 3e-4):
         return all(s is None for s in spec)
 
     def local_step(params, opt_state, tokens):
-        dp = jax.lax.axis_size("dp")
+        from ..parallel.device_mesh import axis_size_compat
+        dp = axis_size_compat("dp")
 
         def local_loss(p):
             return loss_fn(p, tokens, cfg, tp_axis="tp")
@@ -255,11 +256,11 @@ def make_sharded_train_step(cfg: ModelConfig, mesh, lr: float = 3e-4):
             return P()
 
         ospec_tree = tree_map_with_path(state_spec, opt_state)
-        f = jax.shard_map(
+        from ..parallel.device_mesh import shard_map_compat
+        f = shard_map_compat(
             local_step, mesh=mesh,
             in_specs=(pspec_tree, ospec_tree, data_spec),
-            out_specs=(pspec_tree, ospec_tree, P()),
-            check_vma=False)
+            out_specs=(pspec_tree, ospec_tree, P()))
         return jax.jit(f)
 
     return init, make

@@ -38,7 +38,8 @@ __all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
 # the engine/lower.py pipeline span names, in pipeline order — the ONE
 # copy every consumer (analyzer --trace, bench.py embedding, tests)
 # keys its per-phase breakdown on
-LOWER_PHASES = ("canonicalize", "checks", "plan", "codegen", "artifact")
+LOWER_PHASES = ("canonicalize", "checks", "comm_opt", "plan", "codegen",
+                "artifact")
 
 
 def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
@@ -234,6 +235,13 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "bytes": sum(v for k, v in counters.items()
                      if k.startswith("comm.bytes{")
                      or k == "comm.bytes"),
+        # collective-optimizer accounting (parallel/lowering.py records
+        # these only when a rewrite fired): wire bytes before/after the
+        # fuse/dce/overlap pass and the hop savings it bought
+        "pre_opt_bytes": c("comm.opt.pre_wire_bytes"),
+        "post_opt_bytes": c("comm.opt.post_wire_bytes"),
+        "hops_saved": c("comm.opt.hops_saved"),
+        "rewrites": c("comm.opt.rewrites"),
     }
 
     def labelled_total(name: str) -> float:

@@ -85,6 +85,31 @@ def make_jax_mesh(nrows: int, ncols: int, devices: Optional[Sequence] = None):
     return Mesh(devs, ("x", "y"))
 
 
+def axis_size_compat(axis_name):
+    """Static mesh-axis size inside shard_map across jax versions:
+    ``lax.axis_size`` when present, else ``lax.psum(1, name)`` (which
+    jax constant-folds to a python int for a static operand)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the top-level API (with
+    ``check_vma``) when present, else the ``jax.experimental`` form
+    (whose equivalent knob is ``check_rep``). Every SPMD entry point in
+    this package goes through here so a jax upgrade/downgrade is a
+    one-line fix."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 class TPUMeshProperties:
     """Per-core resource model — the analog of SunmmioDeviceProperties
     (reference sunmmio_driver.py: RSRAM/WSRAM/ASRAM per core). Used by the

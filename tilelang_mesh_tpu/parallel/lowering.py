@@ -24,17 +24,21 @@ from ..analysis import run_semantic_checks
 from ..codegen.pallas import generate_source
 from ..engine.param import CompiledArtifact, KernelParam
 from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
-                  CommBarrier, CommBroadcast, CommFence, CommPut, CommStmt,
+                  CommBarrier, CommBroadcast, CommChunked, CommFence,
+                  CommFused, CommPut, CommStmt,
                   CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
                   collect, walk)
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
+from ..transform.comm_opt import comm_opt_modes, optimize_collectives
 from ..transform.plan import plan_kernel
-from .device_mesh import core_id_to_tuple, make_jax_mesh
+from .device_mesh import core_id_to_tuple, make_jax_mesh, shard_map_compat
 
 _DIRNAMES = {0: "h", 1: "v", 2: "all"}
 # the mesh axis each direction lowers onto in _apply_comm
 _DIR_AXES = {0: "y", 1: "x", 2: "x,y"}
+# ... and the jax axis-name form of the same map
+_COMM_AXES = {0: ("y",), 1: ("x",), 2: ("x", "y")}
 
 
 class MeshLowerError(Exception):
@@ -102,6 +106,16 @@ def _buffer_reads_writes(stmts: Sequence[Stmt]):
 
 def _comm_buffers(c: CommStmt) -> Tuple[List[Region], List[Region]]:
     """(read regions, written regions) of a collective."""
+    if isinstance(c, CommFused):
+        reads: List[Region] = []
+        writes: List[Region] = []
+        for m in c.ops:
+            r, w = _comm_buffers(m)
+            reads.extend(r)
+            writes.extend(w)
+        return reads, writes
+    if isinstance(c, CommChunked):
+        return _comm_buffers(c.op)
     if isinstance(c, CommBroadcast):
         return [c.src], [c.dst]
     if isinstance(c, CommPut):
@@ -112,6 +126,22 @@ def _comm_buffers(c: CommStmt) -> Tuple[List[Region], List[Region]]:
         regs = [c.buffer] + ([c.out] if not c.clear else [])
         return regs, [c.out]
     return [], []
+
+
+def segments_rw(segments) -> List[Tuple[set, set]]:
+    """Per-segment (read uids, written uids) over a lower_mesh segment
+    list — THE liveness the fragment promoter and the collective
+    optimizer (transform/comm_opt.py DCE fixpoint) both consume, kept in
+    one place so they can never diverge."""
+    rw = []
+    for kind, payload in segments:
+        if kind == "compute":
+            rw.append(_buffer_reads_writes(payload))
+        else:
+            r, w = _comm_buffers(payload)
+            rw.append(({x.buffer.uid for x in r},
+                       {x.buffer.uid for x in w}))
+    return rw
 
 
 def lower_mesh(func: PrimFunc, target: str,
@@ -155,14 +185,29 @@ def lower_mesh(func: PrimFunc, target: str,
 
     # liveness of on-chip buffers across segment boundaries
     alloc_bufs = {a.buffer.uid: a.buffer for a in allocs}
-    seg_rw = []
-    for kind, payload in segments:
-        if kind == "compute":
-            seg_rw.append(_buffer_reads_writes(payload))
-        else:
-            r, w = _comm_buffers(payload)
-            seg_rw.append(({x.buffer.uid for x in r},
-                           {x.buffer.uid for x in w}))
+    seg_rw = segments_rw(segments)
+
+    global_params = list(func.buffer_params)
+    gp_uids = {b.uid for b in global_params}
+
+    # cost-model-driven collective optimization (transform/comm_opt.py):
+    # fuse adjacent same-axis collectives, drop dead ones, chunk large
+    # transfers against their consumer's compute. TL_TPU_COMM_OPT=0
+    # bypasses the pass entirely, restoring the unoptimized schedule.
+    comm_opt_rec = None
+    opt_modes = comm_opt_modes(pass_cfg)
+    if has_comm and opt_modes:
+        with _trace.span("comm_opt", "lower", kernel=func.name, mesh=True):
+            opt = optimize_collectives(segments, seg_rw, gp_uids,
+                                       nrow, ncol, opt_modes, pass_cfg)
+        comm_opt_rec = opt.attrs_record()
+        if opt.rewrites:
+            segments = opt.segments
+            seg_rw = segments_rw(segments)
+            _trace.inc("comm.opt.rewrites", len(opt.rewrites))
+            _trace.inc("comm.opt.pre_wire_bytes", opt.pre_wire_bytes)
+            _trace.inc("comm.opt.post_wire_bytes", opt.post_wire_bytes)
+            _trace.inc("comm.opt.hops_saved", opt.hops_saved)
 
     n_seg = len(segments)
 
@@ -180,9 +225,6 @@ def lower_mesh(func: PrimFunc, target: str,
     compiled_segments: List[dict] = []
     schedule_lines: List[str] = [
         f"mesh_program({func.name}) mesh=({nrow}x{ncol}) axes=(x,y):"]
-
-    global_params = list(func.buffer_params)
-    gp_uids = {b.uid for b in global_params}
 
     collective_recs: List[dict] = []
     for i, (kind, payload) in enumerate(segments):
@@ -249,6 +291,18 @@ def lower_mesh(func: PrimFunc, target: str,
                    else (b.static_shape() or tuple(b.shape))),
             dtype=b.dtype, role=roles.get(b.uid, "in"), mesh_spec=spec))
 
+    # optimizer decisions, golden-testable: only printed when a rewrite
+    # actually fired, so unoptimized programs (and TL_TPU_COMM_OPT=0)
+    # keep the exact pre-optimizer schedule text
+    if comm_opt_rec and comm_opt_rec["rewrites"]:
+        schedule_lines.append(
+            f"  comm_opt[{','.join(comm_opt_rec['modes'])}]: wire "
+            f"{comm_opt_rec['pre_wire_bytes']}B -> "
+            f"{comm_opt_rec['post_wire_bytes']}B, hops "
+            f"{comm_opt_rec['pre_hops']} -> {comm_opt_rec['post_hops']}")
+        for line in comm_opt_rec["rewrites"]:
+            schedule_lines.append(f"    * {line}")
+
     for p in params:
         schedule_lines.append(
             f"  param {p.name}: role={p.role} spec="
@@ -268,7 +322,11 @@ def lower_mesh(func: PrimFunc, target: str,
                "_global_params": global_params,
                # static collective accounting (JSON-safe): what this
                # program moves over ICI, per lowered kernel
-               "collectives": collective_recs})
+               "collectives": collective_recs,
+               # collective-optimizer accounting (None when disabled or
+               # the program has no collectives): pre-/post-optimization
+               # wire bytes, hop savings, and the rewrite decisions
+               "comm_opt": comm_opt_rec})
     return art
 
 
@@ -279,21 +337,44 @@ def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
     (hops x per-hop payload from comm_cost). Recorded as a tracer event
     + counters AND returned as a JSON-safe record for the artifact, so
     a compiled mesh program is self-documenting about its ICI traffic.
-    Barriers/fences (payload-free) return None."""
-    kind = type(c).__name__.replace("Comm", "").lower()
+    Optimizer-rewritten ops (fused/chunked) additionally report the
+    pre-optimization wire bytes they replaced. Barriers/fences
+    (payload-free) return None."""
     hops, payload = comm_cost(c, nrow, ncol)
     if payload == 0:
         return None
     direction = getattr(c, "direction", 2)
-    rec = {"kernel": kernel, "segment": seg_idx, "op": kind,
+    rec = {"kernel": kernel, "segment": seg_idx,
            "axis": _DIR_AXES.get(direction, "x,y"),
            "dir": _DIRNAMES.get(direction, "all"),
            "payload_bytes": payload, "hops": hops,
            # exact hops x per-hop payload: a zero-hop collective (e.g.
            # put onto the same core) moves nothing over the wire
            "wire_bytes": payload * hops}
-    if isinstance(c, CommAllReduce):
-        rec["reduce_type"] = c.reduce_type
+    if isinstance(c, CommFused):
+        inner_kind = type(c.ops[0]).__name__.replace("Comm", "").lower()
+        rec["op"] = f"fused_{inner_kind}"
+        rec["members"] = len(c.ops)
+        rec["slots"] = c.n_slots
+        # what the folded ops (surviving members AND dropped duplicates)
+        # would have cost unoptimized — keeps per-record totals equal to
+        # attrs["comm_opt"].pre_wire_bytes
+        rec["pre_opt_wire_bytes"] = sum(
+            h * p for h, p in (comm_cost(m, nrow, ncol)
+                               for m in list(c.ops) + list(c.dropped)))
+        if isinstance(c.ops[0], CommAllReduce):
+            rec["reduce_type"] = c.ops[0].reduce_type
+    elif isinstance(c, CommChunked):
+        rec["op"] = type(c.op).__name__.replace("Comm", "").lower()
+        rec["chunks"] = c.chunks
+        rec["pre_opt_wire_bytes"] = rec["wire_bytes"]
+        if isinstance(c.op, CommAllReduce):
+            rec["reduce_type"] = c.op.reduce_type
+    else:
+        rec["op"] = type(c).__name__.replace("Comm", "").lower()
+        if isinstance(c, CommAllReduce):
+            rec["reduce_type"] = c.reduce_type
+    kind = rec["op"]
     _faults.maybe_fail("comm.collective", kernel=kernel, op=kind)
     _trace.event("comm.collective", "comm", **rec)
     _trace.inc("comm.ops", op=kind)
@@ -337,6 +418,14 @@ def _make_segment_func(func: PrimFunc, kn: KernelNode, allocs, stmts,
 
 
 def _comm_desc(c: CommStmt, nrow: int, ncol: int) -> str:
+    if isinstance(c, CommFused):
+        kind = type(c.ops[0]).__name__.replace("Comm", "").lower()
+        return (f"fused[{len(c.ops)}x {kind}, "
+                f"axis={_DIR_AXES.get(c.direction, 'x,y')}, "
+                f"dir={_DIRNAMES.get(c.direction, 'all')}, "
+                f"slots={c.n_slots}]")
+    if isinstance(c, CommChunked):
+        return f"chunked[{c.chunks}] {_comm_desc(c.op, nrow, ncol)}"
     if isinstance(c, CommBroadcast):
         return (f"broadcast({c.src.buffer.name} -> {c.dst.buffer.name}, "
                 f"src_core={core_id_to_tuple(c.src_core, (nrow, ncol))}, "
@@ -405,6 +494,22 @@ def comm_cost(c: CommStmt, nrow: int, ncol: int):
 
     if isinstance(c, (CommBarrier, CommFence)):
         return 0, 0
+    if isinstance(c, CommFused):
+        # one batched schedule: the representative member's hop count,
+        # each DISTINCT payload slot's bytes crossing every hop once
+        hops, _ = comm_cost(c.ops[0], nrow, ncol)
+        seen: set = set()
+        payload = 0
+        for m, s in zip(c.ops, c.slots):
+            if s in seen:
+                continue
+            seen.add(s)
+            payload += comm_cost(m, nrow, ncol)[1]
+        return hops, payload
+    if isinstance(c, CommChunked):
+        # chunking pipelines the same bytes over the same hops; the win
+        # is overlap with the consumer, not wire volume
+        return comm_cost(c.op, nrow, ncol)
     if isinstance(c, CommBroadcast):
         r0, c0 = c.src_core // ncol, c.src_core % ncol
         steps = _schedule_steps("broadcast", nrow, ncol, c.direction,
@@ -430,6 +535,15 @@ def _xla_lowering_desc(c: CommStmt, nrow: int, ncol: int) -> str:
     kept in lockstep with _apply_comm so the golden schedule text IS the
     lowering contract."""
     ax = {0: "'y'", 1: "'x'", 2: "('x', 'y')"}
+    if isinstance(c, CommFused):
+        inner = _xla_lowering_desc(c.ops[0], nrow, ncol)
+        return (f"{inner} over {c.n_slots}-slot concat payload "
+                f"({len(c.ops)} members)")
+    if isinstance(c, CommChunked):
+        inner = _xla_lowering_desc(c.op, nrow, ncol)
+        if inner.startswith("xla: "):
+            inner = inner[len("xla: "):]
+        return f"xla: {c.chunks} x [{inner}] on leading-axis chunks"
     if isinstance(c, CommBroadcast):
         r0, c0 = c.src_core // ncol, c.src_core % ncol
         tgt = {0: f"row {r0}", 1: f"col {c0}", 2: "all cores"}[c.direction]
@@ -460,6 +574,21 @@ def _comm_schedule_lines(c: CommStmt, nrow: int, ncol: int) -> list:
     dirname = {0: "h", 1: "v"}
     lines = []
     steps = None
+    if isinstance(c, CommFused):
+        for j, (m, slot) in enumerate(zip(c.ops, c.slots)):
+            lines.append(f"        member[{j}] slot={slot}: "
+                         f"{_comm_desc(m, nrow, ncol)}")
+        lines.extend(_comm_schedule_lines(c.ops[0], nrow, ncol)[:-1])
+        lines.append(f"        {_xla_lowering_desc(c, nrow, ncol)}")
+        return lines
+    if isinstance(c, CommChunked):
+        hops, payload = comm_cost(c, nrow, ncol)
+        lines.extend(_comm_schedule_lines(c.op, nrow, ncol)[:-1])
+        lines.append(
+            f"        overlap: {c.chunks} x {payload // c.chunks}B "
+            f"chunks, transfer(i+1) || compute(i) (double-buffered)")
+        lines.append(f"        {_xla_lowering_desc(c, nrow, ncol)}")
+        return lines
     if isinstance(c, CommBroadcast):
         r0, c0 = c.src_core // ncol, c.src_core % ncol
         steps = _schedule_steps("broadcast", nrow, ncol, c.direction,
@@ -562,8 +691,8 @@ class MeshKernel:
         out_specs = tuple(
             (b.mesh_meta.partition_spec() if b.mesh_meta else P())
             for b in out_bufs)
-        f = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
+        f = shard_map_compat(spmd, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
         self.func = jax.jit(f)
         self._in_params = in_params
         self._out_params = out_params
@@ -603,6 +732,12 @@ class MeshKernel:
     def get_plan(self) -> str:
         return self.artifact.plan_desc
 
+    def get_comm_opt(self) -> Optional[dict]:
+        """Collective-optimizer accounting for this program: modes,
+        pre-/post-optimization wire bytes, hop savings, and the rewrite
+        decisions (None when the optimizer was disabled)."""
+        return self.artifact.attrs.get("comm_opt")
+
     def get_profiler(self, tensor_supply_type=None):
         from ..profiler import Profiler
         from ..utils.tensor import TensorSupplyType
@@ -611,6 +746,71 @@ class MeshKernel:
     @property
     def params(self):
         return self.artifact.params
+
+
+def _nelem(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _participants(direction: int, nrow: int, ncol: int) -> int:
+    return {0: ncol, 1: nrow, 2: nrow * ncol}[direction]
+
+
+def _allreduce_local(op: CommAllReduce, x):
+    """The per-core half of an all_reduce: local reduction over op.dim,
+    returning (local value, mesh-reduce kind)."""
+    import jax.numpy as jnp
+    keepdims = len(op.out.buffer.shape) == len(op.buffer.buffer.shape)
+    kind = op.reduce_type
+    if kind == "abssum":
+        return jnp.sum(jnp.abs(x), axis=op.dim, keepdims=keepdims), "sum"
+    if kind == "absmax":
+        return jnp.max(jnp.abs(x), axis=op.dim, keepdims=keepdims), "max"
+    if kind == "sum":
+        return jnp.sum(x, axis=op.dim, keepdims=keepdims), "sum"
+    if kind == "max":
+        return jnp.max(x, axis=op.dim, keepdims=keepdims), "max"
+    if kind == "min":
+        return jnp.min(x, axis=op.dim, keepdims=keepdims), "min"
+    # bit ops: gather + local combine (no pbit primitive)
+    from ..codegen import rt
+    return (getattr(rt, f"reduce_{kind}")(x, op.dim, keepdims),
+            "gather_" + kind)
+
+
+def _mesh_reduce(local, kind_mesh: str, axes):
+    """The cross-core half of an all_reduce."""
+    from jax import lax
+    if kind_mesh == "sum":
+        return lax.psum(local, axes)
+    if kind_mesh == "max":
+        return lax.pmax(local, axes)
+    if kind_mesh == "min":
+        return lax.pmin(local, axes)
+    g = lax.all_gather(local, axes)
+    from ..codegen import rt
+    return getattr(rt, f"reduce_{kind_mesh[len('gather_'):]}")(g, 0, False)
+
+
+def _allreduce_finish(op: CommAllReduce, red, state, get):
+    """Cast/reshape the mesh-reduced value into op.out, honoring
+    clear=False accumulation."""
+    import jax.numpy as jnp
+    out_buf = op.out.buffer
+    red = red.astype(jnp.dtype(out_buf.dtype)).reshape(
+        tuple(int(s) for s in out_buf.shape))
+    if not op.clear:
+        old = get(op.out)
+        from ..codegen.rt import _COMBINE_FNS
+        kind = op.reduce_type
+        red = _COMBINE_FNS["sum" if kind in ("sum", "abssum") else
+                           ("max" if kind in ("max", "absmax") else
+                            ("min" if kind == "min" else
+                             kind))](old, red)
+    state[out_buf.uid] = red
 
 
 def _apply_comm(op: CommStmt, state: Dict[int, Any], nrow: int, ncol: int):
@@ -640,20 +840,26 @@ def _apply_comm(op: CommStmt, state: Dict[int, Any], nrow: int, ncol: int):
     row = lax.axis_index("x")
     col = lax.axis_index("y")
 
+    if isinstance(op, CommChunked):
+        _apply_chunked(op, state, get, nrow, ncol)
+        return
+
+    if isinstance(op, CommFused):
+        _apply_fused(op, state, get, nrow, ncol, row, col)
+        return
+
     if isinstance(op, CommBroadcast):
         src = get(op.src)
         dst_old = get(op.dst)
         r0, c0 = op.src_core // ncol, op.src_core % ncol
         contrib = jnp.where((row == r0) & (col == c0), src,
                             jnp.zeros_like(src))
+        tot = lax.psum(contrib, _COMM_AXES[op.direction])
         if op.direction == 0:    # horizontal: within the source row
-            tot = lax.psum(contrib, "y")
             new = jnp.where(row == r0, tot.astype(dst_old.dtype), dst_old)
         elif op.direction == 1:  # vertical: within the source column
-            tot = lax.psum(contrib, "x")
             new = jnp.where(col == c0, tot.astype(dst_old.dtype), dst_old)
         else:                    # all cores
-            tot = lax.psum(contrib, ("x", "y"))
             new = tot.astype(dst_old.dtype)
         state[op.dst.buffer.uid] = jnp.broadcast_to(
             new, dst_old.shape).astype(dst_old.dtype)
@@ -687,50 +893,125 @@ def _apply_comm(op: CommStmt, state: Dict[int, Any], nrow: int, ncol: int):
         return
 
     if isinstance(op, CommAllReduce):
-        x = get(op.buffer)
-        out_buf = op.out.buffer
-        keepdims = len(out_buf.shape) == len(op.buffer.buffer.shape)
-        kind = op.reduce_type
-        if kind == "abssum":
-            local = jnp.sum(jnp.abs(x), axis=op.dim, keepdims=keepdims)
-            kind_mesh = "sum"
-        elif kind == "absmax":
-            local = jnp.max(jnp.abs(x), axis=op.dim, keepdims=keepdims)
-            kind_mesh = "max"
-        elif kind == "sum":
-            local = jnp.sum(x, axis=op.dim, keepdims=keepdims)
-            kind_mesh = "sum"
-        elif kind == "max":
-            local = jnp.max(x, axis=op.dim, keepdims=keepdims)
-            kind_mesh = "max"
-        elif kind == "min":
-            local = jnp.min(x, axis=op.dim, keepdims=keepdims)
-            kind_mesh = "min"
-        else:  # bit ops: gather + local combine (no pbit primitive)
-            from ..codegen import rt
-            local = getattr(rt, f"reduce_{kind}")(x, op.dim, keepdims)
-            kind_mesh = "gather_" + kind
-        axes = {0: ("y",), 1: ("x",), 2: ("x", "y")}[op.direction]
-        if kind_mesh == "sum":
-            red = lax.psum(local, axes)
-        elif kind_mesh == "max":
-            red = lax.pmax(local, axes)
-        elif kind_mesh == "min":
-            red = lax.pmin(local, axes)
-        else:
-            g = lax.all_gather(local, axes)
-            from ..codegen import rt
-            red = getattr(rt, f"reduce_{kind}")(g, 0, False)
-        red = red.astype(jnp.dtype(out_buf.dtype)).reshape(
-            tuple(int(s) for s in out_buf.shape))
-        if not op.clear:
-            old = get(op.out)
-            from ..codegen.rt import _COMBINE_FNS
-            red = _COMBINE_FNS["sum" if kind in ("sum", "abssum") else
-                               ("max" if kind in ("max", "absmax") else
-                                ("min" if kind == "min" else
-                                 kind))](old, red)
-        state[out_buf.uid] = red
+        local, kind_mesh = _allreduce_local(op, get(op.buffer))
+        red = _mesh_reduce(local, kind_mesh, _COMM_AXES[op.direction])
+        _allreduce_finish(op, red, state, get)
         return
 
     raise MeshLowerError(f"unhandled collective {type(op).__name__}")
+
+
+def _apply_chunked(op: CommChunked, state, get, nrow: int, ncol: int):
+    """Execute a chunked collective: K independent chunk ops over the
+    split payload, concatenated back — XLA is then free to schedule each
+    chunk's ICI transfer against the consumer segment's compute instead
+    of serializing one monolithic collective before it."""
+    import jax.numpy as jnp
+    from jax import lax
+    inner, k = op.op, op.chunks
+    axes = _COMM_AXES[inner.direction]
+    if isinstance(inner, CommAllGather):
+        send = get(inner.send)
+        n = _participants(inner.direction, nrow, ncol)
+        parts = jnp.split(send, k, axis=0)
+        gs = [lax.all_gather(p, axes).reshape((n,) + p.shape)
+              for p in parts]
+        g = jnp.concatenate(gs, axis=1)
+        recv = inner.recv.buffer
+        state[recv.uid] = g.astype(jnp.dtype(recv.dtype)).reshape(
+            tuple(int(s) for s in recv.shape))
+        return
+    # all_reduce (the rewrite only chunks psum-able reduce types)
+    local, kind_mesh = _allreduce_local(inner, get(inner.buffer))
+    parts = jnp.split(local, k, axis=0)
+    red = jnp.concatenate(
+        [_mesh_reduce(p, kind_mesh, axes) for p in parts], axis=0)
+    _allreduce_finish(inner, red, state, get)
+
+
+def _apply_fused(op: CommFused, state, get, nrow: int, ncol: int,
+                 row, col):
+    """Execute a fused collective: each distinct payload slot is
+    flattened and concatenated, ONE mesh op moves the batch, and the
+    result is split back to every member destination."""
+    import jax.numpy as jnp
+    from jax import lax
+    members, slots = op.ops, op.slots
+    axes = _COMM_AXES[op.direction]
+    head = members[0]
+    order: List[int] = []      # distinct slots, first-appearance order
+    for s in slots:
+        if s not in order:
+            order.append(s)
+
+    if isinstance(head, CommAllReduce):
+        slot_local: Dict[int, Any] = {}
+        kind_mesh = None
+        for m, s in zip(members, slots):
+            if s not in slot_local:
+                slot_local[s], kind_mesh = _allreduce_local(
+                    m, get(m.buffer))
+        flat = jnp.concatenate(
+            [slot_local[s].reshape(-1) for s in order])
+        red = _mesh_reduce(flat, kind_mesh, axes)
+        parts: Dict[int, Any] = {}
+        off = 0
+        for s in order:
+            sz = _nelem(slot_local[s].shape)
+            parts[s] = red[off:off + sz].reshape(slot_local[s].shape)
+            off += sz
+        for m, s in zip(members, slots):
+            _allreduce_finish(m, parts[s], state, get)
+        return
+
+    if isinstance(head, CommAllGather):
+        n = _participants(head.direction, nrow, ncol)
+        slot_send: Dict[int, Any] = {}
+        for m, s in zip(members, slots):
+            if s not in slot_send:
+                slot_send[s] = get(m.send)
+        flat = jnp.concatenate(
+            [slot_send[s].reshape(-1) for s in order])
+        g = lax.all_gather(flat, axes).reshape(n, -1)
+        parts = {}
+        off = 0
+        for s in order:
+            sz = _nelem(slot_send[s].shape)
+            parts[s] = g[:, off:off + sz]
+            off += sz
+        for m, s in zip(members, slots):
+            recv = m.recv.buffer
+            state[recv.uid] = parts[s].astype(
+                jnp.dtype(recv.dtype)).reshape(
+                    tuple(int(x) for x in recv.shape))
+        return
+
+    # broadcast: the fuse key pins src_core + direction across members
+    r0, c0 = head.src_core // ncol, head.src_core % ncol
+    slot_src: Dict[int, Any] = {}
+    for m, s in zip(members, slots):
+        if s not in slot_src:
+            slot_src[s] = get(m.src)
+    flat = jnp.concatenate([slot_src[s].reshape(-1) for s in order])
+    contrib = jnp.where((row == r0) & (col == c0), flat,
+                        jnp.zeros_like(flat))
+    tot = lax.psum(contrib, axes)
+    parts = {}
+    off = 0
+    for s in order:
+        sz = _nelem(slot_src[s].shape)
+        parts[s] = tot[off:off + sz].reshape(slot_src[s].shape)
+        off += sz
+    for m, s in zip(members, slots):
+        dst_old = get(m.dst)
+        part = parts[s]
+        if head.direction == 0:
+            new = jnp.where(row == r0, part.astype(dst_old.dtype),
+                            dst_old)
+        elif head.direction == 1:
+            new = jnp.where(col == c0, part.astype(dst_old.dtype),
+                            dst_old)
+        else:
+            new = part.astype(dst_old.dtype)
+        state[m.dst.buffer.uid] = jnp.broadcast_to(
+            new, dst_old.shape).astype(dst_old.dtype)

@@ -33,7 +33,8 @@ def moe_ffn_local(x, w_router, w1, w2, top_k: int, capacity: int,
     T_local, d = x.shape
     E = w_router.shape[1]
     E_local = w1.shape[0]
-    P = jax.lax.axis_size(axis_name)
+    from .device_mesh import axis_size_compat
+    P = axis_size_compat(axis_name)
     assert E_local * P == E, (E_local, P, E)
     C = capacity
 
@@ -106,10 +107,11 @@ def make_moe_layer(mesh, axis_name: str = "ep", top_k: int = 2,
         return moe_ffn_local(x, wr, w1, w2, top_k, cap, ax,
                              use_tile_kernel)
 
-    f = jax.shard_map(
+    from .device_mesh import shard_map_compat
+    f = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P_(ax), P_(), P_(ax), P_(ax)),
-        out_specs=P_(ax), check_vma=False)
+        out_specs=P_(ax))
     return jax.jit(f)
 
 

@@ -44,7 +44,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     B, H, S, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    P = jax.lax.axis_size(axis_name)
+    from .device_mesh import axis_size_compat
+    P = axis_size_compat(axis_name)
     r = jax.lax.axis_index(axis_name)
 
     # step 0: the diagonal block (always included; causal within the shard)
@@ -75,6 +76,7 @@ def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
         return ring_attention_local(q, k, v, axis_name, causal, sm_scale,
                                     block_M, block_N)
 
-    f = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                      out_specs=spec, check_vma=False)
+    from .device_mesh import shard_map_compat
+    f = shard_map_compat(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)
     return jax.jit(f)

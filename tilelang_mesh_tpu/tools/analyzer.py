@@ -256,11 +256,28 @@ def format_trace_report(records) -> str:
     if s["collectives"]:
         lines.append("collectives (static accounting):")
         for c in s["collectives"]:
+            extra = ""
+            if "pre_opt_wire_bytes" in c:
+                extra = f" pre_opt={c['pre_opt_wire_bytes']}B"
+            if "members" in c:
+                extra += f" members={c['members']} slots={c.get('slots')}"
+            if "chunks" in c:
+                extra += f" chunks={c['chunks']}"
             lines.append(
                 f"  {c.get('kernel', '?')}[{c.get('segment', '?')}] "
                 f"{c.get('op', '?'):<11} axis={c.get('axis', '?'):<4} "
                 f"payload={c.get('payload_bytes', 0)}B "
-                f"hops={c.get('hops', 0)} wire={c.get('wire_bytes', 0)}B")
+                f"hops={c.get('hops', 0)} wire={c.get('wire_bytes', 0)}B"
+                f"{extra}")
+    opt = {k: v for k, v in s["counters"].items()
+           if k.startswith("comm.opt.")}
+    if opt:
+        lines.append("collective optimizer (comm_opt):")
+        lines.append(
+            f"  rewrites={int(opt.get('comm.opt.rewrites', 0))} "
+            f"wire {int(opt.get('comm.opt.pre_wire_bytes', 0))}B -> "
+            f"{int(opt.get('comm.opt.post_wire_bytes', 0))}B "
+            f"hops_saved={int(opt.get('comm.opt.hops_saved', 0))}")
     return "\n".join(lines)
 
 

@@ -1,0 +1,441 @@
+"""Cost-model-driven mesh collective optimizer.
+
+Runs between segmentation and codegen in ``parallel/lowering.lower_mesh``,
+rewriting the segment list the way the reference's comm IR passes rewrite
+its NoC schedules (src/op/comm.cc): the compiler — not program order —
+decides what crosses the ICI and when. Three rewrites, individually
+selectable through ``TL_TPU_COMM_OPT`` (see docs/mesh_comm_opt.md):
+
+``fuse``
+    Adjacent collectives of the same kind on the same mesh axis are
+    batched into one :class:`~..ir.CommFused` op over their concatenated
+    payloads (one XLA collective, one synchronization, one per-hop setup
+    cost instead of N).  Byte-identical members share a payload *slot* —
+    each distinct payload crosses the wire once and fans out to every
+    member destination — and fully identical idempotent duplicates are
+    dropped outright.
+
+``dce``
+    A payload-bearing collective whose written buffers are never read by
+    a later segment and never reach a kernel output is deleted; compute
+    segments left adjacent by the deletion are merged back into one
+    Pallas kernel.
+
+``overlap``
+    A large ``all_gather``/``all_reduce`` feeding a later compute segment
+    is split into K equal leading-axis chunks (:class:`~..ir.CommChunked`)
+    issued as independent collectives, so the ICI transfer of chunk i+1
+    can overlap the consumer's compute on chunk i — the double-buffered
+    ring schedule, chosen only when the cost model says the wire time is
+    worth pipelining (wire bytes >= ``tl.tpu.comm_chunk_bytes``).
+
+Every decision is deterministic (program order + canonical keys that
+include the collective's kind, mesh axis/direction, and operand
+identity — never dict iteration order) and is recorded both in
+``CompiledArtifact.plan_desc`` (golden-testable) and in the artifact's
+``attrs["comm_opt"]`` accounting consumed by ``analyzer trace`` and
+``metrics_summary()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Set, Tuple
+
+from ..ir import (CommAllGather, CommAllReduce, CommBroadcast, CommChunked,
+                  CommFused, CommPut, CommStmt, Region)
+
+# rewrites in canonical order (plan_desc / attrs always print this order)
+MODES = ("fuse", "dce", "overlap")
+
+# reduce types the fused/chunked all_reduce paths can realize with one
+# jax psum/pmax/pmin over a concatenated or split payload; the bit ops
+# take the gather+local-combine path and are left unrewritten
+_PSUMMABLE = ("sum", "abssum", "max", "absmax", "min")
+
+
+def comm_opt_modes(pass_cfg: Optional[dict] = None) -> Tuple[str, ...]:
+    """Active rewrite set: ``tl.tpu.comm_opt`` pass config when present,
+    else the ``TL_TPU_COMM_OPT`` env var.  "1"/"on"/"all" enables every
+    rewrite, "0"/"off" disables the pass, and a comma list selects a
+    subset (e.g. ``fuse,dce`` for debugging the overlap rewrite)."""
+    raw: Any = None
+    if pass_cfg:
+        raw = pass_cfg.get("tl.tpu.comm_opt")
+    if raw is None:
+        from ..env import env
+        raw = env.TL_TPU_COMM_OPT
+    raw = str(raw).strip().lower()
+    if raw in ("1", "on", "true", "all", "yes", ""):
+        return MODES
+    if raw in ("0", "off", "false", "none", "no"):
+        return ()
+    picked = {m.strip() for m in raw.replace("+", ",").split(",")
+              if m.strip()}
+    unknown = picked - set(MODES)
+    if unknown:
+        # a typo'd token must not silently disable the optimizer
+        raise ValueError(
+            f"unknown TL_TPU_COMM_OPT mode(s) {sorted(unknown)}; valid "
+            f"tokens are {list(MODES)}, or 1/0 for all/none")
+    return tuple(m for m in MODES if m in picked)
+
+
+@dataclass
+class CommOptResult:
+    """Outcome of one optimizer run over a segment list."""
+    segments: List[Tuple[str, Any]]
+    modes: Tuple[str, ...]
+    pre_wire_bytes: int = 0
+    post_wire_bytes: int = 0
+    pre_hops: int = 0
+    post_hops: int = 0
+    rewrites: List[str] = field(default_factory=list)
+
+    @property
+    def hops_saved(self) -> int:
+        return max(0, self.pre_hops - self.post_hops)
+
+    def attrs_record(self) -> dict:
+        """JSON-safe accounting for CompiledArtifact.attrs['comm_opt']."""
+        return {
+            "modes": list(self.modes),
+            "pre_wire_bytes": self.pre_wire_bytes,
+            "post_wire_bytes": self.post_wire_bytes,
+            "pre_hops": self.pre_hops,
+            "post_hops": self.post_hops,
+            "hops_saved": self.hops_saved,
+            "rewrites": list(self.rewrites),
+        }
+
+
+# ---------------------------------------------------------------------------
+# canonical keys — deterministic, and always including the collective's
+# kind and mesh direction/axis so grouping can never depend on dict
+# iteration order
+# ---------------------------------------------------------------------------
+
+
+def _region_key(r: Region) -> tuple:
+    return (r.buffer.uid, tuple(str(b) for b in r.base),
+            tuple(str(s) for s in r.shape))
+
+
+def _fuse_key(c: CommStmt) -> Optional[tuple]:
+    """Grouping key for the fusion rewrite: ops with equal keys are
+    batchable into one mesh collective. None = never fused."""
+    if isinstance(c, CommBroadcast):
+        return ("broadcast", c.direction, c.src_core, c.src.dtype)
+    if isinstance(c, CommAllGather):
+        return ("all_gather", c.direction, c.send.dtype)
+    if isinstance(c, CommAllReduce) and c.reduce_type in _PSUMMABLE:
+        return ("all_reduce", c.direction, c.reduce_type, c.buffer.dtype)
+    return None
+
+
+def _slot_key(c: CommStmt) -> tuple:
+    """Payload identity inside a fused group: members with equal slot
+    keys move byte-identical data and share one wire transfer. The
+    payload bytes the DSL recorded at emission (``emit_meta``,
+    language/comm.py) fold into the key as defense in depth — two ops
+    can only share a slot when the frontend also agrees on their size."""
+    meta = getattr(c, "emit_meta", None)
+    nbytes = meta.get("payload_bytes") if meta else None
+    if isinstance(c, CommBroadcast):
+        return ("broadcast", _region_key(c.src), c.size, nbytes)
+    if isinstance(c, CommAllGather):
+        return ("all_gather", _region_key(c.send), c.size, nbytes)
+    # all_reduce payload = the locally-reduced buffer
+    return ("all_reduce", _region_key(c.buffer), c.reduce_type, c.dim,
+            nbytes)
+
+
+def _dup_key(c: CommStmt) -> Optional[tuple]:
+    """Full identity of an IDEMPOTENT collective (payload + destination
+    + semantics): a later op with the same key recomputes exactly the
+    same destination bytes and can be dropped.  Non-idempotent ops
+    (all_reduce clear=False accumulates into dst) return None."""
+    if isinstance(c, CommBroadcast):
+        return ("broadcast", _slot_key(c), _region_key(c.dst),
+                c.dst_offset, c.src_core, c.direction)
+    if isinstance(c, CommAllGather):
+        return ("all_gather", _slot_key(c), _region_key(c.recv),
+                c.direction)
+    if isinstance(c, CommAllReduce) and c.clear:
+        return ("all_reduce", _slot_key(c), _region_key(c.out),
+                c.direction, c.clear)
+    return None
+
+
+def _rw_uids(c: CommStmt) -> Tuple[Set[int], Set[int]]:
+    """(read uids, written uids) of one collective."""
+    from ..parallel.lowering import _comm_buffers
+    r, w = _comm_buffers(c)
+    return ({x.buffer.uid for x in r}, {x.buffer.uid for x in w})
+
+
+def _payload_bearing(c: CommStmt) -> bool:
+    return isinstance(c, (CommBroadcast, CommPut, CommAllGather,
+                          CommAllReduce))
+
+
+# ---------------------------------------------------------------------------
+# the three rewrites
+# ---------------------------------------------------------------------------
+
+
+def _eliminate_dead(segments, seg_rw, global_out_uids, desc_fn, rewrites):
+    """Drop collectives whose results never reach a later read or a
+    kernel output, then merge the compute segments left adjacent."""
+    n = len(segments)
+    keep = [True] * n
+    for i, (kind, payload) in enumerate(segments):
+        if kind != "comm" or not _payload_bearing(payload):
+            continue
+        _, writes = _rw_uids(payload)
+        if not writes:
+            continue
+        live = False
+        for w in sorted(writes):
+            if w in global_out_uids:
+                live = True
+                break
+            if any(w in seg_rw[j][0] for j in range(i + 1, n)):
+                live = True
+                break
+        if not live:
+            keep[i] = False
+            rewrites.append(f"dce: dropped dead {desc_fn(payload)}")
+    out: List[Tuple[str, Any]] = []
+    for i, seg in enumerate(segments):
+        if not keep[i]:
+            continue
+        if (seg[0] == "compute" and out and out[-1][0] == "compute"):
+            # the collective between them is gone: one kernel again
+            out[-1] = ("compute", list(out[-1][1]) + list(seg[1]))
+            rewrites.append("dce: merged adjacent compute segments")
+            continue
+        out.append(seg)
+    return out
+
+
+def _fuse_run(run: List[CommStmt], desc_fn, rewrites) -> List[CommStmt]:
+    """Fuse one maximal run of adjacent payload-bearing collectives.
+    Scans in program order, batching while the fuse key holds and the
+    members stay data-independent; byte-identical idempotent duplicates
+    are dropped, identical payloads to distinct destinations share a
+    payload slot."""
+    out: List[CommStmt] = []
+    i = 0
+    while i < len(run):
+        head = run[i]
+        key = _fuse_key(head)
+        if key is None:
+            out.append(head)
+            i += 1
+            continue
+        members: List[CommStmt] = [head]
+        slots: List[int] = [0]
+        slot_keys: List[tuple] = [_slot_key(head)]
+        dup_keys = {_dup_key(head)} - {None}
+        dropped: List[CommStmt] = []
+        reads0, writes0 = _rw_uids(head)
+        grp_reads, grp_writes = set(reads0), set(writes0)
+        j = i + 1
+        while j < len(run) and _fuse_key(run[j]) == key:
+            cand = run[j]
+            dk = _dup_key(cand)
+            if dk is not None and dk in dup_keys:
+                dropped.append(cand)
+                rewrites.append(
+                    f"fuse: dropped duplicate {desc_fn(cand)}")
+                j += 1
+                continue
+            creads, cwrites = _rw_uids(cand)
+            # batching reorders members into ONE simultaneous op: a
+            # member may not read what an earlier member writes, nor
+            # overwrite anything the group already touches
+            if (creads & grp_writes) or (cwrites & grp_writes) \
+                    or (cwrites & grp_reads):
+                break
+            sk = _slot_key(cand)
+            slots.append(slot_keys.index(sk) if sk in slot_keys
+                         else len(slot_keys))
+            if sk not in slot_keys:
+                slot_keys.append(sk)
+            members.append(cand)
+            if dk is not None:
+                dup_keys.add(dk)
+            grp_reads |= creads
+            grp_writes |= cwrites
+            j += 1
+        if len(members) >= 2 or dropped:
+            # a single survivor still becomes a (1-member) fused op when
+            # duplicates were dropped, so its record can carry the
+            # pre-optimization wire bytes of the ops it replaced
+            fused = CommFused(members, slots, dropped=dropped)
+            out.append(fused)
+            if len(members) >= 2:
+                shared = len(members) - len(set(slots))
+                rewrites.append(
+                    f"fuse: {len(members)}x {desc_fn(members[0])} -> 1 "
+                    f"batched op"
+                    + (f" ({shared} shared payload slot"
+                       f"{'s' if shared > 1 else ''})" if shared else ""))
+        else:
+            out.append(members[0])
+        i = j
+    return out
+
+
+def _fuse_collectives(segments, desc_fn, rewrites):
+    """Batch adjacent same-key collectives across the whole segment
+    list. Barriers, fences and compute segments bound the runs."""
+    out: List[Tuple[str, Any]] = []
+    run: List[CommStmt] = []
+
+    def flush():
+        for op in _fuse_run(run, desc_fn, rewrites):
+            out.append(("comm", op))
+        run.clear()
+
+    for kind, payload in segments:
+        if kind == "comm" and _payload_bearing(payload):
+            run.append(payload)
+            continue
+        flush()
+        out.append((kind, payload))
+    flush()
+    return out
+
+
+def _chunk_candidates(c: CommStmt):
+    """(chunk-axis extent, written uid) when the overlap rewrite knows
+    how to split this collective, else None."""
+    if isinstance(c, CommAllGather):
+        shape = c.send.static_shape()
+        if shape:
+            return shape[0], c.recv.buffer.uid
+    elif isinstance(c, CommAllReduce) and c.reduce_type in _PSUMMABLE:
+        shape = c.out.static_shape()
+        if shape:
+            return shape[0], c.out.buffer.uid
+    return None
+
+
+def _overlap_chunks(segments, cost_fn, desc_fn, pass_cfg, rewrites):
+    """Split large collectives that feed a later compute segment into K
+    pipelined chunks (double-buffered ring-style schedule)."""
+    from ..env import env
+    min_bytes = int(pass_cfg.get("tl.tpu.comm_chunk_bytes",
+                                 env.TL_TPU_COMM_CHUNK_BYTES))
+    want_k = int(pass_cfg.get("tl.tpu.comm_chunks", env.TL_TPU_COMM_CHUNKS))
+    if want_k < 2:
+        return segments
+    out = list(segments)
+    for i, (kind, payload) in enumerate(out):
+        if kind != "comm":
+            continue
+        cand = _chunk_candidates(payload)
+        if cand is None:
+            continue
+        extent, out_uid = cand
+        hops, per_hop = cost_fn(payload)
+        if hops * per_hop < min_bytes:
+            continue
+        # a consumer compute segment must read the result before anything
+        # else overwrites it — otherwise there is nothing to overlap with
+        consumer = None
+        for j in range(i + 1, len(out)):
+            jkind, jpayload = out[j]
+            if jkind == "compute":
+                from ..parallel.lowering import _buffer_reads_writes
+                reads, writes = _buffer_reads_writes(jpayload)
+                if out_uid in reads:
+                    consumer = j
+                    break
+                if out_uid in writes:
+                    break
+            else:
+                creads, cwrites = _rw_uids(jpayload)
+                if out_uid in creads or out_uid in cwrites:
+                    break
+        if consumer is None:
+            continue
+        k = next((kk for kk in range(min(want_k, extent), 1, -1)
+                  if extent % kk == 0), None)
+        if k is None:
+            continue
+        out[i] = ("comm", CommChunked(payload, k))
+        rewrites.append(
+            f"overlap: {desc_fn(payload)} -> {k} pipelined chunks "
+            f"({hops * per_hop}B wire over segment [{consumer}]'s "
+            f"compute)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def optimize_collectives(segments: Sequence[Tuple[str, Any]],
+                         seg_rw: Sequence[Tuple[set, set]],
+                         global_out_uids: Set[int],
+                         nrow: int, ncol: int,
+                         modes: Sequence[str],
+                         pass_cfg: Optional[dict] = None) -> CommOptResult:
+    """Run the enabled rewrites over a lower_mesh segment list.
+
+    ``seg_rw`` is the caller's per-segment (reads, writes) liveness for
+    the INPUT segments (the dce rewrite consumes it); ``global_out_uids``
+    are the kernel's global param buffers (collective results reaching
+    them are always live)."""
+    from ..parallel.lowering import _comm_desc, comm_cost
+    pass_cfg = pass_cfg or {}
+    modes = tuple(m for m in MODES if m in modes)
+
+    def cost_fn(c):
+        return comm_cost(c, nrow, ncol)
+
+    def desc_fn(c):
+        return _comm_desc(c, nrow, ncol)
+
+    def wire(segs) -> Tuple[int, int]:
+        total, hops_total = 0, 0
+        for kind, payload in segs:
+            if kind != "comm":
+                continue
+            hops, per_hop = cost_fn(payload)
+            if per_hop:
+                total += hops * per_hop
+                hops_total += hops
+        return total, hops_total
+
+    from ..parallel.lowering import segments_rw as seg_rw_of
+
+    res = CommOptResult(segments=list(segments), modes=modes)
+    res.pre_wire_bytes, res.pre_hops = wire(segments)
+    segs = list(segments)
+    if "dce" in modes:
+        # to fixpoint: dropping a dead collective can strand the reads
+        # that kept an EARLIER collective alive (a dead chain), so
+        # liveness is recomputed until a pass deletes nothing
+        rw = seg_rw
+        while True:
+            dropped_before = sum(1 for r in res.rewrites
+                                 if r.startswith("dce: dropped"))
+            segs = _eliminate_dead(segs, rw, global_out_uids,
+                                   desc_fn, res.rewrites)
+            if sum(1 for r in res.rewrites
+                   if r.startswith("dce: dropped")) == dropped_before:
+                break
+            rw = seg_rw_of(segs)
+    if "fuse" in modes:
+        segs = _fuse_collectives(segs, desc_fn, res.rewrites)
+    if "overlap" in modes:
+        segs = _overlap_chunks(segs, cost_fn, desc_fn, pass_cfg,
+                               res.rewrites)
+    res.segments = segs
+    res.post_wire_bytes, res.post_hops = wire(segs)
+    return res

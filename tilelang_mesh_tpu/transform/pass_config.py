@@ -31,6 +31,12 @@ class PassConfigKey(str, Enum):
     TL_TPU_INTERPRET = "tl.tpu.interpret"
     TL_TPU_COST_ESTIMATE = "tl.tpu.cost_estimate"
     TL_TPU_ALLOW_INPUT_FUSION = "tl.tpu.allow_input_fusion"
+    # mesh collective optimizer (transform/comm_opt.py): rewrite set
+    # ("1"/"0"/comma list of fuse,dce,overlap — overrides
+    # TL_TPU_COMM_OPT), overlap chunking threshold and chunk count
+    TL_TPU_COMM_OPT = "tl.tpu.comm_opt"
+    TL_TPU_COMM_CHUNK_BYTES = "tl.tpu.comm_chunk_bytes"
+    TL_TPU_COMM_CHUNKS = "tl.tpu.comm_chunks"
     # accepted for API parity, no TPU effect
     TL_DISABLE_TMA_LOWER = "tl.disable_tma_lower"
     TL_DISABLE_WARP_SPECIALIZED = "tl.disable_warp_specialized"
