@@ -1,0 +1,782 @@
+"""Mesh collective verifier & runtime guardrail suite (verify/;
+docs/robustness.md "Schedule verification & guardrails").
+
+Four layers, mirroring the subsystem's wiring points:
+
+1. **Static schedule verifier** — every comm_opt golden program passes
+   clean under the default ``TL_TPU_VERIFY=1`` (byte-identical
+   plan_desc), and every deliberately corrupted schedule (dropped
+   chunk, mismatched fused slot, subset-only barrier, payload/recv
+   alias, fused race, broken wire-byte conservation) raises a
+   ``MeshVerifyError`` naming the offending op. Corruption is injected
+   by wrapping the optimizer the way a miscompiling rewrite would
+   misbehave — the verifier must catch it downstream.
+2. **Differential self-check** — ``TL_TPU_SELFCHECK=1`` diffs the
+   optimized schedule's first call against ``TL_TPU_COMM_OPT=0``;
+   seeded corruption on the collective interpret paths triggers
+   divergence detection plus fallback to the unoptimized schedule.
+3. **Runtime guardrails** — the NaN/Inf sanitizer on collective
+   payloads and kernel outputs, and the collective watchdog
+   (timeout classification, breaker trip, schedule degradation).
+4. **Reporting** — ``verify.*`` counters, ``metrics_summary()
+   ["verify"]``, and the ``analyzer verify`` subcommand.
+
+Everything is deterministic (seeded fault clauses, seeded fuzz RNG).
+"""
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.analysis.checkers import SemanticError
+from tilelang_mesh_tpu.cache.kernel_cache import _CACHE
+from tilelang_mesh_tpu.ir import (Buffer, CommBarrier, CommBroadcast,
+                                  CommChunked, CommFused, Region)
+from tilelang_mesh_tpu.observability import get_tracer
+from tilelang_mesh_tpu.parallel import lowering, mesh_config
+from tilelang_mesh_tpu.parallel.lowering import segments_rw
+from tilelang_mesh_tpu.resilience import FAULT_SITES, TLTimeoutError, inject
+from tilelang_mesh_tpu.resilience.retry import global_breaker
+from tilelang_mesh_tpu.transform import pass_config
+from tilelang_mesh_tpu.verify import (MeshVerifyError, NumericError,
+                                      SelfCheckDivergence, guard_state,
+                                      verify_mode, verify_schedule)
+from tilelang_mesh_tpu.verify.runtime import watchdog_call
+
+MESH = (2, 2)
+NROW, NCOL = MESH
+SHAPE = (8, 128)
+TARGET = f"cpu-mesh[{NROW}x{NCOL}]"
+CHUNK_CFG = {"tl.tpu.comm_chunk_bytes": 1024}
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(monkeypatch):
+    """Fresh kernel cache / tracer / breaker and default guard knobs per
+    test: degraded-kernel state must never leak between tests."""
+    for var in ("TL_TPU_VERIFY", "TL_TPU_SELFCHECK", "TL_TPU_SANITIZE",
+                "TL_TPU_COMM_TIMEOUT_MS", "TL_TPU_FAULTS", "TL_TPU_TRACE"):
+        monkeypatch.delenv(var, raising=False)
+    _CACHE.clear()
+    get_tracer().reset()
+    obs.reset()
+    global_breaker().reset()
+    yield
+    _CACHE.clear()
+    get_tracer().reset()
+    obs.reset()
+    global_breaker().reset()
+
+
+def _global(shape=None):
+    shape = shape or (NROW * NCOL * SHAPE[0], SHAPE[1])
+    return T.MeshTensor(shape, T.MeshShardingPolicy(cross_mesh_dim=0),
+                        MESH, "float32")
+
+
+def _shards(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (NROW * NCOL * SHAPE[0], SHAPE[1])).astype(np.float32)
+
+
+def _fused_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global((NROW * NCOL * SHAPE[0], 1)),
+              C: _global((NROW * NCOL * SHAPE[0], 1))):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                y = T.alloc_fragment(SHAPE, "float32")
+                o1 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                o2 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.copy(A, y)
+                T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                T.comm.all_reduce(y, o2, "sum", "h", dim=1)
+                T.copy(o1, B)
+                T.copy(o2, C)
+        return k
+
+
+def _chunk_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(),
+              B: _global((NROW * NCOL, NCOL, SHAPE[0], SHAPE[1]))):
+            with T.Kernel(1) as bx:
+                send = T.alloc_shared(SHAPE, "float32")
+                recv = T.alloc_shared((NCOL, *SHAPE), "float32")
+                T.copy(A, send)
+                T.comm.all_gather(send, recv, "h")
+                T.copy(recv, B[0, 0, 0])
+        return k
+
+
+def _dedup_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global(), C: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared(SHAPE, "float32")
+                d1 = T.alloc_shared(SHAPE, "float32")
+                d2 = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, x)
+                T.comm.broadcast(x, d1, (0, 1), "h")
+                T.comm.broadcast(x, d1, (0, 1), "h")   # exact duplicate
+                T.comm.broadcast(x, d2, (0, 1), "h")   # same payload
+                T.copy(d1, B)
+                T.copy(d2, C)
+        return k
+
+
+def _dce_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                dead = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, dead, "sum", "v", dim=1)
+                T.copy(x, B)
+        return k
+
+
+def _bcast_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared(SHAPE, "float32")
+                d = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, x)
+                T.comm.broadcast(x, d, (0, 1), "h")
+                T.copy(d, B)
+        return k
+
+
+def _lower(pf, **cfg):
+    if cfg:
+        with pass_config(cfg):
+            return tilelang.lower(pf, target=TARGET)
+    return tilelang.lower(pf, target=TARGET)
+
+
+def _compile(prog, **cfg):
+    if cfg:
+        with pass_config(cfg):
+            return tilelang.compile(prog(), target=TARGET)
+    return tilelang.compile(prog(), target=TARGET)
+
+
+# ---------------------------------------------------------------------------
+# mode parsing
+# ---------------------------------------------------------------------------
+
+
+def test_verify_mode_parsing(monkeypatch):
+    assert verify_mode() == "on"                  # default
+    monkeypatch.setenv("TL_TPU_VERIFY", "0")
+    assert verify_mode() == "off"
+    monkeypatch.setenv("TL_TPU_VERIFY", "strict")
+    assert verify_mode() == "strict"
+    # pass config wins over the env var
+    assert verify_mode({"tl.tpu.verify": "off"}) == "off"
+    with pytest.raises(ValueError, match="unknown TL_TPU_VERIFY"):
+        verify_mode({"tl.tpu.verify": "strcit"})
+
+
+# ---------------------------------------------------------------------------
+# clean schedules verify clean — and plan_desc stays byte-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("prog,cfg", [
+    (_fused_program, {}),
+    (_chunk_program, CHUNK_CFG),
+    (_bcast_program, {}),
+])
+def test_goldens_pass_clean_and_unchanged(prog, cfg, monkeypatch):
+    """Default TL_TPU_VERIFY=1 must neither reject nor reformat any
+    existing golden schedule — a clean verification adds nothing."""
+    art_on = _lower(prog(), **cfg)
+    assert art_on.attrs["verify"] is not None
+    assert art_on.attrs["verify"]["warnings"] == []
+    assert art_on.attrs["verify"]["checked"] >= 1
+    monkeypatch.setenv("TL_TPU_VERIFY", "0")
+    art_off = _lower(prog(), **cfg)
+    assert art_off.attrs["verify"] is None
+    assert art_on.plan_desc == art_off.plan_desc
+
+
+def test_unoptimized_schedules_also_verified(monkeypatch):
+    """The verifier is independent of the optimizer: it runs (and
+    passes) on the TL_TPU_COMM_OPT=0 schedule too."""
+    monkeypatch.setenv("TL_TPU_COMM_OPT", "0")
+    art = _lower(_fused_program())
+    assert art.attrs["comm_opt"] is None
+    assert art.attrs["verify"]["checked"] == 2    # both raw all_reduces
+
+
+def test_verify_counters():
+    _lower(_fused_program())
+    c = obs.metrics_summary()["verify"]
+    assert c["schedules"] == 1
+    assert c["collectives_checked"] >= 1
+    assert c["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: a corrupted schedule must raise, naming the op
+# ---------------------------------------------------------------------------
+
+
+def _with_corruption(monkeypatch, corrupt_fn):
+    """Wrap the optimizer so its (correct) output is corrupted before
+    the verifier sees it — the shape of a miscompiling rewrite."""
+    real = lowering.optimize_collectives
+
+    def wrapper(*args, **kwargs):
+        res = real(*args, **kwargs)
+        corrupt_fn(res)
+        res.rewrites.append("corrupted-by-test")  # force application
+        return res
+
+    monkeypatch.setattr(lowering, "optimize_collectives", wrapper)
+
+
+def _first_comm_idx(res):
+    return next(i for i, (k, _) in enumerate(res.segments) if k == "comm")
+
+
+def test_mutation_dropped_chunk(monkeypatch):
+    """Chunk count that does not divide the payload's leading axis:
+    trailing rows would silently never cross the wire."""
+    def corrupt(res):
+        i = _first_comm_idx(res)
+        res.segments[i] = ("comm", CommChunked(res.segments[i][1], 3))
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError, match=r"dropped chunk.*all_gather"):
+        _lower(_chunk_program())   # default threshold: op still raw
+
+
+def test_mutation_mismatched_fused_slot(monkeypatch):
+    """Two members with DIFFERENT payloads forced onto one wire slot:
+    one destination would receive the other's bytes."""
+    def corrupt(res):
+        for i, (k, p) in enumerate(res.segments):
+            if k == "comm" and isinstance(p, CommFused):
+                p.slots = [0] * len(p.ops)
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError,
+                       match=r"mismatched fused slot.*all_reduce"):
+        _lower(_fused_program())
+
+
+def test_mutation_subset_barrier(monkeypatch):
+    """A barrier only core 0 reaches: every other core deadlocks."""
+    def corrupt(res):
+        res.segments.append(("comm", CommBarrier(group=[0])))
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError, match=r"subset barrier.*barrier"):
+        _lower(_fused_program())
+
+
+def test_mutation_payload_recv_alias(monkeypatch):
+    """A collective reading the buffer it writes: the NoC schedule
+    would consume bytes it is concurrently overwriting."""
+    def corrupt(res):
+        i = _first_comm_idx(res)
+        op = res.segments[i][1]
+        res.segments[i] = ("comm", CommBroadcast(
+            op.send, op.send, -1, 0, 0, 0))
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError,
+                       match=r"payload/recv alias.*broadcast"):
+        _lower(_chunk_program())   # default threshold: op still raw
+
+
+def test_mutation_race_inside_fused(monkeypatch):
+    """A fused member reading another member's output: batching
+    executes them simultaneously, so the read races the write."""
+    def corrupt(res):
+        for _, p in res.segments:
+            if isinstance(p, CommFused):
+                m = copy.copy(p.ops[1])
+                m.buffer = p.ops[0].out   # member[1] reads member[0]'s out
+                p.ops[1] = m
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError, match=r"race inside fused"):
+        _lower(_fused_program())
+
+
+def test_mutation_wire_byte_conservation(monkeypatch):
+    """Accounting drift: the optimizer claims different wire bytes than
+    the op sequence actually moves."""
+    def corrupt(res):
+        res.post_wire_bytes += 64
+    _with_corruption(monkeypatch, corrupt)
+    with pytest.raises(MeshVerifyError, match=r"wire-byte conservation"):
+        _lower(_fused_program())
+
+
+def test_mutation_off_switch_bypasses(monkeypatch):
+    """TL_TPU_VERIFY=0 must bypass the net (escape hatch, documented as
+    dangerous) — the corrupted schedule lowers without complaint."""
+    def corrupt(res):
+        res.segments.append(("comm", CommBarrier(group=[0])))
+    _with_corruption(monkeypatch, corrupt)
+    monkeypatch.setenv("TL_TPU_VERIFY", "0")
+    art = _lower(_fused_program())      # no raise
+    assert art.attrs["verify"] is None
+
+
+def test_strict_escalates_warnings(monkeypatch):
+    """A finding that is only a warning by default (frontend/lowering
+    payload-byte drift) becomes a hard error under strict."""
+    def corrupt(res):
+        i = _first_comm_idx(res)
+        op = res.segments[i][1]
+        meta = dict(getattr(op, "emit_meta", None) or {})
+        meta["payload_bytes"] = (meta.get("payload_bytes") or 4096) + 4
+        op.emit_meta = meta
+    _with_corruption(monkeypatch, corrupt)
+    art = _lower(_chunk_program())      # default mode: warning only
+    assert "verify[on]" in art.plan_desc
+    assert "payload accounting drift" in art.plan_desc
+    assert art.attrs["verify"]["warnings"]
+    monkeypatch.setenv("TL_TPU_VERIFY", "strict")
+    with pytest.raises(MeshVerifyError,
+                       match=r"\(strict\).*accounting drift"):
+        _lower(_chunk_program())
+
+
+# ---------------------------------------------------------------------------
+# direct unit checks + pre-lower alias checker
+# ---------------------------------------------------------------------------
+
+
+def _mini_segments():
+    """A hand-built two-segment schedule for unit-level checks."""
+    src = Buffer("src", SHAPE, "float32", "shared")
+    dst = Buffer("dst", SHAPE, "float32", "shared")
+    bc = CommBroadcast(Region(src, (0, 0), SHAPE),
+                       Region(dst, (0, 0), SHAPE), -1, 0, 0, 0)
+    return [("comm", bc)], {dst.uid}
+
+
+def test_verify_schedule_unit_clean():
+    segs, gp = _mini_segments()
+    rep = verify_schedule(segs, segments_rw(segs), gp, NROW, NCOL)
+    assert rep.checked == 1 and not rep.warnings
+
+
+def test_verify_schedule_unit_off_mode():
+    segs, gp = _mini_segments()
+    rep = verify_schedule(segs, segments_rw(segs), gp, NROW, NCOL,
+                          mode="off")
+    assert rep.checked == 0
+
+
+def test_prelower_alias_checker():
+    """User-written aliasing is rejected pre-lower with the T.comm call
+    named — before segmentation ever runs."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _global(), B: _global()):
+            with T.Kernel(1) as bx:
+                x = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, x)
+                T.comm.broadcast(x, x, (0, 0), "h")
+                T.copy(x, B)
+    with pytest.raises(SemanticError, match=r"broadcast src/dst alias"):
+        tilelang.lower(k, target=TARGET)
+
+
+# ---------------------------------------------------------------------------
+# property fuzz: random comm programs verify clean; corrupted variants
+# are flagged
+# ---------------------------------------------------------------------------
+
+
+def _random_program(rng):
+    """A random top-level collective sequence (kind/axis/direction/
+    payload routing drawn from the rng) over the 2x2 mesh."""
+    n_ops = int(rng.integers(1, 5))
+    spec = []
+    for _ in range(n_ops):
+        kind = rng.choice(["broadcast", "all_reduce", "all_gather",
+                           "barrier"])
+        direction = str(rng.choice(["h", "v", "all"]))
+        src = (int(rng.integers(0, NROW)), int(rng.integers(0, NCOL)))
+        rt = str(rng.choice(["sum", "max", "min"]))
+        spec.append((str(kind), direction, src, rt))
+
+    with mesh_config(*MESH):
+        @T.prim_func
+        def fuzz(A: _global(), B: _global()):
+            with T.Kernel(1) as bx:
+                cur = T.alloc_shared(SHAPE, "float32")
+                T.copy(A, cur)
+                for kind, direction, src, rt in spec:
+                    if kind == "broadcast":
+                        dst = T.alloc_shared(SHAPE, "float32")
+                        T.comm.broadcast(cur, dst, src, direction)
+                        cur = dst
+                    elif kind == "all_reduce":
+                        frag = T.alloc_fragment(SHAPE, "float32")
+                        out = T.alloc_fragment((SHAPE[0], 1), "float32")
+                        T.copy(A, frag)
+                        T.comm.all_reduce(frag, out, rt, direction, dim=1)
+                    elif kind == "all_gather":
+                        n = {"h": NCOL, "v": NROW,
+                             "all": NROW * NCOL}[direction]
+                        recv = T.alloc_shared((n, *SHAPE), "float32")
+                        T.comm.all_gather(cur, recv, direction)
+                    else:
+                        T.comm.barrier()
+                T.copy(cur, B)
+        return fuzz
+
+
+_CORRUPTIONS = ("chunk3", "alias", "subset_barrier")
+
+
+def _fuzz_corrupt(res, which):
+    from tilelang_mesh_tpu.parallel.lowering import _comm_buffers
+    comms = [i for i, (k, p) in enumerate(res.segments)
+             if k == "comm" and not isinstance(p, CommBarrier)]
+    if which == "subset_barrier" or not comms:
+        res.segments.append(("comm", CommBarrier(group=[0])))
+        return
+    i = comms[0]
+    op = res.segments[i][1]
+    if which == "chunk3":
+        res.segments[i] = ("comm", CommChunked(op, 3))
+    else:
+        reads, _ = _comm_buffers(op)
+        r = reads[0]
+        res.segments[i] = ("comm", CommBroadcast(r, r, -1, 0, 0, 0))
+
+
+def test_fuzz_random_programs_verify_clean_and_corruptions_flagged(
+        monkeypatch):
+    rng = np.random.default_rng(20260804)
+    real = lowering.optimize_collectives
+    for trial in range(12):
+        pf = _random_program(rng)
+        cfg = dict(CHUNK_CFG) if rng.random() < 0.5 else {}
+        # 1) the comm_opt-rewritten schedule verifies clean
+        monkeypatch.setattr(lowering, "optimize_collectives", real)
+        art = _lower(pf, **cfg)
+        assert art.attrs["verify"] is not None, f"trial {trial}"
+        assert not art.attrs["verify"]["warnings"], f"trial {trial}"
+        # 2) the unoptimized schedule verifies clean too
+        _lower(pf, **{**cfg, "tl.tpu.comm_opt": "0"})
+        # 3) a mutation-corrupted variant is flagged
+        which = str(rng.choice(_CORRUPTIONS))
+
+        def wrapper(*args, _w=which, **kwargs):
+            res = real(*args, **kwargs)
+            _fuzz_corrupt(res, _w)
+            res.rewrites.append("corrupted-by-fuzz")
+            return res
+
+        monkeypatch.setattr(lowering, "optimize_collectives", wrapper)
+        with pytest.raises(MeshVerifyError):
+            _lower(pf, **cfg)
+
+
+# ---------------------------------------------------------------------------
+# differential self-check
+# ---------------------------------------------------------------------------
+
+
+def test_selfcheck_clean_pass(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    k = _compile(_chunk_program, **CHUNK_CFG)
+    a = _shards(0)
+    r = np.asarray(k(a))
+    v = obs.metrics_summary()["verify"]
+    assert v["selfcheck_runs"] == 1 and v["selfcheck_ok"] == 1
+    assert v["selfcheck_divergence"] == 0
+    # second call: no re-check (first-call-only contract)
+    k(a)
+    assert obs.metrics_summary()["verify"]["selfcheck_runs"] == 1
+    # and the result is actually right
+    with pass_config({"tl.tpu.comm_opt": "0"}):
+        ref = tilelang.compile(_chunk_program(), target=TARGET)
+    np.testing.assert_allclose(r, np.asarray(ref(a)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("prog,cfg,n_out", [
+    (_fused_program, {}, 3),        # fuse rewrite
+    (_dedup_program, {}, 3),        # dedup + slot sharing
+    (_dce_program, {}, 2),          # dead-collective elimination
+    (_chunk_program, CHUNK_CFG, 2),  # overlap chunking
+])
+def test_selfcheck_confirms_equivalence_for_golden_programs(
+        monkeypatch, prog, cfg, n_out):
+    """Acceptance: TL_TPU_SELFCHECK=1 confirms optimized-vs-unoptimized
+    numerical equivalence for every comm_opt golden program shape on
+    the 2x2 CPU mesh."""
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    k = _compile(prog, **cfg)
+    assert k.artifact.attrs["comm_opt"]["rewrites"]
+    res = k(_shards(10))
+    res = res if isinstance(res, tuple) else (res,)
+    assert len(res) == n_out - 1    # outputs = params minus the input
+    v = obs.metrics_summary()["verify"]
+    assert v["selfcheck_runs"] == 1 and v["selfcheck_ok"] == 1
+    assert v["selfcheck_divergence"] == 0 and v["degraded_schedules"] == 0
+
+
+def test_selfcheck_skips_unrewritten_programs(monkeypatch):
+    """No rewrites -> optimized == unoptimized; nothing to diff."""
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    k = _compile(_bcast_program)        # single broadcast: no rewrite
+    assert k.artifact.attrs["comm_opt"]["rewrites"] == []
+    k(_shards(1))
+    v = obs.metrics_summary()["verify"]
+    assert v["selfcheck_runs"] == 0
+    assert v["selfcheck_skipped"] == 1
+
+
+@pytest.mark.parametrize("site,prog,cfg", [
+    ("comm.chunk", _chunk_program, CHUNK_CFG),
+    ("comm.fused", _fused_program, {}),
+])
+def test_selfcheck_catches_injected_corruption(monkeypatch, site, prog,
+                                               cfg):
+    """Seeded corruption in the optimized interpret path: divergence is
+    detected on first call and the kernel falls back to (and returns)
+    the unoptimized schedule's result."""
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    a = _shards(2)
+    with pass_config({**cfg, "tl.tpu.comm_opt": "0"}):
+        ref = tilelang.compile(prog(), target=TARGET)
+    want = ref(a)
+    want = want if isinstance(want, tuple) else (want,)
+    _CACHE.clear()
+    with inject(site, kind="corrupt", seed=3):
+        k = _compile(prog, **cfg)
+        got = k(a)
+    got = got if isinstance(got, tuple) else (got,)
+    v = obs.metrics_summary()["verify"]
+    assert v["selfcheck_divergence"] == 1
+    assert v["degraded_schedules"] == 1
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-6, atol=1e-6)
+    # degraded permanently: later calls route through the reference
+    got2 = k(a)
+    got2 = got2 if isinstance(got2, tuple) else (got2,)
+    np.testing.assert_allclose(np.asarray(got2[0]), np.asarray(want[0]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_selfcheck_divergence_raises_without_fallback(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    monkeypatch.setenv("TL_TPU_FALLBACK", "none")
+    with inject("comm.chunk", kind="corrupt", seed=3):
+        k = _compile(_chunk_program, **CHUNK_CFG)
+        with pytest.raises(SelfCheckDivergence, match="diverged"):
+            k(_shards(3))
+
+
+# ---------------------------------------------------------------------------
+# numeric sanitizer
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_catches_poisoned_mesh_payload(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    k = _compile(_bcast_program)
+    bad = _shards(4)
+    bad[0, 0] = np.nan
+    with pytest.raises(NumericError, match=r"collective \[1\] payload"):
+        k(bad)
+    # clean inputs pass through the same sanitized program
+    r = np.asarray(k(_shards(4)))
+    assert np.isfinite(r).all()
+    assert obs.metrics_summary()["verify"]["sanitize_violations"] == 1
+
+
+def test_sanitizer_catches_nonfinite_kernel_output(monkeypatch):
+    """The non-mesh path: JITKernel outputs are checked host-side."""
+    M, N = 32, 128
+
+    @T.prim_func
+    def double(A: T.Tensor((M, N), "float32"),
+               B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, B)
+
+    k = tilelang.compile(double)
+    a = np.ones((M, N), np.float32)
+    a[3, 7] = np.inf
+    assert not np.isfinite(np.asarray(k(a))).all()   # off: passes through
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    with pytest.raises(NumericError, match=r"output 'B'"):
+        k(a)
+    k(np.ones((M, N), np.float32))                   # clean: fine
+
+
+def test_guards_disabled_is_zero_overhead():
+    """The default dispatch path: no guard state object is allocated,
+    no sanitized variant is ever built."""
+    assert guard_state() is None
+    k = _compile(_bcast_program)
+    k(_shards(5))
+    assert k._sanitized_cache is None
+    assert k._ref_kernel is None
+    assert k._delegate is None
+    v = obs.metrics_summary()["verify"]
+    assert v["selfcheck_runs"] == 0 and v["sanitize_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_call_unit():
+    """Wall-clock expiry: the wedged worker is abandoned and the error
+    is a timeout TLError attributed to the watchdog site."""
+    def wedged():
+        time.sleep(5.0)
+
+    t0 = time.perf_counter()
+    with pytest.raises(TLTimeoutError, match="watchdog"):
+        watchdog_call(wedged, timeout_ms=50, n_collectives=1, kernel="k")
+    assert time.perf_counter() - t0 < 2.0
+    assert watchdog_call(lambda: 7, timeout_ms=5000, n_collectives=1,
+                         kernel="k") == 7
+
+
+def test_watchdog_classifies_and_degrades(monkeypatch):
+    """An injected timeout on the chunked interpret path: classified as
+    timeout, breaker fed, kernel degraded to the unoptimized schedule,
+    call still returns the right answer."""
+    monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "60000")
+    a = _shards(6)
+    with pass_config({**CHUNK_CFG, "tl.tpu.comm_opt": "0"}):
+        ref = tilelang.compile(_chunk_program(), target=TARGET)
+    want = np.asarray(ref(a))
+    _CACHE.clear()
+    with inject("comm.chunk", kind="timeout"):
+        k = _compile(_chunk_program, **CHUNK_CFG)
+        got = np.asarray(k(a))
+    v = obs.metrics_summary()["verify"]
+    assert v["watchdog_timeouts"] == 1
+    assert v["degraded_schedules"] == 1
+    assert global_breaker()._failures        # signature recorded
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_watchdog_exempts_first_call_compile(monkeypatch):
+    """The wall-clock budget arms on WARM dispatches only: the first
+    call's jax trace + XLA compile must never trip it. The second
+    (warm) call under an absurd budget trips and degrades — and the
+    degraded reference's own first call is exempt again."""
+    monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "0.001")
+    k = _compile(_chunk_program, **CHUNK_CFG)
+    a = _shards(9)
+    r1 = np.asarray(k(a))     # compile-heavy first call: exempt
+    assert obs.metrics_summary()["verify"]["watchdog_timeouts"] == 0
+    r2 = np.asarray(k(a))     # warm call: trips, degrades, still right
+    v = obs.metrics_summary()["verify"]
+    assert v["watchdog_timeouts"] == 1 and v["degraded_schedules"] == 1
+    np.testing.assert_allclose(r2, r1, rtol=1e-6, atol=1e-6)
+
+
+def test_watchdog_exempts_fresh_sanitized_variant(monkeypatch):
+    """Warm gating is per program VARIANT: enabling TL_TPU_SANITIZE
+    after warmup compiles a fresh sanitized program, whose first
+    (compile) dispatch must also be exempt from the budget."""
+    monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "0.001")
+    k = _compile(_chunk_program, **CHUNK_CFG)
+    a = _shards(11)
+    k(a)                       # plain variant compiles: exempt
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    k(a)                       # sanitized variant compiles: exempt too
+    assert obs.metrics_summary()["verify"]["watchdog_timeouts"] == 0
+
+
+def test_watchdog_raises_without_fallback(monkeypatch):
+    monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "60000")
+    monkeypatch.setenv("TL_TPU_FALLBACK", "none")
+    with inject("comm.chunk", kind="timeout"):
+        k = _compile(_chunk_program, **CHUNK_CFG)
+        with pytest.raises(TLTimeoutError):
+            k(_shards(7))
+
+
+# ---------------------------------------------------------------------------
+# fault sites + reporting surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_comm_fault_sites_registered():
+    assert "comm.chunk" in FAULT_SITES
+    assert "comm.fused" in FAULT_SITES
+
+
+def test_metrics_summary_verify_section():
+    s = obs.metrics_summary()["verify"]
+    for key in ("schedules", "collectives_checked", "warnings", "errors",
+                "selfcheck_runs", "selfcheck_divergence",
+                "selfcheck_skipped", "sanitize_violations",
+                "watchdog_timeouts", "degraded_schedules"):
+        assert key in s
+
+
+def test_analyzer_verify_subcommand(monkeypatch, tmp_path, capsys):
+    """A traced divergence run is summarized by `analyzer verify`."""
+    from tilelang_mesh_tpu.tools.analyzer import (format_verify_report,
+                                                  main, summarize_verify)
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    with inject("comm.chunk", kind="corrupt", seed=3):
+        k = _compile(_chunk_program, **CHUNK_CFG)
+        k(_shards(8))
+    path = tmp_path / "trace.jsonl"
+    obs.write_jsonl(str(path))
+    records = obs.read_jsonl(str(path))
+    s = summarize_verify(records)
+    assert s["counters"]["verify.selfcheck.divergence"] == 1
+    assert s["selfcheck_divergence"]            # kernel -> details
+    assert s["degraded"]
+    report = format_verify_report(records)
+    assert "selfcheck divergence by kernel" in report
+    assert "degraded to the unoptimized schedule" in report
+    assert main(["verify", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "schedule verification & guardrails" in out
+    assert main(["verify", str(path), "--json"]) == 0
+
+
+@pytest.mark.chaos
+def test_chaos_verify_driver(tmp_path, monkeypatch):
+    """The CI chaos-verify entry point end to end: corruption armed on
+    both comm sites, guardrails must catch it, artifacts written."""
+    from tilelang_mesh_tpu.verify.chaos import main
+    # the CLI sets these in its own process; pin them here so pytest's
+    # env is restored after the in-process invocation
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    assert main(["--out", str(tmp_path), "--seed", "11"]) == 0
+    assert (tmp_path / "chaos_trace.jsonl").exists()
+    assert (tmp_path / "chaos_report.json").exists()
+    import json
+    rep = json.loads((tmp_path / "chaos_report.json").read_text())
+    assert rep["ok"] and len(rep["scenarios"]) == 2

@@ -13,8 +13,10 @@ import pytest
 import jax.numpy as jnp
 
 from tilelang_mesh_tpu.ops.bitnet import quantize_activations
-from tilelang_mesh_tpu.ops.dequant_gemm import (quantize_w4_per_channel,
-                                                w4a8_matmul)
+from tilelang_mesh_tpu.ops.dequant_gemm import (pack_planar, pack_reference,
+                                                quantize_w4_per_channel,
+                                                repack_from_reference,
+                                                unpack_planar, w4a8_matmul)
 
 
 def _int_reference(x, packed, sw):
@@ -50,6 +52,43 @@ def test_w4a8_tracks_f32_gemm_loosely():
     full = x @ w
     rel = np.linalg.norm(out - full) / np.linalg.norm(full)
     assert rel < 0.25, rel
+
+
+def test_repack_from_reference_roundtrip():
+    """Reference layout (per-row K-interleaved, two's-complement
+    nibbles) -> planar +8-bias layout is an exact byte permutation:
+    repack(pack_reference(q)) == pack_planar(q) for every int4 value,
+    and both layouts unpack to the same q."""
+    rng = np.random.default_rng(3)
+    q = rng.integers(-8, 8, size=(64, 48), dtype=np.int64).astype(np.int32)
+    ref_packed = pack_reference(q)
+    assert ref_packed.shape == (32, 48) and ref_packed.dtype == np.uint8
+    # reference nibble layout: row 2k low, row 2k+1 high, two's complement
+    assert (ref_packed[0] & 0xF == (q[0] & 0xF)).all()
+    assert ((ref_packed[0] >> 4) == (q[1] & 0xF)).all()
+    planar = repack_from_reference(ref_packed)
+    np.testing.assert_array_equal(planar, pack_planar(q))
+    np.testing.assert_array_equal(unpack_planar(planar), q)
+    # full boundary coverage: every nibble value survives the round trip
+    edge = np.tile(np.arange(-8, 8, dtype=np.int32), 2).reshape(2, 16)
+    edge = np.repeat(edge, 8, axis=0)   # (16, 16), K even
+    np.testing.assert_array_equal(
+        unpack_planar(repack_from_reference(pack_reference(edge))), edge)
+
+
+def test_w4a8_matmul_accepts_repacked_reference_weights():
+    """A reference-packed checkpoint run through repack_from_reference
+    must produce bit-identical GEMM results to native planar packing."""
+    rng = np.random.default_rng(4)
+    M, N, K = 64, 128, 256
+    x = rng.standard_normal((M, K)).astype(np.float32)
+    w = rng.standard_normal((K, N)).astype(np.float32) * 0.1
+    packed, sw = quantize_w4_per_channel(w)
+    ref_packed = pack_reference(unpack_planar(packed))
+    out_native = np.asarray(w4a8_matmul(jnp.asarray(x), packed, sw))
+    out_repacked = np.asarray(
+        w4a8_matmul(jnp.asarray(x), repack_from_reference(ref_packed), sw))
+    np.testing.assert_array_equal(out_native, out_repacked)
 
 
 def test_w4_pack_roundtrip():

@@ -62,6 +62,10 @@ from .observability import metrics_summary  # noqa: E402
 # breaking, interpreter fallback via TL_TPU_FALLBACK)
 from . import resilience  # noqa: E402
 
+# mesh verifier & runtime guardrails (TL_TPU_VERIFY schedule checks,
+# TL_TPU_SELFCHECK differential check, TL_TPU_SANITIZE, watchdog)
+from . import verify  # noqa: E402
+
 # transform / pass config
 from .transform.pass_config import PassConfigKey  # noqa: E402
 
@@ -76,6 +80,6 @@ __all__ = [
     "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
     "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
     "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
-    "observability", "metrics_summary", "resilience",
+    "observability", "metrics_summary", "resilience", "verify",
     "env", "logger", "set_log_level", "__version__",
 ]

@@ -117,10 +117,40 @@ class StaticBoundsChecker:
         return errs
 
 
+class CollectiveAliasChecker:
+    """A collective's payload region must not alias its destination
+    region: the synthesized NoC schedule would read payload bytes it is
+    concurrently overwriting. This is the pre-lower (user-program) slice
+    of the same rule the post-optimizer schedule verifier
+    (verify/schedule.py) re-checks on the FINAL op sequence — catching
+    it here names the offending T.comm.* call instead of a rewritten
+    op. The all_reduce accumulate read (clear=False reads ``out``) is
+    not aliasing; reading the destination is its semantics."""
+
+    def check(self, func: PrimFunc) -> List[str]:
+        # ONE payload/destination pair spec for both layers: the
+        # verifier owns it, this checker applies it pre-lower
+        from ..verify.schedule import _alias_pairs
+        errs: List[str] = []
+
+        def note(s):
+            if not isinstance(s, CommStmt):
+                return
+            kind = type(s).__name__.replace("Comm", "").lower()
+            for payload, dst, what in _alias_pairs(s):
+                if payload.buffer.uid == dst.buffer.uid:
+                    errs.append(
+                        f"{kind} {what} alias buffer "
+                        f"{payload.buffer.name!r}; use a distinct "
+                        f"destination buffer")
+        walk(func.body, note)
+        return errs
+
+
 def run_semantic_checks(func: PrimFunc) -> None:
     errs: List[str] = []
     for checker in (NestedLoopChecker(), FragmentLoopChecker(),
-                    StaticBoundsChecker()):
+                    StaticBoundsChecker(), CollectiveAliasChecker()):
         errs.extend(checker.check(func))
     if func.kernel_node() is None:
         errs.append("kernel body has no `with T.Kernel(...)` frame")

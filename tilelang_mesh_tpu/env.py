@@ -61,6 +61,20 @@ class Environment:
     # chunk count for the overlap rewrite (clamped to what divides the
     # payload's leading axis)
     TL_TPU_COMM_CHUNKS = EnvVar("TL_TPU_COMM_CHUNKS", 4, int)
+    # mesh verifier & runtime guardrails (verify/; docs/robustness.md).
+    # TL_TPU_VERIFY: "1"/"on" (default) runs the static schedule verifier
+    # after comm_opt, "0"/"off" disables it, "strict" escalates warnings
+    # to hard MeshVerifyErrors.
+    TL_TPU_VERIFY = EnvVar("TL_TPU_VERIFY", "1")
+    # differential self-check: first call of each optimized mesh kernel
+    # also runs the TL_TPU_COMM_OPT=0 schedule and compares outputs
+    TL_TPU_SELFCHECK = EnvVar("TL_TPU_SELFCHECK", False, bool)
+    # NaN/Inf sanitizer on collective payloads and kernel outputs
+    TL_TPU_SANITIZE = EnvVar("TL_TPU_SANITIZE", False, bool)
+    # per-collective watchdog budget in ms (0 = disabled): a mesh
+    # dispatch exceeding budget x n_collectives is classified as a
+    # timeout, trips the breaker, and degrades to the unopt schedule
+    TL_TPU_COMM_TIMEOUT_MS = EnvVar("TL_TPU_COMM_TIMEOUT_MS", 0.0, float)
     # resilience (resilience/ reads these; see docs/robustness.md)
     TL_TPU_FAULTS = EnvVar("TL_TPU_FAULTS", "")          # fault-spec string
     TL_TPU_FALLBACK = EnvVar("TL_TPU_FALLBACK", "interp")  # interp | none

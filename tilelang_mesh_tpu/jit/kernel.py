@@ -21,6 +21,7 @@ from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import TLError
+from ..verify import runtime as _verify_rt
 from ..utils.target import target_is_interpret, target_is_mesh
 from ..utils.tensor import TensorSupplyType, copy_back, to_jax
 
@@ -147,6 +148,13 @@ class JITKernel:
                 result = self.func(*jax_ins)
             self._warmed = True
         results = result if isinstance(result, tuple) else (result,)
+        # opt-in numeric sanitizer (TL_TPU_SANITIZE=1, verify/runtime.py):
+        # NaN/Inf on any float output raises a deterministic
+        # NumericError. Disabled (default): one cached env read.
+        if _verify_rt.sanitize_enabled():
+            _verify_rt.check_host_outputs(
+                results, [p.name for p in self._out_params],
+                kernel=self.artifact.name)
         import jax as _jax
         if _rt_t0:
             # block on the FULL result pytree: a multi-output kernel's

@@ -258,9 +258,23 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "cache_read_errors": c("cache.read_errors"),
         "abandoned_threads": c("autotune.abandoned_threads"),
     }
+    # schedule verifier + runtime guardrails (verify/; docs/robustness.md)
+    verify = {
+        "schedules": c("verify.schedules"),
+        "collectives_checked": c("verify.collectives_checked"),
+        "warnings": c("verify.warnings"),
+        "errors": c("verify.errors"),
+        "selfcheck_runs": c("verify.selfcheck.runs"),
+        "selfcheck_ok": c("verify.selfcheck.ok"),
+        "selfcheck_divergence": c("verify.selfcheck.divergence"),
+        "selfcheck_skipped": c("verify.selfcheck.skipped"),
+        "sanitize_violations": c("verify.sanitize.violations"),
+        "watchdog_timeouts": c("verify.watchdog.timeouts"),
+        "degraded_schedules": c("verify.degraded_schedules"),
+    }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
-            "runtime": _runtime.runtime_summary()}
+            "verify": verify, "runtime": _runtime.runtime_summary()}
 
 
 def _json_safe(obj: Any):

@@ -6,6 +6,32 @@ weights use the *planar* pack (quantize/quantization.py
 quantize_int4_planar) so the unpack is two full-tile mask/shift VPU ops and
 both K-halves of A stay contiguous — no LOP3 bit permutations, no strided
 stores. C = A @ dequant(B).
+
+Weight packing: deviation from the reference layout
+---------------------------------------------------
+The reference kernels pack int4 weights **per-row K-interleaved, two's
+complement**: consecutive K-rows share a byte (row ``2k`` in the low
+nibble, row ``2k+1`` in the high nibble of ``packed[k, n]``), and each
+nibble is the signed value's two's-complement bit pattern (``-8..7`` →
+``0x8..0x7``), unpacked on GPU with LOP3 bit tricks.
+
+This package deliberately deviates on both axes — see
+:func:`quantize_w4_per_channel`:
+
+- **Planar halves** instead of K-interleaving: ``packed[k2, n]`` holds
+  row ``k2`` (low nibble) and row ``K/2 + k2`` (high nibble). Both
+  nibble planes unpack into *contiguous* K-halves, so the GEMM runs as
+  two full-tile ``T.gemm`` calls over ``A[:, 0, :]`` / ``A[:, 1, :]``
+  with no strided stores — the layout the TPU's (8, 128) tiling wants.
+- **+8 bias** (offset-binary) instead of two's complement: nibble =
+  ``q + 8``, so the in-kernel unpack is ``(b & 0xF) - 8`` on widened
+  int32 lanes (:func:`_unpack_nibble`) — Mosaic legalizes neither uint8
+  sign-extension nor uint8 shifts, and offset-binary avoids both.
+
+Interop with reference-packed checkpoints goes through
+:func:`repack_from_reference` (round-trip-tested in tests/test_w4a8.py);
+:func:`pack_reference` produces the reference layout for tests and
+export.
 """
 
 
@@ -238,8 +264,63 @@ def quantize_w4_per_channel(w):
     assert K % 2 == 0
     scales = np.maximum(np.abs(w).max(0), 1e-8) / 7.0
     q = np.clip(np.round(w / scales), -8, 7).astype(np.int32)
+    return pack_planar(q), scales.astype(np.float32)
+
+
+def pack_planar(q):
+    """Pack (K, N) int4 values (``-8..7``) into this package's planar
+    +8-bias layout: ``packed[k2, n] = (q[K/2+k2]+8) << 4 | (q[k2]+8)``.
+    The packing half of :func:`quantize_w4_per_channel`, exposed so
+    repack/round-trip code shares one definition."""
+    import numpy as np
+    q = np.asarray(q, np.int32)
+    K = q.shape[0]
+    assert K % 2 == 0
     lo, hi = q[:K // 2] + 8, q[K // 2:] + 8
-    return ((hi << 4) | lo).astype(np.uint8), scales.astype(np.float32)
+    return ((hi << 4) | lo).astype(np.uint8)
+
+
+def unpack_planar(packed):
+    """Inverse of :func:`pack_planar`: (K/2, N) uint8 planar bytes back
+    to (K, N) int32 values in ``-8..7``."""
+    import numpy as np
+    b = np.asarray(packed, np.int32)
+    lo = (b & 0xF) - 8
+    hi = ((b >> 4) & 0xF) - 8
+    return np.concatenate([lo, hi], axis=0)
+
+
+def pack_reference(q):
+    """Pack (K, N) int4 values into the REFERENCE layout: per-row
+    K-interleaved two's complement — ``packed[k, n]`` holds row ``2k``
+    in the low nibble and row ``2k+1`` in the high nibble, each as the
+    signed value's 4-bit two's-complement pattern. For tests and
+    checkpoint export; the kernels never consume this layout."""
+    import numpy as np
+    q = np.asarray(q, np.int32)
+    K = q.shape[0]
+    assert K % 2 == 0
+    even, odd = q[0::2] & 0xF, q[1::2] & 0xF
+    return ((odd << 4) | even).astype(np.uint8)
+
+
+def repack_from_reference(packed_ref):
+    """Convert reference-packed int4 weights (per-row K-interleaved,
+    two's-complement nibbles — see :func:`pack_reference`) into the
+    planar +8-bias layout the w4a16/w4a8 kernels consume. Pure byte
+    permutation + bias, no requantization: round-trips exactly
+    (tests/test_w4a8.py)."""
+    import numpy as np
+    b = np.asarray(packed_ref, np.int32)
+    # two's-complement nibble -> signed: values >= 8 wrap negative
+    even = (b & 0xF)
+    odd = ((b >> 4) & 0xF)
+    even = np.where(even >= 8, even - 16, even)
+    odd = np.where(odd >= 8, odd - 16, odd)
+    q = np.empty((2 * b.shape[0],) + b.shape[1:], np.int32)
+    q[0::2] = even
+    q[1::2] = odd
+    return pack_planar(q)
 
 
 def w4a8_matmul(x, packed, w_scales, block_M=128, block_N=128,

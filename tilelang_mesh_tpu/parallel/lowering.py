@@ -18,6 +18,7 @@ Golden-testable: `lower_mesh` produces a deterministic textual schedule
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis import run_semantic_checks
@@ -41,8 +42,19 @@ _DIR_AXES = {0: "y", 1: "x", 2: "x,y"}
 _COMM_AXES = {0: ("y",), 1: ("x",), 2: ("x", "y")}
 
 
+logger = logging.getLogger("tilelang_mesh_tpu.parallel")
+
+
 class MeshLowerError(Exception):
     pass
+
+
+def _sanitize_payloads(c: CommStmt) -> List[Region]:
+    """Floating payload (read) regions of one collective — what the
+    TL_TPU_SANITIZE=1 mesh program NaN/Inf-checks before applying it."""
+    from ..verify.runtime import is_float_dtype
+    reads, _ = _comm_buffers(c)
+    return [r for r in reads if is_float_dtype(r.dtype)]
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +303,30 @@ def lower_mesh(func: PrimFunc, target: str,
                    else (b.static_shape() or tuple(b.shape))),
             dtype=b.dtype, role=roles.get(b.uid, "in"), mesh_spec=spec))
 
+    # independent static verification of the FINAL schedule (verify/
+    # schedule.py): deadlock freedom, fused-slot agreement, overlap
+    # races, aliasing, wire-byte conservation. Runs whether or not the
+    # optimizer fired — a corrupted schedule from ANY source must be
+    # caught before it compiles. TL_TPU_VERIFY=0 disables; strict
+    # escalates warnings. Clean runs add nothing to plan_desc, so the
+    # golden schedule texts are unchanged.
+    verify_rec = None
+    from ..verify import verify_mode, verify_schedule
+    vmode = verify_mode(pass_cfg)
+    if has_comm and vmode != "off":
+        with _trace.span("verify", "lower", kernel=func.name, mesh=True):
+            report = verify_schedule(
+                segments, seg_rw, gp_uids, nrow, ncol, mode=vmode,
+                collective_recs=collective_recs,
+                comm_opt_rec=comm_opt_rec, kernel=func.name)
+        verify_rec = report.attrs_record()
+        if report.warnings:
+            schedule_lines.append(
+                f"  verify[{vmode}]: {report.checked} collectives "
+                f"checked, {len(report.warnings)} warning(s)")
+            for w in report.warnings:
+                schedule_lines.append(f"    ! {w}")
+
     # optimizer decisions, golden-testable: only printed when a rewrite
     # actually fired, so unoptimized programs (and TL_TPU_COMM_OPT=0)
     # keep the exact pre-optimizer schedule text
@@ -326,7 +362,14 @@ def lower_mesh(func: PrimFunc, target: str,
                # collective-optimizer accounting (None when disabled or
                # the program has no collectives): pre-/post-optimization
                # wire bytes, hop savings, and the rewrite decisions
-               "comm_opt": comm_opt_rec})
+               "comm_opt": comm_opt_rec,
+               # schedule-verifier record (None when TL_TPU_VERIFY=0 or
+               # the program has no collectives)
+               "verify": verify_rec,
+               # the pass config this artifact was lowered under, kept so
+               # the runtime guardrails (selfcheck/watchdog) can re-lower
+               # the SAME program with only the optimizer disabled
+               "_pass_cfg": dict(pass_cfg)})
     return art
 
 
@@ -654,13 +697,70 @@ class MeshKernel:
         in_bufs = [gp_by_name[p.name] for p in in_params]
         out_bufs = [gp_by_name[p.name] for p in out_params]
 
+        mesh = make_jax_mesh(nrow, ncol)
+        self.mesh = mesh
+        in_specs = tuple(
+            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
+            for b in in_bufs)
+        out_specs = tuple(
+            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
+            for b in out_bufs)
+        self._segments_exec = segments
+        self._seg_calls = seg_calls
+        self._in_bufs = in_bufs
+        self._out_bufs = out_bufs
+        self._in_specs = in_specs
+        self._out_specs = out_specs
+        self._n_collectives = sum(
+            1 for s in segments if s["kind"] == "comm"
+            and not isinstance(s["op"], (CommBarrier, CommFence)))
+        # runtime-guardrail state (verify/runtime.py): all lazily
+        # populated so the guards-off dispatch path stays untouched
+        self._sanitized_cache = None
+        self._ref_kernel = None
+        self._delegate = None
+        self._selfchecked = False
+        # program variants ("plain"/"sanitized") that have completed a
+        # dispatch — i.e. whose jax trace + XLA compile already happened
+        self._warmed_variants: set = set()
+        f = shard_map_compat(self._make_spmd(sanitize=False), mesh=mesh,
+                             in_specs=in_specs, out_specs=out_specs)
+        self.func = jax.jit(f)
+        self._in_params = in_params
+        self._out_params = out_params
+
+    def _make_spmd(self, sanitize: bool):
+        """The per-core SPMD program over the compiled segments. With
+        ``sanitize`` the program also emits one mesh-summed bad-element
+        count per floating collective payload and kernel output (the
+        ``TL_TPU_SANITIZE=1`` flags, checked host-side after dispatch —
+        order matches :meth:`_sanitize_checks` exactly)."""
+        segments = self._segments_exec
+        seg_calls = self._seg_calls
+        in_bufs, out_bufs = self._in_bufs, self._out_bufs
+        nrow, ncol = self.artifact.mesh_config
+
         def spmd(*local_ins):
             import jax.numpy as jnp
+            from jax import lax
+
+            def bad_count(v):
+                return lax.psum(
+                    (~jnp.isfinite(v)).sum().astype(jnp.int32),
+                    ("x", "y"))
+
             state: Dict[int, Any] = {}
+            flags: List[Any] = []
             for b, v in zip(in_bufs, local_ins):
                 state[b.uid] = v
             for seg, call in zip(segments, seg_calls):
                 if seg["kind"] == "comm":
+                    if sanitize:
+                        for reg in _sanitize_payloads(seg["op"]):
+                            v = state.get(reg.buffer.uid)
+                            flags.append(
+                                bad_count(v) if v is not None
+                                else jnp.zeros((), jnp.int32))
                     _apply_comm(seg["op"], state, nrow, ncol)
                     continue
                 plan = seg["plan"]
@@ -681,22 +781,196 @@ class MeshKernel:
                     orig = seg["out_map"].get(pp.buffer.uid, None) \
                         or pp.buffer
                     state[orig.uid] = v
-            return tuple(state[b.uid] for b in out_bufs)
+            outs = tuple(state[b.uid] for b in out_bufs)
+            if sanitize:
+                from ..verify.runtime import is_float_dtype
+                for b, v in zip(out_bufs, outs):
+                    if is_float_dtype(b.dtype):
+                        flags.append(bad_count(v))
+                if flags:
+                    return outs + (jnp.stack(flags),)
+            return outs
 
-        mesh = make_jax_mesh(nrow, ncol)
-        self.mesh = mesh
-        in_specs = tuple(
-            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
-            for b in in_bufs)
-        out_specs = tuple(
-            (b.mesh_meta.partition_spec() if b.mesh_meta else P())
-            for b in out_bufs)
-        f = shard_map_compat(spmd, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs)
-        self.func = jax.jit(f)
-        self._in_params = in_params
-        self._out_params = out_params
-        self._in_bufs = in_bufs
+        return spmd
+
+    def _sanitize_checks(self) -> List[str]:
+        """Descriptions of every sanitizer flag the sanitized SPMD
+        program emits, in emission order."""
+        from ..verify.runtime import is_float_dtype
+        checks: List[str] = []
+        for i, seg in enumerate(self._segments_exec):
+            if seg["kind"] != "comm":
+                continue
+            for reg in _sanitize_payloads(seg["op"]):
+                checks.append(f"collective [{i}] payload "
+                              f"{reg.buffer.name!r}")
+        for b in self._out_bufs:
+            if is_float_dtype(b.dtype):
+                checks.append(f"output {b.name!r}")
+        return checks
+
+    def _sanitized(self):
+        """(jitted sanitized dispatch, flag descriptions), built lazily
+        on the first ``TL_TPU_SANITIZE=1`` dispatch so the disabled path
+        never pays for the second trace."""
+        if self._sanitized_cache is None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+            checks = self._sanitize_checks()
+            out_specs = self._out_specs + ((P(),) if checks else ())
+            fn = jax.jit(shard_map_compat(
+                self._make_spmd(sanitize=True), mesh=self.mesh,
+                in_specs=self._in_specs, out_specs=out_specs))
+            self._sanitized_cache = (fn, checks)
+        return self._sanitized_cache
+
+    # -- runtime guardrails (verify/runtime.py; docs/robustness.md) ----
+    def _dispatch(self, jins):
+        """Execute one dispatch under the enabled runtime guards. With
+        every guard off this is exactly ``self.func(*jins)`` — the
+        guard probe is a few env reads, no allocation."""
+        from ..verify import runtime as _guard
+        if self._delegate is not None:
+            return self._delegate._dispatch(jins)
+        g = _guard.guard_state()
+        if g is None:
+            res = self.func(*jins)
+            self._warmed_variants.add("plain")
+            return res
+        from ..resilience.errors import TLTimeoutError
+        name = self.artifact.name
+
+        def primary():
+            if g.sanitize:
+                fn, checks = self._sanitized()
+                out = fn(*jins)
+                if checks:
+                    _guard.check_flags(out[-1], checks, kernel=name)
+                    out = out[:-1]
+                return out
+            return self.func(*jins)
+
+        variant = "sanitized" if g.sanitize else "plain"
+        try:
+            # the wall-clock watchdog arms only once THIS program
+            # variant is warm: a first call's jax trace + XLA compile
+            # takes seconds and would spuriously trip any realistic
+            # per-collective budget (same gating as JITKernel's
+            # runtime-latency recording) — and flipping TL_TPU_SANITIZE
+            # mid-process compiles a fresh variant, warm again later.
+            # Timeout TLErrors RAISED from the collective path (injected
+            # or organic) are classified on every call either way.
+            if g.timeout_ms > 0 and self._n_collectives and \
+                    variant in self._warmed_variants:
+                res = _guard.watchdog_call(primary, g.timeout_ms,
+                                           self._n_collectives,
+                                           kernel=name)
+            else:
+                res = primary()
+        except TLTimeoutError as e:
+            res = self._on_comm_timeout(e, jins)
+        self._warmed_variants.add(variant)
+        if g.selfcheck and not self._selfchecked:
+            self._selfchecked = True
+            res = self._selfcheck(jins, res)
+        return res
+
+    def _on_comm_timeout(self, exc, jins):
+        """Watchdog expiry (or an injected/organic timeout raised from
+        the collective path): record it, trip the shared breaker, and
+        degrade to the unoptimized schedule when one exists."""
+        from ..env import env as _env
+        from ..resilience.errors import error_signature
+        from ..resilience.retry import global_breaker
+        _trace.inc("verify.watchdog.timeouts")
+        _trace.event("verify.watchdog_timeout", "verify",
+                     kernel=self.artifact.name, error=str(exc))
+        global_breaker().record_failure(error_signature(exc))
+        ref = self._reference_kernel()
+        if ref is None or _env.TL_TPU_FALLBACK != "interp":
+            raise exc
+        logger.warning(
+            "mesh kernel %s hit the collective watchdog (%s); retrying "
+            "on the TL_TPU_COMM_OPT=0 schedule", self.artifact.name, exc)
+        self._use_reference(ref, why="watchdog timeout")
+        return self._delegate._dispatch(jins)
+
+    def _selfcheck(self, jins, res):
+        """``TL_TPU_SELFCHECK=1`` first-call differential check: run the
+        ``TL_TPU_COMM_OPT=0`` schedule on the same inputs and compare
+        outputs within dtype tolerance. Divergence raises a
+        deterministic :class:`~..verify.SelfCheckDivergence`, or — under
+        ``TL_TPU_FALLBACK=interp`` (default) — degrades this kernel to
+        the reference schedule and returns its result."""
+        from ..env import env as _env
+        from ..verify import runtime as _guard
+        ref = self._reference_kernel()
+        if ref is None:
+            # the optimizer rewrote nothing (schedules identical), or
+            # the traced IR is unavailable: nothing to diff against
+            _trace.inc("verify.selfcheck.skipped")
+            return res
+        name = self.artifact.name
+        _trace.inc("verify.selfcheck.runs")
+        r_ref = ref.func(*jins)
+        names = [p.name for p in self._out_params]
+        divs = _guard.compare_outputs(res, r_ref, names)
+        if not divs:
+            _trace.inc("verify.selfcheck.ok")
+            _trace.event("verify.selfcheck_ok", "verify", kernel=name)
+            return res
+        _trace.inc("verify.selfcheck.divergence")
+        _trace.event("verify.selfcheck_divergence", "verify", kernel=name,
+                     divergence=list(divs))
+        err = _guard.SelfCheckDivergence(
+            f"{name}: optimized schedule diverged from the "
+            f"TL_TPU_COMM_OPT=0 reference on first call:\n  - " +
+            "\n  - ".join(divs), site="comm.selfcheck")
+        if _env.TL_TPU_FALLBACK != "interp":
+            raise err
+        logger.warning("%s; falling back to the unoptimized schedule "
+                       "(TL_TPU_FALLBACK=interp)", err)
+        self._use_reference(ref, why="selfcheck divergence")
+        return r_ref
+
+    def _reference_kernel(self) -> Optional["MeshKernel"]:
+        """A MeshKernel for the SAME program lowered with the collective
+        optimizer off — the trustworthy schedule the selfcheck diffs
+        against and the fallback target when a rewritten schedule
+        misbehaves. None when the optimizer changed nothing or the
+        traced IR is unavailable (artifact-only construction)."""
+        if self._ref_kernel is not None:
+            return self._ref_kernel
+        rec = self.artifact.attrs.get("comm_opt")
+        if not rec or not rec.get("rewrites"):
+            return None
+        pf = getattr(self, "prim_func", None)
+        if pf is None:
+            return None
+        from ..engine.lower import lower
+        cfg = dict(self.artifact.attrs.get("_pass_cfg") or {})
+        cfg["tl.tpu.comm_opt"] = "0"
+        art = lower(pf, target=self.artifact.target, pass_configs=cfg)
+        ref = MeshKernel(art, out_idx=self.out_idx)
+        if [p.name for p in ref._out_params] != \
+                [p.name for p in self._out_params]:
+            return None   # param roles diverged; cannot substitute
+        self._ref_kernel = ref
+        return ref
+
+    def _use_reference(self, ref: "MeshKernel", why: str) -> None:
+        """Permanently route this kernel through the unoptimized
+        schedule (the TL_TPU_FALLBACK degradation for mesh programs)."""
+        _trace.inc("verify.degraded_schedules")
+        _trace.event("verify.degraded", "verify",
+                     kernel=self.artifact.name, why=why)
+        logger.warning(
+            "mesh kernel %s degraded to the TL_TPU_COMM_OPT=0 schedule "
+            "(%s)", self.artifact.name, why)
+        self._delegate = ref
+        ref._selfchecked = True    # the reference IS the baseline
+        self._selfchecked = True   # nothing left to diff against
+        self.func = ref.func       # profiler/introspection follow along
 
     def __call__(self, *args, **kwargs):
         from ..utils.tensor import to_jax, copy_back
@@ -714,7 +988,7 @@ class MeshKernel:
         else:
             raise TypeError(f"expected {n_in} inputs, got {len(args)}")
         jins = [to_jax(a) for a in ins]
-        res = self.func(*jins)
+        res = self._dispatch(jins)
         res = res if isinstance(res, tuple) else (res,)
         if outs_provided:
             wrote = False
@@ -910,10 +1184,22 @@ def _apply_chunked(op: CommChunked, state, get, nrow: int, ncol: int):
     from jax import lax
     inner, k = op.op, op.chunks
     axes = _COMM_AXES[inner.direction]
+    # chaos site (TL_TPU_FAULTS="comm.chunk:..."): transient/timeout
+    # kinds raise here (the watchdog's classification path); 'corrupt'
+    # silently poisons chunk 0's payload at trace time — the
+    # miscompile class the differential selfcheck exists to catch
+    corrupt = False
+    try:
+        _faults.maybe_fail("comm.chunk", op=type(inner).__name__,
+                           chunks=k)
+    except _faults.CorruptionRequest:
+        corrupt = True
     if isinstance(inner, CommAllGather):
         send = get(inner.send)
         n = _participants(inner.direction, nrow, ncol)
         parts = jnp.split(send, k, axis=0)
+        if corrupt:
+            parts[0] = parts[0] + 1
         gs = [lax.all_gather(p, axes).reshape((n,) + p.shape)
               for p in parts]
         g = jnp.concatenate(gs, axis=1)
@@ -924,6 +1210,8 @@ def _apply_chunked(op: CommChunked, state, get, nrow: int, ncol: int):
     # all_reduce (the rewrite only chunks psum-able reduce types)
     local, kind_mesh = _allreduce_local(inner, get(inner.buffer))
     parts = jnp.split(local, k, axis=0)
+    if corrupt:
+        parts[0] = parts[0] + 1
     red = jnp.concatenate(
         [_mesh_reduce(p, kind_mesh, axes) for p in parts], axis=0)
     _allreduce_finish(inner, red, state, get)
@@ -939,6 +1227,14 @@ def _apply_fused(op: CommFused, state, get, nrow: int, ncol: int,
     members, slots = op.ops, op.slots
     axes = _COMM_AXES[op.direction]
     head = members[0]
+    # chaos site (TL_TPU_FAULTS="comm.fused:..."): same contract as
+    # comm.chunk — 'corrupt' poisons the concatenated wire payload
+    corrupt = False
+    try:
+        _faults.maybe_fail("comm.fused", op=type(head).__name__,
+                           members=len(members))
+    except _faults.CorruptionRequest:
+        corrupt = True
     order: List[int] = []      # distinct slots, first-appearance order
     for s in slots:
         if s not in order:
@@ -953,6 +1249,8 @@ def _apply_fused(op: CommFused, state, get, nrow: int, ncol: int,
                     m, get(m.buffer))
         flat = jnp.concatenate(
             [slot_local[s].reshape(-1) for s in order])
+        if corrupt:
+            flat = flat + 1
         red = _mesh_reduce(flat, kind_mesh, axes)
         parts: Dict[int, Any] = {}
         off = 0
@@ -972,6 +1270,8 @@ def _apply_fused(op: CommFused, state, get, nrow: int, ncol: int,
                 slot_send[s] = get(m.send)
         flat = jnp.concatenate(
             [slot_send[s].reshape(-1) for s in order])
+        if corrupt:
+            flat = flat + 1
         g = lax.all_gather(flat, axes).reshape(n, -1)
         parts = {}
         off = 0
@@ -993,6 +1293,8 @@ def _apply_fused(op: CommFused, state, get, nrow: int, ncol: int,
         if s not in slot_src:
             slot_src[s] = get(m.src)
     flat = jnp.concatenate([slot_src[s].reshape(-1) for s in order])
+    if corrupt:
+        flat = flat + 1
     contrib = jnp.where((row == r0) & (col == c0), flat,
                         jnp.zeros_like(flat))
     tot = lax.psum(contrib, axes)

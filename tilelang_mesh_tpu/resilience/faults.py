@@ -19,9 +19,12 @@ Grammar (``;``-separated clauses, ``:``-separated fields)::
   byte-for-byte (default 0). The RNG advances once per matching visit.
 - ``kind``  — ``transient`` (default) / ``timeout`` / ``deterministic`` /
   ``oserror`` / ``corrupt``. The first four raise the matching exception
-  from the errors taxonomy; ``corrupt`` is only meaningful at
-  ``cache.disk.write``, where the site simulates a torn write (the
-  artifact lands truncated, exercising checksum + quarantine on load).
+  from the errors taxonomy; ``corrupt`` is site-specific: at
+  ``cache.disk.write`` the site simulates a torn write (the artifact
+  lands truncated, exercising checksum + quarantine on load), and at
+  ``comm.chunk``/``comm.fused`` the collective interpret path silently
+  poisons its wire payload (a compiled-in miscompile, exercising the
+  ``TL_TPU_SELFCHECK`` divergence net — parallel/lowering.py).
 - ``times`` — inject at most N times, then the clause goes inert.
 
 Tests use the ``inject(...)`` context manager instead of the env var.
@@ -61,15 +64,20 @@ FAULT_SITES = (
     "autotune.trial",
     "jit.compile",
     "comm.collective",
+    "comm.chunk",
+    "comm.fused",
 )
 
 _KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt")
 
 
 class CorruptionRequest(Exception):
-    """Raised at ``cache.disk.write`` for ``kind=corrupt`` clauses. The
-    cache catches it and persists a deliberately torn artifact instead of
-    failing the write — the on-disk damage a crash mid-write would leave."""
+    """Raised for ``kind=corrupt`` clauses; the site catches it and
+    corrupts its own artifact instead of failing. ``cache.disk.write``
+    persists a deliberately torn artifact (the on-disk damage a crash
+    mid-write would leave); ``comm.chunk``/``comm.fused`` poison the
+    collective's wire payload at trace time (a silent miscompile for
+    the selfcheck to catch)."""
 
     def __init__(self, site: str):
         super().__init__(f"injected torn write at {site}")

@@ -350,9 +350,95 @@ def format_faults_report(records) -> str:
     return "\n".join(lines)
 
 
+def summarize_verify(records) -> dict:
+    """Aggregate the verifier/guardrail activity of a JSONL trace:
+    schedules verified, warnings/errors per kernel, selfcheck outcomes,
+    sanitizer violations, watchdog trips, and schedule degradations —
+    the guardrail counterpart of ``summarize_faults``."""
+    findings: dict = {}       # kernel -> list of warning/error texts
+    divergence: dict = {}     # kernel -> divergence detail lists
+    sanitize: dict = {}       # kernel -> violated checks
+    watchdog: dict = {}       # kernel -> timeout count
+    degraded: dict = {}       # kernel -> reasons
+    counters: dict = {}
+    for r in records:
+        name = r.get("name")
+        attrs = r.get("attrs", {})
+        k = attrs.get("kernel", "?")
+        if r.get("type") == "event":
+            if name in ("verify.warning", "verify.error"):
+                kind = "error" if name == "verify.error" else "warning"
+                findings.setdefault(k, []).append(
+                    f"{kind}: {attrs.get('finding', '?')}")
+            elif name == "verify.selfcheck_divergence":
+                divergence.setdefault(k, []).extend(
+                    attrs.get("divergence") or ["?"])
+            elif name == "verify.sanitize_violation":
+                sanitize.setdefault(k, []).append(attrs.get("check", "?"))
+            elif name == "verify.watchdog_timeout":
+                watchdog[k] = watchdog.get(k, 0) + 1
+            elif name == "verify.degraded":
+                degraded.setdefault(k, []).append(attrs.get("why", "?"))
+        elif r.get("type") == "counter" and \
+                str(name).startswith("verify."):
+            counters[name] = r["value"]
+    return {"counters": counters, "findings": findings,
+            "selfcheck_divergence": divergence, "sanitize": sanitize,
+            "watchdog": watchdog, "degraded": degraded}
+
+
+def format_verify_report(records) -> str:
+    """Human-readable verifier/guardrail summary of a JSONL trace (CLI
+    ``verify`` subcommand, docs/robustness.md)."""
+    s = summarize_verify(records)
+    c = s["counters"]
+    lines = [
+        "schedule verification & guardrails:",
+        f"  schedules verified      {int(c.get('verify.schedules', 0))}",
+        f"  collectives checked     "
+        f"{int(c.get('verify.collectives_checked', 0))}",
+        f"  warnings / errors       {int(c.get('verify.warnings', 0))} / "
+        f"{int(c.get('verify.errors', 0))}",
+        f"  selfcheck runs/ok/div   "
+        f"{int(c.get('verify.selfcheck.runs', 0))} / "
+        f"{int(c.get('verify.selfcheck.ok', 0))} / "
+        f"{int(c.get('verify.selfcheck.divergence', 0))}",
+        f"  sanitizer violations    "
+        f"{int(c.get('verify.sanitize.violations', 0))}",
+        f"  watchdog timeouts       "
+        f"{int(c.get('verify.watchdog.timeouts', 0))}",
+        f"  degraded schedules      "
+        f"{int(c.get('verify.degraded_schedules', 0))}",
+    ]
+    if s["findings"]:
+        lines.append("verifier findings by kernel:")
+        for k in sorted(s["findings"]):
+            for f in s["findings"][k]:
+                lines.append(f"  {k}: {f}")
+    if s["selfcheck_divergence"]:
+        lines.append("selfcheck divergence by kernel:")
+        for k in sorted(s["selfcheck_divergence"]):
+            for d in s["selfcheck_divergence"][k]:
+                lines.append(f"  {k}: {d}")
+    if s["sanitize"]:
+        lines.append("sanitizer violations by kernel:")
+        for k in sorted(s["sanitize"]):
+            for chk in s["sanitize"][k]:
+                lines.append(f"  {k}: {chk}")
+    if s["watchdog"]:
+        lines.append("watchdog timeouts by kernel:")
+        for k in sorted(s["watchdog"]):
+            lines.append(f"  {k}: {s['watchdog'][k]}")
+    if s["degraded"]:
+        lines.append("kernels degraded to the unoptimized schedule:")
+        for k in sorted(s["degraded"]):
+            lines.append(f"  {k}: {', '.join(s['degraded'][k])}")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
-# CLI: trace / faults / perf-diff subcommands (legacy --flag spellings
-# are translated, so existing scripts keep working)
+# CLI: trace / faults / verify / perf-diff subcommands (legacy --flag
+# spellings are translated, so existing scripts keep working)
 # ---------------------------------------------------------------------------
 
 def _load_trace(path) -> list:
@@ -374,6 +460,12 @@ def _run_trace(path, as_json: bool) -> int:
 def _run_faults(path, as_json: bool) -> int:
     records = _load_trace(path)
     _emit(summarize_faults(records), format_faults_report(records), as_json)
+    return 0
+
+
+def _run_verify(path, as_json: bool) -> int:
+    records = _load_trace(path)
+    _emit(summarize_verify(records), format_verify_report(records), as_json)
     return 0
 
 
@@ -448,6 +540,10 @@ def main(argv=None) -> int:
         "faults", help="injected-fault / retry / degradation counts per "
                        "site (chaos runs, docs/robustness.md)")
     p_fl.add_argument("file", help="JSONL trace file")
+    p_vf = sub.add_parser(
+        "verify", help="schedule-verifier / selfcheck / sanitizer / "
+                       "watchdog summary (docs/robustness.md)")
+    p_vf.add_argument("file", help="JSONL trace file")
     p_pd = sub.add_parser(
         "perf-diff", help="noise-aware per-config latency comparison of "
                           "two bench artifacts; exits 1 on a real "
@@ -463,7 +559,7 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -471,6 +567,8 @@ def main(argv=None) -> int:
         return _run_trace(args.file, args.json)
     if args.cmd == "faults":
         return _run_faults(args.file, args.json)
+    if args.cmd == "verify":
+        return _run_verify(args.file, args.json)
     return _run_perf_diff(args.baseline, args.current, args.json,
                           args.threshold_mads, args.min_rel,
                           args.report_only)

@@ -35,6 +35,13 @@ identity — never dict iteration order) and is recorded both in
 ``CompiledArtifact.plan_desc`` (golden-testable) and in the artifact's
 ``attrs["comm_opt"]`` accounting consumed by ``analyzer trace`` and
 ``metrics_summary()``.
+
+The optimizer does not check its own work: the rewritten schedule is
+independently re-verified before codegen by ``verify/schedule.py``
+(deadlock freedom, slot agreement, overlap races, aliasing, wire-byte
+conservation — ``TL_TPU_VERIFY``, default on), and at runtime the
+``TL_TPU_SELFCHECK=1`` differential check diffs the optimized program's
+first call against the ``TL_TPU_COMM_OPT=0`` schedule.
 """
 
 from __future__ import annotations
@@ -50,8 +57,12 @@ MODES = ("fuse", "dce", "overlap")
 
 # reduce types the fused/chunked all_reduce paths can realize with one
 # jax psum/pmax/pmin over a concatenated or split payload; the bit ops
-# take the gather+local-combine path and are left unrewritten
-_PSUMMABLE = ("sum", "abssum", "max", "absmax", "min")
+# take the gather+local-combine path and are left unrewritten. Public:
+# the schedule verifier (verify/schedule.py) keys its "is this op
+# chunkable at all" rule on the same vocabulary, so the two can never
+# disagree about which collectives the overlap rewrite may touch.
+PSUMMABLE = ("sum", "abssum", "max", "absmax", "min")
+_PSUMMABLE = PSUMMABLE   # pre-verifier spelling, kept for callers
 
 
 def comm_opt_modes(pass_cfg: Optional[dict] = None) -> Tuple[str, ...]:

@@ -37,6 +37,9 @@ class PassConfigKey(str, Enum):
     TL_TPU_COMM_OPT = "tl.tpu.comm_opt"
     TL_TPU_COMM_CHUNK_BYTES = "tl.tpu.comm_chunk_bytes"
     TL_TPU_COMM_CHUNKS = "tl.tpu.comm_chunks"
+    # mesh schedule verifier (verify/schedule.py): "1"/"on" (default),
+    # "0"/"off", or "strict" — overrides TL_TPU_VERIFY
+    TL_TPU_VERIFY = "tl.tpu.verify"
     # accepted for API parity, no TPU effect
     TL_DISABLE_TMA_LOWER = "tl.disable_tma_lower"
     TL_DISABLE_WARP_SPECIALIZED = "tl.disable_warp_specialized"

@@ -1,0 +1,36 @@
+"""Mesh collective verifier & runtime guardrails.
+
+An independent correctness net around the mesh layer, wired in at three
+points (see docs/robustness.md, "Schedule verification & guardrails"):
+
+- ``schedule`` — the static schedule verifier run inside
+  ``parallel/lowering.lower_mesh`` after ``transform/comm_opt.py``:
+  SPMD deadlock freedom, fused-slot agreement, overlap races,
+  payload/recv aliasing, and wire-byte conservation. ``TL_TPU_VERIFY``
+  (default on; ``strict`` escalates warnings) — hard
+  :class:`MeshVerifyError` on violation.
+- ``runtime`` — opt-in dispatch guards: the differential self-check
+  (``TL_TPU_SELFCHECK=1``: optimized vs ``TL_TPU_COMM_OPT=0`` outputs on
+  first call), the NaN/Inf sanitizer (``TL_TPU_SANITIZE=1``), and the
+  per-collective watchdog (``TL_TPU_COMM_TIMEOUT_MS``).
+- ``chaos`` — the seeded chaos-verify driver CI runs: arms faults on
+  the comm interpret paths and asserts the guardrails catch them
+  (``python -m tilelang_mesh_tpu.verify.chaos``).
+
+Everything reports through ``verify.*`` tracer counters/events,
+``metrics_summary()["verify"]``, and the ``analyzer verify`` subcommand.
+"""
+
+from .runtime import (GuardState, NumericError, SelfCheckDivergence,
+                      check_flags, check_host_outputs, compare_outputs,
+                      guard_state, sanitize_enabled, tolerance_for,
+                      watchdog_call)
+from .schedule import (MeshVerifyError, VerifyReport, verify_mode,
+                       verify_schedule)
+
+__all__ = [
+    "MeshVerifyError", "VerifyReport", "verify_mode", "verify_schedule",
+    "NumericError", "SelfCheckDivergence", "GuardState", "guard_state",
+    "sanitize_enabled", "tolerance_for", "compare_outputs",
+    "check_host_outputs", "check_flags", "watchdog_call",
+]

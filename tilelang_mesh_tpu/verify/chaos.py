@@ -1,0 +1,190 @@
+"""Seeded chaos-verify driver (the CI ``chaos-verify`` job).
+
+Arms deterministic corruption faults on the PR-4 collective interpret
+paths (``comm.chunk`` / ``comm.fused``), runs comm-opt-rewritten mesh
+programs on the 2x2 CPU mesh with the differential selfcheck on, and
+asserts the guardrails actually caught the corruption:
+
+- every corrupted program must trigger selfcheck divergence AND degrade
+  to the ``TL_TPU_COMM_OPT=0`` schedule,
+- every degraded program's outputs must match the clean reference,
+- a clean control run must pass selfcheck with zero divergence.
+
+Exit code 0 = all corruption caught (the guardrails work); 1 = a
+corruption slipped through (a real miscompile would too). The JSONL
+trace and a JSON report land in ``--out`` for CI artifact upload;
+``analyzer verify <out>/chaos_trace.jsonl`` prints the summary.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
+        --out chaos_report
+"""
+
+# NOTE: no `from __future__ import annotations` here — the T.prim_func
+# tracer evaluates parameter annotations, and stringified annotations
+# cannot see the factory's closure.
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+MESH = (2, 2)
+SHAPE = (8, 128)
+
+
+def _programs():
+    """(name, program factory, pass config, fault site) per scenario —
+    one exercising the chunked interpret path, one the fused path."""
+    import tilelang_mesh_tpu.language as T
+    from tilelang_mesh_tpu.parallel import mesh_config
+    nrow, ncol = MESH
+
+    def _global(shape=None, name="float32"):
+        shape = shape or (nrow * ncol * SHAPE[0], SHAPE[1])
+        return T.MeshTensor(shape, T.MeshShardingPolicy(cross_mesh_dim=0),
+                            MESH, name)
+
+    def chunked():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def chaos_chunked(A: _global(),
+                              B: _global((nrow * ncol, ncol, SHAPE[0],
+                                          SHAPE[1]))):
+                with T.Kernel(1) as bx:
+                    send = T.alloc_shared(SHAPE, "float32")
+                    recv = T.alloc_shared((ncol, *SHAPE), "float32")
+                    T.copy(A, send)
+                    T.comm.all_gather(send, recv, "h")
+                    T.copy(recv, B[0, 0, 0])
+            return chaos_chunked
+
+    def fused():
+        with mesh_config(*MESH):
+            @T.prim_func
+            def chaos_fused(A: _global(),
+                            B: _global((nrow * ncol * SHAPE[0], 1)),
+                            C: _global((nrow * ncol * SHAPE[0], 1))):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment(SHAPE, "float32")
+                    y = T.alloc_fragment(SHAPE, "float32")
+                    o1 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    o2 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    T.copy(A, x)
+                    T.copy(A, y)
+                    T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                    T.comm.all_reduce(y, o2, "sum", "h", dim=1)
+                    T.copy(o1, B)
+                    T.copy(o2, C)
+            return chaos_fused
+
+    chunk_cfg = {"tl.tpu.comm_chunk_bytes": 1024}
+    return [("chunked_allgather", chunked, chunk_cfg, "comm.chunk"),
+            ("fused_allreduce", fused, {}, "comm.fused")]
+
+
+def _run_one(name, prog, cfg, site, seed, report):
+    import numpy as np
+    import tilelang_mesh_tpu as tilelang
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.parallel import mesh_config  # noqa: F401
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.transform import pass_config
+
+    nrow, ncol = MESH
+    target = f"cpu-mesh[{nrow}x{ncol}]"
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((nrow * ncol * SHAPE[0], SHAPE[1])
+                            ).astype(np.float32)
+
+    def compiled():
+        with pass_config(cfg):
+            return tilelang.compile(prog(), target=target)
+
+    def as_tuple(r):
+        return r if isinstance(r, tuple) else (r,)
+
+    # the trustworthy reference
+    with pass_config({**cfg, "tl.tpu.comm_opt": "0"}):
+        ref = tilelang.compile(prog(), target=target)
+    want = as_tuple(ref(a))
+
+    # clean control: selfcheck must pass
+    tilelang.clear_cache()
+    before = obs.metrics_summary()["verify"]
+    got = as_tuple(compiled()(a))
+    after = obs.metrics_summary()["verify"]
+    clean_ok = (after["selfcheck_ok"] > before["selfcheck_ok"]
+                and after["selfcheck_divergence"]
+                == before["selfcheck_divergence"])
+
+    # corrupted run: selfcheck must diverge AND fall back
+    tilelang.clear_cache()
+    with inject(site, kind="corrupt", seed=seed):
+        k = compiled()
+        got_corrupt = as_tuple(k(a))
+    after2 = obs.metrics_summary()["verify"]
+    caught = (after2["selfcheck_divergence"]
+              > after["selfcheck_divergence"]
+              and after2["degraded_schedules"]
+              > after["degraded_schedules"])
+    numerically_safe = all(
+        np.allclose(np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6)
+        for g, w in zip(got_corrupt, want)) and all(
+        np.allclose(np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6)
+        for g, w in zip(got, want))
+
+    ok = clean_ok and caught and numerically_safe
+    report["scenarios"].append({
+        "name": name, "fault_site": site, "seed": seed,
+        "clean_selfcheck_ok": clean_ok,
+        "corruption_caught": caught,
+        "fallback_numerically_safe": numerically_safe,
+        "ok": ok,
+    })
+    print(f"[chaos-verify] {name}: clean={clean_ok} caught={caught} "  # noqa: T201
+          f"safe={numerically_safe} -> {'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.verify.chaos",
+        description="Seeded chaos run proving the mesh guardrails catch "
+                    "corrupted collective schedules (docs/robustness.md).")
+    ap.add_argument("--out", default="chaos_report",
+                    help="directory for the trace + report artifacts")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    os.environ["TL_TPU_TRACE"] = "1"
+    os.environ["TL_TPU_SELFCHECK"] = "1"
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+    from tilelang_mesh_tpu import observability as obs
+
+    report = {"seed": args.seed, "scenarios": []}
+    ok = True
+    for i, (name, prog, cfg, site) in enumerate(_programs()):
+        ok = _run_one(name, prog, cfg, site, args.seed + i, report) and ok
+    report["ok"] = ok
+
+    trace_path = out / "chaos_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "chaos_report.json").write_text(json.dumps(report, indent=2))
+
+    from ..tools.analyzer import format_verify_report
+    summary = format_verify_report(obs.read_jsonl(str(trace_path)))
+    (out / "chaos_report.txt").write_text(summary + "\n")
+    print(summary)  # noqa: T201
+    print(f"[chaos-verify] {'PASS' if ok else 'FAIL'}; artifacts in "  # noqa: T201
+          f"{out}/")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

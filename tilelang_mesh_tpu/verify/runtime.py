@@ -1,0 +1,213 @@
+"""Runtime guardrails for mesh kernels: differential self-check,
+numeric sanitizer, and the collective watchdog.
+
+Three opt-in nets around the dispatch path (all default-off, all
+zero-cost when off — the dispatch fast path is one env read per knob,
+the same contract as ``TL_TPU_RUNTIME_METRICS``):
+
+- **Self-check** (``TL_TPU_SELFCHECK=1``): the FIRST call of each
+  comm-opt-rewritten mesh kernel also runs through the
+  ``TL_TPU_COMM_OPT=0`` schedule and compares outputs within dtype
+  tolerance. Divergence is a deterministic :class:`SelfCheckDivergence`;
+  under ``TL_TPU_FALLBACK=interp`` (the default) the kernel degrades to
+  the unoptimized schedule and returns its (trustworthy) result instead
+  of raising.
+- **Sanitizer** (``TL_TPU_SANITIZE=1``): NaN/Inf checks on every
+  floating collective payload and kernel output. Mesh kernels lazily
+  build a sanitized variant of their SPMD program whose per-payload
+  finite flags ride back as one extra (replicated) output; plain
+  kernels check their outputs host-side. Violations raise
+  :class:`NumericError` naming the poisoned payload.
+- **Watchdog** (``TL_TPU_COMM_TIMEOUT_MS=N``): a mesh dispatch that
+  exceeds ``N x n_collectives`` ms is classified as a timeout
+  ``TLError``, trips the shared circuit breaker, and degrades to the
+  unoptimized schedule (a hung rewritten collective must not wedge the
+  serving process). The wedged device call cannot be interrupted, so
+  its worker thread is abandoned — uniquely named, like the
+  autotuner's timed-out trial workers.
+
+All three report through ``verify.*`` counters/events,
+``metrics_summary()["verify"]``, and ``analyzer verify``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..env import env
+from ..observability import tracer as _trace
+from ..resilience.errors import DeterministicError, TLTimeoutError
+
+__all__ = ["NumericError", "SelfCheckDivergence", "GuardState",
+           "guard_state", "sanitize_enabled", "tolerance_for",
+           "compare_outputs", "check_host_outputs", "check_flags",
+           "watchdog_call"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.verify")
+
+
+class NumericError(DeterministicError):
+    """The sanitizer found a NaN/Inf on a collective payload or kernel
+    output."""
+
+
+class SelfCheckDivergence(DeterministicError):
+    """The optimized schedule's outputs diverged from the
+    ``TL_TPU_COMM_OPT=0`` reference beyond dtype tolerance."""
+
+
+class GuardState:
+    """Snapshot of the enabled guards for one dispatch. Only allocated
+    when at least one guard is on — the disabled path returns the
+    module-level ``None`` so tests can assert zero allocation."""
+
+    __slots__ = ("selfcheck", "sanitize", "timeout_ms")
+
+    def __init__(self, selfcheck: bool, sanitize: bool, timeout_ms: float):
+        self.selfcheck = selfcheck
+        self.sanitize = sanitize
+        self.timeout_ms = timeout_ms
+
+
+def guard_state() -> Optional[GuardState]:
+    """The enabled runtime guards, or None when everything is off (the
+    common case: short-circuiting env reads, no allocation)."""
+    sc = env.TL_TPU_SELFCHECK
+    sz = env.TL_TPU_SANITIZE
+    to = env.TL_TPU_COMM_TIMEOUT_MS
+    if not (sc or sz or to > 0):
+        return None
+    return GuardState(sc, sz, to)
+
+
+def sanitize_enabled() -> bool:
+    return env.TL_TPU_SANITIZE
+
+
+# ---------------------------------------------------------------------------
+# numeric comparison
+# ---------------------------------------------------------------------------
+
+_TOLERANCES = {
+    "float64": (1e-9, 1e-12),
+    "float32": (1e-5, 1e-6),
+    "bfloat16": (2e-2, 1e-2),
+    "float16": (1e-3, 1e-3),
+}
+
+
+def tolerance_for(dtype: str) -> Tuple[float, float]:
+    """(rtol, atol) for one dtype; integers compare exactly."""
+    return _TOLERANCES.get(str(dtype), (0.0, 0.0))
+
+
+def compare_outputs(got: Sequence, want: Sequence,
+                    names: Sequence[str]) -> List[str]:
+    """Compare two output tuples leaf-by-leaf within dtype tolerance;
+    returns a description per diverging leaf (empty = equivalent)."""
+    import numpy as np
+    divs: List[str] = []
+    for g, w, name in zip(got, want, names):
+        ga, wa = np.asarray(g), np.asarray(w)
+        if ga.shape != wa.shape:
+            divs.append(f"{name}: shape {ga.shape} vs {wa.shape}")
+            continue
+        rtol, atol = tolerance_for(str(wa.dtype))
+        gf = ga.astype(np.float64) if ga.dtype != np.float64 else ga
+        wf = wa.astype(np.float64) if wa.dtype != np.float64 else wa
+        with np.errstate(invalid="ignore"):
+            ok = np.isclose(gf, wf, rtol=rtol, atol=atol, equal_nan=True)
+        if not ok.all():
+            bad = int((~ok).sum())
+            idx = tuple(int(x[0]) for x in np.nonzero(~ok))
+            divs.append(
+                f"{name}: {bad}/{ok.size} element(s) beyond "
+                f"rtol={rtol}/atol={atol}, first at {idx} "
+                f"(got {gf[idx]!r}, want {wf[idx]!r})")
+    return divs
+
+
+# ---------------------------------------------------------------------------
+# sanitizer
+# ---------------------------------------------------------------------------
+
+
+def is_float_dtype(dtype: str) -> bool:
+    return str(dtype).startswith(("float", "bfloat"))
+
+
+def check_flags(flags, checks: Sequence[str], kernel: str) -> None:
+    """Validate the bad-element counts a sanitized SPMD program returned
+    (one per registered check, in registration order)."""
+    import numpy as np
+    vals = np.asarray(flags)
+    for bad, what in zip(vals, checks):
+        if int(bad) > 0:
+            _trace.inc("verify.sanitize.violations")
+            _trace.event("verify.sanitize_violation", "verify",
+                         kernel=kernel, check=what)
+            raise NumericError(
+                f"{kernel}: NaN/Inf detected on {what} "
+                f"(TL_TPU_SANITIZE=1)", site="comm.sanitize")
+
+
+def check_host_outputs(results: Sequence, names: Sequence[str],
+                       kernel: str) -> None:
+    """Host-side NaN/Inf check over a kernel's output leaves (the
+    non-mesh path: no SPMD program to instrument)."""
+    import jax.numpy as jnp
+    for r, name in zip(results, names):
+        if not is_float_dtype(str(getattr(r, "dtype", ""))):
+            continue
+        if bool(jnp.isfinite(r).all()):
+            continue
+        _trace.inc("verify.sanitize.violations")
+        _trace.event("verify.sanitize_violation", "verify", kernel=kernel,
+                     check=f"output {name}")
+        raise NumericError(
+            f"{kernel}: NaN/Inf detected on output {name!r} "
+            f"(TL_TPU_SANITIZE=1)", site="comm.sanitize")
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+_watchdog_seq = itertools.count()
+
+
+def watchdog_call(fn: Callable, timeout_ms: float, n_collectives: int,
+                  kernel: str):
+    """Run ``fn()`` (a device dispatch) under the collective watchdog:
+    the budget is ``timeout_ms`` per collective. On expiry the worker is
+    abandoned (a wedged ICI transfer cannot be interrupted in-process)
+    and a timeout ``TLError`` is raised for the caller to classify."""
+    import queue
+    import jax
+
+    budget_s = timeout_ms * max(1, n_collectives) / 1e3
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _worker():
+        try:
+            q.put((True, jax.block_until_ready(fn())))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            q.put((False, e))
+
+    t = threading.Thread(target=_worker, daemon=True,
+                         name=f"tl-comm-watchdog-{next(_watchdog_seq)}")
+    t.start()
+    try:
+        ok, val = q.get(timeout=budget_s)
+    except queue.Empty:
+        raise TLTimeoutError(
+            f"{kernel}: mesh dispatch exceeded the collective watchdog "
+            f"budget ({timeout_ms}ms x {max(1, n_collectives)} "
+            f"collectives = {budget_s * 1e3:.0f}ms); worker {t.name} "
+            f"abandoned", site="comm.watchdog") from None
+    if not ok:
+        raise val
+    return val

@@ -1,0 +1,418 @@
+"""Static mesh schedule verifier.
+
+An independent correctness net over the segment list `lower_mesh`
+is about to compile — run AFTER ``transform/comm_opt.py`` has rewritten
+it, so a miscompiling rewrite (or a corrupted schedule from any other
+source) is caught before it becomes a silently-wrong compiled program.
+"Independent" is load-bearing: the verifier re-derives payload identity,
+data dependence, and wire-byte totals from the IR itself rather than
+trusting the optimizer's own bookkeeping, the same way the pre-lower
+semantic checks (analysis/checkers.py) re-derive loop legality instead
+of trusting the tracer.
+
+Checks, per the four failure classes a rewritten collective schedule
+can introduce:
+
+1. **SPMD deadlock freedom** — every core must execute the same
+   collective sequence: no collective may hide inside a compute
+   segment (where per-core control flow could skip it), a barrier may
+   not synchronize only a subset of the mesh's cores, and every member
+   of a fused op must agree on kind and mesh axis (a direction-mixed
+   fused op would have different cores waiting on different axes).
+2. **Races** — members batched into one simultaneous ``CommFused`` op
+   must be pairwise data-independent (no member reads or overwrites
+   what another member writes), and a ``CommChunked`` overlap window —
+   the region between the chunked collective and the consumer segment
+   that reads it — must not contain a write to the in-flight buffer.
+3. **Payload/slot agreement** — members sharing a fused payload *slot*
+   must move byte-identical regions (same buffer, window, dtype,
+   semantics), and no collective's payload region may alias its
+   destination region (the NoC schedule would read bytes it is
+   concurrently overwriting).
+4. **Wire-byte conservation** — the bytes the final op sequence moves,
+   re-derived from ``comm_cost``, must equal both the per-record
+   ``attrs["collectives"]`` accounting and the optimizer's own
+   ``post_wire_bytes`` claim; a mismatch means a rewrite lost or
+   invented payload.
+
+``TL_TPU_VERIFY`` (or pass config ``tl.tpu.verify``) selects the mode:
+``1``/``on`` (default) raises :class:`MeshVerifyError` on violations and
+records warnings in ``plan_desc``; ``strict`` escalates warnings to
+errors; ``0``/``off`` disables the pass. Every run lands in the tracer
+(``verify.*`` counters, ``verify.warning``/``verify.error`` events) and
+``metrics_summary()["verify"]``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Set, Tuple
+
+from ..ir import (CommAllGather, CommAllReduce, CommBarrier, CommBroadcast,
+                  CommChunked, CommFence, CommFused, CommPut, CommStmt,
+                  Region, walk)
+from ..observability import tracer as _trace
+from ..resilience.errors import DeterministicError
+
+__all__ = ["MeshVerifyError", "VerifyReport", "verify_mode",
+           "verify_schedule"]
+
+MODES = ("off", "on", "strict")
+
+
+class MeshVerifyError(DeterministicError):
+    """A rewritten mesh schedule failed static verification. Subclasses
+    ``DeterministicError``: retrying the same compile cannot help, and
+    the circuit breaker should learn the signature."""
+
+
+@dataclass
+class VerifyReport:
+    """Outcome of one verifier run over a final segment list."""
+    mode: str
+    checked: int = 0                  # collectives examined
+    errors: List[str] = field(default_factory=list)
+    warnings: List[str] = field(default_factory=list)
+
+    def attrs_record(self) -> dict:
+        """JSON-safe record for CompiledArtifact.attrs['verify']."""
+        return {"mode": self.mode, "checked": self.checked,
+                "warnings": list(self.warnings)}
+
+
+def verify_mode(pass_cfg: Optional[dict] = None) -> str:
+    """Active verifier mode: ``tl.tpu.verify`` pass config when present,
+    else ``TL_TPU_VERIFY``. Unknown tokens raise — a typo'd mode must
+    not silently disable the safety net."""
+    raw: Any = None
+    if pass_cfg:
+        raw = pass_cfg.get("tl.tpu.verify")
+    if raw is None:
+        from ..env import env
+        raw = env.TL_TPU_VERIFY
+    raw = str(raw).strip().lower()
+    if raw in ("1", "on", "true", "yes", ""):
+        return "on"
+    if raw in ("0", "off", "false", "no", "none"):
+        return "off"
+    if raw == "strict":
+        return "strict"
+    raise ValueError(
+        f"unknown TL_TPU_VERIFY mode {raw!r}; valid values are 0/off, "
+        f"1/on, strict")
+
+
+# ---------------------------------------------------------------------------
+# independent payload identity (deliberately NOT comm_opt's _slot_key:
+# the net re-derives what two ops move from the IR regions themselves)
+# ---------------------------------------------------------------------------
+
+
+def _region_id(r: Region) -> tuple:
+    return (r.buffer.uid, tuple(str(b) for b in r.base),
+            tuple(str(s) for s in r.shape), r.dtype)
+
+
+def _payload_identity(c: CommStmt) -> Optional[tuple]:
+    """What one collective moves over the wire: payload region identity
+    plus the semantics that change its bytes. Two ops may share a fused
+    payload slot only when these agree exactly."""
+    if isinstance(c, CommBroadcast):
+        return ("broadcast", _region_id(c.src), c.size, c.src_core)
+    if isinstance(c, CommAllGather):
+        return ("all_gather", _region_id(c.send), c.size)
+    if isinstance(c, CommAllReduce):
+        return ("all_reduce", _region_id(c.buffer), c.reduce_type, c.dim)
+    if isinstance(c, CommPut):
+        return ("put", _region_id(c.src), c.size, c.src_core, c.dst_core)
+    return None
+
+
+def _alias_pairs(c: CommStmt) -> List[Tuple[Region, Region, str]]:
+    """(payload region, destination region) pairs that must not share a
+    buffer: the schedule would read payload bytes it is concurrently
+    overwriting. The all_reduce accumulate read (clear=False) is not a
+    pair — reading the destination is its semantics."""
+    if isinstance(c, CommBroadcast):
+        return [(c.src, c.dst, "src/dst")]
+    if isinstance(c, CommPut):
+        return [(c.src, c.dst, "src/dst")]
+    if isinstance(c, CommAllGather):
+        return [(c.send, c.recv, "send/recv")]
+    if isinstance(c, CommAllReduce):
+        return [(c.buffer, c.out, "buffer/out")]
+    return []
+
+
+def _leaf_ops(c: CommStmt) -> List[CommStmt]:
+    if isinstance(c, CommFused):
+        return list(c.ops)
+    if isinstance(c, CommChunked):
+        return [c.op]
+    return [c]
+
+
+def _chunk_extent(c: CommStmt) -> Optional[int]:
+    """Leading-axis extent the overlap rewrite splits, or None when this
+    op kind cannot be chunked at all."""
+    from ..transform.comm_opt import PSUMMABLE
+    if isinstance(c, CommAllGather):
+        shape = c.send.static_shape()
+        return shape[0] if shape else None
+    if isinstance(c, CommAllReduce) and c.reduce_type in PSUMMABLE:
+        shape = c.out.static_shape()
+        return shape[0] if shape else None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+
+def _check_uniformity(c: CommStmt, i: int, n_cores: int, desc, rep):
+    if isinstance(c, CommBarrier) and c.group is not None:
+        cores = set(c.group)
+        if cores != set(range(n_cores)):
+            rep.errors.append(
+                f"[{i}] subset barrier: {desc(c)} synchronizes only "
+                f"cores {sorted(cores)} of {n_cores} — cores outside "
+                f"the group deadlock waiting for a barrier they never "
+                f"reach")
+    if isinstance(c, CommFused):
+        head = c.ops[0]
+        for j, m in enumerate(c.ops[1:], start=1):
+            if type(m) is not type(head):
+                rep.errors.append(
+                    f"[{i}] mixed-kind fused op: member[{j}] {desc(m)} "
+                    f"is a {type(m).__name__} inside a fused "
+                    f"{type(head).__name__} batch")
+            elif getattr(m, "direction", 2) != getattr(head, "direction",
+                                                       2):
+                rep.errors.append(
+                    f"[{i}] mixed-axis fused op: member[{j}] {desc(m)} "
+                    f"runs on a different mesh axis than {desc(head)} — "
+                    f"cores would wait on different collective "
+                    f"sequences")
+
+
+def _check_alias(c: CommStmt, i: int, desc, rep):
+    for leaf in _leaf_ops(c):
+        for payload, dst, what in _alias_pairs(leaf):
+            if payload.buffer.uid == dst.buffer.uid:
+                rep.errors.append(
+                    f"[{i}] payload/recv alias: {desc(leaf)} {what} "
+                    f"regions share buffer {payload.buffer.name!r} — "
+                    f"the schedule would read payload bytes it is "
+                    f"concurrently overwriting")
+
+
+def _check_fused(c: CommFused, i: int, desc, rw_of, rep):
+    if len(c.ops) != len(c.slots):
+        rep.errors.append(
+            f"[{i}] malformed fused op: {len(c.ops)} members but "
+            f"{len(c.slots)} slot assignments")
+        return
+    # slot agreement: members sharing a slot must move identical bytes
+    by_slot: dict = {}
+    for j, (m, s) in enumerate(zip(c.ops, c.slots)):
+        ident = _payload_identity(m)
+        prev = by_slot.get(s)
+        if prev is None:
+            by_slot[s] = (j, ident)
+        elif prev[1] != ident:
+            rep.errors.append(
+                f"[{i}] mismatched fused slot {s}: member[{j}] "
+                f"{desc(m)} does not move the same payload as "
+                f"member[{prev[0]}] {desc(c.ops[prev[0]])} — fanning "
+                f"one wire transfer out to both would corrupt one "
+                f"destination")
+    # data independence: fusion executes members as ONE simultaneous op
+    seen_reads: Set[int] = set()
+    seen_writes: Set[int] = set()
+    for j, m in enumerate(c.ops):
+        reads, writes = rw_of(m)
+        if j and ((reads & seen_writes) or (writes & seen_writes)
+                  or (writes & seen_reads)):
+            rep.errors.append(
+                f"[{i}] race inside fused op: member[{j}] {desc(m)} "
+                f"touches a buffer another member writes — batching "
+                f"reorders them into one simultaneous op")
+        seen_reads |= reads
+        seen_writes |= writes
+
+
+def _check_chunked(c: CommChunked, i: int, segments, seg_rw, gp_uids,
+                   desc, rw_of, rep):
+    inner = c.op
+    if c.chunks < 2:
+        rep.errors.append(
+            f"[{i}] degenerate chunking: {desc(inner)} split into "
+            f"{c.chunks} chunk(s)")
+    extent = _chunk_extent(inner)
+    if extent is None:
+        rep.errors.append(
+            f"[{i}] unchunkable collective: {desc(inner)} cannot be "
+            f"split on a leading axis")
+        return
+    if extent % c.chunks != 0:
+        rep.errors.append(
+            f"[{i}] dropped chunk: {desc(inner)} leading extent "
+            f"{extent} is not divisible into {c.chunks} chunks — "
+            f"{extent % c.chunks} trailing row(s) would never cross "
+            f"the wire")
+    _, writes = rw_of(inner)
+    # the overlap window: everything between the chunked transfer and
+    # the consumer that reads it races against the in-flight chunks
+    consumer = None
+    for j in range(i + 1, len(segments)):
+        jkind, jpayload = segments[j]
+        reads_j, writes_j = seg_rw[j]
+        hit_w = writes & writes_j
+        hit_r = writes & reads_j
+        if jkind == "compute":
+            if hit_r:
+                consumer = j
+                break
+            if hit_w:
+                rep.errors.append(
+                    f"[{i}] comm/compute race: segment [{j}] overwrites "
+                    f"the result of {desc(inner)} while its pipelined "
+                    f"chunks may still be in flight")
+                break
+        else:
+            if hit_w:
+                rep.errors.append(
+                    f"[{i}] write-write race: collective [{j}] "
+                    f"{desc(jpayload)} overwrites the in-flight result "
+                    f"of chunked {desc(inner)}")
+                break
+            if hit_r:
+                rep.warnings.append(
+                    f"[{i}] chunked {desc(inner)} feeds collective "
+                    f"[{j}], not a compute segment — nothing overlaps "
+                    f"the pipelined chunks")
+                consumer = j
+                break
+    if consumer is None and not (writes & gp_uids) and not rep.errors:
+        rep.warnings.append(
+            f"[{i}] chunked {desc(inner)} has no consumer — the "
+            f"overlap rewrite buys nothing here")
+
+
+def _check_emit_meta(c: CommStmt, i: int, cost_fn, desc, rep):
+    """Defense in depth: the payload bytes the frontend recorded at
+    emission must agree with the bytes the lowering will move."""
+    for leaf in _leaf_ops(c):
+        meta = getattr(leaf, "emit_meta", None)
+        if not meta or not meta.get("payload_bytes"):
+            continue
+        _, per_hop = cost_fn(leaf)
+        if per_hop and meta["payload_bytes"] != per_hop:
+            rep.warnings.append(
+                f"[{i}] payload accounting drift: {desc(leaf)} was "
+                f"emitted as {meta['payload_bytes']}B but lowers to "
+                f"{per_hop}B per hop")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def verify_schedule(segments: Sequence[Tuple[str, Any]],
+                    seg_rw: Sequence[Tuple[set, set]],
+                    global_out_uids: Set[int],
+                    nrow: int, ncol: int,
+                    mode: str = "on",
+                    collective_recs: Optional[List[dict]] = None,
+                    comm_opt_rec: Optional[dict] = None,
+                    kernel: str = "?") -> VerifyReport:
+    """Verify the FINAL (post-comm_opt) segment list of one mesh
+    program. Raises :class:`MeshVerifyError` naming every offending op
+    when a check fails (warnings too, in ``strict`` mode); returns the
+    report otherwise so the caller can record findings in plan_desc."""
+    from ..parallel.lowering import _comm_buffers, _comm_desc, comm_cost
+    if mode not in MODES:
+        raise ValueError(f"unknown verify mode {mode!r}")
+    rep = VerifyReport(mode=mode)
+    if mode == "off":
+        return rep
+    n_cores = nrow * ncol
+
+    def desc(c: CommStmt) -> str:
+        return _comm_desc(c, nrow, ncol)
+
+    def rw_of(c: CommStmt) -> Tuple[Set[int], Set[int]]:
+        r, w = _comm_buffers(c)
+        return ({x.buffer.uid for x in r}, {x.buffer.uid for x in w})
+
+    def cost_fn(c: CommStmt):
+        return comm_cost(c, nrow, ncol)
+
+    recomputed_wire = 0
+    for i, (kind, payload) in enumerate(segments):
+        if kind == "compute":
+            # uniformity: a collective nested in per-core compute would
+            # be reachable by only the cores whose control flow hits it
+            for s in payload:
+                walk(s, lambda x: rep.errors.append(
+                    f"[{i}] collective {desc(x)} embedded inside a "
+                    f"compute segment — per-core control flow could "
+                    f"skip it on a subset of the mesh")
+                    if isinstance(x, CommStmt) else None)
+            continue
+        c = payload
+        rep.checked += 1
+        _check_uniformity(c, i, n_cores, desc, rep)
+        if isinstance(c, (CommBarrier, CommFence)):
+            continue
+        _check_alias(c, i, desc, rep)
+        _check_emit_meta(c, i, cost_fn, desc, rep)
+        if isinstance(c, CommFused):
+            _check_fused(c, i, desc, rw_of, rep)
+        if isinstance(c, CommChunked):
+            _check_chunked(c, i, segments, seg_rw, global_out_uids,
+                           desc, rw_of, rep)
+        hops, per_hop = cost_fn(c)
+        recomputed_wire += hops * per_hop
+
+    # wire-byte conservation: the independent re-derivation must match
+    # both accounting surfaces
+    if collective_recs is not None:
+        accounted = sum(r.get("wire_bytes", 0) for r in collective_recs)
+        if accounted != recomputed_wire:
+            rep.errors.append(
+                f"wire-byte conservation: attrs['collectives'] accounts "
+                f"{accounted}B but the op sequence moves "
+                f"{recomputed_wire}B")
+    if comm_opt_rec is not None:
+        claimed = comm_opt_rec.get("post_wire_bytes", 0)
+        if claimed != recomputed_wire:
+            rep.errors.append(
+                f"wire-byte conservation: comm_opt claims "
+                f"{claimed}B post-optimization but the op sequence "
+                f"moves {recomputed_wire}B")
+        if comm_opt_rec.get("rewrites") and \
+                claimed > comm_opt_rec.get("pre_wire_bytes", claimed):
+            rep.warnings.append(
+                f"comm_opt increased wire bytes: "
+                f"{comm_opt_rec.get('pre_wire_bytes')}B -> {claimed}B")
+
+    _trace.inc("verify.schedules")
+    _trace.inc("verify.collectives_checked", rep.checked)
+    for w in rep.warnings:
+        _trace.inc("verify.warnings")
+        _trace.event("verify.warning", "verify", kernel=kernel, finding=w)
+    if mode == "strict" and rep.warnings:
+        rep.errors.extend(f"(strict) {w}" for w in rep.warnings)
+    if rep.errors:
+        _trace.inc("verify.errors", len(rep.errors))
+        for e in rep.errors:
+            _trace.event("verify.error", "verify", kernel=kernel,
+                         finding=e)
+        raise MeshVerifyError(
+            f"{kernel}: mesh schedule verification failed "
+            f"({len(rep.errors)} violation(s)):\n  - " +
+            "\n  - ".join(rep.errors), site="verify.schedule")
+    return rep
