@@ -109,6 +109,14 @@ def _make_runner(fn, args):
 
 
 def _t(run, n, args):
+    # armed-faults-only visit of the device.dispatch site (children
+    # only; the env check keeps the common path import- and branch-free
+    # and the jax-free parent never times): the timed loop is where a
+    # bench run actually touches the device, so this is where a chaos
+    # run kills the "worker" mid-config
+    if os.environ.get("TL_TPU_FAULTS"):
+        from tilelang_mesh_tpu.resilience import faults as _faults
+        _faults.maybe_fail("device.dispatch", where="bench.timing")
     t0 = time.perf_counter()
     float(run(n, *args))
     return time.perf_counter() - t0
@@ -213,6 +221,14 @@ def _pick_best(cands, check, what, rounds=1):
             if best is None or dt < best[1]:
                 best = ((name, fn, args), dt)
         except Exception as e:
+            # a DEVICE loss is not a candidate failure: the worker is
+            # gone, and grinding through the remaining candidates would
+            # burn the whole per-config budget on a dead device — let
+            # the config-level failover re-run the sweep on the next
+            # backend instead
+            from tilelang_mesh_tpu.resilience.errors import classify
+            if classify(e) == "device_loss":
+                raise
             print(f"# {what} '{name}' failed: {str(e)[:200]}",
                   file=sys.stderr)
     if best is None:
@@ -1045,6 +1061,84 @@ def _attach_observability(rec: dict, name: str) -> dict:
     return rec
 
 
+def _backends_used(counters_raw: dict) -> list:
+    """Backend names that built kernels this process, from the tracer's
+    structured ``(name, labels) -> value`` counter map (sorted)."""
+    return sorted({dict(labels).get("backend")
+                   for (name, labels), _ in counters_raw.items()
+                   if name == "backend.build"} - {None})
+
+
+def _run_config_failover(name, builder, peaks, rounds, cfg_timeout):
+    """run_config under the backend-registry failover contract: a
+    config dying with a device-loss error (the worker died mid-config —
+    surfaced from the timed loop, a kernel dispatch, or the candidate
+    sweep) marks the serving backend unhealthy in the registry and
+    re-runs ONCE; the rebuilt kernels' chain walks then land on the
+    next healthy tier, so the sweep produces a record instead of
+    burning the per-config budget on a dead device."""
+    try:
+        return _watchdog(
+            lambda: run_config(name, builder, peaks, rounds=rounds),
+            f"config {name}", cfg_timeout)
+    except Exception as e:
+        from tilelang_mesh_tpu.env import env as _tl_env
+        from tilelang_mesh_tpu.resilience.errors import classify
+        if classify(e) != "device_loss" or \
+                _tl_env.TL_TPU_FALLBACK == "none":
+            # fail-fast contract: fallback disabled means NO config
+            # retry either — same rule the kernel layers apply
+            raise
+        import tilelang_mesh_tpu as tilelang
+        from tilelang_mesh_tpu.codegen.backends import registry
+        from tilelang_mesh_tpu.observability import get_tracer
+        reg = registry()
+        used = set(_backends_used(get_tracer().counters_raw()))
+        # the tier that was serving = the CHAIN-earliest backend that
+        # built kernels (a kernel may have degraded to a later tier;
+        # alphabetical order would blame the fallback, not the primary)
+        frm = next((b.name for b in reg.chain() if b.name in used),
+                   sorted(used)[0] if used else "tpu-pallas")
+        nxt = reg.next_healthy(reg.chain(), frm)
+        if nxt is None:
+            raise          # spent chain: don't poison the terminal tier
+        reg.mark_unhealthy(frm, e)
+        reg.note_failover(frm=frm, to=nxt.name, kernel=f"bench.{name}",
+                          during="bench", error=e)
+        print(f"# config {name}: device loss on backend {frm} "
+              f"({type(e).__name__}: {str(e)[:160]}); retrying once on "
+              f"{nxt.name}", file=sys.stderr, flush=True)
+        # drop BOTH kernel tiers: the object cache and every factory
+        # callsite cache — a cached kernel pins the dead backend's
+        # jitted callable, and only a rebuild re-walks the chain
+        tilelang.clear_cache()
+        from tilelang_mesh_tpu.jit import clear_factory_caches
+        clear_factory_caches()
+        return _watchdog(
+            lambda: run_config(name, builder, peaks, rounds=rounds),
+            f"config {name} (failover)", cfg_timeout)
+
+
+def _attach_backend_state(rec: dict) -> dict:
+    """Name the execution tiers that served this config: the backends
+    that built kernels (``backends_used``), the failover count, and the
+    registry health snapshot — a hermetic/failed-over record says WHICH
+    fallback produced its numbers. Must run BEFORE _attach_observability
+    (which resets the tracer's counters)."""
+    try:
+        from tilelang_mesh_tpu.codegen.backends import registry
+        from tilelang_mesh_tpu.observability import get_tracer
+        raw = get_tracer().counters_raw()
+        fo = sum(v for (name, _), v in raw.items()
+                 if name == "backend.failover")
+        rec["backends_used"] = _backends_used(raw)
+        rec["backend_failovers"] = fo
+        rec["backend_health"] = registry().snapshot()
+    except Exception:  # accounting must never take down a capture
+        pass
+    return rec
+
+
 def _reset_tracer() -> None:
     """Best-effort per-config tracer reset for the paths that never reach
     a successful _attach_observability export (failed configs in
@@ -1092,25 +1186,20 @@ def _watchdog(fn, what: str, timeout_s: float):
 
 
 def _probe_device(timeout_s: float):
-    """(ok, error) after a trivial computation, bounded by timeout via
-    _watchdog. A kernel fault kills the tunnel's worker for many minutes
-    and a backend-init attempt then HANGS (not errors); abandoning the
-    probe thread lets the bench abort with a diagnostic line instead of
-    wedging the driver. A fast local failure (broken jax install) is
-    reported as itself, not as a timeout."""
-    def _p():
-        import jax.numpy as jnp
-        jnp.ones((8, 128)).sum().block_until_ready()
-
-    try:
-        _watchdog(_p, "device probe", timeout_s)
-        return True, None
-    except TimeoutError:
-        return False, (f"TPU backend unreachable within {timeout_s:.0f}s "
-                       f"(tunnel worker down? a prior kernel fault keeps "
-                       f"it dead for 20+ min)")
-    except Exception as e:
-        return False, f"device probe failed: {type(e).__name__}: {e}"
+    """Probe the default jax platform through the backend registry
+    (tilelang_mesh_tpu.codegen.backends — ONE probe implementation for
+    bench, jit, and the autotuner). Returns ``None`` when healthy, else
+    the classified ``TLError``: a ``DeviceLossError`` for a dead worker,
+    a ``TLTimeoutError`` for a wedged one (a kernel fault kills the
+    tunnel's worker for many minutes and a backend-init attempt then
+    HANGS, not errors — the registry's bounded probe abandons its
+    thread). The verdict is cached in the registry's health state, so
+    in-child consumers (kernel builds, failover walks) reuse it for the
+    probe TTL instead of re-touching the device. NEVER touches jax on
+    this thread: after a wedged probe, any jax call here would block on
+    the same backend-init lock the abandoned probe thread holds."""
+    from tilelang_mesh_tpu.codegen.backends import probe_default_device
+    return probe_default_device(timeout_s, record=True)
 
 
 def exit_code(strict: bool, n_failed: int) -> int:
@@ -1144,6 +1233,24 @@ def _config_env(name: str, tpu_alive: bool) -> dict:
                 flags + " --xla_force_host_platform_device_count=8").strip()
     if not tpu_alive and name in CPU_SAFE_CONFIGS:
         over["JAX_PLATFORMS"] = "cpu"
+    return over
+
+
+def _hermetic_env(name: str, device_loss_at=None) -> dict:
+    """Child-process env for ``--hermetic``: pin the host platform, arm
+    the ``device.probe`` fault so the TPU tier is dead inside the child
+    too (the child's registry records it), give the chain both host
+    tiers to fail over across, and — for the chaos driver — arm a
+    one-shot ``device.dispatch`` loss inside the victim config."""
+    over = {"JAX_PLATFORMS": "cpu", "TL_TPU_BENCH_HERMETIC": "1"}
+    if not os.environ.get("TL_TPU_BACKENDS"):
+        over["TL_TPU_BACKENDS"] = "tpu-pallas,host-xla,host-interpret"
+    clauses = [os.environ.get("TL_TPU_FAULTS", "")]
+    if "device.probe" not in clauses[0]:
+        clauses.append("device.probe:kind=unreachable")
+    if device_loss_at == name:
+        clauses.append("device.dispatch:kind=unreachable:times=1")
+    over["TL_TPU_FAULTS"] = ";".join(c for c in clauses if c)
     return over
 
 
@@ -1198,20 +1305,27 @@ def _child_main(args) -> None:
         print(json.dumps({"config": name, "error": "unknown config"}),
               flush=True)
         os._exit(3)
+    if os.environ.get("TL_TPU_BENCH_HERMETIC"):
+        # hermetic child: probe the TPU tier through the registry ONCE
+        # so its dead verdict (armed device.probe fault, or simply no
+        # TPU attached) is cached health state every kernel build's
+        # chain walk reuses — and the record's snapshot shows it
+        from tilelang_mesh_tpu.codegen.backends import registry
+        registry().is_available("tpu-pallas")
     probe_s = _env_float("TL_TPU_BENCH_CHILD_PROBE_TIMEOUT", 120)
-    ok, perr = _probe_device(probe_s)
-    if not ok:
-        print(json.dumps({"config": name, "error": perr}), flush=True)
+    perr = _probe_device(probe_s)
+    if perr is not None:
+        from tilelang_mesh_tpu.resilience.errors import classify
+        print(json.dumps({"config": name, "error": str(perr),
+                          "error_kind": classify(perr)}), flush=True)
         os._exit(3)
     cfg_timeout = _env_float("TL_TPU_BENCH_CONFIG_TIMEOUT", 1800)
     if cfg_timeout <= 0:
         cfg_timeout = 1800.0
     try:
         peaks = _watchdog(_chip_peak_tflops, "device model probe", probe_s)
-        rec = _watchdog(
-            lambda: run_config(name, builders[name], peaks,
-                               rounds=1 if q else 3),
-            f"config {name}", cfg_timeout)
+        rec = _run_config_failover(name, builders[name], peaks,
+                                   1 if q else 3, cfg_timeout)
     except Exception as e:
         print(f"# config {name} FAILED: {type(e).__name__}: {e}",
               file=sys.stderr, flush=True)
@@ -1219,6 +1333,7 @@ def _child_main(args) -> None:
               flush=True)
         sys.stdout.flush()
         os._exit(3)
+    rec = _attach_backend_state(rec)
     rec = _attach_observability(rec, name)
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
@@ -1227,7 +1342,13 @@ def _child_main(args) -> None:
 
 def _spawn_probe(timeout_s: float) -> bool:
     """Probe the TPU from a FRESH subprocess (the parent never imports
-    jax, so a wedged backend can never take the orchestrator down)."""
+    jax, so a wedged backend can never take the orchestrator down).
+    Deliberately a minimal jax one-liner, NOT the package's
+    probe_default_device: the full package import costs seconds, and a
+    mid-sweep recovery probe runs under the shrinking dead_budget —
+    import time eating the budget would misreport a recovered worker as
+    dead. This wrapper only needs alive/dead; the classified in-process
+    probe (registry probe_default_device) lives in the children."""
     import subprocess
     try:
         r = subprocess.run(
@@ -1239,6 +1360,30 @@ def _spawn_probe(timeout_s: float) -> bool:
         return r.returncode == 0
     except Exception:
         return False
+
+
+# Parent-side cache of spawn-probe verdicts, honoring the backend
+# registry's TTL knob. The parent cannot hold the registry itself (any
+# tilelang_mesh_tpu import loads jax — forbidden here), so it caches the
+# subprocess verdicts under the same TL_TPU_BACKEND_PROBE_TTL_S the
+# in-child registry uses; the children seed their registries from their
+# own probes.
+_PROBE_CACHE = {"at": None, "ok": None}
+
+
+def _probe_ttl_s() -> float:
+    return _env_float("TL_TPU_BACKEND_PROBE_TTL_S", 30.0)
+
+
+def _spawn_probe_cached(timeout_s: float) -> bool:
+    now = time.monotonic()
+    if _PROBE_CACHE["at"] is not None and \
+            now - _PROBE_CACHE["at"] < _probe_ttl_s():
+        return _PROBE_CACHE["ok"]
+    ok = _spawn_probe(timeout_s)
+    _PROBE_CACHE["at"] = time.monotonic()
+    _PROBE_CACHE["ok"] = ok
+    return ok
 
 
 def _spawn_config(name: str, q: bool, timeout_s: float, extra_env=None):
@@ -1312,6 +1457,18 @@ def main():
                          "default keeps partial sweeps green so a dead "
                          "tunnel worker late in the run cannot zero the "
                          "whole capture")
+    ap.add_argument("--hermetic", action="store_true",
+                    help="run ONLY the CPU-safe configs through the "
+                         "backend registry with the TPU tier forcibly "
+                         "marked dead (device.probe fault armed): a "
+                         "sweep that always produces numbers, rc=0, "
+                         "regardless of TPU health — the CI "
+                         "hermetic-bench job and the verify.chaos "
+                         "--device-loss driver run this")
+    ap.add_argument("--device-loss-at", type=str, default=None,
+                    help=argparse.SUPPRESS)   # internal (chaos driver):
+    # arm a one-shot device.dispatch loss inside the NAMED config's
+    # child, simulating the worker dying at that point mid-sweep
     args = ap.parse_args()
 
     if args.child:
@@ -1320,7 +1477,14 @@ def main():
 
     q = args.quick
     configs = _config_builders(q)
-    if args.only:
+    if args.hermetic:
+        # hermetic sweep: the CPU-safe set only, every config through
+        # the backend registry with the TPU tier dead — guaranteed to
+        # produce numbers on the host fallback tiers
+        keep = set(args.only.split(",")) if args.only else None
+        configs = [(n, b) for n, b in configs if n in CPU_SAFE_CONFIGS
+                   and (keep is None or n in keep)]
+    elif args.only:
         keep = set(args.only.split(","))
         configs = [(n, b) for n, b in configs if n in keep]
     else:
@@ -1352,8 +1516,17 @@ def main():
     dead_reason = "unreachable at the startup probe"
     tpu_needed = any(n not in CPU_SAFE_CONFIGS for n in names) \
         or not os.environ.get("JAX_PLATFORMS")
-    if args.probe_timeout > 0 and not args.in_process and tpu_needed:
-        alive = _spawn_probe(min(probe_s, args.probe_timeout))
+    if args.hermetic:
+        # the TPU tier is dead BY CONSTRUCTION: no probe, no recovery
+        # budget — the whole point is numbers without TPU health
+        alive = False
+        dead_reason = "hermetic mode: TPU backend forcibly marked dead"
+        print("# hermetic sweep: TPU backend forcibly marked dead; "
+              f"CPU-safe configs ({', '.join(n for n in names)}) run "
+              "through the backend failover chain", file=sys.stderr,
+              flush=True)
+    elif args.probe_timeout > 0 and not args.in_process and tpu_needed:
+        alive = _spawn_probe_cached(min(probe_s, args.probe_timeout))
         if not alive:
             print("# TPU worker unreachable (probed once); skipping "
                   "TPU-only configs — CPU-safe configs "
@@ -1362,9 +1535,11 @@ def main():
     # mid-sweep recovery probes share ONE bounded budget; a worker
     # already dead at startup gets none (probe once, skip immediately),
     # while a worker lost mid-sweep — possibly a transient blip — gets
-    # a chance to be noticed recovering
+    # a chance to be noticed recovering. Verdicts are TTL-cached
+    # (TL_TPU_BACKEND_PROBE_TTL_S, mirroring the in-child registry) so
+    # back-to-back failed configs cannot burn the budget re-probing.
     dead_budget = _env_float("TL_TPU_BENCH_DEAD_PROBE_BUDGET",
-                             300 if alive else 0)
+                             300 if alive and not args.hermetic else 0)
 
     results = []
     headline = None
@@ -1381,6 +1556,7 @@ def main():
                     lambda: run_config(name, builders[name], peaks,
                                        rounds=1 if q else 3),
                     f"config {name}", cfg_timeout)
+                rec = _attach_backend_state(rec)
                 rec = _attach_observability(rec, name)
                 err = None
             except Exception as e:
@@ -1395,16 +1571,19 @@ def main():
                 # startup-dead case never enters here with the default
                 # budget spent on one bounded probe.
                 t0 = time.time()
-                alive = _spawn_probe(min(probe_s, dead_budget))
+                alive = _spawn_probe_cached(min(probe_s, dead_budget))
                 dead_budget -= time.time() - t0
             if alive or name in CPU_SAFE_CONFIGS:
                 # the child pays jax import + probes before its own
                 # watchdog starts: give its subprocess that allowance on
                 # top of cfg_timeout so a slow-but-legitimate config is
                 # never misreported as a wedged worker
+                child_env = _config_env(name, alive)
+                if args.hermetic:
+                    child_env.update(_hermetic_env(name,
+                                                   args.device_loss_at))
                 rec, err = _spawn_config(name, q, cfg_timeout + 300,
-                                         extra_env=_config_env(name,
-                                                               alive))
+                                         extra_env=child_env)
                 if rec is None and "worker" in (err or "").lower():
                     if alive:
                         dead_reason = (f"lost mid-sweep at config "

@@ -41,7 +41,8 @@ from .engine.lower import lower  # noqa: E402
 from .engine.param import CompiledArtifact, KernelParam  # noqa: E402
 
 # jit / kernels
-from .jit import compile, par_compile, jit, lazy_jit  # noqa: E402,A004
+from .jit import (compile, par_compile, jit, lazy_jit,  # noqa: E402,A004
+                  clear_factory_caches)
 from .jit.kernel import JITKernel  # noqa: E402
 
 # cache
@@ -78,6 +79,7 @@ from . import parallel  # noqa: E402
 __all__ = [
     "language", "jit", "lazy_jit", "compile", "par_compile", "lower",
     "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
+    "clear_factory_caches",
     "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
     "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
     "observability", "metrics_summary", "resilience", "verify",

@@ -1,2 +1,4 @@
 from . import rt
 from .pallas import generate_source, CodegenError
+from .backends import (Backend, BackendRegistry, backend_states,
+                       probe_default_device, registry)

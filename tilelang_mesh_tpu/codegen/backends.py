@@ -1,0 +1,468 @@
+"""Execution-backend registry + device-loss failover chain.
+
+Every perf round since r03 died with "TPU worker unreachable": the only
+degradation path was ``TL_TPU_FALLBACK=interp`` in ``jit/kernel.py``,
+which fires on *compile* failure only — a device that dies at dispatch
+time, mid-autotune-sweep, or mid-bench took the whole process down.
+This module gives the pipeline ONE failure-handling contract instead of
+the four improvised ones (bench's ad-hoc ``_probe_device``, jit's
+compile-only fallback, the autotuner's retry loop, MeshKernel's
+watchdog degradation):
+
+- :class:`Backend` — a named execution tier with a TTL-cached health
+  probe (``is_available()``), a build path for plain kernels
+  (``build_plain``), a mesh target for re-lowering mesh programs
+  (``mesh_target``), and capability flags (``supports_mesh`` /
+  ``is_host``).
+- Three registered instances::
+
+      tpu-pallas      compile Pallas to Mosaic, run on the TPU
+      host-xla        host-platform XLA execution (the mesh
+                      host-platform path bench uses via
+                      --xla_force_host_platform_device_count; plain
+                      kernels run the interpret trace XLA-compiled on
+                      the host)
+      host-interpret  Pallas interpret-mode execution on the host
+                      (the TL_TPU_FALLBACK=interp tier)
+
+- An ordered **failover chain** from ``TL_TPU_BACKENDS`` (default
+  ``tpu-pallas,host-interpret``): ``JITKernel``/``MeshKernel`` build on
+  the first chain entry that is capable + healthy; a warm call that
+  dies with a device-loss error (``resilience.errors.classify() ==
+  "device_loss"``) marks the backend unhealthy here, feeds the shared
+  circuit breaker, and the kernel re-lowers on the next entry — an
+  autotune sweep or bench run survives the worker dying mid-flight.
+- Health state is probed lazily and cached for
+  ``TL_TPU_BACKEND_PROBE_TTL_S`` seconds; probes are bounded by
+  ``TL_TPU_BACKEND_PROBE_TIMEOUT_S`` on an abandoned thread (a dead
+  tunnel worker HANGS a probe, it does not error).
+
+Observability: every probe lands in ``backend.probe{backend=,healthy=}``
+counters, every failover in a ``backend.failover`` counter + a
+degraded-class ``backend.failover`` event; ``metrics_summary()
+["resilience"]["backends"]`` and ``analyzer faults`` surface the health
+states and per-backend failover counts.
+
+Fault sites: ``device.probe`` (armed ``kind=unreachable`` = the TPU is
+dead — only TPU-platform probes visit it, so host tiers stay alive) and
+``device.dispatch`` (a warm call dying mid-flight) make the whole
+failover path deterministically testable without hardware; see
+``verify/chaos.py --device-loss`` and ``bench.py --hermetic``.
+
+This module must stay importable WITHOUT jax: bench's parent
+orchestrator routes its re-probe budget through the registry's cached
+health state and never imports jax (jax only loads inside probes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from ..env import env
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import (DeviceLossError, TLError, TLTimeoutError,
+                                 classify, error_signature)
+from ..utils.target import target_is_interpret, target_is_mesh
+
+__all__ = ["Backend", "BackendHealth", "BackendRegistry", "registry",
+           "backend_states", "probe_default_device", "KNOWN_BACKENDS"]
+
+KNOWN_BACKENDS = ("tpu-pallas", "host-xla", "host-interpret")
+
+_PROBE_COUNTER = [0]
+_PROBE_COUNTER_LOCK = threading.Lock()
+
+
+def _bounded(fn: Callable, what: str, timeout_s: float):
+    """Run fn() on an abandoned-on-timeout daemon thread: a dead device
+    HANGS jax calls rather than erroring, so a bounded wait is the only
+    honest probe. Fast failures are relayed as themselves."""
+    qq: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _t():
+        try:
+            qq.put((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            qq.put((False, e))
+
+    with _PROBE_COUNTER_LOCK:
+        _PROBE_COUNTER[0] += 1
+        n = _PROBE_COUNTER[0]
+    t = threading.Thread(target=_t, daemon=True,
+                         name=f"tl-backend-probe-{n}")
+    t.start()
+    try:
+        ok, val = qq.get(timeout=max(timeout_s, 0.001))
+    except queue.Empty:
+        raise TLTimeoutError(
+            f"{what} exceeded {timeout_s:.0f}s (worker wedged?); probe "
+            f"thread abandoned", site="device.probe") from None
+    if not ok:
+        raise val
+    return val
+
+
+def probe_default_device(timeout_s: Optional[float] = None,
+                         record: bool = False) -> Optional[TLError]:
+    """Probe the process's DEFAULT jax platform with a trivial bounded
+    computation. Returns ``None`` when healthy, else a classified
+    ``TLError`` (``DeviceLossError`` for a dead/unreachable worker,
+    ``TLTimeoutError`` for a wedged one) — the shared probe bench.py and
+    the ``tpu-pallas`` backend both use. EVERY jax touch (including
+    platform detection) happens inside the bounded thread: a wedged
+    backend init blocks the process-global init lock, and touching jax
+    on the caller's thread afterwards would wedge the caller too. The
+    ``device.probe`` fault site is visited only when the default
+    platform is a TPU, so arming it kills the TPU tier without touching
+    host execution. With ``record``, the verdict lands in the
+    registry's ``tpu-pallas`` health state when the default platform is
+    (or is presumed, on a hang, to be) the TPU."""
+    timeout_s = timeout_s if timeout_s is not None \
+        else env.TL_TPU_BACKEND_PROBE_TIMEOUT_S
+    platform = [None]   # written inside the bounded thread
+
+    def _p():
+        import jax
+        platform[0] = jax.default_backend()
+        if platform[0] in ("tpu", "axon"):
+            _faults.maybe_fail("device.probe", backend="tpu-pallas")
+        import jax.numpy as jnp
+        jnp.ones((8, 128)).sum().block_until_ready()
+
+    err: Optional[TLError] = None
+    try:
+        _bounded(_p, "device probe", timeout_s)
+    except TLError as e:
+        if classify(e) in ("device_loss", "timeout"):
+            err = e if isinstance(e, (DeviceLossError, TLTimeoutError)) \
+                else DeviceLossError(str(e), site="device.probe")
+        else:
+            err = DeviceLossError(f"device probe failed: {e}",
+                                  site="device.probe")
+    except Exception as e:  # noqa: BLE001 — every probe failure is loss
+        err = DeviceLossError(
+            f"device probe failed: {type(e).__name__}: {e}",
+            site="device.probe")
+    # a hang before platform detection means backend init itself wedged
+    # — on this machine that is the TPU tunnel, never the host platform
+    if record and (platform[0] in ("tpu", "axon")
+                   or (err is not None and platform[0] is None)):
+        registry().record_probe("tpu-pallas", err is None,
+                                error=str(err) if err else None)
+    return err
+
+
+@dataclass
+class BackendHealth:
+    """Cached probe verdict + failure accounting for one backend."""
+
+    healthy: Optional[bool] = None     # None = never probed
+    checked_at: float = 0.0            # monotonic stamp of the verdict
+    error: Optional[str] = None
+    probes: int = 0
+    failovers: int = 0                 # times work failed AWAY from it
+
+    def fresh(self, ttl_s: float, now: Optional[float] = None) -> bool:
+        if self.healthy is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return (now - self.checked_at) < ttl_s
+
+    def as_dict(self) -> dict:
+        return {"healthy": self.healthy, "error": self.error,
+                "probes": self.probes, "failovers": self.failovers}
+
+
+class Backend:
+    """One execution tier. Subclasses provide the probe and the build
+    paths; health caching/bookkeeping lives in the registry so bench's
+    jax-free parent can participate."""
+
+    name: str = "?"
+    supports_mesh: bool = False
+    is_host: bool = False
+
+    def probe(self) -> None:
+        """Raise a TLError when the backend cannot execute work now."""
+        raise NotImplementedError
+
+    def build_plain(self, ns: dict, pin_host: bool = False
+                    ) -> Tuple[Callable, Callable]:
+        """(raw_call, dispatch func) for a generated kernel module
+        namespace. ``pin_host`` pins dispatch to the host platform —
+        set on a failover build, where the process default device may
+        be the dead backend."""
+        raise NotImplementedError
+
+    def mesh_target(self, nrow: int, ncol: int) -> str:
+        """The target string a mesh program re-lowers to on this
+        backend (None-equivalent: raise for non-mesh backends)."""
+        raise NotImplementedError(
+            f"backend {self.name} does not run mesh programs")
+
+    # -- shared helpers ------------------------------------------------
+    @staticmethod
+    def _jit(raw: Callable, pin_host: bool) -> Callable:
+        import jax
+        jfn = jax.jit(raw)
+        if not pin_host:
+            return jfn
+        try:
+            cpu0 = jax.devices("cpu")[0]
+        except Exception:  # no host platform registered: dispatch as-is
+            return jfn
+
+        def pinned(*args):
+            with jax.default_device(cpu0):
+                return jfn(*args)
+
+        return pinned
+
+
+class TpuPallasBackend(Backend):
+    """The current production path: Pallas lowered through Mosaic,
+    executed on the local TPU."""
+
+    name = "tpu-pallas"
+    supports_mesh = True
+    is_host = False
+
+    def probe(self) -> None:
+        import jax
+        if not any(d.platform in ("tpu", "axon") for d in jax.devices()):
+            _faults.maybe_fail("device.probe", backend=self.name)
+            raise DeviceLossError(
+                "no TPU devices attached to this process",
+                site="device.probe", backend=self.name)
+        err = probe_default_device()
+        if err is not None:
+            err.backend = getattr(err, "backend", None) or self.name
+            raise err
+
+    def build_plain(self, ns, pin_host=False):
+        raw = ns["build"](interpret=False)
+        return raw, self._jit(raw, pin_host=False)
+
+    def mesh_target(self, nrow: int, ncol: int) -> str:
+        return f"tpu-mesh[{nrow}x{ncol}]"
+
+
+class HostXlaBackend(Backend):
+    """Host-platform XLA execution: mesh programs run shard_map over
+    forced host devices (the path bench's CPU-safe configs use); plain
+    kernels run the interpret trace XLA-compiled on the host."""
+
+    name = "host-xla"
+    supports_mesh = True
+    is_host = True
+
+    def probe(self) -> None:
+        import jax
+        if not jax.devices("cpu"):
+            raise DeviceLossError("no host-platform devices",
+                                  site="device.probe", backend=self.name)
+
+    def build_plain(self, ns, pin_host=False):
+        raw = ns["build"](interpret=True)
+        return raw, self._jit(raw, pin_host=pin_host)
+
+    def mesh_target(self, nrow: int, ncol: int) -> str:
+        return f"cpu-mesh[{nrow}x{ncol}]"
+
+
+class HostInterpretBackend(Backend):
+    """Pallas interpret-mode execution on the host — the existing
+    ``TL_TPU_FALLBACK=interp`` tier, now a first-class chain entry."""
+
+    name = "host-interpret"
+    supports_mesh = False
+    is_host = True
+
+    def probe(self) -> None:
+        import jax
+        if not jax.devices("cpu"):
+            raise DeviceLossError("no host-platform devices",
+                                  site="device.probe", backend=self.name)
+
+    def build_plain(self, ns, pin_host=False):
+        raw = ns["build"](interpret=True)
+        return raw, self._jit(raw, pin_host=pin_host)
+
+
+class BackendRegistry:
+    """Name -> Backend plus per-backend cached health, the parsed
+    ``TL_TPU_BACKENDS`` chain, and the failover bookkeeping every layer
+    (jit, parallel, autotune, bench) shares."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._backends = {}
+        self._health = {}
+        # per-backend in-flight probe locks: N par_compile workers
+        # TTL-missing together must pay ONE bounded probe, not N
+        self._probe_locks = {}
+        for b in (TpuPallasBackend(), HostXlaBackend(),
+                  HostInterpretBackend()):
+            self.register(b)
+
+    def _probe_lock(self, name: str) -> threading.Lock:
+        with self._lock:
+            return self._probe_locks.setdefault(name, threading.Lock())
+
+    def register(self, backend: Backend) -> None:
+        with self._lock:
+            self._backends[backend.name] = backend
+            self._health.setdefault(backend.name, BackendHealth())
+
+    def get(self, name: str) -> Backend:
+        try:
+            return self._backends[name]
+        except KeyError:
+            raise ValueError(
+                f"TL_TPU_BACKENDS: unknown backend {name!r} (one of "
+                f"{tuple(sorted(self._backends))})") from None
+
+    def health(self, name: str) -> BackendHealth:
+        with self._lock:
+            return self._health.setdefault(name, BackendHealth())
+
+    # -- chain ---------------------------------------------------------
+    def chain(self) -> List[Backend]:
+        """The ordered failover chain from ``TL_TPU_BACKENDS``."""
+        names = [n.strip() for n in env.TL_TPU_BACKENDS.split(",")
+                 if n.strip()]
+        if not names:
+            names = ["tpu-pallas", "host-interpret"]
+        return [self.get(n) for n in names]
+
+    def chain_for(self, target: str) -> List[Backend]:
+        """The chain filtered to backends capable of this target: mesh
+        targets need ``supports_mesh``, interpret (cpu*) targets must
+        stay on host tiers. An empty result falls back to the one
+        backend the target semantically IS (a cpu target must run
+        interpret; a cpu-mesh target must run host XLA) so an all-TPU
+        chain cannot strand host-targeted kernels."""
+        mesh = target_is_mesh(target)
+        chain = self.chain()
+        if mesh:
+            chain = [b for b in chain if b.supports_mesh]
+        if target_is_interpret(target):
+            chain = [b for b in chain if b.is_host]
+        if not chain:
+            chain = [self.get("host-xla" if mesh else "host-interpret")]
+        return chain
+
+    # -- health probing ------------------------------------------------
+    def is_available(self, name: str,
+                     ttl_s: Optional[float] = None) -> bool:
+        """TTL-cached health probe. A verdict younger than
+        ``TL_TPU_BACKEND_PROBE_TTL_S`` is returned as-is; otherwise the
+        backend's ``probe()`` runs (bounded) and the verdict is cached."""
+        ttl = ttl_s if ttl_s is not None else env.TL_TPU_BACKEND_PROBE_TTL_S
+        h = self.health(name)
+        if h.fresh(ttl):
+            return bool(h.healthy)
+        backend = self.get(name)
+        with self._probe_lock(name):
+            # a concurrent caller may have probed while we waited:
+            # their fresh verdict is ours
+            h = self.health(name)
+            if h.fresh(ttl):
+                return bool(h.healthy)
+            try:
+                _bounded(backend.probe, f"backend {name} probe",
+                         env.TL_TPU_BACKEND_PROBE_TIMEOUT_S)
+            except Exception as e:  # noqa: BLE001 — any failure = unhealthy
+                self.record_probe(name, False,
+                                  error=f"{type(e).__name__}: {e}")
+                return False
+            self.record_probe(name, True)
+            return True
+
+    def record_probe(self, name: str, ok: bool,
+                     error: Optional[str] = None) -> None:
+        """Record a probe verdict (local probe, or bench's subprocess
+        probe — the parent orchestrator feeds its jax-free spawn-probe
+        results through here so mid-sweep re-probes respect the TTL)."""
+        h = self.health(name)
+        with self._lock:
+            h.healthy = ok
+            h.checked_at = time.monotonic()
+            h.error = None if ok else (error or "probe failed")
+            h.probes += 1
+        _trace.inc("backend.probe", backend=name,
+                   healthy=str(bool(ok)).lower())
+
+    def mark_unhealthy(self, name: str, exc: BaseException) -> None:
+        """A dispatch died on this backend: cache the unhealthy verdict
+        (so sibling kernels skip it for a TTL) and feed the shared
+        per-signature circuit breaker."""
+        from ..resilience.retry import global_breaker
+        h = self.health(name)
+        with self._lock:
+            h.healthy = False
+            h.checked_at = time.monotonic()
+            h.error = f"{type(exc).__name__}: {exc}"
+            h.failovers += 1
+        global_breaker().record_failure(error_signature(exc))
+        _trace.inc("backend.unhealthy", backend=name)
+
+    def next_healthy(self, chain: List[Backend],
+                     current: str) -> Optional[Backend]:
+        """The first backend after ``current`` in ``chain`` that probes
+        healthy (the failover target); None when the chain is spent."""
+        names = [b.name for b in chain]
+        try:
+            start = names.index(current) + 1
+        except ValueError:
+            start = 0
+        for b in chain[start:]:
+            if self.is_available(b.name):
+                return b
+        return None
+
+    def note_failover(self, *, frm: str, to: str, kernel: str,
+                      during: str, error: BaseException) -> None:
+        """The one place a failover is recorded: degraded-class event +
+        counter, shared by JITKernel, MeshKernel, and bench."""
+        _trace.inc("backend.failover", frm=frm, to=to)
+        _trace.inc("resilience.degraded")
+        _trace.event("backend.failover", "resilience", kernel=kernel,
+                     frm=frm, to=to, during=during,
+                     error=f"{type(error).__name__}: {error}")
+
+    def snapshot(self) -> dict:
+        """Per-backend health for metrics_summary / bench records."""
+        with self._lock:
+            return {n: h.as_dict() for n, h in self._health.items()}
+
+    def reset(self) -> None:
+        """Forget every cached verdict (tests)."""
+        with self._lock:
+            self._health = {n: BackendHealth() for n in self._backends}
+
+
+_REGISTRY: Optional[BackendRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> BackendRegistry:
+    """The process-wide backend registry."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = BackendRegistry()
+        return _REGISTRY
+
+
+def backend_states() -> dict:
+    """Health snapshot WITHOUT forcing registry construction costs on
+    callers that never used backends (metrics_summary)."""
+    if _REGISTRY is None:
+        return {}
+    return _REGISTRY.snapshot()

@@ -78,6 +78,18 @@ class Environment:
     # resilience (resilience/ reads these; see docs/robustness.md)
     TL_TPU_FAULTS = EnvVar("TL_TPU_FAULTS", "")          # fault-spec string
     TL_TPU_FALLBACK = EnvVar("TL_TPU_FALLBACK", "interp")  # interp | none
+    # backend registry / device-loss failover (codegen/backends.py):
+    # ordered failover chain of execution backends; a backend that dies
+    # at build, dispatch, or mid-sweep is marked unhealthy and the
+    # kernel re-lowers on the next entry
+    TL_TPU_BACKENDS = EnvVar("TL_TPU_BACKENDS", "tpu-pallas,host-interpret")
+    # seconds a health-probe verdict stays cached before re-probing
+    TL_TPU_BACKEND_PROBE_TTL_S = EnvVar(
+        "TL_TPU_BACKEND_PROBE_TTL_S", 30.0, float)
+    # wall-clock bound on one device health probe (a dead TPU worker
+    # HANGS the probe; the thread is abandoned past this budget)
+    TL_TPU_BACKEND_PROBE_TIMEOUT_S = EnvVar(
+        "TL_TPU_BACKEND_PROBE_TIMEOUT_S", 60.0, float)
     TL_TPU_RETRY_MAX = EnvVar("TL_TPU_RETRY_MAX", 3, int)
     TL_TPU_RETRY_BASE_MS = EnvVar("TL_TPU_RETRY_BASE_MS", 50.0, float)
     TL_TPU_RETRY_MAX_MS = EnvVar("TL_TPU_RETRY_MAX_MS", 2000.0, float)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import inspect
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -68,6 +69,39 @@ def par_compile(funcs: Sequence[PrimFuncObj], num_workers: Optional[int] = None,
         return list(pool.map(one, funcs))
 
 
+# every live factory cache, so device-loss recovery (bench failover,
+# codegen/backends.py) can force kernels to re-select a backend: a
+# cached JITKernel pins the jitted callable of the backend it was built
+# on, and clearing the kernel cache alone cannot reach it
+_FACTORY_IMPLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def clear_factory_caches() -> int:
+    """Empty every ``@tilelang.jit`` / ``@tilelang.lazy_jit`` callsite
+    cache (returns how many cached kernels were dropped), plus every
+    ``functools.lru_cache`` on package modules — the ops-level kernel
+    factories (``ops/gemm.matmul_kernel`` etc.) and device-sniffing
+    caches (``utils.target.tpu_available``) memoize kernels/verdicts
+    that pin a possibly-dead backend. Combined with ``clear_cache()``
+    this forces the next factory call to rebuild its kernel through the
+    backend registry's chain walk — the recovery step after a backend
+    was marked unhealthy."""
+    import sys
+    n = 0
+    for impl in list(_FACTORY_IMPLS):
+        n += len(impl._kernels)
+        impl._kernels.clear()
+    for modname, mod in list(sys.modules.items()):
+        if not modname.startswith("tilelang_mesh_tpu") or mod is None:
+            continue
+        for attr in list(vars(mod).values()):
+            if callable(attr) and hasattr(attr, "cache_clear") \
+                    and hasattr(attr, "cache_info"):
+                n += attr.cache_info().currsize
+                attr.cache_clear()
+    return n
+
+
 class JITImpl:
     """Per-callsite kernel factory cache (reference JITImpl:190)."""
 
@@ -81,6 +115,7 @@ class JITImpl:
         self.verbose = verbose
         self.pass_configs = pass_configs
         self._kernels = {}
+        _FACTORY_IMPLS.add(self)
 
     def _key(self, args, kwargs):
         return (tuple(args), tuple(sorted(kwargs.items())))
@@ -207,6 +242,7 @@ class LazyJITImpl:
                     "padded dyn dims back")
         self.dynamic_bucket = dynamic_bucket
         self._kernels = {}
+        _FACTORY_IMPLS.add(self)
 
     def __call__(self, *tensors):
         from ..language.annot import TensorAnnot

@@ -10,35 +10,48 @@ to_jax) because jax.Array IS the device handle.
 
 from __future__ import annotations
 
-import functools
 import logging
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..engine.param import CompiledArtifact
 from ..env import env
 from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
-from ..resilience.errors import TLError
+from ..resilience.errors import TLError, classify
 from ..verify import runtime as _verify_rt
-from ..utils.target import target_is_interpret, target_is_mesh
+from ..utils.target import target_is_interpret
 from ..utils.tensor import TensorSupplyType, copy_back, to_jax
 
 logger = logging.getLogger("tilelang_mesh_tpu.jit")
 
 
-def _compile_shaped(exc: BaseException) -> bool:
-    """Is this the kind of error the interpreter fallback can help with?
-    XLA/Mosaic compile failures (jax/jaxlib-raised), Mosaic unsupported
-    ops (NotImplementedError), and injected chaos faults — yes. Builtin
+def _recoverable(exc: BaseException) -> bool:
+    """Is this an error the fallback machinery (interpreter degrade or
+    backend failover) can help with? Delegates to the taxonomy's
+    ``classify()`` so device-loss and compile-failure recovery share
+    one predicate: ``device_loss`` (a dispatch-time PJRT disconnect,
+    "worker unreachable" — previously misread as deterministic and
+    never recovered) is always recoverable by failover; beyond that,
+    only compile-shaped failures — XLA/Mosaic compile errors
+    (jax/jaxlib-raised), Mosaic unsupported ops (NotImplementedError),
+    and taxonomy errors — can be fixed by the interpreter. Builtin
     Python errors from user code (a data-dependent ValueError, a bad
-    operand TypeError) — no: those are user errors, and degrading would
-    silently pin good inputs to the slow interpreter forever."""
+    operand TypeError) and transient I/O pressure are not: the former
+    are user errors, the latter belong to the retry machinery, and
+    degrading on either would silently pin good inputs to the slow
+    interpreter forever."""
+    if classify(exc) == "device_loss":
+        return True
     if isinstance(exc, (TLError, NotImplementedError)):
         return True
     mod = type(exc).__module__ or ""
     return mod.startswith(("jax", "jaxlib"))
+
+
+# back-compat spelling (pre-registry tests import this name)
+_compile_shaped = _recoverable
 
 
 class JITKernel:
@@ -63,14 +76,15 @@ class JITKernel:
             self._interpret = target_is_interpret(art.target)
             self._degraded = False
             self._warmed = False   # set after the first successful call
+            from ..codegen import backends as _backends
+            self._registry = _backends.registry()
+            self._chain = self._registry.chain_for(art.target)
+            self._backend = None
             try:
                 _faults.maybe_fail("jit.compile", kernel=art.name)
-                self._raw_call: Callable = \
-                    ns["build"](interpret=self._interpret)
+                self._select_and_build()
             except Exception as e:  # noqa: BLE001 — degrade or re-raise
                 self._degrade(e, during="build")
-        import jax
-        self.func = jax.jit(self._raw_call)
         self._in_params = art.in_params
         self._out_params = art.out_params
         self._in_positions = [i for i, p in enumerate(art.params)
@@ -83,6 +97,38 @@ class JITKernel:
         self._inout_results = [
             (oi, self._in_params.index(p))
             for oi, p in enumerate(self._out_params) if p.role == "inout"]
+
+    def _select_and_build(self) -> None:
+        """Build on the first capable+healthy entry of the backend chain
+        (codegen/backends.py). A single-entry chain skips the health
+        probe entirely — there is nothing to choose, and the happy path
+        must not pay a device round-trip per cold build. A chain whose
+        head probes unhealthy (dead TPU worker at BUILD time) fails
+        over immediately with a ``backend.failover`` event instead of
+        wedging on the first dispatch."""
+        from ..resilience.errors import DeviceLossError
+        chain = self._chain
+        backend = chain[0]
+        if len(chain) > 1 and not self._registry.is_available(backend.name):
+            h = self._registry.health(backend.name)
+            err = DeviceLossError(h.error or "backend unhealthy",
+                                  site="device.probe", backend=backend.name)
+            nxt = self._registry.next_healthy(chain, backend.name)
+            if nxt is not None and env.TL_TPU_FALLBACK != "none":
+                self._registry.note_failover(
+                    frm=backend.name, to=nxt.name,
+                    kernel=self.artifact.name, during="build", error=err)
+                logger.warning(
+                    "kernel %s: backend %s is unhealthy (%s); building on "
+                    "%s instead", self.artifact.name, backend.name,
+                    h.error, nxt.name)
+                backend = nxt
+        self._backend = backend
+        pin = backend is not chain[0] and backend.is_host \
+            and not chain[0].is_host
+        _trace.inc("backend.build", backend=backend.name)
+        self._raw_call, self.func = backend.build_plain(self._ns,
+                                                        pin_host=pin)
 
     def _degrade(self, exc: BaseException, during: str) -> None:
         """Graceful degradation (``TL_TPU_FALLBACK=interp``, default on):
@@ -101,6 +147,8 @@ class JITKernel:
             "interpreter (TL_TPU_FALLBACK=interp)", self.artifact.name,
             "build" if during == "build" else "compile", type(exc).__name__,
             exc)
+        self._backend = self._registry.get("host-interpret")
+        _trace.inc("backend.build", backend=self._backend.name)
         self._raw_call = self._ns["build"](interpret=True)
         import jax
         self.func = jax.jit(self._raw_call)
@@ -131,22 +179,7 @@ class JITKernel:
         if self._warmed and _runtime.runtime_enabled() and \
                 _runtime.should_sample(self.artifact.name):
             _rt_t0 = time.perf_counter()
-        if self._warmed:
-            result = self.func(*jax_ins)
-        else:
-            # first call is where XLA/Mosaic actually compiles: a compile
-            # failure here degrades to the interpreter (once) instead of
-            # raising. After one success the guard is off — a post-warmup
-            # error is a runtime fault that must propagate.
-            try:
-                result = self.func(*jax_ins)
-            except Exception as e:  # noqa: BLE001 — degrade or re-raise
-                if self._degraded or self._interpret or \
-                        not _compile_shaped(e):
-                    raise
-                self._degrade(e, during="compile")
-                result = self.func(*jax_ins)
-            self._warmed = True
+        result = self._dispatch(jax_ins)
         results = result if isinstance(result, tuple) else (result,)
         # opt-in numeric sanitizer (TL_TPU_SANITIZE=1, verify/runtime.py):
         # NaN/Inf on any float output raises a deterministic
@@ -179,6 +212,91 @@ class JITKernel:
         if delivered and len(delivered) == len(results):
             return None
         return results[0] if len(results) == 1 else results
+
+    def _dispatch(self, jax_ins):
+        """One guarded dispatch. Warm calls catch device-loss errors
+        (classify() == "device_loss": PJRT disconnects, DEADLINE_EXCEEDED,
+        "unreachable" — or an injected ``device.dispatch`` fault), mark
+        the backend unhealthy in the registry, and re-lower on the next
+        entry of the failover chain; every other warm error is a runtime
+        fault that must propagate. The first call is where XLA/Mosaic
+        actually compiles, so it additionally keeps the compile-shaped
+        interpreter degrade (``TL_TPU_FALLBACK=interp``)."""
+        if self._warmed:
+            try:
+                _faults.maybe_fail("device.dispatch",
+                                   kernel=self.artifact.name)
+                return self.func(*jax_ins)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify(e) != "device_loss":
+                    raise
+                return self._failover_dispatch(e, jax_ins,
+                                               during="dispatch")
+        try:
+            _faults.maybe_fail("device.dispatch", kernel=self.artifact.name)
+            result = self.func(*jax_ins)
+        except Exception as e:  # noqa: BLE001 — degrade or re-raise
+            if classify(e) == "device_loss":
+                result = self._failover_dispatch(e, jax_ins,
+                                                 during="compile")
+            elif self._degraded or self._interpret or not _recoverable(e):
+                raise
+            else:
+                self._degrade(e, during="compile")
+                result = self.func(*jax_ins)
+        self._warmed = True
+        return result
+
+    def _failover_dispatch(self, exc: BaseException, jax_ins,
+                           during: str):
+        """The device under this kernel died mid-flight: mark the
+        backend unhealthy (feeding the shared circuit breaker), walk
+        down the ``TL_TPU_BACKENDS`` chain re-lowering on each healthy
+        entry until one completes the dispatch, and emit a
+        degraded-class ``backend.failover`` event per hop.
+        ``TL_TPU_FALLBACK=none`` (or a spent/single-entry chain)
+        re-raises — an operator who disabled fallback gets fail-fast."""
+        reg = self._registry
+        while True:
+            cur = self._backend.name if self._backend is not None \
+                else self._chain[0].name
+            nxt = reg.next_healthy(self._chain, cur)
+            if nxt is None or env.TL_TPU_FALLBACK == "none":
+                # spent chain (or fallback disabled): re-raise WITHOUT
+                # poisoning the tier in the shared registry — a terminal
+                # host tier cannot really be dead, and caching it
+                # unhealthy would block sibling kernels' legitimate
+                # failovers for the probe TTL
+                raise exc
+            reg.mark_unhealthy(cur, exc)
+            reg.note_failover(frm=cur, to=nxt.name,
+                              kernel=self.artifact.name, during=during,
+                              error=exc)
+            logger.warning(
+                "kernel %s lost backend %s during %s (%s: %s); "
+                "re-lowering on %s", self.artifact.name, cur, during,
+                type(exc).__name__, exc, nxt.name)
+            pin = nxt.is_host and not self._chain[0].is_host
+            self._backend = nxt
+            _trace.inc("backend.build", backend=nxt.name)
+            self._raw_call, self.func = nxt.build_plain(self._ns,
+                                                        pin_host=pin)
+            try:
+                _faults.maybe_fail("device.dispatch",
+                                   kernel=self.artifact.name)
+                result = self.func(*jax_ins)
+                self._warmed = True
+                return result
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify(e) != "device_loss":
+                    raise
+                exc = e
+
+    @property
+    def backend(self) -> Optional[str]:
+        """The name of the registry backend currently serving dispatches
+        (None only if the build itself failed before selection)."""
+        return self._backend.name if self._backend is not None else None
 
     def _check_shapes(self, jax_ins):
         for a, p in zip(jax_ins, self._in_params):

@@ -171,6 +171,16 @@ def _prometheus_histogram_lines() -> List[str]:
     return lines
 
 
+def _backend_states() -> dict:
+    """Backend-registry health snapshot (lazy import: observability must
+    stay importable by every layer, including codegen itself)."""
+    try:
+        from ..codegen.backends import backend_states
+        return backend_states()
+    except Exception:
+        return {}
+
+
 def _rate(hit: float, miss: float) -> Optional[float]:
     total = hit + miss
     return round(hit / total, 4) if total else None
@@ -257,6 +267,10 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "cache_write_errors": c("cache.write_errors"),
         "cache_read_errors": c("cache.read_errors"),
         "abandoned_threads": c("autotune.abandoned_threads"),
+        # backend registry / device-loss failover (codegen/backends.py)
+        "backend_failovers": labelled_total("backend.failover"),
+        "backend_probes": labelled_total("backend.probe"),
+        "backends": _backend_states(),
     }
     # schedule verifier + runtime guardrails (verify/; docs/robustness.md)
     verify = {

@@ -31,6 +31,7 @@ from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
                   collect, walk)
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
+from ..resilience.errors import classify as _classify
 from ..transform.comm_opt import comm_opt_modes, optimize_collectives
 from ..transform.plan import plan_kernel
 from .device_mesh import core_id_to_tuple, make_jax_mesh, shard_map_compat
@@ -667,6 +668,9 @@ class MeshKernel:
     def __init__(self, artifact: CompiledArtifact, out_idx=None):
         self.artifact = artifact
         self.out_idx = out_idx
+        # one terminal rebuild-and-retry per kernel after a device loss
+        # on the LAST chain entry (must survive _build resets)
+        self._rebuilt_after_loss = False
         self._build()
 
     def _build(self):
@@ -679,6 +683,11 @@ class MeshKernel:
         segments = art.attrs["_segments"]
         global_params = art.attrs["_global_params"]
         interpret = target_is_interpret(art.target)
+        # registry identity of the tier this program executes on
+        # (codegen/backends.py): a cpu-mesh program IS the host-platform
+        # XLA path; everything else runs Mosaic on the TPU
+        self._backend_name = "host-xla" if interpret else "tpu-pallas"
+        _trace.inc("backend.build", backend=self._backend_name)
 
         # build per-segment pallas callables
         seg_calls = []
@@ -826,12 +835,29 @@ class MeshKernel:
 
     # -- runtime guardrails (verify/runtime.py; docs/robustness.md) ----
     def _dispatch(self, jins):
-        """Execute one dispatch under the enabled runtime guards. With
-        every guard off this is exactly ``self.func(*jins)`` — the
-        guard probe is a few env reads, no allocation."""
-        from ..verify import runtime as _guard
+        """Execute one dispatch under the enabled runtime guards, with
+        device-loss failover around the whole thing: a warm call dying
+        because the device itself died (classify() == "device_loss" —
+        PJRT disconnect, DEADLINE_EXCEEDED, "unreachable", or an
+        injected ``device.dispatch`` fault) marks this program's backend
+        unhealthy and re-lowers the mesh program on the next
+        mesh-capable entry of the ``TL_TPU_BACKENDS`` chain."""
         if self._delegate is not None:
             return self._delegate._dispatch(jins)
+        try:
+            _faults.maybe_fail("device.dispatch",
+                               kernel=self.artifact.name)
+            return self._dispatch_guarded(jins)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if _classify(e) != "device_loss":
+                raise
+            return self._on_device_loss(e, jins)
+
+    def _dispatch_guarded(self, jins):
+        """The guard pipeline proper. With every guard off this is
+        exactly ``self.func(*jins)`` — the guard probe is a few env
+        reads, no allocation."""
+        from ..verify import runtime as _guard
         g = _guard.guard_state()
         if g is None:
             res = self.func(*jins)
@@ -957,6 +983,94 @@ class MeshKernel:
             return None   # param roles diverged; cannot substitute
         self._ref_kernel = ref
         return ref
+
+    def _on_device_loss(self, exc: BaseException, jins):
+        """The device under this mesh program died mid-dispatch: mark
+        the backend unhealthy (feeding the shared breaker), re-lower on
+        the next mesh-capable chain entry (``tpu-mesh[RxC]`` becomes
+        ``cpu-mesh[RxC]`` on ``host-xla``) and delegate permanently,
+        emitting a degraded-class ``backend.failover`` event. On the
+        terminal host tier — where the platform itself cannot really
+        die — one rebuild-and-retry absorbs an injected or transient
+        blip; a second loss propagates. ``TL_TPU_FALLBACK=none``
+        re-raises immediately."""
+        from ..codegen import backends as _backends
+        from ..env import env as _env
+        reg = _backends.registry()
+        cur = self._backend_name
+        if _env.TL_TPU_FALLBACK == "none":
+            raise exc
+        nrow, ncol = self.artifact.mesh_config
+        chain = reg.chain_for(self.artifact.target)
+        nxt = reg.next_healthy(chain, cur)
+        fb = self._lower_on_backend(nxt, nrow, ncol) \
+            if nxt is not None else None
+        if fb is not None:
+            reg.mark_unhealthy(cur, exc)
+            reg.note_failover(frm=cur, to=nxt.name,
+                              kernel=self.artifact.name,
+                              during="dispatch", error=exc)
+            logger.warning(
+                "mesh kernel %s lost backend %s mid-dispatch (%s: %s); "
+                "re-lowered on %s", self.artifact.name, cur,
+                type(exc).__name__, exc, nxt.name)
+            self._delegate = fb
+            fb._selfchecked = True
+            self._selfchecked = True
+            self.func = fb.func
+            return fb._dispatch(jins)
+        if not reg.get(cur).is_host:
+            # a non-host terminal tier (tpu-mesh with nowhere to go) is
+            # genuinely dead — rebuilding against it would WEDGE, not
+            # fail. Cache the verdict so sibling kernels' chain walks
+            # and bench probes skip the dead worker for the TTL.
+            reg.mark_unhealthy(cur, exc)
+            raise exc
+        if self._rebuilt_after_loss:
+            # one host-tier rebuild has already been spent
+            raise exc
+        self._rebuilt_after_loss = True
+        reg.note_failover(frm=cur, to=cur, kernel=self.artifact.name,
+                          during="dispatch", error=exc)
+        logger.warning(
+            "mesh kernel %s hit a device loss on the terminal backend "
+            "%s (%s: %s); rebuilding once and retrying",
+            self.artifact.name, cur, type(exc).__name__, exc)
+        self._build()
+        return self._dispatch(jins)
+
+    def _lower_on_backend(self, backend, nrow: int,
+                          ncol: int) -> Optional["MeshKernel"]:
+        """Re-lower this program for ``backend`` (same pass config, the
+        backend's mesh target). None when the traced IR is unavailable
+        (artifact-only construction), the host platform cannot hold the
+        mesh, or the re-lowered param roles diverged."""
+        pf = getattr(self, "prim_func", None)
+        if pf is None:
+            return None
+        from ..engine.lower import lower
+        cfg = dict(self.artifact.attrs.get("_pass_cfg") or {})
+        try:
+            art = lower(pf, target=backend.mesh_target(nrow, ncol),
+                        pass_configs=cfg)
+            fb = MeshKernel(art, out_idx=self.out_idx)
+            fb.prim_func = pf
+        except Exception as e:  # noqa: BLE001 — failover is best-effort
+            logger.warning(
+                "mesh kernel %s could not re-lower on %s: %s: %s",
+                self.artifact.name, backend.name, type(e).__name__, e)
+            return None
+        if [p.name for p in fb._out_params] != \
+                [p.name for p in self._out_params]:
+            return None
+        return fb
+
+    @property
+    def backend(self) -> str:
+        """Registry name of the tier currently serving dispatches."""
+        if self._delegate is not None:
+            return self._delegate.backend
+        return self._backend_name
 
     def _use_reference(self, ref: "MeshKernel", why: str) -> None:
         """Permanently route this kernel through the unoptimized

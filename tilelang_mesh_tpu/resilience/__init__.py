@@ -21,16 +21,17 @@ sweep journal), ``jit/kernel.py`` (interpreter fallback under
 breaker trips, quarantines, and degradations all land in the tracer.
 """
 
-from .errors import (DeterministicError, InjectedFault, TLError,
-                     TLTimeoutError, TransientError, classify,
-                     error_signature)
+from .errors import (DeterministicError, DeviceLossError, InjectedFault,
+                     TLError, TLTimeoutError, TransientError, classify,
+                     error_signature, is_device_loss)
 from .faults import (FAULT_SITES, CorruptionRequest, FaultSpec,
                      active_specs, inject, maybe_fail, parse_fault_spec)
 from .retry import CircuitBreaker, RetryPolicy, global_breaker, retry_call
 
 __all__ = [
     "TLError", "TransientError", "DeterministicError", "TLTimeoutError",
-    "InjectedFault", "classify", "error_signature",
+    "DeviceLossError", "InjectedFault", "classify", "error_signature",
+    "is_device_loss",
     "FAULT_SITES", "FaultSpec", "CorruptionRequest", "maybe_fail", "inject",
     "parse_fault_spec", "active_specs",
     "RetryPolicy", "CircuitBreaker", "retry_call", "global_breaker",

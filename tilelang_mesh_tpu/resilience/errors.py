@@ -14,6 +14,13 @@ kinds, and the retry/fallback machinery keys its decisions on that kind:
                       failures, codegen bugs). Never retried; repeated
                       occurrences of the same signature trip the circuit
                       breaker so sweeps stop burning time on them.
+- ``device_loss``   — the execution backend itself died (TPU worker
+                      unreachable, PJRT disconnect, DEADLINE_EXCEEDED
+                      mid-dispatch). Retrying the SAME backend cannot
+                      help, but the work is salvageable: the backend
+                      registry (codegen/backends.py) marks the backend
+                      unhealthy and the kernel re-lowers on the next
+                      entry of the ``TL_TPU_BACKENDS`` failover chain.
 
 ``TLError`` subclasses carry ``site`` (the fault-site name, e.g.
 ``autotune.trial``) and ``phase`` (the pipeline phase, e.g. ``lower.plan``)
@@ -28,7 +35,8 @@ from typing import Optional
 
 __all__ = [
     "TLError", "TransientError", "DeterministicError", "TLTimeoutError",
-    "InjectedFault", "classify", "error_signature",
+    "DeviceLossError", "InjectedFault", "classify", "error_signature",
+    "is_device_loss",
 ]
 
 
@@ -62,6 +70,19 @@ class DeterministicError(TLError):
     kind = "deterministic"
 
 
+class DeviceLossError(TLError):
+    """The execution backend died under the operation (worker
+    unreachable, PJRT disconnect). Not retried on the same backend;
+    handled by backend failover (codegen/backends.py)."""
+    kind = "device_loss"
+
+    def __init__(self, message: str, *, site: Optional[str] = None,
+                 phase: Optional[str] = None,
+                 backend: Optional[str] = None):
+        super().__init__(message, site=site, phase=phase)
+        self.backend = backend
+
+
 class TLTimeoutError(TLError, concurrent.futures.TimeoutError):
     """An operation exceeded its wall-clock budget. Also a
     ``concurrent.futures.TimeoutError`` so pre-taxonomy callers (and the
@@ -83,6 +104,9 @@ class InjectedFault(TransientError):
             return DeterministicError(msg, site=site)
         if kind == "oserror":
             return _InjectedOSError(msg)
+        if kind == "unreachable":
+            return DeviceLossError(f"injected device loss at {site}: "
+                                   f"worker unreachable", site=site)
         return InjectedFault(msg, site=site)
 
 
@@ -96,14 +120,52 @@ class _InjectedOSError(OSError):
 _TRANSIENT_TYPES = (OSError, IOError, ConnectionError, MemoryError)
 _TIMEOUT_TYPES = (concurrent.futures.TimeoutError, TimeoutError)
 
+# message signatures of a dying execution backend, as XLA/jax surface
+# them: gRPC deadline expiry, a tunnel/PJRT worker going away, and the
+# PJRT client's own disconnect wording. Matched case-insensitively on
+# FOREIGN exceptions only (TLErrors self-classify). Deliberately
+# NARROW multi-word phrases: a bare "unreachable" would match a
+# compiler's "unreachable code reached" and a bare "pjrt" would match
+# "PJRT plugin does not support X" — deterministic errors that must
+# never mark a healthy backend dead.
+_DEVICE_LOSS_MARKERS = (
+    "deadline_exceeded",
+    "deadline exceeded",
+    "worker unreachable",
+    "failed to connect",
+    "connection reset",
+    "socket closed",
+    "device lost",
+    "device is lost",
+    "pjrt client is dead",
+    "pjrt plugin exited",
+    "tpu initialization failed",
+    "backend 'tpu' failed to initialize",
+    "unavailable: ",      # absl::UnavailableError prefix
+)
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Does this exception look like the execution backend itself died
+    (as opposed to the program on it being wrong)?"""
+    if isinstance(exc, DeviceLossError):
+        return True
+    if isinstance(exc, TLError):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
 
 def classify(exc: BaseException) -> str:
     """Map any exception to ``transient`` / ``timeout`` /
-    ``deterministic``. TLErrors self-classify; foreign exceptions fall
-    back to type-based rules (I/O errors are transient, everything else —
+    ``deterministic`` / ``device_loss``. TLErrors self-classify; foreign
+    exceptions fall back to message signatures (device loss) then
+    type-based rules (I/O errors are transient, everything else —
     TypeError, ValueError, codegen failures — is deterministic)."""
     if isinstance(exc, TLError):
         return exc.kind
+    if is_device_loss(exc):
+        return "device_loss"
     if isinstance(exc, _TIMEOUT_TYPES):
         return "timeout"
     if isinstance(exc, _TRANSIENT_TYPES):

@@ -18,8 +18,11 @@ Grammar (``;``-separated clauses, ``:``-separated fields)::
 - ``seed``  — seeds the clause's private RNG, so a chaos run replays
   byte-for-byte (default 0). The RNG advances once per matching visit.
 - ``kind``  — ``transient`` (default) / ``timeout`` / ``deterministic`` /
-  ``oserror`` / ``corrupt``. The first four raise the matching exception
-  from the errors taxonomy; ``corrupt`` is site-specific: at
+  ``oserror`` / ``corrupt`` / ``unreachable``. All but ``corrupt`` raise
+  the matching exception from the errors taxonomy (``unreachable`` raises
+  :class:`~.errors.DeviceLossError`, simulating the TPU worker dying at
+  ``device.probe`` / ``device.dispatch`` so the backend-failover tier is
+  deterministically testable); ``corrupt`` is site-specific: at
   ``cache.disk.write`` the site simulates a torn write (the artifact
   lands truncated, exercising checksum + quarantine on load), and at
   ``comm.chunk``/``comm.fused`` the collective interpret path silently
@@ -66,9 +69,12 @@ FAULT_SITES = (
     "comm.collective",
     "comm.chunk",
     "comm.fused",
+    "device.probe",
+    "device.dispatch",
 )
 
-_KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt")
+_KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt",
+          "unreachable")
 
 
 class CorruptionRequest(Exception):

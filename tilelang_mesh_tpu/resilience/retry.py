@@ -8,6 +8,10 @@ key on the error taxonomy (errors.classify):
 - transient    — retried up to ``max_attempts`` total attempts
 - timeout      — retried at most once (a wedged compile usually wedges
                  again; one retry covers scheduler hiccups)
+- device_loss  — retried like a transient: the kernel-level backend
+                 failover (codegen/backends.py) swaps the dead backend
+                 underneath the retry, so the next attempt runs on a
+                 live one instead of burning the budget on a dead worker
 - deterministic — never retried, and its signature is fed to the circuit
                  breaker: after ``threshold`` occurrences the breaker
                  opens and callers (the autotuner sweep) fast-fail
@@ -134,7 +138,7 @@ def retry_call(fn: Callable, *, site: str, policy: Optional[RetryPolicy] = None,
             # open the circuit on the flakiness it is meant to ride out
             if breaker is not None and kind == "deterministic":
                 breaker.record_failure(sig)
-            retryable = (kind == "transient" and
+            retryable = (kind in ("transient", "device_loss") and
                          attempt + 1 < policy.max_attempts) or \
                         (kind == "timeout" and attempt == 0 and
                          policy.max_attempts > 1)

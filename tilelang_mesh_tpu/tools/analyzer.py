@@ -288,6 +288,8 @@ def summarize_faults(records) -> dict:
     injected: dict = {}
     retries: dict = {}
     degraded: dict = {}
+    failovers: dict = {}
+    backend_health: dict = {}
     quarantines = 0
     breaker_opens = 0
     abandoned = 0
@@ -304,6 +306,9 @@ def summarize_faults(records) -> dict:
             elif name == "degraded":
                 k = attrs.get("kernel", "?")
                 degraded[k] = degraded.get(k, 0) + 1
+            elif name == "backend.failover":
+                hop = f"{attrs.get('frm', '?')} -> {attrs.get('to', '?')}"
+                failovers[hop] = failovers.get(hop, 0) + 1
             elif name == "cache.quarantine":
                 quarantines += 1
             elif name == "resilience.breaker_open":
@@ -319,7 +324,20 @@ def summarize_faults(records) -> dict:
                 breaker_opens = max(breaker_opens, int(r["value"]))
             elif name == "autotune.abandoned_threads":
                 abandoned = max(abandoned, int(r["value"]))
+            elif name and name.startswith("backend.probe{"):
+                # labelled counters serialize flat:
+                # backend.probe{backend=tpu-pallas,healthy=false}
+                lbl = dict(kv.split("=", 1) for kv in
+                           name[name.index("{") + 1:-1].split(",")
+                           if "=" in kv)
+                st = backend_health.setdefault(
+                    lbl.get("backend", "?"),
+                    {"probes": 0, "unhealthy_probes": 0})
+                st["probes"] += int(r["value"])
+                if lbl.get("healthy") == "false":
+                    st["unhealthy_probes"] += int(r["value"])
     return {"injected": injected, "retries": retries, "degraded": degraded,
+            "failovers": failovers, "backend_health": backend_health,
             "quarantines": quarantines, "breaker_opens": breaker_opens,
             "abandoned_threads": abandoned}
 
@@ -342,6 +360,16 @@ def format_faults_report(records) -> str:
         lines.append("degraded kernels (interpreter fallback):")
         for k in sorted(s["degraded"]):
             lines.append(f"  {k:<32} {s['degraded'][k]}")
+    if s["failovers"]:
+        lines.append("backend failovers (device loss):")
+        for hop in sorted(s["failovers"]):
+            lines.append(f"  {hop:<32} {s['failovers'][hop]}")
+    if s["backend_health"]:
+        lines.append("backend health probes:")
+        for b in sorted(s["backend_health"]):
+            st = s["backend_health"][b]
+            lines.append(f"  {b:<22} {st['probes']:>4} probed, "
+                         f"{st['unhealthy_probes']} unhealthy")
     for label, key in (("quarantined cache entries", "quarantines"),
                        ("circuit-breaker trips", "breaker_opens"),
                        ("abandoned autotune workers", "abandoned_threads")):

@@ -15,10 +15,20 @@ corruption slipped through (a real miscompile would too). The JSONL
 trace and a JSON report land in ``--out`` for CI artifact upload;
 ``analyzer verify <out>/chaos_trace.jsonl`` prints the summary.
 
+``--device-loss`` switches to the second chaos mode (the PR-6 failover
+tier): a seeded RNG kills the "device" at a random config index of a
+``bench.py --hermetic`` sweep (a one-shot ``device.dispatch``
+unreachable fault inside that config's child) and asserts the sweep
+still completes — rc=0, EVERY CPU-safe config producing a record, and
+the victim's record carrying ``backend.failover`` accounting. Exit 1
+means a dying worker can still zero a bench round.
+
 Usage::
 
     JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
         --out chaos_report
+    python -m tilelang_mesh_tpu.verify.chaos --device-loss \
+        --out chaos_device_loss --seed 7
 """
 
 # NOTE: no `from __future__ import annotations` here — the T.prim_func
@@ -148,6 +158,80 @@ def _run_one(name, prog, cfg, site, seed, report):
     return ok
 
 
+def run_device_loss(out: Path, seed: int) -> int:
+    """Seeded device-loss chaos: run ``bench.py --hermetic`` with the
+    worker killed at a random config index, assert the sweep completes
+    with a record for EVERY CPU-safe config and failover accounting on
+    the victim. Runs the bench as a subprocess (its own architecture:
+    the parent stays jax-free, each config in its own child)."""
+    import random
+    import subprocess
+
+    repo_root = Path(__file__).resolve().parents[2]
+    bench_py = repo_root / "bench.py"
+    # the import is cheap (no jax in bench's parent) and keeps the
+    # config list in ONE place
+    sys.path.insert(0, str(repo_root))
+    import bench as _bench
+    cpu_safe = list(_bench.CPU_SAFE_CONFIGS)
+    victim = random.Random(seed).choice(cpu_safe)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TL_TPU_TRACE"] = "1"
+    env["TL_TPU_TRACE_DIR"] = str(out / "trace")
+    print(f"[chaos-device-loss] seed={seed}: killing the device inside "
+          f"config {victim!r} of the hermetic sweep")  # noqa: T201
+
+    proc = subprocess.run(
+        [sys.executable, str(bench_py), "--hermetic", "--quick",
+         "--device-loss-at", victim],
+        capture_output=True, text=True, env=env, timeout=1800)
+    records = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("config") and "geomean_vs_baseline" not in rec:
+            records[rec["config"]] = rec
+
+    missing = [n for n in cpu_safe
+               if n not in records or "error" in records[n]]
+    vic = records.get(victim, {})
+    checks = {
+        "rc_zero": proc.returncode == 0,
+        "all_configs_produced_records": not missing,
+        "victim_failed_over": vic.get("backend_failovers", 0) >= 1,
+        "victim_on_fallback_backend":
+            bool(vic.get("backends_used"))
+            and vic.get("backend_health", {}).get(
+                "tpu-pallas", {}).get("healthy") is False,
+    }
+    ok = all(checks.values())
+    report = {"mode": "device-loss", "seed": seed, "victim": victim,
+              "bench_rc": proc.returncode, "checks": checks,
+              "missing_or_failed_configs": missing,
+              "records": records}
+    (out / "device_loss_report.json").write_text(
+        json.dumps(report, indent=2))
+    (out / "bench_stdout.jsonl").write_text(proc.stdout)
+    (out / "bench_stderr.txt").write_text(proc.stderr)
+    for name, rec in sorted(records.items()):
+        print(f"[chaos-device-loss] {name}: backends_used="  # noqa: T201
+              f"{rec.get('backends_used')} "
+              f"failovers={rec.get('backend_failovers')}")
+    for k, v in checks.items():
+        print(f"[chaos-device-loss] {k}: "  # noqa: T201
+              f"{'OK' if v else 'FAIL'}")
+    print(f"[chaos-device-loss] {'PASS' if ok else 'FAIL'}; artifacts "  # noqa: T201
+          f"in {out}/")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tilelang_mesh_tpu.verify.chaos",
@@ -156,7 +240,17 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="chaos_report",
                     help="directory for the trace + report artifacts")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--device-loss", action="store_true",
+                    help="device-loss mode: kill the worker at a seeded "
+                         "random config index of a bench.py --hermetic "
+                         "sweep and assert the failover tier still "
+                         "produces a record per CPU-safe config")
     args = ap.parse_args(argv)
+
+    if args.device_loss:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        return run_device_loss(out, args.seed)
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
