@@ -450,6 +450,80 @@ def cfg_gemm_smoke(M=256, N=256, K=256, dtype="float32"):
                 ours=ours, ref=ref, args=(a, b), rel_tol=3e-2)
 
 
+def cfg_dispatch_overhead_smoke(M=128, calls=300):
+    """CI perf-smoke config for the host dispatch fast path
+    (jit/dispatch.py; docs/host_dispatch.md): a small GEMM whose device
+    time is tiny, so the per-call Python marshalling cost dominates the
+    request latency — exactly the regime ROADMAP item 5 targets. The
+    kernel is driven through ``JITKernel.__call__`` twice, once with
+    ``TL_TPU_FAST_DISPATCH=0`` (the legacy marshalling loop) and once
+    on the precompiled dispatch plan; both overhead distributions come
+    out of the shared ``dispatch.overhead`` histogram via
+    ``Profiler.dispatch_overhead``. Headline value = warm calls/sec on
+    the fast path; ``vs_baseline`` = legacy/fast overhead p50 ratio
+    (the acceptance gate wants >= 2). CPU-safe: runs identically on the
+    host platform and on a real TPU."""
+    import jax.numpy as jnp
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+
+    kern = matmul_kernel(M, M, M, in_dtype="float32", out_dtype="float32",
+                         block_M=M, block_N=M, block_K=M)
+    rng = np.random.default_rng(13)
+    a = jnp.asarray(rng.standard_normal((M, M)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((M, M)) * 0.1, jnp.float32)
+    prof = kern.get_profiler()
+
+    def run():
+        prev = os.environ.get("TL_TPU_FAST_DISPATCH")
+        try:
+            os.environ["TL_TPU_FAST_DISPATCH"] = "0"
+            legacy = prof.dispatch_overhead(calls=calls,
+                                            input_tensors=(a, b))
+            os.environ["TL_TPU_FAST_DISPATCH"] = "1"
+            fast = prof.dispatch_overhead(calls=calls,
+                                          input_tensors=(a, b))
+        finally:
+            if prev is None:
+                os.environ.pop("TL_TPU_FAST_DISPATCH", None)
+            else:
+                os.environ["TL_TPU_FAST_DISPATCH"] = prev
+        f50 = fast["overhead_p50_us"] or 0.0
+        l50 = legacy["overhead_p50_us"] or 0.0
+        ratio = l50 / f50 if f50 else None
+        noise_us = max(fast["overhead_iqr2_us"] or 0.0,
+                       legacy["overhead_iqr2_us"] or 0.0)
+        return {
+            "value": fast["calls_per_sec"],
+            "unit": "calls/s",
+            # >1 means the fast path beats legacy marshalling
+            "vs_baseline": round(ratio, 4) if ratio else None,
+            # perf-diff gate inputs: the FAST path's host overhead is
+            # the guarded latency (a regression here is a fast-path
+            # regression, which is what this config exists to catch)
+            "latency_ms": round(f50 / 1e3, 6),
+            "baseline_ms": round(l50 / 1e3, 6),
+            "latency_p50_ms": round(f50 / 1e3, 6),
+            "latency_p90_ms": round((fast["overhead_p90_us"] or 0.0) / 1e3,
+                                    6),
+            "latency_p99_ms": round((fast["overhead_p99_us"] or 0.0) / 1e3,
+                                    6),
+            "latency_mad_ms": round(noise_us / 1e3, 6),
+            "latency_samples": fast["overhead_samples"],
+            "reps": calls,
+            "baseline_mad_ms": round((legacy["overhead_iqr2_us"] or 0.0)
+                                     / 1e3, 6),
+            "host_overhead_p50_us_fast": f50,
+            "host_overhead_p50_us_legacy": l50,
+            "overhead_ratio": round(ratio, 4) if ratio else None,
+            "calls_per_sec_fast": fast["calls_per_sec"],
+            "calls_per_sec_legacy": legacy["calls_per_sec"],
+        }
+
+    return dict(metric=f"host dispatch overhead {M}x{M}x{M} GEMM "
+                       f"(fast dispatch plan vs legacy marshalling)",
+                custom_run=run)
+
+
 def cfg_flash(D, S=2048, B=2, H=16, causal=True):
     import jax.numpy as jnp
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -966,6 +1040,16 @@ def cfg_moe_grouped(E=8, M=512, K=2048, N=2048):
 def run_config(name, build, peaks, rounds=3):
     """Build, cross-check, time, validate, and report one config."""
     spec = build()
+    if "custom_run" in spec:
+        # self-measuring config (dispatch_overhead_smoke): the builder
+        # returns a callable producing the record fields directly —
+        # host-side overhead is not a device-slope measurement, so the
+        # interleaved A/B timing and peak-capping above don't apply
+        rec = dict(spec["custom_run"]())
+        rec.setdefault("metric", spec.get("metric", name))
+        rec["config"] = name
+        rec.update(spec.get("extra", {}))
+        return rec
     args = spec["args"]
     ref_args = spec.get("ref_args", args)
     if not spec.get("checked"):
@@ -1212,7 +1296,8 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # the CI perf-smoke job runs exactly these, and a sweep whose startup
 # probe finds the TPU worker dead still runs them (on the host platform)
 # instead of producing an empty artifact.
-CPU_SAFE_CONFIGS = ("gemm_smoke", "mesh_allreduce_smoke")
+CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
+                    "mesh_allreduce_smoke")
 
 
 def _config_env(name: str, tpu_alive: bool) -> dict:
@@ -1260,6 +1345,7 @@ def _config_builders(q: bool):
     radius of the riskiest config must not include the others."""
     return [
         ("gemm_smoke", lambda: cfg_gemm_smoke()),
+        ("dispatch_overhead_smoke", lambda: cfg_dispatch_overhead_smoke()),
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q

@@ -1,0 +1,448 @@
+"""Host dispatch fast path (jit/dispatch.py; docs/host_dispatch.md).
+
+Covers the PR 7 tentpole: warm/cold result equivalence through the
+precompiled dispatch plan, buffer donation semantics
+(``TL_TPU_DONATE``), torch/numpy dlpack round-trips in ``to_jax`` /
+``copy_back``, fingerprint-vs-slow-path error parity, the
+``dispatch.overhead`` histogram split, and the fast path's interplay
+with the PR 6 device-loss failover machinery.
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.observability import histogram as _hist
+from tilelang_mesh_tpu.observability import metrics_summary
+from tilelang_mesh_tpu.observability.runtime import HIST_NAME, OVERHEAD_HIST
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.utils.tensor import copy_back, to_jax
+
+M, N = 64, 128
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    """Dispatch-path state is process-global (kernel cache, backend
+    health, histograms): every test starts clean and leaves no armed
+    knobs behind."""
+    from tilelang_mesh_tpu.codegen.backends import registry
+    import tilelang_mesh_tpu.observability as obs
+    for var in ("TL_TPU_FAST_DISPATCH", "TL_TPU_DONATE",
+                "TL_TPU_RUNTIME_METRICS", "TL_TPU_RUNTIME_SAMPLE"):
+        monkeypatch.delenv(var, raising=False)
+    registry().reset()
+    tilelang.clear_cache()
+    obs.reset()
+    yield
+    registry().reset()
+    tilelang.clear_cache()
+    obs.reset()
+
+
+def _scale_func(mult):
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * mult
+            T.copy(s, B)
+    return scale
+
+
+def _bump_func():
+    """An in-place (inout role) kernel: reads AND writes A."""
+    @T.prim_func
+    def bump(A: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] + 1.0
+            T.copy(s, A)
+    return bump
+
+
+def _data():
+    rng = np.random.default_rng(3)
+    return rng.standard_normal((M, N)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# plan structure + warm/cold equivalence
+# ---------------------------------------------------------------------------
+
+class TestDispatchPlan:
+    def test_plan_precomputed(self):
+        import jax.numpy as jnp
+        k = tilelang.compile(_scale_func(2.5))
+        plan = k._plan
+        assert plan.n_in == 1
+        assert plan.expected_fp == (((M, N), jnp.dtype("float32")),)
+        assert plan.donate_argnums == ()   # no inout params
+        assert plan.fast_on and plan.donate_on is False
+
+    def test_cold_then_warm_equivalence(self):
+        import jax.numpy as jnp
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        cold = np.asarray(k(a))
+        warm = np.asarray(k(a))
+        warm_jax = np.asarray(k(jnp.asarray(a)))
+        np.testing.assert_allclose(cold, a * 2.5, rtol=1e-6)
+        np.testing.assert_array_equal(cold, warm)
+        np.testing.assert_array_equal(cold, warm_jax)
+
+    def test_fast_matches_legacy(self, monkeypatch):
+        k = tilelang.compile(_scale_func(3.0))
+        a = _data()
+        fast = np.asarray(k(a))
+        monkeypatch.setenv("TL_TPU_FAST_DISPATCH", "0")
+        legacy = np.asarray(k(a))
+        np.testing.assert_array_equal(fast, legacy)
+        monkeypatch.delenv("TL_TPU_FAST_DISPATCH")
+        np.testing.assert_array_equal(np.asarray(k(a)), fast)
+
+    def test_shape_mismatch_same_valueerror(self):
+        k = tilelang.compile(_scale_func(2.5))
+        k(_data())   # warm the plan first
+        with pytest.raises(ValueError,
+                           match=r"param A expects shape \(64, 128\)"):
+            k(np.zeros((8, 8), np.float32))
+
+    def test_dtype_mismatch_same_valueerror(self):
+        k = tilelang.compile(_scale_func(2.5))
+        k(_data())
+        with pytest.raises(ValueError, match="expects dtype float32"):
+            k(np.zeros((M, N), np.int32))
+
+    def test_wrong_arity_same_typeerror(self):
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        with pytest.raises(TypeError, match="expected 1 input tensors"):
+            k(a, a, a)
+
+    def test_reference_style_out_buffer_still_works(self):
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        out = np.zeros((M, N), np.float32)
+        assert k(a, out) is None
+        np.testing.assert_allclose(out, a * 2.5, rtol=1e-6)
+
+    def test_env_flags_rearm_on_change(self, monkeypatch):
+        """The plan's cached flags re-derive when a watched env var
+        changes mid-process — metrics flipped on start recording on
+        the very next call."""
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        k(a); k(a)
+        assert _hist.get_histogram(OVERHEAD_HIST,
+                                   kernel=k.artifact.name,
+                                   path="fast") is None
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        k(a)
+        h = _hist.get_histogram(OVERHEAD_HIST, kernel=k.artifact.name,
+                                path="fast")
+        assert h is not None and h.count == 1
+        assert _hist.get_histogram(HIST_NAME, kernel=k.artifact.name,
+                                   source="dispatch").count == 1
+        monkeypatch.delenv("TL_TPU_RUNTIME_METRICS")
+        k(a)
+        assert h.count == 1   # recording stopped again
+
+
+# ---------------------------------------------------------------------------
+# buffer donation (TL_TPU_DONATE)
+# ---------------------------------------------------------------------------
+
+class TestDonation:
+    def test_jax_inout_input_donated(self):
+        import jax.numpy as jnp
+        k = tilelang.compile(_bump_func())
+        assert k._plan.donate_argnums == (0,)
+        k(jnp.zeros((M, N), jnp.float32))       # cold: no donation
+        x = jnp.zeros((M, N), jnp.float32)
+        r = k(x)                                 # warm: donated
+        np.testing.assert_allclose(np.asarray(r), 1.0)
+        assert x.is_deleted()
+        with pytest.raises(RuntimeError, match="deleted"):
+            (x + 1).block_until_ready()
+
+    def test_numpy_caller_not_donated_gets_copy_back(self):
+        k = tilelang.compile(_bump_func())
+        a = np.zeros((M, N), np.float32)
+        assert k(a) is None      # cold: copy-back convention
+        assert k(a) is None      # warm: still copy-back, never donates
+        np.testing.assert_allclose(a, 2.0)
+
+    def test_donate_env_bypass(self, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("TL_TPU_DONATE", "0")
+        k = tilelang.compile(_bump_func())
+        k(jnp.zeros((M, N), jnp.float32))
+        x = jnp.zeros((M, N), jnp.float32)
+        r = k(x)
+        np.testing.assert_allclose(np.asarray(r), 1.0)
+        assert not x.is_deleted()
+        np.testing.assert_allclose(np.asarray(x), 0.0)   # caller keeps it
+
+    def test_donation_results_equal_non_donated(self):
+        import jax.numpy as jnp
+        k = tilelang.compile(_bump_func())
+        a = _data()
+        k(jnp.asarray(a))                        # cold
+        donated = np.asarray(k(jnp.asarray(a)))  # warm, donated
+        plain = np.asarray(a) + 1.0
+        np.testing.assert_allclose(donated, plain, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dlpack round trips (utils/tensor.py satellites)
+# ---------------------------------------------------------------------------
+
+class TestZeroCopyIO:
+    def test_numpy_roundtrip(self):
+        a = _data()
+        j = to_jax(a)
+        np.testing.assert_array_equal(np.asarray(j), a)
+
+    def test_numpy_noncontiguous_falls_back(self):
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        view = base.T                       # non-contiguous
+        j = to_jax(view)
+        np.testing.assert_array_equal(np.asarray(j), view)
+
+    def test_torch_roundtrip_via_dlpack(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+        j = to_jax(t)
+        np.testing.assert_array_equal(np.asarray(j), t.numpy())
+
+    def test_torch_noncontiguous(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4).t()
+        j = to_jax(t)
+        np.testing.assert_array_equal(np.asarray(j), t.contiguous().numpy())
+
+    def test_torch_bfloat16_roundtrip(self):
+        """bfloat16 cannot pass through numpy at all — dlpack is the
+        only route (the pre-PR detach().numpy() path raised)."""
+        torch = pytest.importorskip("torch")
+        import jax.numpy as jnp
+        t = torch.arange(8, dtype=torch.bfloat16)
+        j = to_jax(t)
+        assert j.dtype == jnp.bfloat16
+        dst = torch.zeros(8, dtype=torch.bfloat16)
+        copy_back(dst, j)
+        assert torch.equal(dst, t)
+
+    def test_torch_requires_grad_detached(self):
+        torch = pytest.importorskip("torch")
+        t = torch.ones(4, requires_grad=True)
+        j = to_jax(t)
+        np.testing.assert_array_equal(np.asarray(j), np.ones(4, np.float32))
+
+    def test_copy_back_numpy(self):
+        import jax.numpy as jnp
+        src = jnp.asarray(_data())
+        dst = np.zeros((M, N), np.float32)
+        copy_back(dst, src)
+        np.testing.assert_array_equal(dst, np.asarray(src))
+
+    def test_copy_back_torch(self):
+        torch = pytest.importorskip("torch")
+        import jax.numpy as jnp
+        src = jnp.asarray(_data())
+        dst = torch.zeros((M, N), dtype=torch.float32)
+        copy_back(dst, src)
+        np.testing.assert_array_equal(dst.numpy(), np.asarray(src))
+
+    def test_gpu_torch_rejected(self):
+        torch = pytest.importorskip("torch")
+        if torch.cuda.is_available():   # pragma: no cover - CPU CI
+            t = torch.ones(4, device="cuda")
+            with pytest.raises(ValueError, match="CPU torch"):
+                to_jax(t)
+
+    def test_kernel_accepts_torch_inputs(self):
+        torch = pytest.importorskip("torch")
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        r = np.asarray(k(torch.from_numpy(a.copy())))
+        np.testing.assert_allclose(r, a * 2.5, rtol=1e-6)
+        # warm path too
+        r2 = np.asarray(k(torch.from_numpy(a.copy())))
+        np.testing.assert_array_equal(r, r2)
+
+
+# ---------------------------------------------------------------------------
+# dispatch.overhead histogram + summaries
+# ---------------------------------------------------------------------------
+
+class TestOverheadInstrumentation:
+    def test_fast_and_legacy_paths_recorded(self, monkeypatch):
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        k(a); k(a)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        for _ in range(5):
+            k(a)
+        monkeypatch.setenv("TL_TPU_FAST_DISPATCH", "0")
+        for _ in range(5):
+            k(a)
+        name = k.artifact.name
+        hf = _hist.get_histogram(OVERHEAD_HIST, kernel=name, path="fast")
+        hl = _hist.get_histogram(OVERHEAD_HIST, kernel=name, path="legacy")
+        assert hf.count == 5 and hl.count == 5
+        assert hf.quantile(0.5) > 0 and hl.quantile(0.5) > 0
+
+    def test_runtime_summary_carries_overhead(self, monkeypatch):
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        k(a); k(a)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        for _ in range(4):
+            k(a)
+        rt = metrics_summary()["runtime"][k.artifact.name]
+        assert rt["count"] == 4
+        assert rt["host_overhead_p50_us"] > 0
+        assert rt["host_overhead_by_path"]["fast"] > 0
+
+    def test_profiler_dispatch_overhead(self):
+        k = tilelang.compile(_scale_func(2.5))
+        prof = k.get_profiler()
+        d = prof.dispatch_overhead(calls=20, warmup=2)
+        assert d["path"] == "fast"
+        assert d["overhead_samples"] == 20
+        assert d["overhead_p50_us"] > 0
+        assert d["calls_per_sec"] > 0
+
+    def test_histogram_minus(self):
+        from tilelang_mesh_tpu.observability import Histogram
+        h = Histogram()
+        for v in (1e-5, 2e-5, 4e-5):
+            h.observe(v)
+        snap = h.minus(None)
+        for v in (1e-3, 2e-3):
+            h.observe(v)
+        delta = h.minus(snap)
+        assert delta.count == 2
+        assert delta.quantile(0.5) > 5e-4   # only the new observations
+
+    def test_analyzer_trace_runtime_section(self, monkeypatch, tmp_path):
+        from tilelang_mesh_tpu.observability import write_jsonl, read_jsonl
+        from tilelang_mesh_tpu.tools.analyzer import (format_trace_report,
+                                                      summarize_trace)
+        k = tilelang.compile(_scale_func(2.5))
+        a = _data()
+        k(a); k(a)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        for _ in range(3):
+            k(a)
+        p = write_jsonl(tmp_path / "t.jsonl")
+        records = read_jsonl(p)
+        rt = summarize_trace(records)["runtime"]
+        d = rt[k.artifact.name]
+        assert d["calls"] == 3
+        assert d["host_overhead_by_path"]["fast"] > 0
+        report = format_trace_report(records)
+        assert "host_overhead_p50" in report
+
+
+# ---------------------------------------------------------------------------
+# sanitizer + failover interplay through the fast path
+# ---------------------------------------------------------------------------
+
+class TestGuardInterplay:
+    def test_sanitizer_fires_through_fast_path(self, monkeypatch):
+        from tilelang_mesh_tpu.verify import NumericError
+
+        @T.prim_func
+        def div(A: T.Tensor((M, N), "float32"),
+                B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = s[i, j] / 0.0
+                T.copy(s, B)
+
+        k = tilelang.compile(div)
+        a = np.ones((M, N), np.float32)
+        k(a)   # warm, sanitizer off: Inf flows through silently
+        monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+        with pytest.raises(NumericError):
+            k(a)
+
+    def test_warm_device_loss_fails_over_through_fast_path(
+            self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_BACKENDS", "host-xla,host-interpret")
+        from tilelang_mesh_tpu.codegen.backends import registry
+        registry().reset()
+        k = tilelang.compile(_scale_func(1.5))
+        a = _data()
+        np.testing.assert_allclose(np.asarray(k(a)), a * 1.5, rtol=1e-6)
+        assert k.backend == "host-xla"
+        with inject("device.dispatch", kind="unreachable", times=1):
+            np.testing.assert_allclose(np.asarray(k(a)), a * 1.5,
+                                       rtol=1e-6)
+        assert k.backend == "host-interpret"
+        # the plan's closure now drives the re-lowered backend
+        np.testing.assert_allclose(np.asarray(k(a)), a * 1.5, rtol=1e-6)
+
+    def test_failover_rearms_donation_variant(self, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("TL_TPU_BACKENDS", "host-xla,host-interpret")
+        from tilelang_mesh_tpu.codegen.backends import registry
+        registry().reset()
+        k = tilelang.compile(_bump_func())
+        k(jnp.zeros((M, N), jnp.float32))                 # cold
+        k(jnp.zeros((M, N), jnp.float32))                 # warm: donates
+        assert k._plan._donate_cache is not None
+        with inject("device.dispatch", kind="unreachable", times=1):
+            k(jnp.zeros((M, N), jnp.float32))
+        # the failover dropped the stale donation variant; the next
+        # donated call re-jits against the new backend and still works
+        assert k.backend == "host-interpret"
+        x = jnp.zeros((M, N), jnp.float32)
+        np.testing.assert_allclose(np.asarray(k(x)), 1.0)
+        assert x.is_deleted()
+
+    def test_mesh_overhead_recorded(self, monkeypatch):
+        """MeshKernel's hoisted marshalling records into the shared
+        overhead histogram under path=mesh."""
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        import jax.numpy as jnp
+        from tilelang_mesh_tpu.parallel import mesh_config
+        rows = cols = 2
+        n, m = 16, 128
+        mesh_t = (rows, cols)
+        shard = T.MeshShardingPolicy(cross_mesh_dim=0)
+        with mesh_config(rows, cols):
+            @T.prim_func
+            def ksum(A: T.MeshTensor((rows * cols * n, m), shard, mesh_t,
+                                     "float32"),
+                     B: T.MeshTensor((rows * cols * n, 1), shard, mesh_t,
+                                     "float32")):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment((n, m), "float32")
+                    o = T.alloc_fragment((n, 1), "float32")
+                    T.copy(A, x)
+                    T.comm.all_reduce(x, o, "sum", "all", dim=1)
+                    T.copy(o, B)
+            kern = tilelang.compile(
+                ksum, target=f"cpu-mesh[{rows}x{cols}]")
+        a = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (rows * cols * n, m)) * 0.1, jnp.float32)
+        kern(a)   # cold (trace+compile)
+        monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+        kern(a)
+        h = _hist.get_histogram(OVERHEAD_HIST, kernel=kern.artifact.name,
+                                path="mesh")
+        assert h is not None and h.count == 1

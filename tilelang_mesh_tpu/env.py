@@ -107,6 +107,17 @@ class Environment:
     TL_TPU_RUNTIME_METRICS = EnvVar("TL_TPU_RUNTIME_METRICS", False, bool)
     TL_TPU_RUNTIME_SAMPLE = EnvVar("TL_TPU_RUNTIME_SAMPLE", 1, int)
     TL_TPU_RUNTIME_RING = EnvVar("TL_TPU_RUNTIME_RING", 256, int)
+    # host dispatch fast path (jit/dispatch.py; docs/host_dispatch.md):
+    # precompiled per-kernel dispatch plans — monomorphic warm-path
+    # closure, single-tuple shape/dtype fingerprint, cached flag reads.
+    # "0" restores the legacy per-call marshalling loop.
+    TL_TPU_FAST_DISPATCH = EnvVar("TL_TPU_FAST_DISPATCH", True, bool)
+    # buffer donation for inout params: warm calls whose inout inputs
+    # are jax arrays dispatch through jax.jit(donate_argnums=...), so
+    # XLA may reuse the input buffer for the aliased output (the caller
+    # 's donated array is invalidated). Off for numpy/torch callers
+    # (they need copy-back) and under TL_TPU_DONATE=0.
+    TL_TPU_DONATE = EnvVar("TL_TPU_DONATE", True, bool)
 
     def cache_dir(self) -> Path:
         p = Path(self.TL_TPU_CACHE_DIR)

@@ -1,0 +1,222 @@
+"""Per-kernel dispatch plans: the host-side fast path.
+
+The reference compiles a dedicated C host wrapper per kernel so a
+steady-state dispatch costs one function call; our pre-plan
+``JITKernel.__call__`` re-ran the whole marshalling gauntlet per
+invocation — per-arg ``to_jax``, a Python shape/dtype loop with two
+tuple constructions and a ``str(dtype)`` per param, two inline
+``import jax`` statements, and several env reads. The AXI4MLIR line of
+work (PAPERS.md) shows a specialized host driver is worth integer
+factors on small kernels; this module is that driver for the XLA
+runtime (ROADMAP item 5; docs/host_dispatch.md).
+
+A :class:`DispatchPlan` is compiled ONCE per ``JITKernel._build`` and
+holds everything a warm call needs precomputed:
+
+- the single-tuple **shape/dtype fingerprint** — one tuple comparison
+  replaces the per-param loop; a mismatch falls into the original
+  ``_check_shapes`` so the error text is byte-identical;
+- per-call **flag cache**: the raw values of the env vars that shape a
+  dispatch (fast-path switch, donation, runtime metrics, sanitizer,
+  fault spec) are snapshotted and the derived flags re-armed only when
+  a raw value changes — a flipped ``TL_TPU_SANITIZE=1`` mid-process
+  still takes effect on the next call, but a steady-state call pays
+  tuple-of-getenv + one equality instead of N descriptor reads;
+- the **monomorphic warm-path closure** state (``func``): the failover
+  machinery (PR 6) swaps it atomically via :meth:`rearm`, so device
+  loss recovery keeps working through the fast path;
+- **buffer donation** (``TL_TPU_DONATE``, default on): warm calls whose
+  ``inout`` inputs are all jax arrays dispatch through a lazily-built
+  ``jax.jit(raw_call, donate_argnums=...)`` so XLA may alias the input
+  buffer into the output. Callers passing numpy/torch need copy-back
+  and never donate; ``TL_TPU_DONATE=0`` restores the exact pre-plan
+  dispatch;
+- host-overhead instrumentation: sampled calls (when
+  ``TL_TPU_RUNTIME_METRICS=1``) record their Python marshalling time
+  into the ``dispatch.overhead`` histogram (labelled by path), the
+  split the ``dispatch_overhead_smoke`` bench and the perf gate read.
+
+Legacy escape hatches: ``TL_TPU_FAST_DISPATCH=0`` and the
+reference-style all-params calling convention route through
+``JITKernel._legacy_call`` (the pre-plan body), which records into the
+same histogram under ``path=legacy``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+from ..observability import runtime as _runtime
+from ..utils.tensor import copy_back, to_jax
+from ..verify import runtime as _verify_rt
+
+__all__ = ["DispatchPlan", "ENV_KEYS"]
+
+# the env vars whose RAW values the plan snapshots per call; order is
+# load-bearing only for the snapshot tuple comparison
+ENV_KEYS = ("TL_TPU_FAST_DISPATCH", "TL_TPU_DONATE",
+            "TL_TPU_RUNTIME_METRICS", "TL_TPU_SANITIZE", "TL_TPU_FAULTS")
+
+_TRUE = ("1", "true", "yes", "on")
+_getenv = os.environ.get
+
+
+def _flag(raw: Optional[str], default: bool) -> bool:
+    if raw is None:
+        return default
+    return raw.lower() in _TRUE
+
+
+class DispatchPlan:
+    """Precompiled per-kernel dispatch state; see the module docstring.
+    Built by ``JITKernel._build`` after params are known, re-armed by
+    the backend failover / degradation paths via :meth:`rearm`."""
+
+    __slots__ = (
+        "kernel", "name", "n_in", "n_all", "expected_fp", "inout_results",
+        "donate_argnums", "out_names", "jax", "jax_array",
+        "_env_snap", "fast_on", "donate_on", "metrics_on", "sanitize_on",
+        "_donate_cache",
+    )
+
+    def __init__(self, kernel):
+        import jax
+        import jax.numpy as jnp
+        art = kernel.artifact
+        self.kernel = kernel
+        self.name = art.name
+        self.n_in = len(kernel._in_params)
+        self.n_all = len(art.params)
+        # one tuple: ((shape, np.dtype), ...) per input param — jax
+        # arrays expose .shape as a tuple and .dtype as np.dtype, so
+        # the warm check is a single structural equality
+        self.expected_fp = tuple(
+            (tuple(int(s) for s in p.shape), jnp.dtype(p.dtype))
+            for p in kernel._in_params)
+        self.inout_results = tuple(kernel._inout_results)
+        # positions (within the jax_ins tuple == in_params order) of
+        # donation-eligible params: inout inputs aliasable into outputs
+        self.donate_argnums = tuple(
+            i for i, p in enumerate(kernel._in_params)
+            if p.role == "inout")
+        self.out_names = tuple(p.name for p in kernel._out_params)
+        self.jax = jax
+        self.jax_array = jax.Array
+        self._donate_cache: Optional[Callable] = None
+        self._env_snap: Tuple = ()
+        self._refresh(tuple(map(_getenv, ENV_KEYS)))
+
+    # -- flag cache ----------------------------------------------------
+    def _refresh(self, snap: Tuple) -> None:
+        """Re-derive the per-call flags from a fresh raw-env snapshot
+        (runs only when a watched env var actually changed)."""
+        self._env_snap = snap
+        fast, donate, metrics, sanitize, _ = snap
+        self.fast_on = _flag(fast, True)
+        self.donate_on = _flag(donate, True) and bool(self.donate_argnums)
+        self.metrics_on = _flag(metrics, False)
+        self.sanitize_on = _flag(sanitize, False)
+
+    # -- failover / rebuild interplay ---------------------------------
+    def rearm(self) -> None:
+        """The kernel's dispatch callable changed (backend failover,
+        interpreter degradation, terminal-tier rebuild): drop the
+        donation variant so the next donated call re-jits against the
+        NEW raw_call. The plain path needs nothing — the closure reads
+        ``kernel.func`` through one attribute load, and that swap is a
+        single atomic store."""
+        self._donate_cache = None
+
+    def donating(self) -> Callable:
+        """The donation variant of the dispatch callable:
+        ``jax.jit(raw_call, donate_argnums=...)`` (+ the same host pin
+        the serving backend applied), built lazily on the first
+        donation-eligible warm call and invalidated by :meth:`rearm`."""
+        fn = self._donate_cache
+        if fn is None:
+            jax = self.jax
+            jfn = jax.jit(self.kernel._raw_call,
+                          donate_argnums=self.donate_argnums)
+            if getattr(self.kernel, "_pin_host", False):
+                try:
+                    cpu0 = jax.devices("cpu")[0]
+                except Exception:
+                    cpu0 = None
+                if cpu0 is not None:
+                    inner = jfn
+
+                    def jfn(*a, _inner=inner, _dev=cpu0, _jax=jax):
+                        with _jax.default_device(_dev):
+                            return _inner(*a)
+            self._donate_cache = fn = jfn
+        return fn
+
+    # -- the call ------------------------------------------------------
+    def execute(self, args: tuple):
+        """One ``JITKernel.__call__``. The warm steady state runs:
+        env-snapshot compare, single-tuple fingerprint check, optional
+        fault hook, jitted dispatch, tuple-normalize, return — no
+        imports, no per-param loop, no descriptor reads."""
+        kernel = self.kernel
+        snap = tuple(map(_getenv, ENV_KEYS))
+        if snap != self._env_snap:
+            self._refresh(snap)
+        if not self.fast_on or len(args) != self.n_in:
+            # legacy marshalling loop: TL_TPU_FAST_DISPATCH=0, the
+            # reference-style all-params convention, and arity errors
+            # (the legacy path raises the identical TypeError)
+            return kernel._legacy_call(args)
+        timed = self.metrics_on and kernel._warmed and \
+            _runtime.should_sample(self.name)
+        t0 = time.perf_counter() if timed else 0.0
+        all_jax = True
+        jax_ins = []
+        for a in args:
+            if isinstance(a, self.jax_array):
+                jax_ins.append(a)
+            else:
+                all_jax = False
+                jax_ins.append(to_jax(a))
+        if tuple((a.shape, a.dtype) for a in jax_ins) != self.expected_fp:
+            # raises the same per-param ValueError the slow path did; a
+            # benign representation difference falls through and runs
+            kernel._check_shapes(jax_ins)
+        donate = self.donate_on and kernel._warmed and \
+            (all_jax or all(isinstance(args[i], self.jax_array)
+                            for i in self.donate_argnums))
+        if timed:
+            t1 = time.perf_counter()
+            result = kernel._dispatch(jax_ins, donate=donate)
+            t2 = time.perf_counter()
+        else:
+            result = kernel._dispatch(jax_ins, donate=donate)
+        results = result if isinstance(result, tuple) else (result,)
+        if self.sanitize_on:
+            _verify_rt.check_host_outputs(results, self.out_names,
+                                          kernel=self.name)
+        if timed:
+            # host overhead = marshalling before + bookkeeping after
+            # the jitted dispatch, recorded BEFORE the device sync so
+            # it never includes device time — and BEFORE the copy-back
+            # loop, mirroring the legacy recorder exactly so the
+            # fast/legacy histogram rows measure the same window. The
+            # e2e latency then blocks the full pytree and spans
+            # dispatch-to-sync (t1 onward), the same window the pre-PR
+            # recorder measured.
+            t3 = time.perf_counter()
+            _runtime.record_overhead(self.name, (t1 - t0) + (t3 - t2),
+                                     path="fast")
+            self.jax.block_until_ready(results)
+            _runtime.record(self.name, time.perf_counter() - t1)
+        delivered = 0
+        if not all_jax and self.inout_results:
+            for oi, ii in self.inout_results:
+                a = args[ii]
+                if not isinstance(a, self.jax_array):
+                    copy_back(a, results[oi])
+                    delivered += 1
+        if delivered and delivered == len(results):
+            return None
+        return results[0] if len(results) == 1 else results

@@ -97,6 +97,15 @@ class JITKernel:
         self._inout_results = [
             (oi, self._in_params.index(p))
             for oi, p in enumerate(self._out_params) if p.role == "inout"]
+        # jax is already loaded (the exec'd kernel source imports it);
+        # caching the module here keeps every per-call import out of the
+        # dispatch path
+        import jax
+        self._jax = jax
+        # the precompiled dispatch plan: fingerprint, flag cache,
+        # donation variant, overhead instrumentation (jit/dispatch.py)
+        from .dispatch import DispatchPlan
+        self._plan = DispatchPlan(self)
 
     def _select_and_build(self) -> None:
         """Build on the first capable+healthy entry of the backend chain
@@ -127,8 +136,12 @@ class JITKernel:
         pin = backend is not chain[0] and backend.is_host \
             and not chain[0].is_host
         _trace.inc("backend.build", backend=backend.name)
+        self._pin_host = pin
         self._raw_call, self.func = backend.build_plain(self._ns,
                                                         pin_host=pin)
+        plan = getattr(self, "_plan", None)
+        if plan is not None:
+            plan.rearm()
 
     def _degrade(self, exc: BaseException, during: str) -> None:
         """Graceful degradation (``TL_TPU_FALLBACK=interp``, default on):
@@ -149,12 +162,29 @@ class JITKernel:
             exc)
         self._backend = self._registry.get("host-interpret")
         _trace.inc("backend.build", backend=self._backend.name)
+        self._pin_host = False
         self._raw_call = self._ns["build"](interpret=True)
         import jax
         self.func = jax.jit(self._raw_call)
+        plan = getattr(self, "_plan", None)
+        if plan is not None:
+            plan.rearm()
 
     # ------------------------------------------------------------------
     def __call__(self, *args, stream=None, **kwargs):
+        # one attribute load + the plan's precompiled fast path
+        # (jit/dispatch.py). TL_TPU_FAST_DISPATCH=0 and the
+        # reference-style all-params convention route to _legacy_call.
+        return self._plan.execute(args)
+
+    def _legacy_call(self, args):
+        """The pre-plan marshalling loop, byte-for-byte semantics: the
+        ``TL_TPU_FAST_DISPATCH=0`` escape hatch and the reference-style
+        ``kernel(a, b, c)`` all-params convention (caller-provided
+        output buffers + copy-back) run here. Sampled calls record
+        their host overhead under ``path=legacy`` so the
+        dispatch_overhead_smoke bench can compare the two paths."""
+        _jax = self._jax
         n_in, n_all = len(self._in_params), len(self.artifact.params)
         outs_provided = None
         if len(args) == n_in:
@@ -166,8 +196,6 @@ class JITKernel:
             raise TypeError(
                 f"{self.artifact.name}: expected {n_in} input tensors "
                 f"(or all {n_all} params, reference-style), got {len(args)}")
-        jax_ins = [to_jax(a) for a in ins]
-        self._check_shapes(jax_ins)
         # opt-in runtime recording (TL_TPU_RUNTIME_METRICS=1): sampled
         # calls pay a device sync for an honest end-to-end latency and
         # land in the shared kernel.latency histogram + ring buffer.
@@ -179,7 +207,16 @@ class JITKernel:
         if self._warmed and _runtime.runtime_enabled() and \
                 _runtime.should_sample(self.artifact.name):
             _rt_t0 = time.perf_counter()
+        jax_ins = [to_jax(a) for a in ins]
+        self._check_shapes(jax_ins)
+        # _rt_td marks the end of marshalling: the overhead window is
+        # (_rt_t0.._rt_td) + the post-dispatch bookkeeping, and the e2e
+        # latency spans _rt_td onward (dispatch-to-sync — the same
+        # window the pre-PR recorder measured, so historical
+        # kernel.latency digests stay comparable)
+        _rt_td = time.perf_counter() if _rt_t0 else 0.0
         result = self._dispatch(jax_ins)
+        _post_t0 = time.perf_counter() if _rt_t0 else 0.0
         results = result if isinstance(result, tuple) else (result,)
         # opt-in numeric sanitizer (TL_TPU_SANITIZE=1, verify/runtime.py):
         # NaN/Inf on any float output raises a deterministic
@@ -188,13 +225,16 @@ class JITKernel:
             _verify_rt.check_host_outputs(
                 results, [p.name for p in self._out_params],
                 kernel=self.artifact.name)
-        import jax as _jax
         if _rt_t0:
+            _runtime.record_overhead(
+                self.artifact.name,
+                (_rt_td - _rt_t0) + (time.perf_counter() - _post_t0),
+                path="legacy")
             # block on the FULL result pytree: a multi-output kernel's
             # latency must include every sibling, not just the first leaf
             _jax.block_until_ready(results)
             _runtime.record(self.artifact.name,
-                            time.perf_counter() - _rt_t0)
+                            time.perf_counter() - _rt_td)
         delivered = set()
         for oi, ii in self._inout_results:
             if not isinstance(ins[ii], _jax.Array):
@@ -213,7 +253,7 @@ class JITKernel:
             return None
         return results[0] if len(results) == 1 else results
 
-    def _dispatch(self, jax_ins):
+    def _dispatch(self, jax_ins, donate: bool = False):
         """One guarded dispatch. Warm calls catch device-loss errors
         (classify() == "device_loss": PJRT disconnects, DEADLINE_EXCEEDED,
         "unreachable" — or an injected ``device.dispatch`` fault), mark
@@ -221,12 +261,19 @@ class JITKernel:
         entry of the failover chain; every other warm error is a runtime
         fault that must propagate. The first call is where XLA/Mosaic
         actually compiles, so it additionally keeps the compile-shaped
-        interpreter degrade (``TL_TPU_FALLBACK=interp``)."""
+        interpreter degrade (``TL_TPU_FALLBACK=interp``). With
+        ``donate`` (fast path, jax-array inout inputs, TL_TPU_DONATE
+        on) the dispatch runs the plan's donating jit variant instead of
+        ``self.func``; a donation-eligible call that loses its device
+        still walks the failover chain, though the donated buffers may
+        already be invalid — the retry then surfaces the honest
+        RuntimeError instead of silently double-spending them."""
+        fn = self._plan.donating() if donate else self.func
         if self._warmed:
             try:
                 _faults.maybe_fail("device.dispatch",
                                    kernel=self.artifact.name)
-                return self.func(*jax_ins)
+                return fn(*jax_ins)
             except Exception as e:  # noqa: BLE001 — classified below
                 if classify(e) != "device_loss":
                     raise
@@ -234,7 +281,7 @@ class JITKernel:
                                                during="dispatch")
         try:
             _faults.maybe_fail("device.dispatch", kernel=self.artifact.name)
-            result = self.func(*jax_ins)
+            result = fn(*jax_ins)
         except Exception as e:  # noqa: BLE001 — degrade or re-raise
             if classify(e) == "device_loss":
                 result = self._failover_dispatch(e, jax_ins,
@@ -279,8 +326,13 @@ class JITKernel:
             pin = nxt.is_host and not self._chain[0].is_host
             self._backend = nxt
             _trace.inc("backend.build", backend=nxt.name)
+            self._pin_host = pin
             self._raw_call, self.func = nxt.build_plain(self._ns,
                                                         pin_host=pin)
+            # the dispatch plan's monomorphic closure reads self.func;
+            # drop its donation variant so the next donated call re-jits
+            # against the NEW backend's raw_call (atomic swap: one store)
+            self._plan.rearm()
             try:
                 _faults.maybe_fail("device.dispatch",
                                    kernel=self.artifact.name)

@@ -31,8 +31,9 @@ from .tracer import (Span, Tracer, event, get_tracer, inc, span,
 from .tracer import reset as _tracer_reset
 from .histogram import (Histogram, HistogramRegistry, default_bounds,
                         get_histogram, get_registry, histograms, observe)
-from .runtime import (HIST_NAME, recent, record, runtime_enabled,
-                      runtime_summary, should_sample)
+from .runtime import (HIST_NAME, OVERHEAD_HIST, recent, record,
+                      record_overhead, runtime_enabled, runtime_summary,
+                      should_sample)
 from .export import (LOWER_PHASES, aggregate_spans, metrics_summary,
                      read_jsonl, to_chrome_trace, to_jsonl,
                      to_prometheus_text, write_chrome_trace, write_jsonl)
@@ -55,6 +56,6 @@ __all__ = [
     "Histogram", "HistogramRegistry", "default_bounds", "get_registry",
     "get_histogram", "histograms", "observe",
     # runtime dispatch recording
-    "HIST_NAME", "runtime_enabled", "should_sample", "record", "recent",
-    "runtime_summary",
+    "HIST_NAME", "OVERHEAD_HIST", "runtime_enabled", "should_sample",
+    "record", "record_overhead", "recent", "runtime_summary",
 ]

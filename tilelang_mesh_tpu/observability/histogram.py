@@ -137,6 +137,30 @@ class Histogram:
         self.max = max(self.max, other.max)
         return self
 
+    def minus(self, earlier: Optional["Histogram"]) -> "Histogram":
+        """A new histogram holding only the observations recorded since
+        ``earlier`` (an older snapshot of this same series; None means
+        everything counts). The registry accumulates forever, so a
+        measurement window over a shared histogram is a count delta —
+        this is how ``Profiler.dispatch_overhead`` isolates its calls.
+        min/max cannot be un-merged and carry over from self, which only
+        widens the clamp range of quantile estimates."""
+        if earlier is None:
+            out = Histogram(self.bounds)
+            out.merge(self)
+            return out
+        if self.bounds != earlier.bounds:
+            raise ValueError("cannot diff histograms with different "
+                             "bounds")
+        out = Histogram(self.bounds)
+        out.counts = [max(0, a - b)
+                      for a, b in zip(self.counts, earlier.counts)]
+        out.count = max(0, self.count - earlier.count)
+        out.sum = max(0.0, self.sum - earlier.sum)
+        out.min = self.min
+        out.max = self.max
+        return out
+
     def to_dict(self) -> dict:
         return {
             "bounds": list(self.bounds), "counts": list(self.counts),

@@ -33,10 +33,21 @@ from ..env import env
 from . import histogram as _hist
 
 __all__ = ["runtime_enabled", "should_sample", "record", "recent",
-           "runtime_summary", "reset", "HIST_NAME"]
+           "runtime_summary", "reset", "HIST_NAME", "OVERHEAD_HIST",
+           "record_overhead"]
 
 # the one histogram family every latency source records into (seconds)
 HIST_NAME = "kernel.latency"
+
+# host-side dispatch overhead (seconds): the Python marshalling time a
+# sampled ``__call__`` spends OUTSIDE the jitted dispatch — arg
+# classification/conversion, fingerprint check, and copy-back handling,
+# excluding the device wait. Labelled by kernel and by ``path``
+# ("fast" = the jit/dispatch.py plan, "legacy" = the
+# TL_TPU_FAST_DISPATCH=0 marshalling loop, "mesh" = MeshKernel), so the
+# dispatch_overhead_smoke bench can compare the two jit paths in one
+# process. See docs/host_dispatch.md.
+OVERHEAD_HIST = "dispatch.overhead"
 
 
 def runtime_enabled() -> bool:
@@ -84,6 +95,13 @@ def record(kernel: str, seconds: float, source: str = "dispatch") -> None:
                        "source": source})
 
 
+def record_overhead(kernel: str, seconds: float,
+                    path: str = "fast") -> None:
+    """One sampled call's host-side dispatch overhead (seconds spent in
+    Python marshalling around the jitted dispatch)."""
+    _hist.observe(OVERHEAD_HIST, seconds, kernel=kernel, path=path)
+
+
 def recent(kernel: str) -> List[dict]:
     """The ring buffer of recent recorded calls for one kernel,
     oldest first (bounded by ``TL_TPU_RUNTIME_RING``)."""
@@ -97,36 +115,69 @@ def recent(kernel: str) -> List[dict]:
 def runtime_summary() -> Dict[str, dict]:
     """Per-kernel latency digest from the shared histograms:
     {kernel: {count, p50_ms, p90_ms, p99_ms, mean_ms, max_ms,
-    sources}} — the ``metrics_summary()["runtime"]`` payload."""
+    sources}} — the ``metrics_summary()["runtime"]`` payload. Kernels
+    with recorded host-side dispatch overhead (``dispatch.overhead``)
+    additionally carry ``host_overhead_p50_us`` / ``_p90_us`` /
+    ``_mean_us`` and a per-path p50 breakdown
+    (``host_overhead_by_path``; see docs/host_dispatch.md)."""
     merged: Dict[str, _hist.Histogram] = {}
     sources: Dict[str, set] = {}
+    overhead: Dict[str, _hist.Histogram] = {}
+    by_path: Dict[str, Dict[str, _hist.Histogram]] = {}
 
     def _q(h: "_hist.Histogram", q: float) -> Optional[float]:
         v = h.quantile(q)
         return round(v * 1e3, 6) if v is not None else None
 
+    def _q_us(h: "_hist.Histogram", q: float) -> Optional[float]:
+        v = h.quantile(q)
+        return round(v * 1e6, 3) if v is not None else None
+
     for (name, labels), h in _hist.histograms():
-        if name != HIST_NAME or h.count == 0:
+        if h.count == 0 or name not in (HIST_NAME, OVERHEAD_HIST):
             continue
         lab = dict(labels)
         kernel = lab.get("kernel", "?")
+        if name == OVERHEAD_HIST:
+            acc = overhead.get(kernel)
+            if acc is None:
+                acc = overhead[kernel] = _hist.Histogram(h.bounds)
+            acc.merge(h)
+            path = lab.get("path", "?")
+            pacc = by_path.setdefault(kernel, {}).get(path)
+            if pacc is None:
+                pacc = by_path[kernel][path] = _hist.Histogram(h.bounds)
+            pacc.merge(h)
+            continue
         acc = merged.get(kernel)
         if acc is None:
             acc = merged[kernel] = _hist.Histogram(h.bounds)
         acc.merge(h)
         sources.setdefault(kernel, set()).add(lab.get("source", "?"))
-    return {
-        kernel: {
-            "count": h.count,
-            "p50_ms": _q(h, 0.50),
-            "p90_ms": _q(h, 0.90),
-            "p99_ms": _q(h, 0.99),
-            "mean_ms": round(h.mean * 1e3, 6) if h.count else None,
-            "max_ms": round(h.max * 1e3, 6) if h.count else None,
+
+    out: Dict[str, dict] = {}
+    for kernel in sorted(set(merged) | set(overhead)):
+        h = merged.get(kernel)
+        d = {
+            "count": h.count if h else 0,
+            "p50_ms": _q(h, 0.50) if h else None,
+            "p90_ms": _q(h, 0.90) if h else None,
+            "p99_ms": _q(h, 0.99) if h else None,
+            "mean_ms": round(h.mean * 1e3, 6) if h and h.count else None,
+            "max_ms": round(h.max * 1e3, 6) if h and h.count else None,
             "sources": sorted(sources.get(kernel, ())),
         }
-        for kernel, h in sorted(merged.items())
-    }
+        oh = overhead.get(kernel)
+        if oh is not None:
+            d["host_overhead_p50_us"] = _q_us(oh, 0.50)
+            d["host_overhead_p90_us"] = _q_us(oh, 0.90)
+            d["host_overhead_mean_us"] = \
+                round(oh.mean * 1e6, 3) if oh.count else None
+            d["host_overhead_by_path"] = {
+                path: _q_us(ph, 0.50)
+                for path, ph in sorted(by_path.get(kernel, {}).items())}
+        out[kernel] = d
+    return out
 
 
 def reset() -> None:

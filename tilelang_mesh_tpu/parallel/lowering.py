@@ -19,6 +19,7 @@ Golden-testable: `lower_mesh` produces a deterministic textual schedule
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis import run_semantic_checks
@@ -29,6 +30,7 @@ from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
                   CommFused, CommPut, CommStmt,
                   CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
                   collect, walk)
+from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import classify as _classify
@@ -737,6 +739,19 @@ class MeshKernel:
         self.func = jax.jit(f)
         self._in_params = in_params
         self._out_params = out_params
+        # host-dispatch fast path (the mesh analog of jit/dispatch.py):
+        # jax and the marshalling helpers are hoisted out of __call__ to
+        # build time, and the reference-style param positions are
+        # precomputed once instead of rebuilding a name->index dict per
+        # call. See docs/host_dispatch.md.
+        from ..utils.tensor import copy_back as _cb, to_jax as _tj
+        self._jax = jax
+        self._to_jax = _tj
+        self._copy_back = _cb
+        pos = {p.name: i for i, p in enumerate(art.params)}
+        self._in_arg_positions = [pos[p.name] for p in in_params]
+        self._out_arg_positions = [pos[p.name] for p in out_params
+                                   if p.role == "out"]
 
     def _make_spmd(self, sanitize: bool):
         """The per-core SPMD program over the compiled segments. With
@@ -1087,31 +1102,55 @@ class MeshKernel:
         self.func = ref.func       # profiler/introspection follow along
 
     def __call__(self, *args, **kwargs):
-        from ..utils.tensor import to_jax, copy_back
-        import jax
+        jax = self._jax
+        to_jax = self._to_jax
         n_in = len(self._in_params)
-        n_all = len(self.artifact.params)
+        # opt-in host-overhead + e2e latency recording, warm calls only
+        # (a first call folds the jax trace + XLA compile into the
+        # digest otherwise) — the mesh rows of the dispatch.overhead
+        # histogram (path=mesh; docs/host_dispatch.md)
+        timed = bool(self._warmed_variants or self._delegate) and \
+            _runtime.runtime_enabled() and \
+            _runtime.should_sample(self.artifact.name)
+        t0 = time.perf_counter() if timed else 0.0
         outs_provided = None
         if len(args) == n_in:
             ins = list(args)
-        elif len(args) == n_all:
-            pos = {p.name: i for i, p in enumerate(self.artifact.params)}
-            ins = [args[pos[p.name]] for p in self._in_params]
-            outs_provided = [args[pos[p.name]] for p in self._out_params
-                             if p.role == "out"]
+        elif len(args) == len(self.artifact.params):
+            ins = [args[i] for i in self._in_arg_positions]
+            outs_provided = [args[i] for i in self._out_arg_positions]
         else:
             raise TypeError(f"expected {n_in} inputs, got {len(args)}")
-        jins = [to_jax(a) for a in ins]
-        res = self._dispatch(jins)
+        # zero_copy=False: a dlpack import commits its result to ONE
+        # device, and shard_map inputs must stay uncommitted so XLA can
+        # spread them over the mesh
+        jins = [a if isinstance(a, jax.Array) else to_jax(a, zero_copy=False)
+                for a in ins]
+        if timed:
+            t1 = time.perf_counter()
+            res = self._dispatch(jins)
+            t2 = time.perf_counter()
+        else:
+            res = self._dispatch(jins)
         res = res if isinstance(res, tuple) else (res,)
+        if timed:
+            # same windows as the jit recorder (jit/dispatch.py):
+            # overhead = marshalling + post-dispatch bookkeeping before
+            # the copy-back loop; e2e latency = dispatch-to-sync
+            t3 = time.perf_counter()
+            _runtime.record_overhead(self.artifact.name,
+                                     (t1 - t0) + (t3 - t2), path="mesh")
+            jax.block_until_ready(res)
+            _runtime.record(self.artifact.name, time.perf_counter() - t1)
+        wrote = False
         if outs_provided:
-            wrote = False
+            copy_back = self._copy_back
             for dst, src in zip(outs_provided, res):
                 if not isinstance(dst, jax.Array):
                     copy_back(dst, src)
                     wrote = True
-            if wrote:
-                return None
+        if wrote:
+            return None
         return res[0] if len(res) == 1 else res
 
     def get_kernel_source(self) -> str:

@@ -288,6 +288,83 @@ class Profiler:
             vmem_bytes=vmem, vmem_ok=vmem <= arch.vmem_bytes,
             ici_wire_bytes=wire, n_collectives=len(colls))
 
+    def dispatch_overhead(self, calls: int = 300, warmup: int = 5,
+                          input_tensors: Optional[Sequence[Any]] = None
+                          ) -> Dict[str, Any]:
+        """Host-side dispatch overhead of ``kernel.__call__``: run
+        ``calls`` sampled invocations with ``TL_TPU_RUNTIME_METRICS``
+        forced on, then read the window back out of the shared
+        ``dispatch.overhead`` histogram (observability/runtime.py) —
+        the same series ``metrics_summary()["runtime"]`` reports, so a
+        bench number and a production number mean the same thing.
+        Throughput (``calls_per_sec``) is measured separately with
+        metrics off, because sampled calls pay a device sync the steady
+        state never does. The active path label ("fast" unless
+        ``TL_TPU_FAST_DISPATCH=0``) keys which histogram row the window
+        is diffed against — the dispatch_overhead_smoke bench flips the
+        env var and calls this twice to get the fast/legacy split."""
+        import os
+        import jax
+        from ..jit.dispatch import _flag
+        from ..observability import histogram as _h
+        from ..observability.runtime import OVERHEAD_HIST
+
+        kern = self.kernel
+        name = kern.artifact.name
+        ins = input_tensors if input_tensors is not None \
+            else self._inputs()
+        for _ in range(max(1, warmup)):
+            r = kern(*ins)
+        jax.block_until_ready(r)
+        # the ONE predicate DispatchPlan uses, so the window is diffed
+        # against the histogram row the calls actually record into
+        path = "fast" if _flag(os.environ.get("TL_TPU_FAST_DISPATCH"),
+                               True) else "legacy"
+        before = _h.get_histogram(OVERHEAD_HIST, kernel=name, path=path)
+        before = before.minus(None) if before is not None else None
+        prev = {k: os.environ.get(k)
+                for k in ("TL_TPU_RUNTIME_METRICS", "TL_TPU_RUNTIME_SAMPLE")}
+        os.environ["TL_TPU_RUNTIME_METRICS"] = "1"
+        os.environ["TL_TPU_RUNTIME_SAMPLE"] = "1"
+        try:
+            for _ in range(calls):
+                kern(*ins)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        after = _h.get_histogram(OVERHEAD_HIST, kernel=name, path=path)
+        window = after.minus(before) if after is not None else None
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            r = kern(*ins)
+        jax.block_until_ready(r)
+        wall = time.perf_counter() - t0
+
+        def _us(q: float) -> Optional[float]:
+            if window is None or window.count == 0:
+                return None
+            v = window.quantile(q)
+            return round(v * 1e6, 3) if v is not None else None
+
+        return {
+            "kernel": name,
+            "path": path,
+            "calls": calls,
+            "overhead_p50_us": _us(0.50),
+            "overhead_p90_us": _us(0.90),
+            "overhead_p99_us": _us(0.99),
+            # IQR/2: the MAD stand-in the perf-diff gate can use as its
+            # noise floor for overhead measurements
+            "overhead_iqr2_us": (
+                round((_us(0.75) - _us(0.25)) / 2, 3)
+                if window is not None and window.count else None),
+            "overhead_samples": window.count if window is not None else 0,
+            "calls_per_sec": round(calls / wall, 1) if wall > 0 else None,
+        }
+
     def run_once(self, func: Optional[Callable] = None):
         ins = self._inputs()
         fn = func or self.kernel

@@ -195,16 +195,23 @@ _PHASE_ORDER = LOWER_PHASES
 
 def summarize_trace(records) -> dict:
     """Aggregate JSONL trace records (observability.read_jsonl) into
-    {phases, spans, counters, collectives}: per-phase total/mean/max ms
-    for the lowering phases, plus everything else worth printing."""
+    {phases, spans, counters, collectives, runtime}: per-phase
+    total/mean/max ms for the lowering phases, plus everything else
+    worth printing. ``runtime`` reconstructs the serialized
+    ``kernel.latency`` / ``dispatch.overhead`` histogram lines into
+    per-kernel digests — e2e p50/p99 and the host-overhead split by
+    dispatch path (docs/host_dispatch.md)."""
     from ..observability import aggregate_spans
     phase_recs, other_recs = [], []
     collectives = []
     counters: dict = {}
+    hist_recs = []
     for r in records:
         t = r.get("type")
         if t == "counter":
             counters[r["name"]] = r["value"]
+        elif t == "histogram":
+            hist_recs.append(r)
         elif t == "event" and r.get("name") == "comm.collective":
             collectives.append(r.get("attrs", {}))
         elif t == "span":
@@ -214,7 +221,56 @@ def summarize_trace(records) -> dict:
                 other_recs.append(r)
     return {"phases": aggregate_spans(phase_recs),
             "spans": aggregate_spans(other_recs),
-            "counters": counters, "collectives": collectives}
+            "counters": counters, "collectives": collectives,
+            "runtime": _runtime_from_histograms(hist_recs)}
+
+
+def _runtime_from_histograms(hist_recs) -> dict:
+    """kernel -> {calls, p50_ms, p99_ms, host_overhead_p50_us,
+    host_overhead_by_path} from serialized histogram JSONL lines."""
+    from ..observability import Histogram
+    from ..observability.runtime import HIST_NAME, OVERHEAD_HIST
+    latency: dict = {}          # kernel -> merged Histogram
+    overhead: dict = {}         # kernel -> merged Histogram
+    by_path: dict = {}          # kernel -> {path: merged Histogram}
+    for r in hist_recs:
+        name = r.get("name")
+        if name not in (HIST_NAME, OVERHEAD_HIST):
+            continue
+        labels = r.get("labels") or {}
+        kernel = labels.get("kernel", "?")
+        try:
+            h = Histogram.from_dict(r)
+        except (KeyError, ValueError, TypeError):
+            continue
+        if not h.count:
+            continue
+        if name == HIST_NAME:
+            acc = latency.setdefault(kernel, Histogram(h.bounds))
+            acc.merge(h)
+        else:
+            acc = overhead.setdefault(kernel, Histogram(h.bounds))
+            acc.merge(h)
+            pacc = by_path.setdefault(kernel, {}).setdefault(
+                labels.get("path", "?"), Histogram(h.bounds))
+            pacc.merge(h)
+    out: dict = {}
+    for kernel in sorted(set(latency) | set(overhead)):
+        d: dict = {"calls": 0}
+        h = latency.get(kernel)
+        if h is not None:
+            d["calls"] = h.count
+            d["p50_ms"] = round((h.quantile(0.5) or 0) * 1e3, 6)
+            d["p99_ms"] = round((h.quantile(0.99) or 0) * 1e3, 6)
+        oh = overhead.get(kernel)
+        if oh is not None:
+            d["host_overhead_p50_us"] = \
+                round((oh.quantile(0.5) or 0) * 1e6, 3)
+            d["host_overhead_by_path"] = {
+                p: round((ph.quantile(0.5) or 0) * 1e6, 3)
+                for p, ph in sorted(by_path.get(kernel, {}).items())}
+        out[kernel] = d
+    return out
 
 
 def format_trace_report(records) -> str:
@@ -278,6 +334,24 @@ def format_trace_report(records) -> str:
             f"wire {int(opt.get('comm.opt.pre_wire_bytes', 0))}B -> "
             f"{int(opt.get('comm.opt.post_wire_bytes', 0))}B "
             f"hops_saved={int(opt.get('comm.opt.hops_saved', 0))}")
+    rt = s.get("runtime") or {}
+    if rt:
+        lines.append("runtime dispatch (kernel.latency / "
+                     "dispatch.overhead histograms):")
+        for kernel in sorted(rt):
+            d = rt[kernel]
+            parts = [f"  {kernel:<28} calls={d.get('calls', 0)}"]
+            if d.get("p50_ms") is not None:
+                parts.append(f" e2e_p50={d['p50_ms']:.4f}ms "
+                             f"p99={d.get('p99_ms', 0):.4f}ms")
+            if d.get("host_overhead_p50_us") is not None:
+                parts.append(
+                    f" host_overhead_p50={d['host_overhead_p50_us']:.2f}us")
+                bp = d.get("host_overhead_by_path") or {}
+                if len(bp) > 1:
+                    parts.append(" (" + ", ".join(
+                        f"{p}={v:.2f}us" for p, v in bp.items()) + ")")
+            lines.append("".join(parts))
     return "\n".join(lines)
 
 
