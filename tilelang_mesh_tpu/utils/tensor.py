@@ -54,9 +54,45 @@ def get_tensor_supply(supply_type: TensorSupplyType = TensorSupplyType.Auto,
     return supply
 
 
-def to_jax(x: Any):
-    """Convert torch / numpy / python inputs to jax arrays (zero-copy where
-    possible via dlpack)."""
+def _dlpack_import(x):
+    """Best-effort dlpack ingestion (None = caller must fall back to the
+    copying path). Raising here would turn an unsupported-but-valid
+    input (non-contiguous view, exotic dtype, unaligned buffer) into an
+    error the copying path handles fine.
+
+    Only used when the process's default backend IS the host platform:
+    a dlpack import of host memory commits the array to a CPU device,
+    and ``jit`` follows committed inputs — on a TPU-default process that
+    would silently drag the whole dispatch onto the host instead of
+    staging the buffer to HBM like ``jnp.asarray`` does."""
+    try:
+        import jax
+        if jax.default_backend() != "cpu":
+            return None
+        from jax import dlpack as _jdl
+        return _jdl.from_dlpack(x)
+    except Exception:
+        return None
+
+
+def to_jax(x: Any, zero_copy: bool = True):
+    """Convert torch / numpy / python inputs to jax arrays — zero-copy
+    where possible via the ``__dlpack__`` protocol, one copy otherwise.
+
+    CPU torch tensors go through ``jax.dlpack`` (this is also the only
+    path that can carry bfloat16, which numpy cannot represent); inputs
+    that dlpack rejects (non-contiguous views, unsupported dtypes) fall
+    back to a detach+copy. Contiguous aligned numpy arrays take the same
+    dlpack route; everything else is ``jnp.asarray``. Note the dlpack
+    contract: when the backend does alias the caller's buffer, mutating
+    the source after the call is undefined — see the zero-copy matrix in
+    docs/host_dispatch.md.
+
+    ``zero_copy=False`` skips dlpack entirely: a dlpack import commits
+    the result to ONE device, which a multi-device consumer (MeshKernel
+    shard_map inputs) must not receive — mesh marshalling needs the
+    uncommitted ``jnp.asarray`` form XLA can reshard.
+    """
     import jax
     import jax.numpy as jnp
     if isinstance(x, jax.Array):
@@ -66,20 +102,66 @@ def to_jax(x: Any):
         if x.device.type != "cpu":
             raise ValueError("only CPU torch tensors can cross into the TPU "
                              "runtime")
-        return jnp.asarray(x.detach().numpy())
+        t = x.detach() if x.requires_grad else x
+        if zero_copy:
+            j = _dlpack_import(t)
+            if j is not None:
+                return j
+        if not t.is_contiguous():
+            t = t.contiguous()
+        if zero_copy:
+            j = _dlpack_import(t)
+            if j is not None:
+                return j
+        try:
+            return jnp.asarray(t.numpy())
+        except TypeError:
+            # numpy cannot represent this dtype (bfloat16 & friends):
+            # dlpack is the only no-intermediate route — but it commits
+            # the result to one device, so a zero_copy=False caller
+            # (mesh marshalling) must take the float32 round-trip even
+            # here
+            if zero_copy:
+                j = _dlpack_import(t.contiguous())
+                if j is not None:
+                    return j
+            return jnp.asarray(t.float().numpy()).astype(
+                jnp.dtype(str(t.dtype).replace("torch.", "")))
+    if zero_copy and isinstance(x, np.ndarray) and \
+            x.flags.c_contiguous and x.ctypes.data % 16 == 0:
+        j = _dlpack_import(x)
+        if j is not None:
+            return j
     return jnp.asarray(x)
 
 
 def copy_back(dst: Any, src) -> None:
     """Write a jax result back into a caller-provided torch/numpy output
-    buffer (reference-style `kernel(a, b, c)` call convention)."""
-    arr = np.asarray(src)
+    buffer (reference-style `kernel(a, b, c)` call convention).
+
+    Torch destinations read the jax buffer through dlpack (zero-copy
+    view, bfloat16-capable) and let ``Tensor.copy_`` do the one
+    unavoidable write into the caller's memory. The numpy fallback only
+    copies when the jax-backed view is non-contiguous (``np.asarray`` of
+    a jax array is already a host view; the old unconditional
+    ``arr.copy()`` doubled the transfer)."""
     mod = type(dst).__module__
     if mod.startswith("torch"):
         import torch
-        dst.copy_(torch.from_numpy(arr.copy()))
+        view = None
+        try:
+            view = torch.from_dlpack(src)
+        except Exception:
+            pass
+        if view is None:
+            arr = np.asarray(src)
+            if not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr)
+            view = torch.from_numpy(arr) if arr.flags.writeable \
+                else torch.from_numpy(arr.copy())
+        dst.copy_(view)
     elif isinstance(dst, np.ndarray):
-        np.copyto(dst, arr)
+        np.copyto(dst, np.asarray(src))
     else:
         raise TypeError(f"cannot copy kernel output back into {type(dst)}")
 
