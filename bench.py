@@ -524,6 +524,101 @@ def cfg_dispatch_overhead_smoke(M=128, calls=300):
                 custom_run=run)
 
 
+def cfg_serve_smoke(requests=64):
+    """CI serve-smoke config for the serving engine (serving/;
+    docs/serving.md): a seeded request storm through the
+    continuous-batching scheduler on a tiny paged flash-decode
+    workload. Headline value = served requests/sec with batching;
+    ``vs_baseline`` = batched throughput over the SAME requests served
+    unbatched (batch bucket 1) — the continuous-batching win the
+    subsystem exists for (> 1 means batching pays). Every request must
+    retire as ``result`` and the KV slabs must balance to zero or the
+    config raises (a serving smoke that leaks or drops is a failure,
+    not a slow run). CPU-safe: the decode kernels run identically on
+    the host platform tiers."""
+    from tilelang_mesh_tpu.observability import histogram as _h
+    from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                           PagedKVAllocator,
+                                           ServingEngine)
+
+    def build_engine(batch_buckets, name):
+        alloc = PagedKVAllocator(n_pages=256, page_size=8, heads=2,
+                                 head_dim=64)
+        wl = FlashDecodeWorkload(alloc, batch_buckets=batch_buckets,
+                                 page_buckets=(2,))
+        eng = ServingEngine(wl, name=name)
+        eng.warmup()
+        return eng
+
+    def drive(eng):
+        rng = np.random.default_rng(11)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(context_tokens=16,
+                           new_tokens=int(rng.integers(1, 3)),
+                           seed=int(rng.integers(1 << 30)))
+                for _ in range(requests)]
+        eng.run()
+        wall = time.perf_counter() - t0
+        bad = [r.req_id for r in reqs if r.outcome != "result"]
+        if bad:
+            raise BenchError(f"serve_smoke: {len(bad)} request(s) did "
+                             f"not retire as result: {bad[:8]}")
+        if eng.workload.allocator.in_use:
+            raise BenchError("serve_smoke: leaked KV slabs "
+                             f"({eng.workload.allocator.leak_check()})")
+        return wall, eng
+
+    def _step_hist():
+        h = _h.get_histogram("kernel.latency", kernel="serve.step",
+                             source="serving")
+        return None if h is None else _h.Histogram.from_dict(h.to_dict())
+
+    def run():
+        eng_b = build_engine((8,), "smoke-batched")
+        eng_s = build_engine((1,), "smoke-sequential")
+        before = _step_hist()
+        wall_b, eng_b = drive(eng_b)
+        win = _step_hist().minus(before)       # batched steps only
+        wall_s, eng_s = drive(eng_s)
+
+        def q_ms(h, q):
+            v = h.quantile(q) if h and h.count else None
+            return round(v * 1e3, 4) if v is not None else None
+
+        iqr2 = None
+        if win and win.count:
+            iqr2 = round(((win.quantile(0.75) or 0)
+                          - (win.quantile(0.25) or 0)) / 2 * 1e3, 5)
+        return {
+            "value": round(requests / wall_b, 1),
+            "unit": "req/s",
+            # >1 = continuous batching beats unbatched serving
+            "vs_baseline": round(wall_s / wall_b, 4),
+            "latency_ms": round(wall_b / max(eng_b.stats()["steps"], 1)
+                                * 1e3, 4),
+            "baseline_ms": round(wall_s * 1e3 / requests, 4),
+            "latency_p50_ms": q_ms(win, 0.50),
+            "latency_p90_ms": q_ms(win, 0.90),
+            "latency_p99_ms": q_ms(win, 0.99),
+            "latency_mad_ms": iqr2,
+            "latency_samples": win.count if win else 0,
+            "reps": requests,
+            "baseline_mad_ms": iqr2,
+            "requests": requests,
+            "batched_steps": eng_b.stats()["steps"],
+            "sequential_steps": eng_s.stats()["steps"],
+            "req_per_sec_batched": round(requests / wall_b, 1),
+            "req_per_sec_sequential": round(requests / wall_s, 1),
+            "kv_pages_allocated":
+                eng_b.workload.allocator.alloc_count,
+        }
+
+    return dict(metric=f"serving engine smoke: {requests} requests, "
+                       f"paged flash decode (continuous batching vs "
+                       f"unbatched)",
+                custom_run=run)
+
+
 def cfg_flash(D, S=2048, B=2, H=16, causal=True):
     import jax.numpy as jnp
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -1297,7 +1392,7 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # probe finds the TPU worker dead still runs them (on the host platform)
 # instead of producing an empty artifact.
 CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
-                    "mesh_allreduce_smoke")
+                    "mesh_allreduce_smoke", "serve_smoke")
 
 
 def _config_env(name: str, tpu_alive: bool) -> dict:
@@ -1347,6 +1442,7 @@ def _config_builders(q: bool):
         ("gemm_smoke", lambda: cfg_gemm_smoke()),
         ("dispatch_overhead_smoke", lambda: cfg_dispatch_overhead_smoke()),
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
+        ("serve_smoke", lambda: cfg_serve_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),
@@ -1563,11 +1659,24 @@ def main():
 
     q = args.quick
     configs = _config_builders(q)
+    skipped_records = []   # explicit skip_reason records (never silent)
     if args.hermetic:
         # hermetic sweep: the CPU-safe set only, every config through
         # the backend registry with the TPU tier dead — guaranteed to
-        # produce numbers on the host fallback tiers
+        # produce numbers on the host fallback tiers. TPU-only configs
+        # are not silently omitted: each gets a skip record naming the
+        # capability filter, so a snapshot reader can tell "filtered by
+        # design" from "failed to produce numbers".
         keep = set(args.only.split(",")) if args.only else None
+        # configs excluded by an explicit --only are out of the run's
+        # scope by user choice and get no record; only capability
+        # filtering (TPU-only in a CPU-safe sweep) is surfaced
+        skipped_records = [
+            {"config": n, "skipped": True,
+             "skip_reason": "capability filter: TPU-only config; the "
+                            "hermetic sweep runs the CPU-safe set"}
+            for n, _ in configs if n not in CPU_SAFE_CONFIGS
+            and (keep is None or n in keep)]
         configs = [(n, b) for n, b in configs if n in CPU_SAFE_CONFIGS
                    and (keep is None or n in keep)]
     elif args.only:
@@ -1631,7 +1740,10 @@ def main():
     headline = None
     builders = dict(configs)
     peaks = None
+    for rec in skipped_records:
+        print(json.dumps(rec), flush=True)
     for name in names:
+        skip_reason = None
         if args.in_process:
             # legacy single-process path (debugging)
             try:
@@ -1677,6 +1789,7 @@ def main():
                     alive = False
             else:
                 rec, err = None, f"skipped: TPU worker {dead_reason}"
+                skip_reason = f"dead tier: TPU worker {dead_reason}"
         if rec is not None:
             print(json.dumps(rec), flush=True)
             results.append(rec)
@@ -1685,8 +1798,14 @@ def main():
         else:
             print(f"# config {name} FAILED: {err}", file=sys.stderr,
                   flush=True)
-            print(json.dumps({"config": name, "error": (err or "")[:300]}),
-                  flush=True)
+            failed_rec = {"config": name, "error": (err or "")[:300]}
+            if skip_reason:
+                # an explicit skip is not a failure: name the dead tier
+                # so snapshot readers can tell "worker down" from
+                # "config broken" without parsing error strings
+                failed_rec["skipped"] = True
+                failed_rec["skip_reason"] = skip_reason[:300]
+            print(json.dumps(failed_rec), flush=True)
 
 
     ok = results

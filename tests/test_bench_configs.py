@@ -79,6 +79,19 @@ def test_mesh_allreduce_smoke_config():
     assert rec["comm_hops_saved"] >= 0
 
 
+def test_serve_smoke_config():
+    """The CPU-safe serving smoke: every request must retire as result
+    with zero leaked slabs, and the record must carry the batching-win
+    ratio plus KV accounting (docs/serving.md)."""
+    import bench
+    rec = _run("serve_smoke", lambda: bench.cfg_serve_smoke(requests=16))
+    assert rec["unit"] == "req/s"
+    assert rec["requests"] == 16
+    assert rec["kv_pages_allocated"] > 0
+    assert rec["req_per_sec_batched"] > 0
+    assert rec["batched_steps"] <= rec["sequential_steps"]
+
+
 def test_cpu_safe_configs_declared():
     """Probe-once skip logic keys off CPU_SAFE_CONFIGS: both smoke
     configs must be declared CPU-safe and excluded from the default

@@ -1,0 +1,641 @@
+"""Serving-engine suite (serving/; docs/serving.md).
+
+Four layers, mirroring the subsystem:
+
+1. **Primitives** — request lifecycle state machine, the paged-KV slab
+   allocator (atomic alloc, double-free rejection, leak check), shape
+   bucketing, and the sharding-rule hooks.
+2. **Admission control** — every named shed reason is reachable and
+   wired to the real machinery (queue bound, circuit breaker, KV
+   capacity, deadline feasibility, p99 budget, drain mode).
+3. **Failure handling** — injected ``serve.admit`` / ``serve.step`` /
+   ``serve.kv`` faults, the retry budget, deterministic failures
+   feeding the breaker, device loss mid-batch (quarantine + failover +
+   re-admission), and deadline expiry.
+4. **The contract** — a seeded 500-request chaos soak (device loss
+   mid-batch, ``serve.*`` faults armed, deadline mix) asserting every
+   request reaches a terminal outcome, KV slabs balance to zero, and
+   the shed/deadline accounting matches the histograms — the same
+   driver ``verify/chaos.py --serve`` gates CI with.
+
+Everything is deterministic (seeded faults, seeded request content);
+the only wall-clock dependence is deliberate (deadline expiry sleeps).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.resilience.retry import global_breaker
+from tilelang_mesh_tpu.serving import (AdmissionController,
+                                       FlashDecodeWorkload,
+                                       KVCacheExhausted,
+                                       MLADecodeWorkload,
+                                       PagedKVAllocator, Request,
+                                       SERVE_BREAKER_SIG, ServeShardConfig,
+                                       ServingEngine, match_partition_rules,
+                                       serving_state)
+
+H, D, PS = 2, 64, 8
+
+
+def make_engine(n_pages=64, batch_buckets=(4,), page_buckets=(2, 4),
+                **kw):
+    alloc = PagedKVAllocator(n_pages=n_pages, page_size=PS, heads=H,
+                             head_dim=D)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=batch_buckets,
+                             page_buckets=page_buckets)
+    return ServingEngine(wl, **kw), alloc
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle
+# ---------------------------------------------------------------------------
+
+def test_request_lifecycle_states():
+    r = Request(context_tokens=16, new_tokens=2, deadline_ms=1000)
+    assert r.state == "queued" and not r.is_terminal
+    r.admit()
+    assert r.state == "admitted"
+    r.batch()
+    assert r.state == "batched" and r.first_batch_t is not None
+    r.requeue()
+    assert r.state == "admitted"
+    r.finish("result")
+    assert r.is_terminal and r.state == "terminal"
+    assert [s for s, _ in r.timeline] == [
+        "queued", "admitted", "batched", "admitted", "terminal"]
+
+
+def test_request_double_retirement_raises():
+    r = Request(context_tokens=16)
+    r.finish("shed", shed_reason="queue_full")
+    with pytest.raises(RuntimeError):
+        r.finish("result")
+
+
+def test_request_unknown_outcome_rejected():
+    r = Request(context_tokens=16)
+    with pytest.raises(ValueError):
+        r.finish("evaporated")
+
+
+def test_request_deadline_arithmetic():
+    r = Request(context_tokens=16, deadline_ms=10_000)
+    assert 9.0 < r.remaining_s() <= 10.0
+    assert not r.expired()
+    assert Request(context_tokens=16).remaining_s() is None
+    expired = Request(context_tokens=16, deadline_ms=0.0)
+    time.sleep(0.002)
+    assert expired.expired()
+    assert not expired.expired(grace_s=60.0)
+
+
+# ---------------------------------------------------------------------------
+# paged KV allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_alloc_free_balance():
+    a = PagedKVAllocator(n_pages=8, page_size=PS, heads=H, head_dim=D)
+    pages = a.alloc(3, owner=1)
+    assert len(pages) == 3 and a.in_use == 3 and a.free_pages == 5
+    assert a.holdings(1) == pages
+    assert a.free(1) == 3
+    assert a.in_use == 0 and a.alloc_count == a.free_count == 3
+    assert a.leak_check() == {}
+
+
+def test_allocator_exhaustion_is_atomic():
+    a = PagedKVAllocator(n_pages=4, page_size=PS, heads=H, head_dim=D)
+    a.alloc(3, owner=1)
+    with pytest.raises(KVCacheExhausted):
+        a.alloc(2, owner=2)
+    # the failed alloc must not have consumed the last free page
+    assert a.free_pages == 1 and a.holdings(2) == []
+
+
+def test_allocator_double_and_foreign_free_rejected():
+    a = PagedKVAllocator(n_pages=4, page_size=PS, heads=H, head_dim=D)
+    p = a.alloc(2, owner=1)
+    a.free(1, [p[0]])
+    with pytest.raises(ValueError):
+        a.free(1, [p[0]])          # double free
+    with pytest.raises(ValueError):
+        a.free(2, [p[1]])          # foreign free
+    assert a.leak_check() == {1: [p[1]]}
+    a.free(1)
+
+
+def test_allocator_hmajor_layout_and_write():
+    a = PagedKVAllocator(n_pages=4, page_size=PS, heads=H, head_dim=D)
+    page = a.alloc(1, owner=1)[0]
+    k = np.full((H, D), 2.0, np.float32)
+    v = np.full((H, D), 3.0, np.float32)
+    a.write_token(page, 5, k, v)
+    row = a.row0(page) + 5
+    assert float(a.kp[1, row, 0]) == 2.0
+    assert float(a.vp[0, row, -1]) == 3.0
+    with pytest.raises(IndexError):
+        a.write_token(page, PS, k, v)
+
+
+def test_allocator_kv_fault_site():
+    a = PagedKVAllocator(n_pages=4, page_size=PS, heads=H, head_dim=D)
+    with inject("serve.kv", kind="transient"):
+        with pytest.raises(Exception):
+            a.alloc(1, owner=1)
+    assert a.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+def test_batch_bucket_rounding():
+    eng, _ = make_engine(batch_buckets=(1, 2, 4, 8))
+    wl = eng.workload
+    assert wl.batch_bucket(1) == 1
+    assert wl.batch_bucket(3) == 4
+    assert wl.batch_bucket(9) == 8      # clamped to the top bucket
+
+
+def test_window_pages_clamps_to_buckets():
+    eng, _ = make_engine(page_buckets=(2, 4))
+    wl = eng.workload
+    r = Request(context_tokens=2 * PS)       # exactly 2 pages
+    assert wl.window_pages(r) == 2
+    r3 = Request(context_tokens=3 * PS)      # 3 full pages -> bucket 2
+    assert wl.window_pages(r3) == 2
+    r5 = Request(context_tokens=5 * PS)      # above top -> suffix of 4
+    assert wl.window_pages(r5) == 4
+
+
+def test_pages_needed_is_worst_case():
+    eng, _ = make_engine()
+    assert eng.workload.pages_needed(16, 1) == 3     # 17 tokens / 8
+    assert eng.workload.pages_needed(16, 8) == 3
+    assert eng.workload.pages_needed(16, 9) == 4
+
+
+def test_ingest_rejects_sub_bucket_context():
+    eng, _ = make_engine(page_buckets=(2,))
+    with pytest.raises(ValueError):
+        eng.submit(context_tokens=PS)        # one page < smallest bucket
+    # a rejected (caller-bug) request is never accepted: it must not
+    # linger non-terminal in eng.requests, or the all-terminal audit
+    # would report a phantom pending request forever
+    assert eng.requests == []
+    assert eng.outcomes()["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# happy path + warm-up
+# ---------------------------------------------------------------------------
+
+def test_end_to_end_all_results_and_zero_leaks():
+    eng, alloc = make_engine()
+    eng.warmup()
+    reqs = [eng.submit(context_tokens=16 + 16 * (i % 2), new_tokens=2,
+                       seed=i) for i in range(10)]
+    eng.run()
+    assert all(r.outcome == "result" for r in reqs)
+    assert all(np.asarray(r.result).shape == (H, 1, D) for r in reqs)
+    assert alloc.in_use == 0 and alloc.leak_check() == {}
+    assert alloc.alloc_count == alloc.free_count > 0
+    s = eng.stats()
+    assert s["outcomes"]["result"] == 10 and s["queue_depth"] == 0
+
+
+def test_results_match_direct_pool_decode():
+    eng, alloc = make_engine(batch_buckets=(1, 4), page_buckets=(2,))
+    eng.warmup()
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=5)
+    pages = list(r.pages)
+    kp, vp = alloc.kp.copy(), alloc.vp.copy()
+    eng.run()
+    assert r.outcome == "result"
+    from tilelang_mesh_tpu.ops.flash_decoding import flash_decode_paged_pool
+    rng = np.random.default_rng((5, 1, 0))
+    q = rng.standard_normal((H, 1, D)).astype(np.float32)[None]
+    table = np.asarray([pages[:2]], np.int32)
+    ref = np.asarray(flash_decode_paged_pool(
+        q, kp, vp, table, PS, sm_scale=eng.workload.sm_scale))
+    np.testing.assert_allclose(np.asarray(r.result)[None], ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_continuous_batching_mixes_old_and_new_requests():
+    eng, _ = make_engine(batch_buckets=(4,), page_buckets=(2,))
+    eng.warmup()
+    long = eng.submit(context_tokens=16, new_tokens=3, seed=1)
+    eng.step()                                # long did step 1
+    short = eng.submit(context_tokens=16, new_tokens=1, seed=2)
+    assert eng.step()                         # one batch served BOTH
+    assert short.outcome == "result"
+    assert long.steps_done == 2 and not long.is_terminal
+    eng.run()
+    assert long.outcome == "result"
+
+
+def test_warmup_aot_compiles_each_bucket_once():
+    eng, _ = make_engine(batch_buckets=(4,), page_buckets=(2, 4))
+    assert eng.warmup() == 2                  # (4,2) and (4,4)
+    assert eng.warmup() == 0                  # idempotent
+    # warm-up also seeds the step-latency estimate admission reads
+    from tilelang_mesh_tpu.serving.admission import observed_step_ms
+    assert observed_step_ms(0.5) > 0
+
+
+def test_page_growth_allocates_midflight():
+    # context 23 tokens = 2 full pages + 7 tail; the second generated
+    # token fills the tail page and the THIRD allocates a fresh one
+    eng, alloc = make_engine(batch_buckets=(1,), page_buckets=(2,))
+    eng.warmup()
+    r = eng.submit(context_tokens=23, new_tokens=3, seed=3)
+    pages_at_admit = len(r.pages)
+    eng.run()
+    assert r.outcome == "result"
+    assert alloc.in_use == 0
+    assert alloc.alloc_count == pages_at_admit + 1
+
+
+# ---------------------------------------------------------------------------
+# admission control / shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_queue_full():
+    eng, _ = make_engine(n_pages=512,
+                         admission=AdmissionController(max_queue=2))
+    eng.warmup()
+    outcomes = [eng.submit(context_tokens=16, seed=i).outcome
+                for i in range(4)]
+    assert outcomes[:2] == [None, None]
+    assert all(o == "shed" for o in outcomes[2:])
+    assert [r.shed_reason for r in eng.requests[2:]] == ["queue_full"] * 2
+    eng.run()
+
+
+def test_shed_kv_exhausted_at_admission():
+    eng, _ = make_engine(n_pages=4)
+    r1 = eng.submit(context_tokens=16, new_tokens=1)   # needs 3 pages
+    r2 = eng.submit(context_tokens=16, new_tokens=1)   # only 1 left
+    assert r1.outcome is None and r2.outcome == "shed"
+    assert r2.shed_reason == "kv_exhausted"
+    eng.run()
+    assert r1.outcome == "result"
+
+
+def test_shed_deadline_infeasible():
+    eng, _ = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=16, deadline_ms=0.0)
+    assert r.outcome == "shed" and r.shed_reason == "deadline_infeasible"
+
+
+def test_shed_breaker_open():
+    eng, _ = make_engine()
+    b = global_breaker()
+    for _ in range(b.threshold):
+        b.record_failure(SERVE_BREAKER_SIG)
+    r = eng.submit(context_tokens=16)
+    assert r.outcome == "shed" and r.shed_reason == "breaker_open"
+    b.reset()
+
+
+def test_shed_overload_on_p99_budget():
+    eng, _ = make_engine(
+        admission=AdmissionController(p99_budget_ms=0.001))
+    eng.warmup()     # the measured warm step exceeds 1us by construction
+    r = eng.submit(context_tokens=16)
+    assert r.outcome == "shed" and r.shed_reason == "overload"
+
+
+def test_drain_finishes_inflight_and_sheds_new():
+    eng, alloc = make_engine()
+    eng.warmup()
+    inflight = eng.submit(context_tokens=16, new_tokens=2, seed=1)
+    eng.drain()
+    late = eng.submit(context_tokens=16, seed=2)
+    assert late.outcome == "shed" and late.shed_reason == "draining"
+    eng.run()
+    assert inflight.outcome == "result"
+    assert alloc.in_use == 0
+
+
+def test_admit_fault_sheds_terminally():
+    eng, _ = make_engine()
+    with inject("serve.admit", kind="transient"):
+        r = eng.submit(context_tokens=16)
+    assert r.outcome == "shed" and r.shed_reason == "admit_fault"
+    assert r.error and "InjectedFault" in r.error
+
+
+def test_ingest_kv_fault_sheds_terminally():
+    eng, alloc = make_engine()
+    with inject("serve.kv", kind="oserror"):
+        r = eng.submit(context_tokens=16)
+    assert r.outcome == "shed" and r.shed_reason == "kv_exhausted"
+    assert alloc.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expires_in_queue():
+    eng, alloc = make_engine(grace_ms=10.0)
+    eng.warmup()
+    r = eng.submit(context_tokens=16, deadline_ms=30.0)
+    assert r.outcome is None                  # feasible at admission
+    time.sleep(0.08)                          # deadline + grace pass
+    eng.run()
+    assert r.outcome == "deadline_exceeded"
+    assert alloc.in_use == 0                  # slabs released on expiry
+
+
+def test_step_budget_propagates_tightest_deadline():
+    eng, _ = make_engine(grace_ms=50.0, step_timeout_ms=0.0)
+    eng.warmup()
+    a = eng.submit(context_tokens=16, deadline_ms=10_000)
+    b = eng.submit(context_tokens=16, deadline_ms=700)
+    batch = [a, b]
+    budget = eng._step_budget_s(batch)
+    # tightest remaining deadline (~0.7s) + grace (0.05s)
+    assert 0.5 < budget < 0.76
+    eng2, _ = make_engine(step_timeout_ms=200.0)
+    r = Request(context_tokens=16)
+    assert eng2._step_budget_s([r]) == pytest.approx(0.2)
+    assert eng._step_budget_s([Request(context_tokens=16)]) is None
+    eng.run()
+
+
+def test_step_timeout_retries_then_sheds_on_budget():
+    # a step that always blows its budget: the deadline'd request is
+    # retried within its budget, then shed with reason=retry_budget
+    eng, alloc = make_engine(step_timeout_ms=30.0, retry_max=1)
+    eng.warmup()
+    r = eng.submit(context_tokens=16, deadline_ms=60_000, seed=1)
+    orig = eng.workload.run_batch
+
+    def slow(batch):
+        time.sleep(0.12)
+        return orig(batch)
+
+    eng.workload.run_batch = slow
+    eng.run()
+    assert r.outcome == "shed" and r.shed_reason == "retry_budget"
+    assert r.retries == 1
+    assert alloc.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# step failures: retries, breaker, device loss
+# ---------------------------------------------------------------------------
+
+def test_transient_step_fault_retries_to_completion():
+    eng, alloc = make_engine()
+    eng.warmup()
+    reqs = [eng.submit(context_tokens=16, seed=i) for i in range(4)]
+    with inject("serve.step", kind="transient", times=1):
+        eng.run()
+    assert all(r.outcome == "result" for r in reqs)
+    assert all(r.retries == 1 for r in reqs)
+    assert alloc.in_use == 0
+
+
+def test_retry_budget_exhaustion_fails_undeadlined():
+    eng, alloc = make_engine(retry_max=2)
+    eng.warmup()
+    r = eng.submit(context_tokens=16, seed=1)
+    with inject("serve.step", kind="transient"):      # every step fails
+        eng.run()
+    assert r.outcome == "failed" and r.retries == 2
+    assert "retry budget exhausted" in r.error
+    assert alloc.in_use == 0
+
+
+def test_deterministic_step_fault_fails_batch_and_feeds_breaker():
+    eng, alloc = make_engine()
+    eng.warmup()
+    b = global_breaker()
+    for i in range(b.threshold):
+        r = eng.submit(context_tokens=16, seed=i)
+        with inject("serve.step", kind="deterministic"):
+            eng.run()
+        assert r.outcome == "failed" and r.retries == 0
+    # the rolled-up serve.step signature opened the circuit: admission
+    # now sheds at the door
+    shed = eng.submit(context_tokens=16)
+    assert shed.outcome == "shed" and shed.shed_reason == "breaker_open"
+    assert alloc.in_use == 0
+    b.reset()
+
+
+def test_device_loss_midbatch_quarantines_and_readmits():
+    eng, alloc = make_engine()
+    eng.warmup()
+    reqs = [eng.submit(context_tokens=16, seed=i) for i in range(4)]
+    before = obs.metrics_summary()["serving"]
+    with inject("device.dispatch", kind="unreachable", times=1):
+        eng.run()
+    after = obs.metrics_summary()["serving"]
+    assert all(r.outcome == "result" for r in reqs)
+    assert after["failovers"] == before["failovers"] + 1
+    assert eng.stats()["failovers"] == 1
+    assert alloc.in_use == 0
+
+
+def test_device_loss_on_expired_request_is_deadline_exceeded():
+    eng, alloc = make_engine(grace_ms=0.0)
+    eng.warmup()
+    r = eng.submit(context_tokens=16, deadline_ms=40.0, seed=1)
+    orig = eng.workload.run_batch
+
+    def die_slowly(batch):
+        time.sleep(0.08)                      # past the deadline...
+        raise RuntimeError("worker unreachable")   # ...then device loss
+
+    eng.workload.run_batch = die_slowly
+    eng.step()
+    assert r.outcome == "deadline_exceeded"
+    assert alloc.in_use == 0
+    eng.workload.run_batch = orig
+
+
+def test_quarantine_blames_serving_tier_not_first_dead_tier(monkeypatch):
+    # two successive device losses: the second must mark the tier that
+    # is ACTUALLY serving (the first used chain entry not already dead),
+    # not re-blame the long-dead chain head and leave the dying tier
+    # cached healthy for its TTL
+    from tilelang_mesh_tpu.codegen.backends import registry
+    monkeypatch.setenv("TL_TPU_BACKENDS",
+                       "tpu-pallas,host-xla,host-interpret")
+    eng, _ = make_engine()
+    reg = registry()
+    reg.mark_unhealthy("tpu-pallas", RuntimeError("worker unreachable"))
+    monkeypatch.setattr(eng, "_backends_used",
+                        lambda: {"tpu-pallas", "host-xla"})
+    eng._quarantine_and_failover(RuntimeError("socket closed"))
+    assert reg.health("host-xla").healthy is False
+    assert reg.health("host-interpret").healthy is not False
+    global_breaker().reset()
+
+
+def test_midflight_kv_fault_sheds_growing_request():
+    eng, alloc = make_engine(batch_buckets=(1,), page_buckets=(2,))
+    eng.warmup()
+    # 2 full pages + full tail: the first generated token needs a page
+    r = eng.submit(context_tokens=2 * PS, new_tokens=2, seed=1)
+    with inject("serve.kv", kind="transient"):
+        eng.run()
+    assert r.outcome == "shed" and r.shed_reason == "kv_exhausted"
+    assert alloc.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# MLA workload
+# ---------------------------------------------------------------------------
+
+def test_mla_workload_end_to_end_matches_reference():
+    dc, dr = 32, 16
+    alloc = PagedKVAllocator(n_pages=16, page_size=PS, heads=1,
+                             head_dim=dc + dr)
+    wl = MLADecodeWorkload(alloc, heads=2, latent_dim=dc, rope_dim=dr,
+                           batch_buckets=(1,), page_buckets=(2,))
+    eng = ServingEngine(wl, name="mla")
+    eng.warmup()
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=9)
+    rows = alloc.kp[0].copy()
+    pages = list(r.pages)
+    eng.run()
+    assert r.outcome == "result"
+    assert np.asarray(r.result).shape == (2, dc)
+    assert alloc.in_use == 0
+    # reference: gather the pages and run the latent-attention math
+    from tilelang_mesh_tpu.ops.mla import mla_decode_reference
+    idx = (np.asarray(pages[:2])[:, None] * PS
+           + np.arange(PS)[None, :]).reshape(-1)
+    seq = rows[idx][None]                       # (1, S, dc+dr)
+    rng = np.random.default_rng((9, 1, 0))
+    q = rng.standard_normal((2, dc + dr)).astype(np.float32)[None]
+    ref = np.asarray(mla_decode_reference(
+        q[:, :, :dc].copy(), q[:, :, dc:].copy(),
+        seq[:, :, :dc].copy(), seq[:, :, dc:].copy(),
+        sm_scale=wl.sm_scale))
+    np.testing.assert_allclose(np.asarray(r.result)[None], ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_mla_requires_latent_major_allocator():
+    alloc = PagedKVAllocator(n_pages=8, page_size=PS, heads=2, head_dim=D)
+    with pytest.raises(ValueError):
+        MLADecodeWorkload(alloc, heads=2, latent_dim=32, rope_dim=16)
+
+
+# ---------------------------------------------------------------------------
+# sharding hooks
+# ---------------------------------------------------------------------------
+
+def test_match_partition_rules_first_match_wins():
+    from jax.sharding import PartitionSpec as P
+    rules = [(r"kv/.*", P("x")), (r".*", P())]
+    specs = match_partition_rules(rules, ["kv/k_pool", "step/q"])
+    assert specs == [P("x"), P()]
+    with pytest.raises(ValueError):
+        match_partition_rules([(r"kv/.*", P())], ["step/q"])
+
+
+def test_serve_shard_config_layouts():
+    from jax.sharding import PartitionSpec as P
+    head = ServeShardConfig.head_parallel("x")
+    assert head.kv_pool_hrd == P("x")
+    assert head.table_bp == P()
+    names = ["kv/k_pool", "step/query", "kv/page_table", "step/out"]
+    specs = match_partition_rules(head.rules(), names)
+    assert specs == [P("x"), P(None, "x"), P(), P(None, "x")]
+    none = ServeShardConfig.no_sharding()
+    assert all(s == P() for s in
+               match_partition_rules(none.rules(), names))
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_metrics_summary_serving_section(monkeypatch):
+    obs.reset()
+    eng, _ = make_engine()
+    eng.warmup()
+    ok = eng.submit(context_tokens=16, seed=1)
+    shed = eng.submit(context_tokens=16, deadline_ms=0.0)
+    eng.run()
+    s = obs.metrics_summary()["serving"]
+    assert s["admitted"] == 1 and s["completed"] == 1
+    assert s["shed"]["deadline_infeasible"] == 1 and s["shed_total"] == 1
+    assert s["step_latency"]["count"] >= 1
+    assert s["gauges"]["queue_depth"] == 0
+    assert s["gauges"]["kv_pages_in_use"] == 0
+    assert ok.outcome == "result" and shed.outcome == "shed"
+
+
+def test_analyzer_serve_report(tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    obs.reset()
+    eng, _ = make_engine()
+    eng.warmup()
+    for i in range(3):
+        eng.submit(context_tokens=16, seed=i)
+    eng.submit(context_tokens=16, deadline_ms=0.0)
+    eng.run()
+    p = tmp_path / "serve.jsonl"
+    obs.write_jsonl(str(p))
+    from tilelang_mesh_tpu.tools.analyzer import (format_serve_report,
+                                                  summarize_serve)
+    recs = obs.read_jsonl(str(p))
+    s = summarize_serve(recs)
+    assert s["admitted"] == 3 and s["completed"] == 3
+    assert s["shed"] == {"deadline_infeasible": 1}
+    assert s["kv"]["balance"] == 0
+    text = format_serve_report(recs)
+    assert "admitted" in text and "kv pages alloc/free" in text
+    assert "serve.step.latency" in text
+
+
+def test_serving_state_gauges_live():
+    eng, _ = make_engine()
+    eng.warmup()
+    eng.submit(context_tokens=16, seed=1)
+    assert serving_state()["queue_depth"] == 1
+    eng.run()
+    assert serving_state()["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the contract: seeded 500-request chaos soak
+# ---------------------------------------------------------------------------
+
+def test_chaos_soak_500_requests_all_terminal(tmp_path, monkeypatch):
+    """The ISSUE 8 acceptance gate, run in-process: 500 seeded requests
+    with a deadline mix, serve.* faults armed, the device killed once
+    mid-batch, and a drain wave — every request must reach a terminal
+    outcome, KV slabs must balance to zero, and the shed/deadline
+    accounting must match the histograms. Shares the exact driver CI
+    runs (``verify/chaos.py --serve``)."""
+    obs.reset()
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    from tilelang_mesh_tpu.verify.chaos import run_serve
+    rc = run_serve(tmp_path, seed=7, n_requests=500)
+    assert rc == 0
+    import json
+    report = json.loads((tmp_path / "serve_report.json").read_text())
+    assert all(report["checks"].values())
+    assert report["outcomes"]["pending"] == 0
+    total = sum(v for k, v in report["outcomes"].items()
+                if k != "pending")
+    assert total == report["requests"] + 12    # + the stall wave
+    assert report["kv"]["in_use"] == 0
+    assert report["kv"]["alloc_count"] == report["kv"]["free_count"]
+    assert (tmp_path / "serve_trace.jsonl").exists()

@@ -76,6 +76,10 @@ from .utils.target import determine_target, TPU_TARGET_DESC  # noqa: E402
 # mesh extension
 from . import parallel  # noqa: E402
 
+# serving engine (continuous batching + admission control + graceful
+# degradation; docs/serving.md)
+from . import serving  # noqa: E402
+
 __all__ = [
     "language", "jit", "lazy_jit", "compile", "par_compile", "lower",
     "JITKernel", "CompiledArtifact", "KernelParam", "cached", "clear_cache",
@@ -83,5 +87,5 @@ __all__ = [
     "Profiler", "do_bench", "TensorSupplyType", "autotune", "AutoTuner",
     "PassConfigKey", "determine_target", "TPU_TARGET_DESC", "parallel",
     "observability", "metrics_summary", "resilience", "verify",
-    "env", "logger", "set_log_level", "__version__",
+    "serving", "env", "logger", "set_log_level", "__version__",
 ]

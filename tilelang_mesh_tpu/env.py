@@ -112,6 +112,26 @@ class Environment:
     # closure, single-tuple shape/dtype fingerprint, cached flag reads.
     # "0" restores the legacy per-call marshalling loop.
     TL_TPU_FAST_DISPATCH = EnvVar("TL_TPU_FAST_DISPATCH", True, bool)
+    # serving engine (serving/; docs/serving.md) — continuous batching
+    # with admission control. Queue-depth bound checked at admit:
+    TL_TPU_SERVE_MAX_QUEUE = EnvVar("TL_TPU_SERVE_MAX_QUEUE", 256, int)
+    # batch-size ceiling (clamped to the workload's batch buckets)
+    TL_TPU_SERVE_MAX_BATCH = EnvVar("TL_TPU_SERVE_MAX_BATCH", 8, int)
+    # overload shedding: reject new admits while the observed serve.step
+    # p99 exceeds this budget (0 = no p99-based shedding)
+    TL_TPU_SERVE_P99_BUDGET_MS = EnvVar("TL_TPU_SERVE_P99_BUDGET_MS",
+                                        0.0, float)
+    # grace window past a request deadline before the scheduler expires
+    # it (also the slack the zero-hang guarantee is measured against)
+    TL_TPU_SERVE_GRACE_MS = EnvVar("TL_TPU_SERVE_GRACE_MS", 50.0, float)
+    # wall-clock bound on one batch step (0 = unbounded unless the batch
+    # carries deadlines — the tightest remaining deadline always caps a
+    # deadlined batch's step budget)
+    TL_TPU_SERVE_STEP_TIMEOUT_MS = EnvVar("TL_TPU_SERVE_STEP_TIMEOUT_MS",
+                                          0.0, float)
+    # per-request retry ceiling for transient/timeout step failures
+    # (deadline headroom is checked independently on every retry)
+    TL_TPU_SERVE_RETRY_MAX = EnvVar("TL_TPU_SERVE_RETRY_MAX", 2, int)
     # buffer donation for inout params: warm calls whose inout inputs
     # are jax arrays dispatch through jax.jit(donate_argnums=...), so
     # XLA may reuse the input buffer for the aliased output (the caller

@@ -181,6 +181,28 @@ def _backend_states() -> dict:
         return {}
 
 
+def shed_reason_from_counter(name: str) -> Optional[str]:
+    """The shed reason of a rendered ``serve.shed{reason=...}`` counter
+    name (None when ``name`` is not a shed counter; ``(unlabelled)``
+    for a bare ``serve.shed``). The ONE parser both the live
+    ``metrics_summary`` and the analyzer's JSONL replay use."""
+    if name == "serve.shed":
+        return "(unlabelled)"
+    if name.startswith("serve.shed{"):
+        return name[len("serve.shed{"):-1].split("=", 1)[-1]
+    return None
+
+
+def _serving_gauges() -> dict:
+    """Live serving gauges (queue depth, KV slab levels) — lazy import
+    for the same layering reason as ``_backend_states``."""
+    try:
+        from ..serving.request import gauges
+        return gauges()
+    except Exception:
+        return {}
+
+
 def _rate(hit: float, miss: float) -> Optional[float]:
     total = hit + miss
     return round(hit / total, 4) if total else None
@@ -286,9 +308,45 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "watchdog_timeouts": c("verify.watchdog.timeouts"),
         "degraded_schedules": c("verify.degraded_schedules"),
     }
+    # serving engine accounting (serving/; docs/serving.md): monotonic
+    # outcome counters + shed-reason breakdown from the tracer, latency
+    # digests from the shared histograms, live gauges from the engines
+    def _sheds_by_reason() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for k, v in counters.items():
+            reason = shed_reason_from_counter(k)
+            if reason is not None:
+                out[reason] = out.get(reason, 0) + v
+        return out
+
+    def _hist_digest(name: str, **labels) -> Optional[dict]:
+        return _hist.digest_ms(_hist.get_histogram(name, **labels))
+
+    sheds = _sheds_by_reason()
+    serving = {
+        "admitted": c("serve.admitted"),
+        "completed": c("serve.completed"),
+        "failed": c("serve.failed"),
+        "deadline_exceeded": c("serve.deadline_exceeded"),
+        "shed": sheds,
+        "shed_total": sum(sheds.values()),
+        "batches": c("serve.batches"),
+        "steps": labelled_total("serve.steps"),
+        "retries": c("serve.retries"),
+        "failovers": c("serve.failover"),
+        "warmup_kernels": c("serve.warmup.kernels"),
+        "kv_pages_allocated": c("serve.kv.alloc_pages"),
+        "kv_pages_freed": c("serve.kv.free_pages"),
+        "step_latency": _hist_digest("kernel.latency",
+                                     kernel="serve.step",
+                                     source="serving"),
+        "queue_wait": _hist_digest("serve.queue.wait"),
+        "gauges": _serving_gauges(),
+    }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
-            "verify": verify, "runtime": _runtime.runtime_summary()}
+            "verify": verify, "serving": serving,
+            "runtime": _runtime.runtime_summary()}
 
 
 def _json_safe(obj: Any):

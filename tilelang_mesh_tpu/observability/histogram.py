@@ -21,7 +21,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Histogram", "HistogramRegistry", "default_bounds",
            "get_registry", "observe", "get_histogram", "histograms",
-           "reset"]
+           "reset", "digest_ms"]
 
 # Default latency bounds in SECONDS: factor-2 log spacing from 1us to
 # ~67s (27 finite buckets + overflow). Wide enough for a sub-ms Pallas
@@ -184,6 +184,19 @@ class Histogram:
             return "Histogram(empty)"
         return (f"Histogram(n={self.count}, p50={self.quantile(0.5):.3e}, "
                 f"p99={self.quantile(0.99):.3e}, max={self.max:.3e})")
+
+
+def digest_ms(h: Optional["Histogram"]) -> Optional[dict]:
+    """The canonical {count, p50_ms, p99_ms, max_ms} digest of a
+    seconds-valued histogram — shared by ``metrics_summary()`` and the
+    analyzer's trace-replay path so the two can never round or shape
+    the same series differently. None for empty/missing series."""
+    if h is None or h.count == 0:
+        return None
+    return {"count": h.count,
+            "p50_ms": round((h.quantile(0.5) or 0) * 1e3, 4),
+            "p99_ms": round((h.quantile(0.99) or 0) * 1e3, 4),
+            "max_ms": round(h.max * 1e3, 4)}
 
 
 LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]

@@ -71,6 +71,9 @@ FAULT_SITES = (
     "comm.fused",
     "device.probe",
     "device.dispatch",
+    "serve.admit",
+    "serve.step",
+    "serve.kv",
 )
 
 _KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt",

@@ -1,0 +1,39 @@
+"""Serving subsystem: continuous batching over the decode op library.
+
+Public surface (docs/serving.md)::
+
+    from tilelang_mesh_tpu.serving import (
+        ServingEngine, FlashDecodeWorkload, MLADecodeWorkload,
+        PagedKVAllocator, AdmissionController, Request)
+
+    alloc = PagedKVAllocator(n_pages=64, page_size=8, heads=2, head_dim=64)
+    eng = ServingEngine(FlashDecodeWorkload(alloc, batch_buckets=(4,),
+                                            page_buckets=(2, 4)))
+    eng.warmup()                       # AOT: no first-request JIT latency
+    r = eng.submit(context_tokens=16, new_tokens=2, deadline_ms=500)
+    eng.run()                          # every request reaches a terminal
+    assert r.outcome in ("result", "shed", "deadline_exceeded", "failed")
+
+``serving_state()`` is the live-gauge snapshot
+``metrics_summary()["serving"]`` embeds (queue depth, KV slab levels);
+monotonic accounting rides the ``serve.*`` tracer counters.
+"""
+
+from .admission import (AdmissionController, SERVE_BREAKER_SIG,  # noqa: F401
+                        STEP_HIST_KERNEL)
+from .batcher import (DecodeWorkload, FlashDecodeWorkload,  # noqa: F401
+                      MLADecodeWorkload)
+from .engine import ServingEngine  # noqa: F401
+from .kv_cache import KVCacheExhausted, PagedKVAllocator  # noqa: F401
+from .request import (OUTCOMES, Request, SHED_REASONS, STATES,  # noqa: F401
+                      gauges as serving_state, reset_gauges)
+from .shard import ServeShardConfig, match_partition_rules  # noqa: F401
+
+__all__ = [
+    "ServingEngine", "DecodeWorkload", "FlashDecodeWorkload",
+    "MLADecodeWorkload", "PagedKVAllocator", "KVCacheExhausted",
+    "AdmissionController", "Request", "STATES", "OUTCOMES",
+    "SHED_REASONS", "SERVE_BREAKER_SIG", "STEP_HIST_KERNEL",
+    "ServeShardConfig", "match_partition_rules", "serving_state",
+    "reset_gauges",
+]

@@ -1,0 +1,305 @@
+"""Shape-bucketed continuous batching over the decode op library.
+
+XLA wants static shapes, so the batcher quantizes every dispatch onto a
+small grid of precompiled kernels:
+
+- **batch buckets** — a batch of ``n`` live requests pads up to the
+  smallest configured bucket ``B >= n`` (padding rows replicate the
+  last request's page table; their outputs are discarded), so one
+  kernel per bucket serves every batch size;
+- **page buckets** — requests are grouped by their *attention window*
+  in whole pages (``(context + generated) // page_size``); a window
+  larger than the biggest configured bucket attends over the most
+  recent ``max_bucket`` pages (a sliding suffix window). Ragged batches
+  never share a kernel with the wrong sequence length — the page count
+  IS the bucket key.
+
+Two workload families over the ops library (the serving consumers of
+``ops/flash_decoding.py`` and ``ops/mla.py``):
+
+- :class:`FlashDecodeWorkload` — in-kernel page walking
+  (``flash_decode_paged_pool``) over the allocator's H-major pools; no
+  gather pass touches the KV data.
+- :class:`MLADecodeWorkload` — latent-attention decode: pages hold
+  ``[ckv | kpe]`` rows, gathered to contiguous form at the host level
+  (the gather strategy) and fed to ``mla_decode``.
+
+``warmup()`` runs every (batch, pages) bucket once through the
+crash-safe kernel cache AND through a real dispatch, so the first
+serving request never pays trace/compile latency (the AOT warm store
+from ROADMAP item 1).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import tracer as _trace
+from .kv_cache import PagedKVAllocator
+from .request import Request
+
+__all__ = ["DecodeWorkload", "FlashDecodeWorkload", "MLADecodeWorkload"]
+
+BucketKey = Tuple[int, int]          # (batch bucket, window pages)
+
+
+class DecodeWorkload:
+    """Common bucketing/warm-up logic; subclasses supply the kernel."""
+
+    def __init__(self, allocator: PagedKVAllocator,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8),
+                 page_buckets: Sequence[int] = (2, 4)):
+        if not batch_buckets or not page_buckets:
+            raise ValueError("batch_buckets and page_buckets must be "
+                             "non-empty")
+        self.allocator = allocator
+        self.batch_buckets = tuple(sorted(set(int(b)
+                                              for b in batch_buckets)))
+        self.page_buckets = tuple(sorted(set(int(p)
+                                             for p in page_buckets)))
+        if self.page_buckets[0] < 1:
+            raise ValueError("page buckets must be >= 1")
+        self._warm: set = set()
+
+    # -- bucketing -----------------------------------------------------
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    def batch_bucket(self, n: int) -> int:
+        """Smallest configured batch bucket holding ``n`` requests."""
+        for b in self.batch_buckets:
+            if b >= n:
+                return b
+        return self.batch_buckets[-1]
+
+    def window_pages(self, req: Request) -> int:
+        """The request's attention window in whole pages, clamped onto
+        the configured page buckets (sliding suffix window above the
+        top bucket; the smallest bucket below the bottom one)."""
+        total = req.context_tokens + req.steps_done
+        full = total // self.allocator.page_size
+        for p in reversed(self.page_buckets):
+            if full >= p:
+                return p
+        return self.page_buckets[0]
+
+    def bucket_of(self, req: Request) -> int:
+        return self.window_pages(req)
+
+    def pages_needed(self, context_tokens: int,
+                     new_tokens: int) -> int:
+        """Worst-case page footprint of a request (context + every
+        generated token) — what admission checks against capacity."""
+        ps = self.allocator.page_size
+        return math.ceil((context_tokens + new_tokens) / ps)
+
+    # -- request ingestion / growth ------------------------------------
+    def ingest(self, req: Request) -> None:
+        """Allocate + fill the request's context pages (deterministic
+        content from ``req.seed`` unless the payload carries arrays)."""
+        ps = self.allocator.page_size
+        if req.context_tokens < self.page_buckets[0] * ps:
+            raise ValueError(
+                f"request #{req.req_id}: context_tokens="
+                f"{req.context_tokens} is below the smallest page "
+                f"bucket ({self.page_buckets[0]} page(s) x {ps})")
+        n = math.ceil(req.context_tokens / ps)
+        pages = self.allocator.alloc(n, req.req_id)
+        req.pages = pages
+        req.tail_tokens = req.context_tokens % ps
+        rng = np.random.default_rng(req.seed)
+        for i, page in enumerate(pages):
+            k, v = self._context_page(req, rng, i)
+            self.allocator.fill_page(page, k, v)
+
+    def append_token(self, req: Request) -> None:
+        """Append the just-generated token's KV in place; allocates a
+        fresh page exactly when the tail page is full (the mid-flight
+        ``serve.kv`` visit the chaos soak arms)."""
+        ps = self.allocator.page_size
+        if req.tail_tokens == 0:
+            req.pages.extend(self.allocator.alloc(1, req.req_id))
+        page = req.pages[-1]
+        k, v = self._token_kv(req)
+        self.allocator.write_token(page, req.tail_tokens, k, v)
+        req.tail_tokens = (req.tail_tokens + 1) % ps
+
+    def retire(self, req: Request) -> int:
+        """Release every slab the request holds (called on ANY terminal
+        transition of an ingested request)."""
+        freed = self.allocator.free(req.req_id)
+        req.pages = []
+        req.tail_tokens = 0
+        return freed
+
+    # -- dispatch ------------------------------------------------------
+    def run_batch(self, requests: List[Request]) -> List[np.ndarray]:
+        """One decode step for every request (all in one page bucket):
+        pad to the batch bucket, dispatch the precompiled kernel, slice
+        per-request outputs."""
+        if not requests:
+            return []
+        pp = self.bucket_of(requests[0])
+        if any(self.bucket_of(r) != pp for r in requests):
+            raise ValueError("batch mixes page buckets (scheduler bug)")
+        bb = self.batch_bucket(len(requests))
+        table = np.zeros((bb, pp), np.int32)
+        for i in range(bb):
+            r = requests[min(i, len(requests) - 1)]   # pad = replicate
+            # suffix window: the most recent pp FULL pages
+            total = r.context_tokens + r.steps_done
+            full = total // self.allocator.page_size
+            full_pages = r.pages[:full]
+            table[i, :] = full_pages[-pp:]
+        q = np.stack([self._query(requests[min(i, len(requests) - 1)])
+                      for i in range(bb)])
+        out = self._dispatch(q, table, bb, pp)
+        out = np.asarray(out)
+        return [out[i] for i in range(len(requests))]
+
+    # -- AOT warm-up ---------------------------------------------------
+    def warmup(self) -> int:
+        """Compile AND dispatch every (batch, pages) bucket kernel once,
+        routed through the crash-safe kernel cache, so no serving
+        request ever pays first-call trace/compile latency. Returns the
+        number of bucket kernels warmed."""
+        n = 0
+        for bb in self.batch_buckets:
+            for pp in self.page_buckets:
+                if (bb, pp) in self._warm:
+                    continue
+                with _trace.span("serve.warmup", "serving", batch=bb,
+                                 pages=pp, workload=type(self).__name__):
+                    q = np.zeros(self._query_shape(bb), np.float32)
+                    table = np.zeros((bb, pp), np.int32)
+                    self._dispatch(q, table, bb, pp)
+                self._warm.add((bb, pp))
+                _trace.inc("serve.warmup.kernels")
+                n += 1
+        return n
+
+    def forget_kernels(self) -> None:
+        """Drop warm-state after a backend failover: the next dispatch
+        re-walks the backend chain on the rebuilt kernels."""
+        self._warm.clear()
+
+    # -- subclass surface ----------------------------------------------
+    def _query_shape(self, bb: int) -> tuple:
+        raise NotImplementedError
+
+    def _query(self, req: Request) -> np.ndarray:
+        raise NotImplementedError
+
+    def _context_page(self, req: Request, rng, index: int):
+        raise NotImplementedError
+
+    def _token_kv(self, req: Request):
+        raise NotImplementedError
+
+    def _dispatch(self, q, table, bb: int, pp: int):
+        raise NotImplementedError
+
+
+class FlashDecodeWorkload(DecodeWorkload):
+    """Single-token attention over the paged pool, walked in-kernel
+    (``flash_decode_paged_pool``: table-driven DMA offsets, no gather
+    pass)."""
+
+    def __init__(self, allocator: PagedKVAllocator, *,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8),
+                 page_buckets: Sequence[int] = (2, 4),
+                 sm_scale: float = None):
+        super().__init__(allocator, batch_buckets, page_buckets)
+        self.sm_scale = (sm_scale if sm_scale is not None
+                         else 1.0 / math.sqrt(allocator.head_dim))
+
+    def _query_shape(self, bb: int) -> tuple:
+        return (bb, self.allocator.heads, 1, self.allocator.head_dim)
+
+    def _query(self, req: Request) -> np.ndarray:
+        rng = np.random.default_rng((req.seed, 1, req.steps_done))
+        return rng.standard_normal(
+            (self.allocator.heads, 1, self.allocator.head_dim)
+        ).astype(np.float32)
+
+    def _context_page(self, req: Request, rng, index: int):
+        shape = (self.allocator.heads, self.allocator.page_size,
+                 self.allocator.head_dim)
+        return (rng.standard_normal(shape).astype(np.float32),
+                rng.standard_normal(shape).astype(np.float32))
+
+    def _token_kv(self, req: Request):
+        rng = np.random.default_rng((req.seed, 2, req.steps_done))
+        shape = (self.allocator.heads, self.allocator.head_dim)
+        return (rng.standard_normal(shape).astype(np.float32),
+                rng.standard_normal(shape).astype(np.float32))
+
+    def _dispatch(self, q, table, bb: int, pp: int):
+        from ..ops.flash_decoding import flash_decode_paged_pool
+        return flash_decode_paged_pool(
+            q, self.allocator.kp, self.allocator.vp, table,
+            self.allocator.page_size, sm_scale=self.sm_scale)
+
+
+class MLADecodeWorkload(DecodeWorkload):
+    """DeepSeek-MLA decode over paged latent rows: each pool row holds
+    ``[ckv (dc) | kpe (dr)]`` for one token (one shared latent cache
+    for all heads — ``heads`` here is the query-head count the kernel
+    scores per tile). Pages gather to contiguous ``(B, S, dc)/(B, S,
+    dr)`` on the host (the gather strategy of the paged-decode pair),
+    then ``mla_decode`` runs the split-KV latent kernel."""
+
+    def __init__(self, allocator: PagedKVAllocator, *, heads: int,
+                 latent_dim: int, rope_dim: int,
+                 batch_buckets: Sequence[int] = (1, 2, 4),
+                 page_buckets: Sequence[int] = (2, 4),
+                 sm_scale: float = None):
+        if allocator.heads != 1 or \
+                allocator.head_dim != latent_dim + rope_dim:
+            raise ValueError(
+                "MLA pools are latent-major: construct the allocator "
+                "with heads=1, head_dim=latent_dim+rope_dim")
+        super().__init__(allocator, batch_buckets, page_buckets)
+        self.heads = int(heads)
+        self.dc = int(latent_dim)
+        self.dr = int(rope_dim)
+        self.sm_scale = (sm_scale if sm_scale is not None
+                         else 1.0 / math.sqrt(self.dc + self.dr))
+
+    def _query_shape(self, bb: int) -> tuple:
+        return (bb, self.heads, self.dc + self.dr)
+
+    def _query(self, req: Request) -> np.ndarray:
+        rng = np.random.default_rng((req.seed, 1, req.steps_done))
+        return rng.standard_normal(
+            (self.heads, self.dc + self.dr)).astype(np.float32)
+
+    def _context_page(self, req: Request, rng, index: int):
+        shape = (1, self.allocator.page_size, self.dc + self.dr)
+        row = rng.standard_normal(shape).astype(np.float32)
+        return row, np.zeros(shape, np.float32)    # vp unused for MLA
+
+    def _token_kv(self, req: Request):
+        rng = np.random.default_rng((req.seed, 2, req.steps_done))
+        shape = (1, self.dc + self.dr)
+        return (rng.standard_normal(shape).astype(np.float32),
+                np.zeros(shape, np.float32))
+
+    def _dispatch(self, q, table, bb: int, pp: int):
+        from ..ops.mla import mla_decode
+        ps = self.allocator.page_size
+        # host-level gather: rows (pages) -> contiguous (B, S, dc+dr)
+        rows = self.allocator.kp[0]                     # (rows, dc+dr)
+        idx = (np.asarray(table)[:, :, None] * ps
+               + np.arange(ps)[None, None, :]).reshape(bb, pp * ps)
+        seq = rows[idx]                                 # (B, S, dc+dr)
+        q = np.asarray(q)
+        return mla_decode(q[:, :, :self.dc].copy(),
+                          q[:, :, self.dc:].copy(),
+                          seq[:, :, :self.dc].copy(),
+                          seq[:, :, self.dc:].copy(),
+                          sm_scale=self.sm_scale)

@@ -1,0 +1,490 @@
+"""ServingEngine: continuous batching with a failure-handling contract.
+
+The engine is the first end-to-end consumer of the whole stack: the op
+library supplies the decode kernels (via :mod:`.batcher`), the
+crash-safe cache warms them ahead of traffic, admission control leans
+on the PR 2 circuit breaker and the PR 3 latency histograms, and the
+PR 6 backend registry absorbs device loss mid-batch. Its contract —
+the product of this module — is:
+
+1. **Every submitted request reaches a terminal outcome** (``result`` /
+   ``shed`` / ``deadline_exceeded`` / ``failed``): no silent drops, no
+   unbounded waits. Retry budgets are bounded, device-loss re-admission
+   is bounded, and expiry sweeps run before every batch.
+2. **Deadlines propagate.** A request's deadline caps admission
+   feasibility, its retry budget, and the batch step watchdog: a batch
+   carrying deadlines is dispatched under a wall-clock bound of the
+   tightest remaining deadline plus grace (the serving analog of the
+   PR 5 ``TL_TPU_COMM_TIMEOUT_MS`` collective watchdog, which still
+   guards the collectives *inside* a mesh-backed step independently).
+3. **Graceful degradation.** A batch that dies with a device-loss
+   error is quarantined: the serving backend is marked unhealthy in
+   the registry (feeding the shared breaker), kernel caches are
+   dropped so rebuilds re-walk the ``TL_TPU_BACKENDS`` chain, and
+   unexpired requests are re-admitted onto the new tier. ``drain()``
+   finishes in-flight work while shedding new arrivals.
+
+Fault sites: ``serve.admit`` (admission bookkeeping), ``serve.step``
+(one batch dispatch), ``serve.kv`` (slab allocation — lives in
+:mod:`.kv_cache`). ``verify/chaos.py --serve`` soaks the whole
+contract deterministically on CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ..env import env
+from ..observability import histogram as _hist
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import TLError, classify, error_signature
+from ..resilience.retry import global_breaker
+from .admission import (STEP_HIST_KERNEL, SERVE_BREAKER_SIG,
+                        AdmissionController)
+from .batcher import DecodeWorkload
+from .kv_cache import KVCacheExhausted
+from .request import Request, publish_gauges
+
+__all__ = ["ServingEngine"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.serving")
+
+
+def _bounded_step(fn, budget_s: float, what: str):
+    """Dispatch under a wall-clock bound on an abandoned-on-expiry
+    daemon thread (a dead device HANGS the call; only abandonment keeps
+    the scheduler moving — same idiom as the PR 5 collective watchdog).
+    A result that lands late is still returned: per-request expiry
+    decides who missed their deadline, so good work is never thrown
+    away wholesale."""
+    import queue
+    import threading
+    qq: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _t():
+        try:
+            qq.put((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            qq.put((False, e))
+
+    t = threading.Thread(target=_t, daemon=True,
+                         name=f"tl-serve-step-{int(budget_s * 1e3)}ms")
+    t.start()
+    try:
+        ok, val = qq.get(timeout=max(budget_s, 1e-3))
+    except queue.Empty:
+        from ..resilience.errors import TLTimeoutError
+        raise TLTimeoutError(
+            f"{what} exceeded its step budget ({budget_s * 1e3:.0f}ms); "
+            f"worker {t.name} abandoned", site="serve.step") from None
+    if not ok:
+        raise val
+    return val
+
+
+class ServingEngine:
+    """Synchronous continuous-batching scheduler (deterministic by
+    construction: drive it with ``step()``/``run()``; a thread pumping
+    ``run()`` makes it a background server)."""
+
+    def __init__(self, workload: DecodeWorkload, *,
+                 admission: Optional[AdmissionController] = None,
+                 max_batch: Optional[int] = None,
+                 grace_ms: Optional[float] = None,
+                 step_timeout_ms: Optional[float] = None,
+                 retry_max: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 name: str = "serve"):
+        self.workload = workload
+        self.admission = admission or AdmissionController()
+        self.max_batch = min(
+            max_batch if max_batch is not None else env.TL_TPU_SERVE_MAX_BATCH,
+            workload.max_batch)
+        self.grace_ms = (grace_ms if grace_ms is not None
+                         else env.TL_TPU_SERVE_GRACE_MS)
+        self.step_timeout_ms = (step_timeout_ms if step_timeout_ms is not None
+                                else env.TL_TPU_SERVE_STEP_TIMEOUT_MS)
+        self.retry_max = (retry_max if retry_max is not None
+                          else env.TL_TPU_SERVE_RETRY_MAX)
+        self.default_deadline_ms = default_deadline_ms
+        self.name = name
+        self.requests: List[Request] = []    # every submission, in order
+        self._queue: List[Request] = []      # admitted, awaiting a batch
+        self._draining = False
+        self._steps = 0
+        self._failovers = 0
+        self._warmed = False
+
+    # -- submission / admission ----------------------------------------
+    def submit(self, context_tokens: int, new_tokens: int = 1,
+               deadline_ms: Optional[float] = None, seed: int = 0,
+               payload: Optional[dict] = None) -> Request:
+        """Admit or shed one request; ALWAYS returns the request with a
+        state transition recorded (shed requests come back terminal)."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        req = Request(context_tokens, new_tokens, deadline_ms=deadline_ms,
+                      seed=seed, payload=payload)
+        self.requests.append(req)
+        try:
+            _faults.maybe_fail("serve.admit", req=req.req_id)
+        except Exception as e:  # noqa: BLE001 — admission must not crash
+            return self._shed(req, "admit_fault",
+                              error=f"{type(e).__name__}: {e}")
+        ok, reason = self.admission.decide(
+            draining=self._draining,
+            queue_depth=len(self._queue),
+            free_pages=self.workload.allocator.free_pages,
+            pages_needed=self.workload.pages_needed(context_tokens,
+                                                    new_tokens),
+            remaining_s=req.remaining_s(),
+            steps_requested=new_tokens)
+        if not ok:
+            return self._shed(req, reason)
+        try:
+            self.workload.ingest(req)
+        except ValueError:
+            # misconfigured request: a caller bug, not load — it was
+            # never accepted, so it must not linger non-terminal in
+            # self.requests (the all-terminal contract audits that list)
+            self.requests.remove(req)
+            raise
+        except (TLError, OSError) as e:
+            # injected serve.kv fault or organic allocation failure
+            # during context ingestion: terminal shed, never a crash
+            return self._shed(req, "kv_exhausted",
+                              error=f"{type(e).__name__}: {e}")
+        req.admit()
+        self._queue.append(req)
+        _trace.inc("serve.admitted")
+        self._gauges()
+        return req
+
+    def _shed(self, req: Request, reason: str,
+              error: Optional[str] = None) -> Request:
+        req.finish("shed", shed_reason=reason, error=error)
+        self._retire_slabs(req)
+        _trace.inc("serve.shed", reason=reason)
+        _trace.event("serve.shed", "serving", req=req.req_id,
+                     reason=reason, error=error)
+        self._observe_e2e(req)
+        return req
+
+    # -- warm-up -------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile + dispatch every bucket kernel through the
+        crash-safe cache BEFORE traffic, and seed the step-latency
+        histogram admission reads its estimates from."""
+        with _trace.span("serve.warmup_all", "serving", engine=self.name):
+            t0 = time.perf_counter()
+            n = self.workload.warmup()
+            if n:
+                # warm dispatches are compile-dominated; seed the step
+                # estimate with one extra measured warm dispatch instead
+                per = self._measured_warm_step()
+                logger.info("serving engine %s: warmed %d bucket "
+                            "kernel(s) in %.2fs (warm step ~%.2fms)",
+                            self.name, n, time.perf_counter() - t0,
+                            per * 1e3)
+        self._warmed = True
+        return n
+
+    def _measured_warm_step(self) -> float:
+        """One post-compile dispatch per smallest bucket, timed, so the
+        admission estimates start from a WARM step latency (folding
+        compile time in would shed every deadlined request at startup)."""
+        import numpy as np
+        bb = self.workload.batch_buckets[0]
+        pp = self.workload.page_buckets[0]
+        q = np.zeros(self.workload._query_shape(bb), np.float32)
+        table = np.zeros((bb, pp), np.int32)
+        t0 = time.perf_counter()
+        self.workload._dispatch(q, table, bb, pp)
+        dt = time.perf_counter() - t0
+        _hist.observe("kernel.latency", dt, kernel=STEP_HIST_KERNEL,
+                      source="serving")
+        return dt
+
+    # -- scheduling ----------------------------------------------------
+    def _expire_queue(self, now: Optional[float] = None) -> int:
+        grace_s = self.grace_ms / 1e3
+        expired = [r for r in self._queue if r.expired(grace_s, now)]
+        for r in expired:
+            self._queue.remove(r)
+            self._finish(r, "deadline_exceeded")
+        return len(expired)
+
+    def _form_batch(self) -> List[Request]:
+        """FIFO head defines the page bucket; same-bucket followers fill
+        the batch up to ``max_batch`` (order preserved — no starvation:
+        the head is always served)."""
+        if not self._queue:
+            return []
+        head_bucket = self.workload.bucket_of(self._queue[0])
+        batch = []
+        for r in self._queue:
+            if self.workload.bucket_of(r) == head_bucket:
+                batch.append(r)
+                if len(batch) >= self.max_batch:
+                    break
+        for r in batch:
+            self._queue.remove(r)
+            r.batch()
+        return batch
+
+    def _step_budget_s(self, batch: List[Request]) -> Optional[float]:
+        """Deadline propagation into the step watchdog: the tightest
+        remaining deadline (plus grace) caps the dispatch, as does the
+        static ``TL_TPU_SERVE_STEP_TIMEOUT_MS`` when set."""
+        budgets = []
+        if self.step_timeout_ms > 0:
+            budgets.append(self.step_timeout_ms / 1e3)
+        rem = [r.remaining_s() for r in batch
+               if r.remaining_s() is not None]
+        if rem:
+            budgets.append(max(min(rem), 0.0) + self.grace_ms / 1e3)
+        return min(budgets) if budgets else None
+
+    def step(self) -> bool:
+        """Run one batch step; False when the queue is idle."""
+        self._expire_queue()
+        batch = self._form_batch()
+        if not batch:
+            self._gauges()
+            return False
+        now = time.monotonic()
+        for r in batch:
+            if r.first_batch_t is not None and len(r.timeline) <= 3:
+                _hist.observe("serve.queue.wait", now - r.submit_t)
+        budget = self._step_budget_s(batch)
+        t0 = time.perf_counter()
+        try:
+            _faults.maybe_fail("serve.step", batch=len(batch))
+            if budget is not None:
+                outs = _bounded_step(
+                    lambda: self.workload.run_batch(batch), budget,
+                    f"{self.name} batch of {len(batch)}")
+            else:
+                outs = self.workload.run_batch(batch)
+        except Exception as e:  # noqa: BLE001 — classified below
+            self._on_step_failure(batch, e)
+            self._gauges()
+            return True
+        dt = time.perf_counter() - t0
+        self._steps += 1
+        _trace.inc("serve.batches")
+        _trace.inc("serve.steps", len(batch))
+        _hist.observe("kernel.latency", dt, kernel=STEP_HIST_KERNEL,
+                      source="serving")
+        self._retire_or_requeue(batch, outs)
+        self._gauges()
+        return True
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Pump ``step()`` until idle; returns steps executed. The
+        default bound is generous but FINITE — the no-unbounded-waits
+        contract holds even against a scheduler bug."""
+        if max_steps is None:
+            total = sum(r.new_tokens for r in self.requests) or 1
+            max_steps = 20 * total + 100
+        n = 0
+        while n < max_steps:
+            if not self.step():
+                return n
+            n += 1
+        # the bound tripping means requests would otherwise wait forever:
+        # retire everything still queued as failed, honoring the contract
+        for r in list(self._queue):
+            self._queue.remove(r)
+            self._finish(r, "failed",
+                         error=f"scheduler exceeded {max_steps} steps")
+        logger.error("serving engine %s: scheduler bound (%d steps) hit; "
+                     "queue force-retired", self.name, max_steps)
+        self._gauges()
+        return n
+
+    def drain(self) -> None:
+        """Stop admitting; ``run()`` finishes the in-flight work."""
+        self._draining = True
+        _trace.event("serve.drain", "serving", engine=self.name,
+                     queued=len(self._queue))
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    # -- retirement ----------------------------------------------------
+    def _retire_or_requeue(self, batch: List[Request], outs) -> None:
+        for r, out in zip(batch, outs):
+            r.steps_done += 1
+            r.result = out
+            if r.steps_done >= r.new_tokens:
+                self._finish(r, "result")
+                continue
+            try:
+                self.workload.append_token(r)
+            except (KVCacheExhausted, TLError, OSError) as e:
+                # mid-flight KV pressure (or an injected serve.kv
+                # fault): the request cannot grow its context — shed
+                # it terminally rather than serve corrupt attention
+                self._finish(r, "shed", shed_reason="kv_exhausted",
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            r.requeue()
+            self._queue.append(r)
+
+    def _finish(self, req: Request, outcome: str, *,
+                shed_reason: Optional[str] = None,
+                error: Optional[str] = None) -> None:
+        req.finish(outcome, shed_reason=shed_reason, error=error)
+        self._retire_slabs(req)
+        if outcome == "result":
+            _trace.inc("serve.completed")
+        elif outcome == "deadline_exceeded":
+            _trace.inc("serve.deadline_exceeded")
+            _trace.event("serve.deadline_exceeded", "serving",
+                         req=req.req_id, steps_done=req.steps_done)
+        elif outcome == "failed":
+            _trace.inc("serve.failed")
+            _trace.event("serve.request_failed", "serving",
+                         req=req.req_id, error=error)
+        else:
+            _trace.inc("serve.shed", reason=shed_reason)
+            _trace.event("serve.shed", "serving", req=req.req_id,
+                         reason=shed_reason, error=error)
+        self._observe_e2e(req)
+
+    def _retire_slabs(self, req: Request) -> None:
+        """Leak-checked slab release on EVERY terminal transition."""
+        if req.pages:
+            self.workload.retire(req)
+
+    def _observe_e2e(self, req: Request) -> None:
+        if req.terminal_t is not None:
+            _hist.observe("serve.e2e.latency",
+                          req.terminal_t - req.submit_t,
+                          outcome=req.outcome)
+
+    # -- failure handling ----------------------------------------------
+    def _on_step_failure(self, batch: List[Request], exc: Exception) -> None:
+        kind = classify(exc)
+        _trace.inc("serve.step_failures", kind=kind)
+        _trace.event("serve.step_failure", "serving", kind=kind,
+                     batch=[r.req_id for r in batch],
+                     error=f"{type(exc).__name__}: {exc}")
+        if kind == "device_loss":
+            self._quarantine_and_failover(exc)
+        if kind == "deterministic":
+            # feed the shared breaker under both the per-error signature
+            # (the stack-wide convention) and the rolled-up serve.step
+            # signature admission checks
+            breaker = global_breaker()
+            breaker.record_failure(error_signature(exc))
+            breaker.record_failure(SERVE_BREAKER_SIG)
+            for r in batch:
+                self._finish(r, "failed",
+                             error=f"{type(exc).__name__}: {exc}")
+            return
+        # transient / timeout / device_loss: retry within budget
+        grace_s = self.grace_ms / 1e3
+        for r in batch:
+            if r.expired(grace_s):
+                self._finish(r, "deadline_exceeded")
+            elif r.retries < self.retry_max:
+                r.retries += 1
+                _trace.inc("serve.retries")
+                r.requeue()
+                # retries go to the queue FRONT: their deadline budget
+                # is already partly spent
+                self._queue.insert(0, r)
+            elif r.deadline is not None:
+                self._finish(r, "shed", shed_reason="retry_budget",
+                             error=f"{type(exc).__name__}: {exc}")
+            else:
+                self._finish(r, "failed",
+                             error=f"retry budget exhausted: "
+                                   f"{type(exc).__name__}: {exc}")
+
+    def _quarantine_and_failover(self, exc: Exception) -> None:
+        """Device loss mid-batch: mark the serving tier unhealthy in the
+        PR 6 registry, drop every kernel cache tier so rebuilds re-walk
+        the ``TL_TPU_BACKENDS`` chain, and count the failover. (The
+        kernel layer already failed over internally when its chain had
+        a healthy next entry; reaching here means the error surfaced to
+        the scheduler, so the batch is quarantined and its unexpired
+        requests re-admitted by the retry path.)"""
+        from ..codegen.backends import registry
+        self._failovers += 1
+        _trace.inc("serve.failover")
+        reg = registry()
+        chain = reg.chain()
+        used = self._backends_used()
+        cand = [b.name for b in chain if b.name in used]
+        # blame the tier actually serving: builds walk the chain
+        # head->tail picking the first healthy entry, so the serving
+        # tier is the first USED entry not already marked unhealthy —
+        # an earlier tier that died in a previous failover must not
+        # soak up the blame for a later tier's death
+        frm = next((n for n in cand
+                    if reg.health(n).healthy is not False),
+                   cand[-1] if cand else chain[0].name)
+        nxt = reg.next_healthy(chain, frm)
+        if nxt is not None:
+            reg.mark_unhealthy(frm, exc)
+            reg.note_failover(frm=frm, to=nxt.name,
+                              kernel=f"{self.name}.step",
+                              during="serving", error=exc)
+        logger.warning(
+            "serving engine %s: device loss mid-batch (%s: %s); "
+            "quarantining the batch and rebuilding kernels on the "
+            "next healthy tier", self.name, type(exc).__name__, exc)
+        # drop every tier that could pin the dead backend's callables
+        import tilelang_mesh_tpu as tilelang
+        tilelang.clear_cache()
+        from ..jit import clear_factory_caches
+        clear_factory_caches()
+        self.workload.forget_kernels()
+
+    @staticmethod
+    def _backends_used() -> set:
+        raw = _trace.get_tracer().counters_raw()
+        return {dict(labels).get("backend")
+                for (name, labels), _ in raw.items()
+                if name == "backend.build"} - {None}
+
+    # -- accounting ----------------------------------------------------
+    def _gauges(self) -> None:
+        alloc = self.workload.allocator
+        publish_gauges(queue_depth=len(self._queue),
+                       kv_pages_in_use=alloc.in_use,
+                       kv_pages_free=alloc.free_pages,
+                       draining=float(self._draining))
+
+    def outcomes(self) -> Dict[str, int]:
+        out = {"result": 0, "shed": 0, "deadline_exceeded": 0,
+               "failed": 0, "pending": 0}
+        for r in self.requests:
+            out[r.outcome or "pending"] += 1
+        return out
+
+    def stats(self) -> dict:
+        alloc = self.workload.allocator
+        return {
+            "engine": self.name,
+            "requests": len(self.requests),
+            "outcomes": self.outcomes(),
+            "queue_depth": len(self._queue),
+            "steps": self._steps,
+            "failovers": self._failovers,
+            "draining": self._draining,
+            "kv": alloc.stats(),
+            "kv_leaks": {str(k): v
+                         for k, v in alloc.leak_check().items()},
+        }

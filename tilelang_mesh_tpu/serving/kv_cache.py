@@ -1,0 +1,167 @@
+"""Paged KV-cache allocator: a slab freelist over H-major page pools.
+
+The pools are the persistent serving-system layout
+``ops/flash_decoding.pages_to_hmajor`` documents: ``(H, n_pages *
+page_size, D)`` numpy arrays the in-kernel page walker
+(``flash_decode_paged_pool``) DMAs at table-driven offsets. numpy, not
+jax: pages are filled in place as tokens arrive, and the PR 7 zero-copy
+``to_jax`` path hands the aligned C-contiguous pool to the kernel
+without a copy on the host platform.
+
+Accounting contract (the chaos soak gates on it):
+
+- every ``alloc`` names an owner (request id); ``free`` checks the
+  pages back in against that owner — freeing a page twice or freeing
+  someone else's page raises instead of corrupting the freelist;
+- ``leak_check()`` lists owners still holding pages — after every
+  request has retired, it must be empty and ``in_use == 0``;
+- ``serve.kv`` is the fault site on the alloc path (an injected fault
+  there exercises the engine's mid-flight KV-failure handling);
+- allocs/frees land in ``serve.kv.alloc_pages`` / ``serve.kv.free_pages``
+  counters, so trace artifacts can replay the balance.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import TLError
+
+__all__ = ["KVCacheExhausted", "PagedKVAllocator"]
+
+
+class KVCacheExhausted(TLError):
+    """No free slabs left. Transient at admission time (the request is
+    shed, capacity frees as in-flight work retires)."""
+    kind = "transient"
+
+
+class PagedKVAllocator:
+    """Slab freelist over two H-major page pools (K and V)."""
+
+    def __init__(self, n_pages: int, page_size: int, heads: int,
+                 head_dim: int, dtype: str = "float32"):
+        if n_pages <= 0 or page_size <= 0:
+            raise ValueError("n_pages and page_size must be positive")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.dtype = np.dtype(dtype)
+        rows = self.n_pages * self.page_size
+        # H-major pools (H, rows, D): the layout the in-kernel page walk
+        # wants, maintained persistently (not transformed per call)
+        self.kp = np.zeros((self.heads, rows, self.head_dim), self.dtype)
+        self.vp = np.zeros((self.heads, rows, self.head_dim), self.dtype)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self._owned: Dict[int, List[int]] = {}    # owner -> page ids
+        self.alloc_count = 0
+        self.free_count = 0
+
+    # -- capacity ------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.n_pages - len(self._free)
+
+    # -- alloc / free --------------------------------------------------
+    def alloc(self, n: int, owner: int) -> List[int]:
+        """Check out ``n`` pages for ``owner`` (a request id). Raises
+        :class:`KVCacheExhausted` when fewer than ``n`` are free —
+        atomically, so a partially satisfied alloc can never leak."""
+        _faults.maybe_fail("serve.kv", owner=owner, pages=n)
+        with self._lock:
+            if len(self._free) < n:
+                raise KVCacheExhausted(
+                    f"KV cache exhausted: {n} page(s) requested, "
+                    f"{len(self._free)}/{self.n_pages} free",
+                    site="serve.kv")
+            pages = [self._free.pop() for _ in range(n)]
+            self._owned.setdefault(owner, []).extend(pages)
+            self.alloc_count += n
+        _trace.inc("serve.kv.alloc_pages", n)
+        return pages
+
+    def free(self, owner: int,
+             pages: Optional[List[int]] = None) -> int:
+        """Return ``pages`` (default: everything ``owner`` holds) to the
+        freelist. Freeing a page the owner does not hold raises — a
+        double free would hand one slab to two requests."""
+        with self._lock:
+            held = self._owned.get(owner, [])
+            if pages is None:
+                pages = list(held)
+            for p in pages:
+                if p not in held:
+                    raise ValueError(
+                        f"request {owner} does not hold page {p} "
+                        f"(double free or foreign free)")
+            for p in pages:
+                held.remove(p)
+                self._free.append(p)
+            if not held:
+                self._owned.pop(owner, None)
+            self.free_count += len(pages)
+        if pages:
+            _trace.inc("serve.kv.free_pages", len(pages))
+        return len(pages)
+
+    # -- page filling --------------------------------------------------
+    def row0(self, page: int) -> int:
+        """First pool row of ``page`` (token t of the page is row0+t)."""
+        if not 0 <= page < self.n_pages:
+            raise IndexError(f"page {page} out of range")
+        return page * self.page_size
+
+    def write_token(self, page: int, offset: int, k: np.ndarray,
+                    v: np.ndarray) -> None:
+        """Write one token's per-head K/V vectors ``(H, D)`` into
+        ``page`` at token ``offset`` — the in-place append a decode
+        step performs."""
+        if not 0 <= offset < self.page_size:
+            raise IndexError(f"token offset {offset} out of page "
+                             f"(size {self.page_size})")
+        row = self.row0(page) + offset
+        self.kp[:, row, :] = k
+        self.vp[:, row, :] = v
+
+    def fill_page(self, page: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Bulk-fill one page from ``(H, page_size, D)`` arrays (context
+        ingestion at admission)."""
+        r0 = self.row0(page)
+        self.kp[:, r0:r0 + self.page_size, :] = k
+        self.vp[:, r0:r0 + self.page_size, :] = v
+
+    # -- accounting ----------------------------------------------------
+    def holdings(self, owner: int) -> List[int]:
+        with self._lock:
+            return list(self._owned.get(owner, []))
+
+    def leak_check(self) -> Dict[int, List[int]]:
+        """owner -> still-held pages. Empty after every request retired,
+        or the retirement path leaked slabs."""
+        with self._lock:
+            return {o: list(p) for o, p in self._owned.items() if p}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "n_pages": self.n_pages,
+                "page_size": self.page_size,
+                "free": len(self._free),
+                "in_use": self.n_pages - len(self._free),
+                "alloc_count": self.alloc_count,
+                "free_count": self.free_count,
+                "owners": len(self._owned),
+            }

@@ -1,0 +1,164 @@
+"""Request lifecycle for the serving engine.
+
+The state machine is the product (docs/serving.md):
+
+    queued -> admitted -> batched -> terminal
+
+with exactly four terminal outcomes — ``result`` (the request finished
+its decode steps), ``shed`` (admission control rejected it, with a
+named reason), ``deadline_exceeded`` (its deadline + grace passed while
+queued, in flight, or during a retry), ``failed`` (a deterministic
+error retired it). The engine's contract is that EVERY submitted
+request reaches one of the four: no silent drops, no unbounded waits.
+``batched`` flips back to ``admitted`` between decode steps — that
+re-queueing is what makes the batching *continuous* (a half-finished
+request shares its next batch with newly admitted ones).
+
+Every transition is stamped (monotonic clock) into ``timeline`` so the
+chaos soak can prove the zero-hang guarantee per request instead of
+globally.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Request", "STATES", "OUTCOMES", "SHED_REASONS"]
+
+# non-terminal states, in lifecycle order
+STATES = ("queued", "admitted", "batched", "terminal")
+
+# the four terminal outcomes — the whole vocabulary; accounting keys on
+# these strings, so they never grow ad hoc
+OUTCOMES = ("result", "shed", "deadline_exceeded", "failed")
+
+# the admission-control shed vocabulary (admission.py decides, the
+# engine records ``serve.shed{reason=}``); ``retry_budget`` is the one
+# mid-flight shed: a transient step failure whose deadline headroom
+# cannot absorb another attempt
+SHED_REASONS = ("draining", "queue_full", "breaker_open", "kv_exhausted",
+                "deadline_infeasible", "overload", "admit_fault",
+                "retry_budget")
+
+_req_seq = itertools.count(1)
+
+
+class Request:
+    """One inference request: a paged KV context plus ``new_tokens``
+    decode steps to run. ``deadline_ms`` is relative to submission and
+    converted to an absolute monotonic stamp at construction so it can
+    propagate (retry budgets, step watchdog caps) without re-reading
+    clocks ambiguously."""
+
+    __slots__ = ("req_id", "context_tokens", "new_tokens", "deadline",
+                 "submit_t", "seed", "state", "outcome", "shed_reason",
+                 "error", "result", "steps_done", "retries", "pages",
+                 "tail_tokens", "timeline", "terminal_t", "first_batch_t",
+                 "payload")
+
+    def __init__(self, context_tokens: int, new_tokens: int = 1,
+                 deadline_ms: Optional[float] = None, seed: int = 0,
+                 payload: Optional[Dict[str, Any]] = None):
+        if context_tokens <= 0:
+            raise ValueError("context_tokens must be positive")
+        if new_tokens <= 0:
+            raise ValueError("new_tokens must be positive")
+        self.req_id = next(_req_seq)
+        self.context_tokens = int(context_tokens)
+        self.new_tokens = int(new_tokens)
+        self.submit_t = time.monotonic()
+        self.deadline = (self.submit_t + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
+        self.seed = int(seed)
+        self.payload = payload or {}
+        self.state = "queued"
+        self.outcome: Optional[str] = None
+        self.shed_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.result = None           # last decode step's output (np array)
+        self.steps_done = 0
+        self.retries = 0
+        self.pages: List[int] = []   # allocator page ids owned right now
+        self.tail_tokens = 0         # tokens in the (uncommitted) tail page
+        self.timeline: List[tuple] = [("queued", self.submit_t)]
+        self.terminal_t: Optional[float] = None
+        self.first_batch_t: Optional[float] = None
+
+    # -- transitions ---------------------------------------------------
+    def _stamp(self, state: str) -> None:
+        self.state = state
+        self.timeline.append((state, time.monotonic()))
+
+    def admit(self) -> None:
+        self._stamp("admitted")
+
+    def batch(self) -> None:
+        if self.first_batch_t is None:
+            self.first_batch_t = time.monotonic()
+        self._stamp("batched")
+
+    def requeue(self) -> None:
+        """Back to the queue — between decode steps (continuous
+        batching) or on a retryable step failure."""
+        self._stamp("admitted")
+
+    def finish(self, outcome: str, *, shed_reason: Optional[str] = None,
+               error: Optional[str] = None) -> None:
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown outcome {outcome!r}")
+        if self.is_terminal:
+            raise RuntimeError(
+                f"request {self.req_id} already terminal "
+                f"({self.outcome}); double retirement is a scheduler bug")
+        self.outcome = outcome
+        self.shed_reason = shed_reason
+        self.error = error
+        self.terminal_t = time.monotonic()
+        self._stamp("terminal")
+
+    # -- deadline arithmetic -------------------------------------------
+    @property
+    def is_terminal(self) -> bool:
+        return self.outcome is not None
+
+    def remaining_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the deadline (negative = past it); None when
+        the request has no deadline."""
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.monotonic() if now is None else now)
+
+    def expired(self, grace_s: float = 0.0,
+                now: Optional[float] = None) -> bool:
+        r = self.remaining_s(now)
+        return r is not None and r < -grace_s
+
+    def __repr__(self):
+        tail = self.outcome or self.state
+        return (f"Request(#{self.req_id}, ctx={self.context_tokens}, "
+                f"new={self.new_tokens}, steps={self.steps_done}, {tail})")
+
+
+# process-wide live-gauge snapshot the engines publish into and
+# metrics_summary()["serving"] reads (tracer counters are monotonic;
+# queue depth / slabs-in-use are levels, so they live here)
+_GAUGE_LOCK = threading.Lock()
+_GAUGES: Dict[str, float] = {}
+
+
+def publish_gauges(**values: float) -> None:
+    with _GAUGE_LOCK:
+        _GAUGES.update(values)
+
+
+def gauges() -> Dict[str, float]:
+    with _GAUGE_LOCK:
+        return dict(_GAUGES)
+
+
+def reset_gauges() -> None:
+    with _GAUGE_LOCK:
+        _GAUGES.clear()

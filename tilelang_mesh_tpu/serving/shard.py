@@ -1,0 +1,92 @@
+"""Sharding hooks for serving workloads: regex partition-rule tables.
+
+The serving engine runs single-host today, but its data layout is
+designed to shard: the KV pools are head-major precisely so the head
+axis can split across a mesh. This module provides the two idioms the
+related serving stacks use (SNIPPETS.md [1] ``match_partition_rules``
+regex -> PartitionSpec, [2] per-tensor ``ShardConfig`` dataclass),
+adapted to the engine's tensor names, so a mesh-backed workload can
+derive ``in_specs`` for its pools/queries without hand-writing specs
+per bucket.
+
+Rules are ``(regex, PartitionSpec)`` pairs matched IN ORDER against
+slash-separated tensor names (first match wins; scalars are never
+partitioned); unmatched names raise — a silently replicated KV pool is
+a capacity bug, not a default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Sequence, Tuple
+
+__all__ = ["ServeShardConfig", "match_partition_rules"]
+
+
+def _pspec(*axes):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*axes)
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, object]],
+                          names: Sequence[str]) -> List[object]:
+    """PartitionSpec per tensor name: first regex match wins (the
+    SNIPPETS.md [1] idiom, over a flat name list instead of a pytree —
+    the engine's tensors are a fixed small set, not model params)."""
+    out = []
+    for name in names:
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                out.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches {name!r}")
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeShardConfig:
+    """Per-tensor sharding layout of a serving workload (the
+    SNIPPETS.md [2] ``ShardConfig`` idiom): one PartitionSpec per
+    engine tensor, with named constructors for the two layouts that
+    matter. Axis names refer to the 2-D device mesh ("x", "y")."""
+
+    kv_pool_hrd: object       # (H, rows, D) K/V page pools
+    query_bhld: object        # (B, H, 1, D) step queries
+    table_bp: object          # (B, pages) page tables
+    out_bhld: object          # (B, H, 1, D) step outputs
+
+    @staticmethod
+    def no_sharding() -> "ServeShardConfig":
+        """Single-host serving (the default engine layout)."""
+        return ServeShardConfig(kv_pool_hrd=_pspec(),
+                                query_bhld=_pspec(),
+                                table_bp=_pspec(),
+                                out_bhld=_pspec())
+
+    @staticmethod
+    def head_parallel(axis: str = "x") -> "ServeShardConfig":
+        """Split the head axis of pools/queries/outputs over one mesh
+        axis — the natural decode sharding (each device walks its own
+        heads' pages; the page table replicates)."""
+        return ServeShardConfig(kv_pool_hrd=_pspec(axis),
+                                query_bhld=_pspec(None, axis),
+                                table_bp=_pspec(),
+                                out_bhld=_pspec(None, axis))
+
+    @staticmethod
+    def batch_parallel(axis: str = "x") -> "ServeShardConfig":
+        """Split the batch axis — data-parallel serving replicas with a
+        replicated KV pool (small models, large fleets)."""
+        return ServeShardConfig(kv_pool_hrd=_pspec(),
+                                query_bhld=_pspec(axis),
+                                table_bp=_pspec(axis),
+                                out_bhld=_pspec(axis))
+
+    def rules(self) -> List[Tuple[str, object]]:
+        """This config as a ``match_partition_rules`` table."""
+        return [(r"kv/(k|v)_pool", self.kv_pool_hrd),
+                (r"step/q(uery)?", self.query_bhld),
+                (r"kv/page_table", self.table_bp),
+                (r"step/out", self.out_bhld)]

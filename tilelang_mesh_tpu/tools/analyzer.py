@@ -538,9 +538,119 @@ def format_verify_report(records) -> str:
     return "\n".join(lines)
 
 
+def summarize_serve(records) -> dict:
+    """Aggregate the serving-engine activity of a JSONL trace:
+    admissions, sheds by reason, terminal outcomes, retries/failovers,
+    KV slab balance, and the step/queue latency digests — what the
+    ``serve`` subcommand and the chaos-soak report print."""
+    counters: dict = {}
+    sheds: dict = {}
+    failures: list = []
+    deadline_misses: list = []
+    hists: dict = {}
+    from ..observability.export import shed_reason_from_counter
+    for r in records:
+        name = r.get("name")
+        if r.get("type") == "counter" and str(name).startswith("serve."):
+            counters[name] = counters.get(name, 0) + r["value"]
+            reason = shed_reason_from_counter(str(name))
+            if reason is not None:
+                sheds[reason] = sheds.get(reason, 0) + r["value"]
+        elif r.get("type") == "event":
+            attrs = r.get("attrs", {})
+            if name == "serve.request_failed":
+                failures.append({"req": attrs.get("req"),
+                                 "error": attrs.get("error")})
+            elif name == "serve.deadline_exceeded":
+                deadline_misses.append(attrs.get("req"))
+            elif name == "serve.shed" and "reason" in attrs:
+                pass     # counted via the labelled counter lines
+        elif r.get("type") == "histogram" and name in (
+                "serve.queue.wait", "serve.e2e.latency", "kernel.latency"):
+            labels = r.get("labels", {})
+            if name == "kernel.latency" and \
+                    labels.get("kernel") != "serve.step":
+                continue
+            from ..observability.histogram import Histogram
+            h = Histogram.from_dict(r)
+            key = name if name != "kernel.latency" else "serve.step.latency"
+            if labels.get("outcome"):
+                key = f"{name}{{outcome={labels['outcome']}}}"
+            acc = hists.get(key)
+            hists[key] = h if acc is None else acc.merge(h)
+
+    def flat(pfx: str) -> float:
+        return sum(v for k, v in counters.items()
+                   if k == pfx or k.startswith(pfx + "{"))
+
+    from ..observability.histogram import digest_ms
+    digests = {k: digest_ms(h) for k, h in sorted(hists.items())
+               if h.count}
+    alloc = counters.get("serve.kv.alloc_pages", 0)
+    freed = counters.get("serve.kv.free_pages", 0)
+    return {
+        "admitted": counters.get("serve.admitted", 0),
+        "completed": counters.get("serve.completed", 0),
+        "failed": counters.get("serve.failed", 0),
+        "deadline_exceeded": counters.get("serve.deadline_exceeded", 0),
+        "shed": sheds,
+        "shed_total": flat("serve.shed"),
+        "batches": counters.get("serve.batches", 0),
+        "steps": flat("serve.steps"),
+        "retries": counters.get("serve.retries", 0),
+        "failovers": counters.get("serve.failover", 0),
+        "step_failures": {k.split("=", 1)[-1].rstrip("}"): v
+                          for k, v in counters.items()
+                          if k.startswith("serve.step_failures{")},
+        "kv": {"alloc_pages": alloc, "free_pages": freed,
+               "balance": alloc - freed},
+        "latency": digests,
+        "request_failures": failures,
+        "deadline_missed_requests": deadline_misses,
+    }
+
+
+def format_serve_report(records) -> str:
+    """Human-readable serving summary of a JSONL trace (CLI ``serve``
+    subcommand, docs/serving.md)."""
+    s = summarize_serve(records)
+    lines = [
+        "serving engine:",
+        f"  admitted                {int(s['admitted'])}",
+        f"  completed (result)      {int(s['completed'])}",
+        f"  shed                    {int(s['shed_total'])}"
+        + ("" if not s["shed"] else "  ("
+           + ", ".join(f"{k}={int(v)}"
+                       for k, v in sorted(s["shed"].items())) + ")"),
+        f"  deadline exceeded       {int(s['deadline_exceeded'])}",
+        f"  failed                  {int(s['failed'])}",
+        f"  batches / steps         {int(s['batches'])} / "
+        f"{int(s['steps'])}",
+        f"  retries / failovers     {int(s['retries'])} / "
+        f"{int(s['failovers'])}",
+        f"  kv pages alloc/free     {int(s['kv']['alloc_pages'])} / "
+        f"{int(s['kv']['free_pages'])} "
+        f"(balance {int(s['kv']['balance'])})",
+    ]
+    if s["step_failures"]:
+        lines.append("  step failures by kind   "
+                     + ", ".join(f"{k}={int(v)}" for k, v in
+                                 sorted(s["step_failures"].items())))
+    if s["latency"]:
+        lines.append("latency digests:")
+        for k, d in s["latency"].items():
+            lines.append(f"  {k}: n={d['count']} p50={d['p50_ms']}ms "
+                         f"p99={d['p99_ms']}ms max={d['max_ms']}ms")
+    if s["request_failures"]:
+        lines.append("failed requests:")
+        for f in s["request_failures"][:20]:
+            lines.append(f"  #{f['req']}: {f['error']}")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
-# CLI: trace / faults / verify / perf-diff subcommands (legacy --flag
-# spellings are translated, so existing scripts keep working)
+# CLI: trace / faults / verify / serve / perf-diff subcommands (legacy
+# --flag spellings are translated, so existing scripts keep working)
 # ---------------------------------------------------------------------------
 
 def _load_trace(path) -> list:
@@ -568,6 +678,12 @@ def _run_faults(path, as_json: bool) -> int:
 def _run_verify(path, as_json: bool) -> int:
     records = _load_trace(path)
     _emit(summarize_verify(records), format_verify_report(records), as_json)
+    return 0
+
+
+def _run_serve(path, as_json: bool) -> int:
+    records = _load_trace(path)
+    _emit(summarize_serve(records), format_serve_report(records), as_json)
     return 0
 
 
@@ -646,6 +762,11 @@ def main(argv=None) -> int:
         "verify", help="schedule-verifier / selfcheck / sanitizer / "
                        "watchdog summary (docs/robustness.md)")
     p_vf.add_argument("file", help="JSONL trace file")
+    p_sv = sub.add_parser(
+        "serve", help="serving-engine summary: admissions, sheds by "
+                      "reason, terminal outcomes, KV slab balance, "
+                      "step/queue latency (docs/serving.md)")
+    p_sv.add_argument("file", help="JSONL trace file")
     p_pd = sub.add_parser(
         "perf-diff", help="noise-aware per-config latency comparison of "
                           "two bench artifacts; exits 1 on a real "
@@ -661,7 +782,7 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -671,6 +792,8 @@ def main(argv=None) -> int:
         return _run_faults(args.file, args.json)
     if args.cmd == "verify":
         return _run_verify(args.file, args.json)
+    if args.cmd == "serve":
+        return _run_serve(args.file, args.json)
     return _run_perf_diff(args.baseline, args.current, args.json,
                           args.threshold_mads, args.min_rel,
                           args.report_only)

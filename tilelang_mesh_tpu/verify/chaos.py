@@ -23,12 +23,22 @@ still completes — rc=0, EVERY CPU-safe config producing a record, and
 the victim's record carrying ``backend.failover`` accounting. Exit 1
 means a dying worker can still zero a bench round.
 
+``--serve`` is the third chaos mode (the ISSUE 8 serving core): a
+seeded request storm through the continuous-batching engine with
+``serve.admit``/``serve.step``/``serve.kv`` faults armed, the device
+killed once mid-batch, a tight-deadline arrival stall, and a drain
+wave — asserting every request reaches a terminal outcome, zero KV
+slabs leak, and the shed/deadline accounting matches the histograms
+(docs/serving.md).
+
 Usage::
 
     JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
         --out chaos_report
     python -m tilelang_mesh_tpu.verify.chaos --device-loss \
         --out chaos_device_loss --seed 7
+    JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
+        --serve --requests 500 --out chaos_serve --seed 7
 """
 
 # NOTE: no `from __future__ import annotations` here — the T.prim_func
@@ -232,6 +242,196 @@ def run_device_loss(out: Path, seed: int) -> int:
     return 0 if ok else 1
 
 
+def run_serve(out: Path, seed: int, n_requests: int) -> int:
+    """Seeded serving-engine chaos soak (the CI ``serve-smoke`` job and
+    the ISSUE 8 acceptance gate): ``n_requests`` requests with a
+    deadline mix submitted in arrival waves, ``serve.*`` faults armed,
+    the device killed once mid-batch (``device.dispatch``), and a drain
+    wave at the end. Asserts the engine's whole failure contract:
+
+    - every request reaches a terminal outcome (no drops, no hangs);
+    - no deadlined request retires later than deadline + grace + one
+      step bound (the zero-hang guarantee, measured per request);
+    - KV slabs balance to zero (allocs == frees, no leaked owners);
+    - the shed/deadline accounting in the counters and the e2e
+      histogram agree with the per-request outcomes.
+    """
+    import random
+
+    import numpy as np  # noqa: F401  (engine results are np arrays)
+
+    os.environ["TL_TPU_TRACE"] = "1"
+    import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                           PagedKVAllocator,
+                                           ServingEngine)
+
+    rng = random.Random(seed)
+    alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
+                             head_dim=64)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=(8,),
+                             page_buckets=(2, 4))
+    import time as _time
+    eng = ServingEngine(wl, name="chaos-soak")
+    t_warm0 = _time.perf_counter()
+    warmed = eng.warmup()
+    warm_s = _time.perf_counter() - t_warm0
+
+    def make_request():
+        ctx = rng.choice((16, 24, 32))
+        steps = rng.choice((1, 1, 2, 3))
+        roll = rng.random()
+        if roll < 0.60:
+            deadline = None
+        elif roll < 0.80:
+            deadline = 2000.0          # generous
+        elif roll < 0.95:
+            deadline = rng.uniform(30.0, 120.0)   # tight but feasible
+        else:
+            deadline = 0.0             # hopeless: shed at admission
+        return dict(context_tokens=ctx, new_tokens=steps,
+                    deadline_ms=deadline, seed=rng.randrange(1 << 30))
+
+    drain_wave = max(4, n_requests // 25)
+    main_wave = n_requests - drain_wave
+    print(f"[chaos-serve] seed={seed}: {n_requests} requests "  # noqa: T201
+          f"({drain_wave} after drain), {warmed} bucket kernels warmed "
+          f"in {warm_s:.1f}s, serve.* + device.dispatch faults armed")
+    t0 = _time.perf_counter()
+    if n_requests < 20:
+        print(f"[chaos-serve] --requests {n_requests} is below the soak "
+              f"minimum (20): the kill/stall/drain phases need room to "
+              f"fire", file=sys.stderr)  # noqa: T201
+        return 2
+    kill_at = rng.randrange(main_wave // 4, main_wave // 2)
+    with inject("serve.step", p=0.03, seed=seed, kind="transient"), \
+            inject("serve.kv", p=0.005, seed=seed + 1, kind="transient"), \
+            inject("serve.admit", p=0.02, seed=seed + 2,
+                   kind="transient"):
+        submitted = 0
+        killed = stalled = False
+        while submitted < main_wave:
+            wave = min(rng.randrange(8, 33), main_wave - submitted)
+            for _ in range(wave):
+                eng.submit(**make_request())
+            submitted += wave
+            if not killed and submitted >= kill_at:
+                # the device dies mid-batch at a seeded point of the
+                # sweep: the scheduler must quarantine the batch, fail
+                # over, and re-admit its unexpired requests
+                killed = True
+                with inject("device.dispatch", kind="unreachable",
+                            times=1):
+                    eng.step()
+            if not stalled and submitted >= main_wave // 2:
+                # seeded arrival stall: a wave of tight-deadline
+                # requests admitted onto a live queue, then the driver
+                # pauses past their deadlines (a GC pause / upstream
+                # hiccup) — the expiry sweep must retire them as
+                # deadline_exceeded, never strand them. The deadline is
+                # picked RELATIVE to the observed p50 so admission's
+                # feasibility gate admits them on any machine speed,
+                # and the pause is sized past deadline + grace so they
+                # are in-flight-expired, not shed at admit.
+                stalled = True
+                from tilelang_mesh_tpu.serving.admission import \
+                    observed_step_ms
+                for _ in range(40):
+                    if eng.queue_depth == 0:
+                        break
+                    eng.step()
+                p50_ms = max(observed_step_ms(0.50, default_ms=5.0), 1.0)
+                # feasibility is re-judged per submit against the queue
+                # the wave itself builds: budget for all 12 ahead of
+                # the last one, doubled for headroom
+                stall_deadline_ms = max(
+                    40.0, p50_ms * (eng.queue_depth + 12 + 2) * 2.0)
+                for _ in range(12):
+                    eng.submit(context_tokens=16, new_tokens=1,
+                               deadline_ms=stall_deadline_ms,
+                               seed=rng.randrange(1 << 30))
+                _time.sleep((stall_deadline_ms + eng.grace_ms) / 1e3
+                            + 0.05)
+            for _ in range(rng.randrange(1, 4)):
+                eng.step()
+        eng.drain()
+        for _ in range(drain_wave):
+            eng.submit(**make_request())
+        eng.run()
+    wall_s = _time.perf_counter() - t0
+
+    # -- the contract checks -------------------------------------------
+    grace_s = eng.grace_ms / 1e3
+    step_h = _hist.get_histogram("kernel.latency", kernel="serve.step",
+                                 source="serving")
+    max_step_s = (step_h.max if step_h and step_h.count else 0.1)
+    non_terminal = [r.req_id for r in eng.requests if not r.is_terminal]
+    late = [r.req_id for r in eng.requests
+            if r.deadline is not None and r.terminal_t is not None
+            and r.terminal_t - r.deadline > grace_s + max_step_s + 0.25]
+    leaks = alloc.leak_check()
+    outcomes = eng.outcomes()
+    counters = obs.metrics_summary()["serving"]
+    e2e_by_outcome = {}
+    for (name, labels), h in _hist.histograms():
+        if name == "serve.e2e.latency":
+            oc = dict(labels).get("outcome", "?")
+            e2e_by_outcome[oc] = e2e_by_outcome.get(oc, 0) + h.count
+    acct_ok = (
+        counters["completed"] == outcomes["result"]
+        and counters["deadline_exceeded"] == outcomes["deadline_exceeded"]
+        and counters["failed"] == outcomes["failed"]
+        and counters["shed_total"] == outcomes["shed"]
+        and sum(e2e_by_outcome.values()) == len(eng.requests)
+        and all(e2e_by_outcome.get(k, 0) == v
+                for k, v in outcomes.items() if k != "pending"))
+    kv_ok = (not leaks and alloc.in_use == 0
+             and alloc.alloc_count == alloc.free_count)
+    checks = {
+        "all_terminal": not non_terminal,
+        "zero_hangs_past_deadline_grace": not late,
+        "kv_slabs_balance_zero": kv_ok,
+        "accounting_matches_histograms": acct_ok,
+        "engine_completed_some_work": outcomes["result"] > 0,
+        "deadline_path_exercised": outcomes["deadline_exceeded"] > 0,
+        "chaos_actually_fired": counters["retries"] > 0
+        and counters["failovers"] >= 1,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "mode": "serve", "seed": seed, "requests": n_requests,
+        "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
+        "warmed_kernels": warmed,
+        "outcomes": outcomes,
+        "shed_by_reason": counters["shed"],
+        "retries": counters["retries"],
+        "failovers": counters["failovers"],
+        "steps": eng.stats()["steps"],
+        "kv": alloc.stats(),
+        "kv_leaks": {str(k): v for k, v in leaks.items()},
+        "e2e_by_outcome": e2e_by_outcome,
+        "non_terminal_requests": non_terminal,
+        "late_requests": late,
+        "checks": checks, "ok": ok,
+    }
+    trace_path = out / "serve_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "serve_report.json").write_text(json.dumps(report, indent=2))
+    from ..tools.analyzer import format_serve_report
+    summary = format_serve_report(obs.read_jsonl(str(trace_path)))
+    (out / "serve_report.txt").write_text(summary + "\n")
+    print(summary)  # noqa: T201
+    for k, v in checks.items():
+        print(f"[chaos-serve] {k}: {'OK' if v else 'FAIL'}")  # noqa: T201
+    print(f"[chaos-serve] outcomes={outcomes} in {wall_s:.1f}s -> "  # noqa: T201
+          f"{'PASS' if ok else 'FAIL'}; artifacts in {out}/")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tilelang_mesh_tpu.verify.chaos",
@@ -245,12 +445,25 @@ def main(argv=None) -> int:
                          "random config index of a bench.py --hermetic "
                          "sweep and assert the failover tier still "
                          "produces a record per CPU-safe config")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-engine soak: seeded request storm with "
+                         "serve.* faults armed and the device killed "
+                         "mid-batch; asserts every request reaches a "
+                         "terminal outcome with zero KV-slab leaks "
+                         "(docs/serving.md)")
+    ap.add_argument("--requests", type=int, default=500,
+                    help="request count for --serve (default 500)")
     args = ap.parse_args(argv)
 
     if args.device_loss:
         out = Path(args.out)
         out.mkdir(parents=True, exist_ok=True)
         return run_device_loss(out, args.seed)
+
+    if args.serve:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        return run_serve(out, args.seed, args.requests)
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)

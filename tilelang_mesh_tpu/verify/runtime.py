@@ -35,6 +35,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..env import env
@@ -184,7 +185,19 @@ def watchdog_call(fn: Callable, timeout_ms: float, n_collectives: int,
     """Run ``fn()`` (a device dispatch) under the collective watchdog:
     the budget is ``timeout_ms`` per collective. On expiry the worker is
     abandoned (a wedged ICI transfer cannot be interrupted in-process)
-    and a timeout ``TLError`` is raised for the caller to classify."""
+    and a timeout ``TLError`` is raised for the caller to classify.
+
+    The budget is enforced on the dispatch's measured wall time, not
+    only on the queue wait: a dispatch whose result lands but took
+    longer than the budget is still classified as a timeout. A caller
+    with a budget has already missed it either way, and relying on the
+    queue wait alone made the verdict depend on thread scheduling — a
+    fast warm dispatch could finish before this thread ever reached
+    ``q.get``, silently passing a budget it had blown (the
+    test_watchdog_exempts_first_call_compile flake when the process was
+    warm). The clock runs INSIDE the worker, around ``fn()`` itself, so
+    thread-spawn and wakeup latency on a loaded host never count
+    against a tight collective budget."""
     import queue
     import jax
 
@@ -193,15 +206,17 @@ def watchdog_call(fn: Callable, timeout_ms: float, n_collectives: int,
 
     def _worker():
         try:
-            q.put((True, jax.block_until_ready(fn())))
+            t0 = time.monotonic()
+            val = jax.block_until_ready(fn())
+            q.put((True, val, time.monotonic() - t0))
         except BaseException as e:  # noqa: BLE001 — relayed to caller
-            q.put((False, e))
+            q.put((False, e, 0.0))
 
     t = threading.Thread(target=_worker, daemon=True,
                          name=f"tl-comm-watchdog-{next(_watchdog_seq)}")
     t.start()
     try:
-        ok, val = q.get(timeout=budget_s)
+        ok, val, elapsed_s = q.get(timeout=budget_s)
     except queue.Empty:
         raise TLTimeoutError(
             f"{kernel}: mesh dispatch exceeded the collective watchdog "
@@ -210,4 +225,11 @@ def watchdog_call(fn: Callable, timeout_ms: float, n_collectives: int,
             f"abandoned", site="comm.watchdog") from None
     if not ok:
         raise val
+    if elapsed_s > budget_s:
+        raise TLTimeoutError(
+            f"{kernel}: mesh dispatch completed but took "
+            f"{elapsed_s * 1e3:.3f}ms, past the collective watchdog "
+            f"budget ({timeout_ms}ms x {max(1, n_collectives)} "
+            f"collectives = {budget_s * 1e3:.3f}ms)",
+            site="comm.watchdog")
     return val
