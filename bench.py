@@ -619,6 +619,126 @@ def cfg_serve_smoke(requests=64):
                 custom_run=run)
 
 
+def cfg_mesh_serve_smoke(requests=48):
+    """CI mesh-serve-smoke config for elastic mesh serving
+    (serving/mesh_workload.py; docs/serving.md): the request storm
+    through a ``MeshDecodeWorkload`` whose decode step is sharded over
+    a 2x2 host device mesh (``head_parallel``), with a mesh slice
+    killed mid-drive so the record carries REAL reshard accounting
+    (layout ladder walked, KV migrated byte-conserved). Headline value
+    = served req/s on the elastic mesh path ACROSS the reshard;
+    ``vs_baseline`` = that against the same requests on the single-host
+    ``no_sharding`` workload. Sharding a tiny decode over host devices
+    buys no speed — the gate is the CONTRACT: every request must
+    retire ``result``, KV slabs must balance to zero, and the slice
+    kill must produce >= 1 reshard, or the config raises. CPU-safe:
+    the mesh is forced host devices (``_config_env``)."""
+    from tilelang_mesh_tpu.observability import histogram as _h
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                           MeshDecodeWorkload,
+                                           PagedKVAllocator,
+                                           ServingEngine, serving_state)
+
+    def build_engine(mesh, name):
+        alloc = PagedKVAllocator(n_pages=256, page_size=8, heads=2,
+                                 head_dim=64)
+        if mesh:
+            wl = MeshDecodeWorkload(alloc, batch_buckets=(8,),
+                                    page_buckets=(2,))
+        else:
+            wl = FlashDecodeWorkload(alloc, batch_buckets=(8,),
+                                     page_buckets=(2,))
+        eng = ServingEngine(wl, name=name)
+        eng.warmup()
+        return eng
+
+    def drive(eng, kill_at=None):
+        rng = np.random.default_rng(11)
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(requests):
+            reqs.append(eng.submit(context_tokens=16,
+                                   new_tokens=int(rng.integers(1, 3)),
+                                   seed=int(rng.integers(1 << 30))))
+            if kill_at is not None and i == kill_at:
+                with inject("serve.shard", kind="unreachable", times=1):
+                    eng.step()
+        eng.run()
+        wall = time.perf_counter() - t0
+        bad = [r.req_id for r in reqs if r.outcome != "result"]
+        if bad:
+            raise BenchError(f"mesh_serve_smoke: {len(bad)} request(s) "
+                             f"did not retire as result: {bad[:8]}")
+        if eng.workload.allocator.in_use:
+            raise BenchError(
+                "mesh_serve_smoke: leaked KV slabs "
+                f"({eng.workload.allocator.leak_check()})")
+        return wall, eng
+
+    def _step_hist():
+        h = _h.get_histogram("kernel.latency", kernel="serve.step",
+                             source="serving")
+        return None if h is None else _h.Histogram.from_dict(h.to_dict())
+
+    def run():
+        eng_m = build_engine(True, "mesh-smoke")
+        first_layout = eng_m.workload.layout.name
+        before = _step_hist()
+        wall_m, eng_m = drive(eng_m, kill_at=requests // 2)
+        win = _step_hist().minus(before)       # mesh steps only
+        if eng_m.reshards < 1:
+            raise BenchError("mesh_serve_smoke: the mid-drive slice "
+                             "kill produced no reshard")
+        eng_s = build_engine(False, "mesh-smoke-ref")
+        wall_s, eng_s = drive(eng_s)
+
+        def q_ms(h, q):
+            v = h.quantile(q) if h and h.count else None
+            return round(v * 1e3, 4) if v is not None else None
+
+        iqr2 = None
+        if win and win.count:
+            iqr2 = round(((win.quantile(0.75) or 0)
+                          - (win.quantile(0.25) or 0)) / 2 * 1e3, 5)
+        from tilelang_mesh_tpu import observability as _obs
+        serving = _obs.metrics_summary()["serving"]
+        return {
+            "value": round(requests / wall_m, 1),
+            "unit": "req/s",
+            # mesh-elastic throughput over the single-host reference
+            # (informational on CPU; the contract is the gate)
+            "vs_baseline": round(wall_s / wall_m, 4),
+            "latency_ms": round(wall_m / max(eng_m.stats()["steps"], 1)
+                                * 1e3, 4),
+            "baseline_ms": round(wall_s
+                                 / max(eng_s.stats()["steps"], 1)
+                                 * 1e3, 4),
+            "latency_p50_ms": q_ms(win, 0.50),
+            "latency_p90_ms": q_ms(win, 0.90),
+            "latency_p99_ms": q_ms(win, 0.99),
+            "latency_mad_ms": iqr2,
+            "latency_samples": win.count if win else 0,
+            "reps": requests,
+            "baseline_mad_ms": iqr2,
+            "requests": requests,
+            # the elastic accounting the CI gate reads
+            "layout_first": first_layout,
+            "layout_final": eng_m.workload.layout.name,
+            "layout_ladder": [r.name for r in eng_m.workload.ladder],
+            "reshards": eng_m.reshards,
+            "kv_pages_migrated": serving["kv_pages_migrated"],
+            "shard_skew": serving_state().get("shard_skew"),
+            "mesh_steps": eng_m.stats()["steps"],
+            "single_host_steps": eng_s.stats()["steps"],
+        }
+
+    return dict(metric=f"elastic mesh serving smoke: {requests} "
+                       f"requests on a 2x2 host mesh, slice kill + "
+                       f"live reshard (vs single-host decode)",
+                custom_run=run)
+
+
 def cfg_flash(D, S=2048, B=2, H=16, causal=True):
     import jax.numpy as jnp
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -1392,7 +1512,8 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # probe finds the TPU worker dead still runs them (on the host platform)
 # instead of producing an empty artifact.
 CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
-                    "mesh_allreduce_smoke", "serve_smoke")
+                    "mesh_allreduce_smoke", "serve_smoke",
+                    "mesh_serve_smoke")
 
 
 def _config_env(name: str, tpu_alive: bool) -> dict:
@@ -1400,12 +1521,12 @@ def _config_env(name: str, tpu_alive: bool) -> dict:
     needs forced host devices for its 2x2 CPU mesh, and CPU-safe configs
     fall back to the host platform when the TPU worker is down."""
     over = {}
-    if name == "mesh_allreduce_smoke":
-        # this config is DEFINED as a host-device mesh smoke (its
-        # checked-in baseline was captured on CPU devices): pin the
+    if name in ("mesh_allreduce_smoke", "mesh_serve_smoke"):
+        # these configs are DEFINED as host-device mesh smokes (their
+        # checked-in baselines were captured on CPU devices): pin the
         # platform so a TPU host doesn't silently benchmark the mesh
         # on TPU against a CPU baseline, and force the host device
-        # count its 2x2 mesh needs
+        # count their 2x2 meshes need
         over["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -1443,6 +1564,7 @@ def _config_builders(q: bool):
         ("dispatch_overhead_smoke", lambda: cfg_dispatch_overhead_smoke()),
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("serve_smoke", lambda: cfg_serve_smoke()),
+        ("mesh_serve_smoke", lambda: cfg_mesh_serve_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),

@@ -92,6 +92,23 @@ def test_serve_smoke_config():
     assert rec["batched_steps"] <= rec["sequential_steps"]
 
 
+def test_mesh_serve_smoke_config():
+    """The elastic mesh-serving smoke: the decode step shards over the
+    2x2 host mesh, a mid-drive slice kill walks the layout ladder one
+    rung down, and the record carries the layout/reshard/migration
+    accounting the CI gate reads (docs/serving.md)."""
+    import bench
+    rec = _run("mesh_serve_smoke",
+               lambda: bench.cfg_mesh_serve_smoke(requests=16))
+    assert rec["unit"] == "req/s"
+    assert rec["requests"] == 16
+    assert rec["layout_first"] == "head_parallel:2x2"
+    assert rec["reshards"] >= 1
+    assert rec["layout_final"] != rec["layout_first"]
+    assert rec["kv_pages_migrated"] > 0
+    assert rec["layout_ladder"][-1] == "no_sharding"
+
+
 def test_cpu_safe_configs_declared():
     """Probe-once skip logic keys off CPU_SAFE_CONFIGS: both smoke
     configs must be declared CPU-safe and excluded from the default
@@ -104,9 +121,11 @@ def test_cpu_safe_configs_declared():
     # the mesh smoke child gets forced host devices (injected, or
     # already present in the ambient flags — conftest sets them here)
     import os
-    env = bench._config_env("mesh_allreduce_smoke", tpu_alive=True)
-    flags = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
-    assert "host_platform_device_count" in flags
+    for cfg in ("mesh_allreduce_smoke", "mesh_serve_smoke"):
+        env = bench._config_env(cfg, tpu_alive=True)
+        flags = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+        assert "host_platform_device_count" in flags
+        assert env.get("JAX_PLATFORMS") == "cpu"
     # CPU-safe configs fall back to the host platform on a dead worker
     env = bench._config_env("gemm_smoke", tpu_alive=False)
     assert env.get("JAX_PLATFORMS") == "cpu"

@@ -1,0 +1,592 @@
+"""Elastic mesh-sharded serving suite (serving/mesh_workload.py;
+docs/serving.md "Mesh sharding & elastic degradation").
+
+Five layers, mirroring the subsystem:
+
+1. **Layout ladder** — token/ladder parsing, the guaranteed
+   ``no_sharding`` terminal rung, the ``TL_TPU_SERVE_LAYOUTS`` knob.
+2. **Build-time validation** — head/batch divisibility, unknown mesh
+   axis names, too-few devices: every violation is a named
+   ``MeshVerifyError`` at workload build, never a shard_map failure
+   deep inside XLA.
+3. **KV migration** — checksummed ``snapshot()``/``restore()``:
+   round-trip byte equality, repacking onto a smaller allocator,
+   double-restore rejection, corruption detection, and balanced books
+   via ``migrate()``.
+4. **Sharded dispatch** — ``shard_map`` decode numerics match the
+   single-host workload bit-for-tolerance on head- and batch-parallel
+   layouts; the straggler probe fills per-shard histograms.
+5. **The elastic contract** — a slice kill mid-decode walks the ladder
+   one rung down with live KV migration and zero leaks; the metrics /
+   analyzer surfaces report it; the ``--serve-mesh`` chaos driver
+   passes end to end (the same driver CI gates with).
+
+Everything runs on the conftest-forced 8-device virtual CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.resilience.errors import DeviceLossError
+from tilelang_mesh_tpu.serving import (FlashDecodeWorkload, KVCacheExhausted,
+                                       MeshDecodeWorkload, MeshLayout,
+                                       PagedKVAllocator, ServeShardConfig,
+                                       ServingEngine, layout_ladder, migrate,
+                                       parse_layout, serving_meta,
+                                       serving_state, validate_shard_config)
+from tilelang_mesh_tpu.verify.schedule import MeshVerifyError
+
+H, D, PS = 2, 64, 8
+
+
+def make_alloc(n_pages=64):
+    return PagedKVAllocator(n_pages=n_pages, page_size=PS, heads=H,
+                            head_dim=D)
+
+
+def make_mesh_engine(n_pages=64, batch_buckets=(4,), page_buckets=(2,),
+                     layouts=None, **kw):
+    alloc = make_alloc(n_pages)
+    wl = MeshDecodeWorkload(alloc, batch_buckets=batch_buckets,
+                            page_buckets=page_buckets, layouts=layouts)
+    return ServingEngine(wl, **kw), alloc
+
+
+# ---------------------------------------------------------------------------
+# layout ladder parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_layout_tokens():
+    lay = parse_layout("head_parallel:2x2")
+    assert lay.kind == "head_parallel" and (lay.rows, lay.cols) == (2, 2)
+    assert lay.name == "head_parallel:2x2" and lay.sharded
+    assert parse_layout("no_sharding").devices == 1
+    assert parse_layout("batch_parallel:1x4").cols == 4
+
+
+@pytest.mark.parametrize("bad", ["", "ring_parallel:2x2", "head_parallel",
+                                 "head_parallel:2", "head_parallel:0x2",
+                                 "no_sharding:2x2"])
+def test_parse_layout_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_layout(bad)
+
+
+def test_layout_ladder_default_and_terminal_rung(monkeypatch):
+    rungs = layout_ladder()
+    assert rungs[0].name == "head_parallel:2x2"
+    assert rungs[-1].kind == "no_sharding"
+    # a ladder without a terminal rung gets no_sharding appended
+    rungs = layout_ladder("head_parallel:2x2")
+    assert [r.name for r in rungs] == ["head_parallel:2x2", "no_sharding"]
+    monkeypatch.setenv("TL_TPU_SERVE_LAYOUTS",
+                       "batch_parallel:2x1,no_sharding")
+    rungs = layout_ladder()
+    assert [r.name for r in rungs] == ["batch_parallel:2x1", "no_sharding"]
+
+
+def test_workload_honors_env_ladder(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_LAYOUTS", "head_parallel:2x1")
+    wl = MeshDecodeWorkload(make_alloc(), batch_buckets=(2,),
+                            page_buckets=(2,))
+    assert [r.name for r in wl.ladder] == ["head_parallel:2x1",
+                                           "no_sharding"]
+    assert wl.layout.name == "head_parallel:2x1"
+
+
+# ---------------------------------------------------------------------------
+# build-time validation (satellite: MeshVerifyError, not deep XLA)
+# ---------------------------------------------------------------------------
+
+def test_heads_must_divide_sharded_axis():
+    alloc = PagedKVAllocator(n_pages=16, page_size=PS, heads=3, head_dim=D)
+    with pytest.raises(MeshVerifyError, match="3 head.*not divisible"):
+        MeshDecodeWorkload(alloc, batch_buckets=(2,), page_buckets=(2,),
+                           layouts="head_parallel:2x2")
+
+
+def test_batch_buckets_must_divide_sharded_axis():
+    with pytest.raises(MeshVerifyError, match=r"batch bucket.*\[1\]"):
+        MeshDecodeWorkload(make_alloc(), batch_buckets=(1, 4),
+                           page_buckets=(2,), layouts="batch_parallel:2x2")
+
+
+def test_unknown_mesh_axis_rejected_at_build():
+    with pytest.raises(MeshVerifyError, match="mesh axis 'z'"):
+        MeshDecodeWorkload(make_alloc(), batch_buckets=(2,),
+                           page_buckets=(2,),
+                           layouts="head_parallel:2x2",
+                           shard_config=ServeShardConfig.head_parallel("z"))
+
+
+def test_too_few_devices_named_error():
+    # conftest forces 8 host devices; a 3x3 mesh cannot build (batch
+    # bucket 9 divides the axis, so the DEVICE check is what fires)
+    with pytest.raises(MeshVerifyError, match="9 device"):
+        MeshDecodeWorkload(make_alloc(), batch_buckets=(9,),
+                           page_buckets=(2,), layouts="batch_parallel:3x3")
+
+
+def test_validate_shard_config_direct():
+    lay = MeshLayout("head_parallel", 2, 2)
+    validate_shard_config(ServeShardConfig.head_parallel("x"), lay,
+                          heads=2, batch_buckets=(4,))
+    with pytest.raises(MeshVerifyError):
+        validate_shard_config(ServeShardConfig.head_parallel("x"), lay,
+                              heads=5, batch_buckets=(4,))
+    # no_sharding validates trivially regardless of geometry
+    validate_shard_config(ServeShardConfig.no_sharding(),
+                          MeshLayout("no_sharding"), heads=5,
+                          batch_buckets=(3,))
+
+
+# ---------------------------------------------------------------------------
+# KV snapshot / restore / migrate
+# ---------------------------------------------------------------------------
+
+def _fill(alloc, owner, n, seed=0):
+    rng = np.random.default_rng(seed)
+    pages = alloc.alloc(n, owner)
+    for p in pages:
+        shape = (alloc.heads, alloc.page_size, alloc.head_dim)
+        alloc.fill_page(p, rng.standard_normal(shape).astype(np.float32),
+                        rng.standard_normal(shape).astype(np.float32))
+    return pages
+
+
+def test_snapshot_checksum_round_trip():
+    src = make_alloc(16)
+    pages = _fill(src, owner=1, n=3, seed=7)
+    snap = src.snapshot()
+    assert snap.n_pages == 3 and snap.nbytes == \
+        3 * 2 * H * PS * D * 4
+    snap.verify()            # self-consistent
+    dst = make_alloc(16)
+    mapping = dst.restore(snap)
+    assert sorted(mapping) == sorted(pages)
+    # bytes land identically (order preserved per owner)
+    for old, new in mapping.items():
+        r0o, r0n = old * PS, new * PS
+        np.testing.assert_array_equal(src.kp[:, r0o:r0o + PS],
+                                      dst.kp[:, r0n:r0n + PS])
+        np.testing.assert_array_equal(src.vp[:, r0o:r0o + PS],
+                                      dst.vp[:, r0n:r0n + PS])
+    assert dst.holdings(1) == [mapping[p] for p in pages]
+
+
+def test_restore_onto_smaller_allocator_repacks():
+    src = make_alloc(64)
+    # spread pages high in the id space so a smaller target MUST remap
+    _fill(src, owner=1, n=2, seed=1)
+    _fill(src, owner=2, n=3, seed=2)
+    src.free(1)
+    pages2 = _fill(src, owner=3, n=2, seed=3)
+    snap = src.snapshot()
+    dst = make_alloc(8)      # 64-page placement -> 8-page placement
+    mapping = dst.restore(snap)
+    assert len(mapping) == 5 and dst.in_use == 5
+    assert all(new < 8 for new in mapping.values())
+    assert dst.holdings(3) == [mapping[p] for p in pages2]
+
+
+def test_restore_capacity_and_geometry_checks():
+    src = make_alloc(16)
+    _fill(src, owner=1, n=4)
+    snap = src.snapshot()
+    tiny = make_alloc(2)
+    with pytest.raises(KVCacheExhausted):
+        tiny.restore(snap)
+    other = PagedKVAllocator(n_pages=16, page_size=PS, heads=H + 2,
+                             head_dim=D)
+    with pytest.raises(ValueError, match="geometry"):
+        other.restore(snap)
+
+
+def test_double_restore_rejected():
+    src = make_alloc(16)
+    _fill(src, owner=1, n=2)
+    snap = src.snapshot()
+    make_alloc(16).restore(snap)
+    with pytest.raises(ValueError, match="already restored"):
+        make_alloc(16).restore(snap)
+
+
+def test_corrupted_snapshot_detected():
+    src = make_alloc(16)
+    pages = _fill(src, owner=1, n=2)
+    snap = src.snapshot()
+    snap.pages[pages[0]][0][0, 0, 0] += 1.0     # bit-rot in flight
+    with pytest.raises(ValueError, match="checksum"):
+        make_alloc(16).restore(snap)
+
+
+def test_restore_frees_target_pages_when_written_bytes_corrupt(monkeypatch):
+    """The post-write conservation check is inside the undo scope: a
+    corrupted write raises AND releases the freshly allocated target
+    pages — no phantom owners leak into the target allocator."""
+    src = make_alloc(16)
+    _fill(src, owner=1, n=3, seed=3)
+    snap = src.snapshot()
+    dst = make_alloc(16)
+    real_fill = dst.fill_page
+
+    def corrupting_fill(page, k, v):
+        real_fill(page, k + 1.0, v)          # write the WRONG bytes
+
+    monkeypatch.setattr(dst, "fill_page", corrupting_fill)
+    with pytest.raises(ValueError, match="corrupted"):
+        dst.restore(snap)
+    assert dst.in_use == 0 and not dst.leak_check()
+    assert snap.consumed is False            # still restorable elsewhere
+    monkeypatch.undo()
+    assert len(dst.restore(snap)) == 3       # clean retry succeeds
+
+
+def test_reshard_clears_stale_shard_skew_gauge():
+    """The old layout's straggler signal dies with its mesh: after a
+    reshard the shard_skew gauge is gone until the new rung's first
+    probe repopulates it."""
+    eng, _ = make_mesh_engine(name="elastic-skew")
+    eng.workload.probe_shards()
+    from tilelang_mesh_tpu.serving.request import publish_gauges
+    publish_gauges(shard_skew=9.9)
+    err = DeviceLossError("slice died", site="serve.shard")
+    assert eng._maybe_reshard(err) is True
+    assert "shard_skew" not in serving_state()
+
+
+def test_migrate_balances_both_allocators():
+    src, dst = make_alloc(16), make_alloc(16)
+    _fill(src, owner=1, n=3)
+    _fill(src, owner=2, n=2)
+    mapping, nbytes = migrate(src, dst)
+    assert len(mapping) == 5
+    assert nbytes == 5 * 2 * H * PS * D * 4
+    assert src.in_use == 0 and not src.leak_check()
+    assert dst.in_use == 5
+    assert src.alloc_count == src.free_count == 5
+    dst.free(1)
+    dst.free(2)
+    assert dst.in_use == 0 and dst.alloc_count == dst.free_count
+
+
+# ---------------------------------------------------------------------------
+# sharded dispatch numerics + straggler probe
+# ---------------------------------------------------------------------------
+
+def _single_host_result(seed, new_tokens=2, **wl_kw):
+    alloc = make_alloc()
+    wl = FlashDecodeWorkload(alloc, **wl_kw)
+    eng = ServingEngine(wl, name="ref")
+    r = eng.submit(context_tokens=16, new_tokens=new_tokens, seed=seed)
+    eng.run()
+    assert r.outcome == "result"
+    return np.asarray(r.result)
+
+
+@pytest.mark.parametrize("layouts", ["head_parallel:2x2",
+                                     "batch_parallel:2x2"])
+def test_mesh_dispatch_matches_single_host(layouts):
+    eng, _ = make_mesh_engine(batch_buckets=(4,), layouts=layouts)
+    r = eng.submit(context_tokens=16, new_tokens=2, seed=11)
+    eng.run()
+    assert r.outcome == "result"
+    want = _single_host_result(11, batch_buckets=(4,), page_buckets=(2,))
+    np.testing.assert_allclose(np.asarray(r.result), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_dispatch_multi_request_batch():
+    eng, alloc = make_mesh_engine(batch_buckets=(4,))
+    reqs = [eng.submit(context_tokens=16, new_tokens=2, seed=i)
+            for i in range(3)]
+    eng.run()
+    assert all(r.outcome == "result" for r in reqs)
+    assert alloc.in_use == 0 and not alloc.leak_check()
+
+
+def test_no_sharding_rung_delegates_to_single_host():
+    eng, _ = make_mesh_engine(layouts="no_sharding")
+    assert eng.workload.mesh is None
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=5)
+    eng.run()
+    assert r.outcome == "result"
+
+
+def test_straggler_probe_fills_per_shard_histograms():
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    eng, _ = make_mesh_engine()
+    skew = eng.workload.probe_shards()
+    assert skew is not None and skew >= 1.0
+    shards = {dict(labels).get("shard")
+              for (name, labels), h in _hist.histograms()
+              if name == "serve.shard.latency" and h.count}
+    assert set(eng.workload.shard_names()) <= shards
+    assert len(eng.workload.shard_names()) == 4
+
+
+def test_engine_publishes_shard_skew_gauge():
+    eng, _ = make_mesh_engine()
+    eng._shard_probe_every = 1          # probe on every step
+    eng.submit(context_tokens=16, new_tokens=1, seed=3)
+    eng.run()
+    assert serving_state().get("shard_skew", 0) >= 1.0
+
+
+def test_probe_lost_all_alive():
+    eng, _ = make_mesh_engine()
+    assert eng.workload.probe_lost() == []
+
+
+# ---------------------------------------------------------------------------
+# the elastic contract: slice loss -> reshard -> migrate -> serve on
+# ---------------------------------------------------------------------------
+
+def test_slice_kill_walks_ladder_with_live_migration():
+    obs.reset()
+    eng, first_alloc = make_mesh_engine(name="elastic")
+    eng.warmup()
+    reqs = [eng.submit(context_tokens=16, new_tokens=3, seed=i)
+            for i in range(3)]
+    eng.step()                           # one healthy sharded step
+    with inject("serve.shard", kind="unreachable", times=1):
+        eng.step()                       # the slice dies mid-step
+    eng.run()
+    wl = eng.workload
+    assert wl.layout.name == "head_parallel:2x1"
+    assert eng.reshards == 1
+    assert all(r.outcome == "result" for r in reqs)
+    # migration swapped allocators; BOTH placements balance to zero
+    assert wl.allocator is not first_alloc
+    assert first_alloc.in_use == 0 and not first_alloc.leak_check()
+    assert wl.allocator.in_use == 0 and not wl.allocator.leak_check()
+    assert serving_meta()["layout"] == "head_parallel:2x1"
+    s = obs.metrics_summary()["serving"]
+    assert s["reshards"] == 1 and s["layout"] == "head_parallel:2x1"
+    assert s["kv_pages_migrated"] > 0
+    assert s["kv_pages_allocated"] == s["kv_pages_freed"]
+
+
+def test_second_kill_reaches_no_sharding_terminal_rung():
+    eng, _ = make_mesh_engine(name="elastic2")
+    for kill in range(2):
+        reqs = [eng.submit(context_tokens=16, new_tokens=2, seed=kill * 7 + i)
+                for i in range(2)]
+        with inject("serve.shard", kind="unreachable", times=1):
+            eng.step()
+        eng.run()
+        assert all(r.outcome == "result" for r in reqs)
+    assert eng.workload.layout.name == "no_sharding"
+    assert eng.reshards == 2
+    # a further device loss on the terminal rung cannot reshard: it
+    # takes the ordinary quarantine/retry path and still completes
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=99)
+    with inject("device.dispatch", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert r.outcome == "result" and eng.reshards == 2
+
+
+def test_watchdog_timeout_also_walks_ladder():
+    eng, _ = make_mesh_engine(name="elastic-to")
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    with inject("serve.shard", kind="timeout", times=1):
+        eng.step()
+    eng.run()
+    assert eng.reshards == 1
+    assert eng.workload.layout.name == "head_parallel:2x1"
+    assert r.outcome == "result"
+
+
+def test_reshard_budget_bounds_ladder_walk():
+    eng, _ = make_mesh_engine(name="elastic-budget", retry_max=3)
+    eng.reshard_max = 0
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    with inject("serve.shard", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert eng.reshards == 0
+    assert eng.workload.layout.name == "head_parallel:2x2"
+    assert r.outcome == "result"         # retried on the same layout
+
+
+def test_lost_device_quarantined_and_excluded():
+    from tilelang_mesh_tpu.codegen.backends import registry
+    eng, _ = make_mesh_engine(name="elastic-q")
+    eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    victim = str(eng.workload.mesh.devices.flat[0])
+    err = DeviceLossError("slice died", site="serve.shard")
+    err.device = victim
+    assert eng._maybe_reshard(err) is True
+    assert victim in registry().quarantined_devices()
+    assert victim not in eng.workload.layout_stats()["mesh_devices"]
+    assert "quarantined_devices" in registry().snapshot()
+    eng.run()
+
+
+def test_deadline_budget_timeout_does_not_reshard():
+    """A deadline-derived step-budget timeout (site=serve.step) says
+    nothing about mesh health: one tight-deadlined request must not
+    halve serving capacity by walking the ladder."""
+    eng, _ = make_mesh_engine(name="elastic-ddl")
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    # an injected serve.step timeout carries site=serve.step — the same
+    # signature a deadline-derived _bounded_step expiry raises with
+    with inject("serve.step", kind="timeout", times=1):
+        eng.step()
+    assert eng.reshards == 0
+    assert eng.workload.layout.name == "head_parallel:2x2"
+    eng.run()
+    assert r.outcome == "result"         # retried on the same layout
+
+
+def test_failed_migration_leaves_layout_unchanged(monkeypatch):
+    """Atomicity of the reshard: when the KV migration fails, NOTHING
+    moves — old allocator installed, old layout serving, no reshard
+    accounted — and the failure falls through to ordinary handling."""
+    from tilelang_mesh_tpu.serving import kv_cache as kvmod
+    eng, alloc = make_mesh_engine(name="elastic-migfail")
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+
+    def boom(src, dst):
+        raise KVCacheExhausted("injected migration failure",
+                               site="serve.kv")
+
+    monkeypatch.setattr(kvmod, "migrate", boom)
+    err = DeviceLossError("slice died", site="serve.shard")
+    assert eng._maybe_reshard(err) is False
+    assert eng.reshards == 0
+    assert eng.workload.layout.name == "head_parallel:2x2"
+    assert eng.workload.allocator is alloc
+    assert serving_meta().get("layout") == "head_parallel:2x2"
+    monkeypatch.undo()
+    eng.run()
+    assert r.outcome == "result"
+
+
+def test_rewarm_failure_does_not_crash_reshard(monkeypatch):
+    """A warm-up failure on the new rung is best-effort: the reshard
+    still lands (buckets compile lazily on first dispatch) instead of
+    escaping step() with the batch stuck non-terminal."""
+    eng, _ = make_mesh_engine(name="elastic-warmfail")
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    wl = eng.workload
+    monkeypatch.setattr(type(wl), "warmup",
+                        lambda self: (_ for _ in ()).throw(
+                            RuntimeError("injected warm-up failure")))
+    err = DeviceLossError("slice died", site="serve.shard")
+    assert eng._maybe_reshard(err) is True
+    assert eng.reshards == 1
+    assert wl.layout.name == "head_parallel:2x1"
+    monkeypatch.undo()
+    eng.run()
+    assert r.outcome == "result"
+
+
+def test_later_reshards_exclude_previously_quarantined():
+    """A device quarantined by an EARLIER reshard never re-enters a
+    layout: the second rung walk excludes the union of every
+    quarantined slice, not just the current failure's."""
+    from tilelang_mesh_tpu.codegen.backends import registry
+    eng, _ = make_mesh_engine(
+        name="elastic-q2",
+        layouts="head_parallel:2x2,head_parallel:2x1,head_parallel:1x2")
+    wl = eng.workload
+    victim1 = str(wl.mesh.devices.flat[0])
+    err1 = DeviceLossError("slice died", site="serve.shard")
+    err1.device = victim1
+    assert eng._maybe_reshard(err1) is True
+    assert victim1 not in wl.layout_stats()["mesh_devices"]
+    victim2 = str(wl.mesh.devices.flat[0])
+    err2 = DeviceLossError("slice died", site="serve.shard")
+    err2.device = victim2
+    assert eng._maybe_reshard(err2) is True
+    mesh_devs = wl.layout_stats()["mesh_devices"]
+    assert victim1 not in mesh_devs      # the EARLIER quarantine holds
+    assert victim2 not in mesh_devs
+    assert {victim1, victim2} <= set(registry().quarantined_devices())
+
+
+def test_requests_survive_reshard_with_correct_results():
+    """The correctness half of 'degrades capacity, never correctness':
+    a request whose decode spans a reshard produces the same final
+    output as the same request served without any failure."""
+    eng, _ = make_mesh_engine(name="elastic-num")
+    r = eng.submit(context_tokens=16, new_tokens=3, seed=21)
+    eng.step()
+    with inject("serve.shard", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert r.outcome == "result"
+    want = _single_host_result(21, new_tokens=3, batch_buckets=(4,),
+                               page_buckets=(2,))
+    np.testing.assert_allclose(np.asarray(r.result), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_analyzer_serve_mesh_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    obs.reset()
+    eng, _ = make_mesh_engine(name="elastic-an")
+    eng._shard_probe_every = 1
+    reqs = [eng.submit(context_tokens=16, new_tokens=2, seed=i)
+            for i in range(2)]
+    with inject("serve.shard", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert all(r.outcome == "result" for r in reqs)
+    p = tmp_path / "mesh.jsonl"
+    obs.write_jsonl(str(p))
+    from tilelang_mesh_tpu.tools.analyzer import (format_serve_report,
+                                                  summarize_serve)
+    recs = obs.read_jsonl(str(p))
+    s = summarize_serve(recs)
+    assert s["reshards"] == 1
+    assert s["layout"] == "head_parallel:2x1"
+    assert s["reshard_events"][0]["frm"] == "head_parallel:2x2"
+    assert s["kv"]["migrated_pages"] > 0
+    assert s["shard_latency"]          # per-shard probe digests
+    assert s["shard_skew"] is None or s["shard_skew"] >= 1.0
+    text = format_serve_report(recs)
+    assert "mesh serving (elastic):" in text
+    assert "reshard head_parallel:2x2 -> head_parallel:2x1" in text
+    assert "per-shard latency" in text
+
+
+def test_stats_and_layout_stats():
+    eng, _ = make_mesh_engine()
+    st = eng.stats()
+    assert st["reshards"] == 0
+    assert st["mesh"]["layout"] == "head_parallel:2x2"
+    assert st["mesh"]["ladder"][-1] == "no_sharding"
+    assert len(st["mesh"]["mesh_devices"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# the contract, end to end: the --serve-mesh chaos driver
+# ---------------------------------------------------------------------------
+
+def test_chaos_serve_mesh_soak(tmp_path, monkeypatch):
+    """The ISSUE 9 acceptance gate, run in-process with the exact
+    driver CI uses (``verify/chaos.py --serve-mesh``): a seeded storm
+    with a mesh slice killed mid-step — 100% terminal outcomes, >= 1
+    reshard down the ladder, zero KV leaks, byte-conservation across
+    the migration, accounting agreement."""
+    obs.reset()
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    from tilelang_mesh_tpu.verify.chaos import run_serve_mesh
+    rc = run_serve_mesh(tmp_path, seed=13, n_requests=120)
+    assert rc == 0
+    import json
+    report = json.loads((tmp_path / "serve_mesh_report.json").read_text())
+    assert all(report["checks"].values())
+    assert report["reshards"] >= 1
+    assert report["final_layout"] != report["first_layout"]
+    assert report["outcomes"]["pending"] == 0
+    assert report["kv_pages_migrated"] > 0

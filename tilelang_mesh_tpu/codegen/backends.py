@@ -303,6 +303,11 @@ class BackendRegistry:
         self._lock = threading.Lock()
         self._backends = {}
         self._health = {}
+        # quarantined mesh slices/devices: finer-grained than backend
+        # health — an elastic mesh workload that loses ONE slice keeps
+        # its backend healthy but must not rebuild onto the dead
+        # devices (serving/mesh_workload.py reads this on reshard)
+        self._quarantined_devices: dict = {}
         # per-backend in-flight probe locks: N par_compile workers
         # TTL-missing together must pay ONE bounded probe, not N
         self._probe_locks = {}
@@ -426,6 +431,30 @@ class BackendRegistry:
                 return b
         return None
 
+    def quarantine_device(self, device: str, error: BaseException,
+                          *, backend: Optional[str] = None) -> None:
+        """Quarantine ONE device / mesh slice without condemning its
+        whole backend tier: a mesh workload that lost a slice records
+        it here so a rebuild on the same tier excludes the dead
+        hardware. Keyed by the device's stable string id (e.g.
+        ``TFRT_CPU_3`` / ``TPU_2(process=0,(1,0,0,0))``)."""
+        with self._lock:
+            self._quarantined_devices[str(device)] = {
+                "error": f"{type(error).__name__}: {error}",
+                "backend": backend,
+            }
+        _trace.inc("backend.device_quarantined",
+                   **({"backend": backend} if backend else {}))
+        _trace.event("backend.device_quarantined", "resilience",
+                     device=str(device), backend=backend,
+                     error=f"{type(error).__name__}: {error}")
+
+    def quarantined_devices(self) -> dict:
+        """device id -> {error, backend} for every quarantined slice."""
+        with self._lock:
+            return {k: dict(v)
+                    for k, v in self._quarantined_devices.items()}
+
     def note_failover(self, *, frm: str, to: str, kernel: str,
                       during: str, error: BaseException) -> None:
         """The one place a failover is recorded: degraded-class event +
@@ -439,12 +468,18 @@ class BackendRegistry:
     def snapshot(self) -> dict:
         """Per-backend health for metrics_summary / bench records."""
         with self._lock:
-            return {n: h.as_dict() for n, h in self._health.items()}
+            out = {n: h.as_dict() for n, h in self._health.items()}
+            if self._quarantined_devices:
+                out["quarantined_devices"] = {
+                    k: dict(v)
+                    for k, v in self._quarantined_devices.items()}
+            return out
 
     def reset(self) -> None:
         """Forget every cached verdict (tests)."""
         with self._lock:
             self._health = {n: BackendHealth() for n in self._backends}
+            self._quarantined_devices.clear()
 
 
 _REGISTRY: Optional[BackendRegistry] = None

@@ -132,6 +132,21 @@ class Environment:
     # per-request retry ceiling for transient/timeout step failures
     # (deadline headroom is checked independently on every retry)
     TL_TPU_SERVE_RETRY_MAX = EnvVar("TL_TPU_SERVE_RETRY_MAX", 2, int)
+    # elastic mesh serving (serving/mesh_workload.py; docs/serving.md):
+    # the layout LADDER a MeshDecodeWorkload degrades down when a mesh
+    # slice dies mid-decode — comma list of kind[:RxC] rungs, walked
+    # left to right on DeviceLossError / collective-watchdog timeout
+    TL_TPU_SERVE_LAYOUTS = EnvVar(
+        "TL_TPU_SERVE_LAYOUTS",
+        "head_parallel:2x2,head_parallel:2x1,no_sharding")
+    # reshard ceiling per engine: past it, step failures fall through to
+    # the ordinary (non-elastic) failure handling
+    TL_TPU_SERVE_RESHARD_MAX = EnvVar("TL_TPU_SERVE_RESHARD_MAX", 4, int)
+    # straggler probe cadence: every N successful sharded steps the
+    # engine times a tiny per-shard dispatch into the per-shard
+    # serve.shard.latency histograms + the shard_skew gauge (0 = off)
+    TL_TPU_SERVE_SHARD_PROBE_EVERY = EnvVar(
+        "TL_TPU_SERVE_SHARD_PROBE_EVERY", 8, int)
     # buffer donation for inout params: warm calls whose inout inputs
     # are jax arrays dispatch through jax.jit(donate_argnums=...), so
     # XLA may reuse the input buffer for the aliased output (the caller

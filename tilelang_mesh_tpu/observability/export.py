@@ -203,6 +203,16 @@ def _serving_gauges() -> dict:
         return {}
 
 
+def _serving_meta() -> dict:
+    """String-valued serving state (active mesh layout) — the gauges'
+    non-numeric sibling."""
+    try:
+        from ..serving.request import serving_meta
+        return serving_meta()
+    except Exception:
+        return {}
+
+
 def _rate(hit: float, miss: float) -> Optional[float]:
     total = hit + miss
     return round(hit / total, 4) if total else None
@@ -323,6 +333,20 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         return _hist.digest_ms(_hist.get_histogram(name, **labels))
 
     sheds = _sheds_by_reason()
+    gauges = _serving_gauges()
+    # per-shard straggler-probe digests + skew: the p50 ratio of the
+    # slowest to the fastest shard (elastic mesh serving; None until a
+    # sharded layout probed)
+    shard_latency: Dict[str, dict] = {}
+    for (hname, labels), h in _hist.histograms():
+        if hname == "serve.shard.latency" and h.count:
+            shard = dict(labels).get("shard", "?")
+            shard_latency[shard] = _hist.digest_ms(h)
+    skew = _hist.p50_skew(shard_latency) if shard_latency else None
+    if gauges.get("shard_skew"):
+        # the live gauge (last probe sweep) wins over the historical
+        # p50 ratio when an engine is actually running
+        skew = gauges["shard_skew"]
     serving = {
         "admitted": c("serve.admitted"),
         "completed": c("serve.completed"),
@@ -337,11 +361,17 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "warmup_kernels": c("serve.warmup.kernels"),
         "kv_pages_allocated": c("serve.kv.alloc_pages"),
         "kv_pages_freed": c("serve.kv.free_pages"),
+        # elastic mesh serving (serving/mesh_workload.py)
+        "layout": _serving_meta().get("layout"),
+        "reshards": labelled_total("serve.reshard"),
+        "shard_skew": skew,
+        "kv_pages_migrated": c("serve.kv.migrated_pages"),
+        "shard_latency": shard_latency,
         "step_latency": _hist_digest("kernel.latency",
                                      kernel="serve.step",
                                      source="serving"),
         "queue_wait": _hist_digest("serve.queue.wait"),
-        "gauges": _serving_gauges(),
+        "gauges": gauges,
     }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,

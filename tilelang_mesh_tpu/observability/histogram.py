@@ -21,7 +21,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Histogram", "HistogramRegistry", "default_bounds",
            "get_registry", "observe", "get_histogram", "histograms",
-           "reset", "digest_ms"]
+           "reset", "digest_ms", "p50_skew"]
 
 # Default latency bounds in SECONDS: factor-2 log spacing from 1us to
 # ~67s (27 finite buckets + overflow). Wide enough for a sub-ms Pallas
@@ -197,6 +197,19 @@ def digest_ms(h: Optional["Histogram"]) -> Optional[dict]:
             "p50_ms": round((h.quantile(0.5) or 0) * 1e3, 4),
             "p99_ms": round((h.quantile(0.99) or 0) * 1e3, 4),
             "max_ms": round(h.max * 1e3, 4)}
+
+
+def p50_skew(digests) -> Optional[float]:
+    """Slowest/fastest p50 ratio over a {name -> digest_ms()} mapping —
+    the serving ``shard_skew`` definition, shared by
+    ``metrics_summary()`` and ``analyzer serve`` so the two can never
+    compute a different skew for the same shards. None when fewer than
+    one shard has a positive p50."""
+    p50s = [d["p50_ms"] for d in digests.values()
+            if d and d.get("p50_ms")]
+    if not p50s or min(p50s) <= 0:
+        return None
+    return round(max(p50s) / min(p50s), 4)
 
 
 LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]

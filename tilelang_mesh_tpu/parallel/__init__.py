@@ -5,4 +5,4 @@ from .sharding import (MeshShardingPolicy, MeshReplicationType,
                        MeshTensorMeta)
 from .device_mesh import (get_device_mesh_config, set_device_mesh_config,
                           mesh_config, core_tuple_to_id, core_id_to_tuple,
-                          make_jax_mesh, TPUMeshProperties)
+                          make_jax_mesh, make_host_mesh, TPUMeshProperties)

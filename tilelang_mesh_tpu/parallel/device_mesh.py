@@ -85,6 +85,28 @@ def make_jax_mesh(nrows: int, ncols: int, devices: Optional[Sequence] = None):
     return Mesh(devs, ("x", "y"))
 
 
+def make_host_mesh(nrows: int, ncols: int,
+                   exclude: Sequence[str] = ()):
+    """Build an ``(nrows, ncols)`` mesh over host-platform devices,
+    skipping any whose string id is in ``exclude`` — the elastic
+    serving path rebuilds its mesh through here after a slice loss so
+    a quarantined device (codegen/backends.py
+    ``registry().quarantined_devices()``) never re-enters a layout.
+    Raises ``ValueError`` when too few usable devices remain; the
+    caller (the layout ladder) decides which smaller rung to try."""
+    import jax
+    excluded = {str(e) for e in exclude}
+    devs = [d for d in jax.devices("cpu") if str(d) not in excluded]
+    need = nrows * ncols
+    if len(devs) < need:
+        raise ValueError(
+            f"host mesh {nrows}x{ncols} needs {need} device(s); "
+            f"{len(devs)} usable ({len(excluded)} quarantined) — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need} "
+            f"or step down the layout ladder")
+    return make_jax_mesh(nrows, ncols, devices=devs[:need])
+
+
 def axis_size_compat(axis_name):
     """Static mesh-axis size inside shard_map across jax versions:
     ``lax.axis_size`` when present, else ``lax.psum(1, name)`` (which

@@ -74,6 +74,7 @@ FAULT_SITES = (
     "serve.admit",
     "serve.step",
     "serve.kv",
+    "serve.shard",
 )
 
 _KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt",

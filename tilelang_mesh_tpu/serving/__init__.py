@@ -24,16 +24,23 @@ from .admission import (AdmissionController, SERVE_BREAKER_SIG,  # noqa: F401
 from .batcher import (DecodeWorkload, FlashDecodeWorkload,  # noqa: F401
                       MLADecodeWorkload)
 from .engine import ServingEngine  # noqa: F401
-from .kv_cache import KVCacheExhausted, PagedKVAllocator  # noqa: F401
+from .kv_cache import (KVCacheExhausted, KVSnapshot,  # noqa: F401
+                       PagedKVAllocator, migrate)
+from .mesh_workload import (LAYOUT_KINDS, MeshDecodeWorkload,  # noqa: F401
+                            MeshLayout, layout_ladder, parse_layout,
+                            validate_shard_config)
 from .request import (OUTCOMES, Request, SHED_REASONS, STATES,  # noqa: F401
-                      gauges as serving_state, reset_gauges)
+                      gauges as serving_state, publish_meta,
+                      reset_gauges, serving_meta)
 from .shard import ServeShardConfig, match_partition_rules  # noqa: F401
 
 __all__ = [
     "ServingEngine", "DecodeWorkload", "FlashDecodeWorkload",
-    "MLADecodeWorkload", "PagedKVAllocator", "KVCacheExhausted",
-    "AdmissionController", "Request", "STATES", "OUTCOMES",
+    "MLADecodeWorkload", "MeshDecodeWorkload", "MeshLayout",
+    "layout_ladder", "parse_layout", "validate_shard_config",
+    "LAYOUT_KINDS", "PagedKVAllocator", "KVCacheExhausted", "KVSnapshot",
+    "migrate", "AdmissionController", "Request", "STATES", "OUTCOMES",
     "SHED_REASONS", "SERVE_BREAKER_SIG", "STEP_HIST_KERNEL",
     "ServeShardConfig", "match_partition_rules", "serving_state",
-    "reset_gauges",
+    "serving_meta", "publish_meta", "reset_gauges",
 ]

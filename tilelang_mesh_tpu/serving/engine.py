@@ -46,7 +46,8 @@ from .admission import (STEP_HIST_KERNEL, SERVE_BREAKER_SIG,
                         AdmissionController)
 from .batcher import DecodeWorkload
 from .kv_cache import KVCacheExhausted
-from .request import Request, publish_gauges
+from .request import (Request, clear_gauges, publish_gauges,
+                      publish_meta)
 
 __all__ = ["ServingEngine"]
 
@@ -117,6 +118,14 @@ class ServingEngine:
         self._steps = 0
         self._failovers = 0
         self._warmed = False
+        # elastic mesh serving (serving/mesh_workload.py): the layout
+        # ladder the engine walks on a sharded-step device loss /
+        # watchdog timeout, bounded by TL_TPU_SERVE_RESHARD_MAX
+        self.reshard_max = env.TL_TPU_SERVE_RESHARD_MAX
+        self._shard_probe_every = env.TL_TPU_SERVE_SHARD_PROBE_EVERY
+        self._reshards = 0
+        if getattr(workload, "elastic", False):
+            publish_meta(layout=workload.layout.name)
 
     # -- submission / admission ----------------------------------------
     def submit(self, context_tokens: int, new_tokens: int = 1,
@@ -279,9 +288,30 @@ class ServingEngine:
         _trace.inc("serve.steps", len(batch))
         _hist.observe("kernel.latency", dt, kernel=STEP_HIST_KERNEL,
                       source="serving")
+        self._maybe_probe_shards()
         self._retire_or_requeue(batch, outs)
         self._gauges()
         return True
+
+    def _maybe_probe_shards(self) -> None:
+        """Sampled straggler probe on sharded layouts: per-shard probe
+        latencies land in ``serve.shard.latency{shard=}`` and the skew
+        ratio in the ``shard_skew`` gauge — a slow shard is visible
+        before it is dead (docs/serving.md)."""
+        wl = self.workload
+        if (self._shard_probe_every <= 0
+                or not getattr(wl, "elastic", False)
+                or not wl.layout.sharded
+                or self._steps % self._shard_probe_every):
+            return
+        try:
+            skew = wl.probe_shards()
+        except Exception as e:  # noqa: BLE001 — a probe must not kill a step
+            logger.warning("serving engine %s: shard probe failed: %s",
+                           self.name, e)
+            return
+        if skew is not None:
+            publish_gauges(shard_skew=skew)
 
     def run(self, max_steps: Optional[int] = None) -> int:
         """Pump ``step()`` until idle; returns steps executed. The
@@ -379,7 +409,20 @@ class ServingEngine:
         _trace.event("serve.step_failure", "serving", kind=kind,
                      batch=[r.req_id for r in batch],
                      error=f"{type(exc).__name__}: {exc}")
-        if kind == "device_loss":
+        resharded = False
+        if kind == "device_loss" or (
+                kind == "timeout"
+                and getattr(exc, "site", None) != "serve.step"):
+            # elastic mesh workloads degrade one layout rung instead of
+            # condemning the whole backend tier: losing a slice costs
+            # capacity, never correctness (docs/serving.md). A
+            # deadline-derived step-budget timeout (site=serve.step)
+            # says nothing about mesh health — one tight-deadlined
+            # request must not halve serving capacity — so only
+            # collective-watchdog / mesh-dispatch timeouts walk the
+            # ladder.
+            resharded = self._maybe_reshard(exc)
+        if kind == "device_loss" and not resharded:
             self._quarantine_and_failover(exc)
         if kind == "deterministic":
             # feed the shared breaker under both the per-error signature
@@ -411,6 +454,106 @@ class ServingEngine:
                 self._finish(r, "failed",
                              error=f"retry budget exhausted: "
                                    f"{type(exc).__name__}: {exc}")
+
+    def _maybe_reshard(self, exc: Exception) -> bool:
+        """Walk the elastic layout ladder one rung down after a sharded
+        step died (device loss / watchdog timeout): quarantine the lost
+        slice in the PR 6 backend registry, rebuild the workload's mesh
+        + specs on the next rung, migrate the KV state byte-conserved
+        into a fresh placement, AOT re-warm the bucket kernels, and let
+        the caller's retry path re-admit the batch's unexpired
+        requests. Returns False (-> ordinary failure handling) when the
+        workload is not elastic, already unsharded, the ladder or the
+        reshard budget is spent, or the migration failed."""
+        wl = self.workload
+        if not getattr(wl, "elastic", False) or not wl.layout.sharded \
+                or not wl.can_degrade():
+            return False
+        if self._reshards >= self.reshard_max:
+            logger.error(
+                "serving engine %s: reshard budget (%d) spent; falling "
+                "through to ordinary failure handling", self.name,
+                self.reshard_max)
+            return False
+        frm = wl.layout.name
+        # 1. quarantine the lost slice: the error's device when it
+        # names one, plus every mesh device failing a bounded liveness
+        # probe (an injected loss leaves all host devices answering, so
+        # this set may be empty — the rung walk is the degradation)
+        from ..codegen.backends import registry
+        lost = []
+        dev = getattr(exc, "device", None)
+        if dev is not None:
+            lost.append(str(dev))
+        try:
+            lost.extend(d for d in wl.probe_lost() if d not in lost)
+        except Exception:  # noqa: BLE001 — probe is best-effort
+            pass
+        reg = registry()
+        for d in lost:
+            reg.quarantine_device(d, exc)
+        # every slice quarantined by an EARLIER reshard stays excluded
+        # too — a known-dead device must never re-enter a layout
+        exclude = sorted(set(lost) | set(reg.quarantined_devices()))
+        # 2. migrate the surviving KV slabs into a fresh placement
+        # FIRST, checksummed + byte-conservation-verified, so a failure
+        # anywhere below leaves a consistent engine: a failed migration
+        # keeps the OLD allocator installed (nothing moved) and falls
+        # through to the ordinary failure handling
+        from .kv_cache import migrate
+        new_alloc = wl.make_allocator()
+        try:
+            mapping, nbytes = migrate(wl.allocator, new_alloc)
+        except Exception as e:  # noqa: BLE001 — migration must not crash
+            logger.error(
+                "serving engine %s: KV migration off %s failed "
+                "(%s: %s); keeping the old placement", self.name, frm,
+                type(e).__name__, e)
+            return False
+        wl.install_allocator(new_alloc)
+        for r in self.requests:
+            if not r.is_terminal and r.pages:
+                r.pages = [mapping[p] for p in r.pages]
+        # 3. next rung (skips rungs that cannot build on the survivors);
+        # on failure the engine stays on the OLD layout with its KV
+        # migrated in place — byte-identical state, books balanced
+        try:
+            to = wl.degrade(exclude=exclude)
+        except Exception as e:  # noqa: BLE001 — ladder spent / unbuildable
+            logger.error(
+                "serving engine %s: layout ladder walk from %s failed "
+                "(%s: %s); falling through to ordinary failure "
+                "handling", self.name, frm, type(e).__name__, e)
+            return False
+        # 4. AOT re-warm every bucket on the new rung before traffic;
+        # a warm-up failure must not crash the step (buckets compile
+        # lazily on first dispatch, and if the rung is truly dead the
+        # next step failure walks the ladder again)
+        try:
+            with _trace.span("serve.rewarm", "serving", engine=self.name,
+                             layout=to.name):
+                wl.warmup()
+        except Exception as e:  # noqa: BLE001 — warm-up is best-effort
+            logger.warning(
+                "serving engine %s: re-warm on %s failed (%s: %s); "
+                "buckets will compile lazily", self.name, to.name,
+                type(e).__name__, e)
+        self._reshards += 1
+        _trace.inc("serve.reshard", frm=frm, to=to.name)
+        _trace.event("serve.reshard", "serving", engine=self.name,
+                     frm=frm, to=to.name, pages=len(mapping),
+                     bytes=nbytes, lost=sorted(lost),
+                     error=f"{type(exc).__name__}: {exc}")
+        publish_meta(layout=to.name)
+        # the old layout's straggler signal dies with its mesh; the
+        # next probe on the new rung (if sharded) repopulates it
+        clear_gauges("shard_skew")
+        logger.warning(
+            "serving engine %s: mesh slice loss mid-decode (%s: %s); "
+            "resharded %s -> %s, %d KV page(s) (%d bytes) migrated, "
+            "%d device(s) quarantined", self.name, type(exc).__name__,
+            exc, frm, to.name, len(mapping), nbytes, len(lost))
+        return True
 
     def _quarantine_and_failover(self, exc: Exception) -> None:
         """Device loss mid-batch: mark the serving tier unhealthy in the
@@ -474,17 +617,25 @@ class ServingEngine:
             out[r.outcome or "pending"] += 1
         return out
 
+    @property
+    def reshards(self) -> int:
+        return self._reshards
+
     def stats(self) -> dict:
         alloc = self.workload.allocator
-        return {
+        out = {
             "engine": self.name,
             "requests": len(self.requests),
             "outcomes": self.outcomes(),
             "queue_depth": len(self._queue),
             "steps": self._steps,
             "failovers": self._failovers,
+            "reshards": self._reshards,
             "draining": self._draining,
             "kv": alloc.stats(),
             "kv_leaks": {str(k): v
                          for k, v in alloc.leak_check().items()},
         }
+        if getattr(self.workload, "elastic", False):
+            out["mesh"] = self.workload.layout_stats()
+        return out

@@ -19,12 +19,25 @@ Accounting contract (the chaos soak gates on it):
   there exercises the engine's mid-flight KV-failure handling);
 - allocs/frees land in ``serve.kv.alloc_pages`` / ``serve.kv.free_pages``
   counters, so trace artifacts can replay the balance.
+
+Migration contract (the elastic mesh path, docs/serving.md): a live
+reshard moves every in-use slab between allocators through a
+checksummed :class:`KVSnapshot` — ``snapshot()`` captures the live
+pages + owner map with a sha256 over the page bytes, ``restore()``
+repacks them into a (possibly smaller) target allocator, re-verifies
+the checksum on the bytes it actually wrote, and returns the
+old-page -> new-page mapping the engine rewrites request holdings
+with. A snapshot restores exactly once (double restore would hand the
+same slabs to two allocators) and byte conservation is asserted, not
+assumed — the ``--serve-mesh`` chaos soak gates on it.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -32,13 +45,58 @@ from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import TLError
 
-__all__ = ["KVCacheExhausted", "PagedKVAllocator"]
+__all__ = ["KVCacheExhausted", "KVSnapshot", "PagedKVAllocator", "migrate"]
 
 
 class KVCacheExhausted(TLError):
     """No free slabs left. Transient at admission time (the request is
     shed, capacity frees as in-flight work retires)."""
     kind = "transient"
+
+
+def _page_digest(h, page: int, k: np.ndarray, v: np.ndarray) -> int:
+    """Feed one page's identity + bytes into a running sha256."""
+    h.update(str(page).encode())
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    h.update(k.tobytes())
+    h.update(v.tobytes())
+    return k.nbytes + v.nbytes
+
+
+@dataclasses.dataclass
+class KVSnapshot:
+    """Checksummed capture of every LIVE slab of one allocator — the
+    unit of KV migration across a reshard. ``owners`` preserves each
+    request's page ORDER (page sequence is token order); ``pages`` maps
+    page id -> ``(k, v)`` copies of shape ``(H, page_size, D)``."""
+
+    page_size: int
+    heads: int
+    head_dim: int
+    dtype: np.dtype
+    owners: Dict[int, List[int]]
+    pages: Dict[int, Tuple[np.ndarray, np.ndarray]]
+    checksum: str
+    nbytes: int
+    consumed: bool = False
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.pages)
+
+    def verify(self) -> None:
+        """Recompute the checksum over the held bytes; raises on a
+        corrupted snapshot (bit-rot between snapshot and restore)."""
+        h = hashlib.sha256()
+        n = 0
+        for page in sorted(self.pages):
+            k, v = self.pages[page]
+            n += _page_digest(h, page, k, v)
+        if h.hexdigest() != self.checksum or n != self.nbytes:
+            raise ValueError(
+                f"KV snapshot corrupted: checksum mismatch over "
+                f"{len(self.pages)} page(s) ({n} bytes)")
 
 
 class PagedKVAllocator:
@@ -143,6 +201,91 @@ class PagedKVAllocator:
         self.kp[:, r0:r0 + self.page_size, :] = k
         self.vp[:, r0:r0 + self.page_size, :] = v
 
+    # -- migration (elastic reshard) -----------------------------------
+    def snapshot(self) -> KVSnapshot:
+        """Checksummed copy of every live slab + the owner map — what a
+        reshard carries across allocators. Free pages are not captured
+        (their contents are garbage by contract)."""
+        with self._lock:
+            owners = {o: list(p) for o, p in self._owned.items() if p}
+        h = hashlib.sha256()
+        nbytes = 0
+        pages: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for page in sorted(p for held in owners.values() for p in held):
+            r0 = page * self.page_size
+            k = self.kp[:, r0:r0 + self.page_size, :].copy()
+            v = self.vp[:, r0:r0 + self.page_size, :].copy()
+            nbytes += _page_digest(h, page, k, v)
+            pages[page] = (k, v)
+        return KVSnapshot(page_size=self.page_size, heads=self.heads,
+                          head_dim=self.head_dim, dtype=self.dtype,
+                          owners=owners, pages=pages,
+                          checksum=h.hexdigest(), nbytes=nbytes)
+
+    def restore(self, snap: KVSnapshot) -> Dict[int, int]:
+        """Repack a snapshot's live slabs into THIS allocator: allocate
+        fresh pages per owner (order preserved), write the bytes back,
+        re-verify the checksum on what was actually written, and return
+        the old-page -> new-page mapping the engine rewrites request
+        holdings with. The target may be smaller than the source (a
+        reshard onto fewer slices) as long as it has capacity for the
+        LIVE pages; a snapshot restores exactly once."""
+        if snap.consumed:
+            raise ValueError(
+                "KV snapshot already restored; restoring it twice would "
+                "hand the same slabs to two allocators")
+        if (snap.page_size, snap.heads, snap.head_dim) != \
+                (self.page_size, self.heads, self.head_dim) or \
+                snap.dtype != self.dtype:
+            raise ValueError(
+                f"KV snapshot geometry (ps={snap.page_size}, "
+                f"H={snap.heads}, D={snap.head_dim}, {snap.dtype}) does "
+                f"not match this allocator (ps={self.page_size}, "
+                f"H={self.heads}, D={self.head_dim}, {self.dtype})")
+        snap.verify()
+        need = snap.n_pages
+        if self.free_pages < need:
+            raise KVCacheExhausted(
+                f"cannot restore KV snapshot: {need} live page(s), "
+                f"{self.free_pages}/{self.n_pages} free in the target",
+                site="serve.kv")
+        mapping: Dict[int, int] = {}
+        restored: List[Tuple[int, int]] = []   # (owner, new page) undo log
+        try:
+            for owner in sorted(snap.owners):
+                for old in snap.owners[owner]:
+                    new = self.alloc(1, owner)[0]
+                    restored.append((owner, new))
+                    k, v = snap.pages[old]
+                    self.fill_page(new, k, v)
+                    mapping[old] = new
+            # byte conservation, asserted on the WRITTEN bytes: re-read
+            # the target pages and re-derive the digest under the OLD
+            # page ids (the mapping is the identity of the migration,
+            # not the bytes)
+            h = hashlib.sha256()
+            nbytes = 0
+            for old in sorted(mapping):
+                r0 = mapping[old] * self.page_size
+                nbytes += _page_digest(
+                    h, old, self.kp[:, r0:r0 + self.page_size, :],
+                    self.vp[:, r0:r0 + self.page_size, :])
+            if h.hexdigest() != snap.checksum or nbytes != snap.nbytes:
+                raise ValueError(
+                    f"KV migration corrupted {need} page(s) in flight: "
+                    f"restored bytes do not match the snapshot checksum")
+        except Exception:
+            # a mid-restore failure (injected serve.kv fault, a
+            # corrupted write caught by the conservation check) must
+            # not leak half the migration into the target
+            for owner, new in restored:
+                self.free(owner, [new])
+            raise
+        snap.consumed = True
+        _trace.inc("serve.kv.migrated_pages", need)
+        _trace.inc("serve.kv.migrated_bytes", nbytes)
+        return mapping
+
     # -- accounting ----------------------------------------------------
     def holdings(self, owner: int) -> List[int]:
         with self._lock:
@@ -165,3 +308,19 @@ class PagedKVAllocator:
                 "free_count": self.free_count,
                 "owners": len(self._owned),
             }
+
+
+def migrate(src: PagedKVAllocator,
+            dst: PagedKVAllocator) -> Tuple[Dict[int, int], int]:
+    """Move every live slab from ``src`` to ``dst`` in one audited
+    step: snapshot (checksummed), restore (byte-conservation verified),
+    then release the source's slabs so BOTH allocators' books balance —
+    the global ``serve.kv.alloc_pages``/``free_pages`` counters stay
+    replayable across a reshard. Returns ``(old -> new page mapping,
+    bytes migrated)``. On a restore failure nothing moves: the source
+    keeps its slabs and the exception propagates."""
+    snap = src.snapshot()
+    mapping = dst.restore(snap)
+    for owner in snap.owners:
+        src.free(owner)
+    return mapping, snap.nbytes

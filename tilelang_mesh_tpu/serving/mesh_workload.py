@@ -1,0 +1,429 @@
+"""Mesh-sharded decode workload + the elastic layout ladder.
+
+This is the mesh-backed consumer of the ``serving/shard.py`` hooks
+(ROADMAP item 1's open remainder): a :class:`MeshDecodeWorkload`
+dispatches the shape-bucketed decode step through ``shard_map`` over a
+2-D host device mesh, deriving its ``in_specs`` from a
+:class:`~.shard.ServeShardConfig` layout (``head_parallel`` /
+``batch_parallel``) via :func:`~.shard.match_partition_rules` — the
+SNIPPETS.md [1]/[2] idioms the rule tables were staged for.
+
+The robustness contract is the product: **losing a mesh slice
+mid-decode degrades capacity, never correctness.** Each workload
+carries a layout *ladder* (``TL_TPU_SERVE_LAYOUTS``, default
+``head_parallel:2x2 -> head_parallel:2x1 -> no_sharding``); when a
+sharded step dies with a :class:`DeviceLossError` or a
+collective-watchdog timeout, the engine walks one rung down: the
+surviving KV slabs are snapshot/checksummed (``kv_cache.KVSnapshot``),
+the lost slice is quarantined in the PR 6 backend registry
+(``registry().quarantine_device``), the workload rebuilds its mesh +
+specs on the next rung, and the KV state migrates byte-conserved into
+the new placement. The terminal ``no_sharding`` rung is the PR 8
+single-host path through the crash-safe kernel cache, so the ladder
+always bottoms out on a layout that needs no mesh at all.
+
+Layout validation happens at workload build, not deep inside XLA: head
+and batch-bucket counts must divide the sharded axis size, every axis
+name in the config must exist on the concrete mesh, and the mesh must
+have enough non-quarantined host devices — violations raise
+:class:`~..verify.schedule.MeshVerifyError` naming the offending
+dimension.
+
+Observability: sharded steps land in the shared
+``kernel.latency{kernel=serve.step}`` histogram like every step; a
+sampled *straggler probe* (``TL_TPU_SERVE_SHARD_PROBE_EVERY``) times a
+tiny per-device dispatch into per-shard
+``serve.shard.latency{shard=x0y1}`` histograms and feeds the
+``shard_skew`` gauge, so a slow shard is visible before it is dead.
+``serve.shard`` is the fault site on the sharded dispatch (armed
+``kind=unreachable`` = a mesh slice dying mid-step; the
+``--serve-mesh`` chaos soak kills exactly one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..env import env
+from ..observability import histogram as _hist
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from .batcher import FlashDecodeWorkload
+from .kv_cache import PagedKVAllocator
+from .shard import ServeShardConfig, match_partition_rules
+
+__all__ = ["MeshLayout", "MeshDecodeWorkload", "layout_ladder",
+           "parse_layout", "validate_shard_config", "LAYOUT_KINDS"]
+
+LAYOUT_KINDS = ("head_parallel", "batch_parallel", "no_sharding")
+
+# the engine tensor names the partition-rule table is matched against,
+# in dispatch argument order (q, kp, vp, table) + the step output
+_IN_NAMES = ("step/q", "kv/k_pool", "kv/v_pool", "kv/page_table")
+_OUT_NAME = "step/out"
+
+
+def _verify_error(msg: str):
+    from ..verify.schedule import MeshVerifyError
+    return MeshVerifyError(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """One rung of the elastic layout ladder."""
+
+    kind: str                          # one of LAYOUT_KINDS
+    rows: int = 1
+    cols: int = 1
+
+    @property
+    def name(self) -> str:
+        if self.kind == "no_sharding":
+            return "no_sharding"
+        return f"{self.kind}:{self.rows}x{self.cols}"
+
+    @property
+    def sharded(self) -> bool:
+        return self.kind != "no_sharding"
+
+    @property
+    def devices(self) -> int:
+        return self.rows * self.cols if self.sharded else 1
+
+    def shard_config(self) -> ServeShardConfig:
+        if self.kind == "head_parallel":
+            return ServeShardConfig.head_parallel("x")
+        if self.kind == "batch_parallel":
+            return ServeShardConfig.batch_parallel("x")
+        return ServeShardConfig.no_sharding()
+
+
+def parse_layout(token: str) -> MeshLayout:
+    """``head_parallel:2x2`` / ``batch_parallel:1x4`` / ``no_sharding``
+    -> :class:`MeshLayout`. Raises ``ValueError`` on a malformed token
+    (a typo'd ladder must not silently serve unsharded)."""
+    token = token.strip()
+    if not token:
+        raise ValueError("empty layout token")
+    kind, _, shape = token.partition(":")
+    kind = kind.strip()
+    if kind not in LAYOUT_KINDS:
+        raise ValueError(
+            f"unknown serve layout kind {kind!r} (one of {LAYOUT_KINDS})")
+    if kind == "no_sharding":
+        if shape:
+            raise ValueError(
+                f"no_sharding takes no mesh shape, got {token!r}")
+        return MeshLayout("no_sharding")
+    try:
+        r, c = (int(x) for x in shape.lower().split("x"))
+    except Exception:
+        raise ValueError(
+            f"layout {token!r}: mesh shape must be RxC (e.g. 2x2)"
+        ) from None
+    if r < 1 or c < 1:
+        raise ValueError(f"layout {token!r}: mesh dims must be >= 1")
+    return MeshLayout(kind, r, c)
+
+
+def layout_ladder(spec: Optional[str] = None) -> List[MeshLayout]:
+    """The ordered degradation ladder from ``spec`` (default
+    ``TL_TPU_SERVE_LAYOUTS``). A ladder without a terminal
+    ``no_sharding`` rung gets one appended: capacity degradation must
+    always bottom out on a layout that cannot lose a slice."""
+    spec = spec if spec is not None else env.TL_TPU_SERVE_LAYOUTS
+    rungs = [parse_layout(t) for t in spec.split(",") if t.strip()]
+    if not rungs:
+        raise ValueError("TL_TPU_SERVE_LAYOUTS parsed to an empty ladder")
+    if rungs[-1].kind != "no_sharding":
+        rungs.append(MeshLayout("no_sharding"))
+    return rungs
+
+
+def _spec_axes(spec) -> List[Tuple[int, Tuple[str, ...]]]:
+    """(dim index, axis names) per sharded dim of one PartitionSpec."""
+    out = []
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        out.append((dim, tuple(str(n) for n in names)))
+    return out
+
+
+def validate_shard_config(cfg: ServeShardConfig, layout: MeshLayout, *,
+                          heads: int,
+                          batch_buckets: Sequence[int]) -> None:
+    """Validate a shard config against the CONCRETE mesh a layout will
+    build, at workload-build time: unknown mesh axis names and
+    non-divisible head/batch counts raise a named ``MeshVerifyError``
+    here instead of letting ``shard_map`` fail deep inside XLA."""
+    if not layout.sharded:
+        return
+    axis_sizes = {"x": layout.rows, "y": layout.cols}
+
+    def shard_factor(spec, dim: int) -> int:
+        f = 1
+        for d, names in _spec_axes(spec):
+            for n in names:
+                if n not in axis_sizes:
+                    raise _verify_error(
+                        f"serve layout {layout.name}: shard config "
+                        f"names mesh axis {n!r}, but the "
+                        f"{layout.rows}x{layout.cols} mesh has axes "
+                        f"{tuple(axis_sizes)}")
+                if d == dim:
+                    f *= axis_sizes[n]
+        return f
+
+    # walk EVERY spec so an unknown axis anywhere is rejected, then
+    # check the divisibility that matters per tensor
+    for field in ("kv_pool_hrd", "query_bhld", "table_bp", "out_bhld"):
+        shard_factor(getattr(cfg, field), -1)
+    hf = shard_factor(cfg.kv_pool_hrd, 0)
+    if hf > 1 and heads % hf:
+        raise _verify_error(
+            f"serve layout {layout.name}: {heads} head(s) not divisible "
+            f"by the sharded head-axis size {hf}")
+    qh = shard_factor(cfg.query_bhld, 1)
+    if qh > 1 and heads % qh:
+        raise _verify_error(
+            f"serve layout {layout.name}: {heads} query head(s) not "
+            f"divisible by the sharded head-axis size {qh}")
+    bf = max(shard_factor(cfg.query_bhld, 0), shard_factor(cfg.table_bp, 0))
+    if bf > 1:
+        bad = [b for b in batch_buckets if b % bf]
+        if bad:
+            raise _verify_error(
+                f"serve layout {layout.name}: batch bucket(s) {bad} not "
+                f"divisible by the sharded batch-axis size {bf}")
+
+
+class MeshDecodeWorkload(FlashDecodeWorkload):
+    """Flash-decode workload dispatched through ``shard_map`` over a
+    2-D host device mesh, with an elastic layout ladder.
+
+    The sharded rungs run the decode math as one SPMD program per
+    (batch, pages) bucket: each device holds its head (or batch) shard
+    of the H-major pools and computes its slice of the step; the
+    ``no_sharding`` terminal rung delegates to the single-host
+    ``flash_decode_paged_pool`` path (built through the crash-safe
+    kernel cache, exactly the PR 8 engine path). ``warmup()`` AOT
+    compiles + dispatches every bucket ON THE CURRENT RUNG; a layout
+    change clears the warm set so the next warm-up covers the new
+    layout.
+
+    The pools stay host-side numpy (tokens append in place between
+    steps), so every sharded step re-feeds them to the compiled SPMD
+    executable — the per-step upload is the price of in-place appends,
+    and the CPU-mesh smoke measures it honestly.
+    """
+
+    elastic = True
+
+    def __init__(self, allocator: PagedKVAllocator, *,
+                 layouts: Union[str, Sequence[MeshLayout], None] = None,
+                 shard_config: Optional[ServeShardConfig] = None,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8),
+                 page_buckets: Sequence[int] = (2, 4),
+                 sm_scale: Optional[float] = None):
+        super().__init__(allocator, batch_buckets=batch_buckets,
+                         page_buckets=page_buckets, sm_scale=sm_scale)
+        if isinstance(layouts, str) or layouts is None:
+            self.ladder = layout_ladder(layouts)
+        else:
+            self.ladder = list(layouts)
+            if not self.ladder:
+                raise ValueError("layout ladder must be non-empty")
+            if self.ladder[-1].kind != "no_sharding":
+                self.ladder.append(MeshLayout("no_sharding"))
+        self._shard_config_override = shard_config
+        self._rung = -1
+        self.mesh = None
+        self._in_specs: Optional[tuple] = None
+        self._out_spec = None
+        self._fns: Dict[tuple, object] = {}
+        self._apply_rung(0)
+
+    # -- layout ladder -------------------------------------------------
+    @property
+    def layout(self) -> MeshLayout:
+        return self.ladder[self._rung]
+
+    def can_degrade(self) -> bool:
+        return self._rung + 1 < len(self.ladder)
+
+    def _config_for(self, layout: MeshLayout) -> ServeShardConfig:
+        if layout.sharded and self._shard_config_override is not None:
+            return self._shard_config_override
+        return layout.shard_config()
+
+    def _apply_rung(self, rung: int,
+                    exclude: Sequence[str] = ()) -> None:
+        layout = self.ladder[rung]
+        cfg = self._config_for(layout)
+        validate_shard_config(cfg, layout, heads=self.allocator.heads,
+                              batch_buckets=self.batch_buckets)
+        if layout.sharded:
+            from ..parallel.device_mesh import make_host_mesh
+            try:
+                mesh = make_host_mesh(layout.rows, layout.cols,
+                                      exclude=exclude)
+            except ValueError as e:
+                raise _verify_error(
+                    f"serve layout {layout.name}: {e}") from e
+            specs = match_partition_rules(cfg.rules(), _IN_NAMES)
+            out_spec = match_partition_rules(cfg.rules(), [_OUT_NAME])[0]
+        else:
+            mesh, specs, out_spec = None, None, None
+        self.mesh = mesh
+        self._in_specs = tuple(specs) if specs is not None else None
+        self._out_spec = out_spec
+        self._rung = rung
+        self._fns.clear()            # per-layout SPMD programs
+        self._warm.clear()            # buckets re-warm per layout
+
+    def degrade(self, exclude: Sequence[str] = ()) -> MeshLayout:
+        """Step down the ladder: apply the next rung that can build on
+        the surviving (non-excluded) devices. Rungs that cannot build
+        are skipped with a traced event; ``no_sharding`` always builds.
+        Raises when the ladder is spent."""
+        rung = self._rung + 1
+        while rung < len(self.ladder):
+            try:
+                self._apply_rung(rung, exclude=exclude)
+                return self.layout
+            except Exception as e:  # noqa: BLE001 — rung skipped, traced
+                if rung == len(self.ladder) - 1:
+                    raise
+                _trace.event("serve.layout_skipped", "serving",
+                             layout=self.ladder[rung].name,
+                             error=f"{type(e).__name__}: {e}")
+                rung += 1
+        raise _verify_error("serve layout ladder is spent")
+
+    def make_allocator(self) -> PagedKVAllocator:
+        """A fresh allocator with this workload's geometry — the
+        migration target a reshard restores the KV snapshot into."""
+        a = self.allocator
+        return PagedKVAllocator(a.n_pages, a.page_size, a.heads,
+                                a.head_dim, dtype=str(a.dtype))
+
+    def install_allocator(self, alloc: PagedKVAllocator) -> None:
+        """Swap in the migrated allocator (after a successful
+        ``restore``; the engine rewrites request page ids)."""
+        self.allocator = alloc
+
+    # -- sharded dispatch ----------------------------------------------
+    def _dispatch(self, q, table, bb: int, pp: int):
+        layout = self.layout
+        if not layout.sharded:
+            return super()._dispatch(q, table, bb, pp)
+        _faults.maybe_fail("serve.shard", layout=layout.name,
+                           batch=bb, pages=pp)
+        fn = self._fns.get((bb, pp))
+        if fn is None:
+            fn = self._build_sharded_fn(bb, pp)
+            self._fns[(bb, pp)] = fn
+        out = fn(np.asarray(q, np.float32), self.allocator.kp,
+                 self.allocator.vp, np.asarray(table, np.int32))
+        return np.asarray(out)
+
+    def _build_sharded_fn(self, bb: int, pp: int):
+        """One jitted ``shard_map`` SPMD program for this bucket on the
+        current mesh + specs: every device computes plain decode
+        attention over ITS head/batch shard of the pools (table-driven
+        page walk, softmax over the full ``pp`` page window — the same
+        math ``flash_decode_paged_pool`` runs single-host)."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.device_mesh import shard_map_compat
+
+        ps = self.allocator.page_size
+        scale = self.sm_scale
+
+        def local_step(q, kp, vp, table):
+            # q (b, h, 1, D) / kp, vp (h, rows, D) / table (b, PP) —
+            # shapes are the per-device shards under the layout's specs
+            b, ppl = table.shape
+            idx = (table[:, :, None] * ps
+                   + jnp.arange(ps)[None, None, :]).reshape(b, ppl * ps)
+            h, _, d = kp.shape
+            k = jnp.take(kp, idx.reshape(-1), axis=1
+                         ).reshape(h, b, ppl * ps, d)
+            v = jnp.take(vp, idx.reshape(-1), axis=1
+                         ).reshape(h, b, ppl * ps, d)
+            s = jnp.einsum("bhqd,hbsd->bhqs", q, k) * scale
+            w = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqs,hbsd->bhqd", w, v)
+
+        spmd = shard_map_compat(local_step, self.mesh,
+                                self._in_specs, self._out_spec)
+        return jax.jit(spmd)
+
+    # -- straggler probe -----------------------------------------------
+    def shard_names(self) -> List[str]:
+        if self.mesh is None:
+            return []
+        return [f"x{i}y{j}"
+                for (i, j), _ in np.ndenumerate(self.mesh.devices)]
+
+    def probe_shards(self) -> Optional[float]:
+        """Time one tiny dispatch per mesh device into the per-shard
+        ``serve.shard.latency{shard=}`` histograms; returns the skew
+        ratio (slowest/fastest probe this sweep, >= 1.0) the engine
+        publishes as the ``shard_skew`` gauge. A straggling slice shows
+        up here while it is still answering — before it is dead."""
+        if self.mesh is None:
+            return None
+        import jax
+        payload = np.ones((8, 8), np.float32)
+        times = {}
+        for (i, j), dev in np.ndenumerate(self.mesh.devices):
+            t0 = time.perf_counter()
+            jax.device_put(payload, dev).block_until_ready()
+            dt = time.perf_counter() - t0
+            name = f"x{i}y{j}"
+            _hist.observe("serve.shard.latency", dt, shard=name)
+            times[name] = dt
+        fastest = min(times.values())
+        skew = (max(times.values()) / fastest) if fastest > 0 else 1.0
+        return max(skew, 1.0)
+
+    def probe_lost(self, timeout_s: float = 0.25) -> List[str]:
+        """Bounded per-device liveness sweep after a sharded-step
+        failure: each mesh device gets one tiny dispatch on an
+        abandoned-on-timeout daemon thread (a dead device HANGS jax
+        calls rather than erroring — same idiom as the PR 6 probes);
+        devices that hang or raise are presumed lost. Injected losses
+        leave every host device answering, so an empty result is the
+        common chaos-soak outcome."""
+        if self.mesh is None:
+            return []
+        import jax
+
+        from ..codegen.backends import _bounded
+        payload = np.ones((4,), np.float32)
+        dead: List[str] = []
+        for dev in self.mesh.devices.flat:
+            def _probe(d=dev):
+                jax.device_put(payload, d).block_until_ready()
+            try:
+                _bounded(_probe, f"shard {dev} probe", timeout_s)
+            except Exception:  # noqa: BLE001 — hang or raise = lost
+                dead.append(str(dev))
+        return dead
+
+    # -- accounting ----------------------------------------------------
+    def layout_stats(self) -> dict:
+        return {
+            "layout": self.layout.name,
+            "rung": self._rung,
+            "ladder": [r.name for r in self.ladder],
+            "mesh_devices": ([str(d) for d in self.mesh.devices.flat]
+                             if self.mesh is not None else []),
+        }

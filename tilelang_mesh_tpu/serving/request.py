@@ -144,9 +144,12 @@ class Request:
 
 # process-wide live-gauge snapshot the engines publish into and
 # metrics_summary()["serving"] reads (tracer counters are monotonic;
-# queue depth / slabs-in-use are levels, so they live here)
+# queue depth / slabs-in-use are levels, so they live here); _META is
+# the string-valued sibling (active mesh layout name — a level too,
+# just not a number)
 _GAUGE_LOCK = threading.Lock()
 _GAUGES: Dict[str, float] = {}
+_META: Dict[str, str] = {}
 
 
 def publish_gauges(**values: float) -> None:
@@ -159,6 +162,25 @@ def gauges() -> Dict[str, float]:
         return dict(_GAUGES)
 
 
+def publish_meta(**values: str) -> None:
+    with _GAUGE_LOCK:
+        _META.update({k: str(v) for k, v in values.items()})
+
+
+def serving_meta() -> Dict[str, str]:
+    with _GAUGE_LOCK:
+        return dict(_META)
+
+
+def clear_gauges(*names: str) -> None:
+    """Drop named level-gauges (e.g. ``shard_skew`` on a reshard: the
+    old layout's straggler signal must not outlive its mesh)."""
+    with _GAUGE_LOCK:
+        for n in names:
+            _GAUGES.pop(n, None)
+
+
 def reset_gauges() -> None:
     with _GAUGE_LOCK:
         _GAUGES.clear()
+        _META.clear()

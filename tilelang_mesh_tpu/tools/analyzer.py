@@ -547,7 +547,9 @@ def summarize_serve(records) -> dict:
     sheds: dict = {}
     failures: list = []
     deadline_misses: list = []
+    reshard_events: list = []
     hists: dict = {}
+    shard_hists: dict = {}
     from ..observability.export import shed_reason_from_counter
     for r in records:
         name = r.get("name")
@@ -563,16 +565,26 @@ def summarize_serve(records) -> dict:
                                  "error": attrs.get("error")})
             elif name == "serve.deadline_exceeded":
                 deadline_misses.append(attrs.get("req"))
+            elif name == "serve.reshard":
+                reshard_events.append(
+                    {k: attrs.get(k)
+                     for k in ("frm", "to", "pages", "bytes", "lost")})
             elif name == "serve.shed" and "reason" in attrs:
                 pass     # counted via the labelled counter lines
         elif r.get("type") == "histogram" and name in (
-                "serve.queue.wait", "serve.e2e.latency", "kernel.latency"):
+                "serve.queue.wait", "serve.e2e.latency",
+                "serve.shard.latency", "kernel.latency"):
             labels = r.get("labels", {})
             if name == "kernel.latency" and \
                     labels.get("kernel") != "serve.step":
                 continue
             from ..observability.histogram import Histogram
             h = Histogram.from_dict(r)
+            if name == "serve.shard.latency":
+                shard = labels.get("shard", "?")
+                acc = shard_hists.get(shard)
+                shard_hists[shard] = h if acc is None else acc.merge(h)
+                continue
             key = name if name != "kernel.latency" else "serve.step.latency"
             if labels.get("outcome"):
                 key = f"{name}{{outcome={labels['outcome']}}}"
@@ -586,6 +598,10 @@ def summarize_serve(records) -> dict:
     from ..observability.histogram import digest_ms
     digests = {k: digest_ms(h) for k, h in sorted(hists.items())
                if h.count}
+    from ..observability.histogram import p50_skew
+    shard_digests = {k: digest_ms(h) for k, h in sorted(shard_hists.items())
+                     if h.count}
+    skew = p50_skew(shard_digests)
     alloc = counters.get("serve.kv.alloc_pages", 0)
     freed = counters.get("serve.kv.free_pages", 0)
     return {
@@ -599,10 +615,20 @@ def summarize_serve(records) -> dict:
         "steps": flat("serve.steps"),
         "retries": counters.get("serve.retries", 0),
         "failovers": counters.get("serve.failover", 0),
+        "reshards": flat("serve.reshard"),
+        "reshard_events": reshard_events,
+        "layout": (reshard_events[-1].get("to")
+                   if reshard_events else None),
+        "shard_latency": shard_digests,
+        "shard_skew": skew,
         "step_failures": {k.split("=", 1)[-1].rstrip("}"): v
                           for k, v in counters.items()
                           if k.startswith("serve.step_failures{")},
         "kv": {"alloc_pages": alloc, "free_pages": freed,
+               "migrated_pages": counters.get("serve.kv.migrated_pages",
+                                              0),
+               "migrated_bytes": counters.get("serve.kv.migrated_bytes",
+                                              0),
                "balance": alloc - freed},
         "latency": digests,
         "request_failures": failures,
@@ -636,6 +662,31 @@ def format_serve_report(records) -> str:
         lines.append("  step failures by kind   "
                      + ", ".join(f"{k}={int(v)}" for k, v in
                                  sorted(s["step_failures"].items())))
+    if s["reshards"] or s["shard_latency"]:
+        lines.append("mesh serving (elastic):")
+        lines.append(f"  reshards                {int(s['reshards'])}"
+                     + (f"  (final layout {s['layout']})"
+                        if s["layout"] else ""))
+        for ev in s["reshard_events"]:
+            lost = ev.get("lost") or []
+            lines.append(
+                f"  reshard {ev.get('frm')} -> {ev.get('to')}: "
+                f"{ev.get('pages')} page(s) / {ev.get('bytes')} bytes "
+                f"migrated"
+                + (f", lost={lost}" if lost else ""))
+        if s["kv"]["migrated_pages"]:
+            lines.append(f"  kv pages migrated       "
+                         f"{int(s['kv']['migrated_pages'])} "
+                         f"({int(s['kv']['migrated_bytes'])} bytes)")
+        if s["shard_latency"]:
+            lines.append("  per-shard latency (straggler probe):")
+            for shard, d in s["shard_latency"].items():
+                lines.append(
+                    f"    {shard}: n={d['count']} p50={d['p50_ms']}ms "
+                    f"p99={d['p99_ms']}ms max={d['max_ms']}ms")
+            if s["shard_skew"] is not None:
+                lines.append(f"  shard skew (p50 max/min) "
+                             f"{s['shard_skew']}")
     if s["latency"]:
         lines.append("latency digests:")
         for k, d in s["latency"].items():

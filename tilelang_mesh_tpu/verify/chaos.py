@@ -31,6 +31,19 @@ wave — asserting every request reaches a terminal outcome, zero KV
 slabs leak, and the shed/deadline accounting matches the histograms
 (docs/serving.md).
 
+``--serve-mesh`` is the fourth chaos mode (elastic mesh serving,
+docs/serving.md): the same storm through a ``MeshDecodeWorkload``
+sharded over the 2x2 host device mesh, with a mesh slice killed
+mid-step (``serve.shard`` armed ``kind=unreachable``). Exit 0 requires
+100% terminal outcomes, at least one recorded reshard down the layout
+ladder, zero leaked KV slabs, KV byte-conservation across the
+migration, and counter/histogram accounting agreement.
+
+``--seeds 7,13,42`` runs the selected mode once per seed (artifacts
+land in ``<out>/seed<N>`` when more than one); the exit code is the
+worst of the runs. Without ``--seeds`` the single ``--seed`` (default
+7) runs exactly as before.
+
 Usage::
 
     JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
@@ -39,6 +52,8 @@ Usage::
         --out chaos_device_loss --seed 7
     JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
         --serve --requests 500 --out chaos_serve --seed 7
+    JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.verify.chaos \
+        --serve-mesh --seeds 7,13,42 --out chaos_serve_mesh
 """
 
 # NOTE: no `from __future__ import annotations` here — the T.prim_func
@@ -242,6 +257,49 @@ def run_device_loss(out: Path, seed: int) -> int:
     return 0 if ok else 1
 
 
+def _reset_serving_state() -> None:
+    """Per-seed reset of the process-global serving/observability
+    state: the serve soaks' accounting checks compare ABSOLUTE counters
+    against per-run request outcomes, so a multi-seed invocation
+    (``--seeds 7,13,42``) must start every seed from a clean slate."""
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.resilience.retry import global_breaker
+    from tilelang_mesh_tpu.serving import reset_gauges
+    obs.reset()
+    reset_gauges()
+    global_breaker().reset()
+    try:
+        from tilelang_mesh_tpu.codegen import backends as _backends
+        if _backends._REGISTRY is not None:
+            _backends._REGISTRY.reset()
+    except Exception:
+        pass
+
+
+def _serve_accounting(eng, counters) -> tuple:
+    """The counters-vs-outcomes-vs-``serve.e2e.latency``-histograms
+    agreement predicate BOTH serve soaks gate on — one definition so
+    the ``serve-smoke`` and ``mesh-serve-smoke`` CI gates can never
+    silently test different accounting contracts. Returns
+    ``(e2e_by_outcome, acct_ok)``."""
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    outcomes = eng.outcomes()
+    e2e_by_outcome: dict = {}
+    for (name, labels), h in _hist.histograms():
+        if name == "serve.e2e.latency":
+            oc = dict(labels).get("outcome", "?")
+            e2e_by_outcome[oc] = e2e_by_outcome.get(oc, 0) + h.count
+    acct_ok = (
+        counters["completed"] == outcomes["result"]
+        and counters["deadline_exceeded"] == outcomes["deadline_exceeded"]
+        and counters["failed"] == outcomes["failed"]
+        and counters["shed_total"] == outcomes["shed"]
+        and sum(e2e_by_outcome.values()) == len(eng.requests)
+        and all(e2e_by_outcome.get(k, 0) == v
+                for k, v in outcomes.items() if k != "pending"))
+    return e2e_by_outcome, acct_ok
+
+
 def run_serve(out: Path, seed: int, n_requests: int) -> int:
     """Seeded serving-engine chaos soak (the CI ``serve-smoke`` job and
     the ISSUE 8 acceptance gate): ``n_requests`` requests with a
@@ -269,6 +327,7 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
                                            PagedKVAllocator,
                                            ServingEngine)
 
+    _reset_serving_state()
     rng = random.Random(seed)
     alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
                              head_dim=64)
@@ -375,19 +434,7 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
     leaks = alloc.leak_check()
     outcomes = eng.outcomes()
     counters = obs.metrics_summary()["serving"]
-    e2e_by_outcome = {}
-    for (name, labels), h in _hist.histograms():
-        if name == "serve.e2e.latency":
-            oc = dict(labels).get("outcome", "?")
-            e2e_by_outcome[oc] = e2e_by_outcome.get(oc, 0) + h.count
-    acct_ok = (
-        counters["completed"] == outcomes["result"]
-        and counters["deadline_exceeded"] == outcomes["deadline_exceeded"]
-        and counters["failed"] == outcomes["failed"]
-        and counters["shed_total"] == outcomes["shed"]
-        and sum(e2e_by_outcome.values()) == len(eng.requests)
-        and all(e2e_by_outcome.get(k, 0) == v
-                for k, v in outcomes.items() if k != "pending"))
+    e2e_by_outcome, acct_ok = _serve_accounting(eng, counters)
     kv_ok = (not leaks and alloc.in_use == 0
              and alloc.alloc_count == alloc.free_count)
     checks = {
@@ -432,41 +479,174 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
     return 0 if ok else 1
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m tilelang_mesh_tpu.verify.chaos",
-        description="Seeded chaos run proving the mesh guardrails catch "
-                    "corrupted collective schedules (docs/robustness.md).")
-    ap.add_argument("--out", default="chaos_report",
-                    help="directory for the trace + report artifacts")
-    ap.add_argument("--seed", type=int, default=7)
-    ap.add_argument("--device-loss", action="store_true",
-                    help="device-loss mode: kill the worker at a seeded "
-                         "random config index of a bench.py --hermetic "
-                         "sweep and assert the failover tier still "
-                         "produces a record per CPU-safe config")
-    ap.add_argument("--serve", action="store_true",
-                    help="serving-engine soak: seeded request storm with "
-                         "serve.* faults armed and the device killed "
-                         "mid-batch; asserts every request reaches a "
-                         "terminal outcome with zero KV-slab leaks "
-                         "(docs/serving.md)")
-    ap.add_argument("--requests", type=int, default=500,
-                    help="request count for --serve (default 500)")
-    args = ap.parse_args(argv)
+def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
+    """Elastic mesh-serving chaos soak (the CI ``mesh-serve-smoke``
+    gate): a seeded request storm through a ``MeshDecodeWorkload``
+    sharded over the 2x2 host device mesh, with a mesh SLICE killed
+    mid-step (``serve.shard`` armed ``kind=unreachable``) and low-rate
+    transient step faults underneath. Asserts the elastic contract —
+    losing a slice degrades capacity, never correctness:
 
-    if args.device_loss:
-        out = Path(args.out)
-        out.mkdir(parents=True, exist_ok=True)
-        return run_device_loss(out, args.seed)
+    - every request reaches a terminal outcome (no drops, no hangs);
+    - at least one reshard walked the layout ladder down, and the
+      final layout differs from the starting rung;
+    - KV slabs balance to zero globally (allocs == frees across BOTH
+      the pre- and post-migration allocators, no leaked owners);
+    - KV byte-conservation across the migration: every ``serve.reshard``
+      event's migrated bytes equal pages x page-bytes, and the
+      ``serve.kv.migrated_*`` counters agree (the checksummed
+      ``restore()`` already hard-verified the bytes in flight);
+    - the outcome accounting in the counters matches the
+      ``serve.e2e.latency`` histograms.
+    """
+    import random
 
-    if args.serve:
-        out = Path(args.out)
-        out.mkdir(parents=True, exist_ok=True)
-        return run_serve(out, args.seed, args.requests)
+    os.environ["TL_TPU_TRACE"] = "1"
+    # APPEND the host-device flag to any ambient XLA_FLAGS (a bare
+    # setdefault would be a no-op under e.g. XLA_FLAGS=--xla_cpu_...,
+    # leaving 1 CPU device and killing the 2x2 mesh build)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (MeshDecodeWorkload,
+                                           PagedKVAllocator,
+                                           ServingEngine)
 
-    out = Path(args.out)
-    out.mkdir(parents=True, exist_ok=True)
+    _reset_serving_state()
+    rng = random.Random(seed)
+    alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
+                             head_dim=64)
+    wl = MeshDecodeWorkload(alloc, batch_buckets=(8,),
+                            page_buckets=(2, 4))
+    import time as _time
+    eng = ServingEngine(wl, name="mesh-soak")
+    t_warm0 = _time.perf_counter()
+    warmed = eng.warmup()
+    warm_s = _time.perf_counter() - t_warm0
+    first_layout = wl.layout.name
+
+    if n_requests < 20:
+        print(f"[chaos-serve-mesh] --requests {n_requests} is below the "
+              f"soak minimum (20): the kill/drain phases need room to "
+              f"fire", file=sys.stderr)  # noqa: T201
+        return 2
+
+    def make_request():
+        ctx = rng.choice((16, 24, 32))
+        steps = rng.choice((1, 1, 2, 3))
+        deadline = None if rng.random() < 0.8 else 2000.0
+        return dict(context_tokens=ctx, new_tokens=steps,
+                    deadline_ms=deadline, seed=rng.randrange(1 << 30))
+
+    drain_wave = max(4, n_requests // 25)
+    main_wave = n_requests - drain_wave
+    kill_at = rng.randrange(main_wave // 4, main_wave // 2)
+    print(f"[chaos-serve-mesh] seed={seed}: {n_requests} requests "  # noqa: T201
+          f"({drain_wave} after drain) on layout {first_layout}, "
+          f"{warmed} bucket kernels warmed in {warm_s:.1f}s; slice "
+          f"kill at ~request {kill_at}")
+    t0 = _time.perf_counter()
+    with inject("serve.step", p=0.02, seed=seed, kind="transient"):
+        submitted = 0
+        killed = False
+        while submitted < main_wave:
+            wave = min(rng.randrange(8, 33), main_wave - submitted)
+            for _ in range(wave):
+                eng.submit(**make_request())
+            submitted += wave
+            if not killed and submitted >= kill_at:
+                # the mesh slice dies mid-step at a seeded point: the
+                # engine must snapshot the surviving KV, quarantine,
+                # walk one ladder rung down, migrate, and re-admit
+                killed = True
+                with inject("serve.shard", kind="unreachable", times=1):
+                    eng.step()
+            for _ in range(rng.randrange(1, 4)):
+                eng.step()
+        eng.drain()
+        for _ in range(drain_wave):
+            eng.submit(**make_request())
+        eng.run()
+    wall_s = _time.perf_counter() - t0
+
+    # -- the elastic contract checks -----------------------------------
+    cur = eng.workload.allocator       # post-migration allocator
+    leaks = cur.leak_check()
+    outcomes = eng.outcomes()
+    counters = obs.metrics_summary()["serving"]
+    non_terminal = [r.req_id for r in eng.requests if not r.is_terminal]
+    e2e_by_outcome, acct_ok = _serve_accounting(eng, counters)
+    # byte conservation: 2 pools x H x page_size x D x itemsize per page
+    page_bytes = 2 * cur.heads * cur.page_size * cur.head_dim \
+        * cur.dtype.itemsize
+    resh_events = [e.get("attrs", {})
+                   for e in obs.get_tracer().events()
+                   if e.get("type") == "event"
+                   and e.get("name") == "serve.reshard"]
+    mig_pages = counters["kv_pages_migrated"]
+    conserve_ok = (
+        resh_events != []
+        and all(ev.get("bytes") == ev.get("pages", 0) * page_bytes
+                for ev in resh_events)
+        and mig_pages == sum(ev.get("pages", 0) for ev in resh_events))
+    kv_ok = (not leaks and cur.in_use == 0
+             and counters["kv_pages_allocated"]
+             == counters["kv_pages_freed"])
+    checks = {
+        "all_terminal": not non_terminal,
+        "kv_slabs_balance_zero": kv_ok,
+        "resharded_down_the_ladder": counters["reshards"] >= 1
+        and wl.layout.name != first_layout,
+        "kv_bytes_conserved_across_migration": conserve_ok,
+        "accounting_matches_histograms": acct_ok,
+        "engine_completed_some_work": outcomes["result"] > 0,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "mode": "serve-mesh", "seed": seed, "requests": n_requests,
+        "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
+        "warmed_kernels": warmed,
+        "first_layout": first_layout,
+        "final_layout": wl.layout.name,
+        "ladder": [r.name for r in wl.ladder],
+        "reshards": counters["reshards"],
+        "reshard_events": resh_events,
+        "kv_pages_migrated": mig_pages,
+        "outcomes": outcomes,
+        "shed_by_reason": counters["shed"],
+        "retries": counters["retries"],
+        "steps": eng.stats()["steps"],
+        "kv": cur.stats(),
+        "kv_leaks": {str(k): v for k, v in leaks.items()},
+        "e2e_by_outcome": e2e_by_outcome,
+        "non_terminal_requests": non_terminal,
+        "checks": checks, "ok": ok,
+    }
+    trace_path = out / "serve_mesh_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "serve_mesh_report.json").write_text(
+        json.dumps(report, indent=2))
+    from ..tools.analyzer import format_serve_report
+    summary = format_serve_report(obs.read_jsonl(str(trace_path)))
+    (out / "serve_mesh_report.txt").write_text(summary + "\n")
+    print(summary)  # noqa: T201
+    for k, v in checks.items():
+        print(f"[chaos-serve-mesh] {k}: {'OK' if v else 'FAIL'}")  # noqa: T201
+    print(f"[chaos-serve-mesh] layout {first_layout} -> "  # noqa: T201
+          f"{wl.layout.name}, outcomes={outcomes} in {wall_s:.1f}s -> "
+          f"{'PASS' if ok else 'FAIL'}; artifacts in {out}/")
+    return 0 if ok else 1
+
+
+def run_verify(out: Path, seed: int) -> int:
+    """The default mode: seeded corruption on the comm interpret paths,
+    the differential selfcheck must catch every scenario."""
     os.environ["TL_TPU_TRACE"] = "1"
     os.environ["TL_TPU_SELFCHECK"] = "1"
     os.environ.setdefault("XLA_FLAGS",
@@ -474,10 +654,11 @@ def main(argv=None) -> int:
 
     from tilelang_mesh_tpu import observability as obs
 
-    report = {"seed": args.seed, "scenarios": []}
+    obs.reset()      # per-seed clean slate (multi-seed invocations)
+    report = {"seed": seed, "scenarios": []}
     ok = True
     for i, (name, prog, cfg, site) in enumerate(_programs()):
-        ok = _run_one(name, prog, cfg, site, args.seed + i, report) and ok
+        ok = _run_one(name, prog, cfg, site, seed + i, report) and ok
     report["ok"] = ok
 
     trace_path = out / "chaos_trace.jsonl"
@@ -491,6 +672,70 @@ def main(argv=None) -> int:
     print(f"[chaos-verify] {'PASS' if ok else 'FAIL'}; artifacts in "  # noqa: T201
           f"{out}/")
     return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.verify.chaos",
+        description="Seeded chaos run proving the mesh guardrails catch "
+                    "corrupted collective schedules (docs/robustness.md).")
+    ap.add_argument("--out", default="chaos_report",
+                    help="directory for the trace + report artifacts")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--seeds", type=str, default=None,
+                    help="comma-separated seed list (e.g. 7,13,42): runs "
+                         "the selected mode once per seed — artifacts in "
+                         "<out>/seed<N> when more than one — and exits "
+                         "with the worst run's code. Overrides --seed.")
+    ap.add_argument("--device-loss", action="store_true",
+                    help="device-loss mode: kill the worker at a seeded "
+                         "random config index of a bench.py --hermetic "
+                         "sweep and assert the failover tier still "
+                         "produces a record per CPU-safe config")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-engine soak: seeded request storm with "
+                         "serve.* faults armed and the device killed "
+                         "mid-batch; asserts every request reaches a "
+                         "terminal outcome with zero KV-slab leaks "
+                         "(docs/serving.md)")
+    ap.add_argument("--serve-mesh", action="store_true",
+                    help="elastic mesh-serving soak: the storm through a "
+                         "MeshDecodeWorkload sharded over the 2x2 host "
+                         "mesh, a mesh slice killed mid-step; asserts "
+                         "100%% terminal outcomes, a recorded reshard "
+                         "down the layout ladder, zero KV leaks, and "
+                         "byte-conservation across the KV migration "
+                         "(docs/serving.md)")
+    ap.add_argument("--requests", type=int, default=500,
+                    help="request count for --serve / --serve-mesh "
+                         "(default 500)")
+    args = ap.parse_args(argv)
+
+    try:
+        seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
+                 if args.seeds else [args.seed])
+    except ValueError:
+        ap.error(f"--seeds must be a comma list of integers, got "
+                 f"{args.seeds!r}")
+    if not seeds:
+        ap.error("--seeds parsed to an empty list")
+    out = Path(args.out)
+
+    def per_seed(runner) -> int:
+        rc = 0
+        for s in seeds:
+            d = out if len(seeds) == 1 else out / f"seed{s}"
+            d.mkdir(parents=True, exist_ok=True)
+            rc = max(rc, runner(d, s))
+        return rc
+
+    if args.device_loss:
+        return per_seed(run_device_loss)
+    if args.serve:
+        return per_seed(lambda d, s: run_serve(d, s, args.requests))
+    if args.serve_mesh:
+        return per_seed(lambda d, s: run_serve_mesh(d, s, args.requests))
+    return per_seed(run_verify)
 
 
 if __name__ == "__main__":
