@@ -161,7 +161,11 @@ mesh_program(k) mesh=(2x2) axes=(x,y):
 
 
 def test_dce_golden_schedule():
-    assert _lower(_dce_program()).plan_desc == """\
+    # tl.tpu.lint off: this program DELIBERATELY writes a never-read
+    # fragment (the DCE seed), which rule TL006 would rightly flag —
+    # the golden here is the comm_opt rewrite text, not the lint block
+    assert _lower(_dce_program(),
+                  **{"tl.tpu.lint": "0"}).plan_desc == """\
 mesh_program(k) mesh=(2x2) axes=(x,y):
   [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(B)
   comm_opt[fuse,dce,overlap]: wire 128B -> 0B, hops 4 -> 0

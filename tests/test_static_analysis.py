@@ -1,0 +1,826 @@
+"""tl-lint static-analysis suite tests (analysis/dataflow.py,
+analysis/regions.py, analysis/rules.py, analysis/checkers.py,
+tools/lint.py; docs/static_analysis.md).
+
+Layout:
+
+- dataflow / region engine unit tests;
+- per-rule golden fire/no-fire pairs, including the SEEDED MUTATION
+  SWEEP: one known-good GEMM-shaped kernel, six mutations each injecting
+  exactly one bug class, each asserted to fire its rule with the golden
+  message while the clean kernel stays silent (the PR 5 chaos pattern
+  applied to the front end);
+- TL_TPU_LINT=0/warn/strict semantics and plan_desc/attrs/counters
+  surfacing (goldens byte-stable when clean);
+- golden-message tests for the four legacy checkers (TL101-TL104) and
+  their aggregation into ONE SemanticError;
+- CLI smoke over ops/gemm.py + ops/flash_attention.py and the
+  CLI == in-pipeline consistency check.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.analysis import (
+    Diagnostic, SemanticError, collect_diagnostics, legacy_diagnostics,
+    lint_mode, run_semantic_checks)
+from tilelang_mesh_tpu.analysis import dataflow as df
+from tilelang_mesh_tpu.analysis import regions as rg
+from tilelang_mesh_tpu.ir import CopyStmt, FillStmt, GemmStmt, Var
+
+
+def _rules(diags):
+    return {d.rule for d in diags}
+
+
+def _msgs(diags, rule):
+    return [d.message for d in diags if d.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# dataflow engine unit tests
+# ---------------------------------------------------------------------------
+
+
+def _simple_kernel():
+    @T.prim_func
+    def k(A: T.Tensor((128, 128), "float32"),
+          B: T.Tensor((128, 128), "float32")):
+        with T.Kernel(1) as bx:
+            A_s = T.alloc_shared((128, 128), "float32")
+            acc = T.alloc_fragment((128, 128), "float32")
+            T.copy(A[0, 0], A_s)
+            T.clear(acc)
+            for i, j in T.Parallel(128, 128):
+                acc[i, j] = acc[i, j] + A_s[i, j]
+            T.copy(acc, B[0, 0])
+    return k.func
+
+
+class TestDataflow:
+    def test_stmt_accesses_copy(self):
+        func = _simple_kernel()
+        copies = [s for s, _ in df.iter_stmts(func.body)
+                  if isinstance(s, CopyStmt)]
+        acc = df.stmt_accesses(copies[0])
+        kinds = [(a.kind, a.attr) for a in acc]
+        assert ("read", "src") in kinds and ("write", "dst") in kinds
+
+    def test_stmt_accesses_gemm_accum_reads_c(self):
+        @T.prim_func
+        def g(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                c = T.alloc_fragment((128, 128), "float32")
+                T.copy(A[0, 0], s)
+                T.clear(c)
+                T.gemm(s, s, c)                      # accumulating
+                T.gemm(s, s, c, clear_accum=True)    # clearing
+        gemms = [s for s, _ in df.iter_stmts(g.func.body)
+                 if isinstance(s, GemmStmt)]
+        accum = df.stmt_accesses(gemms[0])
+        clear = df.stmt_accesses(gemms[1])
+        assert ("read", "C") in [(a.kind, a.attr) for a in accum]
+        assert ("read", "C") not in [(a.kind, a.attr) for a in clear]
+        # reads are listed before the C write (init-order contract)
+        c_events = [(a.kind) for a in accum if a.attr == "C"]
+        assert c_events == ["read", "write"]
+
+    def test_iter_stmts_reaches_else_branch(self):
+        @T.prim_func
+        def k(A: T.Tensor((8, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((8, 128), "float32")
+                with T.If(bx == 0):
+                    T.fill(s, 1.0)
+                with T.Else():
+                    T.fill(s, 2.0)
+        fills = [(s, ctx) for s, ctx in df.iter_stmts(k.func.body)
+                 if isinstance(s, FillStmt)]
+        assert len(fills) == 2
+        # the else-arm fill carries a negative-polarity guard
+        assert fills[1][1].guards[-1][1] is False
+
+    def test_def_use_counts(self):
+        func = _simple_kernel()
+        du = df.def_use(func)
+        by_name = {d.buffer.name: d for d in du.values()}
+        assert len(by_name["shared"].writes) == 1    # the copy in
+        assert len(by_name["shared"].reads) == 1     # the parallel read
+        assert len(by_name["frag"].writes) == 2      # clear + store
+        assert len(by_name["frag"].reads) == 2       # store value + copy
+
+    def test_writes_in_and_scratch(self):
+        func = _simple_kernel()
+        scratch = df.scratch_buffers(func)
+        assert {b.name for b in scratch.values()} == {"shared", "frag"}
+        kn = func.kernel_node()
+        assert df.writes_in(kn.body) >= set(scratch)
+
+
+class TestRegions:
+    def test_expr_interval(self):
+        i, j = Var("i"), Var("j")
+        r = rg.VarRanges()
+        r.add(i, 0, 7)
+        r.add(j, 0, 3)
+        assert rg.expr_interval(i * 16 + j, r) == (0, 115)
+        assert rg.expr_interval(8 - i, r) == (1, 8)
+        assert rg.expr_interval(5, r) == (5, 5)
+        k = Var("k")      # unranged var -> unknown
+        assert rg.expr_interval(i + k, r) is None
+
+    def test_access_affine_and_missing(self):
+        i, j = Var("i"), Var("j")
+        forms = rg.access_affine((i, 0), [i, j])
+        assert forms is not None
+        assert [v.name for v in rg.vars_missing_from(forms, [i, j])] \
+            == ["j"]
+        assert rg.vars_missing_from(rg.access_affine((i, j), [i, j]),
+                                    [i, j]) == []
+
+    def test_collision_shift(self):
+        i = Var("i")
+        w = rg.access_affine((i,), [i])
+        r = rg.access_affine((i + 1,), [i])
+        hit = rg.collision_shift(w, r, {id(i): 8})
+        assert hit == (id(i), 1)
+        # same-iteration access is not a collision
+        assert rg.collision_shift(w, w, {id(i): 8}) is None
+        # shift outside the extent is unreachable
+        r9 = rg.access_affine((i + 9,), [i])
+        assert rg.collision_shift(w, r9, {id(i): 8}) is None
+
+
+# ---------------------------------------------------------------------------
+# seeded mutation sweep: one clean kernel, six injected bug classes
+# ---------------------------------------------------------------------------
+
+
+def _mutant(mutate=None):
+    """A known-good pipelined GEMM-shaped kernel; each mutation injects
+    exactly one bug class."""
+    par_n = 132 if mutate == "TL004" else 128
+
+    @T.prim_func
+    def k(A: T.Tensor((256, 256), "float32"),
+          B: T.Tensor((256, 256), "float32"),
+          C: T.Tensor((256, 256), "float32")):
+        with T.Kernel(2, 2) as (bx, by):
+            A_s = T.alloc_shared((128, 128), "float32")
+            B_s = T.alloc_shared((128, 128), "float32")
+            C_l = T.alloc_fragment((128, 128), "float32")
+            if mutate == "TL006":
+                T.alloc_fragment((128, 128), "float32")
+            if mutate != "TL003":
+                T.clear(C_l)
+            for ko in T.Pipelined(2):
+                T.copy(A[by * 128, ko * 128], A_s)
+                T.copy(B[ko * 128, bx * 128], B_s)
+                T.gemm(A_s, B_s, C_l, clear_accum=False)
+            for i, j in T.Parallel(128, par_n):
+                if mutate == "TL001":
+                    C_l[0, j] = C_l[i, j] * 2.0
+                else:
+                    C_l[i, j] = C_l[i, j] * 2.0
+            T.copy(C_l, C[by * 128, bx * 128])
+    return k.func
+
+
+class TestMutationSweep:
+    def test_clean_kernel_is_silent(self):
+        diags = collect_diagnostics(_mutant(None))
+        assert diags == []
+
+    def test_tl001_parallel_race_fires(self):
+        diags = collect_diagnostics(_mutant("TL001"))
+        assert "TL001" in _rules(diags)
+        msg = _msgs(diags, "TL001")[0]
+        assert "race" in msg and "C_l" not in msg or "frag" in msg
+
+    def test_tl002_pipeline_hazard_fires(self):
+        @T.prim_func
+        def k(A: T.Tensor((256, 128), "float32"),
+              B: T.Tensor((256, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                o = T.alloc_fragment((128, 128), "float32")
+                sem = T.alloc_semaphore(2)
+                T.copy_async(A[0, 0], s, sem, 0)
+                for i, j in T.Parallel(128, 128):
+                    o[i, j] = s[i, j]            # consumed before wait
+                T.copy_wait(A[0, 0], s, sem, 0)
+                T.copy(o, B[0, 0])
+        diags = collect_diagnostics(k.func)
+        assert "TL002" in _rules(diags)
+        assert any("T.copy_wait" in m for m in _msgs(diags, "TL002"))
+
+    def test_tl003_uninitialized_read_fires(self):
+        diags = collect_diagnostics(_mutant("TL003"))
+        assert "TL003" in _rules(diags)
+        msg = _msgs(diags, "TL003")[0]
+        assert "GemmStmt.C" in msg and "clear_accum" in msg
+
+    def test_tl004_out_of_bounds_fires(self):
+        diags = collect_diagnostics(_mutant("TL004"))
+        assert "TL004" in _rules(diags)
+        assert any("walks outside" in m for m in _msgs(diags, "TL004"))
+
+    def test_tl005_vmem_budget_fires(self):
+        diags = collect_diagnostics(
+            _mutant(None), {"tl.tpu.vmem_budget_bytes": 4096})
+        assert "TL005" in _rules(diags)
+        msg = _msgs(diags, "TL005")[0]
+        assert "exceeds" in msg and "largest consumers" in msg
+
+    def test_tl006_dead_store_fires(self):
+        diags = collect_diagnostics(_mutant("TL006"))
+        assert "TL006" in _rules(diags)
+        assert any("never used" in m for m in _msgs(diags, "TL006"))
+
+
+# ---------------------------------------------------------------------------
+# per-rule precision (no-fire on the idioms the ops library uses)
+# ---------------------------------------------------------------------------
+
+
+class TestTL001Precision:
+    def test_elementwise_update_is_clean(self):
+        assert collect_diagnostics(_simple_kernel()) == []
+
+    def test_idempotent_broadcast_store_is_warning(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                v = T.alloc_fragment((1,), "float32")
+                T.copy(A[0, 0], s)
+                T.fill(v, 0.0)
+                for i in T.Parallel(128):
+                    v[0] = 7.0           # same value every iteration
+                s[0, 0] = v[0]
+        diags = [d for d in collect_diagnostics(k.func)
+                 if d.rule == "TL001"]
+        assert len(diags) == 1 and diags[0].severity == "warning"
+        assert "idempotent" in diags[0].message
+
+    def test_value_dependent_broadcast_is_error(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                v = T.alloc_fragment((1,), "float32")
+                T.copy(A[0, 0], s)
+                T.fill(v, 0.0)
+                for i, j in T.Parallel(128, 128):
+                    v[0] = v[0] + s[i, j]     # lost-update reduction
+                s[0, 0] = v[0]
+        diags = [d for d in collect_diagnostics(k.func)
+                 if d.rule == "TL001"]
+        assert diags and diags[0].severity == "error"
+        assert diags[0].buffer == "frag"
+
+    def test_shifted_read_fires(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                T.copy(A[0, 0], s)
+                for i, j in T.Parallel(127, 128):
+                    s[i, j] = s[i + 1, j]     # cross-iteration shift
+        diags = [d for d in collect_diagnostics(k.func)
+                 if d.rule == "TL001"]
+        assert diags and "read-write race" in diags[0].message
+        # iteration i writes s[i], which iteration i-1 READS (as s[i])
+        assert "iteration i-1 reads" in diags[0].message
+
+    def test_sibling_of_nested_parallel_not_charged(self):
+        """Review regression: a store that is a SIBLING of a nested
+        T.Parallel must not be judged over that loop's vars."""
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                row = T.alloc_fragment((128,), "float32")
+                T.copy(A[0, 0], s)
+                for i in T.Parallel(128):
+                    row[i] = s[i, 0]        # uses i: fine
+                    for j in T.Parallel(128):
+                        s[i, j] = s[i, j] + 1.0   # uses i and j: fine
+                T.copy(s, B[0, 0])
+        assert "TL001" not in _rules(collect_diagnostics(k.func))
+
+    def test_atomic_add_in_parallel_is_clean(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              O: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                T.copy(A[0, 0], s)
+                for i, j in T.Parallel(128, 128):
+                    T.atomic_add(O[i, j], s[i, j])
+        assert "TL001" not in _rules(collect_diagnostics(k.func))
+
+
+class TestTL002Precision:
+    def test_double_buffered_pipeline_is_clean(self):
+        """The examples/warp_specialize split-phase DMA schedule: start
+        one slab ahead, wait right before the gemm — no hazard."""
+        nstep = 4
+
+        @T.prim_func
+        def k(A: T.Tensor((128, 512), "float32"),
+              C: T.Tensor((128, 512), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((2, 128, 128), "float32")
+                sem = T.alloc_semaphore(2)
+                T.copy_async(A[0, 0], s[0, 0:128, 0:128], sem, 0)
+                for ko in range(nstep):
+                    cur, nxt = ko % 2, (ko + 1) % 2
+                    if ko + 1 < nstep:
+                        T.copy_async(A[0, (ko + 1) * 128],
+                                     s[nxt, 0:128, 0:128], sem, nxt)
+                    T.copy_wait(A[0, ko * 128],
+                                s[cur, 0:128, 0:128], sem, cur)
+                    T.copy(s[cur, 0:128, 0:128],
+                           C[0:128, ko * 128:(ko + 1) * 128])
+        diags = collect_diagnostics(k.func)
+        assert "TL002" not in _rules(diags)
+
+    def test_slot_reuse_fires(self):
+        @T.prim_func
+        def k(A: T.Tensor((256, 128), "float32"),
+              B: T.Tensor((256, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((2, 128, 128), "float32")
+                sem = T.alloc_semaphore(2)
+                T.copy_async(A[0, 0], s[0, 0:128, 0:128], sem, 0)
+                T.copy_async(A[128, 0], s[1, 0:128, 0:128], sem, 0)
+                T.copy_wait(A[0, 0], s[0, 0:128, 0:128], sem, 0)
+                T.copy(s[0, 0:128, 0:128], B[0:128, 0:128])
+        diags = collect_diagnostics(k.func)
+        assert any("re-armed" in m for m in _msgs(diags, "TL002"))
+
+    def test_extent_one_loop_has_no_back_edge(self):
+        """Review regression: a loop whose every static extent is 1 has
+        no second iteration, so the loop-carried reuse scan must not
+        model one (no false slot-reuse on nK=1 pipelines)."""
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                sem = T.alloc_semaphore(1)
+                for ko in T.serial(1):
+                    T.copy_async(A[0, 0], s, sem, 0)
+                T.copy_wait(A[0, 0], s, sem, 0)
+                T.copy(s, B[0, 0])
+        assert "TL002" not in _rules(collect_diagnostics(k.func))
+
+    def test_dynamic_slot_wait_covers_never_awaited(self):
+        """Review regression: a T.copy_wait with a dynamic slot expr
+        (ko % 2) must count as awaiting every slot of its semaphore."""
+        @T.prim_func
+        def k(A: T.Tensor((256, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                sem = T.alloc_semaphore(2)
+                T.copy_async(A[0, 0], s, sem, 0)
+                for ko in T.serial(2):
+                    T.copy_wait(A[0, 0], s, sem, ko % 2)
+                T.copy(s, B[0, 0])
+        assert "TL002" not in _rules(collect_diagnostics(k.func))
+
+    def test_never_awaited_is_warning(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                sem = T.alloc_semaphore(1)
+                T.copy_async(A[0, 0], s, sem, 0)
+                T.copy(A[0, 0], B[0, 0])
+        diags = [d for d in collect_diagnostics(k.func)
+                 if d.rule == "TL002"]
+        assert diags and diags[0].severity == "warning"
+        assert "never awaited" in diags[0].message
+
+
+class TestTL003Precision:
+    def test_guarded_first_iteration_init_is_clean(self):
+        """The flash-attention idiom: state filled under If(ko == 0)."""
+        @T.prim_func
+        def k(A: T.Tensor((256, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                acc = T.alloc_fragment((128, 128), "float32")
+                for ko in T.Pipelined(2):
+                    with T.If(ko == 0):
+                        T.fill(acc, 0.0)
+                    T.copy(A[ko * 128, 0], s)
+                    for i, j in T.Parallel(128, 128):
+                        acc[i, j] = acc[i, j] + s[i, j]
+                T.copy(acc, B[0, 0])
+        assert "TL003" not in _rules(collect_diagnostics(k.func))
+
+    def test_loop_carried_read_behind_guard_is_clean(self):
+        """Software-pipeline idiom: If(ko > 0) guards the read of a
+        value the previous iteration wrote."""
+        @T.prim_func
+        def k(A: T.Tensor((256, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                prev = T.alloc_fragment((128, 128), "float32")
+                out = T.alloc_fragment((128, 128), "float32")
+                T.fill(out, 0.0)
+                for ko in T.Pipelined(2):
+                    with T.If(ko > 0):
+                        for i, j in T.Parallel(128, 128):
+                            out[i, j] = out[i, j] + prev[i, j]
+                    T.copy(A[ko * 128, 0], prev)
+                T.copy(out, B[0, 0])
+        assert "TL003" not in _rules(collect_diagnostics(k.func))
+
+    def test_read_in_else_branch_fires(self):
+        """The traversal-gap regression: an uninitialized read hiding in
+        a T.Else body must be reachable by the analysis."""
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(2) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                with T.If(bx == 0):
+                    T.copy(A[0, 0], s)
+                    T.copy(s, B[0, 0])
+                with T.Else():
+                    T.copy(s, B[0, 0])     # s never written on this path
+        diags = collect_diagnostics(k.func)
+        assert "TL003" in _rules(diags)
+
+    def test_partial_then_branch_init_is_maybe_not_flagged(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(2) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                with T.If(bx == 0):
+                    T.copy(A[0, 0], s)
+                T.copy(s, B[0, 0])     # maybe-initialized: not flagged
+        assert "TL003" not in _rules(collect_diagnostics(k.func))
+
+
+class TestTL004Precision:
+    def test_guarded_ragged_access_is_clean(self):
+        @T.prim_func
+        def k(A: T.Tensor((100, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((100, 128), "float32")
+                T.copy(A[0, 0], s)
+                for i, j in T.Parallel(128, 128):
+                    with T.If(i < 100):
+                        B[i, j] = s[i, j]
+        assert "TL004" not in _rules(collect_diagnostics(k.func))
+
+    def test_global_oob_is_warning_onchip_is_error(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((200, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((48, 128), "float32")
+                for ko in T.serial(3):
+                    T.copy(A[ko * 48, 0], s)     # 3*48=144 > 128: global
+                    T.copy(s, B[ko * 48, 0])
+        diags = [d for d in collect_diagnostics(k.func)
+                 if d.rule == "TL004"]
+        assert diags and all(d.severity == "warning" for d in diags)
+
+        @T.prim_func
+        def k2(A: T.Tensor((256, 128), "float32"),
+               B: T.Tensor((256, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((100, 128), "float32")
+                T.copy(A[0, 0], s[0:100, 0:128])
+                for i, j in T.Parallel(128, 128):
+                    B[i, j] = s[i, j]            # 128 > 100 rows: VMEM
+        diags2 = [d for d in collect_diagnostics(k2.func)
+                  if d.rule == "TL004"]
+        assert diags2 and any(d.severity == "error" for d in diags2)
+
+
+# ---------------------------------------------------------------------------
+# legacy checkers: golden messages + aggregation (TL100-TL104)
+# ---------------------------------------------------------------------------
+
+
+class TestLegacyCheckers:
+    def test_tl101_async_copy_in_parallel_fires(self):
+        """The traversal-gap fix: split-phase DMA inside T.Parallel was
+        previously invisible to the nested-loop checker."""
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                sem = T.alloc_semaphore(1)
+                for i in T.Parallel(128):
+                    T.copy_async(A[0, 0], s, sem, 0)
+        diags = legacy_diagnostics(k.func)
+        assert any(d.rule == "TL101" and "AsyncCopyStmt" in d.message
+                   for d in diags)
+
+    def test_tl101_golden_message(self):
+        @T.prim_func
+        def k(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((128, 128), "float32")
+                for i in T.Parallel(128):
+                    T.copy(A[0, 0], s)
+        msgs = [d.message for d in legacy_diagnostics(k.func)
+                if d.rule == "TL101"]
+        assert msgs == ["tile op CopyStmt inside T.Parallel; hoist it "
+                        "out of the elementwise loop"]
+
+    def test_tl103_golden_message_and_loc(self):
+        @T.prim_func
+        def k(A: T.Tensor((16, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((16, 128), "float32")
+                T.copy(A[4:20, 0:128], s)   # rows [4:20) exceed 16
+        diags = [d for d in legacy_diagnostics(k.func)
+                 if d.rule == "TL103"]
+        assert diags
+        assert "window [4:20) exceeds A dim 0 (extent 16)" \
+            in diags[0].message
+        assert diags[0].loc and "test_static_analysis.py" in diags[0].loc
+
+    def test_aggregation_one_error_reports_all(self):
+        """Findings from DIFFERENT checkers land in one SemanticError."""
+        @T.prim_func
+        def k(A: T.Tensor((16, 128), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((16, 128), "float32")
+                T.copy(A[4:20, 0:128], s)       # TL103 bounds
+                for i in T.Parallel(16):
+                    T.copy(A[0, 0], s)          # TL101 tile op
+        with pytest.raises(SemanticError) as ei:
+            run_semantic_checks(k.func)
+        text = str(ei.value)
+        assert "TL101" in text and "TL103" in text
+        assert {d.rule for d in ei.value.diagnostics} == {"TL101",
+                                                          "TL103"}
+
+
+# ---------------------------------------------------------------------------
+# TL_TPU_LINT knob + surfacing
+# ---------------------------------------------------------------------------
+
+
+def _racy_func():
+    """Lints with a TL001 error; the race also trips codegen, so only
+    strict mode (which raises BEFORE codegen) lowers this one."""
+    @T.prim_func
+    def racy(A: T.Tensor((128, 128), "float32"),
+             B: T.Tensor((128, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((128, 128), "float32")
+            v = T.alloc_fragment((1,), "float32")
+            T.copy(A[0, 0], s)
+            T.fill(v, 0.0)
+            for i, j in T.Parallel(128, 128):
+                v[0] = v[0] + s[i, j]
+            s[0, 0] = v[0]
+            T.copy(s, B[0, 0])
+    return racy
+
+
+def _dirty_compilable():
+    """Lints dirty (TL003 error + TL006 info) but codegens fine — the
+    kernel the warn-mode surfacing tests lower end to end."""
+    @T.prim_func
+    def dirty(A: T.Tensor((128, 128), "float32"),
+              B: T.Tensor((128, 128), "float32")):
+        with T.Kernel(2) as bx:
+            s = T.alloc_shared((128, 128), "float32")
+            dead = T.alloc_fragment((8, 128), "float32")
+            T.fill(dead, 0.0)                  # TL006: never read
+            with T.If(bx == 0):
+                T.copy(A[0, 0], s)
+                T.copy(s, B[0, 0])
+            with T.Else():
+                T.copy(s, B[0, 0])             # TL003: uninit path
+    return dirty
+
+
+class TestLintKnob:
+    def test_mode_parsing(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        assert lint_mode() == "warn"
+        monkeypatch.setenv("TL_TPU_LINT", "0")
+        assert lint_mode() == "off"
+        monkeypatch.setenv("TL_TPU_LINT", "strict")
+        assert lint_mode() == "strict"
+        assert lint_mode({"tl.tpu.lint": "off"}) == "off"
+        monkeypatch.setenv("TL_TPU_LINT", "bogus")
+        with pytest.raises(ValueError, match="TL_TPU_LINT"):
+            lint_mode()
+
+    def test_warn_mode_compiles_and_surfaces(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        art = tilelang.lower(_dirty_compilable())
+        lint = art.attrs.get("lint")
+        assert lint and {d["rule"] for d in lint} == {"TL003", "TL006"}
+        assert "lint[warn]" in art.plan_desc
+        assert "TL003" in art.plan_desc
+
+    def test_off_mode_adds_nothing(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "0")
+        art = tilelang.lower(_dirty_compilable())
+        assert "lint" not in art.attrs
+        assert "lint[" not in art.plan_desc
+
+    def test_strict_mode_raises(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "strict")
+        with pytest.raises(SemanticError, match="TL001"):
+            tilelang.lower(_racy_func())
+
+    def test_clean_plan_desc_byte_stable(self, monkeypatch):
+        from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+        monkeypatch.setenv("TL_TPU_LINT", "0")
+        matmul_kernel.cache_clear()
+        off = matmul_kernel(256, 256, 256, 128, 128, 128) \
+            .artifact.plan_desc
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        matmul_kernel.cache_clear()
+        warn = matmul_kernel(256, 256, 256, 128, 128, 128) \
+            .artifact.plan_desc
+        assert off == warn
+        assert "lint[" not in warn
+
+    def test_counters_and_metrics_summary(self, monkeypatch):
+        obs.reset()
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        tilelang.lower(_dirty_compilable())
+        summary = obs.metrics_summary()["lint"]
+        assert summary["findings"] >= 2
+        assert summary["errors"] >= 1
+        assert "TL003" in summary["by_rule"]
+        c = obs.get_tracer().counters()
+        assert any(k.startswith("lint.findings{rule=TL003")
+                   for k in c)
+
+    def test_cache_does_not_bypass_strict(self, monkeypatch):
+        """Review regression: the lint mode is part of the kernel-cache
+        key, so a warn-mode cached artifact cannot satisfy a strict
+        compile (which must re-check and reject)."""
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        f = _dirty_compilable()
+        tilelang.compile(f)                      # cached under warn
+        monkeypatch.setenv("TL_TPU_LINT", "strict")
+        with pytest.raises(SemanticError, match="TL003"):
+            tilelang.compile(f)
+
+    def test_strict_clean_kernel_still_compiles(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "strict")
+        art = tilelang.lower(_simple_kernel())
+        assert "lint[" not in art.plan_desc
+
+    def test_source_loc_points_at_kernel_line(self):
+        diags = [d for d in collect_diagnostics(_racy_func().func)
+                 if d.rule == "TL001"]
+        assert diags and diags[0].loc
+        assert "test_static_analysis.py" in diags[0].loc
+
+
+class TestMeshSurfacing:
+    def test_mesh_lint_block_and_attrs(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        from tilelang_mesh_tpu.parallel import mesh_config
+        with mesh_config(2, 2):
+            @T.prim_func
+            def k(A: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32"),
+                  B: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32")):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment((8, 128), "float32")
+                    dead = T.alloc_fragment((8, 1), "float32")
+                    T.copy(A, x)
+                    T.comm.all_reduce(x, dead, "sum", "v", dim=1)
+                    T.copy(x, B)
+        art = tilelang.lower(k, target="cpu-mesh[2x2]")
+        assert art.attrs["lint"] and \
+            art.attrs["lint"][0]["rule"] == "TL006"
+        assert "lint[warn]" in art.plan_desc
+
+    def test_mesh_clean_program_adds_nothing(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_LINT", "warn")
+        from tilelang_mesh_tpu.parallel import mesh_config
+        with mesh_config(2, 2):
+            @T.prim_func
+            def k(A: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32"),
+                  B: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32")):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment((8, 128), "float32")
+                    T.copy(A, x)
+                    T.copy(x, B)
+        art = tilelang.lower(k, target="cpu-mesh[2x2]")
+        assert art.attrs["lint"] is None
+        assert "lint[" not in art.plan_desc
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_cli_smoke_over_oplib_modules(self):
+        from tilelang_mesh_tpu.tools.lint import lint_targets
+        report = lint_targets(["tilelang_mesh_tpu/ops/gemm.py",
+                               "tilelang_mesh_tpu/ops/flash_attention.py"])
+        assert report["kernels_linted"] >= 2
+        assert report["summary"]["errors"] == 0
+
+    def test_cli_main_json_and_exit_codes(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import lint as lint_cli
+        mod = tmp_path / "clean_mod.py"
+        mod.write_text(textwrap.dedent("""\
+            import tilelang_mesh_tpu.language as T
+
+            @T.prim_func
+            def ok(A: T.Tensor((128, 128), "float32"),
+                   B: T.Tensor((128, 128), "float32")):
+                with T.Kernel(1) as bx:
+                    s = T.alloc_shared((128, 128), "float32")
+                    T.copy(A[0, 0], s)
+                    T.copy(s, B[0, 0])
+        """))
+        rc = lint_cli.main([str(mod), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["kernels_linted"] == 1
+        assert out["summary"]["errors"] == 0
+
+        bad = tmp_path / "racy_mod.py"
+        bad.write_text(textwrap.dedent("""\
+            import tilelang_mesh_tpu.language as T
+
+            @T.prim_func
+            def racy(A: T.Tensor((128, 128), "float32"),
+                     B: T.Tensor((128, 128), "float32")):
+                with T.Kernel(1) as bx:
+                    s = T.alloc_shared((128, 128), "float32")
+                    v = T.alloc_fragment((1,), "float32")
+                    T.copy(A[0, 0], s)
+                    T.fill(v, 0.0)
+                    for i, j in T.Parallel(128, 128):
+                        v[0] = v[0] + s[i, j]
+                    s[0, 0] = v[0]
+                    T.copy(s, B[0, 0])
+        """))
+        outfile = tmp_path / "report.json"
+        rc = lint_cli.main([str(bad), "--out", str(outfile)])
+        capsys.readouterr()
+        assert rc == 1
+        saved = json.loads(outfile.read_text())
+        assert saved["summary"]["errors"] >= 1
+        assert any(f["rule"] == "TL001" for f in saved["findings"])
+
+    def test_cli_matches_pipeline_findings(self):
+        """The CLI and the in-pipeline pass agree on the same kernel."""
+        func = _racy_func().func
+        cli_view = collect_diagnostics(func, with_plan=True)
+        pipeline_view = run_semantic_checks(func)   # warn mode default
+        from tilelang_mesh_tpu.analysis import run_plan_lint
+        from tilelang_mesh_tpu.transform.plan import plan_kernel
+        pipeline_view = list(pipeline_view) + \
+            run_plan_lint(func, plan_kernel(func, {}))
+        assert sorted((d.rule, d.message) for d in cli_view) == \
+            sorted((d.rule, d.message) for d in pipeline_view)
+
+    def test_analyzer_lint_subcommand(self, capsys):
+        from tilelang_mesh_tpu.tools.analyzer import main as analyzer_main
+        rc = analyzer_main(["lint", "tilelang_mesh_tpu/ops/gemm.py",
+                            "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["kernels_linted"] >= 1
+
+    def test_diagnostic_round_trip(self):
+        d = Diagnostic("TL001", "error", "msg", kernel="k",
+                       buffer="b", op="CopyStmt", loc="f.py:3")
+        assert Diagnostic.from_dict(d.to_dict()) == d

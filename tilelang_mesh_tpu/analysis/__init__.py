@@ -1,4 +1,9 @@
 from .checkers import (NestedLoopChecker, FragmentLoopChecker,
-                       run_semantic_checks, SemanticError)
+                       StaticBoundsChecker, CollectiveAliasChecker,
+                       run_semantic_checks, collect_diagnostics,
+                       legacy_diagnostics, SemanticError)
+from .diagnostics import Diagnostic, LintReport, SEVERITIES
+from .rules import (RULES, lint_mode, run_lint, run_plan_lint,
+                    record_findings, plan_desc_block)
 from .layout_visual import (visualize_plan, visualize_fragment,
                             visualize_mesh_blocks)

@@ -1,79 +1,107 @@
-"""Pre-lower semantic checks.
+"""Pre-lower semantic checks + the tl-lint entry point.
 
 Reference: /root/reference/tilelang/analysis/nested_loop_checker.py and
 fragment_loop_checker.py, run by PreLowerSemanticCheck
 (tilelang/engine/phase.py:112). Same job here: reject IR shapes the rest of
 the pipeline would mis-compile, with actionable messages.
+
+Since the tl-lint PR every checker emits structured ``Diagnostic``s with
+stable rule ids (TL101-TL104; TL100 = missing kernel frame) and the DSL
+source location, every checker runs even when an earlier one found errors
+(one aggregated ``SemanticError`` reports them ALL), and
+``run_semantic_checks`` additionally runs the dataflow lint rules
+(TL001-TL006, analysis/rules.py) under the ``TL_TPU_LINT`` knob:
+``warn`` (default) surfaces findings in plan_desc/attrs/counters,
+``strict`` escalates error-severity findings to a hard SemanticError,
+``0`` turns the lint rules off (the TL1xx semantic checks stay on —
+they guard the lowering itself). See docs/static_analysis.md.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
-from ..ir import (CommStmt, CopyStmt, ForNest, GemmStmt, KernelNode, PrimFunc,
-                  walk)
+from ..ir import (AsyncCopyStmt, CommStmt, CopyStmt, ForNest, GemmStmt,
+                  PrimFunc, walk)
+from .diagnostics import Diagnostic, stmt_loc
 
 
 class SemanticError(Exception):
-    pass
+    """Aggregated pre-lower failure; ``.diagnostics`` carries the
+    structured findings behind the text."""
+
+    def __init__(self, msg: str, diagnostics: Optional[list] = None):
+        super().__init__(msg)
+        self.diagnostics = diagnostics or []
 
 
 class NestedLoopChecker:
     """Pipelined loops must not nest inside Parallel loops, and T.Parallel
-    nests must not contain tile-ops (they are elementwise regions)."""
+    nests must not contain tile-ops (they are elementwise regions).
+    Rule TL101."""
 
-    def check(self, func: PrimFunc) -> List[str]:
-        errs: List[str] = []
+    RULE = "TL101"
+    # tile ops with no elementwise meaning: split-phase DMA included (the
+    # traversal gap fixed by the tl-lint PR — AsyncCopyStmt inside a
+    # T.Parallel was previously invisible). AtomicStmt is deliberately
+    # absent: an atomic accumulate IS elementwise-legal in Parallel
+    # (transform/plan.py lowers it via _elementwise_access).
+    _TILE_OPS = (CopyStmt, AsyncCopyStmt, GemmStmt, CommStmt)
 
-        def visit(s, in_parallel=False):
-            if isinstance(s, ForNest):
-                if s.kind == "parallel":
-                    for c in s.body.stmts:
-                        visit(c, True)
-                    return
-                if in_parallel:
-                    errs.append(
-                        f"loop kind {s.kind!r} nested inside T.Parallel; "
-                        "T.Parallel bodies must be elementwise")
-                for c in s.body.stmts:
-                    visit(c, in_parallel)
-            elif in_parallel and isinstance(s, (CopyStmt, GemmStmt,
-                                                CommStmt)):
-                errs.append(
-                    f"tile op {type(s).__name__} inside T.Parallel; hoist it "
-                    "out of the elementwise loop")
-            else:
-                for attr in ("body", "then_body", "else_body"):
-                    b = getattr(s, attr, None)
-                    if b is not None:
-                        for c in getattr(b, "stmts", []):
-                            visit(c, in_parallel)
-
+    def diagnostics(self, func: PrimFunc) -> List[Diagnostic]:
+        from .dataflow import iter_stmts
+        out: List[Diagnostic] = []
         kn = func.kernel_node()
-        if kn is not None:
-            for s in kn.body.stmts:
-                visit(s)
-        return errs
+        if kn is None:
+            return out
+        for s, ctx in iter_stmts(kn.body):
+            in_parallel = any(ln.kind == "parallel" for ln in ctx.loops)
+            if not in_parallel:
+                continue
+            if isinstance(s, ForNest) and s.kind != "parallel":
+                out.append(Diagnostic(
+                    self.RULE, "error",
+                    f"loop kind {s.kind!r} nested inside T.Parallel; "
+                    "T.Parallel bodies must be elementwise",
+                    op="ForNest", loc=stmt_loc(s)))
+            elif isinstance(s, self._TILE_OPS):
+                out.append(Diagnostic(
+                    self.RULE, "error",
+                    f"tile op {type(s).__name__} inside T.Parallel; "
+                    "hoist it out of the elementwise loop",
+                    op=type(s).__name__, loc=stmt_loc(s)))
+        return out
+
+    # string-message compatibility surface
+    def check(self, func: PrimFunc) -> List[str]:
+        return [d.message for d in self.diagnostics(func)]
 
 
 class FragmentLoopChecker:
     """Comm ops must sit at the top level of the kernel body (the SPMD
-    phase-splitter cannot hoist them out of loops yet)."""
+    phase-splitter cannot hoist them out of loops yet). Rule TL102."""
 
-    def check(self, func: PrimFunc) -> List[str]:
-        errs: List[str] = []
+    RULE = "TL102"
+
+    def diagnostics(self, func: PrimFunc) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
         kn = func.kernel_node()
         if kn is None:
-            return errs
+            return out
         top = set(id(s) for s in kn.body.stmts)
 
         def note(s):
             if isinstance(s, CommStmt) and id(s) not in top:
-                errs.append(
-                    "T.comm.* collective nested inside a loop/branch; move "
-                    "it to the top level of the T.Kernel body")
+                out.append(Diagnostic(
+                    self.RULE, "error",
+                    "T.comm.* collective nested inside a loop/branch; "
+                    "move it to the top level of the T.Kernel body",
+                    op=type(s).__name__, loc=stmt_loc(s)))
         walk(kn.body, note)
-        return errs
+        return out
+
+    def check(self, func: PrimFunc) -> List[str]:
+        return [d.message for d in self.diagnostics(func)]
 
 
 class StaticBoundsChecker:
@@ -82,14 +110,18 @@ class StaticBoundsChecker:
     legalize_safe_memory_access.cc, which predicates every access; on TPU
     Pallas masks ragged grid-mapped blocks itself, so only windows that
     are provably out of range for EVERY execution need rejecting, and
-    they get a named error instead of a downstream shape mismatch)."""
+    they get a named error instead of a downstream shape mismatch).
+    Rule TL103; the affine loop-var extension lives in rule TL004
+    (analysis/rules.py)."""
 
-    def check(self, func: PrimFunc) -> List[str]:
+    RULE = "TL103"
+
+    def diagnostics(self, func: PrimFunc) -> List[Diagnostic]:
         from ..ir import Region, as_int
-        errs: List[str] = []
+        out: List[Diagnostic] = []
         seen = set()
 
-        def chk_region(r: Region, what: str):
+        def chk_region(r: Region, what: str, stmt):
             if id(r) in seen:
                 return
             seen.add(id(r))
@@ -102,9 +134,12 @@ class StaticBoundsChecker:
                 if bi is None:
                     continue  # dynamic starts are clamped/masked at run
                 if bi < 0 or bi + sz > dim:
-                    errs.append(
+                    out.append(Diagnostic(
+                        self.RULE, "error",
                         f"{what}: window [{bi}:{bi + sz}) exceeds "
-                        f"{r.buffer.name} dim {d} (extent {dim})")
+                        f"{r.buffer.name} dim {d} (extent {dim})",
+                        buffer=r.buffer.name,
+                        op=type(stmt).__name__, loc=stmt_loc(stmt)))
 
         def note(s):
             # generic scan: every Region-valued attribute of every
@@ -112,9 +147,12 @@ class StaticBoundsChecker:
             # send/recv/buffer/out today)
             for at, r in vars(s).items():
                 if isinstance(r, Region):
-                    chk_region(r, f"{type(s).__name__}.{at}")
+                    chk_region(r, f"{type(s).__name__}.{at}", s)
         walk(func.body, note)
-        return errs
+        return out
+
+    def check(self, func: PrimFunc) -> List[str]:
+        return [d.message for d in self.diagnostics(func)]
 
 
 class CollectiveAliasChecker:
@@ -125,13 +163,15 @@ class CollectiveAliasChecker:
     (verify/schedule.py) re-checks on the FINAL op sequence — catching
     it here names the offending T.comm.* call instead of a rewritten
     op. The all_reduce accumulate read (clear=False reads ``out``) is
-    not aliasing; reading the destination is its semantics."""
+    not aliasing; reading the destination is its semantics. Rule TL104."""
 
-    def check(self, func: PrimFunc) -> List[str]:
+    RULE = "TL104"
+
+    def diagnostics(self, func: PrimFunc) -> List[Diagnostic]:
         # ONE payload/destination pair spec for both layers: the
         # verifier owns it, this checker applies it pre-lower
         from ..verify.schedule import _alias_pairs
-        errs: List[str] = []
+        out: List[Diagnostic] = []
 
         def note(s):
             if not isinstance(s, CommStmt):
@@ -139,22 +179,89 @@ class CollectiveAliasChecker:
             kind = type(s).__name__.replace("Comm", "").lower()
             for payload, dst, what in _alias_pairs(s):
                 if payload.buffer.uid == dst.buffer.uid:
-                    errs.append(
+                    out.append(Diagnostic(
+                        self.RULE, "error",
                         f"{kind} {what} alias buffer "
                         f"{payload.buffer.name!r}; use a distinct "
-                        f"destination buffer")
+                        f"destination buffer",
+                        buffer=payload.buffer.name,
+                        op=type(s).__name__, loc=stmt_loc(s)))
         walk(func.body, note)
-        return errs
+        return out
+
+    def check(self, func: PrimFunc) -> List[str]:
+        return [d.message for d in self.diagnostics(func)]
 
 
-def run_semantic_checks(func: PrimFunc) -> None:
-    errs: List[str] = []
-    for checker in (NestedLoopChecker(), FragmentLoopChecker(),
-                    StaticBoundsChecker(), CollectiveAliasChecker()):
-        errs.extend(checker.check(func))
+LEGACY_CHECKERS = (NestedLoopChecker, FragmentLoopChecker,
+                   StaticBoundsChecker, CollectiveAliasChecker)
+
+
+def legacy_diagnostics(func: PrimFunc) -> List[Diagnostic]:
+    """All TL100-TL104 findings. Every checker runs — a crash inside one
+    becomes its own diagnostic instead of hiding the others' findings
+    (the aggregation guarantee ``run_semantic_checks`` documents)."""
+    diags: List[Diagnostic] = []
+    for cls in LEGACY_CHECKERS:
+        try:
+            diags.extend(cls().diagnostics(func))
+        except Exception as e:    # noqa: BLE001 - checker bug must not
+            diags.append(Diagnostic(                # mask other findings
+                cls.RULE, "error",
+                f"checker {cls.__name__} crashed: {type(e).__name__}: "
+                f"{e}"))
     if func.kernel_node() is None:
-        errs.append("kernel body has no `with T.Kernel(...)` frame")
-    if errs:
-        raise SemanticError(
-            f"{func.name}: semantic check failed:\n  - " +
-            "\n  - ".join(errs))
+        diags.append(Diagnostic(
+            "TL100", "error",
+            "kernel body has no `with T.Kernel(...)` frame"))
+    for d in diags:
+        if not d.kernel:
+            d.kernel = func.name
+    return diags
+
+
+def _raise_aggregated(func_name: str, diags: List[Diagnostic]) -> None:
+    raise SemanticError(
+        f"{func_name}: semantic check failed:\n  - " +
+        "\n  - ".join(d.format() for d in diags), diags)
+
+
+def run_semantic_checks(func: PrimFunc,
+                        pass_cfg: Optional[dict] = None
+                        ) -> List[Diagnostic]:
+    """Run the TL1xx semantic checkers (hard errors, all aggregated into
+    ONE SemanticError) and — under ``TL_TPU_LINT`` != 0 — the TL00x
+    dataflow lint rules. Returns the non-raising lint findings so the
+    caller (engine/lower.py, parallel/lowering.py, tools/lint.py) can
+    surface them in plan_desc / attrs / counters."""
+    from .rules import lint_mode, run_lint
+    legacy = legacy_diagnostics(func)
+    if legacy:
+        _raise_aggregated(func.name, legacy)
+    mode = lint_mode(pass_cfg)
+    if mode == "off":
+        return []
+    findings = run_lint(func, pass_cfg, ir_only=True)
+    if mode == "strict":
+        errs = [d for d in findings if d.severity == "error"]
+        if errs:
+            _raise_aggregated(func.name, errs)
+    return findings
+
+
+def collect_diagnostics(func: PrimFunc,
+                        pass_cfg: Optional[dict] = None,
+                        with_plan: bool = True) -> List[Diagnostic]:
+    """Every finding for one kernel WITHOUT raising — the offline CLI's
+    entry point (tools/lint.py). ``with_plan`` additionally runs the
+    plan-consuming rules (TL005) by planning the kernel here; the
+    in-pipeline pass reaches the identical finding set via
+    run_semantic_checks + run_plan_lint on the real plan."""
+    from .rules import run_lint
+    diags = legacy_diagnostics(func)
+    # lint rules assume structurally valid IR; a kernel with hard
+    # semantic errors reports just those (the pipeline would too)
+    if any(d.severity == "error" for d in diags):
+        return diags
+    diags.extend(run_lint(func, pass_cfg, ir_only=not with_plan))
+    return diags

@@ -1,0 +1,404 @@
+"""Def-use / reaching-init dataflow over the tile IR.
+
+The engine the lint rules (analysis/rules.py) are built on. Three layers:
+
+- ``stmt_accesses`` — the ONE enumeration of every buffer read/write a
+  statement performs (region operands, elementwise loads inside value and
+  index expressions, accumulator re-reads like ``T.gemm(clear_accum=False)``),
+  so no rule can drift from another about what an op touches;
+- ``iter_stmts`` — structured program-order traversal carrying the
+  enclosing-loop stack and branch guards (both If arms, else bodies
+  included — the traversal gap the ad-hoc checker recursion had);
+- ``def_use`` / ``InitState`` — whole-function def-use chains and the
+  forward definitely/maybe-initialized analysis behind TL003/TL006.
+
+Reference analog: the pre-lower slice of tilelang's PreLowerSemanticCheck
+pass family; the GPU-to-CPU transpilation work (PAPERS.md) shows this IR
+altitude — explicit parallel/pipelined constructs, region operands — is
+where such reasoning stays tractable and precise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
+                  BufferLoad, BufferStoreStmt, CommAllGather, CommAllReduce,
+                  CommBroadcast, CommPut, CommStmt, CopyStmt, CumSumStmt,
+                  EvaluateStmt, FillStmt, ForNest, GemmStmt, IfThenElse,
+                  KernelNode, PrimFunc, PrintStmt, Region, ReduceStmt,
+                  SeqStmt, Stmt, as_int, for_each_load)
+
+
+# ---------------------------------------------------------------------------
+# access enumeration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Access:
+    """One buffer touch: a region operand or an elementwise load/store."""
+
+    buffer: Buffer
+    kind: str                          # "read" | "write"
+    stmt: Stmt
+    attr: str = ""                     # operand name, e.g. "src", "C"
+    region: Optional[Region] = None    # set for region-valued operands
+    indices: Optional[tuple] = None    # set for elementwise accesses
+
+    @property
+    def is_region(self) -> bool:
+        return self.region is not None
+
+
+def expr_reads(e, stmt: Stmt, attr: str = "") -> List[Access]:
+    """Every BufferLoad inside an expression tree as a read Access."""
+    out: List[Access] = []
+
+    def on(ld: BufferLoad):
+        out.append(Access(ld.buffer, "read", stmt, attr,
+                          indices=tuple(ld.indices)))
+    for_each_load(e, on)
+    return out
+
+
+def _region_index_reads(r: Region, stmt: Stmt, attr: str) -> List[Access]:
+    """Loads inside a region's base expressions (gather-style bases)."""
+    out: List[Access] = []
+    for b in r.base:
+        if not isinstance(b, slice):
+            out.extend(expr_reads(b, stmt, attr))
+    return out
+
+
+def stmt_accesses(s: Stmt) -> List[Access]:
+    """All buffer accesses of one statement, reads listed before writes
+    (an accumulating op like gemm(clear_accum=False) reads C before it
+    writes C — the order the init analysis depends on)."""
+    A: List[Access] = []
+
+    def rd(buf_or_region, attr, region=None, indices=None):
+        if isinstance(buf_or_region, Region):
+            A.extend(_region_index_reads(buf_or_region, s, attr))
+            A.append(Access(buf_or_region.buffer, "read", s, attr,
+                            region=buf_or_region))
+        else:
+            A.append(Access(buf_or_region, "read", s, attr, region=region,
+                            indices=indices))
+
+    def wr(buf_or_region, attr, indices=None):
+        if isinstance(buf_or_region, Region):
+            A.extend(_region_index_reads(buf_or_region, s, attr))
+            A.append(Access(buf_or_region.buffer, "write", s, attr,
+                            region=buf_or_region))
+        else:
+            A.append(Access(buf_or_region, "write", s, attr,
+                            indices=indices))
+
+    if isinstance(s, CopyStmt):
+        rd(s.src, "src")
+        wr(s.dst, "dst")
+    elif isinstance(s, AsyncCopyStmt):
+        # the "start" phase performs the DMA's read+write; "wait" only
+        # synchronizes (its src/dst restate the awaited copy)
+        if s.phase == "start":
+            rd(s.src, "src")
+            wr(s.dst, "dst")
+    elif isinstance(s, GemmStmt):
+        rd(s.A, "A")
+        rd(s.B, "B")
+        if not s.clear_accum:
+            rd(s.C, "C")
+        wr(s.C, "C")
+    elif isinstance(s, FillStmt):
+        A.extend(expr_reads(s.value, s, "value"))
+        wr(s.dst, "dst")
+    elif isinstance(s, ReduceStmt):
+        rd(s.src, "src")
+        if not s.clear:
+            rd(s.dst, "dst")
+        wr(s.dst, "dst")
+    elif isinstance(s, CumSumStmt):
+        rd(s.src, "src")
+        wr(s.dst, "dst")
+    elif isinstance(s, AtomicStmt):
+        if isinstance(s.value, Region):
+            rd(s.value, "value")
+        else:
+            A.extend(expr_reads(s.value, s, "value"))
+        rd(s.dst, "dst")        # read-modify-write
+        wr(s.dst, "dst")
+    elif isinstance(s, BufferStoreStmt):
+        A.extend(expr_reads(s.value, s, "value"))
+        for i in s.indices:
+            if not isinstance(i, slice):
+                A.extend(expr_reads(i, s, "index"))
+        wr(s.buffer, "dst", indices=tuple(s.indices))
+    elif isinstance(s, (EvaluateStmt,)):
+        A.extend(expr_reads(s.expr, s, "expr"))
+    elif isinstance(s, (PrintStmt,)):
+        obj = s.obj
+        if isinstance(obj, Buffer):
+            rd(obj, "obj")
+        elif isinstance(obj, Region):
+            rd(obj, "obj")
+        elif obj is not None and not isinstance(obj, str):
+            A.extend(expr_reads(obj, s, "obj"))
+    elif isinstance(s, AssertStmt):
+        A.extend(expr_reads(s.cond, s, "cond"))
+    elif isinstance(s, CommBroadcast) or isinstance(s, CommPut):
+        rd(s.src, "src")
+        wr(s.dst, "dst")
+    elif isinstance(s, CommAllGather):
+        rd(s.send, "send")
+        wr(s.recv, "recv")
+    elif isinstance(s, CommAllReduce):
+        rd(s.buffer, "buffer")
+        if not s.clear:
+            rd(s.out, "out")    # accumulate-into-existing reads out
+        wr(s.out, "out")
+    elif isinstance(s, CommStmt):
+        # future comm variants: every Region-valued attribute is at least
+        # a read (conservative), names starting with a destination-ish
+        # prefix also a write
+        for at, r in vars(s).items():
+            if isinstance(r, Region):
+                rd(r, at)
+                if at in ("dst", "recv", "out"):
+                    wr(r, at)
+    elif isinstance(s, IfThenElse):
+        A.extend(expr_reads(s.cond, s, "cond"))
+    elif isinstance(s, ForNest):
+        for e in s.extents:
+            if not isinstance(e, int):
+                A.extend(expr_reads(e, s, "extent"))
+    return A
+
+
+# ---------------------------------------------------------------------------
+# structured traversal
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StmtContext:
+    """Where a statement sits: the enclosing loop nests (outermost first)
+    and the branch guards on its path ((cond, True) = then arm)."""
+
+    loops: Tuple[ForNest, ...] = ()
+    guards: Tuple[Tuple[object, bool], ...] = ()
+
+    def with_loop(self, ln: ForNest) -> "StmtContext":
+        return StmtContext(self.loops + (ln,), self.guards)
+
+    def with_guard(self, cond, polarity: bool) -> "StmtContext":
+        return StmtContext(self.loops, self.guards + ((cond, polarity),))
+
+    def loop_vars(self, kinds=None) -> List[tuple]:
+        """[(var, static_extent_or_None, kind), ...] over enclosing loops,
+        optionally filtered by loop kind."""
+        out = []
+        for ln in self.loops:
+            if kinds is not None and ln.kind not in kinds:
+                continue
+            for v, e in zip(ln.loop_vars, ln.extents):
+                out.append((v, as_int(e), ln.kind))
+        return out
+
+
+def iter_stmts(stmts, ctx: Optional[StmtContext] = None
+               ) -> Iterator[Tuple[Stmt, StmtContext]]:
+    """Program-order traversal yielding (stmt, context) for every
+    statement, descending into loop bodies and BOTH If arms."""
+    ctx = ctx or StmtContext()
+    for s in _as_list(stmts):
+        yield s, ctx
+        if isinstance(s, SeqStmt):
+            yield from iter_stmts(s.stmts, ctx)
+        elif isinstance(s, KernelNode):
+            yield from iter_stmts(list(s.prelude), ctx)
+            yield from iter_stmts(s.body, ctx)
+        elif isinstance(s, ForNest):
+            yield from iter_stmts(s.body, ctx.with_loop(s))
+        elif isinstance(s, IfThenElse):
+            yield from iter_stmts(s.then_body, ctx.with_guard(s.cond, True))
+            if s.else_body is not None:
+                yield from iter_stmts(s.else_body,
+                                      ctx.with_guard(s.cond, False))
+
+
+def _as_list(stmts) -> List[Stmt]:
+    if isinstance(stmts, SeqStmt):
+        return list(stmts.stmts)
+    if isinstance(stmts, Stmt):
+        return [stmts]
+    return list(stmts)
+
+
+# ---------------------------------------------------------------------------
+# def-use chains
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DefUse:
+    """Every read and write of one buffer across a function."""
+
+    buffer: Buffer
+    reads: List[Tuple[Access, StmtContext]] = field(default_factory=list)
+    writes: List[Tuple[Access, StmtContext]] = field(default_factory=list)
+
+
+def def_use(func: PrimFunc) -> Dict[int, DefUse]:
+    """Buffer uid -> DefUse over the whole function body (prelude and
+    kernel frame included)."""
+    out: Dict[int, DefUse] = {}
+
+    def du(buf: Buffer) -> DefUse:
+        d = out.get(buf.uid)
+        if d is None:
+            d = out[buf.uid] = DefUse(buf)
+        return d
+
+    for s, ctx in iter_stmts(func.body):
+        if isinstance(s, AllocStmt):
+            du(s.buffer)    # present even when never touched
+            continue
+        for acc in stmt_accesses(s):
+            (du(acc.buffer).reads if acc.kind == "read"
+             else du(acc.buffer).writes).append((acc, ctx))
+    return out
+
+
+def scratch_buffers(func: PrimFunc) -> Dict[int, Buffer]:
+    """On-chip buffers from T.alloc_* (semaphores excluded: they are
+    runtime-managed DMA state, not data)."""
+    out: Dict[int, Buffer] = {}
+    for s, _ in iter_stmts(func.body):
+        if isinstance(s, AllocStmt) and s.buffer.scope != "global" \
+                and s.buffer.scope != "sem":
+            out[s.buffer.uid] = s.buffer
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reaching-init analysis (TL003)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class InitState:
+    """Forward per-path write facts at buffer granularity.
+
+    ``definite`` — written on every path reaching here;
+    ``maybe``    — written on at least one path (a read of a maybe-written
+    buffer is NOT flagged: guarded first-iteration inits like
+    ``with T.If(ko == 0): T.fill(acc, 0)`` are a core idiom)."""
+
+    definite: set = field(default_factory=set)
+    maybe: set = field(default_factory=set)
+
+    def clone(self) -> "InitState":
+        return InitState(set(self.definite), set(self.maybe))
+
+    def write(self, uid: int) -> None:
+        self.definite.add(uid)
+        self.maybe.add(uid)
+
+
+def writes_in(stmts) -> set:
+    """uids of every buffer written anywhere under ``stmts``."""
+    out = set()
+    for s, _ in iter_stmts(stmts):
+        for acc in stmt_accesses(s):
+            if acc.kind == "write":
+                out.add(acc.buffer.uid)
+    return out
+
+
+def uninitialized_reads(func: PrimFunc
+                        ) -> List[Tuple[Access, StmtContext]]:
+    """Reads of on-chip scratch that NO write can reach.
+
+    The analysis is first-iteration-accurate for loops: a write LATER in
+    a loop body does not reach an earlier read on iteration 0, so the
+    classic "forgot T.clear before the accumulating T.gemm" bug fires —
+    UNLESS the read sits under a branch guard that mentions an enclosing
+    loop var and the buffer is written somewhere in that loop's body
+    (the ``with T.If(ko > 0): use(prev)`` software-pipeline idiom, where
+    the guard skips exactly the uninitialized iterations). Guarded
+    first-iteration inits (``with T.If(ko == 0): T.fill(...)``) reach
+    the reads after them as maybe-writes and are never flagged."""
+    scratch = scratch_buffers(func)
+    found: List[Tuple[Access, StmtContext]] = []
+
+    def visit(stmts, state: InitState, ctx: StmtContext,
+              carried: set) -> None:
+        for s in _as_list(stmts):
+            if isinstance(s, AllocStmt):
+                continue
+            if isinstance(s, SeqStmt):
+                visit(s.stmts, state, ctx, carried)
+                continue
+            if isinstance(s, KernelNode):
+                visit(list(s.prelude), state, ctx, carried)
+                visit(s.body, state, ctx, carried)
+                continue
+            if isinstance(s, ForNest):
+                body_writes = writes_in(s.body)
+                inner = state.clone()
+                visit(s.body, inner, ctx.with_loop(s),
+                      carried | body_writes)
+                # after the loop every body write may have happened ...
+                state.maybe |= body_writes
+                exts = [as_int(e) for e in s.extents]
+                if all(e is not None and e >= 1 for e in exts):
+                    # ... and all-path body writes definitely did
+                    state.definite |= inner.definite
+                continue
+            if isinstance(s, IfThenElse):
+                for acc in stmt_accesses(s):     # cond reads
+                    _judge(acc, state, ctx, carried)
+                st_t = state.clone()
+                visit(s.then_body, st_t, ctx.with_guard(s.cond, True),
+                      carried)
+                st_e = state.clone()
+                if s.else_body is not None:
+                    visit(s.else_body, st_e,
+                          ctx.with_guard(s.cond, False), carried)
+                state.definite = st_t.definite & st_e.definite
+                state.maybe = st_t.maybe | st_e.maybe
+                continue
+            accs = stmt_accesses(s)
+            for acc in accs:
+                if acc.kind == "read":
+                    _judge(acc, state, ctx, carried)
+            for acc in accs:
+                if acc.kind == "write":
+                    state.write(acc.buffer.uid)
+
+    def _guarded_by_loop_var(ctx: StmtContext) -> bool:
+        loop_ids = set()
+        for ln in ctx.loops:
+            loop_ids |= {id(v) for v in ln.loop_vars}
+        for cond, _pol in ctx.guards:
+            try:
+                from ..ir import free_vars
+                if any(id(v) in loop_ids for v in free_vars(cond)):
+                    return True
+            except TypeError:
+                continue
+        return False
+
+    def _judge(acc: Access, state: InitState, ctx: StmtContext,
+               carried: set) -> None:
+        uid = acc.buffer.uid
+        if uid not in scratch or uid in state.maybe:
+            return
+        if uid in carried and _guarded_by_loop_var(ctx):
+            return   # loop-carried value behind an iteration guard
+        found.append((acc, ctx))
+
+    visit(func.body, InitState(), StmtContext(), set())
+    return found

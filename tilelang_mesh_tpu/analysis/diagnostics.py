@@ -1,0 +1,140 @@
+"""Structured diagnostics for the pre-lower static-analysis suite.
+
+The reference reports semantic-check failures as free-form strings
+(tilelang/analysis/*.py); here every finding is a ``Diagnostic`` carrying a
+stable rule id, a severity, the offending buffer/op names, and the DSL
+source location the trace builder captured — so the same finding renders
+uniformly in a raised ``SemanticError``, the ``lint[...]`` plan_desc block,
+``attrs["lint"]``, the ``lint.*`` counters, and the offline
+``tools.lint`` CLI's JSON artifact (docs/static_analysis.md).
+
+Rule id namespaces:
+
+- ``TL001``-``TL006`` — the dataflow lint rules (analysis/rules.py)
+- ``TL100``-``TL104`` — the legacy semantic checkers (analysis/checkers.py),
+  always-on hard errors
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+#: severity lattice, most severe first. "error" findings fail compilation
+#: under TL_TPU_LINT=strict (legacy TL1xx rules always fail); "warning"
+#: findings surface in plan_desc/attrs/counters; "info" is lint-only
+#: advice (dead stores, unused allocs).
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass
+class Diagnostic:
+    """One static-analysis finding."""
+
+    rule: str                      # stable id, e.g. "TL001"
+    severity: str                  # error | warning | info
+    message: str                   # human-readable, golden-testable text
+    kernel: str = ""               # PrimFunc name
+    buffer: str = ""               # offending buffer, when one exists
+    op: str = ""                   # offending statement type, when useful
+    loc: Optional[str] = None      # "file:line" captured by the builder
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}; "
+                             f"expected one of {SEVERITIES}")
+
+    def format(self) -> str:
+        """One-line rendering shared by SemanticError text, the plan_desc
+        ``lint[...]`` block, and the CLI report."""
+        bits = [f"{self.rule} {self.severity}: {self.message}"]
+        ctx = []
+        if self.buffer:
+            ctx.append(f"buffer={self.buffer}")
+        if self.op:
+            ctx.append(f"op={self.op}")
+        if ctx:
+            bits.append(f" [{', '.join(ctx)}]")
+        if self.loc:
+            bits.append(f" @ {self.loc}")
+        return "".join(bits)
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message}
+        for k in ("kernel", "buffer", "op", "loc"):
+            v = getattr(self, k)
+            if v:
+                d[k] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Diagnostic":
+        return cls(rule=d["rule"], severity=d["severity"],
+                   message=d["message"], kernel=d.get("kernel", ""),
+                   buffer=d.get("buffer", ""), op=d.get("op", ""),
+                   loc=d.get("loc"))
+
+
+def stmt_loc(stmt) -> Optional[str]:
+    """The "file:line" the trace builder stamped on a statement, or None
+    (hand-built IR, pre-PR pickles)."""
+    loc = getattr(stmt, "loc", None)
+    if loc is None:
+        return None
+    if isinstance(loc, str):
+        return loc
+    try:
+        fname, lineno = loc
+        return f"{fname}:{lineno}"
+    except (TypeError, ValueError):
+        return None
+
+
+@dataclass
+class LintReport:
+    """Ordered findings for one kernel, with the summary helpers every
+    surface (plan_desc, attrs, counters, CLI) shares."""
+
+    kernel: str = ""
+    findings: List[Diagnostic] = field(default_factory=list)
+
+    def add(self, diag: Diagnostic) -> None:
+        if not diag.kernel:
+            diag.kernel = self.kernel
+        self.findings.append(diag)
+
+    def extend(self, diags) -> None:
+        for d in diags:
+            self.add(d)
+
+    def by_severity(self, severity: str) -> List[Diagnostic]:
+        return [d for d in self.findings if d.severity == severity]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return self.by_severity("warning")
+
+    def sorted(self) -> List[Diagnostic]:
+        """Stable order: severity (most severe first), then rule id, then
+        original discovery order."""
+        sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+        return sorted(self.findings,
+                      key=lambda d: (sev_rank.get(d.severity, 99), d.rule))
+
+    def to_dicts(self) -> List[dict]:
+        return [d.to_dict() for d in self.sorted()]
+
+    def counts(self) -> dict:
+        """{"by_rule": {...}, "by_severity": {...}, "total": n}."""
+        by_rule: dict = {}
+        by_sev: dict = {}
+        for d in self.findings:
+            by_rule[d.rule] = by_rule.get(d.rule, 0) + 1
+            by_sev[d.severity] = by_sev.get(d.severity, 0) + 1
+        return {"by_rule": by_rule, "by_severity": by_sev,
+                "total": len(self.findings)}

@@ -1,0 +1,196 @@
+"""Affine region / interval model for buffer accesses.
+
+The numeric workhorse behind the lint rules (analysis/rules.py): index
+expressions are decomposed as ``sum(coeff * var) + const`` over the
+enclosing loop/grid variables (ir/expr.py affine_decompose), loop extents
+bound each variable, and the rules ask three kinds of questions:
+
+- interval: what index range can this expression take? (TL004 bounds)
+- overlap: can two regions of the same buffer intersect? (TL002 hazards)
+- injectivity / collision: can two distinct iterations of a T.Parallel
+  nest touch the same element? (TL001 races)
+
+Everything here is *conservative in the right direction per question*:
+interval/overlap answers "don't know" as ``None``/may-overlap, while the
+race collision proofs only report when a colliding iteration pair provably
+exists — the rules stay silent rather than cry wolf on index math they
+cannot model (the CUDA Tile evaluation's lesson: tile-level diagnostics
+are only trusted when they never false-positive on shipped kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..ir import Region, Var, as_int, convert
+from ..ir.expr import affine_decompose
+
+
+class VarRanges:
+    """Inclusive value ranges for variables: id(var) -> (var, lo, hi)."""
+
+    def __init__(self):
+        self._r: Dict[int, Tuple[Var, int, int]] = {}
+
+    def add(self, var: Var, lo: int, hi: int) -> None:
+        self._r[id(var)] = (var, lo, hi)
+
+    def get(self, var) -> Optional[Tuple[int, int]]:
+        e = self._r.get(id(var))
+        return None if e is None else (e[1], e[2])
+
+    def __contains__(self, var) -> bool:
+        return id(var) in self._r
+
+    def vars(self) -> List[Tuple[Var, int, int]]:
+        return list(self._r.values())
+
+    @classmethod
+    def from_loops(cls, loop_vars: Sequence[tuple]) -> "VarRanges":
+        """From StmtContext.loop_vars() tuples (var, extent, kind);
+        dynamic extents are skipped (no range knowledge)."""
+        r = cls()
+        for v, ext, _kind in loop_vars:
+            if ext is not None and ext >= 1:
+                r.add(v, 0, ext - 1)
+        return r
+
+
+def expr_interval(e, ranges: VarRanges) -> Optional[Tuple[int, int]]:
+    """Inclusive [lo, hi] an integer expression can take, or None when a
+    variable is unranged or the expression is not affine."""
+    v = as_int(e)
+    if v is not None:
+        return v, v
+    dec = affine_decompose(convert(e))
+    if dec is None:
+        return None
+    coeffs, const = dec
+    lo = hi = const
+    for _vid, (var, c) in coeffs.items():
+        r = ranges.get(var)
+        if r is None:
+            return None
+        a, b = c * r[0], c * r[1]
+        lo += min(a, b)
+        hi += max(a, b)
+    return lo, hi
+
+
+def region_dim_window(r: Region, d: int, ranges: VarRanges
+                      ) -> Optional[Tuple[int, int]]:
+    """[lo, hi) index window dimension ``d`` of a region can touch across
+    all valuations of the ranged vars; None when unanalyzable."""
+    base = r.base[d]
+    if isinstance(base, slice):
+        return None
+    iv = expr_interval(base, ranges)
+    if iv is None:
+        return None
+    ext = as_int(r.shape[d])
+    if ext is None or ext < 0:
+        return None
+    return iv[0], iv[1] + ext
+
+
+def regions_may_overlap(a: Region, b: Region, ranges: VarRanges) -> bool:
+    """May two regions of the SAME buffer intersect? Conservative: any
+    dimension we cannot bound counts as overlapping; one provably
+    disjoint dimension proves disjointness."""
+    if a.buffer.uid != b.buffer.uid:
+        return False
+    for d in range(min(len(a.base), len(b.base))):
+        wa = region_dim_window(a, d, ranges)
+        wb = region_dim_window(b, d, ranges)
+        if wa is None or wb is None:
+            continue
+        if wa[1] <= wb[0] or wb[1] <= wa[0]:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# per-dimension affine forms for race reasoning
+# ---------------------------------------------------------------------------
+
+
+def access_affine(indices, wrt: Sequence[Var]
+                  ) -> Optional[List[Tuple[Dict[int, int], tuple, int]]]:
+    """Per-dimension affine forms of an index tuple over ``wrt`` vars.
+
+    Each entry is (coeffs_wrt, ambient_key, const); ``ambient_key`` is a
+    canonical key of the non-wrt affine part so two accesses can be
+    compared dimension-wise. None when any dimension is non-affine (or a
+    slice) — the caller must stay silent about such accesses."""
+    wrt_ids = {id(v): v for v in wrt}
+    out: List[Tuple[Dict[int, int], tuple, int]] = []
+    for e in indices:
+        if isinstance(e, slice):
+            return None
+        dec = affine_decompose(convert(e))
+        if dec is None:
+            return None
+        coeffs, const = dec
+        wrt_c: Dict[int, int] = {}
+        ambient: List[Tuple[int, int]] = []
+        for vid, (var, c) in coeffs.items():
+            if vid in wrt_ids:
+                wrt_c[vid] = c
+            else:
+                ambient.append((var.uid, c))
+        out.append((wrt_c, tuple(sorted(ambient)), const))
+    return out
+
+
+def vars_missing_from(forms: List[Tuple[Dict[int, int], tuple, int]],
+                      wrt: Sequence[Var]) -> List[Var]:
+    """Vars of ``wrt`` with zero coefficient in EVERY dimension — every
+    iteration of such a var addresses the same elements."""
+    present = set()
+    for coeffs, _amb, _k in forms:
+        present |= {vid for vid, c in coeffs.items() if c != 0}
+    return [v for v in wrt if id(v) not in present]
+
+
+def collision_shift(write_forms, read_forms, wrt_exts: Dict[int, int]
+                    ) -> Optional[Tuple[int, int]]:
+    """Prove that iteration p's write address equals iteration p'(≠p)'s
+    read address under a single-variable shift p' = p + dv·e_v.
+
+    Both form lists must be per-dimension affine over the same var set
+    with IDENTICAL coefficients and ambient parts; the constant deltas
+    must then be reproduced by one variable's coefficients with a single
+    consistent non-zero dv inside that variable's extent. Returns
+    (var_id, dv) or None (no provable cross-iteration collision)."""
+    if len(write_forms) != len(read_forms):
+        return None
+    deltas: List[int] = []
+    for (wc, wamb, wk), (rc, ramb, rk) in zip(write_forms, read_forms):
+        if wc != rc or wamb != ramb:
+            return None
+        deltas.append(rk - wk)       # read = write + delta
+    if not any(deltas):
+        return None                  # same-iteration access, not a race
+    for vid, ext in wrt_exts.items():
+        dv = None
+        ok = True
+        for (wc, _a, _k), delta in zip(write_forms, deltas):
+            c = wc.get(vid, 0)
+            if c == 0:
+                if delta != 0:
+                    ok = False
+                    break
+                continue
+            if delta % c != 0:
+                ok = False
+                break
+            d = delta // c
+            if dv is None:
+                dv = d
+            elif dv != d:
+                ok = False
+                break
+        if ok and dv is not None and dv != 0 and abs(dv) <= ext - 1:
+            return vid, dv
+    return None
+

@@ -1,0 +1,685 @@
+"""The tl-lint rule registry: dataflow-based diagnostics over the tile IR.
+
+Six rules run before lowering (docs/static_analysis.md), each built on the
+def-use engine (analysis/dataflow.py) and the affine region model
+(analysis/regions.py):
+
+========  ========  =====================================================
+rule      severity  fires when
+========  ========  =====================================================
+TL001     error*    two T.Parallel iterations provably touch the same
+                    element (write-write, or a read shifted onto another
+                    iteration's write); *idempotent broadcast stores
+                    (value invariant in the missing var) downgrade to
+                    warning
+TL002     error     an async copy's destination (or source) is touched
+                    before its T.copy_wait, a semaphore slot is re-armed
+                    while in flight, or a started copy is never awaited
+                    (warning)
+TL003     error     VMEM scratch from T.alloc_* is read with NO reaching
+                    write on any path (loop back edges and guarded
+                    first-iteration inits count as reaching)
+TL004     error/    an affine index over ranged loop vars provably walks
+          warning   outside the buffer (error on-chip, warning for HBM
+                    operands, which the runtime clamps/masks)
+TL005     warning   the liveness-packed VMEM footprint (scratch arena +
+                    double-buffered BlockSpec windows) exceeds the
+                    budget Mosaic will enforce later, reported per buffer
+TL006     info      dead stores / unused allocations
+==========================================================================
+
+Every rule is *proof-gated*: it reports only what the affine model can
+demonstrate, and stays silent on index math it cannot analyze — the whole
+shipped ops library lints clean at error severity (enforced by the CI
+``lint-oplib`` job).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ..ir import (AsyncCopyStmt, AtomicStmt, Buffer, ForNest,
+                  GemmStmt, PrimFunc, Region, as_int, free_vars)
+from .dataflow import (Access, def_use, iter_stmts, stmt_accesses,
+                       uninitialized_reads)
+from .diagnostics import Diagnostic, stmt_loc
+from .regions import (VarRanges, access_affine, collision_shift,
+                      expr_interval, regions_may_overlap,
+                      vars_missing_from)
+
+LINT_MODES = ("off", "warn", "strict")
+
+#: loop kinds whose variables take every value in [0, extent) inside the
+#: kernel body — grid vars and T.Pipelined vars are grid-mapped (Pallas
+#: masks their ragged edges), so they are deliberately NOT ranged here
+RANGED_LOOP_KINDS = ("parallel", "serial", "unroll", "vectorized",
+                     "persistent")
+
+
+def lint_mode(pass_cfg: Optional[dict] = None) -> str:
+    """Active lint mode: ``tl.tpu.lint`` pass config when present, else
+    the ``TL_TPU_LINT`` env knob (default warn). Mirrors verify_mode:
+    a typo'd mode raises instead of silently disabling the suite."""
+    from ..env import env
+    raw = None
+    if pass_cfg:
+        raw = pass_cfg.get("tl.tpu.lint")
+    if raw is None:
+        raw = env.TL_TPU_LINT
+    raw = str(raw).strip().lower()
+    if raw in ("0", "off", "false", "none", "no"):
+        return "off"
+    if raw in ("1", "on", "warn", "warning", "true", "yes", "default"):
+        return "warn"
+    if raw == "strict":
+        return "strict"
+    raise ValueError(
+        f"unknown TL_TPU_LINT mode {raw!r}; valid values are 0/off, "
+        f"warn (default), strict")
+
+
+@dataclass
+class LintRule:
+    id: str
+    name: str
+    fn: Callable
+    needs_plan: bool = False
+
+
+RULES: List[LintRule] = []
+
+
+def _rule(rule_id: str, name: str, needs_plan: bool = False):
+    def deco(fn):
+        RULES.append(LintRule(rule_id, name, fn, needs_plan))
+        return fn
+    return deco
+
+
+class LintContext:
+    """Everything a rule may consult; the plan is resolved lazily so
+    IR-only runs (mesh kernels, unplannable funcs) never pay for or
+    crash on planning."""
+
+    def __init__(self, func: PrimFunc, pass_cfg: Optional[dict] = None,
+                 plan=None):
+        self.func = func
+        self.pass_cfg = dict(pass_cfg or {})
+        self._plan = plan
+        self._plan_tried = plan is not None
+
+    @property
+    def plan(self):
+        if not self._plan_tried:
+            self._plan_tried = True
+            from ..transform.plan import PlanError, plan_kernel
+            try:
+                self._plan = plan_kernel(self.func, self.pass_cfg)
+            except Exception:      # PlanError / mesh funcs: no footprint
+                self._plan = None
+        return self._plan
+
+
+def run_lint(func: PrimFunc, pass_cfg: Optional[dict] = None,
+             plan=None, ir_only: bool = False) -> List[Diagnostic]:
+    """Run every registered rule over one kernel; returns the findings
+    (empty for a clean kernel). ``ir_only`` skips plan-consuming rules
+    (TL005) — the pipeline runs those separately once the real plan
+    exists, so planning is never done twice per compile."""
+    ctx = LintContext(func, pass_cfg, plan)
+    out: List[Diagnostic] = []
+    for rule in RULES:
+        if ir_only and rule.needs_plan:
+            continue
+        for d in rule.fn(ctx):
+            if not d.kernel:
+                d.kernel = func.name
+            out.append(d)
+    return out
+
+
+def run_plan_lint(func: PrimFunc, plan, pass_cfg: Optional[dict] = None
+                  ) -> List[Diagnostic]:
+    """Only the plan-consuming rules (TL005), with the pipeline's
+    already-computed plan."""
+    ctx = LintContext(func, pass_cfg, plan)
+    out: List[Diagnostic] = []
+    for rule in RULES:
+        if not rule.needs_plan:
+            continue
+        for d in rule.fn(ctx):
+            if not d.kernel:
+                d.kernel = func.name
+            out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# surfacing — shared by engine/lower.py, parallel/lowering.py, tools/lint.py
+# ---------------------------------------------------------------------------
+
+
+def record_findings(diags: List[Diagnostic], kernel: str = "") -> None:
+    """Account findings into the ``lint.*`` counters (and, when tracing,
+    one event per finding) — the feed behind metrics_summary()["lint"]
+    and ``analyzer lint``'s trace view."""
+    from ..observability import tracer as _trace
+    _trace.inc("lint.kernels")
+    for d in diags:
+        _trace.inc("lint.findings", rule=d.rule, severity=d.severity)
+        _trace.event("lint.finding", kernel=kernel or d.kernel,
+                     rule=d.rule, severity=d.severity,
+                     message=d.message, buffer=d.buffer, loc=d.loc or "")
+
+
+def plan_desc_block(diags: List[Diagnostic], mode: str) -> List[str]:
+    """The ``lint[...]`` lines appended to plan_desc / the mesh schedule
+    text. Empty for a clean kernel, so every golden stays byte-stable."""
+    if not diags:
+        return []
+    from .diagnostics import LintReport
+    rep = LintReport(findings=list(diags))
+    lines = [f"  lint[{mode}]: {len(diags)} finding(s)"]
+    for d in rep.sorted():
+        lines.append(f"    ! {d.format()}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# TL001 — parallel-race
+# ---------------------------------------------------------------------------
+
+
+def _write_value_vars(acc: Access) -> set:
+    """ids of vars the written VALUE depends on (lost-update evidence)."""
+    val = getattr(acc.stmt, "value", None)
+    if val is None or isinstance(val, (Region, Buffer)):
+        return set()
+    try:
+        return {id(v) for v in free_vars(val)}
+    except TypeError:
+        return set()
+
+
+def _access_index_forms(acc: Access, wrt):
+    """Per-dim affine forms of an access (elementwise indices, or a
+    region's base), or None when unanalyzable."""
+    if acc.indices is not None:
+        return access_affine(acc.indices, wrt)
+    if acc.region is not None:
+        return access_affine(acc.region.base, wrt)
+    return None
+
+
+@_rule("TL001", "parallel-race")
+def _tl001_parallel_race(ctx: LintContext) -> List[Diagnostic]:
+    """Every access is judged over the parallel vars that actually
+    ENCLOSE it (a statement that is a sibling of a nested T.Parallel is
+    never charged with that loop's vars), and its affine forms are
+    decomposed exactly once. Cross-access pair checks only compare
+    accesses living in the same parallel iteration space."""
+    from .dataflow import StmtContext
+    out: List[Diagnostic] = []
+    seen = set()
+    for nest, nctx in iter_stmts(ctx.func.body):
+        if not isinstance(nest, ForNest) or nest.kind != "parallel":
+            continue
+        if any(ln.kind == "parallel" for ln in nctx.loops):
+            continue        # analyzed as part of the outermost parallel
+
+        # per-access entries, each with ITS OWN enclosing parallel vars
+        # and affine forms computed once
+        entries: List[dict] = []
+        for s, c in iter_stmts([nest], StmtContext()):
+            # c.loops holds the loops enclosing s; the nest's own extent
+            # expressions (s is nest, no enclosing parallel) are not in
+            # the iteration space and are skipped
+            par_loops = [ln for ln in c.loops if ln.kind == "parallel"]
+            if not par_loops:
+                continue
+            par = [(v, as_int(e)) for ln in par_loops
+                   for v, e in zip(ln.loop_vars, ln.extents)]
+            wrt = [v for v, _e in par]
+            space = frozenset(id(v) for v in wrt)
+            for acc in stmt_accesses(s):
+                if acc.kind == "write":
+                    if isinstance(acc.stmt, AtomicStmt):
+                        continue    # atomic RMW is race-free by op
+                elif acc.indices is None:
+                    continue
+                entries.append({
+                    "acc": acc, "par": par, "wrt": wrt, "space": space,
+                    "forms": _access_index_forms(acc, wrt),
+                })
+
+        writes = [e for e in entries if e["acc"].kind == "write"]
+        reads = [e for e in entries if e["acc"].kind == "read"]
+
+        def _var(wrt, vid):
+            return next(v for v in wrt if id(v) == vid)
+
+        for we in writes:
+            w, forms, par = we["acc"], we["forms"], we["par"]
+            if forms is None:
+                continue
+            exts = {id(v): e for v, e in par if e is not None and e > 1}
+            key_w = (id(w.stmt), w.attr)
+            ranged = [v for v, e in par if e is not None and e > 1]
+            missing = vars_missing_from(forms, ranged)
+            if missing and key_w not in seen:
+                seen.add(key_w)
+                vnames = ", ".join(v.name for v in missing)
+                dep = _write_value_vars(w) & {id(v) for v in missing}
+                sev = "error" if dep else "warning"
+                what = ("different values" if dep
+                        else "the same value (idempotent, but wasted "
+                             "lanes)")
+                out.append(Diagnostic(
+                    "TL001", sev,
+                    f"write-write race: every iteration of T.Parallel "
+                    f"var(s) {vnames} writes the same element(s) of "
+                    f"'{w.buffer.name}' with {what}; index the store "
+                    f"with {vnames} or hoist it out of the loop",
+                    buffer=w.buffer.name, op=type(w.stmt).__name__,
+                    loc=stmt_loc(w.stmt)))
+            # cross-iteration read-write overlap (same iteration space)
+            for re_ in reads:
+                r = re_["acc"]
+                if r.buffer.uid != w.buffer.uid or                         re_["space"] != we["space"] or                         re_["forms"] is None:
+                    continue
+                hit = collision_shift(forms, re_["forms"], exts)
+                if hit is None:
+                    continue
+                vid, dv = hit
+                var = _var(we["wrt"], vid)
+                key = (id(w.stmt), id(r.stmt), vid, dv)
+                if key in seen:
+                    continue
+                seen.add(key)
+                # collision: W(p) == R(q) with read = write + dv in the
+                # constant term, so the READER iteration is p - dv
+                out.append(Diagnostic(
+                    "TL001", "error",
+                    f"read-write race: iteration {var.name} writes "
+                    f"'{w.buffer.name}' at an index that iteration "
+                    f"{var.name}{-dv:+d} reads — T.Parallel iterations "
+                    f"are unordered, so the read may observe either "
+                    f"value; use a staging buffer or a serial loop",
+                    buffer=w.buffer.name, op=type(w.stmt).__name__,
+                    loc=stmt_loc(w.stmt)))
+            # write-write overlap between distinct statements
+            for w2e in writes:
+                w2 = w2e["acc"]
+                if w2 is w or w2.buffer.uid != w.buffer.uid or                         w2e["space"] != we["space"] or                         w2e["forms"] is None:
+                    continue
+                hit = collision_shift(forms, w2e["forms"], exts)
+                if hit is None:
+                    continue
+                vid, dv = hit
+                var = _var(we["wrt"], vid)
+                key = tuple(sorted((id(w.stmt), id(w2.stmt)))) + (vid,)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Diagnostic(
+                    "TL001", "error",
+                    f"write-write race: two stores to "
+                    f"'{w.buffer.name}' collide across T.Parallel "
+                    f"iterations of {var.name} (shift {dv:+d})",
+                    buffer=w.buffer.name, op=type(w.stmt).__name__,
+                    loc=stmt_loc(w.stmt)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TL002 — pipeline-hazard
+# ---------------------------------------------------------------------------
+
+
+def _acc_overlaps_region(acc: Access, region: Region,
+                         ranges: VarRanges) -> bool:
+    """May an access touch an in-flight DMA window? Conservative (an
+    unanalyzable index counts as overlapping)."""
+    if acc.buffer.uid != region.buffer.uid:
+        return False
+    if acc.region is not None:
+        return regions_may_overlap(acc.region, region, ranges)
+    if acc.indices is None:
+        return True
+    for d, idx in enumerate(acc.indices):
+        if d >= len(region.base) or isinstance(idx, slice):
+            continue
+        iv = expr_interval(idx, ranges)
+        if iv is None:
+            continue
+        from .regions import region_dim_window
+        w = region_dim_window(region, d, ranges)
+        if w is None:
+            continue
+        if iv[1] < w[0] or iv[0] >= w[1]:
+            return False
+    return True
+
+
+@_rule("TL002", "pipeline-hazard")
+def _tl002_pipeline_hazard(ctx: LintContext) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    reported = set()
+
+    # which (sem, slot) keys are EVER awaited anywhere (loop-carried
+    # pipelines wait in the next iteration, so "never awaited" is only
+    # meaningful function-globally). A wait with a DYNAMIC slot
+    # expression conservatively covers every slot of its semaphore —
+    # same conservatism as the in-flight scan below.
+    waited = set()
+    dyn_waited_sems = set()
+    started = {}
+    for s, _c in iter_stmts(ctx.func.body):
+        if isinstance(s, AsyncCopyStmt):
+            slot = as_int(s.slot)
+            if slot is None:
+                if s.phase == "wait":
+                    dyn_waited_sems.add(s.sem.uid)
+                continue
+            key = (s.sem.uid, slot)
+            if s.phase == "wait":
+                waited.add(key)
+            else:
+                started.setdefault(key, s)
+    for key, s in sorted(started.items()):
+        if key not in waited and key[0] not in dyn_waited_sems:
+            out.append(Diagnostic(
+                "TL002", "warning",
+                f"async copy into '{s.dst.buffer.name}' "
+                f"(slot {key[1]}) is started but never awaited with "
+                f"T.copy_wait; its completion is unordered with every "
+                f"later use",
+                buffer=s.dst.buffer.name, op="AsyncCopyStmt",
+                loc=stmt_loc(s)))
+
+    def report(kind: str, stmt, diag: Diagnostic):
+        key = (kind, id(stmt))
+        if key not in reported:
+            reported.add(key)
+            out.append(diag)
+
+    def scan(stmts, inflight: dict, ctx_ranges: VarRanges):
+        from .dataflow import _as_list
+        for s in _as_list(stmts):
+            from ..ir import (AllocStmt, IfThenElse, KernelNode, SeqStmt)
+            if isinstance(s, AllocStmt):
+                continue
+            if isinstance(s, SeqStmt):
+                scan(s.stmts, inflight, ctx_ranges)
+                continue
+            if isinstance(s, KernelNode):
+                scan(list(s.prelude), inflight, ctx_ranges)
+                scan(s.body, inflight, ctx_ranges)
+                continue
+            if isinstance(s, ForNest):
+                ranges = VarRanges()
+                for var, lo, hi in ctx_ranges.vars():
+                    ranges.add(var, lo, hi)
+                for v, e in zip(s.loop_vars, s.extents):
+                    ei = as_int(e)
+                    if ei is not None and ei >= 1:
+                        ranges.add(v, 0, ei - 1)
+                # a second pass catches loop-carried slot reuse; only
+                # meaningful when a second iteration can actually run
+                # (every-extent-<=1 loops have no back edge). Duplicate
+                # findings are deduped by statement identity.
+                exts = [as_int(e) for e in s.extents]
+                scan(s.body, inflight, ranges)
+                if any(e is None or e > 1 for e in exts):
+                    scan(s.body, inflight, ranges)
+                continue
+            if isinstance(s, IfThenElse):
+                st_t = dict(inflight)
+                scan(s.then_body, st_t, ctx_ranges)
+                st_e = dict(inflight)
+                if s.else_body is not None:
+                    scan(s.else_body, st_e, ctx_ranges)
+                inflight.clear()
+                inflight.update(st_e)
+                inflight.update(st_t)   # union: in flight on any path
+                continue
+            if isinstance(s, AsyncCopyStmt):
+                slot = as_int(s.slot)
+                if slot is None:
+                    if s.phase == "wait":
+                        # dynamic wait slot: conservatively clears every
+                        # slot of that semaphore (no false reuse reports)
+                        for k in [k for k in inflight
+                                  if k[0] == s.sem.uid]:
+                            inflight.pop(k, None)
+                    continue
+                key = (s.sem.uid, slot)
+                if s.phase == "start":
+                    if key in inflight:
+                        report("reuse", s, Diagnostic(
+                            "TL002", "error",
+                            f"semaphore slot {slot} re-armed by a second "
+                            f"T.copy_async while its first DMA (into "
+                            f"'{inflight[key][1].buffer.name}') is still "
+                            f"in flight; T.copy_wait the slot first",
+                            buffer=s.dst.buffer.name, op="AsyncCopyStmt",
+                            loc=stmt_loc(s)))
+                    inflight[key] = (s, s.dst)
+                else:
+                    inflight.pop(key, None)
+                continue
+            for acc in stmt_accesses(s):
+                for key, (st, dst) in list(inflight.items()):
+                    if acc.kind == "read" and _acc_overlaps_region(
+                            acc, dst, ctx_ranges):
+                        report(("consume", key), s, Diagnostic(
+                            "TL002", "error",
+                            f"'{dst.buffer.name}' is read by "
+                            f"{type(s).__name__} while the async copy "
+                            f"filling it (slot {key[1]}) is still in "
+                            f"flight; insert T.copy_wait before the use",
+                            buffer=dst.buffer.name,
+                            op=type(s).__name__, loc=stmt_loc(s)))
+                    elif acc.kind == "write" and _acc_overlaps_region(
+                            acc, st.src, ctx_ranges):
+                        report(("clobber", key), s, Diagnostic(
+                            "TL002", "error",
+                            f"'{st.src.buffer.name}' is overwritten by "
+                            f"{type(s).__name__} while an async copy "
+                            f"(slot {key[1]}) is still reading it; "
+                            f"T.copy_wait the slot first",
+                            buffer=st.src.buffer.name,
+                            op=type(s).__name__, loc=stmt_loc(s)))
+
+    scan(ctx.func.body, {}, VarRanges())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TL003 — uninitialized-read
+# ---------------------------------------------------------------------------
+
+
+@_rule("TL003", "uninitialized-read")
+def _tl003_uninitialized_read(ctx: LintContext) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    seen = set()
+    for acc, _c in uninitialized_reads(ctx.func):
+        key = (id(acc.stmt), acc.buffer.uid, acc.attr)
+        if key in seen:
+            continue
+        seen.add(key)
+        how = f"{type(acc.stmt).__name__}.{acc.attr}"
+        hint = ("initialize it with T.clear/T.fill/T.copy first")
+        if isinstance(acc.stmt, GemmStmt) and acc.attr == "C":
+            hint = ("pass clear_accum=True to the first T.gemm or "
+                    "T.clear the accumulator before the loop")
+        out.append(Diagnostic(
+            "TL003", "error",
+            f"VMEM scratch '{acc.buffer.name}' is read ({how}) before "
+            f"any write reaches it on any path; {hint}",
+            buffer=acc.buffer.name, op=type(acc.stmt).__name__,
+            loc=stmt_loc(acc.stmt)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TL004 — out-of-bounds (affine loop-var ranges)
+# ---------------------------------------------------------------------------
+
+
+def _guard_mentions(ctx_guards, vids: set) -> bool:
+    for cond, _pol in ctx_guards:
+        try:
+            if any(id(v) in vids for v in free_vars(cond)):
+                return True
+        except TypeError:
+            continue
+    return False
+
+
+@_rule("TL004", "out-of-bounds")
+def _tl004_bounds(ctx: LintContext) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    seen = set()
+    for s, sctx in iter_stmts(ctx.func.body):
+        loop_vars = sctx.loop_vars(RANGED_LOOP_KINDS)
+        if not loop_vars:
+            continue
+        ranges = VarRanges.from_loops(loop_vars)
+        ranged_ids = {id(v) for v, e, _k in loop_vars if e is not None}
+        for acc in stmt_accesses(s):
+            buf = acc.buffer
+            bshape = buf.static_shape()
+            if bshape is None:
+                continue
+            dims = []
+            if acc.region is not None:
+                rshape = acc.region.static_shape()
+                if rshape is None:
+                    continue
+                dims = [(d, b, rshape[d])
+                        for d, b in enumerate(acc.region.base)]
+            elif acc.indices is not None:
+                dims = [(d, i, 1) for d, i in enumerate(acc.indices)
+                        if not isinstance(i, slice)]
+            for d, base, ext in dims:
+                if d >= len(bshape):
+                    continue
+                try:
+                    vids = {id(v) for v in free_vars(base)}
+                except TypeError:
+                    continue
+                if not (vids & ranged_ids):
+                    continue    # constant windows are TL103's job
+                if _guard_mentions(sctx.guards, vids):
+                    continue    # ragged edge handled by an If guard
+                iv = expr_interval(base, ranges)
+                if iv is None:
+                    continue
+                lo, hi = iv
+                if lo >= 0 and hi + ext <= bshape[d]:
+                    continue
+                key = (id(s), acc.attr, d)
+                if key in seen:
+                    continue
+                seen.add(key)
+                sev = "warning" if buf.scope == "global" else "error"
+                out.append(Diagnostic(
+                    "TL004", sev,
+                    f"index range [{lo}:{hi + ext}) of "
+                    f"{type(s).__name__}.{acc.attr} walks outside "
+                    f"'{buf.name}' dim {d} (extent {bshape[d]}) for "
+                    f"some iteration of the enclosing loop(s)",
+                    buffer=buf.name, op=type(s).__name__,
+                    loc=stmt_loc(s)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TL005 — vmem-budget
+# ---------------------------------------------------------------------------
+
+
+@_rule("TL005", "vmem-budget", needs_plan=True)
+def _tl005_vmem_budget(ctx: LintContext) -> List[Diagnostic]:
+    plan = ctx.plan
+    if plan is None:
+        return []
+    from ..transform.plan import (_DEFAULT_VMEM_BUDGET, _block_param_bytes)
+    budget = ctx.pass_cfg.get("tl.tpu.vmem_budget_bytes")
+    if budget is None:
+        budget = ctx.pass_cfg.get("tl.tpu.vmem_limit_bytes")
+    if budget is None:
+        budget = _DEFAULT_VMEM_BUDGET   # explicit 0 means "flag all"
+    budget = int(budget)
+    contributors: List[tuple] = []      # (bytes, name, what)
+    total = plan.vmem_arena
+    if plan.vmem_arena:
+        for b in plan.scratch:
+            if b.uid in plan.vmem_offsets:
+                from ..ir import dtype_bits
+                ss = b.static_shape()
+                if ss is None:
+                    continue
+                n = 1
+                for x in ss:
+                    n *= x
+                contributors.append(
+                    (n * dtype_bits(b.dtype) // 8, b.name,
+                     f"scratch [{b.scope}]"))
+    for p in plan.params:
+        if p.mode == "block" and p.block_dims:
+            nbytes = _block_param_bytes(p, plan.grid)
+            total += nbytes
+            contributors.append((nbytes, p.buffer.name,
+                                 "BlockSpec window (double-buffered)"))
+    if total <= budget:
+        return []
+    contributors.sort(reverse=True)
+    top = "; ".join(f"{name}: {nb} B ({what})"
+                    for nb, name, what in contributors[:6])
+    return [Diagnostic(
+        "TL005", "warning",
+        f"planned VMEM footprint {total} B exceeds the "
+        f"{budget} B budget (arena {plan.vmem_arena} B + BlockSpec "
+        f"windows); largest consumers: {top}. Shrink block sizes or "
+        f"raise tl.tpu.vmem_budget_bytes",
+        buffer=contributors[0][1] if contributors else "")]
+
+
+# ---------------------------------------------------------------------------
+# TL006 — dead-store / unused-alloc
+# ---------------------------------------------------------------------------
+
+
+@_rule("TL006", "dead-store")
+def _tl006_dead_store(ctx: LintContext) -> List[Diagnostic]:
+    from ..ir import AllocStmt
+    out: List[Diagnostic] = []
+    allocs = {}     # buffer uid -> AllocStmt, built in ONE pass
+    for s, _ in iter_stmts(ctx.func.body):
+        if isinstance(s, AllocStmt):
+            allocs.setdefault(s.buffer.uid, s)
+    for uid, du in sorted(def_use(ctx.func).items()):
+        b = du.buffer
+        if b.scope in ("global", "sem"):
+            continue
+        alloc = allocs.get(uid)
+        loc = stmt_loc(alloc) if alloc is not None else None
+        if not du.reads and not du.writes:
+            out.append(Diagnostic(
+                "TL006", "info",
+                f"scratch '{b.name}' is allocated but never used; "
+                f"remove the T.alloc_* (it still costs VMEM)",
+                buffer=b.name, op="AllocStmt", loc=loc))
+        elif not du.reads:
+            out.append(Diagnostic(
+                "TL006", "info",
+                f"scratch '{b.name}' is written but never read "
+                f"(dead stores); remove the buffer and its writes",
+                buffer=b.name,
+                op=type(du.writes[0][0].stmt).__name__,
+                loc=stmt_loc(du.writes[0][0].stmt)))
+    return out

@@ -115,6 +115,12 @@ class KernelCache:
         h.update(json.dumps(pass_cfg, sort_keys=True, default=str).encode())
         h.update(__version__.encode())
         h.update(str(CODEGEN_VERSION).encode())
+        # the resolved tl-lint mode is part of the artifact's identity:
+        # strict must re-check (and reject) what warn cached, and a
+        # warn-mode artifact carries a lint[...] plan_desc block an
+        # off-mode compile would not
+        from ..analysis.rules import lint_mode
+        h.update(lint_mode(pass_cfg).encode())
         return h.hexdigest()
 
     def get(self, key: str):

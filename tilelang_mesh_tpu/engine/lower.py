@@ -78,10 +78,34 @@ def lower(func, target: str = "auto",
 
         with _trace.span("checks", "lower", kernel=func.name):
             _faults.maybe_fail("lower.checks", kernel=func.name)
-            run_semantic_checks(func)
+            lint_findings = run_semantic_checks(func, cfg)
         with _trace.span("plan", "lower", kernel=func.name):
             _faults.maybe_fail("lower.plan", kernel=func.name)
             plan = plan_kernel(func, cfg)
+        # tl-lint plan-level rules (TL005 vmem-budget) run on the REAL
+        # plan — no second planning pass — and the combined findings are
+        # surfaced in plan_desc + attrs["lint"] + lint.* counters. A
+        # clean kernel adds NOTHING, keeping every golden byte-stable.
+        from ..analysis import (SemanticError, lint_mode, plan_desc_block,
+                                record_findings, run_plan_lint)
+        lmode = lint_mode(cfg)
+        plan_desc = plan.describe()
+        attrs = dict(func.attrs)
+        if lmode != "off":
+            with _trace.span("lint", "lower", kernel=func.name):
+                lint_findings = list(lint_findings) + \
+                    run_plan_lint(func, plan, cfg)
+                record_findings(lint_findings, kernel=func.name)
+            errs = [d for d in lint_findings if d.severity == "error"]
+            if lmode == "strict" and errs:
+                raise SemanticError(
+                    f"{func.name}: lint failed (TL_TPU_LINT=strict):"
+                    "\n  - " + "\n  - ".join(d.format() for d in errs),
+                    errs)
+            if lint_findings:
+                plan_desc += "\n".join(
+                    plan_desc_block(lint_findings, lmode)) + "\n"
+                attrs["lint"] = [d.to_dict() for d in lint_findings]
         with _trace.span("codegen", "lower", kernel=func.name) as sp:
             _faults.maybe_fail("lower.codegen", kernel=func.name)
             source = generate_source(plan, cfg)
@@ -95,6 +119,6 @@ def lower(func, target: str = "auto",
                 target=target,
                 grid=tuple(a.extent for a in plan.grid),
                 ir_script=func.script(),
-                plan_desc=plan.describe(),
-                attrs=dict(func.attrs),
+                plan_desc=plan_desc,
+                attrs=attrs,
             )

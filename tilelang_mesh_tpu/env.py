@@ -66,6 +66,13 @@ class Environment:
     # after comm_opt, "0"/"off" disables it, "strict" escalates warnings
     # to hard MeshVerifyErrors.
     TL_TPU_VERIFY = EnvVar("TL_TPU_VERIFY", "1")
+    # tl-lint static-analysis suite (analysis/rules.py; docs/
+    # static_analysis.md). "warn" (default) runs the TL001-TL006 dataflow
+    # rules and surfaces findings in plan_desc/attrs["lint"]/lint.*
+    # counters; "strict" escalates error-severity findings to a hard
+    # SemanticError; "0" disables the rules (the TL1xx semantic checkers
+    # stay on). Pass config "tl.tpu.lint" overrides per compile.
+    TL_TPU_LINT = EnvVar("TL_TPU_LINT", "warn")
     # differential self-check: first call of each optimized mesh kernel
     # also runs the TL_TPU_COMM_OPT=0 schedule and compares outputs
     TL_TPU_SELFCHECK = EnvVar("TL_TPU_SELFCHECK", False, bool)

@@ -16,7 +16,10 @@ from .expr import PrimExpr, Var, convert
 
 
 class Stmt:
-    pass
+    #: DSL call site ("file", lineno) stamped by the trace builder
+    #: (language/builder.py) so static-analysis diagnostics can point at
+    #: the offending kernel line; None for IR built outside a trace.
+    loc = None
 
 
 class SeqStmt(Stmt):
@@ -329,7 +332,9 @@ class PrimFunc:
 
 
 def walk(stmt: Stmt, fn):
-    """Pre-order visit of every statement."""
+    """Pre-order visit of every statement, including the member ops of
+    post-optimizer composites (CommFused/CommChunked) so a checker written
+    against the leaf CommStmt types cannot silently skip a rewritten op."""
     fn(stmt)
     children = []
     if isinstance(stmt, SeqStmt):
@@ -341,6 +346,10 @@ def walk(stmt: Stmt, fn):
     elif isinstance(stmt, IfThenElse):
         children = [stmt.then_body] + ([stmt.else_body] if stmt.else_body
                                        else [])
+    elif isinstance(stmt, CommFused):
+        children = list(stmt.ops)
+    elif isinstance(stmt, CommChunked):
+        children = [stmt.op]
     for c in children:
         walk(c, fn)
 

@@ -14,12 +14,44 @@ from __future__ import annotations
 
 import functools
 import inspect
+import os
+import sys
 import threading
 from typing import Any, Callable, List, Optional
 
 from ..ir import (Buffer, PrimFunc, SeqStmt, Stmt, AllocStmt, Var, convert)
 
 _STATE = threading.local()
+
+# DSL-machinery directories skipped when attributing an emitted statement
+# to its user call site: the first frame OUTSIDE these is the kernel body
+# line a diagnostic should point at (ops/ and user modules both count as
+# kernel source).
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DSL_DIRS = (os.path.join(_PKG_DIR, "language") + os.sep,
+             os.path.join(_PKG_DIR, "ir") + os.sep)
+
+
+def _source_loc(max_depth: int = 32):
+    """("file", lineno) of the innermost non-DSL frame, or None.
+
+    Captured on every Builder.emit so static-analysis diagnostics
+    (analysis/diagnostics.py) can name the offending kernel line. Tracing
+    runs once per kernel shape, so the small frame walk is off every hot
+    path."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:          # pragma: no cover - interpreter limits
+        return None
+    depth = 0
+    while f is not None and depth < max_depth:
+        fname = f.f_code.co_filename
+        if not fname.startswith("<") and \
+                not any(fname.startswith(d) for d in _DSL_DIRS):
+            return fname, f.f_lineno
+        f = f.f_back
+        depth += 1
+    return None
 
 
 def _stack() -> List["Builder"]:
@@ -61,6 +93,8 @@ class Builder:
         return self.frames.pop()
 
     def emit(self, stmt: Stmt):
+        if stmt.loc is None:
+            stmt.loc = _source_loc()
         self.frames[-1].stmts.append(stmt)
 
     # -- naming --------------------------------------------------------------
@@ -150,6 +184,25 @@ def _param_annotations(fn: Callable) -> List[tuple]:
     return out
 
 
+# observers called with every PrimFuncObj the builder produces — the
+# offline linter (tools/lint.py) hooks here to collect the kernels a
+# module traces while importing / seeding factories, without needing the
+# module to export them
+_TRACE_CALLBACKS: List[Callable] = []
+
+
+def add_trace_callback(cb: Callable) -> Callable:
+    _TRACE_CALLBACKS.append(cb)
+    return cb
+
+
+def remove_trace_callback(cb: Callable) -> None:
+    try:
+        _TRACE_CALLBACKS.remove(cb)
+    except ValueError:
+        pass
+
+
 def trace_prim_func(fn: Callable, name: Optional[str] = None) -> PrimFuncObj:
     """Run `fn` against proxies built from its annotations; return the IR."""
     annots = _param_annotations(fn)
@@ -163,7 +216,10 @@ def trace_prim_func(fn: Callable, name: Optional[str] = None) -> PrimFuncObj:
         fn(*args)
     finally:
         _stack().pop()
-    return PrimFuncObj(b.finish(), fn, annots)
+    obj = PrimFuncObj(b.finish(), fn, annots)
+    for cb in list(_TRACE_CALLBACKS):
+        cb(obj)
+    return obj
 
 
 def _make_param(b: Builder, pname: str, annot) -> Any:

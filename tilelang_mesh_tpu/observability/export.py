@@ -38,8 +38,8 @@ __all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
 # the engine/lower.py pipeline span names, in pipeline order — the ONE
 # copy every consumer (analyzer --trace, bench.py embedding, tests)
 # keys its per-phase breakdown on
-LOWER_PHASES = ("canonicalize", "checks", "comm_opt", "plan", "codegen",
-                "artifact")
+LOWER_PHASES = ("canonicalize", "checks", "comm_opt", "plan", "lint",
+                "codegen", "artifact")
 
 
 def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
@@ -318,6 +318,29 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "watchdog_timeouts": c("verify.watchdog.timeouts"),
         "degraded_schedules": c("verify.degraded_schedules"),
     }
+    # tl-lint accounting (analysis/rules.py; docs/static_analysis.md):
+    # findings by rule and severity parsed from the labelled
+    # lint.findings{rule=...,severity=...} counters, so soaks/benches
+    # can assert lint-cleanliness like they assert verify-cleanliness
+    lint_by_rule: Dict[str, float] = {}
+    lint_by_sev: Dict[str, float] = {}
+    for k, v in counters.items():
+        if not k.startswith("lint.findings{"):
+            continue
+        lbl = dict(kv.split("=", 1)
+                   for kv in k[k.index("{") + 1:-1].split(",") if "=" in kv)
+        r = lbl.get("rule", "?")
+        sv = lbl.get("severity", "?")
+        lint_by_rule[r] = lint_by_rule.get(r, 0) + v
+        lint_by_sev[sv] = lint_by_sev.get(sv, 0) + v
+    lint = {
+        "kernels": c("lint.kernels"),
+        "findings": labelled_total("lint.findings"),
+        "errors": lint_by_sev.get("error", 0),
+        "warnings": lint_by_sev.get("warning", 0),
+        "by_rule": dict(sorted(lint_by_rule.items())),
+        "by_severity": dict(sorted(lint_by_sev.items())),
+    }
     # serving engine accounting (serving/; docs/serving.md): monotonic
     # outcome counters + shed-reason breakdown from the tracer, latency
     # digests from the shared histograms, live gauges from the engines
@@ -375,7 +398,7 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
     }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
-            "verify": verify, "serving": serving,
+            "verify": verify, "lint": lint, "serving": serving,
             "runtime": _runtime.runtime_summary()}
 
 

@@ -58,8 +58,12 @@ def _varlen_softmax_loop(Q, K, V, SeqQ, SeqK, PosQ, PosK, BlockLive, bx,
     V_s = T.alloc_shared((block_N, D), dtype)
     sq_s = T.alloc_shared((block_M,), "int32")
     sk_s = T.alloc_shared((block_N,), "int32")
-    pq_s = T.alloc_shared((block_M,), "int32")
-    pk_s = T.alloc_shared((block_N,), "int32")
+    # local-position buffers are causal-only (rule TL006: the non-causal
+    # trace would otherwise carry two dead allocs into the VMEM arena)
+    pq_s = pk_s = None
+    if causal:
+        pq_s = T.alloc_shared((block_M,), "int32")
+        pk_s = T.alloc_shared((block_N,), "int32")
     st = alloc_softmax_state(block_M, block_N, D, dtype)
     S = st["S"]
 
@@ -219,8 +223,10 @@ def varlen_bwd_dkdv_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
             De_s = T.alloc_shared((block_M,), "float32")
             sq_s = T.alloc_shared((block_M,), "int32")
             sk_s = T.alloc_shared((block_N,), "int32")
-            pq_s = T.alloc_shared((block_M,), "int32")
-            pk_s = T.alloc_shared((block_N,), "int32")
+            pq_s = pk_s = None
+            if causal:      # causal-only (TL006): see _varlen_softmax_loop
+                pq_s = T.alloc_shared((block_M,), "int32")
+                pk_s = T.alloc_shared((block_N,), "int32")
             S = T.alloc_fragment((block_M, block_N), "float32")
             P = T.alloc_fragment((block_M, block_N), dtype)
             dP = T.alloc_fragment((block_M, block_N), "float32")
@@ -294,8 +300,10 @@ def varlen_bwd_dq_kernel(Hq, Hkv, Tq, Tk, D, block_M, block_N, causal,
             V_s = T.alloc_shared((block_N, D), dtype)
             sq_s = T.alloc_shared((block_M,), "int32")
             sk_s = T.alloc_shared((block_N,), "int32")
-            pq_s = T.alloc_shared((block_M,), "int32")
-            pk_s = T.alloc_shared((block_N,), "int32")
+            pq_s = pk_s = None
+            if causal:      # causal-only (TL006): see _varlen_softmax_loop
+                pq_s = T.alloc_shared((block_M,), "int32")
+                pk_s = T.alloc_shared((block_N,), "int32")
             S = T.alloc_fragment((block_M, block_N), "float32")
             dP = T.alloc_fragment((block_M, block_N), "float32")
             dS = T.alloc_fragment((block_M, block_N), dtype)

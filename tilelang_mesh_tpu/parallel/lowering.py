@@ -163,7 +163,7 @@ def lower_mesh(func: PrimFunc, target: str,
                mesh_cfg: Optional[Tuple[int, int]],
                pass_cfg: dict) -> CompiledArtifact:
     with _trace.span("checks", "lower", kernel=func.name, mesh=True):
-        run_semantic_checks(func)
+        lint_findings = run_semantic_checks(func, pass_cfg)
     kn = func.kernel_node()
     if mesh_cfg is None:
         mesh_cfg = func.attrs.get("mesh_config")
@@ -342,6 +342,19 @@ def lower_mesh(func: PrimFunc, target: str,
         for line in comm_opt_rec["rewrites"]:
             schedule_lines.append(f"    * {line}")
 
+    # tl-lint findings (strict mode already raised inside
+    # run_semantic_checks): same three surfaces as the single-kernel
+    # path — schedule text block, attrs["lint"], lint.* counters.
+    # Clean programs add nothing, so the golden schedule texts hold.
+    lint_rec = None
+    from ..analysis import lint_mode, plan_desc_block, record_findings
+    lmode = lint_mode(pass_cfg)
+    if lmode != "off":
+        record_findings(lint_findings, kernel=func.name)
+        if lint_findings:
+            schedule_lines.extend(plan_desc_block(lint_findings, lmode))
+            lint_rec = [d.to_dict() for d in lint_findings]
+
     for p in params:
         schedule_lines.append(
             f"  param {p.name}: role={p.role} spec="
@@ -369,6 +382,8 @@ def lower_mesh(func: PrimFunc, target: str,
                # schedule-verifier record (None when TL_TPU_VERIFY=0 or
                # the program has no collectives)
                "verify": verify_rec,
+               # tl-lint findings (None when clean or TL_TPU_LINT=0)
+               "lint": lint_rec,
                # the pass config this artifact was lowered under, kept so
                # the runtime guardrails (selfcheck/watchdog) can re-lower
                # the SAME program with only the optimizer disabled

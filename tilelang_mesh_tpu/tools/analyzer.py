@@ -738,6 +738,19 @@ def _run_serve(path, as_json: bool) -> int:
     return 0
 
 
+def _run_lint(targets, as_json: bool, out) -> int:
+    """``analyzer lint`` — the offline module linter (tools/lint.py)
+    behind the shared analyzer surface (``--json`` honored like every
+    other subcommand). Exit 1 iff an error-severity finding fired."""
+    from .lint import format_report, lint_targets
+    report = lint_targets(list(targets))
+    if out:
+        from pathlib import Path
+        Path(out).write_text(json.dumps(report, indent=2))
+    _emit(report, format_report(report), as_json)
+    return 1 if report["summary"]["errors"] else 0
+
+
 def _run_perf_diff(baseline, current, as_json: bool,
                    threshold_mads: float, min_rel: float,
                    report_only: bool) -> int:
@@ -818,6 +831,15 @@ def main(argv=None) -> int:
                       "reason, terminal outcomes, KV slab balance, "
                       "step/queue latency (docs/serving.md)")
     p_sv.add_argument("file", help="JSONL trace file")
+    p_ln = sub.add_parser(
+        "lint", help="offline static analysis of kernel modules: the "
+                     "TL001-TL006 dataflow rules + TL1xx semantic "
+                     "checks (docs/static_analysis.md); exit 1 on any "
+                     "error-severity finding")
+    p_ln.add_argument("targets", nargs="+",
+                      help=".py file, directory, or dotted module name")
+    p_ln.add_argument("--out", metavar="FILE",
+                      help="also write the JSON report to FILE")
     p_pd = sub.add_parser(
         "perf-diff", help="noise-aware per-config latency comparison of "
                           "two bench artifacts; exits 1 on a real "
@@ -833,7 +855,7 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_sv, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -845,6 +867,8 @@ def main(argv=None) -> int:
         return _run_verify(args.file, args.json)
     if args.cmd == "serve":
         return _run_serve(args.file, args.json)
+    if args.cmd == "lint":
+        return _run_lint(args.targets, args.json, args.out)
     return _run_perf_diff(args.baseline, args.current, args.json,
                           args.threshold_mads, args.min_rel,
                           args.report_only)

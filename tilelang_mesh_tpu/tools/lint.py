@@ -1,0 +1,329 @@
+"""tl-lint CLI: offline static analysis of whole kernel modules.
+
+::
+
+    python -m tilelang_mesh_tpu.tools.lint tilelang_mesh_tpu/ops/
+    python -m tilelang_mesh_tpu.tools.lint tilelang_mesh_tpu/ops/gemm.py --json
+    python -m tilelang_mesh_tpu.tools.analyzer lint examples/ --json
+
+Targets are .py files, directories (recursed), or dotted module names.
+For each module the linter:
+
+1. imports it while hooking the trace builder, so every ``@T.prim_func``
+   traced at import time is collected;
+2. seed-instantiates the module's public ``*_kernel`` factory functions
+   with small smoke shapes (a dimension-name default table plus
+   per-module overrides), collecting every kernel they trace — this is
+   how the ops library, whose kernels are built lazily per shape, gets
+   linted without running anything;
+3. runs ``analysis.collect_diagnostics`` (the TL1xx semantic checkers +
+   the TL001-TL006 dataflow rules, plan-level TL005 included) on each
+   collected kernel — the identical finding set the in-pipeline pass
+   produces for the same kernel.
+
+Exit code 1 iff any error-severity finding fired — the contract the CI
+``lint-oplib`` job gates on (the shipped library must be lint-clean).
+Modules that fail to import and seeds that fail to instantiate are
+REPORTED but do not gate; they mean "not linted", not "buggy".
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import inspect
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# small smoke shapes for required factory parameters, by conventional
+# dimension name (ops library wide). Values are chosen to trace valid,
+# Mosaic-tileable kernels fast — they never execute.
+DIM_DEFAULTS: Dict[str, object] = {
+    "M": 256, "N": 256, "K": 256, "K2": 128, "E": 2,
+    "B": 2, "H": 4, "Hq": 4, "Hkv": 2, "HI": 4,
+    "S": 256, "Sq": 256, "Sk": 256, "Skv": 256,
+    "Tq": 256, "Tk": 256, "Tt": 128, "TB": 128,
+    "D": 128, "DI": 64, "DK": 64, "DV": 64, "DT": 64, "V": 64, "P": 64,
+    "G": 2, "PP": 8, "PS": 128, "rows": 2048, "rows_pad": 256,
+    "Ns": 2, "NS": 4, "BS": 64, "BI": 64, "topk": 64,
+    "dc": 512, "dr": 64,
+    "n_split": 2, "n_seg": 4, "chunk": 64, "window": 64,
+    "q_offset": 0, "scale": 1.0, "sm_scale": 0.125, "causal": False,
+    "block_M": 128, "block_N": 128, "block_K": 128, "block_K2": 256,
+    "block_T": 64,
+    "dtype": "float32", "in_dtype": "float32", "out_dtype": "float32",
+}
+
+# per-module overrides where a conventional name means something else
+# (nsa's S is "selected blocks per query", not a sequence length)
+SEED_OVERRIDES: Dict[str, Dict[str, object]] = {
+    "nsa": {"S": 4, "Tk": 512},
+    "nsa_bwd": {"S": 4, "NS": 4, "Tk": 512},
+    "dsa": {"S": 128, "block_T": 64},
+    # w4a8 packs K/2 int4 pairs and asserts K2 % block_K2(=256) == 0
+    "dequant_gemm": {"K": 512},
+}
+
+
+def _package_module_name(path: Path) -> Optional[Tuple[str, Path]]:
+    """(dotted.module.name, package_root_parent) when the file sits
+    inside a package (an __init__.py chain) — such files use relative
+    imports and must be imported by their real name."""
+    path = path.resolve()
+    parts = [path.stem]
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.append(d.name)
+        d = d.parent
+    if len(parts) == 1:
+        return None
+    return ".".join(reversed(parts)), d
+
+
+def _load_module(path: Path):
+    """Import a file: by dotted name when it belongs to a package, else
+    as a uniquely-named standalone module (no package side effects;
+    `if __name__ == "__main__"` guards stay cold either way)."""
+    pkg = _package_module_name(path)
+    if pkg is not None:
+        name, root = pkg
+        added = False
+        if str(root) not in sys.path:
+            sys.path.insert(0, str(root))
+            added = True
+        try:
+            return importlib.import_module(name)
+        finally:
+            if added:
+                sys.path.remove(str(root))
+    name = "tl_lint_target_" + "_".join(path.with_suffix("").parts[-3:])
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(name, None)
+    return mod
+
+
+def _seed_kwargs(fn, overrides: Dict[str, object]
+                 ) -> Optional[Dict[str, object]]:
+    """Smoke arguments for a factory's required params, or None when a
+    required param has no table entry (seed skipped)."""
+    target = getattr(fn, "__wrapped__", fn)
+    try:
+        sig = inspect.signature(target)
+    except (TypeError, ValueError):
+        return None
+    kwargs: Dict[str, object] = {}
+    for p in sig.parameters.values():
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if p.default is not inspect.Parameter.empty:
+            continue
+        if p.name in overrides:
+            kwargs[p.name] = overrides[p.name]
+        elif p.name in DIM_DEFAULTS:
+            kwargs[p.name] = DIM_DEFAULTS[p.name]
+        else:
+            return None
+    return kwargs
+
+
+def collect_module_kernels(target) -> Tuple[list, List[dict]]:
+    """Import + seed one module; returns ([PrimFuncObj...], notes).
+
+    Notes record import failures and skipped/failed seeds so a CI
+    artifact shows exactly what was and was not linted."""
+    from ..language import builder as _builder
+    collected: list = []
+    seen_ids = set()
+    notes: List[dict] = []
+
+    def hook(obj):
+        if id(obj.func) not in seen_ids:
+            seen_ids.add(id(obj.func))
+            collected.append(obj)
+
+    _builder.add_trace_callback(hook)
+    try:
+        if isinstance(target, Path):
+            modname = target.stem
+            try:
+                mod = _load_module(target)
+            except BaseException as e:   # noqa: BLE001 - report, don't die
+                notes.append({"kind": "import-error",
+                              "target": str(target),
+                              "error": f"{type(e).__name__}: {e}"})
+                return collected, notes
+        else:
+            modname = str(target).rsplit(".", 1)[-1]
+            try:
+                mod = importlib.import_module(target)
+            except BaseException as e:   # noqa: BLE001
+                notes.append({"kind": "import-error",
+                              "target": str(target),
+                              "error": f"{type(e).__name__}: {e}"})
+                return collected, notes
+
+        # module-level prim funcs were collected by the hook at import;
+        # also pick up any the module re-exports
+        from ..language.builder import PrimFuncObj
+        for v in vars(mod).values():
+            if isinstance(v, PrimFuncObj):
+                hook(v)
+
+        overrides = SEED_OVERRIDES.get(modname, {})
+        for name, fn in sorted(vars(mod).items()):
+            if name.startswith("_") or not name.endswith("_kernel") \
+                    or not callable(fn):
+                continue
+            kwargs = _seed_kwargs(fn, overrides)
+            if kwargs is None:
+                notes.append({"kind": "seed-skipped", "target": modname,
+                              "factory": name,
+                              "error": "required parameter without a "
+                                       "smoke default"})
+                continue
+            before = len(collected)
+            # lru_cached factories only trace on a miss: clear so a
+            # second lint run (same process, e.g. tests) still collects
+            if hasattr(fn, "cache_clear"):
+                fn.cache_clear()
+            try:
+                fn(**kwargs)
+            except BaseException as e:   # noqa: BLE001 - the traced IR
+                # (if any) is still linted; the compile failure itself
+                # is the pipeline's business, not the linter's
+                notes.append({"kind": "seed-error", "target": modname,
+                              "factory": name,
+                              "error": f"{type(e).__name__}: {e}"})
+            if len(collected) == before:
+                notes.append({"kind": "seed-no-kernel", "target": modname,
+                              "factory": name})
+    finally:
+        _builder.remove_trace_callback(hook)
+    return collected, notes
+
+
+def _expand_targets(targets: List[str]) -> List[object]:
+    out: List[object] = []
+    for t in targets:
+        p = Path(t)
+        if p.is_dir():
+            out.extend(sorted(f for f in p.rglob("*.py")
+                              if "__pycache__" not in f.parts
+                              and f.name != "__init__.py"))
+        elif p.suffix == ".py" and p.exists():
+            out.append(p)
+        else:
+            out.append(t)    # dotted module name
+    return out
+
+
+def lint_targets(targets: List[str],
+                 pass_cfg: Optional[dict] = None) -> dict:
+    """Lint every kernel of every target; returns the JSON-able report
+    the CLI prints and CI uploads."""
+    from ..analysis import collect_diagnostics
+    findings: List[dict] = []
+    notes: List[dict] = []
+    kernels = 0
+    by_rule: Dict[str, int] = {}
+    by_sev: Dict[str, int] = {}
+    expanded = _expand_targets(targets)
+    for target in expanded:
+        objs, tnotes = collect_module_kernels(target)
+        notes.extend(tnotes)
+        for obj in objs:
+            kernels += 1
+            try:
+                diags = collect_diagnostics(obj.func, pass_cfg,
+                                            with_plan=True)
+            except Exception as e:    # noqa: BLE001
+                notes.append({"kind": "lint-error",
+                              "target": str(target),
+                              "kernel": obj.func.name,
+                              "error": f"{type(e).__name__}: {e}"})
+                continue
+            for d in diags:
+                rec = d.to_dict()
+                rec["target"] = str(target)
+                findings.append(rec)
+                by_rule[d.rule] = by_rule.get(d.rule, 0) + 1
+                by_sev[d.severity] = by_sev.get(d.severity, 0) + 1
+    return {
+        "targets": [str(t) for t in expanded],
+        "kernels_linted": kernels,
+        "findings": findings,
+        "summary": {"by_rule": dict(sorted(by_rule.items())),
+                    "by_severity": dict(sorted(by_sev.items())),
+                    "total": len(findings),
+                    "errors": by_sev.get("error", 0)},
+        "notes": notes,
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [f"tl-lint: {report['kernels_linted']} kernel(s) from "
+             f"{len(report['targets'])} target(s)"]
+    for f in report["findings"]:
+        loc = f" @ {f['loc']}" if f.get("loc") else ""
+        buf = f" [buffer={f['buffer']}]" if f.get("buffer") else ""
+        lines.append(f"  {f.get('kernel', '?')}: {f['rule']} "
+                     f"{f['severity']}: {f['message']}{buf}{loc}")
+    s = report["summary"]
+    if s["total"]:
+        by = ", ".join(f"{r}={n}" for r, n in s["by_rule"].items())
+        lines.append(f"findings: {s['total']} ({by}); "
+                     f"errors: {s['errors']}")
+    else:
+        lines.append("no findings — lint-clean")
+    skipped = [n for n in report["notes"]
+               if n["kind"] in ("seed-skipped", "seed-error")]
+    imports = [n for n in report["notes"] if n["kind"] == "import-error"]
+    if skipped:
+        lines.append(f"{len(skipped)} factory seed(s) not instantiated "
+                     f"(not linted):")
+        for n in skipped[:20]:
+            lines.append(f"  {n['target']}.{n.get('factory', '?')}: "
+                         f"{n.get('error', '')}")
+    if imports:
+        lines.append(f"{len(imports)} target(s) failed to import "
+                     f"(not linted):")
+        for n in imports[:20]:
+            lines.append(f"  {n['target']}: {n['error']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.tools.lint",
+        description="Lint tile-kernel modules offline with the TL001-"
+                    "TL006 dataflow rules + TL1xx semantic checks "
+                    "(docs/static_analysis.md). Exit 1 iff an error-"
+                    "severity finding fired.")
+    ap.add_argument("targets", nargs="+",
+                    help=".py file, directory, or dotted module name")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report")
+    ap.add_argument("--out", metavar="FILE",
+                    help="also write the JSON report to FILE "
+                         "(CI artifact)")
+    args = ap.parse_args(argv)
+    report = lint_targets(args.targets)
+    if args.out:
+        Path(args.out).write_text(json.dumps(report, indent=2))
+    print(json.dumps(report, indent=2) if args.json     # noqa: T201
+          else format_report(report))
+    return 1 if report["summary"]["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
