@@ -161,11 +161,11 @@ mesh_program(k) mesh=(2x2) axes=(x,y):
 
 
 def test_dce_golden_schedule():
-    # tl.tpu.lint off: this program DELIBERATELY writes a never-read
-    # fragment (the DCE seed), which rule TL006 would rightly flag —
-    # the golden here is the comm_opt rewrite text, not the lint block
-    assert _lower(_dce_program(),
-                  **{"tl.tpu.lint": "0"}).plan_desc == """\
+    # no tl.tpu.lint=0 workaround needed anymore: TL006 recognizes that
+    # the never-read fragment is written only by a collective the
+    # enabled dce rewrite will delete, and stays silent — the deletion
+    # is reported through the comm_opt accounting below instead
+    assert _lower(_dce_program()).plan_desc == """\
 mesh_program(k) mesh=(2x2) axes=(x,y):
   [0] pallas_segment k_seg0 grid=(1,) ins=(A) outs=(B)
   comm_opt[fuse,dce,overlap]: wire 128B -> 0B, hops 4 -> 0

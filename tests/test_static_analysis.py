@@ -635,6 +635,9 @@ class TestLintKnob:
 
     def test_warn_mode_compiles_and_surfaces(self, monkeypatch):
         monkeypatch.setenv("TL_TPU_LINT", "warn")
+        # tile-opt's dse would auto-fix (and consume) the TL006 finding;
+        # this test asserts the raw lint surface
+        monkeypatch.setenv("TL_TPU_TILE_OPT", "0")
         art = tilelang.lower(_dirty_compilable())
         lint = art.attrs.get("lint")
         assert lint and {d["rule"] for d in lint} == {"TL003", "TL006"}
@@ -668,6 +671,7 @@ class TestLintKnob:
     def test_counters_and_metrics_summary(self, monkeypatch):
         obs.reset()
         monkeypatch.setenv("TL_TPU_LINT", "warn")
+        monkeypatch.setenv("TL_TPU_TILE_OPT", "0")   # keep TL006 surfaced
         tilelang.lower(_dirty_compilable())
         summary = obs.metrics_summary()["lint"]
         assert summary["findings"] >= 2
@@ -703,6 +707,10 @@ class TestLintKnob:
 class TestMeshSurfacing:
     def test_mesh_lint_block_and_attrs(self, monkeypatch):
         monkeypatch.setenv("TL_TPU_LINT", "warn")
+        # with comm_opt dce enabled TL006 stays silent on dead
+        # collective results (the optimizer deletes them); disable the
+        # rewrite so the mesh lint SURFACE is what's under test
+        monkeypatch.setenv("TL_TPU_COMM_OPT", "0")
         from tilelang_mesh_tpu.parallel import mesh_config
         with mesh_config(2, 2):
             @T.prim_func

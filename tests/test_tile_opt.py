@@ -1,0 +1,778 @@
+"""tile-opt pass-suite tests (transform/tile_opt.py; docs/tile_opt.md).
+
+Layout:
+
+- mode-knob parsing (TL_TPU_TILE_OPT / tl.tpu.tile_opt);
+- per-rewrite golden fire/no-fire pairs: dse (incl. the dead-chain
+  fixpoint and TL006 consumption), repack (incl. the overlapping- and
+  guarded-lifetime refusals), dbuf (incl. the loop-carried and
+  src-clobber refusals), fuse (incl. the shifted-dependency and
+  non-injective refusals) — each with numerical equivalence against the
+  TL_TPU_TILE_OPT=0 lowering;
+- pass-composition determinism: the canonical dse -> repack -> dbuf ->
+  fuse pipeline on a kernel that triggers all four, two lowerings
+  byte-identical, plus a seeded sweep of generated kernels;
+- TL_TPU_TILE_OPT=0 restores the pre-pass plan_desc byte-identically on
+  ops-library kernels (and kernels with no rewrite stay byte-stable
+  with the pass ON);
+- the differential selfcheck (TL_TPU_SELFCHECK=1): a clean optimized
+  kernel passes, a deliberately corrupted rewrite raises
+  SelfCheckDivergence on the first call (the PR 5 mutation pattern);
+- cache-key separation, attrs/counters/metrics_summary surfacing, the
+  unified eliminated accounting with comm_opt dce, the analyzer trace
+  section, and the lint CLI --fix hint.
+"""
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.engine.lower import lower
+from tilelang_mesh_tpu.transform import tile_opt
+from tilelang_mesh_tpu.transform.tile_opt import (MODES, run_tile_opt,
+                                                  tile_opt_modes)
+
+M = N = 128
+
+OFF = {"tl.tpu.tile_opt": "0"}
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _rand(shape, seed=0):
+    jnp = _jnp()
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), jnp.float32)
+
+
+def _assert_equivalent(func, *args, pass_configs=None):
+    """Numerics of the optimized lowering == the TL_TPU_TILE_OPT=0
+    lowering on the same inputs."""
+    cfg = dict(pass_configs or {})
+    k1 = tilelang.compile(func, target="cpu", pass_configs=cfg or None)
+    k0 = tilelang.compile(func, target="cpu",
+                          pass_configs={**cfg, **OFF})
+    r1, r0 = k1(*args), k0(*args)
+    r1 = r1 if isinstance(r1, tuple) else (r1,)
+    r0 = r0 if isinstance(r0, tuple) else (r0,)
+    for a, b in zip(r1, r0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    return k1, k0
+
+
+# ---------------------------------------------------------------------------
+# mode knob
+# ---------------------------------------------------------------------------
+
+
+class TestModes:
+    def test_default_all(self, monkeypatch):
+        monkeypatch.delenv("TL_TPU_TILE_OPT", raising=False)
+        assert tile_opt_modes() == MODES
+
+    def test_off_spellings(self):
+        for v in ("0", "off", "false", "none", "no"):
+            assert tile_opt_modes({"tl.tpu.tile_opt": v}) == ()
+
+    def test_subset_and_order(self):
+        assert tile_opt_modes({"tl.tpu.tile_opt": "fuse,dse"}) == \
+            ("dse", "fuse")
+        assert tile_opt_modes({"tl.tpu.tile_opt": "repack+dbuf"}) == \
+            ("repack", "dbuf")
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_TILE_OPT", "dse")
+        assert tile_opt_modes() == ("dse",)
+        # pass config wins over env
+        assert tile_opt_modes({"tl.tpu.tile_opt": "0"}) == ()
+
+    def test_typo_raises(self):
+        with pytest.raises(ValueError, match="TL_TPU_TILE_OPT"):
+            tile_opt_modes({"tl.tpu.tile_opt": "dce"})
+
+
+# ---------------------------------------------------------------------------
+# dse
+# ---------------------------------------------------------------------------
+
+
+def _dead_store_kernel():
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            dead = T.alloc_shared((M, N), "float32")
+            unused = T.alloc_fragment((8, N), "float32")
+            live = T.alloc_shared((M, N), "float32")
+            T.copy(A, dead)             # dead store: never read
+            T.copy(A, live)
+            for i, j in T.Parallel(M, N):
+                live[i, j] = live[i, j] * 2.0
+            T.copy(live, B)
+    return k
+
+
+class TestDSE:
+    def test_golden_block_and_consumed_tl006(self):
+        art = lower(_dead_store_kernel(), target="cpu")
+        assert "tile_opt[dse,repack,dbuf,fuse]" in art.plan_desc
+        assert "dse: removed dead scratch 'shared'" in art.plan_desc
+        assert "dse: removed unused alloc 'frag'" in art.plan_desc
+        # the auto-fixed TL006 findings are consumed: no lint block
+        assert "TL006" not in art.plan_desc
+        assert "lint[" not in art.plan_desc
+        # the dead buffers are gone from the plan's scratch
+        assert "scratch shared:" not in art.plan_desc
+        rec = art.attrs["tile_opt"]
+        assert rec["dse"] == {"stores": 1, "allocs": 2,
+                              "bytes": rec["dse"]["bytes"]}
+        assert rec["dse"]["bytes"] > 0
+        assert {e["buffer"] for e in rec["eliminated"]} == \
+            {"shared", "frag"}
+        for e in rec["eliminated"]:
+            assert set(e) == {"op", "buffer", "bytes"}
+
+    def test_bypass_restores_pre_pass_text(self):
+        f = _dead_store_kernel()
+        art0 = lower(f, target="cpu", pass_configs=OFF)
+        assert "tile_opt[" not in art0.plan_desc
+        assert "tile_opt" not in art0.attrs
+        # the lint block (TL006) is back, and the dead scratch planned
+        assert "TL006" in art0.plan_desc
+        # the unused alloc is back in the planned scratch (the dead
+        # copy target itself becomes A's BlockSpec alias when planned)
+        assert "scratch frag:" in art0.plan_desc
+
+    def test_numerics_unchanged(self):
+        _assert_equivalent(_dead_store_kernel(), _rand((M, N)))
+
+    def test_dead_chain_fixpoint(self):
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                a = T.alloc_shared((M, N), "float32")
+                b = T.alloc_shared((M, N), "float32")
+                out = T.alloc_shared((M, N), "float32")
+                T.copy(A, a)
+                T.copy(a, b)            # b never read -> b dead, then a
+                T.copy(A, out)
+                T.copy(out, B)
+        art = lower(k, target="cpu")
+        rec = art.attrs["tile_opt"]
+        assert {e["buffer"] for e in rec["eliminated"]} == \
+            {"shared", "shared_1"}
+        assert rec["dse"]["stores"] == 2
+
+
+# ---------------------------------------------------------------------------
+# repack
+# ---------------------------------------------------------------------------
+
+
+def _two_stage_kernel():
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), B: T.Tensor((M, N), "float32"),
+          O1: T.Tensor((M, N), "float32"),
+          O2: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            t1 = T.alloc_shared((M, N), "float32")
+            t2 = T.alloc_shared((M, N), "float32")
+            T.copy(A, t1)
+            for i, j in T.Parallel(M, N):
+                t1[i, j] = t1[i, j] * 2.0
+            T.copy(t1, O1)
+            T.copy(B, t2)
+            for i, j in T.Parallel(M, N):
+                t2[i, j] = t2[i, j] + 3.0
+            T.copy(t2, O2)
+    return k
+
+
+class TestRepack:
+    def test_golden_merge_and_footprint(self):
+        art = lower(_two_stage_kernel(), target="cpu")
+        assert "repack: 'shared_1' shares the VMEM slot of 'shared'" \
+            in art.plan_desc
+        rec = art.attrs["tile_opt"]["repack"]
+        assert rec["buffers"] == 1
+        assert rec["pre_bytes"] == 2 * rec["post_bytes"]
+        # the merged buffer is gone from the planned scratch
+        assert "scratch shared_1:" not in art.plan_desc
+        # the repacked footprint is surfaced on the header line
+        assert f"scratch {rec['pre_bytes']}B -> {rec['post_bytes']}B" \
+            in art.plan_desc
+
+    def test_numerics_unchanged(self):
+        _assert_equivalent(_two_stage_kernel(), _rand((M, N)),
+                           _rand((M, N), 1))
+
+    def test_refuses_overlapping_lifetimes(self):
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                t1 = T.alloc_shared((M, N), "float32")
+                t2 = T.alloc_shared((M, N), "float32")
+                T.copy(A, t1)
+                T.copy(A, t2)           # t1 and t2 live simultaneously
+                for i, j in T.Parallel(M, N):
+                    t1[i, j] = t1[i, j] + t2[i, j]
+                T.copy(t1, B)
+        art = lower(k, target="cpu")
+        assert "repack" not in art.plan_desc
+        # no rewrite fired at all -> byte-identical to the bypass
+        assert art.plan_desc == lower(k, target="cpu",
+                                      pass_configs=OFF).plan_desc
+
+    def test_refuses_guarded_first_write(self):
+        """A buffer first written under a branch guard is the
+        grid-carried-init idiom — its slot must never be reused."""
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(2) as bx:
+                acc = T.alloc_shared((M, N), "float32")
+                t = T.alloc_shared((M, N), "float32")
+                with T.If(bx == 0):
+                    T.copy(A, acc)
+                T.copy(acc, B[0, 0])
+                T.copy(A, t)
+                for i, j in T.Parallel(M, N):
+                    t[i, j] = t[i, j] * 2.0
+                T.copy(t, B[0, 0])
+        art = lower(k, target="cpu")
+        assert "repack" not in art.plan_desc
+
+
+# ---------------------------------------------------------------------------
+# dbuf
+# ---------------------------------------------------------------------------
+
+
+def _stream_kernel():
+    K, BK = 512, 128
+
+    @T.prim_func
+    def k(A: T.Tensor((M, K), "float32"), B: T.Tensor((M, K), "float32")):
+        with T.Kernel(1) as bx:
+            w = T.alloc_shared((M, BK), "float32")
+            for ko in T.serial(K // BK):
+                T.copy(A[0, ko * BK], w)
+                for i, j in T.Parallel(M, BK):
+                    w[i, j] = w[i, j] * 2.0
+                T.copy(w, B[0, ko * BK])
+    return k
+
+
+class TestDbuf:
+    def test_golden_rotated_slots(self):
+        art = lower(_stream_kernel(), target="cpu")
+        assert "dbuf: double-buffered 'shared'" in art.plan_desc
+        assert art.attrs["tile_opt"]["dbuf"]["chains"] == 1
+        # the rewritten kernel carries the slotted buffer + semaphore
+        assert "scratch shared_db: (2, 128, 128)" in art.plan_desc
+        assert "scratch shared_dbsem: (2,)" in art.plan_desc
+        src = art.kernel_source
+        assert "rt.dma_start" in src and "rt.dma_wait" in src
+        assert "% 2" in src     # the rotated slot index
+
+    def test_numerics_unchanged(self):
+        _assert_equivalent(_stream_kernel(), _rand((M, 512)))
+
+    def test_refuses_loop_carried_read(self):
+        """A read of the stream buffer BEFORE the copy observes the
+        previous iteration's window — re-slotting would hand it data
+        from two iterations back."""
+        K, BK = 512, 128
+
+        @T.prim_func
+        def k(A: T.Tensor((M, K), "float32"),
+              B: T.Tensor((M, K), "float32")):
+            with T.Kernel(1) as bx:
+                w = T.alloc_shared((M, BK), "float32")
+                acc = T.alloc_fragment((M, BK), "float32")
+                T.clear(acc)
+                T.copy(A[0, 0], w)
+                for ko in T.serial(K // BK):
+                    for i, j in T.Parallel(M, BK):
+                        acc[i, j] = acc[i, j] + w[i, j]   # read BEFORE
+                    T.copy(A[0, ko * BK], w)              # the refill
+                T.copy(acc, B[0, 0])
+        art = lower(k, target="cpu")
+        assert "dbuf" not in art.plan_desc
+
+    def test_refuses_gather_source_with_updated_index(self):
+        """Review regression: a gather-style source `A[idx[0], 0]`
+        whose index scratch is updated inside the loop must NOT be
+        double-buffered — the prefetch for ko+1 would be addressed
+        through ko's stale index value."""
+        K, BK = 512, 128
+
+        @T.prim_func
+        def k(A: T.Tensor((K, BK), "float32"),
+              B: T.Tensor((K // BK, BK), "float32")):
+            with T.Kernel(1) as bx:
+                idx = T.alloc_var("int32")
+                w = T.alloc_shared((1, BK), "float32")
+                idx[0] = 0
+                for ko in T.serial(K // BK):
+                    idx[0] = (idx[0] + 3) % (K // BK)
+                    T.copy(A[idx[0] * BK, 0], w)
+                    for j in T.Parallel(BK):
+                        w[0, j] = w[0, j] * 2.0
+                    T.copy(w, B[ko, 0])
+        art = lower(k, target="cpu")
+        assert "dbuf" not in art.plan_desc
+        _assert_equivalent(k, _rand((K, BK)))
+
+    def test_refuses_src_clobber(self):
+        """Nothing in the loop may write the DMA source while the
+        prefetch is in flight (TL002's clobber hazard)."""
+        K, BK = 512, 128
+
+        @T.prim_func
+        def k(A: T.Tensor((M, K), "float32"),
+              B: T.Tensor((M, K), "float32")):
+            with T.Kernel(1) as bx:
+                w = T.alloc_shared((M, BK), "float32")
+                for ko in T.serial(K // BK):
+                    T.copy(A[0, ko * BK], w)
+                    for i, j in T.Parallel(M, BK):
+                        w[i, j] = w[i, j] * 2.0
+                    T.copy(w, A[0, ko * BK])    # writes the source
+                    T.copy(w, B[0, ko * BK])
+        art = lower(k, target="cpu")
+        assert "dbuf" not in art.plan_desc
+
+
+# ---------------------------------------------------------------------------
+# fuse
+# ---------------------------------------------------------------------------
+
+
+def _fusable_kernel():
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), O1: T.Tensor((M, N), "float32"),
+          O2: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            lo = T.alloc_fragment((M, N), "float32")
+            hi = T.alloc_fragment((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                lo[i, j] = s[i, j] * 2.0
+            for i, j in T.Parallel(M, N):
+                hi[i, j] = s[i, j] + 1.0
+            T.copy(lo, O1)
+            T.copy(hi, O2)
+    return k
+
+
+class TestFuse:
+    def test_golden_merge(self):
+        art = lower(_fusable_kernel(), target="cpu")
+        assert "fuse: merged adjacent T.Parallel(128, 128)" \
+            in art.plan_desc
+        assert art.attrs["tile_opt"]["fuse"]["regions"] == 1
+        # two regions became one main statement
+        art0 = lower(_fusable_kernel(), target="cpu", pass_configs=OFF)
+        def mains(a):
+            import re
+            return int(re.search(r"main=(\d+)", a.plan_desc).group(1))
+        assert mains(art) == mains(art0) - 1
+
+    def test_numerics_unchanged(self):
+        _assert_equivalent(_fusable_kernel(), _rand((M, N)))
+
+    def test_refuses_shifted_dependency(self):
+        """loop2 reads what loop1 wrote at ANOTHER iteration (the TL001
+        collision class: the broadcast read of row 0) — fusing would
+        read a not-yet-written element."""
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                t = T.alloc_fragment((M, N), "float32")
+                o = T.alloc_fragment((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    t[i, j] = s[i, j] * 2.0
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = t[0, j] + s[i, j]   # reads iteration (0, j)
+                T.copy(o, B)
+        art = lower(k, target="cpu")
+        assert "fuse" not in art.plan_desc
+        _assert_equivalent(k, _rand((M, N)))
+
+    def test_refuses_non_injective_write(self):
+        """Defense-in-depth at the oracle level: identical affine forms
+        whose write misses an extent>1 var (a broadcast store) alias
+        elements across iterations — `_fusable` must refuse even though
+        the per-pair form comparison passes."""
+        from tilelang_mesh_tpu.ir import ForNest, KernelNode
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                row = T.alloc_fragment((1, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    row[0, j] = s[i, j]
+                for i, j in T.Parallel(M, N):
+                    row[0, j] = row[0, j] + s[i, j]
+                T.copy(s, B)
+        kn = next(st for st in k.func.body.stmts
+                  if isinstance(st, KernelNode))
+        nests = [st for st in kn.body.stmts
+                 if isinstance(st, ForNest) and st.kind == "parallel"]
+        assert len(nests) == 2
+        assert not tile_opt._fusable(nests[0], nests[1])
+
+    def test_chain_fusion(self):
+        """Three adjacent independent regions collapse into one."""
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                a = T.alloc_fragment((M, N), "float32")
+                b = T.alloc_fragment((M, N), "float32")
+                c = T.alloc_fragment((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    a[i, j] = s[i, j] * 2.0
+                for i, j in T.Parallel(M, N):
+                    b[i, j] = s[i, j] + 1.0
+                for i, j in T.Parallel(M, N):
+                    c[i, j] = a[i, j] + b[i, j]
+                T.copy(c, B)
+        art = lower(k, target="cpu")
+        assert art.attrs["tile_opt"]["fuse"]["regions"] == 2
+        _assert_equivalent(k, _rand((M, N)))
+
+
+# ---------------------------------------------------------------------------
+# composition & determinism
+# ---------------------------------------------------------------------------
+
+
+def _composite_kernel():
+    """Triggers all four rewrites: a dead buffer (dse), two disjoint
+    same-shape stages (repack), a serial-loop HBM stream (dbuf), and
+    adjacent independent parallel regions (fuse). The stream buffer's
+    shape is distinct from the stage buffers' so repack cannot claim it
+    first (composition is deterministic either way — this kernel wants
+    all four to fire)."""
+    K, BK = 256, 64
+
+    @T.prim_func
+    def k(A: T.Tensor((M, K), "float32"), B: T.Tensor((M, N), "float32"),
+          O1: T.Tensor((M, K), "float32"),
+          O2: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            dead = T.alloc_shared((8, N), "float32")
+            w = T.alloc_shared((M, BK), "float32")
+            t1 = T.alloc_shared((M, N), "float32")
+            t2 = T.alloc_shared((M, N), "float32")
+            lo = T.alloc_fragment((M, N), "float32")
+            hi = T.alloc_fragment((M, N), "float32")
+            T.copy(B[0, 0], dead)               # dse
+            for ko in T.serial(K // BK):        # dbuf
+                T.copy(A[0, ko * BK], w)
+                for i, j in T.Parallel(M, BK):
+                    w[i, j] = w[i, j] * 2.0
+                T.copy(w, O1[0, ko * BK])
+            T.copy(B, t1)                       # repack stage 1
+            for i, j in T.Parallel(M, N):
+                t1[i, j] = t1[i, j] * 2.0
+            T.copy(t1, O2)
+            T.copy(B, t2)                       # repack stage 2
+            for i, j in T.Parallel(M, N):       # fuse pair
+                lo[i, j] = t2[i, j] * 3.0
+            for i, j in T.Parallel(M, N):
+                hi[i, j] = t2[i, j] - 1.0
+            for i, j in T.Parallel(M, N):
+                t2[i, j] = lo[i, j] + hi[i, j]
+            T.copy(t2, O2)
+    return k
+
+
+class TestComposition:
+    def test_all_four_fire_deterministically(self):
+        f = _composite_kernel()
+        a1 = lower(f, target="cpu")
+        a2 = lower(f, target="cpu")
+        assert a1.plan_desc == a2.plan_desc
+        assert a1.kernel_source == a2.kernel_source
+        rec = a1.attrs["tile_opt"]
+        assert rec["dse"]["allocs"] >= 1
+        assert rec["repack"]["buffers"] >= 1
+        assert rec["dbuf"]["chains"] >= 1
+        assert rec["fuse"]["regions"] >= 1
+        assert rec["modes"] == list(MODES)
+
+    def test_composite_numerics(self):
+        _assert_equivalent(_composite_kernel(), _rand((M, 256)),
+                           _rand((M, N), 1))
+
+    def test_bypass_byte_identity(self):
+        f = _composite_kernel()
+        a0a = lower(f, target="cpu", pass_configs=OFF)
+        a0b = lower(f, target="cpu", pass_configs=OFF)
+        assert a0a.plan_desc == a0b.plan_desc
+        assert "tile_opt" not in a0a.attrs
+        assert "tile_opt[" not in a0a.plan_desc
+
+    @pytest.mark.parametrize("seed", [3, 7, 11])
+    def test_seeded_generated_kernels_deterministic(self, seed):
+        """Seeded sweep: generated kernels with a random mix of dead
+        buffers / stages / streams compose deterministically and stay
+        numerically equivalent to the bypass lowering."""
+        rng = np.random.default_rng(seed)
+        n_stage = int(rng.integers(2, 4))
+        with_dead = bool(rng.integers(0, 2))
+        mul = [float(rng.integers(1, 5)) for _ in range(n_stage)]
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                if with_dead:
+                    dead = T.alloc_shared((M, N), "float32")
+                    T.copy(A, dead)
+                ts = [T.alloc_shared((M, N), "float32")
+                      for _ in range(n_stage)]
+                for si, t in enumerate(ts):
+                    T.copy(A, t)
+                    for i, j in T.Parallel(M, N):
+                        t[i, j] = t[i, j] * mul[si]
+                    T.copy(t, B)
+        a1 = lower(k, target="cpu")
+        a2 = lower(k, target="cpu")
+        assert a1.plan_desc == a2.plan_desc
+        assert a1.kernel_source == a2.kernel_source
+        assert "tile_opt[" in a1.plan_desc   # repack (and dse) fire
+        _assert_equivalent(k, _rand((M, N), seed))
+
+
+# ---------------------------------------------------------------------------
+# ops-library byte-identity
+# ---------------------------------------------------------------------------
+
+
+class TestOpsLibrary:
+    def test_bypass_restores_pre_pass_plan_desc(self, monkeypatch):
+        """TL_TPU_TILE_OPT=0 must reproduce the pre-pass plan_desc on
+        real ops kernels (no tile_opt block, stable across runs), and a
+        kernel with no rewrite must be byte-stable with the pass ON."""
+        from tilelang_mesh_tpu.jit import clear_factory_caches
+        from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+        clear_factory_caches()
+        on = matmul_kernel(256, 256, 256, 128, 128, 128).artifact
+        monkeypatch.setenv("TL_TPU_TILE_OPT", "0")
+        clear_factory_caches()
+        off = matmul_kernel(256, 256, 256, 128, 128, 128).artifact
+        # plain pipelined GEMM: nothing to rewrite -> byte-identical
+        assert on.plan_desc == off.plan_desc
+        assert "tile_opt[" not in off.plan_desc
+
+    def test_dequant_gemm_fuse_fires(self, monkeypatch):
+        from tilelang_mesh_tpu.jit import clear_factory_caches
+        from tilelang_mesh_tpu.ops.dequant_gemm import dequant_gemm_kernel
+        clear_factory_caches()
+        art = dequant_gemm_kernel(256, 256, 512).artifact
+        assert "fuse: merged adjacent T.Parallel(128, 128)" \
+            in art.plan_desc
+        monkeypatch.setenv("TL_TPU_TILE_OPT", "0")
+        clear_factory_caches()
+        art0 = dequant_gemm_kernel(256, 256, 512).artifact
+        assert "tile_opt[" not in art0.plan_desc
+        clear_factory_caches()
+
+
+# ---------------------------------------------------------------------------
+# differential selfcheck (TL_TPU_SELFCHECK=1)
+# ---------------------------------------------------------------------------
+
+
+class TestSelfcheck:
+    def test_clean_rewrite_passes(self, monkeypatch):
+        from tilelang_mesh_tpu.cache.kernel_cache import clear_cache
+        clear_cache()       # a cached kernel was built with the check off
+        obs.reset()
+        monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+        k = tilelang.compile(_fusable_kernel(), target="cpu")
+        r = k(_rand((M, N)))
+        assert isinstance(r, tuple) and len(r) == 2
+        c = obs.get_tracer().counters()
+        assert c.get("verify.selfcheck.runs", 0) >= 1
+        assert c.get("verify.selfcheck.ok", 0) >= 1
+        assert not c.get("verify.selfcheck.divergence")
+        # second call does not re-run the check
+        k(_rand((M, N), 1))
+        assert obs.get_tracer().counters()[
+            "verify.selfcheck.runs"] == c["verify.selfcheck.runs"]
+
+    def test_corrupted_rewrite_caught(self, monkeypatch):
+        """PR 5 mutation pattern: corrupt the fuse rewrite so it drops
+        a statement — the optimized kernel now computes the wrong
+        answer, and the selfcheck must catch it on the first call."""
+        from tilelang_mesh_tpu.verify import SelfCheckDivergence
+        obs.reset()
+        monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+        orig = tile_opt._fuse_pair
+
+        def corrupt(n1, n2):
+            merged = orig(n1, n2)
+            merged.body.stmts.pop()     # lose the last fused store
+            return merged
+        monkeypatch.setattr(tile_opt, "_fuse_pair", corrupt)
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                lo = T.alloc_fragment((M, N), "float32")
+                hi = T.alloc_fragment((M, N), "float32")
+                o = T.alloc_fragment((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    lo[i, j] = s[i, j] * 2.0
+                for i, j in T.Parallel(M, N):
+                    hi[i, j] = s[i, j] * 3.0
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = lo[i, j] + hi[i, j]
+                T.copy(o, B)
+        kern = tilelang.compile(k, target="cpu")
+        with pytest.raises(SelfCheckDivergence, match="tile-opt"):
+            kern(_rand((M, N)))
+        assert obs.get_tracer().counters().get(
+            "verify.selfcheck.divergence", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# cache key, counters, metrics, analyzer, unified accounting, CLI hint
+# ---------------------------------------------------------------------------
+
+
+class TestSurfacing:
+    def test_cache_key_separates_mode_sets(self):
+        from tilelang_mesh_tpu.cache.kernel_cache import KernelCache
+        k_on = KernelCache.key_for("x", "cpu", None, {})
+        k_off = KernelCache.key_for("x", "cpu", None, OFF)
+        k_sub = KernelCache.key_for("x", "cpu", None,
+                                    {"tl.tpu.tile_opt": "dse"})
+        assert len({k_on, k_off, k_sub}) == 3
+
+    def test_ambient_pass_config_respected_by_cache(self):
+        """Review regression: cached() keys on the RESOLVED config —
+        an ambient pass_config() tile-opt override must not hit the
+        default compile's cache entry (and vice versa)."""
+        from tilelang_mesh_tpu.transform import pass_config
+        f = _fusable_kernel()
+        k1 = tilelang.compile(f, target="cpu")
+        with pass_config({"tl.tpu.tile_opt": "0"}):
+            k0 = tilelang.compile(f, target="cpu")
+        assert k0 is not k1
+        assert "tile_opt[" in k1.artifact.plan_desc
+        assert "tile_opt[" not in k0.artifact.plan_desc
+
+    def test_compile_on_and_off_are_distinct_kernels(self):
+        f = _fusable_kernel()
+        k1 = tilelang.compile(f, target="cpu")
+        k0 = tilelang.compile(f, target="cpu", pass_configs=OFF)
+        assert k1 is not k0
+        assert k1.artifact.plan_desc != k0.artifact.plan_desc
+
+    def test_counters_and_metrics_summary(self):
+        obs.reset()
+        lower(_composite_kernel(), target="cpu")
+        s = obs.metrics_summary()["tile_opt"]
+        assert s["kernels"] >= 1
+        assert s["rewrites"] >= 4
+        assert set(s["by_mode"]) == {"dse", "repack", "dbuf", "fuse"}
+        assert s["dse_bytes"] > 0
+        assert s["repack_bytes_saved"] > 0
+        assert s["dbuf_chains"] >= 1
+        assert s["fuse_regions"] >= 1
+        assert s["eliminated_vmem_bytes"] > 0
+        assert s["eliminated_wire_bytes"] == 0   # no mesh program ran
+
+    def test_comm_opt_unified_eliminated_record(self):
+        """comm_opt's dce now emits the SAME {op, buffer, bytes} record
+        shape as tile-opt's dse (the one-table contract)."""
+        from tilelang_mesh_tpu.parallel import mesh_config
+
+        with mesh_config(2, 2):
+            @T.prim_func
+            def k(A: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32"),
+                  B: T.MeshTensor((32, 128),
+                                  T.MeshShardingPolicy(cross_mesh_dim=0),
+                                  (2, 2), "float32")):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment((8, 128), "float32")
+                    dead = T.alloc_fragment((8, 1), "float32")
+                    T.copy(A, x)
+                    T.comm.all_reduce(x, dead, "sum", "v", dim=1)
+                    T.copy(x, B)
+        art = tilelang.lower(k, target="cpu-mesh[2x2]")
+        elim = art.attrs["comm_opt"]["eliminated"]
+        assert len(elim) == 1
+        assert set(elim[0]) == {"op", "buffer", "bytes"}
+        assert elim[0]["op"] == "CommAllReduce"
+        assert elim[0]["buffer"] == "frag_1"
+        assert elim[0]["bytes"] > 0
+        # ... and TL006 stayed silent on the comm-dce'd buffer
+        assert "TL006" not in art.plan_desc
+
+    def test_analyzer_trace_section(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TL_TPU_TRACE", "1")
+        obs.reset()
+        lower(_dead_store_kernel(), target="cpu")
+        p = tmp_path / "trace.jsonl"
+        obs.write_jsonl(str(p))
+        from tilelang_mesh_tpu.tools.analyzer import (_load_trace,
+                                                      format_trace_report)
+        report = format_trace_report(_load_trace(p))
+        assert "tile-IR optimizer (tile_opt)" in report
+        assert "eliminated (tile_opt dse + comm_opt dce" in report
+        assert "tile_opt" in report
+
+    def test_lint_cli_fix_hint(self, tmp_path):
+        mod = tmp_path / "dead_mod.py"
+        mod.write_text(
+            "import tilelang_mesh_tpu.language as T\n\n"
+            "@T.prim_func\n"
+            "def k(A: T.Tensor((128, 128), 'float32'),\n"
+            "      B: T.Tensor((128, 128), 'float32')):\n"
+            "    with T.Kernel(1) as bx:\n"
+            "        dead = T.alloc_shared((128, 128), 'float32')\n"
+            "        s = T.alloc_shared((128, 128), 'float32')\n"
+            "        T.copy(A, dead)\n"
+            "        T.copy(A, s)\n"
+            "        T.copy(s, B)\n")
+        from tilelang_mesh_tpu.tools.lint import (format_report,
+                                                  lint_targets)
+        report = lint_targets([str(mod)])
+        text = format_report(report)
+        assert "TL006" in text
+        assert "--fix" in text and "TL_TPU_TILE_OPT" in text
+
+    def test_run_tile_opt_no_modes_is_identity(self):
+        f = _composite_kernel()
+        func = f.func
+        out, res, findings = run_tile_opt(func, OFF, [])
+        assert out is func
+        assert res.rewrites == []

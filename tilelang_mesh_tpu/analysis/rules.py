@@ -656,15 +656,24 @@ def _tl005_vmem_budget(ctx: LintContext) -> List[Diagnostic]:
 
 @_rule("TL006", "dead-store")
 def _tl006_dead_store(ctx: LintContext) -> List[Diagnostic]:
-    from ..ir import AllocStmt
+    from ..ir import AllocStmt, CommStmt
     out: List[Diagnostic] = []
     allocs = {}     # buffer uid -> AllocStmt, built in ONE pass
     for s, _ in iter_stmts(ctx.func.body):
         if isinstance(s, AllocStmt):
             allocs.setdefault(s.buffer.uid, s)
+    # stores the enabled optimizers will DELETE are not worth a finding:
+    # a dead buffer written only by collectives is comm_opt dce's job
+    # (the rewrite drops the collective and its accounting names it),
+    # so TL006 stays silent on it when dce is enabled
+    from ..transform.comm_opt import comm_opt_modes
+    comm_dce = "dce" in comm_opt_modes(ctx.pass_cfg)
     for uid, du in sorted(def_use(ctx.func).items()):
         b = du.buffer
         if b.scope in ("global", "sem"):
+            continue
+        if comm_dce and du.writes and all(
+                isinstance(acc.stmt, CommStmt) for acc, _c in du.writes):
             continue
         alloc = allocs.get(uid)
         loc = stmt_loc(alloc) if alloc is not None else None

@@ -58,13 +58,23 @@ QUARANTINE_DIR = ".quarantine"
 LOCKS_DIR = ".locks"
 
 # Bump whenever codegen output OR the on-disk artifact format changes —
-# artifacts cached under older versions must not be reused. (8: artifact
-# metadata gained the source_sha256 integrity checksum.)
-CODEGEN_VERSION = 8
+# artifacts cached under older versions must not be reused. (10: the
+# tile-opt IR passes rewrite kernels before planning, and artifact
+# metadata persists every JSON-clean attr — attrs["tile_opt"] included.)
+CODEGEN_VERSION = 10
 
 
 def _sha256(text: str) -> str:
     return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _json_clean(v) -> bool:
+    """Can this attr value round-trip through the artifact JSON?"""
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
 
 
 @contextlib.contextmanager
@@ -121,6 +131,13 @@ class KernelCache:
         # off-mode compile would not
         from ..analysis.rules import lint_mode
         h.update(lint_mode(pass_cfg).encode())
+        # ... and so is the tile-opt rewrite set: an artifact lowered
+        # with the optimizer on (fused regions, repacked arena, deleted
+        # stores) must never satisfy a TL_TPU_TILE_OPT=0 compile, and
+        # vice versa — the differential selfcheck depends on the two
+        # lowerings being genuinely distinct cache entries
+        from ..transform.tile_opt import tile_opt_modes
+        h.update(",".join(tile_opt_modes(pass_cfg)).encode())
         return h.hexdigest()
 
     def get(self, key: str):
@@ -250,8 +267,11 @@ class KernelCache:
             "ir_script": art.ir_script,
             "plan_desc": art.plan_desc,
             "mesh_config": list(art.mesh_config) if art.mesh_config else None,
+            # every JSON-clean attr persists (tile_opt/lint records are
+            # dicts/lists of dicts); non-serializable values — mesh
+            # closures and friends — are dropped as before
             "attrs": {k: v for k, v in art.attrs.items()
-                      if isinstance(v, (str, int, float, bool, list))},
+                      if _json_clean(v)},
             "source_sha256": _sha256(art.kernel_source),
         }
         meta_text = json.dumps(meta, indent=1)
@@ -290,8 +310,15 @@ def cached(func, target: str = "auto", out_idx=None,
     target = determine_target(target)
     ir_script = func.script() if isinstance(func, PrimFuncObj) else \
         func.script()
-    cfg = {getattr(k, "value", str(k)): v
-           for k, v in (pass_configs or {}).items()}
+    # the key must see the SAME resolved config lower() will compile
+    # under: the ambient pass_config() stack merged with the explicit
+    # pass_configs. Keying on the explicit dict alone let an ambient
+    # tl.tpu.tile_opt/lint/comm_opt override silently hit the other
+    # lowering's cache entry.
+    from ..transform.pass_config import current_pass_config
+    cfg = dict(current_pass_config())
+    for k, v in (pass_configs or {}).items():
+        cfg[getattr(k, "value", str(k))] = v
     key = _CACHE.key_for(ir_script, target, out_idx, cfg)
 
     hit = _CACHE.get(key)
@@ -325,6 +352,10 @@ def cached(func, target: str = "auto", out_idx=None,
             kernel: Any = MeshKernel(art, out_idx=out_idx)
         else:
             kernel = JITKernel(art, out_idx=out_idx, verbose=verbose)
+    # the pass config this kernel was lowered under: the tile-opt
+    # differential selfcheck re-lowers with the SAME cfg plus
+    # tl.tpu.tile_opt=0 (jit/kernel.py _selfcheck_first_call)
+    kernel._lower_cfg = cfg
     _CACHE.put(key, kernel)
     if env.TL_TPU_PRINT_ON_COMPILATION:
         print(f"[tilelang_mesh_tpu] compiled {art.name} for {target} "

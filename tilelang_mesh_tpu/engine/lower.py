@@ -79,6 +79,19 @@ def lower(func, target: str = "auto",
         with _trace.span("checks", "lower", kernel=func.name):
             _faults.maybe_fail("lower.checks", kernel=func.name)
             lint_findings = run_semantic_checks(func, cfg)
+        # tile-opt (transform/tile_opt.py): proof-carrying IR rewrites
+        # between the semantic checks and planning — dead-store
+        # elimination, VMEM arena re-packing, auto double-buffering,
+        # affine fusion — reusing the tl-lint analysis as the legality
+        # oracle. TL_TPU_TILE_OPT=0 skips the pass entirely, restoring
+        # the pre-pass plan_desc byte-identically; auto-fixed TL006
+        # findings are consumed (reported via tile_opt[...] instead).
+        from ..transform.tile_opt import run_tile_opt, tile_opt_modes
+        topt = None
+        if tile_opt_modes(cfg):
+            with _trace.span("tile_opt", "lower", kernel=func.name):
+                func, topt, lint_findings = run_tile_opt(
+                    func, cfg, lint_findings)
         with _trace.span("plan", "lower", kernel=func.name):
             _faults.maybe_fail("lower.plan", kernel=func.name)
             plan = plan_kernel(func, cfg)
@@ -91,6 +104,12 @@ def lower(func, target: str = "auto",
         lmode = lint_mode(cfg)
         plan_desc = plan.describe()
         attrs = dict(func.attrs)
+        # tile-opt decisions, golden-testable: only printed when a
+        # rewrite actually fired, so unoptimized kernels (and
+        # TL_TPU_TILE_OPT=0) keep the exact pre-pass text
+        if topt is not None and topt.rewrites:
+            plan_desc += "\n".join(topt.desc_block()) + "\n"
+            attrs["tile_opt"] = topt.attrs_record()
         if lmode != "off":
             with _trace.span("lint", "lower", kernel=func.name):
                 lint_findings = list(lint_findings) + \

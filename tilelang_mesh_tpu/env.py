@@ -55,6 +55,13 @@ class Environment:
     # mesh_comm_opt.md). "1"/"on" = all rewrites, "0"/"off" = none,
     # or a comma list of fuse/dce/overlap to enable a subset.
     TL_TPU_COMM_OPT = EnvVar("TL_TPU_COMM_OPT", "1")
+    # tile-IR optimizer (transform/tile_opt.py; docs/tile_opt.md):
+    # proof-carrying rewrites between semantic checks and planning.
+    # "1"/"on" (default) = all rewrites, "0"/"off" = none (restores the
+    # pre-pass plan_desc byte-identically), or a comma subset of
+    # dse/repack/dbuf/fuse. Pass config "tl.tpu.tile_opt" overrides
+    # per compile; the resolved mode set is part of the kernel-cache key.
+    TL_TPU_TILE_OPT = EnvVar("TL_TPU_TILE_OPT", "1")
     # minimum wire bytes before the overlap rewrite chunks a collective
     TL_TPU_COMM_CHUNK_BYTES = EnvVar("TL_TPU_COMM_CHUNK_BYTES",
                                      1 << 20, int)

@@ -106,6 +106,13 @@ class JITKernel:
         # donation variant, overhead instrumentation (jit/dispatch.py)
         from .dispatch import DispatchPlan
         self._plan = DispatchPlan(self)
+        # tile-opt differential selfcheck (TL_TPU_SELFCHECK=1, verify/):
+        # armed only for kernels the optimizer actually rewrote; the
+        # first call also runs the TL_TPU_TILE_OPT=0 lowering and
+        # compares outputs within dtype tolerance. One boolean on the
+        # warm path once disarmed.
+        self._selfcheck_done = not (
+            env.TL_TPU_SELFCHECK and self.artifact.attrs.get("tile_opt"))
 
     def _select_and_build(self) -> None:
         """Build on the first capable+healthy entry of the backend chain
@@ -175,7 +182,68 @@ class JITKernel:
         # one attribute load + the plan's precompiled fast path
         # (jit/dispatch.py). TL_TPU_FAST_DISPATCH=0 and the
         # reference-style all-params convention route to _legacy_call.
+        if not self._selfcheck_done:
+            return self._selfcheck_first_call(args)
         return self._plan.execute(args)
+
+    def _selfcheck_first_call(self, args):
+        """Differential check of a tile-opt-rewritten kernel's first
+        call (TL_TPU_SELFCHECK=1): the same prim_func is re-lowered
+        with ``tl.tpu.tile_opt=0`` (a distinct cache entry — the pass
+        set is part of the key), the REFERENCE runs first on copies of
+        the inputs (donation/in-place semantics may consume the
+        originals), and divergence beyond dtype tolerance raises
+        :class:`~..verify.SelfCheckDivergence` naming the leaves. A
+        kernel loaded from the disk cache has no traced prim_func to
+        re-lower and records ``verify.selfcheck.skipped`` instead."""
+        import numpy as np
+        pf = getattr(self, "prim_func", None)
+        if pf is None:
+            self._selfcheck_done = True
+            _trace.inc("verify.selfcheck.skipped")
+            return self._plan.execute(args)
+        from ..verify.runtime import SelfCheckDivergence, compare_outputs
+        cfg = dict(getattr(self, "_lower_cfg", None) or {})
+        cfg["tl.tpu.tile_opt"] = "0"
+        from ..cache.kernel_cache import cached
+        ref = cached(pf, target=self.artifact.target,
+                     out_idx=self.out_idx, pass_configs=cfg)
+        ref_args = []
+        for a in args:
+            try:
+                ref_args.append(np.array(a))
+            except Exception:   # noqa: BLE001 — e.g. bf16 torch
+                # an uncopyable input must NOT be aliased into the
+                # reference run (inout/donation semantics could consume
+                # it before the optimized run sees it) — skip the check
+                self._selfcheck_done = True
+                _trace.inc("verify.selfcheck.skipped")
+                return self._plan.execute(args)
+        want = ref(*ref_args)
+        got = self._plan.execute(args)
+        # disarm only once the differential actually ran: an exception
+        # above (transient ref-compile failure, I/O fault) propagates
+        # with the check still ARMED, so the caller's retry is verified
+        # instead of silently running the rewritten kernel unchecked
+        self._selfcheck_done = True
+        _trace.inc("verify.selfcheck.runs")
+        if want is None or got is None:
+            _trace.inc("verify.selfcheck.skipped")
+            return got
+        got_t = got if isinstance(got, tuple) else (got,)
+        want_t = want if isinstance(want, tuple) else (want,)
+        names = [p.name for p in self._out_params]
+        divs = compare_outputs(got_t, want_t, names)
+        if divs:
+            _trace.inc("verify.selfcheck.divergence")
+            rec = self.artifact.attrs.get("tile_opt") or {}
+            raise SelfCheckDivergence(
+                f"{self.artifact.name}: tile-opt selfcheck divergence vs "
+                f"the TL_TPU_TILE_OPT=0 lowering "
+                f"(rewrites: {rec.get('rewrites')}):\n  - "
+                + "\n  - ".join(divs))
+        _trace.inc("verify.selfcheck.ok")
+        return got
 
     def _legacy_call(self, args):
         """The pre-plan marshalling loop, byte-for-byte semantics: the

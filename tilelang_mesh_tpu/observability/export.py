@@ -38,8 +38,8 @@ __all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
 # the engine/lower.py pipeline span names, in pipeline order — the ONE
 # copy every consumer (analyzer --trace, bench.py embedding, tests)
 # keys its per-phase breakdown on
-LOWER_PHASES = ("canonicalize", "checks", "comm_opt", "plan", "lint",
-                "codegen", "artifact")
+LOWER_PHASES = ("canonicalize", "checks", "tile_opt", "comm_opt", "plan",
+                "lint", "codegen", "artifact")
 
 
 def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
@@ -341,6 +341,35 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "by_rule": dict(sorted(lint_by_rule.items())),
         "by_severity": dict(sorted(lint_by_sev.items())),
     }
+    # tile-opt accounting (transform/tile_opt.py; docs/tile_opt.md):
+    # per-mode rewrite counts from the labelled opt.rewrites{mode=...}
+    # counters plus the dse/repack/dbuf/fuse savings the pass recorded
+    opt_by_mode: Dict[str, float] = {}
+    for k, v in counters.items():
+        if not k.startswith("opt.rewrites{"):
+            continue
+        lbl = dict(kv.split("=", 1)
+                   for kv in k[k.index("{") + 1:-1].split(",") if "=" in kv)
+        m = lbl.get("mode", "?")
+        opt_by_mode[m] = opt_by_mode.get(m, 0) + v
+    tile_opt = {
+        "kernels": c("opt.kernels"),
+        "rewrites": labelled_total("opt.rewrites"),
+        "by_mode": dict(sorted(opt_by_mode.items())),
+        "dse_stores": c("opt.dse.stores"),
+        "dse_allocs": c("opt.dse.allocs"),
+        "dse_bytes": c("opt.dse.bytes"),
+        "repack_bytes_saved": c("opt.repack.bytes_saved"),
+        "dbuf_chains": c("opt.dbuf.chains"),
+        "fuse_regions": c("opt.fuse.regions"),
+        # unified dead-code table, split by source because the units
+        # differ: dse rows are padded VMEM footprint bytes, comm dce
+        # rows are ICI wire bytes (summing them would be meaningless)
+        "eliminated_vmem_bytes": c(
+            "opt.eliminated.bytes{source=tile_opt}"),
+        "eliminated_wire_bytes": c(
+            "opt.eliminated.bytes{source=comm_opt}"),
+    }
     # serving engine accounting (serving/; docs/serving.md): monotonic
     # outcome counters + shed-reason breakdown from the tracer, latency
     # digests from the shared histograms, live gauges from the engines
@@ -398,8 +427,8 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
     }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
-            "verify": verify, "lint": lint, "serving": serving,
-            "runtime": _runtime.runtime_summary()}
+            "verify": verify, "lint": lint, "tile_opt": tile_opt,
+            "serving": serving, "runtime": _runtime.runtime_summary()}
 
 
 def _json_safe(obj: Any):

@@ -223,6 +223,15 @@ def lower_mesh(func: PrimFunc, target: str,
             _trace.inc("comm.opt.pre_wire_bytes", opt.pre_wire_bytes)
             _trace.inc("comm.opt.post_wire_bytes", opt.post_wire_bytes)
             _trace.inc("comm.opt.hops_saved", opt.hops_saved)
+            # unified dead-code table (same record shape as tile-opt's
+            # dse — analyzer trace renders ONE "eliminated" section;
+            # these bytes are ICI wire bytes, so the shared counter is
+            # labelled by source and never summed with dse's VMEM bytes)
+            for e in opt.eliminated:
+                _trace.inc("opt.eliminated.bytes", e["bytes"],
+                           source="comm_opt")
+                _trace.event("opt.eliminated", "lower",
+                             source="comm_opt", kernel=func.name, **e)
 
     n_seg = len(segments)
 

@@ -204,6 +204,7 @@ def summarize_trace(records) -> dict:
     from ..observability import aggregate_spans
     phase_recs, other_recs = [], []
     collectives = []
+    eliminated = []     # unified tile-opt dse + comm_opt dce records
     counters: dict = {}
     hist_recs = []
     for r in records:
@@ -214,6 +215,8 @@ def summarize_trace(records) -> dict:
             hist_recs.append(r)
         elif t == "event" and r.get("name") == "comm.collective":
             collectives.append(r.get("attrs", {}))
+        elif t == "event" and r.get("name") == "opt.eliminated":
+            eliminated.append(r.get("attrs", {}))
         elif t == "span":
             if r.get("cat") == "lower" and r["name"] != "lower":
                 phase_recs.append(r)
@@ -222,6 +225,7 @@ def summarize_trace(records) -> dict:
     return {"phases": aggregate_spans(phase_recs),
             "spans": aggregate_spans(other_recs),
             "counters": counters, "collectives": collectives,
+            "eliminated": eliminated,
             "runtime": _runtime_from_histograms(hist_recs)}
 
 
@@ -334,6 +338,33 @@ def format_trace_report(records) -> str:
             f"wire {int(opt.get('comm.opt.pre_wire_bytes', 0))}B -> "
             f"{int(opt.get('comm.opt.post_wire_bytes', 0))}B "
             f"hops_saved={int(opt.get('comm.opt.hops_saved', 0))}")
+    topt = {k: v for k, v in s["counters"].items()
+            if k.startswith("opt.") and not k.startswith("opt.eliminated")}
+    if topt:
+        def ti(name):
+            return int(sum(v for k, v in topt.items()
+                           if k == name or k.startswith(name + "{")))
+        lines.append("tile-IR optimizer (tile_opt):")
+        lines.append(
+            f"  kernels={ti('opt.kernels')} rewrites={ti('opt.rewrites')} "
+            f"dse_stores={ti('opt.dse.stores')} "
+            f"dse_bytes={ti('opt.dse.bytes')}B "
+            f"repack_saved={ti('opt.repack.bytes_saved')}B "
+            f"dbuf_chains={ti('opt.dbuf.chains')} "
+            f"fuse_regions={ti('opt.fuse.regions')}")
+    if s.get("eliminated"):
+        # ONE dead-code table across both optimizers: tile-opt dse
+        # (source=tile_opt) and comm_opt dce (source=comm_opt) emit the
+        # same {op, buffer, bytes} record shape
+        lines.append("eliminated (tile_opt dse + comm_opt dce; bytes are "
+                     "VMEM footprint for tile_opt rows, ICI wire for "
+                     "comm_opt rows):")
+        lines.append(f"  {'source':<10} {'op':<16} {'buffer':<24} "
+                     f"{'bytes':>10}")
+        for e in s["eliminated"]:
+            lines.append(
+                f"  {e.get('source', '?'):<10} {e.get('op', '?'):<16} "
+                f"{e.get('buffer', '?'):<24} {e.get('bytes', 0):>10}")
     rt = s.get("runtime") or {}
     if rt:
         lines.append("runtime dispatch (kernel.latency / "

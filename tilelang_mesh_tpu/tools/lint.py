@@ -282,6 +282,14 @@ def format_report(report: dict) -> str:
         by = ", ".join(f"{r}={n}" for r, n in s["by_rule"].items())
         lines.append(f"findings: {s['total']} ({by}); "
                      f"errors: {s['errors']}")
+        if s["by_rule"].get("TL006"):
+            # TL006's proof is exactly what the tile-opt dse rewrite
+            # executes — point at the auto-fix instead of asking for a
+            # hand edit (docs/tile_opt.md)
+            lines.append(
+                "--fix: TL006 dead stores are deleted automatically at "
+                "compile time by the tile-opt dse pass (TL_TPU_TILE_OPT, "
+                "default on; see docs/tile_opt.md)")
     else:
         lines.append("no findings — lint-clean")
     skipped = [n for n in report["notes"]

@@ -9,3 +9,4 @@ splitting — lives in plan.py; mesh SPMD splitting in parallel/lowering.py.
 from .pass_config import PassConfigKey, pass_config, current_pass_config
 from .plan import plan_kernel, KernelPlan, PlanError
 from .comm_opt import (CommOptResult, comm_opt_modes, optimize_collectives)
+from .tile_opt import TileOptResult, run_tile_opt, tile_opt_modes

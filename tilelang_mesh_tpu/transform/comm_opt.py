@@ -76,20 +76,8 @@ def comm_opt_modes(pass_cfg: Optional[dict] = None) -> Tuple[str, ...]:
     if raw is None:
         from ..env import env
         raw = env.TL_TPU_COMM_OPT
-    raw = str(raw).strip().lower()
-    if raw in ("1", "on", "true", "all", "yes", ""):
-        return MODES
-    if raw in ("0", "off", "false", "none", "no"):
-        return ()
-    picked = {m.strip() for m in raw.replace("+", ",").split(",")
-              if m.strip()}
-    unknown = picked - set(MODES)
-    if unknown:
-        # a typo'd token must not silently disable the optimizer
-        raise ValueError(
-            f"unknown TL_TPU_COMM_OPT mode(s) {sorted(unknown)}; valid "
-            f"tokens are {list(MODES)}, or 1/0 for all/none")
-    return tuple(m for m in MODES if m in picked)
+    from .pass_config import parse_mode_set
+    return parse_mode_set(raw, MODES, "TL_TPU_COMM_OPT")
 
 
 @dataclass
@@ -102,6 +90,10 @@ class CommOptResult:
     pre_hops: int = 0
     post_hops: int = 0
     rewrites: List[str] = field(default_factory=list)
+    #: dce accounting in the SAME {op, buffer, bytes} record shape the
+    #: tile-opt dse pass emits (transform/tile_opt.py), so ``analyzer
+    #: trace`` renders one unified "eliminated" table for both
+    eliminated: List[dict] = field(default_factory=list)
 
     @property
     def hops_saved(self) -> int:
@@ -117,6 +109,7 @@ class CommOptResult:
             "post_hops": self.post_hops,
             "hops_saved": self.hops_saved,
             "rewrites": list(self.rewrites),
+            "eliminated": [dict(e) for e in self.eliminated],
         }
 
 
@@ -195,7 +188,8 @@ def _payload_bearing(c: CommStmt) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _eliminate_dead(segments, seg_rw, global_out_uids, desc_fn, rewrites):
+def _eliminate_dead(segments, seg_rw, global_out_uids, desc_fn, rewrites,
+                    cost_fn=None, eliminated=None):
     """Drop collectives whose results never reach a later read or a
     kernel output, then merge the compute segments left adjacent."""
     n = len(segments)
@@ -217,6 +211,16 @@ def _eliminate_dead(segments, seg_rw, global_out_uids, desc_fn, rewrites):
         if not live:
             keep[i] = False
             rewrites.append(f"dce: dropped dead {desc_fn(payload)}")
+            if eliminated is not None:
+                from ..parallel.lowering import _comm_buffers
+                _r, wregs = _comm_buffers(payload)
+                hops, per_hop = cost_fn(payload) if cost_fn else (0, 0)
+                eliminated.append({
+                    "op": type(payload).__name__,
+                    "buffer": ",".join(sorted(
+                        x.buffer.name for x in wregs)),
+                    "bytes": hops * per_hop,
+                })
     out: List[Tuple[str, Any]] = []
     for i, seg in enumerate(segments):
         if not keep[i]:
@@ -437,7 +441,9 @@ def optimize_collectives(segments: Sequence[Tuple[str, Any]],
             dropped_before = sum(1 for r in res.rewrites
                                  if r.startswith("dce: dropped"))
             segs = _eliminate_dead(segs, rw, global_out_uids,
-                                   desc_fn, res.rewrites)
+                                   desc_fn, res.rewrites,
+                                   cost_fn=cost_fn,
+                                   eliminated=res.eliminated)
             if sum(1 for r in res.rewrites
                    if r.startswith("dce: dropped")) == dropped_before:
                 break

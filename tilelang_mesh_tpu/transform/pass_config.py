@@ -37,6 +37,9 @@ class PassConfigKey(str, Enum):
     TL_TPU_COMM_OPT = "tl.tpu.comm_opt"
     TL_TPU_COMM_CHUNK_BYTES = "tl.tpu.comm_chunk_bytes"
     TL_TPU_COMM_CHUNKS = "tl.tpu.comm_chunks"
+    # tile-IR optimizer (transform/tile_opt.py): rewrite set ("1"/"0"/
+    # comma list of dse,repack,dbuf,fuse — overrides TL_TPU_TILE_OPT)
+    TL_TPU_TILE_OPT = "tl.tpu.tile_opt"
     # mesh schedule verifier (verify/schedule.py): "1"/"on" (default),
     # "0"/"off", or "strict" — overrides TL_TPU_VERIFY
     TL_TPU_VERIFY = "tl.tpu.verify"
@@ -48,6 +51,27 @@ class PassConfigKey(str, Enum):
     TL_ENABLE_AGGRESSIVE_SHARED_MEMORY_MERGE = \
         "tl.enable_aggressive_shared_memory_merge"
     TL_ENABLE_PTXAS_VERBOSE_OUTPUT = "tl.enable_ptxas_verbose_output"
+
+
+def parse_mode_set(raw, valid, knob: str):
+    """The ONE rewrite-set knob grammar shared by TL_TPU_COMM_OPT and
+    TL_TPU_TILE_OPT (comm_opt_modes / tile_opt_modes delegate here):
+    "1"/"on"/"all" = every mode, "0"/"off" = none, or a comma (or +)
+    subset of ``valid``. A typo'd token raises instead of silently
+    disabling an optimizer."""
+    raw = str(raw).strip().lower()
+    if raw in ("1", "on", "true", "all", "yes", ""):
+        return tuple(valid)
+    if raw in ("0", "off", "false", "none", "no"):
+        return ()
+    picked = {m.strip() for m in raw.replace("+", ",").split(",")
+              if m.strip()}
+    unknown = picked - set(valid)
+    if unknown:
+        raise ValueError(
+            f"unknown {knob} mode(s) {sorted(unknown)}; valid "
+            f"tokens are {list(valid)}, or 1/0 for all/none")
+    return tuple(m for m in valid if m in picked)
 
 
 _STATE = threading.local()

@@ -1128,8 +1128,14 @@ def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt],
     from ..layout import native as lnat
     from ..layout import python_impl as lpy
 
-    uids = {b.uid: i for i, b in enumerate(scratch)
-            if b.scope != "sem"}
+    uids: Dict[int, int] = {}
+    for b in scratch:
+        # contiguous slot indices: enumerate positions would leave holes
+        # (and walk off the first/last arrays) when a semaphore sits
+        # mid-list — e.g. the tile-opt dbuf rewrite allocates its
+        # rotated semaphore right after the slotted stream buffer
+        if b.scope != "sem" and b.uid not in uids:
+            uids[b.uid] = len(uids)
     if not uids:
         return 0, {}
     n = len(uids)

@@ -1,0 +1,986 @@
+"""Proof-carrying tile-IR optimization passes (tile-opt).
+
+PR 10 built a dataflow/affine proof engine (``analysis/dataflow.py``,
+``analysis/regions.py``) whose proofs only produced diagnostics.  This
+pass suite promotes those proofs into rewrites: it runs in
+``engine/lower.py`` between the semantic checks and planning, reusing
+the lint analysis VERBATIM as its legality oracle — every rewrite fires
+only on what the affine model can *prove*, exactly the
+proof-carrying-tile-rewrite discipline of the xDSL custom-lowering and
+CUDA-Tile evaluation work (PAPERS.md).  Four rewrites, individually
+selectable through ``TL_TPU_TILE_OPT`` (docs/tile_opt.md):
+
+``dse``
+    Dead-store elimination.  The TL006 proof (a scratch buffer with
+    writes but no reaching read, or an alloc with no use at all) turns
+    from an info diagnostic into a deletion: the stores, the alloc, and
+    — to fixpoint — anything that only fed the deleted stores are
+    removed, shrinking both the VMEM arena and the executed store
+    count.  The auto-fixed TL006 findings are consumed (they surface in
+    the ``tile_opt[...]`` accounting instead of the lint block).
+
+``repack``
+    VMEM arena re-packing.  The TL005 interval model already proves
+    which scratch lifetimes never overlap; this rewrite *realizes* that
+    packing at the IR level by aliasing same-shape/same-dtype buffers
+    with provably disjoint top-level live ranges onto one shared arena
+    slot — Mosaic then allocates one buffer where it allocated N, so
+    bigger tiles fit the real VMEM budget (the advisory
+    liveness-packed arena becomes the physical footprint).
+
+``dbuf``
+    Proof-gated automatic double-buffering.  A synchronous ``T.copy``
+    HBM->VMEM feeding compute inside a serial loop — the pattern the
+    planner must lower as a blocking per-iteration DMA — gets the
+    second slot and the rotated semaphore automatically: the rewrite
+    re-shapes the destination to ``(2,) + shape``, prefetches iteration
+    ko+1 into slot ``(ko+1) % 2`` while compute consumes slot
+    ``ko % 2``, and is gated by the same region-overlap machinery TL002
+    uses (the stream buffer single-writer / loop-local, the source
+    never written in the loop), so the in-flight window provably never
+    collides with compute.
+
+``fuse``
+    Affine loop fusion.  Adjacent ``T.Parallel`` nests with identical
+    iteration spaces merge into one elementwise region when the TL001
+    affine collision machinery proves no cross-region dependency:
+    every shared written buffer is accessed with per-dimension affine
+    forms that are IDENTICAL across the two nests (iteration i only
+    talks to iteration i) and injective over the extent>1 vars.  One
+    region means one vectorized sweep — shared loads (the dequant
+    ``Bp_s[i, j]`` nibble source) are read once instead of twice.
+
+Every decision is deterministic (program order, no dict-order
+dependence; two lowerings are byte-identical), golden-recorded in a
+``tile_opt[...]`` plan_desc block (nothing is emitted when no rewrite
+fires, so existing goldens stay byte-stable), accounted in
+``attrs["tile_opt"]`` + ``opt.*`` counters +
+``metrics_summary()["tile_opt"]``, guarded by the PR 5 differential
+selfcheck (``TL_TPU_SELFCHECK=1`` compares the optimized kernel's first
+call against the ``TL_TPU_TILE_OPT=0`` lowering), and part of the
+kernel-cache key.  ``TL_TPU_TILE_OPT=0`` restores the pre-pass
+plan_desc byte-identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
+                  BufferLoad, BufferStoreStmt, CommStmt, CopyStmt, CumSumStmt,
+                  EvaluateStmt, FillStmt, ForNest, GemmStmt, IfThenElse,
+                  KernelNode, PrimFunc, PrintStmt, Region, ReduceStmt,
+                  SeqStmt, Stmt, as_int, dtype_bits)
+from ..ir.expr import BinOp, Call, Cast, Var, convert
+
+# rewrites in canonical order (execution, plan_desc and attrs all use it;
+# the composition tests assert dse -> repack -> dbuf -> fuse is the one
+# deterministic pipeline)
+MODES = ("dse", "repack", "dbuf", "fuse")
+
+
+def tile_opt_modes(pass_cfg: Optional[dict] = None) -> Tuple[str, ...]:
+    """Active rewrite set: ``tl.tpu.tile_opt`` pass config when present,
+    else the ``TL_TPU_TILE_OPT`` env var.  "1"/"on"/"all" enables every
+    rewrite, "0"/"off" disables the pass (restoring pre-pass plan_descs
+    byte-identically), and a comma list selects a subset.  A typo'd
+    token raises instead of silently disabling the optimizer (the same
+    contract as TL_TPU_COMM_OPT / TL_TPU_LINT)."""
+    raw: Any = None
+    if pass_cfg:
+        raw = pass_cfg.get("tl.tpu.tile_opt")
+    if raw is None:
+        from ..env import env
+        raw = env.TL_TPU_TILE_OPT
+    from .pass_config import parse_mode_set
+    return parse_mode_set(raw, MODES, "TL_TPU_TILE_OPT")
+
+
+@dataclass
+class TileOptResult:
+    """Outcome of one tile-opt run over a kernel."""
+
+    modes: Tuple[str, ...] = ()
+    rewrites: List[str] = field(default_factory=list)
+    #: unified dead-code accounting — the SAME record shape comm_opt's
+    #: dce emits ({op, buffer, bytes}), so ``analyzer trace`` shows one
+    #: "eliminated" table across both optimizers
+    eliminated: List[dict] = field(default_factory=list)
+    dse_stores: int = 0
+    dse_allocs: int = 0
+    dse_bytes: int = 0
+    repack_pre_bytes: int = 0
+    repack_post_bytes: int = 0
+    repack_buffers: int = 0
+    repack_slots: int = 0
+    dbuf_chains: int = 0
+    fuse_regions: int = 0
+
+    def attrs_record(self) -> dict:
+        """JSON-safe accounting for CompiledArtifact.attrs['tile_opt']."""
+        return {
+            "modes": list(self.modes),
+            "rewrites": list(self.rewrites),
+            "eliminated": [dict(e) for e in self.eliminated],
+            "dse": {"stores": self.dse_stores, "allocs": self.dse_allocs,
+                    "bytes": self.dse_bytes},
+            "repack": {"pre_bytes": self.repack_pre_bytes,
+                       "post_bytes": self.repack_post_bytes,
+                       "buffers": self.repack_buffers,
+                       "slots": self.repack_slots},
+            "dbuf": {"chains": self.dbuf_chains},
+            "fuse": {"regions": self.fuse_regions},
+        }
+
+    def desc_block(self) -> List[str]:
+        """The ``tile_opt[...]`` lines appended to plan_desc — only when
+        a rewrite actually fired, so unoptimized kernels (and
+        TL_TPU_TILE_OPT=0) keep the exact pre-pass text."""
+        if not self.rewrites:
+            return []
+        head = (f"  tile_opt[{','.join(self.modes)}]: "
+                f"{len(self.rewrites)} rewrite(s)")
+        if self.repack_buffers:
+            # the repacked footprint, surfaced next to the TL005 budget
+            # accounting the lint block carries
+            head += (f", scratch {self.repack_pre_bytes}B -> "
+                     f"{self.repack_post_bytes}B")
+        return [head] + [f"    * {r}" for r in self.rewrites]
+
+
+# ---------------------------------------------------------------------------
+# shared rewrite machinery: functional stmt/expr reconstruction.  The
+# traced PrimFunc is shared state (lint CLI collections, selfcheck
+# re-lowers with the pass off), so the passes never mutate a statement
+# in place — containers are rebuilt, unchanged subtrees are reused.
+# ---------------------------------------------------------------------------
+
+#: uid -> (replacement buffer, optional leading index expr).  A None
+#: lead is a plain buffer substitution (repack); a non-None lead
+#: prepends a slot index to every access (dbuf's rotated second slot).
+BufSub = Dict[int, Tuple[Buffer, Optional[Any]]]
+
+
+def _rw_expr(e, vm: dict, bs: BufSub):
+    if isinstance(e, Var):
+        return vm.get(id(e), e)
+    if isinstance(e, BufferLoad):
+        idx = [i if isinstance(i, slice) else _rw_expr(i, vm, bs)
+               for i in e.indices]
+        sub = bs.get(e.buffer.uid)
+        changed = any(a is not b for a, b in zip(idx, e.indices))
+        if sub is None and not changed:
+            return e
+        buf, lead = sub if sub is not None else (e.buffer, None)
+        if lead is not None:
+            idx = [lead] + idx
+        return BufferLoad(buf, tuple(idx))
+    if isinstance(e, BinOp):
+        a, b = _rw_expr(e.a, vm, bs), _rw_expr(e.b, vm, bs)
+        if a is e.a and b is e.b:
+            return e
+        return BinOp(e.op, convert(a), convert(b))
+    if isinstance(e, Call):
+        args = [a if isinstance(a, str) else _rw_expr(a, vm, bs)
+                for a in e.args]
+        if all(a is b for a, b in zip(args, e.args)):
+            return e
+        return Call(e.name, args, e.dtype)
+    if isinstance(e, Cast):
+        v = _rw_expr(e.value, vm, bs)
+        return e if v is e.value else Cast(e.dtype, v)
+    return e
+
+
+def _rw_region(r: Region, vm: dict, bs: BufSub) -> Region:
+    base = [_rw_expr(b, vm, bs) for b in r.base]
+    sub = bs.get(r.buffer.uid)
+    if sub is None and all(a is b for a, b in zip(base, r.base)):
+        return r
+    buf, lead = sub if sub is not None else (r.buffer, None)
+    shape = list(r.shape)
+    if lead is not None:
+        base = [lead] + base
+        shape = [1] + shape
+    return Region(buf, tuple(base), tuple(shape))
+
+
+def _rw_buf(b: Buffer, bs: BufSub) -> Buffer:
+    sub = bs.get(b.uid)
+    if sub is None:
+        return b
+    buf, lead = sub
+    if lead is not None:
+        raise AssertionError(
+            f"buffer {b.name} used as a whole-buffer operand cannot take "
+            f"a slot index (tile-opt pass bug: dbuf must bail on it)")
+    return buf
+
+
+def _keep_loc(new: Stmt, old: Stmt) -> Stmt:
+    if old.loc is not None:
+        new.loc = old.loc
+    return new
+
+
+def _rw_stmt(s: Stmt, vm: dict, bs: BufSub) -> Stmt:
+    """Rebuild one statement under a var/buffer substitution; returns
+    the ORIGINAL object when nothing inside it changed."""
+    if isinstance(s, SeqStmt):
+        kids = [_rw_stmt(c, vm, bs) for c in s.stmts]
+        if all(a is b for a, b in zip(kids, s.stmts)):
+            return s
+        return _keep_loc(SeqStmt(kids), s)
+    if isinstance(s, KernelNode):
+        pre = [_rw_stmt(c, vm, bs) for c in s.prelude]
+        body = _rw_stmt(s.body, vm, bs)
+        if body is s.body and all(a is b for a, b in zip(pre, s.prelude)):
+            return s
+        return _keep_loc(KernelNode(s.grid_vars, s.extents, s.threads,
+                                    body, prelude=pre), s)
+    if isinstance(s, ForNest):
+        exts = [e if isinstance(e, int) else _rw_expr(e, vm, bs)
+                for e in s.extents]
+        body = _rw_stmt(s.body, vm, bs)
+        if body is s.body and all(a is b for a, b in zip(exts, s.extents)):
+            return s
+        return _keep_loc(ForNest(s.loop_vars, exts, s.kind, body,
+                                 s.num_stages, dict(s.annotations)), s)
+    if isinstance(s, IfThenElse):
+        cond = _rw_expr(s.cond, vm, bs)
+        then = _rw_stmt(s.then_body, vm, bs)
+        els = _rw_stmt(s.else_body, vm, bs) if s.else_body is not None \
+            else None
+        if cond is s.cond and then is s.then_body and els is s.else_body:
+            return s
+        return _keep_loc(IfThenElse(cond, then, els), s)
+    if isinstance(s, AllocStmt):
+        return s
+    if isinstance(s, CopyStmt):
+        src, dst = _rw_region(s.src, vm, bs), _rw_region(s.dst, vm, bs)
+        if src is s.src and dst is s.dst:
+            return s
+        return _keep_loc(CopyStmt(src, dst, s.coalesced_width), s)
+    if isinstance(s, AsyncCopyStmt):
+        src, dst = _rw_region(s.src, vm, bs), _rw_region(s.dst, vm, bs)
+        slot = _rw_expr(s.slot, vm, bs)
+        sem = _rw_buf(s.sem, bs)
+        if src is s.src and dst is s.dst and slot is s.slot \
+                and sem is s.sem:
+            return s
+        return _keep_loc(AsyncCopyStmt(src, dst, sem, slot, s.phase), s)
+    if isinstance(s, GemmStmt):
+        A, B, C = (_rw_region(r, vm, bs) for r in (s.A, s.B, s.C))
+        if A is s.A and B is s.B and C is s.C:
+            return s
+        return _keep_loc(GemmStmt(A, B, C, s.trans_A, s.trans_B, s.policy,
+                                  s.clear_accum), s)
+    if isinstance(s, FillStmt):
+        dst = _rw_region(s.dst, vm, bs)
+        val = _rw_expr(s.value, vm, bs)
+        if dst is s.dst and val is s.value:
+            return s
+        return _keep_loc(FillStmt(dst, val), s)
+    if isinstance(s, ReduceStmt):
+        src, dst = _rw_buf(s.src, bs), _rw_buf(s.dst, bs)
+        if src is s.src and dst is s.dst:
+            return s
+        return _keep_loc(ReduceStmt(s.kind, src, dst, s.dim, s.clear), s)
+    if isinstance(s, CumSumStmt):
+        src, dst = _rw_buf(s.src, bs), _rw_buf(s.dst, bs)
+        if src is s.src and dst is s.dst:
+            return s
+        return _keep_loc(CumSumStmt(src, dst, s.dim, s.reverse), s)
+    if isinstance(s, AtomicStmt):
+        dst = _rw_region(s.dst, vm, bs)
+        val = _rw_region(s.value, vm, bs) if isinstance(s.value, Region) \
+            else _rw_expr(s.value, vm, bs)
+        if dst is s.dst and val is s.value:
+            return s
+        return _keep_loc(AtomicStmt(s.op, dst, val), s)
+    if isinstance(s, BufferStoreStmt):
+        idx = [i if isinstance(i, slice) else _rw_expr(i, vm, bs)
+               for i in s.indices]
+        val = _rw_expr(s.value, vm, bs)
+        sub = bs.get(s.buffer.uid)
+        if sub is None and val is s.value and \
+                all(a is b for a, b in zip(idx, s.indices)):
+            return s
+        buf, lead = sub if sub is not None else (s.buffer, None)
+        if lead is not None:
+            idx = [lead] + idx
+        return _keep_loc(BufferStoreStmt(buf, tuple(idx), val), s)
+    if isinstance(s, EvaluateStmt):
+        e = _rw_expr(s.expr, vm, bs)
+        return s if e is s.expr else _keep_loc(EvaluateStmt(e), s)
+    if isinstance(s, AssertStmt):
+        c = _rw_expr(s.cond, vm, bs)
+        return s if c is s.cond else _keep_loc(AssertStmt(c, s.msg), s)
+    if isinstance(s, PrintStmt):
+        obj = s.obj
+        if isinstance(obj, Buffer):
+            obj = _rw_buf(obj, bs)
+        elif isinstance(obj, Region):
+            obj = _rw_region(obj, vm, bs)
+        elif obj is not None and not isinstance(obj, str):
+            obj = _rw_expr(obj, vm, bs)
+        return s if obj is s.obj else _keep_loc(PrintStmt(obj, s.msg), s)
+    # CommStmt and friends: tile-opt never runs on mesh programs
+    # (lower_mesh branches before it); leave them untouched if ever seen
+    return s
+
+
+def _drop_stmts(stmts, drop: set) -> List[Stmt]:
+    """Rebuild a statement list without the dropped statements, pruning
+    loops whose bodies emptied and Ifs whose arms both emptied (their
+    condition/extent reads are pure)."""
+    out: List[Stmt] = []
+    for s in stmts:
+        if id(s) in drop:
+            continue
+        if isinstance(s, SeqStmt):
+            kids = _drop_stmts(s.stmts, drop)
+            if kids != list(s.stmts):
+                if not kids:
+                    continue
+                s = _keep_loc(SeqStmt(kids), s)
+        elif isinstance(s, KernelNode):
+            pre = _drop_stmts(s.prelude, drop)
+            body = _drop_stmts(s.body.stmts, drop)
+            if pre != list(s.prelude) or body != list(s.body.stmts):
+                s = _keep_loc(KernelNode(s.grid_vars, s.extents, s.threads,
+                                         SeqStmt(body), prelude=pre), s)
+        elif isinstance(s, ForNest):
+            body = _drop_stmts(s.body.stmts, drop)
+            if not body:
+                continue
+            if body != list(s.body.stmts):
+                s = _keep_loc(ForNest(s.loop_vars, s.extents, s.kind,
+                                      SeqStmt(body), s.num_stages,
+                                      dict(s.annotations)), s)
+        elif isinstance(s, IfThenElse):
+            then = _drop_stmts(s.then_body.stmts, drop)
+            els = _drop_stmts(s.else_body.stmts, drop) \
+                if s.else_body is not None else None
+            if not then and not els:
+                continue
+            if then != list(s.then_body.stmts) or \
+                    (s.else_body is not None and
+                     els != list(s.else_body.stmts)):
+                s = _keep_loc(IfThenElse(
+                    s.cond, SeqStmt(then),
+                    SeqStmt(els) if els else None), s)
+        out.append(s)
+    return out
+
+
+def _buf_bytes(b: Buffer) -> int:
+    """Padded VMEM footprint of one scratch buffer — the same
+    (sublane, lane)-tile rule transform/plan._pack_scratch charges."""
+    ss = b.static_shape()
+    if not ss:
+        return 0
+    from ..layout import native as lnat
+    from ..layout import python_impl as lpy
+    rows = ss[-2] if len(ss) >= 2 else 1
+    cols = ss[-1] if ss else 1
+    bits = dtype_bits(b.dtype)
+    tile = lnat.vmem_bytes(rows, cols, bits)
+    if tile is None:
+        tile = lpy.vmem_bytes(rows, cols, bits)
+    lead = 1
+    for x in ss[:-2]:
+        lead *= x
+    return tile * lead
+
+
+# ---------------------------------------------------------------------------
+# dse — dead-store / dead-alloc elimination (TL006's proof, applied)
+# ---------------------------------------------------------------------------
+
+#: statements that only exist to produce their written buffers — safe to
+#: delete when every written buffer is dead (their reads are pure)
+_PURE_WRITERS = (CopyStmt, FillStmt, GemmStmt, ReduceStmt, CumSumStmt,
+                 BufferStoreStmt, AtomicStmt)
+
+
+def _dse_dead_allocs(body) -> Dict[int, AllocStmt]:
+    """TL006's exact dead set: on-chip allocs never read (dead stores)
+    or never touched at all (unused allocs).  Async-copy destinations
+    are excluded — deleting half a split-phase DMA pair would leave a
+    wait on a never-armed slot.  Split out as its own helper so the
+    mutation tests can corrupt it and assert the selfcheck catches the
+    miscompile."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    allocs: Dict[int, AllocStmt] = {}
+    reads: set = set()
+    async_touched: set = set()
+    for s, _c in iter_stmts(body):
+        if isinstance(s, AllocStmt):
+            allocs.setdefault(s.buffer.uid, s)
+            continue
+        if isinstance(s, AsyncCopyStmt):
+            async_touched.add(s.src.buffer.uid)
+            async_touched.add(s.dst.buffer.uid)
+        for acc in stmt_accesses(s):
+            if acc.kind == "read":
+                reads.add(acc.buffer.uid)
+    return {uid: a for uid, a in allocs.items()
+            if a.buffer.scope not in ("global", "sem")
+            and uid not in reads and uid not in async_touched}
+
+
+def _dse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
+    """Delete TL006-proven dead stores and unused allocs, to fixpoint
+    (removing the stores into a dead buffer can strand the buffer that
+    only fed them — a dead chain, same fixpoint comm_opt's dce runs)."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    for _round in range(16):
+        dead = _dse_dead_allocs(body)
+        if not dead:
+            break
+        drop: set = set()
+        stores: Dict[int, List[Stmt]] = {uid: [] for uid in dead}
+        for s, _c in iter_stmts(body):
+            if not isinstance(s, _PURE_WRITERS):
+                continue
+            ws = [a for a in stmt_accesses(s) if a.kind == "write"]
+            if ws and all(a.buffer.uid in dead for a in ws):
+                drop.add(id(s))
+                for a in ws:
+                    stores[a.buffer.uid].append(s)
+        for uid, astmt in sorted(dead.items()):
+            b = astmt.buffer
+            drop.add(id(astmt))
+            nbytes = _buf_bytes(b)
+            nstores = len(stores.get(uid, []))
+            res.dse_bytes += nbytes
+            res.dse_allocs += 1
+            res.dse_stores += nstores
+            op = (type(stores[uid][0]).__name__ if stores.get(uid)
+                  else "AllocStmt")
+            res.eliminated.append(
+                {"op": op, "buffer": b.name, "bytes": nbytes})
+            if nstores:
+                res.rewrites.append(
+                    f"dse: removed dead scratch '{b.name}' "
+                    f"({nstores} store(s), {nbytes}B VMEM)")
+            else:
+                res.rewrites.append(
+                    f"dse: removed unused alloc '{b.name}' "
+                    f"({nbytes}B VMEM)")
+        body = SeqStmt(_drop_stmts(body.stmts, drop))
+    return body
+
+
+# ---------------------------------------------------------------------------
+# repack — realize the TL005 interval packing at the IR level
+# ---------------------------------------------------------------------------
+
+
+def _kernel_node(body) -> Optional[KernelNode]:
+    for s in body.stmts:
+        if isinstance(s, KernelNode):
+            return s
+    return None
+
+
+def _repack(body: SeqStmt, res: TileOptResult) -> SeqStmt:
+    """Alias same-shape/dtype/scope scratch buffers with provably
+    disjoint top-level live intervals onto one shared slot.
+
+    Liveness is measured at top-level-statement granularity of the
+    kernel body — the same interval model TL005's arena packing uses.
+    A buffer is slot-shareable only when its FIRST access is an
+    unconditional write (no branch guard, every enclosing loop extent
+    statically >= 1): a guarded first write is the grid-carried-init
+    idiom, whose value must survive from one grid step into the next —
+    re-using its slot between steps would corrupt it, so such buffers
+    are left alone."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    kn = _kernel_node(body)
+    if kn is None:
+        return body
+    top = list(kn.body.stmts)
+
+    info: Dict[int, dict] = {}
+    # accesses OUTSIDE the kernel body — the KernelNode prelude and any
+    # sibling top statements — are invisible to the top-level interval
+    # model below; buffers they touch are disqualified outright (a
+    # prelude read of grid-carried scratch must never lose its slot)
+    outside = [s for s in body.stmts if s is not kn] + list(kn.prelude)
+    for s, _c in iter_stmts(outside):
+        for acc in stmt_accesses(s):
+            info[acc.buffer.uid] = {"first": -1, "last": -1,
+                                    "first_write": False, "bad": True}
+    for ti, child in enumerate(top):
+        for s, c in iter_stmts([child]):
+            if isinstance(s, AllocStmt):
+                continue
+            bad = isinstance(s, AsyncCopyStmt)
+            for acc in stmt_accesses(s):
+                b = acc.buffer
+                d = info.get(b.uid)
+                if d is None:
+                    uncond = (not c.guards) and all(
+                        as_int(e) is not None and as_int(e) >= 1
+                        for ln in c.loops for e in ln.extents)
+                    d = info[b.uid] = {
+                        "first": ti, "last": ti,
+                        "first_write": acc.kind == "write" and uncond,
+                    }
+                d["last"] = ti
+                if bad:
+                    d["bad"] = True
+
+    # allocs in program order (anywhere in the func body)
+    alloc_stmts: List[AllocStmt] = []
+    seen_allocs: set = set()
+    for s, _c in iter_stmts(body):
+        if isinstance(s, AllocStmt) and s.buffer.uid not in seen_allocs:
+            seen_allocs.add(s.buffer.uid)
+            alloc_stmts.append(s)
+
+    res.repack_pre_bytes = sum(
+        _buf_bytes(a.buffer) for a in alloc_stmts
+        if a.buffer.scope not in ("global", "sem"))
+
+    cands = []
+    for a in alloc_stmts:
+        b = a.buffer
+        if b.scope in ("global", "sem") or b.static_shape() is None:
+            continue
+        d = info.get(b.uid)
+        if d is None or d.get("bad") or not d["first_write"]:
+            continue
+        cands.append((d["first"], b.uid, a, d))
+    cands.sort(key=lambda t: (t[0], t[1]))
+
+    slots: List[dict] = []       # {"rep": Buffer, "last": int}
+    buf_sub: BufSub = {}
+    drop: set = set()
+    saved = 0
+    for _first, _uid, astmt, d in cands:
+        b = astmt.buffer
+        placed = False
+        for slot in slots:
+            rep = slot["rep"]
+            if rep.static_shape() == b.static_shape() \
+                    and rep.dtype == b.dtype and rep.scope == b.scope \
+                    and slot["last"] < d["first"]:
+                buf_sub[b.uid] = (rep, None)
+                drop.add(id(astmt))
+                slot["last"] = d["last"]
+                saved += _buf_bytes(b)
+                res.repack_buffers += 1
+                res.rewrites.append(
+                    f"repack: '{b.name}' shares the VMEM slot of "
+                    f"'{rep.name}' (disjoint lifetimes, "
+                    f"{_buf_bytes(b)}B saved)")
+                placed = True
+                break
+        if not placed:
+            slots.append({"rep": b, "last": d["last"]})
+
+    if not buf_sub:
+        res.repack_pre_bytes = 0
+        return body
+    res.repack_slots = len(slots)
+    res.repack_post_bytes = res.repack_pre_bytes - saved
+    body = SeqStmt(_drop_stmts(body.stmts, drop))
+    return _rw_stmt(body, {}, buf_sub)
+
+
+# ---------------------------------------------------------------------------
+# dbuf — proof-gated automatic double-buffering of serial-loop streams
+# ---------------------------------------------------------------------------
+
+
+def _dbuf(body: SeqStmt, res: TileOptResult) -> SeqStmt:
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+
+    # whole-function facts: every write/read of every buffer, and the
+    # buffers used as whole-buffer operands (ReduceStmt/CumSumStmt take
+    # a Buffer, which cannot carry a slot index)
+    writes: Dict[int, List[Stmt]] = {}
+    reads: Dict[int, List[Stmt]] = {}
+    whole_ops: set = set()
+    for s, _c in iter_stmts(body):
+        if isinstance(s, (ReduceStmt, CumSumStmt)):
+            whole_ops.add(s.src.uid)
+            whole_ops.add(s.dst.uid)
+        if isinstance(s, AllocStmt):
+            continue
+        for acc in stmt_accesses(s):
+            (writes if acc.kind == "write" else reads).setdefault(
+                acc.buffer.uid, []).append(s)
+
+    drop_allocs: set = set()
+
+    def try_loop(loop: ForNest) -> Optional[Tuple[List[Stmt], ForNest]]:
+        if loop.kind != "serial" or len(loop.loop_vars) != 1:
+            return None
+        n = as_int(loop.extents[0])
+        if n is None or n < 2:
+            return None
+        ko = loop.loop_vars[0]
+        children = list(loop.body.stmts)
+        owner: Dict[int, int] = {}
+        for idx, child in enumerate(children):
+            for st, _ in iter_stmts([child]):
+                owner[id(st)] = idx
+        body_writes: set = set()
+        for child in children:
+            for st, _ in iter_stmts([child]):
+                for acc in stmt_accesses(st):
+                    if acc.kind == "write":
+                        body_writes.add(acc.buffer.uid)
+
+        new_allocs: List[Stmt] = []
+        buf_sub: BufSub = {}
+        copy_repl: Dict[int, List[Stmt]] = {}
+        for ci, s in enumerate(children):
+            if not isinstance(s, CopyStmt):
+                continue
+            dstb = s.dst.buffer
+            if dstb.uid in buf_sub:
+                continue
+            if s.src.buffer.scope != "global" \
+                    or dstb.scope in ("global", "sem") \
+                    or not s.dst.is_full() \
+                    or dstb.static_shape() is None \
+                    or dstb.uid in whole_ops:
+                continue
+            # the full-region copy must be the FIRST access of the
+            # stream buffer: every other touch (the in-place transforms
+            # and the consumers) lives inside THIS loop body after it.
+            # The full refill kills loop-carried state, so re-slotting
+            # each iteration onto ko % 2 cannot change what any read
+            # observes — the proof the TL002 window machinery encodes.
+            others = [w for w in writes.get(dstb.uid, []) if w is not s] \
+                + reads.get(dstb.uid, [])
+            if not reads.get(dstb.uid) or \
+                    any(owner.get(id(o), -1) <= ci for o in others):
+                continue
+            # the in-flight prefetch reads src(ko+1): nothing in the
+            # loop may write the DMA source (TL002's clobber hazard) —
+            # NOR any buffer the source's base indices read (a
+            # gather-style `A[idx[0], 0]` source whose index scratch is
+            # updated in the loop would prefetch ko+1's tile through
+            # ko's stale index value). stmt_accesses enumerates both:
+            # the src region read and every load inside its bases.
+            if any(a.kind == "read" and a.buffer.uid in body_writes
+                   for a in stmt_accesses(s)):
+                continue
+            shape = dstb.static_shape()
+            dst2 = Buffer(f"{dstb.name}_db", (2,) + shape, dstb.dtype,
+                          dstb.scope)
+            sem = Buffer(f"{dstb.name}_dbsem", (2,), "int32", "sem")
+            new_allocs.extend([AllocStmt(dst2), AllocStmt(sem)])
+            lead = ko % 2
+            nxt = (ko + 1) % 2
+            zeros = (0,) * len(shape)
+            slot_cur = Region(dst2, (lead,) + zeros, (1,) + shape)
+            slot_nxt = Region(dst2, (nxt,) + zeros, (1,) + shape)
+            src_next = _rw_region(s.src, {id(ko): ko + 1}, {})
+            prologue = IfThenElse(
+                ko == 0,
+                SeqStmt([_keep_loc(AsyncCopyStmt(s.src, slot_cur, sem,
+                                                 lead, "start"), s)]))
+            prefetch = IfThenElse(
+                ko + 1 < n,
+                SeqStmt([_keep_loc(AsyncCopyStmt(src_next, slot_nxt, sem,
+                                                 nxt, "start"), s)]))
+            wait = _keep_loc(AsyncCopyStmt(s.src, slot_cur, sem, lead,
+                                           "wait"), s)
+            copy_repl[ci] = [_keep_loc(prologue, s),
+                             _keep_loc(prefetch, s), wait]
+            buf_sub[dstb.uid] = (dst2, lead)
+            res.dbuf_chains += 1
+            res.rewrites.append(
+                f"dbuf: double-buffered '{dstb.name}' "
+                f"({_fmt_shape(shape)} {dstb.dtype}) HBM stream in serial "
+                f"loop {ko.name} — prefetch ko+1 overlaps compute on ko "
+                f"(2 slots, rotated semaphore)")
+        if not buf_sub:
+            return None
+        # the original allocs of the re-slotted buffers die with them
+        for s, _c in iter_stmts(body):
+            if isinstance(s, AllocStmt) and s.buffer.uid in buf_sub:
+                drop_allocs.add(id(s))
+        new_children: List[Stmt] = []
+        for ci, child in enumerate(children):
+            if ci in copy_repl:
+                new_children.extend(copy_repl[ci])
+            else:
+                new_children.append(_rw_stmt(child, {}, buf_sub))
+        return new_allocs, _keep_loc(
+            ForNest(loop.loop_vars, loop.extents, loop.kind,
+                    SeqStmt(new_children), loop.num_stages,
+                    dict(loop.annotations)), loop)
+
+    def rebuild(stmts) -> List[Stmt]:
+        out: List[Stmt] = []
+        for s in stmts:
+            if isinstance(s, ForNest):
+                hit = try_loop(s)
+                if hit is not None:
+                    allocs, newl = hit
+                    out.extend(allocs)
+                    out.append(newl)
+                    continue
+                nb = rebuild(s.body.stmts)
+                if nb != list(s.body.stmts):
+                    s = _keep_loc(ForNest(s.loop_vars, s.extents, s.kind,
+                                          SeqStmt(nb), s.num_stages,
+                                          dict(s.annotations)), s)
+            elif isinstance(s, KernelNode):
+                nb = rebuild(s.body.stmts)
+                if nb != list(s.body.stmts):
+                    s = _keep_loc(KernelNode(s.grid_vars, s.extents,
+                                             s.threads, SeqStmt(nb),
+                                             prelude=list(s.prelude)), s)
+            elif isinstance(s, IfThenElse):
+                then = rebuild(s.then_body.stmts)
+                els = rebuild(s.else_body.stmts) \
+                    if s.else_body is not None else None
+                if then != list(s.then_body.stmts) or \
+                        (s.else_body is not None and
+                         els != list(s.else_body.stmts)):
+                    s = _keep_loc(IfThenElse(
+                        s.cond, SeqStmt(then),
+                        SeqStmt(els) if els is not None else None), s)
+            elif isinstance(s, SeqStmt):
+                nb = rebuild(s.stmts)
+                if nb != list(s.stmts):
+                    s = _keep_loc(SeqStmt(nb), s)
+            out.append(s)
+        return out
+
+    new_body = SeqStmt(rebuild(body.stmts))
+    if drop_allocs:
+        new_body = SeqStmt(_drop_stmts(new_body.stmts, drop_allocs))
+    return new_body
+
+
+def _fmt_shape(shape) -> str:
+    return "(" + ", ".join(str(s) for s in shape) + ")"
+
+
+# ---------------------------------------------------------------------------
+# fuse — affine fusion of adjacent identical-space T.Parallel regions
+# ---------------------------------------------------------------------------
+
+
+def _positional_forms(indices, loop_vars) -> Optional[list]:
+    """Per-dimension affine forms with loop-var coefficients keyed by
+    POSITION in ``loop_vars`` (so forms from two different nests compare
+    directly), or None when any dimension is unanalyzable."""
+    from ..analysis.regions import access_affine
+    forms = access_affine(indices, loop_vars)
+    if forms is None:
+        return None
+    pos_of = {id(v): i for i, v in enumerate(loop_vars)}
+    out = []
+    for coeffs, ambient, const in forms:
+        vec = [0] * len(loop_vars)
+        for vid, c in coeffs.items():
+            vec[pos_of[vid]] = c
+        out.append((tuple(vec), ambient, const))
+    return out
+
+
+def _forms_injective(forms, exts) -> bool:
+    """Sufficient injectivity proof over the iteration box: every
+    extent>1 var owns at least one dimension alone (single-var affine
+    dim with non-zero coefficient) — two iterations differing in that
+    var provably differ in that dimension."""
+    for pos, ext in enumerate(exts):
+        if ext is None or ext <= 1:
+            continue
+        owned = any(
+            vec[pos] != 0 and all(c == 0 for i, c in enumerate(vec)
+                                  if i != pos)
+            for vec, _amb, _k in forms)
+        if not owned:
+            return False
+    return True
+
+
+def _fusable(n1: ForNest, n2: ForNest) -> bool:
+    from ..analysis.dataflow import stmt_accesses
+    if n1.kind != "parallel" or n2.kind != "parallel":
+        return False
+    if len(n1.loop_vars) != len(n2.loop_vars):
+        return False
+    e1 = [as_int(e) for e in n1.extents]
+    e2 = [as_int(e) for e in n2.extents]
+    if e1 != e2 or any(e is None or e < 1 for e in e1):
+        return False
+    # only simple elementwise bodies (no nested control flow — guards
+    # would weaken the iteration-space identity the proof relies on)
+    for nest in (n1, n2):
+        for st in nest.body.stmts:
+            if not isinstance(st, (BufferStoreStmt, EvaluateStmt)):
+                return False
+    acc1 = [a for st in n1.body.stmts for a in stmt_accesses(st)]
+    acc2 = [a for st in n2.body.stmts for a in stmt_accesses(st)]
+    touched1 = {a.buffer.uid for a in acc1}
+    touched2 = {a.buffer.uid for a in acc2}
+    written = {a.buffer.uid for a in acc1 + acc2 if a.kind == "write"}
+    shared = (touched1 & touched2) & written
+    if not shared:
+        return True
+    # TL001's machinery as the dependency oracle: on every shared
+    # written buffer, all cross-nest access pairs must be affine with
+    # IDENTICAL positional forms (iteration i talks only to iteration
+    # i), and every write must be injective over the extent>1 vars
+    # (no two iterations alias one element).
+    for uid in sorted(shared):
+        f1 = [(_positional_forms(a.indices, n1.loop_vars), a.kind)
+              for a in acc1 if a.buffer.uid == uid]
+        f2 = [(_positional_forms(a.indices, n2.loop_vars), a.kind)
+              for a in acc2 if a.buffer.uid == uid]
+        for forms, _k in f1 + f2:
+            if forms is None:
+                return False
+        for forms1, k1 in f1:
+            for forms2, k2 in f2:
+                if k1 != "write" and k2 != "write":
+                    continue
+                if forms1 != forms2:
+                    return False
+        for forms, k in f1 + f2:
+            if k == "write" and not _forms_injective(forms, e1):
+                return False
+    return True
+
+
+def _fuse_pair(n1: ForNest, n2: ForNest) -> ForNest:
+    vm = {id(v2): v1 for v1, v2 in zip(n1.loop_vars, n2.loop_vars)}
+    body2 = [_rw_stmt(st, vm, {}) for st in n2.body.stmts]
+    return _keep_loc(ForNest(
+        n1.loop_vars, n1.extents, "parallel",
+        SeqStmt(list(n1.body.stmts) + body2),
+        0, {**n2.annotations, **n1.annotations}), n1)
+
+
+def _fuse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
+    def rebuild(stmts) -> List[Stmt]:
+        kids: List[Stmt] = []
+        for s in stmts:
+            if isinstance(s, KernelNode):
+                nb = rebuild(s.body.stmts)
+                if nb != list(s.body.stmts):
+                    s = _keep_loc(KernelNode(s.grid_vars, s.extents,
+                                             s.threads, SeqStmt(nb),
+                                             prelude=list(s.prelude)), s)
+            elif isinstance(s, ForNest):
+                nb = rebuild(s.body.stmts)
+                if nb != list(s.body.stmts):
+                    s = _keep_loc(ForNest(s.loop_vars, s.extents, s.kind,
+                                          SeqStmt(nb), s.num_stages,
+                                          dict(s.annotations)), s)
+            elif isinstance(s, IfThenElse):
+                then = rebuild(s.then_body.stmts)
+                els = rebuild(s.else_body.stmts) \
+                    if s.else_body is not None else None
+                if then != list(s.then_body.stmts) or \
+                        (s.else_body is not None and
+                         els != list(s.else_body.stmts)):
+                    s = _keep_loc(IfThenElse(
+                        s.cond, SeqStmt(then),
+                        SeqStmt(els) if els is not None else None), s)
+            elif isinstance(s, SeqStmt):
+                nb = rebuild(s.stmts)
+                if nb != list(s.stmts):
+                    s = _keep_loc(SeqStmt(nb), s)
+            out_merge(kids, s)
+        return kids
+
+    def out_merge(kids: List[Stmt], s: Stmt) -> None:
+        if kids and isinstance(s, ForNest) and \
+                isinstance(kids[-1], ForNest) and _fusable(kids[-1], s):
+            n1 = kids[-1]
+            exts = [as_int(e) for e in n1.extents]
+            kids[-1] = _fuse_pair(n1, s)
+            res.fuse_regions += 1
+            res.rewrites.append(
+                f"fuse: merged adjacent T.Parallel{_fmt_shape(exts)} "
+                f"regions (no cross-region dependency; one vectorized "
+                f"sweep)")
+            return
+        kids.append(s)
+
+    return SeqStmt(rebuild(body.stmts))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def run_tile_opt(func: PrimFunc, pass_cfg: Optional[dict] = None,
+                 findings: Optional[list] = None):
+    """Run the enabled rewrites over one kernel.
+
+    Returns ``(func, result, findings)``: the (possibly rebuilt)
+    PrimFunc, the :class:`TileOptResult` accounting, and the lint
+    findings with auto-fixed TL006 entries consumed (they are reported
+    through the ``tile_opt[...]`` block instead — the finding is fixed,
+    not worth a second warning)."""
+    from ..observability import tracer as _trace
+    findings = list(findings or [])
+    modes = tile_opt_modes(pass_cfg)
+    res = TileOptResult(modes=modes)
+    if not modes:
+        return func, res, findings
+
+    body = func.body if isinstance(func.body, SeqStmt) \
+        else SeqStmt(list(func.body))
+    new_body = body
+    if "dse" in modes:
+        new_body = _dse(new_body, res)
+    if "repack" in modes:
+        new_body = _repack(new_body, res)
+    if "dbuf" in modes:
+        new_body = _dbuf(new_body, res)
+    if "fuse" in modes:
+        new_body = _fuse(new_body, res)
+
+    if not res.rewrites:
+        return func, res, findings
+
+    _trace.inc("opt.kernels")
+    for mode, n in (("dse", res.dse_allocs), ("repack", res.repack_buffers),
+                    ("dbuf", res.dbuf_chains), ("fuse", res.fuse_regions)):
+        if n:
+            _trace.inc("opt.rewrites", n, mode=mode)
+    if res.dse_stores:
+        _trace.inc("opt.dse.stores", res.dse_stores)
+    if res.dse_allocs:
+        _trace.inc("opt.dse.allocs", res.dse_allocs)
+    if res.dse_bytes:
+        _trace.inc("opt.dse.bytes", res.dse_bytes)
+    if res.repack_buffers:
+        _trace.inc("opt.repack.bytes_saved",
+                   res.repack_pre_bytes - res.repack_post_bytes)
+    if res.dbuf_chains:
+        _trace.inc("opt.dbuf.chains", res.dbuf_chains)
+    if res.fuse_regions:
+        _trace.inc("opt.fuse.regions", res.fuse_regions)
+    for e in res.eliminated:
+        # bytes here are padded VMEM footprint; comm_opt's dce rows
+        # carry ICI wire bytes — the shared counter is labelled by
+        # source so the two units are never summed into one scalar
+        _trace.inc("opt.eliminated.bytes", e["bytes"], source="tile_opt")
+        _trace.event("opt.eliminated", "lower", source="tile_opt",
+                     kernel=func.name, **e)
+
+    new_func = PrimFunc(func.name, func.params, new_body,
+                        dict(func.attrs))
+    fixed = {e["buffer"] for e in res.eliminated}
+    findings = [d for d in findings
+                if not (d.rule == "TL006" and d.buffer in fixed)]
+    return new_func, res, findings
