@@ -628,6 +628,125 @@ def cfg_vmem_repack_smoke(M=256, N=256, reps=60):
                 custom_run=run)
 
 
+def cfg_autotune_smoke(M_seed=128, M_target=256):
+    """CI tune-smoke config for cost-model-guided autotuning
+    (autotuner/cost_model.py + tune_cache.py; docs/autotuning.md): a
+    seeded 8-config GEMM sweep run four ways in ONE child process with
+    isolated cache dirs. (1) a cold model-mode sweep on a small seed
+    bucket — full sweep by construction (the cold-model fallback), it
+    seeds the fitted residual and the fleet tune cache; (2) a
+    ``TL_TPU_TUNE=bruteforce`` sweep on the target bucket — the pre-model
+    trial count and winner; (3) a warm model-mode sweep on the target
+    bucket — the model ranks the space from the sibling bucket's samples
+    and measures only the top-K + epsilon tail; (4) a fresh tuner on the
+    target bucket with the legacy result cache bypassed — the fleet
+    tune-cache warm start, which must measure ZERO trials. Headline
+    value (= ``vs_baseline``, CI gate >= 2) is the measured-trial
+    reduction of (3) vs (2); the record embeds the chosen-vs-bruteforce
+    latency ratio so the perf-diff harness guards tuned-config QUALITY
+    over time, not just trial count. CPU-safe."""
+    import tempfile
+
+    from tilelang_mesh_tpu.autotuner import AutoTuner
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+    from tilelang_mesh_tpu.profiler import Profiler
+
+    cfgs = [{"block_M": bm, "block_N": bn, "block_K": bk}
+            for bm, bn, bk in [(32, 32, 32), (32, 64, 64), (64, 64, 64),
+                               (64, 128, 128), (128, 128, 128),
+                               (128, 32, 64), (64, 32, 128),
+                               (128, 64, 32)]]
+    kw = dict(in_dtype="float32", out_dtype="float32")
+
+    def tuner(cache=True):
+        # rep=3: sub-ms CPU trials are noisy enough that rep=2 lets the
+        # measured ordering (and so the chosen-vs-brute quality ratio)
+        # wander run to run
+        return AutoTuner(matmul_kernel, cfgs, warmup=1, rep=3,
+                         cache_results=cache)
+
+    def run():
+        # isolated cache dirs: the tune cache derives from the autotune
+        # dir, so one env var isolates both tiers (this runs in the
+        # per-config child process — the parent env is untouched)
+        root = tempfile.mkdtemp(prefix="tltpu-bench-tune-")
+        os.environ["TL_TPU_AUTOTUNE_CACHE_DIR"] = os.path.join(
+            root, "autotune")
+        os.environ.pop("TL_TPU_TUNE_CACHE_DIR", None)
+        prev_mode = os.environ.pop("TL_TPU_TUNE", None)
+        try:
+            seed = tuner().run(M_seed, M_seed, M_seed, **kw)
+            os.environ["TL_TPU_TUNE"] = "bruteforce"
+            brute = tuner(cache=False).run(M_target, M_target, M_target,
+                                           **kw)
+            os.environ.pop("TL_TPU_TUNE", None)
+            model = tuner().run(M_target, M_target, M_target, **kw)
+            warm = tuner(cache=False).run(M_target, M_target, M_target,
+                                          **kw)
+        finally:
+            if prev_mode is None:
+                os.environ.pop("TL_TPU_TUNE", None)
+            else:
+                os.environ["TL_TPU_TUNE"] = prev_mode
+        if seed.trials_measured != len(cfgs):
+            raise BenchError(
+                "autotune_smoke: the cold-model seed sweep must measure "
+                f"every config ({seed.trials_measured}/{len(cfgs)})")
+        if model.trials_measured >= brute.trials_measured:
+            raise BenchError(
+                "autotune_smoke: the warm model pruned nothing "
+                f"({model.trials_measured} vs bruteforce "
+                f"{brute.trials_measured}) — the config exists to "
+                "measure the reduction")
+        if not warm.from_cache or warm.trials_measured != 0:
+            raise BenchError(
+                "autotune_smoke: the fleet tune-cache warm start must "
+                f"measure zero trials (measured {warm.trials_measured}, "
+                f"from_cache={warm.from_cache})")
+        reduction = brute.trials_measured / max(1, model.trials_measured)
+        # noise floor for the perf-diff gate: re-measure the chosen
+        # kernel a few times and take the median absolute deviation
+        prof = Profiler(model.kernel)
+        lats = sorted([model.latency_ms]
+                      + [prof.do_bench(warmup=1, rep=2) for _ in range(3)])
+        med = lats[len(lats) // 2]
+        mad = sorted(abs(x - med) for x in lats)[len(lats) // 2]
+        return {
+            "value": round(reduction, 4),
+            "unit": "x fewer measured trials",
+            # >= 2 is the tune-smoke acceptance gate
+            "vs_baseline": round(reduction, 4),
+            # perf-diff gate inputs: the latency of the MODEL-CHOSEN
+            # config vs the bruteforce winner's — a regression here means
+            # pruning started discarding the real winners
+            "latency_ms": round(model.latency_ms, 6),
+            "baseline_ms": round(brute.latency_ms, 6),
+            "latency_p50_ms": round(model.latency_ms, 6),
+            "latency_p90_ms": round(max(lats), 6),
+            "latency_p99_ms": round(max(lats), 6),
+            "latency_mad_ms": round(max(mad, 1e-6), 6),
+            "latency_samples": len(lats),
+            "reps": len(cfgs),
+            "baseline_mad_ms": round(max(mad, 1e-6), 6),
+            "trials_measured_model": model.trials_measured,
+            "trials_measured_bruteforce": brute.trials_measured,
+            "trials_pruned": model.trials_pruned,
+            "model_rank_agreement": model.model_agreement,
+            "chosen_config": model.config,
+            "bruteforce_config": brute.config,
+            "chosen_vs_bruteforce": round(
+                model.latency_ms / brute.latency_ms, 4)
+            if brute.latency_ms else None,
+            "warm_start_trials": warm.trials_measured,
+            "seed_trials": seed.trials_measured,
+        }
+
+    return dict(metric=f"cost-model autotune smoke {M_target}^3 GEMM "
+                       f"x{len(cfgs)} configs (model-guided trials vs "
+                       f"bruteforce)",
+                custom_run=run)
+
+
 def cfg_serve_smoke(requests=64):
     """CI serve-smoke config for the serving engine (serving/;
     docs/serving.md): a seeded request storm through the
@@ -1616,7 +1735,8 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # probe finds the TPU worker dead still runs them (on the host platform)
 # instead of producing an empty artifact.
 CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
-                    "vmem_repack_smoke", "mesh_allreduce_smoke",
+                    "vmem_repack_smoke", "autotune_smoke",
+                    "mesh_allreduce_smoke",
                     "serve_smoke", "mesh_serve_smoke")
 
 
@@ -1667,6 +1787,7 @@ def _config_builders(q: bool):
         ("gemm_smoke", lambda: cfg_gemm_smoke()),
         ("dispatch_overhead_smoke", lambda: cfg_dispatch_overhead_smoke()),
         ("vmem_repack_smoke", lambda: cfg_vmem_repack_smoke()),
+        ("autotune_smoke", lambda: cfg_autotune_smoke()),
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("serve_smoke", lambda: cfg_serve_smoke()),
         ("mesh_serve_smoke", lambda: cfg_mesh_serve_smoke()),

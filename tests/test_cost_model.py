@@ -1,0 +1,680 @@
+"""Cost-model-guided autotuning + fleet tune cache (docs/autotuning.md).
+
+Covers the four contracts the subsystem makes:
+
+- **feature extraction is deterministic and executes nothing** — two
+  lowerings of one kernel yield byte-identical feature dicts, and a
+  GEMM's modeled FLOPs are exact;
+- **the model never discards the true best** — cold models run the full
+  sweep, warm models keep the winner in the measured set on the seeded
+  synthetic sweep, and a ranking that disagrees with measurement falls
+  back to measuring everything;
+- **``TL_TPU_TUNE=bruteforce`` restores pre-model behavior** — every
+  config measured, no tune-cache consults, no model fields in the
+  records;
+- **the tune cache is crash-safe and mergeable** — checksummed entries,
+  corruption quarantined (never trusted), commutative merges where the
+  per-config best wins, and a completed sweep warm-starting a second
+  tuner (and serving ``warmup()``) with ZERO measurements.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu.env import env
+from tilelang_mesh_tpu.observability import get_tracer
+
+
+@pytest.fixture(autouse=True)
+def _isolated_dirs(monkeypatch, tmp_path):
+    # the fleet tune cache derives from the autotune dir, so one var
+    # isolates both tiers per test (warm entries from an earlier test
+    # must never change a later test's trial counts)
+    monkeypatch.setenv("TL_TPU_AUTOTUNE_CACHE_DIR",
+                       str(tmp_path / "autotune"))
+    monkeypatch.delenv("TL_TPU_TUNE_CACHE_DIR", raising=False)
+    monkeypatch.delenv("TL_TPU_TUNE", raising=False)
+    yield
+
+
+def _make_factory():
+    """A tiny tunable copy kernel; every call returns a fresh jit
+    factory with IDENTICAL source, so fleet-tier source keying works."""
+    @tilelang.jit
+    def tune_fac(M, N, block_M=32):
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(T.ceildiv(M, block_M)) as bx:
+                s = T.alloc_shared((block_M, N), "float32")
+                T.copy(A[bx * block_M, 0], s)
+                T.copy(s, B[bx * block_M, 0])
+        return k
+    return tune_fac
+
+
+def _fake_bench(monkeypatch, lat_of):
+    """Replace Profiler.do_bench with a deterministic latency function
+    of the measured kernel's compile-time features — measurement noise
+    must not decide these tests."""
+    from tilelang_mesh_tpu.autotuner.cost_model import features_from_kernel
+    from tilelang_mesh_tpu.profiler import Profiler
+
+    def fake(self, func=None, warmup=3, rep=30, backend="loop",
+             input_tensors=None):
+        feats = features_from_kernel(self.kernel)
+        assert feats is not None, "measured kernel must carry features"
+        return float(lat_of(feats))
+
+    monkeypatch.setattr(Profiler, "do_bench", fake)
+
+
+# ---------------------------------------------------------------------------
+# feature extraction
+# ---------------------------------------------------------------------------
+
+class TestFeatures:
+    def test_extraction_deterministic(self):
+        from tilelang_mesh_tpu.engine.lower import lower
+        fac = _make_factory()
+        pf = fac(64, 128, block_M=32).prim_func
+        f1 = lower(pf, target="cpu").attrs["features"]
+        f2 = lower(pf, target="cpu").attrs["features"]
+        assert f1 == f2
+        assert json.dumps(f1, sort_keys=True) == \
+            json.dumps(f2, sort_keys=True)
+
+    def test_copy_kernel_features(self):
+        from tilelang_mesh_tpu.transform.plan import FEATURES_VERSION
+        fac = _make_factory()
+        feats = fac(128, 128, block_M=32).artifact.attrs["features"]
+        assert feats["version"] == FEATURES_VERSION
+        assert feats["flops"] == 0
+        assert feats["hbm_bytes"] >= 2 * 128 * 128 * 4   # A in + B out
+        assert feats["grid_steps"] == 4                  # 128 / 32
+        assert feats["block_rows"] == 32
+        assert feats["block_cols"] == 128
+        assert feats["dbuf_chains"] == 0
+
+    def test_gemm_flops_exact(self):
+        from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+        k = matmul_kernel(128, 128, 128, block_M=64, block_N=64,
+                          block_K=64, in_dtype="float32",
+                          out_dtype="float32")
+        feats = k.artifact.attrs["features"]
+        assert feats["flops"] == 2 * 128 * 128 * 128
+        # the dispatch grid (incl. a grid-mapped pipelined axis) is what
+        # the artifact reports
+        assert feats["grid_steps"] == int(np.prod(k.artifact.grid))
+        assert feats["hbm_bytes"] > 0
+        assert feats["vmem_block_bytes"] > 0
+
+    def test_features_survive_disk_cache(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kern"))
+        from tilelang_mesh_tpu.cache.kernel_cache import KernelCache
+        fac = _make_factory()
+        k1 = fac(64, 128, block_M=32)
+        feats = k1.artifact.attrs["features"]
+        # drop the memory tier: the next build loads the disk artifact
+        KernelCache().clear()
+        from tilelang_mesh_tpu.jit import clear_factory_caches
+        clear_factory_caches()
+        k2 = _make_factory()(64, 128, block_M=32)
+        assert k2.artifact.attrs["features"] == feats
+
+
+# ---------------------------------------------------------------------------
+# analytic model + ridge residual
+# ---------------------------------------------------------------------------
+
+def _feats(**over):
+    from tilelang_mesh_tpu.transform.plan import FEATURES_VERSION
+    base = {"version": FEATURES_VERSION, "flops": 1 << 30,
+            "hbm_bytes": 1 << 24, "vpu_elems": 0, "grid_steps": 16,
+            "vmem_arena": 1 << 20, "vmem_block_bytes": 1 << 18,
+            "n_scratch": 2, "n_params": 3, "pipelined": 1,
+            "block_rows": 128, "block_cols": 128, "block_skew": 1.0,
+            "dbuf_chains": 0}
+    base.update(over)
+    return base
+
+
+class TestCostModel:
+    def test_analytic_monotone_in_flops(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+        assert analytic_ms(_feats(flops=1 << 34)) > \
+            analytic_ms(_feats(flops=1 << 30)) > 0
+
+    def test_analytic_overlap_discount(self):
+        # a kernel with neither a pipelined grid axis nor a dbuf chain
+        # pays the serialization penalty
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+        f_serial = _feats(pipelined=0, dbuf_chains=0,
+                          flops=1 << 32, hbm_bytes=1 << 28)
+        f_dbuf = _feats(pipelined=0, dbuf_chains=1,
+                        flops=1 << 32, hbm_bytes=1 << 28)
+        assert analytic_ms(f_serial) > analytic_ms(f_dbuf)
+
+    def test_ridge_fit_round_trip(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import (CostModel,
+                                                            analytic_ms)
+        samples = [(_feats(flops=1 << (28 + i), grid_steps=1 << i), None)
+                   for i in range(6)]
+        samples = [(f, analytic_ms(f) * 2.5) for f, _ in samples]
+        m = CostModel(min_fit=4)
+        assert m.seed(samples) == 6
+        assert m.fitted
+        for f, lat in samples:
+            assert m.predict_ms(f) == pytest.approx(lat, rel=0.1)
+        # refitting the same data in a second model is bit-deterministic
+        m2 = CostModel(min_fit=4)
+        m2.seed(samples)
+        assert m2.predict_ms(samples[0][0]) == \
+            m.predict_ms(samples[0][0])
+
+    def test_cold_below_min_fit(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import (CostModel,
+                                                            analytic_ms)
+        m = CostModel(min_fit=4)
+        for i in range(3):
+            m.observe(_feats(flops=1 << (28 + i)), 1.0 + i)
+        assert not m.fitted
+        assert m.confidence_band() is None
+        f = _feats()
+        assert m.predict_ms(f) == analytic_ms(f, m.arch)
+
+    def test_rejects_mismatched_feature_version(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import CostModel
+        m = CostModel(min_fit=1)
+        assert not m.observe(_feats(version=99), 1.0)
+        assert not m.observe(None, 1.0)
+        assert not m.observe(_feats(), 0.0)
+
+    def test_rank_agreement(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import rank_agreement
+        assert rank_agreement([(1, 10), (2, 20), (3, 30)]) == 1.0
+        assert rank_agreement([(1, 30), (2, 20), (3, 10)]) == 0.0
+        assert rank_agreement([(1, 10)]) is None
+        # measured values within the noise tolerance count as ties, not
+        # discordance (the top-K configs are near-ties by construction)
+        assert rank_agreement([(1.0, 10.0), (2.0, 9.8)]) == 0.5
+
+
+# ---------------------------------------------------------------------------
+# model-guided sweeps
+# ---------------------------------------------------------------------------
+
+CFGS = [{"block_M": b} for b in (16, 32, 64, 128)]
+
+
+class TestGuidedSweep:
+    def test_cold_model_runs_full_sweep(self):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 128)
+        assert res.trials_measured == len(CFGS)
+        assert res.trials_pruned == 0
+        assert not res.from_cache
+        # the cold sweep seeded the fleet cache
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        assert TuneCache().stats()["entries"] == 1
+
+    def test_warm_model_prunes_and_keeps_true_best(self, monkeypatch):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+        # deterministic "hardware": latency = 3x the analytic roofline,
+        # so the fitted residual is exactly learnable and the true best
+        # config is the analytic best
+        _fake_bench(monkeypatch, lambda f: analytic_ms(f) * 3.0)
+        seed = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 128)
+        assert seed.trials_measured == len(CFGS)   # cold: full sweep
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 256)                              # sibling shape bucket
+        assert res.trials_measured < len(CFGS)
+        assert res.trials_pruned >= 1
+        assert res.trials_measured + res.trials_pruned == len(CFGS)
+        # the model-guided sweep still chose the true best config
+        fac = _make_factory()
+        best = min(CFGS, key=lambda c: analytic_ms(
+            fac(128, 256, **c).artifact.attrs["features"]))
+        assert res.config == best
+        assert res.model_agreement is None or res.model_agreement >= 0.5
+
+    def test_disagreement_falls_back_to_full_sweep(self, monkeypatch):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+
+        # seed bucket: latency grows steeply with the block window,
+        # teaching the model "small blocks win" (analytic-relative so
+        # the learned correction stays inside the clamp)
+        _fake_bench(monkeypatch,
+                    lambda f: analytic_ms(f) * (f["block_rows"] / 16) ** 2)
+        seed = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 128)
+        assert seed.trials_measured == len(CFGS)
+        # target bucket: the "hardware" inverts — big blocks win. The
+        # model's ranking disagrees with what it measures, so the sweep
+        # must fall back to measuring EVERYTHING and still find the
+        # true winner.
+        _fake_bench(monkeypatch,
+                    lambda f: analytic_ms(f) * (128 / f["block_rows"]) ** 2)
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 256)
+        assert res.trials_measured == len(CFGS)
+        assert res.trials_pruned == 0
+        assert res.config == {"block_M": 128}
+        assert get_tracer().counters().get(
+            "autotune.model_fallback", 0) >= 1
+
+    def test_early_stop_skips_hopeless_tail(self, monkeypatch):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+        # widen the measured fraction so the early-stop rule (not the
+        # top-K cut) is what trims the sweep; no exploration tail
+        monkeypatch.setenv("TL_TPU_TUNE_TOPK", "1.0")
+        monkeypatch.setenv("TL_TPU_TUNE_EPS", "0")
+        # latency grows quadratically with the block window: steep and
+        # learnable, so after 3 measurements every remaining prediction
+        # sits far outside the confidence band of the best
+        _fake_bench(monkeypatch,
+                    lambda f: analytic_ms(f) * (f["block_rows"] / 16) ** 2)
+        seed = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 128)
+        assert seed.trials_measured == len(CFGS)
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 256)
+        assert res.config == {"block_M": 16}
+        assert res.trials_measured == 3          # early stop after 3
+        assert res.trials_pruned == 1
+
+    def test_bruteforce_bypasses_model_and_cache(self, monkeypatch):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        # warm fleet cache first (model mode)
+        AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(128, 128)
+        monkeypatch.setenv("TL_TPU_TUNE", "bruteforce")
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1,
+                        cache_results=False).run(128, 128)
+        # pre-model behavior: every config measured, no warm start, no
+        # model fields in the capture
+        assert not res.from_cache
+        assert res.trials_measured == len(CFGS)
+        assert res.trials_pruned == 0
+        assert res.model_agreement is None
+        assert len(res.all_results) == len(CFGS)
+        for rec in res.all_results:
+            assert "predicted_ms" not in rec
+            assert "pruned" not in rec
+            assert "from_tune_cache" not in rec
+
+    def test_tune_mode_typo_raises(self, monkeypatch):
+        from tilelang_mesh_tpu.autotuner import AutoTuner, tune_mode
+        monkeypatch.setenv("TL_TPU_TUNE", "banana")
+        with pytest.raises(ValueError, match="TL_TPU_TUNE"):
+            tune_mode()
+        with pytest.raises(ValueError, match="TL_TPU_TUNE"):
+            AutoTuner(_make_factory(), CFGS, warmup=1,
+                      rep=1).run(128, 128)
+
+    def test_fleet_warm_start_measures_nothing(self):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        first = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(
+            128, 128)
+        assert first.trials_measured == len(CFGS)
+        # a fresh tuner with the LEGACY result cache bypassed: only the
+        # fleet tune cache can explain a zero-measurement warm start
+        res = AutoTuner(_make_factory(), CFGS, warmup=1, rep=1,
+                        cache_results=False).run(128, 128)
+        assert res.from_cache
+        assert res.trials_measured == 0
+        assert res.config == first.config
+        assert all(r.get("from_tune_cache") for r in res.all_results)
+
+
+# ---------------------------------------------------------------------------
+# journal resume hardening (the stale-record bugfix)
+# ---------------------------------------------------------------------------
+
+class TestJournalStaleness:
+    def _journal_for(self, tuner, args, configs):
+        key = tuner._disk_key(args, {}, configs)
+        return env.autotune_dir() / f"{key}.journal.jsonl"
+
+    def test_journal_skips_stale_codegen(self):
+        """A journal record measured under an older CODEGEN_VERSION must
+        NOT be resumed — the kernel it timed no longer exists. It is
+        skipped with a traced warning and the config re-measures."""
+        from tilelang_mesh_tpu.autotuner import (AutoTuner, _JOURNAL_SCHEMA,
+                                                 _config_key)
+        configs = [{"block_M": 32}, {"block_M": 64}]
+        tuner = AutoTuner(_make_factory(), configs, warmup=1, rep=1)
+        journal = self._journal_for(tuner, (128, 128), configs)
+        journal.parent.mkdir(parents=True, exist_ok=True)
+        journal.write_text(json.dumps(
+            {"config_key": _config_key(configs[0]), "status": "ok",
+             "latency_ms": 0.00001, "schema": _JOURNAL_SCHEMA,
+             "codegen_version": 1}) + "\n")
+        before = get_tracer().counters().get("autotune.journal.stale", 0)
+        res = tuner.run(128, 128)
+        assert res.trials_measured == 2          # both re-measured
+        assert res.latency_ms != 0.00001
+        assert not any(r.get("resumed") for r in res.all_results)
+        assert get_tracer().counters()["autotune.journal.stale"] == \
+            before + 1
+
+    def test_journal_skips_old_schema_records(self):
+        """Pre-stamp records (no schema/codegen fields at all — the old
+        config-key schema) are stale by definition."""
+        from tilelang_mesh_tpu.autotuner import AutoTuner, _config_key
+        configs = [{"block_M": 32}, {"block_M": 64}]
+        tuner = AutoTuner(_make_factory(), configs, warmup=1, rep=1)
+        journal = self._journal_for(tuner, (128, 128), configs)
+        journal.parent.mkdir(parents=True, exist_ok=True)
+        journal.write_text(
+            json.dumps({"config_key": _config_key(configs[0]),
+                        "status": "ok", "latency_ms": 0.00001}) + "\n"
+            + json.dumps({"not_a": "journal record"}) + "\n")
+        res = tuner.run(128, 128)
+        assert res.trials_measured == 2
+        assert res.latency_ms != 0.00001
+
+    def test_current_records_still_resume(self):
+        from tilelang_mesh_tpu.autotuner import (AutoTuner, _JOURNAL_SCHEMA,
+                                                 _config_key)
+        from tilelang_mesh_tpu.cache.kernel_cache import CODEGEN_VERSION
+        configs = [{"block_M": 32}, {"block_M": 64}]
+        tuner = AutoTuner(_make_factory(), configs, warmup=1, rep=1)
+        journal = self._journal_for(tuner, (128, 128), configs)
+        journal.parent.mkdir(parents=True, exist_ok=True)
+        journal.write_text(json.dumps(
+            {"config_key": _config_key(configs[0]), "status": "ok",
+             "latency_ms": 0.00001, "schema": _JOURNAL_SCHEMA,
+             "codegen_version": CODEGEN_VERSION}) + "\n")
+        res = tuner.run(128, 128)
+        assert res.trials_measured == 1          # one resumed, one run
+        assert res.config == configs[0]
+        assert res.latency_ms == 0.00001
+
+
+# ---------------------------------------------------------------------------
+# tune cache: crash safety + merge
+# ---------------------------------------------------------------------------
+
+def _payload(cfg, lat, source="src", bucket="b", arch="tpu_v5e", **over):
+    p = {"source_sha": source, "shape_bucket": bucket, "arch": arch,
+         "pass_cfg": {}, "factory": "f", "best_config": cfg,
+         "best_latency_ms": lat,
+         "trials": [{"config": cfg, "latency_ms": lat}], "merges": 0}
+    p.update(over)
+    return p
+
+
+class TestTuneCache:
+    def test_put_get_round_trip(self, tmp_path):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        c = TuneCache(tmp_path / "tc")
+        key = TuneCache.key("s", "b", "tpu_v5e", {})
+        c.put(key, _payload({"block_M": 32}, 1.5))
+        ent = c.get(key)
+        assert ent["best_config"] == {"block_M": 32}
+        assert ent["best_latency_ms"] == 1.5
+        assert ent["schema"] == 1
+        assert "checksum" in ent
+
+    def test_key_covers_identity(self):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        base = TuneCache.key("s", "b", "tpu_v5e", {})
+        assert TuneCache.key("s2", "b", "tpu_v5e", {}) != base
+        assert TuneCache.key("s", "b2", "tpu_v5e", {}) != base
+        assert TuneCache.key("s", "b", "tpu_v6e", {}) != base
+        assert TuneCache.key("s", "b", "tpu_v5e",
+                             {"tl.tpu.tile_opt": "0"}) != base
+
+    def test_corruption_quarantined(self, tmp_path):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        c = TuneCache(tmp_path / "tc")
+        key = TuneCache.key("s", "b", "a", {})
+        c.put(key, _payload({"block_M": 32}, 1.5))
+        p = c._path(key)
+        # flip a payload byte: the checksum must catch it
+        p.write_text(p.read_text().replace('"block_M": 32',
+                                           '"block_M": 64'))
+        before = get_tracer().counters().get("tune.cache.quarantined", 0)
+        assert c.get(key) is None
+        assert not p.exists()
+        qdir = c.root / ".quarantine"
+        assert len(list(qdir.glob("*"))) == 1
+        assert get_tracer().counters()["tune.cache.quarantined"] == \
+            before + 1
+
+    def test_torn_json_quarantined(self, tmp_path):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        c = TuneCache(tmp_path / "tc")
+        key = TuneCache.key("s", "b", "a", {})
+        c.put(key, _payload({"block_M": 32}, 1.5))
+        p = c._path(key)
+        p.write_text(p.read_text()[: len(p.read_text()) // 2])
+        assert c.get(key) is None
+        assert not p.exists()
+
+    def test_merge_payloads_best_wins(self):
+        from tilelang_mesh_tpu.autotuner.tune_cache import merge_payloads
+        a = _payload({"block_M": 32}, 2.0)
+        b = _payload({"block_M": 64}, 1.0)
+        m = merge_payloads(a, b)
+        assert m["best_config"] == {"block_M": 64}
+        assert m["best_latency_ms"] == 1.0
+        assert len(m["trials"]) == 2
+        assert m["merges"] == 1
+        # commutative best/trials (the merge-counter provenance differs
+        # by construction, never the tuning payload)
+        m2 = merge_payloads(b, a)
+        assert m2["best_config"] == m["best_config"]
+        assert {json.dumps(t, sort_keys=True) for t in m2["trials"]} == \
+            {json.dumps(t, sort_keys=True) for t in m["trials"]}
+
+    def test_merge_same_config_keeps_lower_latency(self):
+        from tilelang_mesh_tpu.autotuner.tune_cache import merge_payloads
+        a = _payload({"block_M": 32}, 2.0)
+        b = _payload({"block_M": 32}, 1.2)
+        m = merge_payloads(a, b)
+        assert len(m["trials"]) == 1
+        assert m["best_latency_ms"] == 1.2
+
+    def test_merge_identical_is_fixed_point(self):
+        """Re-merging identical payloads must converge, merge counter
+        included — a cron'd `tune_cache merge` of the same dirs would
+        otherwise rewrite every entry forever."""
+        from tilelang_mesh_tpu.autotuner.tune_cache import merge_payloads
+        a = _payload({"block_M": 32}, 2.0, merges=1)
+        m = merge_payloads(a, a)
+        assert m == {k: v for k, v in a.items() if k != "checksum"}
+        assert merge_payloads(m, m) == m
+
+    def test_merge_from_dirs(self, tmp_path):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        src1 = TuneCache(tmp_path / "s1")
+        src2 = TuneCache(tmp_path / "s2")
+        dst = TuneCache(tmp_path / "dst")
+        k1 = TuneCache.key("s", "b1", "a", {})
+        k2 = TuneCache.key("s", "b2", "a", {})
+        src1.put(k1, _payload({"block_M": 32}, 2.0, bucket="b1"))
+        src2.put(k1, _payload({"block_M": 64}, 1.0, bucket="b1"))
+        src2.put(k2, _payload({"block_M": 16}, 3.0, bucket="b2"))
+        # a torn file in a source is skipped, never imported
+        (src2.root / f"{'0' * 64}.json").write_text("{ torn")
+        stats = dst.merge_from([src1.root, src2.root])
+        assert stats["new"] == 2           # k1 from src1, k2 from src2
+        assert stats["merged"] == 1        # src2's better k1 merged in
+        assert stats["corrupt"] == 1
+        assert dst.get(k1)["best_latency_ms"] == 1.0
+        assert len(dst.get(k1)["trials"]) == 2
+        assert dst.get(k2)["best_config"] == {"block_M": 16}
+        # merging again is idempotent
+        stats2 = dst.merge_from([src1.root, src2.root])
+        assert stats2["new"] == 0 and stats2["merged"] == 0
+        assert stats2["unchanged"] == 3
+
+    def test_cli_merge_and_stats(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache, main
+        src = TuneCache(tmp_path / "src")
+        key = TuneCache.key("s", "b", "a", {})
+        src.put(key, _payload({"block_M": 32}, 1.5))
+        dst = tmp_path / "dst"
+        assert main(["merge", str(src.root), "--into", str(dst)]) == 0
+        out = capsys.readouterr().out
+        assert "1 new" in out
+        assert main(["stats", "--root", str(dst), "--json"]) == 0
+        stats = json.loads(capsys.readouterr().out)
+        assert stats["entries"] == 1 and stats["trials"] == 1
+        assert main(["list", "--root", str(dst)]) == 0
+        assert "block_M" in capsys.readouterr().out
+
+    def test_sweep_entry_merges_with_concurrent_writer(self):
+        """record() must union with an existing entry, not clobber it —
+        two processes finishing the same sweep both contribute trials."""
+        from tilelang_mesh_tpu.autotuner.tune_cache import TuneCache
+        c = TuneCache()
+        key = TuneCache.key("s", "b", "a", {})
+        c.record(key, _payload({"block_M": 32}, 2.0))
+        c.record(key, _payload({"block_M": 64}, 1.0))
+        ent = c.get(key)
+        assert len(ent["trials"]) == 2
+        assert ent["best_config"] == {"block_M": 64}
+
+
+# ---------------------------------------------------------------------------
+# serving warmup consumption
+# ---------------------------------------------------------------------------
+
+class TestServingWarmup:
+    def _workload(self):
+        from tilelang_mesh_tpu.serving.batcher import FlashDecodeWorkload
+        from tilelang_mesh_tpu.serving.kv_cache import PagedKVAllocator
+        alloc = PagedKVAllocator(n_pages=8, page_size=8, heads=2,
+                                 head_dim=16)
+        return FlashDecodeWorkload(alloc, batch_buckets=(1,),
+                                   page_buckets=(2,))
+
+    def test_warmup_adopts_fleet_tuned_config(self):
+        # an "offline sweep" publishes a tuned split for the bucket…
+        wl_pub = self._workload()
+        key = wl_pub.record_bucket_tuning(1, 2, {"n_split": 1}, 0.5)
+        assert key is not None
+        # …and a FRESH serving process adopts it at warmup with zero
+        # measurements (the zero-cold-start bucket-config path)
+        before = get_tracer().counters().get("serve.warmup.tuned", 0)
+        wl = self._workload()
+        assert wl.tuned_config(1, 2) == {}
+        warmed = wl.warmup()
+        assert warmed == 1
+        assert wl.tuned_config(1, 2) == {"n_split": 1}
+        assert get_tracer().counters()["serve.warmup.tuned"] == before + 1
+
+    def test_warmup_without_entry_is_untuned(self):
+        wl = self._workload()
+        wl.warmup()
+        assert wl.tuned_config(1, 2) == {}
+
+    def test_warmup_adopts_config_published_after_first_miss(self):
+        """A miss is not cached forever: a config merged into the fleet
+        cache AFTER the first warmup is adopted by the next one."""
+        wl = self._workload()
+        wl.warmup()
+        assert wl.tuned_config(1, 2) == {}
+        wl.record_bucket_tuning(1, 2, {"n_split": 2}, 0.4)
+        wl.warmup()
+        assert wl.tuned_config(1, 2) == {"n_split": 2}
+
+    def test_tuned_dispatch_matches_untuned_numerics(self):
+        """A fleet-tuned n_split changes the schedule, never the math."""
+        import numpy as _np
+        wl_plain = self._workload()
+        wl_plain.warmup()
+        q = _np.random.default_rng(7).standard_normal(
+            (1, 2, 1, 16)).astype(_np.float32)
+        table = _np.zeros((1, 2), _np.int32)
+        ref = _np.asarray(wl_plain._dispatch(q, table, 1, 2))
+        wl_tuned = self._workload()
+        wl_tuned.record_bucket_tuning(1, 2, {"n_split": 1}, 0.5)
+        wl_tuned.warmup()
+        out = _np.asarray(wl_tuned._dispatch(q, table, 1, 2))
+        _np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# analyzer + metrics surfacing
+# ---------------------------------------------------------------------------
+
+class TestSurfacing:
+    def test_analyzer_tune_report(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.autotuner import _JOURNAL_SCHEMA
+        from tilelang_mesh_tpu.cache.kernel_cache import CODEGEN_VERSION
+        from tilelang_mesh_tpu.tools.analyzer import main
+        stamp = {"schema": _JOURNAL_SCHEMA,
+                 "codegen_version": CODEGEN_VERSION}
+        j = tmp_path / "sweep.journal.jsonl"
+        j.write_text("\n".join(json.dumps(r) for r in [
+            {"config_key": '{"block_M": 32}', "status": "ok",
+             "latency_ms": 1.0, "predicted_ms": 1.1, **stamp},
+            {"config_key": '{"block_M": 64}', "status": "ok",
+             "latency_ms": 2.0, "predicted_ms": 2.4, **stamp},
+            {"config_key": '{"block_M": 128}', "status": "pruned",
+             "predicted_ms": 9.0, **stamp},
+            {"config_key": '{"block_M": 256}', "status": "failed",
+             "kind": "deterministic", **stamp},
+            # a transient failure later resolved by a resumed ok trial:
+            # the report must dedup by config (last record wins), like
+            # the tuner's own journal resume does
+            {"config_key": '{"block_M": 32}', "status": "ok",
+             "latency_ms": 0.9, "predicted_ms": 1.1, **stamp},
+        ]) + "\n")
+        assert main(["tune", str(j), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["trials"]["total"] == 4
+        assert rep["trials"]["measured"] == 3
+        assert rep["trials"]["pruned"] == 1
+        row32 = [r for r in rep["rows"]
+                 if r["config"] == '{"block_M": 32}']
+        assert len(row32) == 1 and row32[0]["latency_ms"] == 0.9
+        assert rep["model"]["rank_agreement"] == 1.0
+        assert main(["tune", str(j)]) == 0
+        text = capsys.readouterr().out
+        assert "pruned" in text and "rank agreement" in text
+
+    def test_metrics_summary_autotune_section(self):
+        from tilelang_mesh_tpu.autotuner import AutoTuner
+        from tilelang_mesh_tpu.observability import metrics_summary
+        AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(128, 128)
+        at = metrics_summary()["autotune"]
+        for k in ("trials_measured", "trials_pruned", "trials_resumed",
+                  "tune_cache_hits", "tune_cache_misses",
+                  "tune_cache_writes", "journal_stale_skipped",
+                  "model_cold_sweeps", "model_fallbacks",
+                  "model_rank_agreement"):
+            assert k in at
+        assert at["trials_measured"] >= len(CFGS)
+        assert at["tune_cache_writes"] >= 1
+
+    def test_sweep_records_predictions_in_journal(self, monkeypatch):
+        """Warm-model trials journal their predicted_ms so `analyzer
+        tune` can reconstruct the predicted-vs-measured table from an
+        interrupted sweep's journal."""
+        from tilelang_mesh_tpu.autotuner import AutoTuner, _append_journal
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_ms
+        _fake_bench(monkeypatch, lambda f: analytic_ms(f) * 3.0)
+        AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(128, 128)
+        recorded = []
+        monkeypatch.setattr(
+            "tilelang_mesh_tpu.autotuner._append_journal",
+            lambda path, rec: recorded.append(rec) or
+            _append_journal(path, rec))
+        AutoTuner(_make_factory(), CFGS, warmup=1, rep=1).run(128, 256)
+        assert any(r.get("predicted_ms") is not None for r in recorded)
+        assert any(r.get("status") == "pruned" for r in recorded)
+        assert any(r.get("features") for r in recorded
+                   if r.get("status") == "ok")

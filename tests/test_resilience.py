@@ -434,9 +434,15 @@ class TestAutotunerResilience:
         key = tuner._disk_key((128, 128), {}, configs)
         journal = env.autotune_dir() / f"{key}.journal.jsonl"
         # an interrupted sweep already measured block_M=32 at 0.001 ms
+        # (stamped with THIS build's schema/codegen — unstamped or
+        # mismatched records are deliberately skipped as stale, see
+        # test_cost_model.py::test_journal_skips_stale_codegen)
+        from tilelang_mesh_tpu.autotuner import _JOURNAL_SCHEMA
+        from tilelang_mesh_tpu.cache.kernel_cache import CODEGEN_VERSION
         journal.write_text(json.dumps(
             {"config_key": _config_key(configs[0]), "status": "ok",
-             "latency_ms": 0.001}) + "\n")
+             "latency_ms": 0.001, "schema": _JOURNAL_SCHEMA,
+             "codegen_version": CODEGEN_VERSION}) + "\n")
         res = tuner.run(128, 128)
         # the journaled config won without re-benchmarking; its kernel is
         # built once at the end (so 32 appears once, not warmup+rep times)
@@ -458,9 +464,13 @@ class TestAutotunerResilience:
                           cache_results=True)
         key = tuner._disk_key((128, 128), {}, configs)
         journal = env.autotune_dir() / f"{key}.journal.jsonl"
+        from tilelang_mesh_tpu.autotuner import _JOURNAL_SCHEMA
+        from tilelang_mesh_tpu.cache.kernel_cache import CODEGEN_VERSION
         journal.write_text(json.dumps(
             {"config_key": _config_key(configs[0]), "status": "failed",
-             "kind": "deterministic", "error": "TypeError: broken"}) + "\n")
+             "kind": "deterministic", "error": "TypeError: broken",
+             "schema": _JOURNAL_SCHEMA,
+             "codegen_version": CODEGEN_VERSION}) + "\n")
         res = tuner.run(128, 128)
         assert res.config == {"block_M": 64}
         assert 32 not in calls             # known-bad config never re-paid

@@ -1,4 +1,4 @@
-"""Autotuner: config grid search over jit kernel factories.
+"""Autotuner: config search over jit kernel factories.
 
 Reference: /root/reference/tilelang/autotuner/tuner.py (AutoTuner:100,
 autotune:685). Same surface:
@@ -12,6 +12,20 @@ Candidates compile on a thread pool; each is benchmarked with the in-graph
 profiler; failures are isolated per-config (the reference's timeout/
 ignore_error guard) and results persist to disk keyed by the factory source
 and args.
+
+Cost-model-guided pruning (docs/autotuning.md): under ``TL_TPU_TUNE=model``
+(the default) the sweep ranks the config space with the analytic+fitted
+cost model (autotuner/cost_model.py — compile-time roofline/footprint
+features, ridge residual fit on measured latencies) and measures only the
+predicted top-``TL_TPU_TUNE_TOPK`` fraction plus an epsilon exploration
+tail, early-stopping once nothing unmeasured can plausibly beat the best
+measured config. The model falls back to the full sweep whenever it is
+cold (too few samples) or its ranking disagrees with what measurement
+shows. Completed sweeps land in the content-addressed fleet tune cache
+(autotuner/tune_cache.py), so any process — this machine or a merged
+fleet member — warm-starts the same sweep with ZERO measurements.
+``TL_TPU_TUNE=bruteforce`` restores the pre-model behavior
+trial-for-trial.
 """
 
 from __future__ import annotations
@@ -22,10 +36,13 @@ import itertools
 import json
 import logging
 import inspect
+import math
 import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
 
 from ..env import env
 from ..observability import runtime as _runtime
@@ -39,6 +56,29 @@ from ..utils.tensor import TensorSupplyType
 logger = logging.getLogger("tilelang_mesh_tpu.autotune")
 
 
+def tune_mode() -> str:
+    """Resolved TL_TPU_TUNE mode: 'model' (cost-model-guided pruning +
+    fleet tune cache) or 'bruteforce' (pre-model behavior,
+    trial-for-trial). A typo raises instead of silently changing sweep
+    semantics — the same contract as TL_TPU_TILE_OPT / TL_TPU_LINT."""
+    raw = str(env.TL_TPU_TUNE).strip().lower()
+    if raw in ("model", "1", "on", ""):
+        return "model"
+    if raw in ("bruteforce", "brute", "0", "off"):
+        return "bruteforce"
+    raise ValueError(
+        f"TL_TPU_TUNE={raw!r}: expected 'model' or 'bruteforce'")
+
+
+# last-sweep model telemetry, surfaced via metrics_summary()["autotune"]
+_MODEL_STATE: Dict[str, Any] = {"rank_agreement": None}
+
+
+def tune_state() -> dict:
+    """Model telemetry of the most recent sweep in this process."""
+    return dict(_MODEL_STATE)
+
+
 @dataclass
 class AutotuneResult:
     config: Dict[str, Any]
@@ -48,6 +88,12 @@ class AutotuneResult:
     # candidate, so callers can inspect the whole search, not just the winner.
     all_results: List[Dict[str, Any]] = field(default_factory=list)
     from_cache: bool = False
+    # cost-model accounting (zeros/None under TL_TPU_TUNE=bruteforce):
+    # how many configs were actually measured vs pruned by the model's
+    # ranking, and the predicted-vs-measured pairwise rank agreement
+    trials_measured: int = 0
+    trials_pruned: int = 0
+    model_agreement: Optional[float] = None
 
 
 # Abandoned-worker accounting: a timed-out trial's daemon thread cannot be
@@ -124,7 +170,14 @@ def run_with_timeout(fn: Callable, timeout: Optional[float], *args, **kwargs):
 # -- sweep journal -----------------------------------------------------------
 # One JSONL line per finished trial, appended as it lands (append + flush:
 # a crash loses at most the in-flight trial). Keyed by the config's sorted
-# JSON so resume matching is insensitive to dict ordering.
+# JSON so resume matching is insensitive to dict ordering. Every record is
+# stamped with the journal schema AND the build's CODEGEN_VERSION: a
+# resumed sweep must never reuse trial latencies measured under an older
+# codegen (the kernels it timed no longer exist), so mismatched records
+# are skipped with a traced warning instead of silently trusted.
+
+_JOURNAL_SCHEMA = 2
+
 
 def _config_key(cfg: Dict[str, Any]) -> str:
     return json.dumps(cfg, sort_keys=True, default=str)
@@ -133,7 +186,9 @@ def _config_key(cfg: Dict[str, Any]) -> str:
 def _load_journal(path: Optional[Path]) -> Dict[str, dict]:
     if path is None or not path.exists():
         return {}
+    from ..cache.kernel_cache import CODEGEN_VERSION
     out: Dict[str, dict] = {}
+    stale = 0
     try:
         for line in path.read_text().splitlines():
             line = line.strip()
@@ -143,9 +198,30 @@ def _load_journal(path: Optional[Path]) -> Dict[str, dict]:
                 rec = json.loads(line)
             except ValueError:
                 continue   # torn final line from an interrupted run
+            if not isinstance(rec, dict) or \
+                    not isinstance(rec.get("config_key"), str) or \
+                    "status" not in rec:
+                stale += 1   # config-key schema mismatch (older build)
+                continue
+            if rec.get("schema") != _JOURNAL_SCHEMA or \
+                    rec.get("codegen_version") != CODEGEN_VERSION:
+                stale += 1   # measured under a different codegen
+                continue
+            if rec["status"] == "pruned":
+                # pruning is a per-sweep model decision, never resumed —
+                # the record exists for the `analyzer tune` report
+                continue
             out[rec["config_key"]] = rec
     except OSError:
         return {}
+    if stale:
+        logger.warning(
+            "autotune: journal %s: skipped %d stale record(s) whose "
+            "CODEGEN_VERSION/schema does not match this build — those "
+            "configs will re-measure", path.name, stale)
+        _trace.inc("autotune.journal.stale", stale)
+        _trace.event("autotune.journal_stale", "autotune",
+                     journal=path.name, skipped=stale)
     if out:
         logger.info("autotune: resuming sweep from journal %s "
                     "(%d trial(s) already done)", path.name, len(out))
@@ -155,6 +231,9 @@ def _load_journal(path: Optional[Path]) -> Dict[str, dict]:
 def _append_journal(path: Optional[Path], rec: dict) -> None:
     if path is None:
         return
+    from ..cache.kernel_cache import CODEGEN_VERSION
+    rec = {**rec, "schema": _JOURNAL_SCHEMA,
+           "codegen_version": CODEGEN_VERSION}
     try:
         with path.open("a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -286,7 +365,80 @@ class AutoTuner:
                             default=str).encode())
         return h.hexdigest()
 
+    # -- fleet tune cache (tune_cache.py; docs/autotuning.md) ----------
+    def _source_sha(self) -> Optional[str]:
+        """sha256 of the factory's source — the kernel-identity half of
+        the tune-cache key. None (no fleet tier) for sourceless
+        callables (REPL lambdas, C extensions)."""
+        try:
+            src = inspect.getsource(getattr(self.fn, "fn", self.fn))
+        except (OSError, TypeError):
+            return None
+        return hashlib.sha256(src.encode()).hexdigest()
+
+    def _shape_bucket(self, args, kwargs) -> str:
+        """Canonical shape-bucket token: the call-site args plus the
+        config-space spec, so an entry can only satisfy a sweep over the
+        same problem AND the same candidate space."""
+        if self.configs is not None:
+            space = json.dumps(self.configs, sort_keys=True, default=str)
+        elif self.template is None:
+            space = json.dumps({"mode": "ir-derived", "topk": self.topk})
+        else:
+            space = json.dumps({"mode": "template", "topk": self.topk})
+        return json.dumps({"args": repr(args),
+                           "kwargs": repr(sorted(kwargs.items())),
+                           "space": space}, sort_keys=True)
+
+    def _tune_key(self, args, kwargs) -> Optional[str]:
+        src = self._source_sha()
+        if src is None:
+            return None
+        from ..carver.arch import auto_arch
+        from ..transform.pass_config import current_pass_config
+        from .tune_cache import TuneCache
+        return TuneCache.key(src, self._shape_bucket(args, kwargs),
+                             auto_arch().name,
+                             dict(current_pass_config()))
+
+    def _usable_entry_config(self, ent, args, kwargs) -> Optional[dict]:
+        """The entry's best config iff it can actually parameterize THIS
+        factory at THIS call site (keys are unbound tunables)."""
+        if not isinstance(ent, dict):
+            return None
+        cfg = ent.get("best_config")
+        if not isinstance(cfg, dict) or not cfg or \
+                ent.get("best_latency_ms") is None:
+            return None
+        names = self._tunable_names() - self._bound_names(args, kwargs)
+        if not set(cfg) <= names:
+            return None
+        return cfg
+
+    def _extract_features(self, configs, args,
+                          kwargs) -> Dict[int, Optional[dict]]:
+        """Compile-time cost features per candidate WITHOUT measuring:
+        each config's kernel is built (through the jit + artifact
+        caches, so the measured trial reuses the identical build) and
+        its ``attrs["features"]`` read. A config whose build fails is
+        unrankable (None) and always measured — the ordinary trial path
+        then classifies and journals the failure."""
+        from .cost_model import features_from_kernel
+        out: Dict[int, Optional[dict]] = {}
+        with _trace.span("autotune.features", "autotune",
+                         n_configs=len(configs)):
+            for i, cfg in enumerate(configs):
+                try:
+                    k = run_with_timeout(
+                        lambda c=cfg: self.fn(*args, **{**kwargs, **c}),
+                        self.timeout)
+                    out[i] = features_from_kernel(k)
+                except Exception:  # noqa: BLE001 — trial path reports it
+                    out[i] = None
+        return out
+
     def run(self, *args, **kwargs) -> AutotuneResult:
+        mode = tune_mode()
         derive = self.configs is None and self.template is None
         if derive:
             # key the cache on the MODE + ARCH, not the candidate list,
@@ -319,6 +471,41 @@ class AutoTuner:
             except Exception:
                 pass
             _trace.inc("autotune.cache.miss")
+
+        factory = getattr(self.fn, "__name__", "?")
+        # Fleet tune cache (content-addressed, mergeable): a completed
+        # sweep for this exact (source, shape bucket, arch, pass config,
+        # CODEGEN_VERSION) — ours from an earlier process, or another
+        # fleet member's via `tune_cache merge` — is a ZERO-measurement
+        # warm start. bruteforce mode never consults it (pre-model
+        # behavior, trial-for-trial).
+        tcache = None
+        tune_key = None
+        if mode == "model":
+            from .tune_cache import TuneCache
+            tcache = TuneCache()
+            tune_key = self._tune_key(args, kwargs)
+            if tune_key is not None:
+                ent = tcache.get(tune_key)
+                best_cfg = self._usable_entry_config(ent, args, kwargs)
+                if best_cfg is not None:
+                    kernel = self.fn(*args, **{**kwargs, **best_cfg})
+                    _trace.inc("tune.cache.hit")
+                    _trace.event("tune.cache.hit", "autotune",
+                                 factory=factory, key=tune_key,
+                                 config=_config_key(best_cfg))
+                    logger.info(
+                        "autotune: fleet tune cache warm start for %s "
+                        "(%s, %.4f ms) — zero trials measured", factory,
+                        best_cfg, ent["best_latency_ms"])
+                    return AutotuneResult(
+                        best_cfg, ent["best_latency_ms"], kernel,
+                        [{"config": t.get("config"),
+                          "latency_ms": t.get("latency_ms"),
+                          "from_tune_cache": True}
+                         for t in ent.get("trials") or []],
+                        from_cache=True)
+                _trace.inc("tune.cache.miss")
         if configs is None:
             configs = self._derive_configs(args, kwargs)
 
@@ -335,115 +522,253 @@ class AutoTuner:
         best: Optional[AutotuneResult] = None
         captured: List[Dict[str, Any]] = []
         n = len(configs)
-        factory = getattr(self.fn, "__name__", "?")
+
+        # -- cost model: seed from the fleet cache + resumed journal ---
+        model = None
+        if mode == "model":
+            from .cost_model import CostModel, features_from_kernel, \
+                rank_agreement
+            model = CostModel()
+            src_sha = self._source_sha()
+            if tcache is not None and src_sha is not None:
+                model.seed(tcache.samples(src_sha, model.arch.name))
+            for rec in prior.values():
+                if rec.get("status") == "ok":
+                    model.observe(rec.get("features"),
+                                  rec.get("latency_ms"), refit=False)
+            model.fit()
+
+        # -- sweep plan: what to measure, in what order ----------------
+        # bruteforce / cold model: every config, in config order (the
+        # pre-model behavior). Warm model: predicted-rank order, top-K
+        # fraction + epsilon exploration tail; the rest is pruned.
+        predicted: Dict[int, float] = {}
+        measure_order = list(range(n))
+        pruned: List[int] = []
+        protected: set = set()     # epsilon tail: exploration, never
+        #                            early-stopped out of the sweep
+        if model is not None and model.fitted and n > 1:
+            feats_pre = self._extract_features(configs, args, kwargs)
+            rankable = [i for i in range(n) if feats_pre.get(i)]
+            for i in rankable:
+                predicted[i] = model.predict_ms(feats_pre[i])
+            if len(rankable) == n:
+                topk = min(max(float(env.TL_TPU_TUNE_TOPK), 0.0), 1.0)
+                eps = min(max(float(env.TL_TPU_TUNE_EPS), 0.0), 1.0)
+                ranked = sorted(range(n),
+                                key=lambda i: (predicted[i], i))
+                k = max(1, math.ceil(topk * n))
+                chosen = list(ranked[:k])
+                rest = ranked[k:]
+                eps_n = min(len(rest), math.ceil(eps * n)) if eps else 0
+                if eps_n:
+                    # seeded by the sweep's own disk key: deterministic
+                    # per sweep, different across sweeps
+                    rng = np.random.default_rng(int(key[:12], 16))
+                    picks = sorted(rng.choice(len(rest), size=eps_n,
+                                              replace=False).tolist())
+                    tail = [rest[j] for j in picks]
+                    chosen += tail
+                    protected |= set(tail)
+                measure_order = chosen
+                in_chosen = set(chosen)
+                pruned = [i for i in ranked if i not in in_chosen]
+                _trace.event("autotune.model_prune", "autotune",
+                             factory=factory, n_configs=n,
+                             selected=len(chosen), pruned=len(pruned),
+                             samples=model.n_samples)
+            else:
+                _trace.event("autotune.model_unrankable", "autotune",
+                             factory=factory,
+                             unrankable=n - len(rankable))
+        elif model is not None and n > 1:
+            _trace.inc("autotune.model_cold")
+            _trace.event("autotune.model_cold", "autotune",
+                         factory=factory, samples=model.n_samples)
+
+        measured_ms: Dict[int, float] = {}
+        measured_feats: Dict[int, Optional[dict]] = {}
+        stats = {"measured": 0}     # trials actually run (ok OR failed)
         # consecutive-identical-failure streak: once the breaker is open
         # for the signature every recent trial died with, the failure is
         # systematic (a codegen bug, not a bad tile) and remaining
         # configs fast-fail instead of each burning a full timeout budget
-        streak_sig: Optional[str] = None
-        streak_len = 0
-        with _trace.span("autotune.run", "autotune", factory=factory,
-                         n_configs=n, resumed_trials=len(prior)) as run_sp:
-            for i, cfg in enumerate(configs):
-                ck = _config_key(cfg)
-                prev = prior.get(ck)
-                if streak_sig is not None and \
-                        streak_len >= breaker.threshold and \
-                        breaker.is_open(streak_sig):
-                    _trace.inc("autotune.breaker_skips")
-                    _trace.inc("autotune.trials", outcome="breaker_skipped")
-                    _trace.event("autotune.breaker_skip", "autotune",
-                                 factory=factory, config=ck,
-                                 signature=streak_sig)
+        streak: Dict[str, Any] = {"sig": None, "len": 0}
+
+        def measure(i: int, cfg: Dict[str, Any]) -> None:
+            nonlocal best
+            ck = _config_key(cfg)
+            prev = prior.get(ck)
+            if streak["sig"] is not None and \
+                    streak["len"] >= breaker.threshold and \
+                    breaker.is_open(streak["sig"]):
+                _trace.inc("autotune.breaker_skips")
+                _trace.inc("autotune.trials", outcome="breaker_skipped")
+                _trace.event("autotune.breaker_skip", "autotune",
+                             factory=factory, config=ck,
+                             signature=streak["sig"])
+                captured.append({"config": cfg, "latency_ms": None,
+                                 "error": streak["sig"],
+                                 "skipped": "circuit breaker open"})
+                # journaled WITHOUT kind=deterministic: a resumed
+                # sweep gives breaker-skipped configs a fresh chance
+                _append_journal(journal_f, {
+                    "config_key": ck, "status": "failed",
+                    "kind": "breaker_skipped", "error": streak["sig"]})
+                return
+            if prev is not None and prev.get("status") == "ok":
+                lat = prev["latency_ms"]
+                _trace.inc("autotune.trials", outcome="resumed")
+                captured.append({"config": cfg, "latency_ms": lat,
+                                 "resumed": True})
+                if best is None or lat < best.latency_ms:
+                    best = AutotuneResult(cfg, lat, None)
+                return
+            if prev is not None and prev.get("kind") == "deterministic":
+                # retrying cannot fix it; the journal remembers so a
+                # resumed sweep never re-pays for a known-bad config
+                _trace.inc("autotune.trials", outcome="skipped")
+                captured.append({"config": cfg, "latency_ms": None,
+                                 "error": prev.get("error"),
+                                 "skipped": "journaled deterministic "
+                                            "failure"})
+                return
+            stats["measured"] += 1
+            with _trace.span("autotune.trial", "autotune",
+                             factory=factory, config=cfg) as sp:
+                attempts = [0]
+
+                def _one():
+                    attempts[0] += 1
+                    _faults.maybe_fail("autotune.trial", config=ck)
+                    kernel = self.fn(*args, **{**kwargs, **cfg})
+                    prof = Profiler(kernel, self.supply_type)
+                    return kernel, prof.do_bench(warmup=self.warmup,
+                                                 rep=self.rep)
+                try:
+                    kernel, lat = retry_call(
+                        lambda: run_with_timeout(_one, self.timeout),
+                        site="autotune.trial", policy=policy,
+                        breaker=breaker)
+                except Exception as e:  # config isolation (tuner.py:51)
+                    kind = classify(e)
+                    sig = error_signature(e)
+                    err = f"{type(e).__name__}: {e}"
+                    logger.debug("autotune config %s failed (%s): %s",
+                                 cfg, kind, e)
+                    sp.set(outcome="failed", kind=kind, error=err,
+                           attempts=attempts[0])
+                    _trace.inc("autotune.trials", outcome="failed")
+                    if sig == streak["sig"]:
+                        streak["len"] += 1
+                    else:
+                        streak["sig"], streak["len"] = sig, 1
                     captured.append({"config": cfg, "latency_ms": None,
-                                     "error": streak_sig,
-                                     "skipped": "circuit breaker open"})
-                    # journaled WITHOUT kind=deterministic: a resumed
-                    # sweep gives breaker-skipped configs a fresh chance
+                                     "error": err, "kind": kind,
+                                     "attempts": attempts[0]})
                     _append_journal(journal_f, {
                         "config_key": ck, "status": "failed",
-                        "kind": "breaker_skipped", "error": streak_sig})
-                    continue
-                if prev is not None and prev.get("status") == "ok":
-                    lat = prev["latency_ms"]
-                    _trace.inc("autotune.trials", outcome="resumed")
-                    captured.append({"config": cfg, "latency_ms": lat,
-                                     "resumed": True})
-                    if best is None or lat < best.latency_ms:
-                        best = AutotuneResult(cfg, lat, None)
-                    continue
-                if prev is not None and prev.get("kind") == "deterministic":
-                    # retrying cannot fix it; the journal remembers so a
-                    # resumed sweep never re-pays for a known-bad config
-                    _trace.inc("autotune.trials", outcome="skipped")
-                    captured.append({"config": cfg, "latency_ms": None,
-                                     "error": prev.get("error"),
-                                     "skipped": "journaled deterministic "
-                                                "failure"})
-                    continue
-                with _trace.span("autotune.trial", "autotune",
-                                 factory=factory, config=cfg) as sp:
-                    attempts = [0]
+                        "kind": kind, "error": err,
+                        "attempts": attempts[0]})
+                    return
+                sp.set(outcome="ok", latency_ms=lat,
+                       attempts=attempts[0])
+                _trace.inc("autotune.trials", outcome="ok")
+                # trial medians feed the SAME per-kernel latency
+                # histograms as runtime dispatch recording, so the
+                # sweep's distribution shows up in
+                # metrics_summary()["runtime"] / Prometheus
+                _runtime.record(
+                    getattr(getattr(kernel, "artifact", None), "name",
+                            factory),
+                    lat / 1e3, source="autotune")
+                streak["sig"], streak["len"] = None, 0
+            logger.info("autotune [%d/%d] %s -> %.4f ms",
+                        i + 1, n, cfg, lat)
+            rec: Dict[str, Any] = {"config": cfg, "latency_ms": lat}
+            jrec: Dict[str, Any] = {"config_key": ck, "status": "ok",
+                                    "latency_ms": lat}
+            if model is not None:
+                feats = features_from_kernel(kernel)
+                measured_ms[i] = lat
+                measured_feats[i] = feats
+                model.observe(feats, lat)   # incremental refit
+                if i in predicted:
+                    rec["predicted_ms"] = predicted[i]
+                    jrec["predicted_ms"] = predicted[i]
+                if feats is not None:
+                    jrec["features"] = feats
+            captured.append(rec)
+            _append_journal(journal_f, jrec)
+            if best is None or lat < best.latency_ms:
+                best = AutotuneResult(cfg, lat, kernel)
 
-                    def _one():
-                        attempts[0] += 1
-                        _faults.maybe_fail("autotune.trial", config=ck)
-                        kernel = self.fn(*args, **{**kwargs, **cfg})
-                        prof = Profiler(kernel, self.supply_type)
-                        return kernel, prof.do_bench(warmup=self.warmup,
-                                                     rep=self.rep)
-                    try:
-                        kernel, lat = retry_call(
-                            lambda: run_with_timeout(_one, self.timeout),
-                            site="autotune.trial", policy=policy,
-                            breaker=breaker)
-                    except Exception as e:  # config isolation (tuner.py:51)
-                        kind = classify(e)
-                        sig = error_signature(e)
-                        err = f"{type(e).__name__}: {e}"
-                        logger.debug("autotune config %s failed (%s): %s",
-                                     cfg, kind, e)
-                        sp.set(outcome="failed", kind=kind, error=err,
-                               attempts=attempts[0])
-                        _trace.inc("autotune.trials", outcome="failed")
-                        if sig == streak_sig:
-                            streak_len += 1
-                        else:
-                            streak_sig, streak_len = sig, 1
-                        captured.append({"config": cfg, "latency_ms": None,
-                                         "error": err, "kind": kind,
-                                         "attempts": attempts[0]})
-                        _append_journal(journal_f, {
-                            "config_key": ck, "status": "failed",
-                            "kind": kind, "error": err,
-                            "attempts": attempts[0]})
+        with _trace.span("autotune.run", "autotune", factory=factory,
+                         n_configs=n, resumed_trials=len(prior)) as run_sp:
+            early_stopped: List[int] = []
+            for pos, i in enumerate(measure_order):
+                # model-guided early stop: once enough trials landed and
+                # this config's prediction is outside the confidence
+                # band of the best measured latency, nothing it could
+                # plausibly measure would win — skip it (the epsilon
+                # tail is exempt: exploration exists to correct the
+                # model, not to be pruned by it)
+                if model is not None and model.fitted and \
+                        best is not None and i in predicted and \
+                        i not in protected and len(measured_ms) >= 3:
+                    band = model.confidence_band() or 0.0
+                    if predicted[i] >= best.latency_ms * (1.0 + band):
+                        early_stopped.append(i)
                         continue
-                    sp.set(outcome="ok", latency_ms=lat,
-                           attempts=attempts[0])
-                    _trace.inc("autotune.trials", outcome="ok")
-                    # trial medians feed the SAME per-kernel latency
-                    # histograms as runtime dispatch recording, so the
-                    # sweep's distribution shows up in
-                    # metrics_summary()["runtime"] / Prometheus
-                    _runtime.record(
-                        getattr(getattr(kernel, "artifact", None), "name",
-                                factory),
-                        lat / 1e3, source="autotune")
-                    streak_sig, streak_len = None, 0
-                logger.info("autotune [%d/%d] %s -> %.4f ms",
-                            i + 1, n, cfg, lat)
-                captured.append({"config": cfg, "latency_ms": lat})
+                measure(i, configs[i])
+
+            # -- ranking-disagreement fallback -------------------------
+            agreement = None
+            if model is not None and predicted:
+                agreement = rank_agreement(
+                    [(predicted.get(i), measured_ms.get(i))
+                     for i in measured_ms])
+            leftover = pruned + early_stopped
+            if leftover and agreement is not None and agreement < 0.5:
+                # the model's ranking is noise for this kernel: measure
+                # everything it held back (the full-sweep guarantee)
+                _trace.inc("autotune.model_fallback")
+                _trace.event("autotune.model_fallback", "autotune",
+                             factory=factory, agreement=agreement)
+                logger.warning(
+                    "autotune: cost-model ranking disagrees with "
+                    "measurements (agreement %.2f); falling back to the "
+                    "full sweep for %s", agreement, factory)
+                for i in sorted(leftover):
+                    measure(i, configs[i])
+                leftover = []
+                agreement = rank_agreement(
+                    [(predicted.get(i), measured_ms.get(i))
+                     for i in measured_ms])
+            for i in leftover:
+                _trace.inc("autotune.trials", outcome="pruned")
+                captured.append({"config": configs[i], "latency_ms": None,
+                                 "pruned": True,
+                                 "predicted_ms": predicted.get(i)})
                 _append_journal(journal_f, {
-                    "config_key": ck, "status": "ok", "latency_ms": lat})
-                if best is None or lat < best.latency_ms:
-                    best = AutotuneResult(cfg, lat, kernel)
+                    "config_key": _config_key(configs[i]),
+                    "status": "pruned",
+                    "predicted_ms": predicted.get(i)})
+            if mode == "model":
+                _MODEL_STATE["rank_agreement"] = agreement
+
             if best is None:
                 raise RuntimeError("autotune: every candidate config failed")
             if best.kernel is None:
                 # winner came from the resume journal: build it now
                 best.kernel = self.fn(*args, **{**kwargs, **best.config})
+            best.trials_measured = stats["measured"]
+            best.trials_pruned = len(leftover)
+            best.model_agreement = agreement
             run_sp.set(best_config=best.config,
-                       best_latency_ms=best.latency_ms)
+                       best_latency_ms=best.latency_ms,
+                       trials_measured=stats["measured"],
+                       trials_pruned=len(leftover))
         best.all_results = captured
         if self.cache_results:
             cache_f.write_text(json.dumps(
@@ -454,6 +779,35 @@ class AutoTuner:
             # deliberate cache delete on the next re-tune)
             if journal_f is not None:
                 journal_f.unlink(missing_ok=True)
+        # -- record the completed sweep for the fleet ------------------
+        if tcache is not None and tune_key is not None:
+            trials = []
+            for r in captured:
+                if r.get("latency_ms") is None or r.get("resumed"):
+                    continue
+                trials.append({"config": r["config"],
+                               "latency_ms": r["latency_ms"]})
+            # attach features where the trial produced them (the model's
+            # warm start for sibling shape buckets)
+            by_ck = {_config_key(configs[i]): measured_feats.get(i)
+                     for i in measured_feats}
+            for t in trials:
+                feats = by_ck.get(_config_key(t["config"]))
+                if feats is not None:
+                    t["features"] = feats
+            from ..carver.arch import auto_arch
+            from ..transform.pass_config import current_pass_config
+            tcache.record(tune_key, {
+                "source_sha": self._source_sha(),
+                "shape_bucket": self._shape_bucket(args, kwargs),
+                "arch": auto_arch().name,
+                "pass_cfg": dict(current_pass_config()),
+                "factory": factory,
+                "best_config": best.config,
+                "best_latency_ms": best.latency_ms,
+                "trials": trials,
+                "merges": 0,
+            })
         return best
 
 
@@ -486,7 +840,7 @@ def autotune(fn: Optional[Callable] = None, *,
              cache_results: bool = True, timeout: Optional[float] = None,
              template: Any = None, topk: int = 10,
              **_ignored):
-    """Grid-search tuner. Candidates come from an explicit ``configs``
+    """Config-space tuner. Candidates come from an explicit ``configs``
     list, or from the carver: ``template=`` takes a carver template
     instance or a callable over the call-site args returning one, and the
     roofline-ranked top-``topk`` hints become the config grid::
@@ -505,6 +859,10 @@ def autotune(fn: Optional[Callable] = None, *,
         @tilelang.autotune          # no template needed
         @tilelang.jit
         def matmul(M, N, K, block_M=128, block_N=128, block_K=128): ...
+
+    Under ``TL_TPU_TUNE=model`` (default) the sweep is cost-model-guided
+    — see docs/autotuning.md; ``TL_TPU_TUNE=bruteforce`` measures every
+    candidate exactly as before.
     """
     # Reference-parity kwargs (reference autotuner/tuner.py:685-702)
     # that have no TPU effect here: numeric checking is the caller's job

@@ -1,0 +1,246 @@
+"""Analytic + fitted autotune cost model (docs/autotuning.md).
+
+The autotuner's trials are the expensive part of a sweep — each one pays
+an XLA compile plus ``warmup + rep`` device dispatches — while the stack
+already records everything a latency *predictor* needs at compile time:
+the roofline FLOP/byte counts and VMEM interval footprint that
+``transform/plan.py`` derives per config (``plan_features``, persisted
+on ``CompiledArtifact.attrs["features"]``), the carver arch model's
+peaks, and the static ICI wire bytes on ``attrs["collectives"]``.
+
+Two-layer model, following the host-codegen literature (AXI4MLIR,
+arxiv 2312.14821: analytic transfer/occupancy features carry the bulk of
+the predictive signal — no heavyweight ML dependency needed):
+
+- **analytic**: a deterministic roofline —
+  ``max(t_mxu, t_hbm, t_vpu) + t_ici + grid_steps * overhead``, with a
+  serialization penalty when the kernel has neither a pipelined grid
+  axis nor a tile-opt double-buffer chain (its HBM stream cannot hide
+  under compute). Shares the throughput vocabulary of
+  ``carver/roller.py``'s DefaultPolicy.
+- **fitted residual**: ridge regression (pure numpy) on
+  ``log(measured) - log(analytic)`` over a small basis of log-scaled
+  features, refit incrementally as trials land and seeded from the
+  fleet tune cache's recorded trials. The model is **cold** below
+  ``TL_TPU_TUNE_MIN_FIT`` samples — a cold model never prunes.
+
+The residual's training RMSE doubles as the model's *confidence band*:
+the sweep early-stops only when no unmeasured config's prediction could
+plausibly (within the band) beat the best measured latency.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..carver.arch import TPUArch, auto_arch
+# the carver policy's roofline constants (per-grid-step overhead, VPU
+# throughput) — one shared vocabulary, so the carver's candidate ranking
+# and the tuner's pruning can never disagree about what a tile costs
+from ..carver.roller import TILE_OVERHEAD_S as _TILE_OVERHEAD_S
+from ..carver.roller import VPU_ELEMS_PER_S as _VPU_ELEMS_PER_S
+from ..transform.plan import FEATURES_VERSION
+
+__all__ = ["CostModel", "analytic_ms", "features_from_artifact",
+           "features_from_kernel", "rank_agreement", "FEATURES_VERSION"]
+
+# ridge regularizer: heavy enough that a handful of seed samples can't
+# produce wild extrapolation, light enough to learn a systematic offset
+_RIDGE_LAMBDA = 1.0
+# the fitted correction is a multiplicative factor exp(w . phi); clamp it
+# so a sparse fit can never rank a config e.g. 1000x off its roofline
+_MAX_LOG_CORR = 3.0
+# confidence band floor/ceiling (relative): even a perfectly-fit model
+# keeps a 25% band (measurement noise exists), and a terrible fit's band
+# saturates instead of making early-stop impossible forever
+_BAND_FLOOR = 0.25
+_BAND_CEIL = 4.0
+
+
+def features_from_artifact(art) -> Optional[Dict[str, float]]:
+    """The cost-feature dict of a compiled artifact, or None when the
+    artifact predates the feature schema (stale disk cache, mesh
+    artifacts) — callers must treat None as 'cannot rank, measure it'.
+    Static ICI wire bytes from ``attrs["collectives"]`` are folded in
+    here so mesh-tier features stay one dict."""
+    attrs = getattr(art, "attrs", None) or {}
+    feats = attrs.get("features")
+    if not isinstance(feats, dict) or \
+            feats.get("version") != FEATURES_VERSION:
+        return None
+    wire = 0
+    for rec in attrs.get("collectives") or []:
+        try:
+            wire += int(rec.get("wire_bytes") or 0)
+        except (TypeError, ValueError, AttributeError):
+            continue
+    out = dict(feats)
+    out["wire_bytes"] = wire
+    return out
+
+
+def features_from_kernel(kernel) -> Optional[Dict[str, float]]:
+    return features_from_artifact(getattr(kernel, "artifact", None))
+
+
+def analytic_ms(feats: Dict[str, float],
+                arch: Optional[TPUArch] = None) -> float:
+    """Deterministic roofline latency (ms) of one config's features
+    against an arch model. Never zero (ranking needs a total order)."""
+    arch = arch or auto_arch()
+    t_mxu = float(feats.get("flops") or 0) / (arch.bf16_tflops * 1e12)
+    t_hbm = float(feats.get("hbm_bytes") or 0) / (arch.hbm_gbps * 1e9)
+    t_vpu = float(feats.get("vpu_elems") or 0) / _VPU_ELEMS_PER_S
+    t_ici = float(feats.get("wire_bytes") or 0) / (
+        arch.ici_gbps_per_link * arch.ici_links * 1e9)
+    t = max(t_mxu, t_hbm, t_vpu)
+    if not (feats.get("dbuf_chains") or feats.get("pipelined")):
+        # no double-buffer chain and no pipelined grid axis: the HBM
+        # stream serializes behind compute instead of hiding under it
+        t += 0.5 * min(t_mxu, t_hbm)
+    t += t_ici + float(feats.get("grid_steps") or 1) * _TILE_OVERHEAD_S
+    return max(t * 1e3, 1e-9)
+
+
+def _phi(feats: Dict[str, float], ana_ms: float) -> np.ndarray:
+    """Regression basis for the fitted residual: log-scaled roofline
+    numerators, footprint, shape skew, and the occupancy bits."""
+    return np.array([
+        math.log1p(float(feats.get("flops") or 0)),
+        math.log1p(float(feats.get("hbm_bytes") or 0)),
+        math.log1p(float(feats.get("vpu_elems") or 0)),
+        math.log1p(float(feats.get("grid_steps") or 1)),
+        math.log1p(float(feats.get("vmem_arena") or 0)
+                   + float(feats.get("vmem_block_bytes") or 0)),
+        math.log(max(float(feats.get("block_skew") or 1.0), 1.0) + 1.0),
+        min(float(feats.get("dbuf_chains") or 0), 4.0),
+        1.0 if feats.get("pipelined") else 0.0,
+        math.log(max(ana_ms, 1e-9)),
+    ], dtype=np.float64)
+
+
+def _usable(feats) -> bool:
+    return isinstance(feats, dict) and \
+        feats.get("version") == FEATURES_VERSION
+
+
+class CostModel:
+    """Analytic roofline + incrementally-refit ridge residual."""
+
+    def __init__(self, arch: Optional[TPUArch] = None,
+                 min_fit: Optional[int] = None,
+                 ridge_lambda: float = _RIDGE_LAMBDA):
+        from ..env import env
+        self.arch = arch or auto_arch()
+        self.min_fit = int(min_fit if min_fit is not None
+                           else env.TL_TPU_TUNE_MIN_FIT)
+        self.ridge_lambda = float(ridge_lambda)
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+        self._w: Optional[np.ndarray] = None
+        self._mu: Optional[np.ndarray] = None
+        self._resid_rms: Optional[float] = None
+
+    # -- training ------------------------------------------------------
+    @property
+    def n_samples(self) -> int:
+        return len(self._y)
+
+    @property
+    def fitted(self) -> bool:
+        return self._w is not None
+
+    def observe(self, feats: Optional[Dict[str, float]],
+                measured_ms: Optional[float], refit: bool = True) -> bool:
+        """Add one measured trial; refit unless deferred. Returns whether
+        the sample was usable (feature schema matched, latency > 0)."""
+        if not _usable(feats) or not measured_ms or measured_ms <= 0:
+            return False
+        ana = analytic_ms(feats, self.arch)
+        self._X.append(_phi(feats, ana))
+        self._y.append(math.log(measured_ms) - math.log(ana))
+        if refit:
+            self.fit()
+        return True
+
+    def seed(self, samples: Iterable[Tuple[Dict[str, float], float]]) -> int:
+        """Bulk-load (features, measured_ms) pairs — the fleet tune
+        cache's recorded trials — then fit once."""
+        n = 0
+        for feats, lat in samples:
+            if self.observe(feats, lat, refit=False):
+                n += 1
+        if n:
+            self.fit()
+        return n
+
+    def fit(self) -> bool:
+        """Ridge-solve the residual. No-op (stays cold) below min_fit."""
+        if len(self._y) < self.min_fit:
+            return False
+        X = np.vstack(self._X)
+        y = np.asarray(self._y, dtype=np.float64)
+        self._mu = X.mean(axis=0)
+        A = np.hstack([np.ones((X.shape[0], 1)), X - self._mu])
+        # the intercept is NOT regularized (standard ridge practice): a
+        # uniform multiplicative offset between roofline and measurement
+        # must be learned exactly, not shrunk toward "the roofline is
+        # already right"
+        lam = self.ridge_lambda * np.eye(A.shape[1])
+        lam[0, 0] = 0.0
+        self._w = np.linalg.solve(A.T @ A + lam, A.T @ y)
+        resid = A @ self._w - y
+        self._resid_rms = float(np.sqrt(np.mean(resid * resid)))
+        return True
+
+    # -- inference -----------------------------------------------------
+    def predict_ms(self, feats: Dict[str, float]) -> float:
+        """Predicted latency: the roofline, multiplied by the fitted
+        residual when warm (clamped — sparse fits must not explode)."""
+        ana = analytic_ms(feats, self.arch)
+        if self._w is None:
+            return ana
+        a = np.concatenate([[1.0], _phi(feats, ana) - self._mu])
+        corr = float(np.clip(a @ self._w, -_MAX_LOG_CORR, _MAX_LOG_CORR))
+        return ana * math.exp(corr)
+
+    def confidence_band(self) -> Optional[float]:
+        """Relative band b: a config predicted at p could plausibly
+        measure anywhere in [p/(1+b), p*(1+b)]. None while cold."""
+        if self._resid_rms is None:
+            return None
+        band = math.expm1(2.0 * self._resid_rms)
+        return min(max(band, _BAND_FLOOR), _BAND_CEIL)
+
+
+def rank_agreement(pairs: Sequence[Tuple[float, float]],
+                   meas_rel_tol: float = 0.1) -> Optional[float]:
+    """Pairwise order concordance between predicted and measured
+    latencies over the measured set (1.0 = the model's ranking matches
+    measurement exactly, 0.5 = random, 0.0 = inverted). Measured pairs
+    within ``meas_rel_tol`` of each other count as ties (0.5): the
+    model-guided sweep deliberately measures the configs predicted to be
+    CLOSE to best, so their measured order is often noise — punishing
+    the model for coin-flips would trip the disagreement fallback on
+    perfectly healthy rankings. None below two usable pairs — agreement
+    over nothing is not evidence."""
+    pts = [(p, m) for p, m in pairs
+           if p is not None and m is not None and m > 0]
+    if len(pts) < 2:
+        return None
+    concordant = 0.0
+    total = 0
+    for i in range(len(pts)):
+        for j in range(i + 1, len(pts)):
+            dp = pts[i][0] - pts[j][0]
+            dm = pts[i][1] - pts[j][1]
+            total += 1
+            if dp == 0 or abs(dm) <= meas_rel_tol * max(pts[i][1],
+                                                        pts[j][1]):
+                concordant += 0.5
+            elif (dp > 0) == (dm > 0):
+                concordant += 1.0
+    return round(concordant / total, 4)

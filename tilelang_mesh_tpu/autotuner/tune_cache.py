@@ -1,0 +1,396 @@
+"""Content-addressed, mergeable fleet tune cache (docs/autotuning.md).
+
+One process's completed autotune sweep should warm every other process
+on the fleet — including ones on other machines whose cache dirs are
+aggregated offline. Entries are keyed on
+
+    sha256(kernel source sha, shape bucket, arch, resolved pass config,
+           CODEGEN_VERSION, schema)
+
+so a codegen change, a different chip, or a different pass configuration
+can never resurrect a stale winner, and live in ``env.tune_cache_dir()``
+as one JSON file per key, written with the crash-safe kernel-cache
+discipline (``cache/kernel_cache.py``):
+
+- **atomic writes** — tmp file + ``os.replace``; a crash leaves the old
+  entry or a tmp file, never a torn entry;
+- **checksummed entries** — every payload carries a sha256 of its own
+  canonical JSON, verified on every read;
+- **quarantine, never silent deletion** — a corrupt entry moves to
+  ``<root>/.quarantine/`` (counted + traced) so the damage stays
+  inspectable.
+
+Entries are **mergeable**: two payloads for the same key union their
+trial lists (per-config best latency wins) and keep the better best
+config, so fleet aggregation is a commutative fold::
+
+    python -m tilelang_mesh_tpu.autotuner.tune_cache merge <dir>...
+
+merges other runners' cache dirs into this machine's root. The
+autotuner consults the cache before sweeping (a hit is a
+zero-measurement warm start), records every completed sweep, and seeds
+its cost model from the recorded (features, latency) samples of sibling
+shape buckets; serving ``warmup()`` consults it for per-bucket kernel
+configs (serving/batcher.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..env import env
+from ..observability import tracer as _trace
+
+try:
+    import fcntl
+except ImportError:          # non-POSIX: locking degrades to process-local
+    fcntl = None  # type: ignore[assignment]
+
+logger = logging.getLogger("tilelang_mesh_tpu.autotune")
+
+__all__ = ["TuneCache", "merge_payloads", "main", "SCHEMA"]
+
+#: entry-format version: part of the key, so a schema change simply
+#: starts a fresh namespace instead of tripping over old entries
+SCHEMA = 1
+QUARANTINE_DIR = ".quarantine"
+
+
+def _canonical(payload: dict) -> str:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def entry_checksum(payload: dict) -> str:
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    return hashlib.sha256(_canonical(body).encode()).hexdigest()
+
+
+def _config_key(cfg: dict) -> str:
+    return json.dumps(cfg, sort_keys=True, default=str)
+
+
+def _tuning_body(payload: dict) -> dict:
+    """The entry minus its provenance (checksum, merge counter): what
+    idempotence and unchanged-detection are judged on."""
+    return {k: v for k, v in payload.items()
+            if k not in ("checksum", "merges")}
+
+
+def merge_payloads(a: dict, b: dict) -> dict:
+    """Commutative, idempotent merge of two entries for the SAME key:
+    trials union per config (lower measured latency wins), best config
+    re-derived from the union. The merge counter takes the max of both
+    sides and bumps only when the union actually changed the tuning
+    payload — so re-merging identical entries is a fixed point (a cron'd
+    ``tune_cache merge`` of the same dirs converges instead of churning
+    checksums forever)."""
+    trials: Dict[str, dict] = {}
+    for src in (a, b):
+        for t in src.get("trials") or []:
+            if not isinstance(t, dict) or "config" not in t:
+                continue
+            ck = _config_key(t["config"])
+            prev = trials.get(ck)
+            lat = t.get("latency_ms")
+            if prev is None or (
+                    lat is not None
+                    and (prev.get("latency_ms") is None
+                         or lat < prev["latency_ms"])):
+                trials[ck] = dict(t)
+    measured = [t for t in trials.values()
+                if t.get("latency_ms") is not None]
+    out = _tuning_body(a)
+    out["trials"] = sorted(trials.values(), key=lambda t: _config_key(
+        t["config"]))
+    if measured:
+        best = min(measured, key=lambda t: t["latency_ms"])
+        out["best_config"] = best["config"]
+        out["best_latency_ms"] = best["latency_ms"]
+    changed = _canonical(_tuning_body(a)) != _canonical(out)
+    out["merges"] = max(int(a.get("merges") or 0),
+                        int(b.get("merges") or 0)) + (1 if changed else 0)
+    return out
+
+
+class TuneCache:
+    """One directory of checksummed, atomically-written tune entries."""
+
+    def __init__(self, root=None):
+        self.root = Path(root) if root is not None else env.tune_cache_dir()
+
+    # -- keying --------------------------------------------------------
+    @staticmethod
+    def key(source_sha: str, shape_bucket: str, arch: str,
+            pass_cfg: Optional[dict] = None) -> str:
+        from ..cache.kernel_cache import CODEGEN_VERSION
+        h = hashlib.sha256()
+        h.update(source_sha.encode())
+        h.update(shape_bucket.encode())
+        h.update(arch.encode())
+        h.update(json.dumps(pass_cfg or {}, sort_keys=True,
+                            default=str).encode())
+        h.update(str(CODEGEN_VERSION).encode())
+        h.update(str(SCHEMA).encode())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> Path:
+        return self.root / f"{key}.json"
+
+    @contextlib.contextmanager
+    def _key_lock(self, key: str):
+        """Serialize cross-process read-merge-write cycles of one entry
+        (the same flock discipline as the kernel cache: advisory and
+        kernel-released on crash, so a dead writer can never wedge the
+        fleet tier; degrades to nothing where fcntl is unavailable)."""
+        if fcntl is None:
+            yield
+            return
+        lock_dir = self.root / ".locks"
+        lock_dir.mkdir(parents=True, exist_ok=True)
+        fd = os.open(lock_dir / f"{key}.lock", os.O_CREAT | os.O_RDWR,
+                     0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # -- read / write --------------------------------------------------
+    def _quarantine(self, path: Path, reason: str) -> None:
+        qroot = self.root / QUARANTINE_DIR
+        qroot.mkdir(parents=True, exist_ok=True)
+        dest = qroot / path.name
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = qroot / f"{path.name}.{n}"
+        try:
+            os.replace(path, dest)
+        except OSError:
+            try:
+                path.unlink()
+            except OSError:
+                dest = None
+        _trace.inc("tune.cache.quarantined")
+        _trace.event("tune.cache.quarantine", "autotune",
+                     entry=path.name, reason=reason,
+                     dest=str(dest) if dest else "removed")
+        logger.warning("quarantined corrupt tune-cache entry %s (%s)%s",
+                       path.name, reason, f" -> {dest}" if dest else "")
+
+    @staticmethod
+    def _verify(payload) -> Optional[str]:
+        """None when the payload is intact, else the corruption reason."""
+        if not isinstance(payload, dict):
+            return "not a JSON object"
+        if payload.get("schema") != SCHEMA:
+            return f"schema {payload.get('schema')!r} != {SCHEMA}"
+        expect = payload.get("checksum")
+        actual = entry_checksum(payload)
+        if expect != actual:
+            return (f"checksum mismatch (expect {str(expect)[:12]}…, "
+                    f"got {actual[:12]}…)")
+        return None
+
+    def get(self, key: str) -> Optional[dict]:
+        p = self._path(key)
+        if not p.exists():
+            return None
+        try:
+            payload = json.loads(p.read_text())
+        except (OSError, ValueError) as e:
+            self._quarantine(p, f"{type(e).__name__}: {e}")
+            return None
+        reason = self._verify(payload)
+        if reason is not None:
+            self._quarantine(p, reason)
+            return None
+        return payload
+
+    def put(self, key: str, payload: dict) -> None:
+        from ..cache.kernel_cache import CODEGEN_VERSION, atomic_write
+        body = {k: v for k, v in payload.items() if k != "checksum"}
+        body.setdefault("schema", SCHEMA)
+        body.setdefault("codegen_version", CODEGEN_VERSION)
+        body["checksum"] = entry_checksum(body)
+        self.root.mkdir(parents=True, exist_ok=True)
+        try:
+            atomic_write(self._path(key), json.dumps(body, indent=1))
+        except OSError as e:    # a full disk degrades the fleet tier,
+            logger.warning(     # never the sweep that produced the result
+                "tune-cache write failed for %s: %s", key, e)
+            return
+        _trace.inc("tune.cache.writes")
+
+    def record(self, key: str, payload: dict) -> None:
+        """Write-or-merge under the per-key lock: a concurrent writer's
+        trials survive (two processes finishing the same sweep both
+        contribute; without the lock the read-merge-write cycles would
+        interleave and the loser's trials would vanish)."""
+        with self._key_lock(key):
+            existing = self.get(key)
+            self.put(key, merge_payloads(existing, payload)
+                     if existing else payload)
+
+    # -- enumeration / model seeding -----------------------------------
+    def entries(self) -> Iterator[Tuple[str, dict]]:
+        if not self.root.is_dir():
+            return
+        for p in sorted(self.root.glob("*.json")):
+            payload = self.get(p.stem)
+            if payload is not None:
+                yield p.stem, payload
+
+    def samples(self, source_sha: str,
+                arch: str) -> List[Tuple[dict, float]]:
+        """(features, measured_ms) pairs recorded for this kernel source
+        on this arch across EVERY shape bucket — the cost model's warm
+        start for a bucket it has never measured."""
+        out: List[Tuple[dict, float]] = []
+        for _key, payload in self.entries():
+            if payload.get("source_sha") != source_sha or \
+                    payload.get("arch") != arch:
+                continue
+            for t in payload.get("trials") or []:
+                feats = t.get("features")
+                lat = t.get("latency_ms")
+                if isinstance(feats, dict) and lat:
+                    out.append((feats, float(lat)))
+        return out
+
+    def stats(self) -> dict:
+        entries = list(self.entries())
+        qdir = self.root / QUARANTINE_DIR
+        return {
+            "root": str(self.root),
+            "entries": len(entries),
+            "trials": sum(len(p.get("trials") or []) for _, p in entries),
+            "merges": sum(int(p.get("merges") or 0) for _, p in entries),
+            "quarantined": len(list(qdir.glob("*")))
+            if qdir.is_dir() else 0,
+        }
+
+    # -- fleet aggregation ---------------------------------------------
+    def merge_from(self, sources: Sequence) -> dict:
+        """Fold other cache dirs into this one. Corrupt source entries
+        are counted and skipped (never quarantined in-place — the source
+        dir may be another machine's artifact, read-only by contract)."""
+        stats = {"examined": 0, "new": 0, "merged": 0, "unchanged": 0,
+                 "corrupt": 0}
+        for src in sources:
+            src = Path(src)
+            if not src.is_dir():
+                continue
+            for p in sorted(src.glob("*.json")):
+                stats["examined"] += 1
+                try:
+                    theirs = json.loads(p.read_text())
+                except (OSError, ValueError):
+                    stats["corrupt"] += 1
+                    continue
+                if self._verify(theirs) is not None:
+                    stats["corrupt"] += 1
+                    continue
+                key = p.stem
+                with self._key_lock(key):
+                    mine = self.get(key)
+                    if mine is None:
+                        self.put(key, theirs)
+                        stats["new"] += 1
+                        continue
+                    merged = merge_payloads(mine, theirs)
+                    if _canonical({k: v for k, v in mine.items()
+                                   if k != "checksum"}) == \
+                            _canonical({k: v for k, v in merged.items()
+                                        if k != "checksum"}):
+                        stats["unchanged"] += 1
+                    else:
+                        self.put(key, merged)
+                        stats["merged"] += 1
+        n = stats["new"] + stats["merged"]
+        if n:
+            _trace.inc("tune.cache.merged", n)
+        _trace.event("tune.cache.merge", "autotune", **stats)
+        return stats
+
+
+# ---------------------------------------------------------------------------
+# CLI: fleet aggregation + inspection
+# ---------------------------------------------------------------------------
+
+def _fmt_list(cache: TuneCache) -> str:
+    lines = [f"tune cache @ {cache.root}"]
+    for key, p in cache.entries():
+        lat = p.get("best_latency_ms")
+        tail = (f"best={p.get('best_config')} ({lat:.4f} ms)"
+                if lat is not None else "(no measured trials)")
+        lines.append(
+            f"  {key[:12]}…  {p.get('factory', '?'):24s} "
+            f"arch={p.get('arch', '?'):8s} "
+            f"trials={len(p.get('trials') or []):3d} "
+            f"merges={p.get('merges', 0)} {tail}")
+    if len(lines) == 1:
+        lines.append("  (empty)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys as _sys
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.autotuner.tune_cache",
+        description="Fleet tune cache: merge other runners' sweep "
+                    "results into this machine's cache, or inspect it "
+                    "(docs/autotuning.md).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_mg = sub.add_parser(
+        "merge", help="fold other tune-cache dirs into the local root "
+                      "(checksummed entries; per-config best wins)")
+    p_mg.add_argument("sources", nargs="+", help="tune-cache dir(s)")
+    p_mg.add_argument("--into", metavar="DIR",
+                      help="destination root (default: "
+                           "env.tune_cache_dir())")
+    p_ls = sub.add_parser("list", help="entries in a tune-cache dir")
+    p_ls.add_argument("--root", metavar="DIR")
+    p_st = sub.add_parser("stats", help="entry/trial/merge totals")
+    p_st.add_argument("--root", metavar="DIR")
+    for p in (p_mg, p_ls, p_st):
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable JSON output")
+    args = ap.parse_args(list(_sys.argv[1:] if argv is None else argv))
+    if args.cmd == "merge":
+        cache = TuneCache(args.into) if args.into else TuneCache()
+        stats = cache.merge_from(args.sources)
+        if args.json:
+            print(json.dumps(stats, indent=2))  # noqa: T201
+        else:
+            print(f"merged into {cache.root}: "  # noqa: T201
+                  f"{stats['new']} new, {stats['merged']} merged, "
+                  f"{stats['unchanged']} unchanged, "
+                  f"{stats['corrupt']} corrupt skipped "
+                  f"({stats['examined']} examined)")
+        return 0
+    cache = TuneCache(args.root) if args.root else TuneCache()
+    if args.cmd == "list":
+        if args.json:
+            print(json.dumps(  # noqa: T201
+                {k: p for k, p in cache.entries()}, indent=2))
+        else:
+            print(_fmt_list(cache))  # noqa: T201
+        return 0
+    stats = cache.stats()
+    print(json.dumps(stats, indent=2) if args.json  # noqa: T201
+          else "\n".join(f"{k}: {v}" for k, v in stats.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,10 +58,11 @@ QUARANTINE_DIR = ".quarantine"
 LOCKS_DIR = ".locks"
 
 # Bump whenever codegen output OR the on-disk artifact format changes —
-# artifacts cached under older versions must not be reused. (10: the
-# tile-opt IR passes rewrite kernels before planning, and artifact
-# metadata persists every JSON-clean attr — attrs["tile_opt"] included.)
-CODEGEN_VERSION = 10
+# artifacts cached under older versions must not be reused. (11: plain
+# artifacts carry attrs["features"], the compile-time cost-feature dict
+# the autotuner's cost model consumes — older entries lack it and would
+# silently disable model-guided pruning on disk hits.)
+CODEGEN_VERSION = 11
 
 
 def _sha256(text: str) -> str:
@@ -101,6 +102,11 @@ def _atomic_write(path: Path, text: str) -> None:
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
     tmp.write_text(text)
     os.replace(tmp, path)
+
+
+# public spelling: the fleet tune cache (autotuner/tune_cache.py) reuses
+# the same tmp+rename commit discipline for its entries
+atomic_write = _atomic_write
 
 
 class KernelCache:

@@ -48,9 +48,15 @@ class Candidate:
 
 # per-grid-step fixed overhead (dispatch + window bookkeeping); value in
 # seconds — small, but it is what separates equal-roofline candidates and
-# makes fewer/bigger tiles win, matching measurement
-_TILE_OVERHEAD_S = 1e-6
-_VPU_ELEMS_PER_S = 0.5e12   # ~VPU elementwise throughput (f32 elems/s)
+# makes fewer/bigger tiles win, matching measurement. Public: the
+# autotuner's cost model (autotuner/cost_model.py) prices configs with
+# the SAME constants, so the carver's ranking and the tuner's pruning
+# can never disagree about the roofline vocabulary.
+TILE_OVERHEAD_S = 1e-6
+VPU_ELEMS_PER_S = 0.5e12    # ~VPU elementwise throughput (f32 elems/s)
+# legacy private spellings (pre-cost-model callers)
+_TILE_OVERHEAD_S = TILE_OVERHEAD_S
+_VPU_ELEMS_PER_S = VPU_ELEMS_PER_S
 
 
 class DefaultPolicy:

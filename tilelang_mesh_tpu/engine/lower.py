@@ -110,6 +110,17 @@ def lower(func, target: str = "auto",
         if topt is not None and topt.rewrites:
             plan_desc += "\n".join(topt.desc_block()) + "\n"
             attrs["tile_opt"] = topt.attrs_record()
+        # compile-time cost features (transform/plan.py plan_features):
+        # the raw roofline/footprint quantities the autotuner's cost
+        # model consumes WITHOUT executing — persisted with the artifact
+        # so a cached kernel still yields features. The tile-opt dbuf
+        # chain count is the double-buffer-occupancy feature (an
+        # auto-double-buffered stream hides its HBM time under compute).
+        from ..transform.plan import plan_features
+        feats = plan_features(func, plan)
+        if topt is not None:
+            feats["dbuf_chains"] = topt.dbuf_chains
+        attrs["features"] = feats
         if lmode != "off":
             with _trace.span("lint", "lower", kernel=func.name):
                 lint_findings = list(lint_findings) + \

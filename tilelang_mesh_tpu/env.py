@@ -49,6 +49,29 @@ class Environment:
     TL_TPU_AUTOTUNE_CACHE_DIR = EnvVar(
         "TL_TPU_AUTOTUNE_CACHE_DIR",
         str(Path.home() / ".tilelang_mesh_tpu" / "autotune"))
+    # cost-model-guided autotuning (autotuner/cost_model.py;
+    # docs/autotuning.md). "model" (default) ranks the config space with
+    # the analytic+fitted cost model and measures only the predicted
+    # top-K fraction plus an epsilon exploration tail (falling back to a
+    # full sweep when the model is cold or its ranking disagrees with
+    # measurements); "bruteforce" restores the pre-model behavior
+    # trial-for-trial (every config measured, no tune-cache consults).
+    TL_TPU_TUNE = EnvVar("TL_TPU_TUNE", "model")
+    # fraction of the config space the model-guided sweep measures
+    # (ceil(topk * n) configs, ranked by predicted latency)
+    TL_TPU_TUNE_TOPK = EnvVar("TL_TPU_TUNE_TOPK", 0.25, float)
+    # epsilon-greedy exploration tail: this fraction of the PRUNED
+    # configs is still measured (seeded deterministic picks) so the
+    # fitted residual keeps learning outside the model's comfort zone
+    TL_TPU_TUNE_EPS = EnvVar("TL_TPU_TUNE_EPS", 0.1, float)
+    # minimum measured samples before the fitted residual is trusted;
+    # below it the model is "cold" and the sweep runs in full
+    TL_TPU_TUNE_MIN_FIT = EnvVar("TL_TPU_TUNE_MIN_FIT", 4, int)
+    # fleet tune cache root (autotuner/tune_cache.py): content-addressed
+    # mergeable sweep results. Empty (default) derives
+    # <TL_TPU_AUTOTUNE_CACHE_DIR>/tune so isolating the autotune dir
+    # (tests, benches) isolates the fleet tier too.
+    TL_TPU_TUNE_CACHE_DIR = EnvVar("TL_TPU_TUNE_CACHE_DIR", "")
     # native library
     TL_TPU_DISABLE_NATIVE = EnvVar("TL_TPU_DISABLE_NATIVE", False, bool)
     # mesh collective optimizer (transform/comm_opt.py; docs/
@@ -175,6 +198,13 @@ class Environment:
 
     def autotune_dir(self) -> Path:
         p = Path(self.TL_TPU_AUTOTUNE_CACHE_DIR)
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def tune_cache_dir(self) -> Path:
+        raw = self.TL_TPU_TUNE_CACHE_DIR
+        p = Path(raw) if raw else \
+            Path(self.TL_TPU_AUTOTUNE_CACHE_DIR) / "tune"
         p.mkdir(parents=True, exist_ok=True)
         return p
 

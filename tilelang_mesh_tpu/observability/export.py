@@ -250,6 +250,9 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
       max ms) from the runtime histograms — populated when
       ``TL_TPU_RUNTIME_METRICS=1`` recorded dispatches, or when the
       autotuner/profiler fed trial latencies in
+    - ``autotune``: measured vs model-pruned trial totals, both tune
+      cache tiers' hit rates, stale-journal skips, and the last sweep's
+      predicted-vs-measured rank agreement (docs/autotuning.md)
     """
     t = tracer or get_tracer()
     counters = t.counters()
@@ -399,6 +402,38 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         # the live gauge (last probe sweep) wins over the historical
         # p50 ratio when an engine is actually running
         skew = gauges["shard_skew"]
+    # autotune accounting (autotuner/; docs/autotuning.md): measured vs
+    # model-pruned trial counts, legacy + fleet tune-cache tiers, stale
+    # journal skips, and the last sweep's predicted-vs-measured rank
+    # agreement (lazy-read from the autotuner's model state)
+    def _tune_agreement():
+        try:
+            from ..autotuner import tune_state
+            return tune_state().get("rank_agreement")
+        except Exception:
+            return None
+
+    autotune = {
+        "trials_ok": c("autotune.trials{outcome=ok}"),
+        "trials_failed": c("autotune.trials{outcome=failed}"),
+        "trials_measured": c("autotune.trials{outcome=ok}")
+        + c("autotune.trials{outcome=failed}"),
+        "trials_pruned": c("autotune.trials{outcome=pruned}"),
+        "trials_resumed": c("autotune.trials{outcome=resumed}"),
+        "trials_skipped": c("autotune.trials{outcome=skipped}")
+        + c("autotune.trials{outcome=breaker_skipped}"),
+        "cache_hits": c("autotune.cache.hit"),
+        "cache_misses": c("autotune.cache.miss"),
+        "tune_cache_hits": c("tune.cache.hit"),
+        "tune_cache_misses": c("tune.cache.miss"),
+        "tune_cache_writes": c("tune.cache.writes"),
+        "tune_cache_merged": c("tune.cache.merged"),
+        "tune_cache_quarantined": c("tune.cache.quarantined"),
+        "journal_stale_skipped": c("autotune.journal.stale"),
+        "model_cold_sweeps": c("autotune.model_cold"),
+        "model_fallbacks": c("autotune.model_fallback"),
+        "model_rank_agreement": _tune_agreement(),
+    }
     serving = {
         "admitted": c("serve.admitted"),
         "completed": c("serve.completed"),
@@ -428,7 +463,8 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
             "verify": verify, "lint": lint, "tile_opt": tile_opt,
-            "serving": serving, "runtime": _runtime.runtime_summary()}
+            "autotune": autotune, "serving": serving,
+            "runtime": _runtime.runtime_summary()}
 
 
 def _json_safe(obj: Any):

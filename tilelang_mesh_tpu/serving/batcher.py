@@ -27,7 +27,12 @@ Two workload families over the ops library (the serving consumers of
 ``warmup()`` runs every (batch, pages) bucket once through the
 crash-safe kernel cache AND through a real dispatch, so the first
 serving request never pays trace/compile latency (the AOT warm store
-from ROADMAP item 1).
+from ROADMAP item 1). It also consults the **fleet tune cache**
+(autotuner/tune_cache.py; docs/autotuning.md) for each bucket: a tuned
+kernel config recorded by any fleet member — an offline sweep, another
+serving process, a merged cache dir — is adopted with ZERO measurements
+(the zero-cold-start bucket-config path), and ``record_bucket_tuning``
+is how an offline tuner publishes one.
 """
 
 from __future__ import annotations
@@ -63,6 +68,9 @@ class DecodeWorkload:
         if self.page_buckets[0] < 1:
             raise ValueError("page buckets must be >= 1")
         self._warm: set = set()
+        # (batch, pages) bucket -> tuned kernel config adopted from the
+        # fleet tune cache at warmup (None = nothing recorded)
+        self._tuned: dict = {}
 
     # -- bucketing -----------------------------------------------------
     @property
@@ -161,15 +169,103 @@ class DecodeWorkload:
         out = np.asarray(out)
         return [out[i] for i in range(len(requests))]
 
+    # -- fleet tune-cache consumption ----------------------------------
+    def _tune_source(self) -> "str | None":
+        """Source text identifying the bucket kernel in the fleet tune
+        cache — None (no tuned-config consumption) by default."""
+        return None
+
+    def _tune_bucket(self, bb: int, pp: int) -> str:
+        """Canonical shape-bucket token: the (batch, pages) bucket plus
+        the pool geometry that shapes the kernel."""
+        al = self.allocator
+        return (f"{type(self).__name__}:b{bb}:p{pp}:h{al.heads}"
+                f":d{al.head_dim}:ps{al.page_size}:rows{al.kp.shape[1]}")
+
+    def bucket_tune_key(self, bb: int, pp: int) -> "str | None":
+        """The tune-cache key of one bucket's kernel, or None when the
+        workload exposes no tunable kernel source."""
+        src = self._tune_source()
+        if src is None:
+            return None
+        import hashlib
+
+        from ..autotuner.tune_cache import TuneCache
+        from ..carver.arch import auto_arch
+        return TuneCache.key(hashlib.sha256(src.encode()).hexdigest(),
+                             self._tune_bucket(bb, pp),
+                             auto_arch().name, {})
+
+    def _consult_tune_cache(self, bb: int, pp: int) -> "dict | None":
+        key = self.bucket_tune_key(bb, pp)
+        if key is None:
+            return None
+        try:
+            from ..autotuner.tune_cache import TuneCache
+            ent = TuneCache().get(key)
+        except Exception:   # noqa: BLE001 — tuning is advisory, never
+            return None     # a warmup failure
+        if isinstance(ent, dict) and isinstance(ent.get("best_config"),
+                                                dict):
+            cfg = dict(ent["best_config"])
+            _trace.inc("serve.warmup.tuned")
+            _trace.event("serve.warmup.tuned", "serving", batch=bb,
+                         pages=pp, workload=type(self).__name__,
+                         config=str(cfg))
+            return cfg
+        return None
+
+    def record_bucket_tuning(self, bb: int, pp: int, config: dict,
+                             latency_ms: float) -> "str | None":
+        """Publish one bucket's tuned config to the fleet tune cache
+        (what an offline sweep calls so every serving process
+        warm-starts with it). Returns the entry key, or None when the
+        workload has no tunable kernel source."""
+        key = self.bucket_tune_key(bb, pp)
+        if key is None:
+            return None
+        import hashlib
+
+        from ..autotuner.tune_cache import TuneCache
+        from ..carver.arch import auto_arch
+        src = self._tune_source()
+        TuneCache().record(key, {
+            "source_sha": hashlib.sha256(src.encode()).hexdigest(),
+            "shape_bucket": self._tune_bucket(bb, pp),
+            "arch": auto_arch().name,
+            "pass_cfg": {},
+            "factory": type(self).__name__,
+            "best_config": dict(config),
+            "best_latency_ms": float(latency_ms),
+            "trials": [{"config": dict(config),
+                        "latency_ms": float(latency_ms)}],
+            "merges": 0,
+        })
+        return key
+
+    def tuned_config(self, bb: int, pp: int) -> dict:
+        """The bucket's adopted tuned config ({} when none)."""
+        return self._tuned.get((bb, pp)) or {}
+
     # -- AOT warm-up ---------------------------------------------------
     def warmup(self) -> int:
         """Compile AND dispatch every (batch, pages) bucket kernel once,
         routed through the crash-safe kernel cache, so no serving
-        request ever pays first-call trace/compile latency. Returns the
-        number of bucket kernels warmed."""
+        request ever pays first-call trace/compile latency. Consults the
+        fleet tune cache first, so a bucket some fleet member already
+        swept dispatches its TUNED config from the very first request —
+        zero cold-start measurements. Returns the number of bucket
+        kernels warmed."""
         n = 0
         for bb in self.batch_buckets:
             for pp in self.page_buckets:
+                # re-consult on every warmup while the bucket is still
+                # untuned: a config published (or `tune_cache merge`d)
+                # after the first warmup must be adopted by the next
+                # one, not ignored until process restart
+                if not self._tuned.get((bb, pp)):
+                    self._tuned[(bb, pp)] = self._consult_tune_cache(
+                        bb, pp)
                 if (bb, pp) in self._warm:
                     continue
                 with _trace.span("serve.warmup", "serving", batch=bb,
@@ -238,11 +334,26 @@ class FlashDecodeWorkload(DecodeWorkload):
         return (rng.standard_normal(shape).astype(np.float32),
                 rng.standard_normal(shape).astype(np.float32))
 
+    def _tune_source(self) -> "str | None":
+        import inspect
+
+        from ..ops.flash_decoding import paged_decode_kernel
+        try:
+            return inspect.getsource(paged_decode_kernel)
+        except (OSError, TypeError):
+            return None
+
     def _dispatch(self, q, table, bb: int, pp: int):
         from ..ops.flash_decoding import flash_decode_paged_pool
+        # fleet-tuned split factor when a sweep recorded one for this
+        # bucket (flash_decode_paged_pool clamps it to a divisor of the
+        # page count, so a merged entry can never produce an invalid
+        # split)
+        ns = self.tuned_config(bb, pp).get("n_split")
         return flash_decode_paged_pool(
             q, self.allocator.kp, self.allocator.vp, table,
-            self.allocator.page_size, sm_scale=self.sm_scale)
+            self.allocator.page_size, sm_scale=self.sm_scale,
+            n_split=int(ns) if ns else None)
 
 
 class MLADecodeWorkload(DecodeWorkload):

@@ -735,6 +735,112 @@ def format_serve_report(records) -> str:
 # --flag spellings are translated, so existing scripts keep working)
 # ---------------------------------------------------------------------------
 
+def summarize_tune(records, cache_stats: Optional[dict] = None) -> dict:
+    """Aggregate an autotune sweep journal (the per-trial JSONL the
+    tuner appends as trials land — docs/autotuning.md) into a
+    predicted-vs-measured report: one row per config, the model's
+    pairwise rank agreement over the measured set, trials saved by
+    pruning, and — when a tune-cache dir is reachable — the fleet
+    cache's entry/trial/merge totals."""
+    from ..autotuner.cost_model import rank_agreement
+    # one row per config, LAST record wins (the same dedup rule the
+    # tuner's own journal resume applies): a transient failure followed
+    # by a resumed ok trial leaves two lines for one config, and
+    # counting both would overstate the sweep
+    by_ck: dict = {}
+    order: list = []
+    for rec in records:
+        if not isinstance(rec, dict) or "config_key" not in rec:
+            continue
+        ck = rec.get("config_key")
+        if ck not in by_ck:
+            order.append(ck)
+        by_ck[ck] = {
+            "config": ck,
+            "status": rec.get("status"),
+            "predicted_ms": rec.get("predicted_ms"),
+            "latency_ms": rec.get("latency_ms"),
+            "kind": rec.get("kind"),
+        }
+    rows = [by_ck[ck] for ck in order]
+    measured = [r for r in rows if r["status"] == "ok"
+                and r["latency_ms"] is not None]
+    pruned = [r for r in rows if r["status"] == "pruned"]
+    failed = [r for r in rows if r["status"] == "failed"]
+    pairs = [(r["predicted_ms"], r["latency_ms"]) for r in measured
+             if r["predicted_ms"] is not None]
+    agreement = rank_agreement(pairs)
+    # top-K hit: did the model's best prediction also measure best?
+    top_hit = None
+    if len(pairs) >= 2:
+        by_pred = min(pairs, key=lambda p: p[0])
+        by_meas = min(pairs, key=lambda p: p[1])
+        top_hit = by_pred is by_meas or by_pred[1] == by_meas[1]
+    total = len(rows)
+    out = {
+        "trials": {
+            "total": total,
+            "measured": len(measured) + len(failed),
+            "ok": len(measured),
+            "failed": len(failed),
+            "pruned": len(pruned),
+            "saved_frac": round(len(pruned) / total, 4) if total else None,
+        },
+        "model": {
+            "rank_agreement": agreement,
+            "top1_hit": top_hit,
+            "predicted_rows": len(pairs),
+        },
+        "rows": rows,
+    }
+    if cache_stats is not None:
+        out["tune_cache"] = cache_stats
+    return out
+
+
+def format_tune_report(records, cache_stats: Optional[dict] = None) -> str:
+    s = summarize_tune(records, cache_stats)
+    t = s["trials"]
+    lines = ["autotune sweep journal",
+             f"  configs: {t['total']}  measured: {t['measured']} "
+             f"(ok {t['ok']}, failed {t['failed']})  "
+             f"pruned: {t['pruned']}"
+             + (f"  ({t['saved_frac'] * 100:.0f}% trials saved)"
+                if t["saved_frac"] else "")]
+    m = s["model"]
+    if m["predicted_rows"]:
+        agr = m["rank_agreement"]
+        lines.append(
+            f"  model: rank agreement "
+            f"{agr if agr is not None else 'n/a'}"
+            f"  top-1 hit: {m['top1_hit']}")
+    lines.append("")
+    lines.append(f"  {'config':40s} {'predicted':>10s} {'measured':>10s} "
+                 f"{'err':>7s}  status")
+    for r in s["rows"]:
+        pred = f"{r['predicted_ms']:.4f}" \
+            if r["predicted_ms"] is not None else "-"
+        meas = f"{r['latency_ms']:.4f}" \
+            if r["latency_ms"] is not None else "-"
+        err = "-"
+        if r["predicted_ms"] is not None and r["latency_ms"]:
+            err = f"{(r['predicted_ms'] / r['latency_ms'] - 1) * 100:+.0f}%"
+        cfg = r["config"] or "?"
+        if len(cfg) > 40:
+            cfg = cfg[:37] + "..."
+        lines.append(f"  {cfg:40s} {pred:>10s} {meas:>10s} {err:>7s}  "
+                     f"{r['status']}")
+    if "tune_cache" in s:
+        tc = s["tune_cache"]
+        lines.append("")
+        lines.append(f"  fleet tune cache @ {tc.get('root')}: "
+                     f"{tc.get('entries')} entries, "
+                     f"{tc.get('trials')} recorded trials, "
+                     f"{tc.get('merges')} merges, "
+                     f"{tc.get('quarantined')} quarantined")
+    return "\n".join(lines)
+
+
 def _load_trace(path) -> list:
     """Shared JSONL loading for the trace-consuming subcommands."""
     from ..observability import read_jsonl
@@ -766,6 +872,25 @@ def _run_verify(path, as_json: bool) -> int:
 def _run_serve(path, as_json: bool) -> int:
     records = _load_trace(path)
     _emit(summarize_serve(records), format_serve_report(records), as_json)
+    return 0
+
+
+def _run_tune(path, as_json: bool, cache_dir: Optional[str]) -> int:
+    """``analyzer tune <journal.jsonl>`` — predicted-vs-measured table
+    for one sweep journal + fleet tune-cache stats (docs/autotuning.md).
+    Works on live journals (interrupted sweeps) and on copies saved
+    before the completed sweep retired its journal."""
+    records = _load_trace(path)
+    cache_stats = None
+    try:
+        from ..autotuner.tune_cache import TuneCache
+        cache = TuneCache(cache_dir) if cache_dir else TuneCache()
+        if cache.root.is_dir():
+            cache_stats = cache.stats()
+    except Exception:   # noqa: BLE001 — stats are garnish, never a crash
+        cache_stats = None
+    _emit(summarize_tune(records, cache_stats),
+          format_tune_report(records, cache_stats), as_json)
     return 0
 
 
@@ -862,6 +987,17 @@ def main(argv=None) -> int:
                       "reason, terminal outcomes, KV slab balance, "
                       "step/queue latency (docs/serving.md)")
     p_sv.add_argument("file", help="JSONL trace file")
+    p_tn = sub.add_parser(
+        "tune", help="autotune sweep journal summary: per-config "
+                     "predicted-vs-measured latency, model rank "
+                     "agreement, trials saved by pruning, fleet "
+                     "tune-cache stats (docs/autotuning.md)")
+    p_tn.add_argument("file", help="sweep journal "
+                      "(<key>.journal.jsonl under the autotune cache "
+                      "dir)")
+    p_tn.add_argument("--cache-dir", metavar="DIR",
+                      help="fleet tune-cache root to report stats for "
+                           "(default: env.tune_cache_dir())")
     p_ln = sub.add_parser(
         "lint", help="offline static analysis of kernel modules: the "
                      "TL001-TL006 dataflow rules + TL1xx semantic "
@@ -886,7 +1022,7 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_sv, p_ln, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_tn, p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -898,6 +1034,8 @@ def main(argv=None) -> int:
         return _run_verify(args.file, args.json)
     if args.cmd == "serve":
         return _run_serve(args.file, args.json)
+    if args.cmd == "tune":
+        return _run_tune(args.file, args.json, args.cache_dir)
     if args.cmd == "lint":
         return _run_lint(args.targets, args.json, args.out)
     return _run_perf_diff(args.baseline, args.current, args.json,

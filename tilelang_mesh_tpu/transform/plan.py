@@ -1231,3 +1231,142 @@ def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt],
         return 0, {}
     arena, offsets = packed
     return arena, {idx_of[i]: offsets[i] for i in range(n)}
+
+
+# ---------------------------------------------------------------------------
+# compile-time cost features (autotuner/cost_model.py; docs/autotuning.md)
+# ---------------------------------------------------------------------------
+
+#: bump when the feature dict's keys or semantics change — the cost
+#: model refuses to mix samples across feature schemas, and stale
+#: journal/tune-cache features are skipped instead of misfit
+FEATURES_VERSION = 1
+
+
+def plan_features(func: PrimFunc, plan: KernelPlan) -> dict:
+    """Arch-independent cost features of one planned kernel, derived
+    entirely from the traced IR and this plan — nothing executes.
+
+    These are the raw quantities the autotuner's analytic cost model
+    (autotuner/cost_model.py) combines with a ``carver/arch.py`` machine
+    model at predict time: total MXU FLOPs and global<->VMEM traffic
+    with loop/grid multiplicity (the roofline numerators), the
+    liveness-packed scratch arena plus resident BlockSpec windows (the
+    TL005 interval model's footprint, post tile-opt repack since the
+    plan is built AFTER the rewrites), grid step count, and block shape
+    descriptors. ``engine/lower.py`` attaches the dict to
+    ``CompiledArtifact.attrs["features"]`` (adding the tile-opt dbuf
+    chain count), so features ride the crash-safe artifact cache and are
+    available without re-planning.
+    """
+    from ..ir import dtype_bits
+    grid_steps = 1
+    for a in plan.grid:
+        grid_steps *= max(1, a.extent)
+    flops = [0]
+    copy_bytes = [0]
+    vpu = [0]
+    kn = func.kernel_node()
+    # walk multiplicity starts from the KERNEL grid (T.Kernel vars), not
+    # plan.grid: a pipelined loop that plan promoted onto the dispatch
+    # grid still appears as a ForNest in the body and multiplies there —
+    # basing the walk on plan.grid would double-count it
+    kn_mult = 1
+    if kn is not None:
+        for e in kn.extents:
+            kn_mult *= max(1, int(e))
+
+    def visit(s, mult):
+        if isinstance(s, ForNest):
+            exts = [as_int(e) or 1 for e in s.extents]
+            prod = 1
+            for e in exts:
+                prod *= e
+            if s.kind == "parallel":
+                vpu[0] += prod * mult
+            else:
+                mult *= prod
+            for c in s.body.stmts:
+                visit(c, mult)
+        elif isinstance(s, SeqStmt):
+            for c in s.stmts:
+                visit(c, mult)
+        elif isinstance(s, KernelNode):
+            for c in s.body.stmts:
+                visit(c, mult)
+        elif isinstance(s, IfThenElse):
+            for c in s.then_body.stmts:
+                visit(c, mult)
+            if s.else_body is not None:
+                for c in s.else_body.stmts:
+                    visit(c, mult)
+        elif isinstance(s, GemmStmt):
+            a_sh = s.A.static_shape()
+            c_sh = s.C.static_shape()
+            if a_sh and c_sh:
+                k = a_sh[0] if s.trans_A else a_sh[-1]
+                flops[0] += 2 * c_sh[-2] * c_sh[-1] * k * mult
+        elif isinstance(s, (CopyStmt, AsyncCopyStmt)):
+            src, dst = s.src, s.dst
+            if isinstance(src, Region) and isinstance(dst, Region) and \
+                    (src.buffer.scope == "global"
+                     or dst.buffer.scope == "global"):
+                n = src.numel() or dst.numel() or 0
+                copy_bytes[0] += n * dtype_bits(src.dtype) // 8 * mult
+        elif isinstance(s, (ReduceStmt, CumSumStmt)):
+            r = getattr(s, "src", None)
+            if isinstance(r, Region):
+                vpu[0] += (r.numel() or 0) * mult
+
+    if kn is not None:
+        for s in kn.body.stmts:
+            visit(s, kn_mult)
+
+    # BlockSpec streaming: each block-mode param's window is fetched
+    # (or written back) once per grid step; smem-promoted params stage
+    # fully once. The max() with the explicit-copy count covers both
+    # idioms — elementwise kernels move data through BlockSpecs with no
+    # CopyStmt, staged GEMMs through copies the params alias.
+    block_resident = 0
+    stream_bytes = 0
+    best_block: Tuple[int, Tuple[int, ...]] = (0, ())
+    for p in plan.params:
+        if p.mode == "block" and p.block_dims:
+            b = _block_param_bytes(p, plan.grid)
+            block_resident += b
+            stream_bytes += b * grid_steps
+            sizes = tuple(d.size for d in p.block_dims
+                          if d.size is not None)
+            if b > best_block[0]:
+                best_block = (b, sizes)
+        elif p.mode == "smem":
+            shape = p.buffer.static_shape()
+            if shape:
+                n = 1
+                for d in shape:
+                    n *= d
+                stream_bytes += n * dtype_bits(p.buffer.dtype) // 8
+    hbm_bytes = max(copy_bytes[0], stream_bytes)
+
+    sizes = best_block[1] or (1,)
+    rows = 1
+    for d in sizes[:-1]:
+        rows *= d
+    cols = sizes[-1]
+    skew = max(rows, cols) / max(1, min(rows, cols))
+    return {
+        "version": FEATURES_VERSION,
+        "flops": int(flops[0]),
+        "hbm_bytes": int(hbm_bytes),
+        "vpu_elems": int(vpu[0]),
+        "grid_steps": int(grid_steps),
+        "vmem_arena": int(plan.vmem_arena),
+        "vmem_block_bytes": int(block_resident),
+        "n_scratch": len(plan.scratch),
+        "n_params": len(plan.params),
+        "pipelined": 1 if plan.pipeline_axis is not None else 0,
+        "block_rows": int(rows),
+        "block_cols": int(cols),
+        "block_skew": float(round(skew, 4)),
+        "dbuf_chains": 0,          # engine/lower.py fills from tile-opt
+    }
