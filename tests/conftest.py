@@ -17,6 +17,10 @@ _CACHE_TMP = tempfile.mkdtemp(prefix="tltpu-test-cache-")
 os.environ.setdefault("TL_TPU_CACHE_DIR", os.path.join(_CACHE_TMP, "kernels"))
 os.environ.setdefault("TL_TPU_AUTOTUNE_CACHE_DIR",
                       os.path.join(_CACHE_TMP, "autotune"))
+# ... and the trace dir: the always-on flight recorder dumps its black
+# box under <trace dir>/flight on injected failures, which must land in
+# the test sandbox, never the user's home
+os.environ.setdefault("TL_TPU_TRACE_DIR", os.path.join(_CACHE_TMP, "trace"))
 
 import pytest
 

@@ -121,14 +121,18 @@ class TestTracerCore:
         t.inc("still.counted")
         assert t.counters()["still.counted"] == 1
 
-    def test_event_cap_drops_and_counts(self, monkeypatch):
+    def test_event_cap_evicts_oldest_and_counts(self, monkeypatch):
         monkeypatch.setenv("TL_TPU_TRACE", "1")
         monkeypatch.setenv("TL_TPU_TRACE_MAX_EVENTS", "3")
         t = obs.get_tracer()
         for i in range(10):
             t.event(f"e{i}", "test")
-        assert len(t.events()) == 3
-        assert t.counters()["trace.dropped_events"] == 7
+        evs = t.events()
+        assert len(evs) == 3
+        # ring semantics: the NEWEST events survive (a long serving
+        # soak keeps its most recent history), the oldest are evicted
+        assert [e["name"] for e in evs] == ["e7", "e8", "e9"]
+        assert t.counters()["trace.dropped"] == 7
 
     def test_reset_clears_state(self, monkeypatch):
         monkeypatch.setenv("TL_TPU_TRACE", "1")

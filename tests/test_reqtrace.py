@@ -1,0 +1,665 @@
+"""tl-scope suite: per-request causal tracing, flight recorder, SLO
+engine, telemetry endpoint, and the analyzer request/dash views
+(docs/observability.md).
+
+Five layers, mirroring the subsystem:
+
+1. **Chains** — RequestTrace span/parent discipline, completeness
+   audits (leaked spans, forged parents), the bounded registry's
+   completed-first eviction, contextvar propagation into the tracer.
+2. **Serving propagation** — trace ids surviving re-queue, retry,
+   device-loss failover, and mesh reshard; causal completeness on the
+   full 500-request chaos soak at DEFAULTS (flight on, TL_TPU_TRACE
+   off).
+3. **Flight recorder** — ring eviction, the off switch, dump-on-
+   failure naming victim trace ids, and atomicity under injected
+   ``cache.disk.write`` faults.
+4. **SLO engine** — window math on synthetic samples, burn-rate breach
+   edges, the opt-in admission consult, knob validation.
+5. **Surfaces** — the HTTP endpoint's four routes (scrape parses as
+   exposition format), Prometheus label-value escaping round-trip,
+   Chrome-trace flow events, ``analyzer request`` / ``analyzer dash``.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import flight, reqtrace
+from tilelang_mesh_tpu.observability.histogram import Histogram
+from tilelang_mesh_tpu.observability.slo import SLOEngine, parse_windows
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                       PagedKVAllocator, ServingEngine)
+
+H, D, PS = 2, 64, 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Chains/flight/SLO are process singletons; every test starts from
+    a clean slate (the conftest fixture resets resilience, not these)."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def make_engine(n_pages=64, batch_buckets=(4,), page_buckets=(2, 4),
+                **kw):
+    alloc = PagedKVAllocator(n_pages=n_pages, page_size=PS, heads=H,
+                             head_dim=D)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=batch_buckets,
+                             page_buckets=page_buckets)
+    return ServingEngine(wl, **kw), alloc
+
+
+# ---------------------------------------------------------------------------
+# 1. chains
+# ---------------------------------------------------------------------------
+
+def test_chain_parent_links_and_completeness():
+    tr = reqtrace.start_trace("request", req=1)
+    s1 = tr.span("submit")
+    tr.close_span(s1)
+    s2 = tr.span("decode.step")
+    tr.close_span(s2)
+    assert not tr.complete            # no terminal yet
+    tr.finish("result")
+    assert tr.complete
+    spans = tr.to_dict()["spans"]
+    assert [sp["parent"] for sp in spans] == [None, s1]
+
+
+def test_chain_leaked_span_fails_completeness():
+    tr = reqtrace.start_trace("request")
+    tr.span("decode.step")            # never closed by its owner
+    tr.finish("result")
+    assert tr.terminal == "result"
+    assert not tr.complete            # the leak is the finding
+    leaked = [sp for sp in tr.to_dict()["spans"]
+              if sp["attrs"].get("leaked")]
+    assert len(leaked) == 1
+
+
+def test_chain_forged_parent_fails_chain_ok():
+    tr = reqtrace.start_trace("request")
+    tr.span("a", parent=999)          # parent that never existed
+    assert not tr.chain_ok()
+
+
+def test_mark_is_zero_duration_and_chained():
+    tr = reqtrace.start_trace("request")
+    a = tr.span("submit")
+    tr.close_span(a)
+    tr.mark("requeue", retries=1)
+    d = tr.to_dict()
+    m = d["spans"][-1]
+    assert m["name"] == "requeue" and m["t1"] is not None
+    assert m["parent"] == a
+
+
+def test_close_span_idempotent_and_trace_ids_unique():
+    tr = reqtrace.start_trace("request")
+    s = tr.span("x")
+    tr.close_span(s, outcome="ok")
+    tr.close_span(s, outcome="clobbered")     # dropped, not applied
+    assert tr.to_dict()["spans"][0]["attrs"]["outcome"] == "ok"
+    ids = {reqtrace.start_trace("request").trace_id for _ in range(50)}
+    assert len(ids) == 50
+
+
+def test_long_lived_chain_bounds_spans():
+    """The engine trace records one batch span per step forever: a
+    max_spans bound keeps the tail, drops the ancient history, and the
+    chain stays well-formed (evicted parents resolve)."""
+    tr = reqtrace.start_trace("engine", kind="engine", max_spans=10)
+    for i in range(50):
+        s = tr.span("serve.batch", batch=i)
+        tr.close_span(s)
+    d = tr.to_dict()
+    assert len(d["spans"]) == 10 and d["dropped"] == 40
+    assert [sp["attrs"]["batch"] for sp in d["spans"]] == \
+        list(range(40, 50))                  # newest history survives
+    assert tr.chain_ok()                     # evicted parents resolve
+
+
+def test_serving_engine_trace_is_bounded():
+    eng, _ = make_engine()
+    assert eng.trace.max_spans > 0
+
+
+def test_registry_evicts_completed_first(monkeypatch):
+    monkeypatch.setenv("TL_TPU_REQTRACE_MAX", "3")
+    done = reqtrace.start_trace("request", tag="done")
+    done.finish("result")
+    live = [reqtrace.start_trace("request", tag=f"live{i}")
+            for i in range(3)]
+    # the completed chain was evicted; all live chains survive
+    assert reqtrace.get_trace(done.trace_id) is None
+    assert all(reqtrace.get_trace(t.trace_id) is not None for t in live)
+    assert reqtrace.evicted() == 1
+
+
+def test_bind_tags_tracer_records(monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    tr = reqtrace.start_trace("request")
+    sid = tr.span("decode.step")
+    with reqtrace.bind(tr.trace_id, sid):
+        obs.event("kernel.dispatch", "test", kernel="k")
+        with obs.span("inner", "test"):
+            pass
+    obs.event("outside", "test")
+    evs = {e["name"]: e for e in obs.get_tracer().events()}
+    assert evs["kernel.dispatch"]["attrs"]["trace_id"] == tr.trace_id
+    assert evs["kernel.dispatch"]["attrs"]["parent_span"] == sid
+    assert evs["inner"]["attrs"]["trace_id"] == tr.trace_id
+    assert "trace_id" not in evs["outside"]["attrs"]
+
+
+# ---------------------------------------------------------------------------
+# 2. serving propagation
+# ---------------------------------------------------------------------------
+
+def test_request_chain_through_decode_steps():
+    eng, _ = make_engine()
+    r = eng.submit(context_tokens=16, new_tokens=3)
+    eng.run()
+    assert r.outcome == "result" and r.trace.complete
+    names = [sp["name"] for sp in r.trace.to_dict()["spans"]]
+    assert names[0] == "submit"
+    assert names.count("decode.step") == 3
+    assert names.count("requeue") == 2        # between the 3 steps
+
+
+def test_shed_request_chain_closes():
+    eng, _ = make_engine()
+    eng.drain()
+    r = eng.submit(context_tokens=16)
+    assert r.outcome == "shed" and r.trace.complete
+    assert r.trace.terminal_attrs["shed_reason"] == "draining"
+
+
+def test_trace_id_survives_retry():
+    eng, _ = make_engine()
+    r = eng.submit(context_tokens=16, new_tokens=1)
+    tid = r.trace_id
+    with inject("serve.step", times=1, kind="transient"):
+        eng.run()
+    assert r.outcome == "result"
+    assert r.trace_id == tid
+    d = r.trace.to_dict()
+    marks = [sp for sp in d["spans"] if sp["name"] == "requeue"]
+    assert any(m["attrs"].get("retries", 0) >= 1 for m in marks)
+    assert r.trace.complete
+
+
+def test_trace_id_survives_device_loss_failover():
+    eng, _ = make_engine()
+    r = eng.submit(context_tokens=16, new_tokens=1)
+    with inject("device.dispatch", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert r.outcome == "result" and r.trace.complete
+
+
+def test_trace_id_survives_reshard():
+    from tilelang_mesh_tpu.serving import MeshDecodeWorkload
+    alloc = PagedKVAllocator(n_pages=64, page_size=PS, heads=H,
+                             head_dim=D)
+    wl = MeshDecodeWorkload(alloc, batch_buckets=(4,), page_buckets=(2,))
+    eng = ServingEngine(wl, name="reshard-trace")
+    rs = [eng.submit(context_tokens=16, new_tokens=2) for _ in range(3)]
+    with inject("serve.shard", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    assert eng.reshards >= 1
+    for r in rs:
+        assert r.outcome == "result" and r.trace.complete
+    resharded = [r for r in rs
+                 if any(sp["name"] == "reshard"
+                        for sp in r.trace.to_dict()["spans"])]
+    assert resharded, "the slice loss must land in survivor chains"
+
+
+def test_batch_step_links_member_traces(monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    eng, _ = make_engine()
+    rs = [eng.submit(context_tokens=16) for _ in range(3)]
+    eng.run()
+    batches = [e for e in obs.get_tracer().events()
+               if e.get("type") == "span"
+               and e.get("name") == "serve.batch"]
+    assert batches
+    linked = set().union(*(set(b["attrs"]["links"]) for b in batches))
+    assert {r.trace_id for r in rs} <= linked
+    # the engine-trace context tags the dispatch event underneath
+    disp = [e for e in obs.get_tracer().events()
+            if e.get("name") == "serve.dispatch"]
+    assert disp and disp[0]["attrs"]["trace_id"] == eng.trace.trace_id
+
+
+@pytest.mark.slow
+def test_causal_completeness_on_500_request_soak(tmp_path):
+    """The ISSUE 13 acceptance gate, run exactly as CI runs it: the
+    500-request chaos soak at DEFAULTS (flight recorder on,
+    TL_TPU_TRACE off) must exit 0 with every tl-scope check green."""
+    from tilelang_mesh_tpu.verify import chaos
+    rc = chaos.run_serve(tmp_path, seed=13, n_requests=500)
+    assert rc == 0
+    report = json.loads((tmp_path / "serve_report.json").read_text())
+    assert report["checks"]["causal_chains_complete"]
+    assert report["checks"]["device_loss_flight_dump_names_victims"]
+    assert report["checks"]["flight_dumps_atomic"]
+    assert report["causally_incomplete_requests"] == []
+
+
+# ---------------------------------------------------------------------------
+# 3. flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_evicts_oldest(monkeypatch):
+    monkeypatch.setenv("TL_TPU_FLIGHT_RING", "8")
+    for i in range(20):
+        flight.note_event(f"e{i}", "test", {})
+    recs = flight.records()
+    assert len(recs) == 8
+    assert [r["name"] for r in recs] == [f"e{i}" for i in range(12, 20)]
+
+
+def test_flight_off_switch(monkeypatch, tmp_path):
+    monkeypatch.setenv("TL_TPU_FLIGHT", "0")
+    flight.note_event("e", "test", {})
+    assert flight.records() == []
+    flight.configure(dump_dir=tmp_path)
+    assert flight.dump("anything") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_captures_counters_and_traced_spans(monkeypatch):
+    obs.inc("some.counter", 2, site="x")
+    kinds = {r["k"] for r in flight.records()}
+    assert "counter" in kinds
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    with obs.span("phase", "test"):
+        pass
+    assert any(r["k"] == "span" and r["name"] == "phase"
+               for r in flight.records())
+
+
+def test_flight_dump_on_step_failure_names_victims(tmp_path):
+    flight.configure(dump_dir=tmp_path)
+    eng, _ = make_engine()
+    rs = [eng.submit(context_tokens=16) for _ in range(2)]
+    with inject("device.dispatch", kind="unreachable", times=1):
+        eng.step()
+    eng.run()
+    dumps = sorted(tmp_path.glob("flight_*.jsonl"))
+    heads = [json.loads(p.read_text().splitlines()[0]) for p in dumps]
+    victims = [h for h in heads if h["reason"] == "step_failure"
+               and h["attrs"]["kind"] == "device_loss"]
+    assert victims, [h["reason"] for h in heads]
+    assert set(victims[0]["attrs"]["batch_trace_ids"]) == \
+        {r.trace_id for r in rs}
+    # the dump is a full black box: ring + counter snapshot
+    lines = [json.loads(ln) for ln in
+             dumps[0].read_text().splitlines() if ln.strip()]
+    assert lines[0]["schema"] == flight.FLIGHT_SCHEMA
+    assert any(r.get("type") == "counter" for r in lines)
+    assert any(r.get("type") == "flight_record" for r in lines)
+
+
+def test_flight_dump_atomic_under_disk_write_fault(tmp_path):
+    flight.configure(dump_dir=tmp_path)
+    rec = flight.get_flight()
+    with inject("cache.disk.write", kind="oserror"):
+        assert flight.dump("step_failure") is None
+    assert rec.dump_errors == 1
+    # atomicity: NOTHING on disk — no target, no torn tmp file
+    assert list(tmp_path.iterdir()) == []
+    # and the next dump (fault cleared) succeeds
+    assert flight.dump("step_failure") is not None
+    assert len(list(tmp_path.glob("flight_*.jsonl"))) == 1
+
+
+def test_flight_dump_per_reason_cap(tmp_path):
+    """A flapping failure source must not fill the disk: past the
+    per-reason ceiling dumps are counted, not written."""
+    flight.configure(dump_dir=tmp_path)
+    rec = flight.get_flight()
+    cap = rec.MAX_DUMPS_PER_REASON
+    for _ in range(cap + 5):
+        flight.dump("step_failure")
+    assert flight.dump("slo_breach") is not None   # other reasons live
+    assert rec.dumps == cap + 1
+    assert rec.dumps_capped == 5
+    assert len(list(tmp_path.glob("flight_*step_failure*"))) == cap
+
+
+def test_selfcheck_divergence_dumps_flight(tmp_path, monkeypatch):
+    """The verify-layer triggers share the same black box: a corrupted
+    collective schedule caught by the differential selfcheck dumps."""
+    monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+    flight.configure(dump_dir=tmp_path)
+    import numpy as np
+    import tilelang_mesh_tpu as tilelang
+    import tilelang_mesh_tpu.language as T
+    from tilelang_mesh_tpu.parallel import mesh_config
+    with mesh_config(2, 2):
+        @T.prim_func
+        def ft_fused(A: T.MeshTensor((2 * 2 * 8, 128),
+                                     T.MeshShardingPolicy(cross_mesh_dim=0),
+                                     (2, 2), "float32"),
+                     B: T.MeshTensor((2 * 2 * 8, 1),
+                                     T.MeshShardingPolicy(cross_mesh_dim=0),
+                                     (2, 2), "float32"),
+                     C: T.MeshTensor((2 * 2 * 8, 1),
+                                     T.MeshShardingPolicy(cross_mesh_dim=0),
+                                     (2, 2), "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment((8, 128), "float32")
+                y = T.alloc_fragment((8, 128), "float32")
+                o1 = T.alloc_fragment((8, 1), "float32")
+                o2 = T.alloc_fragment((8, 1), "float32")
+                T.copy(A, x)
+                T.copy(A, y)
+                T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                T.comm.all_reduce(y, o2, "sum", "h", dim=1)
+                T.copy(o1, B)
+                T.copy(o2, C)
+        k = tilelang.compile(ft_fused, target="cpu-mesh[2x2]")
+    a = np.random.default_rng(0).standard_normal((32, 128)).astype(
+        np.float32)
+    with inject("comm.fused", kind="corrupt", seed=7):
+        k(a)
+    dumps = list(tmp_path.glob("flight_*selfcheck_divergence*.jsonl"))
+    assert dumps, list(tmp_path.iterdir())
+
+
+# ---------------------------------------------------------------------------
+# 4. SLO engine
+# ---------------------------------------------------------------------------
+
+def _sample(t, submitted, shed, hist=None, completed=0):
+    return {"t": t, "submitted": float(submitted), "shed": float(shed),
+            "completed": float(completed), "failed": 0.0,
+            "deadline_exceeded": 0.0, "hist": hist}
+
+
+def test_slo_window_availability_and_burn():
+    s = SLOEngine(windows=[10.0], target=0.99)
+    s.add(_sample(0.0, 0, 0))
+    s.add(_sample(5.0, 100, 5))
+    w = s.window_stats(10.0)
+    assert w["submitted"] == 100 and w["shed"] == 5
+    assert w["availability"] == pytest.approx(0.95)
+    # burn = (1 - 0.95) / (1 - 0.99) = 5x the budgeted error rate
+    assert w["burn_rate"] == pytest.approx(5.0)
+
+
+def test_slo_window_uses_edge_sample_not_lifetime():
+    s = SLOEngine(windows=[10.0], target=0.99)
+    s.add(_sample(0.0, 1000, 900))       # ancient history: terrible
+    s.add(_sample(100.0, 1000, 900))     # window edge
+    s.add(_sample(105.0, 1100, 900))     # last 10s: 100 clean submits
+    w = s.window_stats(10.0)
+    assert w["submitted"] == 100 and w["shed"] == 0
+    assert w["availability"] == 1.0 and w["burn_rate"] == 0.0
+
+
+def test_slo_window_p99_is_deltaed():
+    base = Histogram()
+    for _ in range(100):
+        base.observe(0.001)               # ancient fast steps
+    cur = Histogram()
+    cur.merge(base)
+    for _ in range(100):
+        cur.observe(1.0)                  # the window's slow steps
+    s = SLOEngine(windows=[10.0], target=0.99)
+    s.add(_sample(0.0, 0, 0, hist=base))
+    s.add(_sample(5.0, 10, 0, hist=cur))
+    p99 = s.window_stats(10.0)["p99_ms"]
+    assert p99 is not None and p99 > 100     # the old fast steps are out
+
+
+def test_slo_breach_edge_fires_once(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SLO_BURN_MAX", "2.0")
+    s = SLOEngine(windows=[10.0], target=0.99)
+    s.add(_sample(0.0, 0, 0))
+    s.add(_sample(5.0, 100, 50))          # burn 50x: breach
+    assert s.check_breach() is not None
+    assert s.check_breach() is None       # same episode: no re-fire
+    # the bad window ages out entirely: the last 10s are clean
+    s.add(_sample(100.0, 1100, 50))
+    assert s.check_breach() is None       # recovered: episode closed
+    s.add(_sample(109.0, 1200, 150))      # fresh sheds: new episode
+    assert s.check_breach() is not None
+    assert s.breaches == 2
+
+
+def test_slo_admission_consult(monkeypatch):
+    from tilelang_mesh_tpu.observability.slo import get_slo
+    from tilelang_mesh_tpu.serving import AdmissionController
+    monkeypatch.setenv("TL_TPU_SLO_ADMIT", "1")
+    monkeypatch.setenv("TL_TPU_SLO_BURN_MAX", "2.0")
+    s = get_slo()
+    s.add(_sample(0.0, 0, 0))
+    s.add(_sample(5.0, 100, 50))
+    ok, reason = AdmissionController().decide(
+        draining=False, queue_depth=0, free_pages=10, pages_needed=1,
+        remaining_s=None, steps_requested=1)
+    assert not ok and reason == "overload"
+    monkeypatch.setenv("TL_TPU_SLO_ADMIT", "0")
+    ok, _ = AdmissionController().decide(
+        draining=False, queue_depth=0, free_pages=10, pages_needed=1,
+        remaining_s=None, steps_requested=1)
+    assert ok
+
+
+def test_slo_windows_typo_raises(monkeypatch):
+    with pytest.raises(ValueError):
+        parse_windows("30,oops")
+    with pytest.raises(ValueError):
+        parse_windows("-5")
+    assert parse_windows("300,30") == [30.0, 300.0]
+
+
+def test_metrics_summary_has_tl_scope_sections():
+    eng, _ = make_engine()
+    eng.submit(context_tokens=16)
+    eng.run()
+    m = obs.metrics_summary()
+    assert m["slo"]["target"] == pytest.approx(0.999)
+    assert m["flight"]["enabled"] is True
+    assert m["reqtrace"]["terminal"] == 1
+    assert m["reqtrace"]["complete"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. surfaces: endpoint, escaping, flow events, analyzer
+# ---------------------------------------------------------------------------
+
+_EXPO_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                       # metric name
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*",?)*\})?'  # labels
+    r' [0-9eE+.\-]+(inf|nan)?$')
+
+
+def _assert_valid_exposition(text: str) -> int:
+    n = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _EXPO_LINE.match(line), f"unparseable exposition: {line!r}"
+        n += 1
+    return n
+
+
+def test_endpoint_routes_and_scrape_parse():
+    from tilelang_mesh_tpu.observability import server
+    eng, _ = make_engine()
+    eng.submit(context_tokens=16)
+    eng.run()
+    srv = server.start_server(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"{srv.url}{path}", timeout=5) as r:
+                return r.status, r.read().decode()
+        code, metrics = get("/metrics")
+        assert code == 200
+        assert _assert_valid_exposition(metrics) > 0
+        assert "tl_tpu_serve_completed" in metrics
+        code, health = get("/healthz")
+        assert code == 200 and json.loads(health)["ok"] is True
+        code, slo = get("/slo")
+        assert code == 200
+        assert json.loads(slo)["target"] == pytest.approx(0.999)
+        code, fl = get("/flight")
+        assert code == 200
+        assert json.loads(fl)["enabled"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_endpoint_off_by_default(monkeypatch):
+    from tilelang_mesh_tpu.observability import server
+    monkeypatch.delenv("TL_TPU_METRICS_PORT", raising=False)
+    assert server.maybe_start() is None
+
+
+def test_prometheus_label_escaping_round_trip():
+    """Adversarial label values — quotes, backslashes, newlines (kernel
+    names are user strings) — must survive exposition + unescape."""
+    evil = 'kernel "with" \\backslash\\ and\nnewline'
+    obs.inc("escape.test", kernel=evil)
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    _hist.observe("kernel.latency", 0.001, kernel=evil, source="test")
+    text = obs.to_prometheus_text()
+    _assert_valid_exposition(text)
+    m = re.search(r'tl_tpu_escape_test\{kernel="((?:\\.|[^"\\])*)"\} 1',
+                  text)
+    assert m, text
+    unescaped = (m.group(1).replace("\\n", "\n").replace('\\"', '"')
+                 .replace("\\\\", "\\"))
+    assert unescaped == evil
+
+
+def test_chrome_trace_flow_events(monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    eng, _ = make_engine()
+    eng.submit(context_tokens=16, new_tokens=2)
+    eng.run()
+    ct = obs.to_chrome_trace()
+    phases = {}
+    for e in ct["traceEvents"]:
+        phases.setdefault(e["ph"], []).append(e)
+    # flow start + steps connect the request's chain and the batch
+    # spans that served it into one arrow chain
+    assert "s" in phases and ("t" in phases or "f" in phases)
+    flow_ids = {e["id"] for e in phases["s"]}
+    assert all(isinstance(i, int) and i > 0 for i in flow_ids)
+    json.dumps(ct)     # the whole object must stay serializable
+
+
+def test_jsonl_trace_context_schema_and_versioning(tmp_path):
+    eng, _ = make_engine()
+    r = eng.submit(context_tokens=16)
+    eng.run()
+    path = tmp_path / "t.jsonl"
+    obs.write_jsonl(path)
+    recs = obs.read_jsonl(path)
+    header = [x for x in recs if x.get("type") == "trace_context"]
+    assert header and header[0]["schema"] == obs.REQTRACE_SCHEMA
+    chains = [x for x in recs if x.get("type") == "reqtrace"]
+    assert any(c["trace_id"] == r.trace_id and c["complete"]
+               for c in chains)
+    # a future-schema chain is skipped, not misread
+    from tilelang_mesh_tpu.tools.analyzer import summarize_request
+    alien = dict(chains[0], schema=99, trace_id="alien-1")
+    s = summarize_request(recs + [alien])
+    assert s["skipped_other_schema"] == 1
+    assert all(row["trace_id"] != "alien-1" for row in s["traces"])
+
+
+def test_analyzer_request_views(tmp_path, capsys):
+    from tilelang_mesh_tpu.tools import analyzer
+    eng, _ = make_engine()
+    r = eng.submit(context_tokens=16, new_tokens=2)
+    eng.run()
+    path = tmp_path / "t.jsonl"
+    obs.write_jsonl(path)
+    assert analyzer.main(["request", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert r.trace_id in out and "request traces" in out
+    assert analyzer.main(["request", str(path),
+                          "--trace-id", r.trace_id]) == 0
+    out = capsys.readouterr().out
+    assert "decode.step" in out and "submit" in out
+    assert analyzer.main(["request", str(path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schema"] == obs.REQTRACE_SCHEMA
+
+
+def _round(tmp_path, name, n, rc, records):
+    tail = "\n".join(json.dumps(r) for r in records)
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": n, "cmd": "bench", "rc": rc,
+                             "tail": tail}))
+    return str(p)
+
+
+def test_analyzer_dash_flags_regressions_and_missing(tmp_path, capsys):
+    from tilelang_mesh_tpu.tools import analyzer
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps([
+        {"config": "k", "latency_p50_ms": 1.0, "latency_mad_ms": 0.01}]))
+    r1 = _round(tmp_path, "BENCH_r01.json", 1, 0,
+                [{"config": "k", "latency_p50_ms": 1.02,
+                  "latency_mad_ms": 0.01}])
+    r2 = _round(tmp_path, "BENCH_r02.json", 2, 0,
+                [{"config": "k", "latency_p50_ms": 3.0,
+                  "latency_mad_ms": 0.01}])
+    r3 = _round(tmp_path, "BENCH_r03.json", 3, 1,
+                [{"config": "k",
+                  "error": "skipped: TPU worker unreachable"}])
+    assert analyzer.main(["dash", r1, r2, r3,
+                          "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "missing-not-regressed" in out     # the rc=1 round
+    assert "REGRESSED: k" in out              # the genuine 3x slowdown
+    assert analyzer.main(["dash", r1, r2, r3, "--baseline", str(base),
+                          "--json"]) == 0
+    dash = json.loads(capsys.readouterr().out)
+    cells = dash["configs"]["k"]["cells"]
+    assert [c["verdict"] for c in cells] == \
+        ["ok", "REGRESSION", "missing-not-regressed"]
+    assert dash["rounds"][2]["status"] == "missing-not-regressed"
+    assert dash["regressions"] == ["k"]
+
+
+def test_analyzer_dash_checked_in_rounds(capsys):
+    """The acceptance gate: the repo's own BENCH_r0*.json render, and
+    the rc=1 rounds r03-r05 read missing-not-regressed."""
+    import glob
+    from pathlib import Path
+
+    from tilelang_mesh_tpu.tools import analyzer
+    repo = Path(__file__).resolve().parent.parent
+    rounds = sorted(glob.glob(str(repo / "BENCH_r0*.json")))
+    assert len(rounds) >= 5
+    assert analyzer.main(["dash", *rounds, "--baseline",
+                          str(repo / ".github" / "perf_baseline.json"),
+                          "--json"]) == 0
+    dash = json.loads(capsys.readouterr().out)
+    by_label = {r["label"]: r for r in dash["rounds"]}
+    for lbl in ("r03", "r04", "r05"):
+        assert by_label[lbl]["rc"] == 1
+        assert by_label[lbl]["status"] == "missing-not-regressed"
+    assert dash["regressions"] == []          # missing is never regressed
+    assert analyzer.main(["dash", *rounds]) == 0
+    assert "missing-not-regressed" in capsys.readouterr().out

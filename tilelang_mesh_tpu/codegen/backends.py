@@ -458,11 +458,18 @@ class BackendRegistry:
     def note_failover(self, *, frm: str, to: str, kernel: str,
                       during: str, error: BaseException) -> None:
         """The one place a failover is recorded: degraded-class event +
-        counter, shared by JITKernel, MeshKernel, and bench."""
+        counter, shared by JITKernel, MeshKernel, and bench — plus one
+        flight-recorder black box per hop (device loss is a dump
+        trigger; the jit dispatch path reaches here on every warm
+        failover, so the post-mortem exists even untraced)."""
         _trace.inc("backend.failover", frm=frm, to=to)
         _trace.inc("resilience.degraded")
         _trace.event("backend.failover", "resilience", kernel=kernel,
                      frm=frm, to=to, during=during,
+                     error=f"{type(error).__name__}: {error}")
+        from ..observability import flight as _flight
+        _flight.dump("device_loss", kernel=kernel, frm=frm, to=to,
+                     during=during,
                      error=f"{type(error).__name__}: {error}")
 
     def snapshot(self) -> dict:

@@ -139,6 +139,35 @@ class Environment:
     TL_TPU_TRACE_DIR = EnvVar(
         "TL_TPU_TRACE_DIR", str(Path.home() / ".tilelang_mesh_tpu" / "trace"))
     TL_TPU_TRACE_MAX_EVENTS = EnvVar("TL_TPU_TRACE_MAX_EVENTS", 100_000, int)
+    # tl-scope request tracing (observability/reqtrace.py; docs/
+    # observability.md): bound on the per-request causal-trace registry
+    # — oldest completed chains are evicted past it
+    TL_TPU_REQTRACE_MAX = EnvVar("TL_TPU_REQTRACE_MAX", 8192, int)
+    # flight recorder (observability/flight.py): always-on bounded ring
+    # of recent events/counter deltas, atomically dumped as a
+    # post-mortem JSONL on step failure / SelfCheckDivergence /
+    # MeshVerifyError / watchdog timeout / device loss / SLO breach.
+    # "0" turns the black box off entirely.
+    TL_TPU_FLIGHT = EnvVar("TL_TPU_FLIGHT", True, bool)
+    TL_TPU_FLIGHT_RING = EnvVar("TL_TPU_FLIGHT_RING", 2048, int)
+    # where flight dumps land; empty derives <TL_TPU_TRACE_DIR>/flight
+    TL_TPU_FLIGHT_DIR = EnvVar("TL_TPU_FLIGHT_DIR", "")
+    # live SLO telemetry endpoint (observability/server.py): port for
+    # the stdlib HTTP server exposing /metrics /healthz /slo /flight
+    # (0 = off; a serving engine starts it lazily when set)
+    TL_TPU_METRICS_PORT = EnvVar("TL_TPU_METRICS_PORT", 0, int)
+    # SLO engine (observability/slo.py): availability target, sliding
+    # windows (comma seconds, shortest first = the fast-burn window),
+    # and the p99 latency budget (0 falls back to
+    # TL_TPU_SERVE_P99_BUDGET_MS)
+    TL_TPU_SLO_TARGET = EnvVar("TL_TPU_SLO_TARGET", 0.999, float)
+    TL_TPU_SLO_WINDOWS_S = EnvVar("TL_TPU_SLO_WINDOWS_S", "30,300")
+    TL_TPU_SLO_P99_BUDGET_MS = EnvVar("TL_TPU_SLO_P99_BUDGET_MS",
+                                      0.0, float)
+    # opt-in: admission sheds new arrivals ("overload") while the
+    # fast-burn window's error-budget burn rate exceeds the ceiling
+    TL_TPU_SLO_ADMIT = EnvVar("TL_TPU_SLO_ADMIT", False, bool)
+    TL_TPU_SLO_BURN_MAX = EnvVar("TL_TPU_SLO_BURN_MAX", 14.0, float)
     # runtime metrics (observability/runtime.py): opt-in per-kernel
     # dispatch latency histograms + ring buffers
     TL_TPU_RUNTIME_METRICS = EnvVar("TL_TPU_RUNTIME_METRICS", False, bool)
@@ -210,6 +239,12 @@ class Environment:
 
     def trace_dir(self) -> Path:
         p = Path(self.TL_TPU_TRACE_DIR)
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def flight_dir(self) -> Path:
+        raw = self.TL_TPU_FLIGHT_DIR
+        p = Path(raw) if raw else Path(self.TL_TPU_TRACE_DIR) / "flight"
         p.mkdir(parents=True, exist_ok=True)
         return p
 

@@ -237,6 +237,9 @@ class JITKernel:
         if divs:
             _trace.inc("verify.selfcheck.divergence")
             rec = self.artifact.attrs.get("tile_opt") or {}
+            from ..observability import flight as _flight
+            _flight.dump("selfcheck_divergence",
+                         kernel=self.artifact.name, divergence=list(divs))
             raise SelfCheckDivergence(
                 f"{self.artifact.name}: tile-opt selfcheck divergence vs "
                 f"the TL_TPU_TILE_OPT=0 lowering "

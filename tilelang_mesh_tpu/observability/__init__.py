@@ -24,8 +24,11 @@ recording on with ``TL_TPU_RUNTIME_METRICS=1``; see
   kernel)
 """
 
+from . import flight  # noqa: F401  (tl-scope: always-on flight recorder)
 from . import histogram as _histogram
+from . import reqtrace  # noqa: F401  (tl-scope: per-request causal tracing)
 from . import runtime as _runtime
+from . import slo as _slo
 from .tracer import (Span, Tracer, event, get_tracer, inc, span,
                      trace_enabled)
 from .tracer import reset as _tracer_reset
@@ -34,24 +37,34 @@ from .histogram import (Histogram, HistogramRegistry, default_bounds,
 from .runtime import (HIST_NAME, OVERHEAD_HIST, recent, record,
                       record_overhead, runtime_enabled, runtime_summary,
                       should_sample)
-from .export import (LOWER_PHASES, aggregate_spans, metrics_summary,
-                     read_jsonl, to_chrome_trace, to_jsonl,
-                     to_prometheus_text, write_chrome_trace, write_jsonl)
+from .export import (LOWER_PHASES, aggregate_spans, escape_label_value,
+                     metrics_summary, read_jsonl, to_chrome_trace,
+                     to_jsonl, to_prometheus_text, write_chrome_trace,
+                     write_jsonl)
+from .reqtrace import REQTRACE_SCHEMA  # noqa: F401
+from .slo import SLOEngine, get_slo, slo_summary  # noqa: F401
 
 
 def reset() -> None:
-    """Drop every recorded span, event, counter, histogram, and runtime
-    ring buffer (tests, bench children)."""
+    """Drop every recorded span, event, counter, histogram, runtime
+    ring buffer, request-trace chain, flight ring, and SLO sample
+    (tests, bench children)."""
     _tracer_reset()
     _histogram.reset()
     _runtime.reset()
+    reqtrace.reset()
+    flight.reset()
+    _slo.reset()
 
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "span", "event", "inc", "reset",
     "trace_enabled", "LOWER_PHASES", "aggregate_spans", "metrics_summary",
     "to_chrome_trace", "write_chrome_trace", "to_jsonl", "write_jsonl",
-    "read_jsonl", "to_prometheus_text",
+    "read_jsonl", "to_prometheus_text", "escape_label_value",
+    # tl-scope: request tracing, flight recorder, SLO engine
+    "reqtrace", "flight", "REQTRACE_SCHEMA", "SLOEngine", "get_slo",
+    "slo_summary",
     # histogram metric type
     "Histogram", "HistogramRegistry", "default_bounds", "get_registry",
     "get_histogram", "histograms", "observe",

@@ -28,12 +28,13 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from . import histogram as _hist
+from . import reqtrace as _reqtrace
 from . import runtime as _runtime
 from .tracer import Tracer, get_tracer
 
 __all__ = ["LOWER_PHASES", "aggregate_spans", "to_chrome_trace",
            "write_chrome_trace", "to_jsonl", "write_jsonl", "read_jsonl",
-           "to_prometheus_text", "metrics_summary"]
+           "to_prometheus_text", "escape_label_value", "metrics_summary"]
 
 # the engine/lower.py pipeline span names, in pipeline order — the ONE
 # copy every consumer (analyzer --trace, bench.py embedding, tests)
@@ -42,13 +43,40 @@ LOWER_PHASES = ("canonicalize", "checks", "tile_opt", "comm_opt", "plan",
                 "lint", "codegen", "artifact")
 
 
+def _flow_id(trace_id: str) -> int:
+    """Stable positive int id for Chrome flow binding (the format wants
+    an int-ish id; trace ids are strings)."""
+    return (hash(trace_id) & 0x7FFFFFFF) or 1
+
+
 def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
     """The recorded spans/events/counters as a Chrome-trace JSON object
-    (``json.dumps``-able, loads in Perfetto)."""
+    (``json.dumps``-able, loads in Perfetto).
+
+    tl-scope: request-trace chains (``reqtrace``) render as their own
+    lanes (one synthetic tid per trace), and *flow events* — ``s``
+    (start) / ``t`` (step) / ``f`` (finish) bound by the trace id —
+    connect each chain's spans AND every tracer span tagged with that
+    ``trace_id`` (batch steps, kernel dispatches), so one request's
+    life reads as a connected arrow chain across lanes."""
     t = tracer or get_tracer()
     pid = os.getpid()
     out: List[dict] = []
     last_ts = 0.0
+    # flow bookkeeping: per trace_id, has the flow started yet?
+    flow_started: Dict[str, bool] = {}
+
+    def _flow(trace_id: str, ts: float, tid, final: bool = False) -> None:
+        ph = "s" if not flow_started.get(trace_id) else \
+            ("f" if final else "t")
+        flow_started[trace_id] = True
+        ev = {"name": f"req:{trace_id}", "cat": "reqtrace", "ph": ph,
+              "ts": ts, "pid": pid, "tid": tid,
+              "id": _flow_id(trace_id)}
+        if ph == "f":
+            ev["bp"] = "e"
+        out.append(ev)
+
     for ev in t.events():
         last_ts = max(last_ts, ev["ts_us"])
         if ev["type"] == "span":
@@ -56,10 +84,45 @@ def to_chrome_trace(tracer: Optional[Tracer] = None) -> dict:
                         "ts": ev["ts_us"], "dur": ev["dur_us"],
                         "pid": pid, "tid": ev["tid"],
                         "args": _json_safe(ev["attrs"])})
+            tid_attr = ev["attrs"].get("trace_id")
+            if tid_attr:
+                _flow(str(tid_attr), ev["ts_us"], ev["tid"])
+            for linked in ev["attrs"].get("links") or ():
+                _flow(str(linked), ev["ts_us"], ev["tid"])
         else:
             out.append({"name": ev["name"], "cat": ev["cat"], "ph": "i",
                         "ts": ev["ts_us"], "pid": pid, "tid": ev["tid"],
                         "s": "t", "args": _json_safe(ev["attrs"])})
+    # request-trace chains: one synthetic lane per chain, flow-bound.
+    # Chain clocks are absolute time.monotonic() seconds; the tracer's
+    # spans are monotonic_ns since ITS epoch — same clock, different
+    # origin — so chain timestamps are rebased onto the tracer epoch or
+    # the flow arrows would land days away from the batch spans they
+    # bind to. (Chains recorded before the tracer's last reset() rebase
+    # negative; Perfetto clamps, and their relative order holds.)
+    epoch_us = t._t0_ns / 1e3
+    for lane, tr in enumerate(_reqtrace.traces()):
+        d = tr.to_dict()
+        spans = d["spans"]
+        if not spans:
+            continue
+        lane_tid = 1_000_000 + lane
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": lane_tid,
+                    "args": {"name": f"{d['kind']}:{d['trace_id']}"}})
+        for i, sp in enumerate(spans):
+            ts = sp["t0"] * 1e6 - epoch_us
+            dur = ((sp["t1"] or sp["t0"]) - sp["t0"]) * 1e6
+            out.append({"name": sp["name"], "cat": "reqtrace", "ph": "X",
+                        "ts": ts, "dur": dur, "pid": pid,
+                        "tid": lane_tid,
+                        "args": _json_safe({**sp["attrs"],
+                                            "trace_id": d["trace_id"],
+                                            "span_id": sp["span_id"],
+                                            "parent_span": sp["parent"]})})
+            _flow(d["trace_id"], ts, lane_tid,
+                  final=(i == len(spans) - 1
+                         and d["terminal"] is not None))
     for name, value in sorted(t.counters().items()):
         out.append({"name": name, "cat": "counter", "ph": "C",
                     "ts": last_ts, "pid": pid, "tid": 0,
@@ -76,7 +139,11 @@ def write_chrome_trace(path, tracer: Optional[Tracer] = None) -> Path:
 
 def to_jsonl(tracer: Optional[Tracer] = None) -> str:
     """One JSON object per line: every span/event in record order, then
-    one ``{"type": "counter"}`` line per counter."""
+    one ``{"type": "counter"}`` line per counter, one ``histogram``
+    line per recorded series, and — when request traces exist — a
+    versioned ``{"type": "trace_context"}`` header followed by one
+    ``{"type": "reqtrace"}`` chain per trace (the schema ``analyzer
+    request`` consumes; see docs/observability.md)."""
     t = tracer or get_tracer()
     lines = [json.dumps(_json_safe(ev)) for ev in t.events()]
     lines += [json.dumps({"type": "counter", "name": name, "value": value})
@@ -85,6 +152,13 @@ def to_jsonl(tracer: Optional[Tracer] = None) -> str:
                           "labels": dict(labels), **h.to_dict()})
               for (name, labels), h in sorted(_hist.histograms())
               if h.count]
+    chains = _reqtrace.traces()
+    if chains:
+        lines.append(json.dumps({
+            "type": "trace_context",
+            "schema": _reqtrace.REQTRACE_SCHEMA,
+            "traces": len(chains), "evicted": _reqtrace.evicted()}))
+        lines += [json.dumps(_json_safe(tr.to_dict())) for tr in chains]
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -112,6 +186,16 @@ def _prom_name(name: str) -> str:
     return _PROM_BAD.sub("_", name)
 
 
+def escape_label_value(v: str) -> str:
+    """Escape a label VALUE per the Prometheus exposition format:
+    backslash, double-quote, and newline must be escaped (in that
+    order — escaping the escapes first keeps the round-trip exact).
+    Kernel names are user strings; an unescaped quote in one used to
+    produce an unparseable exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
     """Counters and per-span-name duration aggregates in the Prometheus
     exposition format, prefixed ``tl_tpu_``."""
@@ -127,7 +211,9 @@ def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
         lines.append(f"# TYPE {mname} counter")
         for labels, value in series:
             if labels:
-                lab = ",".join(f'{_prom_name(k)}="{v}"' for k, v in labels)
+                lab = ",".join(
+                    f'{_prom_name(k)}="{escape_label_value(v)}"'
+                    for k, v in labels)
                 lines.append(f"{mname}{{{lab}}} {value:g}")
             else:
                 lines.append(f"{mname} {value:g}")
@@ -158,7 +244,8 @@ def _prometheus_histogram_lines() -> List[str]:
         mname = f"tl_tpu_{_prom_name(name)}_seconds"
         lines.append(f"# TYPE {mname} histogram")
         for labels, h in series:
-            base = [f'{_prom_name(k)}="{v}"' for k, v in labels]
+            base = [f'{_prom_name(k)}="{escape_label_value(v)}"'
+                    for k, v in labels]
             cum = h.cumulative()
             les = [f"{b:g}" for b in h.bounds] + ["+Inf"]
             for le, c in zip(les, cum):
@@ -460,10 +547,40 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "queue_wait": _hist_digest("serve.queue.wait"),
         "gauges": gauges,
     }
+    # tl-scope: sliding-window SLO summary + flight-recorder / request-
+    # trace accounting (lazy imports keep layering clean; a torn section
+    # must never take metrics_summary down with it)
+    def _slo_section():
+        try:
+            from .slo import slo_summary
+            return slo_summary()
+        except Exception:
+            return None
+
+    def _flight_section():
+        try:
+            from . import flight as _flight
+            s = _flight.snapshot()
+            return {"enabled": s["enabled"], "ring_records": len(s["ring"]),
+                    "dumps": s["dumps"], "dump_errors": s["dump_errors"],
+                    "dump_dir": s["dump_dir"]}
+        except Exception:
+            return None
+
+    req_traces = _reqtrace.traces(kind="request")
+    reqtrace = {
+        "traces": len(req_traces),
+        "terminal": sum(1 for t in req_traces if t.terminal is not None),
+        "complete": sum(1 for t in req_traces if t.complete),
+        "evicted": _reqtrace.evicted(),
+        "dropped_events": c("trace.dropped"),
+    }
     return {"counters": counters, "spans": spans, "cache": cache,
             "collectives": collectives, "resilience": resilience,
             "verify": verify, "lint": lint, "tile_opt": tile_opt,
             "autotune": autotune, "serving": serving,
+            "slo": _slo_section(), "flight": _flight_section(),
+            "reqtrace": reqtrace,
             "runtime": _runtime.runtime_summary()}
 
 

@@ -1,0 +1,161 @@
+"""Live telemetry endpoint (tl-scope, part 3b of 4).
+
+An opt-in, stdlib-only HTTP server exposing the process's observability
+state while it serves traffic:
+
+- ``/metrics``  — the Prometheus exposition snapshot
+  (``export.to_prometheus_text``: counters, span summaries, histograms)
+- ``/healthz``  — liveness + the backend-registry health snapshot
+- ``/slo``      — the sliding-window SLO summary (``slo.slo_summary``)
+- ``/flight``   — the flight recorder's ring + dump accounting
+
+Enable with ``TL_TPU_METRICS_PORT=<port>`` — a :class:`ServingEngine`
+calls :func:`maybe_start` at construction, so a serving process scrapes
+with zero code changes — or start explicitly::
+
+    from tilelang_mesh_tpu.observability import server
+    srv = server.start_server(port=0)      # 0 = ephemeral (tests)
+    print(srv.url)                          # http://127.0.0.1:NNNNN
+    srv.stop()
+
+The server is a daemon ``ThreadingHTTPServer`` bound to 127.0.0.1:
+telemetry is operator-local by default; fronting it for a fleet
+scraper is a deployment decision, not a library default.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..env import env
+
+__all__ = ["MetricsServer", "start_server", "maybe_start", "stop_server",
+           "get_server"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.observability")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tl-scope/1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — silence stdlib spam
+        logger.debug("metrics endpoint: " + fmt, *args)
+
+    def _send(self, body: str, ctype: str, code: int = 200) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — stdlib handler contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                from .export import to_prometheus_text
+                self._send(to_prometheus_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                self._send(json.dumps(_health()), "application/json")
+            elif path == "/slo":
+                from .slo import slo_summary
+                self._send(json.dumps(slo_summary()), "application/json")
+            elif path == "/flight":
+                from . import flight as _flight
+                self._send(json.dumps(_flight.snapshot()),
+                           "application/json")
+            else:
+                self._send(json.dumps({
+                    "error": "not found",
+                    "endpoints": ["/metrics", "/healthz", "/slo",
+                                  "/flight"]}), "application/json", 404)
+        except Exception as e:  # noqa: BLE001 — a scrape must not crash
+            self._send(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                       "application/json", 500)
+
+
+def _health() -> dict:
+    out = {"ok": True}
+    try:
+        from ..codegen.backends import backend_states
+        out["backends"] = backend_states()
+    except Exception:  # noqa: BLE001 — health is liveness, not depth
+        pass
+    try:
+        from ..serving.request import gauges, serving_meta
+        out["serving"] = {"gauges": gauges(), "meta": serving_meta()}
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+class MetricsServer:
+    """One daemon HTTP server; ``port=0`` binds an ephemeral port
+    (read it back from ``.port`` / ``.url``)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"tl-metrics-{self.port}")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_LOCK = threading.Lock()
+_SERVER: Optional[MetricsServer] = None
+
+
+def start_server(port: Optional[int] = None,
+                 host: str = "127.0.0.1") -> MetricsServer:
+    """Start (or return) the process server. Explicit ``port`` always
+    starts a fresh instance; None reads ``TL_TPU_METRICS_PORT``."""
+    global _SERVER
+    if port is not None:
+        return MetricsServer(port, host)
+    with _LOCK:
+        if _SERVER is None:
+            _SERVER = MetricsServer(env.TL_TPU_METRICS_PORT, host)
+            logger.info("tl-scope telemetry endpoint on %s", _SERVER.url)
+        return _SERVER
+
+
+def maybe_start() -> Optional[MetricsServer]:
+    """Start the endpoint iff ``TL_TPU_METRICS_PORT`` is set (>0);
+    idempotent and non-fatal (a busy port logs, never crashes the
+    engine that asked)."""
+    if env.TL_TPU_METRICS_PORT <= 0:
+        return None
+    try:
+        return start_server()
+    except OSError as e:
+        logger.warning("tl-scope telemetry endpoint failed to bind "
+                       "port %d: %s", env.TL_TPU_METRICS_PORT, e)
+        return None
+
+
+def get_server() -> Optional[MetricsServer]:
+    return _SERVER
+
+
+def stop_server() -> None:
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None

@@ -32,9 +32,17 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..env import env
+# both stdlib+env-only siblings, so importing them here preserves the
+# no-layering-violations property: reqtrace supplies the active
+# request-trace context merged into every recorded span/event, and
+# flight captures events/counters into its always-on ring BEFORE the
+# trace gate (the black box works untraced)
+from . import flight as _flight
+from . import reqtrace as _reqtrace
 
 __all__ = ["Span", "Tracer", "get_tracer", "span", "event", "inc",
            "reset", "trace_enabled"]
@@ -94,6 +102,12 @@ class Span:
         self.tid = threading.get_ident()
         self.epoch = t._epoch
         stack.append(self)
+        # tl-scope: a span opened under a bound request-trace context
+        # inherits trace_id/parent_span (explicit attrs win)
+        ctx = _reqtrace.current_attrs()
+        if ctx:
+            for k, v in ctx.items():
+                self.attrs.setdefault(k, v)
         self.ts_ns = time.monotonic_ns() - t._t0_ns
         return self
 
@@ -107,6 +121,8 @@ class Span:
             # a failed run must be attributable to its span: record the
             # error on the span itself, then let it propagate
             self.attrs["error"] = f"{exc_type.__name__}: {exc_val}"
+        _flight.note_span(self.name, self.cat, self.dur_ns / 1e3,
+                          self.attrs)
         self.tracer._record({
             "type": "span", "name": self.name, "cat": self.cat,
             "ts_us": self.ts_ns / 1e3, "dur_us": self.dur_ns / 1e3,
@@ -120,14 +136,15 @@ class Tracer:
 
     Thread-safe: events append under a lock; the live-span stack is
     thread-local. The event list is bounded by ``TL_TPU_TRACE_MAX_EVENTS``
-    — overflow drops the newest event and counts it in the
-    ``trace.dropped_events`` counter instead of growing without bound in
-    a long serving process.
+    — overflow evicts the OLDEST record (ring semantics: a long traced
+    serving soak keeps its most recent history, which is the half a
+    post-mortem wants) and counts each eviction in the
+    ``trace.dropped`` counter instead of growing without bound.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._events: List[dict] = []
+        self._events: deque = deque()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                              float] = {}
         self._tls = threading.local()
@@ -146,14 +163,14 @@ class Tracer:
         return s
 
     def _record(self, ev: dict, epoch: Optional[int] = None) -> None:
-        cap = env.TL_TPU_TRACE_MAX_EVENTS
+        cap = max(1, env.TL_TPU_TRACE_MAX_EVENTS)
         with self._lock:
             if epoch is not None and epoch != self._epoch:
                 return   # span from before a reset(): stale, drop
-            if len(self._events) >= cap:
-                self.inc("trace.dropped_events", _locked=True)
-                return
             self._events.append(ev)
+            while len(self._events) > cap:
+                self._events.popleft()     # oldest-first eviction
+                self.inc("trace.dropped", _locked=True)
 
     def span(self, name: str, cat: str = "compile", **attrs):
         """A nested timed interval; no-op (shared instance) when tracing
@@ -163,8 +180,15 @@ class Tracer:
         return Span(self, name, cat, attrs)
 
     def event(self, name: str, cat: str = "compile", **attrs) -> None:
-        """An instant marker (Chrome-trace 'i' phase); dropped when
-        tracing is disabled."""
+        """An instant marker (Chrome-trace 'i' phase); dropped from the
+        TRACE when tracing is disabled — but always offered to the
+        flight recorder's ring first, so the black box captures the
+        same instrumentation sites untraced."""
+        ctx = _reqtrace.current_attrs()
+        if ctx:
+            for k, v in ctx.items():
+                attrs.setdefault(k, v)
+        _flight.note_event(name, cat, attrs)
         if not trace_enabled():
             return
         self._record({
@@ -181,6 +205,7 @@ class Tracer:
         if _locked:     # already under self._lock (overflow accounting)
             self._counters[key] = self._counters.get(key, 0) + value
             return
+        _flight.note_counter(name, value, labels)   # always-on delta ring
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + value
 

@@ -950,6 +950,9 @@ class MeshKernel:
         _trace.inc("verify.watchdog.timeouts")
         _trace.event("verify.watchdog_timeout", "verify",
                      kernel=self.artifact.name, error=str(exc))
+        from ..observability import flight as _flight
+        _flight.dump("watchdog_timeout", kernel=self.artifact.name,
+                     error=str(exc))
         global_breaker().record_failure(error_signature(exc))
         ref = self._reference_kernel()
         if ref is None or _env.TL_TPU_FALLBACK != "interp":
@@ -986,6 +989,9 @@ class MeshKernel:
             return res
         _trace.inc("verify.selfcheck.divergence")
         _trace.event("verify.selfcheck_divergence", "verify", kernel=name,
+                     divergence=list(divs))
+        from ..observability import flight as _flight
+        _flight.dump("selfcheck_divergence", kernel=name,
                      divergence=list(divs))
         err = _guard.SelfCheckDivergence(
             f"{name}: optimized schedule diverged from the "

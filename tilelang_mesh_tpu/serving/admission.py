@@ -98,6 +98,8 @@ class AdmissionController:
             p99 = observed_step_ms(0.99)
             if p99 > self.p99_budget_ms:
                 return False, "overload"
+        if env.TL_TPU_SLO_ADMIT and self._slo_burning():
+            return False, "overload"
         if remaining_s is not None:
             # feasibility at the OBSERVED p50: the queue ahead (in
             # batches, optimistically one step each) plus this
@@ -108,3 +110,16 @@ class AdmissionController:
                     remaining_s <= 0:
                 return False, "deadline_infeasible"
         return True, None
+
+    @staticmethod
+    def _slo_burning() -> bool:
+        """Opt-in (``TL_TPU_SLO_ADMIT=1``) windowed overload gate: shed
+        while the SLO engine's fast-burn window spends error budget
+        faster than ``TL_TPU_SLO_BURN_MAX`` — the multi-window sibling
+        of the lifetime-p99 gate above (docs/observability.md)."""
+        try:
+            from ..observability.slo import get_slo
+            burn = get_slo().fast_burn_rate()   # cached per SLO tick
+            return burn is not None and burn > env.TL_TPU_SLO_BURN_MAX
+        except Exception:  # noqa: BLE001 — a broken SLO gate must
+            return False   # never shed (fail open, like admit_fault)

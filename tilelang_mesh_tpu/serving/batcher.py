@@ -165,6 +165,11 @@ class DecodeWorkload:
             table[i, :] = full_pages[-pp:]
         q = np.stack([self._query(requests[min(i, len(requests) - 1)])
                       for i in range(bb)])
+        # tl-scope: a traced run tags this dispatch with the bound
+        # batch-step context (trace_id/parent_span merge in the tracer),
+        # joining the kernel dispatch to the requests it served
+        _trace.event("serve.dispatch", "serving",
+                     workload=type(self).__name__, batch=bb, pages=pp)
         out = self._dispatch(q, table, bb, pp)
         out = np.asarray(out)
         return [out[i] for i in range(len(requests))]

@@ -37,7 +37,10 @@ import time
 from typing import Dict, List, Optional
 
 from ..env import env
+from ..observability import flight as _flight
 from ..observability import histogram as _hist
+from ..observability import reqtrace as _reqtrace
+from ..observability import slo as _slo
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import TLError, classify, error_signature
@@ -126,6 +129,22 @@ class ServingEngine:
         self._reshards = 0
         if getattr(workload, "elastic", False):
             publish_meta(layout=workload.layout.name)
+        # tl-scope (docs/observability.md): the engine's own causal
+        # trace — batch-step spans live here, linked to every member
+        # request's trace — plus the sliding-window SLO engine, and the
+        # opt-in telemetry endpoint (TL_TPU_METRICS_PORT)
+        # max_spans bounds the never-terminal engine chain (one batch
+        # span lands per step, forever): recent history stays, ancient
+        # steps evict — the same keep-the-tail policy as the tracer ring
+        self.trace = _reqtrace.start_trace("engine", kind="engine",
+                                           engine=name, max_spans=1024)
+        self._slo = _slo.get_slo()
+        try:
+            from ..observability import server as _server
+            _server.maybe_start()
+        except Exception:  # noqa: BLE001 — telemetry must not block serving
+            logger.warning("serving engine %s: telemetry endpoint "
+                           "failed to start", self.name, exc_info=True)
 
     # -- submission / admission ----------------------------------------
     def submit(self, context_tokens: int, new_tokens: int = 1,
@@ -269,20 +288,38 @@ class ServingEngine:
             if r.first_batch_t is not None and len(r.timeline) <= 3:
                 _hist.observe("serve.queue.wait", now - r.submit_t)
         budget = self._step_budget_s(batch)
+        # tl-scope: the batch step is one span in the ENGINE's causal
+        # trace, linked to every member request's trace_id; binding its
+        # context around the dispatch tags every span/event recorded
+        # underneath (kernel dispatches, collectives, faults) with
+        # trace_id/parent_span — the connected arrow chain in the
+        # Chrome trace
+        member_ids = [r.trace_id for r in batch]
+        batch_no = self._steps + 1
+        step_sid = self.trace.span("serve.batch", batch=batch_no,
+                                   size=len(batch), links=member_ids)
         t0 = time.perf_counter()
         try:
-            _faults.maybe_fail("serve.step", batch=len(batch))
-            if budget is not None:
-                outs = _bounded_step(
-                    lambda: self.workload.run_batch(batch), budget,
-                    f"{self.name} batch of {len(batch)}")
-            else:
-                outs = self.workload.run_batch(batch)
+            with _trace.span("serve.batch", "serving", engine=self.name,
+                             batch=batch_no, size=len(batch),
+                             links=member_ids), \
+                    _reqtrace.bind(self.trace.trace_id, step_sid):
+                _faults.maybe_fail("serve.step", batch=len(batch))
+                if budget is not None:
+                    outs = _bounded_step(
+                        lambda: self.workload.run_batch(batch), budget,
+                        f"{self.name} batch of {len(batch)}")
+                else:
+                    outs = self.workload.run_batch(batch)
         except Exception as e:  # noqa: BLE001 — classified below
+            self.trace.close_span(step_sid,
+                                  error=f"{type(e).__name__}: {e}")
             self._on_step_failure(batch, e)
             self._gauges()
+            self._slo_tick()
             return True
         dt = time.perf_counter() - t0
+        self.trace.close_span(step_sid)
         self._steps += 1
         _trace.inc("serve.batches")
         _trace.inc("serve.steps", len(batch))
@@ -291,7 +328,24 @@ class ServingEngine:
         self._maybe_probe_shards()
         self._retire_or_requeue(batch, outs)
         self._gauges()
+        self._slo_tick()
         return True
+
+    def _slo_tick(self) -> None:
+        """Feed the sliding-window SLO engine (throttled) and fire ONE
+        flight-recorder dump per breach episode (docs/observability.md)."""
+        try:
+            if self._slo.tick():
+                breach = self._slo.check_breach()
+                if breach is not None:
+                    _trace.event("slo.breach", "serving",
+                                 engine=self.name,
+                                 reasons=breach["breach_reasons"])
+                    _flight.dump("slo_breach", engine=self.name,
+                                 reasons=breach["breach_reasons"])
+        except Exception:  # noqa: BLE001 — SLO math must not kill a step
+            logger.warning("serving engine %s: SLO tick failed",
+                           self.name, exc_info=True)
 
     def _maybe_probe_shards(self) -> None:
         """Sampled straggler probe on sharded layouts: per-shard probe
@@ -409,6 +463,13 @@ class ServingEngine:
         _trace.event("serve.step_failure", "serving", kind=kind,
                      batch=[r.req_id for r in batch],
                      error=f"{type(exc).__name__}: {exc}")
+        # the black box: a step failure dumps the flight ring with the
+        # victim batch's member trace ids, so the post-mortem names
+        # exactly which requests were in flight when the step died
+        _flight.dump("step_failure", engine=self.name, kind=kind,
+                     batch=[r.req_id for r in batch],
+                     batch_trace_ids=[r.trace_id for r in batch],
+                     error=f"{type(exc).__name__}: {exc}")
         resharded = False
         if kind == "device_loss" or (
                 kind == "timeout"
@@ -422,6 +483,14 @@ class ServingEngine:
             # collective-watchdog / mesh-dispatch timeouts walk the
             # ladder.
             resharded = self._maybe_reshard(exc)
+            if resharded:
+                # the reshard lands in every surviving member's causal
+                # chain: a request that lived through a slice loss says
+                # so in its own trace
+                for r in batch:
+                    if not r.is_terminal:
+                        r.trace.mark("reshard",
+                                     layout=self.workload.layout.name)
         if kind == "device_loss" and not resharded:
             self._quarantine_and_failover(exc)
         if kind == "deterministic":

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..observability import reqtrace as _reqtrace
+
 __all__ = ["Request", "STATES", "OUTCOMES", "SHED_REASONS"]
 
 # non-terminal states, in lifecycle order
@@ -57,7 +59,7 @@ class Request:
                  "submit_t", "seed", "state", "outcome", "shed_reason",
                  "error", "result", "steps_done", "retries", "pages",
                  "tail_tokens", "timeline", "terminal_t", "first_batch_t",
-                 "payload")
+                 "payload", "trace", "_step_span")
 
     def __init__(self, context_tokens: int, new_tokens: int = 1,
                  deadline_ms: Optional[float] = None, seed: int = 0,
@@ -86,23 +88,50 @@ class Request:
         self.timeline: List[tuple] = [("queued", self.submit_t)]
         self.terminal_t: Optional[float] = None
         self.first_batch_t: Optional[float] = None
+        # tl-scope causal chain (observability/reqtrace.py): every
+        # lifecycle transition below lands in it, so a terminal
+        # request's whole story — submit, admit, every decode step,
+        # every requeue/retry, the outcome — is reconstructible even
+        # with TL_TPU_TRACE off. The root "submit" span closes at the
+        # admission decision; step spans open at batch() and close at
+        # requeue()/finish().
+        self.trace = _reqtrace.start_trace(
+            "request", req=self.req_id, ctx=self.context_tokens,
+            steps=self.new_tokens, deadline_ms=deadline_ms)
+        self._step_span: Optional[int] = self.trace.span("submit")
+
+    @property
+    def trace_id(self) -> str:
+        return self.trace.trace_id
 
     # -- transitions ---------------------------------------------------
     def _stamp(self, state: str) -> None:
         self.state = state
         self.timeline.append((state, time.monotonic()))
 
+    def _close_step(self, **attrs) -> None:
+        if self._step_span is not None:
+            self.trace.close_span(self._step_span, **attrs)
+            self._step_span = None
+
     def admit(self) -> None:
+        self._close_step(outcome="admitted")
         self._stamp("admitted")
 
     def batch(self) -> None:
         if self.first_batch_t is None:
             self.first_batch_t = time.monotonic()
+        self._close_step()    # defensive: a step span must never nest
+        self._step_span = self.trace.span("decode.step",
+                                          step=self.steps_done + 1)
         self._stamp("batched")
 
     def requeue(self) -> None:
         """Back to the queue — between decode steps (continuous
         batching) or on a retryable step failure."""
+        self._close_step(outcome="requeue")
+        self.trace.mark("requeue", steps_done=self.steps_done,
+                        retries=self.retries)
         self._stamp("admitted")
 
     def finish(self, outcome: str, *, shed_reason: Optional[str] = None,
@@ -117,6 +146,9 @@ class Request:
         self.shed_reason = shed_reason
         self.error = error
         self.terminal_t = time.monotonic()
+        self._close_step(outcome=outcome)
+        self.trace.finish(outcome, shed_reason=shed_reason, error=error,
+                          steps_done=self.steps_done)
         self._stamp("terminal")
 
     # -- deadline arithmetic -------------------------------------------

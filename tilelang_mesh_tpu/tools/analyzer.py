@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass
-from typing import Optional
+from pathlib import Path
+from typing import Dict, List, Optional
 
 from ..carver.arch import TPUArch, auto_arch
 from ..ir import (CopyStmt, GemmStmt, PrimFunc, ReduceStmt, dtype_bits, walk,
@@ -730,6 +731,311 @@ def format_serve_report(records) -> str:
     return "\n".join(lines)
 
 
+def summarize_request(records, trace_id: Optional[str] = None) -> dict:
+    """Aggregate the tl-scope request traces of a JSONL trace
+    (docs/observability.md): the versioned ``reqtrace`` chain lines
+    plus every tracer span/event tagged with a ``trace_id`` attr.
+    Without ``trace_id``: one summary row per chain. With it: the full
+    causal timeline of that one request — its chain spans in order and
+    the tracer records (batch steps, kernel dispatches, collectives)
+    linked to it."""
+    from ..observability.reqtrace import REQTRACE_SCHEMA
+    chains: dict = {}
+    skipped_schema = 0
+    tagged: dict = {}        # trace_id -> tracer span/event records
+    for r in records:
+        t = r.get("type")
+        if t == "reqtrace":
+            if r.get("schema") != REQTRACE_SCHEMA:
+                skipped_schema += 1     # a future/foreign schema is
+                continue                # skipped, never misread
+            chains[r["trace_id"]] = r
+        elif t in ("span", "event"):
+            attrs = r.get("attrs", {})
+            tid = attrs.get("trace_id")
+            if tid:
+                tagged.setdefault(tid, []).append(r)
+            for linked in attrs.get("links") or ():
+                tagged.setdefault(linked, []).append(r)
+    rows = []
+    for tid, ch in chains.items():
+        spans = ch.get("spans", [])
+        t0 = spans[0]["t0"] if spans else None
+        t1 = max((sp["t1"] or sp["t0"]) for sp in spans) if spans else None
+        rows.append({
+            "trace_id": tid, "kind": ch.get("kind", "request"),
+            "req": ch.get("attrs", {}).get("req"),
+            "terminal": ch.get("terminal"),
+            "spans": len(spans),
+            "complete": ch.get("complete"),
+            "duration_ms": (round((t1 - t0) * 1e3, 3)
+                            if t0 is not None else None),
+            "linked_records": len(tagged.get(tid, ())),
+        })
+    out = {"schema": REQTRACE_SCHEMA, "traces": rows,
+           "skipped_other_schema": skipped_schema}
+    if trace_id is not None:
+        ch = chains.get(trace_id)
+        out["selected"] = {
+            "trace_id": trace_id,
+            "chain": ch,
+            "linked": tagged.get(trace_id, []),
+        }
+    return out
+
+
+def format_request_report(records, trace_id: Optional[str] = None) -> str:
+    """Human-readable request-trace view (CLI ``request`` subcommand,
+    docs/observability.md)."""
+    s = summarize_request(records, trace_id)
+    lines: List[str] = []
+    if trace_id is not None:
+        sel = s["selected"]
+        ch = sel["chain"]
+        if ch is None:
+            return (f"trace {trace_id} not found in this file "
+                    f"({len(s['traces'])} request traces present)")
+        lines.append(
+            f"request trace {trace_id} ({ch.get('kind')}): terminal="
+            f"{ch.get('terminal')} complete={ch.get('complete')}")
+        spans = ch.get("spans", [])
+        if spans:
+            t0 = spans[0]["t0"]
+            lines.append(f"  {'offset_ms':>10} {'dur_ms':>9} "
+                         f"{'span':<14} {'parent':>6}  attrs")
+            for sp in spans:
+                dur = ((sp["t1"] or sp["t0"]) - sp["t0"]) * 1e3
+                attrs = {k: v for k, v in sp.get("attrs", {}).items()
+                         if v is not None}
+                lines.append(
+                    f"  {(sp['t0'] - t0) * 1e3:>10.3f} {dur:>9.3f} "
+                    f"{sp['name']:<14} "
+                    f"{sp['parent'] if sp['parent'] else '-':>6}  "
+                    f"{attrs}")
+        if sel["linked"]:
+            lines.append("  linked tracer records (batch steps, "
+                         "dispatches, collectives):")
+            for r in sel["linked"]:
+                lines.append(
+                    f"    [{r.get('type')}] {r.get('name')} "
+                    f"cat={r.get('cat')} "
+                    f"attrs={_compact_attrs(r.get('attrs', {}))}")
+        return "\n".join(lines)
+    if not s["traces"]:
+        return ("no request traces in this file (serving runs record "
+                "them always; was this a compile-only trace?)")
+    lines.append(f"request traces ({len(s['traces'])}):")
+    lines.append(f"  {'trace_id':<26} {'kind':<8} {'req':>5} "
+                 f"{'terminal':<18} {'spans':>5} {'dur_ms':>9} "
+                 f"{'complete':>8} {'linked':>6}")
+    for row in s["traces"]:
+        lines.append(
+            f"  {row['trace_id']:<26} {row['kind']:<8} "
+            f"{row['req'] if row['req'] is not None else '-':>5} "
+            f"{str(row['terminal']):<18} {row['spans']:>5} "
+            f"{row['duration_ms'] if row['duration_ms'] is not None else 0:>9.3f} "
+            f"{str(bool(row['complete'])):>8} {row['linked_records']:>6}")
+    incomplete = [r for r in s["traces"]
+                  if r["kind"] == "request" and r["terminal"]
+                  and not r["complete"]]
+    if incomplete:
+        lines.append("CAUSALLY INCOMPLETE terminal requests: "
+                     + ", ".join(r["trace_id"] for r in incomplete))
+    if s["skipped_other_schema"]:
+        lines.append(f"({s['skipped_other_schema']} chain(s) with a "
+                     f"different schema skipped)")
+    return "\n".join(lines)
+
+
+def _compact_attrs(attrs: dict, limit: int = 6) -> dict:
+    items = list(attrs.items())
+    out = dict(items[:limit])
+    if len(items) > limit:
+        out["..."] = f"+{len(items) - limit} more"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet perf-regression dashboard (analyzer dash)
+# ---------------------------------------------------------------------------
+
+def _round_label(path, doc) -> str:
+    import re as _re
+    m = _re.search(r"(r\d+)", Path(str(path)).stem)
+    if m:
+        return m.group(1)
+    n = doc.get("n") if isinstance(doc, dict) else None
+    return f"r{int(n):02d}" if isinstance(n, int) else Path(str(path)).stem
+
+
+def summarize_dash(round_paths, baseline: Optional[str] = None,
+                   threshold_mads: float = 5.0, min_rel: float = 0.05,
+                   cache_stats: Optional[dict] = None) -> dict:
+    """The fleet dashboard (ROADMAP item 4's regression-dashboard
+    remainder): every ``BENCH_r*`` round plus the checked-in baseline
+    in one per-config trend table. Each cell is that round's p50
+    latency; each transition is judged by perfdiff's median+MAD rule
+    (``compare_records`` — the SAME decision the CI gate applies), so
+    a real slowdown flags ``REGRESSION`` while an rc!=0 round or a
+    config that simply stopped producing records flags
+    ``missing-not-regressed`` (perfdiff semantics: a worker outage
+    must never read as a perf regression)."""
+    import json as _json
+    from .perfdiff import _by_config, compare_records, load_bench_records
+    rounds = []
+    for p in round_paths:
+        try:
+            text = Path(p).read_text()
+        except OSError as e:
+            rounds.append({"label": str(p), "rc": None, "error": str(e),
+                           "records": {}, "failed": [], "headline": None})
+            continue
+        try:
+            doc = _json.loads(text)
+        except ValueError:
+            doc = {}
+        recs = load_bench_records(p)
+        ok, failed = _by_config(recs)
+        # a round's headline: the first config-less metric record (the
+        # early driver rounds r01/r02 emitted only these)
+        headline = next(
+            ({"metric": r.get("metric"), "value": r.get("value"),
+              "unit": r.get("unit"), "vs_baseline": r.get("vs_baseline")}
+             for r in recs
+             if not r.get("config") and r.get("metric")
+             and "error" not in r), None)
+        rc = doc.get("rc") if isinstance(doc, dict) else None
+        rounds.append({"label": _round_label(p, doc), "rc": rc,
+                       "records": ok, "failed": failed,
+                       "headline": headline})
+    base_recs: Dict[str, dict] = {}
+    if baseline and Path(baseline).is_file():
+        base_recs, _ = _by_config(load_bench_records(baseline))
+    configs = sorted(set(base_recs)
+                     | {c for r in rounds for c in r["records"]}
+                     | {c for r in rounds for c in r["failed"]})
+    table: Dict[str, dict] = {}
+    regressions: List[str] = []
+    for cfg in configs:
+        prev = base_recs.get(cfg)
+        cells = []
+        last_verdict = None
+        for rnd in rounds:
+            rec = rnd["records"].get(cfg)
+            if rec is None:
+                status = "failed" if cfg in rnd["failed"] else "miss"
+                cells.append({"round": rnd["label"], "status": status,
+                              "verdict": "missing-not-regressed"})
+                continue
+            cmp_row = compare_records(prev, rec,
+                                      threshold_mads=threshold_mads,
+                                      min_rel=min_rel) \
+                if prev is not None else None
+            verdict = cmp_row["verdict"] if cmp_row else "new"
+            cells.append({"round": rnd["label"], "status": "ok",
+                          "latency_ms": _lat(rec), "value": rec.get("value"),
+                          "unit": rec.get("unit"),
+                          "vs_baseline": rec.get("vs_baseline"),
+                          "verdict": verdict,
+                          "rel": cmp_row["rel"] if cmp_row else None})
+            prev = rec          # the trend compares consecutive data
+            last_verdict = verdict
+        table[cfg] = {
+            "baseline_ms": _lat(base_recs[cfg])
+            if cfg in base_recs else None,
+            "cells": cells,
+            "flag": last_verdict or "missing-not-regressed",
+        }
+        if last_verdict == "REGRESSION":
+            regressions.append(cfg)
+    for rnd in rounds:
+        # a round is "missing-not-regressed" when it produced no
+        # per-config records AND cannot vouch for itself (rc!=0, or no
+        # headline either) — an rc=0 headline-only round (the early
+        # driver rounds) is ok, just pre-config-records
+        rnd["status"] = ("ok" if rnd["records"]
+                         or (rnd["rc"] in (0, None) and rnd["headline"])
+                         else "missing-not-regressed")
+        rnd["n_records"] = len(rnd.pop("records"))
+    out = {
+        "rounds": rounds,
+        "baseline": str(baseline) if baseline else None,
+        "configs": table,
+        "regressions": regressions,
+        "params": {"threshold_mads": threshold_mads, "min_rel": min_rel},
+    }
+    if cache_stats is not None:
+        out["tune_cache"] = cache_stats
+    return out
+
+
+def _lat(rec: dict) -> Optional[float]:
+    from .perfdiff import _latency_ms
+    return _latency_ms(rec)
+
+
+def format_dash_report(dash: dict) -> str:
+    """Human-readable fleet dashboard (CLI ``dash`` subcommand)."""
+    lines: List[str] = []
+    rounds = dash["rounds"]
+    lines.append(f"fleet perf dashboard: {len(rounds)} round(s)"
+                 + (f", baseline {dash['baseline']}"
+                    if dash["baseline"] else ""))
+    lines.append(f"  {'round':<10} {'rc':>3} {'records':>7} "
+                 f"{'status':<22} headline")
+    for rnd in rounds:
+        hl = rnd.get("headline")
+        hl_s = (f"{hl['value']} {hl['unit']} "
+                f"(vs_baseline {hl['vs_baseline']})" if hl else "-")
+        rc = rnd["rc"] if rnd["rc"] is not None else "-"
+        lines.append(f"  {rnd['label']:<10} {rc:>3} "
+                     f"{rnd['n_records']:>7} {rnd['status']:<22} {hl_s}")
+    cfgs = dash["configs"]
+    if cfgs:
+        labels = [r["label"] for r in rounds]
+        lines.append("")
+        lines.append("per-config trend (p50 ms; verdicts by the "
+                     "perfdiff median+MAD rule):")
+        head = f"  {'config':<24} {'baseline':>10}"
+        for lb in labels:
+            head += f" {lb:>14}"
+        head += "  flag"
+        lines.append(head)
+        for cfg in sorted(cfgs):
+            row = cfgs[cfg]
+            b = row["baseline_ms"]
+            line = (f"  {cfg:<24} "
+                    f"{(f'{b:.4f}' if b is not None else '-'):>10}")
+            for cell in row["cells"]:
+                if cell["status"] != "ok":
+                    line += f" {cell['status']:>14}"
+                else:
+                    lat = cell.get("latency_ms")
+                    v = cell["verdict"]
+                    mark = {"REGRESSION": "!", "improved": "+",
+                            "ok": "", "new": "*"}.get(v, "")
+                    cell_s = (f"{lat:.4f}{mark}" if lat is not None
+                              else str(cell.get("value")))
+                    line += f" {cell_s:>14}"
+            line += f"  {row['flag']}"
+            lines.append(line)
+        lines.append("  (! = REGRESSION beyond noise, + = improved, "
+                     "* = new; missing/failed cells are "
+                     "missing-not-regressed)")
+    if dash["regressions"]:
+        lines.append("REGRESSED: " + ", ".join(dash["regressions"]))
+    else:
+        lines.append("no regressions beyond noise")
+    if "tune_cache" in dash:
+        tc = dash["tune_cache"]
+        lines.append(f"fleet tune cache @ {tc.get('root')}: "
+                     f"{tc.get('entries')} entries, "
+                     f"{tc.get('trials')} recorded trials, "
+                     f"{tc.get('merges')} merges, "
+                     f"{tc.get('quarantined')} quarantined")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # CLI: trace / faults / verify / serve / perf-diff subcommands (legacy
 # --flag spellings are translated, so existing scripts keep working)
@@ -875,6 +1181,48 @@ def _run_serve(path, as_json: bool) -> int:
     return 0
 
 
+def _run_request(path, as_json: bool, trace_id: Optional[str]) -> int:
+    """``analyzer request <jsonl> [--trace-id]`` — per-request causal
+    timeline from the versioned reqtrace chains + tagged tracer
+    records (docs/observability.md)."""
+    records = _load_trace(path)
+    _emit(summarize_request(records, trace_id),
+          format_request_report(records, trace_id), as_json)
+    return 0
+
+
+def _run_dash(paths, baseline: Optional[str], as_json: bool,
+              threshold_mads: float, min_rel: float) -> int:
+    """``analyzer dash [BENCH_r*.json ...]`` — the fleet dashboard.
+    With no paths, globs ``BENCH_r*.json`` in the working directory;
+    the default baseline is ``.github/perf_baseline.json`` when
+    present. Exit 0 always (the dashboard reports; the perf-diff
+    subcommand gates)."""
+    import glob as _glob
+    files = list(paths) or sorted(_glob.glob("BENCH_r*.json"))
+    if not files:
+        # missing rounds are a missing-not-regressed condition, not a
+        # failure: the documented contract is exit 0 always
+        print("analyzer dash: no BENCH_r*.json rounds found "  # noqa: T201
+              "(pass paths explicitly)")
+        return 0
+    if baseline is None:
+        cand = Path(".github/perf_baseline.json")
+        baseline = str(cand) if cand.is_file() else None
+    cache_stats = None
+    try:
+        from ..autotuner.tune_cache import TuneCache
+        cache = TuneCache()
+        if cache.root.is_dir():
+            cache_stats = cache.stats()
+    except Exception:   # noqa: BLE001 — stats are garnish, never a crash
+        cache_stats = None
+    dash = summarize_dash(files, baseline, threshold_mads=threshold_mads,
+                          min_rel=min_rel, cache_stats=cache_stats)
+    _emit(dash, format_dash_report(dash), as_json)
+    return 0
+
+
 def _run_tune(path, as_json: bool, cache_dir: Optional[str]) -> int:
     """``analyzer tune <journal.jsonl>`` — predicted-vs-measured table
     for one sweep journal + fleet tune-cache stats (docs/autotuning.md).
@@ -987,6 +1335,30 @@ def main(argv=None) -> int:
                       "reason, terminal outcomes, KV slab balance, "
                       "step/queue latency (docs/serving.md)")
     p_sv.add_argument("file", help="JSONL trace file")
+    p_rq = sub.add_parser(
+        "request", help="per-request causal timeline from the tl-scope "
+                        "reqtrace chains: one summary row per request, "
+                        "or the full span chain + linked batch/dispatch "
+                        "records with --trace-id "
+                        "(docs/observability.md)")
+    p_rq.add_argument("file", help="JSONL trace file "
+                      "(observability.write_jsonl / a soak artifact)")
+    p_rq.add_argument("--trace-id", metavar="ID",
+                      help="show one request's full causal timeline")
+    p_da = sub.add_parser(
+        "dash", help="fleet perf-regression dashboard: BENCH_r* rounds "
+                     "+ the checked-in baseline in one per-config trend "
+                     "table with perfdiff's MAD-rule flags; rc!=0 "
+                     "rounds read missing-not-regressed "
+                     "(docs/observability.md)")
+    p_da.add_argument("rounds", nargs="*",
+                      help="BENCH_r*.json wrappers / bench JSONL files "
+                           "(default: glob BENCH_r*.json in cwd)")
+    p_da.add_argument("--baseline", metavar="FILE",
+                      help="baseline records (default "
+                           ".github/perf_baseline.json when present)")
+    p_da.add_argument("--threshold-mads", type=float, default=5.0)
+    p_da.add_argument("--min-rel", type=float, default=0.05)
     p_tn = sub.add_parser(
         "tune", help="autotune sweep journal summary: per-config "
                      "predicted-vs-measured latency, model rank "
@@ -1022,7 +1394,7 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_sv, p_tn, p_ln, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_rq, p_da, p_tn, p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -1034,6 +1406,11 @@ def main(argv=None) -> int:
         return _run_verify(args.file, args.json)
     if args.cmd == "serve":
         return _run_serve(args.file, args.json)
+    if args.cmd == "request":
+        return _run_request(args.file, args.json, args.trace_id)
+    if args.cmd == "dash":
+        return _run_dash(args.rounds, args.baseline, args.json,
+                         args.threshold_mads, args.min_rel)
     if args.cmd == "tune":
         return _run_tune(args.file, args.json, args.cache_dir)
     if args.cmd == "lint":

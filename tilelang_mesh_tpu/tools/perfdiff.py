@@ -34,7 +34,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_bench_records", "perf_diff", "format_perf_diff",
-           "perf_diff_exit_code"]
+           "perf_diff_exit_code", "compare_records"]
 
 
 def _records_from_lines(text: str) -> List[dict]:
@@ -103,6 +103,32 @@ def _mad_ms(rec: dict) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) and v >= 0 else None
 
 
+def compare_records(base: dict, cur: dict, threshold_mads: float = 5.0,
+                    min_rel: float = 0.05,
+                    rel_floor: float = 0.02) -> Optional[dict]:
+    """The ONE median+MAD decision applied to a single (baseline,
+    current) record pair — shared by ``perf_diff`` and the fleet
+    dashboard (``analyzer dash``), so the two consumers can never flag
+    the same pair differently. None when either side has no usable
+    latency."""
+    bl, cl = _latency_ms(base), _latency_ms(cur)
+    if bl is None or cl is None:
+        return None
+    noise = max(_mad_ms(base) or 0.0, _mad_ms(cur) or 0.0,
+                rel_floor * bl)
+    delta = cl - bl
+    rel = cl / bl - 1.0
+    if delta > threshold_mads * noise and rel > min_rel:
+        verdict = "REGRESSION"
+    elif -delta > threshold_mads * noise and -rel > min_rel:
+        verdict = "improved"
+    else:
+        verdict = "ok"
+    return {"baseline_ms": round(bl, 6), "current_ms": round(cl, 6),
+            "delta_ms": round(delta, 6), "rel": round(rel, 4),
+            "noise_ms": round(noise, 6), "verdict": verdict}
+
+
 def perf_diff(baseline: List[dict], current: List[dict],
               threshold_mads: float = 5.0, min_rel: float = 0.05,
               rel_floor: float = 0.02) -> dict:
@@ -121,28 +147,16 @@ def perf_diff(baseline: List[dict], current: List[dict],
     regressions: List[str] = []
     improvements: List[str] = []
     for name in sorted(set(base_ok) & set(cur_ok)):
-        b, c = base_ok[name], cur_ok[name]
-        bl, cl = _latency_ms(b), _latency_ms(c)
-        if bl is None or cl is None:
+        row = compare_records(base_ok[name], cur_ok[name],
+                              threshold_mads=threshold_mads,
+                              min_rel=min_rel, rel_floor=rel_floor)
+        if row is None:
             continue
-        noise = max(_mad_ms(b) or 0.0, _mad_ms(c) or 0.0,
-                    rel_floor * bl)
-        delta = cl - bl
-        rel = cl / bl - 1.0
-        if delta > threshold_mads * noise and rel > min_rel:
-            verdict = "REGRESSION"
+        if row["verdict"] == "REGRESSION":
             regressions.append(name)
-        elif -delta > threshold_mads * noise and -rel > min_rel:
-            verdict = "improved"
+        elif row["verdict"] == "improved":
             improvements.append(name)
-        else:
-            verdict = "ok"
-        rows.append({
-            "config": name,
-            "baseline_ms": round(bl, 6), "current_ms": round(cl, 6),
-            "delta_ms": round(delta, 6), "rel": round(rel, 4),
-            "noise_ms": round(noise, 6), "verdict": verdict,
-        })
+        rows.append({"config": name, **row})
     missing = sorted((set(base_ok) - set(cur_ok)) | set(cur_failed))
     return {
         "rows": rows,

@@ -205,6 +205,9 @@ def run_device_loss(out: Path, seed: int) -> int:
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["TL_TPU_TRACE"] = "1"
     env["TL_TPU_TRACE_DIR"] = str(out / "trace")
+    # the bench children's flight-recorder dumps (the victim's device
+    # loss is a dump trigger) land in the artifact dir CI uploads
+    env["TL_TPU_FLIGHT_DIR"] = str(out / "flight")
     print(f"[chaos-device-loss] seed={seed}: killing the device inside "
           f"config {victim!r} of the hermetic sweep")  # noqa: T201
 
@@ -227,6 +230,7 @@ def run_device_loss(out: Path, seed: int) -> int:
     missing = [n for n in cpu_safe
                if n not in records or "error" in records[n]]
     vic = records.get(victim, {})
+    flight_audit = _audit_flight_dumps(out / "flight")
     checks = {
         "rc_zero": proc.returncode == 0,
         "all_configs_produced_records": not missing,
@@ -235,11 +239,16 @@ def run_device_loss(out: Path, seed: int) -> int:
             bool(vic.get("backends_used"))
             and vic.get("backend_health", {}).get(
                 "tpu-pallas", {}).get("healthy") is False,
+        # the victim's device loss is a flight-dump trigger; the black
+        # box must exist in the uploaded artifact dir, atomically
+        "flight_dumped_and_atomic": flight_audit["dumps"] >= 1
+        and flight_audit["atomic"],
     }
     ok = all(checks.values())
     report = {"mode": "device-loss", "seed": seed, "victim": victim,
               "bench_rc": proc.returncode, "checks": checks,
               "missing_or_failed_configs": missing,
+              "flight": flight_audit,
               "records": records}
     (out / "device_loss_report.json").write_text(
         json.dumps(report, indent=2))
@@ -300,6 +309,44 @@ def _serve_accounting(eng, counters) -> tuple:
     return e2e_by_outcome, acct_ok
 
 
+def _audit_flight_dumps(flight_dir: Path, trace_ids=None) -> dict:
+    """Audit one soak's flight-recorder dumps (tl-scope,
+    docs/observability.md): every dump must parse as JSONL with a
+    versioned header, no torn tmp files may remain (the atomic-write
+    contract), and — when ``trace_ids`` is given — at least one
+    device-loss dump must name victim batch trace ids that all belong
+    to the run's requests."""
+    dumps = sorted(flight_dir.glob("flight_*.jsonl")) \
+        if flight_dir.is_dir() else []
+    torn = sorted(p.name for p in flight_dir.glob("*.tmp.*")) \
+        if flight_dir.is_dir() else []
+    parsed = []
+    parse_ok = True
+    for p in dumps:
+        try:
+            lines = [json.loads(ln) for ln in
+                     p.read_text().splitlines() if ln.strip()]
+            head = lines[0]
+            assert head.get("type") == "flight" and head.get("schema")
+            parsed.append(head)
+        except Exception:  # noqa: BLE001 — a torn dump is the finding
+            parse_ok = False
+    device_loss_ok = True
+    if trace_ids is not None:
+        victims = [h for h in parsed
+                   if h.get("reason") == "step_failure"
+                   and h.get("attrs", {}).get("kind") == "device_loss"
+                   and h.get("attrs", {}).get("batch_trace_ids")]
+        device_loss_ok = bool(victims) and all(
+            set(h["attrs"]["batch_trace_ids"]) <= set(trace_ids)
+            for h in victims)
+    return {"dumps": len(dumps), "files": [p.name for p in dumps],
+            "reasons": sorted({h.get("reason", "?") for h in parsed}),
+            "torn_tmp_files": torn,
+            "atomic": parse_ok and not torn,
+            "device_loss_dump_ok": device_loss_ok}
+
+
 def run_serve(out: Path, seed: int, n_requests: int) -> int:
     """Seeded serving-engine chaos soak (the CI ``serve-smoke`` job and
     the ISSUE 8 acceptance gate): ``n_requests`` requests with a
@@ -312,15 +359,25 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
       step bound (the zero-hang guarantee, measured per request);
     - KV slabs balance to zero (allocs == frees, no leaked owners);
     - the shed/deadline accounting in the counters and the e2e
-      histogram agree with the per-request outcomes.
+      histogram agree with the per-request outcomes;
+    - tl-scope (docs/observability.md), PROVED AT DEFAULTS — flight
+      recorder on, ``TL_TPU_TRACE`` off: every terminal request's
+      causal span chain closes (100% causally complete), and the
+      injected mid-batch device loss produced an atomic
+      flight-recorder dump naming the victim batch's member trace ids.
     """
     import random
 
     import numpy as np  # noqa: F401  (engine results are np arrays)
 
-    os.environ["TL_TPU_TRACE"] = "1"
+    # tl-scope runs this soak at DEFAULTS: the flight recorder and the
+    # per-request causal chains must carry the post-mortem WITHOUT
+    # TL_TPU_TRACE (the old always-on-trace soak could never prove
+    # that); an operator can still export a full trace by arming the
+    # env themselves
     import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
     from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import flight as _flight
     from tilelang_mesh_tpu.observability import histogram as _hist
     from tilelang_mesh_tpu.resilience import inject
     from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
@@ -328,6 +385,7 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
                                            ServingEngine)
 
     _reset_serving_state()
+    _flight.configure(dump_dir=out / "flight")
     rng = random.Random(seed)
     alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
                              head_dim=64)
@@ -437,6 +495,13 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
     e2e_by_outcome, acct_ok = _serve_accounting(eng, counters)
     kv_ok = (not leaks and alloc.in_use == 0
              and alloc.alloc_count == alloc.free_count)
+    # tl-scope gates (docs/observability.md): causal completeness of
+    # EVERY terminal request's span chain, and an atomic flight dump
+    # for the injected device loss naming the victim batch's members
+    incomplete = [r.req_id for r in eng.requests
+                  if r.is_terminal and not r.trace.complete]
+    trace_ids = {r.trace_id for r in eng.requests}
+    flight_audit = _audit_flight_dumps(out / "flight", trace_ids)
     checks = {
         "all_terminal": not non_terminal,
         "zero_hangs_past_deadline_grace": not late,
@@ -446,6 +511,10 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
         "deadline_path_exercised": outcomes["deadline_exceeded"] > 0,
         "chaos_actually_fired": counters["retries"] > 0
         and counters["failovers"] >= 1,
+        "causal_chains_complete": not incomplete,
+        "device_loss_flight_dump_names_victims":
+            flight_audit["device_loss_dump_ok"],
+        "flight_dumps_atomic": flight_audit["atomic"],
     }
     ok = all(checks.values())
 
@@ -463,6 +532,8 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
         "e2e_by_outcome": e2e_by_outcome,
         "non_terminal_requests": non_terminal,
         "late_requests": late,
+        "causally_incomplete_requests": incomplete,
+        "flight": flight_audit,
         "checks": checks, "ok": ok,
     }
     trace_path = out / "serve_trace.jsonl"
@@ -511,6 +582,7 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
             flags + " --xla_force_host_platform_device_count=8").strip()
     import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
     from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import flight as _flight
     from tilelang_mesh_tpu.observability import histogram as _hist
     from tilelang_mesh_tpu.resilience import inject
     from tilelang_mesh_tpu.serving import (MeshDecodeWorkload,
@@ -518,6 +590,7 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
                                            ServingEngine)
 
     _reset_serving_state()
+    _flight.configure(dump_dir=out / "flight")
     rng = random.Random(seed)
     alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
                              head_dim=64)
@@ -597,6 +670,9 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
     kv_ok = (not leaks and cur.in_use == 0
              and counters["kv_pages_allocated"]
              == counters["kv_pages_freed"])
+    incomplete = [r.req_id for r in eng.requests
+                  if r.is_terminal and not r.trace.complete]
+    flight_audit = _audit_flight_dumps(out / "flight")
     checks = {
         "all_terminal": not non_terminal,
         "kv_slabs_balance_zero": kv_ok,
@@ -605,6 +681,11 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
         "kv_bytes_conserved_across_migration": conserve_ok,
         "accounting_matches_histograms": acct_ok,
         "engine_completed_some_work": outcomes["result"] > 0,
+        "causal_chains_complete": not incomplete,
+        # the slice kill surfaced to the scheduler, so its black box
+        # must exist and every dump must have committed atomically
+        "flight_dumped_and_atomic": flight_audit["dumps"] >= 1
+        and flight_audit["atomic"],
     }
     ok = all(checks.values())
 
@@ -626,6 +707,8 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
         "kv_leaks": {str(k): v for k, v in leaks.items()},
         "e2e_by_outcome": e2e_by_outcome,
         "non_terminal_requests": non_terminal,
+        "causally_incomplete_requests": incomplete,
+        "flight": flight_audit,
         "checks": checks, "ok": ok,
     }
     trace_path = out / "serve_mesh_trace.jsonl"

@@ -411,6 +411,9 @@ def verify_schedule(segments: Sequence[Tuple[str, Any]],
         for e in rep.errors:
             _trace.event("verify.error", "verify", kernel=kernel,
                          finding=e)
+        from ..observability import flight as _flight
+        _flight.dump("mesh_verify_error", kernel=kernel,
+                     errors=list(rep.errors))
         raise MeshVerifyError(
             f"{kernel}: mesh schedule verification failed "
             f"({len(rep.errors)} violation(s)):\n  - " +
