@@ -39,7 +39,9 @@ def online_softmax_kernel(M, N, block_N, dtype="float32"):
                 for i in T.Parallel(M):
                     m[i] = m_new[i]
             for i, j in T.Parallel(M, N):
-                A_s[i, j] = T.exp(A_s[i, j] - m[i]) / l[i]
+                # clamped divide: a fully-underflowed row's normalizer
+                # is 0.0 and the bare divide is 0/0 = NaN (tl-num TL009)
+                A_s[i, j] = T.exp(A_s[i, j] - m[i]) / T.max(l[i], 1e-30)
             T.copy(A_s, B)
     return tilelang.compile(softmax)
 

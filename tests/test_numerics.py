@@ -1,0 +1,828 @@
+"""tl-num numerical-safety analysis suite (analysis/absint.py,
+analysis/numerics.py; docs/static_analysis.md "tl-num").
+
+Five layers:
+
+1. **Domain units** — interval arithmetic, saturation-to-unknown, join
+   semantics of the dual-track abstract value.
+2. **Rule fire / no-fire pairs** — each of TL007-TL010 on its canonical
+   bug AND on the guarded idiom the ops library uses (clamped divide,
+   max-subtracted exp, planar +8 decode, f32 accumulation), plus the
+   seeded mutation sweep (tools/num_sweep.py) across seeds.
+3. **Proof precision contract** — the exact golden set of (kernel,
+   rule, severity) findings over the shipped ops library: zero errors,
+   and the warning set is pinned so precision drift is a visible diff.
+4. **Finiteness proofs & TL_TPU_SANITIZE=auto** — attrs["numerics"] on
+   plain + mesh artifacts, differential parity vs =1, the
+   sanitize.elided counter, and the elision-never-skips-unproven
+   guarantee under a comm.collective corrupt fault.
+5. **Surfacing** — plan_desc lint block, strict-mode escalation with
+   the flight-recorder dump naming kernel+rules, CLI loc round-trip,
+   severity summary, cache-key separation of the tl-num knobs.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.analysis import (SemanticError, collect_diagnostics,
+                                        analyze_numerics)
+from tilelang_mesh_tpu.analysis.absint import (INF, AbsVal, av_div, av_max,
+                                               av_mul, mk)
+from tilelang_mesh_tpu.cache.kernel_cache import _CACHE, KernelCache
+from tilelang_mesh_tpu.observability import get_tracer
+from tilelang_mesh_tpu.parallel import mesh_config
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.verify import NumericError
+from tilelang_mesh_tpu.verify.runtime import sanitize_mode
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(monkeypatch):
+    for var in ("TL_TPU_SANITIZE", "TL_TPU_LINT", "TL_TPU_TRACE",
+                "TL_TPU_FAULTS", "TL_TPU_NUM_ASSUME_ABS",
+                "TL_TPU_RUNTIME_METRICS"):
+        monkeypatch.delenv(var, raising=False)
+    _CACHE.clear()
+    get_tracer().reset()
+    obs.reset()
+    yield
+    _CACHE.clear()
+    get_tracer().reset()
+    obs.reset()
+
+
+def _rules(func, **kw):
+    return {d.rule for d in collect_diagnostics(func, with_plan=False, **kw)}
+
+
+def _diags(func):
+    return collect_diagnostics(func, with_plan=False)
+
+
+# ---------------------------------------------------------------------------
+# 1. domain units
+# ---------------------------------------------------------------------------
+
+
+def test_interval_mul_signs():
+    a = mk(-2.0, 3.0, -2.0, 3.0, True)
+    b = mk(-5.0, 4.0, -5.0, 4.0, True)
+    r = av_mul(a, b)
+    assert (r.lo, r.hi) == (-15.0, 12.0)
+    assert (r.slo, r.shi) == (-15.0, 12.0)
+
+
+def test_interval_div_excludes_zero():
+    a = mk(1.0, 10.0, 1.0, 10.0, True)
+    b = mk(2.0, 4.0, 2.0, 4.0, True)
+    r = av_div(a, b)
+    assert r.lo == 0.25 and r.hi == 5.0 and r.finite
+
+
+def test_saturation_to_unknown():
+    """Bounds past any dtype's range become +-inf (unknown) — a guard
+    epsilon must not manufacture a fake bounded-overflow proof."""
+    a = mk(0.0, 1e30, 0.0, 1e30, True)
+    b = mk(1e-300, 1.0, 1e-300, 1.0, True)
+    r = av_div(a, b)
+    assert r.shi == INF and r.hi == INF
+
+
+def test_join_intersects_relational_state():
+    a = AbsVal(0.0, 1.0, 0.0, 1.0, finite=True, unit_dim=1)
+    b = AbsVal(0.0, 2.0, 0.0, 2.0, finite=True, unit_dim=0)
+    j = a.join(b)
+    assert j.unit_dim is None and j.hi == 2.0 and j.finite
+
+
+def test_av_max_drops_facts():
+    from tilelang_mesh_tpu.analysis.absint import DomFact
+    a = AbsVal(0.0, 1.0, 0.0, 1.0, finite=True,
+               facts=frozenset({DomFact(1, 0, 1, True)}))
+    assert av_max(a, AbsVal.const(0.5)).facts == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# 2. fire / no-fire pairs
+# ---------------------------------------------------------------------------
+
+
+def _int_accum_kernel(acc_dtype):
+    @T.prim_func
+    def k(A: T.Tensor((128, 512), "int8"), B: T.Tensor((512, 128), "int8"),
+          C: T.Tensor((128, 128), "float32")):
+        with T.Kernel(1) as bx:
+            acc = T.alloc_fragment((128, 128), acc_dtype)
+            out = T.alloc_fragment((128, 128), "float32")
+            T.clear(acc)
+            T.gemm(A, B, acc)
+            for i, j in T.Parallel(128, 128):
+                out[i, j] = T.cast(acc[i, j], "float32")
+            T.copy(out, C)
+    return k
+
+
+def test_tl007_int_wrap_fires_and_int32_silent():
+    assert "TL007" in _rules(_int_accum_kernel("int16").func)
+    assert "TL007" not in _rules(_int_accum_kernel("int32").func)
+
+
+def test_tl007_is_error_severity():
+    d = [x for x in _diags(_int_accum_kernel("int16").func)
+         if x.rule == "TL007"]
+    assert d and all(x.severity == "error" for x in d)
+    assert "int16" in d[0].message
+
+
+def _range_kernel(dst_dtype):
+    @T.prim_func
+    def k(C: T.Tensor((8, 128), dst_dtype)):
+        with T.Kernel(1) as bx:
+            a = T.alloc_fragment((8, 128), "float32")
+            b = T.alloc_fragment((8, 128), dst_dtype)
+            T.fill(a, 1.7e38)
+            for i, j in T.Parallel(8, 128):
+                b[i, j] = a[i, j] + a[i, j]
+            T.copy(b, C)
+    return k
+
+
+def test_tl007_bf16_range_escape():
+    """3.4e38 fits float32 (3.4028e38) but not bfloat16 (3.3895e38)."""
+    assert "TL007" in _rules(_range_kernel("bfloat16").func)
+    assert "TL007" not in _rules(_range_kernel("float32").func)
+
+
+def _gemm_accum_kernel(accum_dtype, nk):
+    @T.prim_func
+    def k(A: T.Tensor((128, nk * 128), "bfloat16"),
+          B: T.Tensor((nk * 128, 128), "bfloat16"),
+          C: T.Tensor((128, 128), "bfloat16")):
+        with T.Kernel(1) as bx:
+            a_s = T.alloc_shared((128, 128), "bfloat16")
+            b_s = T.alloc_shared((128, 128), "bfloat16")
+            c_l = T.alloc_fragment((128, 128), accum_dtype)
+            c_o = T.alloc_fragment((128, 128), "bfloat16")
+            T.clear(c_l)
+            for ko in T.Pipelined(nk):
+                T.copy(A[0, ko * 128], a_s)
+                T.copy(B[ko * 128, 0], b_s)
+                T.gemm(a_s, b_s, c_l)
+            for i, j in T.Parallel(128, 128):
+                c_o[i, j] = T.cast(c_l[i, j], "bfloat16")
+            T.copy(c_o, C)
+    return k
+
+
+def test_tl008_bf16_accum_large_k_fires():
+    found = [d for d in _diags(_gemm_accum_kernel("bfloat16", 32).func)
+             if d.rule == "TL008"]
+    assert found and found[0].severity == "warning"
+    assert "float32" in found[0].message      # the fix suggestion
+
+
+def test_tl008_f32_accum_idiom_silent():
+    """The f32-accumulate idiom every ops kernel uses, at the same K."""
+    assert "TL008" not in _rules(_gemm_accum_kernel("float32", 32).func)
+
+
+def test_tl008_bf16_small_k_silent():
+    """4 trips x 2^-8 = 0.0156 stays under the 1/16 threshold."""
+    assert "TL008" not in _rules(_gemm_accum_kernel("bfloat16", 4).func)
+
+
+def _softmax_kernel(max_sub, guard="none"):
+    @T.prim_func
+    def k(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((8, 128), "float32")
+            mx = T.alloc_fragment((8,), "float32")
+            den = T.alloc_fragment((8,), "float32")
+            T.copy(A, s)
+            T.reduce_max(s, mx, dim=1)
+            for i, j in T.Parallel(8, 128):
+                if max_sub:
+                    s[i, j] = T.exp(s[i, j] - mx[i])
+                else:
+                    s[i, j] = T.exp(s[i, j])
+            T.reduce_sum(s, den, dim=1)
+            for i, j in T.Parallel(8, 128):
+                if guard == "clamp":
+                    s[i, j] = s[i, j] / T.max(den[i], 1e-30)
+                elif guard == "where":
+                    s[i, j] = T.if_then_else(den[i] > 0.0,
+                                             s[i, j] / den[i], 0.0)
+                else:
+                    s[i, j] = s[i, j] / den[i]
+            T.copy(s, O)
+    return k
+
+
+def test_tl009_softmax_idiom_proven_safe():
+    """The headline proof: exp(x - rowmax(x)) <= 1 AND the normalizer
+    rowsum >= 1 (the argmax term is exactly exp(0)=1) — the bare divide
+    after a TIGHT max-subtraction is clean with no guard at all."""
+    assert not _diags(_softmax_kernel(max_sub=True).func)
+
+
+def test_tl009_missing_max_subtraction_warns():
+    d = [x for x in _diags(_softmax_kernel(max_sub=False).func)
+         if x.rule == "TL009"]
+    assert d and any("max" in x.message for x in d)
+
+
+def _nontight_div_kernel(guard):
+    """Flash-class: the -1e30 floor makes the max non-tight, so the
+    normalizer's >= 1 proof is gone — the divide needs a guard."""
+    @T.prim_func
+    def k(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((8, 128), "float32")
+            mx = T.alloc_fragment((8,), "float32")
+            m2 = T.alloc_fragment((8,), "float32")
+            den = T.alloc_fragment((8,), "float32")
+            T.copy(A, s)
+            T.reduce_max(s, mx, dim=1)
+            for i in T.Parallel(8):
+                m2[i] = T.max(mx[i], -1e30)
+            for i, j in T.Parallel(8, 128):
+                s[i, j] = T.exp(s[i, j] - m2[i])
+            T.reduce_sum(s, den, dim=1)
+            for i, j in T.Parallel(8, 128):
+                if guard == "clamp":
+                    s[i, j] = s[i, j] / T.max(den[i], 1e-30)
+                elif guard == "where":
+                    s[i, j] = T.if_then_else(den[i] > 0.0,
+                                             s[i, j] / den[i], 0.0)
+                else:
+                    s[i, j] = s[i, j] / den[i]
+            T.copy(s, O)
+    return k
+
+
+def test_tl009_unguarded_division_is_error():
+    d = [x for x in _diags(_nontight_div_kernel("none").func)
+         if x.rule == "TL009"]
+    assert d and d[0].severity == "error"
+
+
+def test_tl009_clamped_divide_silent():
+    assert "TL009" not in _rules(_nontight_div_kernel("clamp").func)
+
+
+def test_tl009_where_guarded_divide_silent():
+    assert "TL009" not in _rules(_nontight_div_kernel("where").func)
+
+
+def _log_kernel(guarded):
+    @T.prim_func
+    def k(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((8, 128), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(8, 128):
+                if guarded:
+                    s[i, j] = T.log2(T.max(s[i, j], 1e-30))
+                else:
+                    s[i, j] = T.log2(s[i, j])
+            T.copy(s, O)
+    return k
+
+
+def test_tl009_log_of_raw_input_warns_and_clamp_silences():
+    d = [x for x in _diags(_log_kernel(False).func) if x.rule == "TL009"]
+    assert d and d[0].severity == "warning"
+    assert "65536" in d[0].message        # names the assumption
+    assert "TL009" not in _rules(_log_kernel(True).func)
+
+
+def test_tl009_rsqrt_of_square_plus_eps_silent():
+    """x*x is recognized as nonnegative (the rmsnorm guard shape)."""
+    @T.prim_func
+    def k(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((8, 128), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(8, 128):
+                s[i, j] = T.rsqrt(s[i, j] * s[i, j] + 1e-6)
+            T.copy(s, O)
+    assert "TL009" not in _rules(k.func)
+
+
+def _decode_kernel(zp, mask=0xF):
+    @T.prim_func
+    def k(Bp: T.Tensor((256, 128), "uint8"), S: T.Tensor((1, 128), "float32"),
+          Bd: T.Tensor((256, 128), "float32")):
+        with T.Kernel(1) as bx:
+            d = T.alloc_fragment((256, 128), "float32")
+            for i, j in T.Parallel(256, 128):
+                d[i, j] = (T.cast(T.bitwise_and(
+                    T.cast(Bp[i, j], "int32"), mask), "float32")
+                    - float(zp)) * S[0, j]
+            T.copy(d, Bd)
+    return k
+
+
+def test_tl010_bad_zero_point_fires_planar_decode_silent():
+    d = [x for x in _diags(_decode_kernel(16).func) if x.rule == "TL010"]
+    assert d and d[0].severity == "error" and "envelope" in d[0].message
+    assert "TL010" not in _rules(_decode_kernel(8).func)   # the +8 bias
+    assert "TL010" not in _rules(_decode_kernel(0).func)   # unsigned
+
+
+def test_tl010_twos_complement_branch_decode_silent():
+    """(q & 0xF) then where(q >= 8, q - 16, q): the q-16 arm judges
+    against its branch-refined [8, 15] sub-range — a legal decode."""
+    @T.prim_func
+    def k(Bp: T.Tensor((256, 128), "uint8"),
+          Bd: T.Tensor((256, 128), "float32")):
+        with T.Kernel(1) as bx:
+            q = T.alloc_fragment((256, 128), "int32")
+            d = T.alloc_fragment((256, 128), "float32")
+            for i, j in T.Parallel(256, 128):
+                q[i, j] = T.bitwise_and(T.cast(Bp[i, j], "int32"), 0xF)
+            for i, j in T.Parallel(256, 128):
+                d[i, j] = T.cast(T.if_then_else(
+                    q[i, j] >= 8, q[i, j] - 16, q[i, j]), "float32")
+            T.copy(d, Bd)
+    assert "TL010" not in _rules(k.func)
+
+
+def test_mutation_sweep_all_rules_fire():
+    from tilelang_mesh_tpu.tools.num_sweep import run_sweep
+    for seed in (0, 1, 2):
+        rep = run_sweep(seed)
+        assert rep["ok"], rep
+        assert rep["rules_fired"] == ["TL007", "TL008", "TL009", "TL010"]
+
+
+# ---------------------------------------------------------------------------
+# 3. ops-library precision golden
+# ---------------------------------------------------------------------------
+
+#: the exact tl-num finding surface over the shipped ops library at the
+#: smoke seeds — every entry is a CONTRACT-dependent warning (raw-input
+#: exp/log the kernel cannot bound); zero errors is the CI gate. A new
+#: entry here must be justified the way these are.
+OPS_GOLDEN_WARNINGS = {
+    ("attention_sink", "sink_fwd", "TL009", "warning"),
+    ("flash_attention_bwd", "dkdv", "TL009", "warning"),
+    ("flash_attention_bwd", "dq", "TL009", "warning"),
+    ("flash_attention_varlen", "vdkdv", "TL009", "warning"),
+    ("flash_attention_varlen", "vdq", "TL009", "warning"),
+    ("gdn", "gdn_fwd", "TL009", "warning"),
+    ("gqa_bwd", "dkdv", "TL009", "warning"),
+    ("gqa_bwd", "dq", "TL009", "warning"),
+    ("linear_attention", "retention", "TL009", "warning"),
+    ("mamba2", "ssd", "TL009", "warning"),
+    ("nsa_bwd", "nsa_dkdv", "TL009", "warning"),
+    ("nsa_bwd", "nsa_dq", "TL009", "warning"),
+}
+
+#: ops kernels whose every floating output is proven finite (the
+#: TL_TPU_SANITIZE=auto elision set must never silently shrink)
+OPS_PROVEN_MIN = {
+    ("dequant_gemm", "main"), ("dequant_gemm", "dq"),
+    ("dequant_gemm", "w4a8"), ("gemm", "gemm"),
+    ("flash_decoding", "dec"), ("flash_decoding", "pdec"),
+    ("mla", "mla"), ("linear_attention", "lin_attn"),
+}
+
+
+def test_ops_library_numerics_golden():
+    from pathlib import Path
+
+    from tilelang_mesh_tpu.tools.lint import collect_module_kernels
+    ops = Path(tilelang.__file__).parent / "ops"
+    got = set()
+    proven = set()
+    for f in sorted(ops.glob("*.py")):
+        if f.name.startswith("_"):
+            continue
+        objs, _notes = collect_module_kernels(f)
+        for obj in objs:
+            res = analyze_numerics(obj.func)
+            for d in res.findings:
+                got.add((f.stem, obj.func.name, d.rule, d.severity))
+            if res.proven_finite:
+                proven.add((f.stem, obj.func.name))
+    assert not {g for g in got if g[3] == "error"}, got
+    assert got == OPS_GOLDEN_WARNINGS, got ^ OPS_GOLDEN_WARNINGS
+    assert OPS_PROVEN_MIN <= proven, OPS_PROVEN_MIN - proven
+
+
+def test_quantize_module_lints_clean_and_proves():
+    """The quantize/ factory added to the lint sweep: clean at every
+    severity, outputs proven finite (clamp + guarded divide)."""
+    from pathlib import Path
+
+    from tilelang_mesh_tpu.tools.lint import lint_targets
+    qdir = Path(tilelang.__file__).parent / "quantize"
+    rep = lint_targets([str(qdir)])
+    assert rep["kernels_linted"] >= 1
+    assert rep["summary"]["total"] == 0, rep["findings"]
+
+
+def test_quantize_act_kernel_numerics():
+    from tilelang_mesh_tpu.quantize.quantization import (
+        quantize_act_int8_kernel, quantize_act_int8_ref)
+    k = quantize_act_int8_kernel(64, 128, block_M=32)
+    assert (k.artifact.attrs.get("numerics") or {}).get("proven_finite")
+    x = np.random.default_rng(0).standard_normal((64, 128)) \
+        .astype(np.float32) * 3
+    q, s = k(x)
+    qr, sr = quantize_act_int8_ref(x)
+    np.testing.assert_allclose(np.asarray(s), sr, rtol=1e-6)
+    assert (np.abs(np.asarray(q).astype(np.int32)
+                   - qr.astype(np.int32)) <= 1).all()
+    q0, s0 = k(np.zeros((64, 128), np.float32))   # all-zero rows: no NaN
+    assert np.isfinite(np.asarray(s0)).all()
+    assert (np.asarray(q0) == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# 4. finiteness proofs & TL_TPU_SANITIZE=auto
+# ---------------------------------------------------------------------------
+
+
+def _matmul():
+    @T.prim_func
+    def mm(A: T.Tensor((128, 256), "float32"),
+           B: T.Tensor((256, 128), "float32"),
+           C: T.Tensor((128, 128), "float32")):
+        with T.Kernel(1) as bx:
+            a_s = T.alloc_shared((128, 128), "float32")
+            b_s = T.alloc_shared((128, 128), "float32")
+            c_l = T.alloc_fragment((128, 128), "float32")
+            T.clear(c_l)
+            for ko in T.Pipelined(2):
+                T.copy(A[0, ko * 128], a_s)
+                T.copy(B[ko * 128, 0], b_s)
+                T.gemm(a_s, b_s, c_l)
+            T.copy(c_l, C)
+    return mm
+
+
+def _exp_kernel():
+    @T.prim_func
+    def ek(A: T.Tensor((8, 128), "float32"), O: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((8, 128), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(8, 128):
+                s[i, j] = T.exp(s[i, j])
+            T.copy(s, O)
+    return ek
+
+
+def test_sanitize_mode_parsing(monkeypatch):
+    assert sanitize_mode() == "off"
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    assert sanitize_mode() == "on"
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+    assert sanitize_mode() == "auto"
+    monkeypatch.setenv("TL_TPU_SANITIZE", "yolo")
+    with pytest.raises(ValueError, match="TL_TPU_SANITIZE"):
+        sanitize_mode()
+
+
+def test_proof_attrs_on_plain_artifact():
+    k = tilelang.compile(_matmul())
+    num = k.artifact.attrs.get("numerics")
+    assert num and num["proven_finite"] and num["outputs"] == {"C": True}
+    _CACHE.clear()
+    ke = tilelang.compile(_exp_kernel())
+    nume = ke.artifact.attrs.get("numerics")
+    assert nume and not nume["proven_finite"]
+    assert nume["outputs"] == {"O": False}
+
+
+def test_lint_off_produces_no_proof(monkeypatch):
+    monkeypatch.setenv("TL_TPU_LINT", "0")
+    k = tilelang.compile(_matmul())
+    assert "numerics" not in k.artifact.attrs
+
+
+def test_auto_parity_and_elision_on_proven_kernel(monkeypatch):
+    """Acceptance: =auto is bit-identical to =1 on the proven kernel
+    while skipping the runtime pass (sanitize.elided counts it)."""
+    a = np.random.default_rng(0).standard_normal((128, 256)) \
+        .astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((256, 128)) \
+        .astype(np.float32)
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    k = tilelang.compile(_matmul())
+    r_on = np.asarray(k(a, b))
+    counters = get_tracer().counters()
+    assert not any("sanitize.elided" in c for c in counters)
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+    r_auto = np.asarray(k(a, b))
+    np.testing.assert_array_equal(r_on, r_auto)
+    counters = get_tracer().counters()
+    assert counters.get("sanitize.elided{kernel=mm}", 0) >= 1
+
+
+def test_auto_still_checks_unproven_kernel(monkeypatch):
+    """An unprovable kernel (bare exp) must behave exactly like =1:
+    a non-finite output raises in BOTH modes; =auto elides nothing."""
+    big = np.full((8, 128), 200.0, np.float32)     # exp(200) = inf
+    fine = np.zeros((8, 128), np.float32)
+    for mode in ("1", "auto"):
+        _CACHE.clear()
+        get_tracer().reset()
+        monkeypatch.setenv("TL_TPU_SANITIZE", mode)
+        k = tilelang.compile(_exp_kernel())
+        np.testing.assert_allclose(np.asarray(k(fine)),
+                                   np.ones((8, 128), np.float32))
+        with pytest.raises(NumericError, match="O"):
+            k(big)
+        assert not any("sanitize.elided" in c
+                       for c in get_tracer().counters())
+
+
+def test_auto_without_proof_checks_everything(monkeypatch):
+    """A proof-less artifact (TL_TPU_LINT=0 compile) proves nothing:
+    auto degrades to checking every float output."""
+    monkeypatch.setenv("TL_TPU_LINT", "0")
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+
+    @T.prim_func
+    def double(A: T.Tensor((8, 128), "float32"),
+               B: T.Tensor((8, 128), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((8, 128), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(8, 128):
+                s[i, j] = s[i, j] * 2.0
+            T.copy(s, B)
+
+    k = tilelang.compile(double)
+    bad = np.ones((8, 128), np.float32)
+    bad[2, 7] = np.inf
+    with pytest.raises(NumericError):
+        k(bad)
+    assert not any("sanitize.elided" in c for c in get_tracer().counters())
+
+
+def test_auto_elision_visible_in_overhead_histogram(monkeypatch):
+    """The elided path records dispatch overhead like any sampled call —
+    the histogram rows are how the win is measured (docs/robustness.md)."""
+    monkeypatch.setenv("TL_TPU_RUNTIME_METRICS", "1")
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+    a = np.random.default_rng(0).standard_normal((128, 256)) \
+        .astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((256, 128)) \
+        .astype(np.float32)
+    k = tilelang.compile(_matmul())
+    for _ in range(4):
+        k(a, b)
+    from tilelang_mesh_tpu.observability.runtime import runtime_summary
+    rows = runtime_summary()
+    assert counters_have_elided()
+    assert "fast" in rows["mm"]["host_overhead_by_path"]
+
+
+def counters_have_elided():
+    return any("sanitize.elided" in c for c in get_tracer().counters())
+
+
+def test_auto_elides_on_proven_ops_kernel(monkeypatch):
+    """Acceptance: >= 1 PROVEN ops kernel skips the runtime pass under
+    =auto, bit-identical to =1, with the skip visible in the counter."""
+    from tilelang_mesh_tpu.ops.gemm import matmul_kernel
+    matmul_kernel.cache_clear()
+    a = np.random.default_rng(2).standard_normal((128, 128)) \
+        .astype(np.float32)
+    b = np.random.default_rng(3).standard_normal((128, 128)) \
+        .astype(np.float32)
+    k = matmul_kernel(128, 128, 128, block_M=128, block_N=128,
+                      block_K=128, in_dtype="float32",
+                      out_dtype="float32")
+    assert (k.artifact.attrs.get("numerics") or {}).get("proven_finite")
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    r_on = np.asarray(k(a, b))
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+    r_auto = np.asarray(k(a, b))
+    np.testing.assert_array_equal(r_on, r_auto)
+    assert any("sanitize.elided" in c for c in get_tracer().counters())
+
+
+# -- mesh: payload elision + corruption --------------------------------------
+
+MESH = (2, 2)
+NROW, NCOL = MESH
+SHAPE = (8, 128)
+TARGET = f"cpu-mesh[{NROW}x{NCOL}]"
+
+
+def _mglobal(shape=None):
+    shape = shape or (NROW * NCOL * SHAPE[0], SHAPE[1])
+    return T.MeshTensor(shape, T.MeshShardingPolicy(cross_mesh_dim=0),
+                        MESH, "float32")
+
+
+def _mesh_proven_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _mglobal(), B: _mglobal((NROW * NCOL * SHAPE[0], 1))):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                o = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, o, "sum", "h", dim=1)
+                T.copy(o, B)
+        return k
+
+
+def _mesh_unproven_program():
+    with mesh_config(*MESH):
+        @T.prim_func
+        def k(A: _mglobal(), B: _mglobal((NROW * NCOL * SHAPE[0], 1))):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                e = T.alloc_fragment(SHAPE, "float32")
+                o = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                for i, j in T.Parallel(*SHAPE):
+                    e[i, j] = T.exp(x[i, j])      # unbounded payload
+                T.comm.all_reduce(e, o, "sum", "h", dim=1)
+                T.copy(o, B)
+        return k
+
+
+def _mshards(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (NROW * NCOL * SHAPE[0], SHAPE[1])).astype(np.float32)
+
+
+def test_mesh_auto_parity_and_payload_elision(monkeypatch):
+    a = _mshards(3)
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    k1 = tilelang.compile(_mesh_proven_program(), target=TARGET)
+    num = k1.artifact.attrs.get("numerics")
+    assert num and num["proven_finite"]
+    assert num["payloads"] == [{"buffer": "frag", "proven": True}]
+    r1 = np.asarray(k1(a))
+    _fn, checks, _el = k1._sanitized_cache["on"]
+    assert len(checks) == 2          # payload + output both checked
+    monkeypatch.setenv("TL_TPU_SANITIZE", "auto")
+    _CACHE.clear()
+    get_tracer().reset()
+    k2 = tilelang.compile(_mesh_proven_program(), target=TARGET)
+    r2 = np.asarray(k2(a))
+    np.testing.assert_array_equal(r1, r2)
+    fn, checks, elided = k2._sanitized_cache["auto"]
+    assert checks == [] and elided == 2
+    assert fn is k2.func             # the PLAIN program dispatched
+    assert get_tracer().counters().get(
+        "sanitize.elided{kernel=k}", 0) == 2
+
+
+def test_mesh_auto_never_skips_unproven_payload(monkeypatch):
+    """Acceptance: a comm.collective corrupt fault on an unprovable
+    program is caught identically by =1 and =auto."""
+    a = _mshards(4)
+    for mode in ("1", "auto"):
+        monkeypatch.setenv("TL_TPU_SANITIZE", mode)
+        _CACHE.clear()
+        with inject("comm.collective", kind="corrupt"):
+            k = tilelang.compile(_mesh_unproven_program(), target=TARGET)
+            proof = k.artifact.attrs.get("_num_proof")
+            assert proof == {"payload_uids": [],
+                             "outputs": {"B": False}}
+            with pytest.raises(NumericError):
+                k(a)
+
+
+def test_mesh_corrupt_budget_survives_lowering(monkeypatch):
+    """A times=1 corrupt clause must poison at the RUNTIME site: the
+    lowering-time comm.collective accounting visit must not consume
+    the clause's budget (faults.corrupt_armed probe)."""
+    monkeypatch.setenv("TL_TPU_SANITIZE", "1")
+    _CACHE.clear()
+    with inject("comm.collective", kind="corrupt", times=1):
+        k = tilelang.compile(_mesh_unproven_program(), target=TARGET)
+        with pytest.raises(NumericError):
+            k(_mshards(6))
+
+
+def test_mesh_corrupt_fault_is_noop_when_sanitizer_off():
+    """The corrupt kind must not break an unguarded run — it poisons
+    silently (the class the sanitizer exists to catch)."""
+    _CACHE.clear()
+    with inject("comm.collective", kind="corrupt"):
+        k = tilelang.compile(_mesh_unproven_program(), target=TARGET)
+        out = np.asarray(k(_mshards(5)))
+    assert not np.isfinite(out).all()      # the poison went through
+
+
+# ---------------------------------------------------------------------------
+# 5. surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_findings_surface_in_plan_desc_and_attrs():
+    k = tilelang.compile(_softmax_kernel(max_sub=False))
+    assert "lint[warn]" in k.artifact.plan_desc
+    assert "TL009" in k.artifact.plan_desc
+    rules = {d["rule"] for d in k.artifact.attrs["lint"]}
+    assert "TL009" in rules
+    summ = obs.metrics_summary()["lint"]
+    assert summ["by_rule"].get("TL009")
+
+
+def test_clean_kernel_plan_desc_byte_stable():
+    k = tilelang.compile(_matmul())
+    assert "lint[" not in k.artifact.plan_desc
+    assert "lint" not in {a for a in k.artifact.attrs
+                          if not a.startswith("_")} or \
+        k.artifact.attrs.get("lint") is None
+
+
+def test_strict_mode_rejects_and_dumps_flight(monkeypatch, tmp_path):
+    """Satellite: a strict-mode compile rejection dumps the black box
+    naming the kernel and rules."""
+    from tilelang_mesh_tpu.observability import flight
+    monkeypatch.setenv("TL_TPU_LINT", "strict")
+    monkeypatch.setenv("TL_TPU_FLIGHT_DIR", str(tmp_path))
+    flight.reset()
+    try:
+        with pytest.raises(SemanticError, match="TL009"):
+            tilelang.compile(_nontight_div_kernel("none"))
+        dumps = list(tmp_path.glob("flight_*_strict_lint_*.jsonl"))
+        assert dumps, list(tmp_path.iterdir())
+        head = json.loads(dumps[0].read_text().splitlines()[0])
+        assert head["reason"] == "strict_lint"
+        assert head["attrs"]["kernel"] == "k"
+        assert "TL009" in head["attrs"]["rules"]
+    finally:
+        flight.reset()
+
+
+def test_cli_json_findings_carry_loc_and_severity_summary(tmp_path):
+    """Satellite: --json findings emit Diagnostic.loc and the text
+    summary counts findings by severity."""
+    mod = tmp_path / "badmod.py"
+    mod.write_text(
+        "import tilelang_mesh_tpu.language as T\n\n"
+        "def nomax_kernel(M, N, dtype='float32'):\n"
+        "    @T.prim_func\n"
+        "    def nm(A: T.Tensor((M, N), dtype), O: T.Tensor((M, N), dtype)):\n"
+        "        with T.Kernel(1) as bx:\n"
+        "            s = T.alloc_fragment((M, N), 'float32')\n"
+        "            T.copy(A, s)\n"
+        "            for i, j in T.Parallel(M, N):\n"
+        "                s[i, j] = T.exp(s[i, j])\n"
+        "            T.copy(s, O)\n"
+        "    return nm\n")
+    from tilelang_mesh_tpu.tools.lint import format_report, lint_targets
+    rep = lint_targets([str(mod)])
+    assert rep["findings"], rep
+    for f in rep["findings"]:
+        assert f.get("loc", "").startswith(str(mod))
+    text = format_report(rep)
+    assert "by severity: warning=" in text
+    assert "errors: 0" in text
+
+
+def test_cache_key_separates_num_knobs():
+    mm = _matmul()
+    k0 = KernelCache.key_for(mm.func.script(), "cpu", None, {})
+    k1 = KernelCache.key_for(mm.func.script(), "cpu", None,
+                             {"tl.tpu.num_assume_abs": 1024.0})
+    k2 = KernelCache.key_for(mm.func.script(), "cpu", None,
+                             {"tl.tpu.num_err_threshold": 0.5})
+    assert len({k0, k1, k2}) == 3
+
+
+def test_assume_abs_knob_changes_warning_track(monkeypatch):
+    """A tiny nominal bound proves the bare exp finite (warning gone)."""
+    ek = _exp_kernel()
+    assert "TL009" in {
+        d.rule for d in collect_diagnostics(ek.func, with_plan=False)}
+    diags = collect_diagnostics(
+        ek.func, pass_cfg={"tl.tpu.num_assume_abs": 1.0},
+        with_plan=False)
+    assert "TL009" not in {d.rule for d in diags}
+
+
+def test_strict_escalation_ignores_warnings():
+    """Warnings (contract-dependent hazards) never fail a strict
+    compile — only sound-track errors do."""
+    from tilelang_mesh_tpu.analysis import run_semantic_checks
+    f = _softmax_kernel(max_sub=False).func     # warnings only
+    run_semantic_checks(f, {"tl.tpu.lint": "strict"})
+
+
+def test_numerics_result_payload_uid_semantics():
+    res = analyze_numerics(_mesh_proven_program().func)
+    assert res.payload_uids_proven()
+    res2 = analyze_numerics(_mesh_unproven_program().func)
+    assert not res2.payload_uids_proven()
+    assert res2.payloads and res2.payloads[0][3] is False

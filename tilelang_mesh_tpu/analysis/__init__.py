@@ -5,5 +5,7 @@ from .checkers import (NestedLoopChecker, FragmentLoopChecker,
 from .diagnostics import Diagnostic, LintReport, SEVERITIES
 from .rules import (RULES, lint_mode, run_lint, run_plan_lint,
                     record_findings, plan_desc_block)
+from .numerics import (NUM_RULES, NumericsResult, analyze as analyze_numerics,
+                       num_assume_abs, num_err_threshold, numerics_attrs)
 from .layout_visual import (visualize_plan, visualize_fragment,
                             visualize_mesh_blocks)

@@ -1,0 +1,387 @@
+"""Abstract numeric domain for the tl-num value analysis.
+
+The value-level counterpart of the affine index model (regions.py): each
+buffer is summarized by an :class:`AbsVal` — a *dual-track* element
+interval, a finiteness flag, and an accumulated relative rounding-error
+bound — transferred through the tile IR by the interpreter in
+``analysis/numerics.py``.
+
+Two interval tracks, two kinds of claims:
+
+- the **sound** track assumes nothing about input magnitudes (float
+  inputs start at ``[-inf, +inf]`` = *unknown*); a hazard visible here —
+  a dtype range escaped, a divisor interval straddling zero — holds for
+  every finite input and reports at **error** severity;
+- the **nominal** track additionally assumes ``|float input| <=``
+  the ``tl.tpu.num_assume_abs`` bound (default 2**16); hazards visible
+  only here report as **warnings** ("under the default input-magnitude
+  assumption") and drive the conservative side of the finiteness proofs
+  the ``TL_TPU_SANITIZE=auto`` elision consumes.
+
+On top of the intervals the domain carries the small set of relational
+facts the shipped kernels' numerics actually hinge on:
+
+- **domination** — ``T.reduce_max(S, m)`` records ``m[i] >= max_j
+  S[i, j]`` (and whether the bound is *tight*, i.e. an equality), so the
+  online-softmax ``exp(x - m)`` argument is proven ``<= 0`` and the
+  exponential lands in ``[0, 1]`` on BOTH tracks;
+- **unit rows** — ``exp(x - m)`` under a *tight* rowmax proves each row
+  attains ``exp(0) = 1`` at its argmax, so the row-sum normalizer is
+  ``>= 1`` and the plain-softmax division is pole-free;
+- **quantized payloads** — ``(x & M) - z`` decodes tracked through
+  masks/shifts/casts, the bit-level evidence behind TL010.
+
+Everything here is pure Python floats/ints — no jax, no numpy — so the
+analysis can run inside ``run_semantic_checks`` on every compile.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Dict, FrozenSet, Optional, Tuple
+
+INF = math.inf
+
+#: bounds beyond this magnitude are treated as "unknown" (widened to
+#: +-inf): no supported dtype can represent them, and keeping absurd
+#: finite products (``acc / 1e-300``) would manufacture fake overflow
+#: proofs out of guard epsilons.
+SAT = 1e39
+
+# -- dtype facts ------------------------------------------------------------
+
+#: largest finite magnitude per float dtype
+FLOAT_MAX = {
+    "float64": 1.7976931348623157e308,
+    "float32": 3.4028234663852886e38,
+    "bfloat16": 3.3895313892515355e38,
+    "float16": 65504.0,
+    "float8_e4m3fn": 448.0,
+    "float8_e5m2": 57344.0,
+}
+
+#: unit roundoff (machine epsilon / 2) per float dtype — the per-rounding
+#: relative-error step the TL008 accumulation bound integrates
+FLOAT_EPS = {
+    "float64": 2.0 ** -53,
+    "float32": 2.0 ** -24,
+    "bfloat16": 2.0 ** -8,
+    "float16": 2.0 ** -11,
+    "float8_e4m3fn": 2.0 ** -4,
+    "float8_e5m2": 2.0 ** -3,
+}
+
+
+def is_float(dtype: str) -> bool:
+    return dtype.startswith("float") or dtype == "bfloat16"
+
+
+def is_int(dtype: str) -> bool:
+    return dtype.startswith(("int", "uint"))
+
+
+def int_range(dtype: str) -> Tuple[int, int]:
+    bits = int("".join(c for c in dtype if c.isdigit()) or 32)
+    if dtype.startswith("uint"):
+        return 0, (1 << bits) - 1
+    return -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+
+
+def dtype_max(dtype: str) -> float:
+    if is_float(dtype):
+        return FLOAT_MAX[dtype]
+    return float(int_range(dtype)[1])
+
+
+def dtype_eps(dtype: str) -> float:
+    return FLOAT_EPS.get(dtype, 0.0)
+
+
+# -- relational facts -------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DomFact:
+    """``holder[I] >= max over axis `dim` of buffer (uid, ver)`` — or,
+    with ``dim is None``, the elementwise ``holder[I] >= other[I]``.
+    ``tight`` marks the reduce_max equality (holder == the row max),
+    the precondition of the unit-row argmax argument."""
+
+    uid: int
+    ver: int
+    dim: Optional[int]
+    tight: bool = False
+
+
+# -- the abstract value -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AbsVal:
+    """Per-buffer-element summary. ``lo/hi`` is the nominal track
+    (input-magnitude assumption applied), ``slo/shi`` the sound track
+    (no assumption; +-inf = unknown). ``finite`` is the nominal-track
+    no-NaN/Inf proof the sanitizer elision consumes; ``err`` the
+    accumulated relative rounding-error bound (TL008)."""
+
+    lo: float = -INF
+    hi: float = INF
+    slo: float = -INF
+    shi: float = INF
+    finite: bool = False
+    err: float = 0.0
+    facts: FrozenSet[DomFact] = frozenset()
+    #: axis along which every slice provably attains an element >= 1
+    #: (exp of a tight max-subtraction); feeds the row-sum >= 1 proof
+    unit_dim: Optional[int] = None
+    #: axis along which every slice provably attains 0 (the value is a
+    #: tight ``x - rowmax(x)`` difference); exp() turns it into unit_dim
+    max_sub_dim: Optional[int] = None
+    #: quantization-decode evidence: (mask, zero_point_applied or None)
+    qmask: Optional[int] = None
+    qzp: Optional[float] = None
+
+    # -- constructors --------------------------------------------------
+    @staticmethod
+    def const(v: float) -> "AbsVal":
+        v = float(v)
+        return AbsVal(v, v, v, v, finite=math.isfinite(v))
+
+    @staticmethod
+    def top() -> "AbsVal":
+        return AbsVal()
+
+    def sound_bounded(self) -> bool:
+        """Both sound bounds are known (derivation never touched an
+        unknown input) — the precondition of an error-severity claim."""
+        return self.slo > -INF and self.shi < INF
+
+    # -- lattice -------------------------------------------------------
+    def join(self, o: "AbsVal") -> "AbsVal":
+        return AbsVal(min(self.lo, o.lo), max(self.hi, o.hi),
+                      min(self.slo, o.slo), max(self.shi, o.shi),
+                      finite=self.finite and o.finite,
+                      err=max(self.err, o.err),
+                      facts=self.facts & o.facts,
+                      unit_dim=self.unit_dim
+                      if self.unit_dim == o.unit_dim else None,
+                      max_sub_dim=self.max_sub_dim
+                      if self.max_sub_dim == o.max_sub_dim else None,
+                      qmask=self.qmask if self.qmask == o.qmask else None,
+                      qzp=self.qzp if self.qzp == o.qzp else None)
+
+    def subsumes(self, o: "AbsVal") -> bool:
+        return (self.lo <= o.lo and self.hi >= o.hi
+                and self.slo <= o.slo and self.shi >= o.shi
+                and self.err >= o.err
+                and (o.finite or not self.finite))
+
+    def widen_top(self) -> "AbsVal":
+        return AbsVal(err=INF)
+
+    def plain(self) -> "AbsVal":
+        """Same bounds, relational/bit evidence dropped (any arithmetic
+        that does not preserve a fact goes through here)."""
+        return replace(self, facts=frozenset(), unit_dim=None,
+                       max_sub_dim=None, qmask=None, qzp=None)
+
+
+def _sat(v: float) -> float:
+    if v > SAT:
+        return INF
+    if v < -SAT:
+        return -INF
+    if v != v:        # NaN from inf arithmetic: unknown
+        return INF
+    return v
+
+
+def _satlo(v: float) -> float:
+    if v > SAT:
+        return INF
+    if v < -SAT:
+        return -INF
+    if v != v:
+        return -INF
+    return v
+
+
+def mk(lo, hi, slo, shi, finite, err=0.0) -> AbsVal:
+    return AbsVal(_satlo(lo), _sat(hi), _satlo(slo), _sat(shi),
+                  finite=finite, err=err)
+
+
+# -- interval arithmetic (applied per track) --------------------------------
+
+
+def _add(a: Tuple[float, float], b: Tuple[float, float]):
+    return a[0] + b[0], a[1] + b[1]
+
+
+def _sub(a, b):
+    return a[0] - b[1], a[1] - b[0]
+
+
+def _mul(a, b):
+    cands = []
+    for x in a:
+        for y in b:
+            if x == 0.0 or y == 0.0:
+                cands.append(0.0)
+                continue
+            p = x * y
+            cands.append(p if p == p else 0.0)  # inf*0 -> 0 candidate
+    return min(cands), max(cands)
+
+
+def _div(a, b):
+    # caller guarantees 0 not in b
+    cands = []
+    for x in a:
+        for y in b:
+            if y == 0.0:
+                continue
+            q = x / y if not (math.isinf(x) and math.isinf(y)) else 0.0
+            cands.append(q if q == q else 0.0)
+    if not cands:
+        return -INF, INF
+    lo, hi = min(cands), max(cands)
+    if math.isinf(a[0]) or math.isinf(a[1]):
+        lo, hi = min(lo, -INF if a[0] == -INF else lo), \
+            max(hi, INF if a[1] == INF else hi)
+    return lo, hi
+
+
+def av_add(a: AbsVal, b: AbsVal, eps: float = 0.0) -> AbsVal:
+    lo, hi = _add((a.lo, a.hi), (b.lo, b.hi))
+    slo, shi = _add((a.slo, a.shi), (b.slo, b.shi))
+    return mk(lo, hi, slo, shi, a.finite and b.finite,
+              max(a.err, b.err) + eps)
+
+
+def av_sub(a: AbsVal, b: AbsVal, eps: float = 0.0) -> AbsVal:
+    lo, hi = _sub((a.lo, a.hi), (b.lo, b.hi))
+    slo, shi = _sub((a.slo, a.shi), (b.slo, b.shi))
+    return mk(lo, hi, slo, shi, a.finite and b.finite,
+              max(a.err, b.err) + eps)
+
+
+def av_mul(a: AbsVal, b: AbsVal, eps: float = 0.0) -> AbsVal:
+    lo, hi = _mul((a.lo, a.hi), (b.lo, b.hi))
+    slo, shi = _mul((a.slo, a.shi), (b.slo, b.shi))
+    return mk(lo, hi, slo, shi, a.finite and b.finite,
+              a.err + b.err + eps)
+
+
+def av_div(a: AbsVal, b: AbsVal, eps: float = 0.0) -> AbsVal:
+    lo, hi = _div((a.lo, a.hi), (b.lo, b.hi))
+    slo, shi = _div((a.slo, a.shi), (b.slo, b.shi))
+    return mk(lo, hi, slo, shi, a.finite and b.finite,
+              a.err + b.err + eps)
+
+
+def av_neg(a: AbsVal) -> AbsVal:
+    return mk(-a.hi, -a.lo, -a.shi, -a.slo, a.finite, a.err)
+
+
+def av_min(a: AbsVal, b: AbsVal) -> AbsVal:
+    return mk(min(a.lo, b.lo), min(a.hi, b.hi),
+              min(a.slo, b.slo), min(a.shi, b.shi),
+              a.finite and b.finite, max(a.err, b.err))
+
+
+def av_max(a: AbsVal, b: AbsVal) -> AbsVal:
+    """Interval max. Domination facts are NOT unioned here: a fact's
+    index correspondence can only be validated where the result lands
+    (the store transfer in numerics.py owns that)."""
+    return mk(max(a.lo, b.lo), max(a.hi, b.hi),
+              max(a.slo, b.slo), max(a.shi, b.shi),
+              a.finite and b.finite, max(a.err, b.err))
+
+
+def av_abs(a: AbsVal) -> AbsVal:
+    def ab(lo, hi):
+        if lo >= 0:
+            return lo, hi
+        if hi <= 0:
+            return -hi, -lo
+        return 0.0, max(-lo, hi)
+    lo, hi = ab(a.lo, a.hi)
+    slo, shi = ab(a.slo, a.shi)
+    return mk(lo, hi, slo, shi, a.finite, a.err)
+
+
+def _exp_base(a: AbsVal, base: float, out_dtype: str) -> AbsVal:
+    """exp/exp2/exp10 interval with overflow saturation to +inf; the
+    caller judges the TL009 overflow question from the operand."""
+    def e(x):
+        if x == -INF:
+            return 0.0
+        if x == INF:
+            return INF
+        try:
+            v = base ** x if base != math.e else math.exp(x)
+        except OverflowError:
+            return INF
+        return v
+    thr = math.log(FLOAT_MAX.get(out_dtype, FLOAT_MAX["float32"])) \
+        / math.log(base)
+    fin = a.finite and a.hi <= thr
+    return mk(e(a.lo), e(a.hi), e(a.slo), e(a.shi), fin, a.err + 1e-7)
+
+
+def exp_overflow_threshold(base: float, out_dtype: str) -> float:
+    return math.log(FLOAT_MAX.get(out_dtype, FLOAT_MAX["float32"])) \
+        / math.log(base)
+
+
+def av_bounded_unary(a: AbsVal, lo: float, hi: float) -> AbsVal:
+    """tanh/sigmoid/erf/sin/cos-style range-bounded ops."""
+    return mk(lo, hi, lo, hi, a.finite, a.err)
+
+
+# -- state ------------------------------------------------------------------
+
+
+@dataclass
+class NumState:
+    """uid -> AbsVal plus a per-buffer write version (facts about a
+    buffer die when it is rewritten)."""
+
+    vals: Dict[int, AbsVal] = field(default_factory=dict)
+    ver: Dict[int, int] = field(default_factory=dict)
+
+    def clone(self) -> "NumState":
+        return NumState(dict(self.vals), dict(self.ver))
+
+    def get(self, uid: int) -> Optional[AbsVal]:
+        return self.vals.get(uid)
+
+    def version(self, uid: int) -> int:
+        return self.ver.get(uid, 0)
+
+    def write(self, uid: int, val: AbsVal, strong: bool) -> None:
+        old = self.vals.get(uid)
+        if strong or old is None:
+            self.vals[uid] = val
+        else:
+            self.vals[uid] = old.join(val)
+        self.ver[uid] = self.ver.get(uid, 0) + 1
+
+    def join(self, o: "NumState") -> "NumState":
+        out = NumState()
+        for uid in set(self.vals) | set(o.vals):
+            a, b = self.vals.get(uid), o.vals.get(uid)
+            if a is None or b is None:
+                # written on one path only: maybe-written -> join with
+                # the known side, facts only survive matching versions
+                v = (a or b)
+                out.vals[uid] = v
+            else:
+                out.vals[uid] = a.join(b)
+            out.ver[uid] = max(self.version(uid), o.version(uid))
+        return out
+
+    def fact_valid(self, f: DomFact) -> bool:
+        return self.version(f.uid) == f.ver

@@ -245,6 +245,11 @@ def run_semantic_checks(func: PrimFunc,
     if mode == "strict":
         errs = [d for d in findings if d.severity == "error"]
         if errs:
+            # strict-mode compile rejection: dump the flight-recorder
+            # black box naming the kernel and rules before raising
+            from ..observability import flight as _flight
+            _flight.dump("strict_lint", kernel=func.name,
+                         rules=sorted({d.rule for d in errs}))
             _raise_aggregated(func.name, errs)
     return findings
 

@@ -1,0 +1,1334 @@
+"""tl-num: abstract-interpretation numerical-safety analysis (TL007-010).
+
+An abstract interpreter over the tile IR that transfers the
+:class:`~.absint.AbsVal` domain (dual-track element interval, finiteness
+flag, accumulated relative rounding-error bound) through every statement
+— fill/copy/elementwise stores/gemm-accumulate/reduce/cumsum/collectives
+— with loop-trip-count widening taken from the static loop extents.
+
+Four proof-gated rules ride on the interpretation (docs/static_analysis.md):
+
+========  ==================  ==============================================
+TL007     overflow            a stored/cast value's interval escapes the
+                              destination dtype's finite range (bf16 store
+                              of an over-range f32 accumulator, int wrap)
+TL008     precision-loss      an accumulation chain's relative-error bound
+                              (trip count x unit roundoff of the
+                              accumulator dtype) crosses the threshold —
+                              the low-precision-accumulator-at-large-K bug
+TL009     domain error        an exp/log/sqrt/rsqrt/division operand
+                              interval reaches the op's pole or overflow
+                              region; the online-softmax ``exp(x - m)``
+                              idiom is *proven* safe (``x - max(x) <= 0``)
+TL010     quantization range  a quantized-payload decode ``(x & M) - z``
+                              escapes the b-bit payload envelope (wrong
+                              zero point / scale-range mismatch)
+========  ==================  ==============================================
+
+Severity follows the two interval tracks (absint.py): a hazard the
+*sound* track demonstrates (no input-magnitude assumption involved) is
+an **error**; one visible only under the nominal ``|input| <=
+tl.tpu.num_assume_abs`` assumption is a **warning**.
+
+Loop summarization: a loop body is interpreted twice, the per-iteration
+growth is extrapolated by the static trip count, and the candidate
+invariant is verified by a third pass (growth at the widened state must
+not exceed the observed growth — accelerating recurrences are widened
+to top instead). This is exact for the additive accumulator chains the
+ops library is made of and conservative for everything else.
+
+The same interpretation also produces the **finiteness proofs** behind
+``TL_TPU_SANITIZE=auto`` (docs/robustness.md): a kernel whose every
+floating output (and, for mesh programs, every floating collective
+payload) is proven finite under the nominal assumption gets
+``attrs["numerics"]["proven_finite"]`` and the runtime NaN/Inf pass is
+skipped for it, falling back to checking anything unproven.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
+                  BufferLoad, BufferStoreStmt, CommAllGather,
+                  CommAllReduce, CommBroadcast, CommPut, CommStmt, CopyStmt,
+                  CumSumStmt, EvaluateStmt, FillStmt, ForNest, GemmStmt,
+                  IfThenElse, IntImm, KernelNode, PrimFunc, PrintStmt,
+                  ReduceStmt, Region, SeqStmt, Stmt, Var, as_int, convert)
+from ..ir.expr import (BinOp, BoolImm, Call, Cast, FloatImm, StringImm,
+                       affine_decompose)
+from .absint import (INF, AbsVal, DomFact, NumState, _exp_base, av_abs,
+                     av_add, av_bounded_unary, av_div, av_max, av_min,
+                     av_mul, av_sub, dtype_eps, dtype_max,
+                     exp_overflow_threshold, int_range, is_float, is_int,
+                     mk)
+from .diagnostics import Diagnostic, stmt_loc
+
+__all__ = ["NUM_RULES", "NumericsResult", "analyze", "numerics_attrs",
+           "num_assume_abs", "num_err_threshold"]
+
+NUM_RULES = ("TL007", "TL008", "TL009", "TL010")
+
+#: default magnitude assumption on float (and wide-int) inputs — the
+#: nominal track's contract, overridable via tl.tpu.num_assume_abs /
+#: TL_TPU_NUM_ASSUME_ABS
+DEFAULT_ASSUME_ABS = 65536.0
+
+#: default TL008 relative-error threshold (tl.tpu.num_err_threshold)
+DEFAULT_ERR_THRESHOLD = 0.0625
+
+#: loop bodies are widened, not unrolled, past this trip count
+_EXACT_TRIPS = 1
+
+#: int inputs at least this wide carry no practical value contract: the
+#: sound track treats them as unknown (like floats) so index arithmetic
+#: on loaded page ids cannot "prove" an int32 wrap
+_WIDE_INT_BITS = 32
+
+
+def num_assume_abs(pass_cfg: Optional[dict] = None) -> float:
+    raw = (pass_cfg or {}).get("tl.tpu.num_assume_abs")
+    if raw is None:
+        from ..env import env
+        return float(env.TL_TPU_NUM_ASSUME_ABS)
+    return float(raw)
+
+
+def num_err_threshold(pass_cfg: Optional[dict] = None) -> float:
+    raw = (pass_cfg or {}).get("tl.tpu.num_err_threshold")
+    return float(raw) if raw is not None else DEFAULT_ERR_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# results
+# ---------------------------------------------------------------------------
+
+
+class NumericsResult:
+    """One interpretation of one kernel: the TL007-010 findings plus the
+    finiteness proofs the sanitizer elision consumes."""
+
+    def __init__(self):
+        self.findings: List[Diagnostic] = []
+        #: written float output/inout param name -> proven finite
+        self.outputs: Dict[str, bool] = {}
+        #: float collective payload proofs, program order:
+        #: (stmt id, buffer uid, buffer name, proven)
+        self.payloads: List[Tuple[int, int, str, bool]] = []
+        self.assume_abs: float = DEFAULT_ASSUME_ABS
+
+    @property
+    def proven_finite(self) -> bool:
+        return (all(self.outputs.values())
+                and all(p[3] for p in self.payloads)
+                and bool(self.outputs or self.payloads))
+
+    def payload_uids_proven(self) -> set:
+        """Buffer uids whose EVERY payload use is proven finite."""
+        ok: Dict[int, bool] = {}
+        for _sid, uid, _name, proven in self.payloads:
+            ok[uid] = ok.get(uid, True) and proven
+        return {uid for uid, p in ok.items() if p}
+
+    def attrs_record(self) -> dict:
+        """The JSON-clean ``attrs["numerics"]`` record persisted with
+        the artifact (survives the disk cache)."""
+        rec = {"proven_finite": self.proven_finite,
+               "outputs": dict(sorted(self.outputs.items())),
+               "assume_abs": self.assume_abs}
+        if self.payloads:
+            rec["payloads"] = [
+                {"buffer": name, "proven": proven}
+                for _sid, _uid, name, proven in self.payloads]
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# index keys (fact matching)
+# ---------------------------------------------------------------------------
+
+
+def _idx_key(e):
+    """Canonical affine form of one index expression, or None."""
+    if isinstance(e, slice):
+        return ("slice",)
+    dec = affine_decompose(convert(e))
+    if dec is None:
+        return None
+    coeffs, const = dec
+    return (tuple(sorted((vid, c) for vid, (_v, c) in coeffs.items())),
+            const)
+
+
+def _indices_match(a, b) -> bool:
+    if a is None or b is None or len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        kx, ky = _idx_key(x), _idx_key(y)
+        if kx is None or ky is None or kx != ky:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+class _Ctx:
+    """Evaluation context: integer ranges of in-scope loop/grid vars and
+    branch-derived value refinements (uid -> (lo, hi, ver))."""
+
+    __slots__ = ("ranges", "refine")
+
+    def __init__(self, ranges=None, refine=None):
+        self.ranges: Dict[int, Tuple[int, int]] = dict(ranges or {})
+        self.refine: Dict[int, Tuple[float, float, int]] = \
+            dict(refine or {})
+
+    def child(self) -> "_Ctx":
+        return _Ctx(self.ranges, self.refine)
+
+
+class Interp:
+    def __init__(self, func: PrimFunc, pass_cfg: Optional[dict] = None):
+        self.func = func
+        self.pass_cfg = dict(pass_cfg or {})
+        self.assume = num_assume_abs(self.pass_cfg)
+        self.err_thr = num_err_threshold(self.pass_cfg)
+        self.result = NumericsResult()
+        self.result.assume_abs = self.assume
+        self._seen = set()          # finding dedupe keys
+        self._report = False
+        self._params = {b.uid: b for b in func.buffer_params}
+        self._scopes: Dict[int, str] = {}
+        self._dtypes: Dict[int, str] = {}
+
+    # -- findings ------------------------------------------------------
+    def _emit(self, rule: str, sev: str, msg: str, stmt: Stmt,
+              buffer: str = "", key=None) -> None:
+        if not self._report:
+            return
+        k = key if key is not None else (rule, id(stmt), buffer)
+        if k in self._seen:
+            return
+        self._seen.add(k)
+        self.result.findings.append(Diagnostic(
+            rule, sev, msg, buffer=buffer,
+            op=type(stmt).__name__, loc=stmt_loc(stmt)))
+
+    # -- entry ---------------------------------------------------------
+    def run(self) -> NumericsResult:
+        state = NumState()
+        self._report = True
+        try:
+            self._transfer(self.func.body, state, _Ctx())
+        except RecursionError:      # pragma: no cover - degenerate IR
+            return self.result
+        # output proofs: every float buffer param written anywhere
+        for uid, buf in self._params.items():
+            if not is_float(buf.dtype):
+                continue
+            val = state.get(uid)
+            if val is None or state.version(uid) == 0:
+                continue            # never written: not an output
+            self.result.outputs[buf.name] = bool(val.finite)
+        return self.result
+
+    # -- buffer values -------------------------------------------------
+    def _input_val(self, buf: Buffer) -> AbsVal:
+        dt = buf.dtype
+        if dt == "bool":
+            return AbsVal(0.0, 1.0, 0.0, 1.0, finite=True)
+        if is_int(dt):
+            lo, hi = int_range(dt)
+            bits = int("".join(c for c in dt if c.isdigit()) or 32)
+            if bits >= _WIDE_INT_BITS:
+                b = min(self.assume, float(hi))
+                return AbsVal(-b if lo < 0 else 0.0, b, -INF, INF,
+                              finite=True)
+            return AbsVal(float(lo), float(hi), float(lo), float(hi),
+                          finite=True)
+        b = self.assume
+        return AbsVal(-b, b, -INF, INF, finite=True)
+
+    def _load(self, buf: Buffer, state: NumState, ctx: _Ctx) -> AbsVal:
+        v = state.get(buf.uid)
+        if v is None:
+            if buf.uid in self._params or buf.scope == "global":
+                v = self._input_val(buf)
+                state.vals[buf.uid] = v     # stable identity for facts
+            else:
+                # uninitialized scratch: garbage VMEM (TL003's finding;
+                # numerics just refuses to prove anything about it)
+                v = AbsVal()
+        r = ctx.refine.get(buf.uid)
+        if r is not None and r[2] == state.version(buf.uid):
+            lo, hi = r[0], r[1]
+            v = replace(v, lo=max(v.lo, lo), hi=min(v.hi, hi),
+                        slo=max(v.slo, lo), shi=min(v.shi, hi))
+        return v
+
+    # -- store-side checks ---------------------------------------------
+    def _materialize(self, val: AbsVal, dtype: str, stmt: Stmt,
+                     buf_name: str,
+                     value_dtype: Optional[str] = None) -> AbsVal:
+        """Check + round a value landing in a buffer of ``dtype``:
+        TL007 range escapes, TL008 accumulated-error threshold.
+        ``value_dtype`` is the precision the value already lives at —
+        rounding error is charged only when the landing actually
+        narrows (a bf16->bf16 copy re-rounds nothing)."""
+        if dtype == "bool":
+            return replace(val, finite=True)
+        if is_int(dtype):
+            lo, hi = int_range(dtype)
+            if val.sound_bounded() and (val.shi > hi or val.slo < lo):
+                self._emit(
+                    "TL007", "error",
+                    f"value range [{val.slo:.4g}, {val.shi:.4g}] wraps "
+                    f"the {dtype} destination '{buf_name}' "
+                    f"[{lo}, {hi}]; widen the accumulator dtype",
+                    stmt, buffer=buf_name)
+            return val
+        fmax = dtype_max(dtype)
+        out = val
+        if val.sound_bounded() and (val.shi > fmax or val.slo < -fmax):
+            self._emit(
+                "TL007", "error",
+                f"value range [{val.slo:.4g}, {val.shi:.4g}] escapes "
+                f"the finite range of {dtype} destination "
+                f"'{buf_name}' (max {fmax:.4g}); the store saturates "
+                f"to Inf — keep the value in a wider dtype",
+                stmt, buffer=buf_name)
+            out = replace(out, finite=False)
+        elif val.hi > fmax or val.lo < -fmax:
+            # visible only under the input-magnitude assumption: no
+            # finding, but the finiteness proof is gone
+            out = replace(out, finite=False)
+        step = dtype_eps(dtype)
+        if value_dtype is not None and step <= dtype_eps(value_dtype):
+            step = 0.0          # not a narrowing: no new rounding
+        out = replace(out, err=out.err + step)
+        if out.err > self.err_thr and is_float(dtype):
+            self._emit(
+                "TL008", "warning",
+                f"accumulated relative rounding-error bound "
+                f"{out.err:.3g} on '{buf_name}' exceeds "
+                f"{self.err_thr:g} ({dtype} accumulation chain); "
+                f"accumulate in float32 and cast once at the end",
+                stmt, buffer=buf_name, key=("TL008", buf_name))
+        return out
+
+    # -- expression evaluation -----------------------------------------
+    def _eval(self, e, state: NumState, ctx: _Ctx, stmt: Stmt
+              ) -> Tuple[AbsVal, Optional[Tuple[Buffer, tuple]]]:
+        """(abstract value, load-origin) of an expression. The origin
+        (buffer + index tuple) survives only a bare BufferLoad — it is
+        what the domination-fact subtraction check keys on."""
+        e = convert(e) if not isinstance(e, (slice, str)) else e
+        if isinstance(e, (IntImm, FloatImm)):
+            return AbsVal.const(e.value), None
+        if isinstance(e, BoolImm):
+            return AbsVal.const(1.0 if e.value else 0.0), None
+        if isinstance(e, StringImm):
+            return AbsVal.top(), None
+        if isinstance(e, Var):
+            r = ctx.ranges.get(id(e))
+            if r is not None:
+                return AbsVal(float(r[0]), float(r[1]), float(r[0]),
+                              float(r[1]), finite=True), None
+            if e._bound is not None:
+                return AbsVal.const(float(e._bound)), None
+            # unranged symbol (dynamic shape): finite int, unknown
+            return AbsVal(-self.assume, self.assume, -INF, INF,
+                          finite=True), None
+        if isinstance(e, BufferLoad):
+            for i in e.indices:
+                if not isinstance(i, slice):
+                    self._eval(i, state, ctx, stmt)
+            v = self._load(e.buffer, state, ctx)
+            return v, (e.buffer, tuple(e.indices))
+        if isinstance(e, Cast):
+            v, _o = self._eval(e.value, state, ctx, stmt)
+            src_dt = getattr(e.value, "dtype", None)
+            out = self._materialize(v.plain(), e.dtype, stmt,
+                                    f"<cast:{e.dtype}>",
+                                    value_dtype=src_dt)
+            # casts keep quantization-decode evidence (widen-then-mask)
+            return replace(out, qmask=v.qmask, qzp=v.qzp), None
+        if isinstance(e, BinOp):
+            return self._eval_binop(e, state, ctx, stmt)
+        if isinstance(e, Call):
+            return self._eval_call(e, state, ctx, stmt)
+        return AbsVal.top(), None
+
+    # .. binops ........................................................
+    def _eval_binop(self, e: BinOp, state, ctx, stmt):
+        if e.op in ("and", "or", "<", "<=", ">", ">=", "==", "!="):
+            self._eval(e.a, state, ctx, stmt)
+            self._eval(e.b, state, ctx, stmt)
+            return AbsVal(0.0, 1.0, 0.0, 1.0, finite=True), None
+        a, ao = self._eval(e.a, state, ctx, stmt)
+        b, bo = self._eval(e.b, state, ctx, stmt)
+        if e.op == "+":
+            return av_add(a, b), None
+        if e.op == "-":
+            r = av_sub(a, b)
+            r = self._apply_domination(r, a, ao, b, bo, state)
+            r = self._check_quant_decode(r, a, b, e, stmt)
+            return r, None
+        if e.op == "*":
+            if ao is not None and bo is not None and \
+                    ao[0].uid == bo[0].uid and \
+                    _indices_match(ao[1], bo[1]):
+                # x * x — the square is nonnegative (rsqrt(meansq + eps)
+                # style guards depend on this)
+                sq = av_mul(a, b)
+                return replace(sq, lo=max(0.0, sq.lo),
+                               slo=max(0.0, sq.slo)), None
+            return av_mul(a, b), None
+        if e.op in ("/", "//", "%"):
+            return self._eval_division(e.op, a, b, bo, stmt), None
+        if e.op == "min":
+            return av_min(a, b), None
+        if e.op == "max":
+            return av_max(a, b), None
+        return AbsVal.top(), None
+
+    def _eval_division(self, op: str, a: AbsVal, b: AbsVal, bo,
+                       stmt: Stmt) -> AbsVal:
+        name = bo[0].name if bo is not None else ""
+        contains0 = b.lo <= 0.0 <= b.hi
+        s_contains0 = b.slo <= 0.0 <= b.shi
+        if s_contains0 and b.sound_bounded():
+            self._emit(
+                "TL009", "error",
+                f"division by "
+                f"{'buffer ' + repr(name) if name else 'a value'} whose "
+                f"interval [{b.slo:.4g}, {b.shi:.4g}] contains zero "
+                f"(underflowed normalizer / unguarded divide); clamp "
+                f"the divisor (e.g. T.max(d, 1e-30)) or guard with "
+                f"T.if_then_else(d > 0, ...)",
+                stmt, buffer=name)
+        elif contains0:
+            self._emit(
+                "TL009", "warning",
+                f"cannot bound the divisor"
+                f"{' ' + repr(name) if name else ''} away from zero "
+                f"under the |input| <= {self.assume:g} assumption; a "
+                f"zero divisor yields Inf/NaN at run time",
+                stmt, buffer=name)
+        if contains0:
+            # a zero divisor is reachable under the assumption: the
+            # result is unbounded and the finiteness proof is gone
+            return AbsVal(err=a.err + b.err)
+        r = av_div(a, b, eps=1e-7)
+        if s_contains0:
+            # safe only under the input assumption: keep the nominal
+            # bounds but the sound track knows nothing
+            r = replace(r, slo=-INF, shi=INF)
+        if op in ("//", "%"):
+            r = replace(r, err=0.0)
+        if op == "%":
+            m = max(abs(b.lo), abs(b.hi))
+            r = mk(-m, m, -m, m, r.finite, 0.0)
+        return r
+
+    def _apply_domination(self, r: AbsVal, a: AbsVal, ao, b: AbsVal,
+                          bo, state: NumState) -> AbsVal:
+        """``x[I] - m[J]``: when m carries a valid domination fact over
+        x's current version and the indices correspond, the difference
+        is provably <= 0 on BOTH tracks — the online-softmax proof."""
+        if ao is None or bo is None:
+            return r
+        xbuf, xidx = ao
+        _mbuf, midx = bo
+        for f in b.facts:
+            if f.uid != xbuf.uid or not state.fact_valid(f):
+                continue
+            if f.dim is None:
+                ok = _indices_match(midx, xidx)
+            else:
+                if len(xidx) != len(midx) + 1 or f.dim >= len(xidx):
+                    continue
+                kept = tuple(x for d, x in enumerate(xidx)
+                             if d != f.dim)
+                ok = _indices_match(midx, kept)
+            if ok:
+                r = replace(r, hi=min(r.hi, 0.0), shi=min(r.shi, 0.0))
+                if f.tight and f.dim is not None:
+                    # x - rowmax(x) attains exactly 0 at the argmax:
+                    # exp() of this value attains 1 (the unit-row proof)
+                    r = replace(r, max_sub_dim=f.dim)
+                return r
+        return r
+
+    def _check_quant_decode(self, r: AbsVal, a: AbsVal, b: AbsVal,
+                            e: BinOp, stmt: Stmt) -> AbsVal:
+        """TL010: ``(x & M) - z`` — the decoded payload must stay inside
+        the b-bit envelope [-(M+1)/2, M]."""
+        if a.qmask is None or a.qzp is not None:
+            return r
+        if not (b.lo == b.hi and math.isfinite(b.lo)):
+            return r
+        m = a.qmask
+        z = b.lo
+        lo_env, hi_env = -float((m + 1) // 2), float(m)
+        # judge against the payload's CURRENT (possibly branch-refined)
+        # interval: a two's-complement arm `q - 16` under `q >= 8` is a
+        # legal decode, the same subtraction over the full [0, M] is not
+        dlo, dhi = a.lo - z, a.hi - z
+        if dlo < lo_env or dhi > hi_env:
+            self._emit(
+                "TL010", "error",
+                f"quantized payload decode (x & {hex(m)}) - {z:g} "
+                f"maps the {m.bit_length()}-bit payload to "
+                f"[{dlo:g}, {dhi:g}], outside the representable "
+                f"envelope [{lo_env:g}, {hi_env:g}]; the zero point / "
+                f"mask is inconsistent with the packed format",
+                stmt)
+            return r.plain()
+        return replace(r, qmask=m, qzp=z)
+
+    # .. calls .........................................................
+    def _eval_call(self, e: Call, state, ctx, stmt):
+        name = e.name
+        if name in ("max_value",):
+            dt = e.args[0] if isinstance(e.args[0], str) else "float32"
+            return AbsVal.const(dtype_max(dt)), None
+        if name in ("min_value",):
+            dt = e.args[0] if isinstance(e.args[0], str) else "float32"
+            lo = -dtype_max(dt) if is_float(dt) else \
+                float(int_range(dt)[0])
+            return AbsVal.const(lo), None
+        if name == "where":
+            return self._eval_where(e, state, ctx, stmt), None
+        args = [self._eval(a, state, ctx, stmt)
+                for a in e.args if not isinstance(a, str)]
+        avs = [a for a, _o in args]
+        a = avs[0] if avs else AbsVal.top()
+        if name in ("exp", "exp2", "exp10"):
+            base = {"exp": math.e, "exp2": 2.0, "exp10": 10.0}[name]
+            return self._eval_exp(a, base, e.dtype, stmt), None
+        if name in ("log", "log2", "log10", "log1p"):
+            return self._eval_log(a, name, stmt), None
+        if name == "sqrt":
+            return self._eval_sqrt(a, stmt), None
+        if name == "rsqrt":
+            return self._eval_rsqrt(a, stmt), None
+        if name == "abs":
+            return av_abs(a), None
+        if name in ("tanh", "sin", "cos", "erf"):
+            return av_bounded_unary(a, -1.0, 1.0), None
+        if name == "sigmoid":
+            return av_bounded_unary(a, 0.0, 1.0), None
+        if name in ("floor", "ceil", "round", "trunc"):
+            return mk(a.lo - 1.0, a.hi + 1.0, a.slo - 1.0, a.shi + 1.0,
+                      a.finite, a.err), None
+        if name == "bitwise_and":
+            return self._eval_band(avs, e, stmt), None
+        if name in ("bitwise_or", "bitwise_xor"):
+            if len(avs) == 2 and avs[0].lo >= 0 and avs[1].lo >= 0 \
+                    and math.isfinite(avs[0].hi) \
+                    and math.isfinite(avs[1].hi):
+                hi = float((1 << int(max(avs[0].hi,
+                                         avs[1].hi)).bit_length()) - 1)
+                return mk(0.0, hi, 0.0, hi, True), None
+            return self._dtype_top(e.dtype), None
+        if name == "shift_right":
+            return self._eval_shift(avs, e, right=True), None
+        if name == "shift_left":
+            return self._eval_shift(avs, e, right=False), None
+        if name == "pow":
+            fin = all(v.finite for v in avs) and a.slo >= 0.0
+            return replace(AbsVal.top(), finite=fin), None
+        if name in ("logical_not",):
+            return AbsVal(0.0, 1.0, 0.0, 1.0, finite=True), None
+        if name == "bitcast":
+            dt = e.args[-1] if isinstance(e.args[-1], str) else e.dtype
+            v = self._dtype_top(dt)
+            if is_float(dt):
+                v = replace(v, finite=False)    # bit pattern may be NaN
+            return v, None
+        return self._dtype_top(e.dtype), None
+
+    def _dtype_top(self, dtype: str) -> AbsVal:
+        if is_int(dtype):
+            lo, hi = int_range(dtype)
+            return AbsVal(float(lo), float(hi), float(lo), float(hi),
+                          finite=True)
+        if dtype == "bool":
+            return AbsVal(0.0, 1.0, 0.0, 1.0, finite=True)
+        return AbsVal()
+
+    def _eval_exp(self, a: AbsVal, base: float, dtype: str,
+                  stmt: Stmt) -> AbsVal:
+        out_dt = dtype if is_float(dtype) else "float32"
+        thr = exp_overflow_threshold(base, out_dt)
+        if a.shi > thr and a.shi < INF:
+            self._emit(
+                "TL009", "error",
+                f"exp operand upper bound {a.shi:.4g} exceeds the "
+                f"{out_dt} overflow threshold ({thr:.4g}); the result "
+                f"saturates to Inf — subtract the running max first "
+                f"(exp(x - max(x)) is always <= 1)",
+                stmt)
+        elif a.hi > thr:
+            self._emit(
+                "TL009", "warning",
+                f"cannot bound the exp operand below the {out_dt} "
+                f"overflow threshold ({thr:.4g}) under the |input| <= "
+                f"{self.assume:g} assumption; subtract the running max "
+                f"(exp(x - max(x))) to make the exponential provably "
+                f"finite",
+                stmt)
+        r = _exp_base(a, base, out_dt)
+        if a.max_sub_dim is not None and a.hi <= 0.0:
+            # tight max-subtraction: each row attains exp(0) = 1
+            r = replace(r, unit_dim=a.max_sub_dim)
+        return r
+
+    def _eval_log(self, a: AbsVal, name: str, stmt: Stmt) -> AbsVal:
+        pole = -1.0 if name == "log1p" else 0.0
+        if a.slo <= pole and a.slo > -INF:
+            self._emit(
+                "TL009", "error",
+                f"{name} operand lower bound {a.slo:.4g} reaches the "
+                f"domain boundary ({pole:g}); clamp the operand (e.g. "
+                f"T.max(x, 1e-30)) before taking the logarithm",
+                stmt)
+        elif a.lo <= pole:
+            self._emit(
+                "TL009", "warning",
+                f"cannot bound the {name} operand above {pole:g} under "
+                f"the |input| <= {self.assume:g} assumption; a "
+                f"non-positive operand yields -Inf/NaN",
+                stmt)
+        fin = a.finite and a.lo > pole
+
+        def lg(x):
+            if x <= pole:
+                return -INF
+            fn = {"log": math.log, "log2": math.log2,
+                  "log10": math.log10, "log1p": math.log1p}[name]
+            try:
+                return fn(x)
+            except (ValueError, OverflowError):
+                return INF
+        return mk(lg(max(a.lo, pole)), lg(a.hi),
+                  lg(max(a.slo, pole)), lg(a.shi), fin, a.err + 1e-7)
+
+    def _eval_sqrt(self, a: AbsVal, stmt: Stmt) -> AbsVal:
+        if a.slo < 0.0 and a.slo > -INF:
+            self._emit(
+                "TL009", "error",
+                f"sqrt operand lower bound {a.slo:.4g} is negative; "
+                f"the result is NaN — clamp with T.max(x, 0.0) first",
+                stmt)
+        elif a.lo < 0.0:
+            self._emit(
+                "TL009", "warning",
+                f"cannot bound the sqrt operand to be non-negative "
+                f"under the |input| <= {self.assume:g} assumption",
+                stmt)
+
+        def sq(x):
+            return math.sqrt(x) if 0.0 <= x < INF else \
+                (INF if x == INF else 0.0)
+        fin = a.finite and a.lo >= 0.0
+        return mk(sq(max(a.lo, 0.0)), sq(a.hi),
+                  sq(max(a.slo, 0.0)), sq(a.shi), fin, a.err + 1e-7)
+
+    def _eval_rsqrt(self, a: AbsVal, stmt: Stmt) -> AbsVal:
+        if a.slo <= 0.0 and a.slo > -INF:
+            self._emit(
+                "TL009", "error",
+                f"rsqrt operand lower bound {a.slo:.4g} reaches the "
+                f"pole at zero; clamp with T.max(x, eps) first", stmt)
+        elif a.lo <= 0.0:
+            self._emit(
+                "TL009", "warning",
+                f"cannot bound the rsqrt operand away from zero under "
+                f"the |input| <= {self.assume:g} assumption", stmt)
+
+        def rs(x):
+            return 1.0 / math.sqrt(x) if 0.0 < x < INF else \
+                (0.0 if x == INF else INF)
+        fin = a.finite and a.lo > 0.0
+        return mk(rs(a.hi), rs(max(a.lo, 0.0)),
+                  rs(a.shi), rs(max(a.slo, 0.0)), fin, a.err + 1e-7)
+
+    def _eval_band(self, avs, e: Call, stmt: Stmt) -> AbsVal:
+        if len(avs) != 2:
+            return self._dtype_top(e.dtype)
+        for v, o in ((avs[0], avs[1]), (avs[1], avs[0])):
+            if o.lo == o.hi and o.lo >= 0 and math.isfinite(o.lo):
+                m = int(o.lo)
+                out = mk(0.0, float(m), 0.0, float(m), True)
+                if v.lo >= 0.0 and v.hi < m:
+                    # already narrower than the mask (branch-refined
+                    # payloads): keep the tighter range
+                    out = mk(max(0.0, v.lo), v.hi,
+                             max(0.0, v.slo), min(float(m), v.shi), True)
+                if m >= 3 and (m & (m + 1)) == 0 and m <= 255:
+                    # power-of-two-minus-one mask <= 8 bits: a packed
+                    # quantized-payload extraction (TL010 evidence)
+                    out = replace(out, qmask=m)
+                return out
+        if avs[0].lo >= 0 or avs[1].lo >= 0:
+            hi = min(x.hi for x in avs if x.lo >= 0)
+            return mk(0.0, hi, 0.0, hi, True)
+        return self._dtype_top(e.dtype)
+
+    def _eval_shift(self, avs, e: Call, right: bool) -> AbsVal:
+        if len(avs) == 2 and avs[1].lo == avs[1].hi and \
+                math.isfinite(avs[1].lo) and avs[0].lo >= 0 and \
+                math.isfinite(avs[0].hi):
+            s = int(avs[1].lo)
+            if 0 <= s < 63:
+                if right:
+                    lo, hi = int(avs[0].lo) >> s, int(avs[0].hi) >> s
+                else:
+                    lo, hi = int(avs[0].lo) << s, int(avs[0].hi) << s
+                out = mk(float(lo), float(hi), float(lo), float(hi),
+                         True)
+                return replace(out, qmask=avs[0].qmask,
+                               qzp=avs[0].qzp) if right else out
+        return self._dtype_top(e.dtype)
+
+    def _eval_where(self, e: Call, state, ctx, stmt) -> AbsVal:
+        cond = e.args[0]
+        self._eval(cond, state, ctx, stmt)
+        ctx_t, ctx_f = ctx.child(), ctx.child()
+        self._refine_from_cond(cond, ctx_t, ctx_f, state)
+        a, _ = self._eval(e.args[1], state, ctx_t, stmt)
+        b, _ = self._eval(e.args[2], state, ctx_f, stmt)
+        return a.join(b)
+
+    def _refine_from_cond(self, cond, ctx_t: _Ctx, ctx_f: _Ctx,
+                          state: NumState) -> None:
+        """Clip buffer loads under simple value guards: ``l[i] > 0``
+        bounds l away from zero in the true branch (and symmetrically
+        in the false branch). Conjunctions recurse; anything else is
+        ignored (no refinement, never wrong)."""
+        cond = convert(cond) if not isinstance(cond, (slice, str)) \
+            else cond
+        if not isinstance(cond, BinOp):
+            return
+        if cond.op == "and":
+            self._refine_from_cond(cond.a, ctx_t, _Ctx(), state)
+            self._refine_from_cond(cond.b, ctx_t, _Ctx(), state)
+            return
+        if cond.op == "or":
+            self._refine_from_cond(cond.a, _Ctx(), ctx_f, state)
+            self._refine_from_cond(cond.b, _Ctx(), ctx_f, state)
+            return
+        if cond.op not in ("<", "<=", ">", ">="):
+            return
+        a, b, op = cond.a, cond.b, cond.op
+        if isinstance(convert(b), BufferLoad) and \
+                not isinstance(convert(a), BufferLoad):
+            a, b = b, a
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+        a = convert(a)
+        if not isinstance(a, BufferLoad):
+            return
+        c = None
+        bb = convert(b) if not isinstance(b, (slice, str)) else None
+        if isinstance(bb, (IntImm, FloatImm)):
+            c = float(bb.value)
+        if c is None:
+            return
+        uid, ver = a.buffer.uid, state.version(a.buffer.uid)
+        tiny = abs(c) * 1e-9 + 5e-324
+
+        def put(cx, lo, hi):
+            old = cx.refine.get(uid)
+            if old is not None and old[2] == ver:
+                lo, hi = max(lo, old[0]), min(hi, old[1])
+            cx.refine[uid] = (lo, hi, ver)
+        if op == ">":
+            put(ctx_t, c + tiny, INF)
+            put(ctx_f, -INF, c)
+        elif op == ">=":
+            put(ctx_t, c, INF)
+            put(ctx_f, -INF, c - tiny)
+        elif op == "<":
+            put(ctx_t, -INF, c - tiny)
+            put(ctx_f, c, INF)
+        elif op == "<=":
+            put(ctx_t, -INF, c)
+            put(ctx_f, c + tiny, INF)
+
+    # -- statement transfer --------------------------------------------
+    def _transfer(self, stmts, state: NumState, ctx: _Ctx) -> None:
+        from .dataflow import _as_list
+        for s in _as_list(stmts):
+            self._transfer_one(s, state, ctx)
+
+    def _transfer_one(self, s: Stmt, state: NumState, ctx: _Ctx) -> None:
+        if isinstance(s, AllocStmt):
+            return
+        if isinstance(s, SeqStmt):
+            self._transfer(s.stmts, state, ctx)
+            return
+        if isinstance(s, KernelNode):
+            trips = 1
+            sub = ctx.child()
+            for v, ext in zip(s.grid_vars, s.extents):
+                ei = as_int(ext)
+                if ei is None:
+                    trips = None
+                else:
+                    sub.ranges[id(v)] = (0, max(0, ei - 1))
+                    if trips is not None:
+                        trips *= max(1, ei)
+            body = list(s.prelude) + [s.body]
+            # TPU grids run sequentially per core and scratch persists
+            # across steps (the grid-carried-init idiom), so the grid is
+            # a loop for state purposes
+            self._run_loop(body, state, sub, trips)
+            return
+        if isinstance(s, ForNest):
+            sub = ctx.child()
+            trips = 1
+            for v, ext in zip(s.loop_vars, s.extents):
+                ei = as_int(ext)
+                if ei is None:
+                    trips = None
+                else:
+                    sub.ranges[id(v)] = (0, max(0, ei - 1))
+                    if trips is not None:
+                        trips *= max(1, ei)
+            if s.kind == "parallel":
+                # one pass: lanes are independent (races are TL001's
+                # finding, self-accumulating lanes widen via the store
+                # transfer below)
+                prev = self._parallel_trips
+                self._parallel_trips = trips
+                try:
+                    self._transfer(s.body, state, sub)
+                finally:
+                    self._parallel_trips = prev
+                return
+            self._run_loop([s.body], state, sub, trips)
+            return
+        if isinstance(s, IfThenElse):
+            self._eval(s.cond, state, ctx, s)
+            ctx_t, ctx_f = ctx.child(), ctx.child()
+            self._refine_from_cond(s.cond, ctx_t, ctx_f, state)
+            st_t = state.clone()
+            self._transfer(s.then_body, st_t, ctx_t)
+            st_e = state.clone()
+            if s.else_body is not None:
+                self._transfer(s.else_body, st_e, ctx_f)
+            joined = st_t.join(st_e)
+            state.vals, state.ver = joined.vals, joined.ver
+            return
+        if isinstance(s, FillStmt):
+            self._xfer_fill(s, state, ctx)
+            return
+        if isinstance(s, CopyStmt):
+            self._xfer_copy(s.src, s.dst, s, state, ctx)
+            return
+        if isinstance(s, AsyncCopyStmt):
+            if s.phase == "start":
+                self._xfer_copy(s.src, s.dst, s, state, ctx)
+            return
+        if isinstance(s, GemmStmt):
+            self._xfer_gemm(s, state, ctx)
+            return
+        if isinstance(s, ReduceStmt):
+            self._xfer_reduce(s, state, ctx)
+            return
+        if isinstance(s, CumSumStmt):
+            self._xfer_cumsum(s, state, ctx)
+            return
+        if isinstance(s, BufferStoreStmt):
+            self._xfer_store(s, state, ctx)
+            return
+        if isinstance(s, AtomicStmt):
+            self._xfer_atomic(s, state, ctx)
+            return
+        if isinstance(s, CommStmt):
+            self._xfer_comm(s, state, ctx)
+            return
+        if isinstance(s, (EvaluateStmt,)):
+            self._eval(s.expr, state, ctx, s)
+            return
+        if isinstance(s, AssertStmt):
+            self._eval(s.cond, state, ctx, s)
+            return
+        if isinstance(s, PrintStmt):
+            return
+        # unknown statement type: every buffer it writes goes to top
+        from .dataflow import stmt_accesses
+        for acc in stmt_accesses(s):
+            if acc.kind == "write":
+                state.write(acc.buffer.uid, AbsVal(), strong=False)
+
+    _parallel_trips: Optional[int] = None
+
+    # .. loop widening .................................................
+    def _run_loop(self, body, state: NumState, ctx: _Ctx,
+                  trips: Optional[int]) -> None:
+        pre = state.clone()
+        report, self._report = self._report, False
+        try:
+            if trips is not None and trips <= _EXACT_TRIPS:
+                self._report = report
+                for _ in range(max(1, trips)):
+                    self._transfer(body, state, ctx)
+                return
+            s1 = pre.clone()
+            self._transfer(body, s1, ctx)
+            s2 = s1.clone()
+            self._transfer(body, s2, ctx)
+            inv = self._loop_invariant(pre, s1, s2, body, ctx, trips)
+        finally:
+            self._report = report
+        final = inv.clone()
+        self._transfer(body, final, ctx)       # the reporting pass
+        if trips is None or trips == 0:
+            final = final.join(pre)
+        state.vals, state.ver = final.vals, final.ver
+
+    def _loop_invariant(self, pre, s1, s2, body, ctx,
+                        trips: Optional[int]) -> NumState:
+        """Entry-state invariant of the loop: extrapolate the observed
+        per-iteration growth by the trip count and verify it does not
+        accelerate at the widened state (absint module docstring)."""
+        def growth(a: NumState, b: NumState):
+            g = {}
+            for uid, vb in b.vals.items():
+                va = a.vals.get(uid)
+                if va is None:
+                    g[uid] = (INF, INF, INF, INF, INF)
+                    continue
+                d = (max(0.0, va.lo - vb.lo), max(0.0, vb.hi - va.hi),
+                     max(0.0, va.slo - vb.slo),
+                     max(0.0, vb.shi - va.shi),
+                     max(0.0, vb.err - va.err))
+                if any(x > 0 for x in d):
+                    g[uid] = d
+            return g
+
+        def stable(a: NumState, b: NumState) -> bool:
+            return all(uid in a.vals and a.vals[uid].subsumes(v)
+                       for uid, v in b.vals.items())
+
+        if stable(s1, s2):
+            return pre.join(s1)
+
+        def extrapolate(base: NumState, g, n) -> NumState:
+            out = base.clone()
+            for uid, (dlo, dhi, dslo, dshi, derr) in g.items():
+                v = out.vals.get(uid) or AbsVal()
+                factor = float(n) if n is not None else INF
+                v = replace(
+                    v,
+                    lo=v.lo - (dlo * factor if dlo else 0.0),
+                    hi=v.hi + (dhi * factor if dhi else 0.0),
+                    slo=v.slo - (dslo * factor if dslo else 0.0),
+                    shi=v.shi + (dshi * factor if dshi else 0.0),
+                    err=v.err + (derr * factor if derr else 0.0))
+                if v.lo != v.lo:
+                    v = replace(v, lo=-INF)
+                if v.hi != v.hi:
+                    v = replace(v, hi=INF)
+                out.vals[uid] = v
+            return out
+
+        d = growth(s1, s2)
+        n = trips
+        for _attempt in range(2):
+            w = extrapolate(pre.join(s2), d, n)
+            w2 = w.clone()
+            self._transfer(body, w2, ctx)
+            d2 = growth(w, w2)
+            if all(uid in d and all(
+                    x2 <= x1 * (1.0 + 1e-9) + 1e-300
+                    for x2, x1 in zip(dd, d[uid]))
+                    for uid, dd in d2.items()):
+                return w
+            for uid, dd in d2.items():
+                old = d.get(uid, (0.0,) * 5)
+                d[uid] = tuple(max(a, b) for a, b in zip(old, dd))
+        # growth keeps accelerating: widen every changing buffer to top
+        out = pre.join(s2)
+        for uid in d:
+            out.vals[uid] = AbsVal(err=INF)
+        return out
+
+    # .. per-op transfers ..............................................
+    def _region_full(self, r: Region) -> bool:
+        bs = r.buffer.static_shape()
+        rs = r.static_shape()
+        if bs is None or rs is None or len(bs) != len(rs):
+            return False
+        for b, (sz, dim) in zip(r.base, zip(rs, bs)):
+            if sz != dim:
+                return False
+            if not isinstance(b, slice) and as_int(b) != 0:
+                return False
+        return True
+
+    def _write_region(self, r: Region, val: AbsVal, state: NumState,
+                      stmt: Stmt,
+                      value_dtype: Optional[str] = None) -> None:
+        buf = r.buffer
+        val = self._materialize(val, buf.dtype, stmt, buf.name,
+                                value_dtype=value_dtype)
+        strong = self._region_full(r) and buf.scope != "global"
+        state.write(buf.uid, val, strong=strong)
+
+    def _read_region(self, r: Region, state: NumState, ctx: _Ctx,
+                     stmt: Stmt) -> AbsVal:
+        for b in r.base:
+            if not isinstance(b, slice):
+                self._eval(b, state, ctx, stmt)
+        return self._load(r.buffer, state, ctx)
+
+    def _xfer_fill(self, s: FillStmt, state, ctx) -> None:
+        v, _ = self._eval(s.value, state, ctx, s)
+        self._write_region(s.dst, v.plain(), state, s)
+
+    def _xfer_copy(self, src, dst, s, state, ctx) -> None:
+        if isinstance(src, Region):
+            v = self._read_region(src, state, ctx, s)
+            src_dt = src.buffer.dtype
+        else:
+            v = self._load(src, state, ctx)
+            src_dt = src.dtype
+        v = v.plain()
+        if isinstance(dst, Region):
+            self._write_region(dst, v, state, s, value_dtype=src_dt)
+        else:
+            v = self._materialize(v, dst.dtype, s, dst.name,
+                                  value_dtype=src_dt)
+            state.write(dst.uid, v, strong=True)
+
+    def _gemm_k(self, s: GemmStmt) -> Optional[int]:
+        for r, trans, dim in ((s.A, s.trans_A, -1), (s.B, s.trans_B, 0)):
+            ss = r.static_shape()
+            if ss is None or len(ss) < 2:
+                continue
+            sizes = [x for x in ss if x != 1] or list(ss)
+            if len(sizes) < 2:
+                continue
+            k = sizes[0] if (trans if dim == -1 else not trans) \
+                else sizes[-1]
+            if k is not None:
+                return int(k)
+        return None
+
+    def _xfer_gemm(self, s: GemmStmt, state, ctx) -> None:
+        a = self._read_region(s.A, state, ctx, s)
+        b = self._read_region(s.B, state, ctx, s)
+        cbuf = s.C.buffer
+        k = self._gemm_k(s)
+        prod = av_mul(a, b)
+        if k is None:
+            contrib = AbsVal(finite=False)
+        else:
+            # the sum of k products, each in [prod.lo, prod.hi],
+            # accumulated in f32 on the MXU
+            contrib = mk(prod.lo * k, prod.hi * k,
+                         prod.slo * k if prod.slo > -INF else -INF,
+                         prod.shi * k if prod.shi < INF else INF,
+                         prod.finite,
+                         a.err + b.err + k * dtype_eps("float32"))
+        if s.clear_accum:
+            out = contrib
+        else:
+            c = self._load(cbuf, state, ctx)
+            out = av_add(c, contrib)
+        # the MXU accumulates in f32, then rounds into C's dtype: a
+        # sub-f32 accumulator is charged one rounding per gemm — the
+        # TL008 low-precision-accumulator signal
+        self._write_region(s.C, out.plain(), state, s,
+                           value_dtype="float32")
+
+    def _xfer_reduce(self, s, state, ctx) -> None:
+        src, dst = s.src, s.dst
+        v = self._load(src, state, ctx)
+        ss = src.static_shape() if hasattr(src, "static_shape") else None
+        n = None
+        if ss is not None and 0 <= s.dim < len(ss):
+            n = int(ss[s.dim])
+        kind = s.kind
+        facts = frozenset()
+        if kind in ("max", "min"):
+            out = replace(v.plain(), err=v.err)
+            if kind == "max":
+                facts = frozenset({DomFact(src.uid,
+                                           state.version(src.uid),
+                                           s.dim, tight=bool(s.clear))})
+        elif kind == "absmax":
+            av = av_abs(v)
+            out = av.plain()
+        elif kind in ("sum", "abssum"):
+            base = av_abs(v) if kind == "abssum" else v
+            if n is None:
+                out = AbsVal(finite=False)
+            else:
+                nn = AbsVal.const(float(n))
+                out = av_mul(base, replace(nn, lo=0.0, slo=0.0))
+                out = replace(out,
+                              lo=min(out.lo, base.lo * n),
+                              slo=min(out.slo, base.slo * n)
+                              if base.slo > -INF else -INF,
+                              err=v.err + n * dtype_eps(dst.dtype))
+                lo_floor = 0.0 if kind == "abssum" else None
+                if kind == "sum" and v.unit_dim == s.dim and \
+                        v.lo >= 0.0 and v.slo >= 0.0:
+                    # nonneg elements with a unit at each argmax: the
+                    # softmax normalizer is provably >= 1 (pole-free)
+                    lo_floor = 1.0
+                if lo_floor is not None:
+                    out = replace(out, lo=max(out.lo, lo_floor),
+                                  slo=max(out.slo, lo_floor))
+            out = out.plain()
+        elif kind in ("any", "all", "bitand", "bitor", "bitxor"):
+            out = self._dtype_top(dst.dtype)
+        else:
+            out = AbsVal()
+        if not s.clear:
+            old = self._load(dst, state, ctx)
+            out = av_max(old, out).plain() if kind == "max" else \
+                av_min(old, out) if kind == "min" else av_add(old, out)
+            facts = frozenset()
+        out = replace(out, facts=facts)
+        # the n*eps(dst) reduction rounding is charged explicitly above
+        out = self._materialize(out, dst.dtype, s, dst.name,
+                                value_dtype=dst.dtype)
+        state.write(dst.uid, out, strong=True)
+
+    def _xfer_cumsum(self, s, state, ctx) -> None:
+        v = self._load(s.src, state, ctx)
+        ss = s.src.static_shape()
+        n = int(ss[s.dim]) if ss is not None and s.dim < len(ss) else None
+        if n is None:
+            out = AbsVal(finite=False)
+        else:
+            out = mk(min(v.lo, v.lo * n), max(v.hi, v.hi * n),
+                     min(v.slo, v.slo * n) if v.slo > -INF else -INF,
+                     max(v.shi, v.shi * n) if v.shi < INF else INF,
+                     v.finite, v.err + (n or 1) *
+                     dtype_eps(s.dst.dtype))
+        out = self._materialize(out, s.dst.dtype, s, s.dst.name,
+                                value_dtype=s.dst.dtype)
+        state.write(s.dst.uid, out, strong=True)
+
+    def _max_covered(self, e):
+        """BufferLoads the expression provably dominates: the value IS
+        the load, or a max() chain containing it — the store-side
+        evidence behind ``m_new[i] = T.max(m_prev[i], m_cur[i], ...)``
+        inheriting/creating elementwise domination facts."""
+        e = convert(e) if not isinstance(e, (slice, str)) else e
+        if isinstance(e, BufferLoad) and not e.has_slices:
+            return [e]
+        if isinstance(e, BinOp) and e.op == "max":
+            return self._max_covered(e.a) + self._max_covered(e.b)
+        return []
+
+    def _store_facts(self, s: BufferStoreStmt, state: NumState):
+        """Domination facts the stored value carries, validated against
+        the STORE indices (a fact about x[i] only transfers to a store
+        at the same [i])."""
+        val_expr = convert(s.value)
+        covered = self._max_covered(val_expr)
+        if not covered:
+            return frozenset()
+        bare = isinstance(val_expr, BufferLoad)
+        store_key = tuple(_idx_key(i) for i in s.indices)
+        if any(k is None for k in store_key):
+            return frozenset()
+        facts = set()
+        for ld in covered:
+            if ld.buffer.uid == s.buffer.uid:
+                continue
+            if tuple(_idx_key(i) for i in ld.indices) != store_key:
+                continue
+            src = state.get(ld.buffer.uid)
+            if src is not None:
+                for f in src.facts:
+                    if state.fact_valid(f):
+                        facts.add(f if bare else
+                                  replace(f, tight=False))
+            facts.add(DomFact(ld.buffer.uid,
+                              state.version(ld.buffer.uid), None,
+                              tight=bare))
+        return frozenset(facts)
+
+    def _xfer_store(self, s: BufferStoreStmt, state, ctx) -> None:
+        v, _ = self._eval(s.value, state, ctx, s)
+        v = replace(v, facts=self._store_facts(s, state))
+        for i in s.indices:
+            if not isinstance(i, slice):
+                self._eval(i, state, ctx, s)
+        buf = s.buffer
+        # a lane-parallel self-accumulating store (v[0] += x under
+        # T.Parallel with the store index missing the lanes) folds the
+        # whole lane count into one abstract write
+        trips = self._parallel_trips
+        reads_self = False
+        from ..ir.expr import for_each_load
+        hits = []
+        for_each_load(s.value, lambda ld: hits.append(ld))
+        for ld in hits:
+            if ld.buffer.uid == buf.uid and \
+                    not _indices_match(tuple(ld.indices),
+                                       tuple(s.indices)):
+                reads_self = True
+        if reads_self:
+            if trips is None:
+                v = AbsVal(err=INF)
+            elif trips > 1:
+                old = self._load(buf, state, ctx)
+                d_hi = max(0.0, v.hi - old.hi)
+                d_lo = max(0.0, old.lo - v.lo)
+                v = replace(v, lo=v.lo - d_lo * trips,
+                            hi=v.hi + d_hi * trips,
+                            slo=v.slo - d_lo * trips
+                            if v.slo > -INF else -INF,
+                            shi=v.shi + d_hi * trips
+                            if v.shi < INF else INF,
+                            err=v.err * max(1, trips))
+        v = self._materialize(v, buf.dtype, s, buf.name,
+                              value_dtype=getattr(
+                                  convert(s.value), "dtype", None))
+        strong = self._store_full_cover(s, ctx) and not reads_self
+        state.write(buf.uid, v, strong=strong)
+
+    def _store_full_cover(self, s: BufferStoreStmt, ctx: _Ctx) -> bool:
+        """Is this elementwise store a strong update? True when every
+        index is a distinct in-scope loop var spanning exactly that
+        buffer dimension."""
+        buf = s.buffer
+        if buf.scope == "global":
+            return False
+        bs = buf.static_shape()
+        if bs is None or len(s.indices) != len(bs):
+            return False
+        seen = set()
+        for idx, dim in zip(s.indices, bs):
+            if isinstance(idx, slice):
+                return False
+            e = convert(idx)
+            if not isinstance(e, Var) or id(e) in seen:
+                return False
+            seen.add(id(e))
+            r = ctx.ranges.get(id(e))
+            if r is None or r != (0, dim - 1):
+                return False
+        return True
+
+    def _xfer_atomic(self, s: AtomicStmt, state, ctx) -> None:
+        if isinstance(s.value, Region):
+            v = self._read_region(s.value, state, ctx, s)
+        else:
+            v, _ = self._eval(s.value, state, ctx, s)
+        old = self._load(s.dst.buffer, state, ctx)
+        if s.op == "add":
+            out = av_add(old, v)
+        elif s.op in ("max",):
+            out = av_max(old, v).plain()
+        elif s.op in ("min",):
+            out = av_min(old, v)
+        else:
+            out = AbsVal()
+        self._write_region(s.dst, out.plain(), state, s)
+
+    # .. collectives ...................................................
+    def _mesh_devices(self, direction: int) -> int:
+        cfg = self.func.attrs.get("mesh_config")
+        try:
+            rows, cols = int(cfg[0]), int(cfg[1])
+        except (TypeError, ValueError, IndexError):
+            rows = cols = 4      # conservative default bound
+        return {0: cols, 1: rows}.get(direction, rows * cols)
+
+    def _record_payloads(self, s: CommStmt, state: NumState) -> None:
+        if not self._report:
+            return
+        from ..parallel.lowering import _sanitize_payloads
+        try:
+            payloads = _sanitize_payloads(s)
+        except Exception:       # noqa: BLE001 — proof only, never fatal
+            payloads = []
+        for reg in payloads:
+            v = state.get(reg.buffer.uid)
+            proven = bool(v is not None and v.finite)
+            self.result.payloads.append(
+                (id(s), reg.buffer.uid, reg.buffer.name, proven))
+
+    def _xfer_comm(self, s: CommStmt, state, ctx) -> None:
+        self._record_payloads(s, state)
+        if isinstance(s, (CommBroadcast, CommPut)):
+            v = self._read_region(s.src, state, ctx, s)
+            self._write_region(s.dst, v.plain(), state, s)
+        elif isinstance(s, CommAllGather):
+            v = self._read_region(s.send, state, ctx, s)
+            self._write_region(s.recv, v.plain(), state, s)
+        elif isinstance(s, CommAllReduce):
+            v = self._read_region(s.buffer, state, ctx, s)
+            n = self._mesh_devices(s.direction)
+            if s.reduce_type in ("max", "min"):
+                out = v.plain()
+            else:
+                nn = AbsVal.const(float(n))
+                out = av_mul(v, replace(nn, lo=0.0, slo=0.0))
+                out = replace(out,
+                              lo=min(out.lo, v.lo * n),
+                              slo=min(out.slo, v.slo * n)
+                              if v.slo > -INF else -INF,
+                              err=v.err + n * dtype_eps(s.out.dtype))
+            if not s.clear:
+                out = av_add(self._load(s.out.buffer, state, ctx), out)
+            self._write_region(s.out, out.plain(), state, s)
+        else:
+            from .dataflow import stmt_accesses
+            for acc in stmt_accesses(s):
+                if acc.kind == "write":
+                    state.write(acc.buffer.uid, AbsVal(), strong=False)
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+
+#: one interpretation per (func, knobs): the lint rules and the
+#: attrs["numerics"] proof both consume the same NumericsResult, and a
+#: compile whose tile-opt pass left the func untouched reuses the lint
+#: run's result outright. Weak keys: a dropped PrimFunc drops its entry.
+_MEMO: "weakref.WeakKeyDictionary" = None      # type: ignore[assignment]
+
+
+def analyze(func: PrimFunc,
+            pass_cfg: Optional[dict] = None) -> NumericsResult:
+    """One full interpretation: TL007-010 findings + finiteness proofs.
+    Memoized per (func identity, tl-num knobs) — callers must treat the
+    result as read-only."""
+    global _MEMO
+    if _MEMO is None:
+        import weakref
+        _MEMO = weakref.WeakKeyDictionary()
+    key = (num_assume_abs(pass_cfg), num_err_threshold(pass_cfg))
+    try:
+        per_func = _MEMO.setdefault(func, {})
+    except TypeError:           # unhashable/unweakrefable func: no memo
+        return Interp(func, pass_cfg).run()
+    if key not in per_func:
+        per_func[key] = Interp(func, pass_cfg).run()
+    return per_func[key]
+
+
+def numerics_attrs(func: PrimFunc,
+                   pass_cfg: Optional[dict] = None) -> dict:
+    """The ``attrs["numerics"]`` record for one kernel (engine/lower.py
+    and parallel/lowering.py attach this to every artifact)."""
+    return analyze(func, pass_cfg).attrs_record()

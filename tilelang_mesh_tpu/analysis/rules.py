@@ -26,6 +26,23 @@ TL005     warning   the liveness-packed VMEM footprint (scratch arena +
                     double-buffered BlockSpec windows) exceeds the
                     budget Mosaic will enforce later, reported per buffer
 TL006     info      dead stores / unused allocations
+TL007     error     a stored/cast value's interval provably escapes the
+                    destination dtype's finite range (bf16 store of an
+                    over-range f32 accumulator, int accumulator wrap) —
+                    tl-num (analysis/numerics.py)
+TL008     warning   an accumulation chain's relative rounding-error
+                    bound (trip count x the accumulator dtype's unit
+                    roundoff) crosses the tl.tpu.num_err_threshold —
+                    the bf16-accumulator-at-large-K bug
+TL009     error/    an exp/log/sqrt/rsqrt/divide operand interval
+          warning   reaches the op's pole or overflow region; error
+                    when proven without input assumptions (the
+                    online-softmax exp(x - max(x)) idiom is proven
+                    SAFE), warning when only the nominal |input| bound
+                    shows the hazard
+TL010     error     a quantized-payload decode ``(x & M) - z`` escapes
+                    the b-bit representable envelope (wrong zero point
+                    or mask for the packed int4/int8 format)
 ==========================================================================
 
 Every rule is *proof-gated*: it reports only what the affine model can
@@ -692,3 +709,35 @@ def _tl006_dead_store(ctx: LintContext) -> List[Diagnostic]:
                 op=type(du.writes[0][0].stmt).__name__,
                 loc=stmt_loc(du.writes[0][0].stmt)))
     return out
+
+
+# ---------------------------------------------------------------------------
+# TL007-TL010 — tl-num abstract-interpretation rules (analysis/numerics.py)
+# ---------------------------------------------------------------------------
+
+
+def _numerics_findings(ctx: LintContext) -> List[Diagnostic]:
+    """One abstract interpretation per LintContext, shared by the four
+    tl-num rules (each filters its own rule id out of the run)."""
+    cached = getattr(ctx, "_numerics_cache", None)
+    if cached is None:
+        from .numerics import analyze
+        try:
+            cached = analyze(ctx.func, ctx.pass_cfg).findings
+        except Exception:       # noqa: BLE001 — an interpreter bug must
+            cached = []         # never fail an otherwise-valid compile
+        ctx._numerics_cache = cached
+    return cached
+
+
+def _num_rule(rule_id: str, name: str):
+    @_rule(rule_id, name)
+    def fn(ctx: LintContext, _rid=rule_id) -> List[Diagnostic]:
+        return [d for d in _numerics_findings(ctx) if d.rule == _rid]
+    return fn
+
+
+_num_rule("TL007", "overflow")
+_num_rule("TL008", "precision-loss")
+_num_rule("TL009", "domain-error")
+_num_rule("TL010", "quantization-range")

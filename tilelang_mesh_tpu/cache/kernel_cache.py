@@ -58,11 +58,12 @@ QUARANTINE_DIR = ".quarantine"
 LOCKS_DIR = ".locks"
 
 # Bump whenever codegen output OR the on-disk artifact format changes —
-# artifacts cached under older versions must not be reused. (11: plain
-# artifacts carry attrs["features"], the compile-time cost-feature dict
-# the autotuner's cost model consumes — older entries lack it and would
-# silently disable model-guided pruning on disk hits.)
-CODEGEN_VERSION = 11
+# artifacts cached under older versions must not be reused. (12: plain
+# artifacts carry attrs["numerics"], the tl-num finiteness proof the
+# TL_TPU_SANITIZE=auto elision consults — older entries lack it, which
+# would silently force the conservative check-everything path on disk
+# hits; the lint block may also carry TL007-TL010 findings now.)
+CODEGEN_VERSION = 12
 
 
 def _sha256(text: str) -> str:
@@ -144,6 +145,12 @@ class KernelCache:
         # lowerings being genuinely distinct cache entries
         from ..transform.tile_opt import tile_opt_modes
         h.update(",".join(tile_opt_modes(pass_cfg)).encode())
+        # ... and the tl-num assumptions: the TL007-010 findings in the
+        # lint block and the attrs["numerics"] finiteness proof both
+        # depend on the nominal input bound and the TL008 threshold
+        from ..analysis.numerics import num_assume_abs, num_err_threshold
+        h.update(f"{num_assume_abs(pass_cfg):g},"
+                 f"{num_err_threshold(pass_cfg):g}".encode())
         return h.hexdigest()
 
     def get(self, key: str):

@@ -121,6 +121,18 @@ def lower(func, target: str = "auto",
         if topt is not None:
             feats["dbuf_chains"] = topt.dbuf_chains
         attrs["features"] = feats
+        # tl-num finiteness proofs (analysis/numerics.py): the record
+        # TL_TPU_SANITIZE=auto consults to elide the runtime NaN/Inf
+        # pass on kernels whose every floating output is proven finite.
+        # Persisted with the artifact (JSON-clean), so disk-cache hits
+        # keep their proof; with lint off the proof is skipped and auto
+        # mode conservatively checks everything.
+        if lmode != "off":
+            from ..analysis.numerics import numerics_attrs
+            try:
+                attrs["numerics"] = numerics_attrs(func, cfg)
+            except Exception:   # noqa: BLE001 — a proof bug must never
+                pass            # fail an otherwise-valid compile
         if lmode != "off":
             with _trace.span("lint", "lower", kernel=func.name):
                 lint_findings = list(lint_findings) + \
@@ -128,6 +140,11 @@ def lower(func, target: str = "auto",
                 record_findings(lint_findings, kernel=func.name)
             errs = [d for d in lint_findings if d.severity == "error"]
             if lmode == "strict" and errs:
+                # strict-mode compile rejection: dump the black box
+                # naming the kernel and rules (PR 13 flight recorder)
+                from ..observability import flight as _flight
+                _flight.dump("strict_lint", kernel=func.name,
+                             rules=sorted({d.rule for d in errs}))
                 raise SemanticError(
                     f"{func.name}: lint failed (TL_TPU_LINT=strict):"
                     "\n  - " + "\n  - ".join(d.format() for d in errs),

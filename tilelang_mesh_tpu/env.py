@@ -106,8 +106,18 @@ class Environment:
     # differential self-check: first call of each optimized mesh kernel
     # also runs the TL_TPU_COMM_OPT=0 schedule and compares outputs
     TL_TPU_SELFCHECK = EnvVar("TL_TPU_SELFCHECK", False, bool)
-    # NaN/Inf sanitizer on collective payloads and kernel outputs
-    TL_TPU_SANITIZE = EnvVar("TL_TPU_SANITIZE", False, bool)
+    # NaN/Inf sanitizer on collective payloads and kernel outputs.
+    # "1"/"on": check everything; "auto": skip payloads/outputs the
+    # tl-num static analysis proved finite (attrs["numerics"],
+    # analysis/numerics.py) and check only the unproven rest — the
+    # static proof turned into a dispatch-overhead win; "0" (default):
+    # off. Parsed by verify.runtime.sanitize_mode (typos raise).
+    TL_TPU_SANITIZE = EnvVar("TL_TPU_SANITIZE", "0")
+    # tl-num nominal input-magnitude assumption: the |input| bound the
+    # warning track and the finiteness proofs assume (docs/
+    # static_analysis.md); pass cfg tl.tpu.num_assume_abs overrides
+    TL_TPU_NUM_ASSUME_ABS = EnvVar("TL_TPU_NUM_ASSUME_ABS", 65536.0,
+                                   float)
     # per-collective watchdog budget in ms (0 = disabled): a mesh
     # dispatch exceeding budget x n_collectives is classified as a
     # timeout, trips the breaker, and degrades to the unopt schedule

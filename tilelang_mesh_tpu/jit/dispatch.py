@@ -69,6 +69,14 @@ def _flag(raw: Optional[str], default: bool) -> bool:
     return raw.lower() in _TRUE
 
 
+def _sanitize_mode(raw: Optional[str]):
+    """False / "on" / "auto" from the raw env-snapshot value — ONE
+    grammar (verify.runtime.parse_sanitize_raw); a typo raises on the
+    first call after the flip, never silently disables the guard."""
+    mode = _verify_rt.parse_sanitize_raw(raw)
+    return False if mode == "off" else mode
+
+
 class DispatchPlan:
     """Precompiled per-kernel dispatch state; see the module docstring.
     Built by ``JITKernel._build`` after params are known, re-armed by
@@ -78,7 +86,7 @@ class DispatchPlan:
         "kernel", "name", "n_in", "n_all", "expected_fp", "inout_results",
         "donate_argnums", "out_names", "jax", "jax_array",
         "_env_snap", "fast_on", "donate_on", "metrics_on", "sanitize_on",
-        "_donate_cache",
+        "_donate_cache", "unproven_out", "proven_out_count",
     )
 
     def __init__(self, kernel):
@@ -102,6 +110,17 @@ class DispatchPlan:
             i for i, p in enumerate(kernel._in_params)
             if p.role == "inout")
         self.out_names = tuple(p.name for p in kernel._out_params)
+        # tl-num finiteness proofs (attrs["numerics"], analysis/
+        # numerics.py): under TL_TPU_SANITIZE=auto only the UNPROVEN
+        # float outputs are checked at run time; a missing record (lint
+        # off, pre-proof artifact) proves nothing and auto degrades to
+        # checking every float output
+        proofs = (art.attrs.get("numerics") or {}).get("outputs") or {}
+        float_outs = [(i, p.name) for i, p in enumerate(kernel._out_params)
+                      if _verify_rt.is_float_dtype(p.dtype)]
+        self.unproven_out = tuple(
+            (i, n) for i, n in float_outs if not proofs.get(n, False))
+        self.proven_out_count = len(float_outs) - len(self.unproven_out)
         self.jax = jax
         self.jax_array = jax.Array
         self._donate_cache: Optional[Callable] = None
@@ -117,7 +136,7 @@ class DispatchPlan:
         self.fast_on = _flag(fast, True)
         self.donate_on = _flag(donate, True) and bool(self.donate_argnums)
         self.metrics_on = _flag(metrics, False)
-        self.sanitize_on = _flag(sanitize, False)
+        self.sanitize_on = _sanitize_mode(sanitize)
 
     # -- failover / rebuild interplay ---------------------------------
     def rearm(self) -> None:
@@ -152,6 +171,26 @@ class DispatchPlan:
                             return _inner(*a)
             self._donate_cache = fn = jfn
         return fn
+
+    def run_sanitizer(self, results, mode=None) -> None:
+        """The mode-aware output NaN/Inf pass: ``on`` scans every float
+        output; ``auto`` scans only the outputs the tl-num analysis
+        could NOT prove finite and counts the skipped proven ones in
+        the ``sanitize.elided`` counter. An unproven output is NEVER
+        skipped."""
+        if mode is None:
+            mode = self.sanitize_on
+        if mode == "auto":
+            if self.unproven_out:
+                _verify_rt.check_host_outputs(
+                    [results[i] for i, _n in self.unproven_out],
+                    [n for _i, n in self.unproven_out],
+                    kernel=self.name)
+            if self.proven_out_count:
+                _verify_rt.note_elided(self.name, self.proven_out_count)
+            return
+        _verify_rt.check_host_outputs(results, self.out_names,
+                                      kernel=self.name)
 
     # -- the call ------------------------------------------------------
     def execute(self, args: tuple):
@@ -194,8 +233,7 @@ class DispatchPlan:
             result = kernel._dispatch(jax_ins, donate=donate)
         results = result if isinstance(result, tuple) else (result,)
         if self.sanitize_on:
-            _verify_rt.check_host_outputs(results, self.out_names,
-                                          kernel=self.name)
+            self.run_sanitizer(results)
         if timed:
             # host overhead = marshalling before + bookkeeping after
             # the jitted dispatch, recorded BEFORE the device sync so

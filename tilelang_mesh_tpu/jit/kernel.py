@@ -289,13 +289,14 @@ class JITKernel:
         result = self._dispatch(jax_ins)
         _post_t0 = time.perf_counter() if _rt_t0 else 0.0
         results = result if isinstance(result, tuple) else (result,)
-        # opt-in numeric sanitizer (TL_TPU_SANITIZE=1, verify/runtime.py):
+        # opt-in numeric sanitizer (TL_TPU_SANITIZE, verify/runtime.py):
         # NaN/Inf on any float output raises a deterministic
-        # NumericError. Disabled (default): one cached env read.
+        # NumericError; =auto skips outputs the tl-num analysis proved
+        # finite (the plan holds the precomputed unproven subset).
+        # Disabled (default): one cached env read.
         if _verify_rt.sanitize_enabled():
-            _verify_rt.check_host_outputs(
-                results, [p.name for p in self._out_params],
-                kernel=self.artifact.name)
+            self._plan.run_sanitizer(results,
+                                     mode=_verify_rt.sanitize_mode())
         if _rt_t0:
             _runtime.record_overhead(
                 self.artifact.name,

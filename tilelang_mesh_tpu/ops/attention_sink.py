@@ -92,7 +92,8 @@ def sink_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
             for i in T.Parallel(block_M):
                 l[i] = l[i] + T.exp2(sink[0] * _LOG2E - m_prev[i])
             for i, j in T.Parallel(block_M, D):
-                acc[i, j] = acc[i, j] / l[i]
+                # clamped divide (the dsa/nsa idiom) — tl-num TL009
+                acc[i, j] = acc[i, j] / T.max(l[i], 1e-30)
             T.copy(acc, O[bz, by, bx * block_M, 0])
 
     return _tl_compile(sink_fwd)

@@ -102,7 +102,10 @@ def _mha_fwd_kernel(B, H, Sq, Sk, D, block_M, block_N, causal, sm_scale,
 
             acc, l = st["acc"], st["l"]
             for i, j in T.Parallel(block_M, D):
-                acc[i, j] = acc[i, j] / l[i]
+                # clamped divide (the dsa/nsa idiom): a fully-underflowed
+                # row's normalizer is 0.0 and the bare divide is 0/0 =
+                # NaN — found by tl-num rule TL009 (docs/static_analysis.md)
+                acc[i, j] = acc[i, j] / T.max(l[i], 1e-30)
             T.copy(acc, O[bz, by, bx * block_M, 0])
 
     return _tl_compile(mha_fwd)

@@ -51,7 +51,9 @@ def gqa_fwd_kernel(B, Hq, Hkv, Sq, Sk, D, block_M, block_N, causal,
 
             acc, l = st["acc"], st["l"]
             for i, j in T.Parallel(block_M, D):
-                acc[i, j] = acc[i, j] / l[i]
+                # clamped divide (the dsa/nsa idiom): 0/0 = NaN on a
+                # fully-underflowed row — tl-num TL009
+                acc[i, j] = acc[i, j] / T.max(l[i], 1e-30)
             T.copy(acc, O[bz, by, bx * block_M, 0])
 
     return _tl_compile(gqa_fwd)

@@ -364,6 +364,24 @@ def lower_mesh(func: PrimFunc, target: str,
             schedule_lines.extend(plan_desc_block(lint_findings, lmode))
             lint_rec = [d.to_dict() for d in lint_findings]
 
+    # tl-num finiteness proofs (analysis/numerics.py): which collective
+    # payloads and outputs are statically finite — TL_TPU_SANITIZE=auto
+    # builds its reduced check set from this (elision must never skip
+    # an unproven payload, so a missing/failed analysis proves nothing)
+    num_rec = None
+    num_proof = None
+    if lmode != "off":
+        try:
+            from ..analysis.numerics import analyze as _analyze_num
+            nres = _analyze_num(func, pass_cfg)
+            num_rec = nres.attrs_record()
+            num_proof = {
+                "payload_uids": sorted(nres.payload_uids_proven()),
+                "outputs": dict(nres.outputs),
+            }
+        except Exception:   # noqa: BLE001 — a proof bug must never
+            num_rec = num_proof = None      # fail an otherwise-valid compile
+
     for p in params:
         schedule_lines.append(
             f"  param {p.name}: role={p.role} spec="
@@ -393,6 +411,10 @@ def lower_mesh(func: PrimFunc, target: str,
                "verify": verify_rec,
                # tl-lint findings (None when clean or TL_TPU_LINT=0)
                "lint": lint_rec,
+               # tl-num finiteness proof (JSON-safe summary) + the
+               # in-process uid-level proof TL_TPU_SANITIZE=auto uses
+               "numerics": num_rec,
+               "_num_proof": num_proof,
                # the pass config this artifact was lowered under, kept so
                # the runtime guardrails (selfcheck/watchdog) can re-lower
                # the SAME program with only the optimizer disabled
@@ -445,7 +467,12 @@ def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
         if isinstance(c, CommAllReduce):
             rec["reduce_type"] = c.reduce_type
     kind = rec["op"]
-    _faults.maybe_fail("comm.collective", kernel=kernel, op=kind)
+    # nothing to corrupt at accounting time: when a corrupt clause is
+    # armed, this visit must not consume its coin/budget — the clause
+    # belongs entirely to the runtime interpret site (_apply_comm),
+    # where it poisons the wire payload the sanitizer guards
+    if not _faults.corrupt_armed("comm.collective"):
+        _faults.maybe_fail("comm.collective", kernel=kernel, op=kind)
     _trace.event("comm.collective", "comm", **rec)
     _trace.inc("comm.ops", op=kind)
     _trace.inc("comm.bytes", rec["wire_bytes"], op=kind)
@@ -749,6 +776,12 @@ class MeshKernel:
         self._n_collectives = sum(
             1 for s in segments if s["kind"] == "comm"
             and not isinstance(s["op"], (CommBarrier, CommFence)))
+        # tl-num proofs for TL_TPU_SANITIZE=auto (attrs["_num_proof"],
+        # analysis/numerics.py): payload-buffer uids / output names the
+        # static analysis proved finite. Missing proof = nothing proven.
+        proof = art.attrs.get("_num_proof") or {}
+        self._proven_payload_uids = set(proof.get("payload_uids") or ())
+        self._proven_outputs = dict(proof.get("outputs") or {})
         # runtime-guardrail state (verify/runtime.py): all lazily
         # populated so the guards-off dispatch path stays untouched
         self._sanitized_cache = None
@@ -777,12 +810,21 @@ class MeshKernel:
         self._out_arg_positions = [pos[p.name] for p in out_params
                                    if p.role == "out"]
 
-    def _make_spmd(self, sanitize: bool):
+    def _skip_payload(self, reg, auto: bool) -> bool:
+        """auto-mode elision predicate: True iff the tl-num analysis
+        proved this payload finite (never True without a proof)."""
+        return auto and reg.buffer.uid in self._proven_payload_uids
+
+    def _skip_output(self, b, auto: bool) -> bool:
+        return auto and bool(self._proven_outputs.get(b.name, False))
+
+    def _make_spmd(self, sanitize: bool, auto: bool = False):
         """The per-core SPMD program over the compiled segments. With
         ``sanitize`` the program also emits one mesh-summed bad-element
         count per floating collective payload and kernel output (the
         ``TL_TPU_SANITIZE=1`` flags, checked host-side after dispatch —
-        order matches :meth:`_sanitize_checks` exactly)."""
+        order matches :meth:`_sanitize_checks` exactly). With ``auto``
+        the statically-proven checks are elided from the emission."""
         segments = self._segments_exec
         seg_calls = self._seg_calls
         in_bufs, out_bufs = self._in_bufs, self._out_bufs
@@ -805,6 +847,8 @@ class MeshKernel:
                 if seg["kind"] == "comm":
                     if sanitize:
                         for reg in _sanitize_payloads(seg["op"]):
+                            if self._skip_payload(reg, auto):
+                                continue
                             v = state.get(reg.buffer.uid)
                             flags.append(
                                 bad_count(v) if v is not None
@@ -833,7 +877,8 @@ class MeshKernel:
             if sanitize:
                 from ..verify.runtime import is_float_dtype
                 for b, v in zip(out_bufs, outs):
-                    if is_float_dtype(b.dtype):
+                    if is_float_dtype(b.dtype) and \
+                            not self._skip_output(b, auto):
                         flags.append(bad_count(v))
                 if flags:
                     return outs + (jnp.stack(flags),)
@@ -841,36 +886,55 @@ class MeshKernel:
 
         return spmd
 
-    def _sanitize_checks(self) -> List[str]:
-        """Descriptions of every sanitizer flag the sanitized SPMD
-        program emits, in emission order."""
+    def _sanitize_checks(self, auto: bool = False):
+        """(descriptions of every sanitizer flag the sanitized SPMD
+        program emits, in emission order; number of statically-proven
+        checks auto mode elided)."""
         from ..verify.runtime import is_float_dtype
         checks: List[str] = []
+        elided = 0
         for i, seg in enumerate(self._segments_exec):
             if seg["kind"] != "comm":
                 continue
             for reg in _sanitize_payloads(seg["op"]):
+                if self._skip_payload(reg, auto):
+                    elided += 1
+                    continue
                 checks.append(f"collective [{i}] payload "
                               f"{reg.buffer.name!r}")
         for b in self._out_bufs:
-            if is_float_dtype(b.dtype):
-                checks.append(f"output {b.name!r}")
-        return checks
+            if not is_float_dtype(b.dtype):
+                continue
+            if self._skip_output(b, auto):
+                elided += 1
+                continue
+            checks.append(f"output {b.name!r}")
+        return checks, elided
 
-    def _sanitized(self):
-        """(jitted sanitized dispatch, flag descriptions), built lazily
-        on the first ``TL_TPU_SANITIZE=1`` dispatch so the disabled path
-        never pays for the second trace."""
-        if self._sanitized_cache is None:
+    def _sanitized(self, auto: bool = False):
+        """(jitted sanitized dispatch, flag descriptions, elided count)
+        for the requested mode, built lazily on the first sanitizing
+        dispatch so the disabled path never pays for the second trace.
+        In auto mode with EVERY check statically proven, the dispatch
+        callable is the plain program — the elision payoff."""
+        key = "auto" if auto else "on"
+        cache = self._sanitized_cache
+        if cache is None:
+            cache = self._sanitized_cache = {}
+        if key not in cache:
             import jax
             from jax.sharding import PartitionSpec as P
-            checks = self._sanitize_checks()
-            out_specs = self._out_specs + ((P(),) if checks else ())
-            fn = jax.jit(shard_map_compat(
-                self._make_spmd(sanitize=True), mesh=self.mesh,
-                in_specs=self._in_specs, out_specs=out_specs))
-            self._sanitized_cache = (fn, checks)
-        return self._sanitized_cache
+            checks, elided = self._sanitize_checks(auto=auto)
+            if auto and not checks:
+                cache[key] = (self.func, checks, elided)
+            else:
+                out_specs = self._out_specs + ((P(),) if checks else ())
+                fn = jax.jit(shard_map_compat(
+                    self._make_spmd(sanitize=True, auto=auto),
+                    mesh=self.mesh, in_specs=self._in_specs,
+                    out_specs=out_specs))
+                cache[key] = (fn, checks, elided)
+        return cache[key]
 
     # -- runtime guardrails (verify/runtime.py; docs/robustness.md) ----
     def _dispatch(self, jins):
@@ -905,17 +969,27 @@ class MeshKernel:
         from ..resilience.errors import TLTimeoutError
         name = self.artifact.name
 
+        san_auto = g.sanitize == "auto"
+        san = self._sanitized(auto=san_auto) if g.sanitize else None
+        fully_elided = san is not None and san_auto and not san[1]
+
         def primary():
             if g.sanitize:
-                fn, checks = self._sanitized()
+                fn, checks, elided = san
                 out = fn(*jins)
                 if checks:
                     _guard.check_flags(out[-1], checks, kernel=name)
                     out = out[:-1]
+                if san_auto and elided:
+                    _guard.note_elided(name, elided)
                 return out
             return self.func(*jins)
 
-        variant = "sanitized" if g.sanitize else "plain"
+        # auto mode with every check statically proven dispatches the
+        # PLAIN program (the elision payoff) — warm-variant bookkeeping
+        # must agree with what actually ran
+        variant = "sanitized" if (g.sanitize and not fully_elided) \
+            else "plain"
         try:
             # the wall-clock watchdog arms only once THIS program
             # variant is warm: a first call's jax trace + XLA compile
@@ -1293,6 +1367,22 @@ def _apply_comm(op: CommStmt, state: Dict[int, Any], nrow: int, ncol: int):
             for k, v in zip(keys, vals):
                 state[k] = v
         return
+
+    # chaos site (TL_TPU_FAULTS="comm.collective:...:kind=corrupt"): a
+    # NaN silently poisons the collective's first floating payload at
+    # trace time — the wire-corruption class the TL_TPU_SANITIZE
+    # payload checks exist to catch (and that =auto must still catch on
+    # any payload the static analysis could NOT prove finite). Other
+    # kinds raise here like every runtime fault site.
+    try:
+        _faults.maybe_fail("comm.collective", op=type(op).__name__)
+    except _faults.CorruptionRequest:
+        for reg in _sanitize_payloads(op):
+            v = state.get(reg.buffer.uid)
+            if v is not None:
+                state[reg.buffer.uid] = v.at[(0,) * v.ndim].set(
+                    jnp.nan)
+                break
 
     row = lax.axis_index("x")
     col = lax.axis_index("y")

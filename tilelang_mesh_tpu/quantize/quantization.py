@@ -1,9 +1,15 @@
-"""Weight quantization helpers (host side).
+"""Weight quantization helpers (host side) + the on-device activation
+quantizer.
 
 Reference: /root/reference/tilelang/quantize/ (lop3/mxfp dequant
 permutations). The GPU build permutes bits for LOP3 instructions; on TPU the
 VPU unpacks with plain shifts/masks, so the host side is a straight pack and
 the in-kernel unpack lives in ops/dequant_gemm.py.
+:func:`quantize_act_int8_kernel` is the device-side per-token int8
+quantizer feeding the w4a8 serving path — and a lint-sweep citizen: the
+CI ``lint-oplib`` job runs the TL007-TL010 numerical-safety rules over
+this module (the clamp + guarded-divide idioms here are what keeps the
+int8 cast provably wrap-free).
 """
 
 from __future__ import annotations
@@ -61,6 +67,52 @@ def dequantize_int4_planar_ref(packed: np.ndarray, scales: np.ndarray,
     lo = (lo.reshape(g, group_size, N) * s[0][:, None, :]).reshape(K2, N)
     hi = (hi.reshape(g, group_size, N) * s[1][:, None, :]).reshape(K2, N)
     return np.concatenate([lo, hi], axis=0)
+
+
+def quantize_act_int8_kernel(M, K, block_M=128):
+    """Per-token (row) symmetric int8 activation quantization on device:
+    ``X (M, K) f32 -> Q (M, K) int8, S (M, 1) f32`` with ``S`` the
+    DEQUANT scale (``absmax / 127``), the layout ``w4a8_gemm_kernel``'s
+    ``Sa`` operand consumes directly.
+
+    Numerically-safe by construction (and proven so by tl-num,
+    docs/static_analysis.md): the divide is clamped (an all-zero row's
+    absmax is 0 — bare ``x / s`` would be 0/0 = NaN) and the rounded
+    quotient is clamped into [-127, 127] before the int8 cast, so the
+    cast provably cannot wrap (TL007) and the kernel's outputs carry
+    the ``proven_finite`` elision proof."""
+    import tilelang_mesh_tpu.language as T
+    from ..jit import compile as _tl_compile
+
+    @T.prim_func
+    def quantize_act(X: T.Tensor((M, K), "float32"),
+                     Q: T.Tensor((M, K), "int8"),
+                     S: T.Tensor((M, 1), "float32")):
+        with T.Kernel(T.ceildiv(M, block_M)) as bm:
+            x_s = T.alloc_shared((block_M, K), "float32")
+            q_f = T.alloc_fragment((block_M, K), "int8")
+            amax = T.alloc_fragment((block_M,), "float32")
+            s_f = T.alloc_fragment((block_M, 1), "float32")
+            T.copy(X[bm * block_M, 0], x_s)
+            T.reduce_absmax(x_s, amax, dim=1)
+            for i in T.Parallel(block_M):
+                s_f[i, 0] = T.max(amax[i], 1e-8) / 127.0
+            for i, j in T.Parallel(block_M, K):
+                q_f[i, j] = T.cast(
+                    T.clamp(T.round(x_s[i, j] / s_f[i, 0]),
+                            -127.0, 127.0), "int8")
+            T.copy(q_f, Q[bm * block_M, 0])
+            T.copy(s_f, S[bm * block_M, 0])
+
+    return _tl_compile(quantize_act)
+
+
+def quantize_act_int8_ref(x: np.ndarray):
+    """Host reference of :func:`quantize_act_int8_kernel`."""
+    absmax = np.maximum(np.abs(x).max(axis=-1, keepdims=True), 1e-8)
+    s = (absmax / 127.0).astype(np.float32)
+    q = np.clip(np.round(x / s), -127, 127).astype(np.int8)
+    return q, s
 
 
 def pack_int4(q: np.ndarray) -> np.ndarray:

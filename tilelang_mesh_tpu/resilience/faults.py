@@ -206,6 +206,19 @@ def active_specs() -> List[FaultSpec]:
     return _env_specs() + list(_overrides)
 
 
+def corrupt_armed(site: str) -> bool:
+    """Is a ``kind=corrupt`` clause armed for this site? A read-only
+    probe: neither the seeded coin nor the ``times=`` budget advances.
+    Sites that exist at BOTH a bookkeeping point and the point that can
+    actually corrupt an artifact use this to leave the whole clause
+    budget to the corrupting visit (``comm.collective``: lowering-time
+    accounting vs the trace-time payload poison)."""
+    if not _overrides and not env.TL_TPU_FAULTS:
+        return False
+    return any(spec.kind == "corrupt" and spec.matches(site)
+               for spec in active_specs())
+
+
 def maybe_fail(site: str, **ctx) -> None:
     """The hook each fault site calls. No-op unless a clause matches and
     its seeded coin lands; then records the injection and raises the

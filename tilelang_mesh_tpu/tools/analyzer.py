@@ -1372,7 +1372,7 @@ def main(argv=None) -> int:
                            "(default: env.tune_cache_dir())")
     p_ln = sub.add_parser(
         "lint", help="offline static analysis of kernel modules: the "
-                     "TL001-TL006 dataflow rules + TL1xx semantic "
+                     "TL001-TL010 dataflow + tl-num rules + TL1xx semantic "
                      "checks (docs/static_analysis.md); exit 1 on any "
                      "error-severity finding")
     p_ln.add_argument("targets", nargs="+",

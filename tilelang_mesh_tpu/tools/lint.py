@@ -17,7 +17,7 @@ For each module the linter:
    how the ops library, whose kernels are built lazily per shape, gets
    linted without running anything;
 3. runs ``analysis.collect_diagnostics`` (the TL1xx semantic checkers +
-   the TL001-TL006 dataflow rules, plan-level TL005 included) on each
+   the TL001-TL010 dataflow + tl-num rules, plan-level TL005 included) on each
    collected kernel — the identical finding set the in-pipeline pass
    produces for the same kernel.
 
@@ -179,6 +179,14 @@ def collect_module_kernels(target) -> Tuple[list, List[dict]]:
                 hook(v)
 
         overrides = SEED_OVERRIDES.get(modname, {})
+        # lru_cached factories only trace on a miss: clear EVERY cached
+        # callable in the module — public factories often delegate to a
+        # private lru-cached builder (flash_attention's mha_fwd_kernel
+        # -> _mha_fwd_kernel), and a warm private cache would silently
+        # yield "seed-no-kernel" on a second in-process lint run
+        for v in vars(mod).values():
+            if callable(v) and hasattr(v, "cache_clear"):
+                v.cache_clear()
         for name, fn in sorted(vars(mod).items()):
             if name.startswith("_") or not name.endswith("_kernel") \
                     or not callable(fn):
@@ -191,10 +199,6 @@ def collect_module_kernels(target) -> Tuple[list, List[dict]]:
                                        "smoke default"})
                 continue
             before = len(collected)
-            # lru_cached factories only trace on a miss: clear so a
-            # second lint run (same process, e.g. tests) still collects
-            if hasattr(fn, "cache_clear"):
-                fn.cache_clear()
             try:
                 fn(**kwargs)
             except BaseException as e:   # noqa: BLE001 - the traced IR
@@ -280,8 +284,12 @@ def format_report(report: dict) -> str:
     s = report["summary"]
     if s["total"]:
         by = ", ".join(f"{r}={n}" for r, n in s["by_rule"].items())
+        by_sev = ", ".join(
+            f"{sev}={s['by_severity'][sev]}"
+            for sev in ("error", "warning", "info")
+            if s["by_severity"].get(sev))
         lines.append(f"findings: {s['total']} ({by}); "
-                     f"errors: {s['errors']}")
+                     f"by severity: {by_sev}; errors: {s['errors']}")
         if s["by_rule"].get("TL006"):
             # TL006's proof is exactly what the tile-opt dse rewrite
             # executes — point at the auto-fix instead of asking for a
@@ -314,9 +322,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tilelang_mesh_tpu.tools.lint",
         description="Lint tile-kernel modules offline with the TL001-"
-                    "TL006 dataflow rules + TL1xx semantic checks "
-                    "(docs/static_analysis.md). Exit 1 iff an error-"
-                    "severity finding fired.")
+                    "TL010 dataflow + tl-num rules + TL1xx semantic "
+                    "checks (docs/static_analysis.md). Exit 1 iff an "
+                    "error-severity finding fired.")
     ap.add_argument("targets", nargs="+",
                     help=".py file, directory, or dotted module name")
     ap.add_argument("--json", action="store_true",

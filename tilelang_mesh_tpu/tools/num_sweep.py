@@ -1,0 +1,224 @@
+"""tl-num mutation sweep: prove the TL007-TL010 rules actually fire.
+
+::
+
+    python -m tilelang_mesh_tpu.tools.num_sweep [--seed N] [--json]
+
+Builds a set of deliberately-corrupted kernels — each the canonical
+numerical bug its rule exists for — runs the full diagnostic collection
+on every one, and exits 1 unless EVERY expected rule fires on its
+mutant (and nothing fires on the clean control). The CI ``lint-oplib``
+job runs this next to the clean ops/examples/quantize sweep: the clean
+sweep proves zero false positives, this sweep proves non-zero recall.
+
+Mutations (shapes are seeded so repeated CI runs walk the space):
+
+==========  ============================================================
+TL007       int16 GEMM accumulator wrapped by an int8 x int4 reduction;
+            a bf16 store of an over-range f32 sum
+TL008       bfloat16 GEMM accumulator over a large-K pipelined loop
+TL009       online softmax with the max-subtraction deleted (exp
+            overflow) and an unguarded normalizer division
+TL010       int4 dequant decode with the zero point outside the 4-bit
+            payload envelope
+==========  ============================================================
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+
+def _mutants(seed: int):
+    """(name, expected_rule, prim_func) triples; shapes derived from the
+    seed so the sweep is deterministic per seed but not one fixed IR."""
+    import random
+
+    import tilelang_mesh_tpu.language as T
+
+    rng = random.Random(seed)
+    bm = rng.choice((64, 128))
+    bn = rng.choice((128, 256))
+    nk = rng.choice((32, 48, 64))        # large-K trip count (TL008)
+
+    out = []
+
+    # -- TL007: int16 accumulator wrap ---------------------------------
+    @T.prim_func
+    def int16_wrap(A: T.Tensor((bm, 2, 512), "int8"),
+                   Bp: T.Tensor((512, bn), "uint8"),
+                   C: T.Tensor((bm, bn), "float32")):
+        with T.Kernel(1) as bx:
+            bl = T.alloc_fragment((512, bn), "int8")
+            acc = T.alloc_fragment((bm, bn), "int16")
+            o = T.alloc_fragment((bm, bn), "float32")
+            T.clear(acc)
+            for i, j in T.Parallel(512, bn):
+                bl[i, j] = T.cast(
+                    T.bitwise_and(T.cast(Bp[i, j], "int32"), 0xF) - 8,
+                    "int8")
+            T.gemm(A[:, 0, :], bl, acc)
+            for i, j in T.Parallel(bm, bn):
+                o[i, j] = T.cast(acc[i, j], "float32")
+            T.copy(o, C)
+    out.append(("int16_accumulator_wrap", "TL007", int16_wrap))
+
+    # -- TL007: f32 sum past the bf16 finite range ---------------------
+    @T.prim_func
+    def bf16_range(C: T.Tensor((8, 128), "bfloat16")):
+        with T.Kernel(1) as bx:
+            a = T.alloc_fragment((8, 128), "float32")
+            b = T.alloc_fragment((8, 128), "bfloat16")
+            T.fill(a, 1.7e38)
+            for i, j in T.Parallel(8, 128):
+                b[i, j] = a[i, j] + a[i, j]
+            T.copy(b, C)
+    out.append(("bf16_store_over_range", "TL007", bf16_range))
+
+    # -- TL008: bf16 accumulator at large K ----------------------------
+    @T.prim_func
+    def bf16_accum(A: T.Tensor((bm, nk * 128), "bfloat16"),
+                   B: T.Tensor((nk * 128, bn), "bfloat16"),
+                   C: T.Tensor((bm, bn), "bfloat16")):
+        with T.Kernel(1) as bx:
+            a_s = T.alloc_shared((bm, 128), "bfloat16")
+            b_s = T.alloc_shared((128, bn), "bfloat16")
+            c_l = T.alloc_fragment((bm, bn), "bfloat16")
+            T.clear(c_l)
+            for ko in T.Pipelined(nk):
+                T.copy(A[0, ko * 128], a_s)
+                T.copy(B[ko * 128, 0], b_s)
+                T.gemm(a_s, b_s, c_l)
+            T.copy(c_l, C)
+    out.append(("bf16_accum_large_k", "TL008", bf16_accum))
+
+    # -- TL009: softmax missing the max-subtraction --------------------
+    @T.prim_func
+    def no_max_sub(A: T.Tensor((bm, bn), "float32"),
+                   O: T.Tensor((bm, bn), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((bm, bn), "float32")
+            den = T.alloc_fragment((bm,), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(bm, bn):
+                s[i, j] = T.exp(s[i, j])
+            T.reduce_sum(s, den, dim=1)
+            for i, j in T.Parallel(bm, bn):
+                s[i, j] = s[i, j] / den[i]
+            T.copy(s, O)
+    out.append(("softmax_missing_max_subtraction", "TL009", no_max_sub))
+
+    # -- TL009: unguarded normalizer division --------------------------
+    @T.prim_func
+    def unguarded_div(A: T.Tensor((bm, bn), "float32"),
+                      O: T.Tensor((bm, bn), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_fragment((bm, bn), "float32")
+            mx = T.alloc_fragment((bm,), "float32")
+            den = T.alloc_fragment((bm,), "float32")
+            m2 = T.alloc_fragment((bm,), "float32")
+            T.copy(A, s)
+            T.reduce_max(s, mx, dim=1)
+            for i in T.Parallel(bm):
+                # the -1e30 floor makes the max non-tight, so the
+                # normalizer's >= 1 proof is gone and the bare divide
+                # is provably 0/0-able (the flash-attention bug class)
+                m2[i] = T.max(mx[i], -1e30)
+            for i, j in T.Parallel(bm, bn):
+                s[i, j] = T.exp(s[i, j] - m2[i])
+            T.reduce_sum(s, den, dim=1)
+            for i, j in T.Parallel(bm, bn):
+                s[i, j] = s[i, j] / den[i]
+            T.copy(s, O)
+    out.append(("unguarded_normalizer_division", "TL009", unguarded_div))
+
+    # -- TL010: zero point outside the int4 payload envelope -----------
+    @T.prim_func
+    def bad_zeropoint(Bp: T.Tensor((256, bn), "uint8"),
+                      S: T.Tensor((1, bn), "float32"),
+                      Bd: T.Tensor((256, bn), "float32")):
+        with T.Kernel(1) as bx:
+            d = T.alloc_fragment((256, bn), "float32")
+            for i, j in T.Parallel(256, bn):
+                d[i, j] = (T.cast(T.bitwise_and(
+                    T.cast(Bp[i, j], "int32"), 0xF), "float32")
+                    - 16.0) * S[0, j]
+            T.copy(d, Bd)
+    out.append(("dequant_zero_point_out_of_range", "TL010",
+                bad_zeropoint))
+
+    # -- clean control: must fire NOTHING ------------------------------
+    @T.prim_func
+    def clean(A: T.Tensor((bm, 256), "float32"),
+              B: T.Tensor((256, bn), "float32"),
+              C: T.Tensor((bm, bn), "float32")):
+        with T.Kernel(1) as bx:
+            a_s = T.alloc_shared((bm, 128), "float32")
+            b_s = T.alloc_shared((128, bn), "float32")
+            c_l = T.alloc_fragment((bm, bn), "float32")
+            T.clear(c_l)
+            for ko in T.Pipelined(2):
+                T.copy(A[0, ko * 128], a_s)
+                T.copy(B[ko * 128, 0], b_s)
+                T.gemm(a_s, b_s, c_l)
+            T.copy(c_l, C)
+    out.append(("clean_control", None, clean))
+
+    return out
+
+
+def run_sweep(seed: int = 0) -> dict:
+    from ..analysis import collect_diagnostics
+    report: Dict[str, object] = {"seed": seed, "mutants": []}
+    ok = True
+    fired: set = set()
+    for name, expected, obj in _mutants(seed):
+        diags = collect_diagnostics(obj.func, with_plan=False)
+        rules = sorted({d.rule for d in diags})
+        rec = {"mutant": name, "expected": expected, "fired": rules,
+               "findings": [d.to_dict() for d in diags]}
+        if expected is None:
+            rec["ok"] = not any(r.startswith("TL0") and r in
+                                ("TL007", "TL008", "TL009", "TL010")
+                                for r in rules)
+        else:
+            rec["ok"] = expected in rules
+            fired |= set(rules)
+        ok = ok and bool(rec["ok"])
+        report["mutants"].append(rec)
+    missing = {"TL007", "TL008", "TL009", "TL010"} - fired
+    report["rules_fired"] = sorted(fired)
+    report["rules_missing"] = sorted(missing)
+    report["ok"] = ok and not missing
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.tools.num_sweep",
+        description="Seeded corrupted-kernel sweep for the tl-num "
+                    "TL007-TL010 rules (docs/static_analysis.md). "
+                    "Exit 1 unless every rule fires on its mutant and "
+                    "the clean control stays silent.")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    report = run_sweep(args.seed)
+    if args.json:
+        print(json.dumps(report, indent=2))      # noqa: T201
+    else:
+        for rec in report["mutants"]:
+            status = "ok" if rec["ok"] else "MISSED"
+            exp = rec["expected"] or "(clean)"
+            print(f"  {rec['mutant']}: expected {exp}, "       # noqa: T201
+                  f"fired {rec['fired'] or 'nothing'} -> {status}")
+        print(f"rules fired: {report['rules_fired']}; "        # noqa: T201
+              f"missing: {report['rules_missing'] or 'none'}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,11 @@ class PassConfigKey(str, Enum):
     # mesh schedule verifier (verify/schedule.py): "1"/"on" (default),
     # "0"/"off", or "strict" — overrides TL_TPU_VERIFY
     TL_TPU_VERIFY = "tl.tpu.verify"
+    # tl-num numerical-safety analysis (analysis/numerics.py): nominal
+    # |input| magnitude assumption of the warning track / finiteness
+    # proofs, and the TL008 accumulated-relative-error threshold
+    TL_TPU_NUM_ASSUME_ABS = "tl.tpu.num_assume_abs"
+    TL_TPU_NUM_ERR_THRESHOLD = "tl.tpu.num_err_threshold"
     # accepted for API parity, no TPU effect
     TL_DISABLE_TMA_LOWER = "tl.disable_tma_lower"
     TL_DISABLE_WARP_SPECIALIZED = "tl.disable_warp_specialized"

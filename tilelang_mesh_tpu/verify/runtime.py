@@ -43,7 +43,8 @@ from ..observability import tracer as _trace
 from ..resilience.errors import DeterministicError, TLTimeoutError
 
 __all__ = ["NumericError", "SelfCheckDivergence", "GuardState",
-           "guard_state", "sanitize_enabled", "tolerance_for",
+           "guard_state", "sanitize_enabled", "sanitize_mode",
+           "parse_sanitize_raw", "note_elided", "tolerance_for",
            "compare_outputs", "check_host_outputs", "check_flags",
            "watchdog_call"]
 
@@ -63,29 +64,66 @@ class SelfCheckDivergence(DeterministicError):
 class GuardState:
     """Snapshot of the enabled guards for one dispatch. Only allocated
     when at least one guard is on — the disabled path returns the
-    module-level ``None`` so tests can assert zero allocation."""
+    module-level ``None`` so tests can assert zero allocation.
+    ``sanitize`` carries the MODE (``"on"``/``"auto"``/``False``) so
+    the dispatch paths can elide statically-proven checks in auto."""
 
     __slots__ = ("selfcheck", "sanitize", "timeout_ms")
 
-    def __init__(self, selfcheck: bool, sanitize: bool, timeout_ms: float):
+    def __init__(self, selfcheck: bool, sanitize, timeout_ms: float):
         self.selfcheck = selfcheck
         self.sanitize = sanitize
         self.timeout_ms = timeout_ms
+
+
+def parse_sanitize_raw(raw: Optional[str]) -> str:
+    """The ONE ``TL_TPU_SANITIZE`` grammar: ``off``/``on``/``auto``
+    from a raw env value (None = unset = off); a typo raises instead of
+    silently disabling the guard (the lint_mode/verify_mode contract).
+    Shared with the fast-dispatch flag cache (jit/dispatch.py), which
+    parses its own env snapshot."""
+    if raw is None:
+        return "off"
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "none", "no"):
+        return "off"
+    if raw in ("1", "on", "true", "yes"):
+        return "on"
+    if raw == "auto":
+        return "auto"
+    raise ValueError(
+        f"unknown TL_TPU_SANITIZE mode {raw!r}; valid values are 0/off "
+        f"(default), 1/on, auto")
+
+
+def sanitize_mode() -> str:
+    """The resolved ``TL_TPU_SANITIZE`` mode: ``off`` (default) /
+    ``on`` / ``auto``. ``auto`` skips the runtime NaN/Inf pass for
+    payloads and outputs the tl-num analysis proved finite
+    (``attrs["numerics"]``, analysis/numerics.py) and checks only the
+    unproven rest."""
+    return parse_sanitize_raw(str(env.TL_TPU_SANITIZE))
 
 
 def guard_state() -> Optional[GuardState]:
     """The enabled runtime guards, or None when everything is off (the
     common case: short-circuiting env reads, no allocation)."""
     sc = env.TL_TPU_SELFCHECK
-    sz = env.TL_TPU_SANITIZE
+    sz = sanitize_mode()
     to = env.TL_TPU_COMM_TIMEOUT_MS
-    if not (sc or sz or to > 0):
+    if not (sc or sz != "off" or to > 0):
         return None
-    return GuardState(sc, sz, to)
+    return GuardState(sc, False if sz == "off" else sz, to)
 
 
 def sanitize_enabled() -> bool:
-    return env.TL_TPU_SANITIZE
+    return sanitize_mode() != "off"
+
+
+def note_elided(kernel: str, n: int = 1) -> None:
+    """Count a statically-proven check the auto mode skipped — the
+    observable half of the elision contract (docs/robustness.md)."""
+    _trace.inc("sanitize.elided", value=n, kernel=kernel)
 
 
 # ---------------------------------------------------------------------------
