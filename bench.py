@@ -962,6 +962,110 @@ def cfg_mesh_serve_smoke(requests=48):
                 custom_run=run)
 
 
+def cfg_serve_prefill_smoke(requests=12, shared_pages=32):
+    """CI serve-lifecycle config for the full-lifecycle serving path
+    (serving/prefix_cache.py; docs/serving.md "Full-lifecycle
+    serving"): ``requests`` requests sharing one ``shared_pages``-page
+    system prompt are served twice — COLD (prefix cache off: every
+    request pays the full O(prompt) chunked prefill) and WARM (a fresh
+    prefix cache seeded by one request: every subsequent request
+    restores the prompt's KV pages checksummed instead of recomputing
+    them). Headline value AND ``vs_baseline`` = the warm-prefix
+    speedup (cold wall / warm wall) — the CI gate is >= 2x. Every
+    request must retire ``result`` with zero leaked slabs or the
+    config raises. CPU-safe: prefill fill + page restore are
+    host-side; the decode step runs identically on the host tiers."""
+    import tempfile
+
+    from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                           PagedKVAllocator,
+                                           PrefixKVCache, ServingEngine)
+
+    PS, H, D = 16, 4, 64
+    shared = [int(t) for t in
+              np.random.default_rng(23).integers(
+                  0, 1 << 20, size=shared_pages * PS)]
+
+    def build_engine(prefix_cache, name):
+        alloc = PagedKVAllocator(n_pages=1024, page_size=PS, heads=H,
+                                 head_dim=D)
+        wl = FlashDecodeWorkload(alloc, batch_buckets=(1,),
+                                 page_buckets=(2,),
+                                 prefix_cache=prefix_cache)
+        eng = ServingEngine(wl, name=name)
+        eng.warmup()
+        return eng
+
+    def drive(eng, n, label):
+        rng = np.random.default_rng(11)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(context_tokens=len(shared),
+                           prompt_tokens=list(shared), new_tokens=1,
+                           seed=int(rng.integers(1 << 30)))
+                for _ in range(n)]
+        eng.run()
+        wall = time.perf_counter() - t0
+        bad = [r.req_id for r in reqs if r.outcome != "result"]
+        if bad:
+            raise BenchError(f"serve_prefill_smoke[{label}]: {len(bad)} "
+                             f"request(s) did not retire as result: "
+                             f"{bad[:8]}")
+        if eng.workload.allocator.in_use:
+            raise BenchError(
+                f"serve_prefill_smoke[{label}]: leaked KV slabs "
+                f"({eng.workload.allocator.leak_check()})")
+        return wall
+
+    def run():
+        # cold: every request pays the full chunked prefill
+        eng_cold = build_engine(False, "prefill-cold")
+        wall_cold = drive(eng_cold, requests, "cold")
+        # warm: a fresh hermetic prefix tier, seeded by ONE request
+        cache = PrefixKVCache(
+            root=tempfile.mkdtemp(prefix="tltpu-prefix-smoke-"),
+            page_budget=4 * shared_pages)
+        eng_warm = build_engine(cache, "prefill-warm")
+        drive(eng_warm, 1, "seed")            # the fleet's first tenant
+        walls = [drive(eng_warm, requests, "warm") for _ in range(2)]
+        wall_warm = min(walls)
+        mad = max(abs(walls[0] - walls[1]) / 2, 1e-6)
+        stats = cache.stats()
+        if stats["hits"] < requests:
+            raise BenchError(
+                f"serve_prefill_smoke: expected >= {requests} prefix "
+                f"hits, got {stats['hits']}")
+        speedup = wall_cold / wall_warm
+        return {
+            "value": round(speedup, 4),
+            "unit": "x warm-prefix speedup",
+            # >= 2 is the serve-lifecycle acceptance gate
+            "vs_baseline": round(speedup, 4),
+            "latency_ms": round(wall_warm / requests * 1e3, 4),
+            "baseline_ms": round(wall_cold / requests * 1e3, 4),
+            "latency_p50_ms": round(wall_warm / requests * 1e3, 4),
+            "latency_p90_ms": round(max(walls) / requests * 1e3, 4),
+            "latency_p99_ms": round(max(walls) / requests * 1e3, 4),
+            "latency_mad_ms": round(mad / requests * 1e3, 5),
+            "latency_samples": len(walls),
+            "reps": requests,
+            "baseline_mad_ms": round(mad / requests * 1e3, 5),
+            "requests": requests,
+            "shared_prompt_tokens": len(shared),
+            "prefix_hits": stats["hits"],
+            "prefix_bytes_saved": stats["bytes_saved"],
+            "prefill_ms_per_request_cold": round(
+                wall_cold / requests * 1e3, 4),
+            "restore_ms_per_request_warm": round(
+                wall_warm / requests * 1e3, 4),
+        }
+
+    return dict(metric=f"full-lifecycle serving smoke: {requests} "
+                       f"requests sharing a {shared_pages * PS}-token "
+                       f"system prompt (warm prefix restore vs cold "
+                       f"chunked prefill)",
+                custom_run=run)
+
+
 def cfg_flash(D, S=2048, B=2, H=16, causal=True):
     import jax.numpy as jnp
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -1736,6 +1840,7 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # instead of producing an empty artifact.
 CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
                     "vmem_repack_smoke", "autotune_smoke",
+                    "serve_prefill_smoke",
                     "mesh_allreduce_smoke",
                     "serve_smoke", "mesh_serve_smoke")
 
@@ -1791,6 +1896,7 @@ def _config_builders(q: bool):
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("serve_smoke", lambda: cfg_serve_smoke()),
         ("mesh_serve_smoke", lambda: cfg_mesh_serve_smoke()),
+        ("serve_prefill_smoke", lambda: cfg_serve_prefill_smoke()),
         ("gemm_quickstart", lambda: cfg_gemm(1024, 1024, 1024)),
         ("gemm_large", lambda: cfg_gemm(*(2048, 2048, 2048) if q
                                         else (8192, 8192, 4096))),

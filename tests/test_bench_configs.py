@@ -109,6 +109,23 @@ def test_mesh_serve_smoke_config():
     assert rec["layout_ladder"][-1] == "no_sharding"
 
 
+def test_serve_prefill_smoke_config():
+    """The full-lifecycle prefix smoke: every warm request must hit
+    the prefix cache and the record must carry the warm-vs-cold
+    speedup the serve-lifecycle CI gate reads (docs/serving.md
+    "Full-lifecycle serving"). Tiny shapes: mechanics only — the >= 2x
+    gate runs at the real shape in CI."""
+    import bench
+    rec = _run("serve_prefill_smoke",
+               lambda: bench.cfg_serve_prefill_smoke(requests=4,
+                                                     shared_pages=8))
+    assert rec["unit"] == "x warm-prefix speedup"
+    assert rec["requests"] == 4
+    assert rec["prefix_hits"] >= 2 * 4       # two timed warm rounds
+    assert rec["prefix_bytes_saved"] > 0
+    assert rec["shared_prompt_tokens"] == 8 * 16
+
+
 def test_cpu_safe_configs_declared():
     """Probe-once skip logic keys off CPU_SAFE_CONFIGS: both smoke
     configs must be declared CPU-safe and excluded from the default

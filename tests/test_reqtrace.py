@@ -242,10 +242,13 @@ def test_batch_step_links_member_traces(monkeypatch):
 
 
 @pytest.mark.slow
-def test_causal_completeness_on_500_request_soak(tmp_path):
+def test_causal_completeness_on_500_request_soak(tmp_path, monkeypatch):
     """The ISSUE 13 acceptance gate, run exactly as CI runs it: the
     500-request chaos soak at DEFAULTS (flight recorder on,
     TL_TPU_TRACE off) must exit 0 with every tl-scope check green."""
+    # the driver sandboxes the prefix tier via os.environ (fine as a
+    # CLI); monkeypatch registers the var for restoration in-process
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path))
     from tilelang_mesh_tpu.verify import chaos
     rc = chaos.run_serve(tmp_path, seed=13, n_requests=500)
     assert rc == 0

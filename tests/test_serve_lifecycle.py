@@ -1,0 +1,696 @@
+"""Full-lifecycle serving suite (docs/serving.md "Full-lifecycle
+serving"): chunked prefill, the content-addressed prefix KV cache,
+streaming, cancellation, and temperature/top-p sampling.
+
+Five layers:
+
+1. **Sampling** — greedy/temperature/top-p semantics, determinism
+   under a seeded rng, numerical safety of the softmax.
+2. **Chunked prefill** — ingest fills exactly one chunk, the engine
+   interleaves the remaining chunk units with decode steps (a short
+   request completes while a long prompt is still mid-prefill), and
+   deadline feasibility folds the chunk count in.
+3. **Prefix cache** — restored-prefix decode is BITWISE equal to
+   cold-prefill decode (sampled tokens included), corruption
+   quarantines via the checksum and the ``cache.disk.read`` fault
+   site, eviction respects the page budget, and the fleet disk tier
+   warm-starts a second process-alike cache instance.
+4. **Streaming + cancellation** — token-at-a-time yield with TTFT
+   recorded, early close cancels, and cancellation anywhere in the
+   lifecycle (mid-prefill included) frees every KV page.
+5. **Surfaces** — metrics_summary / SLO windows / analyzer rows, and
+   the offline bucket sweep tool publishing configs serving
+   ``warmup()`` adopts.
+"""
+
+import numpy as np
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.serving import (FlashDecodeWorkload, OUTCOMES,
+                                       PagedKVAllocator, PrefixKVCache,
+                                       Request, ServingEngine,
+                                       default_prompt, sample_token)
+
+H, D, PS = 2, 64, 8
+
+
+def make_engine(tmp_path=None, n_pages=128, batch_buckets=(4,),
+                page_buckets=(2,), prefix=False, **kw):
+    """Engine over a fresh allocator; ``prefix`` is False (off), True
+    (fresh tmp-rooted cache), or an explicit PrefixKVCache."""
+    alloc = PagedKVAllocator(n_pages=n_pages, page_size=PS, heads=H,
+                             head_dim=D)
+    if prefix is True:
+        prefix = PrefixKVCache(root=tmp_path / "prefix",
+                               page_budget=256)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=batch_buckets,
+                             page_buckets=page_buckets,
+                             prefix_cache=prefix or False)
+    return ServingEngine(wl, **kw), alloc
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+def test_greedy_sampling_is_argmax():
+    logits = np.asarray([0.1, 3.0, -1.0, 2.9])
+    assert sample_token(logits, temperature=0.0) == 1
+    assert sample_token(logits) == 1                 # default = greedy
+
+
+def test_temperature_sampling_seeded_deterministic():
+    logits = np.asarray([1.0, 1.1, 0.9, 1.05])
+    a = [sample_token(logits, temperature=0.8,
+                      rng=np.random.default_rng(7)) for _ in range(5)]
+    b = [sample_token(logits, temperature=0.8,
+                      rng=np.random.default_rng(7)) for _ in range(5)]
+    assert a == b
+    # high temperature spreads mass: many draws hit several tokens
+    rng = np.random.default_rng(3)
+    seen = {sample_token(logits, temperature=5.0, rng=rng)
+            for _ in range(200)}
+    assert len(seen) > 1
+
+
+def test_top_p_truncates_the_tail():
+    # one dominant token (~0.73 mass): top_p=0.5 keeps ONLY it
+    logits = np.asarray([4.0, 2.0, 1.0, 0.0])
+    rng = np.random.default_rng(11)
+    draws = {sample_token(logits, temperature=1.0, top_p=0.5, rng=rng)
+             for _ in range(100)}
+    assert draws == {0}
+    # top_p=1.0 keeps the full distribution
+    rng = np.random.default_rng(11)
+    draws = {sample_token(logits, temperature=1.0, top_p=1.0, rng=rng)
+             for _ in range(300)}
+    assert len(draws) > 1
+
+
+def test_sampling_rejects_bad_knobs():
+    with pytest.raises(ValueError):
+        sample_token(np.asarray([1.0]), top_p=0.0)
+    with pytest.raises(ValueError):
+        sample_token(np.asarray([]), temperature=0.0)
+    with pytest.raises(ValueError):
+        Request(context_tokens=16, top_p=1.5)
+
+
+def test_softmax_underflow_is_safe():
+    from tilelang_mesh_tpu.serving.sampling import softmax
+    p = softmax(np.asarray([-1e30, -1e30]))
+    assert np.isfinite(p).all() and p.sum() == pytest.approx(1.0)
+
+
+def test_request_prompt_defaults_and_validation():
+    r = Request(context_tokens=16, seed=9)
+    assert r.prompt_tokens == default_prompt(9, 16)
+    assert Request(context_tokens=16, seed=9).prompt_tokens == \
+        r.prompt_tokens                       # deterministic per seed
+    with pytest.raises(ValueError):
+        Request(context_tokens=16, prompt_tokens=[1, 2, 3])
+    assert "canceled" in OUTCOMES
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill
+# ---------------------------------------------------------------------------
+
+def test_short_prompt_ingests_fully_at_submit():
+    eng, alloc = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    assert not r.needs_prefill and len(r.pages) == 2
+    eng.run()
+    assert r.outcome == "result" and alloc.in_use == 0
+
+
+def test_long_prompt_fills_one_chunk_at_submit(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    eng, alloc = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=64, new_tokens=1, seed=2)
+    assert r.needs_prefill and r.prefill_pos == 16
+    assert len(r.pages) == 2                 # only the chunk's pages
+    eng.run()
+    assert r.outcome == "result"
+    assert r.prefill_pos == 64
+    # all 8 context pages were allocated chunk by chunk (retire()
+    # already returned them; new_tokens=1 appends no KV)
+    assert alloc.alloc_count == 8
+    assert alloc.in_use == 0
+
+
+def test_prefill_interleaves_with_decode(monkeypatch):
+    """The tentpole scheduling property: a short request decodes to
+    completion while a long prompt is still mid-prefill — chunk units
+    never stall the decode path."""
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_PER_STEP", "1")
+    eng, alloc = make_engine(n_pages=256)
+    eng.warmup()
+    long = eng.submit(context_tokens=160, new_tokens=1, seed=1)
+    short = eng.submit(context_tokens=16, new_tokens=1, seed=2)
+    assert long.needs_prefill
+    assert eng.step()          # one chunk of long + short's decode
+    assert short.outcome == "result"
+    assert long.needs_prefill and not long.is_terminal
+    eng.run()
+    assert long.outcome == "result"
+    assert alloc.in_use == 0
+    # the long prompt's chain shows its prefill chunks
+    names = [sp.name for sp in long.trace.spans]
+    assert names.count("prefill.chunk") >= 2
+
+
+def test_prefill_chunk_spans_close_cleanly(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    eng, _ = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=48, new_tokens=1, seed=3)
+    eng.run()
+    assert r.outcome == "result" and r.trace.complete
+
+
+def test_prefill_kv_fault_sheds_terminally(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "8")
+    eng, alloc = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=64, new_tokens=1, seed=4)
+    assert r.needs_prefill
+    with inject("serve.kv", kind="transient"):
+        eng.run()
+    assert r.outcome == "shed" and r.shed_reason == "kv_exhausted"
+    assert alloc.in_use == 0
+
+
+def test_deadline_feasibility_counts_prefill_chunks(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "8")
+    eng, _ = make_engine(n_pages=512, page_buckets=(2,))
+    eng.warmup()       # seeds the observed p50 the estimate uses
+    from tilelang_mesh_tpu.serving.admission import observed_step_ms
+    p50 = observed_step_ms(0.50)
+    assert p50 > 0
+    # a prompt needing ~60 chunk units with a deadline worth ~2 steps:
+    # infeasible BECAUSE of the chunk count
+    r = eng.submit(context_tokens=480, new_tokens=1,
+                   deadline_ms=2 * p50)
+    assert r.outcome == "shed"
+    assert r.shed_reason == "deadline_infeasible"
+
+
+def test_write_span_bounds():
+    a = PagedKVAllocator(n_pages=2, page_size=PS, heads=H, head_dim=D)
+    page = a.alloc(1, owner=1)[0]
+    k = np.ones((H, 3, D), np.float32)
+    a.write_span(page, 2, k, 2 * k)
+    row = a.row0(page) + 2
+    assert float(a.kp[0, row + 2, 0]) == 1.0
+    assert float(a.vp[1, row, -1]) == 2.0
+    with pytest.raises(IndexError):
+        a.write_span(page, PS - 2, k, k)
+    a.free(1)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+
+def _prompt(n, seed=23):
+    return [int(t) for t in
+            np.random.default_rng(seed).integers(0, 1 << 20, size=n)]
+
+
+def test_restored_prefix_decode_bitwise_equals_cold(tmp_path):
+    """The satellite correctness gate: a warm-prefix request's decode
+    outputs AND sampled tokens are bit-identical to the cold-prefill
+    run of the same request."""
+    cache = PrefixKVCache(root=tmp_path / "prefix", page_budget=64)
+    prompt = _prompt(32)                       # 4 whole pages
+    eng1, alloc1 = make_engine(prefix=cache)
+    eng1.warmup()
+    r1 = eng1.submit(context_tokens=32, prompt_tokens=prompt,
+                     new_tokens=2, seed=5)
+    eng1.run()
+    assert r1.outcome == "result" and cache.stats()["inserts"] == 1
+    # a FRESH engine/allocator sharing the cache: same request replays
+    eng2, alloc2 = make_engine(prefix=cache)
+    eng2.warmup()
+    r2 = eng2.submit(context_tokens=32, prompt_tokens=prompt,
+                     new_tokens=2, seed=5)
+    assert r2.prefix_tokens == 32 and not r2.needs_prefill
+    eng2.run()
+    assert r2.outcome == "result"
+    assert cache.stats()["hits"] >= 1
+    assert np.array_equal(np.asarray(r1.result), np.asarray(r2.result))
+    assert r1.generated == r2.generated
+    assert alloc1.in_use == 0 and alloc2.in_use == 0
+
+
+def test_partial_prefix_hit_is_bitwise_correct(tmp_path):
+    """A shared prefix + unique suffix: the prefix restores, the
+    suffix prefills cold, and the result equals the fully-cold run."""
+    cache = PrefixKVCache(root=tmp_path / "prefix", page_budget=64)
+    shared = _prompt(32)                       # 4 pages
+    suffix = _prompt(8, seed=77)
+    prompt = shared + suffix                   # 5 pages
+    # seed the cache with the 4-page shared prefix
+    eng0, _ = make_engine(prefix=cache)
+    eng0.warmup()
+    eng0.submit(context_tokens=32, prompt_tokens=shared, seed=1)
+    eng0.run()
+    # warm: restores 4 pages, prefills 1
+    engw, _ = make_engine(prefix=cache)
+    engw.warmup()
+    rw = engw.submit(context_tokens=40, prompt_tokens=prompt,
+                     new_tokens=1, seed=9)
+    assert rw.prefix_tokens == 32
+    engw.run()
+    # cold reference: prefix cache off entirely
+    engc, _ = make_engine(prefix=False)
+    engc.warmup()
+    rc = engc.submit(context_tokens=40, prompt_tokens=prompt,
+                     new_tokens=1, seed=9)
+    assert rc.prefix_tokens == 0
+    engc.run()
+    assert rw.outcome == rc.outcome == "result"
+    assert np.array_equal(np.asarray(rw.result), np.asarray(rc.result))
+    assert rw.generated == rc.generated
+
+
+def test_prefix_entry_roundtrip_and_lookup_longest(tmp_path):
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    geom = "T:v1:h2:d64:ps8:float32"
+    pages2 = [(np.full((H, PS, D), i, np.float32),
+               np.full((H, PS, D), -i, np.float32)) for i in range(2)]
+    toks = _prompt(24)
+    cache.insert(geom, toks[:16], pages2, PS, H, D, "float32")
+    # longest whole-page prefix of the 24-token prompt is the 2-page
+    # entry (3 pages probed first, misses, then hits 2)
+    ent = cache.lookup(geom, toks, PS)
+    assert ent is not None and ent.n_pages == 2
+    assert cache.lookup(geom, _prompt(16, seed=99), PS) is None
+    s = cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 1 and s["entries"] == 1
+
+
+def test_corrupted_disk_entry_quarantines(tmp_path):
+    """Checksum rejection: flipped bytes on disk -> quarantined +
+    miss, never served (the satellite gate)."""
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    geom = "g"
+    toks = _prompt(8)
+    pages = [(np.ones((H, PS, D), np.float32),
+              np.zeros((H, PS, D), np.float32))]
+    ent = cache.insert(geom, toks, pages, PS, H, D, "float32")
+    assert cache.flush() == 1          # force the deferred publication
+    path = cache.root / f"{ent.key}.json"
+    assert path.is_file()
+    # corrupt the payload on disk, then drop the memory tier the way
+    # a fresh fleet member would start
+    import json as _json
+    doc = _json.loads(path.read_text())
+    doc["pages"][0]["k"] = doc["pages"][0]["v"]     # swapped payload
+    path.write_text(_json.dumps(doc))
+    fresh = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    assert fresh.lookup(geom, toks, PS) is None
+    assert fresh.stats()["quarantined"] == 1
+    assert not path.exists()
+    q = list((cache.root / ".quarantine").iterdir())
+    assert len(q) == 1                    # evidence preserved
+
+
+def test_disk_read_fault_site_quarantines(tmp_path):
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    geom = "g"
+    toks = _prompt(8)
+    pages = [(np.ones((H, PS, D), np.float32),
+              np.zeros((H, PS, D), np.float32))]
+    cache.insert(geom, toks, pages, PS, H, D, "float32")
+    cache.flush()
+    fresh = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    with inject("cache.disk.read", kind="oserror"):
+        assert fresh.lookup(geom, toks, PS) is None
+    assert fresh.stats()["quarantined"] == 1
+
+
+def test_corrupt_memory_entry_rejected_at_restore(tmp_path):
+    """Bit rot between insert and restore: the allocator's checksum
+    verification rejects the snapshot, the entry is dropped, and the
+    request falls back to a (correct) cold prefill."""
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    prompt = _prompt(16)
+    eng0, _ = make_engine(prefix=cache)
+    eng0.warmup()
+    eng0.submit(context_tokens=16, prompt_tokens=prompt, seed=1)
+    eng0.run()
+    ent = cache.lookup("FlashDecodeWorkload:v1:h2:d64:ps8:float32",
+                       prompt, PS)
+    assert ent is not None
+    ent.pages[0][0][0, 0, 0] += 1.0           # flip a value in place
+    eng1, alloc1 = make_engine(prefix=cache)
+    eng1.warmup()
+    r = eng1.submit(context_tokens=16, prompt_tokens=prompt,
+                    new_tokens=1, seed=5)
+    assert r.prefix_tokens == 0               # fell back to cold
+    eng1.run()
+    assert r.outcome == "result" and alloc1.in_use == 0
+    s = cache.stats()
+    assert s["quarantined"] == 1
+    # the cold prefill re-inserted a CLEAN entry under the same key
+    # (never-rebuild-in-place: quarantine first, fresh insert after)
+    assert s["inserts"] == 2
+    ent2 = cache.lookup("FlashDecodeWorkload:v1:h2:d64:ps8:float32",
+                        prompt, PS)
+    assert ent2 is not None
+    from tilelang_mesh_tpu.serving.prefix_cache import _entry_checksum
+    got, _ = _entry_checksum(ent2.pages)
+    assert got == ent2.checksum               # the fresh entry is clean
+
+
+def test_eviction_respects_page_budget(tmp_path):
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=4)
+    geom = "g"
+    for i in range(3):
+        pages = [(np.full((H, PS, D), i, np.float32),) * 2] * 2
+        pages = [(k.copy(), v.copy()) for k, v in pages]
+        cache.insert(geom, _prompt(16, seed=i), pages, PS, H, D,
+                     "float32")
+    s = cache.stats()
+    assert s["evictions"] >= 1 and s["pages"] <= 4
+    # survivors publish on flush; evicted entries left no file behind
+    cache.flush()
+    assert len(list(cache.root.glob("*.json"))) == s["entries"]
+
+
+def test_disk_publication_deferred_to_first_reuse(tmp_path):
+    """Single-use prompts never pay disk serialization on the serving
+    path; the first REUSE publishes the entry, and a fresh
+    process-alike cache instance then hits it from the fleet tier."""
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    prompt = _prompt(16)
+    eng0, _ = make_engine(prefix=cache)
+    eng0.warmup()
+    eng0.submit(context_tokens=16, prompt_tokens=prompt, seed=1)
+    eng0.run()
+    assert cache.stats()["inserts"] == 1
+    assert list(cache.root.glob("*.json")) == []    # not published yet
+    # first reuse: memory hit -> the entry earns its disk file
+    eng1, _ = make_engine(prefix=cache)
+    eng1.warmup()
+    r = eng1.submit(context_tokens=16, prompt_tokens=prompt, seed=2)
+    assert r.prefix_tokens == 16
+    eng1.run()
+    assert len(list(cache.root.glob("*.json"))) == 1
+    # a fresh cache instance (new process in the fleet) hits from disk
+    fresh = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    ent = fresh.lookup("FlashDecodeWorkload:v1:h2:d64:ps8:float32",
+                       prompt, PS)
+    assert ent is not None and ent.n_tokens == 16
+
+
+def test_insert_dedups_by_content_address(tmp_path):
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    prompt = _prompt(16)
+    for _ in range(2):
+        eng, _ = make_engine(prefix=cache)
+        eng.warmup()
+        eng.submit(context_tokens=16, prompt_tokens=prompt,
+                   seed=1)
+        eng.run()
+    assert cache.stats()["inserts"] == 1      # second run hit, no dup
+
+
+def test_env_gated_process_cache(tmp_path, monkeypatch):
+    from tilelang_mesh_tpu.serving import (get_prefix_cache,
+                                           reset_prefix_cache)
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "pp"))
+    reset_prefix_cache()
+    try:
+        monkeypatch.setenv("TL_TPU_SERVE_PREFIX", "0")
+        alloc = PagedKVAllocator(n_pages=16, page_size=PS, heads=H,
+                                 head_dim=D)
+        wl = FlashDecodeWorkload(alloc)
+        assert wl.prefix_cache is None
+        monkeypatch.setenv("TL_TPU_SERVE_PREFIX", "1")
+        wl2 = FlashDecodeWorkload(alloc)
+        assert wl2.prefix_cache is get_prefix_cache()
+        assert wl2.prefix_cache.root == tmp_path / "pp"
+    finally:
+        reset_prefix_cache()
+
+
+# ---------------------------------------------------------------------------
+# streaming + cancellation
+# ---------------------------------------------------------------------------
+
+def test_stream_yields_tokens_and_records_ttft():
+    before = obs.metrics_summary()["serving"]["ttft"]
+    eng, alloc = make_engine()
+    eng.warmup()
+    stream = eng.stream(context_tokens=16, new_tokens=3, seed=7)
+    events = list(stream)
+    r = stream.request
+    assert r.outcome == "result"
+    assert [e["index"] for e in events] == [1, 2, 3]
+    assert [e["token"] for e in events] == r.generated
+    assert r.first_token_t is not None
+    after = obs.metrics_summary()["serving"]["ttft"]
+    assert (after or {}).get("count", 0) > (before or {}).get("count", 0)
+    assert alloc.in_use == 0
+
+
+def test_stream_early_close_cancels_and_frees():
+    eng, alloc = make_engine()
+    eng.warmup()
+    stream = eng.stream(context_tokens=16, new_tokens=8, seed=7)
+    it = iter(stream)
+    first = next(it)
+    assert first["index"] == 1
+    it.close()                               # client disconnect
+    r = stream.request
+    assert r.outcome == "canceled"
+    assert alloc.in_use == 0 and alloc.leak_check() == {}
+    assert r.trace.complete
+
+
+def test_cancel_mid_prefill_leaks_zero_pages(monkeypatch):
+    """The satellite leak gate: cancellation while the prompt is still
+    filling frees every partially-allocated page."""
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    eng, alloc = make_engine(n_pages=256)
+    eng.warmup()
+    r = eng.submit(context_tokens=160, new_tokens=1, seed=1)
+    eng.step()                               # a couple of chunks in
+    assert r.needs_prefill and len(r.pages) > 0 and alloc.in_use > 0
+    assert eng.cancel(r)
+    assert r.outcome == "canceled"
+    assert alloc.in_use == 0 and alloc.leak_check() == {}
+    assert r.trace.complete
+    # cancel of a terminal request is a no-op
+    assert not eng.cancel(r)
+    s = eng.stats()
+    assert s["outcomes"]["canceled"] == 1
+
+
+def test_cancel_mid_decode_discards_remaining_steps():
+    eng, alloc = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=16, new_tokens=5, seed=2)
+    eng.step()
+    assert r.steps_done == 1 and not r.is_terminal
+    eng.cancel(r)
+    assert r.outcome == "canceled" and r.steps_done == 1
+    assert alloc.in_use == 0
+    assert obs.metrics_summary()["serving"]["canceled"] >= 1
+
+
+def test_canceled_requests_count_in_accounting():
+    eng, _ = make_engine()
+    eng.warmup()
+    keep = eng.submit(context_tokens=16, new_tokens=1, seed=1)
+    drop = eng.submit(context_tokens=16, new_tokens=4, seed=2)
+    eng.cancel(drop)
+    eng.run()
+    out = eng.outcomes()
+    assert out["result"] == 1 and out["canceled"] == 1
+    assert keep.outcome == "result" and drop.outcome == "canceled"
+
+
+# ---------------------------------------------------------------------------
+# surfaces: metrics, SLO windows, analyzer
+# ---------------------------------------------------------------------------
+
+def test_metrics_summary_lifecycle_sections(tmp_path, monkeypatch):
+    obs.reset()
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    prompt = _prompt(32)
+    for seed in (1, 2):
+        eng, _ = make_engine(prefix=cache)
+        eng.warmup()
+        eng.submit(context_tokens=32, prompt_tokens=prompt, seed=seed)
+        eng.run()
+    s = obs.metrics_summary()["serving"]
+    # the cold request scheduled one chunk past ingest's synchronous
+    # first chunk; the warm request restored everything (zero chunks)
+    assert s["prefill_chunks"] >= 1 and s["prefill_tokens"] >= 16
+    assert s["ttft"] and s["ttft"]["count"] == 2
+    pc = s["prefix_cache"]
+    assert pc["hits"] == 1 and pc["inserts"] == 1
+    assert pc["bytes_saved"] > 0
+    assert "canceled" in s
+
+
+def test_slo_windows_report_ttft_and_prefix_hit_rate():
+    from tilelang_mesh_tpu.observability.histogram import Histogram
+    from tilelang_mesh_tpu.observability.slo import SLOEngine
+    slo = SLOEngine(windows=[10.0], target=0.999)
+    h0 = Histogram()
+    t0 = Histogram()
+    t0.observe(0.050)
+    base = {"t": 100.0, "submitted": 10.0, "shed": 0.0,
+            "completed": 10.0, "failed": 0.0, "deadline_exceeded": 0.0,
+            "hist": h0, "ttft_hist": t0, "prefix_hits": 2.0,
+            "prefix_misses": 2.0}
+    t1 = Histogram()
+    t1.merge(t0)
+    t1.observe(0.080)
+    cur = dict(base, t=105.0, submitted=20.0, ttft_hist=t1,
+               prefix_hits=8.0, prefix_misses=4.0)
+    slo.add(base)
+    slo.add(cur)
+    w = slo.window_stats(10.0)
+    assert w["ttft_p99_ms"] is not None and w["ttft_p99_ms"] > 0
+    assert w["prefix_hit_rate"] == pytest.approx(6 / 8)
+    # legacy synthetic samples without the new keys stay valid
+    slo2 = SLOEngine(windows=[10.0])
+    slo2.add({"t": 1.0, "submitted": 1.0, "shed": 0.0, "completed": 0.0,
+              "failed": 0.0, "deadline_exceeded": 0.0, "hist": None})
+    slo2.add({"t": 5.0, "submitted": 2.0, "shed": 0.0, "completed": 1.0,
+              "failed": 0.0, "deadline_exceeded": 0.0, "hist": None})
+    w2 = slo2.window_stats(10.0)
+    assert w2["ttft_p99_ms"] is None and w2["prefix_hit_rate"] is None
+
+
+def test_analyzer_serve_report_lifecycle_rows(tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    obs.reset()
+    cache = PrefixKVCache(root=tmp_path / "p", page_budget=64)
+    prompt = _prompt(32)
+    for seed in (1, 2):
+        eng, _ = make_engine(prefix=cache)
+        eng.warmup()
+        eng.submit(context_tokens=32, prompt_tokens=prompt, seed=seed)
+        drop = eng.submit(context_tokens=16, new_tokens=4, seed=9)
+        eng.cancel(drop)
+        eng.run()
+    p = tmp_path / "serve.jsonl"
+    obs.write_jsonl(str(p))
+    from tilelang_mesh_tpu.tools.analyzer import (format_serve_report,
+                                                  summarize_serve)
+    recs = obs.read_jsonl(str(p))
+    s = summarize_serve(recs)
+    assert s["canceled"] == 2
+    assert s["prefill_chunks"] >= 1
+    # the shared prompt hit once; the second canceled request's
+    # identical (seed, ctx) default prompt hit too
+    assert s["prefix_cache"]["hits"] >= 1
+    text = format_serve_report(recs)
+    assert "canceled" in text and "prefix cache" in text
+    assert "serve.ttft" in text
+
+
+def test_prefill_chunk_spans_visible_in_request_timeline(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setenv("TL_TPU_TRACE", "1")
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    obs.reset()
+    eng, _ = make_engine()
+    eng.warmup()
+    r = eng.submit(context_tokens=48, new_tokens=1, seed=3)
+    eng.run()
+    p = tmp_path / "t.jsonl"
+    obs.write_jsonl(str(p))
+    from tilelang_mesh_tpu.tools.analyzer import format_request_report
+    text = format_request_report(obs.read_jsonl(str(p)), r.trace_id)
+    assert "prefill.chunk" in text
+
+
+# ---------------------------------------------------------------------------
+# offline bucket sweep -> fleet tune cache -> warmup adoption
+# ---------------------------------------------------------------------------
+
+def test_serve_sweep_publishes_and_warmup_adopts(tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_TUNE_CACHE_DIR", str(tmp_path / "tune"))
+    from tilelang_mesh_tpu.tools.serve_sweep import sweep_workload
+    alloc = PagedKVAllocator(n_pages=32, page_size=PS, heads=H,
+                             head_dim=D)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=(1,),
+                             page_buckets=(2,), prefix_cache=False)
+    results = sweep_workload(wl, reps=1)
+    assert len(results) == 1
+    r = results[0]
+    assert r["key"] and r["best_config"]["n_split"] in (1, 2)
+    assert len(r["trials"]) == 2              # divisors of 2
+    # a FRESH workload (fresh process-alike) adopts the swept config
+    # with zero measurements at warmup
+    before = obs.metrics_summary()["counters"].get(
+        "serve.warmup.tuned", 0)
+    alloc2 = PagedKVAllocator(n_pages=32, page_size=PS, heads=H,
+                              head_dim=D)
+    wl2 = FlashDecodeWorkload(alloc2, batch_buckets=(1,),
+                              page_buckets=(2,), prefix_cache=False)
+    eng = ServingEngine(wl2)
+    eng.warmup()
+    after = obs.metrics_summary()["counters"].get(
+        "serve.warmup.tuned", 0)
+    assert after == before + 1
+    assert wl2.tuned_config(1, 2) == r["best_config"]
+
+
+def test_serve_sweep_cli_smoke(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TL_TPU_TUNE_CACHE_DIR", str(tmp_path / "tune"))
+    from tilelang_mesh_tpu.tools.serve_sweep import main
+    rc = main(["--batch-buckets", "1", "--page-buckets", "2",
+               "--pages", "16", "--reps", "1", "--json"])
+    assert rc == 0
+    import json as _json
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["results"][0]["best_config"]["n_split"] in (1, 2)
+
+
+# ---------------------------------------------------------------------------
+# the lifecycle soak (the CI gate, in-process)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_lifecycle_soak_all_terminal(tmp_path, monkeypatch):
+    """The serve-lifecycle CI gate run in-process: mixed shared-prompt
+    / long-prompt / decode / stream / cancel traffic with faults armed
+    — 100% terminal, zero leaks, >= 1 prefix hit, prefill interleaved,
+    decode p99 within budget (verify/chaos.py --serve-lifecycle)."""
+    obs.reset()
+    # the chaos driver mutates os.environ for its own process (fine
+    # from the CLI); in-process, monkeypatch pins + restores the same
+    # knobs so this test cannot leak state into later suites
+    monkeypatch.setenv("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR",
+                       str(tmp_path / "prefix"))
+    from tilelang_mesh_tpu.serving import reset_prefix_cache
+    from tilelang_mesh_tpu.verify.chaos import run_serve_lifecycle
+    try:
+        rc = run_serve_lifecycle(tmp_path, seed=7, n_requests=200)
+    finally:
+        reset_prefix_cache()        # the env-derived root just changed
+    assert rc == 0
+    import json as _json
+    report = _json.loads(
+        (tmp_path / "serve_lifecycle_report.json").read_text())
+    assert all(report["checks"].values())
+    assert report["outcomes"]["pending"] == 0
+    assert report["prefix_cache"]["hits"] >= 1
+    assert report["outcomes"]["canceled"] >= 1

@@ -626,6 +626,9 @@ def test_chaos_soak_500_requests_all_terminal(tmp_path, monkeypatch):
     runs (``verify/chaos.py --serve``)."""
     obs.reset()
     monkeypatch.setenv("TL_TPU_TRACE", "1")
+    # the driver sandboxes the prefix tier via os.environ (fine as a
+    # CLI); monkeypatch registers the var for restoration in-process
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path))
     from tilelang_mesh_tpu.verify.chaos import run_serve
     rc = run_serve(tmp_path, seed=7, n_requests=500)
     assert rc == 0

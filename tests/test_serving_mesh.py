@@ -580,6 +580,9 @@ def test_chaos_serve_mesh_soak(tmp_path, monkeypatch):
     the migration, accounting agreement."""
     obs.reset()
     monkeypatch.setenv("TL_TPU_TRACE", "1")
+    # the driver sandboxes the prefix tier via os.environ (fine as a
+    # CLI); monkeypatch registers the var for restoration in-process
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path))
     from tilelang_mesh_tpu.verify.chaos import run_serve_mesh
     rc = run_serve_mesh(tmp_path, seed=13, n_requests=120)
     assert rc == 0

@@ -712,6 +712,48 @@ def test_watchdog_exempts_fresh_sanitized_variant(monkeypatch):
     assert obs.metrics_summary()["verify"]["watchdog_timeouts"] == 0
 
 
+def test_watchdog_first_call_exemption_survives_polluted_globals(
+        monkeypatch):
+    """Regression guard for the PR 7-era cross-suite flake
+    (test_comm_opt -> test_watchdog_exempts_first_call_compile): the
+    root causes were (a) a scheduling race — a warm dispatch could
+    finish before the parent reached its queue wait, silently passing
+    a blown budget — fixed by enforcing the budget on measured wall
+    time, and (b) process-global state (breaker failures, registry
+    health, fault overrides, warm latency histograms) leaking across
+    suites, fixed by the conftest autouse reset. This test recreates
+    the leaked-state half DELIBERATELY in-process — an open breaker
+    circuit under a foreign signature, an unhealthy backend, an armed
+    fault on an unrelated site, and a pre-warmed latency histogram —
+    and asserts the watchdog's first-call compile exemption still
+    holds under an absurd budget, so neither fix can silently
+    regress."""
+    from tilelang_mesh_tpu.codegen.backends import registry
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    # (a) a breaker circuit opened by a previous suite's failures
+    b = global_breaker()
+    for _ in range(b.threshold):
+        b.record_failure("leaked.signature.from.previous.suite")
+    # (b) a backend marked unhealthy by an earlier device-loss test
+    registry().mark_unhealthy("tpu-pallas",
+                              RuntimeError("worker unreachable"))
+    # (c) warm per-kernel latency histograms (the warm-process shape
+    # of the original flake)
+    _hist.observe("kernel.latency", 0.004, kernel="leaked", source="x")
+    monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "0.001")
+    # (d) a fault armed on an UNRELATED site for the whole scenario
+    with inject("autotune.trial", kind="transient"):
+        k = _compile(_chunk_program, **CHUNK_CFG)
+        a = _shards(13)
+        r1 = np.asarray(k(a))     # compile-heavy first call: exempt
+        assert obs.metrics_summary()["verify"]["watchdog_timeouts"] == 0
+        r2 = np.asarray(k(a))     # warm call: trips, degrades
+        v = obs.metrics_summary()["verify"]
+        assert v["watchdog_timeouts"] == 1
+        assert v["degraded_schedules"] == 1
+        np.testing.assert_allclose(r2, r1, rtol=1e-6, atol=1e-6)
+
+
 def test_watchdog_raises_without_fallback(monkeypatch):
     monkeypatch.setenv("TL_TPU_COMM_TIMEOUT_MS", "60000")
     monkeypatch.setenv("TL_TPU_FALLBACK", "none")

@@ -223,6 +223,33 @@ class Environment:
     # serve.shard.latency histograms + the shard_skew gauge (0 = off)
     TL_TPU_SERVE_SHARD_PROBE_EVERY = EnvVar(
         "TL_TPU_SERVE_SHARD_PROBE_EVERY", 8, int)
+    # full-lifecycle serving (docs/serving.md "Full-lifecycle serving"):
+    # prefill chunking — a prompt fills its KV context in chunks of at
+    # most this many tokens; the first chunk runs synchronously at
+    # ingest (short prompts behave exactly as before), the rest are
+    # schedulable units the engine interleaves with decode steps so a
+    # long prompt can never stall decode p99
+    TL_TPU_SERVE_PREFILL_CHUNK = EnvVar("TL_TPU_SERVE_PREFILL_CHUNK",
+                                        256, int)
+    # prefill chunk units processed per engine step (bounds the prefill
+    # work wedged between two decode dispatches)
+    TL_TPU_SERVE_PREFILL_PER_STEP = EnvVar(
+        "TL_TPU_SERVE_PREFILL_PER_STEP", 2, int)
+    # content-addressed prefix KV cache (serving/prefix_cache.py): "1"
+    # (default) caches whole-page token prefixes as checksummed
+    # KVSnapshot-format pages keyed on the token-prefix hash, so a
+    # shared system prompt is prefilled once fleet-wide; "0" off
+    TL_TPU_SERVE_PREFIX = EnvVar("TL_TPU_SERVE_PREFIX", True, bool)
+    # prefix-cache page budget: total pages the cache may hold before
+    # LRU eviction (memory entry + its disk file evict together)
+    TL_TPU_SERVE_PREFIX_PAGES = EnvVar("TL_TPU_SERVE_PREFIX_PAGES",
+                                       512, int)
+    # prefix-cache root; empty derives <TL_TPU_CACHE_DIR>/prefix so the
+    # crash-safe kernel-cache dir isolation isolates this tier too
+    TL_TPU_SERVE_PREFIX_DIR = EnvVar("TL_TPU_SERVE_PREFIX_DIR", "")
+    # stand-in sampler vocabulary: the decode output is projected onto
+    # this many logits before temperature/top-p sampling
+    TL_TPU_SERVE_VOCAB = EnvVar("TL_TPU_SERVE_VOCAB", 128, int)
     # buffer donation for inout params: warm calls whose inout inputs
     # are jax arrays dispatch through jax.jit(donate_argnums=...), so
     # XLA may reuse the input buffer for the aliased output (the caller
@@ -255,6 +282,12 @@ class Environment:
     def flight_dir(self) -> Path:
         raw = self.TL_TPU_FLIGHT_DIR
         p = Path(raw) if raw else Path(self.TL_TPU_TRACE_DIR) / "flight"
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def prefix_cache_dir(self) -> Path:
+        raw = self.TL_TPU_SERVE_PREFIX_DIR
+        p = Path(raw) if raw else Path(self.TL_TPU_CACHE_DIR) / "prefix"
         p.mkdir(parents=True, exist_ok=True)
         return p
 

@@ -526,6 +526,7 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "completed": c("serve.completed"),
         "failed": c("serve.failed"),
         "deadline_exceeded": c("serve.deadline_exceeded"),
+        "canceled": c("serve.canceled"),
         "shed": sheds,
         "shed_total": sum(sheds.values()),
         "batches": c("serve.batches"),
@@ -535,6 +536,20 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "warmup_kernels": c("serve.warmup.kernels"),
         "kv_pages_allocated": c("serve.kv.alloc_pages"),
         "kv_pages_freed": c("serve.kv.free_pages"),
+        # full-lifecycle serving (docs/serving.md): chunked prefill,
+        # TTFT, and the content-addressed prefix KV cache
+        "prefill_chunks": c("serve.prefill.chunks"),
+        "prefill_tokens": c("serve.prefill.tokens"),
+        "ttft": _hist_digest("serve.ttft"),
+        "prefill_latency": _hist_digest("serve.prefill.latency"),
+        "prefix_cache": {
+            "hits": c("prefix_cache.hit"),
+            "misses": c("prefix_cache.miss"),
+            "bytes_saved": c("prefix_cache.bytes_saved"),
+            "evicted": c("prefix_cache.evicted"),
+            "inserts": c("prefix_cache.insert"),
+            "quarantined": c("prefix_cache.quarantined"),
+        },
         # elastic mesh serving (serving/mesh_workload.py)
         "layout": _serving_meta().get("layout"),
         "reshards": labelled_total("serve.reshard"),

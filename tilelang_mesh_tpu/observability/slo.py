@@ -118,9 +118,28 @@ class SLOEngine:
         if h is not None and h.count:
             hist = _hist.Histogram(h.bounds)
             hist.merge(h)
+        # full-lifecycle signals (docs/serving.md): TTFT histogram
+        # snapshot + prefix-cache hit/miss totals, window-diffed like
+        # the step histogram so /slo reports the LIVE hit rate and
+        # first-token latency, not lifetime averages
+        th = _hist.get_histogram("serve.ttft")
+        ttft = None
+        if th is not None and th.count:
+            ttft = _hist.Histogram(th.bounds)
+            ttft.merge(th)
+        prefix_hits = prefix_misses = 0.0
+        try:
+            from .tracer import get_tracer
+            counters = get_tracer().counters()
+            prefix_hits = counters.get("prefix_cache.hit", 0)
+            prefix_misses = counters.get("prefix_cache.miss", 0)
+        except Exception:  # noqa: BLE001 — a torn snapshot beats a crash
+            pass
         return {"t": t, "submitted": submitted, "shed": shed,
                 "completed": completed, "failed": failed,
-                "deadline_exceeded": deadline, "hist": hist}
+                "deadline_exceeded": deadline, "hist": hist,
+                "ttft_hist": ttft, "prefix_hits": prefix_hits,
+                "prefix_misses": prefix_misses}
 
     def add(self, sample: dict) -> None:
         """Append one sample (tests drive this directly with synthetic
@@ -195,7 +214,8 @@ class SLOEngine:
                     "availability": None, "burn_rate": None,
                     "p99_ms": None,
                     "p99_budget_ms": _p99_budget_ms() or None,
-                    "p99_over_budget": False}
+                    "p99_over_budget": False,
+                    "ttft_p99_ms": None, "prefix_hit_rate": None}
         base = self._edge(samples[:-1], t, window)
         d_sub = max(0.0, cur["submitted"] - base["submitted"])
         d_shed = max(0.0, cur["shed"] - base["shed"])
@@ -211,6 +231,21 @@ class SLOEngine:
             if wh.count:
                 q = wh.quantile(0.99)
                 p99_ms = round(q * 1e3, 4) if q is not None else None
+        # windowed TTFT p99 (same snapshot-delta rule as the step p99)
+        ttft_p99_ms = None
+        cur_t, base_t = cur.get("ttft_hist"), base.get("ttft_hist")
+        if cur_t is not None:
+            wt = cur_t.minus(base_t) if base_t is not None else cur_t
+            if wt.count:
+                q = wt.quantile(0.99)
+                ttft_p99_ms = round(q * 1e3, 4) if q is not None else None
+        # windowed prefix-cache hit rate (None until a lookup landed)
+        d_hit = max(0.0, cur.get("prefix_hits", 0.0)
+                    - base.get("prefix_hits", 0.0))
+        d_miss = max(0.0, cur.get("prefix_misses", 0.0)
+                     - base.get("prefix_misses", 0.0))
+        prefix_hit_rate = (round(d_hit / (d_hit + d_miss), 4)
+                           if d_hit + d_miss else None)
         budget = _p99_budget_ms()
         return {
             "window_s": window,
@@ -226,6 +261,8 @@ class SLOEngine:
             "p99_budget_ms": budget or None,
             "p99_over_budget": (p99_ms is not None and budget > 0
                                 and p99_ms > budget),
+            "ttft_p99_ms": ttft_p99_ms,
+            "prefix_hit_rate": prefix_hit_rate,
         }
 
     def summary(self, now: Optional[float] = None) -> dict:

@@ -12,7 +12,14 @@ Public surface (docs/serving.md)::
     eng.warmup()                       # AOT: no first-request JIT latency
     r = eng.submit(context_tokens=16, new_tokens=2, deadline_ms=500)
     eng.run()                          # every request reaches a terminal
-    assert r.outcome in ("result", "shed", "deadline_exceeded", "failed")
+    assert r.outcome in ("result", "shed", "deadline_exceeded",
+                         "failed", "canceled")
+
+Full lifecycle (docs/serving.md "Full-lifecycle serving"): chunked
+prefill interleaves with decode inside ``step()``; ``eng.stream(...)``
+yields sampled tokens one at a time (closing it cancels);
+``serving/prefix_cache.py`` restores shared whole-page prompt prefixes
+from checksummed cached pages instead of recomputing them.
 
 ``serving_state()`` is the live-gauge snapshot
 ``metrics_summary()["serving"]`` embeds (queue depth, KV slab levels);
@@ -23,24 +30,30 @@ from .admission import (AdmissionController, SERVE_BREAKER_SIG,  # noqa: F401
                         STEP_HIST_KERNEL)
 from .batcher import (DecodeWorkload, FlashDecodeWorkload,  # noqa: F401
                       MLADecodeWorkload)
-from .engine import ServingEngine  # noqa: F401
+from .engine import ServingEngine, TokenStream  # noqa: F401
 from .kv_cache import (KVCacheExhausted, KVSnapshot,  # noqa: F401
                        PagedKVAllocator, migrate)
 from .mesh_workload import (LAYOUT_KINDS, MeshDecodeWorkload,  # noqa: F401
                             MeshLayout, layout_ladder, parse_layout,
                             validate_shard_config)
+from .prefix_cache import (PrefixEntry, PrefixKVCache,  # noqa: F401
+                           get_prefix_cache, reset_prefix_cache)
 from .request import (OUTCOMES, Request, SHED_REASONS, STATES,  # noqa: F401
-                      gauges as serving_state, publish_meta,
-                      reset_gauges, serving_meta)
+                      default_prompt, gauges as serving_state,
+                      publish_meta, reset_gauges, serving_meta)
+from .sampling import sample_token  # noqa: F401
 from .shard import ServeShardConfig, match_partition_rules  # noqa: F401
 
 __all__ = [
-    "ServingEngine", "DecodeWorkload", "FlashDecodeWorkload",
+    "ServingEngine", "TokenStream", "DecodeWorkload",
+    "FlashDecodeWorkload",
     "MLADecodeWorkload", "MeshDecodeWorkload", "MeshLayout",
     "layout_ladder", "parse_layout", "validate_shard_config",
     "LAYOUT_KINDS", "PagedKVAllocator", "KVCacheExhausted", "KVSnapshot",
     "migrate", "AdmissionController", "Request", "STATES", "OUTCOMES",
     "SHED_REASONS", "SERVE_BREAKER_SIG", "STEP_HIST_KERNEL",
     "ServeShardConfig", "match_partition_rules", "serving_state",
-    "serving_meta", "publish_meta", "reset_gauges",
+    "serving_meta", "publish_meta", "reset_gauges", "default_prompt",
+    "PrefixEntry", "PrefixKVCache", "get_prefix_cache",
+    "reset_prefix_cache", "sample_token",
 ]

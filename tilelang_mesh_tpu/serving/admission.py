@@ -83,7 +83,8 @@ class AdmissionController:
     def decide(self, *, draining: bool, queue_depth: int,
                free_pages: int, pages_needed: int,
                remaining_s: Optional[float],
-               steps_requested: int) -> Tuple[bool, Optional[str]]:
+               steps_requested: int,
+               prefill_chunks: int = 0) -> Tuple[bool, Optional[str]]:
         """(admit?, shed reason). Ordered so the cheapest checks run
         first and the reason names the FIRST gate that failed."""
         if draining:
@@ -103,9 +104,14 @@ class AdmissionController:
         if remaining_s is not None:
             # feasibility at the OBSERVED p50: the queue ahead (in
             # batches, optimistically one step each) plus this
-            # request's own steps must fit in deadline + grace
+            # request's own steps AND its worst-case prefill chunk
+            # units (deadline propagation into the chunked-prefill
+            # path — a prompt too long for its deadline sheds at the
+            # door instead of expiring mid-prefill) must fit in
+            # deadline + grace
             p50_s = observed_step_ms(0.50) / 1e3
-            need_s = p50_s * (queue_depth + steps_requested)
+            need_s = p50_s * (queue_depth + steps_requested
+                              + max(0, prefill_chunks - 1))
             if remaining_s + self.grace_ms / 1e3 < need_s or \
                     remaining_s <= 0:
                 return False, "deadline_infeasible"

@@ -29,19 +29,40 @@ crash-safe kernel cache AND through a real dispatch, so the first
 serving request never pays trace/compile latency (the AOT warm store
 from ROADMAP item 1). It also consults the **fleet tune cache**
 (autotuner/tune_cache.py; docs/autotuning.md) for each bucket: a tuned
-kernel config recorded by any fleet member — an offline sweep, another
-serving process, a merged cache dir — is adopted with ZERO measurements
-(the zero-cold-start bucket-config path), and ``record_bucket_tuning``
-is how an offline tuner publishes one.
+kernel config recorded by any fleet member — an offline sweep
+(``tools/serve_sweep.py``), another serving process, a merged cache dir
+— is adopted with ZERO measurements (the zero-cold-start bucket-config
+path), and ``record_bucket_tuning`` is how an offline tuner publishes
+one.
+
+Full-lifecycle additions (docs/serving.md "Full-lifecycle serving"):
+
+- **Chunked prefill** — ``ingest()`` fills at most ONE chunk
+  (``TL_TPU_SERVE_PREFILL_CHUNK`` tokens) of the prompt's KV
+  synchronously; the rest is schedulable work the engine drives via
+  ``prefill_chunk()`` between decode steps, so a long prompt can never
+  stall decode p99. KV content is a pure function of ``(token id,
+  position)`` — the property the prefix cache's content addressing
+  rests on.
+- **Prefix reuse** — ``ingest()`` first asks the
+  :mod:`.prefix_cache` for the longest cached whole-page prefix of the
+  prompt and restores it through the allocator's checksummed
+  ``restore()`` (PR 9's snapshot machinery); a completed prefill
+  publishes its whole-page prefix back.
+- **Sampling** — ``sample()`` projects the decode output onto a logit
+  vector and draws one token id (temperature/top-p,
+  :mod:`.sampling`); the sampled token's KV is what ``append_token``
+  writes, so generated continuations are content-consistent too.
 """
 
 from __future__ import annotations
 
 import math
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..env import env
 from ..observability import tracer as _trace
 from .kv_cache import PagedKVAllocator
 from .request import Request
@@ -50,13 +71,20 @@ __all__ = ["DecodeWorkload", "FlashDecodeWorkload", "MLADecodeWorkload"]
 
 BucketKey = Tuple[int, int]          # (batch bucket, window pages)
 
+# bump when the (token, position) -> KV content derivation changes:
+# part of the prefix-cache geometry key, so stale fleet entries can
+# never restore content a fresh prefill would not have produced
+PREFILL_CONTENT_VERSION = 1
+
 
 class DecodeWorkload:
-    """Common bucketing/warm-up logic; subclasses supply the kernel."""
+    """Common bucketing/warm-up/prefill logic; subclasses supply the
+    kernel and the (token, position) -> KV content derivation."""
 
     def __init__(self, allocator: PagedKVAllocator,
                  batch_buckets: Sequence[int] = (1, 2, 4, 8),
-                 page_buckets: Sequence[int] = (2, 4)):
+                 page_buckets: Sequence[int] = (2, 4),
+                 prefix_cache=None):
         if not batch_buckets or not page_buckets:
             raise ValueError("batch_buckets and page_buckets must be "
                              "non-empty")
@@ -71,6 +99,21 @@ class DecodeWorkload:
         # (batch, pages) bucket -> tuned kernel config adopted from the
         # fleet tune cache at warmup (None = nothing recorded)
         self._tuned: dict = {}
+        # stand-in sampler vocabulary (serving/sampling.py)
+        self.vocab = max(2, env.TL_TPU_SERVE_VOCAB)
+        # content-addressed prefix KV cache: None = the env-gated
+        # process cache (TL_TPU_SERVE_PREFIX), False = disabled, or an
+        # explicit PrefixKVCache instance (tests, benches)
+        if prefix_cache is None:
+            if env.TL_TPU_SERVE_PREFIX:
+                from .prefix_cache import get_prefix_cache
+                self.prefix_cache = get_prefix_cache()
+            else:
+                self.prefix_cache = None
+        elif prefix_cache is False:
+            self.prefix_cache = None
+        else:
+            self.prefix_cache = prefix_cache
 
     # -- bucketing -----------------------------------------------------
     @property
@@ -105,27 +148,140 @@ class DecodeWorkload:
         ps = self.allocator.page_size
         return math.ceil((context_tokens + new_tokens) / ps)
 
-    # -- request ingestion / growth ------------------------------------
+    # -- request ingestion / prefill / growth --------------------------
     def ingest(self, req: Request) -> None:
-        """Allocate + fill the request's context pages (deterministic
-        content from ``req.seed`` unless the payload carries arrays)."""
+        """Admit the request's KV context: restore the longest cached
+        whole-page prefix (a prefix-cache hit converts that many tokens
+        of prefill compute into a checksummed page restore), then fill
+        at most ONE prefill chunk synchronously — a prompt no longer
+        than ``TL_TPU_SERVE_PREFILL_CHUNK`` is fully ingested here
+        (exactly the pre-chunking behavior); a longer one leaves
+        ``req.needs_prefill`` set and the engine drives the remaining
+        chunks between decode steps."""
         ps = self.allocator.page_size
         if req.context_tokens < self.page_buckets[0] * ps:
             raise ValueError(
                 f"request #{req.req_id}: context_tokens="
                 f"{req.context_tokens} is below the smallest page "
                 f"bucket ({self.page_buckets[0]} page(s) x {ps})")
-        n = math.ceil(req.context_tokens / ps)
-        pages = self.allocator.alloc(n, req.req_id)
-        req.pages = pages
-        req.tail_tokens = req.context_tokens % ps
-        rng = np.random.default_rng(req.seed)
-        for i, page in enumerate(pages):
-            k, v = self._context_page(req, rng, i)
-            self.allocator.fill_page(page, k, v)
+        req.pages = []
+        req.tail_tokens = 0
+        req.prefill_pos = 0
+        if self.prefix_cache is not None:
+            ent = self.prefix_cache.lookup(
+                self.prefix_geometry(), req.prompt_tokens, ps)
+            if ent is not None:
+                self._restore_prefix(req, ent)
+        self.prefill_chunk(req)
+
+    def _restore_prefix(self, req: Request, ent) -> None:
+        """Restore a prefix-cache hit through the allocator's
+        checksummed ``restore()`` (undo-logged; byte conservation
+        asserted on the written bytes). A corrupt entry is dropped +
+        quarantined and the request falls back to cold prefill;
+        capacity exhaustion propagates (cold prefill would need the
+        same pages)."""
+        try:
+            mapping = self.allocator.restore(ent.to_snapshot(req.req_id))
+        except ValueError as e:
+            # checksum/geometry rejection: the entry is poison — drop
+            # it so it can never serve anyone, and prefill cold
+            self.prefix_cache.drop(ent.key, reason=f"restore rejected: "
+                                                   f"{e}")
+            return
+        req.pages = [mapping[i] for i in range(ent.n_pages)]
+        req.prefill_pos = ent.n_tokens
+        req.prefix_tokens = ent.n_tokens
+        req.tail_tokens = 0
+        # bytes_saved counts only VALIDATED restores (the checksum +
+        # conservation checks above passed), never lookup hits that
+        # failed validation and fell back to cold prefill
+        self.prefix_cache.note_restored(ent)
+        req.trace.mark("prefix.hit", tokens=ent.n_tokens,
+                       pages=ent.n_pages, bytes=ent.nbytes)
+
+    def prefill_chunk(self, req: Request,
+                      max_tokens: Optional[int] = None) -> int:
+        """Fill up to one chunk of the prompt's KV (allocating pages as
+        the fill crosses page boundaries — the ``serve.kv`` fault site
+        is visited per page, so mid-prefill KV pressure surfaces here).
+        Returns the number of tokens filled; on completion the
+        whole-page prefix is published to the prefix cache and the
+        request becomes decode-eligible."""
+        ps = self.allocator.page_size
+        chunk = int(max_tokens if max_tokens is not None
+                    else env.TL_TPU_SERVE_PREFILL_CHUNK)
+        end = min(req.context_tokens, req.prefill_pos + max(1, chunk))
+        start = req.prefill_pos
+        while req.prefill_pos < end:
+            off = req.prefill_pos % ps
+            if off == 0 and len(req.pages) * ps <= req.prefill_pos:
+                req.pages.extend(self.allocator.alloc(1, req.req_id))
+            n = min(ps - off, end - req.prefill_pos)
+            k, v = self._prompt_block(req, req.prefill_pos, n)
+            self.allocator.write_span(req.pages[req.prefill_pos // ps],
+                                      off, k, v)
+            req.prefill_pos += n
+        req.tail_tokens = req.prefill_pos % ps
+        if not req.needs_prefill:
+            self._publish_prefix(req)
+        return req.prefill_pos - start
+
+    def prefill_chunks_needed(self, context_tokens: int) -> int:
+        """Worst-case chunk units a prompt needs (no prefix hit) — what
+        admission folds into deadline feasibility."""
+        return math.ceil(int(context_tokens)
+                         / max(1, env.TL_TPU_SERVE_PREFILL_CHUNK))
+
+    def _prompt_block(self, req: Request, start: int,
+                      n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(H, n, D) K/V blocks for prompt tokens [start, start+n) —
+        pure in (token id, position), the prefix-cache contract."""
+        al = self.allocator
+        k = np.empty((al.heads, n, al.head_dim), al.dtype)
+        v = np.empty((al.heads, n, al.head_dim), al.dtype)
+        for i in range(n):
+            pos = start + i
+            ki, vi = self._content_kv(req.prompt_tokens[pos], pos)
+            k[:, i, :] = ki
+            v[:, i, :] = vi
+        return k, v
+
+    def _publish_prefix(self, req: Request) -> None:
+        """Insert the prompt's whole-page prefix into the prefix cache
+        (copies — the live pages keep mutating as the request decodes).
+        Skipped when the cache is off, the prompt has no full page, or
+        the full prefix itself came from the cache."""
+        if self.prefix_cache is None:
+            return
+        ps = self.allocator.page_size
+        full = req.context_tokens // ps
+        if full < 1 or req.prefix_tokens >= full * ps:
+            return
+        pages = []
+        for page in req.pages[:full]:
+            r0 = self.allocator.row0(page)
+            pages.append((self.allocator.kp[:, r0:r0 + ps, :].copy(),
+                          self.allocator.vp[:, r0:r0 + ps, :].copy()))
+        try:
+            self.prefix_cache.insert(
+                self.prefix_geometry(), req.prompt_tokens[:full * ps],
+                pages, ps, self.allocator.heads, self.allocator.head_dim,
+                self.allocator.dtype)
+        except Exception:  # noqa: BLE001 — caching is advisory, never
+            pass           # a prefill failure
+
+    def prefix_geometry(self) -> str:
+        """The geometry half of the prefix-cache content address: two
+        workloads whose pools or content derivations differ must never
+        share an entry."""
+        al = self.allocator
+        return (f"{type(self).__name__}:v{PREFILL_CONTENT_VERSION}"
+                f":h{al.heads}:d{al.head_dim}:ps{al.page_size}"
+                f":{al.dtype}")
 
     def append_token(self, req: Request) -> None:
-        """Append the just-generated token's KV in place; allocates a
+        """Append the just-sampled token's KV in place; allocates a
         fresh page exactly when the tail page is full (the mid-flight
         ``serve.kv`` visit the chaos soak arms)."""
         ps = self.allocator.page_size
@@ -135,6 +291,26 @@ class DecodeWorkload:
         k, v = self._token_kv(req)
         self.allocator.write_token(page, req.tail_tokens, k, v)
         req.tail_tokens = (req.tail_tokens + 1) % ps
+
+    # -- sampling ------------------------------------------------------
+    def sample(self, req: Request, out) -> int:
+        """One token id from a decode step's output: project onto the
+        stand-in vocabulary, then temperature/top-p sample with a
+        (seed, step)-derived rng — bit-reproducible, so a restored
+        prefix decodes the identical continuation."""
+        from .sampling import sample_token
+        rng = np.random.default_rng((req.seed, 3, req.steps_done))
+        return sample_token(self._logits(out),
+                            temperature=req.temperature,
+                            top_p=req.top_p, rng=rng)
+
+    def _logits(self, out) -> np.ndarray:
+        """Deterministic projection of the decode output onto ``vocab``
+        logits (the stand-in for an LM head)."""
+        flat = np.asarray(out, np.float32).ravel()
+        if flat.size >= self.vocab:
+            return flat[:self.vocab]
+        return np.resize(flat, self.vocab)
 
     def retire(self, req: Request) -> int:
         """Release every slab the request holds (called on ANY terminal
@@ -151,6 +327,9 @@ class DecodeWorkload:
         per-request outputs."""
         if not requests:
             return []
+        if any(r.needs_prefill for r in requests):
+            raise ValueError("batch contains a mid-prefill request "
+                             "(scheduler bug)")
         pp = self.bucket_of(requests[0])
         if any(self.bucket_of(r) != pp for r in requests):
             raise ValueError("batch mixes page buckets (scheduler bug)")
@@ -295,11 +474,22 @@ class DecodeWorkload:
     def _query(self, req: Request) -> np.ndarray:
         raise NotImplementedError
 
-    def _context_page(self, req: Request, rng, index: int):
+    def _content_kv(self, token: int, pos: int):
+        """One token's ``(k, v)`` pair, each ``(heads, head_dim)`` —
+        MUST be pure in (token, pos): prefix-cache content addressing
+        and the restored-vs-cold bitwise-equality guarantee both rest
+        on this purity."""
         raise NotImplementedError
 
     def _token_kv(self, req: Request):
-        raise NotImplementedError
+        """The just-generated token's KV: content derives from the
+        SAMPLED token id at its absolute position, so generated
+        continuations stay content-consistent with prefill."""
+        pos = req.context_tokens + req.steps_done - 1
+        tok = req.generated[-1] if req.generated else \
+            int(np.random.default_rng((req.seed, 2,
+                                       req.steps_done)).integers(1 << 30))
+        return self._content_kv(tok, pos)
 
     def _dispatch(self, q, table, bb: int, pp: int):
         raise NotImplementedError
@@ -313,8 +503,9 @@ class FlashDecodeWorkload(DecodeWorkload):
     def __init__(self, allocator: PagedKVAllocator, *,
                  batch_buckets: Sequence[int] = (1, 2, 4, 8),
                  page_buckets: Sequence[int] = (2, 4),
-                 sm_scale: float = None):
-        super().__init__(allocator, batch_buckets, page_buckets)
+                 sm_scale: float = None, prefix_cache=None):
+        super().__init__(allocator, batch_buckets, page_buckets,
+                         prefix_cache=prefix_cache)
         self.sm_scale = (sm_scale if sm_scale is not None
                          else 1.0 / math.sqrt(allocator.head_dim))
 
@@ -327,14 +518,8 @@ class FlashDecodeWorkload(DecodeWorkload):
             (self.allocator.heads, 1, self.allocator.head_dim)
         ).astype(np.float32)
 
-    def _context_page(self, req: Request, rng, index: int):
-        shape = (self.allocator.heads, self.allocator.page_size,
-                 self.allocator.head_dim)
-        return (rng.standard_normal(shape).astype(np.float32),
-                rng.standard_normal(shape).astype(np.float32))
-
-    def _token_kv(self, req: Request):
-        rng = np.random.default_rng((req.seed, 2, req.steps_done))
+    def _content_kv(self, token: int, pos: int):
+        rng = np.random.default_rng((int(token) % (1 << 31), int(pos)))
         shape = (self.allocator.heads, self.allocator.head_dim)
         return (rng.standard_normal(shape).astype(np.float32),
                 rng.standard_normal(shape).astype(np.float32))
@@ -373,13 +558,14 @@ class MLADecodeWorkload(DecodeWorkload):
                  latent_dim: int, rope_dim: int,
                  batch_buckets: Sequence[int] = (1, 2, 4),
                  page_buckets: Sequence[int] = (2, 4),
-                 sm_scale: float = None):
+                 sm_scale: float = None, prefix_cache=None):
         if allocator.heads != 1 or \
                 allocator.head_dim != latent_dim + rope_dim:
             raise ValueError(
                 "MLA pools are latent-major: construct the allocator "
                 "with heads=1, head_dim=latent_dim+rope_dim")
-        super().__init__(allocator, batch_buckets, page_buckets)
+        super().__init__(allocator, batch_buckets, page_buckets,
+                         prefix_cache=prefix_cache)
         self.heads = int(heads)
         self.dc = int(latent_dim)
         self.dr = int(rope_dim)
@@ -394,16 +580,11 @@ class MLADecodeWorkload(DecodeWorkload):
         return rng.standard_normal(
             (self.heads, self.dc + self.dr)).astype(np.float32)
 
-    def _context_page(self, req: Request, rng, index: int):
-        shape = (1, self.allocator.page_size, self.dc + self.dr)
-        row = rng.standard_normal(shape).astype(np.float32)
-        return row, np.zeros(shape, np.float32)    # vp unused for MLA
-
-    def _token_kv(self, req: Request):
-        rng = np.random.default_rng((req.seed, 2, req.steps_done))
+    def _content_kv(self, token: int, pos: int):
+        rng = np.random.default_rng((int(token) % (1 << 31), int(pos)))
         shape = (1, self.dc + self.dr)
         return (rng.standard_normal(shape).astype(np.float32),
-                np.zeros(shape, np.float32))
+                np.zeros(shape, np.float32))    # vp unused for MLA
 
     def _dispatch(self, q, table, bb: int, pp: int):
         from ..ops.mla import mla_decode

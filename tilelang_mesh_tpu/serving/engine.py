@@ -23,6 +23,16 @@ the product of this module — is:
    dropped so rebuilds re-walk the ``TL_TPU_BACKENDS`` chain, and
    unexpired requests are re-admitted onto the new tier. ``drain()``
    finishes in-flight work while shedding new arrivals.
+4. **Full lifecycle** (docs/serving.md "Full-lifecycle serving"):
+   every ``step()`` interleaves a BOUNDED prefill quantum (at most
+   ``TL_TPU_SERVE_PREFILL_PER_STEP`` chunk units of
+   ``TL_TPU_SERVE_PREFILL_CHUNK`` tokens) with one decode batch, so a
+   long prompt costs queue time, never decode p99; decode outputs are
+   temperature/top-p sampled into token ids (TTFT recorded in
+   ``serve.ttft`` at the first one); ``stream()`` yields tokens as
+   they land and closing the stream cancels; ``cancel()`` retires a
+   request as ``canceled`` and frees its KV slabs wherever it was in
+   the lifecycle — including mid-prefill.
 
 Fault sites: ``serve.admit`` (admission bookkeeping), ``serve.step``
 (one batch dispatch), ``serve.kv`` (slab allocation — lives in
@@ -52,7 +62,7 @@ from .kv_cache import KVCacheExhausted
 from .request import (Request, clear_gauges, publish_gauges,
                       publish_meta)
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "TokenStream"]
 
 logger = logging.getLogger("tilelang_mesh_tpu.serving")
 
@@ -114,6 +124,9 @@ class ServingEngine:
         self.retry_max = (retry_max if retry_max is not None
                           else env.TL_TPU_SERVE_RETRY_MAX)
         self.default_deadline_ms = default_deadline_ms
+        # chunked prefill: chunk units processed per step (bounds the
+        # prefill work wedged between two decode dispatches)
+        self.prefill_per_step = env.TL_TPU_SERVE_PREFILL_PER_STEP
         self.name = name
         self.requests: List[Request] = []    # every submission, in order
         self._queue: List[Request] = []      # admitted, awaiting a batch
@@ -149,13 +162,21 @@ class ServingEngine:
     # -- submission / admission ----------------------------------------
     def submit(self, context_tokens: int, new_tokens: int = 1,
                deadline_ms: Optional[float] = None, seed: int = 0,
-               payload: Optional[dict] = None) -> Request:
+               payload: Optional[dict] = None,
+               prompt_tokens: Optional[list] = None,
+               temperature: float = 0.0,
+               top_p: float = 1.0) -> Request:
         """Admit or shed one request; ALWAYS returns the request with a
-        state transition recorded (shed requests come back terminal)."""
+        state transition recorded (shed requests come back terminal).
+        ``prompt_tokens`` is the prompt's token ids (default: derived
+        from ``seed`` — identical seeds share a prefix-cache address);
+        ``temperature``/``top_p`` are the sampling knobs (0 = greedy)."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         req = Request(context_tokens, new_tokens, deadline_ms=deadline_ms,
-                      seed=seed, payload=payload)
+                      seed=seed, payload=payload,
+                      prompt_tokens=prompt_tokens,
+                      temperature=temperature, top_p=top_p)
         self.requests.append(req)
         try:
             _faults.maybe_fail("serve.admit", req=req.req_id)
@@ -169,7 +190,9 @@ class ServingEngine:
             pages_needed=self.workload.pages_needed(context_tokens,
                                                     new_tokens),
             remaining_s=req.remaining_s(),
-            steps_requested=new_tokens)
+            steps_requested=new_tokens,
+            prefill_chunks=self.workload.prefill_chunks_needed(
+                context_tokens))
         if not ok:
             return self._shed(req, reason)
         try:
@@ -248,12 +271,16 @@ class ServingEngine:
     def _form_batch(self) -> List[Request]:
         """FIFO head defines the page bucket; same-bucket followers fill
         the batch up to ``max_batch`` (order preserved — no starvation:
-        the head is always served)."""
-        if not self._queue:
+        the head is always served). Requests still mid-prefill are not
+        decode-eligible and are skipped (their chunk units run in the
+        prefill quantum instead)."""
+        ready = [r for r in self._queue
+                 if not r.needs_prefill and not r.cancel_requested]
+        if not ready:
             return []
-        head_bucket = self.workload.bucket_of(self._queue[0])
+        head_bucket = self.workload.bucket_of(ready[0])
         batch = []
-        for r in self._queue:
+        for r in ready:
             if self.workload.bucket_of(r) == head_bucket:
                 batch.append(r)
                 if len(batch) >= self.max_batch:
@@ -262,6 +289,59 @@ class ServingEngine:
             self._queue.remove(r)
             r.batch()
         return batch
+
+    def _cancel_sweep(self) -> int:
+        """Retire queued requests whose cancellation was requested:
+        terminal ``canceled``, KV slabs freed — the batcher never sees
+        them again."""
+        victims = [r for r in self._queue if r.cancel_requested]
+        for r in victims:
+            self._queue.remove(r)
+            self._finish(r, "canceled")
+        return len(victims)
+
+    def _prefill_quantum(self) -> bool:
+        """Run at most ``prefill_per_step`` prefill chunk units — the
+        bounded wedge of prompt work between two decode dispatches. The
+        FIFO-first mid-prefill request is re-picked per unit, so the
+        queue head may consume several units in one step (it finishes
+        — and becomes decode-eligible — sooner) and the whole budget
+        is spent whenever work exists. ``prefill_per_step<=0`` is
+        unthrottled: every pending chunk runs this step. Returns True
+        when any chunk ran."""
+        budget = (self.prefill_per_step if self.prefill_per_step > 0
+                  else float("inf"))
+        units = 0
+        while units < budget:
+            r = next((x for x in self._queue
+                      if x.needs_prefill and not x.cancel_requested),
+                     None)
+            if r is None:
+                break
+            sid = r.trace.span("prefill.chunk", pos=r.prefill_pos)
+            t0 = time.perf_counter()
+            try:
+                n = self.workload.prefill_chunk(r)
+            except Exception as e:  # noqa: BLE001 — classified below
+                r.trace.close_span(sid, error=f"{type(e).__name__}: {e}")
+                self._queue.remove(r)
+                if isinstance(e, (TLError, OSError)):
+                    # injected serve.kv fault or organic KV pressure
+                    # mid-prefill: terminal shed, slabs freed
+                    self._finish(r, "shed", shed_reason="kv_exhausted",
+                                 error=f"{type(e).__name__}: {e}")
+                else:
+                    self._finish(r, "failed",
+                                 error=f"{type(e).__name__}: {e}")
+                continue
+            dt = time.perf_counter() - t0
+            r.trace.close_span(sid, tokens=n,
+                               done=not r.needs_prefill)
+            _hist.observe("serve.prefill.latency", dt)
+            _trace.inc("serve.prefill.chunks")
+            _trace.inc("serve.prefill.tokens", n)
+            units += 1
+        return units > 0
 
     def _step_budget_s(self, batch: List[Request]) -> Optional[float]:
         """Deadline propagation into the step watchdog: the tightest
@@ -277,12 +357,18 @@ class ServingEngine:
         return min(budgets) if budgets else None
 
     def step(self) -> bool:
-        """Run one batch step; False when the queue is idle."""
+        """Run one scheduling step — a bounded prefill quantum plus one
+        decode batch; False when the queue is idle (no prefill ran and
+        no batch formed)."""
         self._expire_queue()
+        self._cancel_sweep()
+        prefilled = self._prefill_quantum()
         batch = self._form_batch()
         if not batch:
             self._gauges()
-            return False
+            if prefilled:
+                self._slo_tick()
+            return prefilled
         now = time.monotonic()
         for r in batch:
             if r.first_batch_t is not None and len(r.timeline) <= 3:
@@ -372,7 +458,10 @@ class ServingEngine:
         default bound is generous but FINITE — the no-unbounded-waits
         contract holds even against a scheduler bug."""
         if max_steps is None:
-            total = sum(r.new_tokens for r in self.requests) or 1
+            total = sum(r.new_tokens
+                        + self.workload.prefill_chunks_needed(
+                            r.context_tokens)
+                        for r in self.requests) or 1
             max_steps = 20 * total + 100
         n = 0
         while n < max_steps:
@@ -396,6 +485,44 @@ class ServingEngine:
         _trace.event("serve.drain", "serving", engine=self.name,
                      queued=len(self._queue))
 
+    # -- cancellation / streaming --------------------------------------
+    def cancel(self, req: Request) -> bool:
+        """Cancel one request: queued (incl. mid-prefill) requests
+        retire ``canceled`` immediately with their KV slabs freed; a
+        request currently inside a batch dispatch is flagged and
+        retired when the step returns (its in-flight work is not
+        interruptible, its slabs still free the same step). False when
+        the request is already terminal."""
+        if req.is_terminal:
+            return False
+        req.cancel_requested = True
+        req.trace.mark("cancel", steps_done=req.steps_done,
+                       mid_prefill=req.needs_prefill)
+        if req in self._queue:
+            self._queue.remove(req)
+            self._finish(req, "canceled")
+            self._gauges()
+        return True
+
+    def stream(self, context_tokens: int, new_tokens: int = 1,
+               deadline_ms: Optional[float] = None, seed: int = 0,
+               payload: Optional[dict] = None,
+               prompt_tokens: Optional[list] = None,
+               temperature: float = 0.0,
+               top_p: float = 1.0) -> "TokenStream":
+        """The streaming front-end: submit + an iterator yielding one
+        event dict per sampled token (``{"token", "index", "req",
+        "trace_id"}``) as decode steps land. The iterator pumps
+        ``step()`` itself, so a plain ``for`` loop serves the request
+        end to end; closing it early (``break``, ``.close()``)
+        CANCELS the request and frees its KV slabs — the
+        client-disconnect contract."""
+        req = self.submit(context_tokens, new_tokens,
+                          deadline_ms=deadline_ms, seed=seed,
+                          payload=payload, prompt_tokens=prompt_tokens,
+                          temperature=temperature, top_p=top_p)
+        return TokenStream(self, req)
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -406,9 +533,32 @@ class ServingEngine:
 
     # -- retirement ----------------------------------------------------
     def _retire_or_requeue(self, batch: List[Request], outs) -> None:
+        now = time.monotonic()
         for r, out in zip(batch, outs):
             r.steps_done += 1
             r.result = out
+            # real sampling (serving/sampling.py): the decode output
+            # becomes ONE token id — what stream() yields and what the
+            # appended KV content derives from
+            try:
+                tok = self.workload.sample(r, out)
+            except Exception as e:  # noqa: BLE001 — a sampler bug fails
+                self._finish(r, "failed",        # the request, never
+                             error=f"{type(e).__name__}: {e}")  # a hang
+                continue
+            r.generated.append(tok)
+            if r.first_token_t is None:
+                # TTFT: submit -> first sampled token, the latency a
+                # streaming client actually feels
+                r.first_token_t = now
+                _hist.observe("serve.ttft", now - r.submit_t)
+                r.trace.mark("first_token", token=tok,
+                             ttft_ms=round((now - r.submit_t) * 1e3, 3))
+            if r.cancel_requested:
+                # canceled while in flight: the step's work is done but
+                # the client is gone — retire now, free the slabs
+                self._finish(r, "canceled")
+                continue
             if r.steps_done >= r.new_tokens:
                 self._finish(r, "result")
                 continue
@@ -439,6 +589,11 @@ class ServingEngine:
             _trace.inc("serve.failed")
             _trace.event("serve.request_failed", "serving",
                          req=req.req_id, error=error)
+        elif outcome == "canceled":
+            _trace.inc("serve.canceled")
+            _trace.event("serve.canceled", "serving", req=req.req_id,
+                         steps_done=req.steps_done,
+                         mid_prefill=req.needs_prefill)
         else:
             _trace.inc("serve.shed", reason=shed_reason)
             _trace.event("serve.shed", "serving", req=req.req_id,
@@ -681,7 +836,7 @@ class ServingEngine:
 
     def outcomes(self) -> Dict[str, int]:
         out = {"result": 0, "shed": 0, "deadline_exceeded": 0,
-               "failed": 0, "pending": 0}
+               "failed": 0, "canceled": 0, "pending": 0}
         for r in self.requests:
             out[r.outcome or "pending"] += 1
         return out
@@ -708,3 +863,59 @@ class ServingEngine:
         if getattr(self.workload, "elastic", False):
             out["mesh"] = self.workload.layout_stats()
         return out
+
+
+class TokenStream:
+    """Token-at-a-time iterator over one request (the ``stream()``
+    front-end): yields an event dict per sampled token, pumping the
+    engine's synchronous ``step()`` underneath. Closing the iterator
+    before the request retires cancels it — the generator-``close()``
+    analog of a dropped client connection."""
+
+    def __init__(self, engine: ServingEngine, request: Request):
+        self.engine = engine
+        self.request = request
+
+    def cancel(self) -> bool:
+        return self.engine.cancel(self.request)
+
+    def __iter__(self):
+        eng, req = self.engine, self.request
+        delivered = 0
+
+        def pending():
+            return req.generated[delivered:]
+
+        # same finite-bound discipline as run(), over the WHOLE
+        # engine's work: the stream pumps every request's steps, so a
+        # bound scaled only to this request would spuriously cancel a
+        # healthy stream queued behind a long-running neighbor.
+        # Recomputed per pump — submissions arriving mid-stream extend
+        # it, a scheduler bug still cannot pump forever.
+        def bound():
+            total = sum(r.new_tokens
+                        + eng.workload.prefill_chunks_needed(
+                            r.context_tokens)
+                        for r in eng.requests) or 1
+            return 20 * total + 100
+
+        try:
+            pumps = 0
+            while not req.is_terminal and pumps < bound():
+                progressed = eng.step()
+                pumps += 1
+                for tok in pending():
+                    delivered += 1
+                    yield {"token": int(tok), "index": delivered,
+                           "req": req.req_id, "trace_id": req.trace_id}
+                if not progressed and not req.is_terminal:
+                    break      # idle queue with a live request: a
+                # scheduler bug — the finally clause cancels it so the
+                # contract (every request terminal) still holds
+            for tok in pending():
+                delivered += 1
+                yield {"token": int(tok), "index": delivered,
+                       "req": req.req_id, "trace_id": req.trace_id}
+        finally:
+            if not req.is_terminal:
+                eng.cancel(req)

@@ -194,6 +194,21 @@ class PagedKVAllocator:
         self.kp[:, row, :] = k
         self.vp[:, row, :] = v
 
+    def write_span(self, page: int, offset: int, k: np.ndarray,
+                   v: np.ndarray) -> None:
+        """Write ``n`` consecutive tokens' ``(H, n, D)`` K/V blocks
+        into ``page`` starting at token ``offset`` — the bulk write one
+        prefill chunk performs (a chunk crossing a page boundary issues
+        one span per page)."""
+        n = int(k.shape[1])
+        if not (0 <= offset and offset + n <= self.page_size):
+            raise IndexError(
+                f"token span [{offset}, {offset + n}) out of page "
+                f"(size {self.page_size})")
+        row = self.row0(page) + offset
+        self.kp[:, row:row + n, :] = k
+        self.vp[:, row:row + n, :] = v
+
     def fill_page(self, page: int, k: np.ndarray, v: np.ndarray) -> None:
         """Bulk-fill one page from ``(H, page_size, D)`` arrays (context
         ingestion at admission)."""

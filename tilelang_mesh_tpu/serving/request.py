@@ -4,15 +4,20 @@ The state machine is the product (docs/serving.md):
 
     queued -> admitted -> batched -> terminal
 
-with exactly four terminal outcomes — ``result`` (the request finished
+with exactly five terminal outcomes — ``result`` (the request finished
 its decode steps), ``shed`` (admission control rejected it, with a
 named reason), ``deadline_exceeded`` (its deadline + grace passed while
 queued, in flight, or during a retry), ``failed`` (a deterministic
-error retired it). The engine's contract is that EVERY submitted
-request reaches one of the four: no silent drops, no unbounded waits.
+error retired it), ``canceled`` (the client abandoned it — a closed
+stream, an explicit ``engine.cancel()`` — and its KV slabs were freed
+mid-request). The engine's contract is that EVERY submitted request
+reaches one of the five: no silent drops, no unbounded waits.
 ``batched`` flips back to ``admitted`` between decode steps — that
 re-queueing is what makes the batching *continuous* (a half-finished
-request shares its next batch with newly admitted ones).
+request shares its next batch with newly admitted ones). A request
+with a prompt longer than one prefill chunk additionally spends time
+``admitted`` while its context fills chunk by chunk (the engine
+interleaves those chunk units with decode steps).
 
 Every transition is stamped (monotonic clock) into ``timeline`` so the
 chaos soak can prove the zero-hang guarantee per request instead of
@@ -33,9 +38,9 @@ __all__ = ["Request", "STATES", "OUTCOMES", "SHED_REASONS"]
 # non-terminal states, in lifecycle order
 STATES = ("queued", "admitted", "batched", "terminal")
 
-# the four terminal outcomes — the whole vocabulary; accounting keys on
+# the five terminal outcomes — the whole vocabulary; accounting keys on
 # these strings, so they never grow ad hoc
-OUTCOMES = ("result", "shed", "deadline_exceeded", "failed")
+OUTCOMES = ("result", "shed", "deadline_exceeded", "failed", "canceled")
 
 # the admission-control shed vocabulary (admission.py decides, the
 # engine records ``serve.shed{reason=}``); ``retry_budget`` is the one
@@ -46,6 +51,15 @@ SHED_REASONS = ("draining", "queue_full", "breaker_open", "kv_exhausted",
                 "retry_budget")
 
 _req_seq = itertools.count(1)
+
+
+def default_prompt(seed: int, n: int) -> list:
+    """Deterministic seed-derived prompt token ids (the stand-in for a
+    tokenizer): identical ``(seed, n)`` pairs share a prompt — and
+    therefore a prefix-cache content address — by construction."""
+    import numpy as np
+    rng = np.random.default_rng((int(seed), 0x70))
+    return [int(t) for t in rng.integers(0, 1 << 30, size=int(n))]
 
 
 class Request:
@@ -59,15 +73,21 @@ class Request:
                  "submit_t", "seed", "state", "outcome", "shed_reason",
                  "error", "result", "steps_done", "retries", "pages",
                  "tail_tokens", "timeline", "terminal_t", "first_batch_t",
-                 "payload", "trace", "_step_span")
+                 "payload", "trace", "_step_span", "prompt_tokens",
+                 "temperature", "top_p", "generated", "prefill_pos",
+                 "prefix_tokens", "cancel_requested", "first_token_t")
 
     def __init__(self, context_tokens: int, new_tokens: int = 1,
                  deadline_ms: Optional[float] = None, seed: int = 0,
-                 payload: Optional[Dict[str, Any]] = None):
+                 payload: Optional[Dict[str, Any]] = None,
+                 prompt_tokens: Optional[List[int]] = None,
+                 temperature: float = 0.0, top_p: float = 1.0):
         if context_tokens <= 0:
             raise ValueError("context_tokens must be positive")
         if new_tokens <= 0:
             raise ValueError("new_tokens must be positive")
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         self.req_id = next(_req_seq)
         self.context_tokens = int(context_tokens)
         self.new_tokens = int(new_tokens)
@@ -76,6 +96,22 @@ class Request:
                          if deadline_ms is not None else None)
         self.seed = int(seed)
         self.payload = payload or {}
+        # the prompt as token ids — the content address of the prefix
+        # cache and the input of the stand-in KV derivation; defaults
+        # to a seed-derived deterministic prompt so every pre-prompt
+        # caller keeps its exact behavior
+        if prompt_tokens is None:
+            prompt_tokens = default_prompt(self.seed, self.context_tokens)
+        prompt_tokens = [int(t) for t in prompt_tokens]
+        if len(prompt_tokens) != self.context_tokens:
+            raise ValueError(
+                f"prompt_tokens has {len(prompt_tokens)} token(s) but "
+                f"context_tokens={self.context_tokens}")
+        self.prompt_tokens = prompt_tokens
+        # sampling knobs (serving/sampling.py): temperature 0 = greedy
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.generated: List[int] = []   # sampled token ids, in order
         self.state = "queued"
         self.outcome: Optional[str] = None
         self.shed_reason: Optional[str] = None
@@ -85,9 +121,13 @@ class Request:
         self.retries = 0
         self.pages: List[int] = []   # allocator page ids owned right now
         self.tail_tokens = 0         # tokens in the (uncommitted) tail page
+        self.prefill_pos = 0         # prompt tokens whose KV is filled
+        self.prefix_tokens = 0       # of those, restored from the cache
+        self.cancel_requested = False
         self.timeline: List[tuple] = [("queued", self.submit_t)]
         self.terminal_t: Optional[float] = None
         self.first_batch_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None   # TTFT stamp
         # tl-scope causal chain (observability/reqtrace.py): every
         # lifecycle transition below lands in it, so a terminal
         # request's whole story — submit, admit, every decode step,
@@ -103,6 +143,13 @@ class Request:
     @property
     def trace_id(self) -> str:
         return self.trace.trace_id
+
+    @property
+    def needs_prefill(self) -> bool:
+        """True while prompt tokens remain to fill — the request sits
+        ``admitted`` in the queue as schedulable prefill-chunk work and
+        is not yet eligible for a decode batch."""
+        return self.prefill_pos < self.context_tokens
 
     # -- transitions ---------------------------------------------------
     def _stamp(self, state: str) -> None:

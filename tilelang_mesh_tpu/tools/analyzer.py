@@ -585,7 +585,8 @@ def summarize_serve(records) -> dict:
     from ..observability.export import shed_reason_from_counter
     for r in records:
         name = r.get("name")
-        if r.get("type") == "counter" and str(name).startswith("serve."):
+        if r.get("type") == "counter" and \
+                str(name).startswith(("serve.", "prefix_cache.")):
             counters[name] = counters.get(name, 0) + r["value"]
             reason = shed_reason_from_counter(str(name))
             if reason is not None:
@@ -605,7 +606,8 @@ def summarize_serve(records) -> dict:
                 pass     # counted via the labelled counter lines
         elif r.get("type") == "histogram" and name in (
                 "serve.queue.wait", "serve.e2e.latency",
-                "serve.shard.latency", "kernel.latency"):
+                "serve.shard.latency", "serve.ttft",
+                "serve.prefill.latency", "kernel.latency"):
             labels = r.get("labels", {})
             if name == "kernel.latency" and \
                     labels.get("kernel") != "serve.step":
@@ -641,10 +643,21 @@ def summarize_serve(records) -> dict:
         "completed": counters.get("serve.completed", 0),
         "failed": counters.get("serve.failed", 0),
         "deadline_exceeded": counters.get("serve.deadline_exceeded", 0),
+        "canceled": counters.get("serve.canceled", 0),
         "shed": sheds,
         "shed_total": flat("serve.shed"),
         "batches": counters.get("serve.batches", 0),
         "steps": flat("serve.steps"),
+        "prefill_chunks": counters.get("serve.prefill.chunks", 0),
+        "prefill_tokens": counters.get("serve.prefill.tokens", 0),
+        "prefix_cache": {
+            "hits": counters.get("prefix_cache.hit", 0),
+            "misses": counters.get("prefix_cache.miss", 0),
+            "bytes_saved": counters.get("prefix_cache.bytes_saved", 0),
+            "evicted": counters.get("prefix_cache.evicted", 0),
+            "inserts": counters.get("prefix_cache.insert", 0),
+            "quarantined": counters.get("prefix_cache.quarantined", 0),
+        },
         "retries": counters.get("serve.retries", 0),
         "failovers": counters.get("serve.failover", 0),
         "reshards": flat("serve.reshard"),
@@ -682,6 +695,7 @@ def format_serve_report(records) -> str:
                        for k, v in sorted(s["shed"].items())) + ")"),
         f"  deadline exceeded       {int(s['deadline_exceeded'])}",
         f"  failed                  {int(s['failed'])}",
+        f"  canceled                {int(s['canceled'])}",
         f"  batches / steps         {int(s['batches'])} / "
         f"{int(s['steps'])}",
         f"  retries / failovers     {int(s['retries'])} / "
@@ -690,6 +704,19 @@ def format_serve_report(records) -> str:
         f"{int(s['kv']['free_pages'])} "
         f"(balance {int(s['kv']['balance'])})",
     ]
+    if s["prefill_chunks"]:
+        lines.append(f"  prefill chunks/tokens   "
+                     f"{int(s['prefill_chunks'])} / "
+                     f"{int(s['prefill_tokens'])}")
+    pc = s["prefix_cache"]
+    if pc["hits"] or pc["misses"] or pc["inserts"]:
+        lines.append(
+            f"  prefix cache            hits={int(pc['hits'])} "
+            f"misses={int(pc['misses'])} "
+            f"bytes_saved={int(pc['bytes_saved'])} "
+            f"evicted={int(pc['evicted'])}"
+            + (f" quarantined={int(pc['quarantined'])}"
+               if pc["quarantined"] else ""))
     if s["step_failures"]:
         lines.append("  step failures by kind   "
                      + ", ".join(f"{k}={int(v)}" for k, v in

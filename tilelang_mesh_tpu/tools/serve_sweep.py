@@ -1,0 +1,140 @@
+"""Offline paged-decode bucket sweep -> fleet tune cache.
+
+Closes the PR 12 remainder: serving ``warmup()`` consults the fleet
+tune cache per (batch, pages) bucket but, until now, only PRE-SEEDED
+entries existed — nothing actually swept the paged-decode kernels. This
+tool measures every candidate split factor (``n_split``) of
+``flash_decode_paged_pool`` per configured bucket and publishes the
+winner via ``DecodeWorkload.record_bucket_tuning()``, so every serving
+process pointed at the same tune-cache dir adopts a REAL swept config
+with zero measurements at its next ``warmup()``
+(``serve.warmup.tuned``).
+
+The candidate space is the divisors of the bucket's page count (the op
+clamps ``n_split`` to a divisor, so anything else would silently
+measure a different split). Each candidate is dispatched once to warm
+the kernel cache, then timed best-of-``--reps``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tilelang_mesh_tpu.tools.serve_sweep \
+        --batch-buckets 1,8 --page-buckets 2,4 --reps 3
+
+Exit 0 on success; the swept entries print as a table (or ``--json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional, Sequence
+
+__all__ = ["sweep_workload", "main"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def sweep_workload(workload, reps: int = 3,
+                   batches: Optional[Sequence[int]] = None,
+                   pages: Optional[Sequence[int]] = None) -> List[dict]:
+    """Sweep every (batch, pages) bucket of ``workload`` over the
+    ``n_split`` candidate space and publish each bucket's best config
+    to the fleet tune cache. Returns one result dict per bucket
+    (``best_config``, ``best_latency_ms``, ``trials``, ``key``)."""
+    import numpy as np
+
+    results = []
+    for bb in (batches if batches is not None
+               else workload.batch_buckets):
+        for pp in (pages if pages is not None
+                   else workload.page_buckets):
+            trials = []
+            q = np.zeros(workload._query_shape(bb), np.float32)
+            table = np.zeros((bb, pp), np.int32)
+            for ns in _divisors(pp):
+                workload._tuned[(bb, pp)] = {"n_split": ns}
+                workload._dispatch(q, table, bb, pp)   # warm compile
+                best = float("inf")
+                for _ in range(max(1, reps)):
+                    t0 = time.perf_counter()
+                    workload._dispatch(q, table, bb, pp)
+                    best = min(best, time.perf_counter() - t0)
+                trials.append({"config": {"n_split": ns},
+                               "latency_ms": best * 1e3})
+            workload._tuned.pop((bb, pp), None)
+            winner = min(trials, key=lambda t: t["latency_ms"])
+            key = workload.record_bucket_tuning(
+                bb, pp, winner["config"], winner["latency_ms"])
+            results.append({
+                "batch": bb, "pages": pp,
+                "best_config": winner["config"],
+                "best_latency_ms": round(winner["latency_ms"], 4),
+                "trials": [{**t, "latency_ms":
+                            round(t["latency_ms"], 4)} for t in trials],
+                "key": key,
+            })
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.tools.serve_sweep",
+        description="Offline sweep of the paged-decode kernels per "
+                    "(batch, pages) bucket; winners publish to the "
+                    "fleet tune cache serving warmup() adopts "
+                    "(docs/serving.md, docs/autotuning.md).")
+    ap.add_argument("--batch-buckets", default="1,2,4,8",
+                    help="comma list of batch buckets (default 1,2,4,8)")
+    ap.add_argument("--page-buckets", default="2,4",
+                    help="comma list of page buckets (default 2,4)")
+    ap.add_argument("--pages", type=int, default=64,
+                    help="allocator pool size in pages (default 64)")
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per candidate (best-of)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    try:
+        bbs = [int(b) for b in args.batch_buckets.split(",") if b.strip()]
+        pps = [int(p) for p in args.page_buckets.split(",") if p.strip()]
+    except ValueError:
+        ap.error("--batch-buckets / --page-buckets must be comma lists "
+                 "of integers")
+    if not bbs or not pps:
+        ap.error("bucket lists must be non-empty")
+
+    from ..serving import FlashDecodeWorkload, PagedKVAllocator
+    alloc = PagedKVAllocator(n_pages=args.pages,
+                             page_size=args.page_size,
+                             heads=args.heads, head_dim=args.head_dim)
+    wl = FlashDecodeWorkload(alloc, batch_buckets=bbs, page_buckets=pps,
+                             prefix_cache=False)
+    results = sweep_workload(wl, reps=args.reps)
+
+    if args.as_json:
+        print(json.dumps({"results": results}, indent=2))  # noqa: T201
+        return 0
+    print("serve bucket sweep (flash_decode_paged_pool):")  # noqa: T201
+    print(f"  {'batch':>5} {'pages':>5} {'best n_split':>12} "  # noqa: T201
+          f"{'latency_ms':>11}  trials")
+    for r in results:
+        tr = ", ".join(f"ns={t['config']['n_split']}:"
+                       f"{t['latency_ms']}ms" for t in r["trials"])
+        print(f"  {r['batch']:>5} {r['pages']:>5} "  # noqa: T201
+              f"{r['best_config']['n_split']:>12} "
+              f"{r['best_latency_ms']:>11}  {tr}")
+    print(f"{len(results)} bucket entr(ies) published to the fleet "  # noqa: T201
+          f"tune cache; the next serving warmup() adopts them with "
+          f"zero measurements")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

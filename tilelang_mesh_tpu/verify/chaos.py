@@ -273,9 +273,11 @@ def _reset_serving_state() -> None:
     (``--seeds 7,13,42``) must start every seed from a clean slate."""
     from tilelang_mesh_tpu import observability as obs
     from tilelang_mesh_tpu.resilience.retry import global_breaker
-    from tilelang_mesh_tpu.serving import reset_gauges
+    from tilelang_mesh_tpu.serving import (reset_gauges,
+                                           reset_prefix_cache)
     obs.reset()
     reset_gauges()
+    reset_prefix_cache()
     global_breaker().reset()
     try:
         from tilelang_mesh_tpu.codegen import backends as _backends
@@ -302,6 +304,7 @@ def _serve_accounting(eng, counters) -> tuple:
         counters["completed"] == outcomes["result"]
         and counters["deadline_exceeded"] == outcomes["deadline_exceeded"]
         and counters["failed"] == outcomes["failed"]
+        and counters["canceled"] == outcomes["canceled"]
         and counters["shed_total"] == outcomes["shed"]
         and sum(e2e_by_outcome.values()) == len(eng.requests)
         and all(e2e_by_outcome.get(k, 0) == v
@@ -384,6 +387,9 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
                                            PagedKVAllocator,
                                            ServingEngine)
 
+    # sandbox the prefix-cache disk tier with the other artifacts (it
+    # must never land in $HOME under a CI soak)
+    os.environ["TL_TPU_SERVE_PREFIX_DIR"] = str(out / "prefix")
     _reset_serving_state()
     _flight.configure(dump_dir=out / "flight")
     rng = random.Random(seed)
@@ -589,6 +595,7 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
                                            PagedKVAllocator,
                                            ServingEngine)
 
+    os.environ["TL_TPU_SERVE_PREFIX_DIR"] = str(out / "prefix")
     _reset_serving_state()
     _flight.configure(dump_dir=out / "flight")
     rng = random.Random(seed)
@@ -727,6 +734,245 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
     return 0 if ok else 1
 
 
+def run_serve_lifecycle(out: Path, seed: int, n_requests: int) -> int:
+    """Full-lifecycle serving chaos soak (the CI ``serve-lifecycle``
+    gate; docs/serving.md "Full-lifecycle serving"): seeded MIXED
+    traffic — shared-system-prompt requests (prefix-cache hits),
+    long-prompt requests spanning many prefill chunks, short
+    decode-heavy requests, streaming clients, and cancellations fired
+    mid-prefill AND mid-decode — with ``serve.step``/``serve.kv``
+    transient faults armed underneath. Asserts the lifecycle contract:
+
+    - every request reaches a terminal outcome (the five-outcome
+      vocabulary, ``canceled`` included) and the counters/histogram
+      accounting agrees;
+    - KV slabs balance to zero — cancellation mid-prefill and
+      mid-decode must free every page (``leak_check()``);
+    - at least one prefix-cache HIT with bytes saved (the shared
+      system prompt was prefilled once, not per request);
+    - prefill chunks ran interleaved with decode batches (a decode
+      batch completed while some prompt was still mid-prefill), and
+      the decode step p99 stayed within the budget — chunked prefill
+      must not stall decode;
+    - TTFT was recorded and every terminal request's causal chain is
+      complete (the ``prefill.chunk`` spans ride the same chain).
+    """
+    import random
+
+    import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import flight as _flight
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                           PagedKVAllocator,
+                                           ServingEngine,
+                                           reset_prefix_cache)
+
+    # small chunk so the long prompts genuinely span many schedulable
+    # units (overridable by the operator)
+    os.environ.setdefault("TL_TPU_SERVE_PREFILL_CHUNK", "16")
+    # the decode-p99 acceptance budget: TL_TPU_SERVE_P99_BUDGET_MS when
+    # the operator set a POSITIVE one, else a CI-calibrated CPU ceiling
+    # (0 is the documented "admission gate off" value, not a 0ms budget)
+    try:
+        budget_ms = float(os.environ.get("TL_TPU_SERVE_P99_BUDGET_MS")
+                          or 0.0)
+    except ValueError:
+        budget_ms = 0.0
+    if budget_ms <= 0:
+        budget_ms = 250.0
+    # per-run prefix-cache tier (fresh dir per seed: the >=1-hit gate
+    # must prove THIS run shared a prefill, not inherit one)
+    os.environ["TL_TPU_SERVE_PREFIX_DIR"] = str(out / "prefix")
+    reset_prefix_cache()
+    _reset_serving_state()
+    _flight.configure(dump_dir=out / "flight")
+
+    rng = random.Random(seed)
+    alloc = PagedKVAllocator(n_pages=768, page_size=8, heads=2,
+                             head_dim=64)
+    ps = alloc.page_size
+    wl = FlashDecodeWorkload(alloc, batch_buckets=(8,),
+                             page_buckets=(2, 4))
+    import time as _time
+    eng = ServingEngine(wl, name="lifecycle-soak")
+    t_warm0 = _time.perf_counter()
+    warmed = eng.warmup()
+    warm_s = _time.perf_counter() - t_warm0
+
+    if n_requests < 20:
+        print(f"[chaos-serve-lifecycle] --requests {n_requests} is "
+              f"below the soak minimum (20)", file=sys.stderr)  # noqa: T201
+        return 2
+
+    # two shared system prompts, whole-page (6 pages = 48 tokens each)
+    shared = [[rng.randrange(1 << 20) for _ in range(6 * ps)]
+              for _ in range(2)]
+
+    def make_request():
+        roll = rng.random()
+        kw = dict(seed=rng.randrange(1 << 30),
+                  temperature=rng.choice((0.0, 0.0, 0.8)),
+                  top_p=rng.choice((1.0, 0.9)))
+        if roll < 0.45:
+            # shared system prompt + unique user suffix
+            prompt = list(rng.choice(shared)) \
+                + [rng.randrange(1 << 20)
+                   for _ in range(rng.randrange(0, 2 * ps))]
+            kw.update(context_tokens=len(prompt), prompt_tokens=prompt,
+                      new_tokens=rng.choice((1, 2)))
+        elif roll < 0.60:
+            # long prompt: many prefill chunks, decode must interleave
+            kw.update(context_tokens=rng.choice((96, 128, 160)),
+                      new_tokens=1)
+        else:
+            # short decode-heavy request
+            kw.update(context_tokens=rng.choice((16, 24, 32)),
+                      new_tokens=rng.choice((1, 2, 3)))
+        if rng.random() < 0.15:
+            kw.update(deadline_ms=2000.0)
+        return kw
+
+    print(f"[chaos-serve-lifecycle] seed={seed}: {n_requests} mixed "  # noqa: T201
+          f"requests, {warmed} bucket kernels warmed in {warm_s:.1f}s, "
+          f"chunk={os.environ['TL_TPU_SERVE_PREFILL_CHUNK']} tokens, "
+          f"p99 budget {budget_ms:g}ms")
+    t0 = _time.perf_counter()
+    interleaved = False
+    canceled_mid_prefill = 0
+    stream_tokens = 0
+    with inject("serve.step", p=0.02, seed=seed, kind="transient"), \
+            inject("serve.kv", p=0.003, seed=seed + 1, kind="transient"):
+        # seed the prefix cache: one pure-shared-prompt request per
+        # prompt completes BEFORE the storm (the fleet's first tenant)
+        for prompt in shared:
+            eng.submit(context_tokens=len(prompt), prompt_tokens=prompt,
+                       new_tokens=1, seed=rng.randrange(1 << 30))
+        eng.run()
+        # two streaming clients: one consumed to completion, one
+        # closed after the first token (client disconnect -> cancel)
+        stream = eng.stream(context_tokens=len(shared[0]),
+                            prompt_tokens=list(shared[0]), new_tokens=3,
+                            seed=rng.randrange(1 << 30))
+        stream_tokens += sum(1 for _ in stream)
+        dropper = eng.stream(context_tokens=32, new_tokens=4,
+                             seed=rng.randrange(1 << 30))
+        for _ in dropper:
+            break                    # disconnect after the first token
+        submitted = 0
+        live = []
+        while submitted < n_requests:
+            wave = min(rng.randrange(6, 25), n_requests - submitted)
+            for _ in range(wave):
+                r = eng.submit(**make_request())
+                if not r.is_terminal:
+                    live.append(r)
+            submitted += wave
+            # deterministic mid-prefill cancel: pick a live request
+            # still filling its prompt and cancel it RIGHT NOW — its
+            # partial pages must free (leak_check gates)
+            victims = [r for r in live
+                       if not r.is_terminal and r.needs_prefill]
+            if victims and canceled_mid_prefill < 5:
+                v = rng.choice(victims)
+                if eng.cancel(v):
+                    canceled_mid_prefill += 1
+            # random mid-decode cancels (~8% of a wave)
+            for r in list(live):
+                if not r.is_terminal and r.steps_done > 0 \
+                        and rng.random() < 0.08:
+                    eng.cancel(r)
+            for _ in range(rng.randrange(1, 4)):
+                before_batches = obs.metrics_summary()[
+                    "serving"]["batches"]
+                mid_prefill = any(not r.is_terminal and r.needs_prefill
+                                  for r in eng.requests)
+                eng.step()
+                after_batches = obs.metrics_summary()[
+                    "serving"]["batches"]
+                if mid_prefill and after_batches > before_batches:
+                    # a decode batch completed while a prompt was
+                    # still mid-prefill: the interleave is real
+                    interleaved = True
+            live = [r for r in live if not r.is_terminal]
+        eng.drain()
+        eng.run()
+    wall_s = _time.perf_counter() - t0
+
+    # -- the lifecycle contract checks ---------------------------------
+    leaks = alloc.leak_check()
+    outcomes = eng.outcomes()
+    counters = obs.metrics_summary()["serving"]
+    e2e_by_outcome, acct_ok = _serve_accounting(eng, counters)
+    kv_ok = (not leaks and alloc.in_use == 0
+             and alloc.alloc_count == alloc.free_count)
+    non_terminal = [r.req_id for r in eng.requests if not r.is_terminal]
+    incomplete = [r.req_id for r in eng.requests
+                  if r.is_terminal and not r.trace.complete]
+    step_h = _hist.get_histogram("kernel.latency", kernel="serve.step",
+                                 source="serving")
+    p99_ms = (step_h.quantile(0.99) * 1e3
+              if step_h and step_h.count else None)
+    ttft_h = _hist.get_histogram("serve.ttft")
+    pc = counters["prefix_cache"]
+    checks = {
+        "all_terminal": not non_terminal,
+        "kv_slabs_balance_zero": kv_ok,
+        "accounting_matches_histograms": acct_ok,
+        "engine_completed_some_work": outcomes["result"] > 0,
+        "prefix_cache_hit": pc["hits"] >= 1 and pc["bytes_saved"] > 0,
+        "prefill_chunks_ran": counters["prefill_chunks"] > 0,
+        "prefill_interleaved_with_decode": interleaved,
+        "decode_p99_within_budget": p99_ms is not None
+        and p99_ms <= budget_ms,
+        "cancellation_exercised": outcomes["canceled"] >= 1
+        and canceled_mid_prefill >= 1,
+        "streaming_yielded_tokens": stream_tokens >= 1,
+        "ttft_recorded": bool(ttft_h and ttft_h.count),
+        "causal_chains_complete": not incomplete,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "mode": "serve-lifecycle", "seed": seed,
+        "requests": len(eng.requests),
+        "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
+        "outcomes": outcomes,
+        "shed_by_reason": counters["shed"],
+        "canceled_mid_prefill": canceled_mid_prefill,
+        "stream_tokens": stream_tokens,
+        "prefill_chunks": counters["prefill_chunks"],
+        "prefill_tokens": counters["prefill_tokens"],
+        "prefix_cache": pc,
+        "decode_p99_ms": round(p99_ms, 3) if p99_ms else None,
+        "decode_p99_budget_ms": budget_ms,
+        "ttft": counters["ttft"],
+        "kv": alloc.stats(),
+        "kv_leaks": {str(k): v for k, v in leaks.items()},
+        "e2e_by_outcome": e2e_by_outcome,
+        "non_terminal_requests": non_terminal,
+        "causally_incomplete_requests": incomplete,
+        "checks": checks, "ok": ok,
+    }
+    trace_path = out / "serve_lifecycle_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "serve_lifecycle_report.json").write_text(
+        json.dumps(report, indent=2))
+    from ..tools.analyzer import format_serve_report
+    summary = format_serve_report(obs.read_jsonl(str(trace_path)))
+    (out / "serve_lifecycle_report.txt").write_text(summary + "\n")
+    print(summary)  # noqa: T201
+    for k, v in checks.items():
+        print(f"[chaos-serve-lifecycle] {k}: "  # noqa: T201
+              f"{'OK' if v else 'FAIL'}")
+    print(f"[chaos-serve-lifecycle] outcomes={outcomes} "  # noqa: T201
+          f"prefix={pc['hits']} hit(s)/{pc['bytes_saved']}B saved, "
+          f"p99={report['decode_p99_ms']}ms in {wall_s:.1f}s -> "
+          f"{'PASS' if ok else 'FAIL'}; artifacts in {out}/")
+    return 0 if ok else 1
+
+
 def run_verify(out: Path, seed: int) -> int:
     """The default mode: seeded corruption on the comm interpret paths,
     the differential selfcheck must catch every scenario."""
@@ -789,9 +1035,16 @@ def main(argv=None) -> int:
                          "down the layout ladder, zero KV leaks, and "
                          "byte-conservation across the KV migration "
                          "(docs/serving.md)")
+    ap.add_argument("--serve-lifecycle", action="store_true",
+                    help="full-lifecycle serving soak: mixed shared-"
+                         "prompt / long-prompt / decode / streaming / "
+                         "cancel traffic with chunked prefill "
+                         "interleaved; asserts 100%% terminal outcomes, "
+                         "zero KV leaks, >= 1 prefix-cache hit, and "
+                         "decode p99 within budget (docs/serving.md)")
     ap.add_argument("--requests", type=int, default=500,
-                    help="request count for --serve / --serve-mesh "
-                         "(default 500)")
+                    help="request count for --serve / --serve-mesh / "
+                         "--serve-lifecycle (default 500)")
     args = ap.parse_args(argv)
 
     try:
@@ -818,6 +1071,9 @@ def main(argv=None) -> int:
         return per_seed(lambda d, s: run_serve(d, s, args.requests))
     if args.serve_mesh:
         return per_seed(lambda d, s: run_serve_mesh(d, s, args.requests))
+    if args.serve_lifecycle:
+        return per_seed(lambda d, s: run_serve_lifecycle(d, s,
+                                                         args.requests))
     return per_seed(run_verify)
 
 
