@@ -1647,6 +1647,41 @@ def run_config(name, build, peaks, rounds=3):
     return rec
 
 
+def _attach_sol(rec: dict, name: str) -> dict:
+    """With TL_TPU_SOL=1 in the child's environment, embed the
+    config's speed-of-light summary into the benchmark record: the
+    dominant (most-sampled) kernel's achieved vs roofline-predicted
+    latency, SoL %, and bottleneck term, plus the number of profiled
+    kernels. Must run BEFORE _attach_observability — that helper
+    resets the whole observability state, SoL aggregates included."""
+    try:
+        from tilelang_mesh_tpu.observability import sol as _sol
+        if not _sol.sol_enabled():
+            return rec
+        recs = _sol.sol_records()
+        if not recs:
+            return rec
+        best = max(recs, key=lambda r: r.get("count") or 0)
+        rec["sol"] = {
+            "kernel": best["kernel"],
+            "achieved_ms": best.get("achieved_ms"),
+            "predicted_ms": best.get("predicted_ms"),
+            "sol_pct": best.get("sol_pct"),
+            "bottleneck": best.get("bottleneck"),
+            "kernels": len(recs),
+        }
+        from tilelang_mesh_tpu.observability import trace_enabled
+        if not trace_enabled():
+            # per-config semantics for --in-process mode: without
+            # tracing, _attach_observability won't reset for us (with
+            # tracing, it resets AFTER writing the trace artifacts the
+            # SoL rows must land in, so leave the state to it there)
+            _sol.reset()
+    except Exception as e:  # profiling must never take down a capture
+        rec["sol"] = {"error": f"{type(e).__name__}: {e}"}
+    return rec
+
+
 def _attach_observability(rec: dict, name: str) -> dict:
     """With TL_TPU_TRACE=1 in the child's environment, export this
     config's trace (Chrome JSON + JSONL under TL_TPU_TRACE_DIR) and
@@ -1970,6 +2005,7 @@ def _child_main(args) -> None:
         sys.stdout.flush()
         os._exit(3)
     rec = _attach_backend_state(rec)
+    rec = _attach_sol(rec, name)
     rec = _attach_observability(rec, name)
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
@@ -2209,6 +2245,7 @@ def main():
                                        rounds=1 if q else 3),
                     f"config {name}", cfg_timeout)
                 rec = _attach_backend_state(rec)
+                rec = _attach_sol(rec, name)
                 rec = _attach_observability(rec, name)
                 err = None
             except Exception as e:

@@ -1,0 +1,705 @@
+"""tl-sol suite: kernel-grain speed-of-light profiling, roofline gap
+attribution, and tuned-config drift detection (docs/observability.md
+"Speed-of-light profiling & drift").
+
+Six layers, mirroring the subsystem:
+
+1. **Analytic terms** — ``analytic_terms`` decomposes the roofline into
+   named terms whose total is bit-identical to ``analytic_ms`` (the
+   tuner and the profiler must never disagree about the prediction),
+   and names the dominant bottleneck.
+2. **Sampling** — ``TL_TPU_SOL=1`` alone turns the dispatch timing hook
+   on; sampled dispatches aggregate into per-kernel SoL records with
+   achieved/predicted/SoL%/gap attribution; off by default means ZERO
+   records (the fast-dispatch overhead gate stays honest).
+3. **Drift** — the seeded EWMA+MAD detector: stable under noise, fires
+   exactly once per episode (edge-triggered), re-fires after the
+   episode clears, resets its baseline on config or CODEGEN_VERSION
+   change, and every firing raises the counter + flight dump + retune
+   queue entry.
+4. **Fleet store** — checksummed atomic entries, corruption quarantined
+   (never trusted, never deleted), commutative idempotent merges, the
+   merge/list/stats CLI.
+5. **Surfaces** — the ``/prof`` endpoint, strict Prometheus exposition
+   (+Inf bucket == _count), ``analyzer sol`` / ``analyzer flight``,
+   the dash SoL trend column (old rounds missing-not-regressed), and
+   bench's ``sol`` field.
+6. **Serving soak** — a tuned bucket with an injected tiny prediction
+   drifts under real step latency: ``sol.drift`` fires, the flight
+   dump names the kernel/config, ``/prof`` lists the bucket.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import flight
+from tilelang_mesh_tpu.observability import sol
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _scale_func(mult=2.0, M=16, N=32):
+    @T.prim_func
+    def scale(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                s[i, j] = s[i, j] * mult
+            T.copy(s, B)
+    return scale
+
+
+def _feats(**over):
+    from tilelang_mesh_tpu.transform.plan import FEATURES_VERSION
+    base = {"version": FEATURES_VERSION, "flops": 1 << 30,
+            "hbm_bytes": 1 << 24, "vpu_elems": 0, "grid_steps": 16,
+            "vmem_arena": 1 << 20, "vmem_block_bytes": 1 << 18,
+            "n_scratch": 2, "n_params": 3, "pipelined": 1,
+            "block_rows": 128, "block_cols": 128, "block_skew": 1.0,
+            "dbuf_chains": 0}
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# 1. analytic terms
+# ---------------------------------------------------------------------------
+
+class TestAnalyticTerms:
+    def test_total_bit_identical_to_analytic_ms(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import (analytic_ms,
+                                                            analytic_terms)
+        for f in (_feats(), _feats(flops=1 << 36),
+                  _feats(hbm_bytes=1 << 32, flops=1 << 20),
+                  _feats(pipelined=0, dbuf_chains=0),
+                  _feats(vpu_elems=1 << 28, flops=0),
+                  _feats(grid_steps=4096)):
+            terms = analytic_terms(f)
+            assert terms["total_ms"] == analytic_ms(f)
+
+    def test_terms_and_bottleneck_named(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import analytic_terms
+        terms = analytic_terms(_feats())
+        for k in ("t_mxu_ms", "t_hbm_ms", "t_vpu_ms", "t_ici_ms",
+                  "t_serial_ms", "t_grid_ms", "roof", "bottleneck",
+                  "total_ms"):
+            assert k in terms
+        assert terms["roof"] in ("mxu", "hbm", "vpu")
+        assert terms["bottleneck"] in ("mxu", "hbm", "vpu", "ici",
+                                       "serial", "grid")
+        # a compute monster pins the roof (and bottleneck) on the MXU
+        big = analytic_terms(_feats(flops=1 << 44, hbm_bytes=1 << 10,
+                                    grid_steps=1))
+        assert big["roof"] == "mxu" and big["bottleneck"] == "mxu"
+
+
+# ---------------------------------------------------------------------------
+# 2. dispatch sampling -> SoL records
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def sol_on(monkeypatch, tmp_path):
+    """Profiling ON, every call sampled, hermetic cache dir."""
+    monkeypatch.setenv("TL_TPU_SOL", "1")
+    monkeypatch.setenv("TL_TPU_RUNTIME_SAMPLE", "1")
+    monkeypatch.delenv("TL_TPU_RUNTIME_METRICS", raising=False)
+    monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+    tilelang.clear_cache()
+    yield tmp_path
+    tilelang.clear_cache()
+
+
+class TestDispatchSampling:
+    def test_sampled_dispatch_builds_sol_record(self, sol_on):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.random.default_rng(0).random((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(4):
+            k(a, b)
+        recs = sol.sol_records()
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["kernel"] == "scale"
+        assert r["count"] >= 2              # first call warms, unsampled
+        assert r["achieved_ms"] > 0
+        assert r["predicted_ms"] > 0
+        assert 0 < r["sol_pct"] <= 1.5      # CPU achieved >> TPU roofline
+        assert r["bottleneck"] in ("mxu", "hbm", "vpu", "ici",
+                                   "serial", "grid")
+        for key in ("serialization_ms", "ici_ms", "grid_overhead_ms",
+                    "host_overhead_ms", "unexplained_ms"):
+            assert key in r["gap"]
+        # TL_TPU_SOL alone enabled the runtime timing hook
+        from tilelang_mesh_tpu.observability import runtime
+        assert runtime.runtime_enabled()
+        assert obs.get_tracer().counters()["sol.records"] == r["count"]
+
+    def test_off_by_default_no_records(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("TL_TPU_SOL", raising=False)
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+        tilelang.clear_cache()
+        k = tilelang.compile(_scale_func(3.0), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(3):
+            k(a, b)
+        assert sol.sol_records() == []
+        assert "sol.records" not in obs.get_tracer().counters()
+        tilelang.clear_cache()
+
+    def test_numerics_unchanged_under_profiling(self, sol_on):
+        k = tilelang.compile(_scale_func(2.0), target="cpu")
+        a = np.random.default_rng(1).random((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        k(a, b)
+        k(a, b)
+        np.testing.assert_allclose(b, a * 2.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 3. drift detection
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def drift_knobs(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT", "1")
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT_ALPHA", "0.5")
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT_WARMUP", "3")
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT_SUSTAIN", "2")
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT_MADS", "6")
+    monkeypatch.setenv("TL_TPU_SOL_DRIFT_MIN_REL", "0.5")
+
+
+class TestDrift:
+    def test_stable_under_seeded_noise(self, drift_knobs):
+        rng = np.random.default_rng(42)
+        for _ in range(80):
+            ev = sol.observe_bucket("wl", "b4:p2",
+                                    measured_ms=1.0 + rng.normal(0, 0.05),
+                                    predicted_ms=1.0, config={"b": 4})
+            assert ev is None
+        assert "sol.drift" not in obs.get_tracer().counters()
+        assert sol.retune_queue() == []
+
+    def test_fires_once_per_episode_then_refires(self, drift_knobs,
+                                                 tmp_path):
+        flight.configure(dump_dir=tmp_path)
+        events = [sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                                     predicted_ms=1.0, config={"b": 4})
+                  for _ in range(30)]
+        fired = [e for e in events if e is not None]
+        assert len(fired) == 1              # edge-triggered, once
+        ev = fired[0]
+        assert ev["episode"] == 1 and ev["ratio"] > 1.5
+        assert obs.get_tracer().counters()["sol.drift"] == 1
+        # clearing the episode re-arms the edge
+        for _ in range(30):
+            sol.observe_bucket("wl", "b4:p2", measured_ms=1.0,
+                               predicted_ms=1.0, config={"b": 4})
+        second = [sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                                     predicted_ms=1.0, config={"b": 4})
+                  for _ in range(30)]
+        refired = [e for e in second if e is not None]
+        assert len(refired) == 1 and refired[0]["episode"] == 2
+        assert obs.get_tracer().counters()["sol.drift"] == 2
+        # each firing wrote a flight dump naming kernel and config
+        dumps = sorted(tmp_path.glob("flight_*_sol_drift_*.jsonl"))
+        assert len(dumps) == 2
+        hdr = json.loads(dumps[0].read_text().splitlines()[0])
+        assert hdr["reason"] == "sol_drift"
+        assert hdr["attrs"]["kernel"] == "wl"
+        assert hdr["attrs"]["config"] == {"b": 4}
+
+    def test_baseline_resets_on_config_change(self, drift_knobs):
+        for _ in range(10):
+            sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                               predicted_ms=1.0, config={"b": 4})
+        # a retune landed: new config -> fresh baseline, back in warmup
+        ev = sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                                predicted_ms=1.0, config={"b": 8})
+        assert ev is None
+        st = sol.get_sol()._drift[("wl", "b4:p2")]
+        assert st.n == 1 and not st.in_episode
+
+    def test_baseline_resets_on_codegen_version(self, drift_knobs,
+                                                monkeypatch):
+        for _ in range(10):
+            sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                               predicted_ms=1.0, config={"b": 4})
+        assert sol.get_sol()._drift[("wl", "b4:p2")].in_episode
+        from tilelang_mesh_tpu.cache import kernel_cache
+        monkeypatch.setattr(kernel_cache, "CODEGEN_VERSION",
+                            "test-bumped")
+        ev = sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                                predicted_ms=1.0, config={"b": 4})
+        assert ev is None
+        assert sol.get_sol()._drift[("wl", "b4:p2")].n == 1
+
+    def test_retune_queue_order_cap_and_pop(self, drift_knobs,
+                                            monkeypatch):
+        monkeypatch.setenv("TL_TPU_SOL_RETUNE_MAX", "2")
+        for bucket in ("b1:p1", "b2:p2", "b3:p3"):
+            for _ in range(10):
+                sol.observe_bucket("wl", bucket, measured_ms=3.0,
+                                   predicted_ms=1.0, config={})
+        q = sol.retune_queue()
+        assert [e["bucket"] for e in q] == ["b2:p2", "b3:p3"]  # capped
+        assert sol.pop_retune()["bucket"] == "b2:p2"           # FIFO
+        assert [e["bucket"] for e in sol.retune_queue()] == ["b3:p3"]
+
+    def test_disabled_drift_never_fires(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_SOL_DRIFT", "0")
+        for _ in range(30):
+            assert sol.observe_bucket("wl", "b", 99.0, 1.0) is None
+        assert sol.retune_queue() == []
+
+
+# ---------------------------------------------------------------------------
+# 4. fleet store
+# ---------------------------------------------------------------------------
+
+def _entry(kernel="k", achieved=2.0, predicted=1.0, count=3, **over):
+    e = {"schema": sol.SOL_SCHEMA, "kernel": kernel, "arch": "tpu_v5e",
+         "count": count, "achieved_ms": achieved,
+         "predicted_ms": predicted,
+         "sol_pct": (predicted / achieved) if achieved else None,
+         "bottleneck": "hbm", "terms": None, "rewrites": [],
+         "host_overhead_ms": 0.01, "merges": 0}
+    e.update(over)
+    return e
+
+
+class TestSolStore:
+    def test_round_trip_checksummed(self, tmp_path):
+        store = sol.SolStore(tmp_path / "s")
+        key = store.key("k", "tpu_v5e")
+        store.record(key, _entry())
+        got = store.get(key)
+        assert got["kernel"] == "k" and got["achieved_ms"] == 2.0
+        assert got["checksum"] == sol.entry_checksum(got)
+        assert store.stats()["entries"] == 1
+
+    def test_corruption_quarantined_not_trusted(self, tmp_path):
+        store = sol.SolStore(tmp_path / "s")
+        key = store.key("k", "tpu_v5e")
+        store.record(key, _entry())
+        p = store._path(key)
+        body = json.loads(p.read_text())
+        body["achieved_ms"] = 0.0001      # forged: checksum now stale
+        p.write_text(json.dumps(body))
+        assert store.get(key) is None     # quarantine-and-miss
+        assert not p.exists()
+        qdir = store.root / sol.QUARANTINE_DIR
+        assert len(list(qdir.glob("*.json*"))) == 1
+        assert store.stats()["quarantined"] == 1
+        # a fresh record repopulates the slot
+        store.record(key, _entry(achieved=1.5))
+        assert store.get(key)["achieved_ms"] == 1.5
+
+    def test_merge_commutative_idempotent_best_wins(self):
+        a = _entry(achieved=2.0, count=3)
+        b = _entry(achieved=1.2, count=5)
+        ab = sol.merge_sol_payloads(a, b)
+        ba = sol.merge_sol_payloads(b, a)
+        assert ab["achieved_ms"] == ba["achieved_ms"] == 1.2
+        assert ab["count"] == ba["count"] == 5          # max, not sum
+        assert ab["sol_pct"] == pytest.approx(1.0 / 1.2)
+        aa = sol.merge_sol_payloads(a, a)
+        assert aa["merges"] == 0                        # fixed point
+        assert {k: v for k, v in aa.items() if k != "merges"} == \
+            {k: v for k, v in a.items() if k != "merges"}
+
+    def test_merge_from_dirs_and_cli(self, tmp_path, capsys):
+        src = sol.SolStore(tmp_path / "src")
+        src.record(src.key("k1", "a"), _entry(kernel="k1"))
+        src.record(src.key("k2", "a"), _entry(kernel="k2", achieved=4.0))
+        # corrupt source entry: skipped, counted, never adopted
+        bad = src.root / "deadbeef.json"
+        bad.write_text("{not json")
+        dst = sol.SolStore(tmp_path / "dst")
+        dst.record(dst.key("k2", "a"), _entry(kernel="k2", achieved=1.0))
+        stats = dst.merge_from([src.root])
+        assert stats == {"examined": 3, "new": 1, "merged": 0,
+                         "unchanged": 1, "corrupt": 1}
+        assert dst.get(dst.key("k2", "a"))["achieved_ms"] == 1.0
+        # the CLI spells the same merge + stats + list
+        assert sol.main(["merge", str(src.root), "--into",
+                         str(tmp_path / "dst2"), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["new"] == 2 and out["corrupt"] == 1
+        assert sol.main(["stats", "--root", str(tmp_path / "dst2"),
+                         "--json"]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["entries"] == 2 and st["quarantined"] == 0
+        assert sol.main(["list", "--root", str(tmp_path / "dst2")]) == 0
+        assert "k1" in capsys.readouterr().out
+
+    def test_write_store_from_live_profiler(self, sol_on, tmp_path):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(3):
+            k(a, b)
+        n = sol.write_store(tmp_path / "store")
+        assert n == 1
+        store = sol.SolStore(tmp_path / "store")
+        stats = store.stats()
+        assert stats["entries"] == 1 and stats["with_sol_pct"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. surfaces: sweep artifact, analyzer, /prof, Prometheus, bench, dash
+# ---------------------------------------------------------------------------
+
+def _sweep_artifact(tmp_path):
+    """A synthetic two-kernel sweep JSONL (what run_sweep writes)."""
+    rows = [
+        {"type": "sol_context", "schema": sol.SOL_SCHEMA, "kernels": 2,
+         "with_prediction": 2, "dispatched": 2},
+        {"type": "sol", "schema": sol.SOL_SCHEMA, "kernel": "gemm",
+         "count": 3, "achieved_ms": 2.0, "predicted_ms": 1.0,
+         "sol_pct": 0.5, "bottleneck": "mxu", "host_overhead_ms": 0.01,
+         "gap": {"serialization_ms": 0.0, "ici_ms": 0.0,
+                 "grid_overhead_ms": 0.1, "host_overhead_ms": 0.01,
+                 "unexplained_ms": 1.0}, "arch": "tpu_v5e"},
+        {"type": "sol", "schema": sol.SOL_SCHEMA, "kernel": "decode",
+         "count": 2, "achieved_ms": 4.0, "predicted_ms": None,
+         "sol_pct": None, "bottleneck": None, "arch": "tpu_v5e"},
+    ]
+    p = tmp_path / "sweep.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return p
+
+
+class TestAnalyzerSol:
+    def test_summarize_and_report(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        p = _sweep_artifact(tmp_path)
+        assert analyzer.main(["sol", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "2 kernel(s), 1 with an analytic prediction" in out
+        assert "gemm" in out and "50.0%" in out and "mxu" in out
+        assert analyzer.main(["sol", str(p), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["kernels"] == 2 and doc["with_prediction"] == 1
+        assert doc["rows"]["gemm"]["sol_pct"] == 0.5
+        assert doc["bottlenecks"] == {"mxu": 1}
+
+    def test_store_footer(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        store = sol.SolStore(tmp_path / "s")
+        store.record(store.key("k", "a"), _entry())
+        p = _sweep_artifact(tmp_path)
+        assert analyzer.main(["sol", str(p), "--store",
+                              str(store.root)]) == 0
+        assert "fleet sol store" in capsys.readouterr().out
+
+
+class TestAnalyzerFlight:
+    def test_dump_post_mortem(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        flight.configure(dump_dir=tmp_path)
+        tr = obs.get_tracer()
+        tr.inc("sol.records", 7)
+        tr.event("sol.drift", "sol", kernel="wl", bucket="b4:p2")
+        p = flight.dump("sol_drift", kernel="wl", bucket="b4:p2",
+                        config={"b": 4}, predicted_ms=1.0, ewma_ms=3.0,
+                        ratio=3.0)
+        assert p is not None
+        assert analyzer.main(["flight", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "reason=sol_drift" in out
+        assert "attr kernel = wl" in out
+        assert "sol.records" in out and "slo state" in out
+        assert analyzer.main(["flight", str(p), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["header"]["attrs"]["bucket"] == "b4:p2"
+        assert doc["counters"]["sol.records"] == 7
+        assert doc["ring"]["n"] >= 2
+
+    def test_non_dump_exits_nonzero(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        p = tmp_path / "not_a_dump.jsonl"
+        p.write_text(json.dumps({"type": "span", "name": "x"}) + "\n")
+        assert analyzer.main(["flight", str(p)]) == 1
+        assert "not a flight dump" in capsys.readouterr().out
+
+
+def _round(tmp_path, name, n, rc, records):
+    tail = "\n".join(json.dumps(r) for r in records)
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": n, "cmd": "bench", "rc": rc,
+                             "tail": tail}))
+    return str(p)
+
+
+class TestDashSolColumn:
+    def test_trend_column_and_old_rounds(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        # r01: pre-sol round (no sol field) — must still parse, and the
+        # column reads '-' (missing-not-regressed, never an error)
+        r1 = _round(tmp_path, "BENCH_r01.json", 1, 0,
+                    [{"config": "k", "latency_p50_ms": 1.0,
+                      "latency_mad_ms": 0.01}])
+        r2 = _round(tmp_path, "BENCH_r02.json", 2, 0,
+                    [{"config": "k", "latency_p50_ms": 1.01,
+                      "latency_mad_ms": 0.01,
+                      "sol": {"kernel": "gemm", "sol_pct": 0.42,
+                              "bottleneck": "mxu"}}])
+        assert analyzer.main(["dash", r1, r2, "--json"]) == 0
+        dash = json.loads(capsys.readouterr().out)
+        cells = dash["configs"]["k"]["cells"]
+        assert cells[0]["sol_pct"] is None
+        assert cells[1]["sol_pct"] == 0.42
+        assert dash["configs"]["k"]["sol_pct"] == 0.42   # latest wins
+        assert analyzer.main(["dash", r1, r2]) == 0
+        out = capsys.readouterr().out
+        assert "sol%" in out and "42.0%" in out
+
+    def test_checked_in_rounds_still_parse(self, capsys):
+        import glob
+        from pathlib import Path
+
+        from tilelang_mesh_tpu.tools import analyzer
+        repo = Path(__file__).resolve().parent.parent
+        rounds = sorted(glob.glob(str(repo / "BENCH_r0*.json")))
+        assert len(rounds) >= 5
+        assert analyzer.main(["dash", *rounds, "--json"]) == 0
+        dash = json.loads(capsys.readouterr().out)
+        # pre-sol rounds read '-' in the column: no config may ERROR
+        for cfg in dash["configs"].values():
+            assert "sol_pct" in cfg
+
+
+class TestProfEndpoint:
+    def test_prof_route_serves_snapshot(self, drift_knobs, monkeypatch,
+                                        tmp_path):
+        from tilelang_mesh_tpu.observability import server
+        monkeypatch.setenv("TL_TPU_SOL", "1")
+        flight.configure(dump_dir=tmp_path)
+        for _ in range(10):
+            sol.observe_bucket("FlashDecodeWorkload", "b4:p2",
+                               measured_ms=3.0, predicted_ms=1.0,
+                               config={"b": 4})
+        srv = server.start_server(port=0)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/prof",
+                                        timeout=5) as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            assert doc["schema"] == sol.SOL_SCHEMA
+            assert doc["enabled"] is True
+            assert doc["drift"]["episodes"] == 1
+            assert [e["bucket"] for e in doc["retune_queue"]] == \
+                ["b4:p2"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/nope", timeout=5)
+            assert ei.value.code == 404
+            assert "/prof" in ei.value.read().decode()
+        finally:
+            srv.stop()
+
+
+_EXPO_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*",?)*\})?'
+    r' [0-9eE+.\-]+(inf|nan)?$')
+
+
+def _parse_samples(text):
+    """name -> [(labels-dict-frozenset, value)] for every sample line."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _EXPO_LINE.match(line), f"unparseable exposition: {line!r}"
+        metric, val = line.rsplit(" ", 1)
+        if "{" in metric:
+            name, lab = metric.split("{", 1)
+            lab = lab.rstrip("}")
+            labels = frozenset(
+                m.group(0) for m in
+                re.finditer(r'[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"',
+                            lab))
+        else:
+            name, labels = metric, frozenset()
+        out.setdefault(name, []).append((labels, float(val)))
+    return out
+
+
+class TestPrometheusConformance:
+    def test_strict_grammar_inf_bucket_and_sol_series(self, sol_on,
+                                                      drift_knobs):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(4):
+            k(a, b)
+        for _ in range(10):
+            sol.observe_bucket("wl", "b4:p2", measured_ms=3.0,
+                               predicted_ms=1.0, config={})
+        text = obs.to_prometheus_text()
+        samples = _parse_samples(text)
+        # TYPE declared at most once per metric family
+        types = [ln.split()[2] for ln in text.splitlines()
+                 if ln.startswith("# TYPE")]
+        assert len(types) == len(set(types))
+        # every histogram: the cumulative +Inf bucket equals _count,
+        # per label set (strict exposition conformance)
+        bucket_names = [n for n in samples if n.endswith("_bucket")]
+        assert bucket_names, "expected at least one histogram"
+        for bname in bucket_names:
+            base = bname[:-len("_bucket")]
+            counts = dict(samples[f"{base}_count"])
+            infs = {}
+            for labels, val in samples[bname]:
+                le = next((x for x in labels if x.startswith('le="')),
+                          None)
+                if le == 'le="+Inf"':
+                    infs[labels - {le}] = val
+            assert infs, f"{bname} has no +Inf bucket"
+            for labels, val in infs.items():
+                assert counts[labels] == val
+        # the sol series made it out
+        assert any('kernel="scale"' in labels
+                   for labels, _ in samples["tl_tpu_sol_pct"])
+        assert samples["tl_tpu_sol_retune_queue_depth"][0][1] == 1.0
+        assert samples["tl_tpu_sol_drift"][0][1] == 1.0
+
+    def test_metrics_summary_has_sol_section(self, sol_on):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        k(a, b)
+        k(a, b)
+        summ = obs.metrics_summary()
+        assert summ["sol"]["enabled"] is True
+        assert "scale" in summ["sol"]["kernels"]
+
+    def test_jsonl_trace_carries_sol_rows(self, sol_on, tmp_path):
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(3):
+            k(a, b)
+        p = tmp_path / "trace.jsonl"
+        obs.write_jsonl(p)
+        rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+        assert any(r.get("type") == "sol_context" for r in rows)
+        srows = [r for r in rows if r.get("type") == "sol"]
+        assert len(srows) == 1 and srows[0]["kernel"] == "scale"
+
+
+class TestBenchAttachSol:
+    def test_attaches_dominant_kernel(self, sol_on):
+        import bench
+        k = tilelang.compile(_scale_func(), target="cpu")
+        a = np.ones((16, 32), np.float32)
+        b = np.zeros((16, 32), np.float32)
+        for _ in range(3):
+            k(a, b)
+        rec = bench._attach_sol({"config": "x"}, "x")
+        assert rec["sol"]["kernel"] == "scale"
+        assert 0 < rec["sol"]["sol_pct"] <= 1.5
+        assert rec["sol"]["bottleneck"]
+        assert rec["sol"]["kernels"] == 1
+        # without tracing, attach resets per-config state in-process
+        assert sol.sol_records() == []
+
+    def test_noop_when_disabled(self, monkeypatch):
+        import bench
+        monkeypatch.delenv("TL_TPU_SOL", raising=False)
+        rec = bench._attach_sol({"config": "x"}, "x")
+        assert "sol" not in rec
+
+
+class TestSweep:
+    def test_single_module_sweep_artifact(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TL_TPU_SOL", "1")   # run_sweep sets these;
+        monkeypatch.setenv("TL_TPU_RUNTIME_SAMPLE", "1")  # restore after
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+        tilelang.clear_cache()
+        out = tmp_path / "sweep.jsonl"
+        res = sol.run_sweep(out=str(out), modules="gemm", calls=1,
+                            store=str(tmp_path / "store"),
+                            write_to_store=True)
+        assert res["kernels"] >= 1
+        assert res["with_prediction"] >= 1
+        assert res["store_entries"] >= 1
+        rows = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert rows[0]["type"] == "sol_context"
+        srow = next(r for r in rows if r.get("type") == "sol")
+        assert 0 < srow["sol_pct"] <= 1.5 and srow["bottleneck"]
+        tilelang.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# 6. serving drift soak
+# ---------------------------------------------------------------------------
+
+class TestServingDriftSoak:
+    def test_injected_drift_raises_event_dump_and_prof(
+            self, drift_knobs, monkeypatch, tmp_path):
+        from tilelang_mesh_tpu.observability import server
+        from tilelang_mesh_tpu.serving import (FlashDecodeWorkload,
+                                               PagedKVAllocator,
+                                               ServingEngine)
+        monkeypatch.setenv("TL_TPU_AUTOTUNE_CACHE_DIR",
+                           str(tmp_path / "autotune"))
+        monkeypatch.delenv("TL_TPU_TUNE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("TL_TPU_CACHE_DIR", str(tmp_path / "kernels"))
+        monkeypatch.setenv("TL_TPU_SOL_DRIFT_WARMUP", "2")
+        monkeypatch.setenv("TL_TPU_SOL_DRIFT_SUSTAIN", "2")
+        flight.configure(dump_dir=tmp_path / "dumps")
+        tilelang.clear_cache()
+        alloc = PagedKVAllocator(n_pages=64, page_size=8, heads=2,
+                                 head_dim=64)
+        wl = FlashDecodeWorkload(alloc, batch_buckets=(4,),
+                                 page_buckets=(2, 4))
+        # the injection: publish an absurdly fast tuned latency so real
+        # CPU step time reads as sustained drift from the first steps
+        for pp in (2, 4):
+            assert wl.record_bucket_tuning(4, pp, {"probe": 1},
+                                           latency_ms=1e-6)
+        eng = ServingEngine(wl)
+        wl.warmup()
+        assert wl.tuned_prediction_ms(4, 2) == pytest.approx(1e-6)
+        for _ in range(4):
+            eng.submit(context_tokens=16, new_tokens=4)
+        eng.run()
+        counters = obs.get_tracer().counters()
+        assert counters.get("sol.drift", 0) >= 1
+        q = sol.retune_queue()
+        assert q and q[0]["kernel"] == "FlashDecodeWorkload"
+        assert q[0]["config"] == {"probe": 1}
+        dumps = list((tmp_path / "dumps").glob(
+            "flight_*_sol_drift_*.jsonl"))
+        assert dumps
+        hdr = json.loads(dumps[0].read_text().splitlines()[0])
+        assert hdr["attrs"]["kernel"] == "FlashDecodeWorkload"
+        assert hdr["attrs"]["config"] == {"probe": 1}
+        srv = server.start_server(port=0)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/prof",
+                                        timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert any(e["kernel"] == "FlashDecodeWorkload"
+                       for e in doc["retune_queue"])
+        finally:
+            srv.stop()
+        tilelang.clear_cache()

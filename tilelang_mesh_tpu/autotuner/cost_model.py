@@ -44,8 +44,9 @@ from ..carver.roller import TILE_OVERHEAD_S as _TILE_OVERHEAD_S
 from ..carver.roller import VPU_ELEMS_PER_S as _VPU_ELEMS_PER_S
 from ..transform.plan import FEATURES_VERSION
 
-__all__ = ["CostModel", "analytic_ms", "features_from_artifact",
-           "features_from_kernel", "rank_agreement", "FEATURES_VERSION"]
+__all__ = ["CostModel", "analytic_ms", "analytic_terms",
+           "features_from_artifact", "features_from_kernel",
+           "rank_agreement", "FEATURES_VERSION"]
 
 # ridge regularizer: heavy enough that a handful of seed samples can't
 # produce wild extrapolation, light enough to learn a systematic offset
@@ -86,23 +87,54 @@ def features_from_kernel(kernel) -> Optional[Dict[str, float]]:
     return features_from_artifact(getattr(kernel, "artifact", None))
 
 
-def analytic_ms(feats: Dict[str, float],
-                arch: Optional[TPUArch] = None) -> float:
-    """Deterministic roofline latency (ms) of one config's features
-    against an arch model. Never zero (ranking needs a total order)."""
+def analytic_terms(feats: Dict[str, float],
+                   arch: Optional[TPUArch] = None) -> Dict[str, object]:
+    """The roofline, term by term (ms): the public per-term breakdown
+    the tl-sol profiler joins measured latencies against.
+
+    Returns ``t_mxu_ms`` / ``t_hbm_ms`` / ``t_vpu_ms`` (the three
+    compute/traffic roofs), ``t_ici_ms`` (static collective wire time),
+    ``t_serial_ms`` (the serialization penalty when neither a
+    double-buffer chain nor a pipelined grid axis hides the HBM stream),
+    ``t_grid_ms`` (per-grid-step dispatch overhead), ``roof`` (which of
+    mxu/hbm/vpu binds), ``bottleneck`` (the single largest contributor
+    to the total — the roof term, ici, serial, or grid), and
+    ``total_ms``. :func:`analytic_ms` is exactly ``total_ms``, so SoL
+    attribution and the tuner's pruning can never disagree about what a
+    kernel should cost."""
     arch = arch or auto_arch()
     t_mxu = float(feats.get("flops") or 0) / (arch.bf16_tflops * 1e12)
     t_hbm = float(feats.get("hbm_bytes") or 0) / (arch.hbm_gbps * 1e9)
     t_vpu = float(feats.get("vpu_elems") or 0) / _VPU_ELEMS_PER_S
     t_ici = float(feats.get("wire_bytes") or 0) / (
         arch.ici_gbps_per_link * arch.ici_links * 1e9)
+    t_grid = float(feats.get("grid_steps") or 1) * _TILE_OVERHEAD_S
     t = max(t_mxu, t_hbm, t_vpu)
+    roof = "mxu" if t == t_mxu else ("hbm" if t == t_hbm else "vpu")
+    t_serial = 0.0
     if not (feats.get("dbuf_chains") or feats.get("pipelined")):
         # no double-buffer chain and no pipelined grid axis: the HBM
         # stream serializes behind compute instead of hiding under it
-        t += 0.5 * min(t_mxu, t_hbm)
-    t += t_ici + float(feats.get("grid_steps") or 1) * _TILE_OVERHEAD_S
-    return max(t * 1e3, 1e-9)
+        t_serial = 0.5 * min(t_mxu, t_hbm)
+        t += t_serial
+    t += t_ici + t_grid
+    contrib = {roof: max(t_mxu, t_hbm, t_vpu), "ici": t_ici,
+               "serial": t_serial, "grid": t_grid}
+    bottleneck = max(contrib, key=lambda k: contrib[k])
+    return {
+        "t_mxu_ms": t_mxu * 1e3, "t_hbm_ms": t_hbm * 1e3,
+        "t_vpu_ms": t_vpu * 1e3, "t_ici_ms": t_ici * 1e3,
+        "t_serial_ms": t_serial * 1e3, "t_grid_ms": t_grid * 1e3,
+        "roof": roof, "bottleneck": bottleneck,
+        "total_ms": max(t * 1e3, 1e-9),
+    }
+
+
+def analytic_ms(feats: Dict[str, float],
+                arch: Optional[TPUArch] = None) -> float:
+    """Deterministic roofline latency (ms) of one config's features
+    against an arch model. Never zero (ranking needs a total order)."""
+    return analytic_terms(feats, arch)["total_ms"]
 
 
 def _phi(feats: Dict[str, float], ana_ms: float) -> np.ndarray:

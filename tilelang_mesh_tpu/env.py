@@ -183,6 +183,34 @@ class Environment:
     TL_TPU_RUNTIME_METRICS = EnvVar("TL_TPU_RUNTIME_METRICS", False, bool)
     TL_TPU_RUNTIME_SAMPLE = EnvVar("TL_TPU_RUNTIME_SAMPLE", 1, int)
     TL_TPU_RUNTIME_RING = EnvVar("TL_TPU_RUNTIME_RING", 256, int)
+    # tl-sol speed-of-light profiler (observability/sol.py; docs/
+    # observability.md "Speed-of-light profiling & drift"): joins each
+    # sampled dispatch against the analytic roofline and emits per-kernel
+    # SoL records (achieved vs predicted, bottleneck, gap attribution).
+    # Off by default — turning it on also turns on dispatch sampling
+    # (same 1-in-TL_TPU_RUNTIME_SAMPLE cadence as the runtime ring).
+    TL_TPU_SOL = EnvVar("TL_TPU_SOL", False, bool)
+    # where SoL profile artifacts (content-addressed fleet-mergeable
+    # entries) land; empty derives <TL_TPU_TRACE_DIR>/sol
+    TL_TPU_SOL_DIR = EnvVar("TL_TPU_SOL_DIR", "")
+    # tuned-config drift detection: per-(kernel, bucket) EWMA+MAD
+    # baselines of serving-measured latency vs the tuned config's
+    # prediction. "0" disables the detector (SoL records stay on).
+    TL_TPU_SOL_DRIFT = EnvVar("TL_TPU_SOL_DRIFT", True, bool)
+    # EWMA smoothing factor for the baseline mean and absolute deviation
+    TL_TPU_SOL_DRIFT_ALPHA = EnvVar("TL_TPU_SOL_DRIFT_ALPHA", 0.25, float)
+    # drift threshold: EWMA must exceed predicted * (1 + MIN_REL) plus
+    # MADS robust-sigmas of observed noise before a sample counts as over
+    TL_TPU_SOL_DRIFT_MADS = EnvVar("TL_TPU_SOL_DRIFT_MADS", 6.0, float)
+    TL_TPU_SOL_DRIFT_MIN_REL = EnvVar("TL_TPU_SOL_DRIFT_MIN_REL",
+                                      0.5, float)
+    # samples before a fresh baseline may fire (EWMA needs to settle)
+    TL_TPU_SOL_DRIFT_WARMUP = EnvVar("TL_TPU_SOL_DRIFT_WARMUP", 8, int)
+    # consecutive over-threshold checks before a drift episode fires
+    # (edge-triggered: one sol.drift event + flight dump per episode)
+    TL_TPU_SOL_DRIFT_SUSTAIN = EnvVar("TL_TPU_SOL_DRIFT_SUSTAIN", 3, int)
+    # bound on the retune queue surfaced at /prof (oldest entries drop)
+    TL_TPU_SOL_RETUNE_MAX = EnvVar("TL_TPU_SOL_RETUNE_MAX", 64, int)
     # host dispatch fast path (jit/dispatch.py; docs/host_dispatch.md):
     # precompiled per-kernel dispatch plans — monomorphic warm-path
     # closure, single-tuple shape/dtype fingerprint, cached flag reads.
@@ -282,6 +310,12 @@ class Environment:
     def flight_dir(self) -> Path:
         raw = self.TL_TPU_FLIGHT_DIR
         p = Path(raw) if raw else Path(self.TL_TPU_TRACE_DIR) / "flight"
+        p.mkdir(parents=True, exist_ok=True)
+        return p
+
+    def sol_dir(self) -> Path:
+        raw = self.TL_TPU_SOL_DIR
+        p = Path(raw) if raw else Path(self.TL_TPU_TRACE_DIR) / "sol"
         p.mkdir(parents=True, exist_ok=True)
         return p
 

@@ -49,6 +49,7 @@ import time
 from typing import Callable, Optional, Tuple
 
 from ..observability import runtime as _runtime
+from ..observability import sol as _sol
 from ..utils.tensor import copy_back, to_jax
 from ..verify import runtime as _verify_rt
 
@@ -57,7 +58,8 @@ __all__ = ["DispatchPlan", "ENV_KEYS"]
 # the env vars whose RAW values the plan snapshots per call; order is
 # load-bearing only for the snapshot tuple comparison
 ENV_KEYS = ("TL_TPU_FAST_DISPATCH", "TL_TPU_DONATE",
-            "TL_TPU_RUNTIME_METRICS", "TL_TPU_SANITIZE", "TL_TPU_FAULTS")
+            "TL_TPU_RUNTIME_METRICS", "TL_TPU_SANITIZE", "TL_TPU_FAULTS",
+            "TL_TPU_SOL")
 
 _TRUE = ("1", "true", "yes", "on")
 _getenv = os.environ.get
@@ -86,7 +88,7 @@ class DispatchPlan:
         "kernel", "name", "n_in", "n_all", "expected_fp", "inout_results",
         "donate_argnums", "out_names", "jax", "jax_array",
         "_env_snap", "fast_on", "donate_on", "metrics_on", "sanitize_on",
-        "_donate_cache", "unproven_out", "proven_out_count",
+        "sol_on", "_donate_cache", "unproven_out", "proven_out_count",
     )
 
     def __init__(self, kernel):
@@ -132,10 +134,13 @@ class DispatchPlan:
         """Re-derive the per-call flags from a fresh raw-env snapshot
         (runs only when a watched env var actually changed)."""
         self._env_snap = snap
-        fast, donate, metrics, sanitize, _ = snap
+        fast, donate, metrics, sanitize, _, sol = snap
         self.fast_on = _flag(fast, True)
         self.donate_on = _flag(donate, True) and bool(self.donate_argnums)
-        self.metrics_on = _flag(metrics, False)
+        self.sol_on = _flag(sol, False)
+        # the SoL profiler rides the sampled timing path, so turning it
+        # on alone turns sampling on (same cadence as the runtime ring)
+        self.metrics_on = _flag(metrics, False) or self.sol_on
         self.sanitize_on = _sanitize_mode(sanitize)
 
     # -- failover / rebuild interplay ---------------------------------
@@ -244,10 +249,13 @@ class DispatchPlan:
             # dispatch-to-sync (t1 onward), the same window the pre-PR
             # recorder measured.
             t3 = time.perf_counter()
-            _runtime.record_overhead(self.name, (t1 - t0) + (t3 - t2),
-                                     path="fast")
+            host_s = (t1 - t0) + (t3 - t2)
+            _runtime.record_overhead(self.name, host_s, path="fast")
             self.jax.block_until_ready(results)
-            _runtime.record(self.name, time.perf_counter() - t1)
+            e2e_s = time.perf_counter() - t1
+            _runtime.record(self.name, e2e_s)
+            if self.sol_on:
+                _sol.note_dispatch(kernel, e2e_s, host_s, name=self.name)
         delivered = 0
         if not all_jax and self.inout_results:
             for oi, ii in self.inout_results:

@@ -17,6 +17,7 @@ from typing import Optional, Sequence
 from ..engine.param import CompiledArtifact
 from ..env import env
 from ..observability import runtime as _runtime
+from ..observability import sol as _sol
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from ..resilience.errors import TLError, classify
@@ -298,15 +299,16 @@ class JITKernel:
             self._plan.run_sanitizer(results,
                                      mode=_verify_rt.sanitize_mode())
         if _rt_t0:
-            _runtime.record_overhead(
-                self.artifact.name,
-                (_rt_td - _rt_t0) + (time.perf_counter() - _post_t0),
-                path="legacy")
+            _rt_host = (_rt_td - _rt_t0) + (time.perf_counter() - _post_t0)
+            _runtime.record_overhead(self.artifact.name, _rt_host,
+                                     path="legacy")
             # block on the FULL result pytree: a multi-output kernel's
             # latency must include every sibling, not just the first leaf
             _jax.block_until_ready(results)
-            _runtime.record(self.artifact.name,
-                            time.perf_counter() - _rt_td)
+            _rt_e2e = time.perf_counter() - _rt_td
+            _runtime.record(self.artifact.name, _rt_e2e)
+            _sol.note_dispatch(self, _rt_e2e, _rt_host,
+                               name=self.artifact.name)
         delivered = set()
         for oi, ii in self._inout_results:
             if not isinstance(ins[ii], _jax.Array):

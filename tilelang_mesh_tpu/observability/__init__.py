@@ -29,6 +29,7 @@ from . import histogram as _histogram
 from . import reqtrace  # noqa: F401  (tl-scope: per-request causal tracing)
 from . import runtime as _runtime
 from . import slo as _slo
+from . import sol  # noqa: F401  (tl-sol: speed-of-light profiling + drift)
 from .tracer import (Span, Tracer, event, get_tracer, inc, span,
                      trace_enabled)
 from .tracer import reset as _tracer_reset
@@ -43,18 +44,22 @@ from .export import (LOWER_PHASES, aggregate_spans, escape_label_value,
                      write_jsonl)
 from .reqtrace import REQTRACE_SCHEMA  # noqa: F401
 from .slo import SLOEngine, get_slo, slo_summary  # noqa: F401
+from .sol import (SOL_SCHEMA, SolStore, note_dispatch,  # noqa: F401
+                  observe_bucket, prof_snapshot, sol_enabled,
+                  sol_records, sol_summary)
 
 
 def reset() -> None:
     """Drop every recorded span, event, counter, histogram, runtime
-    ring buffer, request-trace chain, flight ring, and SLO sample
-    (tests, bench children)."""
+    ring buffer, request-trace chain, flight ring, SLO sample, and SoL
+    aggregate (tests, bench children)."""
     _tracer_reset()
     _histogram.reset()
     _runtime.reset()
     reqtrace.reset()
     flight.reset()
     _slo.reset()
+    sol.reset()
 
 
 __all__ = [
@@ -71,4 +76,7 @@ __all__ = [
     # runtime dispatch recording
     "HIST_NAME", "OVERHEAD_HIST", "runtime_enabled", "should_sample",
     "record", "record_overhead", "recent", "runtime_summary",
+    # tl-sol: speed-of-light profiling + drift detection
+    "sol", "SOL_SCHEMA", "SolStore", "sol_enabled", "note_dispatch",
+    "observe_bucket", "sol_records", "sol_summary", "prof_snapshot",
 ]

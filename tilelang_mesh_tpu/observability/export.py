@@ -152,6 +152,15 @@ def to_jsonl(tracer: Optional[Tracer] = None) -> str:
                           "labels": dict(labels), **h.to_dict()})
               for (name, labels), h in sorted(_hist.histograms())
               if h.count]
+    sol_recs = _sol_records_safe()
+    if sol_recs:
+        from . import sol as _sol
+        lines.append(json.dumps({
+            "type": "sol_context", "schema": _sol.SOL_SCHEMA,
+            "kernels": len(sol_recs),
+            "drift": _json_safe(_sol.get_sol().drift_summary()),
+            "retune_queue": _json_safe(_sol.retune_queue())}))
+        lines += [json.dumps(_json_safe(r)) for r in sol_recs]
     chains = _reqtrace.traces()
     if chains:
         lines.append(json.dumps({
@@ -160,6 +169,16 @@ def to_jsonl(tracer: Optional[Tracer] = None) -> str:
             "traces": len(chains), "evicted": _reqtrace.evicted()}))
         lines += [json.dumps(_json_safe(tr.to_dict())) for tr in chains]
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _sol_records_safe() -> List[dict]:
+    """The tl-sol per-kernel records, or [] — a torn SoL join must
+    never make a trace artifact unwritable."""
+    try:
+        from . import sol as _sol
+        return _sol.sol_records()
+    except Exception:
+        return []
 
 
 def write_jsonl(path, tracer: Optional[Tracer] = None) -> Path:
@@ -228,7 +247,34 @@ def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
         lines.append(f"{mname}_seconds_count {len(durs)}")
         lines.append(f"{mname}_seconds_sum {sum(durs) / 1e6:.9g}")
     lines.extend(_prometheus_histogram_lines())
+    lines.extend(_prometheus_sol_lines())
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prometheus_sol_lines() -> List[str]:
+    """tl-sol gauges: per-kernel speed-of-light fraction (labelled by
+    kernel and dominant bottleneck term) and the retune-queue depth.
+    The sol.* activity counters (records/drift/retune.enqueued) already
+    flow through the ordinary counter exposition above as
+    ``tl_tpu_sol_*``."""
+    recs = _sol_records_safe()
+    lines: List[str] = []
+    with_pct = [r for r in recs if r.get("sol_pct")]
+    if with_pct:
+        lines.append("# TYPE tl_tpu_sol_pct gauge")
+        for r in with_pct:
+            lab = (f'kernel="{escape_label_value(r["kernel"])}",'
+                   f'bottleneck="{escape_label_value(r.get("bottleneck") or "?")}"')
+            lines.append(f"tl_tpu_sol_pct{{{lab}}} {r['sol_pct']:g}")
+    try:
+        from . import sol as _sol
+        queue = _sol.retune_queue() if recs or _sol.sol_enabled() else None
+    except Exception:
+        queue = None
+    if queue is not None:
+        lines.append("# TYPE tl_tpu_sol_retune_queue_depth gauge")
+        lines.append(f"tl_tpu_sol_retune_queue_depth {len(queue)}")
+    return lines
 
 
 def _prometheus_histogram_lines() -> List[str]:
@@ -582,6 +628,13 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         except Exception:
             return None
 
+    def _sol_section():
+        try:
+            from . import sol as _sol
+            return _sol.sol_summary()
+        except Exception:
+            return None
+
     req_traces = _reqtrace.traces(kind="request")
     reqtrace = {
         "traces": len(req_traces),
@@ -595,7 +648,7 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
             "verify": verify, "lint": lint, "tile_opt": tile_opt,
             "autotune": autotune, "serving": serving,
             "slo": _slo_section(), "flight": _flight_section(),
-            "reqtrace": reqtrace,
+            "sol": _sol_section(), "reqtrace": reqtrace,
             "runtime": _runtime.runtime_summary()}
 
 

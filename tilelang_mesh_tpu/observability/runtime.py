@@ -51,8 +51,10 @@ OVERHEAD_HIST = "dispatch.overhead"
 
 
 def runtime_enabled() -> bool:
-    """One env read — the single gate the dispatch hot path checks."""
-    return bool(env.TL_TPU_RUNTIME_METRICS)
+    """One env read — the single gate the dispatch hot path checks.
+    ``TL_TPU_SOL=1`` implies sampling too: the tl-sol profiler
+    (observability/sol.py) rides the same sampled timing path."""
+    return bool(env.TL_TPU_RUNTIME_METRICS) or bool(env.TL_TPU_SOL)
 
 
 class _KernelState:

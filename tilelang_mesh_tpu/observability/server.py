@@ -8,6 +8,10 @@ state while it serves traffic:
 - ``/healthz``  — liveness + the backend-registry health snapshot
 - ``/slo``      — the sliding-window SLO summary (``slo.slo_summary``)
 - ``/flight``   — the flight recorder's ring + dump accounting
+- ``/prof``     — the tl-sol profiler snapshot: per-kernel
+  speed-of-light records, drift-detector state, and the retune queue
+  of buckets whose measured latency drifted from their tuned config's
+  prediction (``sol.prof_snapshot``)
 
 Enable with ``TL_TPU_METRICS_PORT=<port>`` — a :class:`ServingEngine`
 calls :func:`maybe_start` at construction, so a serving process scrapes
@@ -69,11 +73,16 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import flight as _flight
                 self._send(json.dumps(_flight.snapshot()),
                            "application/json")
+            elif path == "/prof":
+                from . import sol as _sol
+                self._send(json.dumps(_sol.prof_snapshot()),
+                           "application/json")
             else:
                 self._send(json.dumps({
                     "error": "not found",
                     "endpoints": ["/metrics", "/healthz", "/slo",
-                                  "/flight"]}), "application/json", 404)
+                                  "/flight", "/prof"]}),
+                           "application/json", 404)
         except Exception as e:  # noqa: BLE001 — a scrape must not crash
             self._send(json.dumps({"error": f"{type(e).__name__}: {e}"}),
                        "application/json", 500)

@@ -1,0 +1,876 @@
+"""tl-sol: kernel-grain speed-of-light profiling, roofline gap
+attribution, and tuned-config drift detection (docs/observability.md
+"Speed-of-light profiling & drift").
+
+Three cooperating pieces, all gated on ``TL_TPU_SOL``:
+
+- **SoL records** — the jit dispatch path's sampled timing hook
+  (jit/dispatch.py, jit/kernel.py) calls :func:`note_dispatch` with the
+  device-side latency and the host-side marshalling overhead of every
+  sampled ``JITKernel`` call. The profiler joins the measurement against
+  the analytic roofline terms (``autotuner/cost_model.analytic_terms``
+  over ``attrs["features"]``) and aggregates a per-kernel
+  **speed-of-light record**: achieved vs predicted latency, SoL %
+  (predicted / achieved), the dominant bottleneck term, a gap
+  attribution (modeled serialization / ICI / grid overheads above the
+  pure roof, measured host overhead, and the unexplained remainder),
+  and which tile-opt rewrites fired (``attrs["tile_opt"]``).
+
+- **Drift detection** — serving's per-step tick
+  (serving/engine.py ``_sol_tick``) feeds :func:`observe_bucket` with
+  each bucket's measured step latency and the tuned config's cost-model
+  prediction (``best_latency_ms`` from the fleet tune cache). A
+  per-(kernel, bucket) EWMA+MAD baseline fires a ``sol.drift`` event
+  when the smoothed latency sustainedly exceeds the prediction beyond
+  both a relative floor and the observed noise band — edge-triggered
+  like an SLO breach (once per episode), with a flight-recorder dump
+  naming the kernel/config and the bucket enqueued on a bounded
+  **retune queue** served at the HTTP endpoint ``/prof``. The baseline
+  resets whenever the tuned config or CODEGEN_VERSION changes.
+
+- **Fleet-mergeable profile artifacts** — :class:`SolStore` persists
+  per-kernel SoL entries content-addressed on (kernel, arch,
+  CODEGEN_VERSION, schema) with the kernel-cache discipline (atomic
+  writes, checksummed entries, quarantine-never-delete) and a
+  commutative idempotent merge, mirroring ``autotuner/tune_cache.py``::
+
+      python -m tilelang_mesh_tpu.observability.sol merge <dir>...
+
+  The same CLI's ``sweep`` subcommand compiles and dispatches every
+  non-mesh ops kernel with profiling on and writes the SoL table as a
+  JSONL artifact for ``analyzer sol``.
+
+Import discipline: like the rest of the observability core, this module
+only depends on ``env``, ``tracer`` and ``flight`` at import time; the
+cost model, arch model and kernel cache are imported lazily inside the
+sampled paths so every layer can import observability without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..env import env
+from . import flight as _flight
+from . import tracer as _trace
+
+try:
+    import fcntl
+except ImportError:          # non-POSIX: locking degrades to process-local
+    fcntl = None  # type: ignore[assignment]
+
+logger = logging.getLogger("tilelang_mesh_tpu.sol")
+
+__all__ = ["SOL_SCHEMA", "SolProfiler", "SolStore", "get_sol",
+           "sol_enabled", "drift_enabled", "note_dispatch",
+           "observe_bucket", "sol_records", "sol_summary",
+           "prof_snapshot", "retune_queue", "pop_retune", "write_store",
+           "merge_sol_payloads", "reset", "main"]
+
+#: SoL record/entry format version: part of the store key, so a schema
+#: change starts a fresh namespace instead of tripping over old entries
+SOL_SCHEMA = 1
+QUARANTINE_DIR = ".quarantine"
+
+
+def sol_enabled() -> bool:
+    """One env read — the gate every SoL recording path checks."""
+    return bool(env.TL_TPU_SOL)
+
+
+def drift_enabled() -> bool:
+    return bool(env.TL_TPU_SOL_DRIFT)
+
+
+# ---------------------------------------------------------------------------
+# per-kernel speed-of-light aggregation
+# ---------------------------------------------------------------------------
+
+class _KernelSol:
+    """Running aggregate of one kernel's sampled dispatches."""
+
+    __slots__ = ("count", "min_ms", "ewma_ms", "last_ms", "host_ewma_ms")
+
+    def __init__(self):
+        self.count = 0
+        self.min_ms = float("inf")
+        self.ewma_ms = 0.0
+        self.last_ms = 0.0
+        self.host_ewma_ms = 0.0
+
+
+class _DriftState:
+    """EWMA+MAD baseline of one (kernel, bucket)'s measured latency."""
+
+    __slots__ = ("fingerprint", "ewma", "dev", "n", "over", "in_episode",
+                 "episodes", "predicted_ms", "config")
+
+    def __init__(self, fingerprint: str):
+        self.fingerprint = fingerprint
+        self.ewma: Optional[float] = None
+        self.dev = 0.0           # EWMA of |x - ewma|: a robust MAD proxy
+        self.n = 0
+        self.over = 0            # consecutive over-threshold checks
+        self.in_episode = False
+        self.episodes = 0
+        self.predicted_ms: Optional[float] = None
+        self.config: Optional[dict] = None
+
+
+def _resolve_static(kernel: Any, name: str) -> dict:
+    """Per-kernel facts that never change between samples: the analytic
+    roofline terms from the lowered artifact's features, the tile-opt
+    rewrites that fired, and the arch the prediction was made for.
+    Resolved once per kernel (outside the profiler lock — the cost-model
+    import and feature walk are the expensive part of a first sample)."""
+    info: dict = {"predicted_ms": None, "terms": None, "bottleneck": None,
+                  "rewrites": [], "arch": None}
+    try:
+        art = getattr(kernel, "artifact", None)
+        attrs = dict(getattr(art, "attrs", None) or {})
+        topt = attrs.get("tile_opt") or {}
+        info["rewrites"] = list(topt.get("rewrites") or [])
+        from ..autotuner.cost_model import (analytic_terms,
+                                            features_from_artifact)
+        from ..carver.arch import auto_arch
+        arch = auto_arch()
+        info["arch"] = getattr(arch, "name", None)
+        feats = features_from_artifact(art)
+        if feats:
+            terms = analytic_terms(feats, arch)
+            info["terms"] = terms
+            info["predicted_ms"] = float(terms["total_ms"])
+            info["bottleneck"] = terms["bottleneck"]
+    except Exception as e:      # a kernel without features still gets
+        info["error"] = f"{type(e).__name__}: {e}"   # achieved-only rows
+    return info
+
+
+def _codegen_version() -> str:
+    try:
+        from ..cache.kernel_cache import CODEGEN_VERSION
+        return str(CODEGEN_VERSION)
+    except Exception:
+        return "?"
+
+
+class SolProfiler:
+    """Aggregates sampled dispatches into per-kernel SoL records and
+    runs the per-bucket drift detector. One process-wide instance."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: Dict[str, _KernelSol] = {}
+        self._static: Dict[str, dict] = {}
+        self._drift: Dict[Tuple[str, str], _DriftState] = {}
+        self._retune: "OrderedDict[Tuple[str, str], dict]" = OrderedDict()
+        self._retune_seq = 0
+
+    # -- SoL records ---------------------------------------------------
+    def note_dispatch(self, kernel: Any, device_s: float,
+                      host_s: float = 0.0,
+                      name: Optional[str] = None) -> None:
+        """One sampled dispatch: device-side latency (seconds, e2e to
+        ``block_until_ready``) plus the host marshalling overhead the
+        dispatch path measured around it."""
+        if name is None:
+            art = getattr(kernel, "artifact", None)
+            name = getattr(art, "name", None) or type(kernel).__name__
+        if name not in self._static:
+            static = _resolve_static(kernel, name)   # outside the lock
+        else:
+            static = None
+        ms = device_s * 1e3
+        host_ms = max(host_s, 0.0) * 1e3
+        with self._lock:
+            if static is not None:
+                self._static.setdefault(name, static)
+            st = self._kernels.get(name)
+            if st is None:
+                st = self._kernels[name] = _KernelSol()
+            st.count += 1
+            st.last_ms = ms
+            if ms < st.min_ms:
+                st.min_ms = ms
+            a = 0.25
+            st.ewma_ms = ms if st.count == 1 else \
+                (1 - a) * st.ewma_ms + a * ms
+            st.host_ewma_ms = host_ms if st.count == 1 else \
+                (1 - a) * st.host_ewma_ms + a * host_ms
+        _trace.inc("sol.records")
+
+    def _record_locked(self, name: str) -> dict:
+        st = self._kernels[name]
+        info = self._static.get(name) or {}
+        achieved = st.min_ms if st.count else None
+        rec: dict = {
+            "type": "sol", "schema": SOL_SCHEMA, "kernel": name,
+            "count": st.count, "achieved_ms": achieved,
+            "ewma_ms": st.ewma_ms, "last_ms": st.last_ms,
+            "host_overhead_ms": st.host_ewma_ms,
+            "predicted_ms": info.get("predicted_ms"),
+            "bottleneck": info.get("bottleneck"),
+            "terms": info.get("terms"),
+            "rewrites": info.get("rewrites") or [],
+            "arch": info.get("arch"),
+        }
+        pred = rec["predicted_ms"]
+        if pred and achieved and achieved > 0:
+            rec["sol_pct"] = pred / achieved
+            gap = max(0.0, achieved - pred)
+            terms = info.get("terms") or {}
+            # gap attribution: the modeled overheads above the pure
+            # compute/traffic roof (already inside predicted_ms), the
+            # measured host overhead riding outside the device window,
+            # and whatever the roofline cannot account for
+            rec["gap_ms"] = gap
+            rec["gap"] = {
+                "serialization_ms": terms.get("t_serial_ms", 0.0),
+                "ici_ms": terms.get("t_ici_ms", 0.0),
+                "grid_overhead_ms": terms.get("t_grid_ms", 0.0),
+                "host_overhead_ms": st.host_ewma_ms,
+                "unexplained_ms": gap,
+            }
+        else:
+            rec["sol_pct"] = None
+        return rec
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return [self._record_locked(n) for n in sorted(self._kernels)]
+
+    # -- drift detection -----------------------------------------------
+    def observe_bucket(self, kernel: str, bucket: str, measured_ms: float,
+                       predicted_ms: Optional[float],
+                       config: Optional[dict] = None,
+                       **attrs) -> Optional[dict]:
+        """One serving-measured latency for a tuned (kernel, bucket).
+        Returns the drift event dict when this observation *fires* a new
+        drift episode, else None. Fires once per episode (edge-triggered
+        like an SLO breach); the episode clears when the EWMA drops back
+        under the threshold. The baseline resets whenever the tuned
+        config or CODEGEN_VERSION changes."""
+        if not drift_enabled():
+            return None
+        if not predicted_ms or predicted_ms <= 0 or measured_ms < 0:
+            return None
+        fp = hashlib.sha256(
+            (json.dumps(config or {}, sort_keys=True, default=str)
+             + "|" + _codegen_version()).encode()).hexdigest()
+        key = (str(kernel), str(bucket))
+        alpha = min(max(float(env.TL_TPU_SOL_DRIFT_ALPHA), 1e-3), 1.0)
+        warmup = max(int(env.TL_TPU_SOL_DRIFT_WARMUP), 1)
+        sustain = max(int(env.TL_TPU_SOL_DRIFT_SUSTAIN), 1)
+        event: Optional[dict] = None
+        with self._lock:
+            st = self._drift.get(key)
+            if st is None or st.fingerprint != fp:
+                st = self._drift[key] = _DriftState(fp)
+            st.predicted_ms = float(predicted_ms)
+            st.config = config
+            if st.ewma is None:
+                st.ewma = float(measured_ms)
+            else:
+                st.dev = (1 - alpha) * st.dev + \
+                    alpha * abs(measured_ms - st.ewma)
+                st.ewma = (1 - alpha) * st.ewma + alpha * measured_ms
+            st.n += 1
+            if st.n < warmup:
+                return None
+            sigma = 1.4826 * st.dev       # MAD -> sigma under normality
+            threshold = predicted_ms * (
+                1.0 + float(env.TL_TPU_SOL_DRIFT_MIN_REL)) + \
+                float(env.TL_TPU_SOL_DRIFT_MADS) * sigma
+            if st.ewma > threshold:
+                st.over += 1
+                if st.over >= sustain and not st.in_episode:
+                    st.in_episode = True
+                    st.episodes += 1
+                    event = {
+                        "kernel": key[0], "bucket": key[1],
+                        "config": config, "predicted_ms": st.predicted_ms,
+                        "ewma_ms": st.ewma, "dev_ms": st.dev,
+                        "threshold_ms": threshold,
+                        "ratio": st.ewma / st.predicted_ms,
+                        "samples": st.n, "episode": st.episodes,
+                    }
+                    event.update(attrs)
+            else:
+                st.over = 0
+                st.in_episode = False
+        if event is not None:
+            self._fire_drift(event)
+        return event
+
+    def _fire_drift(self, ev: dict) -> None:
+        """Side effects of a drift episode (outside the profiler lock:
+        the flight dump and tracer take their own locks)."""
+        _trace.inc("sol.drift")
+        _trace.event("sol.drift", "sol", kernel=ev["kernel"],
+                     bucket=ev["bucket"],
+                     ratio=round(ev["ratio"], 3),
+                     predicted_ms=ev["predicted_ms"])
+        _flight.dump("sol_drift", kernel=ev["kernel"], bucket=ev["bucket"],
+                     config=ev.get("config"),
+                     predicted_ms=ev["predicted_ms"],
+                     ewma_ms=ev["ewma_ms"], ratio=ev["ratio"])
+        with self._lock:
+            key = (ev["kernel"], ev["bucket"])
+            self._retune_seq += 1
+            entry = dict(ev, seq=self._retune_seq)
+            self._retune.pop(key, None)   # re-drift moves to the back
+            self._retune[key] = entry
+            cap = max(int(env.TL_TPU_SOL_RETUNE_MAX), 1)
+            while len(self._retune) > cap:
+                self._retune.popitem(last=False)
+        _trace.inc("sol.retune.enqueued")
+        logger.warning(
+            "sol drift: %s bucket %s measured %.4f ms vs tuned "
+            "prediction %.4f ms (x%.2f) — bucket enqueued for retune",
+            ev["kernel"], ev["bucket"], ev["ewma_ms"], ev["predicted_ms"],
+            ev["ratio"])
+
+    def retune_queue(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._retune.values()]
+
+    def pop_retune(self) -> Optional[dict]:
+        """Dequeue the oldest drifted bucket (what a background retuner
+        consumes)."""
+        with self._lock:
+            if not self._retune:
+                return None
+            _key, entry = self._retune.popitem(last=False)
+            return entry
+
+    # -- summaries -----------------------------------------------------
+    def drift_summary(self) -> dict:
+        with self._lock:
+            active = [
+                {"kernel": k[0], "bucket": k[1], "ewma_ms": st.ewma,
+                 "predicted_ms": st.predicted_ms, "episodes": st.episodes}
+                for k, st in self._drift.items() if st.in_episode]
+            return {
+                "enabled": drift_enabled(),
+                "states": len(self._drift),
+                "episodes": sum(st.episodes
+                                for st in self._drift.values()),
+                "active": active,
+            }
+
+    def summary(self) -> dict:
+        recs = self.records()
+        return {
+            "enabled": sol_enabled(),
+            "samples": sum(r["count"] for r in recs),
+            "kernels": {r["kernel"]: r for r in recs},
+            "drift": self.drift_summary(),
+            "retune_queue": self.retune_queue(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+            self._static.clear()
+            self._drift.clear()
+            self._retune.clear()
+            self._retune_seq = 0
+
+
+# ---------------------------------------------------------------------------
+# fleet-mergeable profile artifacts (tune_cache discipline)
+# ---------------------------------------------------------------------------
+
+def _canonical(payload: dict) -> str:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def entry_checksum(payload: dict) -> str:
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    return hashlib.sha256(_canonical(body).encode()).hexdigest()
+
+
+def _sol_body(payload: dict) -> dict:
+    """The entry minus its provenance (checksum, merge counter): what
+    idempotence and unchanged-detection are judged on."""
+    return {k: v for k, v in payload.items()
+            if k not in ("checksum", "merges")}
+
+
+def merge_sol_payloads(a: dict, b: dict) -> dict:
+    """Commutative, idempotent merge of two SoL entries for the SAME
+    key: best (lowest) achieved latency wins, sample counts take the
+    max (re-merging the same artifact must be a fixed point, so counts
+    never double), SoL % is re-derived from the merged achieved. The
+    merge counter bumps only when the merge actually changed the body,
+    mirroring ``tune_cache.merge_payloads``."""
+    la, lb = a.get("achieved_ms"), b.get("achieved_ms")
+    best, other = (a, b) if (
+        lb is None or (la is not None and la <= lb)) else (b, a)
+    out = _sol_body(best)
+    out["count"] = max(int(a.get("count") or 0), int(b.get("count") or 0))
+    hosts = [s.get("host_overhead_ms") for s in (a, b)
+             if s.get("host_overhead_ms") is not None]
+    if hosts:
+        out["host_overhead_ms"] = min(hosts)
+    pred = out.get("predicted_ms")
+    ach = out.get("achieved_ms")
+    out["sol_pct"] = (pred / ach) if (pred and ach) else None
+    changed = _canonical(_sol_body(a)) != _canonical(out)
+    out["merges"] = max(int(a.get("merges") or 0),
+                        int(b.get("merges") or 0)) + (1 if changed else 0)
+    return out
+
+
+class SolStore:
+    """One directory of checksummed, atomically-written SoL entries,
+    content-addressed on (kernel, arch, CODEGEN_VERSION, schema) —
+    the same crash-safe fleet discipline as the tune cache."""
+
+    def __init__(self, root=None):
+        self.root = Path(root) if root is not None else env.sol_dir()
+
+    @staticmethod
+    def key(kernel: str, arch: str) -> str:
+        h = hashlib.sha256()
+        h.update(str(kernel).encode())
+        h.update(str(arch).encode())
+        h.update(_codegen_version().encode())
+        h.update(str(SOL_SCHEMA).encode())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> Path:
+        return self.root / f"{key}.json"
+
+    @contextlib.contextmanager
+    def _key_lock(self, key: str):
+        if fcntl is None:
+            yield
+            return
+        lock_dir = self.root / ".locks"
+        lock_dir.mkdir(parents=True, exist_ok=True)
+        fd = os.open(lock_dir / f"{key}.lock", os.O_CREAT | os.O_RDWR,
+                     0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _quarantine(self, path: Path, reason: str) -> None:
+        qroot = self.root / QUARANTINE_DIR
+        qroot.mkdir(parents=True, exist_ok=True)
+        dest = qroot / path.name
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = qroot / f"{path.name}.{n}"
+        try:
+            os.replace(path, dest)
+        except OSError:
+            try:
+                path.unlink()
+            except OSError:
+                dest = None
+        _trace.inc("sol.store.quarantined")
+        _trace.event("sol.store.quarantine", "sol", entry=path.name,
+                     reason=reason, dest=str(dest) if dest else "removed")
+        logger.warning("quarantined corrupt sol-store entry %s (%s)%s",
+                       path.name, reason, f" -> {dest}" if dest else "")
+
+    @staticmethod
+    def _verify(payload) -> Optional[str]:
+        if not isinstance(payload, dict):
+            return "not a JSON object"
+        if payload.get("schema") != SOL_SCHEMA:
+            return f"schema {payload.get('schema')!r} != {SOL_SCHEMA}"
+        expect = payload.get("checksum")
+        actual = entry_checksum(payload)
+        if expect != actual:
+            return (f"checksum mismatch (expect {str(expect)[:12]}…, "
+                    f"got {actual[:12]}…)")
+        return None
+
+    def get(self, key: str) -> Optional[dict]:
+        p = self._path(key)
+        if not p.exists():
+            return None
+        try:
+            payload = json.loads(p.read_text())
+        except (OSError, ValueError) as e:
+            self._quarantine(p, f"{type(e).__name__}: {e}")
+            return None
+        reason = self._verify(payload)
+        if reason is not None:
+            self._quarantine(p, reason)
+            return None
+        return payload
+
+    def put(self, key: str, payload: dict) -> None:
+        from ..cache.kernel_cache import atomic_write
+        body = {k: v for k, v in payload.items() if k != "checksum"}
+        body.setdefault("schema", SOL_SCHEMA)
+        body.setdefault("codegen_version", _codegen_version())
+        body["checksum"] = entry_checksum(body)
+        self.root.mkdir(parents=True, exist_ok=True)
+        try:
+            atomic_write(self._path(key), json.dumps(body, indent=1))
+        except OSError as e:    # a full disk degrades the fleet tier,
+            logger.warning(     # never the run that produced the profile
+                "sol-store write failed for %s: %s", key, e)
+            return
+        _trace.inc("sol.store.writes")
+
+    def record(self, key: str, payload: dict) -> None:
+        with self._key_lock(key):
+            existing = self.get(key)
+            self.put(key, merge_sol_payloads(existing, payload)
+                     if existing else payload)
+
+    def entries(self) -> Iterator[Tuple[str, dict]]:
+        if not self.root.is_dir():
+            return
+        for p in sorted(self.root.glob("*.json")):
+            payload = self.get(p.stem)
+            if payload is not None:
+                yield p.stem, payload
+
+    def stats(self) -> dict:
+        entries = list(self.entries())
+        qdir = self.root / QUARANTINE_DIR
+        with_sol = [p for _, p in entries if p.get("sol_pct")]
+        return {
+            "root": str(self.root),
+            "entries": len(entries),
+            "with_sol_pct": len(with_sol),
+            "mean_sol_pct": (sum(p["sol_pct"] for p in with_sol)
+                             / len(with_sol)) if with_sol else None,
+            "merges": sum(int(p.get("merges") or 0) for _, p in entries),
+            "quarantined": len(list(qdir.glob("*")))
+            if qdir.is_dir() else 0,
+        }
+
+    def merge_from(self, sources: Sequence) -> dict:
+        """Fold other SoL store dirs into this one (corrupt source
+        entries counted and skipped, never touched in-place)."""
+        stats = {"examined": 0, "new": 0, "merged": 0, "unchanged": 0,
+                 "corrupt": 0}
+        for src in sources:
+            src = Path(src)
+            if not src.is_dir():
+                continue
+            for p in sorted(src.glob("*.json")):
+                stats["examined"] += 1
+                try:
+                    theirs = json.loads(p.read_text())
+                except (OSError, ValueError):
+                    stats["corrupt"] += 1
+                    continue
+                if self._verify(theirs) is not None:
+                    stats["corrupt"] += 1
+                    continue
+                key = p.stem
+                with self._key_lock(key):
+                    mine = self.get(key)
+                    if mine is None:
+                        self.put(key, theirs)
+                        stats["new"] += 1
+                        continue
+                    merged = merge_sol_payloads(mine, theirs)
+                    if _canonical({k: v for k, v in mine.items()
+                                   if k != "checksum"}) == \
+                            _canonical({k: v for k, v in merged.items()
+                                        if k != "checksum"}):
+                        stats["unchanged"] += 1
+                    else:
+                        self.put(key, merged)
+                        stats["merged"] += 1
+        n = stats["new"] + stats["merged"]
+        if n:
+            _trace.inc("sol.store.merged", n)
+        _trace.event("sol.store.merge", "sol", **stats)
+        return stats
+
+
+def _store_payload(rec: dict) -> dict:
+    """A SoL record reshaped into a store entry (drops the volatile
+    per-process EWMA fields; keeps what fleet aggregation compares)."""
+    return {
+        "schema": SOL_SCHEMA,
+        "kernel": rec["kernel"],
+        "arch": rec.get("arch"),
+        "count": rec.get("count") or 0,
+        "achieved_ms": rec.get("achieved_ms"),
+        "predicted_ms": rec.get("predicted_ms"),
+        "sol_pct": rec.get("sol_pct"),
+        "bottleneck": rec.get("bottleneck"),
+        "terms": rec.get("terms"),
+        "rewrites": rec.get("rewrites") or [],
+        "host_overhead_ms": rec.get("host_overhead_ms"),
+        "merges": 0,
+    }
+
+
+def write_store(root=None) -> int:
+    """Persist the live profiler's records into a :class:`SolStore`.
+    Returns the number of entries written."""
+    store = SolStore(root)
+    n = 0
+    for rec in sol_records():
+        if not rec.get("count"):
+            continue
+        store.record(store.key(rec["kernel"], rec.get("arch") or "?"),
+                     _store_payload(rec))
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# module singleton
+# ---------------------------------------------------------------------------
+
+_sol_lock = threading.Lock()
+_profiler: Optional[SolProfiler] = None
+
+
+def get_sol() -> SolProfiler:
+    global _profiler
+    if _profiler is None:
+        with _sol_lock:
+            if _profiler is None:
+                _profiler = SolProfiler()
+    return _profiler
+
+
+def note_dispatch(kernel: Any, device_s: float, host_s: float = 0.0,
+                  name: Optional[str] = None) -> None:
+    if not sol_enabled():
+        return
+    try:
+        get_sol().note_dispatch(kernel, device_s, host_s, name=name)
+    except Exception:           # profiling must never fail a dispatch
+        logger.warning("sol sample failed", exc_info=True)
+
+
+def observe_bucket(kernel: str, bucket: str, measured_ms: float,
+                   predicted_ms: Optional[float],
+                   config: Optional[dict] = None,
+                   **attrs) -> Optional[dict]:
+    return get_sol().observe_bucket(kernel, bucket, measured_ms,
+                                    predicted_ms, config=config, **attrs)
+
+
+def sol_records() -> List[dict]:
+    return get_sol().records()
+
+
+def sol_summary() -> dict:
+    return get_sol().summary()
+
+
+def prof_snapshot() -> dict:
+    """What the HTTP server's ``/prof`` route serves."""
+    return dict(schema=SOL_SCHEMA, **get_sol().summary())
+
+
+def retune_queue() -> List[dict]:
+    return get_sol().retune_queue()
+
+
+def pop_retune() -> Optional[dict]:
+    return get_sol().pop_retune()
+
+
+def reset() -> None:
+    get_sol().reset()
+
+
+# ---------------------------------------------------------------------------
+# CLI: ops-kernel sweep + fleet aggregation + inspection
+# ---------------------------------------------------------------------------
+
+def _smoke_arg(p):
+    """A deterministic, cheap input for one kernel param: zeros for
+    integer/bool params (valid page-table indices), a small varied ramp
+    for floats (top-k and softmax kernels dislike constant inputs)."""
+    import numpy as np
+    import jax.numpy as jnp
+    shape = tuple(int(s) for s in p.shape)
+    if str(p.dtype).startswith(("int", "uint", "bool")):
+        return jnp.zeros(shape, p.dtype)
+    n = 1
+    for s in shape:
+        n *= s
+    base = (np.arange(n, dtype=np.float32) % 13) * 0.125 + 0.25
+    return jnp.asarray(base.reshape(shape)).astype(p.dtype)
+
+
+def run_sweep(out: Optional[str] = None, ops_dir: Optional[str] = None,
+              modules: Optional[str] = None, calls: int = 3,
+              store: Optional[str] = None,
+              write_to_store: bool = False) -> dict:
+    """Compile and dispatch every non-mesh ops kernel with profiling on;
+    write the SoL table as a JSONL artifact for ``analyzer sol``."""
+    os.environ["TL_TPU_SOL"] = "1"
+    os.environ.setdefault("TL_TPU_RUNTIME_SAMPLE", "1")
+    reset()
+    from ..tools.lint import collect_module_kernels
+    # NB: the top-level package re-exports the @jit decorator under the
+    # name `jit`, so import compile() from the submodule explicitly
+    from ..jit import compile as _jit_compile
+    root = Path(ops_dir) if ops_dir else \
+        Path(__file__).resolve().parents[1] / "ops"
+    files = sorted(p for p in root.glob("*.py") if p.stem != "__init__")
+    if modules:
+        want = {m.strip() for m in modules.split(",") if m.strip()}
+        files = [f for f in files if f.stem in want]
+    skipped: List[str] = []
+    dispatched = 0
+    for f in files:
+        try:
+            objs, _notes = collect_module_kernels(f)
+        except Exception as e:
+            skipped.append(f"{f.stem}: {type(e).__name__}: {e}")
+            continue
+        for obj in objs:
+            label = getattr(obj, "name", None) or f.stem
+            try:
+                k = _jit_compile(obj, target="cpu")
+                if (getattr(k.artifact, "attrs", None) or {}).get(
+                        "mesh_config"):
+                    skipped.append(f"{label}: mesh kernel (needs devices)")
+                    continue
+                ins = [_smoke_arg(p) for p in k._in_params]
+                k(*ins)                      # warm: compile + _warmed
+                for _ in range(max(1, int(calls))):
+                    k(*ins)                  # sampled timed dispatches
+                dispatched += 1
+            except Exception as e:
+                skipped.append(f"{label}: {type(e).__name__}: {e}")
+    recs = sol_records()
+    result = {
+        "kernels": len(recs),
+        "with_prediction": sum(1 for r in recs if r.get("sol_pct")),
+        "dispatched": dispatched,
+        "skipped": skipped,
+    }
+    if out:
+        out_p = Path(out)
+        out_p.parent.mkdir(parents=True, exist_ok=True)
+        lines = [json.dumps({"type": "sol_context", "schema": SOL_SCHEMA,
+                             **{k: v for k, v in result.items()
+                                if k != "skipped"}})]
+        lines += [json.dumps(_flight._json_safe(r)) for r in recs]
+        out_p.write_text("\n".join(lines) + "\n")
+        result["out"] = str(out_p)
+    if write_to_store:
+        result["store_entries"] = write_store(store)
+        result["store"] = str(SolStore(store).root)
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys as _sys
+    ap = argparse.ArgumentParser(
+        prog="python -m tilelang_mesh_tpu.observability.sol",
+        description="tl-sol: sweep the ops kernels into a speed-of-light "
+                    "JSONL artifact, or merge/inspect fleet SoL stores "
+                    "(docs/observability.md).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_sw = sub.add_parser(
+        "sweep", help="compile + dispatch every non-mesh ops kernel with "
+                      "profiling on and write the SoL table")
+    p_sw.add_argument("--out", metavar="FILE",
+                      help="JSONL artifact path (default: "
+                           "<trace_dir>/sol_sweep.jsonl)")
+    p_sw.add_argument("--ops-dir", metavar="DIR",
+                      help="ops package dir (default: the installed one)")
+    p_sw.add_argument("--modules", metavar="A,B",
+                      help="comma subset of ops modules to sweep")
+    p_sw.add_argument("--calls", type=int, default=3,
+                      help="timed dispatches per kernel after warmup")
+    p_sw.add_argument("--store", metavar="DIR",
+                      help="also write entries into this SoL store")
+    p_mg = sub.add_parser(
+        "merge", help="fold other SoL store dirs into the local root "
+                      "(checksummed entries; best achieved wins)")
+    p_mg.add_argument("sources", nargs="+", help="SoL store dir(s)")
+    p_mg.add_argument("--into", metavar="DIR",
+                      help="destination root (default: env.sol_dir())")
+    p_ls = sub.add_parser("list", help="entries in a SoL store dir")
+    p_ls.add_argument("--root", metavar="DIR")
+    p_st = sub.add_parser("stats", help="entry/merge/quarantine totals")
+    p_st.add_argument("--root", metavar="DIR")
+    for p in (p_sw, p_mg, p_ls, p_st):
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable JSON output")
+    args = ap.parse_args(list(_sys.argv[1:] if argv is None else argv))
+    if args.cmd == "sweep":
+        out = args.out or str(env.trace_dir() / "sol_sweep.jsonl")
+        result = run_sweep(out=out, ops_dir=args.ops_dir,
+                           modules=args.modules, calls=args.calls,
+                           store=args.store,
+                           write_to_store=args.store is not None)
+        if args.json:
+            print(json.dumps(result, indent=2))  # noqa: T201
+        else:
+            print(f"sol sweep: {result['kernels']} kernels profiled "  # noqa: T201
+                  f"({result['with_prediction']} with roofline "
+                  f"prediction), {len(result['skipped'])} skipped "
+                  f"-> {result.get('out')}")
+            for s in result["skipped"]:
+                print(f"  skipped {s}")  # noqa: T201
+        return 0
+    if args.cmd == "merge":
+        store = SolStore(args.into) if args.into else SolStore()
+        stats = store.merge_from(args.sources)
+        if args.json:
+            print(json.dumps(stats, indent=2))  # noqa: T201
+        else:
+            print(f"merged into {store.root}: "  # noqa: T201
+                  f"{stats['new']} new, {stats['merged']} merged, "
+                  f"{stats['unchanged']} unchanged, "
+                  f"{stats['corrupt']} corrupt skipped "
+                  f"({stats['examined']} examined)")
+        return 0
+    store = SolStore(args.root) if args.root else SolStore()
+    if args.cmd == "list":
+        if args.json:
+            print(json.dumps(  # noqa: T201
+                {k: p for k, p in store.entries()}, indent=2))
+        else:
+            lines = [f"sol store @ {store.root}"]
+            for key, p in store.entries():
+                pct = p.get("sol_pct")
+                tail = f"sol={pct:.1%}" if pct else "(no prediction)"
+                lines.append(
+                    f"  {key[:12]}…  {str(p.get('kernel', '?'))[:40]:40s} "
+                    f"arch={str(p.get('arch', '?')):8s} "
+                    f"achieved={p.get('achieved_ms')} ms {tail}")
+            if len(lines) == 1:
+                lines.append("  (empty)")
+            print("\n".join(lines))  # noqa: T201
+        return 0
+    stats = store.stats()
+    print(json.dumps(stats, indent=2) if args.json  # noqa: T201
+          else "\n".join(f"{k}: {v}" for k, v in stats.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    # `python -m ...sol` executes this file as __main__ while the jit
+    # dispatch hook feeds the canonical tilelang_mesh_tpu.observability.
+    # sol module — delegate so both share ONE profiler singleton
+    from tilelang_mesh_tpu.observability.sol import main as _canonical_main
+    raise SystemExit(_canonical_main())

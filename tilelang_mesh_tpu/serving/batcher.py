@@ -99,6 +99,10 @@ class DecodeWorkload:
         # (batch, pages) bucket -> tuned kernel config adopted from the
         # fleet tune cache at warmup (None = nothing recorded)
         self._tuned: dict = {}
+        # (batch, pages) bucket -> the tuned config's recorded
+        # best_latency_ms: the prediction the tl-sol drift detector
+        # compares serving-measured step latency against
+        self._tuned_pred: dict = {}
         # stand-in sampler vocabulary (serving/sampling.py)
         self.vocab = max(2, env.TL_TPU_SERVE_VOCAB)
         # content-addressed prefix KV cache: None = the env-gated
@@ -392,6 +396,9 @@ class DecodeWorkload:
         if isinstance(ent, dict) and isinstance(ent.get("best_config"),
                                                 dict):
             cfg = dict(ent["best_config"])
+            lat = ent.get("best_latency_ms")
+            if isinstance(lat, (int, float)) and lat > 0:
+                self._tuned_pred[(bb, pp)] = float(lat)
             _trace.inc("serve.warmup.tuned")
             _trace.event("serve.warmup.tuned", "serving", batch=bb,
                          pages=pp, workload=type(self).__name__,
@@ -430,6 +437,12 @@ class DecodeWorkload:
     def tuned_config(self, bb: int, pp: int) -> dict:
         """The bucket's adopted tuned config ({} when none)."""
         return self._tuned.get((bb, pp)) or {}
+
+    def tuned_prediction_ms(self, bb: int, pp: int) -> "float | None":
+        """The tuned config's recorded best latency for this bucket —
+        the baseline the tl-sol drift detector holds serving-measured
+        step latency against (None when the bucket is untuned)."""
+        return self._tuned_pred.get((bb, pp))
 
     # -- AOT warm-up ---------------------------------------------------
     def warmup(self) -> int:

@@ -412,10 +412,37 @@ class ServingEngine:
         _hist.observe("kernel.latency", dt, kernel=STEP_HIST_KERNEL,
                       source="serving")
         self._maybe_probe_shards()
+        self._sol_tick(batch, dt)
         self._retire_or_requeue(batch, outs)
         self._gauges()
         self._slo_tick()
         return True
+
+    def _sol_tick(self, batch, dt: float) -> None:
+        """tl-sol drift tick: hold this step's measured latency against
+        the batch bucket's tuned-config prediction (the fleet tune
+        cache's ``best_latency_ms`` the workload adopted at warmup). A
+        sustained drift fires ``sol.drift``, dumps a flight black box
+        naming the kernel/config, and enqueues the bucket on the retune
+        queue served at ``/prof`` (observability/sol.py)."""
+        try:
+            wl = self.workload
+            pred_fn = getattr(wl, "tuned_prediction_ms", None)
+            if pred_fn is None:
+                return
+            bb = wl.batch_bucket(len(batch))
+            pp = max(wl.bucket_of(r) for r in batch)
+            pred = pred_fn(bb, pp)
+            if pred is None:
+                return
+            from ..observability import sol as _sol
+            _sol.observe_bucket(
+                kernel=type(wl).__name__, bucket=f"b{bb}:p{pp}",
+                measured_ms=dt * 1e3, predicted_ms=pred,
+                config=wl.tuned_config(bb, pp), engine=self.name)
+        except Exception:  # noqa: BLE001 — drift math must not kill a step
+            logger.warning("serving engine %s: sol tick failed",
+                           self.name, exc_info=True)
 
     def _slo_tick(self) -> None:
         """Feed the sliding-window SLO engine (throttled) and fire ONE

@@ -964,14 +964,21 @@ def summarize_dash(round_paths, baseline: Optional[str] = None,
                           "unit": rec.get("unit"),
                           "vs_baseline": rec.get("vs_baseline"),
                           "verdict": verdict,
-                          "rel": cmp_row["rel"] if cmp_row else None})
+                          "rel": cmp_row["rel"] if cmp_row else None,
+                          "sol_pct": (rec.get("sol") or {}).get("sol_pct")})
             prev = rec          # the trend compares consecutive data
             last_verdict = verdict
+        # latest SoL% seen across the trend — rounds captured before
+        # the sol field existed (r01-r05) simply don't contribute
+        # (missing-not-regressed, never an error)
+        sol_latest = next((c["sol_pct"] for c in reversed(cells)
+                           if c.get("sol_pct") is not None), None)
         table[cfg] = {
             "baseline_ms": _lat(base_recs[cfg])
             if cfg in base_recs else None,
             "cells": cells,
             "flag": last_verdict or "missing-not-regressed",
+            "sol_pct": sol_latest,
         }
         if last_verdict == "REGRESSION":
             regressions.append(cfg)
@@ -1026,7 +1033,7 @@ def format_dash_report(dash: dict) -> str:
         head = f"  {'config':<24} {'baseline':>10}"
         for lb in labels:
             head += f" {lb:>14}"
-        head += "  flag"
+        head += f" {'sol%':>7}  flag"
         lines.append(head)
         for cfg in sorted(cfgs):
             row = cfgs[cfg]
@@ -1044,11 +1051,14 @@ def format_dash_report(dash: dict) -> str:
                     cell_s = (f"{lat:.4f}{mark}" if lat is not None
                               else str(cell.get("value")))
                     line += f" {cell_s:>14}"
+            sp = row.get("sol_pct")
+            line += f" {(f'{sp:.1%}' if sp is not None else '-'):>7}"
             line += f"  {row['flag']}"
             lines.append(line)
         lines.append("  (! = REGRESSION beyond noise, + = improved, "
                      "* = new; missing/failed cells are "
-                     "missing-not-regressed)")
+                     "missing-not-regressed; sol% = latest "
+                     "speed-of-light attainment, '-' before tl-sol)")
     if dash["regressions"]:
         lines.append("REGRESSED: " + ", ".join(dash["regressions"]))
     else:
@@ -1060,6 +1070,209 @@ def format_dash_report(dash: dict) -> str:
                      f"{tc.get('trials')} recorded trials, "
                      f"{tc.get('merges')} merges, "
                      f"{tc.get('quarantined')} quarantined")
+    return "\n".join(lines)
+
+
+def summarize_sol(records, store_stats: Optional[dict] = None) -> dict:
+    """Aggregate the speed-of-light rows a profiled run embedded in its
+    trace artifact (``type == "sol"`` lines from observability.to_jsonl,
+    or a ``sol sweep`` artifact — docs/observability.md) into one
+    per-kernel attainment table: achieved vs the analytic prediction,
+    SoL%, the dominant roofline bottleneck, and where the gap went.
+    Duplicate kernel rows (a trace captured across several windows)
+    resolve latest-wins."""
+    ctx = next((r for r in records if r.get("type") == "sol_context"),
+               None)
+    rows: Dict[str, dict] = {}
+    for r in records:
+        if r.get("type") != "sol" or not r.get("kernel"):
+            continue
+        rows[str(r["kernel"])] = {
+            "count": r.get("count"),
+            "achieved_ms": r.get("achieved_ms"),
+            "predicted_ms": r.get("predicted_ms"),
+            "sol_pct": r.get("sol_pct"),
+            "bottleneck": r.get("bottleneck"),
+            "host_overhead_ms": r.get("host_overhead_ms"),
+            "gap": r.get("gap"),
+            "rewrites": r.get("rewrites"),
+            "arch": r.get("arch"),
+        }
+    pcts = [v["sol_pct"] for v in rows.values()
+            if isinstance(v.get("sol_pct"), (int, float))]
+    bn: Dict[str, int] = {}
+    for v in rows.values():
+        if v.get("bottleneck"):
+            bn[v["bottleneck"]] = bn.get(v["bottleneck"], 0) + 1
+    out = {
+        "schema": (ctx or {}).get("schema"),
+        "kernels": len(rows),
+        "with_prediction": len(pcts),
+        "mean_sol_pct": sum(pcts) / len(pcts) if pcts else None,
+        "bottlenecks": bn,
+        "rows": rows,
+    }
+    if ctx is not None:
+        out["drift"] = {k: ctx.get(k)
+                        for k in ("drift", "retune_queue") if k in ctx}
+    if store_stats is not None:
+        out["store"] = store_stats
+    return out
+
+
+def _top_gap(gap) -> str:
+    """Name the largest gap-attribution term (human table only)."""
+    if not isinstance(gap, dict):
+        return "-"
+    best = max(((k, v) for k, v in gap.items()
+                if isinstance(v, (int, float))),
+               key=lambda kv: kv[1], default=None)
+    if best is None or best[1] <= 0:
+        return "-"
+    return f"{best[0].replace('_ms', '')} {best[1]:.4f}ms"
+
+
+def format_sol_report(sol: dict) -> str:
+    """Human-readable speed-of-light table (CLI ``sol`` subcommand) —
+    worst attainment first, so the tuning target is the top row."""
+    lines: List[str] = []
+    mean = sol.get("mean_sol_pct")
+    lines.append(
+        f"speed-of-light: {sol['kernels']} kernel(s), "
+        f"{sol['with_prediction']} with an analytic prediction"
+        + (f", mean SoL {mean:.1%}" if mean is not None else ""))
+    if sol.get("bottlenecks"):
+        lines.append("  bottlenecks: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(sol["bottlenecks"].items(),
+                                          key=lambda kv: -kv[1])))
+    rows = sol.get("rows") or {}
+    if rows:
+        lines.append(f"  {'kernel':<28} {'n':>4} {'achieved':>10} "
+                     f"{'predicted':>10} {'sol%':>7} {'bottleneck':<10} "
+                     f"top gap")
+
+        def _key(kv):
+            p = kv[1].get("sol_pct")
+            return (p is None, p if p is not None else 0.0)
+
+        for name, row in sorted(rows.items(), key=_key):
+            ach, pred, pct = (row.get("achieved_ms"),
+                              row.get("predicted_ms"), row.get("sol_pct"))
+            lines.append(
+                f"  {name:<28} {row.get('count') or 0:>4} "
+                f"{(f'{ach:.4f}' if ach is not None else '-'):>10} "
+                f"{(f'{pred:.4f}' if pred is not None else '-'):>10} "
+                f"{(f'{pct:.1%}' if pct is not None else '-'):>7} "
+                f"{(row.get('bottleneck') or '-'):<10} "
+                f"{_top_gap(row.get('gap'))}")
+    else:
+        lines.append("  no sol records in this artifact "
+                     "(run with TL_TPU_SOL=1 TL_TPU_TRACE=1)")
+    dr = sol.get("drift")
+    if dr:
+        lines.append(f"  drift: {dr.get('drift')}, "
+                     f"retune queue depth {dr.get('retune_queue')}")
+    if "store" in sol:
+        st = sol["store"]
+        lines.append(f"fleet sol store @ {st.get('root')}: "
+                     f"{st.get('entries')} entries, "
+                     f"mean SoL {st.get('mean_sol_pct')}, "
+                     f"{st.get('merges')} merges, "
+                     f"{st.get('quarantined')} quarantined")
+    return "\n".join(lines)
+
+
+def summarize_flight(records, last: int = 10) -> dict:
+    """Post-mortem view of one flight-recorder dump (the black-box
+    JSONL ``flight.dump`` writes on watchdog/SLO/drift trips —
+    docs/observability.md): the versioned header, the ring tail, the
+    full counter snapshot, and the serving/SLO state at dump time."""
+    header = next((r for r in records if r.get("type") == "flight"),
+                  None)
+    ring = [r for r in records if r.get("type") == "flight_record"]
+    counters = {r["name"]: r.get("value")
+                for r in records
+                if r.get("type") == "counter" and r.get("name")}
+    gauges = next((r for r in records if r.get("type") == "gauges"),
+                  None)
+    slo = next((r for r in records if r.get("type") == "slo"), None)
+    by_kind: Dict[str, int] = {}
+    by_name: Dict[str, int] = {}
+    for r in ring:
+        by_kind[r.get("k") or "?"] = by_kind.get(r.get("k") or "?", 0) + 1
+        if r.get("name"):
+            by_name[r["name"]] = by_name.get(r["name"], 0) + 1
+    return {
+        "header": header,
+        "ring": {"n": len(ring), "by_kind": by_kind,
+                 "top_names": dict(sorted(by_name.items(),
+                                          key=lambda kv: -kv[1])[:8]),
+                 "last": ring[-max(0, last):]},
+        "counters": counters,
+        "gauges": gauges,
+        "slo": slo,
+    }
+
+
+def format_flight_report(fl: dict) -> str:
+    """Human-readable flight-dump post-mortem (CLI ``flight``
+    subcommand)."""
+    import datetime as _dt
+    lines: List[str] = []
+    hdr = fl.get("header")
+    if hdr is None:
+        return ("not a flight dump (no type=flight header line); "
+                "dumps live under env.flight_dir()")
+    ts = hdr.get("ts")
+    when = (_dt.datetime.fromtimestamp(ts).isoformat(sep=" ",
+                                                    timespec="seconds")
+            if isinstance(ts, (int, float)) else "-")
+    lines.append(f"flight dump: reason={hdr.get('reason')} "
+                 f"seq={hdr.get('seq')} schema={hdr.get('schema')} "
+                 f"pid={hdr.get('pid')} at {when}")
+    if hdr.get("attrs"):
+        for k, v in sorted(hdr["attrs"].items()):
+            lines.append(f"  attr {k} = {v}")
+    ring = fl["ring"]
+    lines.append(f"ring: {ring['n']} record(s) "
+                 + ", ".join(f"{k}={v}"
+                             for k, v in sorted(ring["by_kind"].items())))
+    if ring["top_names"]:
+        lines.append("  hottest: " + ", ".join(
+            f"{k}×{v}" for k, v in ring["top_names"].items()))
+    if ring["last"]:
+        t0 = hdr.get("ts") if isinstance(hdr.get("ts"),
+                                         (int, float)) else None
+        lines.append(f"  last {len(ring['last'])} before the dump "
+                     "(dt = seconds before dump):")
+        for r in ring["last"]:
+            dt_s = (f"{t0 - r['t']:>8.3f}s"
+                    if t0 is not None and isinstance(r.get("t"),
+                                                     (int, float))
+                    else f"{'-':>9}")
+            kind = r.get("k") or "?"
+            body = r.get("name") or ""
+            if kind == "span":
+                body += f" dur_us={r.get('dur_us')}"
+            elif kind == "counter":
+                body += f" +{r.get('inc')}"
+            if r.get("attrs"):
+                body += " " + json.dumps(r["attrs"], sort_keys=True,
+                                         default=str)
+            lines.append(f"    -{dt_s} {kind:<8} {body}")
+    if fl["counters"]:
+        lines.append(f"counters at dump ({len(fl['counters'])}):")
+        for k, v in sorted(fl["counters"].items()):
+            lines.append(f"  {k:<44} {v}")
+    g = fl.get("gauges")
+    if g:
+        lines.append("serving gauges: " + json.dumps(
+            g.get("values"), sort_keys=True, default=str))
+    s = fl.get("slo")
+    if s:
+        keep = {k: v for k, v in s.items() if k != "type"}
+        lines.append("slo state: " + json.dumps(keep, sort_keys=True,
+                                                default=str))
     return "\n".join(lines)
 
 
@@ -1269,6 +1482,34 @@ def _run_tune(path, as_json: bool, cache_dir: Optional[str]) -> int:
     return 0
 
 
+def _run_sol(path, as_json: bool, store_dir: Optional[str]) -> int:
+    """``analyzer sol <trace.jsonl>`` — per-kernel speed-of-light table
+    from a profiled trace artifact or a ``sol sweep`` JSONL; add
+    ``--store DIR`` (or have a populated default store) for the
+    fleet-merged view (docs/observability.md)."""
+    records = _load_trace(path)
+    store_stats = None
+    try:
+        from ..observability.sol import SolStore
+        store = SolStore(store_dir) if store_dir else SolStore()
+        if store.root.is_dir():
+            store_stats = store.stats()
+    except Exception:   # noqa: BLE001 — stats are garnish, never a crash
+        store_stats = None
+    sol = summarize_sol(records, store_stats)
+    _emit(sol, format_sol_report(sol), as_json)
+    return 0
+
+
+def _run_flight(path, as_json: bool, last: int) -> int:
+    """``analyzer flight <dump.jsonl>`` — human-readable post-mortem of
+    one flight-recorder black box (docs/observability.md)."""
+    records = _load_trace(path)
+    fl = summarize_flight(records, last=last)
+    _emit(fl, format_flight_report(fl), as_json)
+    return 0 if fl.get("header") is not None else 1
+
+
 def _run_lint(targets, as_json: bool, out) -> int:
     """``analyzer lint`` — the offline module linter (tools/lint.py)
     behind the shared analyzer surface (``--json`` honored like every
@@ -1397,6 +1638,26 @@ def main(argv=None) -> int:
     p_tn.add_argument("--cache-dir", metavar="DIR",
                       help="fleet tune-cache root to report stats for "
                            "(default: env.tune_cache_dir())")
+    p_so = sub.add_parser(
+        "sol", help="per-kernel speed-of-light table: achieved vs the "
+                    "analytic roofline prediction, SoL%%, dominant "
+                    "bottleneck, gap attribution — from a TL_TPU_SOL=1 "
+                    "trace artifact or a sol sweep JSONL "
+                    "(docs/observability.md)")
+    p_so.add_argument("file", help="JSONL trace / sol sweep artifact")
+    p_so.add_argument("--store", metavar="DIR",
+                      help="fleet sol-store root to report stats for "
+                           "(default: env.sol_dir())")
+    p_fd = sub.add_parser(
+        "flight", help="post-mortem of one flight-recorder dump: "
+                       "header/reason, ring tail, counter snapshot, "
+                       "SLO state (docs/observability.md); exit 1 if "
+                       "the file is not a flight dump")
+    p_fd.add_argument("file", help="flight_*.jsonl dump "
+                      "(under env.flight_dir())")
+    p_fd.add_argument("--last", type=int, default=10,
+                      help="ring records to show before the dump "
+                           "(default 10)")
     p_ln = sub.add_parser(
         "lint", help="offline static analysis of kernel modules: the "
                      "TL001-TL010 dataflow + tl-num rules + TL1xx semantic "
@@ -1421,7 +1682,8 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_sv, p_rq, p_da, p_tn, p_ln, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_rq, p_da, p_tn, p_so, p_fd,
+              p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -1440,6 +1702,10 @@ def main(argv=None) -> int:
                          args.threshold_mads, args.min_rel)
     if args.cmd == "tune":
         return _run_tune(args.file, args.json, args.cache_dir)
+    if args.cmd == "sol":
+        return _run_sol(args.file, args.json, args.store)
+    if args.cmd == "flight":
+        return _run_flight(args.file, args.json, args.last)
     if args.cmd == "lint":
         return _run_lint(args.targets, args.json, args.out)
     return _run_perf_diff(args.baseline, args.current, args.json,
