@@ -1,0 +1,440 @@
+"""tl-fleet suite (docs/serving.md "Fleet serving & failover"):
+supervised multi-engine serving with SLO-aware routing, per-engine
+circuit breaking, zero-loss failover, and breaker-gated restarts.
+
+Five layers:
+
+1. **Routing** — weighted least-loaded dispatch over breaker-closed
+   LIVE engines; the degraded engine's share drops measurably; an
+   over-budget engine loses to an in-budget peer; unroutable
+   submissions come back terminal (``shed failover``), never lost.
+2. **Breaker semantics** — consecutive step failures eject at the
+   threshold; a clean pump resets the count; ``force_open`` ejects
+   within the same fleet step; an open engine never receives traffic.
+3. **Failover** — an engine killed via the ``serve.engine`` fault site
+   exports its in-flight work to healthy peers (warm prefix-cache
+   restores where a whole-page prefix exists), writes one
+   ``engine_failover`` flight dump naming the victim + every
+   re-routed trace id, and a fleet-hosted ``TokenStream`` keeps
+   yielding across the kill (the client never learns an engine died).
+4. **Restarts** — the dead engine restarts with exponential backoff;
+   a failed half-open probe re-opens the breaker with DOUBLED backoff
+   and takes no live traffic while open; a passed probe re-admits at
+   base backoff and the victim serves traffic again.
+5. **Fairness + surfaces** — per-tenant admission share gate and
+   weighted round-robin batching; ``metrics_summary`` tenant outcome
+   table; ``fleet_health``/``fleet_slo`` registry views; the analyzer
+   ``fleet`` summary over trace records.
+"""
+
+import itertools
+import time
+
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import flight as _flight
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.serving import (Fleet, FlashDecodeWorkload,
+                                       PagedKVAllocator, Router,
+                                       ServingEngine, fleet_health,
+                                       fleet_slo, registered_fleets,
+                                       reset_prefix_cache)
+
+H, D, PS = 2, 64, 8
+
+_seq = itertools.count()
+
+
+def make_workload(n_pages=128, batch_buckets=(4,), page_buckets=(2,)):
+    return FlashDecodeWorkload(
+        PagedKVAllocator(n_pages=n_pages, page_size=PS, heads=H,
+                         head_dim=D),
+        batch_buckets=batch_buckets, page_buckets=page_buckets)
+
+
+def make_fleet(n_engines=2, **kw):
+    # unique fleet names: the registry is process-global and the
+    # per-engine step histograms are keyed by engine name
+    kw.setdefault("name", f"flt{next(_seq)}")
+    return Fleet(make_workload, n_engines=n_engines, **kw)
+
+
+def counters():
+    return obs.get_tracer().counters()
+
+
+# -- 1. routing ---------------------------------------------------------
+
+def test_fleet_routes_and_completes():
+    fleet = make_fleet(n_engines=2)
+    reqs = [fleet.submit(2 * PS, new_tokens=2, seed=i)
+            for i in range(10)]
+    fleet.run()
+    assert all(r.outcome == "result" for r in reqs)
+    # least-loaded routing alternates over equal queues: both engines
+    # carried traffic, and every dispatch left a `route` mark
+    assert all(s.submitted > 0 for s in fleet.slots)
+    for r in reqs:
+        assert "route" in [sp.name for sp in r.trace.spans]
+    assert all(not v for v in fleet.leak_check().values())
+    assert fleet.outcomes()["result"] == len(reqs)
+
+
+def test_router_prefers_low_latency_engine_and_budget():
+    """SLO-aware dispatch: the degraded engine's share drops
+    measurably (the acceptance gate), and with a p99 budget set the
+    over-budget engine is avoided entirely while a peer is within."""
+    def feed(r, slow, fast):
+        # two ticks with step observations BETWEEN them: the windowed
+        # p99 is the delta between samples, so the latency must land
+        # inside the window, not before the first snapshot
+        t0 = time.monotonic() - 1.0
+        for eng in (slow, fast):
+            r.tick(eng, submitted=0, shed=0, completed=0, now=t0)
+        for i in range(20):
+            r.observe_step(slow, 0.080)
+            r.observe_step(fast, 0.005)
+        for eng in (slow, fast):
+            r.tick(eng, submitted=20, shed=0, completed=20,
+                   now=t0 + 0.5)
+
+    r = Router(eject_threshold=3)
+    slow, fast = f"slow{next(_seq)}", f"fast{next(_seq)}"
+    feed(r, slow, fast)
+    # simulate a dispatch loop: picked engine's queue deepens
+    qd = {slow: 0, fast: 0}
+    picks = []
+    for _ in range(50):
+        c = [{"name": slow, "queue_depth": qd[slow]},
+             {"name": fast, "queue_depth": qd[fast]}]
+        chosen = r.pick(c)
+        picks.append(chosen)
+        qd[chosen] += 1
+    share_slow = picks.count(slow) / len(picks)
+    share_fast = picks.count(fast) / len(picks)
+    assert share_slow < share_fast
+    assert share_slow < 0.2          # 16x p99 ratio -> ~1/16 share
+    # budget preference: slow (80ms) is over a 10ms budget, fast is
+    # within -> fast wins even with a much deeper queue
+    rb = Router(eject_threshold=3, p99_budget_ms=10.0)
+    feed(rb, slow, fast)
+    assert rb.pick([{"name": slow, "queue_depth": 0},
+                    {"name": fast, "queue_depth": 30}]) == fast
+
+
+def test_unroutable_submission_sheds_failover():
+    obs.reset()
+    fleet = make_fleet(n_engines=2)
+    for s in fleet.slots:
+        fleet.router.force_open(s.name)
+    req = fleet.submit(2 * PS, new_tokens=1, seed=1)
+    assert req.is_terminal
+    assert req.outcome == "shed"
+    assert req.shed_reason == "failover"
+    assert counters()["fleet.unrouted"] == 1
+
+
+# -- 2. breaker semantics ----------------------------------------------
+
+def test_router_breaker_consecutive_semantics():
+    r = Router(eject_threshold=3)
+    eng = f"brk{next(_seq)}"
+    assert not r.record_failure(eng)
+    assert not r.record_failure(eng)
+    assert not r.is_open(eng)
+    r.note_success(eng)              # clean pump: count restarts at 0
+    assert not r.record_failure(eng)
+    assert not r.record_failure(eng)
+    assert r.record_failure(eng)     # third consecutive trips it
+    assert r.is_open(eng)
+    r.note_success(eng)              # success does NOT close an open
+    assert r.is_open(eng)            # breaker (only a probe reset does)
+    assert r.pick([{"name": eng, "queue_depth": 0}]) is None
+    r.reset(eng)
+    assert not r.is_open(eng)
+    other = f"brk{next(_seq)}"
+    r.force_open(other)
+    assert r.is_open(other)
+    assert r.pick([{"name": other, "queue_depth": 0},
+                   {"name": eng, "queue_depth": 5}]) == eng
+
+
+def test_consecutive_step_failures_eject_within_threshold(monkeypatch):
+    obs.reset()
+    fleet = make_fleet(n_engines=2, router=Router(eject_threshold=3),
+                       restart_base_ms=10_000.0)   # keep it down
+    victim = fleet.slots[0]
+    eng0 = victim.engine
+
+    def flaky_step():
+        eng0._step_failures += 1     # what _on_step_failure records
+        return True
+
+    monkeypatch.setattr(eng0, "step", flaky_step)
+    fleet.step()
+    fleet.step()
+    assert victim.state == "live"    # two failures: below threshold
+    fleet.step()
+    assert victim.state == "ejected"
+    assert fleet.failovers == 1
+    assert fleet.router.is_open(victim.name)
+    assert counters()[
+        "fleet.failover{engine=%s}" % victim.name] == 1
+    # live traffic only reaches the healthy peer while ejected
+    for i in range(4):
+        fleet.submit(2 * PS, new_tokens=1, seed=i)
+    assert victim.submitted == 0
+    assert fleet.slots[1].submitted == 4
+
+
+# -- 3. failover --------------------------------------------------------
+
+def test_zero_loss_failover_warm_restore_and_flight_dump(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    obs.reset()
+    _flight.reset()
+    _flight.configure(dump_dir=tmp_path / "flight")
+    try:
+        fleet = make_fleet(n_engines=2)
+        fleet.warmup()
+        prompt = [9_000 + i for i in range(2 * PS)]   # 2 whole pages
+        seed_req = fleet.submit(len(prompt), new_tokens=1,
+                                prompt_tokens=list(prompt), seed=1)
+        fleet.run()
+        assert seed_req.outcome == "result"   # prefix now cached
+        # queue shared-prompt work on BOTH engines, no pumping between
+        reqs = [fleet.submit(len(prompt), new_tokens=2,
+                             prompt_tokens=list(prompt), seed=2 + i)
+                for i in range(6)]
+        on_victim = [r for r in reqs
+                     if r in fleet.slots[0].engine.requests]
+        assert on_victim                      # e0 holds live work
+        with inject("serve.engine", kind="unreachable", times=1):
+            fleet.step()                      # e0 pumps first -> dies
+        assert fleet.slots[0].state == "ejected"
+        assert fleet.failovers == 1
+        fleet.run()
+        assert all(r.outcome == "result" for r in reqs)   # zero loss
+        c = counters()
+        assert c.get("fleet.failover.warm", 0) >= 1
+        assert c.get("fleet.failover.lost", 0) == 0
+        victim = fleet.slots[0].name
+        dst = fleet.slots[1].name
+        assert c["fleet.redispatched{frm=%s,to=%s}"
+                 % (victim, dst)] == len(on_victim)
+        for r in on_victim:
+            names = [sp.name for sp in r.trace.spans]
+            assert "failover" in names
+        # the black box names the victim and every re-routed trace id
+        dumps = sorted((tmp_path / "flight").glob("*.jsonl"))
+        assert dumps
+        import json
+        head = json.loads(dumps[0].read_text().splitlines()[0])
+        assert head["reason"] == "engine_failover"
+        assert head["attrs"]["victim"] == victim
+        moved = set(head["attrs"]["redispatched_trace_ids"])
+        assert moved == {r.trace_id for r in on_victim}
+        assert head["attrs"]["warm_restores"] >= 1
+        # the victim restarts and serves traffic again
+        assert fleet.await_readmission(timeout_s=10.0)
+        assert fleet.leak_check() and \
+            all(not v for v in fleet.leak_check().values())
+    finally:
+        _flight.configure(dump_dir=None)
+        _flight.reset()
+        reset_prefix_cache()
+
+
+def test_token_stream_survives_failover():
+    """Satellite bugfix pin: a fleet-hosted TokenStream keeps yielding
+    after its engine is killed mid-stream — the request fails over and
+    the next pump decodes it on the peer."""
+    obs.reset()
+    fleet = make_fleet(n_engines=2)
+    fleet.warmup()
+    stream = fleet.stream(2 * PS, new_tokens=6, seed=7)
+    req = stream.request
+    # empty queues tie-break deterministically to the first slot,
+    # which is also the first engine pumped (and so the one killed)
+    assert req in fleet.slots[0].engine.requests
+    it = iter(stream)
+    first = next(it)
+    assert not req.is_terminal
+    with inject("serve.engine", kind="unreachable", times=1):
+        fleet.step()
+    assert fleet.slots[0].state == "ejected"
+    rest = list(it)                  # pumps the WHOLE fleet: decodes
+    tokens = [first] + rest          # resume on the adopting peer
+    assert len(tokens) == 6
+    assert req.outcome == "result"
+    victim, dst = fleet.slots[0].name, fleet.slots[1].name
+    assert counters().get(
+        "fleet.redispatched{frm=%s,to=%s}" % (victim, dst), 0) >= 1
+
+
+# -- 4. restarts --------------------------------------------------------
+
+def test_failed_probe_doubles_backoff_and_blocks_traffic():
+    """Satellite: a half-open engine that fails its probe re-opens the
+    breaker with DOUBLED backoff and never receives live traffic while
+    open; a later passed probe re-admits at base backoff."""
+    obs.reset()
+    base = 5.0
+    fleet = make_fleet(n_engines=2, restart_base_ms=base,
+                       restart_max_ms=1000.0)
+    victim = fleet.slots[0]
+    with inject("serve.engine", kind="unreachable", times=1):
+        fleet.step()
+    assert victim.state == "ejected"
+    assert victim.backoff_ms == base
+    time.sleep(2 * base / 1e3)       # past restart_due: probe is due
+    with inject("serve.engine", kind="unreachable", times=1):
+        fleet.step()                 # the probe itself is killed
+    assert victim.state == "ejected"
+    assert victim.backoff_ms == 2 * base
+    assert fleet.router.is_open(victim.name)
+    assert counters()[
+        "fleet.probe_failed{engine=%s}" % victim.name] == 1
+    # while open: live traffic routes around the victim, always
+    before = victim.submitted
+    for i in range(4):
+        r = fleet.submit(2 * PS, new_tokens=1, seed=i)
+        assert not r.is_terminal or r.outcome != "shed"
+    assert victim.submitted == before == 0
+    assert fleet.slots[1].submitted == 4
+    # clean probe after the doubled backoff: re-admitted at base
+    assert fleet.await_readmission(timeout_s=10.0)
+    assert victim.state == "live"
+    assert victim.backoff_ms == base
+    assert victim.restarts == 1
+    assert counters()["fleet.readmit{engine=%s}" % victim.name] == 1
+    fleet.run()                      # finish the queued work first
+    # ...and the re-admitted victim serves traffic again
+    r = fleet.submit(2 * PS, new_tokens=1, seed=9)
+    assert r in victim.engine.requests
+    fleet.run()
+    assert r.outcome == "result"
+
+
+def test_fleet_thread_hosting_completes_all():
+    fleet = make_fleet(n_engines=2)
+    fleet.warmup()
+    fleet.start()
+    try:
+        reqs = [fleet.submit(2 * PS, new_tokens=2, seed=i)
+                for i in range(8)]
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and \
+                not all(r.is_terminal for r in reqs):
+            time.sleep(0.01)
+    finally:
+        fleet.stop()
+    assert all(r.outcome == "result" for r in reqs)
+
+
+# -- 5. fairness + surfaces --------------------------------------------
+
+def test_tenant_share_gate_sheds_hot_tenant(monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_MAX_QUEUE", "8")
+    monkeypatch.setenv("TL_TPU_SERVE_TENANT_MAX_SHARE", "0.25")
+    eng = ServingEngine(make_workload(), name=f"tnt{next(_seq)}")
+    a1 = eng.submit(2 * PS, new_tokens=1, seed=1, tenant="hot")
+    a2 = eng.submit(2 * PS, new_tokens=1, seed=2, tenant="hot")
+    a3 = eng.submit(2 * PS, new_tokens=1, seed=3, tenant="hot")
+    b1 = eng.submit(2 * PS, new_tokens=1, seed=4, tenant="cold")
+    assert not a1.is_terminal and not a2.is_terminal
+    assert a3.outcome == "shed"      # 2 in flight = 0.25 * 8: capped
+    assert a3.shed_reason == "tenant_share"
+    assert not b1.is_terminal        # the other tenant still admits
+    eng.run()
+    assert all(r.outcome == "result" for r in (a1, a2, b1))
+
+
+def test_tenant_weighted_round_robin_batch():
+    eng = ServingEngine(make_workload(batch_buckets=(4,)),
+                        tenant_weights={"a": 3, "b": 1},
+                        name=f"wrr{next(_seq)}")
+    a = [eng.submit(2 * PS, new_tokens=1, seed=10 + i, tenant="a")
+         for i in range(4)]
+    b = [eng.submit(2 * PS, new_tokens=1, seed=20 + i, tenant="b")
+         for i in range(4)]
+    eng.step()
+    # one 4-wide batch: 3 picks for "a", 1 for "b", FIFO within tenant
+    done = [r for r in a + b if r.is_terminal]
+    assert done == [a[0], a[1], a[2], b[0]]
+    eng.run()
+    assert all(r.outcome == "result" for r in a + b)
+
+
+def test_tenant_outcome_table_in_metrics_summary():
+    obs.reset()
+    eng = ServingEngine(make_workload(), name=f"tbl{next(_seq)}")
+    for i in range(3):
+        eng.submit(2 * PS, new_tokens=1, seed=30 + i, tenant="acme")
+    eng.submit(2 * PS, new_tokens=1, seed=40, tenant="globex")
+    eng.run()
+    table = obs.metrics_summary()["serving"]["tenants"]
+    assert table["acme"]["result"] == 3
+    assert table["globex"]["result"] == 1
+
+
+def test_fleet_health_and_slo_registry():
+    fleet = make_fleet(n_engines=2)
+    for i in range(4):
+        fleet.submit(2 * PS, new_tokens=1, seed=i)
+    fleet.run()
+    assert fleet.name in registered_fleets()
+    fh = fleet_health()[fleet.name]
+    assert set(fh["engines"]) == {s.name for s in fleet.slots}
+    for eng_h in fh["engines"].values():
+        assert eng_h["state"] == "live"
+        assert eng_h["breaker_open"] is False
+    fs = fleet_slo()[fleet.name]
+    assert set(fs) <= {s.name for s in fleet.slots}
+
+
+def test_analyzer_fleet_summary_and_report():
+    from tilelang_mesh_tpu.tools.analyzer import (format_fleet_report,
+                                                  summarize_fleet)
+    records = [
+        {"type": "counter", "name": "fleet.dispatch{engine=f/e0}",
+         "value": 6},
+        {"type": "counter", "name": "fleet.dispatch{engine=f/e1}",
+         "value": 2},
+        {"type": "counter", "name": "fleet.failover{engine=f/e0}",
+         "value": 1},
+        {"type": "counter",
+         "name": "fleet.redispatched{frm=f/e0,to=f/e1}", "value": 3},
+        {"type": "counter", "name": "fleet.failover.warm", "value": 2},
+        {"type": "counter", "name": "fleet.probe{engine=f/e0}",
+         "value": 2},
+        {"type": "counter", "name": "fleet.probe_failed{engine=f/e0}",
+         "value": 1},
+        {"type": "counter", "name": "fleet.readmit{engine=f/e0}",
+         "value": 1},
+        {"type": "event", "name": "fleet.failover",
+         "attrs": {"fleet": "f", "engine": "f/e0",
+                   "error": "DeviceLossError: x"}},
+        {"type": "event", "name": "fleet.readmit",
+         "attrs": {"fleet": "f", "engine": "f/e0", "restarts": 1}},
+    ]
+    s = summarize_fleet(records)
+    assert s["dispatch"] == {"f/e0": 6, "f/e1": 2}
+    assert s["dispatch_share"]["f/e0"] == 0.75
+    assert s["failovers"] == {"f/e0": 1}
+    assert s["redispatched"] == {"f/e0 -> f/e1": 3}
+    assert s["redispatched_total"] == 3
+    assert s["warm_restores"] == 2
+    assert s["probes"] == {"f/e0": 2}
+    assert s["probe_failures"] == {"f/e0": 1}
+    assert s["readmits"] == {"f/e0": 1}
+    assert s["readmit_events"][0]["restarts"] == 1
+    txt = format_fleet_report(records)
+    assert "fleet routing:" in txt
+    assert "f/e0: 6 dispatched (75.0% share)" in txt
+    assert "re-dispatched f/e0 -> f/e1: 3" in txt
+    assert format_fleet_report([]) == \
+        "fleet: no fleet.* activity in this trace"

@@ -442,12 +442,17 @@ def test_deadline_budget_timeout_does_not_reshard():
     assert r.outcome == "result"         # retried on the same layout
 
 
-def test_failed_migration_leaves_layout_unchanged(monkeypatch):
-    """Atomicity of the reshard: when the KV migration fails, NOTHING
-    moves — old allocator installed, old layout serving, no reshard
-    accounted — and the failure falls through to ordinary handling."""
+def test_failed_migration_rewarms_on_fresh_placement(monkeypatch):
+    """ROADMAP 1(d): when the KV migration fails, the reshard no
+    longer gives up — the fresh allocator is installed anyway, live
+    requests re-warm (cold re-prefill without a cached prefix), and
+    the rung walk still lands."""
     from tilelang_mesh_tpu.serving import kv_cache as kvmod
+    obs.reset()
     eng, alloc = make_mesh_engine(name="elastic-migfail")
+    # cache disabled -> the re-warm has nothing to restore from and
+    # must cold re-prefill (the warm variant is the next test)
+    eng.workload.prefix_cache = None
     r = eng.submit(context_tokens=16, new_tokens=1, seed=1)
 
     def boom(src, dst):
@@ -456,14 +461,58 @@ def test_failed_migration_leaves_layout_unchanged(monkeypatch):
 
     monkeypatch.setattr(kvmod, "migrate", boom)
     err = DeviceLossError("slice died", site="serve.shard")
-    assert eng._maybe_reshard(err) is False
-    assert eng.reshards == 0
-    assert eng.workload.layout.name == "head_parallel:2x2"
-    assert eng.workload.allocator is alloc
-    assert serving_meta().get("layout") == "head_parallel:2x2"
+    assert eng._maybe_reshard(err) is True
+    assert eng.reshards == 1
+    assert eng.workload.allocator is not alloc
+    assert eng.workload.layout.name == "head_parallel:2x1"
+    assert serving_meta().get("layout") == "head_parallel:2x1"
+    c = obs.get_tracer().counters()
+    assert c.get("serve.reshard.rewarm{source=cold}", 0) >= 1
+    assert "rewarm" in [sp.name for sp in r.trace.spans]
     monkeypatch.undo()
     eng.run()
     assert r.outcome == "result"
+
+
+def test_failed_migration_rewarm_hits_prefix_cache(
+        tmp_path, monkeypatch):
+    """The re-warm path consults the prefix cache first: a live
+    request whose whole-page prefix is cached restores WARM on the
+    fresh placement (``prefix_cache.hit`` lands on the reshard path)
+    instead of cold re-prefilling."""
+    from tilelang_mesh_tpu.serving import kv_cache as kvmod
+    from tilelang_mesh_tpu.serving import reset_prefix_cache
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    try:
+        obs.reset()
+        eng, alloc = make_mesh_engine(name="elastic-migwarm")
+        prompt = [11_000 + i for i in range(16)]   # 2 whole pages
+        seed_req = eng.submit(context_tokens=16, new_tokens=1, seed=1,
+                              prompt_tokens=list(prompt))
+        eng.run()
+        assert seed_req.outcome == "result"        # prefix now cached
+        r = eng.submit(context_tokens=16, new_tokens=1, seed=2,
+                       prompt_tokens=list(prompt))
+        hits_before = obs.get_tracer().counters().get(
+            "prefix_cache.hit", 0)
+
+        def boom(src, dst):
+            raise KVCacheExhausted("injected migration failure",
+                                   site="serve.kv")
+
+        monkeypatch.setattr(kvmod, "migrate", boom)
+        err = DeviceLossError("slice died", site="serve.shard")
+        assert eng._maybe_reshard(err) is True
+        c = obs.get_tracer().counters()
+        assert c.get("serve.reshard.rewarm{source=prefix}", 0) >= 1
+        assert c.get("prefix_cache.hit", 0) > hits_before
+        assert r.prefix_tokens == 16
+        monkeypatch.undo()
+        eng.run()
+        assert r.outcome == "result"
+    finally:
+        reset_prefix_cache()
 
 
 def test_rewarm_failure_does_not_crash_reshard(monkeypatch):

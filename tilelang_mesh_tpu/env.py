@@ -278,6 +278,32 @@ class Environment:
     # stand-in sampler vocabulary: the decode output is projected onto
     # this many logits before temperature/top-p sampling
     TL_TPU_SERVE_VOCAB = EnvVar("TL_TPU_SERVE_VOCAB", 128, int)
+    # per-tenant admission fairness (serving/admission.py): the largest
+    # fraction of TL_TPU_SERVE_MAX_QUEUE one tenant may hold in flight
+    # before its new arrivals shed "tenant_share"; 1.0 (default) = off
+    TL_TPU_SERVE_TENANT_MAX_SHARE = EnvVar(
+        "TL_TPU_SERVE_TENANT_MAX_SHARE", 1.0, float)
+    # serving fleet (serving/fleet.py): engine count when Fleet is
+    # built without an explicit n_engines
+    TL_TPU_FLEET_ENGINES = EnvVar("TL_TPU_FLEET_ENGINES", 2, int)
+    # consecutive engine step failures before the fleet's per-engine
+    # breaker ejects the engine from routing
+    TL_TPU_FLEET_EJECT_THRESHOLD = EnvVar("TL_TPU_FLEET_EJECT_THRESHOLD",
+                                          3, int)
+    # restart backoff for an ejected engine: base delay, DOUBLED per
+    # failed half-open probe, capped at the max
+    TL_TPU_FLEET_RESTART_BASE_MS = EnvVar("TL_TPU_FLEET_RESTART_BASE_MS",
+                                          50.0, float)
+    TL_TPU_FLEET_RESTART_MAX_MS = EnvVar("TL_TPU_FLEET_RESTART_MAX_MS",
+                                         2000.0, float)
+    # fleet-level watchdog over one engine pump (serve.engine site);
+    # 0 = off (the engine's own step watchdog still applies)
+    TL_TPU_FLEET_STEP_TIMEOUT_MS = EnvVar("TL_TPU_FLEET_STEP_TIMEOUT_MS",
+                                          0.0, float)
+    # fleet routing p99 budget: engines whose windowed step p99 exceeds
+    # it are down-weighted; 0 falls back to TL_TPU_SERVE_P99_BUDGET_MS
+    TL_TPU_FLEET_P99_BUDGET_MS = EnvVar("TL_TPU_FLEET_P99_BUDGET_MS",
+                                        0.0, float)
     # buffer donation for inout params: warm calls whose inout inputs
     # are jax arrays dispatch through jax.jit(donate_argnums=...), so
     # XLA may reuse the input buffer for the aliased output (the caller

@@ -567,6 +567,18 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         "model_fallbacks": c("autotune.model_fallback"),
         "model_rank_agreement": _tune_agreement(),
     }
+    # per-tenant outcome table from the labelled serve.tenant{tenant=,
+    # outcome=} counters the engine records on every terminal
+    # transition (docs/serving.md "Per-tenant fairness")
+    tenants: Dict[str, Dict[str, float]] = {}
+    for k, v in counters.items():
+        if not k.startswith("serve.tenant{"):
+            continue
+        lbl = dict(kv.split("=", 1)
+                   for kv in k[k.index("{") + 1:-1].split(",") if "=" in kv)
+        row = tenants.setdefault(lbl.get("tenant", "?"), {})
+        o = lbl.get("outcome", "?")
+        row[o] = row.get(o, 0) + v
     serving = {
         "admitted": c("serve.admitted"),
         "completed": c("serve.completed"),
@@ -606,8 +618,57 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
                                      kernel="serve.step",
                                      source="serving"),
         "queue_wait": _hist_digest("serve.queue.wait"),
+        "tenants": {t: dict(sorted(row.items()))
+                    for t, row in sorted(tenants.items())},
         "gauges": gauges,
     }
+
+    # tl-fleet (serving/fleet.py): routing shares, failover/readmit
+    # accounting, per-engine step-latency digests, and the live fleets'
+    # health snapshots; None when no fleet ever ran in this process
+    def _fleet_section():
+        def by_engine(prefix: str) -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for k, v in counters.items():
+                if not k.startswith(prefix + "{"):
+                    continue
+                lbl = dict(kv.split("=", 1)
+                           for kv in k[k.index("{") + 1:-1].split(",")
+                           if "=" in kv)
+                e = lbl.get("engine", "?")
+                out[e] = out.get(e, 0) + v
+            return dict(sorted(out.items()))
+
+        if not any(k.startswith("fleet.") for k in counters):
+            return None
+        dispatch = by_engine("fleet.dispatch")
+        total = sum(dispatch.values())
+        step_latency = {}
+        for (hname, labels), h in _hist.histograms():
+            if hname == "fleet.step.latency" and h.count:
+                step_latency[dict(labels).get("engine", "?")] = \
+                    _hist.digest_ms(h)
+        try:
+            from ..serving.fleet import fleet_health
+            health = fleet_health()
+        except Exception:  # noqa: BLE001 — a torn section must never
+            health = {}    # take metrics_summary down with it
+        return {
+            "dispatch": dispatch,
+            "dispatch_share": {e: round(v / total, 4)
+                               for e, v in dispatch.items()} if total
+            else {},
+            "failovers": by_engine("fleet.failover"),
+            "redispatched": labelled_total("fleet.redispatched"),
+            "warm_restores": c("fleet.failover.warm"),
+            "shed_unroutable": c("fleet.failover.lost")
+            + c("fleet.unrouted"),
+            "probes": by_engine("fleet.probe"),
+            "probe_failures": by_engine("fleet.probe_failed"),
+            "readmits": by_engine("fleet.readmit"),
+            "step_latency": dict(sorted(step_latency.items())),
+            "health": health,
+        }
     # tl-scope: sliding-window SLO summary + flight-recorder / request-
     # trace accounting (lazy imports keep layering clean; a torn section
     # must never take metrics_summary down with it)
@@ -647,6 +708,7 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
             "collectives": collectives, "resilience": resilience,
             "verify": verify, "lint": lint, "tile_opt": tile_opt,
             "autotune": autotune, "serving": serving,
+            "fleet": _fleet_section(),
             "slo": _slo_section(), "flight": _flight_section(),
             "sol": _sol_section(), "reqtrace": reqtrace,
             "runtime": _runtime.runtime_summary()}

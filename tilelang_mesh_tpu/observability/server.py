@@ -5,8 +5,12 @@ state while it serves traffic:
 
 - ``/metrics``  — the Prometheus exposition snapshot
   (``export.to_prometheus_text``: counters, span summaries, histograms)
-- ``/healthz``  — liveness + the backend-registry health snapshot
-- ``/slo``      — the sliding-window SLO summary (``slo.slo_summary``)
+- ``/healthz``  — liveness + the backend-registry health snapshot;
+  when a serving :class:`~tilelang_mesh_tpu.serving.Fleet` is live, a
+  ``fleet`` section with per-engine breaker/p99/burn-rate health
+- ``/slo``      — the sliding-window SLO summary (``slo.slo_summary``),
+  plus a ``fleets`` key of per-engine window summaries when a fleet
+  is live
 - ``/flight``   — the flight recorder's ring + dump accounting
 - ``/prof``     — the tl-sol profiler snapshot: per-kernel
   speed-of-light records, drift-detector state, and the retune queue
@@ -68,7 +72,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(json.dumps(_health()), "application/json")
             elif path == "/slo":
                 from .slo import slo_summary
-                self._send(json.dumps(slo_summary()), "application/json")
+                body = slo_summary()
+                try:
+                    from ..serving.fleet import fleet_slo
+                    fs = fleet_slo()
+                    if fs:
+                        body = dict(body)
+                        body["fleets"] = fs
+                except Exception:  # noqa: BLE001 — fleet view is additive
+                    pass
+                self._send(json.dumps(body), "application/json")
             elif path == "/flight":
                 from . import flight as _flight
                 self._send(json.dumps(_flight.snapshot()),
@@ -98,6 +111,13 @@ def _health() -> dict:
     try:
         from ..serving.request import gauges, serving_meta
         out["serving"] = {"gauges": gauges(), "meta": serving_meta()}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..serving.fleet import fleet_health
+        fh = fleet_health()
+        if fh:
+            out["fleet"] = fh
     except Exception:  # noqa: BLE001
         pass
     return out

@@ -75,6 +75,7 @@ FAULT_SITES = (
     "serve.step",
     "serve.kv",
     "serve.shard",
+    "serve.engine",
 )
 
 _KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt",

@@ -21,6 +21,11 @@ yields sampled tokens one at a time (closing it cancels);
 ``serving/prefix_cache.py`` restores shared whole-page prompt prefixes
 from checksummed cached pages instead of recomputing them.
 
+Multi-engine serving (docs/serving.md "Fleet serving & failover"):
+``Fleet`` supervises N engines behind the SLO-aware ``Router`` —
+per-engine breakers, half-open restart probes, and zero-loss failover
+that re-dispatches a dead engine's live requests to healthy peers.
+
 ``serving_state()`` is the live-gauge snapshot
 ``metrics_summary()["serving"]`` embeds (queue depth, KV slab levels);
 monotonic accounting rides the ``serve.*`` tracer counters.
@@ -31,6 +36,8 @@ from .admission import (AdmissionController, SERVE_BREAKER_SIG,  # noqa: F401
 from .batcher import (DecodeWorkload, FlashDecodeWorkload,  # noqa: F401
                       MLADecodeWorkload)
 from .engine import ServingEngine, TokenStream  # noqa: F401
+from .fleet import (EngineSlot, Fleet, fleet_health,  # noqa: F401
+                    fleet_slo, registered_fleets)
 from .kv_cache import (KVCacheExhausted, KVSnapshot,  # noqa: F401
                        PagedKVAllocator, migrate)
 from .mesh_workload import (LAYOUT_KINDS, MeshDecodeWorkload,  # noqa: F401
@@ -41,6 +48,7 @@ from .prefix_cache import (PrefixEntry, PrefixKVCache,  # noqa: F401
 from .request import (OUTCOMES, Request, SHED_REASONS, STATES,  # noqa: F401
                       default_prompt, gauges as serving_state,
                       publish_meta, reset_gauges, serving_meta)
+from .router import Router, fleet_sig, fleet_p99_budget_ms  # noqa: F401
 from .sampling import sample_token  # noqa: F401
 from .shard import ServeShardConfig, match_partition_rules  # noqa: F401
 
@@ -56,4 +64,7 @@ __all__ = [
     "serving_meta", "publish_meta", "reset_gauges", "default_prompt",
     "PrefixEntry", "PrefixKVCache", "get_prefix_cache",
     "reset_prefix_cache", "sample_token",
+    "Fleet", "EngineSlot", "Router", "fleet_sig",
+    "fleet_p99_budget_ms", "fleet_health", "fleet_slo",
+    "registered_fleets",
 ]

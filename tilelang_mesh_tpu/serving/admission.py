@@ -26,6 +26,10 @@ exists instead of new heuristics:
   expiring later.
 - **drain mode** — a draining engine finishes in-flight work and sheds
   every new arrival (``draining``).
+- **tenant share** — opt-in fairness cap
+  (``TL_TPU_SERVE_TENANT_MAX_SHARE`` < 1.0): a tenant already holding
+  that fraction of the queue capacity sheds its new arrivals
+  (``tenant_share``) so one hot tenant cannot crowd every slot.
 
 ``serve.admit`` is the fault site on this path: an injected fault is
 accounted as ``admit_fault`` shedding, never an exception to the
@@ -79,18 +83,25 @@ class AdmissionController:
                               else env.TL_TPU_SERVE_P99_BUDGET_MS)
         self.grace_ms = (grace_ms if grace_ms is not None
                          else env.TL_TPU_SERVE_GRACE_MS)
+        self.tenant_max_share = env.TL_TPU_SERVE_TENANT_MAX_SHARE
 
     def decide(self, *, draining: bool, queue_depth: int,
                free_pages: int, pages_needed: int,
                remaining_s: Optional[float],
                steps_requested: int,
-               prefill_chunks: int = 0) -> Tuple[bool, Optional[str]]:
+               prefill_chunks: int = 0,
+               tenant_inflight: int = 0) -> Tuple[bool, Optional[str]]:
         """(admit?, shed reason). Ordered so the cheapest checks run
-        first and the reason names the FIRST gate that failed."""
+        first and the reason names the FIRST gate that failed.
+        ``tenant_inflight`` is how many queued requests the arriving
+        request's tenant already holds."""
         if draining:
             return False, "draining"
         if queue_depth >= self.max_queue:
             return False, "queue_full"
+        if self.tenant_max_share < 1.0 and \
+                tenant_inflight >= self.tenant_max_share * self.max_queue:
+            return False, "tenant_share"
         if global_breaker().is_open(SERVE_BREAKER_SIG):
             return False, "breaker_open"
         if free_pages < pages_needed:

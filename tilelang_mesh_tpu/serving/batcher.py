@@ -296,6 +296,24 @@ class DecodeWorkload:
         self.allocator.write_token(page, req.tail_tokens, k, v)
         req.tail_tokens = (req.tail_tokens + 1) % ps
 
+    def replay_tokens(self, req: Request) -> int:
+        """Re-derive the KV of every ALREADY-SAMPLED token onto this
+        workload's allocator — the decode half of adopting a request
+        whose pages died elsewhere (fleet failover, reshard re-warm):
+        the prompt KV was just rebuilt by ``ingest``/``prefill_chunk``,
+        and because token KV is pure in (token id, position) the
+        replayed bytes are bitwise what the lost placement held.
+        Returns the number of tokens replayed."""
+        ps = self.allocator.page_size
+        for i, tok in enumerate(req.generated):
+            if req.tail_tokens == 0:
+                req.pages.extend(self.allocator.alloc(1, req.req_id))
+            k, v = self._content_kv(int(tok), req.context_tokens + i)
+            self.allocator.write_token(req.pages[-1], req.tail_tokens,
+                                       k, v)
+            req.tail_tokens = (req.tail_tokens + 1) % ps
+        return len(req.generated)
+
     # -- sampling ------------------------------------------------------
     def sample(self, req: Request, out) -> int:
         """One token id from a decode step's output: project onto the

@@ -111,6 +111,7 @@ class ServingEngine:
                  step_timeout_ms: Optional[float] = None,
                  retry_max: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
+                 tenant_weights: Optional[Dict[str, int]] = None,
                  name: str = "serve"):
         self.workload = workload
         self.admission = admission or AdmissionController()
@@ -128,12 +129,16 @@ class ServingEngine:
         # prefill work wedged between two decode dispatches)
         self.prefill_per_step = env.TL_TPU_SERVE_PREFILL_PER_STEP
         self.name = name
+        # per-tenant batch weights (picks per round-robin round in
+        # _form_batch); unlisted tenants weigh 1
+        self.tenant_weights = dict(tenant_weights or {})
         self.requests: List[Request] = []    # every submission, in order
         self._queue: List[Request] = []      # admitted, awaiting a batch
         self._draining = False
         self._steps = 0
         self._failovers = 0
-        self._warmed = False
+        self._step_failures = 0   # every _on_step_failure entry — the
+        self._warmed = False      # fleet's per-engine breaker signal
         # elastic mesh serving (serving/mesh_workload.py): the layout
         # ladder the engine walks on a sharded-step device loss /
         # watchdog timeout, bounded by TL_TPU_SERVE_RESHARD_MAX
@@ -165,18 +170,22 @@ class ServingEngine:
                payload: Optional[dict] = None,
                prompt_tokens: Optional[list] = None,
                temperature: float = 0.0,
-               top_p: float = 1.0) -> Request:
+               top_p: float = 1.0,
+               tenant: Optional[str] = None) -> Request:
         """Admit or shed one request; ALWAYS returns the request with a
         state transition recorded (shed requests come back terminal).
         ``prompt_tokens`` is the prompt's token ids (default: derived
         from ``seed`` — identical seeds share a prefix-cache address);
-        ``temperature``/``top_p`` are the sampling knobs (0 = greedy)."""
+        ``temperature``/``top_p`` are the sampling knobs (0 = greedy);
+        ``tenant`` is the fairness label admission shares and batch
+        round-robin key on (None = "default")."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         req = Request(context_tokens, new_tokens, deadline_ms=deadline_ms,
                       seed=seed, payload=payload,
                       prompt_tokens=prompt_tokens,
-                      temperature=temperature, top_p=top_p)
+                      temperature=temperature, top_p=top_p,
+                      tenant=tenant)
         self.requests.append(req)
         try:
             _faults.maybe_fail("serve.admit", req=req.req_id)
@@ -192,7 +201,9 @@ class ServingEngine:
             remaining_s=req.remaining_s(),
             steps_requested=new_tokens,
             prefill_chunks=self.workload.prefill_chunks_needed(
-                context_tokens))
+                context_tokens),
+            tenant_inflight=sum(1 for r in self._queue
+                                if r.tenant == req.tenant))
         if not ok:
             return self._shed(req, reason)
         try:
@@ -219,6 +230,7 @@ class ServingEngine:
         req.finish("shed", shed_reason=reason, error=error)
         self._retire_slabs(req)
         _trace.inc("serve.shed", reason=reason)
+        _trace.inc("serve.tenant", tenant=req.tenant, outcome="shed")
         _trace.event("serve.shed", "serving", req=req.req_id,
                      reason=reason, error=error)
         self._observe_e2e(req)
@@ -270,19 +282,33 @@ class ServingEngine:
 
     def _form_batch(self) -> List[Request]:
         """FIFO head defines the page bucket; same-bucket followers fill
-        the batch up to ``max_batch`` (order preserved — no starvation:
-        the head is always served). Requests still mid-prefill are not
-        decode-eligible and are skipped (their chunk units run in the
-        prefill quantum instead)."""
+        the batch up to ``max_batch`` — interleaved weighted round-robin
+        across tenants (FIFO within a tenant, the head's tenant picked
+        first) so one tenant's backlog cannot monopolize every batch
+        slot while another waits. With a single tenant this degenerates
+        to the original FIFO fill; the head is always served — no
+        starvation. Requests still mid-prefill are not decode-eligible
+        and are skipped (their chunk units run in the prefill quantum
+        instead)."""
         ready = [r for r in self._queue
                  if not r.needs_prefill and not r.cancel_requested]
         if not ready:
             return []
         head_bucket = self.workload.bucket_of(ready[0])
-        batch = []
+        by_tenant: Dict[str, List[Request]] = {}
         for r in ready:
             if self.workload.bucket_of(r) == head_bucket:
-                batch.append(r)
+                by_tenant.setdefault(r.tenant, []).append(r)
+        order = list(by_tenant)   # first-seen order: head's tenant first
+        batch: List[Request] = []
+        while len(batch) < self.max_batch and \
+                any(by_tenant[t] for t in order):
+            for t in order:
+                take = max(1, int(self.tenant_weights.get(t, 1)))
+                while take > 0 and by_tenant[t] \
+                        and len(batch) < self.max_batch:
+                    batch.append(by_tenant[t].pop(0))
+                    take -= 1
                 if len(batch) >= self.max_batch:
                     break
         for r in batch:
@@ -480,16 +506,24 @@ class ServingEngine:
         if skew is not None:
             publish_gauges(shard_skew=skew)
 
+    def pump_bound(self) -> int:
+        """The finite pump bound ``run()``/``TokenStream`` share: 20x
+        the total outstanding work (decode steps + worst-case prefill
+        chunk units) plus slack. Recomputed per call — submissions
+        arriving mid-pump extend it; a scheduler bug still cannot pump
+        forever."""
+        total = sum(r.new_tokens
+                    + self.workload.prefill_chunks_needed(
+                        r.context_tokens)
+                    for r in self.requests) or 1
+        return 20 * total + 100
+
     def run(self, max_steps: Optional[int] = None) -> int:
         """Pump ``step()`` until idle; returns steps executed. The
         default bound is generous but FINITE — the no-unbounded-waits
         contract holds even against a scheduler bug."""
         if max_steps is None:
-            total = sum(r.new_tokens
-                        + self.workload.prefill_chunks_needed(
-                            r.context_tokens)
-                        for r in self.requests) or 1
-            max_steps = 20 * total + 100
+            max_steps = self.pump_bound()
         n = 0
         while n < max_steps:
             if not self.step():
@@ -536,7 +570,8 @@ class ServingEngine:
                payload: Optional[dict] = None,
                prompt_tokens: Optional[list] = None,
                temperature: float = 0.0,
-               top_p: float = 1.0) -> "TokenStream":
+               top_p: float = 1.0,
+               tenant: Optional[str] = None) -> "TokenStream":
         """The streaming front-end: submit + an iterator yielding one
         event dict per sampled token (``{"token", "index", "req",
         "trace_id"}``) as decode steps land. The iterator pumps
@@ -547,7 +582,8 @@ class ServingEngine:
         req = self.submit(context_tokens, new_tokens,
                           deadline_ms=deadline_ms, seed=seed,
                           payload=payload, prompt_tokens=prompt_tokens,
-                          temperature=temperature, top_p=top_p)
+                          temperature=temperature, top_p=top_p,
+                          tenant=tenant)
         return TokenStream(self, req)
 
     @property
@@ -625,6 +661,7 @@ class ServingEngine:
             _trace.inc("serve.shed", reason=shed_reason)
             _trace.event("serve.shed", "serving", req=req.req_id,
                          reason=shed_reason, error=error)
+        _trace.inc("serve.tenant", tenant=req.tenant, outcome=outcome)
         self._observe_e2e(req)
 
     def _retire_slabs(self, req: Request) -> None:
@@ -641,6 +678,7 @@ class ServingEngine:
     # -- failure handling ----------------------------------------------
     def _on_step_failure(self, batch: List[Request], exc: Exception) -> None:
         kind = classify(exc)
+        self._step_failures += 1
         _trace.inc("serve.step_failures", kind=kind)
         _trace.event("serve.step_failure", "serving", kind=kind,
                      batch=[r.req_id for r in batch],
@@ -689,6 +727,10 @@ class ServingEngine:
         # transient / timeout / device_loss: retry within budget
         grace_s = self.grace_ms / 1e3
         for r in batch:
+            if r.is_terminal:
+                # retired during the reshard re-warm (the fresh
+                # placement could not hold it) — already accounted
+                continue
             if r.expired(grace_s):
                 self._finish(r, "deadline_exceeded")
             elif r.retries < self.retry_max:
@@ -747,24 +789,33 @@ class ServingEngine:
         # too — a known-dead device must never re-enter a layout
         exclude = sorted(set(lost) | set(reg.quarantined_devices()))
         # 2. migrate the surviving KV slabs into a fresh placement
-        # FIRST, checksummed + byte-conservation-verified, so a failure
-        # anywhere below leaves a consistent engine: a failed migration
-        # keeps the OLD allocator installed (nothing moved) and falls
-        # through to the ordinary failure handling
+        # FIRST, checksummed + byte-conservation-verified. When the
+        # migration itself fails (the bytes cannot be carried over),
+        # the reshard no longer gives up (ROADMAP 1(d)): the fresh
+        # placement is installed anyway and every live request is
+        # RE-WARMED from the prefix cache — a whole-page prefix
+        # restores warm (``prefix_cache.hit`` lands on the reshard
+        # path), the rest cold re-prefills, and already-sampled tokens
+        # replay content-derived
         from .kv_cache import migrate
         new_alloc = wl.make_allocator()
+        rewarmed = None
         try:
             mapping, nbytes = migrate(wl.allocator, new_alloc)
         except Exception as e:  # noqa: BLE001 — migration must not crash
-            logger.error(
+            logger.warning(
                 "serving engine %s: KV migration off %s failed "
-                "(%s: %s); keeping the old placement", self.name, frm,
+                "(%s: %s); re-warming live requests from the prefix "
+                "cache on a fresh placement", self.name, frm,
                 type(e).__name__, e)
-            return False
-        wl.install_allocator(new_alloc)
-        for r in self.requests:
-            if not r.is_terminal and r.pages:
-                r.pages = [mapping[p] for p in r.pages]
+            mapping, nbytes = {}, 0
+            wl.install_allocator(new_alloc)
+            rewarmed = self._rewarm_requests()
+        else:
+            wl.install_allocator(new_alloc)
+            for r in self.requests:
+                if not r.is_terminal and r.pages:
+                    r.pages = [mapping[p] for p in r.pages]
         # 3. next rung (skips rungs that cannot build on the survivors);
         # on failure the engine stays on the OLD layout with its KV
         # migrated in place — byte-identical state, books balanced
@@ -794,6 +845,7 @@ class ServingEngine:
         _trace.event("serve.reshard", "serving", engine=self.name,
                      frm=frm, to=to.name, pages=len(mapping),
                      bytes=nbytes, lost=sorted(lost),
+                     rewarmed=rewarmed,
                      error=f"{type(exc).__name__}: {exc}")
         publish_meta(layout=to.name)
         # the old layout's straggler signal dies with its mesh; the
@@ -805,6 +857,91 @@ class ServingEngine:
             "%d device(s) quarantined", self.name, type(exc).__name__,
             exc, frm, to.name, len(mapping), nbytes, len(lost))
         return True
+
+    def _rewarm_requests(self) -> Dict[str, int]:
+        """Rebuild every live request's KV on the just-installed fresh
+        allocator when a reshard migration could not carry the bytes
+        over: ``ingest`` consults the prefix cache first (a whole-page
+        prefix restores warm — that lookup is where ``prefix_cache.hit``
+        lands on the reshard path), cold re-prefill otherwise; already-
+        sampled tokens replay content-derived. A request the fresh
+        placement cannot hold sheds ``kv_exhausted``. Returns warm/cold
+        counts for the reshard event."""
+        out = {"warm": 0, "cold": 0}
+        for r in list(self.requests):
+            if r.is_terminal or not (r.pages or r.prefill_pos):
+                continue
+            r.pages = []          # the old placement died with its
+            r.tail_tokens = 0     # allocator; nothing left to free
+            r.prefill_pos = 0
+            r.prefix_tokens = 0
+            try:
+                self.workload.ingest(r)
+                if r.generated:
+                    # mid-decode: the request must be fully prefilled
+                    # before its continuation can replay
+                    while r.needs_prefill:
+                        self.workload.prefill_chunk(r)
+                    self.workload.replay_tokens(r)
+            except (TLError, OSError) as e:
+                if r in self._queue:
+                    self._queue.remove(r)
+                self._finish(r, "shed", shed_reason="kv_exhausted",
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            source = "prefix" if r.prefix_tokens > 0 else "cold"
+            out["warm" if source == "prefix" else "cold"] += 1
+            _trace.inc("serve.reshard.rewarm", source=source)
+            r.trace.mark("rewarm", source=source,
+                         prefix_tokens=r.prefix_tokens,
+                         replayed=len(r.generated))
+        return out
+
+    # -- fleet hooks (serving/fleet.py) --------------------------------
+    def export_inflight(self) -> List[Request]:
+        """Remove and return every live (non-terminal) request,
+        releasing its KV slabs on THIS engine so a healthy peer can
+        rebuild them — the donor half of the fleet's zero-loss
+        failover. Terminal requests stay: their accounting is final."""
+        exported = []
+        for r in [x for x in self.requests if not x.is_terminal]:
+            if r in self._queue:
+                self._queue.remove(r)
+            self._retire_slabs(r)
+            r.prefill_pos = 0
+            r.prefix_tokens = 0
+            self.requests.remove(r)
+            exported.append(r)
+        self._gauges()
+        return exported
+
+    def adopt(self, req: Request, *, source: str = "") -> Request:
+        """Adopt a request exported from a dead peer (the recipient
+        half of zero-loss failover): re-ingest its context on THIS
+        workload — prefix-cache warm restore where a whole-page prefix
+        exists, cold re-prefill otherwise — replay already-sampled
+        tokens, and queue it. The request keeps its identity: req_id,
+        causal trace, deadline, steps_done, generated tokens. Skips
+        admission (it was admitted once; shedding an adopted request
+        on load would break the zero-loss contract) but KV exhaustion
+        still sheds terminally — terminal beats lost."""
+        self.requests.append(req)
+        try:
+            self.workload.ingest(req)
+            if req.generated:
+                while req.needs_prefill:
+                    self.workload.prefill_chunk(req)
+                self.workload.replay_tokens(req)
+        except (TLError, OSError) as e:
+            return self._shed(req, "kv_exhausted",
+                              error=f"{type(e).__name__}: {e}")
+        req.trace.mark("readmit", engine=self.name, frm=source,
+                       warm=req.prefix_tokens > 0,
+                       steps_done=req.steps_done)
+        self._queue.append(req)
+        _trace.inc("serve.adopted", engine=self.name)
+        self._gauges()
+        return req
 
     def _quarantine_and_failover(self, exc: Exception) -> None:
         """Device loss mid-batch: mark the serving tier unhealthy in the
@@ -872,6 +1009,14 @@ class ServingEngine:
     def reshards(self) -> int:
         return self._reshards
 
+    @property
+    def step_failures(self) -> int:
+        """Step failures handled INTERNALLY (``_on_step_failure``
+        swallows the exception to keep the scheduler moving) — the
+        fleet supervisor reads the delta per pump to feed its
+        per-engine breaker."""
+        return self._step_failures
+
     def stats(self) -> dict:
         alloc = self.workload.allocator
         out = {
@@ -895,12 +1040,20 @@ class ServingEngine:
 class TokenStream:
     """Token-at-a-time iterator over one request (the ``stream()``
     front-end): yields an event dict per sampled token, pumping the
-    engine's synchronous ``step()`` underneath. Closing the iterator
+    host's synchronous ``step()`` underneath. Closing the iterator
     before the request retires cancels it — the generator-``close()``
-    analog of a dropped client connection."""
+    analog of a dropped client connection.
 
-    def __init__(self, engine: ServingEngine, request: Request):
-        self.engine = engine
+    The host is anything with the pump protocol — ``step()``,
+    ``cancel(req)``, ``pump_bound()``: a single ``ServingEngine`` or a
+    whole ``Fleet``. Tokens are read off ``req.generated``, never off
+    a particular engine's queue, so a fleet-hosted stream survives
+    failover: when the request is re-dispatched to another engine
+    mid-stream, the next pump decodes it THERE and the stream keeps
+    yielding — the client never learns an engine died."""
+
+    def __init__(self, engine, request: Request):
+        self.engine = engine     # the pump host (engine OR fleet)
         self.request = request
 
     def cancel(self) -> bool:
@@ -913,22 +1066,15 @@ class TokenStream:
         def pending():
             return req.generated[delivered:]
 
-        # same finite-bound discipline as run(), over the WHOLE
-        # engine's work: the stream pumps every request's steps, so a
-        # bound scaled only to this request would spuriously cancel a
+        # same finite-bound discipline as run(), over the WHOLE host's
+        # work: the stream pumps every request's steps, so a bound
+        # scaled only to this request would spuriously cancel a
         # healthy stream queued behind a long-running neighbor.
         # Recomputed per pump — submissions arriving mid-stream extend
         # it, a scheduler bug still cannot pump forever.
-        def bound():
-            total = sum(r.new_tokens
-                        + eng.workload.prefill_chunks_needed(
-                            r.context_tokens)
-                        for r in eng.requests) or 1
-            return 20 * total + 100
-
         try:
             pumps = 0
-            while not req.is_terminal and pumps < bound():
+            while not req.is_terminal and pumps < eng.pump_bound():
                 progressed = eng.step()
                 pumps += 1
                 for tok in pending():

@@ -1,0 +1,591 @@
+"""Fleet: a supervised multi-engine serving tier (tl-fleet).
+
+One ``ServingEngine`` crash losing every in-flight request is fatal
+for fronting real traffic (ROADMAP item 1). The Fleet supervises N
+engines — each with its OWN workload + allocator, built by a
+``workload_factory`` so restarts get fresh state — and admits requests
+through the SLO-aware ``Router`` (serving/router.py). The robustness
+core is **zero-loss failover** built on two properties the stack
+already guarantees: KV content is pure in (token id, position), so a
+request's pages can be re-derived bitwise on any engine, and the
+content-addressed prefix cache is shared fleet-wide, so a whole-page
+prefix restores *warm* on the adopting engine.
+
+Supervision state machine, per engine slot::
+
+    LIVE --- death / breaker trip ---> EJECTED (backoff scheduled)
+     ^                                    |
+     |  probe passes: breaker reset,      |  backoff elapsed
+     |  backoff reset, fleet.readmit      v
+     +------------------------------ HALF_OPEN
+                                          |
+      probe fails: backoff DOUBLES  ------+--> EJECTED
+
+An engine dies three ways, all handled identically within ONE fleet
+step: an exception escaping ``engine.step()``, the fleet-level
+watchdog (``TL_TPU_FLEET_STEP_TIMEOUT_MS``) abandoning a hung pump,
+or an injected fault at the ``serve.engine`` site (armed around every
+pump AND every half-open probe, so chaos can kill a restart too).
+Engine-internal step failures — swallowed by the engine's own
+``_on_step_failure`` to keep its scheduler moving — feed the per-engine
+circuit breaker via the ``step_failures`` delta per pump;
+``TL_TPU_FLEET_EJECT_THRESHOLD`` consecutive ones eject the engine the
+same way.
+
+Failover: the dead engine's live requests are exported
+(``export_inflight`` frees their slabs on the victim), each is marked
+``failover`` in its causal chain, re-routed to a healthy peer, and
+adopted there (``adopt``: prefix-cache warm restore where a whole-page
+prefix exists, cold re-prefill otherwise, generated tokens replayed
+content-derived, ``readmit`` mark) — a mid-stream ``TokenStream``
+keeps yielding from the new engine, because tokens come off the
+request, not the engine. One flight dump per failover
+(``engine_failover``) names the victim and the re-routed trace ids.
+When no healthy peer exists the request sheds ``failover`` — terminal
+beats lost; the all-terminal contract survives a full-fleet outage.
+
+Drive it exactly like one engine: ``submit``/``stream``/``step``/
+``run``/``drain`` (deterministic, what tests and the ``--fleet`` chaos
+soak use), or ``start()``/``stop()`` to host each engine on its own
+daemon pump thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional
+
+from ..env import env
+from ..observability import flight as _flight
+from ..observability import histogram as _hist
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from .engine import ServingEngine, TokenStream, _bounded_step
+from .request import Request
+from .router import Router
+
+__all__ = ["Fleet", "EngineSlot", "fleet_health", "fleet_slo",
+           "registered_fleets"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.serving")
+
+# live fleets, for /healthz + /slo (weak: a fleet dying with its test
+# must not haunt the telemetry endpoint)
+_FLEETS: "weakref.WeakValueDictionary[str, Fleet]" = \
+    weakref.WeakValueDictionary()
+
+
+def registered_fleets() -> Dict[str, "Fleet"]:
+    return dict(_FLEETS)
+
+
+def fleet_health() -> Dict[str, dict]:
+    """Per-fleet health sections for ``/healthz`` (guarded upstream)."""
+    return {name: f.health() for name, f in _FLEETS.items()}
+
+
+def fleet_slo() -> Dict[str, dict]:
+    """Per-fleet, per-engine SLO summaries for ``/slo``."""
+    return {name: {s.name: f.router.slo_summary(s.name)
+                   for s in f.slots}
+            for name, f in _FLEETS.items()}
+
+
+class EngineSlot:
+    """One supervised engine position: the slot's name is stable across
+    restarts; the engine instance is rebuilt fresh each time."""
+
+    __slots__ = ("index", "name", "engine", "state", "backoff_ms",
+                 "restart_due", "restarts", "consecutive_failures",
+                 "last_step_failures", "submitted", "shed",
+                 "last_tick")
+
+    def __init__(self, index: int, name: str):
+        self.index = index
+        self.name = name
+        self.engine: Optional[ServingEngine] = None
+        self.state = "ejected"            # until the first build
+        self.backoff_ms = 0.0
+        self.restart_due = 0.0
+        self.restarts = 0
+        self.consecutive_failures = 0
+        self.last_step_failures = 0
+        self.submitted = 0                # per-slot tallies feeding the
+        self.shed = 0                     # router's per-engine SLO
+        self.last_tick = 0.0
+
+
+class Fleet:
+    """Supervised N-engine serving tier; duck-types the single-engine
+    surface (``submit``/``stream``/``step``/``run``/``drain``/
+    ``cancel``/``requests``/``outcomes``) so accounting audits and
+    ``TokenStream`` work unchanged."""
+
+    def __init__(self, workload_factory: Callable[[], object],
+                 n_engines: Optional[int] = None, *,
+                 router: Optional[Router] = None,
+                 engine_kwargs: Optional[dict] = None,
+                 restart_base_ms: Optional[float] = None,
+                 restart_max_ms: Optional[float] = None,
+                 step_timeout_ms: Optional[float] = None,
+                 probe_deadline_ms: float = 5000.0,
+                 name: str = "fleet"):
+        self.workload_factory = workload_factory
+        self.n_engines = (n_engines if n_engines is not None
+                          else env.TL_TPU_FLEET_ENGINES)
+        if self.n_engines < 1:
+            raise ValueError("a fleet needs at least one engine")
+        self.router = router or Router()
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.restart_base_ms = (restart_base_ms
+                                if restart_base_ms is not None
+                                else env.TL_TPU_FLEET_RESTART_BASE_MS)
+        self.restart_max_ms = (restart_max_ms
+                               if restart_max_ms is not None
+                               else env.TL_TPU_FLEET_RESTART_MAX_MS)
+        self.step_timeout_ms = (step_timeout_ms
+                                if step_timeout_ms is not None
+                                else env.TL_TPU_FLEET_STEP_TIMEOUT_MS)
+        self.probe_deadline_ms = probe_deadline_ms
+        self.name = name
+        self.requests: List[Request] = []   # every submission + probes
+        self._draining = False
+        self._warmed = False
+        self._failovers = 0
+        self._lock = threading.RLock()
+        self._stop_evt = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.slots = [EngineSlot(i, f"{name}/e{i}")
+                      for i in range(self.n_engines)]
+        for slot in self.slots:
+            slot.backoff_ms = self.restart_base_ms
+            self._build_slot(slot)
+        _FLEETS[name] = self
+
+    # -- engine lifecycle ----------------------------------------------
+    def _build_slot(self, slot: EngineSlot) -> None:
+        # backoff is deliberately NOT touched here: only a PASSED probe
+        # resets it to base — a rebuild that fails its probe must keep
+        # doubling
+        wl = self.workload_factory()
+        slot.engine = ServingEngine(wl, name=slot.name,
+                                    **self.engine_kwargs)
+        slot.state = "live"
+        slot.consecutive_failures = 0
+        slot.last_step_failures = 0
+        if self._draining:
+            slot.engine.drain()
+
+    def warmup(self) -> int:
+        """Warm every engine's bucket kernels before traffic; restarted
+        engines re-warm inside their half-open probe."""
+        with self._lock:
+            n = sum(s.engine.warmup() for s in self.slots
+                    if s.engine is not None)
+            self._warmed = True
+            return n
+
+    # -- submission ----------------------------------------------------
+    def _live_candidates(self,
+                         exclude: Optional[str] = None) -> List[dict]:
+        return [{"name": s.name, "queue_depth": s.engine.queue_depth}
+                for s in self.slots
+                if s.state == "live" and s.engine is not None
+                and s.name != exclude]
+
+    def _slot_by_name(self, name: str) -> EngineSlot:
+        return next(s for s in self.slots if s.name == name)
+
+    def submit(self, context_tokens: int, new_tokens: int = 1,
+               **kwargs) -> Request:
+        """Route one request to the healthiest engine (weighted
+        least-loaded over breaker-closed LIVE slots) and admit it
+        there; ALWAYS returns a request with a recorded transition —
+        with zero routable engines it comes back shed ``failover``."""
+        with self._lock:
+            target = self.router.pick(self._live_candidates())
+            if target is None:
+                req = Request(context_tokens, new_tokens,
+                              deadline_ms=kwargs.get("deadline_ms"),
+                              seed=kwargs.get("seed", 0),
+                              payload=kwargs.get("payload"),
+                              prompt_tokens=kwargs.get("prompt_tokens"),
+                              temperature=kwargs.get("temperature", 0.0),
+                              top_p=kwargs.get("top_p", 1.0),
+                              tenant=kwargs.get("tenant"))
+                self.requests.append(req)
+                self._finish_shed(req, "failover",
+                                  error="no routable engine")
+                _trace.inc("fleet.unrouted")
+                return req
+            slot = self._slot_by_name(target)
+            req = slot.engine.submit(context_tokens, new_tokens,
+                                     **kwargs)
+            self.requests.append(req)
+            req.trace.mark("route", engine=slot.name)
+            _trace.inc("fleet.dispatch", engine=slot.name)
+            slot.submitted += 1
+            if req.outcome == "shed":
+                slot.shed += 1
+            return req
+
+    def stream(self, context_tokens: int, new_tokens: int = 1,
+               **kwargs) -> TokenStream:
+        """Fleet-hosted streaming: the stream pumps the WHOLE fleet, so
+        it keeps yielding after its request fails over to another
+        engine (the kill-mid-stream contract)."""
+        req = self.submit(context_tokens, new_tokens, **kwargs)
+        return TokenStream(self, req)
+
+    def _finish_shed(self, req: Request, reason: str,
+                     error: Optional[str] = None) -> None:
+        """Terminal shed for a request no engine owns (unroutable
+        submission / failover with no healthy peer) — the same
+        counters + e2e observation an engine-side shed records, so
+        fleet accounting stays exact."""
+        req.finish("shed", shed_reason=reason, error=error)
+        _trace.inc("serve.shed", reason=reason)
+        _trace.inc("serve.tenant", tenant=req.tenant, outcome="shed")
+        _trace.event("serve.shed", "serving", req=req.req_id,
+                     reason=reason, error=error)
+        if req.terminal_t is not None:
+            _hist.observe("serve.e2e.latency",
+                          req.terminal_t - req.submit_t,
+                          outcome=req.outcome)
+
+    # -- supervision / pumping -----------------------------------------
+    def step(self) -> bool:
+        """One fleet scheduling step: run due half-open probes, then
+        pump every LIVE engine once (a dying pump fails over inside
+        this same step — the router ejects within one step). False
+        when nothing progressed (idle)."""
+        with self._lock:
+            progressed = False
+            now = time.monotonic()
+            for slot in self.slots:
+                if slot.state == "ejected" and slot.engine is None \
+                        and now >= slot.restart_due:
+                    self._probe(slot)
+                    progressed = True
+            for slot in self.slots:
+                if slot.state == "live":
+                    progressed |= self._pump(slot)
+            return progressed
+
+    def _pump(self, slot: EngineSlot) -> bool:
+        eng = slot.engine
+        base_failures = eng.step_failures
+        t0 = time.perf_counter()
+        try:
+            _faults.maybe_fail("serve.engine", engine=slot.name)
+            if self.step_timeout_ms > 0:
+                progressed = _bounded_step(
+                    eng.step, self.step_timeout_ms / 1e3,
+                    f"{slot.name} pump")
+            else:
+                progressed = eng.step()
+        except Exception as e:  # noqa: BLE001 — any escape is a death
+            self._fail_engine(slot, e)
+            return True
+        dt = time.perf_counter() - t0
+        if progressed:
+            self.router.observe_step(slot.name, dt)
+        new_failures = eng.step_failures - base_failures
+        if new_failures:
+            slot.consecutive_failures += new_failures
+            for _ in range(new_failures):
+                self.router.record_failure(slot.name)
+            if self.router.is_open(slot.name):
+                self._fail_engine(slot, RuntimeError(
+                    f"{slot.consecutive_failures} consecutive step "
+                    f"failure(s)"))
+                return True
+        elif progressed:
+            slot.consecutive_failures = 0
+            self.router.note_success(slot.name)
+        self._tick_slot(slot)
+        return progressed
+
+    def _tick_slot(self, slot: EngineSlot) -> None:
+        """Throttled per-engine SLO sample for the router."""
+        now = time.monotonic()
+        if now - slot.last_tick < 0.05:
+            return
+        slot.last_tick = now
+        out = slot.engine.outcomes()
+        self.router.tick(slot.name, submitted=slot.submitted,
+                         shed=slot.shed, completed=out["result"],
+                         failed=out["failed"], now=now)
+
+    def _fail_engine(self, slot: EngineSlot, exc: Exception) -> None:
+        """Eject a dead engine and fail its work over, all inside the
+        current fleet step: breaker forced open (no live traffic while
+        open), live requests exported + re-routed to healthy peers,
+        restart scheduled with the slot's current backoff."""
+        eng = slot.engine
+        self._failovers += 1
+        slot.state = "ejected"
+        slot.engine = None
+        self.router.force_open(slot.name)
+        err = f"{type(exc).__name__}: {exc}"
+        _trace.inc("fleet.failover", engine=slot.name)
+        _trace.event("fleet.failover", "fleet", fleet=self.name,
+                     engine=slot.name, error=err)
+        victims = eng.export_inflight() if eng is not None else []
+        redispatched, warm, lost = [], 0, 0
+        for r in victims:
+            r.trace.mark("failover", frm=slot.name, error=err)
+            target = self.router.pick(
+                self._live_candidates(exclude=slot.name))
+            if target is None:
+                # no healthy peer: terminal beats lost
+                self._finish_shed(r, "failover", error=err)
+                lost += 1
+                continue
+            dst = self._slot_by_name(target)
+            dst.engine.adopt(r, source=slot.name)
+            redispatched.append(r.trace_id)
+            _trace.inc("fleet.redispatched", frm=slot.name, to=target)
+            if not r.is_terminal and r.prefix_tokens > 0:
+                warm += 1
+                _trace.inc("fleet.failover.warm")
+        if lost:
+            _trace.inc("fleet.failover.lost", lost)
+        # the black box: one dump per failover naming the victim and
+        # every re-routed trace id — the post-mortem reconstructs who
+        # moved where without replaying the soak
+        _flight.dump("engine_failover", fleet=self.name,
+                     victim=slot.name, error=err,
+                     redispatched_trace_ids=redispatched,
+                     warm_restores=warm, shed_unroutable=lost)
+        slot.restart_due = time.monotonic() + slot.backoff_ms / 1e3
+        logger.warning(
+            "fleet %s: engine %s died (%s); %d request(s) re-dispatched "
+            "(%d warm), %d shed, restart in %.0fms", self.name,
+            slot.name, err, len(redispatched), warm, lost,
+            slot.backoff_ms)
+
+    def _probe(self, slot: EngineSlot) -> None:
+        """Half-open: rebuild the engine from the factory, re-warm, and
+        serve ONE probe request end to end through the guarded pump
+        (the ``serve.engine`` site is armed here too — chaos can kill
+        the restart). Pass -> LIVE with the breaker reset and backoff
+        back to base; fail -> EJECTED with backoff DOUBLED."""
+        slot.state = "half_open"
+        _trace.inc("fleet.probe", engine=slot.name)
+        req = None
+        eng = None
+        ok = False
+        err = None
+        try:
+            _faults.maybe_fail("serve.engine", engine=slot.name,
+                               probe=True)
+            self._build_slot(slot)
+            eng, slot.state = slot.engine, "half_open"
+            if self._warmed:
+                eng.warmup()
+            wl = eng.workload
+            ctx = wl.page_buckets[0] * wl.allocator.page_size
+            req = eng.submit(ctx, 1, deadline_ms=self.probe_deadline_ms,
+                             seed=slot.index)
+            pumps, bound = 0, eng.pump_bound()
+            while not req.is_terminal and pumps < bound:
+                _faults.maybe_fail("serve.engine", engine=slot.name,
+                                   probe=True)
+                if not eng.step():
+                    break
+                pumps += 1
+            ok = req.outcome == "result"
+        except Exception as e:  # noqa: BLE001 — a probe death re-ejects
+            err = f"{type(e).__name__}: {e}"
+        if req is not None:
+            # the probe is a real request: it must reach a terminal
+            # outcome (all-terminal contract) and it stays in the
+            # fleet's accounting either way
+            if not req.is_terminal and eng is not None:
+                eng.cancel(req)
+            self.requests.append(req)
+        if ok:
+            slot.state = "live"
+            slot.backoff_ms = self.restart_base_ms
+            slot.restarts += 1
+            self.router.reset(slot.name)
+            _trace.inc("fleet.readmit", engine=slot.name)
+            _trace.event("fleet.readmit", "fleet", fleet=self.name,
+                         engine=slot.name, restarts=slot.restarts)
+            logger.info("fleet %s: engine %s re-admitted after probe "
+                        "(restart #%d)", self.name, slot.name,
+                        slot.restarts)
+        else:
+            slot.state = "ejected"
+            slot.engine = None
+            self.router.record_failure(slot.name)
+            slot.backoff_ms = min(slot.backoff_ms * 2,
+                                  self.restart_max_ms)
+            slot.restart_due = time.monotonic() + slot.backoff_ms / 1e3
+            _trace.inc("fleet.probe_failed", engine=slot.name)
+            _trace.event("fleet.probe_failed", "fleet", fleet=self.name,
+                         engine=slot.name, error=err,
+                         next_backoff_ms=slot.backoff_ms)
+
+    # -- driving -------------------------------------------------------
+    def pump_bound(self) -> int:
+        """Finite pump bound over the fleet's outstanding work (same
+        discipline as ``ServingEngine.pump_bound``, chunk arithmetic
+        from the env since slots may be mid-restart)."""
+        chunk = max(1, env.TL_TPU_SERVE_PREFILL_CHUNK)
+        total = sum(r.new_tokens + math.ceil(r.context_tokens / chunk)
+                    for r in self.requests) or 1
+        return 20 * total + 100
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Pump ``step()`` until idle; on the (generous, finite) bound
+        tripping, every engine's queue is force-retired — the
+        all-terminal contract holds even against a scheduler bug."""
+        if max_steps is None:
+            max_steps = self.pump_bound()
+        n = 0
+        while n < max_steps:
+            if not self.step():
+                return n
+            n += 1
+        with self._lock:
+            for slot in self.slots:
+                if slot.engine is not None:
+                    slot.engine.run(max_steps=0)   # force-retire queue
+        logger.error("fleet %s: scheduler bound (%d steps) hit; queues "
+                     "force-retired", self.name, max_steps)
+        return n
+
+    def await_readmission(self, timeout_s: float = 10.0,
+                          sleep_s: float = 0.005) -> bool:
+        """Step the fleet until every slot is LIVE again (restart
+        backoffs are wall-clock, so a pure step loop may be too fast);
+        True when the whole fleet is live within the timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(s.state == "live" for s in self.slots):
+                return True
+            self.step()
+            time.sleep(sleep_s)
+        return all(s.state == "live" for s in self.slots)
+
+    def drain(self) -> None:
+        """Stop admitting fleet-wide; ``run()`` finishes in-flight
+        work. Engines restarted after the drain come up draining."""
+        with self._lock:
+            self._draining = True
+            for slot in self.slots:
+                if slot.engine is not None:
+                    slot.engine.drain()
+            _trace.event("fleet.drain", "fleet", fleet=self.name)
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel wherever the request lives NOW (it may have failed
+        over since submission)."""
+        with self._lock:
+            for slot in self.slots:
+                if slot.engine is not None \
+                        and req in slot.engine.requests:
+                    return slot.engine.cancel(req)
+            return False
+
+    # -- thread hosting ------------------------------------------------
+    def start(self) -> None:
+        """Host each engine slot on its own daemon pump thread (the
+        fleet lock serializes scheduling — the deterministic core is
+        unchanged; threads supply liveness, restarts included)."""
+        with self._lock:
+            if self._threads:
+                return
+            self._stop_evt.clear()
+            for slot in self.slots:
+                t = threading.Thread(target=self._host, args=(slot,),
+                                     daemon=True,
+                                     name=f"tl-{slot.name}")
+                t.start()
+                self._threads.append(t)
+
+    def _host(self, slot: EngineSlot) -> None:
+        while not self._stop_evt.is_set():
+            with self._lock:
+                if slot.state == "ejected" and slot.engine is None \
+                        and time.monotonic() >= slot.restart_due:
+                    self._probe(slot)
+                progressed = (self._pump(slot)
+                              if slot.state == "live" else False)
+            if not progressed:
+                self._stop_evt.wait(0.002)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+        self._threads = []
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def failovers(self) -> int:
+        return self._failovers
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(s.engine.queue_depth for s in self.slots
+                   if s.engine is not None)
+
+    def outcomes(self) -> Dict[str, int]:
+        out = {"result": 0, "shed": 0, "deadline_exceeded": 0,
+               "failed": 0, "canceled": 0, "pending": 0}
+        for r in self.requests:
+            out[r.outcome or "pending"] += 1
+        return out
+
+    def leak_check(self) -> Dict[str, dict]:
+        """Per-engine allocator leak audit (empty inner dicts = clean);
+        ejected slots have no allocator — their pages were freed at
+        export."""
+        return {s.name: {str(k): v
+                         for k, v in
+                         s.engine.workload.allocator.leak_check().items()}
+                for s in self.slots if s.engine is not None}
+
+    def health(self) -> dict:
+        """The fleet section of ``/healthz``: per-slot supervision
+        state fused with the router's windowed health."""
+        return {
+            "fleet": self.name,
+            "draining": self._draining,
+            "failovers": self._failovers,
+            "requests": len(self.requests),
+            "engines": {
+                s.name: dict(self.router.health(s.name),
+                             state=s.state,
+                             queue_depth=(s.engine.queue_depth
+                                          if s.engine is not None
+                                          else 0),
+                             restarts=s.restarts,
+                             backoff_ms=s.backoff_ms)
+                for s in self.slots},
+        }
+
+    def stats(self) -> dict:
+        return {
+            "fleet": self.name,
+            "requests": len(self.requests),
+            "outcomes": self.outcomes(),
+            "failovers": self._failovers,
+            "draining": self._draining,
+            "engines": {s.name: (s.engine.stats()
+                                 if s.engine is not None
+                                 else {"state": s.state})
+                        for s in self.slots},
+            "health": self.health(),
+        }

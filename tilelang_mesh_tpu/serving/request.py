@@ -45,10 +45,13 @@ OUTCOMES = ("result", "shed", "deadline_exceeded", "failed", "canceled")
 # the admission-control shed vocabulary (admission.py decides, the
 # engine records ``serve.shed{reason=}``); ``retry_budget`` is the one
 # mid-flight shed: a transient step failure whose deadline headroom
-# cannot absorb another attempt
+# cannot absorb another attempt; ``tenant_share`` is the per-tenant
+# fairness gate (one tenant holding more than its configured share of
+# the queue); ``failover`` is the fleet's last resort — an engine died
+# and no healthy peer could adopt the request
 SHED_REASONS = ("draining", "queue_full", "breaker_open", "kv_exhausted",
                 "deadline_infeasible", "overload", "admit_fault",
-                "retry_budget")
+                "retry_budget", "tenant_share", "failover")
 
 _req_seq = itertools.count(1)
 
@@ -75,13 +78,15 @@ class Request:
                  "tail_tokens", "timeline", "terminal_t", "first_batch_t",
                  "payload", "trace", "_step_span", "prompt_tokens",
                  "temperature", "top_p", "generated", "prefill_pos",
-                 "prefix_tokens", "cancel_requested", "first_token_t")
+                 "prefix_tokens", "cancel_requested", "first_token_t",
+                 "tenant")
 
     def __init__(self, context_tokens: int, new_tokens: int = 1,
                  deadline_ms: Optional[float] = None, seed: int = 0,
                  payload: Optional[Dict[str, Any]] = None,
                  prompt_tokens: Optional[List[int]] = None,
-                 temperature: float = 0.0, top_p: float = 1.0):
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 tenant: Optional[str] = None):
         if context_tokens <= 0:
             raise ValueError("context_tokens must be positive")
         if new_tokens <= 0:
@@ -96,6 +101,10 @@ class Request:
                          if deadline_ms is not None else None)
         self.seed = int(seed)
         self.payload = payload or {}
+        # fairness label: admission shares and batch round-robin key on
+        # it; untagged callers all land in "default" (exactly the old
+        # single-tenant behavior)
+        self.tenant = str(tenant) if tenant else "default"
         # the prompt as token ids — the content address of the prefix
         # cache and the input of the stand-in KV derivation; defaults
         # to a seed-derived deterministic prompt so every pre-prompt

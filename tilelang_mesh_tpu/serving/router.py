@@ -1,0 +1,188 @@
+"""SLO-aware request routing for the serving fleet (tl-fleet).
+
+The Router owns the *policy* half of the fleet: per-engine health and
+the dispatch decision. The Fleet (serving/fleet.py) owns the process
+half — engines, pumps, restarts — and feeds the router its raw
+signals. Health is derived from machinery the stack already has, per
+engine instead of process-wide:
+
+- **windowed step p99 + burn rate** — one ``SLOEngine``
+  (observability/slo.py) per engine, fed synthetic samples built from
+  that engine's own submission/shed tallies and its
+  ``fleet.step.latency{engine=}`` histogram (an exact-label series, so
+  the shared ``kernel.latency{kernel=serve.step}`` estimate admission
+  reads stays unpolluted);
+- **per-engine circuit breaker** — one ``CircuitBreaker``
+  (resilience/retry.py) keyed by the signature ``fleet.<engine>.step``;
+  ``TL_TPU_FLEET_EJECT_THRESHOLD`` consecutive step failures open it
+  and the engine stops receiving live traffic until the fleet's
+  half-open probe passes and resets it.
+
+The dispatch rule is **weighted least-loaded**: among breaker-closed
+candidates, prefer engines whose windowed p99 is inside
+``TL_TPU_FLEET_P99_BUDGET_MS`` (falling back to
+``TL_TPU_SERVE_P99_BUDGET_MS``; engines over budget are a last
+resort), then score ``(queue_depth + 1) * p99 / best_p99`` and take
+the minimum — a degraded engine keeps serving, but its share drops in
+proportion to how much slower it is. Ties break on candidate order,
+so routing is deterministic under the chaos soak's fixed seeds.
+Every decision is visible: the fleet counts ``fleet.dispatch{engine=}``
+per routed request and the analyzer's ``fleet`` view reads the shares
+back.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..env import env
+from ..observability import histogram as _hist
+from ..observability import slo as _slo
+from ..resilience.retry import CircuitBreaker
+
+__all__ = ["Router", "fleet_sig", "fleet_p99_budget_ms",
+           "STEP_HIST_NAME"]
+
+# per-engine step-latency histogram (exact label matching keeps it out
+# of the shared serve.step admission estimate)
+STEP_HIST_NAME = "fleet.step.latency"
+
+
+def fleet_sig(engine: str) -> str:
+    """The per-engine breaker signature."""
+    return f"fleet.{engine}.step"
+
+
+def fleet_p99_budget_ms() -> float:
+    b = env.TL_TPU_FLEET_P99_BUDGET_MS
+    return b if b > 0 else env.TL_TPU_SERVE_P99_BUDGET_MS
+
+
+class Router:
+    """Pure routing policy over named engines; the Fleet feeds signals
+    (``observe_step``/``tick``/``record_failure``) and asks ``pick``."""
+
+    def __init__(self, *, breaker: Optional[CircuitBreaker] = None,
+                 p99_budget_ms: Optional[float] = None,
+                 eject_threshold: Optional[int] = None,
+                 windows: Optional[List[float]] = None,
+                 target: Optional[float] = None):
+        # a dedicated breaker instance by default: the fleet's eject
+        # threshold is its own knob, not TL_TPU_BREAKER_THRESHOLD
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            threshold=(eject_threshold if eject_threshold is not None
+                       else env.TL_TPU_FLEET_EJECT_THRESHOLD))
+        self._budget_ms = p99_budget_ms
+        self._windows = windows
+        self._target = target
+        self._slos: Dict[str, _slo.SLOEngine] = {}
+
+    # -- per-engine breaker --------------------------------------------
+    def sig(self, engine: str) -> str:
+        return fleet_sig(engine)
+
+    def is_open(self, engine: str) -> bool:
+        return self.breaker.is_open(self.sig(engine))
+
+    def record_failure(self, engine: str) -> bool:
+        """One step failure against the engine's breaker; True exactly
+        when this failure trips it open."""
+        return self.breaker.record_failure(self.sig(engine))
+
+    def force_open(self, engine: str) -> None:
+        """Open the engine's breaker NOW (a death is not a countable
+        blip — an engine that died mid-step must stop receiving
+        traffic within the same fleet step)."""
+        s = self.sig(engine)
+        while not self.breaker.is_open(s):
+            self.breaker.record_failure(s)
+
+    def reset(self, engine: str) -> None:
+        """Close the engine's breaker (probe warmup passed)."""
+        self.breaker.reset(self.sig(engine))
+
+    def note_success(self, engine: str) -> None:
+        """A clean pump: consecutive-failure semantics means the count
+        restarts from zero (the stock breaker counts monotonically, so
+        the router resets it while it is still below threshold)."""
+        if not self.is_open(engine):
+            self.breaker.reset(self.sig(engine))
+
+    # -- per-engine SLO signals ----------------------------------------
+    def _slo_for(self, engine: str) -> _slo.SLOEngine:
+        s = self._slos.get(engine)
+        if s is None:
+            s = self._slos[engine] = _slo.SLOEngine(
+                windows=self._windows, target=self._target)
+        return s
+
+    def observe_step(self, engine: str, dt_s: float) -> None:
+        _hist.observe(STEP_HIST_NAME, dt_s, engine=engine)
+
+    def tick(self, engine: str, *, submitted: float, shed: float,
+             completed: float = 0.0, failed: float = 0.0,
+             now: Optional[float] = None) -> None:
+        """Append one synthetic SLO sample for the engine (the fleet
+        calls this per pump with that engine's own tallies) — the same
+        window math as the process-wide ``/slo``, scoped per engine."""
+        h = _hist.get_histogram(STEP_HIST_NAME, engine=engine)
+        hist = None
+        if h is not None and h.count:
+            hist = _hist.Histogram(h.bounds)
+            hist.merge(h)
+        self._slo_for(engine).add({
+            "t": time.monotonic() if now is None else now,
+            "submitted": float(submitted), "shed": float(shed),
+            "completed": float(completed), "failed": float(failed),
+            "deadline_exceeded": 0.0, "hist": hist, "ttft_hist": None,
+            "prefix_hits": 0.0, "prefix_misses": 0.0})
+
+    def window_stats(self, engine: str) -> dict:
+        s = self._slo_for(engine)
+        return s.window_stats(s.windows[0])
+
+    def health(self, engine: str) -> dict:
+        """One engine's routing-health snapshot (what ``/healthz`` and
+        the analyzer surface)."""
+        w = self.window_stats(engine)
+        return {"engine": engine,
+                "breaker_open": self.is_open(engine),
+                "p99_ms": w.get("p99_ms"),
+                "burn_rate": w.get("burn_rate"),
+                "availability": w.get("availability"),
+                "window_s": w.get("window_s")}
+
+    def slo_summary(self, engine: str) -> dict:
+        return self._slo_for(engine).summary()
+
+    def engines(self) -> List[str]:
+        return sorted(self._slos)
+
+    # -- dispatch ------------------------------------------------------
+    def pick(self, candidates: List[dict]) -> Optional[str]:
+        """Weighted least-loaded choice among candidate views
+        (``{"name", "queue_depth"}``, live slots only). Breaker-open
+        engines never receive live traffic; within-budget engines beat
+        over-budget ones; then ``(queue_depth + 1) * p99/best_p99`` is
+        minimized with candidate order as the deterministic
+        tie-break. None when nothing is routable."""
+        live = [c for c in candidates if not self.is_open(c["name"])]
+        if not live:
+            return None
+        p99 = {c["name"]: (self.window_stats(c["name"]).get("p99_ms")
+                           or 0.0)
+               for c in live}
+        budget = (self._budget_ms if self._budget_ms is not None
+                  else fleet_p99_budget_ms())
+        if budget > 0:
+            within = [c for c in live if p99[c["name"]] <= budget]
+            if within:
+                live = within
+        known = [v for v in p99.values() if v > 0]
+        best = min(known) if known else 0.0
+        def score(c):
+            w = p99[c["name"]] / best if best > 0 and p99[c["name"]] > 0 \
+                else 1.0
+            return (c.get("queue_depth", 0) + 1) * w
+        return min(live, key=score)["name"]

@@ -758,6 +758,139 @@ def format_serve_report(records) -> str:
     return "\n".join(lines)
 
 
+def _counter_labels(key: str) -> dict:
+    """Parse ``name{k=v,k2=v2}`` counter-key labels (tracer flattening)."""
+    if "{" not in key:
+        return {}
+    return dict(kv.split("=", 1)
+                for kv in key[key.index("{") + 1:-1].split(",")
+                if "=" in kv)
+
+
+def summarize_fleet(records) -> dict:
+    """Aggregate the multi-engine fleet activity of a JSONL trace:
+    per-engine dispatch shares, failovers with their re-dispatch /
+    warm-restore / lost tallies, probe + readmission cycles, and the
+    per-engine step-latency digests — what the ``fleet`` subcommand
+    and the fleet chaos soak print (docs/serving.md)."""
+    counters: dict = {}
+    failover_events: list = []
+    readmit_events: list = []
+    probe_fail_events: list = []
+    hists: dict = {}
+    for r in records:
+        name = r.get("name")
+        if r.get("type") == "counter" and \
+                str(name).startswith("fleet."):
+            counters[name] = counters.get(name, 0) + r["value"]
+        elif r.get("type") == "event":
+            attrs = r.get("attrs", {})
+            if name == "fleet.failover":
+                failover_events.append(
+                    {k: attrs.get(k) for k in ("fleet", "engine",
+                                               "error")})
+            elif name == "fleet.readmit":
+                readmit_events.append(
+                    {k: attrs.get(k) for k in ("fleet", "engine",
+                                               "restarts")})
+            elif name == "fleet.probe_failed":
+                probe_fail_events.append(
+                    {k: attrs.get(k) for k in ("fleet", "engine", "error",
+                                               "next_backoff_ms")})
+        elif r.get("type") == "histogram" and \
+                name == "fleet.step.latency":
+            from ..observability.histogram import Histogram
+            eng = r.get("labels", {}).get("engine", "?")
+            h = Histogram.from_dict(r)
+            acc = hists.get(eng)
+            hists[eng] = h if acc is None else acc.merge(h)
+
+    def by_label(pfx: str, label: str) -> dict:
+        out: dict = {}
+        for k, v in counters.items():
+            if k == pfx or k.startswith(pfx + "{"):
+                key = _counter_labels(k).get(label, "")
+                out[key] = out.get(key, 0) + v
+        return dict(sorted(out.items()))
+
+    dispatch = by_label("fleet.dispatch", "engine")
+    total = sum(dispatch.values())
+    redisp = {}
+    for k, v in counters.items():
+        if k.startswith("fleet.redispatched{"):
+            lb = _counter_labels(k)
+            redisp[f"{lb.get('frm', '?')} -> {lb.get('to', '?')}"] = \
+                redisp.get(f"{lb.get('frm', '?')} -> {lb.get('to', '?')}",
+                           0) + v
+    from ..observability.histogram import digest_ms
+    return {
+        "dispatch": dispatch,
+        "dispatch_share": {e: round(v / total, 4) for e, v in
+                           dispatch.items()} if total else {},
+        "unrouted": counters.get("fleet.unrouted", 0),
+        "failovers": by_label("fleet.failover", "engine"),
+        "failover_events": failover_events,
+        "redispatched": dict(sorted(redisp.items())),
+        "redispatched_total": sum(redisp.values()),
+        "warm_restores": counters.get("fleet.failover.warm", 0),
+        "shed_unroutable": counters.get("fleet.failover.lost", 0)
+        + counters.get("fleet.unrouted", 0),
+        "probes": by_label("fleet.probe", "engine"),
+        "probe_failures": by_label("fleet.probe_failed", "engine"),
+        "probe_failure_events": probe_fail_events,
+        "readmits": by_label("fleet.readmit", "engine"),
+        "readmit_events": readmit_events,
+        "step_latency": {e: digest_ms(h)
+                         for e, h in sorted(hists.items()) if h.count},
+    }
+
+
+def format_fleet_report(records) -> str:
+    """Human-readable fleet summary of a JSONL trace (CLI ``fleet``
+    subcommand, docs/serving.md)."""
+    s = summarize_fleet(records)
+    if not s["dispatch"] and not s["failovers"] and not s["probes"]:
+        return "fleet: no fleet.* activity in this trace"
+    lines = ["fleet routing:"]
+    for eng, n in s["dispatch"].items():
+        share = s["dispatch_share"].get(eng, 0.0)
+        lines.append(f"  {eng}: {int(n)} dispatched "
+                     f"({share * 100:.1f}% share)")
+    if s["unrouted"]:
+        lines.append(f"  unrouted (no healthy engine) "
+                     f"{int(s['unrouted'])}")
+    if s["failovers"] or s["redispatched_total"]:
+        lines.append("failovers:")
+        for eng, n in s["failovers"].items():
+            lines.append(f"  {eng}: {int(n)} death(s)")
+        for ev in s["failover_events"]:
+            lines.append(f"    {ev.get('engine')}: {ev.get('error')}")
+        for pair, n in s["redispatched"].items():
+            lines.append(f"  re-dispatched {pair}: {int(n)}")
+        lines.append(f"  warm restores           "
+                     f"{int(s['warm_restores'])}")
+        lines.append(f"  shed unroutable         "
+                     f"{int(s['shed_unroutable'])}")
+    if s["probes"] or s["readmits"]:
+        lines.append("restart probes:")
+        for eng in sorted(set(s["probes"]) | set(s["readmits"])
+                          | set(s["probe_failures"])):
+            lines.append(
+                f"  {eng}: probes={int(s['probes'].get(eng, 0))} "
+                f"failed={int(s['probe_failures'].get(eng, 0))} "
+                f"readmitted={int(s['readmits'].get(eng, 0))}")
+        for ev in s["probe_failure_events"]:
+            lines.append(f"    {ev.get('engine')} probe failed "
+                         f"({ev.get('error')}), next backoff "
+                         f"{ev.get('next_backoff_ms')}ms")
+    if s["step_latency"]:
+        lines.append("per-engine step latency:")
+        for eng, d in s["step_latency"].items():
+            lines.append(f"  {eng}: n={d['count']} p50={d['p50_ms']}ms "
+                         f"p99={d['p99_ms']}ms max={d['max_ms']}ms")
+    return "\n".join(lines)
+
+
 def summarize_request(records, trace_id: Optional[str] = None) -> dict:
     """Aggregate the tl-scope request traces of a JSONL trace
     (docs/observability.md): the versioned ``reqtrace`` chain lines
@@ -1421,6 +1554,12 @@ def _run_serve(path, as_json: bool) -> int:
     return 0
 
 
+def _run_fleet(path, as_json: bool) -> int:
+    records = _load_trace(path)
+    _emit(summarize_fleet(records), format_fleet_report(records), as_json)
+    return 0
+
+
 def _run_request(path, as_json: bool, trace_id: Optional[str]) -> int:
     """``analyzer request <jsonl> [--trace-id]`` — per-request causal
     timeline from the versioned reqtrace chains + tagged tracer
@@ -1603,6 +1742,12 @@ def main(argv=None) -> int:
                       "reason, terminal outcomes, KV slab balance, "
                       "step/queue latency (docs/serving.md)")
     p_sv.add_argument("file", help="JSONL trace file")
+    p_ft = sub.add_parser(
+        "fleet", help="multi-engine fleet summary: per-engine dispatch "
+                      "shares, failovers with warm-restore / lost "
+                      "tallies, probe + readmission cycles, per-engine "
+                      "step latency (docs/serving.md)")
+    p_ft.add_argument("file", help="JSONL trace file")
     p_rq = sub.add_parser(
         "request", help="per-request causal timeline from the tl-scope "
                         "reqtrace chains: one summary row per request, "
@@ -1682,8 +1827,8 @@ def main(argv=None) -> int:
                            "(default 0.05 = 5%%)")
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
-    for p in (p_tr, p_fl, p_vf, p_sv, p_rq, p_da, p_tn, p_so, p_fd,
-              p_ln, p_pd):
+    for p in (p_tr, p_fl, p_vf, p_sv, p_ft, p_rq, p_da, p_tn, p_so,
+              p_fd, p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -1695,6 +1840,8 @@ def main(argv=None) -> int:
         return _run_verify(args.file, args.json)
     if args.cmd == "serve":
         return _run_serve(args.file, args.json)
+    if args.cmd == "fleet":
+        return _run_fleet(args.file, args.json)
     if args.cmd == "request":
         return _run_request(args.file, args.json, args.trace_id)
     if args.cmd == "dash":

@@ -39,6 +39,17 @@ mid-step (``serve.shard`` armed ``kind=unreachable``). Exit 0 requires
 ladder, zero leaked KV slabs, KV byte-conservation across the
 migration, and counter/histogram accounting agreement.
 
+``--fleet`` is the fleet chaos mode (tl-fleet, docs/serving.md "Fleet
+serving & failover"): a seeded multi-tenant storm through a supervised
+3-engine ``Fleet`` with streaming clients opened before one engine is
+killed mid-stream (``serve.engine`` armed ``kind=unreachable``). Exit 0
+requires zero lost requests, 100% terminal outcomes, at least one
+warm prefix-cache restore on the failover path, the victim re-admitted
+(half-open probe) and serving live traffic again, every pre-kill
+stream yielding its full token budget, zero KV leaks across engines,
+an atomic ``engine_failover`` flight dump naming the victim + re-routed
+trace ids, and the per-engine fleet step p99 within budget.
+
 ``--seeds 7,13,42`` runs the selected mode once per seed (artifacts
 land in ``<out>/seed<N>`` when more than one); the exit code is the
 worst of the runs. Without ``--seeds`` the single ``--seed`` (default
@@ -973,6 +984,304 @@ def run_serve_lifecycle(out: Path, seed: int, n_requests: int) -> int:
     return 0 if ok else 1
 
 
+def run_fleet(out: Path, seed: int, n_requests: int) -> int:
+    """Fleet chaos soak (the CI ``fleet-chaos`` gate; docs/serving.md
+    "Fleet serving & failover"): a seeded multi-tenant storm through a
+    supervised 3-engine ``Fleet`` with low-rate ``serve.step`` faults
+    underneath, streaming clients opened BEFORE one engine is killed
+    mid-stream (``serve.engine`` armed ``kind=unreachable``), and a
+    post-readmission wave proving the victim serves live traffic
+    again. Asserts the fleet robustness contract:
+
+    - every request reaches a terminal outcome with ZERO lost: no
+      unroutable sheds, no failover-lost requests (healthy peers
+      adopted every victim);
+    - the killed engine is ejected within the kill step, its breaker
+      stays open until the half-open probe passes, and it is
+      re-admitted AND receives new dispatches before the soak ends;
+    - at least one failover re-dispatch restored WARM from the shared
+      prefix cache (whole-page shared prompt, no cold re-prefill);
+    - every ``TokenStream`` opened before the kill yields its full
+      token budget (tokens ride the request, not the engine);
+    - KV slabs balance to zero on every surviving engine (the victim
+      freed its slabs at export);
+    - the counters / ``serve.e2e.latency`` histograms / per-request
+      outcomes agree (the shared ``_serve_accounting`` predicate,
+      fleet-wide), and every terminal request's causal chain closes;
+    - one atomic ``engine_failover`` flight dump names the victim and
+      re-routed trace ids that all belong to this run;
+    - the per-engine fleet step p99 stays within
+      ``TL_TPU_FLEET_P99_BUDGET_MS`` (falling back to
+      ``TL_TPU_SERVE_P99_BUDGET_MS``, else the CI CPU ceiling).
+    """
+    import random
+
+    os.environ["TL_TPU_TRACE"] = "1"
+    import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import flight as _flight
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (Fleet, FlashDecodeWorkload,
+                                           PagedKVAllocator,
+                                           reset_prefix_cache)
+
+    # the fleet p99 acceptance budget: TL_TPU_FLEET_P99_BUDGET_MS when
+    # the operator set a POSITIVE one, TL_TPU_SERVE_P99_BUDGET_MS next,
+    # else the CI-calibrated CPU ceiling
+    budget_ms = 0.0
+    for var in ("TL_TPU_FLEET_P99_BUDGET_MS", "TL_TPU_SERVE_P99_BUDGET_MS"):
+        try:
+            budget_ms = float(os.environ.get(var) or 0.0)
+        except ValueError:
+            budget_ms = 0.0
+        if budget_ms > 0:
+            break
+    if budget_ms <= 0:
+        budget_ms = 250.0
+    # per-run shared prefix tier: the warm-restore gate must prove THIS
+    # run's failover re-warmed from pages THIS run inserted
+    os.environ["TL_TPU_SERVE_PREFIX_DIR"] = str(out / "prefix")
+    reset_prefix_cache()
+    _reset_serving_state()
+    _flight.configure(dump_dir=out / "flight")
+
+    rng = random.Random(seed)
+    tenants = ("acme", "globex", "initech")
+
+    def workload_factory():
+        alloc = PagedKVAllocator(n_pages=512, page_size=8, heads=2,
+                                 head_dim=64)
+        return FlashDecodeWorkload(alloc, batch_buckets=(8,),
+                                   page_buckets=(2, 4))
+
+    import time as _time
+    fleet = Fleet(workload_factory, n_engines=3, name="fleet-soak")
+    t_warm0 = _time.perf_counter()
+    warmed = fleet.warmup()
+    warm_s = _time.perf_counter() - t_warm0
+    ps = 8
+
+    if n_requests < 20:
+        print(f"[chaos-fleet] --requests {n_requests} is below the soak "
+              f"minimum (20): the kill/readmit/drain phases need room "
+              f"to fire", file=sys.stderr)  # noqa: T201
+        return 2
+
+    # two shared whole-page system prompts: their pages land in the
+    # fleet-wide prefix cache, so victims holding them restore WARM on
+    # the adopting engine
+    shared = [[rng.randrange(1 << 20) for _ in range(4 * ps)]
+              for _ in range(2)]
+
+    def make_request():
+        kw = dict(seed=rng.randrange(1 << 30),
+                  tenant=rng.choice(tenants))
+        if rng.random() < 0.45:
+            prompt = list(rng.choice(shared))
+            kw.update(context_tokens=len(prompt), prompt_tokens=prompt,
+                      new_tokens=rng.choice((1, 2, 3)))
+        else:
+            kw.update(context_tokens=rng.choice((16, 24, 32)),
+                      new_tokens=rng.choice((1, 2)))
+        if rng.random() < 0.15:
+            kw.update(deadline_ms=2000.0)
+        return kw
+
+    drain_wave = max(4, n_requests // 25)
+    post_wave = min(24, max(8, n_requests // 20))
+    n_streams = 3
+    burst = 12
+    main_wave = n_requests - drain_wave - post_wave - n_streams - burst
+    phase1 = max(main_wave // 2, 1)
+    print(f"[chaos-fleet] seed={seed}: {n_requests} requests over "  # noqa: T201
+          f"{len(fleet.slots)} engines ({n_streams} streaming, "
+          f"{post_wave} post-readmit, {drain_wave} after drain), "
+          f"{warmed} bucket kernels warmed in {warm_s:.1f}s; one engine "
+          f"killed mid-stream, p99 budget {budget_ms:g}ms")
+    t0 = _time.perf_counter()
+    with inject("serve.step", p=0.02, seed=seed, kind="transient"):
+        # seed the shared prefix cache: one pure-shared-prompt request
+        # per prompt completes before the storm
+        for prompt in shared:
+            fleet.submit(context_tokens=len(prompt),
+                         prompt_tokens=prompt, new_tokens=1,
+                         seed=rng.randrange(1 << 30), tenant="acme")
+        fleet.run()
+
+        # storm phase 1
+        submitted = 0
+        while submitted < phase1:
+            wave = min(rng.randrange(6, 25), phase1 - submitted)
+            for _ in range(wave):
+                fleet.submit(**make_request())
+            submitted += wave
+            for _ in range(rng.randrange(1, 4)):
+                fleet.step()
+
+        # pre-kill burst: shared whole-page-prompt work queued on EVERY
+        # engine (no pumping in between), so the victim dies holding
+        # live requests whose prefix restores warm on the adopter
+        for _ in range(burst):
+            prompt = list(rng.choice(shared))
+            fleet.submit(context_tokens=len(prompt),
+                         prompt_tokens=prompt,
+                         new_tokens=rng.choice((2, 3, 4)),
+                         seed=rng.randrange(1 << 30),
+                         tenant=rng.choice(tenants))
+        # streaming clients on the shared prompt, opened BEFORE the
+        # kill so the kill lands mid-stream; consumed after it — the
+        # tokens ride the request, failover included
+        streams = [fleet.stream(context_tokens=len(shared[0]),
+                                prompt_tokens=list(shared[0]),
+                                new_tokens=3,
+                                seed=rng.randrange(1 << 30),
+                                tenant=rng.choice(tenants))
+                   for _ in range(n_streams)]
+
+        # the kill: the first live engine pumped dies inside this ONE
+        # fleet step; ejection + failover must complete within it
+        live_before = {s.name for s in fleet.slots if s.state == "live"}
+        with inject("serve.engine", kind="unreachable", times=1):
+            fleet.step()
+        ejected = [s.name for s in fleet.slots if s.state != "live"]
+        victim = ejected[0] if ejected else None
+        ejected_within_kill_step = (len(ejected) == 1
+                                    and victim in live_before)
+
+        # storm phase 2 rides through the failover + restart window
+        while submitted < main_wave:
+            wave = min(rng.randrange(6, 25), main_wave - submitted)
+            for _ in range(wave):
+                fleet.submit(**make_request())
+            submitted += wave
+            for _ in range(rng.randrange(1, 4)):
+                fleet.step()
+
+        readmitted = fleet.await_readmission(timeout_s=30.0)
+
+        # post-readmission wave: the victim must receive NEW dispatches
+        disp_before = obs.metrics_summary()["fleet"]["dispatch"] \
+            if victim else {}
+        for _ in range(post_wave):
+            fleet.submit(**make_request())
+        fleet.run()
+        disp_after = obs.metrics_summary()["fleet"]["dispatch"] \
+            if victim else {}
+        victim_served = bool(victim) and (
+            disp_after.get(victim, 0) > disp_before.get(victim, 0))
+
+        # the streams opened before the kill keep yielding (their
+        # requests may have failed over mid-stream)
+        stream_tokens = [sum(1 for _ in s) for s in streams]
+
+        fleet.drain()
+        for _ in range(drain_wave):
+            fleet.submit(**make_request())
+        fleet.run()
+    wall_s = _time.perf_counter() - t0
+
+    # -- the fleet contract checks -------------------------------------
+    leaks = {e: leak for e, leak in fleet.leak_check().items() if leak}
+    in_use = sum(s.engine.workload.allocator.in_use
+                 for s in fleet.slots if s.engine is not None)
+    outcomes = fleet.outcomes()
+    summary = obs.metrics_summary()
+    counters = summary["serving"]
+    fleet_sec = summary["fleet"] or {}
+    e2e_by_outcome, acct_ok = _serve_accounting(fleet, counters)
+    non_terminal = [r.req_id for r in fleet.requests
+                    if not r.is_terminal]
+    incomplete = [r.req_id for r in fleet.requests
+                  if r.is_terminal and not r.trace.complete]
+    # per-engine fleet step p99 (the exact-label fleet.step.latency
+    # series the router also reads)
+    p99s = {}
+    for (hname, labels), h in _hist.histograms():
+        if hname == "fleet.step.latency" and h.count:
+            p99s[dict(labels).get("engine", "?")] = h.quantile(0.99) * 1e3
+    worst_p99 = max(p99s.values()) if p99s else None
+    # the failover black box must name the victim and re-routed ids
+    trace_ids = {r.trace_id for r in fleet.requests}
+    flight_audit = _audit_flight_dumps(out / "flight")
+    failover_heads = []
+    for fname in flight_audit["files"]:
+        try:
+            head = json.loads(
+                (out / "flight" / fname).read_text().splitlines()[0])
+        except Exception:  # noqa: BLE001 — atomicity gated separately
+            continue
+        if head.get("reason") == "engine_failover":
+            failover_heads.append(head)
+    dump_ok = bool(failover_heads) and any(
+        h.get("attrs", {}).get("victim") == victim
+        and h.get("attrs", {}).get("redispatched_trace_ids")
+        and set(h["attrs"]["redispatched_trace_ids"]) <= trace_ids
+        for h in failover_heads)
+    tenants_seen = set(counters.get("tenants", {}))
+    checks = {
+        "all_terminal": not non_terminal,
+        "zero_lost": (not non_terminal
+                      and fleet_sec.get("shed_unroutable", 0) == 0),
+        "kv_slabs_balance_zero": not leaks and in_use == 0,
+        "engine_killed_and_failed_over": fleet.failovers >= 1
+        and victim is not None,
+        "ejected_within_kill_step": ejected_within_kill_step,
+        "warm_restore_redispatch": fleet_sec.get("warm_restores",
+                                                 0) >= 1,
+        "victim_readmitted": readmitted
+        and all(s.state == "live" for s in fleet.slots)
+        and fleet_sec.get("readmits", {}).get(victim, 0) >= 1,
+        "victim_served_after_readmit": victim_served,
+        "streams_survived_failover": all(
+            n == 3 for n in stream_tokens),
+        "per_tenant_accounting": set(tenants) <= tenants_seen,
+        "accounting_matches_histograms": acct_ok,
+        "causal_chains_complete": not incomplete,
+        "failover_flight_dump_names_victims": dump_ok,
+        "flight_dumps_atomic": flight_audit["atomic"],
+        "fleet_p99_within_budget": worst_p99 is not None
+        and worst_p99 <= budget_ms,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "mode": "fleet", "seed": seed, "requests": len(fleet.requests),
+        "engines": [s.name for s in fleet.slots],
+        "victim": victim,
+        "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
+        "warmed_kernels": warmed,
+        "outcomes": outcomes,
+        "shed_by_reason": counters["shed"],
+        "tenants": counters.get("tenants", {}),
+        "fleet": fleet_sec,
+        "stream_tokens": stream_tokens,
+        "step_p99_ms": {e: round(v, 3) for e, v in sorted(p99s.items())},
+        "step_p99_budget_ms": budget_ms,
+        "kv_leaks": {e: leak for e, leak in leaks.items()},
+        "e2e_by_outcome": e2e_by_outcome,
+        "non_terminal_requests": non_terminal,
+        "causally_incomplete_requests": incomplete,
+        "flight": flight_audit,
+        "checks": checks, "ok": ok,
+    }
+    trace_path = out / "fleet_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "fleet_report.json").write_text(json.dumps(report, indent=2))
+    from ..tools.analyzer import format_fleet_report, format_serve_report
+    records = obs.read_jsonl(str(trace_path))
+    summary_txt = (format_fleet_report(records) + "\n\n"
+                   + format_serve_report(records))
+    (out / "fleet_report.txt").write_text(summary_txt + "\n")
+    print(summary_txt)  # noqa: T201
+    for k, v in checks.items():
+        print(f"[chaos-fleet] {k}: {'OK' if v else 'FAIL'}")  # noqa: T201
+    print(f"[chaos-fleet] victim={victim} outcomes={outcomes} "  # noqa: T201
+          f"warm={fleet_sec.get('warm_restores', 0)} in {wall_s:.1f}s "
+          f"-> {'PASS' if ok else 'FAIL'}; artifacts in {out}/")
+    return 0 if ok else 1
+
+
 def run_verify(out: Path, seed: int) -> int:
     """The default mode: seeded corruption on the comm interpret paths,
     the differential selfcheck must catch every scenario."""
@@ -1042,9 +1351,19 @@ def main(argv=None) -> int:
                          "interleaved; asserts 100%% terminal outcomes, "
                          "zero KV leaks, >= 1 prefix-cache hit, and "
                          "decode p99 within budget (docs/serving.md)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet soak: a multi-tenant storm through a "
+                         "supervised 3-engine Fleet with one engine "
+                         "killed mid-stream (serve.engine armed "
+                         "unreachable); asserts zero lost requests, "
+                         "100%% terminal outcomes, >= 1 warm prefix "
+                         "restore on failover, victim re-admitted and "
+                         "serving again, streams yielding across the "
+                         "kill, and fleet p99 within budget "
+                         "(docs/serving.md)")
     ap.add_argument("--requests", type=int, default=500,
                     help="request count for --serve / --serve-mesh / "
-                         "--serve-lifecycle (default 500)")
+                         "--serve-lifecycle / --fleet (default 500)")
     args = ap.parse_args(argv)
 
     try:
@@ -1074,6 +1393,8 @@ def main(argv=None) -> int:
     if args.serve_lifecycle:
         return per_seed(lambda d, s: run_serve_lifecycle(d, s,
                                                          args.requests))
+    if args.fleet:
+        return per_seed(lambda d, s: run_fleet(d, s, args.requests))
     return per_seed(run_verify)
 
 
