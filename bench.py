@@ -354,6 +354,40 @@ def cfg_gemm(M, N, K, dtype="bfloat16"):
                 checked=True)
 
 
+def _mesh_scope_summary(kern, *args):
+    """Drive a few scoped dispatches of a compiled mesh kernel through
+    ``MeshKernel.__call__`` with tl-mesh-scope on and return the compact
+    mesh summary the bench record embeds (per-link ledger bytes,
+    conservation verdict, sampled comm latency) — the runtime
+    counterpart of the record's static comm-opt wire-byte fields."""
+    import os
+    from tilelang_mesh_tpu.observability import meshscope as _ms
+    prev = os.environ.get("TL_TPU_MESH_SCOPE")
+    os.environ["TL_TPU_MESH_SCOPE"] = "1"
+    try:
+        _ms.reset()
+        for _ in range(3):
+            kern(*args)
+        s = _ms.mesh_snapshot()
+        return {
+            "schema": s["schema"], "mesh": s["mesh"],
+            "dispatches": s["dispatches"],
+            "conservation_ok": bool(s["conservation"]["ok"]),
+            "ledger_bytes": s["conservation"]["ledger_bytes"],
+            "links": {k: v["bytes"] for k, v in s["links"].items()},
+            "top_links": s["top_links"],
+            "latency": s["latency"],
+        }
+    except Exception as e:  # noqa: BLE001 — the summary is additive,
+        return {"error": f"{type(e).__name__}: {e}"}  # never a bench kill
+    finally:
+        _ms.reset()
+        if prev is None:
+            os.environ.pop("TL_TPU_MESH_SCOPE", None)
+        else:
+            os.environ["TL_TPU_MESH_SCOPE"] = prev
+
+
 def cfg_mesh_allreduce_smoke(rows=2, cols=2, n=64, m=128):
     """CI perf-smoke config for the mesh comm path: a 2x2 mesh program
     whose two same-payload all_reduces are deduped+fused into ONE psum
@@ -419,6 +453,7 @@ def cfg_mesh_allreduce_smoke(rows=2, cols=2, n=64, m=128):
         extra = {"comm_pre_opt_wire_bytes": opt.get("pre_wire_bytes"),
                  "comm_post_opt_wire_bytes": opt.get("post_wire_bytes"),
                  "comm_hops_saved": opt.get("hops_saved")}
+    extra["mesh"] = _mesh_scope_summary(kern, a)
     return dict(metric=f"mesh all_reduce smoke {rows}x{cols} n={n} m={m} "
                        f"(tile DSL comm-opt vs jax shard_map psum)",
                 flops=2.0 * rows * cols * n * m,
@@ -905,6 +940,11 @@ def cfg_mesh_serve_smoke(requests=48):
         return None if h is None else _h.Histogram.from_dict(h.to_dict())
 
     def run():
+        import os
+        # scope on for the drive: the straggler probe sweeps feed the
+        # tl-mesh-scope skew baseline, so the record's mesh summary
+        # carries real sweep accounting
+        os.environ["TL_TPU_MESH_SCOPE"] = "1"
         eng_m = build_engine(True, "mesh-smoke")
         first_layout = eng_m.workload.layout.name
         before = _step_hist()
@@ -954,7 +994,17 @@ def cfg_mesh_serve_smoke(requests=48):
             "shard_skew": serving_state().get("shard_skew"),
             "mesh_steps": eng_m.stats()["steps"],
             "single_host_steps": eng_s.stats()["steps"],
+            "mesh": _serve_mesh_summary(),
         }
+
+    def _serve_mesh_summary():
+        try:
+            from tilelang_mesh_tpu.observability import meshscope as _ms
+            s = _ms.mesh_snapshot()
+            return {"schema": s["schema"], "skew": s["skew"],
+                    "dispatches": s["dispatches"]}
+        except Exception as e:  # noqa: BLE001 — additive, never a kill
+            return {"error": f"{type(e).__name__}: {e}"}
 
     return dict(metric=f"elastic mesh serving smoke: {requests} "
                        f"requests on a 2x2 host mesh, slice kill + "

@@ -1,0 +1,695 @@
+"""tl-mesh-scope tests (observability/meshscope.py; docs/observability.md
+"Mesh communication").
+
+Covers the PR 18 tentpole: the route model's per-collective link
+decomposition and its conservation invariant (routed link bytes ==
+static post-opt wire bytes, for every collective kind on a sweep of
+mesh shapes), the wire_bytes audit pins for CommFused shared slots and
+chunked collectives, sampled per-collective timing on the 2x2 CPU host
+mesh, skew-episode edge triggering + the flight dump naming the slow
+core, the ``/mesh`` scrape and strict Prometheus exposition grammar,
+``analyzer mesh`` text + ``--json``, and the off-switch contract (an
+unscoped dispatch path never even builds the scope).
+"""
+
+import json
+import re
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tilelang_mesh_tpu as tilelang
+import tilelang_mesh_tpu.language as T
+import tilelang_mesh_tpu.observability as obs
+from tilelang_mesh_tpu.observability import flight
+from tilelang_mesh_tpu.observability import meshscope as ms
+from tilelang_mesh_tpu.observability.meshscope import (
+    MESH_SCHEMA, MeshScope, core_name, link_name, route_record)
+from tilelang_mesh_tpu.parallel import mesh_config
+from tilelang_mesh_tpu.parallel.lowering import (
+    _schedule_hops, _schedule_steps)
+from tilelang_mesh_tpu.transform import pass_config
+
+MESH = (2, 2)
+NROW, NCOL = MESH
+SHAPE = (8, 32)
+TARGET = f"cpu-mesh[{NROW}x{NCOL}]"
+
+_DIR_CODE = {"h": 0, "v": 1, "all": 2}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    """Scope state is process-global (singleton, histograms, tracer):
+    every test starts clean and leaves no armed knobs behind."""
+    for var in ("TL_TPU_MESH_SCOPE", "TL_TPU_RUNTIME_SAMPLE",
+                "TL_TPU_MESH_SKEW", "TL_TPU_MESH_SKEW_ALPHA",
+                "TL_TPU_MESH_SKEW_MADS", "TL_TPU_MESH_SKEW_MIN_REL",
+                "TL_TPU_MESH_SKEW_WARMUP", "TL_TPU_MESH_SKEW_SUSTAIN"):
+        monkeypatch.delenv(var, raising=False)
+    tilelang.clear_cache()
+    obs.reset()
+    yield
+    tilelang.clear_cache()
+    obs.reset()
+
+
+def _need_mesh():
+    import jax
+    if len(jax.devices()) < NROW * NCOL:
+        pytest.skip(f"needs {NROW * NCOL} devices")
+
+
+# ---------------------------------------------------------------------------
+# helpers: static records + stub kernels (no device needed)
+# ---------------------------------------------------------------------------
+
+
+def _hops_for(op, mesh, dirname, src_core=0, dst_core=0):
+    """Schedule hop count straight from the lowering's own schedules —
+    the ground truth the route model must conserve against."""
+    nrow, ncol = mesh
+    kind = op[len("fused_"):] if op.startswith("fused_") else op
+    if kind == "put":
+        sr, sc = divmod(src_core, ncol)
+        dr, dc = divmod(dst_core, ncol)
+        return abs(sr - dr) + abs(sc - dc)
+    d = _DIR_CODE[dirname]
+    if kind == "broadcast":
+        steps = _schedule_steps("broadcast", nrow, ncol, d,
+                                divmod(src_core, ncol))
+    elif kind == "allgather":
+        steps = _schedule_steps("all_gather", nrow, ncol, d)
+    else:
+        steps = _schedule_steps("all_reduce", nrow, ncol, d)
+    return _schedule_hops(steps, nrow, ncol)
+
+
+def _static_rec(op, mesh, dirname="all", payload=4096, segment=1, **kw):
+    """A JSON-safe attrs["collectives"] record shaped exactly like
+    parallel/lowering._account_collective emits."""
+    hops = _hops_for(op, mesh, dirname,
+                     src_core=kw.get("src_core", 0),
+                     dst_core=kw.get("dst_core", 0))
+    return {"kernel": "stub", "segment": segment, "op": op,
+            "dir": dirname,
+            "axis": {"h": "y", "v": "x", "all": "x,y"}[dirname],
+            "payload_bytes": payload, "hops": hops,
+            "wire_bytes": payload * hops, **kw}
+
+
+def _stub_kernel(recs, mesh=MESH, name="stub"):
+    """The artifact surface note_dispatch consumes — enough to drive
+    the ledger without compiling or dispatching anything."""
+    art = types.SimpleNamespace(name=name, mesh_config=mesh,
+                                attrs={"collectives": recs})
+    return types.SimpleNamespace(artifact=art)
+
+
+def _ksum_program():
+    """The smoke kernel: per-row local reduce + all-direction
+    all_reduce on the 2x2 host mesh."""
+    with mesh_config(*MESH):
+        @T.prim_func
+        def ksum(A: T.MeshTensor((NROW * NCOL * SHAPE[0], SHAPE[1]),
+                                 T.MeshShardingPolicy(cross_mesh_dim=0),
+                                 MESH, "float32"),
+                 B: T.MeshTensor((NROW * NCOL * SHAPE[0], 1),
+                                 T.MeshShardingPolicy(cross_mesh_dim=0),
+                                 MESH, "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment(SHAPE, "float32")
+                o = T.alloc_fragment((SHAPE[0], 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, o, "sum", "all", dim=1)
+                T.copy(o, B)
+        return ksum
+
+
+MESHES = [(1, 2), (2, 2), (2, 4), (4, 2), (3, 3), (4, 4), (1, 8)]
+
+
+# ---------------------------------------------------------------------------
+# route model
+# ---------------------------------------------------------------------------
+
+
+class TestRouteModel:
+    def test_core_and_link_names(self):
+        assert core_name(0, 4) == "x0y0"
+        assert core_name(5, 4) == "x1y1"
+        assert core_name(7, 2) == "x3y1"
+        assert link_name((0, 1), 2) == "x0y0->x0y1"
+        assert link_name((3, 1), 2) == "x1y1->x0y1"
+
+    def test_links_are_mesh_neighbors(self):
+        """Every routed link is one directed ICI hop between adjacent
+        cores — the route model can never invent a diagonal wire."""
+        for mesh in MESHES:
+            nrow, ncol = mesh
+            for dirname in ("h", "v", "all"):
+                for op in ("allreduce", "allgather"):
+                    rec = _static_rec(op, mesh, dirname)
+                    for (a, b) in route_record(rec, nrow, ncol):
+                        ra, ca = divmod(a, ncol)
+                        rb, cb = divmod(b, ncol)
+                        assert abs(ra - rb) + abs(ca - cb) == 1
+                        if dirname == "h":
+                            assert ra == rb
+                        if dirname == "v":
+                            assert ca == cb
+
+    def test_conservation_allreduce_allgather(self):
+        """The invariant per record: routed link-byte totals equal
+        payload x schedule hops == wire_bytes, on every mesh shape and
+        direction."""
+        for mesh in MESHES:
+            nrow, ncol = mesh
+            for dirname in ("h", "v", "all"):
+                for op in ("allreduce", "allgather"):
+                    rec = _static_rec(op, mesh, dirname, payload=4096)
+                    routed = route_record(rec, nrow, ncol)
+                    assert sum(routed.values()) == rec["wire_bytes"], \
+                        f"{op} {dirname} on {mesh}"
+
+    def test_conservation_broadcast_every_src(self):
+        for mesh in MESHES:
+            nrow, ncol = mesh
+            for dirname in ("h", "v", "all"):
+                for src in range(nrow * ncol):
+                    rec = _static_rec("broadcast", mesh, dirname,
+                                      payload=512, src_core=src)
+                    routed = route_record(rec, nrow, ncol)
+                    assert sum(routed.values()) == rec["wire_bytes"], \
+                        f"broadcast src={src} {dirname} on {mesh}"
+
+    def test_put_walks_manhattan_path(self):
+        mesh = (3, 3)
+        nrow, ncol = mesh
+        for src in range(9):
+            for dst in range(9):
+                rec = _static_rec("put", mesh, payload=256,
+                                  src_core=src, dst_core=dst)
+                routed = route_record(rec, nrow, ncol)
+                assert sum(routed.values()) == rec["wire_bytes"]
+                sr, sc = divmod(src, ncol)
+                dr, dc = divmod(dst, ncol)
+                hops = abs(sr - dr) + abs(sc - dc)
+                # one distinct link per hop, payload each
+                assert len(routed) == hops
+                if src == dst:
+                    assert routed == {}
+
+    def test_fused_routes_as_inner_kind(self):
+        """A fused record routes like its inner collective with the
+        (distinct-slot summed) fused payload."""
+        for mesh in ((2, 2), (2, 4)):
+            nrow, ncol = mesh
+            fused = _static_rec("fused_allreduce", mesh, "h",
+                                payload=8192, members=2, slots=2)
+            plain = _static_rec("allreduce", mesh, "h", payload=8192)
+            assert route_record(fused, nrow, ncol) == \
+                route_record(plain, nrow, ncol)
+            assert sum(route_record(fused, nrow, ncol).values()) == \
+                fused["wire_bytes"]
+
+    def test_zero_payload_routes_nothing(self):
+        assert route_record({"op": "allreduce", "dir": "all",
+                             "payload_bytes": 0}, 2, 2) == {}
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: wire_bytes audit pins (CommFused shared slots + chunking)
+# ---------------------------------------------------------------------------
+
+
+def _lower(pf, **cfg):
+    if cfg:
+        with pass_config(cfg):
+            return tilelang.lower(pf, target=TARGET)
+    return tilelang.lower(pf, target=TARGET)
+
+
+def _mesh_global(shape):
+    return T.MeshTensor(shape, T.MeshShardingPolicy(cross_mesh_dim=0),
+                        MESH, "float32")
+
+
+class TestWireBytesAudit:
+    """Pin the static accounting the ledger conserves against: fused
+    records carry hops x distinct-slot payload sum (a shared wire slot
+    is counted once), chunked records carry the unchunked wire volume
+    (chunking pipelines bytes, it does not add or remove them)."""
+
+    def test_fused_distinct_slots_sum(self):
+        """Two distinct-payload all_reduces fuse into one record whose
+        payload is the SUM of both slots."""
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _mesh_global((NROW * NCOL * SHAPE[0], SHAPE[1])),
+                  B: _mesh_global((NROW * NCOL * SHAPE[0], 1)),
+                  C: _mesh_global((NROW * NCOL * SHAPE[0], 1))):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_fragment(SHAPE, "float32")
+                    y = T.alloc_fragment(SHAPE, "float32")
+                    o1 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    o2 = T.alloc_fragment((SHAPE[0], 1), "float32")
+                    T.copy(A, x)
+                    T.copy(A, y)
+                    T.comm.all_reduce(x, o1, "sum", "h", dim=1)
+                    T.comm.all_reduce(y, o2, "sum", "h", dim=1)
+                    T.copy(o1, B)
+                    T.copy(o2, C)
+        recs = _lower(k).attrs["collectives"]
+        fused = [r for r in recs if r["op"] == "fused_allreduce"]
+        assert len(fused) == 1
+        rec = fused[0]
+        assert rec["members"] == 2 and rec["slots"] == 2
+        # each all_reduce slot wires its out-sized chunk: (SHAPE[0], 1)
+        # float32 per member, both distinct
+        slot = SHAPE[0] * 4
+        assert rec["payload_bytes"] == 2 * slot
+        assert rec["wire_bytes"] == rec["hops"] * 2 * slot
+        # and the route model conserves the fused record exactly
+        routed = route_record(rec, NROW, NCOL)
+        assert sum(routed.values()) == rec["wire_bytes"]
+
+    def test_fused_shared_slot_counted_once(self):
+        """A duplicate broadcast is dropped and a same-payload broadcast
+        to a second destination SHARES the wire slot: one slot's bytes
+        on the wire, pre-opt accounting remembers all three."""
+        with mesh_config(*MESH):
+            @T.prim_func
+            def k(A: _mesh_global((NROW * NCOL * SHAPE[0], SHAPE[1])),
+                  B: _mesh_global((NROW * NCOL * SHAPE[0], SHAPE[1])),
+                  C: _mesh_global((NROW * NCOL * SHAPE[0], SHAPE[1]))):
+                with T.Kernel(1) as bx:
+                    x = T.alloc_shared(SHAPE, "float32")
+                    d1 = T.alloc_shared(SHAPE, "float32")
+                    d2 = T.alloc_shared(SHAPE, "float32")
+                    T.copy(A, x)
+                    T.comm.broadcast(x, d1, (0, 1), "h")
+                    T.comm.broadcast(x, d1, (0, 1), "h")
+                    T.comm.broadcast(x, d2, (0, 1), "h")
+                    T.copy(d1, B)
+                    T.copy(d2, C)
+        recs = _lower(k).attrs["collectives"]
+        fused = [r for r in recs if r["op"] == "fused_broadcast"]
+        assert len(fused) == 1
+        rec = fused[0]
+        assert rec["members"] == 2 and rec["slots"] == 1
+        one_slot = SHAPE[0] * SHAPE[1] * 4
+        assert rec["payload_bytes"] == one_slot
+        assert rec["wire_bytes"] == rec["hops"] * one_slot
+        # 2 surviving members + 1 dropped duplicate, unfused
+        assert rec["pre_opt_wire_bytes"] == 3 * rec["wire_bytes"]
+        routed = route_record(rec, NROW, NCOL)
+        assert sum(routed.values()) == rec["wire_bytes"]
+
+    def test_chunked_wire_bytes_unchanged(self):
+        """Chunking splits the transfer for overlap; the wire volume —
+        and therefore the ledger — is identical to the unchunked op."""
+        def prog():
+            with mesh_config(*MESH):
+                @T.prim_func
+                def k(A: _mesh_global((NROW * NCOL * SHAPE[0],
+                                       SHAPE[1])),
+                      B: _mesh_global((NROW * NCOL, NCOL, SHAPE[0],
+                                       SHAPE[1]))):
+                    with T.Kernel(1) as bx:
+                        send = T.alloc_shared(SHAPE, "float32")
+                        recv = T.alloc_shared((NCOL, *SHAPE), "float32")
+                        T.copy(A, send)
+                        T.comm.all_gather(send, recv, "h")
+                        T.copy(recv, B[0, 0, 0])
+                return k
+
+        plain = [r for r in _lower(prog()).attrs["collectives"]
+                 if r["op"] == "allgather"]
+        chunked = [r for r in
+                   _lower(prog(), **{"tl.tpu.comm_chunk_bytes": 1024})
+                   .attrs["collectives"]
+                   if r["op"] == "allgather" and r.get("chunks")]
+        assert len(plain) == 1 and len(chunked) == 1
+        assert chunked[0]["chunks"] > 1
+        assert chunked[0]["payload_bytes"] == plain[0]["payload_bytes"]
+        assert chunked[0]["wire_bytes"] == plain[0]["wire_bytes"]
+        assert chunked[0]["pre_opt_wire_bytes"] == plain[0]["wire_bytes"]
+        routed = route_record(chunked[0], NROW, NCOL)
+        assert sum(routed.values()) == chunked[0]["wire_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# ledger + conservation (stub kernels: no device)
+# ---------------------------------------------------------------------------
+
+
+class TestLedger:
+    def test_note_dispatch_conserves(self):
+        scope = MeshScope()
+        rec = _static_rec("allreduce", MESH, "all", payload=1024)
+        kern = _stub_kernel([rec])
+        for _ in range(5):
+            scope.note_dispatch(kern)
+        cons = scope.conservation()
+        assert cons["ok"] is True
+        assert cons["ledger_bytes"] == 5 * rec["wire_bytes"] > 0
+        assert cons["kernels"]["stub"]["dispatches"] == 5
+        assert cons["kernels"]["stub"]["wire_bytes_per_dispatch"] == \
+            rec["wire_bytes"]
+
+    def test_multi_kernel_shared_pool(self):
+        scope = MeshScope()
+        a = _stub_kernel([_static_rec("allreduce", MESH, "h",
+                                      payload=512)], name="a")
+        b = _stub_kernel([_static_rec("broadcast", MESH, "all",
+                                      payload=256, src_core=0)],
+                         name="b")
+        scope.note_dispatch(a)
+        scope.note_dispatch(a)
+        scope.note_dispatch(b)
+        cons = scope.conservation()
+        assert cons["ok"] is True
+        assert set(cons["kernels"]) == {"a", "b"}
+        assert cons["ledger_bytes"] == cons["expected_bytes"]
+
+    def test_summary_links_and_top(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_MESH_SCOPE", "1")
+        scope = MeshScope()
+        rec = _static_rec("allreduce", MESH, "all", payload=2048)
+        scope.note_dispatch(_stub_kernel([rec]))
+        s = scope.summary()
+        assert s["enabled"] is True
+        assert s["mesh"] == [NROW, NCOL]
+        # an all-direction all_reduce on 2x2 touches every directed link
+        assert len(s["links"]) == 8
+        assert all(re.fullmatch(r"x\d+y\d+->x\d+y\d+", n)
+                   for n in s["links"])
+        assert all(row["bytes"] > 0 for row in s["links"].values())
+        assert s["top_links"] and len(s["top_links"]) <= 8
+        assert s["ici_link_bytes_per_s"] > 0
+        assert s["conservation"]["ok"] is True
+
+    def test_mismatched_record_drops_table(self):
+        """A record whose wire_bytes the route model cannot reproduce
+        must NOT silently ledger wrong bytes: the whole kernel's table
+        is dropped, the conservation gate simply has no entry."""
+        scope = MeshScope()
+        bad = _static_rec("allreduce", MESH, "all", payload=1024)
+        bad["wire_bytes"] += 1   # corrupt the static side
+        scope.note_dispatch(_stub_kernel([bad], name="bad"))
+        cons = scope.conservation()
+        assert cons["ledger_bytes"] == 0
+        assert "bad" not in cons["kernels"]
+
+
+# ---------------------------------------------------------------------------
+# sampled-timing smoke on the 2x2 CPU host mesh (real dispatch path)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchSmoke:
+    def test_scoped_dispatch_end_to_end(self, monkeypatch):
+        """The real hook: MeshKernel.__call__ ledgers every scoped
+        dispatch, samples collective timings into comm.latency, and the
+        numerics are untouched by scoping."""
+        _need_mesh()
+        monkeypatch.setenv("TL_TPU_MESH_SCOPE", "1")
+        monkeypatch.setenv("TL_TPU_RUNTIME_SAMPLE", "1")
+        monkeypatch.setattr(ms, "_scope", None)
+        kern = tilelang.compile(_ksum_program(), target=TARGET)
+        a = np.ones((NROW * NCOL * SHAPE[0], SHAPE[1]), np.float32)
+        outs = [np.asarray(kern(a)) for _ in range(3)]
+        # numerics: local row-sum then psum over the 4 cores
+        expect = np.full((NROW * NCOL * SHAPE[0], 1),
+                         NROW * NCOL * SHAPE[1], np.float32)
+        for o in outs:
+            np.testing.assert_allclose(o, expect, rtol=1e-5)
+        scope = ms.get_scope()
+        cons = scope.conservation()
+        name = kern.artifact.name
+        assert cons["ok"] is True and cons["ledger_bytes"] > 0
+        assert cons["kernels"][name]["dispatches"] == 3
+        s = scope.summary()
+        assert len(s["links"]) == 8
+        rows = [r for r in s["collectives"] if r["kernel"] == name]
+        assert rows and rows[0]["samples"] >= 1
+        assert rows[0]["measured_ewma_ms"] > 0
+        assert rows[0]["measured_min_ms"] <= rows[0]["measured_ewma_ms"] \
+            or rows[0]["samples"] == 1
+        assert any(k.startswith("allreduce@") for k in s["latency"])
+
+    def test_off_switch_builds_nothing(self, monkeypatch):
+        """Off is OFF: with TL_TPU_MESH_SCOPE unset a dispatch crosses
+        the hook's single env read and the scope singleton is never
+        even constructed."""
+        _need_mesh()
+        monkeypatch.setattr(ms, "_scope", None)
+        assert ms.mesh_scope_enabled() is False
+        kern = tilelang.compile(_ksum_program(), target=TARGET)
+        a = np.ones((NROW * NCOL * SHAPE[0], SHAPE[1]), np.float32)
+        kern(a)
+        kern(a)
+        assert ms._scope is None
+
+
+# ---------------------------------------------------------------------------
+# skew detection
+# ---------------------------------------------------------------------------
+
+SWEEP_SLOW = {"x0y0": 1e-3, "x0y1": 1e-3, "x1y0": 1e-3, "x1y1": 3e-3}
+SWEEP_FLAT = {k: 1e-3 for k in SWEEP_SLOW}
+
+
+def _skew_knobs(monkeypatch, warmup=4, sustain=2, alpha="1.0"):
+    """alpha=1.0 makes the EWMA track the last ratio exactly — the
+    edge-trigger tests become deterministic step responses."""
+    monkeypatch.setenv("TL_TPU_MESH_SKEW", "1")
+    monkeypatch.setenv("TL_TPU_MESH_SKEW_ALPHA", alpha)
+    monkeypatch.setenv("TL_TPU_MESH_SKEW_WARMUP", str(warmup))
+    monkeypatch.setenv("TL_TPU_MESH_SKEW_SUSTAIN", str(sustain))
+
+
+class TestSkew:
+    def test_episode_fires_exactly_once(self, monkeypatch):
+        _skew_knobs(monkeypatch)
+        scope = MeshScope()
+        fired = []
+        for _ in range(40):
+            fired += scope.observe_shards(dict(SWEEP_SLOW), probe="t")
+        assert len(fired) == 1, "sustained skew must fire exactly once"
+        ev = fired[0]
+        assert ev["shard"] == "x1y1"
+        assert ev["ratio"] > ev["threshold"] > 1.0
+        assert ev["episode"] == 1 and ev["probe"] == "t"
+        skew = scope.summary()["skew"]
+        assert skew["episodes"] == 1 and skew["sweeps"] == 40
+        active = {a["shard"]: a for a in skew["active"]}
+        assert active["x1y1"]["episodes"] == 1
+
+    def test_slow_core_links_named(self, monkeypatch):
+        """The event names the straggler's ICI links, both directions
+        to each mesh neighbor (x1y1 on 2x2 has two neighbors)."""
+        _skew_knobs(monkeypatch)
+        scope = MeshScope()
+        scope.note_dispatch(_stub_kernel(
+            [_static_rec("allreduce", MESH, "all", payload=64)]))
+        fired = []
+        for _ in range(40):
+            fired += scope.observe_shards(dict(SWEEP_SLOW))
+        assert set(fired[0]["links"]) == {
+            "x1y1->x0y1", "x0y1->x1y1", "x1y1->x1y0", "x1y0->x1y1"}
+
+    def test_recovery_rearms_edge(self, monkeypatch):
+        _skew_knobs(monkeypatch)
+        scope = MeshScope()
+        fired = []
+        for _ in range(20):
+            fired += scope.observe_shards(dict(SWEEP_SLOW))
+        assert len(fired) == 1
+        for _ in range(20):   # recovery clears the episode latch
+            fired += scope.observe_shards(dict(SWEEP_FLAT))
+        assert len(fired) == 1
+        for _ in range(20):   # a second sustained episode refires
+            fired += scope.observe_shards(dict(SWEEP_SLOW))
+        assert len(fired) == 2
+        assert scope.summary()["skew"]["episodes"] == 2
+
+    def test_warmup_gates_firing(self, monkeypatch):
+        _skew_knobs(monkeypatch, warmup=10, sustain=2)
+        scope = MeshScope()
+        fired = []
+        for _ in range(8):    # under warmup: never fires
+            fired += scope.observe_shards(dict(SWEEP_SLOW))
+        assert fired == []
+
+    def test_disabled_feed_is_inert(self, monkeypatch):
+        monkeypatch.setenv("TL_TPU_MESH_SKEW", "0")
+        scope = MeshScope()
+        for _ in range(40):
+            assert scope.observe_shards(dict(SWEEP_SLOW)) == []
+        assert scope.summary()["skew"]["sweeps"] == 0
+
+    def test_flight_dump_names_core(self, monkeypatch, tmp_path):
+        _skew_knobs(monkeypatch)
+        flight.configure(dump_dir=tmp_path)
+        try:
+            scope = MeshScope()
+            for _ in range(40):
+                scope.observe_shards(dict(SWEEP_SLOW), probe="t")
+        finally:
+            flight.configure(None)
+        dumps = []
+        for p in sorted(tmp_path.glob("flight_*.jsonl")):
+            with open(p, encoding="utf-8") as fh:
+                head = json.loads(fh.readline())
+            if head.get("reason") == "mesh_skew":
+                dumps.append(head)
+        assert len(dumps) == 1
+        attrs = dumps[0]["attrs"]
+        assert attrs["shard"] == "x1y1"
+        assert attrs["links"] and attrs["episode"] == 1
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /mesh, Prometheus grammar, metrics_summary, analyzer mesh
+# ---------------------------------------------------------------------------
+
+
+def _populate_module_scope(monkeypatch, samples=False):
+    """Route ledger traffic through the MODULE singleton (what the
+    exporters read), via stub dispatches."""
+    monkeypatch.setenv("TL_TPU_MESH_SCOPE", "1")
+    monkeypatch.setattr(ms, "_scope", None)
+    kern = _stub_kernel([_static_rec("allreduce", MESH, "all",
+                                     payload=2048)], name="probe")
+    for _ in range(4):
+        ms.get_scope().note_dispatch(kern)
+    if samples:
+        ms.get_scope().sample_dispatch(kern)
+    return kern
+
+
+class TestSurfaces:
+    def test_mesh_endpoint(self, monkeypatch):
+        from tilelang_mesh_tpu.observability import server
+        _populate_module_scope(monkeypatch)
+        srv = server.start_server(port=0)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/mesh",
+                                        timeout=5) as r:
+                assert r.status == 200
+                snap = json.loads(r.read().decode())
+            # unknown paths 404 with the endpoint index as the body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/nope", timeout=5)
+            index = json.loads(ei.value.read().decode())
+        finally:
+            srv.stop()
+        assert snap["schema"] == MESH_SCHEMA
+        assert snap["dispatches"] == {"probe": 4}
+        assert snap["conservation"]["ok"] is True
+        assert len(snap["links"]) == 8
+        assert "/mesh" in index["endpoints"]
+
+    def test_prometheus_grammar_strict(self, monkeypatch):
+        """Every emitted mesh line must parse under the exposition
+        grammar: TYPE headers, one gauge sample per link label."""
+        from tilelang_mesh_tpu.observability.export import \
+            to_prometheus_text
+        _populate_module_scope(monkeypatch)
+        text = to_prometheus_text()
+        mesh_lines = [ln for ln in text.splitlines()
+                      if "tl_tpu_mesh" in ln]
+        assert "# TYPE tl_tpu_mesh_link_bytes gauge" in mesh_lines
+        sample_re = re.compile(
+            r'^tl_tpu_mesh_link_(bytes|util)'
+            r'\{link="x\d+y\d+->x\d+y\d+"\} '
+            r'-?\d+(\.\d+)?([eE][+-]?\d+)?$')
+        samples = [ln for ln in mesh_lines if not ln.startswith("#")]
+        assert len(samples) >= 8
+        for ln in samples:
+            assert sample_re.fullmatch(ln), f"bad exposition line: {ln}"
+        byte_lines = [ln for ln in samples
+                      if ln.startswith("tl_tpu_mesh_link_bytes")]
+        assert len(byte_lines) == 8
+
+    def test_prometheus_absent_when_unscoped(self, monkeypatch):
+        from tilelang_mesh_tpu.observability.export import \
+            to_prometheus_text
+        monkeypatch.setattr(ms, "_scope", None)
+        assert "tl_tpu_mesh" not in to_prometheus_text()
+
+    def test_metrics_summary_mesh_section(self, monkeypatch):
+        from tilelang_mesh_tpu.observability import metrics_summary
+        _populate_module_scope(monkeypatch)
+        mesh = metrics_summary()["mesh"]
+        assert mesh["enabled"] is True
+        assert mesh["dispatches"] == {"probe": 4}
+        assert mesh["conservation"]["ok"] is True
+
+    def test_metrics_summary_disabled_stub(self, monkeypatch):
+        from tilelang_mesh_tpu.observability import metrics_summary
+        monkeypatch.setattr(ms, "_scope", None)
+        mesh = metrics_summary()["mesh"]
+        assert mesh["mesh"] is None and mesh["dispatches"] == {}
+
+    def test_analyzer_mesh_text_and_json(self, monkeypatch, tmp_path,
+                                         capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        _populate_module_scope(monkeypatch)
+        snap = ms.mesh_snapshot()
+        p = tmp_path / "mesh.json"
+        p.write_text(json.dumps(snap))
+        assert analyzer.main(["mesh", str(p)]) == 0
+        out = capsys.readouterr().out
+        # the heatmap names cores, the table names links
+        assert "x0y0" in out and "x0y0->x0y1" in out
+        assert "conservation" in out.lower()
+        assert analyzer.main(["mesh", str(p), "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["schema"] == MESH_SCHEMA
+        assert parsed["dispatches"] == {"probe": 4}
+
+    def test_analyzer_mesh_rejects_garbage(self, tmp_path, capsys):
+        from tilelang_mesh_tpu.tools import analyzer
+        p = tmp_path / "nope.json"
+        p.write_text(json.dumps({"hello": "world"}))
+        assert analyzer.main(["mesh", str(p)]) == 1
+        capsys.readouterr()
+
+    def test_jsonl_mesh_line(self, monkeypatch):
+        from tilelang_mesh_tpu.observability.export import to_jsonl
+        _populate_module_scope(monkeypatch)
+        lines = [json.loads(ln) for ln in to_jsonl().splitlines()]
+        mesh = [ln for ln in lines if ln.get("type") == "mesh"]
+        assert len(mesh) == 1
+        assert mesh[0]["schema"] == MESH_SCHEMA
+        assert mesh[0]["dispatches"] == {"probe": 4}
+
+
+# ---------------------------------------------------------------------------
+# fault-site attribution (sampled path visits comm.collective)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultAttribution:
+    def test_injected_fault_lands_on_collective(self, monkeypatch):
+        from tilelang_mesh_tpu.resilience import inject
+        kern = _populate_module_scope(monkeypatch)
+        with inject("comm.collective", p=1.0, kind="transient",
+                    times=1):
+            ms.get_scope().sample_dispatch(kern)
+        s = ms.get_scope().summary()
+        assert s["faults"]["injected"] == 1
+        hit = [r for r in s["collectives"] if r["faults"]]
+        assert len(hit) == 1 and hit[0]["op"] == "allreduce"
+        assert hit[0].get("last_fault")
+
+    def test_no_fault_without_injection(self, monkeypatch):
+        kern = _populate_module_scope(monkeypatch)
+        ms.get_scope().sample_dispatch(kern)
+        assert ms.get_scope().summary()["faults"]["injected"] == 0

@@ -46,7 +46,7 @@ from ..transform.plan import FEATURES_VERSION
 
 __all__ = ["CostModel", "analytic_ms", "analytic_terms",
            "features_from_artifact", "features_from_kernel",
-           "rank_agreement", "FEATURES_VERSION"]
+           "ici_link_bytes_per_s", "rank_agreement", "FEATURES_VERSION"]
 
 # ridge regularizer: heavy enough that a handful of seed samples can't
 # produce wild extrapolation, light enough to learn a systematic offset
@@ -87,6 +87,15 @@ def features_from_kernel(kernel) -> Optional[Dict[str, float]]:
     return features_from_artifact(getattr(kernel, "artifact", None))
 
 
+def ici_link_bytes_per_s(arch: Optional[TPUArch] = None) -> float:
+    """Bytes/s of ONE directed ICI link — the roofline constant shared
+    between ``t_ici`` here and the mesh-scope ledger's per-link
+    utilization (``observability/meshscope.py``), so the tuner's comm
+    term and the runtime's congestion view can never disagree about
+    link bandwidth."""
+    return float((arch or auto_arch()).ici_gbps_per_link) * 1e9
+
+
 def analytic_terms(feats: Dict[str, float],
                    arch: Optional[TPUArch] = None) -> Dict[str, object]:
     """The roofline, term by term (ms): the public per-term breakdown
@@ -107,7 +116,7 @@ def analytic_terms(feats: Dict[str, float],
     t_hbm = float(feats.get("hbm_bytes") or 0) / (arch.hbm_gbps * 1e9)
     t_vpu = float(feats.get("vpu_elems") or 0) / _VPU_ELEMS_PER_S
     t_ici = float(feats.get("wire_bytes") or 0) / (
-        arch.ici_gbps_per_link * arch.ici_links * 1e9)
+        ici_link_bytes_per_s(arch) * arch.ici_links)
     t_grid = float(feats.get("grid_steps") or 1) * _TILE_OVERHEAD_S
     t = max(t_mxu, t_hbm, t_vpu)
     roof = "mxu" if t == t_mxu else ("hbm" if t == t_hbm else "vpu")

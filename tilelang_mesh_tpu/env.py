@@ -211,6 +211,26 @@ class Environment:
     TL_TPU_SOL_DRIFT_SUSTAIN = EnvVar("TL_TPU_SOL_DRIFT_SUSTAIN", 3, int)
     # bound on the retune queue surfaced at /prof (oldest entries drop)
     TL_TPU_SOL_RETUNE_MAX = EnvVar("TL_TPU_SOL_RETUNE_MAX", 64, int)
+    # tl-mesh-scope runtime mesh communication observability
+    # (observability/meshscope.py; docs/observability.md "Mesh
+    # communication"): every scoped MeshKernel dispatch lands in the
+    # per-link ICI traffic ledger; sampled dispatches (the
+    # TL_TPU_RUNTIME_SAMPLE cadence) additionally time each collective
+    # into comm.latency{op,axis}. Off by default — the only cost on the
+    # mesh dispatch path is then one env read.
+    TL_TPU_MESH_SCOPE = EnvVar("TL_TPU_MESH_SCOPE", False, bool)
+    # straggler/skew detection over per-shard step timings (the serving
+    # shard probe feeds it): EWMA+MAD baseline of each shard's
+    # slowdown ratio vs the sweep median, edge-triggered episodes
+    # (mesh.skew counter + traced event + flight dump). "0" disables
+    # the detector (the ledger and latency records stay on).
+    TL_TPU_MESH_SKEW = EnvVar("TL_TPU_MESH_SKEW", True, bool)
+    TL_TPU_MESH_SKEW_ALPHA = EnvVar("TL_TPU_MESH_SKEW_ALPHA", 0.25, float)
+    TL_TPU_MESH_SKEW_MADS = EnvVar("TL_TPU_MESH_SKEW_MADS", 6.0, float)
+    TL_TPU_MESH_SKEW_MIN_REL = EnvVar("TL_TPU_MESH_SKEW_MIN_REL",
+                                      0.5, float)
+    TL_TPU_MESH_SKEW_WARMUP = EnvVar("TL_TPU_MESH_SKEW_WARMUP", 8, int)
+    TL_TPU_MESH_SKEW_SUSTAIN = EnvVar("TL_TPU_MESH_SKEW_SUSTAIN", 3, int)
     # host dispatch fast path (jit/dispatch.py; docs/host_dispatch.md):
     # precompiled per-kernel dispatch plans — monomorphic warm-path
     # closure, single-tuple shape/dtype fingerprint, cached flag reads.

@@ -26,6 +26,7 @@ recording on with ``TL_TPU_RUNTIME_METRICS=1``; see
 
 from . import flight  # noqa: F401  (tl-scope: always-on flight recorder)
 from . import histogram as _histogram
+from . import meshscope  # noqa: F401  (tl-mesh-scope: mesh comm observability)
 from . import reqtrace  # noqa: F401  (tl-scope: per-request causal tracing)
 from . import runtime as _runtime
 from . import slo as _slo
@@ -47,6 +48,8 @@ from .slo import SLOEngine, get_slo, slo_summary  # noqa: F401
 from .sol import (SOL_SCHEMA, SolStore, note_dispatch,  # noqa: F401
                   observe_bucket, prof_snapshot, sol_enabled,
                   sol_records, sol_summary)
+from .meshscope import (COMM_HIST, MESH_SCHEMA, MeshScope,  # noqa: F401
+                        mesh_scope_enabled, mesh_snapshot, mesh_summary)
 
 
 def reset() -> None:
@@ -60,6 +63,7 @@ def reset() -> None:
     flight.reset()
     _slo.reset()
     sol.reset()
+    meshscope.reset()
 
 
 __all__ = [
@@ -79,4 +83,7 @@ __all__ = [
     # tl-sol: speed-of-light profiling + drift detection
     "sol", "SOL_SCHEMA", "SolStore", "sol_enabled", "note_dispatch",
     "observe_bucket", "sol_records", "sol_summary", "prof_snapshot",
+    # tl-mesh-scope: mesh communication observability
+    "meshscope", "MESH_SCHEMA", "COMM_HIST", "MeshScope",
+    "mesh_scope_enabled", "mesh_summary", "mesh_snapshot",
 ]

@@ -161,6 +161,10 @@ def to_jsonl(tracer: Optional[Tracer] = None) -> str:
             "drift": _json_safe(_sol.get_sol().drift_summary()),
             "retune_queue": _json_safe(_sol.retune_queue())}))
         lines += [json.dumps(_json_safe(r)) for r in sol_recs]
+    mesh = _mesh_snapshot_safe()
+    if mesh is not None:
+        lines.append(json.dumps(
+            {"type": "mesh", **_json_safe(mesh)}))
     chains = _reqtrace.traces()
     if chains:
         lines.append(json.dumps({
@@ -179,6 +183,22 @@ def _sol_records_safe() -> List[dict]:
         return _sol.sol_records()
     except Exception:
         return []
+
+
+def _mesh_snapshot_safe() -> Optional[dict]:
+    """The tl-mesh-scope snapshot when the scope ledgered anything this
+    process, else None (a torn scope must never make a trace artifact
+    unwritable). This is the ``{"type": "mesh"}`` line ``analyzer
+    mesh`` reads out of a trace JSONL."""
+    try:
+        from . import meshscope as _ms
+        if _ms._scope is None:
+            return None
+        snap = _ms.mesh_snapshot()
+        return snap if snap.get("dispatches") or snap.get(
+            "skew", {}).get("sweeps") else None
+    except Exception:
+        return None
 
 
 def write_jsonl(path, tracer: Optional[Tracer] = None) -> Path:
@@ -248,6 +268,7 @@ def to_prometheus_text(tracer: Optional[Tracer] = None) -> str:
         lines.append(f"{mname}_seconds_sum {sum(durs) / 1e6:.9g}")
     lines.extend(_prometheus_histogram_lines())
     lines.extend(_prometheus_sol_lines())
+    lines.extend(_prometheus_mesh_lines())
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -274,6 +295,37 @@ def _prometheus_sol_lines() -> List[str]:
     if queue is not None:
         lines.append("# TYPE tl_tpu_sol_retune_queue_depth gauge")
         lines.append(f"tl_tpu_sol_retune_queue_depth {len(queue)}")
+    return lines
+
+
+def _prometheus_mesh_lines() -> List[str]:
+    """tl-mesh-scope gauges: per-directed-ICI-link ledgered bytes and
+    utilization vs the per-link roofline, labelled by link
+    (``x<r>y<c>->x<r>y<c>``). Absent entirely until the scope has
+    ledgered at least one dispatch, so an unscoped process exposes no
+    empty mesh families."""
+    try:
+        from . import meshscope as _ms
+        if _ms._scope is None:
+            return []
+        summary = _ms.mesh_summary()
+        links = summary.get("links") or {}
+    except Exception:
+        return []
+    if not links:
+        return []
+    lines = ["# TYPE tl_tpu_mesh_link_bytes gauge"]
+    for name, row in links.items():
+        lab = f'link="{escape_label_value(name)}"'
+        lines.append(f"tl_tpu_mesh_link_bytes{{{lab}}} {row['bytes']:g}")
+    with_util = [(n, r) for n, r in links.items()
+                 if r.get("util") is not None]
+    if with_util:
+        lines.append("# TYPE tl_tpu_mesh_link_util gauge")
+        for name, row in with_util:
+            lab = f'link="{escape_label_value(name)}"'
+            lines.append(
+                f"tl_tpu_mesh_link_util{{{lab}}} {row['util']:g}")
     return lines
 
 
@@ -696,6 +748,18 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
         except Exception:
             return None
 
+    def _mesh_section():
+        try:
+            from . import meshscope as _ms
+            # never instantiate the scope just to summarize it: an
+            # unscoped process reports a disabled stub
+            if _ms._scope is None:
+                return {"enabled": _ms.mesh_scope_enabled(),
+                        "mesh": None, "dispatches": {}}
+            return _ms.mesh_summary()
+        except Exception:
+            return None
+
     req_traces = _reqtrace.traces(kind="request")
     reqtrace = {
         "traces": len(req_traces),
@@ -710,7 +774,8 @@ def metrics_summary(tracer: Optional[Tracer] = None) -> dict:
             "autotune": autotune, "serving": serving,
             "fleet": _fleet_section(),
             "slo": _slo_section(), "flight": _flight_section(),
-            "sol": _sol_section(), "reqtrace": reqtrace,
+            "sol": _sol_section(), "mesh": _mesh_section(),
+            "reqtrace": reqtrace,
             "runtime": _runtime.runtime_summary()}
 
 

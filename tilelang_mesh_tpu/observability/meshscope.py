@@ -1,0 +1,723 @@
+"""tl-mesh-scope: runtime mesh communication observability
+(docs/observability.md "Mesh communication").
+
+The compile pipeline already documents what a mesh program *should*
+move over ICI (``attrs["collectives"]``: per-collective kind, axis,
+payload and post-optimization wire bytes from ``parallel/lowering.py``)
+— but at runtime a collective dispatch was a black box and an ICI link
+had no identity at all. This module gives both a runtime counterpart,
+gated on ``TL_TPU_MESH_SCOPE=1`` with the sol.py opt-in discipline (the
+off path costs one env read on the mesh dispatch hot path):
+
+- **Per-link ICI traffic ledger** — a route model decomposes each
+  static collective record into directed per-link hop traffic using the
+  SAME NoC step schedules the cost model's hop counts come from
+  (``layout/python_impl.py`` via ``parallel/lowering.py``), routing each
+  step's payload along its dominant arm (exactly ``max(pos, n-1-pos)``
+  links, the ``schedule_hops`` critical path). Every scoped
+  ``MeshKernel`` dispatch accumulates the table into per-link byte
+  counters, so the **conservation invariant holds exactly**: per-kernel
+  ledger totals equal static post-opt wire bytes x dispatch count.
+  Utilization divides link bytes by the elapsed window and the per-link
+  ICI roofline shared with ``autotuner/cost_model.py``
+  (``ici_link_bytes_per_s``).
+
+- **Per-collective runtime timing** — sampled dispatches (the
+  ``TL_TPU_RUNTIME_SAMPLE`` cadence, an independent sequence from the
+  kernel-latency sampler) time each collective through a cached
+  one-collective microbench (the segment's ``_apply_comm`` lowered
+  alone in a ``shard_map`` over the kernel's own mesh) into
+  ``comm.latency{op,axis}`` histograms and per-collective records
+  joined against the static record — ``t_ici`` finally meets a
+  measured counterpart. The sampled path also VISITS the
+  ``comm.collective`` fault site host-side, so chaos-injected faults
+  appear *attributed* to a collective in the ledger surfaces.
+
+- **Straggler/skew detection** — per-shard step timings (the serving
+  shard probe, ``serve.shard.latency``) feed a per-core EWMA+MAD
+  baseline of each shard's slowdown ratio vs the sweep median (the
+  tl-sol drift pattern). A sustained episode fires once
+  (edge-triggered): ``mesh.skew`` counter, traced event, and a flight
+  dump naming the slow core and its ICI links.
+
+Surfaces: ``metrics_summary()["mesh"]``, the ``/mesh`` route on the
+telemetry server (:func:`mesh_snapshot`), ``tl_tpu_mesh_link_bytes`` /
+``tl_tpu_mesh_link_util`` Prometheus gauges (``export.py``), and
+``analyzer mesh`` (ASCII mesh heatmap; ``tools/analyzer.py``).
+
+Import discipline: like the rest of the observability core this module
+depends only on ``env``, ``tracer``, ``flight`` and ``histogram`` at
+import time; jax, the mesh lowering and the cost model are imported
+lazily inside the scoped paths so every layer can import observability
+without cycles.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..env import env
+from . import flight as _flight
+from . import histogram as _hist
+from . import tracer as _trace
+
+logger = logging.getLogger("tilelang_mesh_tpu.meshscope")
+
+__all__ = ["MESH_SCHEMA", "COMM_HIST", "MeshScope", "get_scope",
+           "mesh_scope_enabled", "skew_enabled", "on_dispatch",
+           "observe_shards", "route_record", "link_name", "core_name",
+           "mesh_summary", "mesh_snapshot", "reset"]
+
+#: snapshot format version (part of the /mesh payload and the analyzer
+#: contract, like SOL_SCHEMA / REQTRACE_SCHEMA)
+MESH_SCHEMA = 1
+
+#: the histogram family sampled collective timings land in (seconds),
+#: labelled {op, axis} — the comm analog of kernel.latency
+COMM_HIST = "comm.latency"
+
+_DIR_CODES = {"h": 0, "v": 1, "all": 2}
+
+
+def mesh_scope_enabled() -> bool:
+    """One env read — the gate the mesh dispatch hot path checks."""
+    return bool(env.TL_TPU_MESH_SCOPE)
+
+
+def skew_enabled() -> bool:
+    return bool(env.TL_TPU_MESH_SKEW)
+
+
+# ---------------------------------------------------------------------------
+# route model: static collective record -> directed per-link bytes
+# ---------------------------------------------------------------------------
+
+#: a directed ICI link between neighboring cores, as core ids
+Link = Tuple[int, int]
+
+
+def core_name(core_id: int, ncol: int) -> str:
+    """``x<row>y<col>`` — the same shard naming the serving probe uses."""
+    return f"x{core_id // ncol}y{core_id % ncol}"
+
+
+def link_name(link: Link, ncol: int) -> str:
+    return f"{core_name(link[0], ncol)}->{core_name(link[1], ncol)}"
+
+
+def _arm_links(r: int, c: int, horizontal: bool, nrow: int,
+               ncol: int) -> List[Link]:
+    """The directed links of one schedule step's DOMINANT arm: exactly
+    ``max(pos, n-1-pos)`` hops, matching ``schedule_hops``'s per-step
+    critical path — which is what keeps the ledger's per-collective
+    link-byte sum identical to ``hops x payload`` (the conservation
+    invariant is then exact by construction, not approximately true)."""
+    links: List[Link] = []
+    if horizontal:
+        if ncol - 1 - c >= c:
+            rng = range(c, ncol - 1)
+            step = 1
+        else:
+            rng = range(c, 0, -1)
+            step = -1
+        for k in rng:
+            links.append((r * ncol + k, r * ncol + k + step))
+    else:
+        if nrow - 1 - r >= r:
+            rng = range(r, nrow - 1)
+            step = 1
+        else:
+            rng = range(r, 0, -1)
+            step = -1
+        for k in rng:
+            links.append((k * ncol + c, (k + step) * ncol + c))
+    return links
+
+
+def _steps_for(kind: str, nrow: int, ncol: int, direction: int,
+               src_core: Optional[int]) -> list:
+    """The NoC step schedule of one collective kind — the SAME schedule
+    ``comm_cost`` prices (``parallel/lowering._schedule_steps``), so the
+    route model and the static wire-byte accounting can never diverge."""
+    from ..parallel.lowering import _schedule_steps
+    if kind == "broadcast":
+        r0, c0 = divmod(int(src_core or 0), ncol)
+        return _schedule_steps("broadcast", nrow, ncol, direction,
+                               (r0, c0))
+    if kind == "allgather":
+        return _schedule_steps("all_gather", nrow, ncol, direction)
+    return _schedule_steps("all_reduce", nrow, ncol, direction)
+
+
+def route_record(rec: Dict[str, Any], nrow: int,
+                 ncol: int) -> Dict[Link, int]:
+    """Directed per-link wire bytes of ONE static collective record
+    (a ``attrs["collectives"]`` entry — JSON-safe, so this also works on
+    records read back from a trace artifact). The per-record invariant::
+
+        sum(route_record(rec, ...).values()) == rec["wire_bytes"]
+
+    holds for every collective kind: each schedule step routes its
+    payload along the dominant arm (``_arm_links``), a put walks the
+    L-shaped manhattan path, and fused/chunked records route as their
+    inner kind with the record's (distinct-slot summed) payload."""
+    payload = int(rec.get("payload_bytes") or 0)
+    if payload <= 0:
+        return {}
+    op = str(rec.get("op") or "")
+    kind = op[len("fused_"):] if op.startswith("fused_") else op
+    links: Dict[Link, int] = {}
+
+    def add(link: Link) -> None:
+        links[link] = links.get(link, 0) + payload
+
+    if kind == "put":
+        sr, sc = divmod(int(rec.get("src_core") or 0), ncol)
+        dr, dc = divmod(int(rec.get("dst_core") or 0), ncol)
+        r = sr
+        while r != dr:
+            nxt = r + (1 if dr > r else -1)
+            add((r * ncol + sc, nxt * ncol + sc))
+            r = nxt
+        c = sc
+        while c != dc:
+            nxt = c + (1 if dc > c else -1)
+            add((dr * ncol + c, dr * ncol + nxt))
+            c = nxt
+        return links
+
+    direction = _DIR_CODES.get(str(rec.get("dir")), 2)
+    steps = _steps_for(kind, nrow, ncol, direction, rec.get("src_core"))
+    for (r, c, d, _chunk) in steps:
+        for link in _arm_links(r, c, d == 0, nrow, ncol):
+            add(link)
+    return links
+
+
+# ---------------------------------------------------------------------------
+# per-collective timing microbench
+# ---------------------------------------------------------------------------
+
+def _comm_out_buffers(op) -> list:
+    """The buffers a collective writes (what its microbench must return
+    so XLA cannot dead-code the collective away), uid-deduped."""
+    from ..ir import (CommAllGather, CommAllReduce, CommBroadcast,
+                      CommChunked, CommFused, CommPut)
+    if isinstance(op, CommChunked):
+        return _comm_out_buffers(op.op)
+    if isinstance(op, CommFused):
+        seen, out = set(), []
+        for m in op.ops:
+            for b in _comm_out_buffers(m):
+                if b.uid not in seen:
+                    seen.add(b.uid)
+                    out.append(b)
+        return out
+    if isinstance(op, (CommBroadcast, CommPut)):
+        return [op.dst.buffer]
+    if isinstance(op, CommAllGather):
+        return [op.recv.buffer]
+    if isinstance(op, CommAllReduce):
+        return [op.out.buffer]
+    return []
+
+
+def _build_comm_timer(kernel: Any, seg_op: Any, nrow: int,
+                      ncol: int) -> Optional[Callable[[], float]]:
+    """A cached one-collective microbench: the segment's collective
+    lowered ALONE (``_apply_comm`` on zero-seeded operand state) in a
+    ``shard_map`` over the kernel's own mesh, jitted and warmed so a
+    sampled run times only the collective's dispatch-to-sync window.
+    Returns None when the op cannot be benched in isolation (timing is
+    best-effort; the ledger does not depend on it)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.device_mesh import shard_map_compat
+    from ..parallel.lowering import _apply_comm
+
+    outs = _comm_out_buffers(seg_op)
+    if not outs:
+        return None
+
+    def body(tok):
+        state: Dict[int, Any] = {}
+        _apply_comm(seg_op, state, nrow, ncol)
+        return tuple(state[b.uid] for b in outs)
+
+    fn = jax.jit(shard_map_compat(
+        body, mesh=kernel.mesh, in_specs=(P(),),
+        out_specs=(P(),) * len(outs)))
+    tok = jnp.zeros((1,), jnp.float32)
+
+    def run() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(tok))
+        return time.perf_counter() - t0
+
+    run()       # warm: fold the jax trace + XLA compile out of sample 1
+    return run
+
+
+# ---------------------------------------------------------------------------
+# scope state
+# ---------------------------------------------------------------------------
+
+class _CollStat:
+    """Runtime aggregate of one (kernel, segment) collective, joined
+    against its static record."""
+
+    __slots__ = ("static", "count", "ewma_ms", "min_ms", "last_ms",
+                 "faults", "last_fault")
+
+    def __init__(self, static: dict):
+        self.static = static
+        self.count = 0
+        self.ewma_ms = 0.0
+        self.min_ms = float("inf")
+        self.last_ms = 0.0
+        self.faults = 0
+        self.last_fault: Optional[str] = None
+
+
+class _SkewState:
+    """EWMA+MAD baseline of one shard's slowdown ratio vs the sweep
+    median (the tl-sol drift state machine with predicted == 1.0)."""
+
+    __slots__ = ("ewma", "dev", "n", "over", "in_episode", "episodes")
+
+    def __init__(self):
+        self.ewma: Optional[float] = None
+        self.dev = 0.0
+        self.n = 0
+        self.over = 0
+        self.in_episode = False
+        self.episodes = 0
+
+
+class MeshScope:
+    """Process-wide mesh-communication scope: the per-link ledger, the
+    per-collective runtime records, and the skew detector."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mesh: Optional[Tuple[int, int]] = None
+        self._links: Dict[Link, int] = {}
+        # per-kernel: cached route table + dispatch count (conservation)
+        self._tables: Dict[str, Optional[dict]] = {}
+        self._dispatches: Dict[str, int] = {}
+        # per-(kernel, segment) runtime collective stats
+        self._colls: Dict[Tuple[str, int], _CollStat] = {}
+        # cached per-(kernel, segment) microbench timers (None = unbuildable)
+        self._timers: Dict[Tuple[str, int], Optional[Callable]] = {}
+        self._skew: Dict[str, _SkewState] = {}
+        self._skew_sweeps = 0
+        self._t0: Optional[float] = None
+
+    # -- ledger --------------------------------------------------------
+    def _table(self, kernel: Any) -> Optional[dict]:
+        """The kernel's cached route table:
+        ``{mesh, links: {Link: bytes}, wire_bytes, recs}`` — built once
+        per kernel from its static collective records."""
+        art = kernel.artifact
+        name = art.name
+        t = self._tables.get(name, False)
+        if t is not False:
+            return t
+        table: Optional[dict] = None
+        try:
+            nrow, ncol = art.mesh_config
+            recs = [r for r in (art.attrs.get("collectives") or [])
+                    if r.get("wire_bytes")]
+            links: Dict[Link, int] = {}
+            for rec in recs:
+                routed = route_record(rec, nrow, ncol)
+                total = sum(routed.values())
+                if total != rec["wire_bytes"]:
+                    # a mis-routed record would silently break the
+                    # conservation gate — drop the whole table instead
+                    raise ValueError(
+                        f"route model moved {total} B for segment "
+                        f"{rec.get('segment')} ({rec.get('op')}), static "
+                        f"record says {rec['wire_bytes']} B")
+                for link, b in routed.items():
+                    links[link] = links.get(link, 0) + b
+            table = {"mesh": (nrow, ncol), "links": links,
+                     "wire_bytes": sum(r["wire_bytes"] for r in recs),
+                     "recs": recs}
+        except Exception as e:  # noqa: BLE001 — scope must never fail a call
+            logger.warning("mesh-scope: no route table for %s (%s: %s)",
+                           name, type(e).__name__, e)
+            table = None
+        with self._lock:
+            self._tables[name] = table
+        return table
+
+    def note_dispatch(self, kernel: Any) -> None:
+        """Ledger accumulation for one scoped dispatch: add the kernel's
+        route table into the per-link byte counters and bump its
+        dispatch count (what the conservation check divides by)."""
+        table = self._table(kernel)
+        if table is None:
+            return
+        name = kernel.artifact.name
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.monotonic()
+            self._mesh = table["mesh"]
+            self._dispatches[name] = self._dispatches.get(name, 0) + 1
+            for link, b in table["links"].items():
+                self._links[link] = self._links.get(link, 0) + b
+
+    # -- sampled per-collective timing + fault-site visit --------------
+    def sample_dispatch(self, kernel: Any) -> None:
+        """The sampled half of a scoped dispatch: per-collective
+        microbench timing into ``comm.latency{op,axis}`` and a
+        host-side visit of the ``comm.collective`` fault site per
+        collective, so injected faults land attributed to the specific
+        collective they hit."""
+        table = self._table(kernel)
+        if table is None or not table["recs"]:
+            return
+        from ..resilience import faults as _faults
+        name = kernel.artifact.name
+        nrow, ncol = table["mesh"]
+        alpha = 0.25
+        for rec in table["recs"]:
+            seg = int(rec.get("segment", -1))
+            key = (name, seg)
+            with self._lock:
+                st = self._colls.get(key)
+                if st is None:
+                    st = self._colls[key] = _CollStat(rec)
+            # the runtime fault-site visit: like _account_collective, a
+            # corrupt clause's budget belongs to the trace-time payload
+            # poison, so only non-corrupt clauses are consumed here
+            try:
+                if not _faults.corrupt_armed("comm.collective"):
+                    _faults.maybe_fail("comm.collective", kernel=name,
+                                       segment=seg, op=rec.get("op"),
+                                       scope="mesh")
+            except Exception as e:  # noqa: BLE001 — attribute, never fail
+                with self._lock:
+                    st.faults += 1
+                    st.last_fault = type(e).__name__
+                _trace.inc("mesh.collective.faults", op=rec.get("op"))
+                _trace.event("mesh.fault", "mesh", kernel=name,
+                             segment=seg, op=rec.get("op"),
+                             error=type(e).__name__)
+            dt = self._time_collective(kernel, rec, seg, nrow, ncol)
+            if dt is None:
+                continue
+            _hist.observe(COMM_HIST, dt, op=str(rec.get("op")),
+                          axis=str(rec.get("axis")))
+            ms = dt * 1e3
+            with self._lock:
+                st.count += 1
+                st.last_ms = ms
+                st.min_ms = min(st.min_ms, ms)
+                st.ewma_ms = ms if st.count == 1 else \
+                    (1 - alpha) * st.ewma_ms + alpha * ms
+
+    def _time_collective(self, kernel: Any, rec: dict, seg: int,
+                         nrow: int, ncol: int) -> Optional[float]:
+        key = (kernel.artifact.name, seg)
+        timer = self._timers.get(key, False)
+        if timer is False:
+            timer = None
+            try:
+                seg_op = kernel._segments_exec[seg]["op"]
+                timer = _build_comm_timer(kernel, seg_op, nrow, ncol)
+            except Exception as e:  # noqa: BLE001 — timing is best-effort
+                logger.debug("mesh-scope: no timer for %s seg %d (%s)",
+                             key[0], seg, e)
+            with self._lock:
+                self._timers[key] = timer
+        if timer is None:
+            return None
+        try:
+            return timer()
+        except Exception:  # noqa: BLE001 — a failed bench must not
+            return None    # fail the dispatch it rides on
+
+    # -- skew detection ------------------------------------------------
+    def observe_shards(self, times: Dict[str, float],
+                       **attrs) -> List[dict]:
+        """One straggler-probe sweep: per-shard timings (seconds, keyed
+        by shard name ``x<r>y<c>``) feed each shard's EWMA+MAD baseline
+        of its slowdown ratio vs the sweep median. Returns the skew
+        events fired by THIS sweep (edge-triggered: a sustained episode
+        fires exactly once until the shard recovers)."""
+        if not skew_enabled() or len(times) < 2:
+            return []
+        vals = [t for t in times.values() if t >= 0]
+        if len(vals) < 2:
+            return []
+        med = statistics.median(vals)
+        if med <= 0:
+            return []
+        alpha = min(max(float(env.TL_TPU_MESH_SKEW_ALPHA), 1e-3), 1.0)
+        warmup = max(int(env.TL_TPU_MESH_SKEW_WARMUP), 1)
+        sustain = max(int(env.TL_TPU_MESH_SKEW_SUSTAIN), 1)
+        fired: List[dict] = []
+        with self._lock:
+            self._skew_sweeps += 1
+            for shard, t in times.items():
+                ratio = t / med
+                st = self._skew.get(shard)
+                if st is None:
+                    st = self._skew[shard] = _SkewState()
+                if st.ewma is None:
+                    st.ewma = ratio
+                else:
+                    st.dev = (1 - alpha) * st.dev + \
+                        alpha * abs(ratio - st.ewma)
+                    st.ewma = (1 - alpha) * st.ewma + alpha * ratio
+                st.n += 1
+                if st.n < warmup:
+                    continue
+                sigma = 1.4826 * st.dev
+                threshold = 1.0 + float(env.TL_TPU_MESH_SKEW_MIN_REL) + \
+                    float(env.TL_TPU_MESH_SKEW_MADS) * sigma
+                if st.ewma > threshold:
+                    st.over += 1
+                    if st.over >= sustain and not st.in_episode:
+                        st.in_episode = True
+                        st.episodes += 1
+                        fired.append(dict(
+                            shard=shard, ratio=round(st.ewma, 4),
+                            threshold=round(threshold, 4),
+                            sweeps=st.n, episode=st.episodes,
+                            links=self._shard_links_locked(shard),
+                            **attrs))
+                else:
+                    st.over = 0
+                    st.in_episode = False
+        for ev in fired:
+            self._fire_skew(ev)
+        return fired
+
+    def _shard_links_locked(self, shard: str) -> List[str]:
+        """The slow core's ICI links (both directions to each mesh
+        neighbor) — what the flight dump names alongside the core."""
+        mesh = self._mesh
+        try:
+            r, c = (int(v) for v in
+                    shard.removeprefix("x").split("y", 1))
+        except ValueError:
+            return []
+        if mesh is None:
+            # no ledgered mesh yet: infer a bound from the probed coords
+            mesh = (r + 1, c + 1)
+        nrow, ncol = mesh
+        me = r * ncol + c
+        out: List[str] = []
+        for (nr, nc) in ((r - 1, c), (r + 1, c), (r, c - 1), (r, c + 1)):
+            if 0 <= nr < nrow and 0 <= nc < ncol:
+                other = nr * ncol + nc
+                out.append(link_name((me, other), ncol))
+                out.append(link_name((other, me), ncol))
+        return out
+
+    def _fire_skew(self, ev: dict) -> None:
+        """Side effects of one skew episode (outside the scope lock:
+        tracer and flight take their own)."""
+        _trace.inc("mesh.skew")
+        _trace.event("mesh.skew", "mesh", shard=ev["shard"],
+                     ratio=ev["ratio"], episode=ev["episode"])
+        _flight.dump("mesh_skew", **ev)
+        logger.warning(
+            "mesh skew: shard %s is running %.2fx the sweep median "
+            "(threshold %.2fx, sweep %d) — links %s", ev["shard"],
+            ev["ratio"], ev["threshold"], ev["sweeps"],
+            ", ".join(ev["links"]) or "?")
+
+    # -- summaries -----------------------------------------------------
+    def conservation(self) -> Dict[str, dict]:
+        """The invariant, checked per kernel: accumulated ledger bytes
+        must equal the static post-opt wire bytes x dispatch count."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            tables = dict(self._tables)
+            dispatches = dict(self._dispatches)
+            ledger_total = sum(self._links.values())
+        expected_total = 0
+        for name, n in sorted(dispatches.items()):
+            t = tables.get(name)
+            if not t:
+                continue
+            expected = t["wire_bytes"] * n
+            expected_total += expected
+            out[name] = {"dispatches": n,
+                         "wire_bytes_per_dispatch": t["wire_bytes"],
+                         "expected_bytes": expected}
+        # the ledger is one shared pool: the global total must match the
+        # sum of every kernel's static expectation
+        for rec in out.values():
+            rec["ok"] = ledger_total == expected_total
+        return {"kernels": out, "ledger_bytes": ledger_total,
+                "expected_bytes": expected_total,
+                "ok": ledger_total == expected_total}
+
+    def _latency_digests(self) -> Dict[str, Optional[dict]]:
+        out: Dict[str, Optional[dict]] = {}
+        for (name, labels), h in _hist.histograms():
+            if name != COMM_HIST or not h.count:
+                continue
+            lab = dict(labels)
+            key = f"{lab.get('op', '?')}@{lab.get('axis', '?')}"
+            out[key] = _hist.digest_ms(h)
+        return out
+
+    def summary(self) -> dict:
+        """The ``metrics_summary()["mesh"]`` / ``/mesh`` payload."""
+        with self._lock:
+            mesh = self._mesh
+            links = dict(self._links)
+            t0 = self._t0
+            colls = [(k, st.static, st.count, st.ewma_ms, st.min_ms,
+                      st.last_ms, st.faults, st.last_fault)
+                     for k, st in sorted(self._colls.items())]
+            skew = {
+                "enabled": skew_enabled(),
+                "sweeps": self._skew_sweeps,
+                "shards": len(self._skew),
+                "episodes": sum(st.episodes
+                                for st in self._skew.values()),
+                "active": [
+                    {"shard": s, "ratio": round(st.ewma or 0.0, 4),
+                     "episodes": st.episodes}
+                    for s, st in sorted(self._skew.items())
+                    if st.in_episode],
+            }
+            dispatches = dict(self._dispatches)
+        ncol = mesh[1] if mesh else 1
+        window_s = (time.monotonic() - t0) if t0 is not None else 0.0
+        per_link_bps = _ici_link_bytes_per_s()
+        link_rows = {}
+        for link, b in sorted(links.items()):
+            util = (b / window_s / per_link_bps) \
+                if window_s > 0 and per_link_bps else None
+            link_rows[link_name(link, ncol)] = {
+                "bytes": b,
+                "util": round(util, 9) if util is not None else None}
+        top = sorted(link_rows.items(), key=lambda kv: -kv[1]["bytes"])
+        coll_rows = []
+        for (kern, seg), static, count, ewma, mn, last, faults, lf \
+                in colls:
+            row = dict(static)
+            row.update({
+                "kernel": kern, "segment": seg,
+                "dispatches": dispatches.get(kern, 0),
+                "samples": count,
+                "measured_ewma_ms": round(ewma, 6) if count else None,
+                "measured_min_ms": round(mn, 6) if count else None,
+                "measured_last_ms": round(last, 6) if count else None,
+                "modeled_ms": round(
+                    static.get("wire_bytes", 0) / per_link_bps * 1e3, 6)
+                if per_link_bps else None,
+                "faults": faults})
+            if lf:
+                row["last_fault"] = lf
+            coll_rows.append(row)
+        total_faults = sum(r["faults"] for r in coll_rows)
+        return {
+            "enabled": mesh_scope_enabled(),
+            "mesh": list(mesh) if mesh else None,
+            "window_s": round(window_s, 3),
+            "dispatches": dispatches,
+            "ici_link_bytes_per_s": per_link_bps,
+            "links": link_rows,
+            "top_links": [k for k, _ in top[:8]],
+            "collectives": coll_rows,
+            "latency": self._latency_digests(),
+            "skew": skew,
+            "faults": {"injected": total_faults},
+            "conservation": self.conservation(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._mesh = None
+            self._links.clear()
+            self._tables.clear()
+            self._dispatches.clear()
+            self._colls.clear()
+            self._timers.clear()
+            self._skew.clear()
+            self._skew_sweeps = 0
+            self._t0 = None
+
+
+def _ici_link_bytes_per_s() -> float:
+    """The per-directed-link ICI roofline, shared with the cost model
+    (``autotuner/cost_model.ici_link_bytes_per_s``) so the ledger's
+    utilization and ``t_ici`` can never disagree about link bandwidth."""
+    try:
+        from ..autotuner.cost_model import ici_link_bytes_per_s
+        return ici_link_bytes_per_s()
+    except Exception:  # noqa: BLE001 — a summary must render anyway
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# module singleton + hook wrappers
+# ---------------------------------------------------------------------------
+
+_scope: Optional[MeshScope] = None
+_scope_lock = threading.Lock()
+
+
+def get_scope() -> MeshScope:
+    global _scope
+    if _scope is None:
+        with _scope_lock:
+            if _scope is None:
+                _scope = MeshScope()
+    return _scope
+
+
+def on_dispatch(kernel: Any) -> None:
+    """The MeshKernel ``__call__`` hook (call only when
+    :func:`mesh_scope_enabled`): ledger every dispatch; sample the
+    per-collective timing path at the ``TL_TPU_RUNTIME_SAMPLE`` cadence
+    (an independent sequence from the kernel-latency sampler). Scope
+    recording must never fail a dispatch."""
+    try:
+        from . import runtime as _runtime
+        scope = get_scope()
+        scope.note_dispatch(kernel)
+        if _runtime.should_sample(f"mesh-scope:{kernel.artifact.name}"):
+            scope.sample_dispatch(kernel)
+    except Exception as e:  # noqa: BLE001 — observability never raises
+        logger.debug("mesh-scope dispatch hook failed: %s", e)
+
+
+def observe_shards(times: Dict[str, float], **attrs) -> List[dict]:
+    """Module-level skew feed (the serving shard probe calls this when
+    the scope is enabled); never raises."""
+    try:
+        return get_scope().observe_shards(times, **attrs)
+    except Exception as e:  # noqa: BLE001
+        logger.debug("mesh-scope skew feed failed: %s", e)
+        return []
+
+
+def mesh_summary() -> dict:
+    return get_scope().summary()
+
+
+def mesh_snapshot() -> dict:
+    """The ``/mesh`` endpoint payload (and the ``analyzer mesh`` input
+    when saved to a file): schema header + the full summary."""
+    return dict(schema=MESH_SCHEMA, **get_scope().summary())
+
+
+def reset() -> None:
+    if _scope is not None:
+        _scope.reset()

@@ -16,6 +16,10 @@ state while it serves traffic:
   speed-of-light records, drift-detector state, and the retune queue
   of buckets whose measured latency drifted from their tuned config's
   prediction (``sol.prof_snapshot``)
+- ``/mesh``     — the tl-mesh-scope snapshot: per-link ICI traffic
+  ledger (bytes + utilization), per-collective runtime latency joined
+  with the static records, skew-detector state, and the conservation
+  check (``meshscope.mesh_snapshot``)
 
 Enable with ``TL_TPU_METRICS_PORT=<port>`` — a :class:`ServingEngine`
 calls :func:`maybe_start` at construction, so a serving process scrapes
@@ -90,11 +94,15 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import sol as _sol
                 self._send(json.dumps(_sol.prof_snapshot()),
                            "application/json")
+            elif path == "/mesh":
+                from . import meshscope as _ms
+                self._send(json.dumps(_ms.mesh_snapshot()),
+                           "application/json")
             else:
                 self._send(json.dumps({
                     "error": "not found",
                     "endpoints": ["/metrics", "/healthz", "/slo",
-                                  "/flight", "/prof"]}),
+                                  "/flight", "/prof", "/mesh"]}),
                            "application/json", 404)
         except Exception as e:  # noqa: BLE001 — a scrape must not crash
             self._send(json.dumps({"error": f"{type(e).__name__}: {e}"}),

@@ -30,6 +30,7 @@ from ..ir import (AllocStmt, Buffer, CommAllGather, CommAllReduce,
                   CommFused, CommPut, CommStmt,
                   CopyStmt, KernelNode, PrimFunc, Region, SeqStmt, Stmt,
                   collect, walk)
+from ..observability import meshscope as _meshscope
 from ..observability import runtime as _runtime
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
@@ -448,6 +449,8 @@ def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
         rec["op"] = f"fused_{inner_kind}"
         rec["members"] = len(c.ops)
         rec["slots"] = c.n_slots
+        if isinstance(c.ops[0], CommBroadcast):
+            rec["src_core"] = c.ops[0].src_core
         # what the folded ops (surviving members AND dropped duplicates)
         # would have cost unoptimized — keeps per-record totals equal to
         # attrs["comm_opt"].pre_wire_bytes
@@ -462,10 +465,20 @@ def _account_collective(kernel: str, c: CommStmt, nrow: int, ncol: int,
         rec["pre_opt_wire_bytes"] = rec["wire_bytes"]
         if isinstance(c.op, CommAllReduce):
             rec["reduce_type"] = c.op.reduce_type
+        elif isinstance(c.op, CommBroadcast):
+            rec["src_core"] = c.op.src_core
+        elif isinstance(c.op, CommPut):
+            rec["src_core"] = c.op.src_core
+            rec["dst_core"] = c.op.dst_core
     else:
         rec["op"] = type(c).__name__.replace("Comm", "").lower()
         if isinstance(c, CommAllReduce):
             rec["reduce_type"] = c.reduce_type
+        elif isinstance(c, CommBroadcast):
+            rec["src_core"] = c.src_core
+        elif isinstance(c, CommPut):
+            rec["src_core"] = c.src_core
+            rec["dst_core"] = c.dst_core
     kind = rec["op"]
     # nothing to corrupt at accounting time: when a corrupt clause is
     # armed, this visit must not consume its coin/budget — the clause
@@ -1237,6 +1250,11 @@ class MeshKernel:
         else:
             res = self._dispatch(jins)
         res = res if isinstance(res, tuple) else (res,)
+        # tl-mesh-scope (observability/meshscope.py): ledger every scoped
+        # dispatch, sample per-collective timing — off, this is the one
+        # env read the acceptance gate allows on the dispatch path
+        if _meshscope.mesh_scope_enabled():
+            _meshscope.on_dispatch(self)
         if timed:
             # same windows as the jit recorder (jit/dispatch.py):
             # overhead = marshalling + post-dispatch bookkeeping before

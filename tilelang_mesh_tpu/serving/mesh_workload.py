@@ -50,6 +50,7 @@ import numpy as np
 
 from ..env import env
 from ..observability import histogram as _hist
+from ..observability import meshscope as _meshscope
 from ..observability import tracer as _trace
 from ..resilience import faults as _faults
 from .batcher import FlashDecodeWorkload
@@ -390,6 +391,11 @@ class MeshDecodeWorkload(FlashDecodeWorkload):
             name = f"x{i}y{j}"
             _hist.observe("serve.shard.latency", dt, shard=name)
             times[name] = dt
+        # tl-mesh-scope: the same sweep feeds the per-core EWMA+MAD
+        # straggler baseline (a sustained slow shard fires mesh.skew +
+        # a flight dump naming the core and its links)
+        if _meshscope.mesh_scope_enabled():
+            _meshscope.observe_shards(times, probe="serve.shard")
         fastest = min(times.values())
         skew = (max(times.values()) / fastest) if fastest > 0 else 1.0
         return max(skew, 1.0)

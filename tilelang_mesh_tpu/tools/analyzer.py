@@ -1203,7 +1203,318 @@ def format_dash_report(dash: dict) -> str:
                      f"{tc.get('trials')} recorded trials, "
                      f"{tc.get('merges')} merges, "
                      f"{tc.get('quarantined')} quarantined")
+    if dash.get("multichip"):
+        lines.append("")
+        lines.append(format_multichip_section(dash["multichip"]))
     return "\n".join(lines)
+
+
+def _parse_multichip_tail(tail) -> List[dict]:
+    """The ``dryrun_multichip:`` check lines of one driver round's
+    ``tail`` (a single newline-separated STRING). Two grammars exist in
+    the checked-in rounds: the training-smoke headline
+    (``mesh=(4x2) loss0=A loss1=B``) and numeric checks
+    (``<description> ok, maxerr=<x.xxe+yy>``)."""
+    import re as _re
+    checks: List[dict] = []
+    for line in str(tail or "").splitlines():
+        line = line.strip()
+        if not line.startswith("dryrun_multichip:"):
+            continue
+        body = line.split(":", 1)[1].strip()
+        m = _re.match(r"mesh=\((\d+x\d+)\)\s+loss0=([-\d.e+]+)"
+                      r"\s+loss1=([-\d.e+]+)", body)
+        if m:
+            l0, l1 = float(m.group(2)), float(m.group(3))
+            checks.append({"check": "train smoke", "mesh": m.group(1),
+                           "value": l1, "detail": f"loss {l0}->{l1}",
+                           "ok": l1 == l1 and l1 < float("inf")})
+            continue
+        m = _re.match(r"(.*?)\s+ok,\s*maxerr=([-\d.e+]+)", body)
+        if m:
+            checks.append({"check": m.group(1), "value": float(m.group(2)),
+                           "detail": f"maxerr={m.group(2)}", "ok": True})
+            continue
+        checks.append({"check": body, "value": None, "detail": body,
+                       "ok": False})
+    return checks
+
+
+def summarize_multichip(round_paths) -> dict:
+    """The MULTICHIP_r* driver-round trajectory, under the same
+    missing-not-regressed contract as the BENCH rounds: an rc!=0 round,
+    or a check a round simply didn't run, must never read as a
+    regression — only a check that ran and failed flags."""
+    import json as _json
+    rounds: List[dict] = []
+    for p in round_paths:
+        try:
+            doc = _json.loads(Path(p).read_text())
+        except (OSError, ValueError) as e:
+            rounds.append({"label": _round_label(p, {}), "rc": None,
+                           "error": str(e), "checks": [],
+                           "status": "missing-not-regressed"})
+            continue
+        checks = _parse_multichip_tail(doc.get("tail"))
+        rc = doc.get("rc")
+        ok_round = rc == 0 and bool(doc.get("ok"))
+        rounds.append({
+            "label": _round_label(p, doc), "rc": rc,
+            "n_devices": doc.get("n_devices"),
+            "skipped": bool(doc.get("skipped")),
+            "checks": checks,
+            "status": "ok" if ok_round and checks
+            else "missing-not-regressed"})
+    names: List[str] = []
+    for rnd in rounds:
+        for c in rnd["checks"]:
+            if c["check"] not in names:
+                names.append(c["check"])      # first-appearance order
+    table: Dict[str, dict] = {}
+    failures: List[str] = []
+    for name in names:
+        cells = []
+        flag = "missing-not-regressed"
+        for rnd in rounds:
+            hit = next((c for c in rnd["checks"] if c["check"] == name),
+                       None)
+            if hit is None or rnd["status"] != "ok":
+                cells.append({"round": rnd["label"], "status": "miss",
+                              "verdict": "missing-not-regressed"})
+                continue
+            verdict = "ok" if hit["ok"] else "FAILED"
+            cells.append({"round": rnd["label"], "status": "ok",
+                          "value": hit["value"], "detail": hit["detail"],
+                          "verdict": verdict})
+            flag = verdict
+        table[name] = {"cells": cells, "flag": flag}
+        if flag == "FAILED":
+            failures.append(name)
+    return {"rounds": rounds, "checks": table, "failures": failures}
+
+
+def format_multichip_section(mc: dict) -> str:
+    """The MULTICHIP block of the dash report."""
+    lines: List[str] = []
+    rounds = mc["rounds"]
+    lines.append(f"multichip driver rounds: {len(rounds)}")
+    lines.append(f"  {'round':<10} {'rc':>3} {'devices':>7} "
+                 f"{'checks':>6} status")
+    for rnd in rounds:
+        rc = rnd["rc"] if rnd["rc"] is not None else "-"
+        lines.append(f"  {rnd['label']:<10} {rc:>3} "
+                     f"{rnd.get('n_devices') or '-':>7} "
+                     f"{len(rnd['checks']):>6} {rnd['status']}")
+    if mc["checks"]:
+        labels = [r["label"] for r in rounds]
+        lines.append("")
+        lines.append("per-check trend (maxerr / final loss; absent "
+                     "checks are missing-not-regressed):")
+        head = f"  {'check':<44}"
+        for lb in labels:
+            head += f" {lb:>10}"
+        head += "  flag"
+        lines.append(head)
+        for name, row in mc["checks"].items():
+            line = f"  {name[:44]:<44}"
+            for cell in row["cells"]:
+                if cell["status"] != "ok":
+                    line += f" {'miss':>10}"
+                else:
+                    v = cell.get("value")
+                    line += f" {(f'{v:.2e}' if v is not None else 'ok'):>10}"
+            line += f"  {row['flag']}"
+            lines.append(line)
+    if mc["failures"]:
+        lines.append("MULTICHIP FAILED: " + ", ".join(mc["failures"]))
+    return "\n".join(lines)
+
+
+def summarize_mesh_scope(source) -> dict:
+    """Normalize a tl-mesh-scope snapshot from any of its carriers: the
+    ``/mesh`` endpoint payload (or a saved ``mesh_snapshot()`` JSON), a
+    report wrapper with a ``"mesh"`` section (``serve_mesh_report.json``,
+    a ``metrics_summary()`` dump), or a trace-JSONL record list holding
+    a ``{"type": "mesh"}`` line. Raises ValueError when no mesh section
+    is present (the CLI turns that into exit 1)."""
+    snap = None
+    if isinstance(source, list):
+        for rec in reversed(source):
+            if isinstance(rec, dict) and rec.get("type") == "mesh":
+                snap = rec
+                break
+    elif isinstance(source, dict):
+        if "links" in source or "conservation" in source:
+            snap = source
+        elif isinstance(source.get("mesh"), dict):
+            snap = source["mesh"]
+    if snap is None:
+        raise ValueError("no mesh-scope section found (expected a "
+                         "mesh_snapshot() JSON, a report with a 'mesh' "
+                         "key, or a trace JSONL with a type=mesh line)")
+    out = dict(snap)
+    out.setdefault("links", {})
+    out.setdefault("collectives", [])
+    out.setdefault("latency", {})
+    out.setdefault("skew", {})
+    out.setdefault("dispatches", {})
+    return out
+
+
+def _fmt_kb(b: float) -> str:
+    if b >= 1 << 20:
+        return f"{b / (1 << 20):.1f}M"
+    if b >= 1 << 10:
+        return f"{b / (1 << 10):.1f}K"
+    return f"{int(b)}B"
+
+
+def _parse_link(name: str):
+    """``x0y1->x1y1`` -> ((0, 1), (1, 1)), or None on foreign names."""
+    import re as _re
+    m = _re.fullmatch(r"x(\d+)y(\d+)->x(\d+)y(\d+)", name)
+    if not m:
+        return None
+    a, b, c, d = (int(g) for g in m.groups())
+    return (a, b), (c, d)
+
+
+def format_mesh_report(snap: dict) -> str:
+    """Human-readable mesh-communication report (CLI ``mesh``
+    subcommand): ASCII heatmap of per-link ledgered bytes, the
+    top-congested directed links with utilization, per-collective
+    runtime-vs-model latency, skew state, and the conservation check."""
+    lines: List[str] = []
+    mesh = snap.get("mesh")
+    links = snap.get("links") or {}
+    n_disp = sum((snap.get("dispatches") or {}).values())
+    lines.append(
+        "mesh communication"
+        + (f" — {mesh[0]}x{mesh[1]} mesh" if mesh else "")
+        + f", {n_disp} scoped dispatch(es)"
+        + (f", {snap.get('window_s')}s window"
+           if snap.get("window_s") else ""))
+    cons = snap.get("conservation") or {}
+    if cons:
+        lines.append(
+            f"  conservation: ledger {cons.get('ledger_bytes', 0)} B vs "
+            f"static wire x dispatches {cons.get('expected_bytes', 0)} B "
+            f"-> {'OK' if cons.get('ok') else 'VIOLATED'}")
+    # undirected per-edge totals drive the heatmap; direction detail
+    # lives in the top-links table below
+    edges: Dict[tuple, int] = {}
+    for name, row in links.items():
+        p = _parse_link(name)
+        if p is None:
+            continue
+        key = (min(p), max(p))
+        edges[key] = edges.get(key, 0) + int(row.get("bytes") or 0)
+    if mesh and edges:
+        nrow, ncol = int(mesh[0]), int(mesh[1])
+        peak = max(edges.values())
+
+        def bar(b: int) -> str:
+            n = max(1, round(4 * b / peak)) if b else 0
+            return "#" * n + "." * (4 - n)
+
+        cell_w = 6 + 18      # core label + one horizontal-link cell
+        lines.append("")
+        lines.append("  per-link heatmap (bytes both directions; "
+                     "#### = hottest edge):")
+        for r in range(nrow):
+            row_s = "  "
+            for c in range(ncol):
+                row_s += f"{f'x{r}y{c}':<6}"
+                if c + 1 < ncol:
+                    b = edges.get((((r, c)), ((r, c + 1))), 0)
+                    row_s += f"--[{_fmt_kb(b):>6} {bar(b)}]-- "
+            lines.append(row_s.rstrip())
+            if r + 1 < nrow:
+                v_s = " " * 2
+                for c in range(ncol):
+                    b = edges.get((((r, c)), ((r + 1, c))), 0)
+                    seg = f"|{_fmt_kb(b)} {bar(b)}"
+                    v_s += f"{seg:<{cell_w}}"
+                lines.append(v_s.rstrip())
+    if links:
+        top = sorted(links.items(),
+                     key=lambda kv: -(kv[1].get("bytes") or 0))[:8]
+        lines.append("")
+        lines.append("  top directed links:")
+        lines.append(f"    {'link':<14} {'bytes':>10} {'util':>9}")
+        for name, row in top:
+            u = row.get("util")
+            lines.append(
+                f"    {name:<14} {row.get('bytes', 0):>10} "
+                f"{(f'{u:.2e}' if u is not None else '-'):>9}")
+    colls = snap.get("collectives") or []
+    if colls:
+        lines.append("")
+        lines.append("  per-collective runtime (sampled) vs model:")
+        lines.append(f"    {'kernel':<18} {'seg':>3} {'op':<16} "
+                     f"{'axis':<4} {'wire B':>8} {'n':>4} "
+                     f"{'ewma ms':>9} {'model ms':>9} {'faults':>6}")
+        for c in colls:
+            ew = c.get("measured_ewma_ms")
+            md = c.get("modeled_ms")
+            lines.append(
+                f"    {str(c.get('kernel'))[:18]:<18} "
+                f"{c.get('segment', '-'):>3} {str(c.get('op')):<16} "
+                f"{str(c.get('axis')):<4} {c.get('wire_bytes', 0):>8} "
+                f"{c.get('samples', 0):>4} "
+                f"{(f'{ew:.4f}' if ew is not None else '-'):>9} "
+                f"{(f'{md:.4f}' if md is not None else '-'):>9} "
+                f"{c.get('faults', 0):>6}")
+    lat = snap.get("latency") or {}
+    if lat:
+        lines.append("")
+        lines.append("  comm.latency digests (op@axis):")
+        for key in sorted(lat):
+            d = lat[key] or {}
+            lines.append(
+                f"    {key:<22} n={d.get('count', 0):<5} "
+                f"p50={d.get('p50_ms')}ms p99={d.get('p99_ms')}ms "
+                f"max={d.get('max_ms')}ms")
+    skew = snap.get("skew") or {}
+    if skew:
+        lines.append("")
+        act = skew.get("active") or []
+        lines.append(
+            f"  skew: {'on' if skew.get('enabled') else 'off'}, "
+            f"{skew.get('sweeps', 0)} sweep(s) over "
+            f"{skew.get('shards', 0)} shard(s), "
+            f"{skew.get('episodes', 0)} episode(s)"
+            + (", active: " + ", ".join(
+                f"{a['shard']} ({a['ratio']}x)" for a in act)
+               if act else ""))
+    faults = snap.get("faults") or {}
+    if faults.get("injected"):
+        lines.append(f"  injected comm faults attributed: "
+                     f"{faults['injected']}")
+    if not links and not colls:
+        lines.append("  (no scoped mesh dispatches recorded — run with "
+                     "TL_TPU_MESH_SCOPE=1)")
+    return "\n".join(lines)
+
+
+def _run_mesh_cmd(path, as_json: bool) -> int:
+    """``analyzer mesh <snapshot.json|trace.jsonl|report.json>`` — the
+    tl-mesh-scope communication report (docs/observability.md). Exit 1
+    when the file carries no mesh section."""
+    import json as _json
+    text = Path(path).read_text()
+    source = None
+    try:
+        source = _json.loads(text)
+    except ValueError:
+        source = _load_trace(path)
+    try:
+        snap = summarize_mesh_scope(source)
+    except ValueError as e:
+        print(f"analyzer mesh: {e}")  # noqa: T201
+        return 1
+    _emit(snap, format_mesh_report(snap), as_json)
+    return 0
 
 
 def summarize_sol(records, store_stats: Optional[dict] = None) -> dict:
@@ -1578,12 +1889,20 @@ def _run_dash(paths, baseline: Optional[str], as_json: bool,
     present. Exit 0 always (the dashboard reports; the perf-diff
     subcommand gates)."""
     import glob as _glob
-    files = list(paths) or sorted(_glob.glob("BENCH_r*.json"))
-    if not files:
+    # MULTICHIP_r* driver rounds ride the same dashboard: explicit
+    # paths are partitioned by name, the default globs pick up both
+    named = list(paths)
+    mc_files = sorted(p for p in named
+                      if "MULTICHIP" in Path(p).name.upper())
+    files = [p for p in named if p not in mc_files]
+    if not named:
+        files = sorted(_glob.glob("BENCH_r*.json"))
+        mc_files = sorted(_glob.glob("MULTICHIP_r*.json"))
+    if not files and not mc_files:
         # missing rounds are a missing-not-regressed condition, not a
         # failure: the documented contract is exit 0 always
-        print("analyzer dash: no BENCH_r*.json rounds found "  # noqa: T201
-              "(pass paths explicitly)")
+        print("analyzer dash: no BENCH_r*.json / MULTICHIP_r*.json "  # noqa: T201
+              "rounds found (pass paths explicitly)")
         return 0
     if baseline is None:
         cand = Path(".github/perf_baseline.json")
@@ -1597,7 +1916,13 @@ def _run_dash(paths, baseline: Optional[str], as_json: bool,
     except Exception:   # noqa: BLE001 — stats are garnish, never a crash
         cache_stats = None
     dash = summarize_dash(files, baseline, threshold_mads=threshold_mads,
-                          min_rel=min_rel, cache_stats=cache_stats)
+                          min_rel=min_rel, cache_stats=cache_stats) \
+        if files else {"rounds": [], "baseline": None, "configs": {},
+                       "regressions": [],
+                       "params": {"threshold_mads": threshold_mads,
+                                  "min_rel": min_rel}}
+    if mc_files:
+        dash["multichip"] = summarize_multichip(mc_files)
     _emit(dash, format_dash_report(dash), as_json)
     return 0
 
@@ -1793,6 +2118,15 @@ def main(argv=None) -> int:
     p_so.add_argument("--store", metavar="DIR",
                       help="fleet sol-store root to report stats for "
                            "(default: env.sol_dir())")
+    p_ms = sub.add_parser(
+        "mesh", help="tl-mesh-scope communication report: ASCII per-link "
+                     "ICI heatmap, top-congested links with utilization, "
+                     "per-collective runtime-vs-model latency, skew "
+                     "state, conservation check — from a /mesh snapshot "
+                     "JSON, a report with a 'mesh' section, or a trace "
+                     "JSONL (docs/observability.md)")
+    p_ms.add_argument("file", help="mesh snapshot JSON / report JSON / "
+                      "JSONL trace with a type=mesh line")
     p_fd = sub.add_parser(
         "flight", help="post-mortem of one flight-recorder dump: "
                        "header/reason, ring tail, counter snapshot, "
@@ -1828,7 +2162,7 @@ def main(argv=None) -> int:
     p_pd.add_argument("--report-only", action="store_true",
                       help="always exit 0 (CI report-only mode)")
     for p in (p_tr, p_fl, p_vf, p_sv, p_ft, p_rq, p_da, p_tn, p_so,
-              p_fd, p_ln, p_pd):
+              p_ms, p_fd, p_ln, p_pd):
         p.add_argument("--json", action="store_true",
                        help="machine-readable JSON output")
     args = ap.parse_args(argv)
@@ -1851,6 +2185,8 @@ def main(argv=None) -> int:
         return _run_tune(args.file, args.json, args.cache_dir)
     if args.cmd == "sol":
         return _run_sol(args.file, args.json, args.store)
+    if args.cmd == "mesh":
+        return _run_mesh_cmd(args.file, args.json)
     if args.cmd == "flight":
         return _run_flight(args.file, args.json, args.last)
     if args.cmd == "lint":

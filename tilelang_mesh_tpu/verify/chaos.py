@@ -75,6 +75,7 @@ import json
 import os
 import sys
 from pathlib import Path
+from typing import Optional
 
 MESH = (2, 2)
 SHAPE = (8, 128)
@@ -361,6 +362,23 @@ def _audit_flight_dumps(flight_dir: Path, trace_ids=None) -> dict:
             "device_loss_dump_ok": device_loss_ok}
 
 
+def _find_skew_dumps(flight_dir: Path, shard: str) -> list:
+    """The tl-mesh-scope skew flight dumps naming ``shard`` as the slow
+    core (header reason ``mesh_skew``, ``attrs.shard``)."""
+    hits = []
+    dumps = sorted(flight_dir.glob("flight_*.jsonl")) \
+        if flight_dir.is_dir() else []
+    for p in dumps:
+        try:
+            head = json.loads(p.read_text().splitlines()[0])
+        except Exception:  # noqa: BLE001 — torn dumps fail atomicity
+            continue       # elsewhere, not this scan
+        if head.get("reason") == "mesh_skew" \
+                and head.get("attrs", {}).get("shard") == shard:
+            hits.append(p)
+    return hits
+
+
 def run_serve(out: Path, seed: int, n_requests: int) -> int:
     """Seeded serving-engine chaos soak (the CI ``serve-smoke`` job and
     the ISSUE 8 acceptance gate): ``n_requests`` requests with a
@@ -567,6 +585,62 @@ def run_serve(out: Path, seed: int, n_requests: int) -> int:
     return 0 if ok else 1
 
 
+def _build_scope_kernel():
+    """A tiny 2x2 ``T.comm`` all_reduce mesh program compiled through
+    the normal pipeline — the serve-mesh soak dispatches it through
+    ``MeshKernel.__call__`` so tl-mesh-scope's ledger/timing path is
+    exercised by a REAL scoped dispatch, not a synthetic feed."""
+    import numpy as np
+
+    import tilelang_mesh_tpu as tilelang
+    from tilelang_mesh_tpu import language as T
+    from tilelang_mesh_tpu.parallel import mesh_config
+
+    rows = cols = 2
+    n, m = 8, 32
+    mesh_t = (rows, cols)
+    shard = T.MeshShardingPolicy(cross_mesh_dim=0)
+    with mesh_config(rows, cols):
+        @T.prim_func
+        def scope_probe(A: T.MeshTensor((rows * cols * n, m), shard,
+                                        mesh_t, "float32"),
+                        B: T.MeshTensor((rows * cols * n, 1), shard,
+                                        mesh_t, "float32")):
+            with T.Kernel(1) as bx:
+                x = T.alloc_fragment((n, m), "float32")
+                o = T.alloc_fragment((n, 1), "float32")
+                T.copy(A, x)
+                T.comm.all_reduce(x, o, "sum", "all", dim=1)
+                T.copy(o, B)
+        kern = tilelang.compile(scope_probe,
+                                target=f"cpu-mesh[{rows}x{cols}]")
+    arg = np.ones((rows * cols * n, m), np.float32)
+    return kern, arg
+
+
+def _scrape_mesh_endpoint() -> Optional[dict]:
+    """Mid-soak ``/mesh`` scrape through a real HTTP round-trip on an
+    ephemeral-port telemetry server: the endpoint must answer with a
+    schema-versioned snapshot WHILE the storm is running. Returns the
+    parsed payload, or None when the scrape failed (the caller's check
+    turns that into a soak failure)."""
+    import urllib.request
+
+    from tilelang_mesh_tpu.observability.server import start_server
+    srv = None
+    try:
+        srv = start_server(port=0)
+        with urllib.request.urlopen(srv.url + "/mesh", timeout=10) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 — report, let the check gate
+        print(f"[chaos-serve-mesh] /mesh scrape failed: "  # noqa: T201
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return None
+    finally:
+        if srv is not None:
+            srv.stop()
+
+
 def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
     """Elastic mesh-serving chaos soak (the CI ``mesh-serve-smoke``
     gate): a seeded request storm through a ``MeshDecodeWorkload``
@@ -586,10 +660,21 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
       ``restore()`` already hard-verified the bytes in flight);
     - the outcome accounting in the counters matches the
       ``serve.e2e.latency`` histograms.
+
+    tl-mesh-scope rides the same soak (``TL_TPU_MESH_SCOPE=1``): a
+    small ``T.comm`` mesh kernel dispatches through the storm so the
+    per-link ICI ledger populates (conservation gate: ledger bytes ==
+    static wire bytes x dispatches), the ``comm.collective`` fault site
+    is armed inside sampled dispatches (injected faults must appear
+    *attributed* in the ledger surfaces), a synthetic 3x-slow shard
+    must fire exactly one skew episode with a flight dump naming the
+    core, and a mid-run ``/mesh`` scrape must answer.
     """
     import random
 
     os.environ["TL_TPU_TRACE"] = "1"
+    os.environ["TL_TPU_MESH_SCOPE"] = "1"
+    os.environ.setdefault("TL_TPU_RUNTIME_SAMPLE", "1")
     # APPEND the host-device flag to any ambient XLA_FLAGS (a bare
     # setdefault would be a no-op under e.g. XLA_FLAGS=--xla_cpu_...,
     # leaving 1 CPU device and killing the 2x2 mesh build)
@@ -621,6 +706,17 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
     warm_s = _time.perf_counter() - t_warm0
     first_layout = wl.layout.name
 
+    # tl-mesh-scope: the decode workload drives its own jitted spmd, so
+    # a real MeshKernel.__call__ path must dispatch alongside it to
+    # populate the per-link ledger. Warm it BEFORE arming any
+    # comm.collective clause: the warm call traces _apply_comm and
+    # builds+caches the sampled microbench, so once faults arm, only
+    # the scope's host-side attribution visit can consume the budget.
+    from tilelang_mesh_tpu.observability import meshscope as _meshscope
+    mesh_kern, mesh_arg = _build_scope_kernel()
+    mesh_kern(mesh_arg)
+    mesh_dispatches = 1
+
     if n_requests < 20:
         print(f"[chaos-serve-mesh] --requests {n_requests} is below the "
               f"soak minimum (20): the kill/drain phases need room to "
@@ -642,6 +738,8 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
           f"{warmed} bucket kernels warmed in {warm_s:.1f}s; slice "
           f"kill at ~request {kill_at}")
     t0 = _time.perf_counter()
+    mesh_scrape: Optional[dict] = None
+    comm_faults_armed = 0
     with inject("serve.step", p=0.02, seed=seed, kind="transient"):
         submitted = 0
         killed = False
@@ -657,12 +755,40 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
                 killed = True
                 with inject("serve.shard", kind="unreachable", times=1):
                     eng.step()
+                # ... and the observability layer must survive the
+                # failure path it exists for: arm the comm.collective
+                # site INSIDE meshscope-sampled dispatches — the scope
+                # must attribute both faults, not die or drop them
+                with inject("comm.collective", p=1.0, seed=seed,
+                            kind="transient", times=2):
+                    mesh_kern(mesh_arg)
+                    mesh_kern(mesh_arg)
+                mesh_dispatches += 2
+                comm_faults_armed = 2
+                mesh_scrape = _scrape_mesh_endpoint()
             for _ in range(rng.randrange(1, 4)):
                 eng.step()
+            # the scoped mesh kernel rides the storm cadence
+            mesh_kern(mesh_arg)
+            mesh_dispatches += 1
+            wl.probe_shards()       # real sweeps feed the skew baseline
         eng.drain()
         for _ in range(drain_wave):
             eng.submit(**make_request())
         eng.run()
+    # synthetic straggler: one shard pinned at 3x the sweep median long
+    # enough to clear warmup+sustain — the detector must fire EXACTLY
+    # one edge-triggered episode and flight-dump the core's name
+    from tilelang_mesh_tpu.env import env as _env
+    # enough sweeps for the EWMA to converge onto the 3x shard and its
+    # MAD band to decay below the firing threshold even when the real
+    # probe sweeps above already seeded a healthy baseline
+    n_sweeps = 8 * (int(_env.TL_TPU_MESH_SKEW_WARMUP)
+                    + int(_env.TL_TPU_MESH_SKEW_SUSTAIN))
+    for _ in range(n_sweeps):
+        _meshscope.observe_shards(
+            {"x0y0": 1e-3, "x0y1": 1e-3, "x1y0": 1e-3, "x1y1": 3e-3},
+            probe="chaos.synthetic")
     wall_s = _time.perf_counter() - t0
 
     # -- the elastic contract checks -----------------------------------
@@ -691,7 +817,36 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
     incomplete = [r.req_id for r in eng.requests
                   if r.is_terminal and not r.trace.complete]
     flight_audit = _audit_flight_dumps(out / "flight")
+    # -- the tl-mesh-scope contract ------------------------------------
+    mesh_snap = _meshscope.mesh_snapshot()
+    mesh_cons = mesh_snap.get("conservation") or {}
+    mesh_skew = mesh_snap.get("skew") or {}
+    skew_hits = [a for a in (mesh_skew.get("active") or [])
+                 if a.get("shard") == "x1y1"]
+    skew_dumps = _find_skew_dumps(out / "flight", shard="x1y1")
+    mesh_checks = {
+        # ledger bytes == static post-opt wire bytes x dispatch count,
+        # with the ledger actually populated by the storm's dispatches
+        "mesh_ledger_conserved": bool(mesh_cons.get("ok"))
+        and mesh_cons.get("ledger_bytes", 0) > 0
+        and (mesh_cons.get("kernels", {}).get("scope_probe", {})
+             .get("dispatches") == mesh_dispatches),
+        # both armed comm.collective faults landed attributed to the
+        # collective they hit — the scope survived its failure path
+        "mesh_faults_attributed":
+            mesh_snap.get("faults", {}).get("injected", 0)
+            == comm_faults_armed,
+        # the synthetic 3x shard fired EXACTLY one edge-triggered
+        # episode, and its flight dump names the core
+        "mesh_skew_episode_exactly_once":
+            len(skew_hits) == 1 and skew_hits[0].get("episodes") == 1,
+        "mesh_skew_flight_dump_names_core": len(skew_dumps) >= 1,
+        "mesh_endpoint_scraped_midrun": mesh_scrape is not None
+        and mesh_scrape.get("schema") == _meshscope.MESH_SCHEMA
+        and bool(mesh_scrape.get("dispatches")),
+    }
     checks = {
+        **mesh_checks,
         "all_terminal": not non_terminal,
         "kv_slabs_balance_zero": kv_ok,
         "resharded_down_the_ladder": counters["reshards"] >= 1
@@ -711,6 +866,11 @@ def run_serve_mesh(out: Path, seed: int, n_requests: int) -> int:
         "mode": "serve-mesh", "seed": seed, "requests": n_requests,
         "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
         "warmed_kernels": warmed,
+        # the full tl-mesh-scope snapshot: `analyzer mesh
+        # serve_mesh_report.json` renders this section directly
+        "mesh": mesh_snap,
+        "mesh_dispatches": mesh_dispatches,
+        "mesh_skew_dumps": [str(p) for p in skew_dumps],
         "first_layout": first_layout,
         "final_layout": wl.layout.name,
         "ladder": [r.name for r in wl.ladder],
