@@ -663,6 +663,134 @@ def cfg_vmem_repack_smoke(M=256, N=256, reps=60):
                 custom_run=run)
 
 
+def cfg_dtype_narrow_smoke(M=128, N=128, reps=60):
+    """CI perf-smoke config for the tile-opt dtype-narrowing rewrite
+    (transform/tile_opt.py; docs/tile_opt.md): a five-stage elementwise
+    chain over bounded O(1) values staged through f32 fragment scratch.
+    The TL007/TL008 dual-track interpretation proves each intermediate's
+    sound interval and accumulated error bound fit bfloat16, so
+    ``TL_TPU_TILE_OPT=auto`` thins the scratch to half the bytes (the
+    DMA-endpoint buffers stay f32 — narrowing never changes a wire
+    dtype). Headline value = unnarrowed/narrowed resident scratch ratio,
+    derived from the FEATURES_VERSION 2 ``vmem_occupancy`` feature of
+    the two lowerings; ``vs_baseline`` = unnarrowed/narrowed latency
+    (≈1 on CPU interpret — the footprint is the hardware win, plus
+    halved VPU operand traffic Mosaic can exploit). Run under
+    TL_TPU_SELFCHECK=1 the first optimized call is differentially
+    checked against the TL_TPU_TILE_OPT=0 twin within bf16 tolerance.
+    CPU-safe."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import tilelang_mesh_tpu as tilelang
+    import tilelang_mesh_tpu.language as T
+
+    @T.prim_func
+    def narrow_smoke(A: T.Tensor((M, N), "float32"),
+                     O: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            src = T.alloc_shared((M, N), "float32")
+            u1 = T.alloc_fragment((M, N), "float32")
+            u2 = T.alloc_fragment((M, N), "float32")
+            u3 = T.alloc_fragment((M, N), "float32")
+            u4 = T.alloc_fragment((M, N), "float32")
+            u5 = T.alloc_fragment((M, N), "float32")
+            dst = T.alloc_shared((M, N), "float32")
+            T.copy(A, src)
+            # sigmoid bounds the chain's root in (0, 1) regardless of
+            # the input range — everything downstream is then provably
+            # O(1), which is what the narrowing proof needs
+            for i, j in T.Parallel(M, N):
+                u1[i, j] = T.sigmoid(src[i, j])
+            for i, j in T.Parallel(M, N):
+                u2[i, j] = u1[i, j] * u1[i, j]
+            for i, j in T.Parallel(M, N):
+                u3[i, j] = u2[i, j] * 0.5 + u1[i, j] * 0.25
+            for i, j in T.Parallel(M, N):
+                u4[i, j] = u3[i, j] * u3[i, j] * 0.5
+            for i, j in T.Parallel(M, N):
+                u5[i, j] = u4[i, j] * 0.5 + u3[i, j] * 0.125
+            for i, j in T.Parallel(M, N):
+                dst[i, j] = u5[i, j] * 2.0
+            T.copy(dst, O)
+
+    k_opt = tilelang.compile(narrow_smoke,
+                             pass_configs={"tl.tpu.tile_opt": "auto"})
+    k_raw = tilelang.compile(narrow_smoke,
+                             pass_configs={"tl.tpu.tile_opt": "0"})
+    rng = np.random.default_rng(13)
+    # inputs in [-1, 1]: every stage stays O(1), exactly the regime the
+    # narrowing proof's interval/error gates admit
+    a = jnp.asarray(rng.uniform(-1.0, 1.0, (M, N)), jnp.float32)
+
+    def scratch_bytes(kern):
+        from tilelang_mesh_tpu.transform.plan import _DEFAULT_VMEM_BUDGET
+        f = kern.artifact.attrs.get("features") or {}
+        occ = float(f.get("vmem_occupancy") or 0.0)
+        return round(occ * _DEFAULT_VMEM_BUDGET) - \
+            int(f.get("vmem_block_bytes") or 0)
+
+    def timed(kern):
+        jax.block_until_ready(kern(a))              # warm (compile)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(kern(a))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        med = ts[len(ts) // 2]
+        mad = sorted(abs(t - med) for t in ts)[len(ts) // 2]
+        return med, mad
+
+    def run():
+        ro = k_opt(a)
+        rr = k_raw(a)
+        # the narrowed kernel rounds through bf16 internally: compare
+        # within the bf16 band, same contract as the selfcheck
+        _check_close(ro, rr, 2e-2)
+        rec_opt = k_opt.artifact.attrs.get("tile_opt") or {}
+        nar = rec_opt.get("narrow") or {}
+        if not nar.get("buffers"):
+            raise BenchError(
+                "dtype_narrow_smoke: the narrow rewrite did not fire "
+                f"(record: {nar}) — the config exists to measure it")
+        pre, post = scratch_bytes(k_raw), scratch_bytes(k_opt)
+        if not post or pre <= post:
+            raise BenchError(
+                f"dtype_narrow_smoke: no footprint win (pre={pre}B "
+                f"post={post}B)")
+        t_opt, mad_o = timed(k_opt)
+        t_raw, mad_r = timed(k_raw)
+        sched = rec_opt.get("sched") or {}
+        return {
+            "value": round(pre / post, 4),
+            "unit": "x smaller scratch",
+            "vs_baseline": round(t_raw / t_opt, 4) if t_opt else None,
+            "latency_ms": round(t_opt * 1e3, 4),
+            "baseline_ms": round(t_raw * 1e3, 4),
+            "latency_p50_ms": round(t_opt * 1e3, 4),
+            "latency_p90_ms": round(t_opt * 1e3, 4),
+            "latency_p99_ms": round(t_opt * 1e3, 4),
+            "latency_mad_ms": round(mad_o * 1e3, 4),
+            "latency_samples": reps,
+            "reps": reps,
+            "baseline_mad_ms": round(mad_r * 1e3, 4),
+            "scratch_bytes_unnarrowed": pre,
+            "scratch_bytes_narrowed": post,
+            "narrowed_buffers": nar.get("buffers"),
+            "narrowed_bytes_saved": nar.get("bytes"),
+            "narrow_proofs": nar.get("proofs"),
+            "sched_chosen": sched.get("chosen"),
+            "sched_predicted_ms": sched.get("predicted_ms"),
+            "tile_opt_rewrites": rec_opt.get("rewrites"),
+        }
+
+    return dict(metric=f"tile-opt dtype narrow smoke {M}x{N} f32->bf16 "
+                       f"(narrowed vs unnarrowed scratch footprint)",
+                custom_run=run)
+
+
 def cfg_autotune_smoke(M_seed=128, M_target=256):
     """CI tune-smoke config for cost-model-guided autotuning
     (autotuner/cost_model.py + tune_cache.py; docs/autotuning.md): a
@@ -1924,7 +2052,8 @@ def exit_code(strict: bool, n_failed: int) -> int:
 # probe finds the TPU worker dead still runs them (on the host platform)
 # instead of producing an empty artifact.
 CPU_SAFE_CONFIGS = ("gemm_smoke", "dispatch_overhead_smoke",
-                    "vmem_repack_smoke", "autotune_smoke",
+                    "vmem_repack_smoke", "dtype_narrow_smoke",
+                    "autotune_smoke",
                     "serve_prefill_smoke",
                     "mesh_allreduce_smoke",
                     "serve_smoke", "mesh_serve_smoke")
@@ -1977,6 +2106,7 @@ def _config_builders(q: bool):
         ("gemm_smoke", lambda: cfg_gemm_smoke()),
         ("dispatch_overhead_smoke", lambda: cfg_dispatch_overhead_smoke()),
         ("vmem_repack_smoke", lambda: cfg_vmem_repack_smoke()),
+        ("dtype_narrow_smoke", lambda: cfg_dtype_narrow_smoke()),
         ("autotune_smoke", lambda: cfg_autotune_smoke()),
         ("mesh_allreduce_smoke", lambda: cfg_mesh_allreduce_smoke()),
         ("serve_smoke", lambda: cfg_serve_smoke()),

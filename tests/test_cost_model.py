@@ -678,3 +678,69 @@ class TestSurfacing:
         assert any(r.get("status") == "pruned" for r in recorded)
         assert any(r.get("features") for r in recorded
                    if r.get("status") == "ok")
+
+
+# ---------------------------------------------------------------------------
+# stale-featured artifacts (pre-FEATURES_VERSION-bump caches/journals)
+# ---------------------------------------------------------------------------
+
+
+class TestStaleFeatures:
+    class _Art:
+        def __init__(self, feats):
+            self.attrs = {"features": feats}
+
+    def test_old_schema_skipped_and_counted(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import \
+            features_from_artifact
+        from tilelang_mesh_tpu.transform.plan import FEATURES_VERSION
+        before = get_tracer().counters().get(
+            "cost_model.features.stale", 0)
+        stale = _feats(version=FEATURES_VERSION - 1)
+        assert features_from_artifact(self._Art(stale)) is None
+        assert get_tracer().counters()["cost_model.features.stale"] == \
+            before + 1
+        # a missing feature dict is not "stale" — no counter bump
+        assert features_from_artifact(self._Art(None)) is None
+        assert get_tracer().counters()["cost_model.features.stale"] == \
+            before + 1
+
+    def test_current_schema_passes_through(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import \
+            features_from_artifact
+        before = get_tracer().counters().get(
+            "cost_model.features.stale", 0)
+        out = features_from_artifact(self._Art(_feats()))
+        assert out is not None and out["flops"] == _feats()["flops"]
+        assert get_tracer().counters().get(
+            "cost_model.features.stale", 0) == before
+
+    def test_observe_stale_counted_not_fit(self):
+        from tilelang_mesh_tpu.autotuner.cost_model import CostModel
+        from tilelang_mesh_tpu.transform.plan import FEATURES_VERSION
+        m = CostModel()
+        before = get_tracer().counters().get(
+            "cost_model.observe.stale", 0)
+        assert not m.observe(_feats(version=FEATURES_VERSION - 1), 1.0)
+        assert get_tracer().counters()["cost_model.observe.stale"] == \
+            before + 1
+        # None features / bad latency are rejected but not "stale"
+        assert not m.observe(None, 1.0)
+        assert not m.observe(_feats(), 0.0)
+        assert get_tracer().counters()["cost_model.observe.stale"] == \
+            before + 1
+
+    def test_occupancy_feature_present_and_priced(self):
+        """FEATURES_VERSION 2: the post-tile-opt resident footprint
+        rides the feature dict and feeds the ridge basis."""
+        from tilelang_mesh_tpu.autotuner.cost_model import \
+            analytic_ms, _phi
+        fac = _make_factory()
+        feats = fac(128, 128, block_M=32).artifact.attrs["features"]
+        assert feats["version"] == 2
+        assert 0.0 < feats["vmem_occupancy"] <= 4.0
+        lo = _phi(_feats(vmem_occupancy=0.1),
+                  analytic_ms(_feats(vmem_occupancy=0.1)))
+        hi = _phi(_feats(vmem_occupancy=0.9),
+                  analytic_ms(_feats(vmem_occupancy=0.9)))
+        assert list(np.ravel(lo)) != list(np.ravel(hi))

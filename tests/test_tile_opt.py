@@ -31,7 +31,8 @@ import tilelang_mesh_tpu.language as T
 from tilelang_mesh_tpu import observability as obs
 from tilelang_mesh_tpu.engine.lower import lower
 from tilelang_mesh_tpu.transform import tile_opt
-from tilelang_mesh_tpu.transform.tile_opt import (MODES, run_tile_opt,
+from tilelang_mesh_tpu.transform.tile_opt import (DEFAULT_MODES, MODES,
+                                                  run_tile_opt,
                                                   tile_opt_modes)
 
 M = N = 128
@@ -74,7 +75,7 @@ def _assert_equivalent(func, *args, pass_configs=None):
 class TestModes:
     def test_default_all(self, monkeypatch):
         monkeypatch.delenv("TL_TPU_TILE_OPT", raising=False)
-        assert tile_opt_modes() == MODES
+        assert tile_opt_modes() == DEFAULT_MODES
 
     def test_off_spellings(self):
         for v in ("0", "off", "false", "none", "no"):
@@ -518,7 +519,7 @@ class TestComposition:
         assert rec["repack"]["buffers"] >= 1
         assert rec["dbuf"]["chains"] >= 1
         assert rec["fuse"]["regions"] >= 1
-        assert rec["modes"] == list(MODES)
+        assert rec["modes"] == list(DEFAULT_MODES)
 
     def test_composite_numerics(self):
         _assert_equivalent(_composite_kernel(), _rand((M, 256)),
@@ -770,9 +771,503 @@ class TestSurfacing:
         assert "TL006" in text
         assert "--fix" in text and "TL_TPU_TILE_OPT" in text
 
+    def test_lint_cli_narrow_hint(self, tmp_path):
+        """A kernel with a provably-bounded scratch buffer gets the
+        TL_TPU_TILE_OPT=narrow --fix hint (the narrow_candidates oracle
+        run from the lint CLI), naming kernel and buffer."""
+        mod = tmp_path / "narrow_mod.py"
+        mod.write_text(
+            "import tilelang_mesh_tpu.language as T\n\n"
+            "@T.prim_func\n"
+            "def k(A: T.Tensor((128, 128), 'float32'),\n"
+            "      B: T.Tensor((128, 128), 'float32')):\n"
+            "    with T.Kernel(1) as bx:\n"
+            "        s = T.alloc_shared((128, 128), 'float32')\n"
+            "        u = T.alloc_fragment((128, 128), 'float32')\n"
+            "        o = T.alloc_shared((128, 128), 'float32')\n"
+            "        T.copy(A, s)\n"
+            "        for i, j in T.Parallel(128, 128):\n"
+            "            u[i, j] = T.sigmoid(s[i, j])\n"
+            "        for i, j in T.Parallel(128, 128):\n"
+            "            o[i, j] = u[i, j] * 2.0\n"
+            "        T.copy(o, B)\n")
+        from tilelang_mesh_tpu.tools.lint import (format_report,
+                                                  lint_targets)
+        report = lint_targets([str(mod)])
+        assert report["summary"]["narrowable"] == 1
+        assert report["narrow_hints"] == [
+            {"target": str(mod), "kernel": "k", "buffers": ["frag"]}]
+        text = format_report(report)
+        assert "--fix" in text and "TL_TPU_TILE_OPT=narrow" in text
+        assert "k: frag" in text
+
     def test_run_tile_opt_no_modes_is_identity(self):
         f = _composite_kernel()
         func = f.func
         out, res, findings = run_tile_opt(func, OFF, [])
         assert out is func
         assert res.rewrites == []
+
+
+# ---------------------------------------------------------------------------
+# narrow (value-range-driven dtype narrowing)
+# ---------------------------------------------------------------------------
+
+NARROW = {"tl.tpu.tile_opt": "narrow"}
+
+
+def _bounded_chain_kernel():
+    """sigmoid bounds the root in (0, 1): every fragment downstream is
+    provably O(1) with zero accumulated error — all three narrow."""
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            u = T.alloc_fragment((M, N), "float32")
+            v = T.alloc_fragment((M, N), "float32")
+            w = T.alloc_fragment((M, N), "float32")
+            o = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                u[i, j] = T.sigmoid(s[i, j])
+            for i, j in T.Parallel(M, N):
+                v[i, j] = u[i, j] * u[i, j]
+            for i, j in T.Parallel(M, N):
+                w[i, j] = v[i, j] * 0.5 + u[i, j] * 0.25
+            for i, j in T.Parallel(M, N):
+                o[i, j] = w[i, j] * 2.0
+            T.copy(o, B)
+    return k
+
+
+def _cancellation_kernel():
+    """Large-magnitude staging + cancellation: the staged buffer's
+    RELATIVE error is tiny (the envelope pre-gate admits it) but the
+    downstream subtraction amplifies bf16 rounding into O(64) absolute
+    error — the dual-track re-verification must refuse the narrowing."""
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), B: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            big = T.alloc_fragment((M, N), "float32")
+            o = T.alloc_shared((M, N), "float32")
+            T.copy(A, s)
+            for i, j in T.Parallel(M, N):
+                big[i, j] = T.sigmoid(s[i, j]) + 16384.0
+            for i, j in T.Parallel(M, N):
+                o[i, j] = big[i, j] - 16384.0
+            T.copy(o, B)
+    return k
+
+
+def _bounded_input(seed=0):
+    jnp = _jnp()
+    return jnp.asarray(np.random.default_rng(seed).uniform(
+        -1.0, 1.0, (M, N)), jnp.float32)
+
+
+def _assert_close_bf16(k1, k0, *args):
+    r1, r0 = k1(*args), k0(*args)
+    r1 = r1 if isinstance(r1, tuple) else (r1,)
+    r0 = r0 if isinstance(r0, tuple) else (r0,)
+    for a, b in zip(r1, r0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=1e-2)
+
+
+class TestNarrow:
+    def test_fire_bounded_chain(self):
+        art = lower(_bounded_chain_kernel(), target="cpu",
+                    pass_configs=NARROW)
+        rec = art.attrs["tile_opt"]["narrow"]
+        assert rec["buffers"] >= 3
+        assert rec["bytes"] >= 3 * M * N * 2
+        for p in rec["proofs"]:
+            assert p["from"] == "float32" and p["to"] == "bfloat16"
+            assert p["interval"][0] >= -1.0 and p["interval"][1] <= 1.5
+            assert p["err"] + 2 ** -8 <= 0.0625
+            assert p["verify_rounds"] >= 1
+        assert "narrow:" in art.plan_desc
+
+    def test_numerics_vs_off(self):
+        f = _bounded_chain_kernel()
+        k1 = tilelang.compile(f, target="cpu", pass_configs=NARROW)
+        k0 = tilelang.compile(f, target="cpu", pass_configs=OFF)
+        assert k1.artifact.attrs["tile_opt"]["narrow"]["buffers"] >= 3
+        _assert_close_bf16(k1, k0, _bounded_input())
+
+    def test_refuse_unbounded(self):
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                u = T.alloc_fragment((M, N), "float32")
+                o = T.alloc_shared((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    u[i, j] = s[i, j] * 2.0     # unbounded input: no proof
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = u[i, j] * 0.5
+                T.copy(o, B)
+        art = lower(k, target="cpu", pass_configs=NARROW)
+        rec = (art.attrs.get("tile_opt") or {}).get("narrow") or {}
+        assert not rec.get("buffers")
+
+    def test_refuse_cancellation_via_screen(self):
+        """The envelope pre-gate admits the large-magnitude buffer
+        (tiny RELATIVE error — the TL008 model carries max(err) through
+        subtraction) but the cancellation screen sees that its bf16
+        storage rounding is an ABSOLUTE error of ~64 feeding a
+        subtraction whose proven result magnitude is ~1, and refuses."""
+        art = lower(_cancellation_kernel(), target="cpu",
+                    pass_configs=NARROW)
+        rec = (art.attrs.get("tile_opt") or {}).get("narrow") or {}
+        assert not rec.get("buffers")
+
+    def test_refuse_dma_endpoints(self):
+        """Buffers on a global copy leg keep their wire dtype."""
+        art = lower(_bounded_chain_kernel(), target="cpu",
+                    pass_configs=NARROW)
+        narrowed = {p["buffer"]
+                    for p in art.attrs["tile_opt"]["narrow"]["proofs"]}
+        assert "shared" not in narrowed      # copy src staging
+        assert "shared_1" not in narrowed    # copy dst staging
+
+    def test_selfcheck_tolerates_bf16_rounding(self, monkeypatch):
+        """A narrowed kernel legitimately differs from the =0 twin by
+        bf16 rounding; the selfcheck's tolerance floor (derived from
+        the recorded proofs' target dtype) must forgive exactly that."""
+        from tilelang_mesh_tpu.cache.kernel_cache import clear_cache
+        clear_cache()
+        obs.reset()
+        monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+        k = tilelang.compile(_bounded_chain_kernel(), target="cpu",
+                             pass_configs=NARROW)
+        k(_bounded_input())
+        c = obs.get_tracer().counters()
+        assert c.get("verify.selfcheck.ok", 0) >= 1
+        assert not c.get("verify.selfcheck.divergence")
+
+
+# ---------------------------------------------------------------------------
+# compat repack (byte-size-compatible slots)
+# ---------------------------------------------------------------------------
+
+
+class TestCompatRepack:
+    def _compat_kernel(self):
+        """A dead f32 slot, then a bf16 buffer of the same shape with a
+        disjoint lifetime: the compat gate lands the bf16 values in the
+        wider slot through an exact-widening cast view."""
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                wide = T.alloc_fragment((M, N), "float32")
+                thin = T.alloc_fragment((M, N), "bfloat16")
+                o = T.alloc_shared((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    wide[i, j] = s[i, j] * 2.0
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = wide[i, j] + 1.0      # wide dies here
+                for i, j in T.Parallel(M, N):
+                    thin[i, j] = s[i, j]
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = thin[i, j] * 0.5
+                T.copy(o, B)
+        return k
+
+    def test_fire_exact_widening_pair(self):
+        f = self._compat_kernel()
+        art = lower(f, target="cpu",
+                    pass_configs={"tl.tpu.tile_opt": "repack"})
+        rec = art.attrs["tile_opt"]["repack"]
+        assert rec["compat"] >= 1
+        k1 = tilelang.compile(f, target="cpu",
+                              pass_configs={"tl.tpu.tile_opt": "repack"})
+        k0 = tilelang.compile(f, target="cpu", pass_configs=OFF)
+        _assert_close_bf16(k1, k0, _bounded_input())
+
+    def test_fire_composed_with_narrow(self):
+        """The ISSUE's composition contract: a buffer the narrow pass
+        just thinned becomes newly packable into a wider dead slot."""
+        from tilelang_mesh_tpu.ops.softmax import softmax_kernel
+
+        k = softmax_kernel.__wrapped__(256, 128)
+        art = lower(k.prim_func if hasattr(k, "prim_func") else k,
+                    target="cpu",
+                    pass_configs={"tl.tpu.tile_opt": "all"})
+        rec = art.attrs["tile_opt"]
+        assert rec["narrow"]["buffers"] >= 1
+        assert rec["repack"]["compat"] >= 1
+
+    def test_refuse_non_widening_pair(self):
+        """i32 -> f32 is not an exact widening (and vice versa): the
+        compat gate must refuse even at equal byte size."""
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                ints = T.alloc_fragment((M, N), "int32")
+                vals = T.alloc_fragment((M, N), "float32")
+                o = T.alloc_shared((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    ints[i, j] = 3
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = s[i, j] + ints[i, j]  # ints dies
+                for i, j in T.Parallel(M, N):
+                    vals[i, j] = s[i, j] * 0.5
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = vals[i, j]
+                T.copy(o, B)
+        art = lower(k, target="cpu",
+                    pass_configs={"tl.tpu.tile_opt": "repack"})
+        rec = (art.attrs.get("tile_opt") or {}).get("repack") or {}
+        assert not rec.get("compat")
+
+
+# ---------------------------------------------------------------------------
+# interleaved fusion
+# ---------------------------------------------------------------------------
+
+
+def _interleaved_kernel(clobber=False):
+    """Two reader nests of ``s`` separated by a plain copy.  With
+    clobber=False the copy touches unrelated buffers (C -> t): the
+    second nest may legally hop over it and fuse with the first.  With
+    clobber=True the copy REWRITES s (t -> s): hopping the second nest
+    over it would read the stale s, so the disjointness oracle must
+    refuse — and adjacent fusion is impossible (the neighbour is a
+    CopyStmt, not a nest)."""
+    @T.prim_func
+    def k(A: T.Tensor((M, N), "float32"), B: T.Tensor((M, N), "float32"),
+          C: T.Tensor((M, N), "float32"), D: T.Tensor((M, N), "float32")):
+        with T.Kernel(1) as bx:
+            s = T.alloc_shared((M, N), "float32")
+            t = T.alloc_shared((M, N), "float32")
+            w = T.alloc_shared((M, N), "float32")
+            u = T.alloc_fragment((M, N), "float32")
+            v = T.alloc_fragment((M, N), "float32")
+            T.copy(A, s)
+            T.copy(C, t)
+            for i, j in T.Parallel(M, N):
+                u[i, j] = s[i, j] * 2.0
+            if clobber:
+                T.copy(t, s)                # s := C, conflicts with nest 2
+            else:
+                T.copy(t, w)                # unrelated to nest 2
+            for i, j in T.Parallel(M, N):
+                v[i, j] = s[i, j] * 3.0
+            T.copy(u, B)
+            T.copy(v, D)
+    return k
+
+
+class TestInterleavedFuse:
+    def test_fire_across_disjoint_statement(self):
+        f = _interleaved_kernel(clobber=False)
+        art = lower(f, target="cpu",
+                    pass_configs={"tl.tpu.tile_opt": "fuse"})
+        rec = art.attrs["tile_opt"]["fuse"]
+        assert rec["interleaved"] >= 1
+        jnp = _jnp()
+        args = (_rand((M, N)), _rand((M, N), 1))
+        k1 = tilelang.compile(f, target="cpu",
+                              pass_configs={"tl.tpu.tile_opt": "fuse"})
+        k0 = tilelang.compile(f, target="cpu", pass_configs=OFF)
+        for a, b in zip(k1(*args), k0(*args)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_refuse_when_intervening_writes_source(self):
+        art = lower(_interleaved_kernel(clobber=True), target="cpu",
+                    pass_configs={"tl.tpu.tile_opt": "fuse"})
+        rec = (art.attrs.get("tile_opt") or {}).get("fuse") or {}
+        assert not rec.get("interleaved")
+        # ...and the clobbered ordering still computes correctly
+        f = _interleaved_kernel(clobber=True)
+        args = (_rand((M, N)), _rand((M, N), 1))
+        k1 = tilelang.compile(f, target="cpu",
+                              pass_configs={"tl.tpu.tile_opt": "fuse"})
+        k0 = tilelang.compile(f, target="cpu", pass_configs=OFF)
+        for a, b in zip(k1(*args), k0(*args)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cost-model pass scheduler (TL_TPU_TILE_OPT=auto)
+# ---------------------------------------------------------------------------
+
+AUTO = {"tl.tpu.tile_opt": "auto"}
+
+
+class TestAutoScheduler:
+    def test_deterministic_double_lowering(self):
+        f = _composite_kernel()
+        a1 = lower(f, target="cpu", pass_configs=AUTO)
+        a2 = lower(f, target="cpu", pass_configs=AUTO)
+        assert a1.plan_desc == a2.plan_desc
+        assert a1.kernel_source == a2.kernel_source
+
+    def test_never_worse_than_canonical(self):
+        for mk in (_composite_kernel, _bounded_chain_kernel,
+                   _dead_store_kernel):
+            art = lower(mk(), target="cpu", pass_configs=AUTO)
+            s = (art.attrs.get("tile_opt") or {}).get("sched")
+            if s and s.get("canonical_ms") is not None:
+                assert s["predicted_ms"] <= s["canonical_ms"] + 1e-12
+
+    def test_decision_recorded(self):
+        art = lower(_bounded_chain_kernel(), target="cpu",
+                    pass_configs=AUTO)
+        s = art.attrs["tile_opt"]["sched"]
+        assert s["chosen"] and "narrow" in s["chosen"]
+        assert isinstance(s["candidates"], list) and len(s["candidates"]) >= 2
+        assert any(c["modes"] == [] for c in s["candidates"])
+        assert s["predicted_ms"] > 0
+        assert "auto" in art.plan_desc
+
+    def test_auto_zero_bypass_byte_identical(self):
+        f = _composite_kernel()
+        a0a = lower(f, target="cpu", pass_configs=OFF)
+        a0b = lower(f, target="cpu", pass_configs=OFF)
+        assert a0a.plan_desc == a0b.plan_desc
+        assert "tile_opt[" not in a0a.plan_desc
+
+    def test_cache_key_auto_distinct(self):
+        from tilelang_mesh_tpu.cache.kernel_cache import KernelCache
+        k_def = KernelCache.key_for("x", "cpu", None, {})
+        k_auto = KernelCache.key_for("x", "cpu", None, AUTO)
+        k_off = KernelCache.key_for("x", "cpu", None, OFF)
+        assert len({k_def, k_auto, k_off}) == 3
+
+
+# ---------------------------------------------------------------------------
+# seeded mutation sweep: corrupt each proof gate, selfcheck must catch
+# ---------------------------------------------------------------------------
+
+
+class TestMutationSweep:
+    def _armed(self, monkeypatch):
+        from tilelang_mesh_tpu.cache.kernel_cache import clear_cache
+        # disk=True: sibling tests lower these exact kernels unmutated,
+        # and a disk-tier hit would silently bypass the corrupted pass
+        clear_cache(disk=True)
+        obs.reset()
+        monkeypatch.setenv("TL_TPU_SELFCHECK", "1")
+
+    def test_narrow_widened_interval_caught(self, monkeypatch):
+        """Mutant 1: the interval gate is forced open and the
+        re-verification silenced — an int buffer whose values exceed
+        the i16 range gets narrowed and wraps; the exact integer
+        selfcheck comparison must catch it."""
+        from tilelang_mesh_tpu.verify import SelfCheckDivergence
+        self._armed(monkeypatch)
+        monkeypatch.setattr(tile_opt, "_narrow_fits",
+                            lambda env, old, new, thr: True)
+        monkeypatch.setattr(tile_opt, "_narrow_verify",
+                            lambda *a, **kw: set())
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "int32"), B: T.Tensor((M, N), "int32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "int32")
+                idx = T.alloc_fragment((M, N), "int32")
+                o = T.alloc_shared((M, N), "int32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    idx[i, j] = s[i, j] * 0 + 100000    # > i16 range
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = idx[i, j] + s[i, j] * 0
+                T.copy(o, B)
+        kern = tilelang.compile(k, target="cpu", pass_configs=NARROW)
+        assert kern.artifact.attrs["tile_opt"]["narrow"]["buffers"] >= 1
+        jnp = _jnp()
+        a = jnp.zeros((M, N), jnp.int32)
+        with pytest.raises(SelfCheckDivergence, match="tile-opt"):
+            kern(a)
+
+    def test_narrow_dropped_error_term_caught(self, monkeypatch):
+        """Mutant 2: the error terms are dropped from the proof gates —
+        the envelope gate keeps only its range check, the cancellation
+        screen is silenced — so the cancellation kernel narrows; bf16
+        rounding of the 16384-magnitude staging buffer amplifies to
+        O(64) output error, far beyond the bf16 tolerance band."""
+        from tilelang_mesh_tpu.analysis.absint import (dtype_max,
+                                                       is_float)
+        from tilelang_mesh_tpu.verify import SelfCheckDivergence
+        self._armed(monkeypatch)
+
+        def no_err_gate(env, old_dt, new_dt, thr):
+            if env is None or not env.sound_bounded():
+                return False
+            if is_float(old_dt):
+                fmax = dtype_max(new_dt)
+                return env.finite and env.shi <= fmax \
+                    and env.slo >= -fmax     # error term DROPPED
+            return True
+        monkeypatch.setattr(tile_opt, "_narrow_fits", no_err_gate)
+        monkeypatch.setattr(tile_opt, "_cancel_screen",
+                            lambda *a, **kw: set())
+        monkeypatch.setattr(tile_opt, "_narrow_verify",
+                            lambda *a, **kw: set())
+        kern = tilelang.compile(_cancellation_kernel(), target="cpu",
+                                pass_configs=NARROW)
+        assert kern.artifact.attrs["tile_opt"]["narrow"]["buffers"] >= 1
+        with pytest.raises(SelfCheckDivergence, match="tile-opt"):
+            kern(_bounded_input())
+
+    def test_compat_widening_oracle_caught(self, monkeypatch):
+        """Mutant 3: the exact-widening oracle is forced open — a
+        fractional f32 buffer lands in a dead i32 slot and truncates;
+        the selfcheck must catch the wrong values."""
+        from tilelang_mesh_tpu.verify import SelfCheckDivergence
+        self._armed(monkeypatch)
+        monkeypatch.setattr(tile_opt, "_exact_widens",
+                            lambda narrow_dt, wide_dt:
+                            narrow_dt != wide_dt)
+
+        @T.prim_func
+        def k(A: T.Tensor((M, N), "float32"),
+              B: T.Tensor((M, N), "float32")):
+            with T.Kernel(1) as bx:
+                s = T.alloc_shared((M, N), "float32")
+                ints = T.alloc_fragment((M, N), "int32")
+                vals = T.alloc_fragment((M, N), "float32")
+                o = T.alloc_shared((M, N), "float32")
+                T.copy(A, s)
+                for i, j in T.Parallel(M, N):
+                    ints[i, j] = 3
+                for i, j in T.Parallel(M, N):
+                    s[i, j] = s[i, j] + ints[i, j]
+                for i, j in T.Parallel(M, N):
+                    vals[i, j] = s[i, j] * 0.5      # fractional values
+                for i, j in T.Parallel(M, N):
+                    o[i, j] = vals[i, j]
+                T.copy(o, B)
+        kern = tilelang.compile(
+            k, target="cpu", pass_configs={"tl.tpu.tile_opt": "repack"})
+        assert kern.artifact.attrs["tile_opt"]["repack"]["compat"] >= 1
+        with pytest.raises(SelfCheckDivergence, match="tile-opt"):
+            kern(_bounded_input())
+
+    def test_fuse_overlap_oracle_caught(self, monkeypatch):
+        """Mutant 4: the hoist-disjointness oracle is forced open — the
+        second reader nest fuses ACROSS the nest that rewrites their
+        shared source, reading stale values."""
+        from tilelang_mesh_tpu.verify import SelfCheckDivergence
+        self._armed(monkeypatch)
+        monkeypatch.setattr(tile_opt, "_hoist_disjoint",
+                            lambda stmt, nest: True)
+        kern = tilelang.compile(
+            _interleaved_kernel(clobber=True), target="cpu",
+            pass_configs={"tl.tpu.tile_opt": "fuse"})
+        assert kern.artifact.attrs["tile_opt"]["fuse"]["interleaved"] >= 1
+        with pytest.raises(SelfCheckDivergence, match="tile-opt"):
+            kern(_rand((M, N)), _rand((M, N), 1))

@@ -101,6 +101,33 @@ def num_err_threshold(pass_cfg: Optional[dict] = None) -> float:
     return float(raw) if raw is not None else DEFAULT_ERR_THRESHOLD
 
 
+def _cast_exact(e: Cast) -> bool:
+    """True when the cast is an exact widening — every source-dtype
+    value is representable in the target dtype, so the cast is a value
+    identity (no rounding, no wrap).  Such casts are TRANSPARENT to the
+    interpretation: origin and facts flow through them (tile-opt's
+    narrow rewrite wraps every load this way, and re-verification must
+    see the same proofs the original body produced)."""
+    src = getattr(e.value, "dtype", None)
+    if src is None or src == e.dtype:
+        return False
+    if is_float(src) and is_float(e.dtype):
+        return (dtype_eps(e.dtype) <= dtype_eps(src)
+                and dtype_max(e.dtype) >= dtype_max(src))
+    if is_int(src) and is_int(e.dtype):
+        slo, shi = int_range(src)
+        tlo, thi = int_range(e.dtype)
+        return tlo <= slo and thi >= shi
+    if is_int(src) and is_float(e.dtype):
+        # every int of <= mantissa-many bits is exact in the float
+        bits = {"float32": 25, "bfloat16": 9, "float16": 12}.get(e.dtype)
+        if bits is None:
+            return False
+        slo, shi = int_range(src)
+        return -(2 ** (bits - 1)) <= slo and shi <= 2 ** (bits - 1)
+    return False
+
+
 # ---------------------------------------------------------------------------
 # results
 # ---------------------------------------------------------------------------
@@ -118,6 +145,13 @@ class NumericsResult:
         #: (stmt id, buffer uid, buffer name, proven)
         self.payloads: List[Tuple[int, int, str, bool]] = []
         self.assume_abs: float = DEFAULT_ASSUME_ABS
+        #: per-buffer write envelope: uid -> join of every AbsVal that
+        #: landed in the buffer during the REPORTING pass (which runs
+        #: from the widened loop invariant, so the envelope soundly
+        #: covers every store the kernel can execute).  This is the
+        #: value-range/error-bound proof the tile-opt ``narrow`` rewrite
+        #: consumes when deciding a scratch buffer fits a thinner dtype.
+        self.envelopes: Dict[int, "AbsVal"] = {}
 
     @property
     def proven_finite(self) -> bool:
@@ -205,6 +239,16 @@ class Interp:
         self._params = {b.uid: b for b in func.buffer_params}
         self._scopes: Dict[int, str] = {}
         self._dtypes: Dict[int, str] = {}
+
+    # -- write envelopes -----------------------------------------------
+    def _note_write(self, uid: int, val: AbsVal) -> None:
+        """Fold one written value into the buffer's envelope — recorded
+        only on reporting passes (the pass that runs from the widened
+        loop invariant), so the joined envelope covers every store."""
+        if not self._report:
+            return
+        old = self.result.envelopes.get(uid)
+        self.result.envelopes[uid] = val if old is None else old.join(val)
 
     # -- findings ------------------------------------------------------
     def _emit(self, rule: str, sev: str, msg: str, stmt: Stmt,
@@ -351,8 +395,22 @@ class Interp:
             v = self._load(e.buffer, state, ctx)
             return v, (e.buffer, tuple(e.indices))
         if isinstance(e, Cast):
-            v, _o = self._eval(e.value, state, ctx, stmt)
+            v, o = self._eval(e.value, state, ctx, stmt)
             src_dt = getattr(e.value, "dtype", None)
+            if _cast_exact(e):
+                # exact widening casts (the load views tile-opt's
+                # narrow/compat-repack rewrites install) are value
+                # IDENTITIES: the origin, domination facts and
+                # unit/max-sub evidence all survive — losing them here
+                # would break re-verification of the very rewrites the
+                # proofs licensed
+                out = self._materialize(v, e.dtype, stmt,
+                                        f"<cast:{e.dtype}>",
+                                        value_dtype=src_dt)
+                out = replace(out, facts=v.facts, unit_dim=v.unit_dim,
+                              max_sub_dim=v.max_sub_dim,
+                              qmask=v.qmask, qzp=v.qzp)
+                return out, o
             out = self._materialize(v.plain(), e.dtype, stmt,
                                     f"<cast:{e.dtype}>",
                                     value_dtype=src_dt)
@@ -388,7 +446,18 @@ class Interp:
                 sq = av_mul(a, b)
                 return replace(sq, lo=max(0.0, sq.lo),
                                slo=max(0.0, sq.slo)), None
-            return av_mul(a, b), None
+            r = av_mul(a, b)
+            for v, c in ((a, b), (b, a)):
+                if v.max_sub_dim is not None and \
+                        c.lo == c.hi == c.slo == c.shi and \
+                        0.0 < c.lo < INF:
+                    # (x - rowmax(x)) * c with a positive constant c
+                    # still attains exactly 0 at each row's argmax (the
+                    # exp2-domain log2(e) pre-scale idiom): the
+                    # unit-row proof survives the change of base
+                    r = replace(r, max_sub_dim=v.max_sub_dim)
+                    break
+            return r, None
         if e.op in ("/", "//", "%"):
             return self._eval_division(e.op, a, b, bo, stmt), None
         if e.op == "min":
@@ -867,6 +936,7 @@ class Interp:
         from .dataflow import stmt_accesses
         for acc in stmt_accesses(s):
             if acc.kind == "write":
+                self._note_write(acc.buffer.uid, AbsVal())
                 state.write(acc.buffer.uid, AbsVal(), strong=False)
 
     _parallel_trips: Optional[int] = None
@@ -982,6 +1052,7 @@ class Interp:
         val = self._materialize(val, buf.dtype, stmt, buf.name,
                                 value_dtype=value_dtype)
         strong = self._region_full(r) and buf.scope != "global"
+        self._note_write(buf.uid, val)
         state.write(buf.uid, val, strong=strong)
 
     def _read_region(self, r: Region, state: NumState, ctx: _Ctx,
@@ -1008,6 +1079,7 @@ class Interp:
         else:
             v = self._materialize(v, dst.dtype, s, dst.name,
                                   value_dtype=src_dt)
+            self._note_write(dst.uid, v)
             state.write(dst.uid, v, strong=True)
 
     def _gemm_k(self, s: GemmStmt) -> Optional[int]:
@@ -1104,6 +1176,7 @@ class Interp:
         # the n*eps(dst) reduction rounding is charged explicitly above
         out = self._materialize(out, dst.dtype, s, dst.name,
                                 value_dtype=dst.dtype)
+        self._note_write(dst.uid, out)
         state.write(dst.uid, out, strong=True)
 
     def _xfer_cumsum(self, s, state, ctx) -> None:
@@ -1120,6 +1193,7 @@ class Interp:
                      dtype_eps(s.dst.dtype))
         out = self._materialize(out, s.dst.dtype, s, s.dst.name,
                                 value_dtype=s.dst.dtype)
+        self._note_write(s.dst.uid, out)
         state.write(s.dst.uid, out, strong=True)
 
     def _max_covered(self, e):
@@ -1128,6 +1202,8 @@ class Interp:
         evidence behind ``m_new[i] = T.max(m_prev[i], m_cur[i], ...)``
         inheriting/creating elementwise domination facts."""
         e = convert(e) if not isinstance(e, (slice, str)) else e
+        if isinstance(e, Cast) and _cast_exact(e):
+            return self._max_covered(e.value)
         if isinstance(e, BufferLoad) and not e.has_slices:
             return [e]
         if isinstance(e, BinOp) and e.op == "max":
@@ -1142,6 +1218,8 @@ class Interp:
         covered = self._max_covered(val_expr)
         if not covered:
             return frozenset()
+        while isinstance(val_expr, Cast) and _cast_exact(val_expr):
+            val_expr = val_expr.value
         bare = isinstance(val_expr, BufferLoad)
         store_key = tuple(_idx_key(i) for i in s.indices)
         if any(k is None for k in store_key):
@@ -1201,6 +1279,7 @@ class Interp:
                               value_dtype=getattr(
                                   convert(s.value), "dtype", None))
         strong = self._store_full_cover(s, ctx) and not reads_self
+        self._note_write(buf.uid, v)
         state.write(buf.uid, v, strong=strong)
 
     def _store_full_cover(self, s: BufferStoreStmt, ctx: _Ctx) -> bool:
@@ -1293,6 +1372,7 @@ class Interp:
             from .dataflow import stmt_accesses
             for acc in stmt_accesses(s):
                 if acc.kind == "write":
+                    self._note_write(acc.buffer.uid, AbsVal())
                     state.write(acc.buffer.uid, AbsVal(), strong=False)
 
 

@@ -42,6 +42,7 @@ from ..carver.arch import TPUArch, auto_arch
 # and the tuner's pruning can never disagree about what a tile costs
 from ..carver.roller import TILE_OVERHEAD_S as _TILE_OVERHEAD_S
 from ..carver.roller import VPU_ELEMS_PER_S as _VPU_ELEMS_PER_S
+from ..observability import tracer as _trace
 from ..transform.plan import FEATURES_VERSION
 
 __all__ = ["CostModel", "analytic_ms", "analytic_terms",
@@ -71,6 +72,10 @@ def features_from_artifact(art) -> Optional[Dict[str, float]]:
     feats = attrs.get("features")
     if not isinstance(feats, dict) or \
             feats.get("version") != FEATURES_VERSION:
+        if isinstance(feats, dict):
+            # stale schema (pre-FEATURES_VERSION-bump artifact cache /
+            # journal entry): skipped cleanly, never misfit
+            _trace.inc("cost_model.features.stale")
         return None
     wire = 0
     for rec in attrs.get("collectives") or []:
@@ -156,6 +161,11 @@ def _phi(feats: Dict[str, float], ana_ms: float) -> np.ndarray:
         math.log1p(float(feats.get("grid_steps") or 1)),
         math.log1p(float(feats.get("vmem_arena") or 0)
                    + float(feats.get("vmem_block_bytes") or 0)),
+        # post-tile-opt resident footprint fraction (FEATURES_VERSION 2):
+        # a narrowed/repacked kernel occupies less VMEM than the arena
+        # estimate suggests — let the residual learn the spill/occupancy
+        # cliff. Clamped: over-budget kernels must not dominate the fit.
+        min(float(feats.get("vmem_occupancy") or 0.0), 4.0),
         math.log(max(float(feats.get("block_skew") or 1.0), 1.0) + 1.0),
         min(float(feats.get("dbuf_chains") or 0), 4.0),
         1.0 if feats.get("pipelined") else 0.0,
@@ -199,6 +209,9 @@ class CostModel:
         """Add one measured trial; refit unless deferred. Returns whether
         the sample was usable (feature schema matched, latency > 0)."""
         if not _usable(feats) or not measured_ms or measured_ms <= 0:
+            if isinstance(feats, dict) and not _usable(feats):
+                # stale-featured tune-cache/journal sample: skip, count
+                _trace.inc("cost_model.observe.stale")
             return False
         ana = analytic_ms(feats, self.arch)
         self._X.append(_phi(feats, ana))

@@ -58,12 +58,12 @@ QUARANTINE_DIR = ".quarantine"
 LOCKS_DIR = ".locks"
 
 # Bump whenever codegen output OR the on-disk artifact format changes —
-# artifacts cached under older versions must not be reused. (12: plain
-# artifacts carry attrs["numerics"], the tl-num finiteness proof the
-# TL_TPU_SANITIZE=auto elision consults — older entries lack it, which
-# would silently force the conservative check-everything path on disk
-# hits; the lint block may also carry TL007-TL010 findings now.)
-CODEGEN_VERSION = 12
+# artifacts cached under older versions must not be reused. (13: the
+# tile-opt superoptimizer — proof-gated dtype narrowing, compatible
+# repack, and interleaved fusion change generated source for the same
+# IR; attrs["tile_opt"] may carry narrow proofs + the auto scheduler's
+# decision, attrs["features"] moved to FEATURES_VERSION 2.)
+CODEGEN_VERSION = 13
 
 
 def _sha256(text: str) -> str:

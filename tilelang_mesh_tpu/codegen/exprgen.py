@@ -111,15 +111,31 @@ class ExprGen:
         raise ExprGenError(f"cannot print {type(e).__name__}")
 
     def _binop(self, e: BinOp, rec) -> str:
+        def opnd(x) -> str:
+            s = rec(x)
+            if e.dtype == "bool":
+                return s
+            if isinstance(x, (IntImm, FloatImm, BoolImm, int, float,
+                              bool)):
+                return s    # weak scalar: promotes to the typed peer
+            dt = getattr(x, "dtype", None)
+            if dt is not None and dt != e.dtype:
+                # the IR promoted this operation to e.dtype, but jnp's
+                # weak-typing rules would compute at the operand dtype
+                # when the peer is a python scalar (bf16 * 0.5 stays
+                # bf16) — pin the operand to the promoted dtype so the
+                # emitted value dtype matches the IR's
+                return f"rt.cast({s}, {jnp_dtype(e.dtype)})"
+            return s
         if e.op == "min":
-            return f"jnp.minimum({rec(e.a)}, {rec(e.b)})"
+            return f"jnp.minimum({opnd(e.a)}, {opnd(e.b)})"
         if e.op == "max":
-            return f"jnp.maximum({rec(e.a)}, {rec(e.b)})"
+            return f"jnp.maximum({opnd(e.a)}, {opnd(e.b)})"
         if e.op == "and":
             return f"jnp.logical_and({rec(e.a)}, {rec(e.b)})"
         if e.op == "or":
             return f"jnp.logical_or({rec(e.a)}, {rec(e.b)})"
-        return f"({rec(e.a)} {_BIN[e.op]} {rec(e.b)})"
+        return f"({opnd(e.a)} {_BIN[e.op]} {opnd(e.b)})"
 
     def _call(self, e: Call, rec) -> str:
         if e.name == "max_value":

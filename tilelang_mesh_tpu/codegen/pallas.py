@@ -527,9 +527,12 @@ class PallasCodegen:
         # tile GEMMs match the reference's true-fp32 semantics. bf16/fp8
         # inputs keep the fast default. Overridable via pass config
         # tl.tpu.matmul_precision.
+        # … and ONE f32 operand is enough: a bf16-narrowed partner
+        # (tile-opt's narrow rewrite) must never silently demote the
+        # remaining f32 side to the single-pass default.
         prec = self.cfg.get("tl.tpu.matmul_precision")
-        if prec is None and s.A.buffer.dtype == "float32" \
-                and s.B.buffer.dtype == "float32":
+        if prec is None and "float32" in (s.A.buffer.dtype,
+                                          s.B.buffer.dtype):
             prec = "highest"
         prec_arg = f", precision='{prec}'" if prec else ""
         dot = (f"jax.lax.dot_general({a}, {b}, "
@@ -569,6 +572,11 @@ class PallasCodegen:
         dst = self.accessors[s.dst.uid]
         keepdims = s.src.ndim == s.dst.ndim or dst.pad1
         src_v = src.full()
+        if s.src.dtype != s.dst.dtype:
+            # accumulate at the DESTINATION dtype (matching the
+            # interpreter's n*eps(dst) error model) — a narrowed bf16
+            # src must not drag a f32 reduction down to bf16 adds
+            src_v = f"({src_v}).astype({jnp_dtype(s.dst.dtype)})"
         if src.pad1 and not dst.pad1:
             # drop the phantom column axis so dims/keepdims stay logical
             src_v = f"jnp.reshape({src_v}, (-1,))"
@@ -588,7 +596,12 @@ class PallasCodegen:
     def _emit_cumsum(self, s: CumSumStmt) -> bool:
         src = self.accessors[s.src.uid]
         dst = self.accessors[s.dst.uid]
-        val = f"rt.cumsum({src.full()}, {s.dim}, {s.reverse})"
+        src_v = src.full()
+        if s.src.dtype != s.dst.dtype:
+            # accumulate at the destination dtype (the interpreter's
+            # n*eps(dst) model), not the possibly-narrowed src dtype
+            src_v = f"({src_v}).astype({jnp_dtype(s.dst.dtype)})"
+        val = f"rt.cumsum({src_v}, {s.dim}, {s.reverse})"
         if src.pad1 != dst.pad1:
             shp = tuple(as_int(x) for x in s.dst.shape) + \
                 ((1,) if dst.pad1 else ())

@@ -45,6 +45,96 @@ def _param_table(plan) -> list:
     return params
 
 
+def _auto_tile_opt(func, cfg, lint_findings):
+    """Cost-model pass scheduler (``TL_TPU_TILE_OPT=auto``).
+
+    Probes which rewrites fire on this kernel at all, then prices every
+    subset of the fired set through the SAME analytic roofline the
+    autotuner and tl-sol use (``cost_model.analytic_ms`` over re-derived
+    ``plan_features``) and lowers with the min-predicted-latency subset.
+    Ties break toward the smaller resident VMEM footprint (the
+    ``vmem_occupancy`` feature — narrowing and repack shrink bytes the
+    roofline may not see), then toward the LARGER subset, then
+    lexically — fully deterministic, so two lowerings of one kernel are
+    byte-identical.  The canonical default order is always among the
+    candidates, so auto can never pick a predictably-worse set than the
+    fixed pipeline.  Returns ``(func, TileOptResult, findings)`` like
+    :func:`run_tile_opt`; the decision (every candidate with its
+    predicted ms, the chosen set, and the predicted gap closed vs the
+    do-nothing baseline) rides on ``result.sched`` into
+    ``attrs["tile_opt"]`` and the SoL record."""
+    from ..autotuner.cost_model import analytic_ms
+    from ..carver.arch import auto_arch
+    from ..transform.plan import plan_features
+    from ..transform.tile_opt import (DEFAULT_MODES, MODES, TileOptResult,
+                                      run_tile_opt)
+
+    arch = auto_arch()
+
+    def price(modes):
+        f2, r2, l2 = run_tile_opt(func, cfg, lint_findings,
+                                  modes_override=modes, _metrics=False)
+        plan2 = plan_kernel(f2, cfg)
+        feats2 = plan_features(f2, plan2)
+        feats2["dbuf_chains"] = r2.dbuf_chains
+        # tie-break on the post-rewrite resident footprint (the
+        # FEATURES_VERSION 2 occupancy feature): per-buffer scratch +
+        # BlockSpec windows. This is what the rewrites actually shrink —
+        # narrowing thins buffers, repack drops whole allocs — where the
+        # liveness-packed arena is an if-shared estimate that a slot
+        # merge can only ever grow (merged lifetimes union).
+        return analytic_ms(feats2, arch), \
+            float(feats2.get("vmem_occupancy") or 0.0)
+
+    # probe: which rewrites fire on this kernel at all?
+    _f, probe, _l = run_tile_opt(func, cfg, lint_findings,
+                                 modes_override=MODES, _metrics=False)
+    fired = tuple(m for m, n in (
+        ("dse", probe.dse_allocs + probe.dse_stores),
+        ("narrow", probe.narrow_buffers),
+        ("repack", probe.repack_buffers),
+        ("dbuf", probe.dbuf_chains),
+        ("fuse", probe.fuse_regions)) if n)
+    if not fired:
+        return func, TileOptResult(modes=("auto",)), list(lint_findings)
+
+    candidates = []
+    best = None          # (ms, vmem, -len, subset)
+    for mask in range(1 << len(fired)):
+        subset = tuple(m for i, m in enumerate(fired) if mask >> i & 1)
+        try:
+            ms, vmem = price(subset)
+        except Exception:   # noqa: BLE001 — unpriceable subset: skip it
+            continue
+        candidates.append({"modes": list(subset),
+                           "predicted_ms": round(ms, 6)})
+        key = (ms, vmem, -len(subset), subset)
+        if best is None or key < best[0]:
+            best = (key, subset, ms)
+
+    canonical = tuple(m for m in DEFAULT_MODES if m in fired)
+    if best is None:
+        chosen = canonical          # pricing broke: canonical pipeline
+    else:
+        chosen = best[1]
+    new_func, res, findings = run_tile_opt(
+        func, cfg, lint_findings, modes_override=chosen)
+    if best is not None and res.rewrites:
+        by_modes = {tuple(c["modes"]): c["predicted_ms"]
+                    for c in candidates}
+        baseline = by_modes.get(())
+        res.sched = {
+            "candidates": candidates,
+            "chosen": list(chosen),
+            "predicted_ms": round(best[2], 6),
+            "baseline_ms": baseline,
+            "canonical_ms": by_modes.get(canonical),
+            "gap_closed_ms": round(max(0.0, baseline - best[2]), 6)
+            if baseline is not None else None,
+        }
+    return new_func, res, findings
+
+
 def lower(func, target: str = "auto",
           pass_configs: Optional[dict] = None) -> CompiledArtifact:
     """Lower a traced prim_func to a compiled artifact (generated source).
@@ -88,7 +178,12 @@ def lower(func, target: str = "auto",
         # findings are consumed (reported via tile_opt[...] instead).
         from ..transform.tile_opt import run_tile_opt, tile_opt_modes
         topt = None
-        if tile_opt_modes(cfg):
+        modes = tile_opt_modes(cfg)
+        if modes == ("auto",):
+            with _trace.span("tile_opt", "lower", kernel=func.name):
+                func, topt, lint_findings = _auto_tile_opt(
+                    func, cfg, lint_findings)
+        elif modes:
             with _trace.span("tile_opt", "lower", kernel=func.name):
                 func, topt, lint_findings = run_tile_opt(
                     func, cfg, lint_findings)

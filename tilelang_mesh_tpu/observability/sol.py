@@ -132,12 +132,19 @@ def _resolve_static(kernel: Any, name: str) -> dict:
     Resolved once per kernel (outside the profiler lock — the cost-model
     import and feature walk are the expensive part of a first sample)."""
     info: dict = {"predicted_ms": None, "terms": None, "bottleneck": None,
-                  "rewrites": [], "arch": None}
+                  "rewrites": [], "arch": None, "sched": None}
     try:
         art = getattr(kernel, "artifact", None)
         attrs = dict(getattr(art, "attrs", None) or {})
         topt = attrs.get("tile_opt") or {}
         info["rewrites"] = list(topt.get("rewrites") or [])
+        # the auto scheduler's decision (chosen rewrite set + predicted
+        # gap closed vs the do-nothing baseline) — None for fixed-order
+        # lowerings and pre-scheduler sweeps, which render as '-'
+        sched = topt.get("sched")
+        if isinstance(sched, dict):
+            info["sched"] = {"chosen": list(sched.get("chosen") or []),
+                             "gap_closed_ms": sched.get("gap_closed_ms")}
         from ..autotuner.cost_model import (analytic_terms,
                                             features_from_artifact)
         from ..carver.arch import auto_arch
@@ -221,6 +228,7 @@ class SolProfiler:
             "terms": info.get("terms"),
             "rewrites": info.get("rewrites") or [],
             "arch": info.get("arch"),
+            "sched": info.get("sched"),
         }
         pred = rec["predicted_ms"]
         if pred and achieved and achieved > 0:

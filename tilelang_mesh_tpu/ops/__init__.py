@@ -26,3 +26,4 @@ from .seer_attention import seer_attention, seer_block_mask, seer_reference
 from .minference import vertical_slash_sparse_attention, vs_sparse_reference
 from .gdn import gdn_chunk_fwd, gdn_reference
 from .dsa import lightning_indexer, topk_selector, sparse_mla_fwd
+from .softmax import softmax, softmax_kernel

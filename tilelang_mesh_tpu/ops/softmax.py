@@ -1,0 +1,62 @@
+"""Standalone row softmax through the tile pipeline.
+
+The library's attention family inlines its softmax into the online
+update (``_online_softmax.py``); this op is the batch (non-fused) form —
+the building block of router/MoE gating, cross-entropy heads, and
+distillation losses, where the softmax IS the kernel.
+
+The kernel is written as the classic four-phase sweep (shift, exp2,
+row-sum, normalize) rather than one mega-nest on purpose: the phases
+give the tile-IR optimizer (transform/tile_opt.py) real structure to
+work with — the shifted-logits scratch dies before the probability
+buffer is born, so a ``narrow``-thinned probability buffer can land in
+a compatible wider slot, and the normalize nest reuses the shifted
+buffer's slot outright.  All statistics live in the exp2 domain (the
+VPU's native transcendental), like the attention kernels.
+"""
+
+import functools
+from typing import Optional
+
+import tilelang_mesh_tpu.language as T
+from ..jit import compile as _tl_compile
+
+#: log2(e) — pre-scale into the exp2 domain once, at the shift
+_LOG2E = 1.4426950408889634
+
+
+@functools.lru_cache(maxsize=None)
+def softmax_kernel(M, N, block_M=128, in_dtype="float32", out_dtype=None):
+    out_dtype = out_dtype or in_dtype
+    block_M = min(block_M, M)
+
+    @T.prim_func
+    def softmax(X: T.Tensor((M, N), in_dtype),
+                Y: T.Tensor((M, N), out_dtype)):
+        with T.Kernel(T.ceildiv(M, block_M)) as by:
+            Xs = T.alloc_fragment((block_M, N), "float32")
+            Sh = T.alloc_fragment((block_M, N), "float32")
+            P = T.alloc_fragment((block_M, N), "float32")
+            Q = T.alloc_fragment((block_M, N), "float32")
+            m = T.alloc_fragment((block_M,), "float32")
+            z = T.alloc_fragment((block_M,), "float32")
+            T.copy(X[by * block_M, 0], Xs)
+            T.reduce_max(Xs, m, dim=1)
+            for i, j in T.Parallel(block_M, N):
+                Sh[i, j] = (Xs[i, j] - m[i]) * _LOG2E
+            for i, j in T.Parallel(block_M, N):
+                P[i, j] = T.exp2(Sh[i, j])
+            T.reduce_sum(P, z, dim=1)
+            for i, j in T.Parallel(block_M, N):
+                Q[i, j] = P[i, j] / z[i]
+            T.copy(Q, Y[by * block_M, 0])
+
+    return _tl_compile(softmax)
+
+
+def softmax(x, block_M: Optional[int] = None, out_dtype=None):
+    """Row softmax of a 2-D array through the tile pipeline."""
+    M, N = x.shape
+    k = softmax_kernel(M, N, block_M or 128, in_dtype=str(x.dtype),
+                       out_dtype=out_dtype)
+    return k(x)

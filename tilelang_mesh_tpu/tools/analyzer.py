@@ -1541,6 +1541,9 @@ def summarize_sol(records, store_stats: Optional[dict] = None) -> dict:
             "gap": r.get("gap"),
             "rewrites": r.get("rewrites"),
             "arch": r.get("arch"),
+            # auto-scheduler decision (SOL_SCHEMA additive field) —
+            # absent in pre-scheduler sweeps, rendered '-'
+            "sched": r.get("sched"),
         }
     pcts = [v["sol_pct"] for v in rows.values()
             if isinstance(v.get("sol_pct"), (int, float))]
@@ -1562,6 +1565,19 @@ def summarize_sol(records, store_stats: Optional[dict] = None) -> dict:
     if store_stats is not None:
         out["store"] = store_stats
     return out
+
+
+def _sched_cell(sched) -> str:
+    """The scheduler column: chosen rewrite set + predicted gap closed
+    (ms) when TL_TPU_TILE_OPT=auto made the call; '-' for fixed-order
+    lowerings and records written before the scheduler existed."""
+    if not isinstance(sched, dict):
+        return "-"
+    chosen = "+".join(sched.get("chosen") or []) or "none"
+    gap = sched.get("gap_closed_ms")
+    if isinstance(gap, (int, float)):
+        return f"{chosen} (-{gap:.4f}ms)"
+    return chosen
 
 
 def _top_gap(gap) -> str:
@@ -1593,7 +1609,7 @@ def format_sol_report(sol: dict) -> str:
     if rows:
         lines.append(f"  {'kernel':<28} {'n':>4} {'achieved':>10} "
                      f"{'predicted':>10} {'sol%':>7} {'bottleneck':<10} "
-                     f"top gap")
+                     f"{'scheduler':<24} top gap")
 
         def _key(kv):
             p = kv[1].get("sol_pct")
@@ -1608,6 +1624,7 @@ def format_sol_report(sol: dict) -> str:
                 f"{(f'{pred:.4f}' if pred is not None else '-'):>10} "
                 f"{(f'{pct:.1%}' if pct is not None else '-'):>7} "
                 f"{(row.get('bottleneck') or '-'):<10} "
+                f"{_sched_cell(row.get('sched')):<24} "
                 f"{_top_gap(row.get('gap'))}")
     else:
         lines.append("  no sol records in this artifact "

@@ -238,6 +238,8 @@ def lint_targets(targets: List[str],
     findings: List[dict] = []
     notes: List[dict] = []
     kernels = 0
+    narrowable = 0
+    narrow_hints: List[dict] = []
     by_rule: Dict[str, int] = {}
     by_sev: Dict[str, int] = {}
     expanded = _expand_targets(targets)
@@ -261,6 +263,20 @@ def lint_targets(targets: List[str],
                 findings.append(rec)
                 by_rule[d.rule] = by_rule.get(d.rule, 0) + 1
                 by_sev[d.severity] = by_sev.get(d.severity, 0) + 1
+            # probe the narrow rewrite's candidate oracle (the same
+            # TL007/TL008 dual-track proof, run in the inverse
+            # direction): buffers whose proven interval AND error bound
+            # fit a thinner dtype have a one-flag auto-fix
+            try:
+                from ..transform.tile_opt import narrow_candidates
+                cands = narrow_candidates(obj.func, pass_cfg)
+            except Exception:   # noqa: BLE001
+                cands = []
+            if cands:
+                narrowable += len(cands)
+                narrow_hints.append({"target": str(target),
+                                     "kernel": obj.func.name,
+                                     "buffers": list(cands)})
     return {
         "targets": [str(t) for t in expanded],
         "kernels_linted": kernels,
@@ -268,7 +284,9 @@ def lint_targets(targets: List[str],
         "summary": {"by_rule": dict(sorted(by_rule.items())),
                     "by_severity": dict(sorted(by_sev.items())),
                     "total": len(findings),
-                    "errors": by_sev.get("error", 0)},
+                    "errors": by_sev.get("error", 0),
+                    "narrowable": narrowable},
+        "narrow_hints": narrow_hints,
         "notes": notes,
     }
 
@@ -300,6 +318,19 @@ def format_report(report: dict) -> str:
                 "default on; see docs/tile_opt.md)")
     else:
         lines.append("no findings — lint-clean")
+    if s.get("narrowable"):
+        # mirror of the TL006→dse hint: these buffers carry a
+        # machine-checked TL007/TL008 interval + error-bound proof that
+        # already admits the dtype-narrowing rewrite
+        per_k = "; ".join(
+            f"{h['kernel']}: {', '.join(h['buffers'])}"
+            for h in report.get("narrow_hints", [])[:20])
+        lines.append(
+            f"--fix: {s['narrowable']} scratch buffer(s) carry a "
+            f"TL007/TL008-proven interval/error bound that fits a "
+            f"narrower dtype ({per_k}) — TL_TPU_TILE_OPT=narrow (or "
+            f"=auto) applies the rewrite at compile time (see "
+            f"docs/tile_opt.md)")
     skipped = [n for n in report["notes"]
                if n["kind"] in ("seed-skipped", "seed-error")]
     imports = [n for n in report["notes"] if n["kind"] == "import-error"]

@@ -1240,7 +1240,9 @@ def _pack_scratch(scratch: List[Buffer], stmts: List[Stmt],
 #: bump when the feature dict's keys or semantics change — the cost
 #: model refuses to mix samples across feature schemas, and stale
 #: journal/tune-cache features are skipped instead of misfit
-FEATURES_VERSION = 1
+#: (v2: + vmem_occupancy — the post-tile-opt resident footprint, so the
+#: model prices the OPTIMIZED kernel: narrowing/repack shrink it)
+FEATURES_VERSION = 2
 
 
 def plan_features(func: PrimFunc, plan: KernelPlan) -> dict:
@@ -1348,6 +1350,21 @@ def plan_features(func: PrimFunc, plan: KernelPlan) -> dict:
                 stream_bytes += n * dtype_bits(p.buffer.dtype) // 8
     hbm_bytes = max(copy_bytes[0], stream_bytes)
 
+    # resident occupancy: per-buffer scratch bytes (Mosaic allocates each
+    # scratch buffer separately — the liveness-packed arena is the
+    # *if-shared* lower bound, not the allocation) + BlockSpec windows,
+    # as a fraction of the TL005 budget. The plan is built AFTER tile-opt
+    # ran, so a narrowed or repacked kernel genuinely shrinks this — the
+    # PR 11/12 remainder: the cost model prices the optimized kernel.
+    scratch_bytes = 0
+    for b in plan.scratch:
+        sh = b.static_shape()
+        if sh:
+            n = max(1, dtype_bits(b.dtype) // 8)
+            for d in sh:
+                n *= d
+            scratch_bytes += n
+
     sizes = best_block[1] or (1,)
     rows = 1
     for d in sizes[:-1]:
@@ -1362,6 +1379,8 @@ def plan_features(func: PrimFunc, plan: KernelPlan) -> dict:
         "grid_steps": int(grid_steps),
         "vmem_arena": int(plan.vmem_arena),
         "vmem_block_bytes": int(block_resident),
+        "vmem_occupancy": round(
+            (scratch_bytes + block_resident) / _DEFAULT_VMEM_BUDGET, 6),
         "n_scratch": len(plan.scratch),
         "n_params": len(plan.params),
         "pipelined": 1 if plan.pipeline_axis is not None else 0,

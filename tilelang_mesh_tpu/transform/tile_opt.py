@@ -7,7 +7,7 @@ pass suite promotes those proofs into rewrites: it runs in
 the lint analysis VERBATIM as its legality oracle — every rewrite fires
 only on what the affine model can *prove*, exactly the
 proof-carrying-tile-rewrite discipline of the xDSL custom-lowering and
-CUDA-Tile evaluation work (PAPERS.md).  Four rewrites, individually
+CUDA-Tile evaluation work (PAPERS.md).  Five rewrites, individually
 selectable through ``TL_TPU_TILE_OPT`` (docs/tile_opt.md):
 
 ``dse``
@@ -19,6 +19,29 @@ selectable through ``TL_TPU_TILE_OPT`` (docs/tile_opt.md):
     count.  The auto-fixed TL006 findings are consumed (they surface in
     the ``tile_opt[...]`` accounting instead of the lint block).
 
+``narrow``
+    Value-range-driven dtype narrowing — the TL007/TL008 dual-track
+    interpretation (``analysis/numerics.py``) run in the INVERSE
+    direction: instead of checking that a value fits its declared
+    dtype, the pass finds scratch buffers whose proven sound interval
+    AND accumulated rounding-error bound fit a *thinner* dtype
+    (f32 -> bf16 scratch, i32 -> i16 index/position buffers) and
+    rewrites the alloc plus every touch.  Loads present the original
+    dtype through an exact widening cast (compute precision is
+    unchanged; only the storage rounds), so the interpreter's
+    store-side error model prices the rewrite exactly.  The proof is
+    triple-checked: after the cheap envelope pre-gate, a cancellation
+    screen refuses any candidate whose storage rounding (an ABSOLUTE
+    error the relative TL008 model cannot see through a subtraction of
+    nearly-equal values) could blow the error budget, and the
+    candidate narrowed kernel is re-interpreted end to end with any
+    candidate implicated in a new finding (or a lost output-finiteness
+    proof) dropped.  Each narrowing is golden-recorded with its proof
+    (interval, error bound, bytes) and guarded by the
+    ``TL_TPU_SELFCHECK`` differential first-call check.  Opt-in: not
+    part of the default set (``TL_TPU_TILE_OPT=narrow,...`` or
+    ``=auto`` or ``=all``), so default plan_descs stay byte-stable.
+
 ``repack``
     VMEM arena re-packing.  The TL005 interval model already proves
     which scratch lifetimes never overlap; this rewrite *realizes* that
@@ -26,7 +49,12 @@ selectable through ``TL_TPU_TILE_OPT`` (docs/tile_opt.md):
     with provably disjoint top-level live ranges onto one shared arena
     slot — Mosaic then allocates one buffer where it allocated N, so
     bigger tiles fit the real VMEM budget (the advisory
-    liveness-packed arena becomes the physical footprint).
+    liveness-packed arena becomes the physical footprint).  The slot
+    gate also admits byte-compatible slots: a buffer whose dtype
+    widens EXACTLY into a same-shape slot's dtype (bf16 into f32)
+    shares it through a cast view — loads re-narrow, stored values
+    round eagerly — which is bit-exact, and is how a buffer thinned by
+    ``narrow`` becomes newly packable (the passes compose).
 
 ``dbuf``
     Proof-gated automatic double-buffering.  A synchronous ``T.copy``
@@ -49,6 +77,19 @@ selectable through ``TL_TPU_TILE_OPT`` (docs/tile_opt.md):
     talks to iteration i) and injective over the extent>1 vars.  One
     region means one vectorized sweep — shared loads (the dequant
     ``Bp_s[i, j]`` nibble source) are read once instead of twice.
+    Fusion is also INTERLEAVED: a nest separated from its partner by
+    unrelated statements still fuses when every intervening statement
+    provably touches a disjoint buffer set (the TL001 access
+    enumeration as the overlap oracle), hoisting the nest across them.
+
+``TL_TPU_TILE_OPT=auto`` replaces the fixed canonical order with a
+cost-model pass scheduler (``engine/lower.py``): the legal rewrite
+subsets for the kernel are enumerated, each candidate is priced via
+``autotuner/cost_model.analytic_terms`` on re-derived
+``plan_features``, and the min-predicted-latency set (VMEM footprint
+as the tie-break) is chosen; the decision — candidates, predicted ms,
+chosen set, predicted-gap-closed — is recorded in
+``attrs["tile_opt"]["sched"]`` and the SoL record.
 
 Every decision is deterministic (program order, no dict-order
 dependence; two lowerings are byte-identical), golden-recorded in a
@@ -74,25 +115,40 @@ from ..ir import (AllocStmt, AssertStmt, AsyncCopyStmt, AtomicStmt, Buffer,
                   SeqStmt, Stmt, as_int, dtype_bits)
 from ..ir.expr import BinOp, Call, Cast, Var, convert
 
-# rewrites in canonical order (execution, plan_desc and attrs all use it;
-# the composition tests assert dse -> repack -> dbuf -> fuse is the one
-# deterministic pipeline)
-MODES = ("dse", "repack", "dbuf", "fuse")
+# rewrites in canonical order (execution, plan_desc and attrs all use
+# it; the composition tests assert dse -> narrow -> repack -> dbuf ->
+# fuse is the one deterministic pipeline — narrow runs before repack so
+# a thinned buffer can land in a widening-compatible slot)
+MODES = ("dse", "narrow", "repack", "dbuf", "fuse")
+
+#: the default-on subset ("1"/"on"/unset): narrow is opt-in — it changes
+#: stored precision (within the proven error budget), so it only runs
+#: when asked for by name, by "all", or by the =auto scheduler.  This
+#: keeps every pre-narrow golden byte-stable under the default knob.
+DEFAULT_MODES = ("dse", "repack", "dbuf", "fuse")
 
 
 def tile_opt_modes(pass_cfg: Optional[dict] = None) -> Tuple[str, ...]:
     """Active rewrite set: ``tl.tpu.tile_opt`` pass config when present,
-    else the ``TL_TPU_TILE_OPT`` env var.  "1"/"on"/"all" enables every
-    rewrite, "0"/"off" disables the pass (restoring pre-pass plan_descs
-    byte-identically), and a comma list selects a subset.  A typo'd
-    token raises instead of silently disabling the optimizer (the same
-    contract as TL_TPU_COMM_OPT / TL_TPU_LINT)."""
+    else the ``TL_TPU_TILE_OPT`` env var.  "1"/"on" enables the default
+    set (everything but ``narrow``), "all" enables every rewrite,
+    "auto" defers the choice to the cost-model pass scheduler in
+    engine/lower.py (returned as the ``("auto",)`` sentinel), "0"/"off"
+    disables the pass (restoring pre-pass plan_descs byte-identically),
+    and a comma list selects a subset.  A typo'd token raises instead
+    of silently disabling the optimizer (the same contract as
+    TL_TPU_COMM_OPT / TL_TPU_LINT)."""
     raw: Any = None
     if pass_cfg:
         raw = pass_cfg.get("tl.tpu.tile_opt")
     if raw is None:
         from ..env import env
         raw = env.TL_TPU_TILE_OPT
+    s = str(raw).strip().lower()
+    if s == "auto":
+        return ("auto",)
+    if s in ("1", "on", "true", "yes", ""):
+        return DEFAULT_MODES
     from .pass_config import parse_mode_set
     return parse_mode_set(raw, MODES, "TL_TPU_TILE_OPT")
 
@@ -110,28 +166,46 @@ class TileOptResult:
     dse_stores: int = 0
     dse_allocs: int = 0
     dse_bytes: int = 0
+    narrow_buffers: int = 0
+    narrow_bytes: int = 0
+    #: per-narrowing proof record: buffer, from/to dtype, sound
+    #: interval, accumulated error bound, bytes saved, verify rounds
+    narrow_proofs: List[dict] = field(default_factory=list)
     repack_pre_bytes: int = 0
     repack_post_bytes: int = 0
     repack_buffers: int = 0
+    repack_compat: int = 0
     repack_slots: int = 0
     dbuf_chains: int = 0
     fuse_regions: int = 0
+    fuse_interleaved: int = 0
+    #: the =auto cost-model scheduler's decision record (engine/lower),
+    #: None under any fixed mode set
+    sched: Optional[dict] = None
 
     def attrs_record(self) -> dict:
         """JSON-safe accounting for CompiledArtifact.attrs['tile_opt']."""
-        return {
+        rec = {
             "modes": list(self.modes),
             "rewrites": list(self.rewrites),
             "eliminated": [dict(e) for e in self.eliminated],
             "dse": {"stores": self.dse_stores, "allocs": self.dse_allocs,
                     "bytes": self.dse_bytes},
+            "narrow": {"buffers": self.narrow_buffers,
+                       "bytes": self.narrow_bytes,
+                       "proofs": [dict(p) for p in self.narrow_proofs]},
             "repack": {"pre_bytes": self.repack_pre_bytes,
                        "post_bytes": self.repack_post_bytes,
                        "buffers": self.repack_buffers,
+                       "compat": self.repack_compat,
                        "slots": self.repack_slots},
             "dbuf": {"chains": self.dbuf_chains},
-            "fuse": {"regions": self.fuse_regions},
+            "fuse": {"regions": self.fuse_regions,
+                     "interleaved": self.fuse_interleaved},
         }
+        if self.sched is not None:
+            rec["sched"] = dict(self.sched)
+        return rec
 
     def desc_block(self) -> List[str]:
         """The ``tile_opt[...]`` lines appended to plan_desc — only when
@@ -146,6 +220,12 @@ class TileOptResult:
             # accounting the lint block carries
             head += (f", scratch {self.repack_pre_bytes}B -> "
                      f"{self.repack_post_bytes}B")
+        if self.narrow_buffers:
+            head += f", narrowed {self.narrow_bytes}B VMEM"
+        if self.sched is not None:
+            head += (f"; auto: predicted "
+                     f"{self.sched['predicted_ms']:.4g}ms (gap closed "
+                     f"{self.sched['gap_closed_ms']:.4g}ms)")
         return [head] + [f"    * {r}" for r in self.rewrites]
 
 
@@ -156,10 +236,18 @@ class TileOptResult:
 # in place — containers are rebuilt, unchanged subtrees are reused.
 # ---------------------------------------------------------------------------
 
-#: uid -> (replacement buffer, optional leading index expr).  A None
-#: lead is a plain buffer substitution (repack); a non-None lead
-#: prepends a slot index to every access (dbuf's rotated second slot).
-BufSub = Dict[int, Tuple[Buffer, Optional[Any]]]
+#: uid -> (replacement buffer, optional leading index expr, optional
+#: cast dtype).  A None lead is a plain buffer substitution (repack); a
+#: non-None lead prepends a slot index to every access (dbuf's rotated
+#: second slot).  A non-None cast is the ORIGINAL dtype the
+#: surrounding code expects: every elementwise load is wrapped
+#: ``Cast(cast, load)`` so consumers see the original type (narrow's
+#: widen-on-read; compat repack's re-narrow-on-read), and — only when
+#: ``cast`` is strictly narrower than the replacement buffer's dtype
+#: (the compat-repack case) — stored values are rounded through
+#: ``Cast(cast, value)`` before landing, keeping every uncasted
+#: observation path (a copy source) bit-exact with the original.
+BufSub = Dict[int, Tuple[Buffer, Optional[Any], Optional[str]]]
 
 
 def _rw_expr(e, vm: dict, bs: BufSub):
@@ -172,10 +260,11 @@ def _rw_expr(e, vm: dict, bs: BufSub):
         changed = any(a is not b for a, b in zip(idx, e.indices))
         if sub is None and not changed:
             return e
-        buf, lead = sub if sub is not None else (e.buffer, None)
+        buf, lead, cast = sub if sub is not None else (e.buffer, None, None)
         if lead is not None:
             idx = [lead] + idx
-        return BufferLoad(buf, tuple(idx))
+        load = BufferLoad(buf, tuple(idx))
+        return Cast(cast, load) if cast is not None else load
     if isinstance(e, BinOp):
         a, b = _rw_expr(e.a, vm, bs), _rw_expr(e.b, vm, bs)
         if a is e.a and b is e.b:
@@ -198,7 +287,7 @@ def _rw_region(r: Region, vm: dict, bs: BufSub) -> Region:
     sub = bs.get(r.buffer.uid)
     if sub is None and all(a is b for a, b in zip(base, r.base)):
         return r
-    buf, lead = sub if sub is not None else (r.buffer, None)
+    buf, lead, _cast = sub if sub is not None else (r.buffer, None, None)
     shape = list(r.shape)
     if lead is not None:
         base = [lead] + base
@@ -206,15 +295,26 @@ def _rw_region(r: Region, vm: dict, bs: BufSub) -> Region:
     return Region(buf, tuple(base), tuple(shape))
 
 
-def _rw_buf(b: Buffer, bs: BufSub) -> Buffer:
+def _rw_buf(b: Buffer, bs: BufSub, allow_cast: bool = False) -> Buffer:
     sub = bs.get(b.uid)
     if sub is None:
         return b
-    buf, lead = sub
+    buf, lead, cast = sub
     if lead is not None:
         raise AssertionError(
             f"buffer {b.name} used as a whole-buffer operand cannot take "
             f"a slot index (tile-opt pass bug: dbuf must bail on it)")
+    if cast is not None and _exact_widens(cast, buf.dtype) \
+            and not allow_cast:
+        # a compat-repack cast view cannot present through a
+        # whole-buffer operand — _compat_castable must have refused
+        # (reduce/cumsum SRC is the one exception: the caller passes
+        # allow_cast because reading exactly-representable values at
+        # the wider slot dtype is bit-identical to the upcast)
+        raise AssertionError(
+            f"buffer {b.name} with a narrowing cast view used as a "
+            f"whole-buffer operand (tile-opt pass bug: compat repack "
+            f"must bail on it)")
     return buf
 
 
@@ -256,6 +356,12 @@ def _rw_stmt(s: Stmt, vm: dict, bs: BufSub) -> Stmt:
             return s
         return _keep_loc(IfThenElse(cond, then, els), s)
     if isinstance(s, AllocStmt):
+        # narrow swaps the alloc in place (same name/shape/scope,
+        # thinner dtype).  repack drops its allocs BEFORE rewriting and
+        # dbuf subs carry a lead, so only narrow reaches this branch.
+        sub = bs.get(s.buffer.uid)
+        if sub is not None and sub[1] is None and sub[0] is not s.buffer:
+            return _keep_loc(AllocStmt(sub[0]), s)
         return s
     if isinstance(s, CopyStmt):
         src, dst = _rw_region(s.src, vm, bs), _rw_region(s.dst, vm, bs)
@@ -279,16 +385,26 @@ def _rw_stmt(s: Stmt, vm: dict, bs: BufSub) -> Stmt:
     if isinstance(s, FillStmt):
         dst = _rw_region(s.dst, vm, bs)
         val = _rw_expr(s.value, vm, bs)
+        sub = bs.get(s.dst.buffer.uid)
+        if sub is not None and sub[2] is not None \
+                and _exact_widens(sub[2], sub[0].dtype):
+            # compat repack: round the fill value at the original
+            # (narrower) dtype before it lands in the wider slot
+            val = Cast(sub[2], convert(val))
         if dst is s.dst and val is s.value:
             return s
         return _keep_loc(FillStmt(dst, val), s)
     if isinstance(s, ReduceStmt):
-        src, dst = _rw_buf(s.src, bs), _rw_buf(s.dst, bs)
+        # the SRC may carry a compat cast view: reading the slot's
+        # exactly-representable values at its wider dtype is
+        # bit-identical to upcast-before-reduce, so the cast is simply
+        # dropped (codegen accumulates at the dst dtype regardless)
+        src, dst = _rw_buf(s.src, bs, allow_cast=True), _rw_buf(s.dst, bs)
         if src is s.src and dst is s.dst:
             return s
         return _keep_loc(ReduceStmt(s.kind, src, dst, s.dim, s.clear), s)
     if isinstance(s, CumSumStmt):
-        src, dst = _rw_buf(s.src, bs), _rw_buf(s.dst, bs)
+        src, dst = _rw_buf(s.src, bs, allow_cast=True), _rw_buf(s.dst, bs)
         if src is s.src and dst is s.dst:
             return s
         return _keep_loc(CumSumStmt(src, dst, s.dim, s.reverse), s)
@@ -307,9 +423,14 @@ def _rw_stmt(s: Stmt, vm: dict, bs: BufSub) -> Stmt:
         if sub is None and val is s.value and \
                 all(a is b for a, b in zip(idx, s.indices)):
             return s
-        buf, lead = sub if sub is not None else (s.buffer, None)
+        buf, lead, cast = sub if sub is not None else (s.buffer, None, None)
         if lead is not None:
             idx = [lead] + idx
+        if cast is not None and _exact_widens(cast, buf.dtype):
+            # compat repack: round the stored value at the original
+            # (narrower) dtype so every observation path — including an
+            # uncasted copy source — stays bit-exact with the original
+            val = Cast(cast, convert(val))
         return _keep_loc(BufferStoreStmt(buf, tuple(idx), val), s)
     if isinstance(s, EvaluateStmt):
         e = _rw_expr(s.expr, vm, bs)
@@ -475,6 +596,336 @@ def _dse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
 
 
 # ---------------------------------------------------------------------------
+# narrow — value-range-driven dtype narrowing (TL007/TL008 inverted)
+# ---------------------------------------------------------------------------
+
+#: the narrowing directions the pass considers.  f32 scratch holding a
+#: proven-small, error-budgeted value thins to bf16 (same exponent
+#: range, so the TL007 range check is about the ERROR budget); i32
+#: index/position scratch with a proven sub-16-bit sound interval thins
+#: to i16 (exact — the range proof is the whole story).
+_NARROW_TARGETS = {"float32": "bfloat16", "int32": "int16"}
+
+
+def _exact_widens(narrow_dt: str, wide_dt: str) -> bool:
+    """True when every value of ``narrow_dt`` is exactly representable
+    in ``wide_dt`` (the narrow -> wide -> narrow round trip is
+    lossless) — the legality rule behind both the compat-repack slot
+    gate and the cast views the BufSub machinery installs."""
+    if narrow_dt == wide_dt:
+        return False
+    from ..analysis.absint import (dtype_eps, dtype_max, int_range,
+                                   is_float, is_int)
+    if is_float(narrow_dt) and is_float(wide_dt):
+        return (dtype_eps(wide_dt) <= dtype_eps(narrow_dt)
+                and dtype_max(wide_dt) >= dtype_max(narrow_dt))
+    if is_int(narrow_dt) and is_int(wide_dt):
+        nlo, nhi = int_range(narrow_dt)
+        wlo, whi = int_range(wide_dt)
+        return wlo <= nlo and whi >= nhi
+    return False
+
+
+def _interp_body(func: PrimFunc, body: SeqStmt, pass_cfg):
+    """Fresh dual-track interpretation of the CURRENT (possibly already
+    rewritten) body — run_tile_opt rewrites functionally, so the
+    memoized analysis/numerics.analyze cache keyed on the original
+    PrimFunc cannot be reused here."""
+    from ..analysis.numerics import Interp
+    f2 = PrimFunc(func.name, func.params, body, dict(func.attrs))
+    return Interp(f2, pass_cfg).run()
+
+
+def _narrow_fits(env, old_dt: str, new_dt: str, err_thr: float) -> bool:
+    """The cheap envelope pre-gate: does the buffer's proven write
+    envelope (sound interval + accumulated relative error) fit the
+    thinner dtype?  Floats need the whole store chain to stay inside
+    the TL008 error budget even after the extra per-store rounding;
+    ints need the exact sound interval inside the thinner range.
+    Module-level so the mutation tests can corrupt it and assert the
+    differential selfcheck catches the miscompile."""
+    from ..analysis.absint import dtype_eps, dtype_max, int_range, is_float
+    if env is None or not env.sound_bounded():
+        return False
+    if is_float(old_dt):
+        if not env.finite:
+            return False
+        fmax = dtype_max(new_dt)
+        if env.shi > fmax or env.slo < -fmax:
+            return False
+        return env.err + dtype_eps(new_dt) <= err_thr
+    lo, hi = int_range(new_dt)
+    return env.slo >= lo and env.shi <= hi
+
+
+def _cancel_screen(body: SeqStmt, base, cands, err_thr: float) -> set:
+    """The cancellation screen — the narrow pass's third gate.
+
+    TL008 tracks RELATIVE rounding error and carries
+    ``max(err_a, err_b)`` through add/sub, which is sound only while
+    magnitudes do not cancel.  Storing a large-magnitude buffer at a
+    thinner dtype plants an ABSOLUTE error of up to
+    ``maxmag * eps(new)``; a downstream subtraction of nearly-equal
+    values then shrinks the value without shrinking that error — a
+    blow-up invisible to both the envelope pre-gate and the
+    re-interpretation (``x + 16384`` staged through bf16 rounds to a
+    multiple of 128; ``- 16384`` afterwards returns garbage).
+
+    The screen walks every store-side expression with plain interval
+    arithmetic (buffer loads read the proven write envelopes, taint
+    tracks which candidates feed which buffers, two forward passes
+    catch simple staging chains) and refuses any candidate feeding an
+    add/sub whose proven result magnitude is small enough for the
+    candidate's storage error alone to blow the error budget:
+    ``maxmag(result) < maxmag(cand) * eps(new) / err_thr``.  This is
+    heuristic hardening, not a completeness proof — the differential
+    selfcheck stays the runtime backstop.  Module-level so the
+    mutation tests can corrupt it.  Returns the blocked buffer uids."""
+    from ..analysis.absint import (AbsVal, av_add, av_mul, av_sub,
+                                   dtype_eps, mk)
+    from ..analysis.dataflow import iter_stmts
+
+    env_mag: Dict[int, float] = {}
+    for _a, b, new_dt, env in cands:
+        env_mag[b.uid] = max(abs(env.slo), abs(env.shi)) \
+            * dtype_eps(new_dt) / err_thr
+    if not env_mag:
+        return set()
+    unknown = AbsVal()
+    blocked: set = set()
+    taint: Dict[int, frozenset] = {}
+
+    def ev(e) -> Tuple[AbsVal, frozenset]:
+        if isinstance(e, (int, float)):
+            v = float(e)
+            return mk(v, v, v, v, True), frozenset()
+        val = getattr(e, "value", None)
+        if isinstance(e, Cast):
+            return ev(val)
+        if val is not None and isinstance(val, (int, float)) \
+                and not isinstance(e, BufferLoad):
+            v = float(val)
+            return mk(v, v, v, v, True), frozenset()
+        if isinstance(e, BufferLoad):
+            uid = e.buffer.uid
+            u = taint.get(uid, frozenset())
+            if uid in env_mag:
+                u = u | frozenset((uid,))
+            env = base.envelopes.get(uid)
+            return (env if env is not None else unknown), u
+        if isinstance(e, BinOp):
+            va, ua = ev(e.a)
+            vb, ub = ev(e.b)
+            u = ua | ub
+            fn = {"+": av_add, "-": av_sub, "*": av_mul}.get(e.op)
+            if fn is None:
+                return unknown, u
+            r = fn(va, vb)
+            if e.op in ("+", "-") and u and r.sound_bounded():
+                rmax = max(abs(r.slo), abs(r.shi))
+                for uid in u:
+                    if rmax < env_mag[uid]:
+                        blocked.add(uid)
+            return r, u
+        if isinstance(e, Call):
+            u = frozenset()
+            for a in e.args:
+                if not isinstance(a, (str, slice)):
+                    u = u | ev(a)[1]
+            return unknown, u
+        return unknown, frozenset()
+
+    for _round in range(2):      # second pass: simple staging back-edges
+        for s, _c in iter_stmts(body):
+            if isinstance(s, BufferStoreStmt):
+                _v, u = ev(s.value)
+                if u:
+                    taint[s.buffer.uid] = taint.get(
+                        s.buffer.uid, frozenset()) | u
+            elif isinstance(s, CopyStmt):
+                u = taint.get(s.src.buffer.uid)
+                if u:
+                    taint[s.dst.buffer.uid] = taint.get(
+                        s.dst.buffer.uid, frozenset()) | u
+    return blocked
+
+
+def _narrow_blockers(body: SeqStmt) -> set:
+    """Buffer uids the narrow rewrite must refuse on STRUCTURAL grounds
+    regardless of any value proof: DMA endpoints (the TPU DMA engine
+    cannot convert dtypes), gemm accumulators (the MXU accumulates at
+    the C dtype), reduce/cumsum destinations (the n*eps(dst) error
+    model is priced at the destination dtype), atomics, collectives,
+    prints, int gemm operands, and anything read before its first
+    write (garbage VMEM has no envelope)."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    from ..analysis.absint import is_int
+    bad: set = set()
+    first_read: set = set()
+    touched: set = set()
+    for s, _c in iter_stmts(body):
+        if isinstance(s, AllocStmt):
+            continue
+        if isinstance(s, (AsyncCopyStmt, CommStmt, AtomicStmt, PrintStmt)):
+            for acc in stmt_accesses(s):
+                bad.add(acc.buffer.uid)
+            if isinstance(s, AsyncCopyStmt):
+                bad.add(s.sem.uid)
+            continue
+        if isinstance(s, CopyStmt):
+            # DMA legs (a global peer) cannot convert dtypes; pure
+            # on-chip copies go through an astype and stay legal
+            if s.src.buffer.scope == "global":
+                bad.add(s.dst.buffer.uid)
+            if s.dst.buffer.scope == "global":
+                bad.add(s.src.buffer.uid)
+        elif isinstance(s, GemmStmt):
+            bad.add(s.C.buffer.uid)
+            # bf16 gemm operands ride the MXU natively (the either-f32
+            # HIGHEST precision rule keeps the wide side exact); int
+            # operands do not — refuse int narrowing there
+            for r in (s.A, s.B):
+                if is_int(r.buffer.dtype):
+                    bad.add(r.buffer.uid)
+        elif isinstance(s, (ReduceStmt, CumSumStmt)):
+            # src is legal: codegen upcasts a narrower src to the dst
+            # dtype before accumulating (matching the interpreter's
+            # n*eps(dst) model); the dst dtype IS the accumulator
+            bad.add(s.dst.uid)
+        # first-touch discipline: a buffer read before any write is
+        # uninitialized garbage — both lowerings would read DIFFERENT
+        # garbage, so narrowing it is unverifiable
+        for acc in stmt_accesses(s):
+            uid = acc.buffer.uid
+            if uid not in touched and acc.kind == "read":
+                first_read.add(uid)
+            touched.add(uid)
+    return bad | first_read
+
+
+def _narrow_candidates(func: PrimFunc, body: SeqStmt, pass_cfg):
+    """(interp result, [(alloc stmt, buffer, target dtype, envelope)])
+    for every scratch alloc that passes the structural refusals AND the
+    envelope pre-gate, in program order.  Shared by the rewrite and the
+    lint CLI's TL008 --fix hint."""
+    from ..analysis.dataflow import iter_stmts
+    from ..analysis.numerics import num_err_threshold
+    try:
+        base = _interp_body(func, body, pass_cfg)
+    except Exception:   # noqa: BLE001 — no proof, no rewrite
+        return None, []
+    err_thr = num_err_threshold(pass_cfg)
+    blocked = _narrow_blockers(body)
+    cands = []
+    seen: set = set()
+    for s, _c in iter_stmts(body):
+        if not isinstance(s, AllocStmt) or s.buffer.uid in seen:
+            continue
+        seen.add(s.buffer.uid)
+        b = s.buffer
+        new_dt = _NARROW_TARGETS.get(b.dtype)
+        if new_dt is None or b.scope in ("global", "sem", "smem") \
+                or b.static_shape() is None or b.uid in blocked:
+            continue
+        env = base.envelopes.get(b.uid)
+        if not _narrow_fits(env, b.dtype, new_dt, err_thr):
+            continue
+        cands.append((s, b, new_dt, env))
+    if cands:
+        cancel = _cancel_screen(body, base, cands, err_thr)
+        cands = [c for c in cands if c[1].uid not in cancel]
+    return base, cands
+
+
+def narrow_candidates(func: PrimFunc, pass_cfg: Optional[dict] = None
+                      ) -> List[str]:
+    """Buffer names the narrow rewrite would provably thin (envelope
+    pre-gate only, no re-verification) — the lint CLI consults this to
+    print the TL008 -> TL_TPU_TILE_OPT=narrow --fix hint."""
+    body = func.body if isinstance(func.body, SeqStmt) \
+        else SeqStmt(list(func.body))
+    _base, cands = _narrow_candidates(func, body, pass_cfg)
+    return [b.name for _s, b, _dt, _env in cands]
+
+
+def _narrow_verify(func: PrimFunc, cand_body: SeqStmt, base, pass_cfg,
+                   cand_names: set):
+    """Re-interpretation verification: run the dual-track interpreter
+    over the candidate NARROWED body and demand it is proof-clean vs
+    the baseline — no new (rule, buffer) finding, no lost
+    output-finiteness proof.  Returns the set of candidate buffer
+    names implicated in a regression ('*' = unattributable, the caller
+    drops everything), an empty set when clean, or None when the
+    interpretation itself failed.  Module-level so the mutation tests
+    can corrupt it."""
+    try:
+        ver = _interp_body(func, cand_body, pass_cfg)
+    except Exception:   # noqa: BLE001
+        return None
+    base_keys = {(d.rule, d.buffer) for d in base.findings}
+    bad: set = set()
+    for d in ver.findings:
+        if (d.rule, d.buffer) in base_keys:
+            continue
+        bad.add(d.buffer if d.buffer in cand_names else "*")
+    for name, proven in base.outputs.items():
+        if proven and not ver.outputs.get(name, False):
+            bad.add("*")
+    return bad
+
+
+def _narrow(func: PrimFunc, body: SeqStmt, res: TileOptResult,
+            pass_cfg) -> SeqStmt:
+    """Thin provably-small scratch buffers to a narrower dtype.
+
+    Loads present the original dtype through an exact widening cast —
+    compute precision is untouched, only the STORAGE rounds (each
+    store charges one eps(new dtype), exactly what the envelope
+    pre-gate budgeted).  After the pre-gate, the candidate narrowed
+    body is re-interpreted end to end; any candidate implicated in a
+    new finding is dropped and verification repeats until the set is
+    clean (or empty)."""
+    from ..analysis.numerics import num_err_threshold
+    base, cands = _narrow_candidates(func, body, pass_cfg)
+    if not cands:
+        return body
+    err_thr = num_err_threshold(pass_cfg)
+    rounds = 0
+    cand_body, buf_sub = body, {}
+    while cands:
+        rounds += 1
+        buf_sub = {}
+        for _astmt, b, new_dt, _env in cands:
+            nb = Buffer(b.name, b.static_shape(), new_dt, b.scope)
+            buf_sub[b.uid] = (nb, None, b.dtype)
+        cand_body = _rw_stmt(body, {}, buf_sub)
+        bad = _narrow_verify(func, cand_body, base, pass_cfg,
+                             {b.name for _a, b, _d, _e in cands})
+        if bad is None or "*" in bad:
+            return body
+        if not bad:
+            break
+        cands = [c for c in cands if c[1].name not in bad]
+    if not cands:
+        return body
+    for _astmt, b, new_dt, env in cands:
+        nb = buf_sub[b.uid][0]
+        saved = _buf_bytes(b) - _buf_bytes(nb)
+        res.narrow_buffers += 1
+        res.narrow_bytes += saved
+        res.narrow_proofs.append({
+            "buffer": b.name, "from": b.dtype, "to": new_dt,
+            "interval": [env.slo, env.shi], "err": env.err,
+            "bytes_saved": saved, "verify_rounds": rounds})
+        res.rewrites.append(
+            f"narrow: '{b.name}' {b.dtype} -> {new_dt} (sound interval "
+            f"[{env.slo:.4g}, {env.shi:.4g}], err bound {env.err:.3g} + "
+            f"eps({new_dt}) <= {err_thr:g}, re-verified by dual-track "
+            f"interpretation; {saved}B VMEM saved)")
+    return cand_body
+
+
+# ---------------------------------------------------------------------------
 # repack — realize the TL005 interval packing at the IR level
 # ---------------------------------------------------------------------------
 
@@ -484,6 +935,43 @@ def _kernel_node(body) -> Optional[KernelNode]:
         if isinstance(s, KernelNode):
             return s
     return None
+
+
+def _compat_castable(body) -> set:
+    """Buffer uids that must NOT be given a cast view into a wider
+    slot.  A compat-placed buffer is observed through loads (castable)
+    and written through stores/fills (the rewriter eagerly rounds the
+    value back to the original dtype, so the slot only ever holds
+    exactly-representable values).  Everything else — copy
+    destinations (region writes land raw src values in the slot,
+    breaking the eager-rounding invariant), DMA legs (the DMA engine
+    cannot convert), and whole-buffer / region operands of gemm,
+    reduce, cumsum, atomics, async copies, collectives and prints —
+    has no place to hang the cast, so the buffer keeps its own slot."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    bad: set = set()
+    for s, _c in iter_stmts(body):
+        if isinstance(s, (AllocStmt, BufferStoreStmt, FillStmt,
+                          EvaluateStmt, AssertStmt)):
+            continue
+        if isinstance(s, CopyStmt):
+            bad.add(s.dst.buffer.uid)
+            if s.src.buffer.scope == "global":
+                bad.add(s.dst.buffer.uid)
+            if s.dst.buffer.scope == "global":
+                bad.add(s.src.buffer.uid)
+            continue
+        if isinstance(s, (ReduceStmt, CumSumStmt)):
+            # the SRC is castable: the slot holds exactly-representable
+            # values, and codegen accumulates at the dst dtype either
+            # way — reading them at the slot's wider dtype is
+            # bit-identical to the upcast-before-reduce the narrow
+            # lowering emits.  The dst is a raw accumulator write.
+            bad.add(s.dst.uid)
+            continue
+        for acc in stmt_accesses(s):
+            bad.add(acc.buffer.uid)
+    return bad
 
 
 def _repack(body: SeqStmt, res: TileOptResult) -> SeqStmt:
@@ -557,6 +1045,7 @@ def _repack(body: SeqStmt, res: TileOptResult) -> SeqStmt:
         cands.append((d["first"], b.uid, a, d))
     cands.sort(key=lambda t: (t[0], t[1]))
 
+    no_cast = _compat_castable(body)
     slots: List[dict] = []       # {"rep": Buffer, "last": int}
     buf_sub: BufSub = {}
     drop: set = set()
@@ -566,20 +1055,35 @@ def _repack(body: SeqStmt, res: TileOptResult) -> SeqStmt:
         placed = False
         for slot in slots:
             rep = slot["rep"]
-            if rep.static_shape() == b.static_shape() \
-                    and rep.dtype == b.dtype and rep.scope == b.scope \
-                    and slot["last"] < d["first"]:
-                buf_sub[b.uid] = (rep, None)
-                drop.add(id(astmt))
-                slot["last"] = d["last"]
-                saved += _buf_bytes(b)
-                res.repack_buffers += 1
+            if rep.static_shape() != b.static_shape() \
+                    or rep.scope != b.scope or slot["last"] >= d["first"]:
+                continue
+            if rep.dtype == b.dtype:
+                buf_sub[b.uid] = (rep, None, None)
                 res.rewrites.append(
                     f"repack: '{b.name}' shares the VMEM slot of "
                     f"'{rep.name}' (disjoint lifetimes, "
                     f"{_buf_bytes(b)}B saved)")
-                placed = True
-                break
+            elif _exact_widens(b.dtype, rep.dtype) \
+                    and b.uid not in no_cast:
+                # compat slot: b's dtype exactly widens into rep's, so
+                # b lives in rep's slot behind a cast view — loads
+                # present b.dtype, stores eagerly round back to it
+                buf_sub[b.uid] = (rep, None, b.dtype)
+                res.repack_compat += 1
+                res.rewrites.append(
+                    f"repack: '{b.name}' ({b.dtype}) shares the "
+                    f"compatible {rep.dtype} slot of '{rep.name}' "
+                    f"(exact-widening cast view, disjoint lifetimes, "
+                    f"{_buf_bytes(b)}B saved)")
+            else:
+                continue
+            drop.add(id(astmt))
+            slot["last"] = d["last"]
+            saved += _buf_bytes(b)
+            res.repack_buffers += 1
+            placed = True
+            break
         if not placed:
             slots.append({"rep": b, "last": d["last"]})
 
@@ -696,7 +1200,7 @@ def _dbuf(body: SeqStmt, res: TileOptResult) -> SeqStmt:
                                            "wait"), s)
             copy_repl[ci] = [_keep_loc(prologue, s),
                              _keep_loc(prefetch, s), wait]
-            buf_sub[dstb.uid] = (dst2, lead)
+            buf_sub[dstb.uid] = (dst2, lead, None)
             res.dbuf_chains += 1
             res.rewrites.append(
                 f"dbuf: double-buffered '{dstb.name}' "
@@ -866,6 +1370,37 @@ def _fuse_pair(n1: ForNest, n2: ForNest) -> ForNest:
         0, {**n2.annotations, **n1.annotations}), n1)
 
 
+#: how many already-emitted siblings the interleaved-fusion scan looks
+#: back across when the immediately preceding statement is not a
+#: fusable nest.  Bounded so the disjointness proof obligation (and the
+#: hoisting distance) stays small and reviewable.
+_FUSE_LOOKBACK = 8
+
+
+def _hoist_disjoint(stmt: Stmt, nest: ForNest) -> bool:
+    """May ``nest`` legally hop over ``stmt`` (so it can fuse with an
+    earlier sibling)?  Only when ``stmt``'s whole subtree is free of
+    ordering-sensitive effects (DMA, collectives, prints, asserts,
+    atomics) and shares NO buffer — accessed or allocated — with the
+    nest's body: TL001's access model proves the two command streams
+    commute.  Module-level so the mutation sweep can corrupt it."""
+    from ..analysis.dataflow import iter_stmts, stmt_accesses
+    nest_uids = {a.buffer.uid for st in nest.body.stmts
+                 for a in stmt_accesses(st)}
+    for s, _c in iter_stmts([stmt]):
+        if isinstance(s, (AsyncCopyStmt, CommStmt, PrintStmt,
+                          AssertStmt, AtomicStmt)):
+            return False
+        if isinstance(s, AllocStmt):
+            if s.buffer.uid in nest_uids:
+                return False
+            continue
+        for a in stmt_accesses(s):
+            if a.buffer.uid in nest_uids:
+                return False
+    return True
+
+
 def _fuse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
     def rebuild(stmts) -> List[Stmt]:
         kids: List[Stmt] = []
@@ -900,17 +1435,34 @@ def _fuse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
         return kids
 
     def out_merge(kids: List[Stmt], s: Stmt) -> None:
-        if kids and isinstance(s, ForNest) and \
-                isinstance(kids[-1], ForNest) and _fusable(kids[-1], s):
-            n1 = kids[-1]
-            exts = [as_int(e) for e in n1.extents]
-            kids[-1] = _fuse_pair(n1, s)
-            res.fuse_regions += 1
-            res.rewrites.append(
-                f"fuse: merged adjacent T.Parallel{_fmt_shape(exts)} "
-                f"regions (no cross-region dependency; one vectorized "
-                f"sweep)")
-            return
+        if isinstance(s, ForNest):
+            # scan back over already-emitted siblings: the adjacent
+            # case (back == 1) is PR 11's original fusion; deeper hits
+            # are interleaved fusion, legal only while every hopped
+            # statement is proven disjoint from this nest
+            for back in range(1, min(len(kids), _FUSE_LOOKBACK) + 1):
+                cand = kids[-back]
+                if isinstance(cand, ForNest) and _fusable(cand, s):
+                    exts = [as_int(e) for e in cand.extents]
+                    kids[-back] = _fuse_pair(cand, s)
+                    res.fuse_regions += 1
+                    if back > 1:
+                        res.fuse_interleaved += 1
+                        res.rewrites.append(
+                            f"fuse: merged T.Parallel{_fmt_shape(exts)} "
+                            f"regions interleaved across {back - 1} "
+                            f"disjoint statement(s) (all hopped "
+                            f"statements touch provably unrelated "
+                            f"buffers; one vectorized sweep)")
+                    else:
+                        res.rewrites.append(
+                            f"fuse: merged adjacent "
+                            f"T.Parallel{_fmt_shape(exts)} regions (no "
+                            f"cross-region dependency; one vectorized "
+                            f"sweep)")
+                    return
+                if not _hoist_disjoint(cand, s):
+                    break
         kids.append(s)
 
     return SeqStmt(rebuild(body.stmts))
@@ -922,19 +1474,29 @@ def _fuse(body: SeqStmt, res: TileOptResult) -> SeqStmt:
 
 
 def run_tile_opt(func: PrimFunc, pass_cfg: Optional[dict] = None,
-                 findings: Optional[list] = None):
+                 findings: Optional[list] = None, *,
+                 modes_override: Optional[tuple] = None,
+                 _metrics: bool = True):
     """Run the enabled rewrites over one kernel.
 
     Returns ``(func, result, findings)``: the (possibly rebuilt)
     PrimFunc, the :class:`TileOptResult` accounting, and the lint
     findings with auto-fixed TL006 entries consumed (they are reported
     through the ``tile_opt[...]`` block instead — the finding is fixed,
-    not worth a second warning)."""
+    not worth a second warning).
+
+    ``modes_override`` lets the ``auto`` scheduler in engine/lower run
+    candidate subsets without round-tripping through pass-config
+    strings; candidate probes pass ``_metrics=False`` so only the one
+    authoritative lowering lands in the tracer counters."""
     from ..observability import tracer as _trace
     findings = list(findings or [])
-    modes = tile_opt_modes(pass_cfg)
+    modes = tuple(modes_override) if modes_override is not None \
+        else tile_opt_modes(pass_cfg)
     res = TileOptResult(modes=modes)
-    if not modes:
+    if not modes or "auto" in modes:
+        # "auto" is resolved by engine/lower's cost-model scheduler,
+        # which re-enters with an explicit modes_override
         return func, res, findings
 
     body = func.body if isinstance(func.body, SeqStmt) \
@@ -942,6 +1504,8 @@ def run_tile_opt(func: PrimFunc, pass_cfg: Optional[dict] = None,
     new_body = body
     if "dse" in modes:
         new_body = _dse(new_body, res)
+    if "narrow" in modes:
+        new_body = _narrow(func, new_body, res, pass_cfg)
     if "repack" in modes:
         new_body = _repack(new_body, res)
     if "dbuf" in modes:
@@ -952,24 +1516,42 @@ def run_tile_opt(func: PrimFunc, pass_cfg: Optional[dict] = None,
     if not res.rewrites:
         return func, res, findings
 
-    _trace.inc("opt.kernels")
-    for mode, n in (("dse", res.dse_allocs), ("repack", res.repack_buffers),
-                    ("dbuf", res.dbuf_chains), ("fuse", res.fuse_regions)):
-        if n:
-            _trace.inc("opt.rewrites", n, mode=mode)
-    if res.dse_stores:
-        _trace.inc("opt.dse.stores", res.dse_stores)
-    if res.dse_allocs:
-        _trace.inc("opt.dse.allocs", res.dse_allocs)
-    if res.dse_bytes:
-        _trace.inc("opt.dse.bytes", res.dse_bytes)
-    if res.repack_buffers:
-        _trace.inc("opt.repack.bytes_saved",
-                   res.repack_pre_bytes - res.repack_post_bytes)
-    if res.dbuf_chains:
-        _trace.inc("opt.dbuf.chains", res.dbuf_chains)
-    if res.fuse_regions:
-        _trace.inc("opt.fuse.regions", res.fuse_regions)
+    if _metrics:
+        _trace.inc("opt.kernels")
+        for mode, n in (("dse", res.dse_allocs),
+                        ("narrow", res.narrow_buffers),
+                        ("repack", res.repack_buffers),
+                        ("dbuf", res.dbuf_chains),
+                        ("fuse", res.fuse_regions)):
+            if n:
+                _trace.inc("opt.rewrites", n, mode=mode)
+        if res.dse_stores:
+            _trace.inc("opt.dse.stores", res.dse_stores)
+        if res.dse_allocs:
+            _trace.inc("opt.dse.allocs", res.dse_allocs)
+        if res.dse_bytes:
+            _trace.inc("opt.dse.bytes", res.dse_bytes)
+        if res.narrow_buffers:
+            _trace.inc("opt.narrow.bytes_saved", res.narrow_bytes)
+        if res.repack_buffers:
+            _trace.inc("opt.repack.bytes_saved",
+                       res.repack_pre_bytes - res.repack_post_bytes)
+        if res.repack_compat:
+            _trace.inc("opt.repack.compat", res.repack_compat)
+        if res.dbuf_chains:
+            _trace.inc("opt.dbuf.chains", res.dbuf_chains)
+        if res.fuse_regions:
+            _trace.inc("opt.fuse.regions", res.fuse_regions)
+        if res.fuse_interleaved:
+            _trace.inc("opt.fuse.interleaved", res.fuse_interleaved)
+
+    new_func = PrimFunc(func.name, func.params, new_body,
+                        dict(func.attrs))
+    fixed = {e["buffer"] for e in res.eliminated}
+    findings = [d for d in findings
+                if not (d.rule == "TL006" and d.buffer in fixed)]
+    if not _metrics:
+        return new_func, res, findings
     for e in res.eliminated:
         # bytes here are padded VMEM footprint; comm_opt's dce rows
         # carry ICI wire bytes — the shared counter is labelled by
@@ -977,10 +1559,4 @@ def run_tile_opt(func: PrimFunc, pass_cfg: Optional[dict] = None,
         _trace.inc("opt.eliminated.bytes", e["bytes"], source="tile_opt")
         _trace.event("opt.eliminated", "lower", source="tile_opt",
                      kernel=func.name, **e)
-
-    new_func = PrimFunc(func.name, func.params, new_body,
-                        dict(func.attrs))
-    fixed = {e["buffer"] for e in res.eliminated}
-    findings = [d for d in findings
-                if not (d.rule == "TL006" and d.buffer in fixed)]
     return new_func, res, findings
