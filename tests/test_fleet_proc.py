@@ -1,0 +1,504 @@
+"""fleet-proc suite (docs/serving.md "Process isolation & crash
+containment"): subprocess engine workers behind the checksummed frame
+protocol, SIGKILL-proof zero-loss failover, crash-loop quarantine, and
+graceful drain.
+
+Five layers:
+
+1. **Frame protocol** — encode/decode round-trips are bit-exact;
+   every adversarial frame (truncated, bit-flipped checksum, oversized
+   length prefix, bad magic, header overrun) is a *detected*
+   ``FrameError`` classified ``deterministic``, never a silent desync.
+2. **Wire formats** — a live ``Request`` and a ``KVSnapshot`` survive
+   the pipe byte-conserved; a frame whose body was tampered after the
+   crc was stamped still fails the snapshot's own sha256.
+3. **Process supervision** — a 2-worker proc fleet serves the same
+   tokens the thread fleet does (isolation is behavior-invisible); a
+   real ``SIGKILL`` mid-flight loses nothing, the flight dump names
+   the dead pid + signal, and the victim restarts under a new pid; a
+   torn frame ejects without a worker death; a stalled round-trip
+   trips the step watchdog.
+4. **Crash containment** — more than ``TL_TPU_FLEET_MAX_RESTARTS``
+   deaths inside the window parks the slot (quarantined: no hot
+   restart loop) until ``readmit_slot``; ``shutdown(graceful=True)``
+   drains, flushes, and returns 0.
+5. **Durability + surfaces** — the cache commit fsyncs the file before
+   the rename and the directory after it; the analyzer ``fleet``
+   report renders worker lifetimes, kill->readmit latency, and the
+   ``fleet.ipc.*`` transport counters.
+"""
+
+import functools
+import itertools
+import json
+import os
+import signal
+import struct
+
+import pytest
+
+from tilelang_mesh_tpu import observability as obs
+from tilelang_mesh_tpu.observability import flight as _flight
+from tilelang_mesh_tpu.resilience import inject
+from tilelang_mesh_tpu.resilience.errors import classify
+from tilelang_mesh_tpu.serving import (Fleet, FrameError,
+                                       PagedKVAllocator, Request,
+                                       decode_frame, decode_snapshot,
+                                       default_workload_factory,
+                                       deserialize_request,
+                                       encode_frame, encode_snapshot,
+                                       reset_prefix_cache,
+                                       serialize_request)
+from tilelang_mesh_tpu.serving.ipc import MAGIC
+
+PS = 8
+_seq = itertools.count()
+
+# spawn pickles the factory by reference: module-level partial only
+small_factory = functools.partial(default_workload_factory, n_pages=64)
+
+
+def make_proc_fleet(n_engines=2, **kw):
+    kw.setdefault("name", f"pflt{next(_seq)}")
+    return Fleet(small_factory, n_engines=n_engines, isolation="proc",
+                 **kw)
+
+
+def counters():
+    return obs.get_tracer().counters()
+
+
+# -- 1. frame protocol --------------------------------------------------
+
+def test_frame_roundtrip_bit_exact():
+    header = {"op": "submit", "cid": 7, "args": {"seed": 3, "t": None}}
+    body = bytes(range(256)) * 3
+    frame = encode_frame(header, body)
+    h2, b2 = decode_frame(frame)
+    assert h2 == header
+    assert b2 == body
+    # deterministic encode: the same message is the same bytes
+    assert encode_frame(header, body) == frame
+    # empty body round-trips too
+    assert decode_frame(encode_frame({"op": "ping"})) == \
+        ({"op": "ping"}, b"")
+
+
+def test_frame_adversarial_decode_classified():
+    """Satellite gate: every way a frame can be wrong is a DETECTED,
+    classified failure — never an exception escape, never a silent
+    desync, never an allocation driven by a hostile length prefix."""
+    frame = encode_frame({"op": "step"}, b"x" * 64)
+    adversarial = [
+        frame[: len(frame) // 2],                      # truncated
+        frame[:-10] + bytes([frame[-10] ^ 0x01]) + frame[-9:],  # flip
+        MAGIC + struct.pack("<II", (1 << 32) - 1, 0),  # oversized len
+        b"NOPE" + frame[4:],                           # bad magic
+        b"",                                           # empty
+        encode_frame({"op": "x"})[:len(MAGIC) + 8],    # prefix only
+    ]
+    for bad in adversarial:
+        with pytest.raises(FrameError) as ei:
+            decode_frame(bad)
+        assert classify(ei.value) == "deterministic"
+        assert ei.value.site == "fleet.ipc"
+    # header length that overruns the payload (crc re-stamped so only
+    # the header-length check can reject it)
+    payload = struct.pack("<I", 999) + b"{}"
+    import zlib
+    crafted = MAGIC + struct.pack("<II", len(payload),
+                                  zlib.crc32(payload)) + payload
+    with pytest.raises(FrameError, match="overruns"):
+        decode_frame(crafted)
+    # non-object JSON header
+    hj = b'["not", "a", "dict"]'
+    payload = struct.pack("<I", len(hj)) + hj
+    crafted = MAGIC + struct.pack("<II", len(payload),
+                                  zlib.crc32(payload)) + payload
+    with pytest.raises(FrameError, match="not an object"):
+        decode_frame(crafted)
+
+
+# -- 2. wire formats ----------------------------------------------------
+
+def test_request_wire_roundtrip_bit_exact():
+    req = Request(2 * PS, 4, deadline_ms=5000.0, seed=5,
+                  payload={"k": "v"},
+                  prompt_tokens=list(range(100, 100 + 2 * PS)),
+                  temperature=0.7, top_p=0.9, tenant="acme")
+    req.steps_done = 2
+    req.retries = 1
+    req.generated = [11, 12]
+    wire = serialize_request(req, cid=42)
+    # the image must survive the JSON header of a frame
+    wire = json.loads(json.dumps(wire))
+    assert wire["cid"] == 42
+    assert 0.0 < wire["deadline_ms"] <= 5000.0
+    r2 = deserialize_request(wire)
+    assert r2.context_tokens == req.context_tokens
+    assert r2.new_tokens == req.new_tokens
+    assert r2.prompt_tokens == req.prompt_tokens
+    assert r2.generated == [11, 12]
+    assert r2.steps_done == 2
+    assert r2.retries == 1
+    assert (r2.temperature, r2.top_p) == (0.7, 0.9)
+    assert r2.tenant == "acme"
+    assert r2.seed == 5
+    assert r2.payload["k"] == "v"
+    # the origin trace id rides along for post-mortems
+    assert r2.payload["origin_trace_id"] == req.trace_id
+    # no deadline stays no deadline
+    r3 = deserialize_request(serialize_request(
+        Request(PS, 1, prompt_tokens=list(range(PS))), cid=1))
+    assert r3.deadline is None
+
+
+def test_snapshot_wire_roundtrip_and_tamper():
+    alloc = PagedKVAllocator(n_pages=8, page_size=PS, heads=2,
+                             head_dim=4)
+    pages = alloc.alloc(3, owner=77)
+    alloc.kp[:, pages[0] * PS:(pages[0] + 1) * PS, :] = 1.5
+    alloc.vp[:, pages[1] * PS:(pages[1] + 1) * PS, :] = -2.25
+    snap = alloc.snapshot()
+    frame = encode_snapshot(snap)
+    got = decode_snapshot(frame)
+    assert got.owners == {77: pages}
+    assert got.checksum == snap.checksum
+    import numpy as np
+    for p in pages:
+        np.testing.assert_array_equal(got.pages[p][0], snap.pages[p][0])
+        np.testing.assert_array_equal(got.pages[p][1], snap.pages[p][1])
+    # tamper INSIDE a re-stamped frame: the crc passes, the snapshot's
+    # own sha256 must still catch it
+    header, body = decode_frame(frame)
+    body = bytearray(body)
+    body[len(body) // 2] ^= 0xFF
+    with pytest.raises(FrameError, match="checksum"):
+        decode_snapshot(encode_frame(header, bytes(body)))
+
+
+# -- 3. process supervision ---------------------------------------------
+
+def test_proc_fleet_tokens_match_thread_fleet_and_shutdown(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    prompt = list(range(300, 300 + 2 * PS))
+
+    def drive(fleet):
+        reqs = [fleet.submit(len(prompt), new_tokens=3,
+                             prompt_tokens=list(prompt), seed=10 + i)
+                for i in range(4)]
+        fleet.run()
+        return reqs
+
+    ref = drive(Fleet(small_factory, n_engines=2, isolation="thread",
+                      name=f"tref{next(_seq)}"))
+    fleet = make_proc_fleet(n_engines=2)
+    try:
+        reqs = drive(fleet)
+        assert all(r.outcome == "result" for r in reqs)
+        # isolation is behavior-invisible: same tokens, same outcomes
+        assert [r.generated for r in reqs] == \
+            [r.generated for r in ref]
+        # health names real pids and the isolation mode
+        h = fleet.health()
+        assert h["isolation"] == "proc"
+        for s in fleet.slots:
+            eh = h["engines"][s.name]
+            assert eh["pid"] == s.engine.pid
+            assert eh["alive"] is True
+        assert all(not v for v in fleet.leak_check().values())
+    finally:
+        assert fleet.shutdown(graceful=True) == 0
+        reset_prefix_cache()
+    # after shutdown: admission is closed, terminally (never lost)
+    r = fleet.submit(2 * PS, new_tokens=1, seed=99)
+    assert r.is_terminal and r.outcome == "shed"
+
+
+def test_proc_sigkill_zero_loss_flight_dump_and_new_pid(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    obs.reset()
+    _flight.reset()
+    _flight.configure(dump_dir=tmp_path / "flight")
+    fleet = make_proc_fleet(n_engines=2, restart_base_ms=50.0)
+    try:
+        prompt = [9_000 + i for i in range(2 * PS)]
+        seed_req = fleet.submit(len(prompt), new_tokens=1,
+                                prompt_tokens=list(prompt), seed=1)
+        fleet.run()
+        assert seed_req.outcome == "result"   # prefix published
+        reqs = [fleet.submit(len(prompt), new_tokens=2,
+                             prompt_tokens=list(prompt), seed=2 + i)
+                for i in range(6)]
+        victim = fleet.slots[0]
+        on_victim = [r for r in reqs if r in victim.engine.requests]
+        assert on_victim                      # shadows held supervisor-side
+        pid0 = victim.engine.pid
+        os.kill(pid0, signal.SIGKILL)
+        fleet.step()                          # death detected -> failover
+        assert victim.state == "ejected"
+        assert fleet.failovers == 1
+        fleet.run()
+        assert all(r.outcome == "result" for r in reqs)   # zero loss
+        c = counters()
+        assert c["fleet.worker.death{engine=%s}" % victim.name] == 1
+        assert c.get("fleet.failover.lost", 0) == 0
+        assert c.get("fleet.failover.warm", 0) >= 1   # disk-tier warm
+        # the black box names the dead PROCESS, not just the slot
+        dumps = sorted((tmp_path / "flight").glob("*.jsonl"))
+        assert dumps
+        head = json.loads(dumps[0].read_text().splitlines()[0])
+        assert head["reason"] == "engine_failover"
+        assert head["attrs"]["victim"] == victim.name
+        assert head["attrs"]["pid"] == pid0
+        assert head["attrs"]["signal"] == int(signal.SIGKILL)
+        assert set(head["attrs"]["redispatched_trace_ids"]) == \
+            {r.trace_id for r in on_victim}
+        # the victim restarts as a NEW process and serves again
+        assert fleet.await_readmission(timeout_s=60.0)
+        assert victim.engine.pid != pid0
+        assert c["fleet.worker.death{engine=%s}" % victim.name] == 1
+    finally:
+        fleet.shutdown(graceful=True)
+        _flight.configure(dump_dir=None)
+        _flight.reset()
+        reset_prefix_cache()
+
+
+def test_torn_frame_ejects_without_worker_death(tmp_path, monkeypatch):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    obs.reset()
+    fleet = make_proc_fleet(n_engines=2, restart_base_ms=50.0)
+    try:
+        reqs = [fleet.submit(2 * PS, new_tokens=2, seed=i)
+                for i in range(4)]
+        victim = fleet.slots[0].name
+        with inject("fleet.ipc", kind="torn", times=1):
+            fleet.step()                 # e0 pumps first: frame torn
+        assert fleet.slots[0].state == "ejected"
+        # a torn frame is a TRANSPORT failure: the worker process never
+        # died — no fleet.worker.death, but a deterministic ipc error
+        c = counters()
+        assert c.get("fleet.worker.death{engine=%s}" % victim, 0) == 0
+        assert any("fleet.ipc.errors" in k and "kind=deterministic" in k
+                   and victim in k for k in c)
+        fleet.run()
+        assert all(r.outcome == "result" for r in reqs)   # zero loss
+        assert fleet.await_readmission(timeout_s=60.0)
+    finally:
+        fleet.shutdown(graceful=True)
+        reset_prefix_cache()
+
+
+def test_stalled_roundtrip_trips_step_watchdog(tmp_path, monkeypatch):
+    """The watchdog covers the WHOLE round-trip: a reply that lands
+    past ``TL_TPU_FLEET_STEP_TIMEOUT_MS`` is a timeout ejection even
+    though the worker is alive and eventually answers."""
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    obs.reset()
+    fleet = make_proc_fleet(n_engines=2, step_timeout_ms=2000.0,
+                            restart_base_ms=50.0)
+    try:
+        fleet.warmup()        # keep compile out of the watchdogged step
+        reqs = [fleet.submit(2 * PS, new_tokens=1, seed=i)
+                for i in range(4)]
+        with inject("fleet.ipc", kind="delay", times=1):
+            fleet.step()                 # stalls 2x the watchdog
+        assert fleet.slots[0].state == "ejected"
+        c = counters()
+        assert any("fleet.ipc.errors" in k and "kind=timeout" in k
+                   for k in c)
+        fleet.run()
+        assert all(r.outcome == "result" for r in reqs)
+    finally:
+        fleet.shutdown(graceful=True)
+        reset_prefix_cache()
+
+
+# -- 4. crash containment -----------------------------------------------
+
+def make_thread_fleet(**kw):
+    kw.setdefault("name", f"tflt{next(_seq)}")
+    return Fleet(functools.partial(default_workload_factory,
+                                   n_pages=128),
+                 n_engines=2, isolation="thread", **kw)
+
+
+def test_crash_loop_quarantine_and_manual_readmit(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("TL_TPU_FLEET_MAX_RESTARTS", "1")
+    monkeypatch.setenv("TL_TPU_FLEET_RESTART_WINDOW_S", "60")
+    obs.reset()
+    _flight.reset()
+    _flight.configure(dump_dir=tmp_path / "flight")
+    try:
+        fleet = make_thread_fleet(restart_base_ms=5.0)
+        victim = fleet.slots[0]
+        with inject("serve.engine", kind="unreachable", times=1):
+            fleet.step()
+        assert victim.state == "ejected"      # death 1: normal ejection
+        assert fleet.await_readmission(timeout_s=10.0)
+        with inject("serve.engine", kind="unreachable", times=1):
+            fleet.step()
+        # death 2 > max_restarts inside the window: PARKED, no restart
+        assert victim.state == "quarantined"
+        assert counters()[
+            "fleet.quarantined{engine=%s}" % victim.name] == 1
+        assert victim.name in fleet.health()["quarantined"]
+        dumps = sorted((tmp_path / "flight").glob("*.jsonl"))
+        heads = [json.loads(d.read_text().splitlines()[0])
+                 for d in dumps]
+        assert any(h["reason"] == "crash_loop" for h in heads)
+        # a parked slot takes no traffic and is NOT probed by stepping
+        for i in range(3):
+            fleet.submit(2 * PS, new_tokens=1, seed=i)
+            fleet.step()
+        assert victim.state == "quarantined"
+        assert victim.submitted == 0
+        fleet.run()
+        # the operator override probes NOW and clears the window
+        assert fleet.readmit_slot(victim.name) is True
+        assert victim.state == "live"
+        r = fleet.submit(2 * PS, new_tokens=1, seed=9)
+        fleet.run()
+        assert r.outcome == "result"
+    finally:
+        _flight.configure(dump_dir=None)
+        _flight.reset()
+
+
+def test_graceful_shutdown_drains_flushes_and_exits_zero(monkeypatch,
+                                                         tmp_path):
+    monkeypatch.setenv("TL_TPU_SERVE_PREFIX_DIR", str(tmp_path / "px"))
+    reset_prefix_cache()
+    try:
+        fleet = make_thread_fleet()
+        reqs = [fleet.submit(2 * PS, new_tokens=2, seed=i)
+                for i in range(5)]
+        prev = fleet.install_signal_handler(signal.SIGTERM)
+        try:
+            assert signal.getsignal(signal.SIGTERM) is not prev
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+        assert fleet.shutdown(graceful=True) == 0
+        # drained, not dropped: every in-flight request reached result
+        assert all(r.outcome == "result" for r in reqs)
+        assert fleet.health()["draining"] is True
+        late = fleet.submit(2 * PS, new_tokens=1, seed=77)
+        assert late.is_terminal and late.outcome == "shed"
+    finally:
+        reset_prefix_cache()
+
+
+# -- 5. durability + surfaces -------------------------------------------
+
+def test_atomic_write_fsyncs_file_then_dir(tmp_path, monkeypatch):
+    """Satellite pin: the cache commit is tmp + fsync(file) + rename +
+    fsync(dir) — rename alone only orders the directory entry, and a
+    host crash could surface a committed name over zero-length data."""
+    from tilelang_mesh_tpu.cache.kernel_cache import atomic_write
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    target = tmp_path / "entry.json"
+    atomic_write(target, '{"v": 1}')
+    assert target.read_text() == '{"v": 1}'
+    assert len(synced) >= 2              # file fd, then the parent dir
+    assert not list(tmp_path.glob("*.tmp.*"))
+
+
+def test_atomic_write_failed_fsync_leaves_old_state(tmp_path,
+                                                    monkeypatch):
+    from tilelang_mesh_tpu.cache.kernel_cache import atomic_write
+    target = tmp_path / "entry.json"
+    atomic_write(target, "old")
+
+    def boom(fd):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(os, "fsync", boom)
+    with pytest.raises(OSError, match="disk gone"):
+        atomic_write(target, "new")
+    # the failed commit is invisible: old content, no tmp debris
+    assert target.read_text() == "old"
+    assert not list(tmp_path.glob("*.tmp.*"))
+
+
+def test_flight_dump_commit_is_all_or_nothing(tmp_path):
+    """The torn window, via the existing ``cache.disk.write`` fault
+    site: a failed dump commit leaves NOTHING on disk (no half-written
+    file, no tmp debris) and is non-fatal; the next dump lands whole."""
+    _flight.reset()
+    _flight.configure(dump_dir=tmp_path)
+    try:
+        with inject("cache.disk.write", kind="oserror", times=1):
+            assert _flight.dump("proc_torn_probe", k=1) is None
+        assert list(tmp_path.iterdir()) == []    # nothing committed
+        path = _flight.dump("proc_torn_probe", k=2)
+        assert path is not None and path.exists()
+        head = json.loads(path.read_text().splitlines()[0])
+        assert head["reason"] == "proc_torn_probe"
+        assert not list(tmp_path.glob("*.tmp.*"))
+    finally:
+        _flight.configure(dump_dir=None)
+        _flight.reset()
+
+
+def test_analyzer_fleet_proc_section():
+    from tilelang_mesh_tpu.tools.analyzer import (format_fleet_report,
+                                                  summarize_fleet)
+    records = [
+        {"type": "counter", "name": "fleet.dispatch{engine=f/e0}",
+         "value": 4},
+        {"type": "counter", "name": "fleet.worker.spawn{engine=f/e0}",
+         "value": 2},
+        {"type": "counter", "name": "fleet.worker.death{engine=f/e0}",
+         "value": 1},
+        {"type": "counter", "name": "fleet.quarantined{engine=f/e1}",
+         "value": 1},
+        {"type": "counter", "name": "fleet.ipc.tx{engine=f/e0}",
+         "value": 10},
+        {"type": "counter", "name": "fleet.ipc.rx{engine=f/e0}",
+         "value": 9},
+        {"type": "counter", "name": "fleet.ipc.bytes_tx{engine=f/e0}",
+         "value": 2048},
+        {"type": "counter", "name": "fleet.ipc.bytes_rx{engine=f/e0}",
+         "value": 4096},
+        {"type": "counter",
+         "name": "fleet.ipc.errors{engine=f/e0,kind=device_loss}",
+         "value": 1},
+        {"type": "event", "name": "fleet.worker.spawn",
+         "attrs": {"engine": "f/e0", "pid": 1234}},
+        {"type": "event", "name": "fleet.worker.spawn",
+         "attrs": {"engine": "f/e0", "pid": 1299}},
+        {"type": "event", "name": "fleet.worker.death",
+         "attrs": {"engine": "f/e0", "pid": 1234, "exitcode": -9,
+                   "signal": 9}},
+        {"type": "event", "name": "fleet.readmit",
+         "attrs": {"fleet": "f", "engine": "f/e0", "restarts": 1,
+                   "down_ms": 812.5, "pid": 1299}},
+    ]
+    s = summarize_fleet(records)
+    assert s["worker_spawns"] == {"f/e0": 2}
+    assert s["worker_deaths"] == {"f/e0": 1}
+    assert s["quarantined"] == {"f/e1": 1}
+    assert s["ipc_tx"] == {"f/e0": 10}
+    assert s["ipc_errors"] == {"device_loss": 1}
+    assert s["kill_to_readmit_ms"] == [812.5]
+    assert s["worker_death_events"][0]["pid"] == 1234
+    txt = format_fleet_report(records)
+    assert "process workers (isolation=proc):" in txt
+    assert "f/e0: spawned=2 died=1 pids=[1234, 1299]" in txt
+    assert "pid 1234 died (signal 9)" in txt
+    assert "f/e1: quarantined x1 (crash loop)" in txt
+    assert "kill -> readmit latency: n=1" in txt
+    assert "ipc frames:" in txt
+    assert "tx=10 rx=9 bytes_tx=2048 bytes_rx=4096" in txt
+    assert "errors: device_loss=1" in txt

@@ -100,9 +100,34 @@ def _key_lock(key: str):
 
 
 def _atomic_write(path: Path, text: str) -> None:
+    """tmp + fsync + rename + dir-fsync commit: rename alone only
+    orders the DIRECTORY entry — after a host crash the kernel may
+    surface the committed name over zero-length data (data blocks not
+    yet flushed), a committed-but-empty cache entry. fsync the file
+    before the rename and the parent directory after it, so a crash
+    leaves either the old state or the complete new one."""
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
-    tmp.write_text(text)
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
     os.replace(tmp, path)
+    try:
+        dfd = os.open(path.parent, os.O_RDONLY)
+    except OSError:
+        return      # platform without O_RDONLY dir opens: rename stands
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass        # the durability fsync is best-effort on exotic fs
+    finally:
+        os.close(dfd)
 
 
 # public spelling: the fleet tune cache (autotuner/tune_cache.py) reuses

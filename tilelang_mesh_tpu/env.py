@@ -324,6 +324,36 @@ class Environment:
     # it are down-weighted; 0 falls back to TL_TPU_SERVE_P99_BUDGET_MS
     TL_TPU_FLEET_P99_BUDGET_MS = EnvVar("TL_TPU_FLEET_P99_BUDGET_MS",
                                         0.0, float)
+    # engine isolation (serving/fleet.py; docs/serving.md "Process
+    # isolation & crash containment"): "thread" (default) hosts every
+    # slot in-process exactly as before; "proc" spawns each slot as a
+    # subprocess worker (serving/worker.py) behind the checksummed
+    # frame protocol (serving/ipc.py) so a SIGKILL'd / segfaulted
+    # engine cannot take the supervisor down. Typos raise.
+    TL_TPU_FLEET_ISOLATION = EnvVar("TL_TPU_FLEET_ISOLATION", "thread")
+    # crash-loop quarantine: more than this many slot deaths (pump
+    # deaths + failed probes) within TL_TPU_FLEET_RESTART_WINDOW_S
+    # parks the slot (no hot restart loop); a manual readmit_slot() or
+    # window expiry re-probes it
+    TL_TPU_FLEET_MAX_RESTARTS = EnvVar("TL_TPU_FLEET_MAX_RESTARTS",
+                                       5, int)
+    TL_TPU_FLEET_RESTART_WINDOW_S = EnvVar(
+        "TL_TPU_FLEET_RESTART_WINDOW_S", 30.0, float)
+    # graceful-drain deadline for fleet.shutdown(graceful=True) / the
+    # SIGTERM handler: in-flight work past it is force-retired
+    # (terminal beats lost), then the fleet still exits 0
+    TL_TPU_FLEET_DRAIN_TIMEOUT_MS = EnvVar(
+        "TL_TPU_FLEET_DRAIN_TIMEOUT_MS", 5000.0, float)
+    # IPC round-trip deadline for non-step worker RPCs (submit, adopt,
+    # cancel, health); the per-pump step watchdog stays
+    # TL_TPU_FLEET_STEP_TIMEOUT_MS
+    TL_TPU_FLEET_IPC_TIMEOUT_MS = EnvVar("TL_TPU_FLEET_IPC_TIMEOUT_MS",
+                                         10000.0, float)
+    # hard cap on one IPC frame (decode rejects bigger length prefixes
+    # before allocating — an adversarial/corrupt header cannot OOM the
+    # supervisor)
+    TL_TPU_FLEET_MAX_FRAME_MB = EnvVar("TL_TPU_FLEET_MAX_FRAME_MB",
+                                       64, int)
     # buffer donation for inout params: warm calls whose inout inputs
     # are jax arrays dispatch through jax.jit(donate_argnums=...), so
     # XLA may reuse the input buffer for the aliased output (the caller

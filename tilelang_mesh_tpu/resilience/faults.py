@@ -28,6 +28,9 @@ Grammar (``;``-separated clauses, ``:``-separated fields)::
   ``comm.chunk``/``comm.fused`` the collective interpret path silently
   poisons its wire payload (a compiled-in miscompile, exercising the
   ``TL_TPU_SELFCHECK`` divergence net — parallel/lowering.py).
+  ``torn`` / ``delay`` / ``kill`` are ``fleet.ipc``-specific
+  (serving/worker.py): flip a byte in the next IPC frame, stall the
+  round-trip past the watchdog, or SIGKILL the worker process.
 - ``times`` — inject at most N times, then the clause goes inert.
 
 Tests use the ``inject(...)`` context manager instead of the env var.
@@ -50,7 +53,8 @@ from ..observability import tracer as _trace
 from .errors import InjectedFault
 
 __all__ = ["FAULT_SITES", "FaultSpec", "maybe_fail", "inject",
-           "parse_fault_spec", "active_specs", "CorruptionRequest"]
+           "parse_fault_spec", "active_specs", "CorruptionRequest",
+           "IPCFaultRequest"]
 
 logger = logging.getLogger("tilelang_mesh_tpu.resilience")
 
@@ -76,10 +80,11 @@ FAULT_SITES = (
     "serve.kv",
     "serve.shard",
     "serve.engine",
+    "fleet.ipc",
 )
 
 _KINDS = ("transient", "timeout", "deterministic", "oserror", "corrupt",
-          "unreachable")
+          "unreachable", "torn", "delay", "kill")
 
 
 class CorruptionRequest(Exception):
@@ -93,6 +98,21 @@ class CorruptionRequest(Exception):
     def __init__(self, site: str):
         super().__init__(f"injected torn write at {site}")
         self.site = site
+
+
+class IPCFaultRequest(Exception):
+    """Raised for ``kind=torn`` / ``delay`` / ``kill`` clauses — the
+    ``fleet.ipc`` site (serving/worker.py) catches it and damages its
+    own transport instead of failing: ``torn`` flips a byte inside the
+    next frame (the checksum catches it on decode), ``delay`` stalls
+    the round-trip past the step watchdog, ``kill`` SIGKILLs the
+    worker process mid-RPC (real process death, not a Python
+    exception)."""
+
+    def __init__(self, site: str, mode: str):
+        super().__init__(f"injected ipc fault ({mode}) at {site}")
+        self.site = site
+        self.mode = mode
 
 
 class FaultSpec:
@@ -236,6 +256,8 @@ def maybe_fail(site: str, **ctx) -> None:
                      site, spec.kind, spec.pattern)
         if spec.kind == "corrupt":
             raise CorruptionRequest(site)
+        if spec.kind in ("torn", "delay", "kill"):
+            raise IPCFaultRequest(site, spec.kind)
         raise InjectedFault.as_kind(spec.kind, site)
 
 

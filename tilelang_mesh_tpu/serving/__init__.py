@@ -25,6 +25,10 @@ Multi-engine serving (docs/serving.md "Fleet serving & failover"):
 ``Fleet`` supervises N engines behind the SLO-aware ``Router`` —
 per-engine breakers, half-open restart probes, and zero-loss failover
 that re-dispatches a dead engine's live requests to healthy peers.
+With ``TL_TPU_FLEET_ISOLATION=proc`` (docs/serving.md "Process
+isolation & crash containment") each slot is a subprocess worker
+behind the checksummed frame protocol in ``serving/ipc.py``, and the
+same failover survives a real SIGKILL.
 
 ``serving_state()`` is the live-gauge snapshot
 ``metrics_summary()["serving"]`` embeds (queue depth, KV slab levels);
@@ -38,6 +42,9 @@ from .batcher import (DecodeWorkload, FlashDecodeWorkload,  # noqa: F401
 from .engine import ServingEngine, TokenStream  # noqa: F401
 from .fleet import (EngineSlot, Fleet, fleet_health,  # noqa: F401
                     fleet_slo, registered_fleets)
+from .ipc import (FrameError, decode_frame, decode_snapshot,  # noqa: F401
+                  deserialize_request, encode_frame, encode_snapshot,
+                  max_frame_bytes, serialize_request)
 from .kv_cache import (KVCacheExhausted, KVSnapshot,  # noqa: F401
                        PagedKVAllocator, migrate)
 from .mesh_workload import (LAYOUT_KINDS, MeshDecodeWorkload,  # noqa: F401
@@ -51,6 +58,8 @@ from .request import (OUTCOMES, Request, SHED_REASONS, STATES,  # noqa: F401
 from .router import Router, fleet_sig, fleet_p99_budget_ms  # noqa: F401
 from .sampling import sample_token  # noqa: F401
 from .shard import ServeShardConfig, match_partition_rules  # noqa: F401
+from .worker import (ProcEngine, default_workload_factory,  # noqa: F401
+                     worker_main)
 
 __all__ = [
     "ServingEngine", "TokenStream", "DecodeWorkload",
@@ -67,4 +76,8 @@ __all__ = [
     "Fleet", "EngineSlot", "Router", "fleet_sig",
     "fleet_p99_budget_ms", "fleet_health", "fleet_slo",
     "registered_fleets",
+    "FrameError", "encode_frame", "decode_frame", "max_frame_bytes",
+    "encode_snapshot", "decode_snapshot", "serialize_request",
+    "deserialize_request", "ProcEngine", "worker_main",
+    "default_workload_factory",
 ]

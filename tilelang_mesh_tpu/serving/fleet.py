@@ -48,12 +48,25 @@ Drive it exactly like one engine: ``submit``/``stream``/``step``/
 ``run``/``drain`` (deterministic, what tests and the ``--fleet`` chaos
 soak use), or ``start()``/``stop()`` to host each engine on its own
 daemon pump thread.
+
+``TL_TPU_FLEET_ISOLATION=proc`` (fleet-proc) swaps each slot's
+in-process engine for a subprocess worker behind a checksummed frame
+protocol (serving/worker.py, serving/ipc.py) — same state machine, but
+deaths are real: SIGKILL, non-zero exits, and torn frames classify
+through the TLError taxonomy and eject within one fleet step; a
+crash-looping slot (> ``TL_TPU_FLEET_MAX_RESTARTS`` deaths within
+``TL_TPU_FLEET_RESTART_WINDOW_S``) is quarantined rather than hot-
+restarted; ``shutdown(graceful=True)`` / ``install_signal_handler()``
+give the SIGTERM drain path. Default ``thread`` keeps today's behavior
+byte-for-byte.
 """
 
 from __future__ import annotations
 
 import logging
 import math
+import signal as _signal
+import sys
 import threading
 import time
 import weakref
@@ -102,7 +115,7 @@ class EngineSlot:
     __slots__ = ("index", "name", "engine", "state", "backoff_ms",
                  "restart_due", "restarts", "consecutive_failures",
                  "last_step_failures", "submitted", "shed",
-                 "last_tick")
+                 "last_tick", "death_times", "quarantined_t", "died_t")
 
     def __init__(self, index: int, name: str):
         self.index = index
@@ -117,6 +130,9 @@ class EngineSlot:
         self.submitted = 0                # per-slot tallies feeding the
         self.shed = 0                     # router's per-engine SLO
         self.last_tick = 0.0
+        self.death_times: List[float] = []   # crash-loop window
+        self.quarantined_t = 0.0
+        self.died_t = 0.0                    # for kill->readmit latency
 
 
 class Fleet:
@@ -133,7 +149,16 @@ class Fleet:
                  restart_max_ms: Optional[float] = None,
                  step_timeout_ms: Optional[float] = None,
                  probe_deadline_ms: float = 5000.0,
+                 isolation: Optional[str] = None,
+                 worker_env: Optional[dict] = None,
                  name: str = "fleet"):
+        self.isolation = (isolation if isolation is not None
+                          else env.TL_TPU_FLEET_ISOLATION)
+        if self.isolation not in ("thread", "proc"):
+            raise ValueError(
+                f"TL_TPU_FLEET_ISOLATION={self.isolation!r} "
+                f"(want 'thread' or 'proc')")
+        self.worker_env = dict(worker_env or {})
         self.workload_factory = workload_factory
         self.n_engines = (n_engines if n_engines is not None
                           else env.TL_TPU_FLEET_ENGINES)
@@ -171,9 +196,17 @@ class Fleet:
         # backoff is deliberately NOT touched here: only a PASSED probe
         # resets it to base — a rebuild that fails its probe must keep
         # doubling
-        wl = self.workload_factory()
-        slot.engine = ServingEngine(wl, name=slot.name,
-                                    **self.engine_kwargs)
+        if self.isolation == "proc":
+            from .worker import ProcEngine
+            slot.engine = ProcEngine(
+                self.workload_factory, name=slot.name,
+                engine_kwargs=self.engine_kwargs,
+                extra_env=self.worker_env,
+                step_timeout_ms=self.step_timeout_ms)
+        else:
+            wl = self.workload_factory()
+            slot.engine = ServingEngine(wl, name=slot.name,
+                                        **self.engine_kwargs)
         slot.state = "live"
         slot.consecutive_failures = 0
         slot.last_step_failures = 0
@@ -267,6 +300,7 @@ class Fleet:
             progressed = False
             now = time.monotonic()
             for slot in self.slots:
+                self._maybe_release_quarantine(slot, now)
                 if slot.state == "ejected" and slot.engine is None \
                         and now >= slot.restart_due:
                     self._probe(slot)
@@ -276,13 +310,28 @@ class Fleet:
                     progressed |= self._pump(slot)
             return progressed
 
+    def _maybe_release_quarantine(self, slot: EngineSlot,
+                                  now: float) -> None:
+        """A quarantined slot re-enters the probe path once the crash
+        window has fully aged out (or via ``readmit_slot``)."""
+        if slot.state != "quarantined":
+            return
+        if now - slot.quarantined_t >= env.TL_TPU_FLEET_RESTART_WINDOW_S:
+            slot.state = "ejected"
+            slot.restart_due = now
+            slot.death_times = []
+
     def _pump(self, slot: EngineSlot) -> bool:
         eng = slot.engine
         base_failures = eng.step_failures
         t0 = time.perf_counter()
         try:
             _faults.maybe_fail("serve.engine", engine=slot.name)
-            if self.step_timeout_ms > 0:
+            # a ProcEngine enforces the watchdog inside its own recv
+            # loop — wrapping the RPC in _bounded_step would leave a
+            # late reply to poison the channel's next round-trip
+            if self.step_timeout_ms > 0 \
+                    and not getattr(eng, "native_watchdog", False):
                 progressed = _bounded_step(
                     eng.step, self.step_timeout_ms / 1e3,
                     f"{slot.name} pump")
@@ -328,13 +377,31 @@ class Fleet:
         restart scheduled with the slot's current backoff."""
         eng = slot.engine
         self._failovers += 1
+        now = time.monotonic()
         slot.state = "ejected"
         slot.engine = None
+        slot.died_t = now
+        slot.death_times.append(now)
         self.router.force_open(slot.name)
         err = f"{type(exc).__name__}: {exc}"
+        # proc isolation: the death has a PID, an exit signal, and a
+        # stderr stream — all of it belongs in the flight dump
+        proc_attrs: dict = {}
+        if eng is not None and hasattr(eng, "proc"):
+            info = getattr(eng, "death_info", None) or {}
+            proc_attrs = {
+                "pid": info.get("pid", getattr(eng, "pid", None)),
+                "exitcode": info.get("exitcode"),
+                "signal": info.get("signal"),
+                "stderr_tail": (info.get("stderr_tail")
+                                or eng._stderr_tail()),
+            }
         _trace.inc("fleet.failover", engine=slot.name)
         _trace.event("fleet.failover", "fleet", fleet=self.name,
-                     engine=slot.name, error=err)
+                     engine=slot.name, error=err,
+                     **({"pid": proc_attrs.get("pid"),
+                         "signal": proc_attrs.get("signal")}
+                        if proc_attrs else {}))
         victims = eng.export_inflight() if eng is not None else []
         redispatched, warm, lost = [], 0, 0
         for r in victims:
@@ -361,13 +428,67 @@ class Fleet:
         _flight.dump("engine_failover", fleet=self.name,
                      victim=slot.name, error=err,
                      redispatched_trace_ids=redispatched,
-                     warm_restores=warm, shed_unroutable=lost)
+                     warm_restores=warm, shed_unroutable=lost,
+                     **proc_attrs)
+        # the dead worker process (if any) must not linger: a torn
+        # frame ejects a still-alive worker, and its half of the pipe
+        # is unrecoverable — the probe builds a fresh one
+        if eng is not None and callable(getattr(eng, "close", None)):
+            try:
+                eng.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.debug("worker close failed", exc_info=True)
+        window = env.TL_TPU_FLEET_RESTART_WINDOW_S
+        slot.death_times = [t for t in slot.death_times
+                            if now - t <= window]
+        if len(slot.death_times) > env.TL_TPU_FLEET_MAX_RESTARTS:
+            self._quarantine(slot, err, window)
+            return
         slot.restart_due = time.monotonic() + slot.backoff_ms / 1e3
         logger.warning(
             "fleet %s: engine %s died (%s); %d request(s) re-dispatched "
             "(%d warm), %d shed, restart in %.0fms", self.name,
             slot.name, err, len(redispatched), warm, lost,
             slot.backoff_ms)
+
+    def _quarantine(self, slot: EngineSlot, err: str,
+                    window: float) -> None:
+        """Crash-loop containment: a slot that keeps dying inside the
+        restart window is PARKED — no hot restart loop burning CPU —
+        until the window ages out or an operator calls
+        ``readmit_slot``. Its traffic sheds to peers (the breaker is
+        already forced open)."""
+        slot.state = "quarantined"
+        slot.quarantined_t = time.monotonic()
+        deaths = len(slot.death_times)
+        _trace.inc("fleet.quarantined", engine=slot.name)
+        _trace.event("fleet.quarantined", "fleet", fleet=self.name,
+                     engine=slot.name, deaths_in_window=deaths,
+                     window_s=window, error=err)
+        _flight.dump("crash_loop", fleet=self.name, engine=slot.name,
+                     deaths_in_window=deaths, window_s=window,
+                     max_restarts=env.TL_TPU_FLEET_MAX_RESTARTS,
+                     last_error=err)
+        logger.error(
+            "fleet %s: engine %s QUARANTINED after %d death(s) within "
+            "%.0fs (%s)", self.name, slot.name, deaths, window, err)
+
+    def readmit_slot(self, name: str) -> bool:
+        """Operator override for a quarantined slot: clear the crash
+        window and run the half-open probe NOW. True if the slot came
+        back live."""
+        with self._lock:
+            slot = self._slot_by_name(name)
+            if slot.state != "quarantined":
+                return slot.state == "live"
+            slot.state = "ejected"
+            slot.death_times = []
+            slot.backoff_ms = self.restart_base_ms
+            slot.restart_due = time.monotonic()
+            _trace.event("fleet.readmit_manual", "fleet",
+                         fleet=self.name, engine=name)
+            self._probe(slot)
+            return slot.state == "live"
 
     def _probe(self, slot: EngineSlot) -> None:
         """Half-open: rebuild the engine from the factory, re-warm, and
@@ -414,9 +535,13 @@ class Fleet:
             slot.backoff_ms = self.restart_base_ms
             slot.restarts += 1
             self.router.reset(slot.name)
+            down_ms = (round((time.monotonic() - slot.died_t) * 1e3, 1)
+                       if slot.died_t else None)
             _trace.inc("fleet.readmit", engine=slot.name)
             _trace.event("fleet.readmit", "fleet", fleet=self.name,
-                         engine=slot.name, restarts=slot.restarts)
+                         engine=slot.name, restarts=slot.restarts,
+                         down_ms=down_ms,
+                         pid=getattr(slot.engine, "pid", None))
             logger.info("fleet %s: engine %s re-admitted after probe "
                         "(restart #%d)", self.name, slot.name,
                         slot.restarts)
@@ -513,6 +638,7 @@ class Fleet:
     def _host(self, slot: EngineSlot) -> None:
         while not self._stop_evt.is_set():
             with self._lock:
+                self._maybe_release_quarantine(slot, time.monotonic())
                 if slot.state == "ejected" and slot.engine is None \
                         and time.monotonic() >= slot.restart_due:
                     self._probe(slot)
@@ -526,6 +652,71 @@ class Fleet:
         for t in self._threads:
             t.join(timeout=timeout_s)
         self._threads = []
+
+    # -- lifecycle -----------------------------------------------------
+    def shutdown(self, graceful: bool = True,
+                 timeout_ms: Optional[float] = None) -> int:
+        """Orderly fleet teardown (what the SIGTERM handler runs):
+        stop admission (new submissions shed ``draining``), finish
+        in-flight work under the ``TL_TPU_FLEET_DRAIN_TIMEOUT_MS``
+        deadline, force-retire anything still pending (all-terminal
+        beats a hung exit), flush the prefix cache's pending disk
+        publications, and tear down worker processes. Returns 0 — the
+        exit status the signal handler propagates."""
+        timeout_ms = (timeout_ms if timeout_ms is not None
+                      else env.TL_TPU_FLEET_DRAIN_TIMEOUT_MS)
+        self.drain()
+        deadline = time.monotonic() + timeout_ms / 1e3
+        if graceful:
+            bound = self.pump_bound()
+            pumps = 0
+            while time.monotonic() < deadline and pumps < bound:
+                if not self.step():
+                    break
+                pumps += 1
+        with self._lock:
+            for slot in self.slots:
+                if slot.engine is not None:
+                    slot.engine.run(max_steps=0)   # force-retire
+            try:
+                from .prefix_cache import get_prefix_cache
+                get_prefix_cache().flush()
+            except Exception:  # noqa: BLE001 — flush is best-effort
+                logger.debug("prefix flush on shutdown failed",
+                             exc_info=True)
+            for slot in self.slots:
+                eng = slot.engine
+                if eng is not None \
+                        and callable(getattr(eng, "close", None)):
+                    try:
+                        eng.close(graceful=graceful)
+                    except Exception:  # noqa: BLE001
+                        logger.debug("worker close failed",
+                                     exc_info=True)
+                    slot.engine = None
+                    slot.state = "ejected"
+        self.stop()
+        _trace.event("fleet.shutdown", "fleet", fleet=self.name,
+                     graceful=graceful)
+        logger.info("fleet %s: shutdown complete (graceful=%s)",
+                    self.name, graceful)
+        return 0
+
+    def install_signal_handler(self,
+                               signum: int = _signal.SIGTERM):
+        """Install the graceful-drain SIGTERM handler: shed new
+        admissions, drain under the deadline, flush, exit 0. Returns
+        the previous handler (callers restore it in tests)."""
+        prev = _signal.getsignal(signum)
+
+        def _handler(sig, frame):  # noqa: ARG001
+            logger.warning("fleet %s: signal %d — graceful shutdown",
+                           self.name, sig)
+            rc = self.shutdown(graceful=True)
+            sys.exit(rc)
+
+        _signal.signal(signum, _handler)
+        return prev
 
     # -- accounting ----------------------------------------------------
     @property
@@ -560,20 +751,28 @@ class Fleet:
     def health(self) -> dict:
         """The fleet section of ``/healthz``: per-slot supervision
         state fused with the router's windowed health."""
+        engines = {}
+        for s in self.slots:
+            h = dict(self.router.health(s.name),
+                     state=s.state,
+                     queue_depth=(s.engine.queue_depth
+                                  if s.engine is not None else 0),
+                     restarts=s.restarts,
+                     backoff_ms=s.backoff_ms)
+            if s.engine is not None \
+                    and callable(getattr(s.engine, "proc_health",
+                                         None)):
+                h.update(s.engine.proc_health())
+            engines[s.name] = h
         return {
             "fleet": self.name,
+            "isolation": self.isolation,
             "draining": self._draining,
             "failovers": self._failovers,
             "requests": len(self.requests),
-            "engines": {
-                s.name: dict(self.router.health(s.name),
-                             state=s.state,
-                             queue_depth=(s.engine.queue_depth
-                                          if s.engine is not None
-                                          else 0),
-                             restarts=s.restarts,
-                             backoff_ms=s.backoff_ms)
-                for s in self.slots},
+            "quarantined": [s.name for s in self.slots
+                            if s.state == "quarantined"],
+            "engines": engines,
         }
 
     def stats(self) -> dict:

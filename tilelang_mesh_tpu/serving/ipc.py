@@ -1,0 +1,235 @@
+"""Frame protocol for the process-isolated fleet (fleet-proc).
+
+Every message between the fleet supervisor and a subprocess engine
+worker (serving/worker.py) is ONE length-prefixed, checksummed frame::
+
+    MAGIC  b"TLF1"
+    u32    payload length          (little-endian)
+    u32    crc32(payload)
+    payload = u32 header length | header JSON (utf-8) | binary body
+
+The JSON header carries the RPC op and its scalar arguments; the body
+carries bulk bytes (KV pages). The crc makes a torn or bit-flipped
+frame a *detected* failure (:class:`FrameError`, ``deterministic`` in
+the TLError taxonomy) instead of a silent desync, and the length cap
+(``TL_TPU_FLEET_MAX_FRAME_MB``) rejects an adversarial/corrupt length
+prefix before allocating. The pipe itself (``multiprocessing``
+``Connection``) is message-oriented, so one bad frame never shifts the
+boundary of the next — the supervisor classifies, ejects the worker,
+and keeps serving.
+
+Request and KVSnapshot wire formats live here too, so the fleet's
+export/adopt failover and the prefix-tier warm restores cross the
+process boundary in exactly the byte-conserved, checksummed shapes the
+in-process paths already audit: ``encode_snapshot`` ships an
+allocator's pages as raw little-endian bytes under the snapshot's own
+sha256 (``KVSnapshot.verify`` re-checks it on the far side), and
+``serialize_request``/``deserialize_request`` round-trip a live
+request bit-exactly (prompt token ids, sampled tokens, tenant tag,
+sampling knobs — the fleet-proc test suite gates on equality).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..env import env
+from ..resilience.errors import TLError
+
+__all__ = ["MAGIC", "FrameError", "encode_frame", "decode_frame",
+           "max_frame_bytes", "encode_snapshot", "decode_snapshot",
+           "serialize_request", "deserialize_request"]
+
+MAGIC = b"TLF1"
+_PREFIX = struct.Struct("<II")        # payload length, crc32(payload)
+_HLEN = struct.Struct("<I")           # header length inside the payload
+
+
+class FrameError(TLError):
+    """A frame failed validation (bad magic, oversized or short length,
+    checksum mismatch, unparsable header). Deterministic: resending the
+    same bytes cannot help — the supervisor ejects the worker and lets
+    the restart probe re-establish the channel."""
+    kind = "deterministic"
+
+    def __init__(self, message: str):
+        super().__init__(message, site="fleet.ipc")
+
+
+def max_frame_bytes() -> int:
+    return max(1, int(env.TL_TPU_FLEET_MAX_FRAME_MB)) << 20
+
+
+def encode_frame(header: dict, body: bytes = b"") -> bytes:
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    payload = _HLEN.pack(len(hjson)) + hjson + bytes(body)
+    return MAGIC + _PREFIX.pack(len(payload), zlib.crc32(payload)) \
+        + payload
+
+
+def decode_frame(data: bytes) -> Tuple[dict, bytes]:
+    """Validate and split one frame into ``(header, body)``. Raises
+    :class:`FrameError` on every way a frame can be wrong; never
+    allocates for a length the cap rejects."""
+    data = bytes(data)
+    head = len(MAGIC) + _PREFIX.size
+    if len(data) < head:
+        raise FrameError(f"truncated frame: {len(data)} byte(s), "
+                         f"need >= {head} for the prefix")
+    if data[:len(MAGIC)] != MAGIC:
+        raise FrameError(f"bad magic {data[:len(MAGIC)]!r} "
+                         f"(want {MAGIC!r})")
+    length, crc = _PREFIX.unpack_from(data, len(MAGIC))
+    if length > max_frame_bytes():
+        raise FrameError(f"oversized length prefix {length} "
+                         f"(cap {max_frame_bytes()} bytes)")
+    payload = data[head:]
+    if len(payload) != length:
+        raise FrameError(f"length mismatch: prefix says {length}, "
+                         f"payload has {len(payload)} byte(s)")
+    if zlib.crc32(payload) != crc:
+        raise FrameError("checksum mismatch: frame corrupted in "
+                         "transit (torn write or bit flip)")
+    if length < _HLEN.size:
+        raise FrameError(f"payload too short for a header length "
+                         f"({length} byte(s))")
+    (hlen,) = _HLEN.unpack_from(payload, 0)
+    if _HLEN.size + hlen > length:
+        raise FrameError(f"header length {hlen} overruns the payload "
+                         f"({length} byte(s))")
+    try:
+        header = json.loads(payload[_HLEN.size:_HLEN.size + hlen]
+                            .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"unparsable frame header: {e}") from None
+    if not isinstance(header, dict):
+        raise FrameError(f"frame header is {type(header).__name__}, "
+                         f"not an object")
+    return header, payload[_HLEN.size + hlen:]
+
+
+# -- KVSnapshot wire format ------------------------------------------------
+def encode_snapshot(snap) -> bytes:
+    """One frame holding a whole :class:`~.kv_cache.KVSnapshot`: the
+    header carries geometry + owners + the snapshot's own sha256, the
+    body the pages' K then V bytes in sorted page order. The snapshot
+    format stays byte-conserved: ``decode_snapshot`` re-verifies the
+    sha256 over exactly the bytes that crossed the pipe."""
+    pages = sorted(snap.pages)
+    chunks = []
+    for p in pages:
+        k, v = snap.pages[p]
+        chunks.append(np.ascontiguousarray(k).tobytes())
+        chunks.append(np.ascontiguousarray(v).tobytes())
+    header = {
+        "kind": "kv_snapshot",
+        "page_size": snap.page_size,
+        "heads": snap.heads,
+        "head_dim": snap.head_dim,
+        "dtype": np.dtype(snap.dtype).str,
+        "owners": {str(o): list(ps) for o, ps in snap.owners.items()},
+        "pages": pages,
+        "checksum": snap.checksum,
+        "nbytes": snap.nbytes,
+    }
+    return encode_frame(header, b"".join(chunks))
+
+
+def decode_snapshot(frame: bytes):
+    """Decode + checksum-verify a snapshot frame back into a
+    :class:`~.kv_cache.KVSnapshot` (fresh, unconsumed). Raises
+    :class:`FrameError` if the page bytes do not hash to the shipped
+    checksum — a corrupt restore must never reach an allocator."""
+    from .kv_cache import KVSnapshot
+    header, body = decode_frame(frame)
+    if header.get("kind") != "kv_snapshot":
+        raise FrameError(f"not a kv_snapshot frame: "
+                         f"kind={header.get('kind')!r}")
+    dtype = np.dtype(header["dtype"])
+    shape = (int(header["heads"]), int(header["page_size"]),
+             int(header["head_dim"]))
+    per = int(np.prod(shape)) * dtype.itemsize
+    page_ids = [int(p) for p in header["pages"]]
+    if len(body) != 2 * per * len(page_ids):
+        raise FrameError(
+            f"snapshot body has {len(body)} byte(s), geometry wants "
+            f"{2 * per * len(page_ids)} for {len(page_ids)} page(s)")
+    pages: Dict[int, tuple] = {}
+    off = 0
+    for p in page_ids:
+        k = np.frombuffer(body, dtype, count=per // dtype.itemsize,
+                          offset=off).reshape(shape).copy()
+        off += per
+        v = np.frombuffer(body, dtype, count=per // dtype.itemsize,
+                          offset=off).reshape(shape).copy()
+        off += per
+        pages[p] = (k, v)
+    snap = KVSnapshot(
+        page_size=int(header["page_size"]), heads=int(header["heads"]),
+        head_dim=int(header["head_dim"]), dtype=dtype,
+        owners={int(o): [int(p) for p in ps]
+                for o, ps in header["owners"].items()},
+        pages=pages, checksum=str(header["checksum"]),
+        nbytes=int(header["nbytes"]))
+    try:
+        snap.verify()
+    except ValueError as e:
+        raise FrameError(f"snapshot failed checksum after transport: "
+                         f"{e}") from None
+    return snap
+
+
+# -- Request wire format ---------------------------------------------------
+def serialize_request(req, cid: int,
+                      now: Optional[float] = None) -> dict:
+    """The JSON-safe image of one live request the supervisor ships to
+    a worker (submit, adopt). ``cid`` is the supervisor-side
+    correlation id; the deadline travels as *remaining* milliseconds so
+    it survives a clock domain it cannot compare against."""
+    remaining = req.remaining_s(now)
+    return {
+        "cid": int(cid),
+        "context_tokens": req.context_tokens,
+        "new_tokens": req.new_tokens,
+        "deadline_ms": (None if remaining is None
+                        else max(0.0, remaining * 1e3)),
+        "seed": req.seed,
+        "payload": dict(req.payload),
+        "prompt_tokens": [int(t) for t in req.prompt_tokens],
+        "temperature": req.temperature,
+        "top_p": req.top_p,
+        "tenant": req.tenant,
+        "steps_done": req.steps_done,
+        "retries": req.retries,
+        "generated": [int(t) for t in req.generated],
+        "trace_id": req.trace_id,
+    }
+
+
+def deserialize_request(d: dict):
+    """Rebuild a :class:`~.request.Request` from its wire image (the
+    worker side of submit/adopt). Progress fields (``steps_done``,
+    ``generated``, ``retries``) are restored so ``adopt()`` replays
+    sampled tokens content-derived, exactly as the in-process failover
+    does; the origin trace id rides in ``payload`` for post-mortems."""
+    from .request import Request
+    req = Request(int(d["context_tokens"]), int(d["new_tokens"]),
+                  deadline_ms=d.get("deadline_ms"),
+                  seed=int(d.get("seed", 0)),
+                  payload=dict(d.get("payload") or {}),
+                  prompt_tokens=[int(t) for t in d["prompt_tokens"]],
+                  temperature=float(d.get("temperature", 0.0)),
+                  top_p=float(d.get("top_p", 1.0)),
+                  tenant=d.get("tenant"))
+    req.steps_done = int(d.get("steps_done", 0))
+    req.retries = int(d.get("retries", 0))
+    req.generated = [int(t) for t in d.get("generated", [])]
+    origin = d.get("trace_id")
+    if origin:
+        req.payload.setdefault("origin_trace_id", origin)
+    return req

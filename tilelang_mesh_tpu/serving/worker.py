@@ -1,0 +1,904 @@
+"""Subprocess engine workers for the process-isolated fleet.
+
+``TL_TPU_FLEET_ISOLATION=proc`` turns every fleet slot into a real OS
+process: :func:`worker_main` (the child) hosts one ordinary
+``ServingEngine`` behind the checksummed frame protocol
+(serving/ipc.py), and :class:`ProcEngine` (the supervisor side)
+duck-types the exact engine surface ``serving/fleet.py`` drives —
+``submit`` / ``step`` / ``adopt`` / ``export_inflight`` / ``cancel`` /
+``drain`` / ``warmup`` / ``outcomes`` / ``step_failures`` — so the
+fleet's LIVE→EJECTED→HALF_OPEN→LIVE supervision runs unchanged over
+processes it can actually lose.
+
+The zero-loss design point: the supervisor holds a **shadow request**
+(a real :class:`Request`) for everything it submitted, synced by
+per-step state deltas off the wire. A SIGKILL'd worker can never
+answer an ``export_inflight`` RPC — so the shadows, not the worker,
+are the source of truth at failover: the fleet exports the shadows,
+re-routes them to healthy peers, and the adopting *worker* re-derives
+their KV content-addressed (warm from the shared disk prefix tier
+where a whole-page prefix was published — the disk tier is the
+cross-process transport, so a warm restore survives the death of the
+process that wrote it). Sampled tokens ride the shadow, so a
+mid-stream ``TokenStream`` keeps yielding across the kill.
+
+Liveness is real-process liveness: every RPC round-trip doubles as a
+heartbeat, the recv loop polls the child's aliveness (waitpid via
+``Process.is_alive``) so SIGKILL mid-RPC is detected immediately and
+classified ``device_loss``; a round-trip past the watchdog
+(``TL_TPU_FLEET_STEP_TIMEOUT_MS``) is a ``timeout``; a torn frame is a
+deterministic :class:`~.ipc.FrameError`. All three eject the slot
+through the same ``_fail_engine`` path as a thread-mode death.
+
+Workers re-record nothing in the supervisor's telemetry — the
+supervisor re-records ``serve.*`` accounting itself as deltas apply,
+so fleet-wide counters / ``serve.e2e.latency`` audits hold without a
+cross-process metrics bus. Worker stderr is redirected to a per-slot
+file whose tail lands in the ``engine_failover`` flight dump.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import signal as _signal
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from ..env import env
+from ..observability import histogram as _hist
+from ..observability import tracer as _trace
+from ..resilience import faults as _faults
+from ..resilience.errors import (DeviceLossError, TLError,
+                                 TLTimeoutError, classify)
+from .ipc import (FrameError, decode_frame, encode_frame,
+                  serialize_request)
+from .request import Request
+
+__all__ = ["ProcEngine", "worker_main", "default_workload_factory"]
+
+logger = logging.getLogger("tilelang_mesh_tpu.serving")
+
+# generous deadline for the first (hello) frame: the child pays the
+# interpreter + package import bill before it can speak
+_SPAWN_DEADLINE_S = 120.0
+_WARMUP_DEADLINE_S = 300.0
+
+
+def default_workload_factory(n_pages: int = 64, page_size: int = 8,
+                             heads: int = 2, head_dim: int = 64,
+                             batch_buckets=(4,), page_buckets=(2, 4)):
+    """A module-level (so picklable across the ``spawn`` boundary)
+    workload factory: ``functools.partial`` over it parameterizes
+    geometry for tests, docs snippets, and the ``--fleet-proc`` soak —
+    closures cannot cross ``multiprocessing`` spawn."""
+    from .batcher import FlashDecodeWorkload
+    from .kv_cache import PagedKVAllocator
+    alloc = PagedKVAllocator(n_pages=n_pages, page_size=page_size,
+                             heads=heads, head_dim=head_dim)
+    return FlashDecodeWorkload(alloc, batch_buckets=tuple(batch_buckets),
+                               page_buckets=tuple(page_buckets))
+
+
+# -- child side ------------------------------------------------------------
+def _flush_prefix() -> None:
+    """Publish pending prefix-cache disk writes after every scheduling
+    quantum: the disk tier is the fleet's cross-process warm-restore
+    transport, so a worker's cached prefixes must survive its death
+    with at most one step of lag."""
+    try:
+        from .prefix_cache import get_prefix_cache
+        get_prefix_cache().flush()
+    except Exception:  # noqa: BLE001 — publication must not kill a step
+        logger.debug("worker prefix flush failed", exc_info=True)
+
+
+class _WorkerLoop:
+    """The child's RPC dispatcher: one ``ServingEngine``, a cid → local
+    request map, and per-cid sync markers so each reply carries only
+    the state that changed."""
+
+    def __init__(self, conn, eng):
+        self.conn = conn
+        self.eng = eng
+        self.reqs: Dict[int, Request] = {}
+        self.synced: Dict[int, tuple] = {}
+
+    def _register(self, cid: int, req: Request) -> None:
+        self.reqs[cid] = req
+        # baseline at the request's CURRENT progress: an adopted
+        # request arrives with generated tokens the supervisor already
+        # holds — re-shipping them would double the shadow's stream
+        self.synced[cid] = (req.steps_done, len(req.generated),
+                            req.prefill_pos, req.prefix_tokens,
+                            req.outcome, req.first_token_t is not None)
+
+    def deltas(self) -> List[dict]:
+        out = []
+        for cid in list(self.reqs):
+            r = self.reqs[cid]
+            mark = (r.steps_done, len(r.generated), r.prefill_pos,
+                    r.prefix_tokens, r.outcome,
+                    r.first_token_t is not None)
+            if mark == self.synced[cid]:
+                continue
+            prev_gen = self.synced[cid][1]
+            out.append({
+                "cid": cid,
+                "outcome": r.outcome,
+                "shed_reason": r.shed_reason,
+                "error": r.error,
+                "steps_done": r.steps_done,
+                "retries": r.retries,
+                "generated_tail": [int(t) for t in
+                                   r.generated[prev_gen:]],
+                "gen_len": len(r.generated),
+                "prefill_pos": r.prefill_pos,
+                "prefix_tokens": r.prefix_tokens,
+                "first_token": r.first_token_t is not None,
+            })
+            if r.is_terminal:
+                del self.reqs[cid]
+                del self.synced[cid]
+            else:
+                self.synced[cid] = mark
+        return out
+
+    def handle(self, header: dict) -> dict:
+        op = header.get("op")
+        eng = self.eng
+        if op == "ping":
+            return {"ok": True, "pid": os.getpid()}
+        if op == "submit":
+            d = header["req"]
+            try:
+                req = eng.submit(
+                    int(d["context_tokens"]), int(d["new_tokens"]),
+                    deadline_ms=d.get("deadline_ms"),
+                    seed=int(d.get("seed", 0)),
+                    payload=dict(d.get("payload") or {}),
+                    prompt_tokens=[int(t) for t in d["prompt_tokens"]],
+                    temperature=float(d.get("temperature", 0.0)),
+                    top_p=float(d.get("top_p", 1.0)),
+                    tenant=d.get("tenant"))
+            except ValueError as e:
+                # caller bug (mis-sized prompt, bad bucket): parity
+                # with the in-process engine, which raises to the
+                # submitter instead of dying
+                return {"ok": False, "etype": "ValueError",
+                        "error": str(e)}
+            # baseline at ZERO, not current state: submit may already
+            # have shed / warm-restored, and that transition must ship
+            # in this very reply
+            self.reqs[int(d["cid"])] = req
+            self.synced[int(d["cid"])] = (0, 0, 0, 0, None, False)
+            return {"ok": True, "deltas": self.deltas(),
+                    "queue_depth": eng.queue_depth}
+        if op == "adopt":
+            from .ipc import deserialize_request
+            req = deserialize_request(header["req"])
+            self._register(int(header["req"]["cid"]), req)
+            eng.adopt(req, source=header.get("source", ""))
+            _flush_prefix()
+            return {"ok": True, "deltas": self.deltas(),
+                    "queue_depth": eng.queue_depth}
+        if op == "step":
+            progressed = eng.step()
+            _flush_prefix()
+            return {"ok": True, "progressed": bool(progressed),
+                    "deltas": self.deltas(),
+                    "step_failures": eng.step_failures,
+                    "queue_depth": eng.queue_depth}
+        if op == "force_retire":
+            eng.run(max_steps=0)
+            return {"ok": True, "deltas": self.deltas(),
+                    "queue_depth": eng.queue_depth}
+        if op == "cancel":
+            req = self.reqs.get(int(header["cid"]))
+            ok = eng.cancel(req) if req is not None else False
+            return {"ok": bool(ok), "deltas": self.deltas(),
+                    "queue_depth": eng.queue_depth}
+        if op == "drain":
+            eng.drain()
+            return {"ok": True}
+        if op == "warmup":
+            return {"ok": True, "warmed": eng.warmup()}
+        if op == "kv":
+            alloc = eng.workload.allocator
+            return {"ok": True, "in_use": alloc.in_use,
+                    "free_pages": alloc.free_pages}
+        if op == "leak_check":
+            return {"ok": True,
+                    "leaks": {str(k): v for k, v in
+                              eng.workload.allocator.leak_check()
+                              .items()}}
+        if op == "stats":
+            return {"ok": True, "stats": eng.stats()}
+        if op == "snapshot":
+            # checksummed KV export of the whole allocator — the
+            # byte-conserved snapshot format crossing the boundary as
+            # one frame (tests + future disaggregated prefill)
+            from .ipc import encode_snapshot
+            snap = eng.workload.allocator.snapshot()
+            return {"ok": True, "_frame": encode_snapshot(snap)}
+        if op == "flush_prefix":
+            _flush_prefix()
+            return {"ok": True}
+        if op == "shutdown":
+            if header.get("graceful"):
+                eng.drain()
+                eng.run()
+                _flush_prefix()
+            return {"ok": True, "deltas": self.deltas(),
+                    "_last": True}
+        return {"ok": False, "etype": "ProtocolError",
+                "error": f"unknown op {op!r}"}
+
+
+def worker_main(conn, spec: dict) -> None:
+    """Child entry point (``multiprocessing`` spawn target): apply env
+    overrides, redirect stderr to the per-slot capture file, build the
+    engine from the (picklable) factory, say hello, then serve RPC
+    frames until EOF/shutdown. Exits 0 on a clean shutdown, 3 when an
+    exception escapes the engine (the supervisor classifies the exit
+    code)."""
+    for k, v in (spec.get("env") or {}).items():
+        os.environ[str(k)] = str(v)
+    path = spec.get("stderr_path")
+    if path:
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            os.dup2(fd, 2)
+            sys.stderr = os.fdopen(2, "w", buffering=1,
+                                   closefd=False)
+        except OSError:
+            pass
+    # the supervisor owns SIGTERM policy; a worker told to terminate
+    # exits promptly and lets the shadows carry its work
+    _signal.signal(_signal.SIGTERM, lambda *a: sys.exit(0))
+    try:
+        from .engine import ServingEngine
+        wl = spec["factory"]()
+        eng = ServingEngine(wl, name=spec.get("name", "worker"),
+                            **(spec.get("engine_kwargs") or {}))
+    except Exception as e:  # noqa: BLE001 — report the build failure
+        try:
+            conn.send_bytes(encode_frame(
+                {"op": "hello", "ok": False,
+                 "error": f"{type(e).__name__}: {e}"}))
+        except Exception:  # noqa: BLE001
+            pass
+        sys.exit(3)
+    alloc = wl.allocator
+    conn.send_bytes(encode_frame({
+        "op": "hello", "ok": True, "pid": os.getpid(),
+        "geometry": {"page_size": alloc.page_size,
+                     "heads": alloc.heads, "head_dim": alloc.head_dim,
+                     "n_pages": alloc.n_pages,
+                     "page_buckets": list(wl.page_buckets),
+                     "batch_buckets": list(wl.batch_buckets)}}))
+    loop = _WorkerLoop(conn, eng)
+    while True:
+        try:
+            data = conn.recv_bytes()
+        except (EOFError, OSError):
+            sys.exit(0)          # supervisor went away: nothing to serve
+        try:
+            header, _body = decode_frame(data)
+        except FrameError as e:
+            # a torn inbound frame: report it and keep the channel —
+            # pipes are message-oriented, the next frame realigns
+            conn.send_bytes(encode_frame(
+                {"op": "error", "etype": "FrameError",
+                 "error": str(e)}))
+            continue
+        try:
+            reply = loop.handle(header)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — an escaped engine error
+            # is a death in thread mode too: report, then die visibly
+            try:
+                conn.send_bytes(encode_frame(
+                    {"op": "error", "etype": type(e).__name__,
+                     "error": f"{type(e).__name__}: {e}",
+                     "fatal": True}))
+            except Exception:  # noqa: BLE001
+                pass
+            sys.exit(3)
+        frame = reply.pop("_frame", None)
+        last = reply.pop("_last", False)
+        conn.send_bytes(frame if frame is not None
+                        else encode_frame(reply))
+        if last:
+            sys.exit(0)
+
+
+# -- supervisor side -------------------------------------------------------
+class _AllocShim:
+    """The allocator face of a remote engine: geometry is local (from
+    the hello frame), levels are RPCs, and a dead worker leaks nothing
+    into the supervisor — its pages died with it."""
+
+    def __init__(self, proxy: "ProcEngine", geometry: dict):
+        self._proxy = proxy
+        self.page_size = int(geometry["page_size"])
+        self.heads = int(geometry["heads"])
+        self.head_dim = int(geometry["head_dim"])
+        self.n_pages = int(geometry["n_pages"])
+
+    @property
+    def in_use(self) -> int:
+        kv = self._proxy._kv_levels()
+        return int(kv.get("in_use", 0))
+
+    @property
+    def free_pages(self) -> int:
+        kv = self._proxy._kv_levels()
+        return int(kv.get("free_pages", self.n_pages))
+
+    def leak_check(self) -> dict:
+        return self._proxy._leak_check()
+
+    def stats(self) -> dict:
+        return {"n_pages": self.n_pages, "page_size": self.page_size,
+                "in_use": self.in_use}
+
+
+class _WorkloadShim:
+    """What the fleet reads off ``engine.workload``: bucket geometry
+    for probe sizing and the allocator shim for leak audits."""
+
+    def __init__(self, proxy: "ProcEngine", geometry: dict):
+        self.page_buckets = tuple(int(p)
+                                  for p in geometry["page_buckets"])
+        self.batch_buckets = tuple(int(b)
+                                   for b in geometry["batch_buckets"])
+        self.allocator = _AllocShim(proxy, geometry)
+
+    def prefill_chunks_needed(self, context_tokens: int) -> int:
+        chunk = max(1, env.TL_TPU_SERVE_PREFILL_CHUNK)
+        return max(1, math.ceil(int(context_tokens) / chunk))
+
+
+class ProcEngine:
+    """Supervisor-side proxy for one subprocess engine worker. Never
+    raises from ``submit``/``adopt``/``cancel``/``drain`` — an IPC
+    failure there is noted and raised at the next ``step()``, the
+    fleet's supervision point, so every death funnels through
+    ``_fail_engine`` with the shadows intact."""
+
+    native_watchdog = True   # step RPCs time out in the recv loop;
+    #                          the fleet must not double-wrap them
+
+    def __init__(self, factory, *, name: str = "worker",
+                 engine_kwargs: Optional[dict] = None,
+                 extra_env: Optional[dict] = None,
+                 step_timeout_ms: Optional[float] = None,
+                 ipc_timeout_ms: Optional[float] = None):
+        import multiprocessing as mp
+        self.name = name
+        self.factory = factory
+        self.step_timeout_ms = (step_timeout_ms or 0.0)
+        self.ipc_timeout_ms = (ipc_timeout_ms
+                               if ipc_timeout_ms is not None
+                               else env.TL_TPU_FLEET_IPC_TIMEOUT_MS)
+        self.requests: List[Request] = []
+        self._by_cid: Dict[int, Request] = {}
+        self._cid_of: Dict[int, int] = {}        # req_id -> cid
+        self._draining = False
+        self._queue_depth = 0
+        self._remote_step_failures = 0
+        self._pending_death: Optional[Exception] = None
+        self._broken = False
+        self.death_info: Optional[dict] = None
+        self._tmpdir = tempfile.mkdtemp(prefix="tl-fleet-worker-")
+        self.stderr_path = os.path.join(self._tmpdir, "stderr.log")
+        ctx = mp.get_context("spawn")
+        self._conn, child_conn = ctx.Pipe(duplex=True)
+        spec = {"name": name, "factory": factory,
+                "engine_kwargs": dict(engine_kwargs or {}),
+                "env": dict(extra_env or {}),
+                "stderr_path": self.stderr_path}
+        self.proc = ctx.Process(target=worker_main,
+                                args=(child_conn, spec), daemon=True,
+                                name=f"tl-{name}")
+        self.spawned_t = time.monotonic()
+        self.proc.start()
+        child_conn.close()
+        self.pid = self.proc.pid
+        hello, _ = self._recv("hello", _SPAWN_DEADLINE_S * 1e3)
+        if not hello.get("ok"):
+            err = hello.get("error", "worker build failed")
+            self.close()
+            raise DeviceLossError(
+                f"worker {name} failed to come up: {err}",
+                site="fleet.ipc", backend="proc")
+        self.pid = int(hello["pid"])
+        self.geometry = dict(hello["geometry"])
+        self.workload = _WorkloadShim(self, self.geometry)
+        self.last_heartbeat = time.monotonic()
+        _trace.inc("fleet.worker.spawn", engine=name)
+        _trace.event("fleet.worker.spawn", "fleet", engine=name,
+                     pid=self.pid)
+
+    # -- transport -----------------------------------------------------
+    def _stderr_tail(self, limit: int = 2000) -> str:
+        try:
+            with open(self.stderr_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - limit))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def _death_error(self) -> DeviceLossError:
+        self.proc.join(timeout=0.5)   # reap, so exitcode is real
+        code = self.proc.exitcode
+        sig = -code if (code is not None and code < 0) else None
+        if sig is not None:
+            try:
+                desc = f"signal {sig} ({_signal.Signals(sig).name})"
+            except ValueError:
+                desc = f"signal {sig}"
+        else:
+            desc = f"exit code {code}"
+        if self.death_info is None:
+            self.death_info = {"pid": self.pid, "exitcode": code,
+                               "signal": sig,
+                               "stderr_tail": self._stderr_tail()}
+            _trace.inc("fleet.worker.death", engine=self.name)
+            _trace.event("fleet.worker.death", "fleet",
+                         engine=self.name, pid=self.pid,
+                         exitcode=code, signal=sig)
+        return DeviceLossError(
+            f"worker {self.name} pid {self.pid} died: {desc}",
+            site="fleet.ipc", backend="proc")
+
+    def _armed_mode(self, op: str) -> Optional[str]:
+        """Visit the ``fleet.ipc`` fault site once per round-trip;
+        ``torn``/``delay``/``kill`` come back as transport damage to
+        apply, anything else raises through (an injected classified
+        error ejects the slot like an organic one)."""
+        try:
+            _faults.maybe_fail("fleet.ipc", engine=self.name, op=op)
+        except _faults.IPCFaultRequest as f:
+            return f.mode
+        except _faults.CorruptionRequest:
+            return "torn"
+        return None
+
+    def _rpc(self, op: str, extra: Optional[dict] = None,
+             timeout_ms: Optional[float] = None) -> dict:
+        if self._broken:
+            raise (self._pending_death
+                   or DeviceLossError(f"worker {self.name} channel "
+                                      f"is down", site="fleet.ipc",
+                                      backend="proc"))
+        timeout_ms = (timeout_ms if timeout_ms is not None
+                      else self.ipc_timeout_ms)
+        t0 = time.monotonic()     # the watchdog covers the WHOLE
+        frame = encode_frame({"op": op, **(extra or {})})   # round-trip
+        mode = self._armed_mode(op)
+        try:
+            if mode == "torn":
+                # flip one payload byte: the far side's crc catches it
+                mid = len(frame) // 2
+                frame = frame[:mid] + bytes([frame[mid] ^ 0xFF]) \
+                    + frame[mid + 1:]
+            elif mode == "delay":
+                time.sleep(max((self.step_timeout_ms or 100.0) * 2,
+                               50.0) / 1e3)
+            elif mode == "kill":
+                os.kill(self.pid, _signal.SIGKILL)
+                self.proc.join(timeout=2.0)
+            self._conn.send_bytes(frame)
+            _trace.inc("fleet.ipc.tx", engine=self.name)
+            _trace.inc("fleet.ipc.bytes_tx", len(frame),
+                       engine=self.name)
+            header, _body = self._recv(
+                op, timeout_ms - (time.monotonic() - t0) * 1e3)
+        except Exception as e:  # noqa: BLE001 — classify + mark broken
+            self._broken = True
+            # a SIGKILL often lands as EPIPE on the SEND before the
+            # recv loop ever polls: convert raw pipe errors on a dead
+            # process into the classified death
+            if isinstance(e, OSError) and not isinstance(e, TLError) \
+                    and not self.proc.is_alive():
+                err = self._death_error()
+                _trace.inc("fleet.ipc.errors", kind=classify(err),
+                           engine=self.name)
+                raise err from e
+            _trace.inc("fleet.ipc.errors", kind=classify(e),
+                       engine=self.name)
+            raise
+        if header.get("op") == "error":
+            err = header.get("error", "worker error")
+            self._broken = True
+            _trace.inc("fleet.ipc.errors", kind="deterministic",
+                       engine=self.name)
+            raise FrameError(f"worker {self.name} reported: {err}")
+        return header
+
+    def _recv(self, op: str, timeout_ms: float):
+        """Blocking receive with the two real liveness signals fused
+        in: the watchdog deadline over the round-trip, and waitpid-
+        backed death detection so a SIGKILL mid-RPC surfaces NOW, not
+        at the deadline."""
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while True:
+            # deadline first: a reply that lands PAST the watchdog is
+            # still a watchdog failure (a stalled round-trip must eject
+            # deterministically, and the late frame would poison the
+            # next RPC's framing if it were accepted)
+            if time.monotonic() > deadline:
+                raise TLTimeoutError(
+                    f"worker {self.name} {op} round-trip exceeded "
+                    f"{timeout_ms:g}ms", site="fleet.ipc")
+            if self._conn.poll(0.005):
+                try:
+                    data = self._conn.recv_bytes()
+                except (EOFError, OSError):
+                    raise self._death_error() from None
+                break
+            if not self.proc.is_alive():
+                # drain anything the worker flushed before dying
+                if self._conn.poll(0):
+                    continue
+                raise self._death_error()
+        _trace.inc("fleet.ipc.rx", engine=self.name)
+        _trace.inc("fleet.ipc.bytes_rx", len(data), engine=self.name)
+        header, body = decode_frame(data)   # FrameError on torn bytes
+        self.last_heartbeat = time.monotonic()
+        return header, body
+
+    def _note_death(self, exc: Exception) -> None:
+        if self._pending_death is None:
+            self._pending_death = exc
+        self._broken = True
+
+    # -- accounting mirror ---------------------------------------------
+    def _record_terminal(self, req: Request) -> None:
+        """Re-record the engine-side terminal accounting in the
+        supervisor's telemetry: worker counters live in another
+        process, but the fleet's audits (counters vs outcomes vs e2e
+        histograms) run here."""
+        outcome = req.outcome
+        if outcome == "result":
+            _trace.inc("serve.completed")
+        elif outcome == "deadline_exceeded":
+            _trace.inc("serve.deadline_exceeded")
+            _trace.event("serve.deadline_exceeded", "serving",
+                         req=req.req_id, steps_done=req.steps_done)
+        elif outcome == "failed":
+            _trace.inc("serve.failed")
+            _trace.event("serve.request_failed", "serving",
+                         req=req.req_id, error=req.error)
+        elif outcome == "canceled":
+            _trace.inc("serve.canceled")
+            _trace.event("serve.canceled", "serving", req=req.req_id,
+                         steps_done=req.steps_done,
+                         mid_prefill=req.needs_prefill)
+        else:
+            _trace.inc("serve.shed", reason=req.shed_reason)
+            _trace.event("serve.shed", "serving", req=req.req_id,
+                         reason=req.shed_reason, error=req.error)
+        _trace.inc("serve.tenant", tenant=req.tenant, outcome=outcome)
+        if req.terminal_t is not None:
+            _hist.observe("serve.e2e.latency",
+                          req.terminal_t - req.submit_t,
+                          outcome=req.outcome)
+        self._cid_of.pop(req.req_id, None)
+
+    def _apply_delta(self, d: dict) -> None:
+        req = self._by_cid.get(int(d["cid"]))
+        if req is None:
+            return
+        req.steps_done = int(d["steps_done"])
+        req.retries = int(d.get("retries", req.retries))
+        tail = [int(t) for t in d.get("generated_tail", [])]
+        if tail:
+            req.generated.extend(tail)
+        req.prefill_pos = int(d.get("prefill_pos", req.prefill_pos))
+        req.prefix_tokens = int(d.get("prefix_tokens",
+                                      req.prefix_tokens))
+        if d.get("first_token") and req.first_token_t is None:
+            now = time.monotonic()
+            req.first_token_t = now
+            _hist.observe("serve.ttft", now - req.submit_t)
+            req.trace.mark("first_token",
+                           token=(req.generated[0]
+                                  if req.generated else None),
+                           ttft_ms=round((now - req.submit_t) * 1e3, 3))
+        outcome = d.get("outcome")
+        if outcome and not req.is_terminal:
+            req.finish(outcome, shed_reason=d.get("shed_reason"),
+                       error=d.get("error"))
+            self._record_terminal(req)
+        if req.is_terminal:
+            self._by_cid.pop(int(d["cid"]), None)
+
+    def _apply_reply(self, reply: dict) -> None:
+        for d in reply.get("deltas", []):
+            self._apply_delta(d)
+        if "queue_depth" in reply:
+            self._queue_depth = int(reply["queue_depth"])
+        if "step_failures" in reply:
+            self._remote_step_failures = int(reply["step_failures"])
+
+    # -- the engine surface the fleet drives ---------------------------
+    def submit(self, context_tokens: int, new_tokens: int = 1,
+               **kwargs) -> Request:
+        req = Request(context_tokens, new_tokens,
+                      deadline_ms=kwargs.get("deadline_ms"),
+                      seed=kwargs.get("seed", 0),
+                      payload=kwargs.get("payload"),
+                      prompt_tokens=kwargs.get("prompt_tokens"),
+                      temperature=kwargs.get("temperature", 0.0),
+                      top_p=kwargs.get("top_p", 1.0),
+                      tenant=kwargs.get("tenant"))
+        self.requests.append(req)
+        cid = req.req_id
+        self._by_cid[cid] = req
+        self._cid_of[req.req_id] = cid
+        try:
+            reply = self._rpc("submit",
+                              {"req": serialize_request(req, cid)})
+        except Exception as e:  # noqa: BLE001 — death waits for step()
+            self._note_death(e)
+            return req          # queued shadow: exported at ejection
+        if not reply.get("ok") and reply.get("etype") == "ValueError":
+            # parity with the in-process engine: a caller bug raises
+            # to the submitter and never lingers in accounting
+            self.requests.remove(req)
+            self._by_cid.pop(cid, None)
+            self._cid_of.pop(req.req_id, None)
+            raise ValueError(reply.get("error", "invalid request"))
+        self._apply_reply(reply)
+        if not req.is_terminal:
+            req.admit()
+            _trace.inc("serve.admitted")
+        return req
+
+    def step(self) -> bool:
+        if self._pending_death is not None:
+            exc, self._pending_death = self._pending_death, None
+            raise exc
+        timeout = (self.step_timeout_ms
+                   if self.step_timeout_ms > 0 else None)
+        reply = self._rpc("step", timeout_ms=timeout)
+        self._apply_reply(reply)
+        return bool(reply.get("progressed"))
+
+    def adopt(self, req: Request, *, source: str = "") -> Request:
+        self.requests.append(req)
+        cid = req.req_id
+        self._by_cid[cid] = req
+        self._cid_of[req.req_id] = cid
+        try:
+            reply = self._rpc("adopt",
+                              {"req": serialize_request(req, cid),
+                               "source": source})
+        except Exception as e:  # noqa: BLE001 — the shadow stays
+            self._note_death(e)  # queued; re-exported when this slot
+            return req           # is ejected in turn
+        self._apply_reply(reply)
+        if not req.is_terminal:
+            req.trace.mark("readmit", engine=self.name, frm=source,
+                           warm=req.prefix_tokens > 0,
+                           steps_done=req.steps_done)
+            _trace.inc("serve.adopted", engine=self.name)
+        return req
+
+    def export_inflight(self) -> List[Request]:
+        """The shadows ARE the export: a SIGKILL'd worker cannot answer
+        an RPC, so failover reads the supervisor's copies — prompt,
+        sampled tokens, deadline, trace identity all intact."""
+        exported = []
+        for r in [x for x in self.requests if not x.is_terminal]:
+            r.prefill_pos = 0
+            r.prefix_tokens = 0
+            self.requests.remove(r)
+            exported.append(r)
+        self._by_cid.clear()
+        self._cid_of.clear()
+        return exported
+
+    def cancel(self, req: Request) -> bool:
+        if req.is_terminal:
+            return False
+        req.cancel_requested = True
+        req.trace.mark("cancel", steps_done=req.steps_done,
+                       mid_prefill=req.needs_prefill)
+        cid = self._cid_of.get(req.req_id)
+        if cid is None or self._broken or not self.proc.is_alive():
+            req.finish("canceled")
+            self._record_terminal(req)
+            return True
+        try:
+            reply = self._rpc("cancel", {"cid": cid})
+        except Exception as e:  # noqa: BLE001
+            self._note_death(e)
+            return True
+        self._apply_reply(reply)
+        return True
+
+    def drain(self) -> None:
+        self._draining = True
+        if self._broken:
+            return
+        try:
+            self._rpc("drain")
+        except Exception as e:  # noqa: BLE001
+            self._note_death(e)
+
+    def warmup(self) -> int:
+        if self._broken:
+            return 0
+        reply = self._rpc("warmup",
+                          timeout_ms=_WARMUP_DEADLINE_S * 1e3)
+        return int(reply.get("warmed", 0))
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        if max_steps == 0:
+            # the fleet's bound-tripped force-retire
+            if self._broken or not self.proc.is_alive():
+                for r in [x for x in self.requests
+                          if not x.is_terminal]:
+                    r.finish("failed",
+                             error="force-retired: worker down")
+                    self._record_terminal(r)
+            else:
+                try:
+                    self._apply_reply(self._rpc("force_retire"))
+                except Exception as e:  # noqa: BLE001
+                    self._note_death(e)
+            return 0
+        bound = max_steps if max_steps is not None else self.pump_bound()
+        n = 0
+        while n < bound:
+            if not self.step():
+                return n
+            n += 1
+        return n
+
+    def pump_bound(self) -> int:
+        chunk = max(1, env.TL_TPU_SERVE_PREFILL_CHUNK)
+        total = sum(r.new_tokens
+                    + math.ceil(r.context_tokens / chunk)
+                    for r in self.requests) or 1
+        return 20 * total + 100
+
+    def pull_snapshot(self):
+        """Fetch the worker's whole live KV as one checksummed
+        snapshot frame (verified on decode) — the cross-process
+        counterpart of ``allocator.snapshot()``."""
+        from .ipc import decode_snapshot
+        if self._broken:
+            raise (self._pending_death or
+                   DeviceLossError(f"worker {self.name} is down",
+                                   site="fleet.ipc", backend="proc"))
+        frame = encode_frame({"op": "snapshot"})
+        self._conn.send_bytes(frame)
+        _trace.inc("fleet.ipc.tx", engine=self.name)
+        _, _ = None, None
+        deadline = time.monotonic() + self.ipc_timeout_ms / 1e3
+        while not self._conn.poll(0.005):
+            if not self.proc.is_alive():
+                raise self._death_error()
+            if time.monotonic() > deadline:
+                raise TLTimeoutError(
+                    f"worker {self.name} snapshot round-trip timed "
+                    f"out", site="fleet.ipc")
+        data = self._conn.recv_bytes()
+        _trace.inc("fleet.ipc.rx", engine=self.name)
+        _trace.inc("fleet.ipc.bytes_rx", len(data), engine=self.name)
+        self.last_heartbeat = time.monotonic()
+        return decode_snapshot(data)
+
+    # -- levels / accounting -------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue_depth
+
+    @property
+    def step_failures(self) -> int:
+        return self._remote_step_failures
+
+    def outcomes(self) -> Dict[str, int]:
+        out = {"result": 0, "shed": 0, "deadline_exceeded": 0,
+               "failed": 0, "canceled": 0, "pending": 0}
+        for r in self.requests:
+            out[r.outcome or "pending"] += 1
+        return out
+
+    def _kv_levels(self) -> dict:
+        if self._broken or not self.proc.is_alive():
+            return {"in_use": 0, "free_pages": 0}
+        try:
+            return self._rpc("kv")
+        except Exception as e:  # noqa: BLE001
+            self._note_death(e)
+            return {"in_use": 0, "free_pages": 0}
+
+    def _leak_check(self) -> dict:
+        if self._broken or not self.proc.is_alive():
+            return {}
+        try:
+            return dict(self._rpc("leak_check").get("leaks", {}))
+        except Exception as e:  # noqa: BLE001
+            self._note_death(e)
+            return {}
+
+    def rss_kb(self) -> Optional[int]:
+        try:
+            with open(f"/proc/{self.pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1])
+        except (OSError, ValueError, IndexError):
+            return None
+        return None
+
+    def proc_health(self) -> dict:
+        return {
+            "pid": self.pid,
+            "alive": self.proc.is_alive(),
+            "rss_kb": self.rss_kb(),
+            "heartbeat_age_ms": round(
+                (time.monotonic() - self.last_heartbeat) * 1e3, 1),
+            "uptime_s": round(time.monotonic() - self.spawned_t, 3),
+        }
+
+    def stats(self) -> dict:
+        out = {"engine": self.name, "isolation": "proc",
+               "pid": self.pid, "alive": self.proc.is_alive(),
+               "requests": len(self.requests),
+               "outcomes": self.outcomes(),
+               "queue_depth": self._queue_depth,
+               "draining": self._draining}
+        if not self._broken and self.proc.is_alive():
+            try:
+                out["worker"] = self._rpc("stats").get("stats", {})
+            except Exception as e:  # noqa: BLE001
+                self._note_death(e)
+        return out
+
+    def close(self, graceful: bool = False,
+              timeout_s: float = 5.0) -> Optional[int]:
+        """Tear the worker down; returns its exit code. Graceful sends
+        the shutdown RPC (drain + finish + prefix flush, exit 0);
+        otherwise (and as escalation) terminate → kill."""
+        try:
+            if graceful and not self._broken and self.proc.is_alive():
+                try:
+                    reply = self._rpc("shutdown", {"graceful": True},
+                                      timeout_ms=max(
+                                          self.ipc_timeout_ms,
+                                          env.TL_TPU_FLEET_DRAIN_TIMEOUT_MS))
+                    self._apply_reply(reply)
+                except Exception:  # noqa: BLE001 — escalate below
+                    pass
+            if self.proc.is_alive():
+                self.proc.join(timeout=timeout_s if graceful else 0.0)
+            if self.proc.is_alive():
+                self.proc.terminate()
+                self.proc.join(timeout=2.0)
+            if self.proc.is_alive():
+                self.proc.kill()
+                self.proc.join(timeout=2.0)
+        finally:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        return self.proc.exitcode

@@ -772,11 +772,17 @@ def summarize_fleet(records) -> dict:
     per-engine dispatch shares, failovers with their re-dispatch /
     warm-restore / lost tallies, probe + readmission cycles, and the
     per-engine step-latency digests — what the ``fleet`` subcommand
-    and the fleet chaos soak print (docs/serving.md)."""
+    and the fleet chaos soak print (docs/serving.md). Under
+    ``TL_TPU_FLEET_ISOLATION=proc`` the summary also carries worker
+    process lifetimes (spawn/death events with pids and kill signals),
+    kill->readmit latency from ``fleet.readmit`` ``down_ms`` attrs, and
+    the ``fleet.ipc.*`` frame-transport counters."""
     counters: dict = {}
     failover_events: list = []
     readmit_events: list = []
     probe_fail_events: list = []
+    spawn_events: list = []
+    death_events: list = []
     hists: dict = {}
     for r in records:
         name = r.get("name")
@@ -788,15 +794,23 @@ def summarize_fleet(records) -> dict:
             if name == "fleet.failover":
                 failover_events.append(
                     {k: attrs.get(k) for k in ("fleet", "engine",
-                                               "error")})
+                                               "error", "pid", "signal")})
             elif name == "fleet.readmit":
                 readmit_events.append(
                     {k: attrs.get(k) for k in ("fleet", "engine",
-                                               "restarts")})
+                                               "restarts", "down_ms",
+                                               "pid")})
             elif name == "fleet.probe_failed":
                 probe_fail_events.append(
                     {k: attrs.get(k) for k in ("fleet", "engine", "error",
                                                "next_backoff_ms")})
+            elif name == "fleet.worker.spawn":
+                spawn_events.append(
+                    {k: attrs.get(k) for k in ("engine", "pid")})
+            elif name == "fleet.worker.death":
+                death_events.append(
+                    {k: attrs.get(k) for k in ("engine", "pid",
+                                               "exitcode", "signal")})
         elif r.get("type") == "histogram" and \
                 name == "fleet.step.latency":
             from ..observability.histogram import Histogram
@@ -842,6 +856,20 @@ def summarize_fleet(records) -> dict:
         "readmit_events": readmit_events,
         "step_latency": {e: digest_ms(h)
                          for e, h in sorted(hists.items()) if h.count},
+        # -- process isolation (TL_TPU_FLEET_ISOLATION=proc) -----------
+        "worker_spawns": by_label("fleet.worker.spawn", "engine"),
+        "worker_deaths": by_label("fleet.worker.death", "engine"),
+        "worker_spawn_events": spawn_events,
+        "worker_death_events": death_events,
+        "quarantined": by_label("fleet.quarantined", "engine"),
+        "ipc_tx": by_label("fleet.ipc.tx", "engine"),
+        "ipc_rx": by_label("fleet.ipc.rx", "engine"),
+        "ipc_bytes_tx": by_label("fleet.ipc.bytes_tx", "engine"),
+        "ipc_bytes_rx": by_label("fleet.ipc.bytes_rx", "engine"),
+        "ipc_errors": by_label("fleet.ipc.errors", "kind"),
+        "kill_to_readmit_ms": sorted(
+            ev["down_ms"] for ev in readmit_events
+            if ev.get("down_ms") is not None),
     }
 
 
@@ -883,6 +911,42 @@ def format_fleet_report(records) -> str:
             lines.append(f"    {ev.get('engine')} probe failed "
                          f"({ev.get('error')}), next backoff "
                          f"{ev.get('next_backoff_ms')}ms")
+    if s["worker_spawns"] or s["worker_deaths"]:
+        lines.append("process workers (isolation=proc):")
+        for eng in sorted(set(s["worker_spawns"])
+                          | set(s["worker_deaths"])):
+            pids = [str(ev.get("pid")) for ev in s["worker_spawn_events"]
+                    if ev.get("engine") == eng]
+            lines.append(
+                f"  {eng}: spawned={int(s['worker_spawns'].get(eng, 0))} "
+                f"died={int(s['worker_deaths'].get(eng, 0))} "
+                f"pids=[{', '.join(pids)}]")
+            for ev in s["worker_death_events"]:
+                if ev.get("engine") != eng:
+                    continue
+                cause = (f"signal {ev['signal']}" if ev.get("signal")
+                         else f"exit code {ev.get('exitcode')}")
+                lines.append(f"    pid {ev.get('pid')} died ({cause})")
+        for eng, n in s["quarantined"].items():
+            lines.append(f"  {eng}: quarantined x{int(n)} (crash loop)")
+        lat = s["kill_to_readmit_ms"]
+        if lat:
+            lines.append(
+                f"  kill -> readmit latency: n={len(lat)} "
+                f"min={lat[0]:g}ms p50={lat[len(lat) // 2]:g}ms "
+                f"max={lat[-1]:g}ms")
+    if s["ipc_tx"] or s["ipc_rx"]:
+        lines.append("ipc frames:")
+        for eng in sorted(set(s["ipc_tx"]) | set(s["ipc_rx"])):
+            lines.append(
+                f"  {eng}: tx={int(s['ipc_tx'].get(eng, 0))} "
+                f"rx={int(s['ipc_rx'].get(eng, 0))} "
+                f"bytes_tx={int(s['ipc_bytes_tx'].get(eng, 0))} "
+                f"bytes_rx={int(s['ipc_bytes_rx'].get(eng, 0))}")
+        if s["ipc_errors"]:
+            err = " ".join(f"{k}={int(v)}" for k, v in
+                           s["ipc_errors"].items())
+            lines.append(f"  errors: {err}")
     if s["step_latency"]:
         lines.append("per-engine step latency:")
         for eng, d in s["step_latency"].items():

@@ -1442,6 +1442,377 @@ def run_fleet(out: Path, seed: int, n_requests: int) -> int:
     return 0 if ok else 1
 
 
+def run_fleet_proc(out: Path, seed: int, n_requests: int) -> int:
+    """Process-isolated fleet chaos soak (the CI ``fleet-proc-chaos``
+    gate; docs/serving.md "Process isolation & crash containment"):
+    the multi-tenant storm through a 3-worker ``Fleet`` running
+    ``TL_TPU_FLEET_ISOLATION=proc`` — every slot a real subprocess
+    behind the checksummed frame protocol — with REAL deaths instead
+    of injected Python exceptions: one worker SIGKILLed mid-stream,
+    a second SIGKILLed mid-prefill, and one torn IPC frame injected
+    once the fleet is whole again. Asserts the SIGKILL-proof zero-loss
+    contract:
+
+    - every request reaches a terminal outcome with ZERO lost (the
+      supervisor's shadow requests survive both SIGKILLs and the torn
+      frame, and healthy peers adopt every victim);
+    - both SIGKILLed workers eject within the kill step, restart with
+      a NEW pid, and re-admit after their end-to-end probes — and the
+      first victim receives fresh dispatches afterwards;
+    - at least one failover re-dispatch restores WARM from the disk
+      prefix tier (the tier written by a process that is now dead);
+    - every ``TokenStream`` opened before the first kill yields its
+      full token budget across the SIGKILL;
+    - the torn frame classifies ``deterministic`` (``fleet.ipc.errors``)
+      and is non-fatal to the supervisor: the slot ejects, restarts,
+      and the storm continues;
+    - each ``engine_failover`` flight dump names the dead PID, exit
+      signal, and re-routed trace ids all belonging to this run, and
+      every dump is atomic;
+    - counters / e2e histograms / per-request outcomes agree
+      fleet-wide (the supervisor re-records worker-side accounting),
+      causal chains close, KV slabs balance to zero, and the per-slot
+      fleet step p99 stays within budget.
+    """
+    import functools
+    import random
+    import signal as _sig
+
+    os.environ["TL_TPU_TRACE"] = "1"
+    import tilelang_mesh_tpu  # noqa: F401  (package init before serving)
+    from tilelang_mesh_tpu import observability as obs
+    from tilelang_mesh_tpu.observability import flight as _flight
+    from tilelang_mesh_tpu.observability import histogram as _hist
+    from tilelang_mesh_tpu.resilience import inject
+    from tilelang_mesh_tpu.serving import (Fleet,
+                                           default_workload_factory,
+                                           reset_prefix_cache)
+
+    budget_ms = 0.0
+    for var in ("TL_TPU_FLEET_P99_BUDGET_MS", "TL_TPU_SERVE_P99_BUDGET_MS"):
+        try:
+            budget_ms = float(os.environ.get(var) or 0.0)
+        except ValueError:
+            budget_ms = 0.0
+        if budget_ms > 0:
+            break
+    if budget_ms <= 0:
+        budget_ms = 400.0   # CI CPU ceiling + IPC round-trip headroom
+    # the disk prefix tier is the CROSS-PROCESS transport here: workers
+    # publish to it after every step, adopters restore warm from it
+    os.environ["TL_TPU_SERVE_PREFIX_DIR"] = str(out / "prefix")
+    reset_prefix_cache()
+    _reset_serving_state()
+    _flight.configure(dump_dir=out / "flight")
+
+    rng = random.Random(seed)
+    tenants = ("acme", "globex", "initech")
+    # module-level factory + partial: closures cannot cross the
+    # multiprocessing spawn boundary
+    factory = functools.partial(default_workload_factory, n_pages=512,
+                                page_size=8, heads=2, head_dim=64,
+                                batch_buckets=(8,), page_buckets=(2, 4))
+
+    import time as _time
+    t_spawn0 = _time.perf_counter()
+    fleet = Fleet(factory, n_engines=3, isolation="proc",
+                  name="fleet-proc-soak")
+    spawn_s = _time.perf_counter() - t_spawn0
+    first_pids = {s.name: s.engine.pid for s in fleet.slots}
+    t_warm0 = _time.perf_counter()
+    warmed = fleet.warmup()
+    warm_s = _time.perf_counter() - t_warm0
+    ps = 8
+
+    if n_requests < 20:
+        print(f"[chaos-fleet-proc] --requests {n_requests} is below the "
+              f"soak minimum (20): the kill/readmit/drain phases need "
+              f"room to fire", file=sys.stderr)  # noqa: T201
+        return 2
+
+    shared = [[rng.randrange(1 << 20) for _ in range(4 * ps)]
+              for _ in range(2)]
+
+    def make_request():
+        kw = dict(seed=rng.randrange(1 << 30),
+                  tenant=rng.choice(tenants))
+        if rng.random() < 0.45:
+            prompt = list(rng.choice(shared))
+            kw.update(context_tokens=len(prompt), prompt_tokens=prompt,
+                      new_tokens=rng.choice((1, 2, 3)))
+        else:
+            kw.update(context_tokens=rng.choice((16, 24, 32)),
+                      new_tokens=rng.choice((1, 2)))
+        if rng.random() < 0.15:
+            kw.update(deadline_ms=4000.0)
+        return kw
+
+    drain_wave = max(4, n_requests // 25)
+    post_wave = min(24, max(8, n_requests // 20))
+    n_streams = 3
+    burst = 12
+    prefill_burst = 8
+    main_wave = (n_requests - drain_wave - post_wave - n_streams
+                 - burst - prefill_burst)
+    phase1 = max(main_wave // 2, 1)
+    print(f"[chaos-fleet-proc] seed={seed}: {n_requests} requests over "  # noqa: T201
+          f"{len(fleet.slots)} subprocess workers "
+          f"(pids {sorted(first_pids.values())}, spawned in "
+          f"{spawn_s:.1f}s, {warmed} kernels warmed in {warm_s:.1f}s); "
+          f"SIGKILL mid-stream + mid-prefill, one torn frame, p99 "
+          f"budget {budget_ms:g}ms")
+    t0 = _time.perf_counter()
+
+    def slot_holding(req):
+        for s in fleet.slots:
+            if s.engine is not None and req in s.engine.requests:
+                return s
+        return None
+
+    # seed the shared prefix tier (a worker process writes it; that
+    # worker may be dead by the time the pages restore)
+    for prompt in shared:
+        fleet.submit(context_tokens=len(prompt), prompt_tokens=prompt,
+                     new_tokens=1, seed=rng.randrange(1 << 30),
+                     tenant="acme")
+    fleet.run()
+
+    # storm phase 1
+    submitted = 0
+    while submitted < phase1:
+        wave = min(rng.randrange(6, 25), phase1 - submitted)
+        for _ in range(wave):
+            fleet.submit(**make_request())
+        submitted += wave
+        for _ in range(rng.randrange(1, 4)):
+            fleet.step()
+
+    # pre-kill burst + streams, then a couple of pumps so the streams
+    # are genuinely mid-flight when the SIGKILL lands
+    for _ in range(burst):
+        prompt = list(rng.choice(shared))
+        fleet.submit(context_tokens=len(prompt), prompt_tokens=prompt,
+                     new_tokens=rng.choice((2, 3, 4)),
+                     seed=rng.randrange(1 << 30),
+                     tenant=rng.choice(tenants))
+    streams = [fleet.stream(context_tokens=len(shared[0]),
+                            prompt_tokens=list(shared[0]),
+                            new_tokens=3, seed=rng.randrange(1 << 30),
+                            tenant=rng.choice(tenants))
+               for _ in range(n_streams)]
+    fleet.step()
+
+    # SIGKILL #1: the worker holding the first stream, killed for real
+    v1 = (slot_holding(streams[0].request)
+          or next(s for s in fleet.slots if s.state == "live"))
+    pid1 = v1.engine.pid
+    live_before = {s.name for s in fleet.slots if s.state == "live"}
+    os.kill(pid1, _sig.SIGKILL)
+    fleet.step()
+    eject1_ok = v1.state != "live" and v1.name in live_before
+
+    # SIGKILL #2: queue whole-page-prompt prefill work WITHOUT pumping,
+    # then kill a second worker holding some of it mid-prefill
+    for _ in range(prefill_burst):
+        prompt = list(rng.choice(shared))
+        fleet.submit(context_tokens=len(prompt), prompt_tokens=prompt,
+                     new_tokens=rng.choice((1, 2)),
+                     seed=rng.randrange(1 << 30),
+                     tenant=rng.choice(tenants))
+    v2 = next((s for s in fleet.slots
+               if s.state == "live" and s is not v1
+               and s.engine is not None and s.engine.queue_depth > 0),
+              None) or next(s for s in fleet.slots
+                            if s.state == "live" and s is not v1)
+    pid2 = v2.engine.pid
+    live_before2 = {s.name for s in fleet.slots if s.state == "live"}
+    os.kill(pid2, _sig.SIGKILL)
+    fleet.step()
+    eject2_ok = v2.state != "live" and v2.name in live_before2
+
+    # back to a whole fleet before the torn frame (a torn frame while
+    # two slots are still down could leave zero adopters — the zero-
+    # loss gate needs a healthy peer to exist, as in any real topology)
+    readmitted_mid = fleet.await_readmission(timeout_s=90.0)
+
+    # storm phase 2 with ONE torn frame armed: some RPC in this phase
+    # gets a flipped byte; the slot ejects (deterministic FrameError),
+    # restarts, and the storm rides through it
+    with inject("fleet.ipc", kind="torn", times=1) as torn_spec:
+        while submitted < main_wave:
+            wave = min(rng.randrange(6, 25), main_wave - submitted)
+            for _ in range(wave):
+                fleet.submit(**make_request())
+            submitted += wave
+            for _ in range(rng.randrange(1, 4)):
+                fleet.step()
+        torn_fired = torn_spec._fired >= 1
+
+    readmitted = fleet.await_readmission(timeout_s=90.0)
+
+    # post-readmission wave: victim #1 must receive NEW dispatches
+    # through its restarted process. Steps are interleaved so queue
+    # depths and latency windows stay live; the horizon extends
+    # (bounded) because the router legitimately favors the LAST-reset
+    # slot (the torn-frame victim, empty latency window) until its
+    # window refills — the gate still demands an ORGANIC re-dispatch
+    # to the SIGKILL victim, never a forced one
+    disp_before = obs.metrics_summary()["fleet"]["dispatch"]
+    for _ in range(post_wave):
+        fleet.submit(**make_request())
+        fleet.step()
+    fleet.run()
+    extra = 0
+    while (obs.metrics_summary()["fleet"]["dispatch"]
+           .get(v1.name, 0) <= disp_before.get(v1.name, 0)
+           and extra < 3 * post_wave):
+        fleet.submit(**make_request())
+        fleet.step()
+        extra += 1
+    fleet.run()
+    disp_after = obs.metrics_summary()["fleet"]["dispatch"]
+    victim_served = (disp_after.get(v1.name, 0)
+                     > disp_before.get(v1.name, 0))
+
+    # the streams opened before SIGKILL #1 keep yielding
+    stream_tokens = [sum(1 for _ in s) for s in streams]
+
+    fleet.drain()
+    for _ in range(drain_wave):
+        fleet.submit(**make_request())
+    fleet.run()
+    wall_s = _time.perf_counter() - t0
+
+    # -- the fleet-proc contract checks --------------------------------
+    new_pids = {s.name: (s.engine.pid if s.engine is not None else None)
+                for s in fleet.slots}
+    leaks = {e: leak for e, leak in fleet.leak_check().items() if leak}
+    in_use = sum(s.engine.workload.allocator.in_use
+                 for s in fleet.slots if s.engine is not None)
+    outcomes = fleet.outcomes()
+    summary = obs.metrics_summary()
+    counters = summary["serving"]
+    counters_all = summary.get("counters", {})
+    fleet_sec = summary["fleet"] or {}
+    e2e_by_outcome, acct_ok = _serve_accounting(fleet, counters)
+    non_terminal = [r.req_id for r in fleet.requests
+                    if not r.is_terminal]
+    incomplete = [r.req_id for r in fleet.requests
+                  if r.is_terminal and not r.trace.complete]
+    p99s = {}
+    for (hname, labels), h in _hist.histograms():
+        if hname == "fleet.step.latency" and h.count:
+            p99s[dict(labels).get("engine", "?")] = h.quantile(0.99) * 1e3
+    worst_p99 = max(p99s.values()) if p99s else None
+    trace_ids = {r.trace_id for r in fleet.requests}
+    flight_audit = _audit_flight_dumps(out / "flight")
+    failover_heads = []
+    for fname in flight_audit["files"]:
+        try:
+            head = json.loads(
+                (out / "flight" / fname).read_text().splitlines()[0])
+        except Exception:  # noqa: BLE001 — atomicity gated separately
+            continue
+        if head.get("reason") == "engine_failover":
+            failover_heads.append(head)
+
+    def dump_names_dead_pid(pid, victim_name):
+        return any(
+            h.get("attrs", {}).get("victim") == victim_name
+            and h.get("attrs", {}).get("pid") == pid
+            and h.get("attrs", {}).get("signal") == int(_sig.SIGKILL)
+            and set(h["attrs"].get("redispatched_trace_ids") or [])
+            <= trace_ids
+            for h in failover_heads)
+
+    ipc_tx = any(k.startswith("fleet.ipc.tx") for k in counters_all)
+    torn_classified = any(
+        k.startswith("fleet.ipc.errors") and "kind=deterministic" in k
+        for k in counters_all)
+    tenants_seen = set(counters.get("tenants", {}))
+    checks = {
+        "all_terminal": not non_terminal,
+        "zero_lost": (not non_terminal
+                      and fleet_sec.get("shed_unroutable", 0) == 0),
+        "kv_slabs_balance_zero": not leaks and in_use == 0,
+        "sigkilled_workers_failed_over": fleet.failovers >= 2
+        and v1.name != v2.name,
+        "ejected_within_kill_step": eject1_ok and eject2_ok,
+        "warm_restore_redispatch": fleet_sec.get("warm_restores",
+                                                 0) >= 1,
+        "torn_frame_ejected_and_recovered": torn_fired
+        and fleet.failovers >= 3 and torn_classified,
+        "victims_restarted_new_pid": all(
+            new_pids.get(v.name) not in (None, first_pids[v.name])
+            for v in (v1, v2)),
+        "victims_readmitted_after_probe": readmitted and readmitted_mid
+        and all(s.state == "live" for s in fleet.slots)
+        and all(fleet_sec.get("readmits", {}).get(v.name, 0) >= 1
+                for v in (v1, v2)),
+        "victim_served_after_readmit": victim_served,
+        "streams_survived_sigkill": all(n == 3 for n in stream_tokens),
+        "ipc_counters_present": ipc_tx,
+        "per_tenant_accounting": set(tenants) <= tenants_seen,
+        "accounting_matches_histograms": acct_ok,
+        "causal_chains_complete": not incomplete,
+        "failover_flight_dump_names_dead_pid":
+        dump_names_dead_pid(pid1, v1.name)
+        and dump_names_dead_pid(pid2, v2.name),
+        "flight_dumps_atomic": flight_audit["atomic"],
+        "fleet_p99_within_budget": worst_p99 is not None
+        and worst_p99 <= budget_ms,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "mode": "fleet-proc", "seed": seed,
+        "requests": len(fleet.requests),
+        "engines": [s.name for s in fleet.slots],
+        "isolation": "proc",
+        "victims": {v1.name: pid1, v2.name: pid2},
+        "first_pids": first_pids, "final_pids": new_pids,
+        "spawn_s": round(spawn_s, 3),
+        "post_wave_dispatch": {"before": disp_before,
+                               "after": disp_after},
+        "wall_s": round(wall_s, 3), "warmup_s": round(warm_s, 3),
+        "warmed_kernels": warmed,
+        "outcomes": outcomes,
+        "shed_by_reason": counters["shed"],
+        "tenants": counters.get("tenants", {}),
+        "fleet": fleet_sec,
+        "ipc": {k: v for k, v in sorted(counters_all.items())
+                if k.startswith("fleet.ipc.")
+                or k.startswith("fleet.worker.")},
+        "stream_tokens": stream_tokens,
+        "step_p99_ms": {e: round(v, 3) for e, v in sorted(p99s.items())},
+        "step_p99_budget_ms": budget_ms,
+        "kv_leaks": {e: leak for e, leak in leaks.items()},
+        "e2e_by_outcome": e2e_by_outcome,
+        "non_terminal_requests": non_terminal,
+        "causally_incomplete_requests": incomplete,
+        "flight": flight_audit,
+        "checks": checks, "ok": ok,
+    }
+    trace_path = out / "fleet_proc_trace.jsonl"
+    obs.write_jsonl(str(trace_path))
+    (out / "fleet_proc_report.json").write_text(
+        json.dumps(report, indent=2))
+    from ..tools.analyzer import format_fleet_report, format_serve_report
+    records = obs.read_jsonl(str(trace_path))
+    summary_txt = (format_fleet_report(records) + "\n\n"
+                   + format_serve_report(records))
+    (out / "fleet_proc_report.txt").write_text(summary_txt + "\n")
+    print(summary_txt)  # noqa: T201
+    for k, v in checks.items():
+        print(f"[chaos-fleet-proc] {k}: {'OK' if v else 'FAIL'}")  # noqa: T201
+    print(f"[chaos-fleet-proc] victims={{{v1.name}: {pid1}, "  # noqa: T201
+          f"{v2.name}: {pid2}}} outcomes={outcomes} "
+          f"warm={fleet_sec.get('warm_restores', 0)} in {wall_s:.1f}s "
+          f"-> {'PASS' if ok else 'FAIL'}; artifacts in {out}/")
+    fleet.shutdown(graceful=True)
+    return 0 if ok else 1
+
+
 def run_verify(out: Path, seed: int) -> int:
     """The default mode: seeded corruption on the comm interpret paths,
     the differential selfcheck must catch every scenario."""
@@ -1521,9 +1892,22 @@ def main(argv=None) -> int:
                          "serving again, streams yielding across the "
                          "kill, and fleet p99 within budget "
                          "(docs/serving.md)")
+    ap.add_argument("--fleet-proc", action="store_true",
+                    help="process-isolated fleet soak: the storm "
+                         "through a 3-subprocess-worker Fleet "
+                         "(TL_TPU_FLEET_ISOLATION=proc) with one "
+                         "worker SIGKILLed mid-stream, a second "
+                         "mid-prefill, and a torn IPC frame armed; "
+                         "asserts zero lost requests, victims "
+                         "restarted under new pids and re-admitted, "
+                         ">= 1 warm restore from the disk prefix "
+                         "tier, streams yielding across the SIGKILL, "
+                         "and flight dumps naming the dead pids "
+                         "(docs/serving.md)")
     ap.add_argument("--requests", type=int, default=500,
                     help="request count for --serve / --serve-mesh / "
-                         "--serve-lifecycle / --fleet (default 500)")
+                         "--serve-lifecycle / --fleet / --fleet-proc "
+                         "(default 500)")
     args = ap.parse_args(argv)
 
     try:
@@ -1555,6 +1939,9 @@ def main(argv=None) -> int:
                                                          args.requests))
     if args.fleet:
         return per_seed(lambda d, s: run_fleet(d, s, args.requests))
+    if args.fleet_proc:
+        return per_seed(lambda d, s: run_fleet_proc(d, s,
+                                                    args.requests))
     return per_seed(run_verify)
 
 
